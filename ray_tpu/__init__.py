@@ -1,0 +1,55 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+Tasks, actors, a shared-memory object store, a distributed resource scheduler
+with placement groups, and an ML stack (data/train/tune/serve/rllib) designed
+around JAX/XLA/Pallas/pjit. See SURVEY.md at the repo root for the capability
+map against the reference system.
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu import exceptions
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker_api import (available_resources, cancel,
+                                         cluster_resources, get, get_actor,
+                                         init, is_initialized, kill, nodes,
+                                         put, shutdown, timeline, wait)
+from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.remote_function import RemoteFunction
+
+
+def remote(*args, **kwargs):
+    """@ray_tpu.remote decorator for tasks and actors.
+
+    Reference parity: python/ray/_private/worker.py:3137.
+    """
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        return _make_remote(args[0], {})
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. "
+                        "@remote(num_tpus=1)")
+
+    def decorator(fn_or_cls):
+        return _make_remote(fn_or_cls, kwargs)
+    return decorator
+
+
+def _make_remote(fn_or_cls, options):
+    if isinstance(fn_or_cls, type):
+        return ActorClass(fn_or_cls, options)
+    return RemoteFunction(fn_or_cls, options)
+
+
+def method(**kwargs):
+    """Decorator for actor methods, e.g. @method(num_returns=2)."""
+    def decorator(fn):
+        fn.__ray_tpu_method_options__ = kwargs
+        return fn
+    return decorator
+
+
+__all__ = [
+    "__version__", "init", "shutdown", "is_initialized", "remote", "method",
+    "get", "put", "wait", "kill", "cancel", "get_actor", "nodes",
+    "cluster_resources", "available_resources", "timeline",
+    "ObjectRef", "ActorClass", "ActorHandle", "RemoteFunction", "exceptions",
+]

@@ -1,0 +1,152 @@
+"""Shared control-plane datatypes (TaskSpec, ActorSpec, resources, etc.).
+
+Equivalent of the reference's protobuf common.proto (TaskSpec, Address) —
+plain dataclasses since the RPC layer is pickle-based.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
+                                  PlacementGroupID, TaskID, WorkerID)
+
+# Argument kinds
+ARG_INLINE = 0   # serialized bytes shipped in the task spec
+ARG_REF = 1      # ObjectID; executor resolves before running
+
+
+@dataclass
+class TaskArg:
+    kind: int
+    data: bytes = b""                       # for ARG_INLINE: serialized value
+    object_id: Optional[ObjectID] = None    # for ARG_REF
+    owner_address: str = ""
+
+
+@dataclass
+class SchedulingStrategy:
+    """DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP"""
+    kind: str = "DEFAULT"
+    node_id: Optional[NodeID] = None
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    name: str = ""
+    # Function is exported to the GCS function table under this key.
+    function_id: str = ""
+    args: List[TaskArg] = field(default_factory=list)
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    scheduling: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    owner_address: str = ""
+    owner_worker_id: Optional[WorkerID] = None
+    # Actor-task fields
+    actor_id: Optional[ActorID] = None
+    method_name: str = ""
+    seq_no: int = 0
+    # Actor-creation fields
+    is_actor_creation: bool = False
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    is_async_actor: bool = False
+    actor_name: str = ""
+    namespace: str = ""
+    runtime_env: Optional[dict] = None
+    # Generator tasks
+    is_generator: bool = False
+
+    def scheduling_class(self) -> Tuple:
+        """Tasks with the same class can reuse worker leases."""
+        return (
+            tuple(sorted(self.resources.items())),
+            self.scheduling.kind,
+            self.scheduling.node_id,
+            self.scheduling.placement_group_id,
+            self.scheduling.bundle_index,
+            self.runtime_env is not None and tuple(sorted(map(str, self.runtime_env.items()))),
+        )
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: str                     # raylet RPC address host:port
+    object_store_address: str = ""   # same daemon, store endpoints
+    resources_total: Dict[str, float] = field(default_factory=dict)
+    resources_available: Dict[str, float] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    is_head: bool = False
+    last_heartbeat: float = field(default_factory=time.time)
+    # TPU topology: slice name / topology this host belongs to, if any.
+    slice_id: str = ""
+    hostname: str = "localhost"
+
+
+# Actor lifecycle states (reference: gcs.proto ActorTableData.ActorState)
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    job_id: JobID
+    state: str = ACTOR_PENDING
+    address: str = ""          # worker RPC address hosting the actor
+    worker_id: Optional[WorkerID] = None
+    node_id: Optional[NodeID] = None
+    name: str = ""
+    namespace: str = ""
+    class_name: str = ""
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: str = ""
+    owner_address: str = ""
+    creation_spec: Optional[TaskSpec] = None
+    resources: Dict[str, float] = field(default_factory=dict)
+
+
+# Placement group states
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_REMOVED = "REMOVED"
+PG_RESCHEDULING = "RESCHEDULING"
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: PlacementGroupID
+    name: str = ""
+    strategy: str = "PACK"
+    bundles: List[Dict[str, float]] = field(default_factory=list)
+    state: str = PG_PENDING
+    # bundle index -> NodeID
+    bundle_nodes: Dict[int, NodeID] = field(default_factory=dict)
+    creator_job: Optional[JobID] = None
+
+
+@dataclass
+class JobInfo:
+    job_id: JobID
+    driver_address: str = ""
+    start_time: float = field(default_factory=time.time)
+    end_time: float = 0.0
+    alive: bool = True
+    entrypoint: str = ""
+    metadata: Dict[str, str] = field(default_factory=dict)

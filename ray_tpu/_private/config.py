@@ -1,0 +1,120 @@
+"""Central config registry.
+
+Equivalent in capability to the reference's RayConfig macro registry
+(src/ray/common/ray_config_def.h): every knob has a typed default and is
+overridable per-process via ``RAY_TPU_<NAME>`` environment variables or a
+cluster-wide ``system_config`` dict passed to ``init()``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+
+def _env(name: str, default):
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    if isinstance(default, (list, dict)):
+        return json.loads(raw)
+    return raw
+
+
+@dataclass
+class Config:
+    # --- serialization / object store ---
+    # Objects smaller than this are inlined into RPC replies and the
+    # in-process store rather than the shared-memory store.
+    max_direct_call_object_size: int = 100 * 1024
+    # Per-node shared-memory object store capacity (bytes).
+    object_store_memory: int = 2 * 1024**3
+    # Fraction of store that triggers LRU eviction/spill.
+    object_store_high_watermark: float = 0.8
+    # Directory for spilled objects; default under session dir.
+    object_spilling_dir: str = ""
+    # Chunk size for node-to-node object transfer.
+    object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+
+    # --- scheduling ---
+    # Hybrid policy: pack onto nodes until utilization crosses this
+    # threshold, then spread (reference: hybrid_scheduling_policy.h).
+    scheduler_spread_threshold: float = 0.5
+    # Max worker leases a submitter requests in parallel per scheduling class.
+    max_pending_lease_requests: int = 10
+    # Lease reuse idle timeout (s): a leased idle worker is returned after this.
+    idle_worker_lease_timeout_s: float = 0.5
+    worker_lease_timeout_s: float = 30.0
+
+    # --- worker pool ---
+    num_initial_workers: int = 0
+    max_workers_per_node: int = 64
+    worker_start_timeout_s: float = 60.0
+    # Soft cap of started workers per node; more start on demand.
+    prestart_workers: bool = True
+
+    # --- health / fault tolerance ---
+    heartbeat_interval_s: float = 0.5
+    node_death_timeout_s: float = 5.0
+    task_max_retries_default: int = 3
+    actor_max_restarts_default: int = 0
+    gcs_rpc_timeout_s: float = 30.0
+
+    # --- pubsub / sync ---
+    resource_broadcast_interval_s: float = 0.2
+
+    # --- metrics / events ---
+    task_events_enabled: bool = True
+    task_events_max_buffer: int = 100_000
+    metrics_report_interval_s: float = 2.0
+
+    # --- logging ---
+    log_to_driver: bool = True
+
+    # --- system ---
+    session_dir_root: str = "/tmp/ray_tpu_sessions"
+
+    extra: dict = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, overrides: dict | None = None) -> "Config":
+        cfg = cls()
+        for f in fields(cls):
+            if f.name == "extra":
+                continue
+            setattr(cfg, f.name, _env(f.name, getattr(cfg, f.name)))
+        if overrides:
+            for k, v in overrides.items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+                else:
+                    cfg.extra[k] = v
+        return cfg
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {f.name: getattr(self, f.name) for f in fields(self) if f.name != "extra"}
+        d.update(self.extra)
+        return d
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config.load()
+    return _global_config
+
+
+def set_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

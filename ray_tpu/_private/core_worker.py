@@ -1,0 +1,1392 @@
+"""CoreWorker: the per-process runtime embedded in the driver and every worker.
+
+Capability parity with the reference CoreWorker (src/ray/core_worker/
+core_worker.h, task_manager.h, reference_count.h, transport/): task submission
+with lease caching + pipelining (direct_task_transport.h), ordered direct actor
+calls with per-caller sequence numbers and restart-aware buffering
+(direct_actor_task_submitter.h / actor_scheduling_queue.cc), in-process store
+for inlined objects, ownership-based distributed refcounting with borrower
+registration, lineage-based object reconstruction (object_recovery_manager.h),
+task retries, and task-event export to the GCS.
+
+Runs an asyncio loop: in worker processes it's the main loop; in the driver it
+runs on a background thread with a thread-safe sync facade.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import object_ref as object_ref_mod
+from ray_tpu._private import rpc
+from ray_tpu._private.common import (ACTOR_ALIVE, ACTOR_DEAD, ARG_INLINE,
+                                     ARG_REF, ActorInfo, TaskArg, TaskSpec)
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
+                                  WorkerID)
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_store import ObjectStoreClient
+from ray_tpu._private.serialization import (SerializationContext,
+                                            SerializedObject)
+
+logger = logging.getLogger(__name__)
+
+META_EXCEPTION = b"EXC"
+
+
+@dataclass
+class OwnedObject:
+    object_id: ObjectID
+    local_refs: int = 0
+    borrowers: int = 0
+    # Where the primary copy lives (raylet addresses).
+    locations: List[str] = field(default_factory=list)
+    inline_value: Optional[bytes] = None       # serialized, for small objects
+    is_exception: bool = False
+    # Lineage: spec of the task that created it (for reconstruction).
+    creating_spec: Optional[TaskSpec] = None
+    ready: bool = False
+    waiters: List[asyncio.Future] = field(default_factory=list)
+    spilled: bool = False
+
+
+@dataclass
+class PendingTask:
+    spec: TaskSpec
+    retries_left: int = 0
+    returns: List[ObjectID] = field(default_factory=list)
+    # Holding real ObjectRefs pins arg objects (refcount) until completion.
+    arg_refs: List[ObjectRef] = field(default_factory=list)
+
+
+@dataclass
+class LeaseEntry:
+    worker_id: WorkerID
+    worker_address: str
+    raylet_address: str
+    busy: bool = False
+    returning: bool = False
+    last_used: float = field(default_factory=time.time)
+
+
+class ActorSubmitQueue:
+    """Client-side per-actor queue: ordered seq numbers, buffering on restart.
+
+    On restart the executing worker resets its per-caller sequence cursor to 0
+    (fresh process), so pending (unacknowledged) tasks are renumbered 0..n-1 in
+    their original submission order before being re-pushed (reference:
+    direct_actor_task_submitter.h resend-on-restart semantics).
+    """
+
+    def __init__(self, actor_id: ActorID):
+        self.actor_id = actor_id
+        self.seq = 0
+        self.epoch = 0               # observed num_restarts
+        self.state = "PENDING"       # PENDING | ALIVE | RESTARTING | DEAD
+        self.address = ""
+        self.death_reason = ""
+        self.wakeup: List[asyncio.Future] = []
+        # seq -> spec of tasks submitted but not yet acknowledged.
+        self.inflight: Dict[int, TaskSpec] = {}
+
+    def next_seq(self) -> int:
+        s = self.seq
+        self.seq += 1
+        return s
+
+    def set_state(self, state: str, address: str = "", reason: str = "",
+                  num_restarts: int = 0):
+        if state == "ALIVE" and num_restarts > self.epoch:
+            self._renumber_for_epoch(num_restarts)
+        self.state = state
+        self.address = address
+        if reason:
+            self.death_reason = reason
+        for fut in self.wakeup:
+            if not fut.done():
+                fut.set_result(None)
+        self.wakeup.clear()
+
+    def _renumber_for_epoch(self, num_restarts: int):
+        self.epoch = num_restarts
+        pending = sorted(self.inflight.items())
+        self.inflight = {}
+        for new_seq, (_, spec) in enumerate(pending):
+            spec.seq_no = new_seq
+            self.inflight[new_seq] = spec
+        self.seq = len(pending)
+
+    async def wait_for_change(self):
+        fut = asyncio.get_running_loop().create_future()
+        self.wakeup.append(fut)
+        await fut
+
+
+class CoreWorker:
+    """One per process. mode: 'driver' | 'worker'."""
+
+    def __init__(self, mode: str, gcs_address: str, raylet_address: str,
+                 config: Config, job_id: Optional[JobID] = None,
+                 worker_id: Optional[WorkerID] = None,
+                 node_id: Optional[NodeID] = None,
+                 session_dir: str = ""):
+        self.mode = mode
+        self.config = config
+        self.gcs_address = gcs_address
+        self.raylet_address = raylet_address
+        self.job_id = job_id or JobID.from_int(0)
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.task_id_counter = 0
+        self.put_counter = 0
+        # current task context (worker side)
+        self.current_task_id: Optional[TaskID] = None
+        self.current_actor_id: Optional[ActorID] = None
+
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self._loop_thread: Optional[threading.Thread] = None
+        self.server = rpc.RpcServer(f"core-{mode}")
+        self.address = ""
+        self.gcs: Optional[rpc.Connection] = None
+        self.raylet: Optional[rpc.Connection] = None
+        self.store: Optional[ObjectStoreClient] = None
+        self.clients = rpc.ClientPool()
+        self.serialization = SerializationContext()
+        self.serialization.deserialized_ref_factory = self._make_borrowed_ref
+
+        # object state
+        self.owned: Dict[ObjectID, OwnedObject] = {}
+        self.borrowed_refs: Dict[ObjectID, Tuple[str, int]] = {}  # oid -> (owner, count)
+        self.inproc: Dict[ObjectID, Any] = {}     # deserialized cache
+        self._inproc_exc: set = set()  # oids whose cached value is an error
+        # Large objects deserialized zero-copy out of shm stay pinned in the
+        # local store until their entry leaves the in-process cache.
+        self._pinned: set = set()
+
+        # task state
+        self.pending_tasks: Dict[TaskID, PendingTask] = {}
+        self.leases: Dict[tuple, List[LeaseEntry]] = {}
+        self._lease_requests_inflight: Dict[tuple, int] = {}
+        self._task_queue: Dict[tuple, List[TaskSpec]] = {}
+
+        # actor state
+        self.actor_queues: Dict[ActorID, ActorSubmitQueue] = {}
+        self.actor_handles: Dict[ActorID, Any] = {}
+
+        # executor state (worker mode)
+        self.executing_actor = None
+        self.executing_actor_info: Optional[dict] = None
+        self._exec_pool = ThreadPoolExecutor(max_workers=8,
+                                             thread_name_prefix="exec")
+        self._actor_semaphore: Optional[asyncio.Semaphore] = None
+        self._caller_next_seq: Dict[bytes, int] = {}
+        self._caller_buffer: Dict[bytes, Dict[int, tuple]] = {}
+        self._function_cache: Dict[str, Any] = {}
+        self._running_tasks: Dict[TaskID, Any] = {}
+        self._cancelled_tasks: set = set()
+        self._task_events_buffer: List[dict] = []
+        self._shutdown = False
+        self._bg_tasks: List[asyncio.Task] = []
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    async def start_async(self):
+        """Start servers + connections on the current loop."""
+        self.loop = asyncio.get_running_loop()
+        self._register_handlers()
+        port = await self.server.start("127.0.0.1", 0)
+        self.address = f"127.0.0.1:{port}"
+        self.gcs = await rpc.connect(self.gcs_address, self._on_gcs_push)
+        await self.gcs.request("subscribe", {"channels": ["actors", "nodes"]})
+        self.raylet = await rpc.connect(self.raylet_address)
+        self.store = ObjectStoreClient(self._raylet_request)
+        object_ref_mod._set_core_worker_hooks(
+            self._on_ref_created, self._on_ref_deleted,
+            self.get_sync, self.get_async)
+        self._bg_tasks.append(asyncio.ensure_future(self._flush_task_events_loop()))
+        self._bg_tasks.append(asyncio.ensure_future(self._lease_janitor_loop()))
+
+    async def _raylet_request(self, method, payload):
+        return await self.raylet.request(method, payload)
+
+    def start_driver_background(self):
+        """Driver mode: run the loop in a daemon thread; block until ready."""
+        ready = threading.Event()
+        err: List[BaseException] = []
+
+        def _run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self.loop = loop
+
+            async def _boot():
+                try:
+                    await self.start_async()
+                    ready.set()
+                except BaseException as e:  # noqa: BLE001
+                    err.append(e)
+                    ready.set()
+            loop.create_task(_boot())
+            loop.run_forever()
+
+        self._loop_thread = threading.Thread(target=_run, daemon=True,
+                                             name="ray_tpu-core")
+        self._loop_thread.start()
+        ready.wait(30)
+        if err:
+            raise err[0]
+
+    def run_sync(self, coro, timeout: Optional[float] = None):
+        """Call from a foreign thread into the core loop."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    async def shutdown_async(self):
+        self._shutdown = True
+        for t in self._bg_tasks:
+            t.cancel()
+        await self._flush_task_events()
+        await self.server.stop()
+        await self.clients.close_all()
+        if self.store:
+            self.store.close()
+        for c in (self.gcs, self.raylet):
+            if c:
+                await c.close()
+
+    def shutdown(self):
+        if self.loop is None:
+            return
+        try:
+            self.run_sync(self.shutdown_async(), timeout=10)
+        except Exception:
+            pass
+        if self._loop_thread is not None:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._loop_thread.join(timeout=5)
+        object_ref_mod._set_core_worker_hooks(None, None, None, None)
+
+    def _register_handlers(self):
+        s = self.server
+        s.register("push_task", self._rpc_push_task)
+        s.register("push_actor_task", self._rpc_push_actor_task)
+        s.register("instantiate_actor", self._rpc_instantiate_actor)
+        s.register("kill_actor", self._rpc_kill_actor)
+        s.register("cancel_task", self._rpc_cancel_task)
+        s.register("owner_locate", self._rpc_owner_locate)
+        s.register("owner_add_borrower", self._rpc_owner_add_borrower)
+        s.register("owner_remove_borrower", self._rpc_owner_remove_borrower)
+        s.register("owner_add_location", self._rpc_owner_add_location)
+        s.register("shutdown", self._rpc_shutdown)
+        s.register("ping", self._rpc_ping)
+
+    async def _rpc_ping(self, conn, payload):
+        return {"worker_id": self.worker_id, "mode": self.mode}
+
+    async def _rpc_shutdown(self, conn, payload):
+        self._shutdown = True
+        self.loop.call_soon(self.loop.stop)
+        return True
+
+    # ------------------------------------------------------------------
+    # GCS pushes (actor + node state)
+
+    def _on_gcs_push(self, method: str, payload):
+        if method != "pub":
+            return
+        channel, msg = payload["channel"], payload["message"]
+        if channel == "actors":
+            info: Optional[ActorInfo] = msg.get("actor_info")
+            actor_id = info.actor_id if info is not None else msg.get("actor_id")
+            q = self.actor_queues.get(actor_id)
+            if q is None:
+                return
+            event = msg["event"]
+            if event == "alive":
+                q.set_state("ALIVE", info.address,
+                            num_restarts=info.num_restarts)
+            elif event == "restarting":
+                q.set_state("RESTARTING")
+            elif event == "dead":
+                q.set_state("DEAD", reason=msg.get("reason", "actor died"))
+        elif channel == "nodes" and msg.get("event") == "dead":
+            # Trigger reconstruction checks for objects on that node lazily.
+            pass
+
+    # ==================================================================
+    # Object API
+    # ==================================================================
+
+    def _next_task_id(self) -> TaskID:
+        self.task_id_counter += 1
+        return TaskID.of(self.job_id)
+
+    def _on_ref_created(self, ref: ObjectRef):
+        ent = self.owned.get(ref.id)
+        if ent is not None:
+            ent.local_refs += 1
+        elif ref.owner_address and ref.owner_address != self.address:
+            oid = ref.id
+            owner, count = self.borrowed_refs.get(oid, (ref.owner_address, 0))
+            self.borrowed_refs[oid] = (owner, count + 1)
+
+    def _on_ref_deleted(self, ref: ObjectRef):
+        if self.loop is None or self._shutdown:
+            return
+        ent = self.owned.get(ref.id)
+        if ent is not None:
+            ent.local_refs -= 1
+            if ent.local_refs <= 0 and ent.borrowers <= 0:
+                self.loop.call_soon_threadsafe(self._schedule_free, ref.id)
+        else:
+            rec = self.borrowed_refs.get(ref.id)
+            if rec is not None:
+                owner, count = rec
+                if count <= 1:
+                    del self.borrowed_refs[ref.id]
+                    self.inproc.pop(ref.id, None)
+                    self._inproc_exc.discard(ref.id)
+                    if ref.id in self._pinned:
+                        self._pinned.discard(ref.id)
+                        oid_bytes = ref.id.binary()
+                        try:
+                            self.loop.call_soon_threadsafe(
+                                lambda: asyncio.ensure_future(
+                                    self.store.release(oid_bytes)))
+                        except RuntimeError:
+                            pass
+                    self._notify_owner_deref(ref.id, owner)
+                else:
+                    self.borrowed_refs[ref.id] = (owner, count - 1)
+
+    def _notify_owner_deref(self, oid: ObjectID, owner: str):
+        async def _go():
+            try:
+                conn = await self.clients.get(owner)
+                await conn.notify("owner_remove_borrower", {"object_id": oid})
+            except Exception:
+                pass
+        try:
+            self.loop.call_soon_threadsafe(lambda: asyncio.ensure_future(_go()))
+        except RuntimeError:
+            pass
+
+    def _schedule_free(self, oid: ObjectID):
+        ent = self.owned.get(oid)
+        if ent is None or ent.local_refs > 0 or ent.borrowers > 0:
+            return
+        asyncio.ensure_future(self._free_object(oid))
+
+    async def _free_object(self, oid: ObjectID):
+        ent = self.owned.pop(oid, None)
+        self.inproc.pop(oid, None)
+        self._inproc_exc.discard(oid)
+        if oid in self._pinned:
+            self._pinned.discard(oid)
+            try:
+                await self.store.release(oid.binary())
+            except Exception:
+                pass
+        if ent is None:
+            return
+        for addr in ent.locations:
+            try:
+                conn = await self.clients.get(addr)
+                await conn.notify("store_delete", {"object_ids": [oid.binary()]})
+            except Exception:
+                pass
+
+    def _make_borrowed_ref(self, object_id: ObjectID, owner_address: str):
+        """Called when a contained ObjectRef is deserialized in this process."""
+        ref = ObjectRef(object_id, owner_address)
+        if owner_address and owner_address != self.address \
+                and object_id not in self.owned:
+            # Register as borrower with the owner (best effort, async).
+            async def _reg():
+                try:
+                    conn = await self.clients.get(owner_address)
+                    await conn.notify("owner_add_borrower", {"object_id": object_id})
+                except Exception:
+                    pass
+            try:
+                asyncio.get_running_loop()
+                asyncio.ensure_future(_reg())
+            except RuntimeError:
+                if self.loop:
+                    self.loop.call_soon_threadsafe(
+                        lambda: asyncio.ensure_future(_reg()))
+        return ref
+
+    # ---- owner protocol handlers ----
+
+    async def _rpc_owner_locate(self, conn, payload):
+        oid: ObjectID = payload["object_id"]
+        ent = self.owned.get(oid)
+        if ent is None:
+            return {"error": "freed"}
+        if not ent.ready:
+            fut = asyncio.get_running_loop().create_future()
+            ent.waiters.append(fut)
+            timeout = payload.get("timeout")
+            try:
+                await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                return {"error": "timeout"}
+            ent = self.owned.get(oid)
+            if ent is None:
+                return {"error": "freed"}
+        return {"inline": ent.inline_value,
+                "locations": list(ent.locations),
+                "is_exception": ent.is_exception}
+
+    async def _rpc_owner_add_borrower(self, conn, payload):
+        ent = self.owned.get(payload["object_id"])
+        if ent is not None:
+            ent.borrowers += 1
+        return True
+
+    async def _rpc_owner_remove_borrower(self, conn, payload):
+        oid = payload["object_id"]
+        ent = self.owned.get(oid)
+        if ent is not None:
+            ent.borrowers -= 1
+            if ent.local_refs <= 0 and ent.borrowers <= 0:
+                self._schedule_free(oid)
+        return True
+
+    async def _rpc_owner_add_location(self, conn, payload):
+        ent = self.owned.get(payload["object_id"])
+        if ent is not None:
+            addr = payload["location"]
+            if addr not in ent.locations:
+                ent.locations.append(addr)
+        return True
+
+    # ---- put / get ----
+
+    async def put_async(self, value: Any, _pin_object: bool = True) -> ObjectRef:
+        self.put_counter += 1
+        task_id = self.current_task_id or TaskID.of(self.job_id)
+        oid = ObjectID.for_put(task_id, self.put_counter)
+        ser = self.serialization.serialize(value)
+        ent = OwnedObject(object_id=oid, ready=True)
+        self.owned[oid] = ent
+        if ser.total_size <= self.config.max_direct_call_object_size:
+            ent.inline_value = ser.to_bytes()
+            self.inproc[oid] = value
+        else:
+            await self.store.put(oid.binary(), ser, owner_address=self.address)
+            ent.locations.append(self.raylet_address)
+        return ObjectRef(oid, self.address)
+
+    def put_sync(self, value: Any) -> ObjectRef:
+        return self.run_sync(self.put_async(value))
+
+    async def get_async(self, ref_or_refs, timeout: Optional[float] = None):
+        if isinstance(ref_or_refs, list):
+            return await asyncio.gather(
+                *[self._get_one(r, timeout) for r in ref_or_refs])
+        return await self._get_one(ref_or_refs, timeout)
+
+    def get_sync(self, ref_or_refs, timeout: Optional[float] = None):
+        t = None if timeout is None else timeout + 5
+        return self.run_sync(self.get_async(ref_or_refs, timeout), t)
+
+    async def _get_one(self, ref: ObjectRef, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.time() + timeout
+        value, is_exception = await self._resolve_object(ref, deadline)
+        if is_exception:
+            raise value
+        return value
+
+    async def _resolve_object(self, ref: ObjectRef,
+                              deadline: Optional[float]) -> Tuple[Any, bool]:
+        oid = ref.id
+        # 1. in-process cache
+        if oid in self.inproc:
+            return self.inproc[oid], oid in self._inproc_exc
+        ent = self.owned.get(oid)
+        if ent is not None:
+            return await self._resolve_owned(ent, deadline)
+        # Borrowed object: ask the owner.
+        return await self._resolve_borrowed(ref, deadline)
+
+    async def _resolve_owned(self, ent: OwnedObject, deadline) -> Tuple[Any, bool]:
+        oid = ent.object_id
+        if not ent.ready:
+            fut = asyncio.get_running_loop().create_future()
+            ent.waiters.append(fut)
+            try:
+                await asyncio.wait_for(
+                    fut, None if deadline is None else max(0, deadline - time.time()))
+            except asyncio.TimeoutError:
+                raise exc.GetTimeoutError(f"get timed out on {oid}")
+        if ent.inline_value is not None:
+            val = self.serialization.deserialize(ent.inline_value)
+            self.inproc[oid] = val
+            if ent.is_exception:
+                self._inproc_exc.add(oid)
+            return val, ent.is_exception
+        # Large object: fetch via local store (pull from remote if needed).
+        data_meta = await self._fetch_to_local(oid, ent.locations, self.address,
+                                               deadline)
+        if data_meta is None:
+            # Primary copies lost -> lineage reconstruction.
+            ok = await self._reconstruct(ent)
+            if not ok:
+                raise exc.ObjectLostError(oid, "all copies lost; "
+                                          "reconstruction failed")
+            return await self._resolve_owned(self.owned[oid], deadline)
+        view, metadata = data_meta
+        val = self.serialization.deserialize(view)
+        # Keep the store pin: `val` may alias the shm buffer (zero-copy numpy).
+        self._pinned.add(oid)
+        self.inproc[oid] = val
+        if metadata == META_EXCEPTION:
+            self._inproc_exc.add(oid)
+        return val, metadata == META_EXCEPTION
+
+    async def _resolve_borrowed(self, ref: ObjectRef, deadline) -> Tuple[Any, bool]:
+        oid = ref.id
+        owner = ref.owner_address or self.address
+        timeout = None if deadline is None else max(0.0, deadline - time.time())
+        try:
+            info = await self.clients.request(
+                owner, "owner_locate", {"object_id": oid, "timeout": timeout})
+        except rpc.RpcError:
+            raise exc.OwnerDiedError(ref)
+        if info.get("error") == "timeout":
+            raise exc.GetTimeoutError(f"get timed out on {oid}")
+        if info.get("error") == "freed":
+            raise exc.ObjectFreedError(ref, "object was freed by its owner")
+        if info.get("inline") is not None:
+            val = self.serialization.deserialize(info["inline"])
+            self.inproc[oid] = val
+            if info["is_exception"]:
+                self._inproc_exc.add(oid)
+            return val, info["is_exception"]
+        data_meta = await self._fetch_to_local(oid, info["locations"], owner,
+                                               deadline)
+        if data_meta is None:
+            raise exc.ObjectLostError(ref, "object copies unreachable")
+        view, metadata = data_meta
+        val = self.serialization.deserialize(view)
+        self._pinned.add(oid)
+        self.inproc[oid] = val
+        if metadata == META_EXCEPTION:
+            self._inproc_exc.add(oid)
+        return val, metadata == META_EXCEPTION
+
+    async def _fetch_to_local(self, oid: ObjectID, locations: List[str],
+                              owner: str, deadline) -> Optional[tuple]:
+        """Ensure the object is in the local store; return pinned view."""
+        key = oid.binary()
+        timeout = 0.05
+        if await self.store.contains(key):
+            return await self.store.get(key, timeout=None)
+        if self.raylet_address in locations:
+            # It should be local but isn't sealed yet; wait.
+            t = None if deadline is None else max(0.0, deadline - time.time())
+            return await self.store.get(key, timeout=t)
+        if not locations:
+            return None
+        ok = await self.raylet.request("store_fetch_remote", {
+            "object_id": key, "locations": list(locations),
+            "owner_address": owner}, timeout=120.0)
+        if not ok:
+            return None
+        # Record the new location with the owner.
+        if owner == self.address:
+            ent = self.owned.get(oid)
+            if ent is not None and self.raylet_address not in ent.locations:
+                ent.locations.append(self.raylet_address)
+        else:
+            try:
+                conn = await self.clients.get(owner)
+                await conn.notify("owner_add_location",
+                                  {"object_id": oid,
+                                   "location": self.raylet_address})
+            except Exception:
+                pass
+        return await self.store.get(key, timeout=timeout)
+
+    async def _reconstruct(self, ent: OwnedObject) -> bool:
+        """Lineage reconstruction: resubmit the creating task."""
+        spec = ent.creating_spec
+        if spec is None:
+            return False
+        logger.warning("reconstructing object %s by resubmitting task %s",
+                       ent.object_id.hex()[:12], spec.name)
+        ent.ready = False
+        ent.locations = []
+        ent.inline_value = None
+        self.inproc.pop(ent.object_id, None)
+        await self._submit_to_cluster(spec)
+        return True
+
+    async def wait_async(self, refs: List[ObjectRef], num_returns: int = 1,
+                         timeout: Optional[float] = None,
+                         fetch_local: bool = True):
+        """ray.wait semantics: (ready, not_ready), order-preserving."""
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds number of refs")
+        done: set = set()
+        pending = {id(r): asyncio.ensure_future(self._await_ready(r))
+                   for r in refs}
+        start = time.time()
+        while len(done) < num_returns:
+            remaining = None
+            if timeout is not None:
+                remaining = timeout - (time.time() - start)
+                if remaining <= 0:
+                    break
+            futs = [f for k, f in pending.items() if k not in done]
+            if not futs:
+                break
+            d, _ = await asyncio.wait(futs, timeout=remaining,
+                                      return_when=asyncio.FIRST_COMPLETED)
+            if not d:
+                break
+            for k, f in pending.items():
+                if f.done() and k not in done:
+                    done.add(k)
+        for f in pending.values():
+            if not f.done():
+                f.cancel()
+        ready = [r for r in refs if id(r) in done]
+        not_ready = [r for r in refs if id(r) not in done]
+        return ready[:max(num_returns, len(ready))], not_ready
+
+    async def _await_ready(self, ref: ObjectRef):
+        ent = self.owned.get(ref.id)
+        if ent is not None:
+            if not ent.ready:
+                fut = asyncio.get_running_loop().create_future()
+                ent.waiters.append(fut)
+                await fut
+            return True
+        if ref.id in self.inproc:
+            return True
+        try:
+            await self.clients.request(ref.owner_address, "owner_locate",
+                                       {"object_id": ref.id, "timeout": None})
+        except rpc.RpcError:
+            pass
+        return True
+
+    # ==================================================================
+    # Task submission (normal tasks)
+    # ==================================================================
+
+    async def export_function(self, func: Any, function_id: str):
+        """Push a cloudpickled function/class to the GCS function table."""
+        import cloudpickle
+        data = cloudpickle.dumps(func)
+        await self.gcs.request("kv_put", {
+            "namespace": "funcs", "key": function_id.encode(), "value": data})
+
+    async def _load_function(self, function_id: str):
+        if function_id in self._function_cache:
+            return self._function_cache[function_id]
+        import pickle
+        data = await self.gcs.request("kv_get", {
+            "namespace": "funcs", "key": function_id.encode()})
+        if data is None:
+            raise exc.RayTpuSystemError(f"function {function_id} not found")
+        func = pickle.loads(data)
+        self._function_cache[function_id] = func
+        return func
+
+    def _prepare_args(self, args: tuple, kwargs: dict) -> List[TaskArg]:
+        """Inline small values; pass refs; ray.put big values first."""
+        out: List[TaskArg] = []
+        packed = (args, kwargs)
+        flat: List[Any] = list(args) + list(kwargs.values())
+        task_args: List[TaskArg] = []
+        for v in flat:
+            if isinstance(v, ObjectRef):
+                task_args.append(TaskArg(ARG_REF, object_id=v.id,
+                                         owner_address=v.owner_address or self.address))
+            else:
+                ser = self.serialization.serialize(v)
+                if ser.total_size > self.config.max_direct_call_object_size:
+                    # Big arg: promote to an owned object in the local store.
+                    fut = asyncio.run_coroutine_threadsafe(
+                        self.put_async(v), self.loop) \
+                        if threading.current_thread() is not self._loop_thread \
+                        and self._loop_thread is not None else None
+                    # (handled by caller via async path; see submit_task)
+                    task_args.append(TaskArg(ARG_INLINE, data=ser.to_bytes()))
+                else:
+                    task_args.append(TaskArg(ARG_INLINE, data=ser.to_bytes()))
+        return task_args
+
+    async def _build_args(self, args: tuple, kwargs: dict) -> Tuple[List[TaskArg], List[str]]:
+        task_args: List[TaskArg] = []
+        kw_names: List[str] = []
+        for v in list(args) + list(kwargs.values()):
+            if isinstance(v, ObjectRef):
+                task_args.append(TaskArg(ARG_REF, object_id=v.id,
+                                         owner_address=v.owner_address or self.address))
+            else:
+                ser = self.serialization.serialize(v)
+                if ser.total_size > self.config.max_direct_call_object_size:
+                    ref = await self.put_async(v)
+                    task_args.append(TaskArg(ARG_REF, object_id=ref.id,
+                                             owner_address=self.address))
+                else:
+                    task_args.append(TaskArg(ARG_INLINE, data=ser.to_bytes()))
+        kw_names = list(kwargs.keys())
+        return task_args, kw_names
+
+    async def submit_task(self, function_id: str, args: tuple, kwargs: dict,
+                          *, name: str = "", num_returns: int = 1,
+                          resources: Optional[Dict[str, float]] = None,
+                          scheduling=None, max_retries: int = -1,
+                          retry_exceptions: bool = False,
+                          is_generator: bool = False) -> List[ObjectRef]:
+        from ray_tpu._private.common import SchedulingStrategy
+        task_id = self._next_task_id()
+        task_args, kw_names = await self._build_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id, name=name,
+            function_id=function_id, args=task_args,
+            num_returns=num_returns,
+            resources=resources or {"CPU": 1.0},
+            scheduling=scheduling or SchedulingStrategy(),
+            max_retries=(self.config.task_max_retries_default
+                         if max_retries < 0 else max_retries),
+            retry_exceptions=retry_exceptions,
+            owner_address=self.address, owner_worker_id=self.worker_id,
+            is_generator=is_generator,
+        )
+        spec.runtime_env = {"kwarg_names": kw_names} if kw_names else None
+        refs = []
+        returns = []
+        for i in range(num_returns):
+            oid = ObjectID.for_task_return(task_id, i)
+            ent = OwnedObject(object_id=oid, creating_spec=spec)
+            self.owned[oid] = ent
+            returns.append(oid)
+            refs.append(ObjectRef(oid, self.address))
+        self.pending_tasks[task_id] = PendingTask(
+            spec=spec, retries_left=spec.max_retries, returns=returns,
+            arg_refs=self._pin_arg_refs(spec))
+        self._record_task_event(spec, "PENDING")
+        await self._submit_to_cluster(spec)
+        return refs
+
+    def _pin_arg_refs(self, spec: TaskSpec) -> List[ObjectRef]:
+        """Task args count as references until the task completes
+        (reference semantics: reference_count.h submitted-task references)."""
+        return [ObjectRef(a.object_id, a.owner_address)
+                for a in spec.args if a.kind == ARG_REF]
+
+    async def _submit_to_cluster(self, spec: TaskSpec):
+        sched_class = spec.scheduling_class()
+        self._task_queue.setdefault(sched_class, []).append(spec)
+        asyncio.ensure_future(self._pump_queue(sched_class))
+
+    async def _pump_queue(self, sched_class: tuple):
+        """Dispatch queued tasks onto cached leases; request more as needed."""
+        queue = self._task_queue.get(sched_class)
+        if not queue:
+            return
+        # Use an existing idle lease
+        leases = self.leases.setdefault(sched_class, [])
+        for lease in leases:
+            if not queue:
+                return
+            if not lease.busy and not lease.returning:
+                spec = queue.pop(0)
+                lease.busy = True
+                asyncio.ensure_future(self._run_on_lease(sched_class, lease, spec))
+        if not queue:
+            return
+        inflight = self._lease_requests_inflight.get(sched_class, 0)
+        want = min(len(queue), self.config.max_pending_lease_requests) - inflight
+        for _ in range(max(0, want)):
+            self._lease_requests_inflight[sched_class] = \
+                self._lease_requests_inflight.get(sched_class, 0) + 1
+            asyncio.ensure_future(self._acquire_lease(sched_class, queue[0]))
+
+    async def _acquire_lease(self, sched_class: tuple, sample_spec: TaskSpec):
+        try:
+            raylet_addr = self.raylet_address
+            for _hop in range(8):
+                if self._shutdown:
+                    return
+                try:
+                    reply = await self.clients.request(
+                        raylet_addr, "request_worker_lease",
+                        {"spec": sample_spec},
+                        timeout=self.config.worker_lease_timeout_s + 10)
+                except (rpc.RpcError, OSError) as e:
+                    if self._shutdown:
+                        return
+                    logger.warning("lease request to %s failed: %s", raylet_addr, e)
+                    await asyncio.sleep(0.2)
+                    continue
+                if "granted" in reply:
+                    g = reply["granted"]
+                    lease = LeaseEntry(worker_id=g["worker_id"],
+                                       worker_address=g["worker_address"],
+                                       raylet_address=raylet_addr)
+                    self.leases.setdefault(sched_class, []).append(lease)
+                    return
+                if "spillback" in reply:
+                    raylet_addr = reply["spillback"]
+                    continue
+                if "infeasible" in reply:
+                    self._fail_queued_tasks(sched_class, exc.RayTpuSystemError(
+                        f"no node can satisfy resources "
+                        f"{sample_spec.resources}"))
+                    return
+                # retry
+                await asyncio.sleep(0.05)
+        except (rpc.RpcError, OSError):
+            pass
+        finally:
+            self._lease_requests_inflight[sched_class] = max(
+                0, self._lease_requests_inflight.get(sched_class, 1) - 1)
+            asyncio.ensure_future(self._pump_queue(sched_class))
+
+    def _fail_queued_tasks(self, sched_class: tuple, error: Exception):
+        queue = self._task_queue.get(sched_class, [])
+        while queue:
+            spec = queue.pop(0)
+            self._complete_task_error(spec, error, retry=False)
+
+    async def _run_on_lease(self, sched_class: tuple, lease: LeaseEntry,
+                            spec: TaskSpec):
+        self._record_task_event(spec, "RUNNING")
+        try:
+            reply = await self.clients.request(
+                lease.worker_address, "push_task", {"spec": spec}, timeout=None)
+        except rpc.RpcError:
+            # Worker died: release lease, maybe retry the task.
+            self._drop_lease(sched_class, lease)
+            self._handle_task_worker_death(spec)
+            return
+        lease.busy = False
+        lease.last_used = time.time()
+        self._handle_task_reply(spec, reply, lease.raylet_address)
+        queue = self._task_queue.get(sched_class, [])
+        if queue:
+            asyncio.ensure_future(self._pump_queue(sched_class))
+        else:
+            asyncio.ensure_future(self._maybe_return_lease(sched_class, lease))
+
+    async def _maybe_return_lease(self, sched_class: tuple, lease: LeaseEntry):
+        await asyncio.sleep(self.config.idle_worker_lease_timeout_s)
+        await self._return_lease(sched_class, lease)
+
+    async def _return_lease(self, sched_class: tuple, lease: LeaseEntry):
+        if lease.busy or lease.returning:
+            return
+        if self._task_queue.get(sched_class, []):
+            return
+        lease.returning = True
+        self._drop_lease(sched_class, lease)
+        try:
+            await self.clients.request(lease.raylet_address, "return_worker",
+                                       {"worker_id": lease.worker_id}, timeout=5)
+        except rpc.RpcError:
+            pass
+
+    async def _lease_janitor_loop(self):
+        """Return leases that sat idle past the reuse window.
+
+        Covers leases granted after their queue drained (the submitter may
+        acquire more leases than tasks remain); reference equivalent:
+        lease idle timeout in direct_task_transport.h.
+        """
+        while not self._shutdown:
+            await asyncio.sleep(self.config.idle_worker_lease_timeout_s)
+            now = time.time()
+            for sched_class, leases in list(self.leases.items()):
+                for lease in list(leases):
+                    if (not lease.busy and not lease.returning and
+                            now - lease.last_used >
+                            self.config.idle_worker_lease_timeout_s):
+                        asyncio.ensure_future(
+                            self._return_lease(sched_class, lease))
+
+    def _drop_lease(self, sched_class: tuple, lease: LeaseEntry):
+        leases = self.leases.get(sched_class, [])
+        if lease in leases:
+            leases.remove(lease)
+
+    def _handle_task_worker_death(self, spec: TaskSpec):
+        pt = self.pending_tasks.get(spec.task_id)
+        if pt is not None and pt.retries_left > 0:
+            pt.retries_left -= 1
+            logger.warning("task %s worker died; retrying (%d left)",
+                           spec.name, pt.retries_left)
+            asyncio.ensure_future(self._submit_to_cluster(spec))
+        else:
+            self._complete_task_error(spec, exc.WorkerCrashedError(
+                f"worker died while running task {spec.name}"), retry=False)
+
+    def _handle_task_reply(self, spec: TaskSpec, reply: dict,
+                           exec_raylet: str):
+        if reply.get("cancelled"):
+            self._complete_task_error(spec, exc.TaskCancelledError(spec.task_id),
+                                      retry=False)
+            return
+        error = reply.get("system_error")
+        if error is not None:
+            logger.warning("task %s system error: %s", spec.name, error)
+            self._handle_task_worker_death(spec)
+            return
+        app_error = reply.get("app_error")
+        if app_error is not None:
+            pt = self.pending_tasks.get(spec.task_id)
+            if spec.retry_exceptions and pt is not None and pt.retries_left > 0:
+                pt.retries_left -= 1
+                asyncio.ensure_future(self._submit_to_cluster(spec))
+                return
+            self._complete_task_error(spec, app_error, retry=False)
+            return
+        returns = reply["returns"]  # list of {"inline": bytes}|{"stored": addr, "size": n}
+        self._complete_task_ok(spec, returns, exec_raylet)
+
+    def _complete_task_ok(self, spec: TaskSpec, returns: List[dict],
+                          exec_raylet: str):
+        self.pending_tasks.pop(spec.task_id, None)
+        self._record_task_event(spec, "FINISHED")
+        for i, ret in enumerate(returns):
+            oid = ObjectID.for_task_return(spec.task_id, i)
+            ent = self.owned.get(oid)
+            if ent is None:
+                ent = OwnedObject(object_id=oid, creating_spec=spec)
+                self.owned[oid] = ent
+            if "inline" in ret:
+                ent.inline_value = ret["inline"]
+            else:
+                loc = ret.get("stored", exec_raylet)
+                if loc not in ent.locations:
+                    ent.locations.append(loc)
+            ent.is_exception = bool(ret.get("is_exception"))
+            ent.ready = True
+            for fut in ent.waiters:
+                if not fut.done():
+                    fut.set_result(True)
+            ent.waiters.clear()
+
+    def _complete_task_error(self, spec: TaskSpec, error: Exception,
+                             retry: bool):
+        self.pending_tasks.pop(spec.task_id, None)
+        self._record_task_event(spec, "FAILED")
+        ser = self.serialization.serialize(error).to_bytes()
+        for i in range(spec.num_returns):
+            oid = ObjectID.for_task_return(spec.task_id, i)
+            ent = self.owned.get(oid)
+            if ent is None:
+                continue
+            ent.inline_value = ser
+            ent.is_exception = True
+            ent.ready = True
+            for fut in ent.waiters:
+                if not fut.done():
+                    fut.set_result(True)
+            ent.waiters.clear()
+
+    async def cancel_task(self, ref: ObjectRef, force: bool = False):
+        task_id = ref.id.task_id()
+        pt = self.pending_tasks.get(task_id)
+        if pt is None:
+            return
+        # Remove from queue if not yet dispatched.
+        sched_class = pt.spec.scheduling_class()
+        queue = self._task_queue.get(sched_class, [])
+        if pt.spec in queue:
+            queue.remove(pt.spec)
+            self._complete_task_error(pt.spec, exc.TaskCancelledError(task_id),
+                                      retry=False)
+            return
+        # Running: ask executors to cancel.
+        for leases in self.leases.values():
+            for lease in leases:
+                try:
+                    await self.clients.request(
+                        lease.worker_address, "cancel_task",
+                        {"task_id": task_id, "force": force}, timeout=5)
+                except rpc.RpcError:
+                    pass
+
+    # ==================================================================
+    # Actor API
+    # ==================================================================
+
+    async def create_actor(self, class_function_id: str, args: tuple,
+                           kwargs: dict, *, class_name: str = "",
+                           resources: Optional[Dict[str, float]] = None,
+                           scheduling=None, max_restarts: int = 0,
+                           max_task_retries: int = 0, max_concurrency: int = 1,
+                           is_async: bool = False, name: str = "",
+                           namespace: str = "", lifetime: str = "") -> ActorID:
+        from ray_tpu._private.common import SchedulingStrategy
+        actor_id = ActorID.of(self.job_id)
+        task_id = self._next_task_id()
+        task_args, kw_names = await self._build_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id, name=class_name,
+            function_id=class_function_id, args=task_args,
+            resources=resources or {"CPU": 1.0},
+            scheduling=scheduling or SchedulingStrategy(),
+            owner_address=self.address, owner_worker_id=self.worker_id,
+            actor_id=actor_id, is_actor_creation=True,
+            max_restarts=max_restarts, max_task_retries=max_task_retries,
+            max_concurrency=max_concurrency, is_async_actor=is_async,
+            actor_name=name, namespace=namespace,
+        )
+        spec.runtime_env = {"kwarg_names": kw_names, "lifetime": lifetime}
+        q = ActorSubmitQueue(actor_id)
+        self.actor_queues[actor_id] = q
+        await self.gcs.request("register_actor", {"spec": spec})
+        return actor_id
+
+    async def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                                args: tuple, kwargs: dict,
+                                num_returns: int = 1,
+                                max_task_retries: int = 0) -> List[ObjectRef]:
+        q = self.actor_queues.get(actor_id)
+        if q is None:
+            q = await self._connect_actor_queue(actor_id)
+        # Reserve the sequence number and register the spec in the inflight
+        # map BEFORE any await so concurrent submissions cannot race to
+        # duplicate/skip seq numbers, and restart renumbering sees every
+        # reserved slot.
+        seq_no = q.next_seq()
+        task_id = TaskID.for_actor_task(self.job_id, actor_id, seq_no)
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id, name=method_name,
+            args=[], num_returns=num_returns,
+            owner_address=self.address, owner_worker_id=self.worker_id,
+            actor_id=actor_id, method_name=method_name, seq_no=seq_no,
+            max_retries=max_task_retries,
+        )
+        q.inflight[seq_no] = spec
+        task_args, kw_names = await self._build_args(args, kwargs)
+        spec.args = task_args
+        spec.runtime_env = {"kwarg_names": kw_names} if kw_names else None
+        refs, returns = [], []
+        for i in range(num_returns):
+            oid = ObjectID.for_task_return(task_id, i)
+            self.owned[oid] = OwnedObject(object_id=oid)
+            returns.append(oid)
+            refs.append(ObjectRef(oid, self.address))
+        self.pending_tasks[task_id] = PendingTask(
+            spec=spec, retries_left=max_task_retries, returns=returns,
+            arg_refs=self._pin_arg_refs(spec))
+        asyncio.ensure_future(self._submit_actor_task(q, spec))
+        return refs
+
+    async def _connect_actor_queue(self, actor_id: ActorID) -> ActorSubmitQueue:
+        info: Optional[ActorInfo] = await self.gcs.request(
+            "get_actor_info", {"actor_id": actor_id})
+        q = ActorSubmitQueue(actor_id)
+        if info is not None:
+            if info.state == ACTOR_ALIVE:
+                q.set_state("ALIVE", info.address)
+            elif info.state == ACTOR_DEAD:
+                q.set_state("DEAD", reason=info.death_cause)
+        self.actor_queues[actor_id] = q
+        return q
+
+    async def _submit_actor_task(self, q: ActorSubmitQueue, spec: TaskSpec):
+        try:
+            while True:
+                if q.state == "DEAD":
+                    self._complete_task_error(
+                        spec, exc.ActorDiedError(q.actor_id, q.death_reason),
+                        retry=False)
+                    return
+                if q.state != "ALIVE":
+                    await q.wait_for_change()
+                    continue
+                address = q.address
+                epoch = q.epoch
+                try:
+                    reply = await self.clients.request(
+                        address, "push_actor_task", {"spec": spec},
+                        timeout=None)
+                except rpc.RpcError:
+                    # Actor worker connection failed; wait for GCS verdict
+                    # (restart or death) then retry/fail.
+                    if q.address == address and q.epoch == epoch:
+                        q.set_state("RESTARTING")
+                    pt = self.pending_tasks.get(spec.task_id)
+                    if pt is None:
+                        return
+                    if pt.retries_left != 0:
+                        if pt.retries_left > 0:
+                            pt.retries_left -= 1
+                        await q.wait_for_change()
+                        continue
+                    self._complete_task_error(
+                        spec, exc.ActorDiedError(
+                            q.actor_id, "actor worker died mid-call"),
+                        retry=False)
+                    return
+                self._handle_task_reply(spec, reply, "")
+                return
+        finally:
+            q.inflight.pop(spec.seq_no, None)
+
+    async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        await self.gcs.request("kill_actor", {"actor_id": actor_id,
+                                              "no_restart": no_restart})
+
+    async def get_named_actor(self, name: str, namespace: str = ""):
+        info: Optional[ActorInfo] = await self.gcs.request(
+            "get_named_actor", {"name": name, "namespace": namespace})
+        if info is None or info.state == ACTOR_DEAD:
+            raise ValueError(f"named actor '{name}' not found")
+        q = self.actor_queues.get(info.actor_id)
+        if q is None:
+            q = ActorSubmitQueue(info.actor_id)
+            if info.state == ACTOR_ALIVE:
+                q.set_state("ALIVE", info.address)
+            self.actor_queues[info.actor_id] = q
+        return info
+
+    # ==================================================================
+    # Task execution (worker mode)
+    # ==================================================================
+
+    async def _resolve_task_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        kw_names = (spec.runtime_env or {}).get("kwarg_names") or []
+        values = []
+        for arg in spec.args:
+            if arg.kind == ARG_INLINE:
+                values.append(self.serialization.deserialize(arg.data))
+            else:
+                ref = ObjectRef(arg.object_id, arg.owner_address,
+                                skip_refcount=True)
+                value, is_exc_ = await self._resolve_object(ref, None)
+                if is_exc_:
+                    raise _DependencyError(value)
+                values.append(value)
+        if kw_names:
+            n_pos = len(values) - len(kw_names)
+            return values[:n_pos], dict(zip(kw_names, values[n_pos:]))
+        return values, {}
+
+    def _serialize_return(self, value: Any, is_exception: bool = False) -> dict:
+        ser = self.serialization.serialize(value)
+        if ser.total_size <= self.config.max_direct_call_object_size:
+            return {"inline": ser.to_bytes(), "is_exception": is_exception}
+        return {"__large__": ser, "is_exception": is_exception}
+
+    async def _store_returns(self, spec: TaskSpec, values: List[Any],
+                             is_exception: bool = False) -> List[dict]:
+        out = []
+        for i, v in enumerate(values):
+            r = self._serialize_return(v, is_exception)
+            if "__large__" in r:
+                ser = r.pop("__large__")
+                oid = ObjectID.for_task_return(spec.task_id, i)
+                meta = META_EXCEPTION if is_exception else b""
+                await self.store.put(oid.binary(), ser, metadata=meta,
+                                     owner_address=spec.owner_address)
+                r["stored"] = self.raylet_address
+            out.append(r)
+        return out
+
+    async def _rpc_push_task(self, conn, payload):
+        spec: TaskSpec = payload["spec"]
+        self.current_task_id = spec.task_id
+        try:
+            func = await self._load_function(spec.function_id)
+            args, kwargs = await self._resolve_task_args(spec)
+        except _DependencyError as e:
+            return {"app_error": e.error, "returns": None}
+        except Exception as e:  # noqa: BLE001
+            return {"system_error": f"{type(e).__name__}: {e}"}
+        try:
+            if spec.task_id in self._cancelled_tasks:
+                self._cancelled_tasks.discard(spec.task_id)
+                return {"cancelled": True}
+            loop = asyncio.get_running_loop()
+            if asyncio.iscoroutinefunction(func):
+                task = asyncio.ensure_future(func(*args, **kwargs))
+                self._running_tasks[spec.task_id] = task
+                result = await task
+            else:
+                fut = loop.run_in_executor(self._exec_pool,
+                                           lambda: func(*args, **kwargs))
+                self._running_tasks[spec.task_id] = fut
+                result = await fut
+            values = self._split_returns(result, spec.num_returns)
+            returns = await self._store_returns(spec, values)
+            return {"returns": returns}
+        except asyncio.CancelledError:
+            return {"cancelled": True}
+        except Exception as e:  # noqa: BLE001
+            import os as _os
+            err = exc.TaskError(e, traceback.format_exc(), spec.task_id,
+                                _os.getpid())
+            returns = await self._store_returns(
+                spec, [err] * spec.num_returns, is_exception=True)
+            return {"app_error": err, "returns": returns}
+        finally:
+            self._running_tasks.pop(spec.task_id, None)
+            self.current_task_id = None
+
+    @staticmethod
+    def _split_returns(result: Any, num_returns: int) -> List[Any]:
+        if num_returns == 1:
+            return [result]
+        if not isinstance(result, (tuple, list)) or len(result) != num_returns:
+            raise ValueError(
+                f"task declared num_returns={num_returns} but returned "
+                f"{type(result)}")
+        return list(result)
+
+    async def _rpc_cancel_task(self, conn, payload):
+        task_id = payload["task_id"]
+        running = self._running_tasks.get(task_id)
+        if running is None:
+            self._cancelled_tasks.add(task_id)
+            return False
+        running.cancel()
+        return True
+
+    # ---- actor execution ----
+
+    async def _rpc_instantiate_actor(self, conn, payload):
+        spec: TaskSpec = payload["spec"]
+        cls = await self._load_function(spec.function_id)
+        args, kwargs = await self._resolve_task_args(spec)
+        loop = asyncio.get_running_loop()
+        instance = await loop.run_in_executor(
+            self._exec_pool, lambda: cls(*args, **kwargs))
+        self.executing_actor = instance
+        self.executing_actor_info = {
+            "spec": spec, "max_concurrency": spec.max_concurrency,
+            "is_async": spec.is_async_actor,
+            "num_restarts": payload.get("num_restarts", 0),
+        }
+        self.current_actor_id = spec.actor_id
+        self._actor_semaphore = asyncio.Semaphore(max(1, spec.max_concurrency))
+        self._caller_next_seq = {}
+        self._caller_buffer = {}
+        return True
+
+    async def _rpc_push_actor_task(self, conn, payload):
+        spec: TaskSpec = payload["spec"]
+        if self.executing_actor is None:
+            return {"system_error": "no actor instantiated on this worker"}
+        caller = spec.owner_worker_id.binary()
+        next_seq = self._caller_next_seq.setdefault(caller, 0)
+        if spec.seq_no > next_seq:
+            # Out-of-order arrival: buffer until predecessors START.
+            buf = self._caller_buffer.setdefault(caller, {})
+            fut = asyncio.get_running_loop().create_future()
+            buf[spec.seq_no] = fut
+            await fut
+        # Ordering gates task *start*, not completion: advance the cursor and
+        # wake the successor now so async/concurrent actors interleave
+        # (reference: actor_scheduling_queue.cc sequence semantics).
+        self._caller_next_seq[caller] = spec.seq_no + 1
+        buf = self._caller_buffer.get(caller, {})
+        nxt = buf.pop(spec.seq_no + 1, None)
+        if nxt is not None and not nxt.done():
+            nxt.set_result(None)
+        return await self._execute_actor_task(spec)
+
+    async def _execute_actor_task(self, spec: TaskSpec) -> dict:
+        async with self._actor_semaphore:
+            self.current_task_id = spec.task_id
+            try:
+                method = getattr(self.executing_actor, spec.method_name)
+                args, kwargs = await self._resolve_task_args(spec)
+                if asyncio.iscoroutinefunction(method):
+                    task = asyncio.ensure_future(method(*args, **kwargs))
+                    self._running_tasks[spec.task_id] = task
+                    result = await task
+                else:
+                    loop = asyncio.get_running_loop()
+                    fut = loop.run_in_executor(self._exec_pool,
+                                               lambda: method(*args, **kwargs))
+                    self._running_tasks[spec.task_id] = fut
+                    result = await fut
+                values = self._split_returns(result, spec.num_returns)
+                returns = await self._store_returns(spec, values)
+                return {"returns": returns}
+            except _DependencyError as e:
+                return {"app_error": e.error, "returns": None}
+            except asyncio.CancelledError:
+                return {"cancelled": True}
+            except Exception as e:  # noqa: BLE001
+                import os as _os
+                err = exc.TaskError(e, traceback.format_exc(), spec.task_id,
+                                    _os.getpid())
+                returns = await self._store_returns(
+                    spec, [err] * spec.num_returns, is_exception=True)
+                return {"app_error": err, "returns": returns}
+            finally:
+                self._running_tasks.pop(spec.task_id, None)
+                self.current_task_id = None
+
+    async def _rpc_kill_actor(self, conn, payload):
+        if self.executing_actor is not None:
+            inst = self.executing_actor
+            if hasattr(inst, "__ray_terminate__"):
+                try:
+                    inst.__ray_terminate__()
+                except Exception:
+                    pass
+        self._shutdown = True
+        self.loop.call_soon(self.loop.stop)
+        return True
+
+    # ==================================================================
+    # task events
+    # ==================================================================
+
+    def _record_task_event(self, spec: TaskSpec, state: str):
+        if not self.config.task_events_enabled:
+            return
+        self._task_events_buffer.append({
+            "task_id": spec.task_id.hex(), "job_id": spec.job_id.hex(),
+            "name": spec.name or spec.method_name or spec.function_id,
+            "state": state, "time": time.time(),
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            "resources": spec.resources,
+            "worker_id": self.worker_id.hex(),
+        })
+        if len(self._task_events_buffer) > 1000:
+            asyncio.ensure_future(self._flush_task_events())
+
+    async def _flush_task_events(self):
+        if not self._task_events_buffer or self.gcs is None or self.gcs.closed:
+            return
+        buf, self._task_events_buffer = self._task_events_buffer, []
+        try:
+            await self.gcs.request("report_task_events", {"events": buf})
+        except rpc.RpcError:
+            pass
+
+    async def _flush_task_events_loop(self):
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            await self._flush_task_events()
+
+
+class _DependencyError(Exception):
+    def __init__(self, error):
+        self.error = error
+        super().__init__(str(error))

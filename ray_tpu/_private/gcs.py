@@ -1,0 +1,645 @@
+"""GCS: the cluster metadata authority.
+
+Capability parity with the reference GCS server (src/ray/gcs/gcs_server/):
+node table + health checking (GcsNodeManager / GcsHealthCheckManager), actor
+management with restart-driven FSM (GcsActorManager), placement groups
+(GcsPlacementGroupManager + bundle scheduling policies), job table
+(GcsJobManager), KV store (GcsKvManager, backs the function table and
+runtime-env URIs), pubsub broadcast (src/ray/pubsub/), cluster resource view
+sync (GcsResourceManager + ray_syncer.h), named actors, and task-event
+collection (GcsTaskManager) for the state API.
+
+Single asyncio process; all state in memory with optional snapshot persistence
+(GCS fault tolerance: snapshot + restart, the Redis-equivalent is a file).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import pickle
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private import rpc
+from ray_tpu._private.common import (ACTOR_ALIVE, ACTOR_DEAD, ACTOR_PENDING,
+                                     ACTOR_RESTARTING, PG_CREATED, PG_PENDING,
+                                     PG_REMOVED, ActorInfo, JobInfo, NodeInfo,
+                                     PlacementGroupInfo)
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
+
+logger = logging.getLogger(__name__)
+
+
+class Pubsub:
+    """Channel-based pubsub over persistent RPC connections.
+
+    Equivalent to src/ray/pubsub/publisher.h: subscribers register channels on
+    their connection; publishes push to every subscribed live connection.
+    """
+
+    def __init__(self):
+        # channel -> set of connections
+        self._subs: Dict[str, set] = {}
+
+    def subscribe(self, conn: rpc.Connection, channels: List[str]):
+        for ch in channels:
+            self._subs.setdefault(ch, set()).add(conn)
+        prev = conn.on_close
+        def _cleanup(c, _prev=prev):
+            self.drop_connection(c)
+            if _prev:
+                _prev(c)
+        conn.on_close = _cleanup
+
+    def unsubscribe(self, conn: rpc.Connection, channels: List[str]):
+        for ch in channels:
+            self._subs.get(ch, set()).discard(conn)
+
+    def drop_connection(self, conn: rpc.Connection):
+        for subs in self._subs.values():
+            subs.discard(conn)
+
+    def publish(self, channel: str, message):
+        conns = self._subs.get(channel)
+        if not conns:
+            return
+        for conn in list(conns):
+            if conn.closed:
+                conns.discard(conn)
+                continue
+            asyncio.ensure_future(self._safe_push(conn, channel, message))
+
+    async def _safe_push(self, conn, channel, message):
+        try:
+            await conn.push("pub", {"channel": channel, "message": message})
+        except Exception:
+            self.drop_connection(conn)
+
+
+class GcsServer:
+    def __init__(self, config: Config, session_dir: str = ""):
+        self.config = config
+        self.session_dir = session_dir
+        self.server = rpc.RpcServer("gcs")
+        self.pubsub = Pubsub()
+        self.clients = rpc.ClientPool()
+
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[tuple, ActorID] = {}   # (namespace, name) -> id
+        self.jobs: Dict[JobID, JobInfo] = {}
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}     # namespace -> {key: val}
+        self.task_events: List[dict] = []
+        self._job_counter = 0
+        self._pg_lock = asyncio.Lock()
+        self._actor_reschedule_lock = asyncio.Lock()
+        self._health_task: Optional[asyncio.Task] = None
+        self.address = ""
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self.server.register_all(self)
+        actual = await self.server.start(host, port)
+        self.address = f"{host}:{actual}"
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        logger.info("GCS started at %s", self.address)
+        return self.address
+
+    async def stop(self):
+        if self._health_task:
+            self._health_task.cancel()
+        await self.server.stop()
+        await self.clients.close_all()
+
+    # ------------- node management -------------
+
+    async def rpc_register_node(self, conn, payload) -> dict:
+        info: NodeInfo = payload["node_info"]
+        self.nodes[info.node_id] = info
+        logger.info("node %s registered at %s (resources=%s)",
+                    info.node_id.hex()[:12], info.address, info.resources_total)
+        self.pubsub.publish("nodes", {"event": "alive", "node_info": info})
+        self._publish_resources(info)
+        return {"node_id": info.node_id, "config": self.config.to_dict(),
+                "cluster_view": self._resource_view()}
+
+    def _publish_resources(self, info: NodeInfo):
+        self.pubsub.publish("resources", {
+            "node_id": info.node_id,
+            "available": info.resources_available,
+            "total": info.resources_total,
+            "address": info.address,
+        })
+
+    def _resource_view(self) -> dict:
+        return {
+            n.node_id: {"available": n.resources_available,
+                        "total": n.resources_total, "address": n.address}
+            for n in self.nodes.values() if n.alive
+        }
+
+    async def rpc_heartbeat(self, conn, payload):
+        node_id = payload["node_id"]
+        info = self.nodes.get(node_id)
+        if info is None:
+            return {"reregister": True}
+        info.last_heartbeat = time.time()
+        if "resources_available" in payload:
+            info.resources_available = payload["resources_available"]
+        return {"reregister": False}
+
+    async def rpc_get_all_nodes(self, conn, payload):
+        return list(self.nodes.values())
+
+    async def rpc_drain_node(self, conn, payload):
+        """Graceful removal (autoscaler downscale)."""
+        node_id = payload["node_id"]
+        await self._mark_node_dead(node_id, reason="drained")
+        return True
+
+    async def _health_loop(self):
+        cfg = self.config
+        while True:
+            await asyncio.sleep(cfg.heartbeat_interval_s)
+            now = time.time()
+            for node_id, info in list(self.nodes.items()):
+                if info.alive and now - info.last_heartbeat > cfg.node_death_timeout_s:
+                    logger.warning("node %s missed heartbeats; marking dead",
+                                   node_id.hex()[:12])
+                    await self._mark_node_dead(node_id, reason="heartbeat timeout")
+
+    async def _mark_node_dead(self, node_id: NodeID, reason: str):
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        self.pubsub.publish("nodes", {"event": "dead", "node_id": node_id,
+                                      "reason": reason})
+        # Fail over actors that lived on that node.
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (ACTOR_ALIVE, ACTOR_PENDING):
+                await self._handle_actor_failure(actor, f"node died: {reason}")
+        # Release PG bundles on that node -> reschedule.
+        for pg in self.placement_groups.values():
+            if pg.state == PG_CREATED and node_id in pg.bundle_nodes.values():
+                asyncio.ensure_future(self._reschedule_pg(pg))
+
+    # ------------- resource view sync (RaySyncer equivalent) -------------
+
+    async def rpc_report_resources(self, conn, payload):
+        node_id = payload["node_id"]
+        info = self.nodes.get(node_id)
+        if info is None:
+            return False
+        info.resources_available = payload["available"]
+        info.last_heartbeat = time.time()
+        # Broadcast the delta to all raylets for local scheduling decisions.
+        self._publish_resources(info)
+        return True
+
+    async def rpc_get_cluster_resources(self, conn, payload):
+        return {
+            n.node_id: {"total": n.resources_total,
+                        "available": n.resources_available,
+                        "alive": n.alive, "labels": n.labels,
+                        "address": n.address}
+            for n in self.nodes.values()
+        }
+
+    # ------------- pubsub -------------
+
+    async def rpc_subscribe(self, conn, payload):
+        self.pubsub.subscribe(conn, payload["channels"])
+        return True
+
+    async def rpc_publish(self, conn, payload):
+        self.pubsub.publish(payload["channel"], payload["message"])
+        return True
+
+    # ------------- KV (function table, runtime envs, rendezvous) -------------
+
+    async def rpc_kv_put(self, conn, payload):
+        ns = self.kv.setdefault(payload.get("namespace", ""), {})
+        overwrite = payload.get("overwrite", True)
+        if not overwrite and payload["key"] in ns:
+            return False
+        ns[payload["key"]] = payload["value"]
+        return True
+
+    async def rpc_kv_get(self, conn, payload):
+        return self.kv.get(payload.get("namespace", ""), {}).get(payload["key"])
+
+    async def rpc_kv_del(self, conn, payload):
+        ns = self.kv.get(payload.get("namespace", ""), {})
+        return ns.pop(payload["key"], None) is not None
+
+    async def rpc_kv_exists(self, conn, payload):
+        return payload["key"] in self.kv.get(payload.get("namespace", ""), {})
+
+    async def rpc_kv_keys(self, conn, payload):
+        ns = self.kv.get(payload.get("namespace", ""), {})
+        prefix = payload.get("prefix", b"")
+        return [k for k in ns.keys() if k.startswith(prefix)]
+
+    # ------------- jobs -------------
+
+    async def rpc_register_job(self, conn, payload):
+        self._job_counter += 1
+        job_id = JobID.from_int(self._job_counter)
+        info = JobInfo(job_id=job_id, driver_address=payload.get("driver_address", ""),
+                       entrypoint=payload.get("entrypoint", ""))
+        self.jobs[job_id] = info
+        return job_id
+
+    async def rpc_finish_job(self, conn, payload):
+        info = self.jobs.get(payload["job_id"])
+        if info:
+            info.alive = False
+            info.end_time = time.time()
+        self.pubsub.publish("jobs", {"event": "finished", "job_id": payload["job_id"]})
+        return True
+
+    async def rpc_get_all_jobs(self, conn, payload):
+        return list(self.jobs.values())
+
+    # ------------- actor management -------------
+
+    async def rpc_register_actor(self, conn, payload):
+        """Register + schedule an actor creation task."""
+        spec = payload["spec"]  # TaskSpec with is_actor_creation
+        actor = ActorInfo(
+            actor_id=spec.actor_id, job_id=spec.job_id,
+            name=spec.actor_name, namespace=spec.namespace,
+            class_name=spec.name, max_restarts=spec.max_restarts,
+            owner_address=spec.owner_address, creation_spec=spec,
+            resources=dict(spec.resources),
+        )
+        if spec.actor_name:
+            key = (spec.namespace, spec.actor_name)
+            existing_id = self.named_actors.get(key)
+            if existing_id is not None and \
+                    self.actors[existing_id].state != ACTOR_DEAD:
+                raise ValueError(
+                    f"actor name '{spec.actor_name}' already taken in "
+                    f"namespace '{spec.namespace}'")
+            self.named_actors[key] = spec.actor_id
+        self.actors[spec.actor_id] = actor
+        asyncio.ensure_future(self._schedule_actor(actor))
+        return True
+
+    async def _schedule_actor(self, actor: ActorInfo, delay: float = 0.0):
+        if delay:
+            await asyncio.sleep(delay)
+        if actor.state == ACTOR_DEAD:
+            return
+        spec = actor.creation_spec
+        node = self._pick_node_for(spec.resources, spec.scheduling)
+        if node is None:
+            # No feasible node right now; retry (autoscaler hook lives here).
+            self.pubsub.publish("demand", {"resources": spec.resources})
+            asyncio.ensure_future(self._schedule_actor(actor, delay=0.5))
+            return
+        try:
+            result = await self.clients.request(
+                node.address, "create_actor",
+                {"spec": spec, "num_restarts": actor.num_restarts},
+                timeout=self.config.gcs_rpc_timeout_s * 4,
+            )
+        except Exception as e:
+            logger.warning("actor %s creation on %s failed: %s",
+                           actor.actor_id.hex()[:12], node.address, e)
+            if actor.state != ACTOR_DEAD:
+                asyncio.ensure_future(self._schedule_actor(actor, delay=0.5))
+            return
+        if actor.state == ACTOR_DEAD:
+            # Killed while creation was in flight: tear the worker down so
+            # its lease and resources return to the node.
+            try:
+                await self.clients.request(
+                    result["actor_address"], "kill_actor",
+                    {"actor_id": spec.actor_id, "no_restart": True},
+                    timeout=5.0)
+            except Exception:
+                pass
+            return
+        actor.state = ACTOR_ALIVE
+        actor.address = result["actor_address"]
+        actor.worker_id = result["worker_id"]
+        actor.node_id = node.node_id
+        self.pubsub.publish("actors", {"event": "alive", "actor_info": actor})
+
+    def _pick_node_for(self, resources: Dict[str, float], scheduling=None):
+        """GCS-side node selection for actor creation (GcsActorScheduler)."""
+        if scheduling is not None and scheduling.kind == "NODE_AFFINITY":
+            node = self.nodes.get(scheduling.node_id)
+            if node is not None and node.alive and _fits(resources, node.resources_available):
+                return node
+            if scheduling is not None and not scheduling.soft:
+                return None
+        if scheduling is not None and scheduling.placement_group_id is not None:
+            pg = self.placement_groups.get(scheduling.placement_group_id)
+            if pg is None or pg.state != PG_CREATED:
+                return None
+            idx = scheduling.bundle_index if scheduling.bundle_index >= 0 else 0
+            node_id = pg.bundle_nodes.get(idx)
+            node = self.nodes.get(node_id)
+            return node if node is not None and node.alive else None
+        candidates = [n for n in self.nodes.values()
+                      if n.alive and _fits(resources, n.resources_available)]
+        if not candidates:
+            return None
+        # Hybrid: prefer most-utilized node under threshold (pack), else spread.
+        def util(n: NodeInfo):
+            used = [
+                1 - n.resources_available.get(k, 0) / t
+                for k, t in n.resources_total.items() if t > 0
+            ]
+            return max(used) if used else 0.0
+        thr = self.config.scheduler_spread_threshold
+        packed = [n for n in candidates if util(n) < thr]
+        if packed:
+            return max(packed, key=util)
+        return min(candidates, key=util)
+
+    async def _handle_actor_failure(self, actor: ActorInfo, reason: str):
+        async with self._actor_reschedule_lock:
+            if actor.state == ACTOR_DEAD:
+                return
+            if actor.max_restarts == -1 or actor.num_restarts < actor.max_restarts:
+                actor.num_restarts += 1
+                actor.state = ACTOR_RESTARTING
+                actor.address = ""
+                self.pubsub.publish("actors", {
+                    "event": "restarting", "actor_id": actor.actor_id,
+                    "actor_info": actor})
+                asyncio.ensure_future(self._schedule_actor(actor))
+            else:
+                actor.state = ACTOR_DEAD
+                actor.death_cause = reason
+                self.pubsub.publish("actors", {
+                    "event": "dead", "actor_id": actor.actor_id,
+                    "reason": reason, "actor_info": actor})
+
+    async def rpc_report_actor_failure(self, conn, payload):
+        actor = self.actors.get(payload["actor_id"])
+        if actor is None:
+            return False
+        await self._handle_actor_failure(actor, payload.get("reason", "worker died"))
+        return True
+
+    async def rpc_kill_actor(self, conn, payload):
+        actor = self.actors.get(payload["actor_id"])
+        if actor is None:
+            return False
+        no_restart = payload.get("no_restart", True)
+        if no_restart:
+            actor.state = ACTOR_DEAD
+            actor.death_cause = "ray.kill"
+        if actor.name:
+            key = (actor.namespace, actor.name)
+            if self.named_actors.get(key) == actor.actor_id and no_restart:
+                del self.named_actors[key]
+        if actor.address:
+            try:
+                await self.clients.request(
+                    actor.address, "kill_actor",
+                    {"actor_id": actor.actor_id, "no_restart": no_restart},
+                    timeout=5.0)
+            except Exception:
+                pass
+        if no_restart:
+            self.pubsub.publish("actors", {"event": "dead",
+                                           "actor_id": actor.actor_id,
+                                           "reason": "killed",
+                                           "actor_info": actor})
+        return True
+
+    async def rpc_get_actor_info(self, conn, payload):
+        return self.actors.get(payload["actor_id"])
+
+    async def rpc_get_named_actor(self, conn, payload):
+        key = (payload.get("namespace", ""), payload["name"])
+        actor_id = self.named_actors.get(key)
+        if actor_id is None:
+            return None
+        return self.actors.get(actor_id)
+
+    async def rpc_list_named_actors(self, conn, payload):
+        ns = payload.get("namespace")
+        out = []
+        for (namespace, name), aid in self.named_actors.items():
+            if ns is None or namespace == ns:
+                if self.actors[aid].state != ACTOR_DEAD:
+                    out.append({"namespace": namespace, "name": name})
+        return out
+
+    async def rpc_get_all_actors(self, conn, payload):
+        return list(self.actors.values())
+
+    # ------------- placement groups -------------
+
+    async def rpc_create_placement_group(self, conn, payload):
+        pg: PlacementGroupInfo = payload["pg"]
+        self.placement_groups[pg.pg_id] = pg
+        asyncio.ensure_future(self._schedule_pg(pg))
+        return True
+
+    async def _schedule_pg(self, pg: PlacementGroupInfo, delay: float = 0.0):
+        if delay:
+            await asyncio.sleep(delay)
+        if pg.state == PG_REMOVED:
+            return
+        async with self._pg_lock:
+            placement = self._place_bundles(pg)
+            if placement is None:
+                self.pubsub.publish("demand", {"pg": pg.pg_id,
+                                               "bundles": pg.bundles})
+                asyncio.ensure_future(self._schedule_pg(pg, delay=0.5))
+                return
+            # Two-phase: reserve on each node, rollback on failure.
+            reserved: List[tuple] = []
+            ok = True
+            for idx, node_id in placement.items():
+                node = self.nodes.get(node_id)
+                try:
+                    got = await self.clients.request(
+                        node.address, "reserve_bundle",
+                        {"pg_id": pg.pg_id, "bundle_index": idx,
+                         "resources": pg.bundles[idx]}, timeout=10.0)
+                except Exception:
+                    got = False
+                if not got:
+                    ok = False
+                    break
+                reserved.append((idx, node_id))
+            if not ok:
+                for idx, node_id in reserved:
+                    node = self.nodes.get(node_id)
+                    try:
+                        await self.clients.request(
+                            node.address, "return_bundle",
+                            {"pg_id": pg.pg_id, "bundle_index": idx}, timeout=10.0)
+                    except Exception:
+                        pass
+                asyncio.ensure_future(self._schedule_pg(pg, delay=0.5))
+                return
+            pg.bundle_nodes = dict(placement)
+            pg.state = PG_CREATED
+            self.pubsub.publish("placement_groups", {"event": "created", "pg": pg})
+
+    def _place_bundles(self, pg: PlacementGroupInfo) -> Optional[Dict[int, NodeID]]:
+        """Bundle placement honoring PACK/SPREAD/STRICT_PACK/STRICT_SPREAD.
+
+        Reference semantics: bundle_scheduling_policy.h — STRICT_PACK all on
+        one node; STRICT_SPREAD all on distinct nodes; PACK/SPREAD best-effort.
+        """
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return None
+        avail = {n.node_id: dict(n.resources_available) for n in alive}
+
+        def take(node_id, bundle) -> bool:
+            a = avail[node_id]
+            if all(a.get(k, 0) >= v for k, v in bundle.items()):
+                for k, v in bundle.items():
+                    a[k] = a.get(k, 0) - v
+                return True
+            return False
+
+        placement: Dict[int, NodeID] = {}
+        if pg.strategy == "STRICT_PACK":
+            for n in alive:
+                trial = dict(avail[n.node_id])
+                if all(all(trial.get(k, 0) >= v for k, v in b.items()) or True
+                       for b in pg.bundles):
+                    ok = True
+                    for b in pg.bundles:
+                        if not all(trial.get(k, 0) >= v for k, v in b.items()):
+                            ok = False
+                            break
+                        for k, v in b.items():
+                            trial[k] = trial.get(k, 0) - v
+                    if ok:
+                        return {i: n.node_id for i in range(len(pg.bundles))}
+            return None
+        if pg.strategy == "STRICT_SPREAD":
+            if len(pg.bundles) > len(alive):
+                return None
+            used_nodes: set = set()
+            for i, b in enumerate(pg.bundles):
+                placed = False
+                for n in alive:
+                    if n.node_id in used_nodes:
+                        continue
+                    if take(n.node_id, b):
+                        placement[i] = n.node_id
+                        used_nodes.add(n.node_id)
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            return placement
+        # PACK / SPREAD best-effort
+        order = alive if pg.strategy == "PACK" else list(alive)
+        for i, b in enumerate(pg.bundles):
+            placed = False
+            if pg.strategy == "SPREAD":
+                # round-robin start
+                order = alive[i % len(alive):] + alive[: i % len(alive)]
+            for n in order:
+                if take(n.node_id, b):
+                    placement[i] = n.node_id
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return placement
+
+    async def _reschedule_pg(self, pg: PlacementGroupInfo):
+        pg.state = PG_PENDING
+        dead = {nid for nid, n in self.nodes.items() if not n.alive}
+        pg.bundle_nodes = {i: n for i, n in pg.bundle_nodes.items() if n not in dead}
+        self.pubsub.publish("placement_groups", {"event": "rescheduling", "pg": pg})
+        await self._schedule_pg(pg)
+
+    async def rpc_remove_placement_group(self, conn, payload):
+        pg = self.placement_groups.get(payload["pg_id"])
+        if pg is None:
+            return False
+        pg.state = PG_REMOVED
+        for idx, node_id in pg.bundle_nodes.items():
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                continue
+            try:
+                await self.clients.request(node.address, "return_bundle",
+                                           {"pg_id": pg.pg_id, "bundle_index": idx},
+                                           timeout=10.0)
+            except Exception:
+                pass
+        self.pubsub.publish("placement_groups", {"event": "removed",
+                                                 "pg_id": pg.pg_id})
+        return True
+
+    async def rpc_get_placement_group(self, conn, payload):
+        if "pg_id" in payload and payload["pg_id"] is not None:
+            return self.placement_groups.get(payload["pg_id"])
+        name = payload.get("name")
+        for pg in self.placement_groups.values():
+            if pg.name == name and pg.state != PG_REMOVED:
+                return pg
+        return None
+
+    async def rpc_get_all_placement_groups(self, conn, payload):
+        return list(self.placement_groups.values())
+
+    # ------------- task events (observability) -------------
+
+    async def rpc_report_task_events(self, conn, payload):
+        if not self.config.task_events_enabled:
+            return True
+        events = payload["events"]
+        self.task_events.extend(events)
+        overflow = len(self.task_events) - self.config.task_events_max_buffer
+        if overflow > 0:
+            del self.task_events[:overflow]
+        return True
+
+    async def rpc_get_task_events(self, conn, payload):
+        job_id = payload.get("job_id")
+        limit = payload.get("limit", 10000)
+        out = [e for e in self.task_events
+               if job_id is None or e.get("job_id") == job_id]
+        return out[-limit:]
+
+    # ------------- persistence (GCS fault tolerance) -------------
+
+    def snapshot(self) -> bytes:
+        return pickle.dumps({
+            "nodes": self.nodes, "actors": self.actors,
+            "named_actors": self.named_actors, "jobs": self.jobs,
+            "placement_groups": self.placement_groups, "kv": self.kv,
+            "job_counter": self._job_counter,
+        })
+
+    def restore(self, data: bytes):
+        state = pickle.loads(data)
+        self.nodes = state["nodes"]
+        self.actors = state["actors"]
+        self.named_actors = state["named_actors"]
+        self.jobs = state["jobs"]
+        self.placement_groups = state["placement_groups"]
+        self.kv = state["kv"]
+        self._job_counter = state["job_counter"]
+
+    def save_snapshot(self, path: str = ""):
+        path = path or os.path.join(self.session_dir, "gcs_snapshot.bin")
+        with open(path, "wb") as f:
+            f.write(self.snapshot())
+
+
+def _fits(request: Dict[str, float], available: Dict[str, float]) -> bool:
+    return all(available.get(k, 0.0) >= v for k, v in request.items() if v > 0)

@@ -1,0 +1,158 @@
+"""Deterministic binary IDs for jobs/tasks/objects/actors/nodes.
+
+Capability parity with the reference's ID scheme (src/ray/common/id.h): IDs are
+fixed-size random/derived byte strings with cheap hashing and hex round-trip.
+Derivation rules (ObjectID = TaskID + return index; ActorID embeds JobID) follow
+the same *semantics* without copying the bit layout.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+_NIL = b"\x00"
+
+
+class BaseID:
+    SIZE = 16
+    __slots__ = ("_bytes", "_hash")
+
+    def __init__(self, id_bytes: bytes):
+        if len(id_bytes) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} expects {self.SIZE} bytes, got {len(id_bytes)}"
+            )
+        self._bytes = id_bytes
+        self._hash = hash(id_bytes)
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(_NIL * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == _NIL * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bytes.hex()[:16]})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+    @classmethod
+    def from_int(cls, i: int):
+        return cls(i.to_bytes(4, "little"))
+
+    def int(self) -> int:
+        return int.from_bytes(self._bytes, "little")
+
+
+class NodeID(BaseID):
+    SIZE = 16
+
+
+class WorkerID(BaseID):
+    SIZE = 16
+
+
+class ActorID(BaseID):
+    """12 random bytes + 4-byte JobID suffix."""
+
+    SIZE = 16
+
+    @classmethod
+    def of(cls, job_id: JobID):
+        return cls(os.urandom(12) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[12:])
+
+
+class TaskID(BaseID):
+    """16 random/derived bytes + 4-byte JobID suffix."""
+
+    SIZE = 20
+
+    @classmethod
+    def of(cls, job_id: JobID):
+        return cls(os.urandom(16) + job_id.binary())
+
+    @classmethod
+    def for_actor_task(cls, job_id: JobID, actor_id: ActorID, seq: int):
+        h = hashlib.blake2b(
+            actor_id.binary() + seq.to_bytes(8, "little"), digest_size=16
+        ).digest()
+        return cls(h + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[16:])
+
+
+class ObjectID(BaseID):
+    """TaskID (20 bytes) + 4-byte little-endian return index."""
+
+    SIZE = 24
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int):
+        return cls(task_id.binary() + index.to_bytes(4, "little"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int):
+        # Put objects use the high bit of the index to avoid collision with returns.
+        return cls(task_id.binary() + (put_index | 0x80000000).to_bytes(4, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:20])
+
+    def job_id(self) -> JobID:
+        return self.task_id().job_id()
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 16
+
+    @classmethod
+    def of(cls, job_id: JobID):
+        return cls(os.urandom(12) + job_id.binary())
+
+
+class _Counter:
+    __slots__ = ("_v", "_lock")
+
+    def __init__(self, start: int = 0):
+        self._v = start
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._v += 1
+            return self._v

@@ -1,0 +1,82 @@
+"""ObjectRef: a future-like handle to a remote object.
+
+Capability parity with the reference ObjectRef (python/ray/includes/object_ref.pxi):
+holds the object id + owner address, participates in distributed refcounting via
+callbacks registered by the core worker, and is awaitable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+# Set by the core worker when connected; used for __del__ deref and await.
+_ref_removed_callback: Optional[Callable[["ObjectRef"], None]] = None
+_ref_added_callback: Optional[Callable[["ObjectRef"], None]] = None
+_get_callback: Optional[Callable[["ObjectRef", Optional[float]], Any]] = None
+_async_get_callback = None
+
+
+def _set_core_worker_hooks(on_added, on_removed, get_fn, async_get_fn):
+    global _ref_added_callback, _ref_removed_callback, _get_callback, _async_get_callback
+    _ref_added_callback = on_added
+    _ref_removed_callback = on_removed
+    _get_callback = get_fn
+    _async_get_callback = async_get_fn
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_address", "_skip_refcount", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_address: str = "",
+                 skip_refcount: bool = False):
+        self.id = object_id
+        self.owner_address = owner_address
+        self._skip_refcount = skip_refcount
+        if not skip_refcount and _ref_added_callback is not None:
+            _ref_added_callback(self)
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def task_id(self):
+        return self.id.task_id()
+
+    def job_id(self):
+        return self.id.job_id()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __del__(self):
+        if not self._skip_refcount and _ref_removed_callback is not None:
+            try:
+                _ref_removed_callback(self)
+            except Exception:
+                pass
+
+    def future(self) -> asyncio.Future:
+        if _async_get_callback is None:
+            raise RuntimeError("ray_tpu not initialized")
+        return asyncio.ensure_future(_async_get_callback(self))
+
+    def __await__(self):
+        if _async_get_callback is None:
+            raise RuntimeError("ray_tpu not initialized")
+        return _async_get_callback(self).__await__()
+
+    def __reduce__(self):
+        # Serialization of a bare ref outside the serializer context still
+        # round-trips, but does not register a borrower.
+        return (ObjectRef, (self.id, self.owner_address, True))

@@ -1,0 +1,693 @@
+"""Raylet: the per-node daemon.
+
+Capability parity with the reference raylet (src/ray/raylet/node_manager.h,
+worker_pool.h, local_task_manager.h, scheduling/): worker lifecycle management,
+the worker-lease protocol with distributed scheduling + spillback (each raylet
+decides locally against a synced cluster resource view, forwarding the lease to
+a better node when it has no capacity — hybrid pack/spread policy per
+hybrid_scheduling_policy.h), placement-group bundle reservation
+(bundle_scheduling_policy.h), the in-process shared-memory object store
+(plasma runs inside the raylet in the reference too), node-to-node object
+transfer (object_manager.h pull/push in chunks), and worker-death detection
+feeding actor failover.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu._private import rpc
+from ray_tpu._private.common import NodeInfo, TaskSpec
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import NodeID, PlacementGroupID, WorkerID
+from ray_tpu._private.object_store import ObjectStoreHost
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    pid: int
+    address: str = ""            # worker RPC endpoint once registered
+    proc: Optional[subprocess.Popen] = None
+    registered: bool = False
+    # Lease state
+    leased: bool = False
+    lease_class: Optional[tuple] = None
+    lease_resources: Dict[str, float] = field(default_factory=dict)
+    lease_pg: Optional[tuple] = None     # (pg_id, bundle_index)
+    is_actor_worker: bool = False
+    actor_id: Optional[object] = None
+    idle_since: float = field(default_factory=time.time)
+    conn: Optional[rpc.Connection] = None
+
+
+class ResourcePool:
+    """Vector resource accounting: node pool + per-bundle sub-pools."""
+
+    def __init__(self, total: Dict[str, float]):
+        self.total = dict(total)
+        self.available = dict(total)
+        # (pg_id_bytes, bundle_index) -> {resource: amount}
+        self.bundles: Dict[tuple, Dict[str, float]] = {}
+        self.bundle_available: Dict[tuple, Dict[str, float]] = {}
+
+    def fits(self, request: Dict[str, float], pg_key: Optional[tuple] = None) -> bool:
+        pool = self.bundle_available.get(pg_key) if pg_key else self.available
+        if pool is None:
+            return False
+        return all(pool.get(k, 0.0) + 1e-9 >= v for k, v in request.items() if v > 0)
+
+    def feasible(self, request: Dict[str, float]) -> bool:
+        return all(self.total.get(k, 0.0) >= v for k, v in request.items() if v > 0)
+
+    def acquire(self, request: Dict[str, float], pg_key: Optional[tuple] = None) -> bool:
+        if not self.fits(request, pg_key):
+            return False
+        pool = self.bundle_available[pg_key] if pg_key else self.available
+        for k, v in request.items():
+            if v > 0:
+                pool[k] = pool.get(k, 0.0) - v
+        return True
+
+    def release(self, request: Dict[str, float], pg_key: Optional[tuple] = None):
+        if pg_key is not None:
+            pool = self.bundle_available.get(pg_key)
+            if pool is None:
+                return
+        else:
+            pool = self.available
+        for k, v in request.items():
+            if v > 0:
+                pool[k] = pool.get(k, 0.0) + v
+
+    def reserve_bundle(self, key: tuple, resources: Dict[str, float]) -> bool:
+        if key in self.bundles:
+            return True
+        if not self.fits(resources):
+            return False
+        for k, v in resources.items():
+            if v > 0:
+                self.available[k] = self.available.get(k, 0.0) - v
+        self.bundles[key] = dict(resources)
+        self.bundle_available[key] = dict(resources)
+        return True
+
+    def return_bundle(self, key: tuple):
+        resources = self.bundles.pop(key, None)
+        self.bundle_available.pop(key, None)
+        if resources:
+            for k, v in resources.items():
+                if v > 0:
+                    self.available[k] = self.available.get(k, 0.0) + v
+
+
+class Raylet:
+    def __init__(self, config: Config, gcs_address: str, session_dir: str,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 is_head: bool = False,
+                 object_store_memory: Optional[int] = None,
+                 node_name: str = ""):
+        self.config = config
+        self.gcs_address = gcs_address
+        self.session_dir = session_dir
+        self.node_id = NodeID.from_random()
+        self.node_name = node_name or self.node_id.hex()[:8]
+        self.is_head = is_head
+        self.resources = resources or self._default_resources()
+        self.labels = labels or {}
+        self.pool = ResourcePool(self.resources)
+        self.server = rpc.RpcServer(f"raylet-{self.node_name}")
+        self.store = ObjectStoreHost(
+            object_store_memory or config.object_store_memory,
+            os.path.join(session_dir, f"spill_{self.node_name}"),
+        )
+        self.clients = rpc.ClientPool()
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+        self._idle_workers: List[WorkerHandle] = []
+        self._pending_leases: List[tuple] = []   # (spec, future)
+        self._starting_workers = 0
+        self.gcs_conn: Optional[rpc.Connection] = None
+        # Cluster resource view: node_id -> {available, total, address}
+        self.cluster_view: Dict[NodeID, dict] = {}
+        self.address = ""
+        self._tasks: List[asyncio.Task] = []
+        self._worker_env = dict(os.environ)
+        self._stopped = False
+        self._resources_dirty = False
+
+    def _default_resources(self) -> Dict[str, float]:
+        cpus = os.cpu_count() or 1
+        res = {"CPU": float(cpus), "memory": 4 * 1024**3}
+        res["object_store_memory"] = float(self.config.object_store_memory) \
+            if hasattr(self, "config") else 2 * 1024**3
+        return res
+
+    # ------------------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self.server.register_all(self)
+        actual = await self.server.start(host, port)
+        self.address = f"{host}:{actual}"
+        # Register with GCS and subscribe to cluster events.
+        self.gcs_conn = await rpc.connect(self.gcs_address, self._on_gcs_push)
+        info = NodeInfo(
+            node_id=self.node_id, address=self.address,
+            resources_total=dict(self.pool.total),
+            resources_available=dict(self.pool.available),
+            labels=self.labels, is_head=self.is_head,
+        )
+        reply = await self.gcs_conn.request("register_node", {"node_info": info})
+        for node_id, view in reply.get("cluster_view", {}).items():
+            if node_id != self.node_id:
+                self.cluster_view[node_id] = view
+        await self.gcs_conn.request(
+            "subscribe", {"channels": ["resources", "nodes", "actors"]})
+        self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._tasks.append(asyncio.ensure_future(self._idle_worker_reaper()))
+        logger.info("raylet %s started at %s", self.node_name, self.address)
+        return self.address
+
+    async def stop(self):
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        for w in self.workers.values():
+            if w.proc is not None:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+        for w in self.workers.values():
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=2)
+                except Exception:
+                    try:
+                        w.proc.kill()
+                    except Exception:
+                        pass
+        await self.server.stop()
+        await self.clients.close_all()
+        self.store.destroy()
+
+    async def _heartbeat_loop(self):
+        while True:
+            await asyncio.sleep(self.config.heartbeat_interval_s)
+            try:
+                await self.gcs_conn.request("heartbeat", {
+                    "node_id": self.node_id,
+                    "resources_available": dict(self.pool.available),
+                })
+                self._check_worker_deaths()
+                if self._resources_dirty:
+                    self._resources_dirty = False
+                    await self._report_resources()
+            except rpc.RpcError:
+                logger.warning("raylet %s lost GCS connection", self.node_name)
+                return
+
+    async def _report_resources(self):
+        try:
+            await self.gcs_conn.request("report_resources", {
+                "node_id": self.node_id,
+                "available": dict(self.pool.available),
+            })
+        except rpc.RpcError:
+            pass
+
+    def _on_gcs_push(self, method: str, payload):
+        if method != "pub":
+            return
+        channel = payload["channel"]
+        msg = payload["message"]
+        if channel == "resources":
+            if msg["node_id"] != self.node_id:
+                self.cluster_view[msg["node_id"]] = {
+                    "available": msg["available"], "total": msg["total"],
+                    "address": msg.get("address", "")}
+        elif channel == "nodes":
+            if msg["event"] == "dead":
+                self.cluster_view.pop(msg.get("node_id"), None)
+
+    # ------------------------------------------------------------------
+    # Worker pool
+
+    def _spawn_worker(self) -> WorkerHandle:
+        env = dict(self._worker_env)
+        # Workers must import ray_tpu regardless of the driver's cwd/sys.path.
+        import ray_tpu
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+            ray_tpu.__file__)))
+        existing = env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = (pkg_root + os.pathsep + existing).rstrip(os.pathsep)
+        env["RAY_TPU_RAYLET_ADDRESS"] = self.address
+        env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        worker_id = WorkerID.from_random()
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"), "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        handle = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc)
+        self.workers[worker_id] = handle
+        self._starting_workers += 1
+        return handle
+
+    async def rpc_register_worker(self, conn, payload):
+        """Called by a worker process once its RPC server is up."""
+        worker_id = payload["worker_id"]
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            handle = WorkerHandle(worker_id=worker_id, pid=payload["pid"])
+            self.workers[worker_id] = handle
+        handle.address = payload["address"]
+        handle.registered = True
+        handle.conn = conn
+        handle.idle_since = time.time()
+        self._starting_workers = max(0, self._starting_workers - 1)
+        self._idle_workers.append(handle)
+        conn.peer_info["worker_id"] = worker_id
+        prev = conn.on_close
+        def _on_close(c, _prev=prev):
+            asyncio.ensure_future(self._on_worker_disconnect(worker_id))
+            if _prev:
+                _prev(c)
+        conn.on_close = _on_close
+        self._try_dispatch()
+        return {"node_id": self.node_id, "config": self.config.to_dict()}
+
+    async def _on_worker_disconnect(self, worker_id: WorkerID):
+        handle = self.workers.pop(worker_id, None)
+        if handle is None:
+            return
+        if not handle.registered:
+            # Died during startup: it still counts against supply.
+            self._starting_workers = max(0, self._starting_workers - 1)
+        if handle in self._idle_workers:
+            self._idle_workers.remove(handle)
+        if handle.leased:
+            self.pool.release(handle.lease_resources, handle.lease_pg)
+            self._resources_dirty = True
+        if handle.is_actor_worker and handle.actor_id is not None:
+            try:
+                await self.gcs_conn.request("report_actor_failure", {
+                    "actor_id": handle.actor_id,
+                    "reason": f"worker process {handle.pid} died"})
+            except rpc.RpcError:
+                pass
+        self._try_dispatch()
+
+    def _check_worker_deaths(self):
+        for worker_id, handle in list(self.workers.items()):
+            if handle.proc is not None and handle.proc.poll() is not None:
+                if handle.registered and handle.conn is not None \
+                        and not handle.conn.closed:
+                    handle.conn.abort(rpc.ConnectionLost("process exited"))
+                else:
+                    asyncio.ensure_future(self._on_worker_disconnect(worker_id))
+
+    async def _idle_worker_reaper(self):
+        """Kill surplus idle workers beyond the prestart floor."""
+        while True:
+            await asyncio.sleep(5.0)
+            floor = max(2, int(self.pool.total.get("CPU", 1)))
+            while len(self._idle_workers) > floor:
+                handle = self._idle_workers.pop(0)
+                try:
+                    if handle.conn:
+                        await handle.conn.push("shutdown", {})
+                except Exception:
+                    pass
+
+    def _get_idle_worker(self) -> Optional[WorkerHandle]:
+        while self._idle_workers:
+            handle = self._idle_workers.pop()
+            if handle.registered and handle.worker_id in self.workers \
+                    and not (handle.conn and handle.conn.closed):
+                return handle
+        return None
+
+    def _ensure_worker_supply(self):
+        demand = len(self._pending_leases)
+        supply = len(self._idle_workers) + self._starting_workers
+        can_start = self.config.max_workers_per_node - len(self.workers)
+        for _ in range(min(max(0, demand - supply), max(0, can_start))):
+            self._spawn_worker()
+
+    # ------------------------------------------------------------------
+    # Lease protocol (normal tasks)
+
+    async def rpc_request_worker_lease(self, conn, payload):
+        """Grant a local worker, queue, or spill to another node.
+
+        Reply: {"granted": {...}} | {"spillback": address} | {"infeasible": True}
+        """
+        spec: TaskSpec = payload["spec"]
+        pg_key = None
+        if spec.scheduling.placement_group_id is not None:
+            idx = spec.scheduling.bundle_index
+            if idx < 0:
+                # any bundle of the PG on this node
+                for key in self.pool.bundles:
+                    if key[0] == spec.scheduling.placement_group_id.binary():
+                        pg_key = key
+                        break
+                if pg_key is None:
+                    return {"infeasible": True}
+            else:
+                pg_key = (spec.scheduling.placement_group_id.binary(), idx)
+                if pg_key not in self.pool.bundles:
+                    return {"infeasible": True}
+
+        if pg_key is None and spec.scheduling.kind == "DEFAULT":
+            # Distributed decision: pick best node from the synced view.
+            best = self._pick_best_node(spec.resources)
+            if best is not None and best != self.node_id:
+                view = self.cluster_view.get(best)
+                if view and view.get("address"):
+                    return {"spillback": view["address"]}
+                # fall through to local queue if address unknown
+            if best is None and not self.pool.feasible(spec.resources):
+                # Nothing available anywhere; spill to a node where the
+                # request is at least feasible by its total resources.
+                for node_id, view in self.cluster_view.items():
+                    total = view.get("total", {})
+                    if view.get("address") and all(
+                            total.get(k, 0) >= v
+                            for k, v in spec.resources.items() if v > 0):
+                        return {"spillback": view["address"]}
+                return {"infeasible": True}
+        elif pg_key is None and spec.scheduling.kind == "SPREAD":
+            best = self._pick_spread_node(spec.resources)
+            if best is not None and best != self.node_id:
+                view = self.cluster_view.get(best)
+                if view and "address" in view:
+                    return {"spillback": view["address"]}
+        elif pg_key is None and spec.scheduling.kind == "NODE_AFFINITY":
+            if spec.scheduling.node_id != self.node_id:
+                view = self.cluster_view.get(spec.scheduling.node_id)
+                if view and "address" in view:
+                    return {"spillback": view["address"]}
+                if not spec.scheduling.soft:
+                    return {"infeasible": True}
+
+        fut = asyncio.get_running_loop().create_future()
+        self._pending_leases.append((spec, pg_key, fut))
+        self._try_dispatch()
+        self._ensure_worker_supply()
+        try:
+            return await asyncio.wait_for(fut, self.config.worker_lease_timeout_s)
+        except asyncio.TimeoutError:
+            try:
+                self._pending_leases.remove((spec, pg_key, fut))
+            except ValueError:
+                pass
+            return {"retry": True}
+
+    def _try_dispatch(self):
+        if not self._pending_leases:
+            return
+        remaining = []
+        for spec, pg_key, fut in self._pending_leases:
+            if fut.done():
+                continue
+            if not self.pool.fits(spec.resources, pg_key):
+                remaining.append((spec, pg_key, fut))
+                continue
+            worker = self._get_idle_worker()
+            if worker is None:
+                remaining.append((spec, pg_key, fut))
+                continue
+            self.pool.acquire(spec.resources, pg_key)
+            self._resources_dirty = True
+            worker.leased = True
+            worker.lease_class = spec.scheduling_class()
+            worker.lease_resources = dict(spec.resources)
+            worker.lease_pg = pg_key
+            worker.idle_since = time.time()
+            fut.set_result({"granted": {
+                "worker_id": worker.worker_id,
+                "worker_address": worker.address,
+                "node_id": self.node_id,
+            }})
+        self._pending_leases = [e for e in remaining if not e[2].done()]
+        self._ensure_worker_supply()
+
+    async def rpc_return_worker(self, conn, payload):
+        """Lease released by the submitter (idle timeout or task class change)."""
+        worker_id = payload["worker_id"]
+        handle = self.workers.get(worker_id)
+        if handle is None or not handle.leased:
+            return False
+        handle.leased = False
+        self.pool.release(handle.lease_resources, handle.lease_pg)
+        self._resources_dirty = True
+        handle.lease_resources = {}
+        handle.lease_pg = None
+        if payload.get("kill", False):
+            try:
+                if handle.conn:
+                    await handle.conn.push("shutdown", {})
+            except Exception:
+                pass
+        else:
+            handle.idle_since = time.time()
+            self._idle_workers.append(handle)
+        self._try_dispatch()
+        return True
+
+    def _pick_best_node(self, resources: Dict[str, float]) -> Optional[NodeID]:
+        """Hybrid pack/spread over local + synced cluster view."""
+        candidates: List[tuple] = []
+        if self.pool.fits(resources):
+            candidates.append((self.node_id, self._utilization(
+                self.pool.available, self.pool.total)))
+        for node_id, view in self.cluster_view.items():
+            if node_id == self.node_id:
+                continue
+            avail, total = view["available"], view["total"]
+            if all(avail.get(k, 0) >= v for k, v in resources.items() if v > 0):
+                candidates.append((node_id, self._utilization(avail, total)))
+        if not candidates:
+            return None
+        thr = self.config.scheduler_spread_threshold
+        packed = [c for c in candidates if c[1] < thr]
+        # Prefer local when tied (locality, lease reuse).
+        def keyfn(c):
+            return (-c[1], c[0] != self.node_id)
+        if packed:
+            return min(packed, key=keyfn)[0]
+        return min(candidates, key=lambda c: (c[1], c[0] != self.node_id))[0]
+
+    def _pick_spread_node(self, resources) -> Optional[NodeID]:
+        candidates = []
+        if self.pool.fits(resources):
+            candidates.append((self.node_id,
+                               self._utilization(self.pool.available, self.pool.total)))
+        for node_id, view in self.cluster_view.items():
+            if node_id == self.node_id:
+                continue
+            if all(view["available"].get(k, 0) >= v
+                   for k, v in resources.items() if v > 0):
+                candidates.append((node_id,
+                                   self._utilization(view["available"], view["total"])))
+        if not candidates:
+            return None
+        return min(candidates, key=lambda c: c[1])[0]
+
+    @staticmethod
+    def _utilization(avail: Dict[str, float], total: Dict[str, float]) -> float:
+        fracs = [1 - avail.get(k, 0) / t for k, t in total.items() if t > 0]
+        return max(fracs) if fracs else 0.0
+
+    # ------------------------------------------------------------------
+    # Actor creation (GCS -> this raylet)
+
+    async def rpc_create_actor(self, conn, payload):
+        spec: TaskSpec = payload["spec"]
+        pg_key = None
+        if spec.scheduling.placement_group_id is not None:
+            idx = max(0, spec.scheduling.bundle_index)
+            pg_key = (spec.scheduling.placement_group_id.binary(), idx)
+        if not self.pool.acquire(spec.resources, pg_key):
+            raise RuntimeError("resources no longer available for actor")
+        worker = self._get_idle_worker()
+        if worker is None:
+            self._spawn_worker()
+            deadline = time.time() + self.config.worker_start_timeout_s
+            while worker is None and time.time() < deadline:
+                await asyncio.sleep(0.02)
+                worker = self._get_idle_worker()
+            if worker is None:
+                self.pool.release(spec.resources, pg_key)
+                raise RuntimeError("worker failed to start for actor")
+        worker.leased = True
+        worker.is_actor_worker = True
+        worker.actor_id = spec.actor_id
+        worker.lease_resources = dict(spec.resources)
+        worker.lease_pg = pg_key
+        self._resources_dirty = True
+        try:
+            await self.clients.request(worker.address, "instantiate_actor", {
+                "spec": spec, "num_restarts": payload.get("num_restarts", 0)},
+                timeout=self.config.worker_start_timeout_s)
+        except Exception:
+            worker.leased = False
+            worker.is_actor_worker = False
+            worker.actor_id = None
+            self.pool.release(spec.resources, pg_key)
+            raise
+        return {"actor_address": worker.address, "worker_id": worker.worker_id}
+
+    async def rpc_kill_worker(self, conn, payload):
+        handle = self.workers.get(payload["worker_id"])
+        if handle is None:
+            return False
+        if handle.proc is not None:
+            try:
+                handle.proc.kill()
+            except Exception:
+                pass
+        return True
+
+    # ------------------------------------------------------------------
+    # Placement group bundles
+
+    async def rpc_reserve_bundle(self, conn, payload):
+        key = (payload["pg_id"].binary(), payload["bundle_index"])
+        ok = self.pool.reserve_bundle(key, payload["resources"])
+        if ok:
+            self._resources_dirty = True
+        return ok
+
+    async def rpc_return_bundle(self, conn, payload):
+        key = (payload["pg_id"].binary(), payload["bundle_index"])
+        self.pool.return_bundle(key)
+        self._resources_dirty = True
+        return True
+
+    # ------------------------------------------------------------------
+    # Object store service (workers on this node + remote raylets)
+
+    async def rpc_store_create(self, conn, payload):
+        return self.store.create(payload["object_id"], payload["size"],
+                                 payload.get("metadata", b""),
+                                 payload.get("owner_address", ""))
+
+    async def rpc_store_seal(self, conn, payload):
+        self.store.seal(payload["object_id"])
+        return True
+
+    async def rpc_store_get(self, conn, payload):
+        oid = payload["object_id"]
+        timeout = payload.get("timeout")
+        if not self.store.contains(oid):
+            ok = await self.store.wait_sealed(oid, timeout)
+            if not ok:
+                return None
+        return self.store.pin(oid)
+
+    async def rpc_store_release(self, conn, payload):
+        self.store.unpin(payload["object_id"])
+        return True
+
+    async def rpc_store_contains(self, conn, payload):
+        return self.store.contains(payload["object_id"])
+
+    async def rpc_store_delete(self, conn, payload):
+        for oid in payload["object_ids"]:
+            self.store.delete(oid)
+        return True
+
+    async def rpc_store_stats(self, conn, payload):
+        return self.store.stats()
+
+    async def rpc_store_put_bytes(self, conn, payload):
+        """Put raw serialized bytes (used by small-RPC path and transfers)."""
+        self.store.write_and_seal(payload["object_id"], payload["data"],
+                                  payload.get("metadata", b""),
+                                  payload.get("owner_address", ""))
+        return True
+
+    # ---- inter-node transfer (object manager) ----
+
+    async def rpc_store_pull_chunk(self, conn, payload):
+        """Serve one chunk of a local object to a remote raylet."""
+        oid = payload["object_id"]
+        offset = payload["offset"]
+        length = payload["length"]
+        desc = self.store.pin(oid)
+        if desc is None:
+            return None
+        try:
+            _, obj_off, size, metadata = desc
+            chunk = bytes(self.store.arena.view(obj_off + offset,
+                                                min(length, size - offset)))
+            return {"data": chunk, "total_size": size, "metadata": metadata}
+        finally:
+            self.store.unpin(oid)
+
+    async def rpc_store_fetch_remote(self, conn, payload):
+        """Pull an object from a remote node into the local store."""
+        oid = payload["object_id"]
+        if self.store.contains(oid):
+            return True
+        locations: List[str] = payload["locations"]   # raylet addresses
+        chunk_size = self.config.object_transfer_chunk_bytes
+        for address in locations:
+            if address == self.address:
+                continue
+            created = False
+            try:
+                first = await self.clients.request(
+                    address, "store_pull_chunk",
+                    {"object_id": oid, "offset": 0, "length": chunk_size},
+                    timeout=30.0)
+                if first is None:
+                    continue
+                total = first["total_size"]
+                name, offset = self.store.create(oid, total,
+                                                 first.get("metadata", b""),
+                                                 payload.get("owner_address", ""))
+                created = True
+                view = self.store.arena.view(offset, total)
+                data = first["data"]
+                view[: len(data)] = data
+                pos = len(data)
+                while pos < total:
+                    part = await self.clients.request(
+                        address, "store_pull_chunk",
+                        {"object_id": oid, "offset": pos, "length": chunk_size},
+                        timeout=30.0)
+                    if part is None:
+                        raise rpc.RpcError("object disappeared mid-transfer")
+                    d = part["data"]
+                    view[pos : pos + len(d)] = d
+                    pos += len(d)
+                self.store.seal(oid)
+                return True
+            except rpc.RpcError:
+                if created:
+                    # Roll back so another location (or retry) can recreate.
+                    self.store.abort_create(oid)
+                continue
+            except MemoryError:
+                raise
+        return False

@@ -1,0 +1,288 @@
+"""Asyncio RPC layer: the control-plane transport for every daemon.
+
+Plays the role of the reference's gRPC wrappers (src/ray/rpc/grpc_server.h,
+grpc_client.h): request/response with correlation ids, one-way notifications,
+and server->client pushes (used for pubsub long-poll equivalents). TCP with a
+length-prefixed pickled envelope; payloads are plain Python structures.
+
+Envelope: u32 length | pickle([kind, msg_id, method, payload])
+    kind: 0=request 1=response 2=error-response 3=notify 4=push
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import pickle
+import struct
+import traceback
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+REQUEST, RESPONSE, ERROR, NOTIFY, PUSH = 0, 1, 2, 3, 4
+
+_MAX_MSG = 1 << 31
+
+
+class RpcError(Exception):
+    pass
+
+
+class RemoteRpcError(RpcError):
+    def __init__(self, method: str, err_type: str, message: str, tb: str):
+        self.method = method
+        self.err_type = err_type
+        self.remote_traceback = tb
+        super().__init__(f"RPC {method} failed remotely: {err_type}: {message}\n{tb}")
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+async def _read_msg(reader: asyncio.StreamReader):
+    header = await reader.readexactly(4)
+    (length,) = struct.unpack("<I", header)
+    if length > _MAX_MSG:
+        raise RpcError(f"message too large: {length}")
+    data = await reader.readexactly(length)
+    return pickle.loads(data)
+
+
+def _encode(kind: int, msg_id: int, method: str, payload: Any) -> bytes:
+    body = pickle.dumps([kind, msg_id, method, payload], protocol=5)
+    return struct.pack("<I", len(body)) + body
+
+
+class Connection:
+    """One live duplex connection; shared by client and server sides."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 push_handler: Optional[Callable] = None):
+        self.reader = reader
+        self.writer = writer
+        self.push_handler = push_handler
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._write_lock = asyncio.Lock()
+        self.on_close: Optional[Callable] = None
+        # Set by server loop: peer-provided identity metadata.
+        self.peer_info: dict = {}
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    async def send(self, kind: int, msg_id: int, method: str, payload: Any):
+        data = _encode(kind, msg_id, method, payload)
+        async with self._write_lock:
+            if self._closed:
+                raise ConnectionLost("connection closed")
+            self.writer.write(data)
+            await self.writer.drain()
+
+    async def request(self, method: str, payload: Any = None,
+                      timeout: Optional[float] = None) -> Any:
+        msg_id = next(self._ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        try:
+            await self.send(REQUEST, msg_id, method, payload)
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(msg_id, None)
+
+    async def notify(self, method: str, payload: Any = None):
+        await self.send(NOTIFY, 0, method, payload)
+
+    async def push(self, method: str, payload: Any = None):
+        await self.send(PUSH, 0, method, payload)
+
+    def abort(self, exc: Exception):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(str(exc)))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close:
+            try:
+                self.on_close(self)
+            except Exception:
+                pass
+
+    async def close(self):
+        self.abort(ConnectionLost("closed"))
+
+    async def _dispatch_response(self, kind, msg_id, payload):
+        fut = self._pending.get(msg_id)
+        if fut is None or fut.done():
+            return
+        if kind == RESPONSE:
+            fut.set_result(payload)
+        else:
+            method, err_type, message, tb = payload
+            fut.set_exception(RemoteRpcError(method, err_type, message, tb))
+
+    async def client_loop(self):
+        """Read loop for the client side of a connection."""
+        try:
+            while True:
+                kind, msg_id, method, payload = await _read_msg(self.reader)
+                if kind in (RESPONSE, ERROR):
+                    await self._dispatch_response(kind, msg_id, payload)
+                elif kind == PUSH and self.push_handler is not None:
+                    try:
+                        res = self.push_handler(method, payload)
+                        if asyncio.iscoroutine(res):
+                            asyncio.ensure_future(res)
+                    except Exception:
+                        logger.exception("push handler failed for %s", method)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            self.abort(e)
+        except Exception as e:
+            logger.exception("client loop error")
+            self.abort(e)
+
+
+Handler = Callable[[Connection, Any], Awaitable[Any]]
+
+
+class RpcServer:
+    def __init__(self, name: str = "server"):
+        self.name = name
+        self._handlers: Dict[str, Handler] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: set[Connection] = set()
+        self.port: int = 0
+
+    def register(self, method: str, handler: Handler):
+        self._handlers[method] = handler
+
+    def register_all(self, obj: Any, prefix: str = ""):
+        """Register every ``rpc_*`` coroutine method of obj."""
+        for attr in dir(obj):
+            if attr.startswith("rpc_"):
+                self.register(prefix + attr[4:], getattr(obj, attr))
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = await asyncio.start_server(self._on_connect, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self):
+        for conn in list(self.connections):
+            conn.abort(ConnectionLost("server stopped"))
+        if self._server:
+            self._server.close()
+            try:
+                # 3.12 wait_closed blocks until every handler drains; our
+                # handlers exit on the aborts above, but bound it anyway.
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2)
+            except Exception:
+                pass
+
+    async def _on_connect(self, reader, writer):
+        conn = Connection(reader, writer)
+        self.connections.add(conn)
+        conn.on_close = lambda c: self.connections.discard(c)
+        try:
+            while True:
+                kind, msg_id, method, payload = await _read_msg(reader)
+                if kind in (RESPONSE, ERROR):
+                    await conn._dispatch_response(kind, msg_id, payload)
+                    continue
+                handler = self._handlers.get(method)
+                if handler is None:
+                    if kind == REQUEST:
+                        await conn.send(ERROR, msg_id, method,
+                                        (method, "KeyError", f"no handler {method}", ""))
+                    continue
+                if kind == REQUEST:
+                    asyncio.ensure_future(self._run_handler(conn, msg_id, method,
+                                                            handler, payload))
+                else:  # NOTIFY
+                    asyncio.ensure_future(self._run_notify(conn, method, handler,
+                                                           payload))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except Exception:
+            logger.exception("%s: connection loop error", self.name)
+        finally:
+            conn.abort(ConnectionLost("peer disconnected"))
+
+    async def _run_handler(self, conn, msg_id, method, handler, payload):
+        try:
+            result = await handler(conn, payload)
+            await conn.send(RESPONSE, msg_id, method, result)
+        except ConnectionLost:
+            pass
+        except Exception as e:
+            tb = traceback.format_exc()
+            try:
+                await conn.send(ERROR, msg_id, method,
+                                (method, type(e).__name__, str(e), tb))
+            except Exception:
+                pass
+
+    async def _run_notify(self, conn, method, handler, payload):
+        try:
+            await handler(conn, payload)
+        except Exception:
+            logger.exception("%s: notify handler %s failed", self.name, method)
+
+
+async def connect(address: str, push_handler: Optional[Callable] = None,
+                  timeout: float = 10.0) -> Connection:
+    host, port = address.rsplit(":", 1)
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, int(port)), timeout)
+    conn = Connection(reader, writer, push_handler)
+    asyncio.ensure_future(conn.client_loop())
+    return conn
+
+
+class ClientPool:
+    """Connection pool keyed by address, with lazy (re)connection."""
+
+    def __init__(self, push_handler: Optional[Callable] = None):
+        self._conns: Dict[str, Connection] = {}
+        self._locks: Dict[str, asyncio.Lock] = {}
+        self._push_handler = push_handler
+
+    async def get(self, address: str) -> Connection:
+        conn = self._conns.get(address)
+        if conn is not None and not conn.closed:
+            return conn
+        lock = self._locks.setdefault(address, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(address)
+            if conn is not None and not conn.closed:
+                return conn
+            conn = await connect(address, self._push_handler)
+            self._conns[address] = conn
+            return conn
+
+    async def request(self, address: str, method: str, payload: Any = None,
+                      timeout: Optional[float] = None) -> Any:
+        conn = await self.get(address)
+        return await conn.request(method, payload, timeout)
+
+    def invalidate(self, address: str):
+        conn = self._conns.pop(address, None)
+        if conn:
+            conn.abort(ConnectionLost("invalidated"))
+
+    async def close_all(self):
+        for conn in self._conns.values():
+            conn.abort(ConnectionLost("pool closed"))
+        self._conns.clear()

@@ -1,0 +1,165 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band zero-copy buffers.
+
+Capability parity with python/ray/_private/serialization.py: functions/classes
+go through cloudpickle; numpy (and jax-on-host) arrays are serialized
+out-of-band so large tensors are written into / read from the shared-memory
+object store with zero copies; ObjectRefs contained in values are collected on
+serialize and re-registered (borrowed) on deserialize.
+
+Wire layout (8-byte aligned so numpy views map directly onto shm):
+    u32 magic | u32 n_buffers | u64 sizes[n] | pad to 8 | buf0 (inband pickle)
+    | pad | buf1 | pad | ...
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import threading
+from typing import Any, Callable, List, Optional
+
+import cloudpickle
+
+from ray_tpu._private.object_ref import ObjectRef
+
+MAGIC = 0x52545055  # "RTPU"
+_ALIGN = 8
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+class SerializedObject:
+    __slots__ = ("buffers", "contained_refs")
+
+    def __init__(self, buffers: List[memoryview], contained_refs: List[ObjectRef]):
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    @property
+    def total_size(self) -> int:
+        header = 8 + 8 * len(self.buffers)
+        size = _pad(header)
+        for b in self.buffers:
+            size = _pad(size + b.nbytes)
+        return size
+
+    def write_to(self, dest: memoryview) -> int:
+        n = len(self.buffers)
+        struct.pack_into("<II", dest, 0, MAGIC, n)
+        off = 8
+        for b in self.buffers:
+            struct.pack_into("<Q", dest, off, b.nbytes)
+            off += 8
+        off = _pad(off)
+        for b in self.buffers:
+            dest[off : off + b.nbytes] = b.cast("B") if b.format != "B" else b
+            off = _pad(off + b.nbytes)
+        return off
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size)
+        self.write_to(memoryview(out))
+        return bytes(out)
+
+
+# Thread-local context used to thread contained-ref collection through pickle.
+_ctx = threading.local()
+
+
+def _objectref_reducer(ref: ObjectRef):
+    lst = getattr(_ctx, "refs", None)
+    if lst is not None:
+        lst.append(ref)
+    return (_restore_ref, (ref.id, ref.owner_address))
+
+
+def _restore_ref(object_id, owner_address):
+    cb = getattr(_ctx, "deser_ref_cb", None)
+    if cb is not None:
+        return cb(object_id, owner_address)
+    return ObjectRef(object_id, owner_address, skip_refcount=True)
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    dispatch_table = dict(getattr(cloudpickle.CloudPickler, "dispatch_table", {}))
+
+    def reducer_override(self, obj):
+        if isinstance(obj, ObjectRef):
+            return _objectref_reducer(obj)
+        return super().reducer_override(obj)
+
+
+class SerializationContext:
+    """Per-worker serializer with custom-serializer registry."""
+
+    def __init__(self):
+        self._custom: dict[type, tuple[Callable, Callable]] = {}
+        # Called with (ObjectID, owner_address) on deserialization of a
+        # contained ref; installed by the core worker to register borrowers.
+        self.deserialized_ref_factory: Optional[Callable] = None
+
+    def register_custom_serializer(self, cls: type, serializer: Callable,
+                                   deserializer: Callable):
+        self._custom[cls] = (serializer, deserializer)
+        cp = self._custom
+
+        def _reduce(obj):
+            ser, deser = cp[type(obj)]
+            return (deser, (ser(obj),))
+
+        _Pickler.dispatch_table[cls] = _reduce
+
+    def serialize(self, value: Any) -> SerializedObject:
+        import io
+
+        _ctx.refs = []
+        buffers: List[pickle.PickleBuffer] = []
+        try:
+            f = io.BytesIO()
+            p = _Pickler(f, protocol=5, buffer_callback=buffers.append)
+            p.dump(value)
+            inband = f.getvalue()
+            refs = list(_ctx.refs)
+        finally:
+            _ctx.refs = None
+        views = [memoryview(inband)]
+        for pb in buffers:
+            views.append(pb.raw())
+        return SerializedObject(views, refs)
+
+    def deserialize(self, data) -> Any:
+        if isinstance(data, (bytes, bytearray)):
+            data = memoryview(data)
+        magic, n = struct.unpack_from("<II", data, 0)
+        if magic != MAGIC:
+            raise ValueError("corrupt serialized object (bad magic)")
+        sizes = struct.unpack_from(f"<{n}Q", data, 8)
+        off = _pad(8 + 8 * n)
+        bufs = []
+        for s in sizes:
+            bufs.append(data[off : off + s])
+            off = _pad(off + s)
+        _ctx.deser_ref_cb = self.deserialized_ref_factory
+        try:
+            return pickle.loads(bufs[0], buffers=bufs[1:])
+        finally:
+            _ctx.deser_ref_cb = None
+
+    # -- convenience one-shot helpers (control-plane metadata, small values) --
+    def dumps(self, value: Any) -> bytes:
+        return self.serialize(value).to_bytes()
+
+    def loads(self, data) -> Any:
+        return self.deserialize(data)
+
+
+_default_context: Optional[SerializationContext] = None
+
+
+def get_serialization_context() -> SerializationContext:
+    global _default_context
+    if _default_context is None:
+        _default_context = SerializationContext()
+    return _default_context

@@ -1,0 +1,288 @@
+"""Driver-side global state + the init/get/put/wait API core.
+
+Reference parity: python/ray/_private/worker.py (ray.init :1219, get :2547,
+put :2679, wait :2744, shutdown :1796, get_actor :2890).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import atexit
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private.config import Config, set_config
+from ray_tpu._private.core_worker import CoreWorker
+from ray_tpu._private.node import HeadNode, detect_node_resources
+from ray_tpu._private.object_ref import ObjectRef
+
+logger = logging.getLogger(__name__)
+
+
+class _GlobalState:
+    def __init__(self):
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.loop_thread: Optional[threading.Thread] = None
+        self.head: Optional[HeadNode] = None
+        self.core: Optional[CoreWorker] = None
+        self.initialized = False
+        self.namespace = ""
+        self.gcs_address = ""
+        self.exported_functions: Dict[str, bool] = {}
+
+    def run(self, coro, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+
+_state = _GlobalState()
+
+
+def _ensure_loop():
+    if _state.loop is not None:
+        return
+    ready = threading.Event()
+
+    def _run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        _state.loop = loop
+        ready.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=_run, daemon=True, name="ray_tpu-loop")
+    t.start()
+    _state.loop_thread = t
+    ready.wait(10)
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def get_core() -> CoreWorker:
+    # Worker-process context: the executing CoreWorker registers itself here
+    # so user code inside tasks can call the public API.
+    if _worker_core.core is not None:
+        return _worker_core.core
+    if not _state.initialized:
+        init()
+    return _state.core
+
+
+class _WorkerCore:
+    """Set inside worker processes (see worker_main) for API reentrancy."""
+    def __init__(self):
+        self.core: Optional[CoreWorker] = None
+
+
+_worker_core = _WorkerCore()
+
+
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         labels: Optional[Dict[str, str]] = None,
+         object_store_memory: Optional[int] = None,
+         namespace: str = "",
+         system_config: Optional[dict] = None,
+         ignore_reinit_error: bool = True,
+         log_level: int = logging.WARNING):
+    """Start (or connect to) a cluster and connect this driver."""
+    if _state.initialized:
+        if ignore_reinit_error:
+            return _state
+        raise RuntimeError("ray_tpu already initialized")
+    logging.basicConfig(level=log_level)
+    config = Config.load(system_config)
+    set_config(config)
+    _ensure_loop()
+    _state.namespace = namespace
+
+    async def _boot():
+        if address is None:
+            res = detect_node_resources(num_cpus, num_tpus, resources, config)
+            head = HeadNode(config, resources=res, labels=labels,
+                            object_store_memory=object_store_memory)
+            gcs_address = await head.start()
+            raylet_address = head.raylet.address
+            _state.head = head
+        else:
+            gcs_address = address
+            from ray_tpu._private import rpc
+            conn = await rpc.connect(gcs_address)
+            nodes = await conn.request("get_all_nodes", {})
+            await conn.close()
+            alive = [n for n in nodes if n.alive]
+            if not alive:
+                raise exc.RayTpuSystemError("no alive nodes in cluster")
+            heads = [n for n in alive if n.is_head]
+            raylet_address = (heads[0] if heads else alive[0]).address
+        from ray_tpu._private import rpc
+        conn = await rpc.connect(gcs_address)
+        job_id = await conn.request("register_job",
+                                    {"driver_address": "", "entrypoint": ""})
+        await conn.close()
+        core = CoreWorker("driver", gcs_address, raylet_address, config,
+                          job_id=job_id)
+        await core.start_async()
+        _state.core = core
+        _state.gcs_address = gcs_address
+        return gcs_address
+
+    _state.run(_boot(), timeout=60)
+    _state.initialized = True
+    atexit.register(shutdown)
+    return _state
+
+
+def shutdown():
+    if not _state.initialized:
+        return
+    try:
+        if _state.core is not None:
+            _state.run(_state.core.shutdown_async(), timeout=10)
+    except Exception:
+        pass
+    try:
+        if _state.head is not None:
+            _state.run(_state.head.stop(), timeout=10)
+    except Exception:
+        pass
+    _state.core = None
+    _state.head = None
+    _state.initialized = False
+    _state.exported_functions.clear()
+
+
+def put(value: Any) -> ObjectRef:
+    core = get_core()
+    return core.run_sync(core.put_async(value)) \
+        if core.mode == "driver" else _worker_put(core, value)
+
+
+def _worker_put(core: CoreWorker, value: Any) -> ObjectRef:
+    # Inside a worker the loop is the current thread's loop when called from
+    # async actor code, else we're on an executor thread.
+    return asyncio.run_coroutine_threadsafe(
+        core.put_async(value), core.loop).result()
+
+
+def get(refs, timeout: Optional[float] = None):
+    core = get_core()
+    if isinstance(refs, (list, tuple)):
+        bad = [r for r in refs if not isinstance(r, ObjectRef)]
+        if bad:
+            raise TypeError(
+                f"get() expects ObjectRefs; got {type(bad[0]).__name__}")
+        refs = list(refs)
+    elif not isinstance(refs, ObjectRef):
+        raise TypeError(
+            f"get() expects an ObjectRef or a list of them; got "
+            f"{type(refs).__name__}")
+    coro = core.get_async(refs, timeout)
+    return _call_on_core_loop(core, coro, timeout)
+
+
+def wait(refs: List[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    core = get_core()
+    refs = list(refs)
+    if any(not isinstance(r, ObjectRef) for r in refs):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    coro = core.wait_async(refs, num_returns, timeout, fetch_local)
+    return _call_on_core_loop(core, coro, None)
+
+
+def _call_on_core_loop(core: CoreWorker, coro, timeout):
+    """Run coro on the core loop from whatever thread we're on."""
+    try:
+        running = asyncio.get_running_loop()
+    except RuntimeError:
+        running = None
+    if running is core.loop:
+        raise RuntimeError(
+            "blocking API called from the core event loop; use await/async "
+            "variants inside async actors")
+    fut = asyncio.run_coroutine_threadsafe(coro, core.loop)
+    return fut.result(None if timeout is None else timeout + 10)
+
+
+def kill(actor, *, no_restart: bool = True):
+    from ray_tpu.actor import ActorHandle
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle")
+    core = get_core()
+    _call_on_core_loop(core, core.kill_actor(actor._actor_id, no_restart), 10)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    core = get_core()
+    _call_on_core_loop(core, core.cancel_task(ref, force), 10)
+
+
+def get_actor(name: str, namespace: Optional[str] = None):
+    from ray_tpu.actor import ActorHandle
+    core = get_core()
+    ns = namespace if namespace is not None else _state.namespace
+    info = _call_on_core_loop(core, core.get_named_actor(name, ns), 10)
+    return ActorHandle._from_actor_info(info)
+
+
+def nodes() -> List[dict]:
+    core = get_core()
+    infos = _call_on_core_loop(core, core.gcs.request("get_all_nodes", {}), 10)
+    return [{
+        "NodeID": n.node_id.hex(), "Alive": n.alive, "Address": n.address,
+        "Resources": n.resources_total, "Labels": n.labels,
+        "IsHead": n.is_head,
+    } for n in infos]
+
+
+def cluster_resources() -> Dict[str, float]:
+    core = get_core()
+    view = _call_on_core_loop(core,
+                              core.gcs.request("get_cluster_resources", {}), 10)
+    out: Dict[str, float] = {}
+    for info in view.values():
+        if not info["alive"]:
+            continue
+        for k, v in info["total"].items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def available_resources() -> Dict[str, float]:
+    core = get_core()
+    view = _call_on_core_loop(core,
+                              core.gcs.request("get_cluster_resources", {}), 10)
+    out: Dict[str, float] = {}
+    for info in view.values():
+        if not info["alive"]:
+            continue
+        for k, v in info["available"].items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def timeline(job_id=None) -> List[dict]:
+    """Chrome-trace-format task timeline (reference: ray.timeline)."""
+    core = get_core()
+    events = _call_on_core_loop(
+        core, core.gcs.request("get_task_events", {"job_id": None}), 30)
+    trace = []
+    starts: Dict[str, dict] = {}
+    for e in events:
+        if e["state"] == "RUNNING":
+            starts[e["task_id"]] = e
+        elif e["state"] in ("FINISHED", "FAILED") and e["task_id"] in starts:
+            s = starts.pop(e["task_id"])
+            trace.append({
+                "cat": "task", "name": e["name"], "ph": "X",
+                "ts": s["time"] * 1e6, "dur": (e["time"] - s["time"]) * 1e6,
+                "pid": e.get("worker_id", "")[:8], "tid": 0,
+            })
+    return trace

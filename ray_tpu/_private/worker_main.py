@@ -1,0 +1,89 @@
+"""Worker process entrypoint (reference: python/ray/_private/workers/default_worker.py).
+
+Spawned by the raylet; registers back over RPC, then serves pushed tasks until
+told to shut down.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import sys
+
+
+def main():
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s worker[%(process)d] %(name)s: %(message)s")
+    raylet_address = os.environ["RAY_TPU_RAYLET_ADDRESS"]
+    gcs_address = os.environ["RAY_TPU_GCS_ADDRESS"]
+    session_dir = os.environ.get("RAY_TPU_SESSION_DIR", "")
+
+    from ray_tpu._private import rpc
+    from ray_tpu._private.config import Config, set_config
+    from ray_tpu._private.core_worker import CoreWorker
+    from ray_tpu._private.ids import NodeID, WorkerID
+
+    worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+    node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
+
+    async def run():
+        config = Config.load()
+        core = CoreWorker("worker", gcs_address, raylet_address, config,
+                          worker_id=worker_id, node_id=node_id,
+                          session_dir=session_dir)
+        await core.start_async()
+        # Make the public API (ray_tpu.get/put/remote inside tasks) reentrant.
+        from ray_tpu._private import worker_api
+        worker_api._worker_core.core = core
+        # Register with the raylet so it can hand out leases to us.
+        raylet_conn = await rpc.connect(raylet_address, core.server and None)
+        reply = await raylet_conn.request("register_worker", {
+            "worker_id": worker_id, "pid": os.getpid(),
+            "address": core.address,
+        })
+        set_config(Config.load(reply["config"]))
+
+        # The raylet pushes "shutdown" notifications over this connection.
+        async def watch_raylet():
+            while True:
+                await asyncio.sleep(0.5)
+                if raylet_conn.closed:
+                    core.loop.stop()
+                    return
+        asyncio.ensure_future(watch_raylet())
+        core.server.register("shutdown", _make_shutdown(core))
+        return core, raylet_conn
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    core_and_conn = loop.run_until_complete(run())
+    core, raylet_conn = core_and_conn
+
+    # raylet "shutdown" arrives as a notify on the raylet connection; handle it.
+    def push_handler(method, payload):
+        if method == "shutdown":
+            loop.call_soon_threadsafe(loop.stop)
+    raylet_conn.push_handler = push_handler
+    # notify-style shutdown also arrives as a request on our server (handled).
+
+    try:
+        loop.run_forever()
+    finally:
+        try:
+            loop.run_until_complete(core.shutdown_async())
+        except Exception:
+            pass
+        sys.exit(0)
+
+
+def _make_shutdown(core):
+    async def _shutdown(conn, payload):
+        core.loop.call_soon(core.loop.stop)
+        return True
+    return _shutdown
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Actor façade: ActorClass / ActorHandle / ActorMethod.
+
+Reference parity: python/ray/actor.py (ActorClass :544, ActorHandle :1193,
+ActorMethod :113, max_restarts/max_task_retries :147). Async actors are
+detected from coroutine methods; handles serialize into tasks and reconnect
+via the GCS actor table on deserialization.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import inspect
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu._private import worker_api
+from ray_tpu._private.ids import ActorID
+from ray_tpu.remote_function import _resolve_scheduling, _resources_from_options
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, **opts) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name,
+                           opts.get("num_returns", self._num_returns))
+
+    def remote(self, *args, **kwargs):
+        core = worker_api.get_core()
+        refs = worker_api._call_on_core_loop(core, core.submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs,
+            num_returns=self._num_returns,
+            max_task_retries=self._handle._max_task_retries,
+        ), None)
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method '{self._name}' cannot be called directly; use "
+            f"'.{self._name}.remote()'.")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, method_names=None,
+                 max_task_retries: int = 0, class_name: str = ""):
+        self._actor_id = actor_id
+        self._method_names = method_names or []
+        self._max_task_retries = max_task_retries
+        self._class_name = class_name
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self._actor_id, self._method_names,
+                                  self._max_task_retries, self._class_name))
+
+    @classmethod
+    def _from_actor_info(cls, info):
+        return cls(info.actor_id, class_name=info.class_name)
+
+
+def _rebuild_handle(actor_id, method_names, max_task_retries, class_name):
+    return ActorHandle(actor_id, method_names, max_task_retries, class_name)
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Optional[dict] = None):
+        self._cls = cls
+        self._options = options or {}
+        self._class_id: Optional[str] = None
+        self.__name__ = cls.__name__
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self.__name__}' cannot be instantiated directly; "
+            f"use '{self.__name__}.remote()'.")
+
+    def options(self, **new_options) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(new_options)
+        ac = ActorClass(self._cls, merged)
+        ac._class_id = self._class_id
+        return ac
+
+    def _is_async(self) -> bool:
+        return any(inspect.iscoroutinefunction(m)
+                   for _, m in inspect.getmembers(self._cls,
+                                                  inspect.isfunction))
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        core = worker_api.get_core()
+        if self._class_id is None:
+            data = cloudpickle.dumps(self._cls)
+            self._class_id = "actor:" + hashlib.sha1(data).hexdigest()
+        if not worker_api._state.exported_functions.get(self._class_id):
+            worker_api._call_on_core_loop(
+                core, core.export_function(self._cls, self._class_id), 30)
+            worker_api._state.exported_functions[self._class_id] = True
+        opts = self._options
+        is_async = self._is_async()
+        max_concurrency = opts.get(
+            "max_concurrency", 1000 if is_async else 1)
+        resources = _resources_from_options(opts) if (
+            opts.get("num_cpus") is not None or opts.get("num_tpus") is not None
+            or opts.get("num_gpus") is not None or opts.get("resources")
+        ) else {"CPU": 0.0}
+        # Ray default: actors reserve 0 CPU for scheduling unless specified
+        # (1 CPU only for creation); we use 0 to allow many actors per node.
+        namespace = opts.get("namespace")
+        if namespace is None:
+            namespace = worker_api._state.namespace
+        actor_id = worker_api._call_on_core_loop(core, core.create_actor(
+            self._class_id, args, kwargs,
+            class_name=self.__name__,
+            resources=resources,
+            scheduling=_resolve_scheduling(opts),
+            max_restarts=opts.get("max_restarts", 0),
+            max_task_retries=opts.get("max_task_retries", 0),
+            max_concurrency=max_concurrency,
+            is_async=is_async,
+            name=opts.get("name", ""),
+            namespace=namespace,
+            lifetime=opts.get("lifetime", ""),
+        ), None)
+        methods = [n for n, _ in inspect.getmembers(self._cls,
+                                                    inspect.isfunction)
+                   if not n.startswith("__")]
+        return ActorHandle(actor_id, methods,
+                           opts.get("max_task_retries", 0), self.__name__)

@@ -1,0 +1,111 @@
+"""Multi-node-in-one-process test cluster.
+
+Reference parity: python/ray/cluster_utils.py — the single highest-leverage
+test asset in the reference (SURVEY.md §4): N raylets sharing one GCS so
+multi-node scheduling, spillback, object transfer, and failure handling are
+testable on one host. Here the raylets run on the driver's background event
+loop (real TCP servers; worker processes are real subprocesses), so tests can
+kill a "node" by stopping its raylet.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+from ray_tpu._private import worker_api
+from ray_tpu._private.config import Config, set_config
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.node import new_session_dir
+from ray_tpu._private.raylet import Raylet
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[dict] = None,
+                 system_config: Optional[dict] = None):
+        self.config = Config.load(system_config)
+        set_config(self.config)
+        self.session_dir = new_session_dir(self.config)
+        self.gcs: Optional[GcsServer] = None
+        self.raylets: List[Raylet] = []
+        self.gcs_address = ""
+        worker_api._ensure_loop()
+        self._loop = worker_api._state.loop
+        self._run(self._start_gcs())
+        if initialize_head:
+            self.add_node(**(head_node_args or {}), is_head=True)
+
+    def _run(self, coro, timeout: float = 60):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(timeout)
+
+    async def _start_gcs(self):
+        self.gcs = GcsServer(self.config, self.session_dir)
+        self.gcs_address = await self.gcs.start()
+
+    def add_node(self, num_cpus: float = 2, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 object_store_memory: int = 256 * 1024**2,
+                 is_head: bool = False, node_name: str = "") -> Raylet:
+        res = dict(resources or {})
+        res.setdefault("CPU", float(num_cpus))
+        if num_tpus:
+            res.setdefault("TPU", float(num_tpus))
+        res.setdefault("memory", 2.0 * 1024**3)
+        res.setdefault("object_store_memory", float(object_store_memory))
+
+        async def _add():
+            raylet = Raylet(self.config, self.gcs_address, self.session_dir,
+                            resources=res, labels=labels, is_head=is_head,
+                            object_store_memory=object_store_memory,
+                            node_name=node_name or f"node{len(self.raylets)}")
+            await raylet.start()
+            return raylet
+
+        raylet = self._run(_add())
+        self.raylets.append(raylet)
+        return raylet
+
+    def remove_node(self, raylet: Raylet, graceful: bool = False):
+        """Kill a node (ungraceful: simulates node failure)."""
+        async def _remove():
+            if graceful:
+                await self.gcs.rpc_drain_node(None, {"node_id": raylet.node_id})
+            await raylet.stop()
+            if not graceful:
+                # Let the health checker notice, or force-mark dead now.
+                await self.gcs._mark_node_dead(raylet.node_id, "node removed")
+        self._run(_remove())
+        self.raylets.remove(raylet)
+
+    def connect(self, namespace: str = ""):
+        """Attach a driver to this cluster."""
+        import ray_tpu
+        ray_tpu.init(address=self.gcs_address, namespace=namespace)
+
+    def wait_for_nodes(self, timeout: float = 10):
+        import time
+        import ray_tpu
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+            if len(alive) >= len(self.raylets):
+                return
+            time.sleep(0.05)
+        raise TimeoutError("nodes did not come up")
+
+    def shutdown(self):
+        import ray_tpu
+        ray_tpu.shutdown()
+
+        async def _stop():
+            for raylet in self.raylets:
+                try:
+                    await raylet.stop()
+                except Exception:
+                    pass
+            if self.gcs:
+                await self.gcs.stop()
+        self._run(_stop())
+        self.raylets.clear()

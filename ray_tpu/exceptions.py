@@ -1,0 +1,118 @@
+"""User-facing exceptions (capability parity with python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised inside a remote task/actor method.
+
+    Re-raised at ``get()`` on the caller with the remote traceback attached.
+    """
+
+    def __init__(self, cause: BaseException | None, traceback_str: str = "",
+                 task_id=None, pid: int | None = None, node: str | None = None):
+        self.cause = cause
+        self.traceback_str = traceback_str
+        self.task_id = task_id
+        self.pid = pid
+        self.node = node
+        super().__init__(str(cause))
+
+    def __str__(self):
+        where = f" (pid={self.pid}, node={self.node})" if self.pid else ""
+        return (
+            f"Task failed{where}: {type(self.cause).__name__}: {self.cause}\n"
+            f"--- remote traceback ---\n{self.traceback_str}"
+        )
+
+    def __reduce__(self):
+        import pickle
+        try:
+            pickle.dumps(self.cause)
+            cause = self.cause
+        except Exception:
+            cause = RayTpuError(f"{type(self.cause).__name__}: {self.cause}")
+        return (TaskError, (cause, self.traceback_str, self.task_id,
+                            self.pid, self.node))
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_id=None, reason: str = "actor died"):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"Actor {actor_id} is dead: {reason}")
+
+
+class ActorUnavailableError(ActorError):
+    """Actor is temporarily unreachable (e.g., restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_ref=None, reason: str = "object lost"):
+        self.object_ref = object_ref
+        super().__init__(f"Object {object_ref} lost: {reason}")
+
+
+class ObjectFreedError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    """The owner (submitting worker) of this object died; value unrecoverable."""
+
+    def __init__(self, object_ref=None):
+        ObjectLostError.__init__(self, object_ref, "owner died")
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupError(RayTpuError):
+    pass
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    """Raised when a task is killed by the node memory monitor."""
+
+
+class RayTpuSystemError(RayTpuError):
+    """Internal invariant violation or control-plane failure."""
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id} was cancelled")
+
+
+class CrossLanguageError(RayTpuError):
+    pass

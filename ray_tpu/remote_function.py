@@ -1,0 +1,101 @@
+"""RemoteFunction: the `@remote` task façade.
+
+Reference parity: python/ray/remote_function.py (RemoteFunction :40,
+.options() :160, ._remote() :262).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu._private import worker_api
+from ray_tpu._private.common import SchedulingStrategy
+
+
+def _resolve_scheduling(options: dict) -> SchedulingStrategy:
+    strategy = options.get("scheduling_strategy")
+    if strategy is None or strategy == "DEFAULT":
+        return SchedulingStrategy()
+    if strategy == "SPREAD":
+        return SchedulingStrategy(kind="SPREAD")
+    # Strategy objects from ray_tpu.util.scheduling_strategies
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        return SchedulingStrategy(kind="NODE_AFFINITY", node_id=strategy.node_id,
+                                  soft=strategy.soft)
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        pg = strategy.placement_group
+        return SchedulingStrategy(
+            kind="PLACEMENT_GROUP", placement_group_id=pg.id,
+            bundle_index=strategy.placement_group_bundle_index,
+            capture_child_tasks=strategy.placement_group_capture_child_tasks)
+    raise ValueError(f"unknown scheduling strategy {strategy!r}")
+
+
+def _resources_from_options(options: dict) -> Dict[str, float]:
+    res = dict(options.get("resources") or {})
+    num_cpus = options.get("num_cpus")
+    num_tpus = options.get("num_tpus")
+    if num_tpus is None:
+        num_tpus = options.get("num_gpus")  # alias for drop-in compatibility
+    res.setdefault("CPU", 1.0 if num_cpus is None else float(num_cpus))
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    if options.get("memory"):
+        res["memory"] = float(options["memory"])
+    return res
+
+
+class RemoteFunction:
+    def __init__(self, func, options: Optional[dict] = None):
+        self._function = func
+        self._options = options or {}
+        self._function_id: Optional[str] = None
+        self.__name__ = getattr(func, "__name__", "remote_fn")
+        self.__doc__ = getattr(func, "__doc__", None)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self.__name__}' cannot be called directly; "
+            f"use '{self.__name__}.remote()'.")
+
+    def options(self, **new_options) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(new_options)
+        rf = RemoteFunction(self._function, merged)
+        rf._function_id = self._function_id
+        return rf
+
+    def _ensure_exported(self, core) -> str:
+        if self._function_id is None:
+            data = cloudpickle.dumps(self._function)
+            self._function_id = "fn:" + hashlib.sha1(data).hexdigest()
+            self._export_payload = data
+        fid = self._function_id
+        if not worker_api._state.exported_functions.get(fid):
+            worker_api._call_on_core_loop(
+                core, core.export_function(self._function, fid), 30)
+            worker_api._state.exported_functions[fid] = True
+        return fid
+
+    def remote(self, *args, **kwargs):
+        core = worker_api.get_core()
+        fid = self._ensure_exported(core)
+        opts = self._options
+        num_returns = opts.get("num_returns", 1)
+        refs = worker_api._call_on_core_loop(core, core.submit_task(
+            fid, args, kwargs,
+            name=self.__name__,
+            num_returns=num_returns,
+            resources=_resources_from_options(opts),
+            scheduling=_resolve_scheduling(opts),
+            max_retries=opts.get("max_retries", -1),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+        ), None)
+        if num_returns == 1:
+            return refs[0]
+        return refs

@@ -1,0 +1,12 @@
+from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
+                                          placement_group_table,
+                                          remove_placement_group,
+                                          get_placement_group)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+
+__all__ = [
+    "PlacementGroup", "placement_group", "remove_placement_group",
+    "get_placement_group", "placement_group_table",
+    "NodeAffinitySchedulingStrategy", "PlacementGroupSchedulingStrategy",
+]

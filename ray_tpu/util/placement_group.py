@@ -1,0 +1,105 @@
+"""Placement groups: gang resource reservation.
+
+Reference parity: python/ray/util/placement_group.py (:41 PlacementGroup,
+:145 placement_group()) with PACK/SPREAD/STRICT_PACK/STRICT_SPREAD strategies
+(src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h).
+
+TPU-first note: a placement group whose bundles each request {"TPU": n} with
+STRICT_SPREAD is the gang-schedulable unit for a pod slice — one bundle per
+host of the ICI domain.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private import worker_api
+from ray_tpu._private.common import PG_CREATED, PlacementGroupInfo
+from ray_tpu._private.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]]):
+        self.id = pg_id
+        self.bundle_specs = bundles
+
+    def ready(self):
+        """Returns an ObjectRef resolved when the PG is placed (ray parity)."""
+        from ray_tpu import remote
+
+        @remote
+        def _pg_ready():
+            return True
+
+        from ray_tpu.util.scheduling_strategies import \
+            PlacementGroupSchedulingStrategy
+        return _pg_ready.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=self, placement_group_bundle_index=0),
+            num_cpus=0).remote()
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        core = worker_api.get_core()
+        deadline = time.time() + timeout_seconds
+        while time.time() < deadline:
+            info: Optional[PlacementGroupInfo] = worker_api._call_on_core_loop(
+                core, core.gcs.request("get_placement_group",
+                                       {"pg_id": self.id}), 10)
+            if info is not None and info.state == PG_CREATED:
+                return True
+            time.sleep(0.05)
+        return False
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs))
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty dicts")
+    core = worker_api.get_core()
+    pg_id = PlacementGroupID.of(core.job_id)
+    info = PlacementGroupInfo(pg_id=pg_id, name=name, strategy=strategy,
+                              bundles=[dict(b) for b in bundles],
+                              creator_job=core.job_id)
+    worker_api._call_on_core_loop(
+        core, core.gcs.request("create_placement_group", {"pg": info}), 30)
+    return PlacementGroup(pg_id, info.bundles)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    core = worker_api.get_core()
+    worker_api._call_on_core_loop(
+        core, core.gcs.request("remove_placement_group", {"pg_id": pg.id}), 30)
+
+
+def get_placement_group(name: str) -> Optional[PlacementGroup]:
+    core = worker_api.get_core()
+    info = worker_api._call_on_core_loop(
+        core, core.gcs.request("get_placement_group", {"pg_id": None,
+                                                       "name": name}), 10)
+    if info is None:
+        return None
+    return PlacementGroup(info.pg_id, info.bundles)
+
+
+def placement_group_table() -> List[dict]:
+    core = worker_api.get_core()
+    infos = worker_api._call_on_core_loop(
+        core, core.gcs.request("get_all_placement_groups", {}), 10)
+    return [{
+        "placement_group_id": i.pg_id.hex(), "name": i.name,
+        "strategy": i.strategy, "state": i.state,
+        "bundles": i.bundles,
+        "bundle_nodes": {k: v.hex() for k, v in i.bundle_nodes.items()},
+    } for i in infos]

@@ -1,0 +1,25 @@
+"""Scheduling strategies (reference: python/ray/util/scheduling_strategies.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu._private.ids import NodeID
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: NodeID
+    soft: bool = False
+
+    def __post_init__(self):
+        if isinstance(self.node_id, str):
+            self.node_id = NodeID.from_hex(self.node_id)
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: "object"
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False

@@ -1,0 +1,63 @@
+"""Test fixtures (reference pattern: python/ray/tests/conftest.py).
+
+JAX is forced onto a virtual 8-device CPU mesh so all parallelism logic runs
+on CPU CI (the analogue of the reference's `_fake_gpus`), per SURVEY.md §4.
+"""
+
+import os
+import sys
+
+# Must happen before jax initializes a backend anywhere in the test process.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["RAY_TPU_HEARTBEAT_INTERVAL_S"] = "0.2"
+os.environ["RAY_TPU_NODE_DEATH_TIMEOUT_S"] = "2.0"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+def _force_cpu_jax():
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+@pytest.fixture(scope="session")
+def jax_cpu():
+    _force_cpu_jax()
+    import jax
+    assert jax.default_backend() == "cpu"
+    return jax
+
+
+@pytest.fixture
+def ray_start(request):
+    """Single-node cluster, 4 CPUs, fresh per test."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, num_tpus=0,
+                 system_config={"task_max_retries_default": 0})
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_shared(request):
+    """Single-node cluster shared across a test module (faster)."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_cluster():
+    """Multi-raylet fake cluster (reference: ray_start_cluster fixture)."""
+    from ray_tpu.cluster_utils import Cluster
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    yield cluster
+    cluster.shutdown()
