@@ -1,0 +1,159 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Primary metric: 1:1 async actor-call throughput — the hot path of the whole
+framework (every Train/Serve/RLlib interaction is an actor call). Reference
+baseline: 9,183 calls/s on a 64-vCPU m5.16xlarge
+(release/release_logs/2.9.2/microbenchmark.json `1_1_actor_calls_async`,
+see BASELINE.md). This box has 1 vCPU; the ratio is reported against the
+reference's number anyway.
+
+Secondary numbers (task throughput, put/get, GPT-2 train step on the TPU
+chip) go to stderr for the curious.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def bench_core():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(2, (os.cpu_count() or 1)))
+
+    @ray_tpu.remote
+    class Sink:
+        def ping(self, x=None):
+            return x
+
+    a = Sink.remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)   # warm: actor up
+
+    # --- 1:1 async actor calls ---
+    n = 3000
+    t0 = time.perf_counter()
+    refs = [a.ping.remote() for _ in range(n)]
+    ray_tpu.get(refs)
+    dt = time.perf_counter() - t0
+    actor_calls_per_s = n / dt
+    log(f"1_1_actor_calls_async: {actor_calls_per_s:,.0f}/s")
+
+    # --- 1:1 sync actor calls ---
+    n = 300
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(a.ping.remote())
+    sync_calls = n / (time.perf_counter() - t0)
+    log(f"1_1_actor_calls_sync: {sync_calls:,.0f}/s")
+
+    # --- single-client async tasks ---
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote(), timeout=60)  # warm lease+worker
+    n = 1000
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(n)])
+    tasks_per_s = n / (time.perf_counter() - t0)
+    log(f"single_client_tasks_async: {tasks_per_s:,.0f}/s")
+
+    # --- put/get calls + throughput ---
+    import numpy as np
+    n = 500
+    small = np.zeros(8)
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(small) for _ in range(n)]
+    put_calls = n / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    for r in refs:
+        ray_tpu.get(r)
+    get_calls = n / (time.perf_counter() - t0)
+    log(f"put_calls: {put_calls:,.0f}/s  get_calls: {get_calls:,.0f}/s")
+
+    big = np.ones(32 * 1024 * 1024)  # 256 MB, zero-copy out-of-band path
+    t0 = time.perf_counter()
+    r = ray_tpu.put(big)
+    put_gbs = big.nbytes / (time.perf_counter() - t0) / 1e9
+    log(f"put_throughput: {put_gbs:.2f} GB/s")
+
+    ray_tpu.shutdown()
+    return {
+        "actor_calls_async": actor_calls_per_s,
+        "actor_calls_sync": sync_calls,
+        "tasks_async": tasks_per_s,
+        "put_gbs": put_gbs,
+    }
+
+
+def bench_model():
+    """GPT-2-small train-step throughput on the local chip (samples/s/chip)."""
+    try:
+        import jax
+        if jax.default_backend() not in ("tpu", "axon"):
+            return None
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from ray_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss
+        from ray_tpu.parallel.mesh import build_mesh, MeshConfig
+        from ray_tpu.train.train_step import init_train_state, make_train_step
+
+        cfg = GPTConfig()  # GPT-2 small, bf16, flash attention
+        mesh = build_mesh(MeshConfig(data=len(jax.devices())))
+        opt = optax.adamw(3e-4)
+        state = init_train_state(
+            lambda: gpt_init(jax.random.PRNGKey(0), cfg), opt, mesh, "dp")
+        step = make_train_step(lambda p, b: gpt_loss(p, b, cfg), opt, mesh,
+                               "dp", sample_params=state.params)
+        bs, seq = 8, 1024
+        tokens = jnp.array(np.random.randint(0, cfg.vocab_size, (bs, seq + 1)),
+                           jnp.int32)
+        batch = {"tokens": tokens}
+        t0 = time.perf_counter()
+        state, m = step(state, batch)
+        jax.block_until_ready(m["loss"])
+        log(f"gpt2 compile+first step: {time.perf_counter()-t0:.1f}s "
+            f"loss={float(m['loss']):.3f}")
+        iters = 10
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = step(state, batch)
+        jax.block_until_ready(m["loss"])
+        dt = (time.perf_counter() - t0) / iters
+        sps = bs / dt
+        tok_s = bs * seq / dt
+        log(f"gpt2-small train: {sps:.2f} samples/s/chip "
+            f"({tok_s:,.0f} tok/s, step {dt*1e3:.0f} ms)")
+        return sps
+    except Exception as e:  # noqa: BLE001
+        log(f"model bench skipped: {type(e).__name__}: {e}")
+        return None
+
+
+def main():
+    core = bench_core()
+    model_sps = bench_model()
+    value = core["actor_calls_async"]
+    baseline = 9183.0  # BASELINE.md 1_1_actor_calls_async (m5.16xlarge)
+    out = {
+        "metric": "1_1_actor_calls_async",
+        "value": round(value, 1),
+        "unit": "calls/s",
+        "vs_baseline": round(value / baseline, 3),
+    }
+    if model_sps is not None:
+        out["gpt2_small_samples_per_s_chip"] = round(model_sps, 2)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

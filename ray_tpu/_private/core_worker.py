@@ -169,8 +169,11 @@ class CoreWorker:
         self.inproc: Dict[ObjectID, Any] = {}     # deserialized cache
         self._inproc_exc: set = set()  # oids whose cached value is an error
         # Large objects deserialized zero-copy out of shm stay pinned in the
-        # local store until their entry leaves the in-process cache.
-        self._pinned: set = set()
+        # local store until their entry leaves the in-process cache
+        # (pin COUNT per oid: concurrent resolves each take a store pin).
+        self._pinned: Dict[ObjectID, int] = {}
+        # In-flight large-object materializations (dedupe concurrent gets).
+        self._resolving: Dict[ObjectID, asyncio.Future] = {}
 
         # task state
         self.pending_tasks: Dict[TaskID, PendingTask] = {}
@@ -356,13 +359,15 @@ class CoreWorker:
                     del self.borrowed_refs[ref.id]
                     self.inproc.pop(ref.id, None)
                     self._inproc_exc.discard(ref.id)
-                    if ref.id in self._pinned:
-                        self._pinned.discard(ref.id)
+                    npins = self._pinned.pop(ref.id, 0)
+                    if npins:
                         oid_bytes = ref.id.binary()
+                        async def _rel(n=npins, ob=oid_bytes):
+                            for _ in range(n):
+                                await self.store.release(ob)
                         try:
                             self.loop.call_soon_threadsafe(
-                                lambda: asyncio.ensure_future(
-                                    self.store.release(oid_bytes)))
+                                lambda: asyncio.ensure_future(_rel()))
                         except RuntimeError:
                             pass
                     self._notify_owner_deref(ref.id, owner)
@@ -391,8 +396,8 @@ class CoreWorker:
         ent = self.owned.pop(oid, None)
         self.inproc.pop(oid, None)
         self._inproc_exc.discard(oid)
-        if oid in self._pinned:
-            self._pinned.discard(oid)
+        npins = self._pinned.pop(oid, 0)
+        for _ in range(npins):
             try:
                 await self.store.release(oid.binary())
             except Exception:
@@ -538,23 +543,16 @@ class CoreWorker:
                 self._inproc_exc.add(oid)
             return val, ent.is_exception
         # Large object: fetch via local store (pull from remote if needed).
-        data_meta = await self._fetch_to_local(oid, ent.locations, self.address,
-                                               deadline)
-        if data_meta is None:
+        result = await self._materialize_large(oid, ent.locations,
+                                               self.address, deadline)
+        if result is None:
             # Primary copies lost -> lineage reconstruction.
             ok = await self._reconstruct(ent)
             if not ok:
                 raise exc.ObjectLostError(oid, "all copies lost; "
                                           "reconstruction failed")
             return await self._resolve_owned(self.owned[oid], deadline)
-        view, metadata = data_meta
-        val = self.serialization.deserialize(view)
-        # Keep the store pin: `val` may alias the shm buffer (zero-copy numpy).
-        self._pinned.add(oid)
-        self.inproc[oid] = val
-        if metadata == META_EXCEPTION:
-            self._inproc_exc.add(oid)
-        return val, metadata == META_EXCEPTION
+        return result
 
     async def _resolve_borrowed(self, ref: ObjectRef, deadline) -> Tuple[Any, bool]:
         oid = ref.id
@@ -575,17 +573,43 @@ class CoreWorker:
             if info["is_exception"]:
                 self._inproc_exc.add(oid)
             return val, info["is_exception"]
-        data_meta = await self._fetch_to_local(oid, info["locations"], owner,
+        result = await self._materialize_large(oid, info["locations"], owner,
                                                deadline)
-        if data_meta is None:
+        if result is None:
             raise exc.ObjectLostError(ref, "object copies unreachable")
-        view, metadata = data_meta
-        val = self.serialization.deserialize(view)
-        self._pinned.add(oid)
-        self.inproc[oid] = val
-        if metadata == META_EXCEPTION:
-            self._inproc_exc.add(oid)
-        return val, metadata == META_EXCEPTION
+        return result
+
+    async def _materialize_large(self, oid: ObjectID, locations: List[str],
+                                 owner: str, deadline) -> Optional[tuple]:
+        """Fetch + zero-copy deserialize a large object exactly once per
+        process; concurrent callers share the result and one store pin."""
+        if oid in self.inproc:
+            return self.inproc[oid], oid in self._inproc_exc
+        inflight = self._resolving.get(oid)
+        if inflight is not None:
+            await inflight
+            if oid in self.inproc:
+                return self.inproc[oid], oid in self._inproc_exc
+            return None
+        fut = asyncio.get_running_loop().create_future()
+        self._resolving[oid] = fut
+        try:
+            data_meta = await self._fetch_to_local(oid, locations, owner,
+                                                   deadline)
+            if data_meta is None:
+                return None
+            view, metadata = data_meta
+            val = self.serialization.deserialize(view)
+            # Keep the store pin: `val` may alias shm (zero-copy numpy).
+            self._pinned[oid] = self._pinned.get(oid, 0) + 1
+            self.inproc[oid] = val
+            if metadata == META_EXCEPTION:
+                self._inproc_exc.add(oid)
+            return val, metadata == META_EXCEPTION
+        finally:
+            self._resolving.pop(oid, None)
+            if not fut.done():
+                fut.set_result(None)
 
     async def _fetch_to_local(self, oid: ObjectID, locations: List[str],
                               owner: str, deadline) -> Optional[tuple]:
@@ -663,9 +687,12 @@ class CoreWorker:
         for f in pending.values():
             if not f.done():
                 f.cancel()
-        ready = [r for r in refs if id(r) in done]
-        not_ready = [r for r in refs if id(r) not in done]
-        return ready[:max(num_returns, len(ready))], not_ready
+        # ray.wait contract: at most num_returns ready refs; surplus completed
+        # refs stay in not_ready, order preserved.
+        ready = [r for r in refs if id(r) in done][:num_returns]
+        ready_set = {id(r) for r in ready}
+        not_ready = [r for r in refs if id(r) not in ready_set]
+        return ready, not_ready
 
     async def _await_ready(self, ref: ObjectRef):
         ent = self.owned.get(ref.id)
@@ -706,30 +733,6 @@ class CoreWorker:
         func = pickle.loads(data)
         self._function_cache[function_id] = func
         return func
-
-    def _prepare_args(self, args: tuple, kwargs: dict) -> List[TaskArg]:
-        """Inline small values; pass refs; ray.put big values first."""
-        out: List[TaskArg] = []
-        packed = (args, kwargs)
-        flat: List[Any] = list(args) + list(kwargs.values())
-        task_args: List[TaskArg] = []
-        for v in flat:
-            if isinstance(v, ObjectRef):
-                task_args.append(TaskArg(ARG_REF, object_id=v.id,
-                                         owner_address=v.owner_address or self.address))
-            else:
-                ser = self.serialization.serialize(v)
-                if ser.total_size > self.config.max_direct_call_object_size:
-                    # Big arg: promote to an owned object in the local store.
-                    fut = asyncio.run_coroutine_threadsafe(
-                        self.put_async(v), self.loop) \
-                        if threading.current_thread() is not self._loop_thread \
-                        and self._loop_thread is not None else None
-                    # (handled by caller via async path; see submit_task)
-                    task_args.append(TaskArg(ARG_INLINE, data=ser.to_bytes()))
-                else:
-                    task_args.append(TaskArg(ARG_INLINE, data=ser.to_bytes()))
-        return task_args
 
     async def _build_args(self, args: tuple, kwargs: dict) -> Tuple[List[TaskArg], List[str]]:
         task_args: List[TaskArg] = []
@@ -1069,7 +1072,7 @@ class CoreWorker:
         # duplicate/skip seq numbers, and restart renumbering sees every
         # reserved slot.
         seq_no = q.next_seq()
-        task_id = TaskID.for_actor_task(self.job_id, actor_id, seq_no)
+        task_id = TaskID.for_actor_task(self.job_id, actor_id, seq_no, q.epoch)
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, name=method_name,
             args=[], num_returns=num_returns,

@@ -513,17 +513,15 @@ class GcsServer:
         if pg.strategy == "STRICT_PACK":
             for n in alive:
                 trial = dict(avail[n.node_id])
-                if all(all(trial.get(k, 0) >= v for k, v in b.items()) or True
-                       for b in pg.bundles):
-                    ok = True
-                    for b in pg.bundles:
-                        if not all(trial.get(k, 0) >= v for k, v in b.items()):
-                            ok = False
-                            break
-                        for k, v in b.items():
-                            trial[k] = trial.get(k, 0) - v
-                    if ok:
-                        return {i: n.node_id for i in range(len(pg.bundles))}
+                ok = True
+                for b in pg.bundles:
+                    if not all(trial.get(k, 0) >= v for k, v in b.items()):
+                        ok = False
+                        break
+                    for k, v in b.items():
+                        trial[k] = trial.get(k, 0) - v
+                if ok:
+                    return {i: n.node_id for i in range(len(pg.bundles))}
             return None
         if pg.strategy == "STRICT_SPREAD":
             if len(pg.bundles) > len(alive):

@@ -106,9 +106,13 @@ class TaskID(BaseID):
         return cls(os.urandom(16) + job_id.binary())
 
     @classmethod
-    def for_actor_task(cls, job_id: JobID, actor_id: ActorID, seq: int):
+    def for_actor_task(cls, job_id: JobID, actor_id: ActorID, seq: int,
+                       epoch: int = 0):
+        # epoch (actor restart count at submission) keeps post-restart task
+        # ids distinct from pre-restart ones after seq renumbering.
         h = hashlib.blake2b(
-            actor_id.binary() + seq.to_bytes(8, "little"), digest_size=16
+            actor_id.binary() + seq.to_bytes(8, "little")
+            + epoch.to_bytes(4, "little"), digest_size=16
         ).digest()
         return cls(h + job_id.binary())
 

@@ -1,0 +1,3 @@
+from ray_tpu.models.gpt import GPTConfig, gpt_init, gpt_forward, gpt_loss
+
+__all__ = ["GPTConfig", "gpt_init", "gpt_forward", "gpt_loss"]

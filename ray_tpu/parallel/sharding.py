@@ -1,0 +1,203 @@
+"""Sharding strategies: DP / FSDP(ZeRO) / TP / PP / SP / EP as pjit specs.
+
+The TPU-native replacement for the reference's wrapped-framework parallelism
+(python/ray/train/torch/train_loop_utils.py:158 prepare_model DDP/FSDP wrap,
+SURVEY.md §2.5): every strategy is a set of PartitionSpec rules applied to the
+parameter pytree + a batch sharding, compiled by XLA/GSPMD — no runtime
+process-group object.
+
+Rules match on the parameter's path (joined with '/'); first match wins.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+@dataclass
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) rules + a default."""
+
+    rules: List[Tuple[str, P]] = field(default_factory=list)
+    default: P = P()
+
+    def spec_for(self, path: str, shape: Tuple[int, ...]):
+        for pattern, spec in self.rules:
+            if re.search(pattern, path):
+                return spec if spec is FSDP_LARGEST else _truncate_spec(spec, shape)
+        if self.default is FSDP_LARGEST:
+            return self.default
+        return _truncate_spec(self.default, shape)
+
+
+def _truncate_spec(spec: P, shape: Tuple[int, ...]) -> P:
+    """Trim/pad a spec to the array rank so one rule covers kernel+bias."""
+    parts = tuple(spec)
+    if len(parts) > len(shape):
+        parts = parts[-len(shape):] if len(shape) > 0 else ()
+    elif len(parts) < len(shape):
+        parts = (None,) * (len(shape) - len(parts)) + parts
+    return P(*parts)
+
+
+class ShardingStrategy:
+    """A named parallelism strategy = param rules + batch spec + remat policy.
+
+    TPU-first equivalents of the reference inventory (SURVEY.md §2.5):
+      dp    -> pure data parallel (params replicated)
+      fsdp  -> ZeRO-3: params/opt-state sharded over ('fsdp',) largest dim
+      tp    -> Megatron-style tensor parallel over 'tensor'
+      tp_fsdp / dp_tp / 3d -> compositions
+      sp    -> sequence parallel: batch sharded over tokens ('sequence')
+      ep    -> expert parallel (MoE layers over 'expert')
+    """
+
+    def __init__(self, name: str, param_rules: ShardingRules,
+                 batch_spec: P, data_axes: Sequence[str] = ("data",)):
+        self.name = name
+        self.param_rules = param_rules
+        self.batch_spec = batch_spec
+        self.data_axes = tuple(data_axes)
+
+    # ---- presets ----
+
+    @staticmethod
+    def dp() -> "ShardingStrategy":
+        return ShardingStrategy("dp", ShardingRules(), P("data"))
+
+    @staticmethod
+    def fsdp() -> "ShardingStrategy":
+        """ZeRO-3: every weight matrix sharded on its largest dim over
+        ('fsdp',); XLA all-gathers params per layer and reduce-scatters
+        grads (what DeepSpeed/FSDP do imperatively, done by GSPMD)."""
+        rules = ShardingRules(rules=[(r".*", FSDP_LARGEST)], default=P())
+        return ShardingStrategy("fsdp", rules, P(("data", "fsdp")))
+
+    @staticmethod
+    def tp_transformer() -> "ShardingStrategy":
+        """Megatron TP for the transformer layout in ray_tpu.models.gpt:
+        column-parallel qkv/up projections, row-parallel out/down."""
+        t = "tensor"
+        rules = ShardingRules(rules=[
+            (r"attn/(wq|wk|wv)", P(None, t)),
+            (r"attn/wo", P(t, None)),
+            (r"mlp/(w_up|w_gate)", P(None, t)),
+            (r"mlp/w_down", P(t, None)),
+            (r"embed/table", P(t, None)),
+            (r"lm_head", P(None, t)),
+            (r"moe/.*w_up", P("expert", None, t)),
+            (r"moe/.*w_down", P("expert", t, None)),
+            (r"moe/router", P(None, None)),
+        ], default=P())
+        return ShardingStrategy("tp", rules, P("data"))
+
+    @staticmethod
+    def tp_fsdp() -> "ShardingStrategy":
+        """2D: TP inner + FSDP outer on the complementary dim."""
+        t = "tensor"
+        f = "fsdp"
+        rules = ShardingRules(rules=[
+            (r"attn/(wq|wk|wv)", P(f, t)),
+            (r"attn/wo", P(t, f)),
+            (r"mlp/(w_up|w_gate)", P(f, t)),
+            (r"mlp/w_down", P(t, f)),
+            (r"embed/table", P(t, f)),
+            (r"lm_head", P(f, t)),
+            (r"moe/.*w_up", P("expert", f, t)),
+            (r"moe/.*w_down", P("expert", t, f)),
+            (r"moe/router", P(None, None)),
+        ], default=FSDP_LARGEST)
+        return ShardingStrategy("tp_fsdp", rules, P(("data", "fsdp")))
+
+    @staticmethod
+    def sp() -> "ShardingStrategy":
+        """Sequence/context parallel: tokens sharded over 'sequence';
+        used with ring attention (ray_tpu.ops.ring_attention)."""
+        return ShardingStrategy(
+            "sp", ShardingRules(), P(("data",), "sequence"),
+        )
+
+    def batch_sharding(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.batch_spec)
+
+    def param_shardings(self, mesh: Mesh, params: Any):
+        """Pytree of NamedShardings matching `params`' structure."""
+        def spec(path, leaf):
+            shape = np.shape(leaf)
+            ps = self.param_rules.spec_for(_path_str(path), shape)
+            ps = _subdivide_largest(ps, shape, mesh)
+            return NamedSharding(mesh, ps)
+        return jax.tree_util.tree_map_with_path(spec, params)
+
+    def shard_params(self, mesh: Mesh, params: Any):
+        shardings = self.param_shardings(mesh, params)
+        return jax.device_put(params, shardings)
+
+
+class _FsdpLargestMarker:
+    """Sentinel: shard the largest divisible dim over 'fsdp'."""
+
+    def __repr__(self):
+        return "FSDP_LARGEST"
+
+
+FSDP_LARGEST = _FsdpLargestMarker()
+
+
+def _subdivide_largest(spec, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    if spec is not FSDP_LARGEST:
+        return spec
+    fsdp_size = mesh.shape.get("fsdp", 1)
+    if fsdp_size <= 1 or not shape:
+        return P()
+    # Pick the largest dim divisible by the fsdp axis.
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % fsdp_size == 0 and shape[i] >= fsdp_size:
+            parts: List = [None] * len(shape)
+            parts[i] = "fsdp"
+            return P(*parts)
+    return P()
+
+
+def strategy_from_name(name: str) -> ShardingStrategy:
+    presets = {
+        "dp": ShardingStrategy.dp,
+        "fsdp": ShardingStrategy.fsdp,
+        "tp": ShardingStrategy.tp_transformer,
+        "tp_fsdp": ShardingStrategy.tp_fsdp,
+        "sp": ShardingStrategy.sp,
+    }
+    if name not in presets:
+        raise ValueError(f"unknown strategy '{name}'; one of {list(presets)}")
+    return presets[name]()
+
+
+def shard_params(params, mesh: Mesh, strategy: "ShardingStrategy | str"):
+    if isinstance(strategy, str):
+        strategy = strategy_from_name(strategy)
+    return strategy.shard_params(mesh, params)
+
+
+def batch_sharding(mesh: Mesh, strategy: "ShardingStrategy | str"):
+    if isinstance(strategy, str):
+        strategy = strategy_from_name(strategy)
+    return strategy.batch_sharding(mesh)

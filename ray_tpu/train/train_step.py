@@ -1,0 +1,141 @@
+"""Sharded train-step compilation: model + optax + ShardingStrategy -> pjit.
+
+The TPU-native core of the Train layer: where the reference wraps a torch
+module in DDP/FSDP (train/torch/train_loop_utils.py:158 prepare_model), here
+a loss function and a strategy compile into ONE XLA program whose collectives
+(reduce-scatter/all-gather for fsdp, all-reduce for dp, all-to-all for ep)
+are inserted by GSPMD along the mesh axes. Buffer donation keeps params/opt
+state in place across steps (HBM), and batch shardings put the host->device
+transfer on the right devices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.sharding import ShardingStrategy, strategy_from_name
+
+
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: Any  # scalar int32 array
+
+    def tree_flatten(self):
+        return (self.params, self.opt_state, self.step), None
+
+
+jax.tree_util.register_pytree_node(
+    TrainState,
+    lambda s: ((s.params, s.opt_state, s.step), None),
+    lambda _, c: TrainState(*c),
+)
+
+
+def init_train_state(init_fn: Callable[[], Any], optimizer,
+                     mesh: Mesh, strategy: "ShardingStrategy | str"):
+    """Initialize params + opt state directly into their shardings.
+
+    init_fn runs under jit with sharded outputs, so even a model too big for
+    one device initializes without materializing replicated copies.
+    """
+    if isinstance(strategy, str):
+        strategy = strategy_from_name(strategy)
+    with mesh:
+        sample = jax.eval_shape(init_fn)
+        param_sh = strategy.param_shardings(mesh, sample)
+        params = jax.jit(init_fn, out_shardings=param_sh)()
+        opt_state = jax.jit(
+            optimizer.init,
+            in_shardings=(param_sh,),
+            out_shardings=_opt_state_shardings(optimizer, sample, param_sh,
+                                               mesh),
+        )(params)
+        step = jnp.zeros((), jnp.int32)
+    return TrainState(params, opt_state, step)
+
+
+def _opt_state_shardings(optimizer, sample_params, param_shardings, mesh):
+    """Shard optimizer moments like their parameters (ZeRO partitioning of
+    optimizer state falls out of the fsdp param sharding)."""
+    state_shape = jax.eval_shape(optimizer.init, sample_params)
+    flat_param = [
+        (tuple(str(getattr(p, "key", getattr(p, "idx", p))) for p in path), sh)
+        for path, sh in jax.tree_util.tree_flatten_with_path(param_shardings)[0]
+    ]
+
+    def assign(path, leaf):
+        # Moments live under e.g. (0, 'mu', <param path...>): match a param
+        # whose full path is a suffix of this leaf's path.
+        key = tuple(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        for pkey, sh in flat_param:
+            if len(key) >= len(pkey) and key[-len(pkey):] == pkey:
+                return sh
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(assign, state_shape)
+
+
+def make_train_step(loss_fn: Callable, optimizer, mesh: Mesh,
+                    strategy: "ShardingStrategy | str",
+                    sample_params: Any = None,
+                    donate: bool = True):
+    """Build the jitted sharded train step.
+
+    loss_fn(params, batch) -> scalar. Returns step(state, batch) ->
+    (state, metrics) compiled with GSPMD shardings from the strategy.
+    """
+    if isinstance(strategy, str):
+        strategy = strategy_from_name(strategy)
+
+    def _step(state: TrainState, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        return (TrainState(params, opt_state, state.step + 1),
+                {"loss": loss.astype(jnp.float32), "grad_norm": gnorm,
+                 "step": state.step + 1})
+
+    batch_sh = NamedSharding(mesh, strategy.batch_spec)
+    kwargs = {}
+    if donate:
+        kwargs["donate_argnums"] = (0,)
+    if sample_params is not None:
+        param_sh = strategy.param_shardings(mesh, sample_params)
+        opt_sh = _opt_state_shardings(optimizer, sample_params, param_sh, mesh)
+        state_sh = TrainState(param_sh, opt_sh,
+                              NamedSharding(mesh, P()))
+        kwargs["in_shardings"] = (state_sh, batch_sh)
+        kwargs["out_shardings"] = (state_sh, NamedSharding(mesh, P()))
+    step = jax.jit(_step, **kwargs)
+
+    def run(state, batch):
+        with mesh:
+            return step(state, batch)
+    run._jitted = step
+    return run
+
+
+def make_eval_step(loss_fn: Callable, mesh: Mesh,
+                   strategy: "ShardingStrategy | str"):
+    if isinstance(strategy, str):
+        strategy = strategy_from_name(strategy)
+
+    @jax.jit
+    def _eval(params, batch):
+        return loss_fn(params, batch).astype(jnp.float32)
+
+    def run(params, batch):
+        with mesh:
+            return _eval(params, batch)
+    return run
