@@ -585,12 +585,22 @@ class CoreWorker:
         process; concurrent callers share the result and one store pin."""
         if oid in self.inproc:
             return self.inproc[oid], oid in self._inproc_exc
-        inflight = self._resolving.get(oid)
-        if inflight is not None:
-            await inflight
+        while True:
+            inflight = self._resolving.get(oid)
+            if inflight is None:
+                break
+            # Wait under OUR deadline, not the winner's; and if the winner
+            # failed (e.g. its shorter timeout expired), fall through and
+            # attempt our own fetch rather than inheriting the failure.
+            t = None if deadline is None else max(0.0, deadline - time.time())
+            try:
+                await asyncio.wait_for(asyncio.shield(inflight), timeout=t)
+            except asyncio.TimeoutError:
+                # Our deadline, not an object failure: don't let the owned
+                # path mistake this for lost copies (reconstruction).
+                raise exc.GetTimeoutError(f"get timed out on {oid}")
             if oid in self.inproc:
                 return self.inproc[oid], oid in self._inproc_exc
-            return None
         fut = asyncio.get_running_loop().create_future()
         self._resolving[oid] = fut
         try:

@@ -34,8 +34,16 @@ class RemoteRpcError(RpcError):
     def __init__(self, method: str, err_type: str, message: str, tb: str):
         self.method = method
         self.err_type = err_type
+        self.err_message = message
         self.remote_traceback = tb
         super().__init__(f"RPC {method} failed remotely: {err_type}: {message}\n{tb}")
+
+    def __reduce__(self):
+        # Default Exception reduction would replay self.args (1 string) into
+        # the 4-arg __init__ and break unpickling wherever this instance is
+        # embedded (e.g. inside a serialized task error).
+        return (RemoteRpcError, (self.method, self.err_type, self.err_message,
+                                 self.remote_traceback))
 
 
 class ConnectionLost(RpcError):

@@ -29,9 +29,6 @@ class TrainState:
     opt_state: Any
     step: Any  # scalar int32 array
 
-    def tree_flatten(self):
-        return (self.params, self.opt_state, self.step), None
-
 
 jax.tree_util.register_pytree_node(
     TrainState,
@@ -127,13 +124,24 @@ def make_train_step(loss_fn: Callable, optimizer, mesh: Mesh,
 
 
 def make_eval_step(loss_fn: Callable, mesh: Mesh,
-                   strategy: "ShardingStrategy | str"):
+                   strategy: "ShardingStrategy | str",
+                   sample_params: Any = None):
+    """Jitted eval step with the strategy's batch/param shardings applied,
+    so eval reuses the training layout instead of re-laying-out (replicating)
+    a sharded model."""
     if isinstance(strategy, str):
         strategy = strategy_from_name(strategy)
 
-    @jax.jit
+    batch_sh = NamedSharding(mesh, strategy.batch_spec)
+    kwargs = {}
+    if sample_params is not None:
+        param_sh = strategy.param_shardings(mesh, sample_params)
+        kwargs["in_shardings"] = (param_sh, batch_sh)
+        kwargs["out_shardings"] = NamedSharding(mesh, P())
+
     def _eval(params, batch):
         return loss_fn(params, batch).astype(jnp.float32)
+    _eval = jax.jit(_eval, **kwargs)
 
     def run(params, batch):
         with mesh:

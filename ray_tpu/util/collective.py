@@ -1,0 +1,355 @@
+"""Collective communication between workers/actors.
+
+Reference parity: python/ray/util/collective/collective.py (:120
+init_collective_group, :258 allreduce, :531 send) — but redesigned for TPU.
+
+Two planes:
+
+1. **In-program (device) plane** — the hot path. Collectives are NOT runtime
+   calls; they are `jax.lax.psum/all_gather/ppermute/all_to_all` inside
+   pjit/shard_map programs, compiled by XLA onto the ICI torus (see
+   ray_tpu.parallel). There is no NCCL communicator object to manage; a
+   `jax.sharding.Mesh` plays that role. This module's `get_mesh_group`
+   returns the mesh-axis handle for it.
+
+2. **Host (control) plane** — this module. Small-tensor / control collectives
+   between actor processes (rendezvous, barriers, weight broadcast outside
+   jit, metric reduction). Implemented over a named rendezvous actor
+   (the reference uses named-actor rendezvous for the NCCL UID the same way)
+   holding per-sequence mailboxes; payloads ride the object store.
+
+All ranks must issue the same collective ops in the same order (standard
+requirement, same as NCCL).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda xs: _tree_reduce(np.add, xs),
+    ReduceOp.PRODUCT: lambda xs: _tree_reduce(np.multiply, xs),
+    ReduceOp.MIN: lambda xs: _tree_reduce(np.minimum, xs),
+    ReduceOp.MAX: lambda xs: _tree_reduce(np.maximum, xs),
+}
+
+
+def _tree_reduce(op, xs):
+    """Reduce a list of arrays-or-pytrees elementwise."""
+    import jax
+    out = xs[0]
+    for x in xs[1:]:
+        out = jax.tree_util.tree_map(op, out, x)
+    return out
+
+
+class _RendezvousActor:
+    """Named per-group coordinator: per-sequence mailboxes + events.
+
+    Async actor; every collective call parks on an asyncio.Event until all
+    world_size contributions for that sequence number have arrived.
+    """
+
+    def __init__(self, world_size: int):
+        import asyncio
+        self.world_size = world_size
+        self._slots: Dict[Any, dict] = {}
+        self._p2p: Dict[Any, Any] = {}
+        self._p2p_events: Dict[Any, Any] = {}
+        self._asyncio = asyncio
+
+    def _slot(self, key):
+        s = self._slots.get(key)
+        if s is None:
+            s = {"parts": {}, "event": self._asyncio.Event(), "result": None,
+                 "claimed": 0}
+            self._slots[key] = s
+        return s
+
+    async def _gather(self, key, rank, data):
+        s = self._slot(key)
+        s["parts"][rank] = data
+        if len(s["parts"]) == self.world_size:
+            s["event"].set()
+        else:
+            await s["event"].wait()
+        return s
+
+    def _release(self, key, s):
+        # Last rank out of the slot frees it.
+        s["claimed"] += 1
+        if s["claimed"] == self.world_size:
+            del self._slots[key]
+
+    async def allreduce(self, seq, rank, data, op, dst_rank=None):
+        s = await self._gather(("ar", seq), rank, data)
+        try:
+            if s["result"] is None:
+                parts = [s["parts"][r] for r in range(self.world_size)]
+                s["result"] = _REDUCERS[op](parts)
+            # For rooted reduce, skip shipping the result to non-dst ranks.
+            return s["result"] if dst_rank is None or rank == dst_rank \
+                else None
+        finally:
+            self._release(("ar", seq), s)
+
+    async def allgather(self, seq, rank, data):
+        s = await self._gather(("ag", seq), rank, data)
+        try:
+            return [s["parts"][r] for r in range(self.world_size)]
+        finally:
+            self._release(("ag", seq), s)
+
+    async def reducescatter(self, seq, rank, data, op):
+        if not isinstance(data, np.ndarray):
+            self._release(("rs", seq), self._slot(("rs", seq)))
+            raise TypeError(
+                "reducescatter takes a single ndarray (partitioned along "
+                "axis 0); reduce pytrees with allreduce instead")
+        s = await self._gather(("rs", seq), rank, data)
+        try:
+            if s["result"] is None:
+                parts = [s["parts"][r] for r in range(self.world_size)]
+                s["result"] = np.array_split(
+                    np.asarray(_REDUCERS[op](parts)), self.world_size)
+            return s["result"][rank]
+        finally:
+            self._release(("rs", seq), s)
+
+    async def broadcast(self, seq, rank, data, src_rank):
+        s = await self._gather(("bc", seq), rank,
+                               data if rank == src_rank else None)
+        try:
+            return s["parts"][src_rank]
+        finally:
+            self._release(("bc", seq), s)
+
+    async def barrier(self, seq, rank):
+        s = await self._gather(("b", seq), rank, True)
+        self._release(("b", seq), s)
+        return True
+
+    async def send(self, src_rank, dst_rank, tag, data):
+        key = (src_rank, dst_rank, tag)
+        self._p2p[key] = data
+        ev = self._p2p_events.get(key)
+        if ev is None:
+            ev = self._p2p_events[key] = self._asyncio.Event()
+        ev.set()
+        return True
+
+    async def recv(self, src_rank, dst_rank, tag):
+        key = (src_rank, dst_rank, tag)
+        ev = self._p2p_events.get(key)
+        if ev is None:
+            ev = self._p2p_events[key] = self._asyncio.Event()
+        await ev.wait()
+        data = self._p2p.pop(key)
+        del self._p2p_events[key]
+        return data
+
+
+class _GroupState:
+    def __init__(self, name: str, world_size: int, rank: int, handle):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.handle = handle
+        self.seq = 0
+        # Keyed by (direction, peer): a rank's Nth send to a peer must pair
+        # with that peer's Nth recv from it, independent of how many recvs
+        # the sender itself has issued (symmetric exchange would otherwise
+        # deadlock).
+        self.p2p_tags: Dict[Any, int] = {}
+        self.lock = threading.Lock()
+
+    def next_seq(self) -> int:
+        with self.lock:
+            s = self.seq
+            self.seq += 1
+            return s
+
+    def next_tag(self, direction: str, peer: int) -> int:
+        with self.lock:
+            t = self.p2p_tags.get((direction, peer), 0)
+            self.p2p_tags[(direction, peer)] = t + 1
+            return t
+
+
+_groups: Dict[str, _GroupState] = {}
+_groups_lock = threading.Lock()
+
+
+def _rendezvous_name(group_name: str) -> str:
+    return f"__collective_group:{group_name}"
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "xla",
+                          group_name: str = "default") -> None:
+    """Join a collective group (call once on each member)."""
+    import ray_tpu
+
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    with _groups_lock:
+        if group_name in _groups:
+            raise RuntimeError(
+                f"group '{group_name}' already initialized here")
+        _groups[group_name] = None  # reserve against concurrent init
+    name = _rendezvous_name(group_name)
+    try:
+        handle = None
+        try:
+            handle = ray_tpu.get_actor(name)
+        except Exception:
+            pass
+        if handle is None:
+            RemoteRdv = ray_tpu.remote(_RendezvousActor)
+            try:
+                handle = RemoteRdv.options(
+                    name=name, lifetime="detached",
+                    max_concurrency=10000).remote(world_size)
+            except Exception:
+                # Lost the creation race to another rank; the name now
+                # resolves (creation errors surface as RemoteRpcError).
+                import time
+                for _ in range(50):
+                    try:
+                        handle = ray_tpu.get_actor(name)
+                        break
+                    except Exception:
+                        time.sleep(0.1)
+                else:
+                    raise
+    except BaseException:
+        with _groups_lock:
+            _groups.pop(group_name, None)
+        raise
+    with _groups_lock:
+        _groups[group_name] = _GroupState(group_name, world_size, rank,
+                                          handle)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    import ray_tpu
+    with _groups_lock:
+        state = _groups.pop(group_name, None)
+    if state is not None and state.rank == 0:
+        try:
+            ray_tpu.kill(state.handle)
+        except Exception:
+            pass
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+def _group(group_name: str) -> _GroupState:
+    with _groups_lock:
+        g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group '{group_name}' not initialized; call "
+            f"init_collective_group() first")
+    return g
+
+
+def _get(ref):
+    import ray_tpu
+    return ray_tpu.get(ref)
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: str = ReduceOp.SUM):
+    """Allreduce an array or pytree across the group; returns the result."""
+    g = _group(group_name)
+    return _get(g.handle.allreduce.remote(g.next_seq(), g.rank, tensor, op))
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = ReduceOp.SUM):
+    g = _group(group_name)
+    out = _get(g.handle.allreduce.remote(g.next_seq(), g.rank, tensor, op,
+                                         dst_rank))
+    return out if g.rank == dst_rank else tensor
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _group(group_name)
+    return _get(g.handle.broadcast.remote(g.next_seq(), g.rank, tensor,
+                                          src_rank))
+
+
+def allgather(tensor, group_name: str = "default") -> List:
+    g = _group(group_name)
+    return _get(g.handle.allgather.remote(g.next_seq(), g.rank, tensor))
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: str = ReduceOp.SUM):
+    g = _group(group_name)
+    return _get(g.handle.reducescatter.remote(g.next_seq(), g.rank, tensor,
+                                              op))
+
+
+def barrier(group_name: str = "default") -> None:
+    g = _group(group_name)
+    _get(g.handle.barrier.remote(g.next_seq(), g.rank))
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    g = _group(group_name)
+    tag = g.next_tag("s", dst_rank)
+    _get(g.handle.send.remote(g.rank, dst_rank, tag, tensor))
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    g = _group(group_name)
+    tag = g.next_tag("r", src_rank)
+    return _get(g.handle.recv.remote(src_rank, g.rank, tag))
+
+
+def create_collective_group(actors, world_size: int, ranks: List[int],
+                            backend: str = "xla",
+                            group_name: str = "default"):
+    """Declarative setup (reference collective.py declare-style API): joins
+    each actor to the group by calling its ``setup_collective_group`` method.
+    Actor classes must provide that method — the easiest way is to inherit
+    :class:`CollectiveGroupMixin`; otherwise define it to call
+    ``init_collective_group(world_size, rank, backend, group_name)``."""
+    import ray_tpu
+    refs = []
+    for actor, rank in zip(actors, ranks):
+        refs.append(actor.setup_collective_group.remote(world_size, rank,
+                                                        backend, group_name))
+    ray_tpu.get(refs)
+
+
+class CollectiveGroupMixin:
+    """Mix into actor classes to make them joinable via
+    create_collective_group()."""
+
+    def setup_collective_group(self, world_size, rank, backend, group_name):
+        init_collective_group(world_size, rank, backend, group_name)
+        return True
