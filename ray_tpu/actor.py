@@ -101,6 +101,36 @@ class ActorClass:
                                                   inspect.isfunction))
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        opts = self._options
+        name = opts.get("name", "")
+        if opts.get("get_if_exists") and name:
+            return self._get_or_create(name, args, kwargs)
+        return self._create(args, kwargs)
+
+    def _get_or_create(self, name: str, args, kwargs) -> ActorHandle:
+        """options(name=..., get_if_exists=True): reference parity with
+        ray's atomic get-or-create (python/ray/actor.py GetOrCreate)."""
+        import time
+        from ray_tpu._private.worker_api import get_actor
+        namespace = self._options.get("namespace")
+        try:
+            return get_actor(name, namespace)
+        except Exception:
+            pass
+        try:
+            return self._create(args, kwargs)
+        except Exception:
+            # Lost the creation race; wait for the winner's actor to
+            # register (worker startup can take seconds on a loaded node).
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                try:
+                    return get_actor(name, namespace)
+                except Exception:
+                    time.sleep(0.1)
+            raise
+
+    def _create(self, args, kwargs) -> ActorHandle:
         core = worker_api.get_core()
         if self._class_id is None:
             data = cloudpickle.dumps(self._cls)

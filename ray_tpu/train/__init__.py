@@ -1,4 +1,31 @@
-from ray_tpu.train.train_step import (TrainState, make_train_step,
-                                      init_train_state)
+"""ray_tpu.train: the TPU-native Train layer.
 
-__all__ = ["TrainState", "make_train_step", "init_train_state"]
+Sharded train-step compilation (train_step), the worker-gang harness
+(JaxTrainer/BackendExecutor/WorkerGroup), the per-worker session API
+(report/get_checkpoint/get_context), and host-parallel sharded
+checkpointing (Checkpoint, save_pytree/load_pytree).
+"""
+
+from ray_tpu.train.checkpoint import (Checkpoint, load_pytree,
+                                      new_checkpoint_dir, save_pytree)
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                  ScalingConfig)
+from ray_tpu.train.session import (get_checkpoint, get_context,
+                                   get_dataset_shard, report, TrainContext)
+from ray_tpu.train.train_step import (TrainState, init_train_state,
+                                      make_eval_step, make_train_step)
+from ray_tpu.train.trainer import JaxTrainer, Result
+from ray_tpu.train.backend_executor import (BackendConfig, BackendExecutor,
+                                            JaxBackendConfig,
+                                            TrainingFailedError)
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = [
+    "Checkpoint", "save_pytree", "load_pytree", "new_checkpoint_dir",
+    "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
+    "report", "get_checkpoint", "get_context", "get_dataset_shard",
+    "TrainContext", "TrainState", "init_train_state", "make_train_step",
+    "make_eval_step", "JaxTrainer", "Result", "BackendConfig",
+    "JaxBackendConfig", "BackendExecutor", "WorkerGroup",
+    "TrainingFailedError",
+]

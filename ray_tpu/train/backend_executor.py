@@ -1,0 +1,178 @@
+"""BackendExecutor: drives a WorkerGroup through a training run.
+
+Reference parity: python/ray/train/_internal/backend_executor.py
+(BackendExecutor :65, PG creation :197, rank mapping :347,
+get_next_results :541) and train/torch/config.py:64 (_setup_torch_process
+group) — here the backend hook configures the JAX distributed runtime
+(coordinator rendezvous over the GCS-backed collective layer) instead of a
+NCCL/TCP process group; in-program collectives are compiled by XLA and need
+no runtime object at all (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.session import TrainContext
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+@dataclass
+class BackendConfig:
+    """Base backend config; subclass hooks run on start/shutdown."""
+
+    def on_start(self, executor: "BackendExecutor") -> None:  # noqa: D401
+        pass
+
+    def on_shutdown(self, executor: "BackendExecutor") -> None:
+        pass
+
+
+@dataclass
+class JaxBackendConfig(BackendConfig):
+    """Sets up the JAX distributed runtime across hosts when needed.
+
+    distributed='auto': initialize jax.distributed only when >1 node hosts
+    workers AND a TPU platform is present. On a single host (or CPU tests)
+    each worker keeps its private local backend.
+    """
+
+    distributed: str = "auto"
+    coordinator_port: int = 7311
+
+    def on_start(self, executor: "BackendExecutor") -> None:
+        infos = executor.node_info_per_worker
+        n_nodes = len({i["hostname"] for i in infos})
+        if self.distributed == "off":
+            return
+        if self.distributed == "auto" and n_nodes <= 1:
+            return
+        coord = f"{infos[0]['ip']}:{self.coordinator_port}"
+        world = executor.world_size
+
+        def _init(coord_addr, num_procs, rank):
+            import jax
+            jax.distributed.initialize(
+                coordinator_address=coord_addr, num_processes=num_procs,
+                process_id=rank)
+
+        fn_b = cloudpickle.dumps(_init)
+        import ray_tpu
+        refs = [
+            w.execute.remote(fn_b, coord, world, rank)
+            for rank, w in enumerate(executor.worker_group.workers)
+        ]
+        ray_tpu.get(refs, timeout=120)
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(self, scaling: ScalingConfig,
+                 backend: Optional[BackendConfig] = None,
+                 experiment_name: str = "", storage_path: str = "",
+                 trial_id: str = ""):
+        self.scaling = scaling
+        self.backend = backend or JaxBackendConfig()
+        self.experiment_name = experiment_name
+        self.storage_path = storage_path
+        self.trial_id = trial_id
+        self.worker_group: Optional[WorkerGroup] = None
+        self.node_info_per_worker: List[dict] = []
+        self.world_size = scaling.num_workers
+
+    def start(self):
+        self.worker_group = WorkerGroup(
+            self.scaling.num_workers, self.scaling.worker_resources(),
+            self.scaling.placement_strategy)
+        self.node_info_per_worker = self.worker_group.node_infos()
+        self.backend.on_start(self)
+
+    def _contexts(self) -> List[TrainContext]:
+        """Global rank = position; local rank = index within its node
+        (reference rank mapping: backend_executor.py:347)."""
+        by_node: Dict[str, List[int]] = {}
+        for i, info in enumerate(self.node_info_per_worker):
+            by_node.setdefault(info["hostname"], []).append(i)
+        node_order = sorted(by_node)
+        ctxs = []
+        for rank, info in enumerate(self.node_info_per_worker):
+            host = info["hostname"]
+            ctxs.append(TrainContext(
+                world_size=self.world_size, world_rank=rank,
+                local_rank=by_node[host].index(rank),
+                local_world_size=len(by_node[host]),
+                node_rank=node_order.index(host),
+                experiment_name=self.experiment_name,
+                storage_path=self.storage_path, trial_id=self.trial_id))
+        return ctxs
+
+    def start_training(self, train_fn: Callable, config: Optional[dict],
+                       checkpoint: Optional[Checkpoint] = None,
+                       datasets_per_worker: Optional[List[dict]] = None):
+        fn_b = cloudpickle.dumps(train_fn)
+        refs = []
+        for i, (w, ctx) in enumerate(zip(self.worker_group.workers,
+                                         self._contexts())):
+            ds = datasets_per_worker[i] if datasets_per_worker else None
+            refs.append(w.start_run.remote(fn_b, config, ctx,
+                                           checkpoint, ds))
+        import ray_tpu
+        ray_tpu.get(refs, timeout=60)
+
+    def get_next_results(self, timeout: float = 600.0) -> Optional[List[dict]]:
+        """One result per worker for this round, or None when all done.
+
+        Raises TrainingFailedError if any worker errored.
+        """
+        import ray_tpu
+        deadline = time.monotonic() + timeout
+        results: List[Optional[dict]] = [None] * len(self.worker_group.workers)
+        pending = set(range(len(results)))
+        finished: Dict[int, dict] = {}
+        while pending:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("timed out waiting for train results")
+            refs = {i: self.worker_group.workers[i].poll.remote(
+                min(5.0, remaining)) for i in pending}
+            for i, ref in refs.items():
+                out = ray_tpu.get(ref, timeout=30)
+                if out is None:
+                    continue
+                if out["type"] == "error":
+                    self._interrupt()
+                    raise TrainingFailedError(out["error"])
+                if out["type"] == "done":
+                    finished[i] = out
+                    pending.discard(i)
+                else:
+                    results[i] = out
+                    pending.discard(i)
+        if finished and len(finished) == len(results):
+            return None
+        if finished:
+            # Mixed done/report: treat stragglers' reports as the last round.
+            return [r for r in results if r is not None] or None
+        return results
+
+    def _interrupt(self):
+        for w in self.worker_group.workers:
+            try:
+                w.interrupt.remote()
+            except Exception:
+                pass
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            self.backend.on_shutdown(self)
+            self.worker_group.shutdown()
+            self.worker_group = None

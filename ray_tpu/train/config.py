@@ -1,0 +1,77 @@
+"""Train/AIR configuration dataclasses.
+
+Reference parity: python/ray/air/config.py (ScalingConfig :101,
+FailureConfig :377, CheckpointConfig :427, RunConfig :576).
+
+TPU-first deltas: `use_tpu`/`tpus_per_worker` instead of GPU fields, and
+`placement_strategy` defaults to STRICT_PACK so a multi-worker gang lands on
+one ICI domain (a slice) — the reference's PG PACK default generalized to the
+TPU topology (SURVEY.md §7 "gang semantics").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many train workers and what each reserves.
+
+    num_workers: one worker per *host* (a TPU host owns all its local chips —
+    the reference's 1-process-1-GPU assumption does not apply on TPU).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: float = 0.0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        res: Dict[str, float] = {"CPU": 1.0}
+        if self.resources_per_worker:
+            res = {k: float(v) for k, v in self.resources_per_worker.items()}
+            res.setdefault("CPU", 0.0)
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = self.tpus_per_worker or 4.0
+        elif self.tpus_per_worker and "TPU" not in res:
+            res["TPU"] = self.tpus_per_worker
+        return res
+
+    def as_placement_group_bundles(self):
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclass
+class FailureConfig:
+    """max_failures: retries of the whole training run (gang restart —
+    SPMD co-failure means one worker loss restarts the mesh)."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Top-K checkpoint retention (reference: air/config.py:427)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 0

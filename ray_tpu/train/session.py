@@ -1,0 +1,144 @@
+"""Per-worker training session: report/get_checkpoint/get_context.
+
+Reference parity: python/ray/train/_internal/session.py (_TrainSession :109,
+report :662, get_checkpoint :749) — the worker side of the Train control
+plane. The hot loop (the jitted train step) never touches this; report() is
+called once per logging interval with scalar metrics.
+
+report() blocks until the driver consumes the result — that per-round
+synchronization is what keeps N SPMD workers in lockstep with the driver's
+bookkeeping, replacing the reference's queue+next_results pairing
+(train/_internal/backend_executor.py:541).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclass
+class TrainContext:
+    world_size: int = 1
+    world_rank: int = 0
+    local_rank: int = 0
+    local_world_size: int = 1
+    node_rank: int = 0
+    experiment_name: str = ""
+    storage_path: str = ""
+    trial_id: str = ""
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+    def get_trial_id(self) -> str:
+        return self.trial_id
+
+    def get_storage_path(self) -> str:
+        return self.storage_path
+
+
+class _Session:
+    """Lives inside the train-worker actor; bridges the user's train fn
+    (running on an executor thread) and the driver's polling."""
+
+    def __init__(self, context: TrainContext,
+                 checkpoint: Optional[Checkpoint] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
+        self.context = context
+        self.starting_checkpoint = checkpoint
+        self.datasets = datasets or {}
+        self._results: "queue.Queue" = queue.Queue(maxsize=1)
+        self._stop = threading.Event()
+
+    # -- called from the user train fn (executor thread) --
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None):
+        if self._stop.is_set():
+            raise _StopTraining()
+        self._results.put({"type": "report", "metrics": dict(metrics),
+                           "checkpoint": checkpoint,
+                           "rank": self.context.world_rank})
+        # Block until consumed: put the *next* item only after the driver
+        # drains; queue(maxsize=1) already provides that.
+
+    def finish(self, value: Any = None, error: Optional[str] = None):
+        self._results.put({"type": "error", "error": error}
+                          if error else {"type": "done", "value": value})
+
+    # -- called from the actor's RPC threads --
+
+    def next_result(self, timeout: float = 10.0) -> Optional[dict]:
+        try:
+            return self._results.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self):
+        self._stop.set()
+
+
+class _StopTraining(Exception):
+    pass
+
+
+_session: Optional[_Session] = None
+
+
+def _set_session(s: Optional[_Session]):
+    global _session
+    _session = s
+
+
+def _get_session() -> Optional[_Session]:
+    return _session
+
+
+def get_context() -> TrainContext:
+    if _session is None:
+        return TrainContext()
+    return _session.context
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (+ optional checkpoint) for this round; blocks until
+    the driver has consumed the previous round (lockstep backpressure)."""
+    if _session is None:
+        raise RuntimeError("train.report() called outside a train worker")
+    _session.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    if _session is None:
+        return None
+    return _session.starting_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    if _session is None:
+        raise RuntimeError("get_dataset_shard() outside a train worker")
+    ds = _session.datasets.get(name)
+    if ds is None:
+        raise KeyError(f"no dataset shard named '{name}'")
+    return ds

@@ -1,0 +1,193 @@
+"""JaxTrainer: the DataParallelTrainer equivalent, plus Result +
+checkpoint top-K bookkeeping.
+
+Reference parity: python/ray/train/data_parallel_trainer.py:22
+(DataParallelTrainer, training_loop :419), base_trainer.py:107/:561 (fit),
+train/_internal/checkpoint_manager.py (top-K retention per
+CheckpointConfig, air/config.py:427).
+
+TPU-first: the per-worker train fn builds its mesh + sharded train step via
+ray_tpu.parallel / ray_tpu.train.train_step; there is no DDP wrapper to
+apply — the "backend" only bootstraps the JAX distributed runtime across
+hosts (JaxBackendConfig). Fault tolerance is gang-granular: on failure the
+whole worker group restarts from the latest checkpoint (SPMD co-failure).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.backend_executor import (BackendConfig, BackendExecutor,
+                                            JaxBackendConfig,
+                                            TrainingFailedError)
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                  ScalingConfig)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Result:
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    path: str = ""
+    error: Optional[str] = None
+    metrics_dataframe: Optional[List[Dict[str, Any]]] = None
+
+    @property
+    def best_checkpoints(self):
+        return self._best_checkpoints
+
+    _best_checkpoints: List = field(default_factory=list)
+
+
+class _CheckpointBook:
+    """Top-K retention (reference: CheckpointConfig.num_to_keep)."""
+
+    def __init__(self, cfg: CheckpointConfig):
+        self.cfg = cfg
+        self.entries: List[tuple] = []  # (score, seq, ckpt, metrics)
+        self._seq = 0
+
+    def register(self, ckpt: Checkpoint, metrics: Dict[str, Any]):
+        attr = self.cfg.checkpoint_score_attribute
+        if attr is not None and attr in metrics:
+            score = float(metrics[attr])
+            if self.cfg.checkpoint_score_order == "min":
+                score = -score
+        else:
+            score = float(self._seq)  # recency
+        self.entries.append((score, self._seq, ckpt, dict(metrics)))
+        self._seq += 1
+        k = self.cfg.num_to_keep
+        if k is not None and len(self.entries) > k:
+            self.entries.sort(key=lambda e: (e[0], e[1]))
+            evicted = self.entries.pop(0)
+            self._delete(evicted[2])
+
+    def _delete(self, ckpt: Checkpoint):
+        import shutil
+        try:
+            shutil.rmtree(ckpt.path, ignore_errors=True)
+        except Exception:
+            pass
+
+    def latest(self) -> Optional[Checkpoint]:
+        if not self.entries:
+            return None
+        return max(self.entries, key=lambda e: e[1])[2]
+
+    def best(self) -> Optional[Checkpoint]:
+        if not self.entries:
+            return None
+        return max(self.entries, key=lambda e: (e[0], e[1]))[2]
+
+
+class JaxTrainer:
+    """Runs `train_loop_per_worker` on a gang of workers over TPU hosts.
+
+    train_loop_per_worker() (or (config)) calls ray_tpu.train.report(...)
+    once per round; rank-0 metrics become the Result rows.
+    """
+
+    def __init__(self,
+                 train_loop_per_worker: Callable,
+                 *,
+                 train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 backend_config: Optional[BackendConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.train_fn = train_loop_per_worker
+        self.train_config = train_loop_config
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.backend_config = backend_config or JaxBackendConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+        if self.run_config.name is None:
+            self.run_config.name = f"JaxTrainer_{int(time.time())}"
+        if self.run_config.storage_path is None:
+            self.run_config.storage_path = os.path.join(
+                tempfile.gettempdir(), "ray_tpu_results")
+
+    # -- data ingestion: split datasets across workers ----------------------
+
+    def _datasets_per_worker(self) -> Optional[List[dict]]:
+        if not self.datasets:
+            return None
+        n = self.scaling.num_workers
+        per_worker: List[dict] = [dict() for _ in range(n)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "streaming_split"):
+                shards = ds.streaming_split(n)
+            elif hasattr(ds, "split"):
+                shards = ds.split(n)
+            else:
+                shards = [ds] * n
+            for i in range(n):
+                per_worker[i][name] = shards[i]
+        return per_worker
+
+    def fit(self) -> Result:
+        failure = self.run_config.failure_config
+        attempts = max(1, 1 + failure.max_failures) \
+            if failure.max_failures >= 0 else 10 ** 9
+        book = _CheckpointBook(self.run_config.checkpoint_config)
+        rows: List[Dict[str, Any]] = []
+        start_ckpt = self.resume_from_checkpoint
+        err: Optional[str] = None
+        exp_path = os.path.join(self.run_config.storage_path,
+                                self.run_config.name)
+        os.makedirs(exp_path, exist_ok=True)
+
+        for attempt in range(attempts):
+            executor = BackendExecutor(
+                self.scaling, self.backend_config,
+                experiment_name=self.run_config.name,
+                storage_path=self.run_config.storage_path,
+                trial_id=f"attempt_{attempt}")
+            try:
+                executor.start()
+                executor.start_training(
+                    self.train_fn, self.train_config,
+                    checkpoint=book.latest() or start_ckpt,
+                    datasets_per_worker=self._datasets_per_worker())
+                while True:
+                    round_results = executor.get_next_results()
+                    if round_results is None:
+                        break
+                    rank0 = next((r for r in round_results
+                                  if r.get("rank") == 0), round_results[0])
+                    rows.append(rank0["metrics"])
+                    ckpts = [r["checkpoint"] for r in round_results
+                             if r.get("checkpoint") is not None]
+                    if ckpts:
+                        book.register(ckpts[0], rank0["metrics"])
+                err = None
+                break
+            except TrainingFailedError as e:
+                err = str(e)
+                logger.warning("training attempt %d failed: %s",
+                               attempt, err.splitlines()[-1] if err else "")
+                if attempt + 1 >= attempts:
+                    break
+            finally:
+                executor.shutdown()
+
+        result = Result(metrics=rows[-1] if rows else {},
+                        checkpoint=book.best() or book.latest(),
+                        path=exp_path, error=err,
+                        metrics_dataframe=rows)
+        result._best_checkpoints = [(c, m) for _, _, c, m in
+                                    sorted(book.entries, key=lambda e: e[1])]
+        if err is not None:
+            raise TrainingFailedError(err)
+        return result

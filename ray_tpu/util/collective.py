@@ -9,8 +9,7 @@ Two planes:
    calls; they are `jax.lax.psum/all_gather/ppermute/all_to_all` inside
    pjit/shard_map programs, compiled by XLA onto the ICI torus (see
    ray_tpu.parallel). There is no NCCL communicator object to manage; a
-   `jax.sharding.Mesh` plays that role. This module's `get_mesh_group`
-   returns the mesh-axis handle for it.
+   `jax.sharding.Mesh` (ray_tpu.parallel.mesh.build_mesh) plays that role.
 
 2. **Host (control) plane** — this module. Small-tensor / control collectives
    between actor processes (rendezvous, barriers, weight broadcast outside
@@ -112,11 +111,6 @@ class _RendezvousActor:
             self._release(("ag", seq), s)
 
     async def reducescatter(self, seq, rank, data, op):
-        if not isinstance(data, np.ndarray):
-            self._release(("rs", seq), self._slot(("rs", seq)))
-            raise TypeError(
-                "reducescatter takes a single ndarray (partitioned along "
-                "axis 0); reduce pytrees with allreduce instead")
         s = await self._gather(("rs", seq), rank, data)
         try:
             if s["result"] is None:
@@ -210,29 +204,10 @@ def init_collective_group(world_size: int, rank: int,
         _groups[group_name] = None  # reserve against concurrent init
     name = _rendezvous_name(group_name)
     try:
-        handle = None
-        try:
-            handle = ray_tpu.get_actor(name)
-        except Exception:
-            pass
-        if handle is None:
-            RemoteRdv = ray_tpu.remote(_RendezvousActor)
-            try:
-                handle = RemoteRdv.options(
-                    name=name, lifetime="detached",
-                    max_concurrency=10000).remote(world_size)
-            except Exception:
-                # Lost the creation race to another rank; the name now
-                # resolves (creation errors surface as RemoteRpcError).
-                import time
-                for _ in range(50):
-                    try:
-                        handle = ray_tpu.get_actor(name)
-                        break
-                    except Exception:
-                        time.sleep(0.1)
-                else:
-                    raise
+        RemoteRdv = ray_tpu.remote(_RendezvousActor)
+        handle = RemoteRdv.options(
+            name=name, lifetime="detached", max_concurrency=10000,
+            get_if_exists=True).remote(world_size)
     except BaseException:
         with _groups_lock:
             _groups.pop(group_name, None)
@@ -297,7 +272,11 @@ def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = _group(group_name)
-    return _get(g.handle.broadcast.remote(g.next_seq(), g.rank, tensor,
+    # Non-src contributions are discarded by the rendezvous; don't ship them
+    # (a weight broadcast would otherwise serialize N-1 full copies for
+    # nothing).
+    payload = tensor if g.rank == src_rank else None
+    return _get(g.handle.broadcast.remote(g.next_seq(), g.rank, payload,
                                           src_rank))
 
 
@@ -308,6 +287,12 @@ def allgather(tensor, group_name: str = "default") -> List:
 
 def reducescatter(tensor, group_name: str = "default",
                   op: str = ReduceOp.SUM):
+    # Validate locally BEFORE consuming a sequence number or posting to the
+    # rendezvous: a server-side error would strand the other ranks' parts.
+    if not isinstance(tensor, np.ndarray):
+        raise TypeError(
+            "reducescatter takes a single ndarray (partitioned along "
+            "axis 0); reduce pytrees with allreduce instead")
     g = _group(group_name)
     return _get(g.handle.reducescatter.remote(g.next_seq(), g.rank, tensor,
                                               op))
