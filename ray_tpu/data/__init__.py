@@ -1,0 +1,29 @@
+"""ray_tpu.data: lazy, streaming, distributed datasets.
+
+Capability parity with Ray Data (reference: python/ray/data/dataset.py:137,
+python/ray/data/_internal/execution/streaming_executor.py:55) redesigned for
+a TPU-first stack: blocks are columnar numpy batches that device_put cleanly
+onto `jax.sharding` meshes, and `iter_jax_batches` / `streaming_split` feed
+SPMD training gangs directly.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.read_api import (from_items, from_numpy, from_pandas, range,
+                                   range_tensor, read_binary_files, read_csv,
+                                   read_json, read_numpy, read_parquet,
+                                   read_text)
+from ray_tpu.data.aggregate import (AggregateFn, Count, Max, Mean, Min, Std,
+                                    Sum)
+
+__all__ = [
+    "Block", "BlockAccessor", "BlockMetadata", "DataContext", "Dataset",
+    "Datasource", "ReadTask", "DataIterator",
+    "from_items", "from_numpy", "from_pandas", "range", "range_tensor",
+    "read_binary_files", "read_csv", "read_json", "read_numpy",
+    "read_parquet", "read_text",
+    "AggregateFn", "Count", "Max", "Mean", "Min", "Std", "Sum",
+]

@@ -1,0 +1,560 @@
+"""Streaming executor: pull-based, backpressured, order-preserving.
+
+Reference parity: python/ray/data/_internal/execution/streaming_executor.py:55
+and operators/ (task-pool map, actor-pool map, all-to-all). Differences by
+design: the driver loop polls task completion with `ray_tpu.wait`, each
+operator has a bounded output buffer (backpressure), and map stages are fused
+chains applied in a single task per block.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data._internal.logical import (AbstractMap, AllToAll,
+                                            ExecutionStats, InputData, Limit,
+                                            LogicalOperator, MapSpec, Read,
+                                            Union, Zip, fuse_plan)
+
+RefMeta = Tuple[Any, Any]  # (ObjectRef[Block], BlockMetadata)
+
+
+def apply_specs(block: Block, specs: List[MapSpec]) -> Block:
+    """Run a fused chain of transforms over one block (inside a task)."""
+    for spec in specs:
+        acc = BlockAccessor.for_block(block)
+        if spec.kind == "batches":
+            out_blocks = []
+            n = acc.num_rows()
+            bs = spec.batch_size or n or 1
+            for start in range(0, n, bs):
+                batch = BlockAccessor.for_block(
+                    acc.slice(start, min(start + bs, n))
+                ).to_batch(spec.batch_format)
+                res = spec.fn(batch)
+                out_blocks.append(BlockAccessor.batch_to_block(res))
+            block = BlockAccessor.concat(out_blocks) if out_blocks else []
+        elif spec.kind == "rows":
+            rows = [spec.fn(r) for r in acc.iter_rows()]
+            block = _rows_to_block(rows, like=block)
+        elif spec.kind == "filter":
+            rows = [r for r in acc.iter_rows() if spec.fn(r)]
+            block = _rows_to_block(rows, like=block)
+        elif spec.kind == "flat":
+            rows = [o for r in acc.iter_rows() for o in spec.fn(r)]
+            block = _rows_to_block(rows, like=block)
+        else:
+            raise ValueError(f"unknown map kind {spec.kind!r}")
+    return block
+
+
+def _rows_to_block(rows: List[Any], like: Block) -> Block:
+    if rows and isinstance(rows[0], dict):
+        keys = rows[0].keys()
+        return {k: np.asarray([r[k] for r in rows]) for k in keys}
+    if not rows and isinstance(like, dict):
+        return {k: v[:0] for k, v in like.items()}
+    return rows
+
+
+def _map_task(specs_blob, block):
+    import cloudpickle
+    specs = cloudpickle.loads(specs_blob)
+    out = apply_specs(block, specs)
+    acc = BlockAccessor.for_block(out)
+    return out, acc.get_metadata()
+
+
+def _read_task(fn):
+    blocks = list(fn())
+    out = BlockAccessor.concat(blocks) if len(blocks) != 1 else blocks[0]
+    return out, BlockAccessor.for_block(out).get_metadata()
+
+
+def _slice_task(block, start, end):
+    out = BlockAccessor.for_block(block).slice(start, end)
+    return out, BlockAccessor.for_block(out).get_metadata()
+
+
+class _MapWorker:
+    """Actor for compute=ActorPoolStrategy map stages (stateful UDFs)."""
+
+    def __init__(self, specs_blob):
+        import cloudpickle
+        specs = cloudpickle.loads(specs_blob)
+        # Class-based UDFs: instantiate once per actor.
+        self._specs = []
+        for s in specs:
+            fn = s.fn
+            if isinstance(fn, type):
+                inst = fn(*s.fn_constructor_args)
+                s = MapSpec(kind=s.kind, fn=inst, batch_size=s.batch_size,
+                            batch_format=s.batch_format)
+            self._specs.append(s)
+
+    def ready(self):
+        return True
+
+    def map(self, block):
+        # num_returns=2 at the call site: the block stays in the object
+        # store; only the metadata is fetched by the driver.
+        out = apply_specs(block, self._specs)
+        return out, BlockAccessor.for_block(out).get_metadata()
+
+
+class PhysOp:
+    """Base physical operator with an ordered, bounded output buffer."""
+
+    def __init__(self, name: str, ctx: DataContext, stats: ExecutionStats):
+        self.name = name
+        self.ctx = ctx
+        self.stats = stats
+        self.inq: deque = deque()          # ordered (ref, meta) inputs
+        self.outq: deque = deque()         # ordered (ref, meta) outputs
+        self.input_done = False
+        self._seq_in = 0
+        self._seq_emit = 0
+        self._pending: Dict[int, RefMeta] = {}
+
+    # -- wiring ------------------------------------------------------------
+    def add_input(self, rm: RefMeta):
+        self.inq.append((self._seq_in, rm))
+        self._seq_in += 1
+
+    def mark_input_done(self):
+        self.input_done = True
+
+    def _emit(self, seq: int, rm: RefMeta):
+        self._pending[seq] = rm
+        while self._seq_emit in self._pending:
+            self.outq.append(self._pending.pop(self._seq_emit))
+            self._seq_emit += 1
+
+    # -- scheduling hooks --------------------------------------------------
+    def wait_refs(self) -> List[Any]:
+        return []
+
+    def process(self, done_refs: set):
+        pass
+
+    def can_accept_work(self) -> bool:
+        return len(self.outq) < self.ctx.max_buffered_blocks
+
+    def done(self) -> bool:
+        raise NotImplementedError
+
+    def finish_early(self):
+        """A downstream Limit is satisfied: abandon all remaining work.
+
+        Outstanding tasks complete in the background and are ignored.
+        """
+        self.inq.clear()
+        self.outq.clear()
+        self.input_done = True
+        for attr in ("_inflight", "_blockref"):
+            d = getattr(self, attr, None)
+            if isinstance(d, dict):
+                d.clear()
+        if hasattr(self, "_reads"):
+            self._reads.clear()
+        if hasattr(self, "_ran"):
+            self._ran = True
+
+    def shutdown(self):
+        pass
+
+
+class InputOp(PhysOp):
+    def __init__(self, items: List[RefMeta], ctx, stats):
+        super().__init__("Input", ctx, stats)
+        for rm in items:
+            self.outq.append(rm)
+        self.input_done = True
+
+    def done(self):
+        return not self.outq
+
+
+class TaskMapOp(PhysOp):
+    """One ray_tpu task per input block; bounded in-flight; ordered out."""
+
+    def __init__(self, name, specs: List[MapSpec], remote_args: dict,
+                 ctx, stats):
+        super().__init__(name, ctx, stats)
+        import cloudpickle
+        self._specs_blob = cloudpickle.dumps(specs)
+        args = dict(remote_args)
+        args.setdefault("num_cpus", 1)
+        self._fn = ray_tpu.remote(_map_task).options(num_returns=2, **args)
+        self._inflight: Dict[Any, Tuple[int, float]] = {}  # meta_ref -> seq
+        self._blockref: Dict[Any, Any] = {}
+        self._cap = ctx.op_concurrency_cap or _default_cap()
+
+    def _dispatch(self):
+        while (self.inq and len(self._inflight) < self._cap
+               and self.can_accept_work()):
+            seq, (ref, _meta) = self.inq.popleft()
+            bref, mref = self._fn.remote(self._specs_blob, ref)
+            self._inflight[mref] = (seq, time.perf_counter())
+            self._blockref[mref] = bref
+
+    def wait_refs(self):
+        self._dispatch()
+        return list(self._inflight.keys())
+
+    def process(self, done_refs: set):
+        for mref in list(self._inflight.keys()):
+            if mref in done_refs:
+                seq, t0 = self._inflight.pop(mref)
+                bref = self._blockref.pop(mref)
+                meta = ray_tpu.get(mref)
+                self.stats.record(self.name, tasks=1, rows=meta.num_rows,
+                                  bytes=meta.size_bytes,
+                                  wall_s=time.perf_counter() - t0)
+                self._emit(seq, (bref, meta))
+
+    def done(self):
+        return (self.input_done and not self.inq and not self._inflight
+                and not self.outq)
+
+
+class ActorMapOp(PhysOp):
+    """Actor-pool map for stateful / class UDFs (compute=ActorPoolStrategy)."""
+
+    def __init__(self, name, specs, remote_args: dict, pool_size: int,
+                 ctx, stats):
+        super().__init__(name, ctx, stats)
+        import cloudpickle
+        blob = cloudpickle.dumps(specs)
+        args = dict(remote_args)
+        args.setdefault("num_cpus", 1)
+        cls = ray_tpu.remote(**args)(_MapWorker)
+        self._actors = [cls.remote(blob) for _ in range(pool_size)]
+        self._idle = deque(self._actors)
+        self._inflight: Dict[Any, Tuple[int, Any, float]] = {}
+        self._blockref: Dict[Any, Any] = {}
+
+    def _dispatch(self):
+        while self.inq and self._idle and self.can_accept_work():
+            seq, (ref, _meta) = self.inq.popleft()
+            actor = self._idle.popleft()
+            bref, mref = actor.map.options(num_returns=2).remote(ref)
+            self._inflight[mref] = (seq, actor, time.perf_counter())
+            self._blockref[mref] = bref
+
+    def wait_refs(self):
+        self._dispatch()
+        return list(self._inflight.keys())
+
+    def process(self, done_refs: set):
+        for mref in list(self._inflight.keys()):
+            if mref in done_refs:
+                seq, actor, t0 = self._inflight.pop(mref)
+                self._idle.append(actor)
+                bref = self._blockref.pop(mref)
+                meta = ray_tpu.get(mref)
+                self.stats.record(self.name, tasks=1, rows=meta.num_rows,
+                                  bytes=meta.size_bytes,
+                                  wall_s=time.perf_counter() - t0)
+                self._emit(seq, (bref, meta))
+
+    def done(self):
+        return (self.input_done and not self.inq and not self._inflight
+                and not self.outq)
+
+    def shutdown(self):
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+class ReadOp(TaskMapOp):
+    """Reads are tasks over ReadTask callables instead of input blocks."""
+
+    def __init__(self, name, read_tasks: List[Callable], ctx, stats):
+        PhysOp.__init__(self, name, ctx, stats)
+        self._fn = ray_tpu.remote(_read_task).options(num_returns=2)
+        self._inflight = {}
+        self._blockref = {}
+        self._cap = ctx.op_concurrency_cap or _default_cap()
+        self._reads = deque(enumerate(read_tasks))
+        self.input_done = True
+
+    def _dispatch(self):
+        while (self._reads and len(self._inflight) < self._cap
+               and self.can_accept_work()):
+            seq, task = self._reads.popleft()
+            bref, mref = self._fn.remote(task)
+            self._inflight[mref] = (seq, time.perf_counter())
+            self._blockref[mref] = bref
+
+    def done(self):
+        return not self._reads and not self._inflight and not self.outq
+
+
+class LimitOp(PhysOp):
+    def __init__(self, limit: int, ctx, stats):
+        super().__init__(f"Limit[{limit}]", ctx, stats)
+        self._remaining = limit
+        self._slice = ray_tpu.remote(_slice_task).options(num_returns=2)
+        self._inflight: Dict[Any, int] = {}
+        self._blockref: Dict[Any, Any] = {}
+        self.satisfied = False
+
+    def wait_refs(self):
+        while self.inq and not self.satisfied:
+            seq, (ref, meta) = self.inq.popleft()
+            if meta.num_rows <= self._remaining:
+                self._remaining -= meta.num_rows
+                self._emit(seq, (ref, meta))
+                if self._remaining == 0:
+                    self.satisfied = True
+            else:
+                bref, mref = self._slice.remote(ref, 0, self._remaining)
+                self._inflight[mref] = seq
+                self._blockref[mref] = bref
+                self._remaining = 0
+                self.satisfied = True
+        return list(self._inflight.keys())
+
+    def process(self, done_refs: set):
+        for mref in list(self._inflight.keys()):
+            if mref in done_refs:
+                seq = self._inflight.pop(mref)
+                bref = self._blockref.pop(mref)
+                meta = ray_tpu.get(mref)
+                self.stats.record(self.name, tasks=1, rows=meta.num_rows,
+                                  bytes=meta.size_bytes)
+                self._emit(seq, (bref, meta))
+
+    def done(self):
+        return ((self.satisfied or (self.input_done and not self.inq))
+                and not self._inflight and not self.outq)
+
+
+class AllToAllOp(PhysOp):
+    """Barrier op: collects every input, then runs bulk_fn on the driver."""
+
+    def __init__(self, name, bulk_fn, ctx, stats):
+        super().__init__(name, ctx, stats)
+        self._bulk_fn = bulk_fn
+        self._collected: List[RefMeta] = []
+        self._ran = False
+
+    def can_accept_work(self):
+        return True  # barrier: must absorb all input regardless of outq
+
+    def wait_refs(self):
+        while self.inq:
+            _seq, rm = self.inq.popleft()
+            self._collected.append(rm)
+        if self.input_done and not self._ran:
+            t0 = time.perf_counter()
+            refs = [r for r, _ in self._collected]
+            metas = [m for _, m in self._collected]
+            out_refs, out_metas = self._bulk_fn(refs, metas)
+            for rm in zip(out_refs, out_metas):
+                self.outq.append(rm)
+            self.stats.record(self.name, tasks=1,
+                              rows=sum(m.num_rows for m in out_metas),
+                              bytes=sum(m.size_bytes for m in out_metas),
+                              wall_s=time.perf_counter() - t0)
+            self._ran = True
+        return []
+
+    def done(self):
+        return self._ran and not self.outq
+
+
+def _default_cap() -> int:
+    try:
+        return max(2, int(ray_tpu.cluster_resources().get("CPU", 2)))
+    except Exception:
+        return 4
+
+
+class StreamingExecutor:
+    """Drives a linear chain of physical operators to completion."""
+
+    def __init__(self, logical_root: LogicalOperator,
+                 ctx: Optional[DataContext] = None):
+        self.ctx = ctx or DataContext.get_current()
+        self.stats = ExecutionStats()
+        self.ops = self._plan(fuse_plan(logical_root))
+
+    # -- planning ----------------------------------------------------------
+    def _plan(self, op: LogicalOperator) -> List[PhysOp]:
+        if isinstance(op, (Union, Zip)):
+            # Materialize non-linear plans up front (bulk), then stream.
+            refs, metas = _materialize_logical(op, self.ctx, self.stats)
+            return [InputOp(list(zip(refs, metas)), self.ctx, self.stats)]
+        chain: List[LogicalOperator] = []
+        cur = op
+        while True:
+            chain.append(cur)
+            if not cur.inputs:
+                break
+            if len(cur.inputs) > 1 or isinstance(cur.inputs[0], (Union, Zip)):
+                break
+            cur = cur.inputs[0]
+        chain.reverse()
+        phys: List[PhysOp] = []
+        for node in chain:
+            if isinstance(node, Read):
+                phys.append(ReadOp(node.name, node.read_tasks, self.ctx,
+                                   self.stats))
+            elif isinstance(node, InputData):
+                phys.append(InputOp(list(zip(node.block_refs, node.metas)),
+                                    self.ctx, self.stats))
+            elif isinstance(node, (Union, Zip)):
+                refs, metas = _materialize_logical(node, self.ctx, self.stats)
+                phys.append(InputOp(list(zip(refs, metas)), self.ctx,
+                                    self.stats))
+            elif isinstance(node, AbstractMap):
+                if node.compute is not None:
+                    phys.append(ActorMapOp(node.name, node.specs,
+                                           node.ray_remote_args,
+                                           node.compute.size, self.ctx,
+                                           self.stats))
+                else:
+                    phys.append(TaskMapOp(node.name, node.specs,
+                                          node.ray_remote_args, self.ctx,
+                                          self.stats))
+            elif isinstance(node, Limit):
+                phys.append(LimitOp(node.limit, self.ctx, self.stats))
+            elif isinstance(node, AllToAll):
+                phys.append(AllToAllOp(node.name, node.bulk_fn, self.ctx,
+                                       self.stats))
+            else:
+                raise TypeError(f"cannot plan {node!r}")
+        return phys
+
+    # -- execution ---------------------------------------------------------
+    def execute(self) -> Iterator[RefMeta]:
+        t_start = time.perf_counter()
+        ops = self.ops
+        last = ops[-1]
+        try:
+            while True:
+                # Forward outputs downstream (and emit from the tail).
+                for i, op in enumerate(ops):
+                    if i + 1 < len(ops):
+                        nxt = ops[i + 1]
+                        while op.outq:
+                            nxt.add_input(op.outq.popleft())
+                        if op.done() and not nxt.input_done:
+                            nxt.mark_input_done()
+                while last.outq:
+                    yield last.outq.popleft()
+                # A satisfied Limit (anywhere in the chain) cancels all
+                # upstream work: the scan stops instead of draining fully.
+                for i, op in enumerate(ops):
+                    if isinstance(op, LimitOp) and op.satisfied:
+                        for up in ops[:i]:
+                            if not up.done():
+                                up.finish_early()
+                if isinstance(last, LimitOp) and last.done():
+                    break
+                if all(op.done() for op in ops):
+                    break
+                refs: List[Any] = []
+                for op in ops:
+                    refs.extend(op.wait_refs())
+                if refs:
+                    done, _ = ray_tpu.wait(
+                        refs, num_returns=min(len(refs), 8), timeout=0.5)
+                    done_set = set(done)
+                    for op in ops:
+                        op.process(done_set)
+                else:
+                    # Only driver-side ops had work; loop again.
+                    progressed = any(op.outq for op in ops)
+                    if not progressed and all(op.done() for op in ops):
+                        break
+            while last.outq:
+                yield last.outq.popleft()
+        finally:
+            for op in ops:
+                op.shutdown()
+            self.stats.total_wall_s = time.perf_counter() - t_start
+
+
+def _materialize_logical(op: LogicalOperator, ctx: DataContext,
+                         stats: ExecutionStats):
+    """Bulk-execute a plan to lists of (refs, metas); handles Union/Zip."""
+    if isinstance(op, Union):
+        refs, metas = [], []
+        for child in op.inputs:
+            r, m = _materialize_logical(child, ctx, stats)
+            refs.extend(r)
+            metas.extend(m)
+        return refs, metas
+    if isinstance(op, Zip):
+        lr, lm = _materialize_logical(op.inputs[0], ctx, stats)
+        rr, rm = _materialize_logical(op.inputs[1], ctx, stats)
+        return _zip_blocks(lr, lm, rr, rm)
+    ex = StreamingExecutor(op, ctx)
+    refs, metas = [], []
+    for ref, meta in ex.execute():
+        refs.append(ref)
+        metas.append(meta)
+    for name, d in ex.stats.per_op.items():
+        stats.record(name, **d)
+    return refs, metas
+
+
+def _zip_task(left, *rights):
+    right = BlockAccessor.concat(list(rights))
+    la = BlockAccessor.for_block(left)
+    ra = BlockAccessor.for_block(right)
+    if la.num_rows() != ra.num_rows():
+        raise ValueError(
+            f"zip: row count mismatch {la.num_rows()} vs {ra.num_rows()}")
+    lb = la.to_batch("numpy")
+    rb = ra.to_batch("numpy")
+    out = dict(lb)
+    for k, v in rb.items():
+        key = k
+        while key in out:
+            key = key + "_1"
+        out[key] = v
+    return out, BlockAccessor.for_block(out).get_metadata()
+
+
+def _zip_blocks(lrefs, lmetas, rrefs, rmetas):
+    """Align right blocks to the left block boundaries, then zip per block."""
+    total_l = sum(m.num_rows for m in lmetas)
+    total_r = sum(m.num_rows for m in rmetas)
+    if total_l != total_r:
+        raise ValueError(f"zip: datasets have {total_l} vs {total_r} rows")
+    slice_fn = ray_tpu.remote(_slice_task).options(num_returns=2)
+    zip_fn = ray_tpu.remote(_zip_task).options(num_returns=2)
+    # Build per-right-block global offsets.
+    r_offsets = [0]
+    for m in rmetas:
+        r_offsets.append(r_offsets[-1] + m.num_rows)
+    out_refs, out_metas = [], []
+    pos = 0
+    for lref, lmeta in zip(lrefs, lmetas):
+        lo, hi = pos, pos + lmeta.num_rows
+        pieces = []
+        for i, rref in enumerate(rrefs):
+            blo, bhi = r_offsets[i], r_offsets[i + 1]
+            s, e = max(lo, blo), min(hi, bhi)
+            if s < e:
+                piece, _ = slice_fn.remote(rref, s - blo, e - blo)
+                pieces.append(piece)
+        bref, mref = zip_fn.remote(lref, *pieces)
+        out_refs.append(bref)
+        out_metas.append(ray_tpu.get(mref))
+        pos = hi
+    return out_refs, out_metas

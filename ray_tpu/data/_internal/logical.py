@@ -1,0 +1,138 @@
+"""Logical plan: what to compute, independent of how it is scheduled.
+
+Reference parity: python/ray/data/_internal/logical/interfaces/
+logical_operator.py:6 and the operators under logical/operations/. The
+planner lowers these onto physical operators in executor.py; consecutive
+row/batch maps are fused into one task per block (reference: fusion rules in
+logical/rules/operator_fusion.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class LogicalOperator:
+    """A node in the lazy plan DAG. `inputs` are upstream operators."""
+
+    def __init__(self, name: str, inputs: List["LogicalOperator"]):
+        self.name = name
+        self.inputs = inputs
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(i.name for i in self.inputs)})"
+
+
+class Read(LogicalOperator):
+    def __init__(self, read_tasks: List[Callable], name: str = "Read"):
+        super().__init__(name, [])
+        self.read_tasks = read_tasks
+
+
+class InputData(LogicalOperator):
+    """Pre-existing blocks (from_items / from_numpy / materialized)."""
+
+    def __init__(self, block_refs: List[Any], metas: List[Any]):
+        super().__init__("InputData", [])
+        self.block_refs = block_refs
+        self.metas = metas
+
+
+@dataclass
+class MapSpec:
+    """One fused stage of row/batch transforms applied per block."""
+    kind: str                       # "batches" | "rows" | "filter" | "flat"
+    fn: Callable
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+    fn_constructor_args: Tuple = ()
+    zero_copy: bool = True
+
+
+class AbstractMap(LogicalOperator):
+    """Per-block transform; `specs` is the fused chain applied in order."""
+
+    def __init__(self, name: str, input_op: LogicalOperator,
+                 specs: List[MapSpec],
+                 ray_remote_args: Optional[dict] = None,
+                 compute: Optional[Any] = None):
+        super().__init__(name, [input_op])
+        self.specs = specs
+        self.ray_remote_args = ray_remote_args or {}
+        self.compute = compute  # None => tasks; ActorPoolStrategy => actors
+
+    def can_fuse_with(self, other: "AbstractMap") -> bool:
+        return (isinstance(other, AbstractMap)
+                and self.ray_remote_args == other.ray_remote_args
+                and self.compute is None and other.compute is None)
+
+    def fused(self, other: "AbstractMap") -> "AbstractMap":
+        return AbstractMap(f"{self.name}->{other.name}", self.inputs[0],
+                           self.specs + other.specs, self.ray_remote_args,
+                           self.compute)
+
+
+class Limit(LogicalOperator):
+    def __init__(self, input_op: LogicalOperator, limit: int):
+        super().__init__(f"Limit[{limit}]", [input_op])
+        self.limit = limit
+
+
+class AllToAll(LogicalOperator):
+    """Materializing exchange: shuffle / sort / repartition / groupby.
+
+    `bulk_fn(block_refs, metas) -> (block_refs, metas)` runs on the driver
+    and may launch its own tasks (reference: AllToAllOperator).
+    """
+
+    def __init__(self, name: str, input_op: LogicalOperator,
+                 bulk_fn: Callable):
+        super().__init__(name, [input_op])
+        self.bulk_fn = bulk_fn
+
+
+class Union(LogicalOperator):
+    def __init__(self, ops: List[LogicalOperator]):
+        super().__init__("Union", list(ops))
+
+
+class Zip(LogicalOperator):
+    def __init__(self, left: LogicalOperator, right: LogicalOperator):
+        super().__init__("Zip", [left, right])
+
+
+@dataclass
+class ExecutionStats:
+    """Wall-time / rows / tasks per operator, printable via Dataset.stats()."""
+    per_op: Dict[str, dict] = field(default_factory=dict)
+    total_wall_s: float = 0.0
+
+    def record(self, op_name: str, **kv):
+        d = self.per_op.setdefault(op_name, {
+            "tasks": 0, "rows": 0, "bytes": 0, "wall_s": 0.0})
+        for k, v in kv.items():
+            d[k] = d.get(k, 0) + v
+
+    def summary(self) -> str:
+        lines = ["Execution stats:"]
+        for name, d in self.per_op.items():
+            lines.append(
+                f"  {name}: {d['tasks']} tasks, {d['rows']} rows, "
+                f"{d['bytes'] / 1e6:.1f} MB, {d['wall_s']:.2f}s")
+        lines.append(f"  total wall time: {self.total_wall_s:.2f}s")
+        return "\n".join(lines)
+
+
+def fuse_plan(op: LogicalOperator) -> LogicalOperator:
+    """Bottom-up fusion of consecutive AbstractMap stages."""
+    new_inputs = [fuse_plan(i) for i in op.inputs]
+    op.inputs = new_inputs
+    if (isinstance(op, AbstractMap) and len(new_inputs) == 1
+            and isinstance(new_inputs[0], AbstractMap)
+            and new_inputs[0].can_fuse_with(op)):
+        parent = new_inputs[0]
+        fused = parent.fused(op)
+        fused.inputs = parent.inputs
+        return fused
+    return op

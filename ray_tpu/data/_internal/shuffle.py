@@ -1,0 +1,240 @@
+"""All-to-all exchanges: shuffle, repartition, sort, groupby.
+
+Reference parity: python/ray/data/_internal/planner/exchange/ (push-based
+two-stage map/reduce shuffle). Map tasks partition each block; reduce tasks
+concatenate one partition from every mapper.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (Block, BlockAccessor, partition_sorted_block,
+                                sort_block)
+
+
+def _meta_of(block):
+    return BlockAccessor.for_block(block).get_metadata()
+
+
+def _shuffle_map(block, n_out: int, seed):
+    """Randomly partition one block into n_out pieces."""
+    acc = BlockAccessor.for_block(block)
+    n = acc.num_rows()
+    rng = np.random.RandomState(seed)
+    assignment = rng.randint(0, n_out, size=n)
+    order = np.argsort(assignment, kind="stable")
+    counts = np.bincount(assignment, minlength=n_out)
+    if isinstance(block, dict):
+        shuffled = {k: v[order] for k, v in block.items()}
+    else:
+        shuffled = [block[i] for i in order]
+    acc = BlockAccessor.for_block(shuffled)
+    parts, start = [], 0
+    for c in counts:
+        parts.append(acc.slice(start, start + int(c)))
+        start += int(c)
+    return tuple(parts)
+
+
+def _shuffle_reduce(seed, *parts):
+    merged = BlockAccessor.concat(list(parts))
+    acc = BlockAccessor.for_block(merged)
+    n = acc.num_rows()
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(n)
+    if isinstance(merged, dict):
+        out = {k: v[order] for k, v in merged.items()}
+    else:
+        out = [merged[i] for i in order]
+    return out, _meta_of(out)
+
+
+def random_shuffle_bulk(refs, metas, seed: Optional[int],
+                        num_blocks: Optional[int] = None):
+    if not refs:
+        return [], []
+    n_out = num_blocks or len(refs)
+    base_seed = seed if seed is not None else random.randrange(2**31)
+    map_fn = ray_tpu.remote(_shuffle_map).options(num_returns=n_out)
+    reduce_fn = ray_tpu.remote(_shuffle_reduce).options(num_returns=2)
+    partss = []
+    for i, ref in enumerate(refs):
+        out = map_fn.remote(ref, n_out, base_seed + i)
+        partss.append(out if isinstance(out, list) else [out])
+    out_refs, meta_refs = [], []
+    for j in range(n_out):
+        bref, mref = reduce_fn.remote(base_seed + 10007 * j,
+                                      *[p[j] for p in partss])
+        out_refs.append(bref)
+        meta_refs.append(mref)
+    return out_refs, ray_tpu.get(meta_refs)
+
+
+def _concat_reduce(*parts):
+    out = BlockAccessor.concat(list(parts))
+    return out, _meta_of(out)
+
+
+def repartition_bulk(refs, metas, num_blocks: int):
+    """Split/merge to exactly num_blocks without changing row order."""
+    total = sum(m.num_rows for m in metas)
+    if total == 0:
+        empty = ray_tpu.put([])
+        return [empty], [_meta_of([])]
+    # Target row ranges per output block.
+    base, rem = divmod(total, num_blocks)
+    targets = [base + (1 if i < rem else 0) for i in range(num_blocks)]
+    offsets = [0]
+    for t in targets:
+        offsets.append(offsets[-1] + t)
+    in_offsets = [0]
+    for m in metas:
+        in_offsets.append(in_offsets[-1] + m.num_rows)
+
+    from ray_tpu.data._internal.executor import _slice_task
+    slice_fn = ray_tpu.remote(_slice_task).options(num_returns=2)
+    reduce_fn = ray_tpu.remote(_concat_reduce).options(num_returns=2)
+    out_refs, meta_refs = [], []
+    for j in range(num_blocks):
+        lo, hi = offsets[j], offsets[j + 1]
+        pieces = []
+        for i, ref in enumerate(refs):
+            blo, bhi = in_offsets[i], in_offsets[i + 1]
+            s, e = max(lo, blo), min(hi, bhi)
+            if s < e:
+                piece, _ = slice_fn.remote(ref, s - blo, e - blo)
+                pieces.append(piece)
+        bref, mref = reduce_fn.remote(*pieces)
+        out_refs.append(bref)
+        meta_refs.append(mref)
+    return out_refs, ray_tpu.get(meta_refs)
+
+
+def _sort_map(block, boundaries, key, descending):
+    sb = sort_block(block, key, descending)
+    parts = partition_sorted_block(sb, boundaries, key, descending)
+    return tuple(parts)
+
+
+def _sort_reduce(key, descending, *parts):
+    merged = BlockAccessor.concat(list(parts))
+    out = sort_block(merged, key, descending)
+    return out, _meta_of(out)
+
+
+def sort_bulk(refs, metas, key, descending: bool = False,
+              num_blocks: Optional[int] = None):
+    """Sample-partitioned distributed sort (reference: planner/exchange/sort)."""
+    if not refs:
+        return [], []
+    n_out = num_blocks or len(refs)
+    kf = key if callable(key) else None
+
+    def _sample(block):
+        acc = BlockAccessor.for_block(block)
+        return acc.sample(16, key=kf if kf else (lambda r: r[key]))
+
+    sample_fn = ray_tpu.remote(_sample)
+    samples = [s for ss in ray_tpu.get([sample_fn.remote(r) for r in refs])
+               for s in ss]
+    samples.sort()
+    if descending:
+        samples = samples[::-1]
+    if len(samples) >= n_out and n_out > 1:
+        idx = [int(len(samples) * i / n_out) for i in range(1, n_out)]
+        boundaries = [samples[i] for i in idx]
+    else:
+        boundaries = samples[:max(0, n_out - 1)]
+    n_parts = len(boundaries) + 1
+    map_fn = ray_tpu.remote(_sort_map).options(num_returns=n_parts)
+    reduce_fn = ray_tpu.remote(_sort_reduce).options(num_returns=2)
+    partss = []
+    for ref in refs:
+        out = map_fn.remote(ref, boundaries, key, descending)
+        partss.append(out if isinstance(out, list) else [out])
+    out_refs, meta_refs = [], []
+    for j in range(n_parts):
+        bref, mref = reduce_fn.remote(key, descending, *[p[j] for p in partss])
+        out_refs.append(bref)
+        meta_refs.append(mref)
+    return out_refs, ray_tpu.get(meta_refs)
+
+
+def _stable_hash(v) -> int:
+    """Process-independent hash (Python's hash() is seed-randomized for
+    strings, and mapper tasks run in different worker processes)."""
+    import zlib
+    if isinstance(v, (np.generic,)):
+        v = v.item()
+    return zlib.crc32(repr(v).encode())
+
+
+def _groupby_map(block, n_out: int, key):
+    """Hash-partition rows by group key."""
+    acc = BlockAccessor.for_block(block)
+    rows = list(acc.iter_rows())
+    kf = key if callable(key) else (lambda r: r[key])
+    buckets: List[List[Any]] = [[] for _ in range(n_out)]
+    for r in rows:
+        buckets[_stable_hash(kf(r)) % n_out].append(r)
+    out = []
+    for b in buckets:
+        if b and isinstance(b[0], dict):
+            out.append({k: np.asarray([r[k] for r in b]) for k in b[0]})
+        else:
+            out.append(b)
+    return tuple(out)
+
+
+def _groupby_reduce(key, aggs_blob, *parts):
+    import cloudpickle
+    aggs = cloudpickle.loads(aggs_blob)
+    merged = BlockAccessor.concat([p for p in parts
+                                   if BlockAccessor.for_block(p).num_rows()])
+    acc = BlockAccessor.for_block(merged)
+    kf = key if callable(key) else (lambda r: r[key])
+    groups: dict = {}
+    for r in acc.iter_rows():
+        groups.setdefault(kf(r), []).append(r)
+    out_rows = []
+    keyname = key if isinstance(key, str) else "key"
+    for gk in sorted(groups.keys(), key=lambda x: (str(type(x)), x)):
+        rows = groups[gk]
+        row = {keyname: gk}
+        for agg in aggs:
+            a = agg.init(gk)
+            for r in rows:
+                a = agg.accumulate(a, r)
+            row[agg.name] = agg.finalize(a)
+        out_rows.append(row)
+    if out_rows:
+        block = {k: np.asarray([r[k] for r in out_rows])
+                 for k in out_rows[0]}
+    else:
+        block = []
+    return block, _meta_of(block)
+
+
+def groupby_bulk(refs, metas, key, aggs, num_blocks: Optional[int] = None):
+    import cloudpickle
+    if not refs:
+        return [], []
+    n_out = min(num_blocks or len(refs), max(1, len(refs)))
+    map_fn = ray_tpu.remote(_groupby_map).options(num_returns=n_out)
+    reduce_fn = ray_tpu.remote(_groupby_reduce).options(num_returns=2)
+    blob = cloudpickle.dumps(aggs)
+    partss = []
+    for ref in refs:
+        out = map_fn.remote(ref, n_out, key)
+        partss.append(out if isinstance(out, list) else [out])
+    out_refs, meta_refs = [], []
+    for j in range(n_out):
+        bref, mref = reduce_fn.remote(key, blob, *[p[j] for p in partss])
+        out_refs.append(bref)
+        meta_refs.append(mref)
+    return out_refs, ray_tpu.get(meta_refs)

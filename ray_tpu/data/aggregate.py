@@ -1,0 +1,113 @@
+"""Aggregation functions (reference: python/ray/data/aggregate.py)."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Union
+
+
+def _getter(on: Optional[Union[str, Callable]]):
+    if on is None:
+        def get(r):
+            if isinstance(r, dict):
+                if len(r) == 1:
+                    return next(iter(r.values()))
+                raise ValueError(
+                    f"aggregate over a multi-column row requires on=<column>;"
+                    f" columns: {list(r)}")
+            return r
+        return get
+    if callable(on):
+        return on
+    return lambda r: r[on]
+
+
+class AggregateFn:
+    """init(key) -> acc; accumulate(acc, row) -> acc; merge; finalize."""
+
+    def __init__(self, init, accumulate, merge, finalize=None,
+                 name: str = "agg"):
+        self.init = init
+        self.accumulate = accumulate
+        self.merge = merge
+        self.finalize = finalize or (lambda a: a)
+        self.name = name
+
+
+class Count(AggregateFn):
+    def __init__(self):
+        super().__init__(lambda k: 0, lambda a, r: a + 1, lambda a, b: a + b,
+                         name="count()")
+
+
+class Sum(AggregateFn):
+    def __init__(self, on=None):
+        g = _getter(on)
+        super().__init__(lambda k: 0, lambda a, r: a + g(r),
+                         lambda a, b: a + b, name=f"sum({on})")
+
+
+class Min(AggregateFn):
+    def __init__(self, on=None):
+        g = _getter(on)
+        super().__init__(lambda k: None,
+                         lambda a, r: g(r) if a is None else min(a, g(r)),
+                         lambda a, b: b if a is None else
+                         (a if b is None else min(a, b)),
+                         name=f"min({on})")
+
+
+class Max(AggregateFn):
+    def __init__(self, on=None):
+        g = _getter(on)
+        super().__init__(lambda k: None,
+                         lambda a, r: g(r) if a is None else max(a, g(r)),
+                         lambda a, b: b if a is None else
+                         (a if b is None else max(a, b)),
+                         name=f"max({on})")
+
+
+class Mean(AggregateFn):
+    def __init__(self, on=None):
+        g = _getter(on)
+        super().__init__(lambda k: (0.0, 0),
+                         lambda a, r: (a[0] + g(r), a[1] + 1),
+                         lambda a, b: (a[0] + b[0], a[1] + b[1]),
+                         lambda a: a[0] / a[1] if a[1] else float("nan"),
+                         name=f"mean({on})")
+
+
+class Std(AggregateFn):
+    """Welford-mergeable variance; ddof=1 to match the reference."""
+
+    def __init__(self, on=None, ddof: int = 1):
+        g = _getter(on)
+
+        def acc(a, r):
+            m, m2, n = a
+            n += 1
+            x = g(r)
+            d = x - m
+            m += d / n
+            m2 += d * (x - m)
+            return (m, m2, n)
+
+        def merge(a, b):
+            m1, s1, n1 = a
+            m2, s2, n2 = b
+            if n1 == 0:
+                return b
+            if n2 == 0:
+                return a
+            d = m2 - m1
+            n = n1 + n2
+            return (m1 + d * n2 / n, s1 + s2 + d * d * n1 * n2 / n, n)
+
+        def fin(a):
+            _m, m2, n = a
+            if n - ddof <= 0:
+                return float("nan")
+            return math.sqrt(m2 / (n - ddof))
+
+        super().__init__(lambda k: (0.0, 0.0, 0), acc, merge, fin,
+                         name=f"std({on})")

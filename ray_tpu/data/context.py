@@ -1,0 +1,40 @@
+"""Execution-wide tunables (reference: python/ray/data/context.py)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class DataContext:
+    """Per-driver dataset execution configuration.
+
+    target_max_block_size: map/read outputs buffer up to this many bytes
+    before emitting a block (dynamic block sizing).
+    op_concurrency_cap: max in-flight tasks per physical operator; None =
+    derive from cluster CPUs at execution time (streaming backpressure).
+    max_buffered_blocks: per-operator bound on completed-but-unconsumed
+    output blocks — the executor stops dispatching upstream work while a
+    downstream queue is full (reference: backpressure_policy/).
+    """
+
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    read_op_min_num_blocks: int = 8
+    op_concurrency_cap: Optional[int] = None
+    max_buffered_blocks: int = 16
+    eager_free: bool = True
+    verbose_stats: bool = False
+    extras: dict = field(default_factory=dict)
+
+    _lock = threading.Lock()
+    _current: Optional["DataContext"] = None
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        with DataContext._lock:
+            if DataContext._current is None:
+                DataContext._current = DataContext()
+            return DataContext._current

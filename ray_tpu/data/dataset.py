@@ -1,0 +1,478 @@
+"""Dataset: the lazy distributed dataset façade.
+
+Reference parity: python/ray/data/dataset.py:137. Execution is lazy; every
+consumption API drives the streaming executor (executor.py). TPU-first
+additions: `iter_jax_batches` device-puts batches onto a sharding, and
+`streaming_split` feeds SPMD training gangs per-epoch.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Union)
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.aggregate import (AggregateFn, Count, Max, Mean, Min, Std,
+                                    Sum)
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
+from ray_tpu.data._internal.executor import StreamingExecutor
+from ray_tpu.data._internal.logical import (AbstractMap, AllToAll, InputData,
+                                            Limit, LogicalOperator, MapSpec,
+                                            Union as UnionOp, Zip)
+from ray_tpu.data._internal import shuffle as _shuffle
+
+
+@dataclass
+class ActorPoolStrategy:
+    """compute= strategy for stateful map_batches (reference: ActorPoolStrategy)."""
+    size: int = 2
+    min_size: Optional[int] = None
+    max_size: Optional[int] = None
+
+    def __post_init__(self):
+        if self.min_size:
+            self.size = self.min_size
+
+
+class Dataset:
+    def __init__(self, op: LogicalOperator,
+                 context: Optional[DataContext] = None):
+        self._op = op
+        self._ctx = context or DataContext.get_current()
+        self._last_stats = None
+
+    # ------------------------------------------------------------------
+    # Transformations (lazy)
+    # ------------------------------------------------------------------
+    def _map_op(self, name: str, spec: MapSpec, ray_remote_args=None,
+                compute=None) -> "Dataset":
+        return Dataset(AbstractMap(name, self._op, [spec],
+                                   ray_remote_args, compute), self._ctx)
+
+    def map(self, fn: Callable, *, num_cpus: Optional[float] = None,
+            **ray_remote_args) -> "Dataset":
+        if num_cpus is not None:
+            ray_remote_args["num_cpus"] = num_cpus
+        return self._map_op("Map", MapSpec("rows", fn), ray_remote_args)
+
+    def map_batches(self, fn: Union[Callable, type], *,
+                    batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
+                    compute: Optional[ActorPoolStrategy] = None,
+                    fn_constructor_args: tuple = (),
+                    num_cpus: Optional[float] = None,
+                    num_tpus: Optional[float] = None,
+                    **ray_remote_args) -> "Dataset":
+        if num_cpus is not None:
+            ray_remote_args["num_cpus"] = num_cpus
+        if num_tpus is not None:
+            ray_remote_args["num_tpus"] = num_tpus
+        if isinstance(fn, type) and compute is None:
+            compute = ActorPoolStrategy(size=2)
+        spec = MapSpec("batches", fn, batch_size=batch_size,
+                       batch_format=batch_format,
+                       fn_constructor_args=fn_constructor_args)
+        return self._map_op("MapBatches", spec, ray_remote_args, compute)
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._map_op("Filter", MapSpec("filter", fn))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._map_op("FlatMap", MapSpec("flat", fn))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def add(batch):
+            batch = dict(batch)
+            batch[name] = np.asarray(fn(batch))
+            return batch
+        return self._map_op("AddColumn", MapSpec("batches", add))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def drop(batch):
+            return {k: v for k, v in batch.items() if k not in cols}
+        return self._map_op("DropColumns", MapSpec("batches", drop))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def select(batch):
+            return {k: batch[k] for k in cols}
+        return self._map_op("SelectColumns", MapSpec("batches", select))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def rename(batch):
+            return {mapping.get(k, k): v for k, v in batch.items()}
+        return self._map_op("RenameColumns", MapSpec("batches", rename))
+
+    def limit(self, n: int) -> "Dataset":
+        return Dataset(Limit(self._op, n), self._ctx)
+
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
+        def bulk(refs, metas):
+            return _shuffle.random_shuffle_bulk(refs, metas, seed, num_blocks)
+        return Dataset(AllToAll("RandomShuffle", self._op, bulk), self._ctx)
+
+    def randomize_block_order(self, *, seed: Optional[int] = None) -> "Dataset":
+        def bulk(refs, metas):
+            import random as _r
+            rng = _r.Random(seed)
+            idx = list(range(len(refs)))
+            rng.shuffle(idx)
+            return [refs[i] for i in idx], [metas[i] for i in idx]
+        return Dataset(AllToAll("RandomizeBlockOrder", self._op, bulk),
+                       self._ctx)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        def bulk(refs, metas):
+            return _shuffle.repartition_bulk(refs, metas, num_blocks)
+        return Dataset(AllToAll(f"Repartition[{num_blocks}]", self._op, bulk),
+                       self._ctx)
+
+    def sort(self, key, descending: bool = False) -> "Dataset":
+        def bulk(refs, metas):
+            return _shuffle.sort_bulk(refs, metas, key, descending)
+        return Dataset(AllToAll("Sort", self._op, bulk), self._ctx)
+
+    def groupby(self, key) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return Dataset(UnionOp([self._op] + [o._op for o in others]),
+                       self._ctx)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return Dataset(Zip(self._op, other._op), self._ctx)
+
+    def random_sample(self, fraction: float,
+                      seed: Optional[int] = None) -> "Dataset":
+        rng_seed = seed if seed is not None else np.random.randint(2**31)
+
+        def sample(batch):
+            import zlib
+            n = len(next(iter(batch.values()))) if batch else 0
+            # Derive a per-block seed from the block's content so distinct
+            # blocks draw independent masks (a fixed seed would repeat the
+            # same mask positions in every block).
+            h = rng_seed
+            for v in batch.values():
+                a = np.asarray(v)
+                h = zlib.crc32(a[:64].tobytes() if a.dtype != object
+                               else repr(a[:8].tolist()).encode(), h)
+                break
+            rng = np.random.RandomState((h + n) % (2**31))
+            mask = rng.random_sample(n) < fraction
+            return {k: v[mask] for k, v in batch.items()}
+        return self._map_op("RandomSample", MapSpec("batches", sample))
+
+    # ------------------------------------------------------------------
+    # Execution / consumption
+    # ------------------------------------------------------------------
+    def _execute(self) -> Iterator:
+        ex = StreamingExecutor(self._op, self._ctx)
+        it = ex.execute()
+        self._last_stats = ex.stats
+        return it
+
+    def materialize(self) -> "Dataset":
+        refs, metas = [], []
+        for ref, meta in self._execute():
+            refs.append(ref)
+            metas.append(meta)
+        return Dataset(InputData(refs, metas), self._ctx)
+
+    def to_block_refs(self):
+        """[(ObjectRef[Block], BlockMetadata)] — executes the plan."""
+        return list(self._execute())
+
+    def iter_blocks(self) -> Iterator[Block]:
+        for ref, _meta in self._execute():
+            yield ray_tpu.get(ref)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_blocks():
+            yield from BlockAccessor.for_block(block).iter_rows()
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None
+                     ) -> Iterator[Any]:
+        from ray_tpu.data.iterator import batch_blocks
+        yield from batch_blocks(self.iter_blocks(), batch_size, batch_format,
+                                drop_last, local_shuffle_buffer_size,
+                                local_shuffle_seed)
+
+    def iter_jax_batches(self, *, batch_size: int,
+                         sharding=None, drop_last: bool = True,
+                         dtype=None, **kw) -> Iterator[Any]:
+        """Batches as jax.Arrays, optionally placed on a NamedSharding.
+
+        TPU-native addition: the host->device transfer happens here, so a
+        training loop can consume device-resident batches directly.
+        """
+        from ray_tpu.data.iterator import jax_batch_stream
+        yield from jax_batch_stream(
+            self.iter_batches(batch_size=batch_size, drop_last=drop_last,
+                              **kw), sharding, dtype)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out = []
+        for row in self.limit(n).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def show(self, n: int = 20):
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        # Fast path: metadata only, no block fetch.
+        return sum(meta.num_rows for _ref, meta in self._execute())
+
+    def _agg(self, agg: AggregateFn):
+        acc = agg.init(None)
+        for block in self.iter_blocks():
+            for row in BlockAccessor.for_block(block).iter_rows():
+                acc = agg.accumulate(acc, row)
+        return agg.finalize(acc)
+
+    def sum(self, on=None):
+        return self._agg(Sum(on))
+
+    def min(self, on=None):
+        return self._agg(Min(on))
+
+    def max(self, on=None):
+        return self._agg(Max(on))
+
+    def mean(self, on=None):
+        return self._agg(Mean(on))
+
+    def std(self, on=None, ddof: int = 1):
+        return self._agg(Std(on, ddof))
+
+    def aggregate(self, *aggs: AggregateFn) -> dict:
+        return {a.name: self._agg(a) for a in aggs}
+
+    def schema(self) -> Optional[List[str]]:
+        for _ref, meta in self._execute():
+            if meta.schema:
+                return meta.schema
+        return None
+
+    def columns(self) -> Optional[List[str]]:
+        return self.schema()
+
+    def num_blocks(self) -> int:
+        return len(list(self._execute()))
+
+    def size_bytes(self) -> int:
+        return sum(meta.size_bytes for _ref, meta in self._execute())
+
+    def stats(self) -> str:
+        if self._last_stats is None:
+            return "(dataset not executed yet)"
+        return self._last_stats.summary()
+
+    # ------------------------------------------------------------------
+    # Splitting
+    # ------------------------------------------------------------------
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        mat = self.materialize()
+        op: InputData = mat._op  # type: ignore[assignment]
+        refs, metas = op.block_refs, op.metas
+        if equal:
+            total = sum(m.num_rows for m in metas)
+            per = total // n
+            refs, metas = _shuffle.repartition_bulk(refs, metas, n)
+            # After repartition blocks differ by <=1 row; trim to equal.
+            out = []
+            for r, m in zip(refs, metas):
+                if m.num_rows > per:
+                    from ray_tpu.data._internal.executor import _slice_task
+                    sl = ray_tpu.remote(_slice_task).options(num_returns=2)
+                    r, mref = sl.remote(r, 0, per)
+                    m = ray_tpu.get(mref)
+                out.append(Dataset(InputData([r], [m]), self._ctx))
+            return out
+        groups: List[List[int]] = [[] for _ in range(n)]
+        loads = [0] * n
+        for i, m in enumerate(metas):
+            j = loads.index(min(loads))
+            groups[j].append(i)
+            loads[j] += m.num_rows
+        return [Dataset(InputData([refs[i] for i in g],
+                                  [metas[i] for i in g]), self._ctx)
+                for g in groups]
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        mat = self.materialize()
+        op: InputData = mat._op  # type: ignore[assignment]
+        refs, metas = op.block_refs, op.metas
+        from ray_tpu.data._internal.executor import _slice_task
+        sl = ray_tpu.remote(_slice_task).options(num_returns=2)
+        offsets = [0]
+        for m in metas:
+            offsets.append(offsets[-1] + m.num_rows)
+        total = offsets[-1]
+        bounds = [0] + list(indices) + [total]
+        out = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            rs, ms = [], []
+            for i, r in enumerate(refs):
+                blo, bhi = offsets[i], offsets[i + 1]
+                s, e = max(lo, blo), min(hi, bhi)
+                if s < e:
+                    if s == blo and e == bhi:
+                        rs.append(r)
+                        ms.append(metas[i])
+                    else:
+                        rr, mref = sl.remote(r, s - blo, e - blo)
+                        rs.append(rr)
+                        ms.append(ray_tpu.get(mref))
+            if not rs:
+                blk = []
+                rs = [ray_tpu.put(blk)]
+                ms = [BlockAccessor.for_block(blk).get_metadata()]
+            out.append(Dataset(InputData(rs, ms), self._ctx))
+        return out
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        total = ds.count()
+        n_test = int(total * test_size)
+        train, test = ds.split_at_indices([total - n_test])
+        return train, test
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List["DataIterator"]:
+        """n iterators that each see a disjoint shard per epoch.
+
+        Reference parity: Dataset.streaming_split (output_splitter op);
+        feeds each SPMD training worker its per-host shard.
+        """
+        from ray_tpu.data.iterator import StreamSplitDataIterator
+        return StreamSplitDataIterator.create(self, n, equal=equal)
+
+    # ------------------------------------------------------------------
+    # Writing
+    # ------------------------------------------------------------------
+    def write_json(self, path: str):
+        import json
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            rows = list(BlockAccessor.for_block(block).iter_rows())
+            with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
+                for r in rows:
+                    f.write(json.dumps(_jsonable(r)) + "\n")
+
+    def write_csv(self, path: str):
+        import csv
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            acc = BlockAccessor.for_block(block)
+            rows = [_jsonable(r) for r in acc.iter_rows()]
+            if not rows:
+                continue
+            with open(os.path.join(path, f"part-{i:05d}.csv"), "w",
+                      newline="") as f:
+                w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+                w.writeheader()
+                w.writerows(rows)
+
+    def write_parquet(self, path: str):
+        import os
+        try:
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+        except ImportError as e:
+            raise ImportError("write_parquet requires pyarrow") from e
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            batch = BlockAccessor.for_block(block).to_batch("numpy")
+            table = pa.table({k: list(v) for k, v in batch.items()})
+            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def __repr__(self):
+        return f"Dataset(plan={self._op!r})"
+
+
+def _jsonable(row):
+    if isinstance(row, dict):
+        return {k: _jsonable(v) for k, v in row.items()}
+    if isinstance(row, np.generic):
+        return row.item()
+    if isinstance(row, np.ndarray):
+        return row.tolist()
+    return row
+
+
+class GroupedData:
+    """Result of Dataset.groupby (reference: grouped_data.py)."""
+
+    def __init__(self, ds: Dataset, key):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        key = self._key
+
+        def bulk(refs, metas):
+            return _shuffle.groupby_bulk(refs, metas, key, list(aggs))
+        return Dataset(AllToAll("GroupByAggregate", self._ds._op, bulk),
+                       self._ds._ctx)
+
+    def count(self) -> Dataset:
+        return self.aggregate(Count())
+
+    def sum(self, on=None) -> Dataset:
+        return self.aggregate(Sum(on))
+
+    def min(self, on=None) -> Dataset:
+        return self.aggregate(Min(on))
+
+    def max(self, on=None) -> Dataset:
+        return self.aggregate(Max(on))
+
+    def mean(self, on=None) -> Dataset:
+        return self.aggregate(Mean(on))
+
+    def std(self, on=None, ddof: int = 1) -> Dataset:
+        return self.aggregate(Std(on, ddof))
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        key = self._key
+
+        def apply(batch):
+            acc = BlockAccessor.for_block(
+                batch if isinstance(batch, dict) else list(batch))
+            rows = list(acc.iter_rows())
+            kf = key if callable(key) else (lambda r: r[key])
+            groups: dict = {}
+            for r in rows:
+                groups.setdefault(kf(r), []).append(r)
+            out = []
+            for gk in sorted(groups, key=lambda x: (str(type(x)), x)):
+                res = fn(groups[gk])
+                out.extend(res if isinstance(res, list) else [res])
+            if out and isinstance(out[0], dict):
+                return {k: np.asarray([r[k] for r in out]) for k in out[0]}
+            return out
+        # Shuffle so that each key lands wholly in one block first.
+        ds = self._ds.sort(key if not callable(key) else key)
+        return ds.repartition(1)._map_op("MapGroups",
+                                         MapSpec("batches", apply))

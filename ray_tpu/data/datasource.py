@@ -1,0 +1,205 @@
+"""Datasources: pluggable readers producing ReadTasks.
+
+Reference parity: python/ray/data/datasource/datasource.py. A ReadTask is a
+zero-arg callable (shipped to a worker) returning an iterable of blocks.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block
+
+
+class ReadTask:
+    def __init__(self, fn: Callable[[], Iterable[Block]],
+                 num_rows: Optional[int] = None):
+        self._fn = fn
+        self.num_rows = num_rows
+
+    def __call__(self) -> Iterable[Block]:
+        return self._fn()
+
+
+class Datasource:
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, tensor_shape: Optional[tuple] = None):
+        self._n = n
+        self._shape = tensor_shape
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = self._n
+        parallelism = max(1, min(parallelism, n)) if n else 1
+        base, rem = divmod(n, parallelism)
+        tasks, start = [], 0
+        for i in range(parallelism):
+            cnt = base + (1 if i < rem else 0)
+            lo, hi = start, start + cnt
+            start = hi
+            shape = self._shape
+
+            def read(lo=lo, hi=hi, shape=shape):
+                ids = np.arange(lo, hi, dtype=np.int64)
+                if shape is None:
+                    return [{"id": ids}]
+                data = np.broadcast_to(
+                    ids.reshape((-1,) + (1,) * len(shape)),
+                    (hi - lo,) + shape).copy()
+                return [{"data": data}]
+
+            tasks.append(ReadTask(read, num_rows=cnt))
+        return tasks
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if not f.startswith("."))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+class FileBasedDatasource(Datasource):
+    """One-or-more files per read task, balanced by file size."""
+
+    def __init__(self, paths):
+        self._paths = _expand_paths(paths)
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        paths = self._paths
+        parallelism = max(1, min(parallelism, len(paths)))
+        groups: List[List[str]] = [[] for _ in range(parallelism)]
+        sizes = [(os.path.getsize(p) if os.path.exists(p) else 0, p)
+                 for p in paths]
+        loads = [0] * parallelism
+        for size, p in sorted(sizes, reverse=True):
+            i = loads.index(min(loads))
+            groups[i].append(p)
+            loads[i] += size + 1
+        tasks = []
+        for grp in groups:
+            if not grp:
+                continue
+
+            def read(grp=grp):
+                blocks: List[Block] = []
+                for p in grp:
+                    blocks.extend(self._read_file(p))
+                return blocks
+
+            tasks.append(ReadTask(read))
+        return tasks
+
+
+class TextDatasource(FileBasedDatasource):
+    def __init__(self, paths, encoding="utf-8", drop_empty_lines=True):
+        super().__init__(paths)
+        self._encoding = encoding
+        self._drop_empty = drop_empty_lines
+
+    def _read_file(self, path):
+        with open(path, "r", encoding=self._encoding) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        if self._drop_empty:
+            lines = [ln for ln in lines if ln]
+        return [{"text": np.asarray(lines, dtype=object)}]
+
+
+class CSVDatasource(FileBasedDatasource):
+    def _read_file(self, path):
+        import csv
+        with open(path, newline="") as f:
+            reader = csv.DictReader(f)
+            rows = list(reader)
+        if not rows:
+            return [[]]
+        cols: Dict[str, list] = {k: [] for k in rows[0]}
+        for r in rows:
+            for k in cols:
+                cols[k].append(_coerce(r.get(k)))
+        return [{k: np.asarray(v) for k, v in cols.items()}]
+
+
+def _coerce(v):
+    if v is None:
+        return None
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return v
+
+
+class JSONDatasource(FileBasedDatasource):
+    """JSON-lines or a top-level JSON array per file."""
+
+    def _read_file(self, path):
+        import json
+        with open(path) as f:
+            head = f.read(1)
+            f.seek(0)
+            if head == "[":
+                rows = json.load(f)
+            else:
+                rows = [json.loads(ln) for ln in f if ln.strip()]
+        if rows and isinstance(rows[0], dict):
+            keys = rows[0].keys()
+            return [{k: np.asarray([r.get(k) for r in rows]) for k in keys}]
+        return [list(rows)]
+
+
+class BinaryDatasource(FileBasedDatasource):
+    def _read_file(self, path):
+        with open(path, "rb") as f:
+            data = f.read()
+        return [{"bytes": np.asarray([data], dtype=object),
+                 "path": np.asarray([path], dtype=object)}]
+
+
+class NumpyDatasource(FileBasedDatasource):
+    def _read_file(self, path):
+        arr = np.load(path)
+        return [{"data": arr}]
+
+
+class ParquetDatasource(FileBasedDatasource):
+    def _read_file(self, path):
+        try:
+            import pyarrow.parquet as pq
+        except ImportError as e:
+            raise ImportError(
+                "read_parquet requires pyarrow, which is not installed"
+            ) from e
+        table = pq.read_table(path)
+        return [{c: table[c].to_numpy(zero_copy_only=False)
+                 for c in table.column_names}]

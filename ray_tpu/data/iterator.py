@@ -1,0 +1,207 @@
+"""DataIterator: per-worker views of a dataset.
+
+Reference parity: python/ray/data/iterator.py + the output_splitter physical
+op (python/ray/data/_internal/execution/operators/output_splitter.py). The
+streaming-split coordinator is an actor that executes the plan once per
+epoch and deals blocks to n consumer queues.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Any, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+def batch_blocks(blocks: Iterator[Block], batch_size: Optional[int],
+                 batch_format: str = "numpy", drop_last: bool = False,
+                 shuffle_buffer_size: Optional[int] = None,
+                 shuffle_seed: Optional[int] = None) -> Iterator[Any]:
+    """Re-chunk a block stream into fixed-size batches."""
+    rng = np.random.RandomState(shuffle_seed)
+    carry: Optional[Block] = None
+    buffer: List[Any] = []  # rows, for local shuffle
+
+    def emit(block: Block):
+        acc = BlockAccessor.for_block(block)
+        return acc.to_batch(batch_format)
+
+    if shuffle_buffer_size:
+        # Row-level local shuffle path.
+        for block in blocks:
+            for row in BlockAccessor.for_block(block).iter_rows():
+                buffer.append(row)
+                if len(buffer) >= shuffle_buffer_size:
+                    rng.shuffle(buffer)
+                    while len(buffer) >= (batch_size or 1):
+                        chunk = buffer[:batch_size]
+                        del buffer[:batch_size]
+                        yield emit(_rows_block(chunk))
+        rng.shuffle(buffer)
+        while buffer:
+            chunk = buffer[:batch_size]
+            del buffer[:batch_size]
+            if batch_size and len(chunk) < batch_size and drop_last:
+                break
+            yield emit(_rows_block(chunk))
+        return
+
+    for block in blocks:
+        if carry is not None:
+            block = BlockAccessor.concat([carry, block])
+            carry = None
+        acc = BlockAccessor.for_block(block)
+        n = acc.num_rows()
+        if batch_size is None:
+            if n:
+                yield emit(block)
+            continue
+        start = 0
+        while n - start >= batch_size:
+            yield emit(acc.slice(start, start + batch_size))
+            start += batch_size
+        if start < n:
+            carry = acc.slice(start, n)
+    if carry is not None:
+        n = BlockAccessor.for_block(carry).num_rows()
+        if n and not (drop_last and batch_size and n < batch_size):
+            yield emit(carry)
+
+
+def _rows_block(rows: List[Any]) -> Block:
+    if rows and isinstance(rows[0], dict):
+        return {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
+    return rows
+
+
+def jax_batch_stream(batches: Iterator[Any], sharding=None, dtype=None
+                     ) -> Iterator[Any]:
+    """numpy batches -> jax.Arrays, optionally device_put on a sharding.
+
+    Shared by Dataset.iter_jax_batches and DataIterator.iter_jax_batches.
+    """
+    import jax
+    import jax.numpy as jnp
+    for batch in batches:
+        arrs = {k: (jnp.asarray(v, dtype=dtype) if dtype else jnp.asarray(v))
+                for k, v in batch.items()}
+        if sharding is not None:
+            arrs = {k: jax.device_put(v, sharding) for k, v in arrs.items()}
+        yield arrs
+
+
+class DataIterator:
+    """Iterable over a shard of a dataset; one per training worker."""
+
+    def iter_blocks(self) -> Iterator[Block]:
+        raise NotImplementedError
+
+    def iter_rows(self) -> Iterator[Any]:
+        for b in self.iter_blocks():
+            yield from BlockAccessor.for_block(b).iter_rows()
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None
+                     ) -> Iterator[Any]:
+        yield from batch_blocks(self.iter_blocks(), batch_size, batch_format,
+                                drop_last, local_shuffle_buffer_size,
+                                local_shuffle_seed)
+
+    def iter_jax_batches(self, *, batch_size: int, sharding=None,
+                         drop_last: bool = True, dtype=None,
+                         **kw) -> Iterator[Any]:
+        yield from jax_batch_stream(
+            self.iter_batches(batch_size=batch_size, drop_last=drop_last,
+                              **kw), sharding, dtype)
+
+
+class _SplitCoordinator:
+    """Actor: runs the dataset once per epoch, deals blocks to n shards.
+
+    Per-epoch queues are kept until every consumer has fetched its shard, so
+    a fast consumer advancing to epoch k+1 cannot discard a slow consumer's
+    epoch-k shard (and the block refs stay alive until delivered).
+    """
+
+    def __init__(self, ds_blob: bytes, n: int, equal: bool):
+        import cloudpickle
+        self._ds = cloudpickle.loads(ds_blob)
+        self._n = n
+        self._equal = equal
+        self._epochs: dict = {}        # epoch -> list[n] of shard queues
+        self._fetched: dict = {}       # epoch -> set of split indices served
+        self._lock = threading.Lock()
+
+    def _build_epoch(self, epoch: int):
+        if epoch in self._epochs:
+            return
+        pairs = self._ds.to_block_refs()
+        if self._equal:
+            total = sum(m.num_rows for _r, m in pairs)
+            per = total // self._n
+            from ray_tpu.data.dataset import Dataset
+            from ray_tpu.data._internal.logical import InputData
+            mat = Dataset(InputData([r for r, _ in pairs],
+                                    [m for _, m in pairs]))
+            # n+1 parts: the last holds the remainder and is dropped, so
+            # every shard has exactly `per` rows (SPMD gangs need lockstep
+            # batch counts).
+            shards = mat.split_at_indices(
+                [per * i for i in range(1, self._n + 1)])
+            queues = []
+            for shard in shards[:self._n]:
+                op = shard._op
+                queues.append(list(zip(
+                    op.block_refs, [m.num_rows for m in op.metas])))
+        else:
+            queues = [[] for _ in range(self._n)]
+            loads = [0] * self._n
+            for ref, meta in pairs:
+                i = loads.index(min(loads))
+                queues[i].append((ref, meta.num_rows))
+                loads[i] += meta.num_rows
+        self._epochs[epoch] = queues
+        self._fetched[epoch] = set()
+
+    def get_blocks(self, split_idx: int, epoch: int):
+        with self._lock:
+            self._build_epoch(epoch)
+            q = self._epochs[epoch][split_idx]
+            self._fetched[epoch].add(split_idx)
+            if len(self._fetched[epoch]) == self._n:
+                # Everyone is on this epoch; release refs for epochs at
+                # least two behind (keep one: a consumer may still be
+                # lazily fetching blocks from the previous epoch).
+                for e in [e for e in self._epochs if e < epoch - 1]:
+                    self._epochs.pop(e, None)
+                    self._fetched.pop(e, None)
+            return q
+
+
+class StreamSplitDataIterator(DataIterator):
+    def __init__(self, coordinator, idx: int):
+        self._coord = coordinator
+        self._idx = idx
+        self._epoch = 0
+
+    @staticmethod
+    def create(ds, n: int, *, equal: bool = False
+               ) -> List["StreamSplitDataIterator"]:
+        import cloudpickle
+        coord_cls = ray_tpu.remote(_SplitCoordinator)
+        coord = coord_cls.remote(cloudpickle.dumps(ds), n, equal)
+        return [StreamSplitDataIterator(coord, i) for i in range(n)]
+
+    def iter_blocks(self) -> Iterator[Block]:
+        pairs = ray_tpu.get(
+            self._coord.get_blocks.remote(self._idx, self._epoch))
+        self._epoch += 1
+        for ref, _n in pairs:
+            yield ray_tpu.get(ref)

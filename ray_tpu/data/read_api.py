@@ -1,0 +1,119 @@
+"""Dataset creation APIs (reference: python/ray/data/read_api.py)."""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, List, Optional, Union
+
+import numpy as np
+
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.datasource import (BinaryDatasource, CSVDatasource,
+                                     Datasource, JSONDatasource,
+                                     NumpyDatasource, ParquetDatasource,
+                                     RangeDatasource, ReadTask,
+                                     TextDatasource)
+from ray_tpu.data._internal.logical import InputData, Read
+
+
+def _make_dataset(op):
+    from ray_tpu.data.dataset import Dataset
+    return Dataset(op)
+
+
+def read_datasource(datasource: Datasource, *,
+                    parallelism: int = -1) -> "Dataset":
+    if parallelism <= 0:
+        parallelism = DataContext.get_current().read_op_min_num_blocks
+    tasks = datasource.get_read_tasks(parallelism)
+    return _make_dataset(Read(list(tasks), name=f"Read{datasource.name}"))
+
+
+def range(n: int, *, parallelism: int = -1) -> "Dataset":
+    """Rows {"id": 0..n-1} (reference: ray.data.range)."""
+    return read_datasource(RangeDatasource(n), parallelism=parallelism)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,),
+                 parallelism: int = -1) -> "Dataset":
+    return read_datasource(RangeDatasource(n, tensor_shape=tuple(shape)),
+                           parallelism=parallelism)
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> "Dataset":
+    import ray_tpu
+    if parallelism <= 0:
+        parallelism = min(DataContext.get_current().read_op_min_num_blocks,
+                          max(1, len(items)))
+    items = list(items)
+    refs, metas = [], []
+    n = len(items)
+    base, rem = builtins.divmod(n, parallelism) if n else (0, 0)
+    start = 0
+    for i in builtins.range(parallelism):
+        cnt = base + (1 if i < rem else 0)
+        chunk = items[start:start + cnt]
+        start += cnt
+        if not chunk and n:
+            continue
+        if chunk and isinstance(chunk[0], dict):
+            block = {k: np.asarray([r[k] for r in chunk]) for k in chunk[0]}
+        else:
+            block = list(chunk)
+        refs.append(ray_tpu.put(block))
+        metas.append(BlockAccessor.for_block(block).get_metadata())
+    if not refs:
+        block = []
+        refs = [ray_tpu.put(block)]
+        metas = [BlockAccessor.for_block(block).get_metadata()]
+    return _make_dataset(InputData(refs, metas))
+
+
+def from_numpy(arr: Union[np.ndarray, List[np.ndarray]],
+               column: str = "data") -> "Dataset":
+    import ray_tpu
+    arrs = arr if isinstance(arr, list) else [arr]
+    refs, metas = [], []
+    for a in arrs:
+        block = {column: np.asarray(a)}
+        refs.append(ray_tpu.put(block))
+        metas.append(BlockAccessor.for_block(block).get_metadata())
+    return _make_dataset(InputData(refs, metas))
+
+
+def from_pandas(dfs) -> "Dataset":
+    import ray_tpu
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    refs, metas = [], []
+    for df in dfs:
+        block = {c: df[c].to_numpy() for c in df.columns}
+        refs.append(ray_tpu.put(block))
+        metas.append(BlockAccessor.for_block(block).get_metadata())
+    return _make_dataset(InputData(refs, metas))
+
+
+def read_text(paths, *, parallelism: int = -1, **kw) -> "Dataset":
+    return read_datasource(TextDatasource(paths, **kw),
+                           parallelism=parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1) -> "Dataset":
+    return read_datasource(CSVDatasource(paths), parallelism=parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1) -> "Dataset":
+    return read_datasource(JSONDatasource(paths), parallelism=parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> "Dataset":
+    return read_datasource(NumpyDatasource(paths), parallelism=parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = -1) -> "Dataset":
+    return read_datasource(BinaryDatasource(paths), parallelism=parallelism)
+
+
+def read_parquet(paths, *, parallelism: int = -1) -> "Dataset":
+    return read_datasource(ParquetDatasource(paths), parallelism=parallelism)
