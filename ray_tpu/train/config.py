@@ -75,3 +75,5 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 0
+    # Tune stop criteria: {"training_iteration": N} / {metric: threshold}.
+    stop: Optional[Dict[str, float]] = None

@@ -1,0 +1,32 @@
+"""ray_tpu.tune: distributed hyperparameter search.
+
+Reference parity: python/ray/tune (Tuner tune/tuner.py:54, TuneController
+tune/execution/tune_controller.py:72, Trial tune/experiment/trial.py:247,
+ASHA tune/schedulers/async_hyperband.py, PBT tune/schedulers/pbt.py).
+Trials run as ray_tpu actors; the controller event-loop drives them with
+`wait` and applies scheduler decisions between reports.
+"""
+
+from ray_tpu.tune.search import (BasicVariantGenerator, Categorical, Domain,
+                                 Float, Integer, choice, grid_search,
+                                 lograndint, loguniform, qrandint, quniform,
+                                 randint, randn, sample_from, uniform)
+from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+                                     MedianStoppingRule,
+                                     PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.trainable import Trainable, report, get_checkpoint
+from ray_tpu.tune.trial import Trial
+from ray_tpu.tune.tuner import (ResultGrid, Result, TuneConfig, Tuner,
+                                run, with_parameters, with_resources)
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "Result", "run", "Trainable",
+    "Trial", "report", "get_checkpoint", "with_parameters", "with_resources",
+    "grid_search", "uniform", "quniform", "loguniform", "choice", "randint",
+    "qrandint", "lograndint", "randn", "sample_from",
+    "Domain", "Float", "Integer", "Categorical", "BasicVariantGenerator",
+    "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
+    "ASHAScheduler", "MedianStoppingRule", "PopulationBasedTraining",
+]

@@ -1,0 +1,194 @@
+"""Trial schedulers: decide continue/stop/pause on every reported result.
+
+Reference parity: python/ray/tune/schedulers/ (trial_scheduler.py:135
+FIFOScheduler, async_hyperband.py ASHA, median_stopping_rule.py, pbt.py).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional
+
+from ray_tpu.tune.trial import Trial
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+PAUSE = "PAUSE"
+
+
+class TrialScheduler:
+    # If set, the controller keeps trials within this many iterations of the
+    # slowest live trial (population schedulers are meaningless when one
+    # trial sprints to completion before the others start).
+    pace_interval: Optional[int] = None
+
+    def set_metric(self, metric: str, mode: str):
+        self._metric = metric
+        self._mode = mode
+        self._sign = 1.0 if mode == "max" else -1.0
+
+    def score(self, result: dict) -> float:
+        return self._sign * result[self._metric]
+
+    def on_trial_result(self, trial: Trial, result: dict,
+                        all_trials: List[Trial]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial: Trial, all_trials: List[Trial]):
+        pass
+
+    def choose_exploit(self, trial: Trial, all_trials: List[Trial]):
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: asynchronous successive halving.
+
+    A trial reaching rung r (iteration = grace_period * rf^r) continues only
+    if its metric is in the top 1/reduction_factor of completed rung entries.
+    """
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: int = 4,
+                 max_t: int = 100, brackets: int = 1):
+        if brackets != 1:
+            raise NotImplementedError(
+                "multi-bracket ASHA is not implemented; use brackets=1")
+        self._time_attr = time_attr
+        self._grace = grace_period
+        self._rf = reduction_factor
+        self._max_t = max_t
+        self._levels = []
+        t = grace_period
+        while t < max_t:
+            self._levels.append(t)
+            t *= reduction_factor
+        # rung level -> list of scores recorded at that rung
+        self._rungs: Dict[int, List[float]] = {}
+
+    def on_trial_result(self, trial: Trial, result: dict,
+                        all_trials: List[Trial]) -> str:
+        if self._metric not in result:
+            return CONTINUE  # warmup steps may not report the metric yet
+        t = result.get(self._time_attr, trial.iteration)
+        if t >= self._max_t:
+            return STOP
+        s = self.score(result)
+        # Cross every rung level passed since the last report (time_attr may
+        # advance in jumps, e.g. timesteps_total — exact equality would let
+        # trials skip rungs and degrade ASHA to FIFO).
+        decision = CONTINUE
+        while trial.rung < len(self._levels) and t >= self._levels[trial.rung]:
+            level = self._levels[trial.rung]
+            trial.rung += 1
+            rung = self._rungs.setdefault(level, [])
+            rung.append(s)
+            k = max(1, len(rung) // self._rf)
+            top_k = sorted(rung, reverse=True)[:k]
+            if s < top_k[-1]:
+                decision = STOP
+        return decision
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average falls below the median of others.
+
+    Reference: schedulers/median_stopping_rule.py.
+    """
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self._time_attr = time_attr
+        self._grace = grace_period
+        self._min_samples = min_samples_required
+
+    def on_trial_result(self, trial: Trial, result: dict,
+                        all_trials: List[Trial]) -> str:
+        if self._metric not in result:
+            return CONTINUE
+        t = result.get(self._time_attr, trial.iteration)
+        if t < self._grace:
+            return CONTINUE
+        others = []
+        for other in all_trials:
+            if other.trial_id == trial.trial_id:
+                continue
+            hist = [self.score(r) for r in other.results
+                    if self._metric in r]
+            if hist:
+                others.append(sum(hist) / len(hist))
+        if len(others) < self._min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        mine = [self.score(r) for r in trial.results if self._metric in r]
+        if not mine:
+            return CONTINUE
+        avg = sum(mine) / len(mine)
+        return STOP if avg < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: bottom-quantile trials clone a top performer's checkpoint and
+    perturb its hyperparameters (reference: schedulers/pbt.py).
+    """
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 synch: bool = True,
+                 seed: Optional[int] = None):
+        self._time_attr = time_attr
+        self._interval = perturbation_interval
+        if synch:
+            self.pace_interval = perturbation_interval
+        self._mutations = hyperparam_mutations or {}
+        self._quantile = quantile_fraction
+        self._resample_p = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+
+    def on_trial_result(self, trial: Trial, result: dict,
+                        all_trials: List[Trial]) -> str:
+        if self._metric not in result:
+            return CONTINUE
+        t = result.get(self._time_attr, trial.iteration)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self._interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        scored = [(self.score(tr.last_result), tr) for tr in all_trials
+                  if tr.last_result and self._metric in tr.last_result]
+        if len(scored) < 2:
+            return CONTINUE
+        scored.sort(key=lambda x: x[0])
+        n_q = max(1, int(len(scored) * self._quantile))
+        bottom = [tr for _s, tr in scored[:n_q]]
+        top = [tr for _s, tr in scored[-n_q:]]
+        if trial in bottom and trial not in top:
+            trial._exploit_target = self._rng.choice(top)  # type: ignore
+            return "EXPLOIT"
+        return CONTINUE
+
+    def explore(self, config: dict) -> dict:
+        out = dict(config)
+        for key, spec in self._mutations.items():
+            if self._rng.random() < self._resample_p or key not in out:
+                if callable(spec):
+                    out[key] = spec()
+                elif isinstance(spec, list):
+                    out[key] = self._rng.choice(spec)
+                else:
+                    out[key] = spec.sample(self._rng)
+            else:
+                factor = self._rng.choice([0.8, 1.2])
+                if isinstance(out[key], (int, float)):
+                    out[key] = type(out[key])(out[key] * factor)
+        return out

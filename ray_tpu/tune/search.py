@@ -1,0 +1,178 @@
+"""Search spaces + variant generation.
+
+Reference parity: python/ray/tune/search/ (sample.py domains,
+basic_variant.py BasicVariantGenerator, search_algorithm.py:10 ABC).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class Domain:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lo: float, hi: float, log: bool = False,
+                 q: Optional[float] = None):
+        self.lo, self.hi, self.log, self.q = lo, hi, log, q
+
+    def sample(self, rng):
+        if self.log:
+            v = float(np.exp(rng.uniform(np.log(self.lo), np.log(self.hi))))
+        else:
+            v = rng.uniform(self.lo, self.hi)
+        if self.q:
+            v = round(v / self.q) * self.q
+        return v
+
+
+class Integer(Domain):
+    def __init__(self, lo: int, hi: int, log: bool = False,
+                 q: Optional[int] = None):
+        self.lo, self.hi, self.log, self.q = lo, hi, log, q
+
+    def sample(self, rng):
+        if self.log:
+            v = int(np.exp(rng.uniform(np.log(self.lo),
+                                       np.log(max(self.hi - 1, self.lo + 1)))))
+        else:
+            v = rng.randint(self.lo, self.hi - 1)
+        if self.q:
+            v = int(round(v / self.q) * self.q)
+        return max(self.lo, min(v, self.hi - 1))
+
+
+class Categorical(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Normal(Domain):
+    def __init__(self, mean: float, sd: float):
+        self.mean, self.sd = mean, sd
+
+    def sample(self, rng):
+        return rng.gauss(self.mean, self.sd)
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None) if self.fn.__code__.co_argcount else self.fn()
+
+
+def uniform(lo, hi) -> Float:
+    return Float(lo, hi)
+
+
+def quniform(lo, hi, q) -> Float:
+    return Float(lo, hi, q=q)
+
+
+def loguniform(lo, hi) -> Float:
+    return Float(lo, hi, log=True)
+
+
+def randint(lo, hi) -> Integer:
+    return Integer(lo, hi)
+
+
+def qrandint(lo, hi, q) -> Integer:
+    return Integer(lo, hi, q=q)
+
+
+def lograndint(lo, hi) -> Integer:
+    return Integer(lo, hi, log=True)
+
+
+def randn(mean=0.0, sd=1.0) -> Normal:
+    return Normal(mean, sd)
+
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn) -> Function:
+    return Function(fn)
+
+
+def grid_search(values) -> Dict[str, list]:
+    return {"grid_search": list(values)}
+
+
+def _split_grid(space: dict, prefix=()):
+    """Yield (path, values) for every grid_search leaf."""
+    for k, v in space.items():
+        if isinstance(v, dict) and set(v.keys()) == {"grid_search"}:
+            yield prefix + (k,), v["grid_search"]
+        elif isinstance(v, dict):
+            yield from _split_grid(v, prefix + (k,))
+
+
+def _set_path(cfg: dict, path, value):
+    d = cfg
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def _resolve(space, rng, out):
+    for k, v in space.items():
+        if isinstance(v, dict) and set(v.keys()) == {"grid_search"}:
+            continue  # filled by grid expansion
+        elif isinstance(v, dict):
+            out[k] = {}
+            _resolve(v, rng, out[k])
+        elif isinstance(v, Domain):
+            out[k] = v.sample(rng)
+        else:
+            out[k] = v
+    return out
+
+
+class SearchAlgorithm:
+    """ABC (reference: search/search_algorithm.py:10)."""
+
+    def next_configs(self, n: int) -> List[dict]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict]):
+        pass
+
+
+class BasicVariantGenerator(SearchAlgorithm):
+    """Grid expansion × random sampling (reference: basic_variant.py)."""
+
+    def __init__(self, space: dict, num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self._space = space
+        self._num_samples = num_samples
+        self._rng = random.Random(seed)
+
+    def variants(self) -> List[dict]:
+        grids = list(_split_grid(self._space))
+        out = []
+        for _ in range(self._num_samples):
+            if grids:
+                paths, values = zip(*grids)
+                for combo in itertools.product(*values):
+                    cfg = _resolve(self._space, self._rng, {})
+                    for path, val in zip(paths, combo):
+                        _set_path(cfg, path, val)
+                    out.append(cfg)
+            else:
+                out.append(_resolve(self._space, self._rng, {}))
+        return out

@@ -1,0 +1,109 @@
+"""Trainables: what a trial runs.
+
+Reference parity: python/ray/tune/trainable/trainable.py (class API) and
+function_trainable.py (fn API with tune.report). Both are hosted inside one
+trial actor (_TrialActor in tuner.py); class trainables step synchronously,
+function trainables run in a thread and hand results over a depth-1 queue so
+the function blocks until the controller has consumed the previous report
+(step-wise lockstep, which schedulers need).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+
+class Trainable:
+    """Subclass API: setup/step/save_checkpoint/load_checkpoint."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.config = dict(config)
+        self.training_iteration = 0
+        self.setup(self.config)
+
+    def setup(self, config: Dict[str, Any]):
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self) -> Any:
+        return None
+
+    def load_checkpoint(self, checkpoint: Any):
+        pass
+
+    def reset_config(self, new_config: Dict[str, Any]) -> bool:
+        """Return True if the trainable supports in-place config reset
+        (lets PBT reuse the actor instead of restarting it)."""
+        return False
+
+    def cleanup(self):
+        pass
+
+
+class _Session:
+    """Per-actor state backing tune.report()/tune.get_checkpoint()."""
+
+    def __init__(self, checkpoint: Any = None):
+        self.queue: queue.Queue = queue.Queue(maxsize=1)
+        self.checkpoint = checkpoint
+        self.last_checkpoint = checkpoint
+
+
+_session: Optional[_Session] = None
+
+
+def _set_session(s: Optional[_Session]):
+    global _session
+    _session = s
+
+
+def report(metrics: Dict[str, Any], *, checkpoint: Any = None):
+    """Report metrics (and optionally a checkpoint) from a fn trainable."""
+    if _session is None:
+        raise RuntimeError("tune.report() called outside a Tune trial")
+    if checkpoint is not None:
+        _session.last_checkpoint = checkpoint
+    _session.queue.put(("result", dict(metrics), checkpoint))
+
+
+def get_checkpoint() -> Any:
+    """The checkpoint this trial was restored from (PBT exploit / resume)."""
+    if _session is None:
+        raise RuntimeError("tune.get_checkpoint() outside a Tune trial")
+    return _session.checkpoint
+
+
+class FunctionRunner:
+    """Runs a user function in a thread; yields step-wise results."""
+
+    def __init__(self, fn: Callable, config: Dict[str, Any],
+                 checkpoint: Any = None):
+        self._session = _Session(checkpoint)
+        self._fn = fn
+        self._config = dict(config)
+        self._thread: Optional[threading.Thread] = None
+
+    def _target(self):
+        _set_session(self._session)
+        try:
+            self._fn(self._config)
+            self._session.queue.put(("done", None, None))
+        except BaseException:
+            self._session.queue.put(("error", traceback.format_exc(), None))
+
+    def next_result(self, timeout: Optional[float] = None):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._target, daemon=True)
+            self._thread.start()
+        try:
+            return self._session.queue.get(timeout=timeout)
+        except queue.Empty:
+            return ("pending", None, None)
+
+    def save(self) -> Any:
+        return self._session.last_checkpoint

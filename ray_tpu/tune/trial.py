@@ -1,0 +1,39 @@
+"""Trial bookkeeping (reference: python/ray/tune/experiment/trial.py:247)."""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclass
+class Trial:
+    config: Dict[str, Any]
+    trial_id: str = field(default_factory=lambda: uuid.uuid4().hex[:8])
+    status: str = PENDING
+    results: List[dict] = field(default_factory=list)
+    error: Optional[str] = None
+    iteration: int = 0
+    checkpoint: Any = None           # latest in-memory checkpoint blob
+    checkpoint_path: Optional[str] = None
+    actor: Any = None                # live actor handle while RUNNING
+    pending_ref: Any = None          # in-flight next_result ref
+    rung: int = 0                    # scheduler bookkeeping (ASHA)
+
+    @property
+    def last_result(self) -> Optional[dict]:
+        return self.results[-1] if self.results else None
+
+    def metric_history(self, metric: str) -> List[float]:
+        return [r[metric] for r in self.results if metric in r]
+
+    def __repr__(self):
+        return (f"Trial({self.trial_id}, {self.status}, it={self.iteration}, "
+                f"cfg={self.config})")

@@ -1,0 +1,379 @@
+"""Tuner + the trial-driving controller loop.
+
+Reference parity: python/ray/tune/tuner.py:54 (Tuner),
+tune/execution/tune_controller.py:72 (event loop over trial actors via the
+actor manager). Trials are plain ray_tpu actors; the controller multiplexes
+their `next_result` futures with `ray_tpu.wait` and applies scheduler
+decisions (CONTINUE/STOP/EXPLOIT) between reports.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune.schedulers import (CONTINUE, FIFOScheduler, STOP,
+                                     PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.search import BasicVariantGenerator
+from ray_tpu.tune.trainable import FunctionRunner, Trainable
+from ray_tpu.tune import trial as trial_mod
+from ray_tpu.tune.trial import (ERROR, PENDING, RUNNING, TERMINATED, Trial)
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+
+
+class _TrialActor:
+    """Hosts one trainable (class or function) inside an actor."""
+
+    def __init__(self, trainable_blob: bytes, config: dict,
+                 checkpoint: Any = None, start_iteration: int = 0):
+        import cloudpickle
+        trainable = cloudpickle.loads(trainable_blob)
+        self._is_class = isinstance(trainable, type) and issubclass(
+            trainable, Trainable)
+        # Restart paths (PBT exploit) resume the iteration counter so stop
+        # criteria and perturbation schedules don't rewind.
+        self._iteration = start_iteration
+        if self._is_class:
+            self._inst = trainable(config)
+            if checkpoint is not None:
+                self._inst.load_checkpoint(checkpoint)
+        else:
+            self._runner = FunctionRunner(trainable, config, checkpoint)
+
+    def next_result(self):
+        """-> (kind, payload, checkpoint) with kind in
+        result|done|error|pending."""
+        if self._is_class:
+            try:
+                metrics = self._inst.step()
+                self._iteration += 1
+                self._inst.training_iteration = self._iteration
+                metrics.setdefault("training_iteration", self._iteration)
+                return ("result", metrics, None)
+            except Exception:
+                import traceback
+                return ("error", traceback.format_exc(), None)
+        kind, payload, ckpt = self._runner.next_result(timeout=3600.0)
+        if kind == "result":
+            self._iteration += 1
+            payload.setdefault("training_iteration", self._iteration)
+        return (kind, payload, ckpt)
+
+    def save(self):
+        if self._is_class:
+            return self._inst.save_checkpoint()
+        return self._runner.save()
+
+    def reset(self, new_config: dict, checkpoint: Any) -> bool:
+        if self._is_class and self._inst.reset_config(new_config):
+            self._inst.config = dict(new_config)
+            if checkpoint is not None:
+                self._inst.load_checkpoint(checkpoint)
+            return True
+        return False
+
+    def stop(self):
+        if self._is_class:
+            self._inst.cleanup()
+        return True
+
+
+@dataclass
+class Result:
+    metrics: Optional[dict]
+    config: dict
+    error: Optional[str] = None
+    checkpoint: Any = None
+    metrics_history: List[dict] = field(default_factory=list)
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str],
+                 mode: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._trials)
+
+    def __getitem__(self, i) -> Result:
+        t = self._trials[i]
+        return Result(metrics=t.last_result, config=t.config, error=t.error,
+                      checkpoint=t.checkpoint, metrics_history=t.results)
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error for t in self._trials if t.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (none set in TuneConfig)")
+        sign = 1.0 if mode == "max" else -1.0
+        best_t, best_s, best_r = None, None, None
+        for t in self._trials:
+            for r in t.results:
+                if metric not in r:
+                    continue
+                s = sign * r[metric]
+                if best_s is None or s > best_s:
+                    best_t, best_s, best_r = t, s, r
+        if best_t is None:
+            raise RuntimeError(f"no trial reported metric {metric!r}")
+        # Return the best-scoring report itself, not the trial's last one —
+        # a trial that peaked then collapsed must not surface its collapsed
+        # metrics as "best".
+        return Result(metrics=best_r, config=best_t.config,
+                      error=best_t.error, checkpoint=best_t.checkpoint,
+                      metrics_history=best_t.results)
+
+    def get_dataframe(self):
+        import pandas as pd
+        rows = []
+        for t in self._trials:
+            row = dict(t.last_result or {})
+            row.update({f"config/{k}": v for k, v in t.config.items()})
+            row["trial_id"] = t.trial_id
+            row["status"] = t.status
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    def __init__(self, trainable: Union[Callable, type], *,
+                 param_space: Optional[dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        self._resources = getattr(trainable, "_tune_resources",
+                                  {"num_cpus": 1})
+
+    def fit(self) -> ResultGrid:
+        import cloudpickle
+        tc = self._tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        if tc.metric:
+            scheduler.set_metric(tc.metric, tc.mode)
+        elif not isinstance(scheduler, FIFOScheduler):
+            raise ValueError("schedulers other than FIFO require a metric")
+        variants = BasicVariantGenerator(
+            self._param_space, tc.num_samples, tc.seed).variants()
+        trials = [Trial(config=cfg) for cfg in variants]
+        blob = cloudpickle.dumps(self._trainable)
+        stop = self._run_config.stop or {}
+
+        try:
+            cpus = ray_tpu.cluster_resources().get("CPU", 2)
+        except Exception:
+            cpus = 2
+        trial_cpus = float(self._resources.get("num_cpus", 1)) or 1
+        max_conc = tc.max_concurrent_trials or max(1, int(cpus // trial_cpus))
+        actor_cls = ray_tpu.remote(**self._resources)(_TrialActor)
+
+        def start(t: Trial, checkpoint=None, config=None,
+                  start_iteration: int = 0):
+            t.actor = actor_cls.remote(blob, config or t.config, checkpoint,
+                                       start_iteration)
+            t.status = RUNNING
+            t.pending_ref = t.actor.next_result.remote()
+
+        def terminate(t: Trial, status: str):
+            t.status = status
+            if t.actor is not None:
+                try:
+                    # Run the Trainable.cleanup() hook before killing the
+                    # process (kill alone would leak user resources).
+                    ray_tpu.get(t.actor.stop.remote(), timeout=5)
+                except Exception:
+                    pass
+                try:
+                    ray_tpu.kill(t.actor)
+                except Exception:
+                    pass
+                t.actor = None
+            t.pending_ref = None
+
+        def should_stop(t: Trial, metrics: dict) -> bool:
+            for k, v in stop.items():
+                if k == "training_iteration":
+                    if metrics.get(k, t.iteration) >= v:
+                        return True
+                elif k in metrics:
+                    cmp = metrics[k]
+                    if (tc.mode == "max" and cmp >= v) or \
+                       (tc.mode == "min" and cmp <= v):
+                        return True
+            return False
+
+        pace = getattr(scheduler, "pace_interval", None)
+
+        def live_min_iteration():
+            live = [t for t in trials if t.status in (PENDING, RUNNING)]
+            return min((t.iteration for t in live), default=0)
+
+        def resume_if_caught_up():
+            """Paced trials (pending_ref=None) resume once peers catch up."""
+            if pace is None:
+                return
+            floor = live_min_iteration()
+            for t in trials:
+                if (t.status == RUNNING and t.pending_ref is None
+                        and t.actor is not None
+                        and t.iteration - floor < pace):
+                    t.pending_ref = t.actor.next_result.remote()
+
+        def submit_next(t: Trial):
+            if pace is not None and t.iteration - live_min_iteration() >= pace:
+                t.pending_ref = None  # paced: resumed by resume_if_caught_up
+            else:
+                t.pending_ref = t.actor.next_result.remote()
+
+        while True:
+            running = [t for t in trials if t.status == RUNNING]
+            pending = [t for t in trials if t.status == PENDING]
+            if not running and not pending:
+                break
+            while pending and len(running) < max_conc:
+                t = pending.pop(0)
+                start(t)
+                running.append(t)
+            resume_if_caught_up()
+            ref_to_trial = {t.pending_ref: t for t in running
+                            if t.pending_ref is not None}
+            if not ref_to_trial:
+                paced = [t for t in running if t.pending_ref is None
+                         and t.actor is not None]
+                if paced and not pending:
+                    time.sleep(0.05)
+                    continue
+                if paced:
+                    # All in-flight slots are paced trials but pending trials
+                    # can't start (resources held): abandon pacing rather
+                    # than deadlock.
+                    for t in paced:
+                        t.pending_ref = t.actor.next_result.remote()
+                    continue
+                time.sleep(0.05)
+                continue
+            done, _ = ray_tpu.wait(list(ref_to_trial.keys()),
+                                   num_returns=1, timeout=5.0)
+            for ref in done:
+                t = ref_to_trial[ref]
+                try:
+                    kind, payload, ckpt = ray_tpu.get(ref)
+                except Exception as e:
+                    t.error = str(e)
+                    terminate(t, ERROR)
+                    continue
+                if kind == "done":
+                    terminate(t, TERMINATED)
+                elif kind == "error":
+                    t.error = payload
+                    terminate(t, ERROR)
+                elif kind == "pending":
+                    submit_next(t)
+                else:  # result
+                    t.iteration = payload.get("training_iteration",
+                                              t.iteration + 1)
+                    t.results.append(payload)
+                    if ckpt is not None:
+                        t.checkpoint = ckpt
+                    if should_stop(t, payload):
+                        terminate(t, TERMINATED)
+                        continue
+                    decision = scheduler.on_trial_result(t, payload, trials)
+                    if decision == STOP:
+                        terminate(t, TERMINATED)
+                    elif decision == "EXPLOIT":
+                        self._exploit(t, scheduler, start, terminate)
+                    else:
+                        submit_next(t)
+        return ResultGrid(trials, tc.metric, tc.mode)
+
+    def _exploit(self, t: Trial, scheduler, start, terminate):
+        """PBT: clone a top trial's checkpoint + perturbed config."""
+        target: Trial = getattr(t, "_exploit_target", None)
+        if target is None or target.actor is None:
+            t.pending_ref = t.actor.next_result.remote()
+            return
+        assert isinstance(scheduler, PopulationBasedTraining)
+        try:
+            ckpt = ray_tpu.get(target.actor.save.remote(), timeout=30)
+        except Exception:
+            t.pending_ref = t.actor.next_result.remote()
+            return
+        new_config = scheduler.explore(target.config)
+        # Try in-place reset first; else restart the actor.
+        reset_ok = False
+        try:
+            reset_ok = ray_tpu.get(
+                t.actor.reset.remote(new_config, ckpt), timeout=30)
+        except Exception:
+            pass
+        t.config = new_config
+        t.checkpoint = ckpt
+        if reset_ok:
+            t.pending_ref = t.actor.next_result.remote()
+        else:
+            terminate(t, RUNNING)  # kill actor, keep status RUNNING
+            start(t, checkpoint=ckpt, config=new_config,
+                  start_iteration=t.iteration)
+
+
+def with_parameters(trainable, **params):
+    """Bind large constant objects to a trainable (reference:
+    tune.with_parameters)."""
+    if isinstance(trainable, type):
+        class _Bound(trainable):  # type: ignore[misc]
+            def setup(self, config):
+                super().setup({**config, **params})
+        _Bound.__name__ = trainable.__name__
+        return _Bound
+
+    def fn(config):
+        return trainable(config, **params)
+    fn._tune_resources = getattr(trainable, "_tune_resources",
+                                 {"num_cpus": 1})
+    return fn
+
+
+def with_resources(trainable, resources: Dict[str, float]):
+    """Attach per-trial resource requests ({"num_cpus": 2, "num_tpus": 1})."""
+    trainable._tune_resources = resources
+    return trainable
+
+
+def run(trainable, *, config: Optional[dict] = None, stop=None,
+        metric=None, mode="max", num_samples: int = 1, scheduler=None,
+        **_ignored) -> ResultGrid:
+    """Legacy tune.run() façade over Tuner."""
+    return Tuner(
+        trainable, param_space=config,
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples, scheduler=scheduler),
+        run_config=RunConfig(stop=stop),
+    ).fit()

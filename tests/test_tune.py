@@ -1,0 +1,173 @@
+"""ray_tpu.tune tests (reference strategy: python/ray/tune/tests/)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture(scope="module")
+def ray_mod():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_grid_and_random_search_space():
+    gen = tune.BasicVariantGenerator(
+        {"lr": tune.grid_search([0.1, 0.01]),
+         "wd": tune.uniform(0.0, 1.0),
+         "layers": tune.randint(1, 4)},
+        num_samples=3, seed=0)
+    variants = gen.variants()
+    assert len(variants) == 6  # 2 grid x 3 samples
+    assert {v["lr"] for v in variants} == {0.1, 0.01}
+    assert all(0.0 <= v["wd"] <= 1.0 for v in variants)
+    assert all(1 <= v["layers"] < 4 for v in variants)
+
+
+def test_function_trainable_basic(ray_mod):
+    def train_fn(config):
+        for i in range(3):
+            tune.report({"loss": config["x"] * (3 - i)})
+
+    results = tune.Tuner(
+        train_fn,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+    ).fit()
+    assert len(results) == 2
+    best = results.get_best_result()
+    assert best.config["x"] == 1.0
+    assert best.metrics["loss"] == 1.0
+    assert len(best.metrics_history) == 3
+
+
+def test_class_trainable_and_stop_criteria(ray_mod):
+    class MyTrainable(tune.Trainable):
+        def setup(self, config):
+            self.acc = 0.0
+
+        def step(self):
+            self.acc += self.config["rate"]
+            return {"acc": self.acc}
+
+        def save_checkpoint(self):
+            return {"acc": self.acc}
+
+        def load_checkpoint(self, ckpt):
+            self.acc = ckpt["acc"]
+
+    from ray_tpu.train.config import RunConfig
+    results = tune.Tuner(
+        MyTrainable,
+        param_space={"rate": tune.grid_search([0.5, 1.0])},
+        tune_config=tune.TuneConfig(metric="acc", mode="max"),
+        run_config=RunConfig(stop={"training_iteration": 4}),
+    ).fit()
+    best = results.get_best_result()
+    assert best.config["rate"] == 1.0
+    assert best.metrics["acc"] == 4.0
+
+
+def test_asha_stops_bad_trials(ray_mod):
+    def train_fn(config):
+        for i in range(16):
+            tune.report({"score": config["q"] * (i + 1)})
+
+    sched = tune.ASHAScheduler(grace_period=2, reduction_factor=2, max_t=16)
+    results = tune.Tuner(
+        train_fn,
+        param_space={"q": tune.grid_search([0.1, 0.2, 0.5, 1.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched),
+    ).fit()
+    best = results.get_best_result()
+    assert best.config["q"] == 1.0
+    # at least one weak trial was cut before finishing
+    iters = [len(results[i].metrics_history) for i in range(len(results))]
+    assert min(iters) < 16
+
+
+def test_metric_threshold_stop(ray_mod):
+    def train_fn(config):
+        for i in range(100):
+            tune.report({"reward": float(i)})
+
+    results = tune.run(train_fn, config={}, stop={"reward": 5.0},
+                       metric="reward", mode="max")
+    assert results[0].metrics["reward"] == 5.0
+
+
+def test_trial_error_is_captured(ray_mod):
+    def train_fn(config):
+        tune.report({"ok": 1})
+        raise ValueError("boom")
+
+    results = tune.Tuner(
+        train_fn, param_space={},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+    ).fit()
+    assert len(results.errors) == 1
+    assert "boom" in results.errors[0]
+
+
+def test_checkpoint_report_and_best(ray_mod):
+    def train_fn(config):
+        for i in range(3):
+            tune.report({"m": i}, checkpoint={"step": i})
+
+    results = tune.Tuner(
+        train_fn, param_space={},
+        tune_config=tune.TuneConfig(metric="m", mode="max"),
+    ).fit()
+    assert results[0].checkpoint == {"step": 2}
+
+
+def test_pbt_exploits(ray_mod):
+    class T(tune.Trainable):
+        def setup(self, config):
+            self.w = 0.0
+
+        def step(self):
+            self.w += self.config["lr"]
+            return {"score": self.w}
+
+        def save_checkpoint(self):
+            return {"w": self.w}
+
+        def load_checkpoint(self, ckpt):
+            self.w = ckpt["w"]
+
+        def reset_config(self, cfg):
+            return True
+
+    from ray_tpu.train.config import RunConfig
+    sched = tune.PopulationBasedTraining(
+        perturbation_interval=2,
+        hyperparam_mutations={"lr": [0.01, 1.0]},
+        quantile_fraction=0.5, seed=0)
+    results = tune.Tuner(
+        T, param_space={"lr": tune.grid_search([0.01, 1.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched),
+        run_config=RunConfig(stop={"training_iteration": 8}),
+    ).fit()
+    # the weak trial should have been pulled up by exploiting the strong one
+    finals = sorted(r["score"] for r in
+                    [results[i].metrics for i in range(len(results))])
+    assert finals[0] > 0.08 * 8  # far above pure lr=0.01 trajectory
+
+
+def test_with_parameters_and_resources(ray_mod):
+    big = list(range(1000))
+
+    def train_fn(config, data=None):
+        tune.report({"n": len(data)})
+
+    bound = tune.with_parameters(train_fn, data=big)
+    bound = tune.with_resources(bound, {"num_cpus": 1})
+    results = tune.Tuner(
+        bound, param_space={},
+        tune_config=tune.TuneConfig(metric="n", mode="max")).fit()
+    assert results[0].metrics["n"] == 1000
