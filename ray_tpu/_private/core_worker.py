@@ -763,17 +763,30 @@ class CoreWorker:
         return task_args, kw_names
 
     async def submit_task(self, function_id: str, args: tuple, kwargs: dict,
+                          **opts) -> List[ObjectRef]:
+        return self.submit_task_local(function_id, args, kwargs, **opts)
+
+    def submit_task_local(self, function_id: str, args: tuple, kwargs: dict,
                           *, name: str = "", num_returns: int = 1,
                           resources: Optional[Dict[str, float]] = None,
                           scheduling=None, max_retries: int = -1,
                           retry_exceptions: bool = False,
-                          is_generator: bool = False) -> List[ObjectRef]:
+                          is_generator: bool = False,
+                          export: Optional[Any] = None) -> List[ObjectRef]:
+        """Synchronous submission: allocates ids/refs immediately and defers
+        arg serialization + cluster dispatch to a background task.
+
+        MUST be called on the core loop thread. This mirrors the reference
+        CoreWorker::SubmitTask being non-blocking from the caller's
+        perspective, and makes `.remote()` legal inside async actors.
+        `export`: optional (func, function_id) exported to the GCS function
+        table before dispatch (ordering guarantee for first-time functions).
+        """
         from ray_tpu._private.common import SchedulingStrategy
         task_id = self._next_task_id()
-        task_args, kw_names = await self._build_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, name=name,
-            function_id=function_id, args=task_args,
+            function_id=function_id, args=[],
             num_returns=num_returns,
             resources=resources or {"CPU": 1.0},
             scheduling=scheduling or SchedulingStrategy(),
@@ -783,7 +796,6 @@ class CoreWorker:
             owner_address=self.address, owner_worker_id=self.worker_id,
             is_generator=is_generator,
         )
-        spec.runtime_env = {"kwarg_names": kw_names} if kw_names else None
         refs = []
         returns = []
         for i in range(num_returns):
@@ -794,10 +806,54 @@ class CoreWorker:
             refs.append(ObjectRef(oid, self.address))
         self.pending_tasks[task_id] = PendingTask(
             spec=spec, retries_left=spec.max_retries, returns=returns,
-            arg_refs=self._pin_arg_refs(spec))
+            arg_refs=[])
         self._record_task_event(spec, "PENDING")
-        await self._submit_to_cluster(spec)
+        asyncio.ensure_future(
+            self._finish_task_submission(spec, args, kwargs, export))
         return refs
+
+    async def _await_export(self, export, function_id: str):
+        """Serialize deferred function exports: the first submission for a
+        function id starts the export; later submissions (which skipped the
+        export optimistically) await the same future so no worker can be
+        asked to load a function the GCS doesn't have yet."""
+        if not hasattr(self, "_pending_exports"):
+            self._pending_exports = {}
+        if export is not None:
+            func, fid = export
+            fut = self._pending_exports.get(fid)
+            if fut is None:
+                fut = asyncio.ensure_future(self.export_function(func, fid))
+                self._pending_exports[fid] = fut
+            try:
+                await fut
+            except Exception:
+                # Unpoison: drop the failed future and the optimistic
+                # "already exported" flag so the next submission retries
+                # the export instead of failing forever.
+                self._pending_exports.pop(fid, None)
+                from ray_tpu._private import worker_api
+                worker_api._state.exported_functions.pop(fid, None)
+                raise
+            self._pending_exports.pop(fid, None)  # GCS has it now
+        elif function_id in self._pending_exports:
+            await self._pending_exports[function_id]
+
+    async def _finish_task_submission(self, spec: TaskSpec, args, kwargs,
+                                      export=None):
+        try:
+            await self._await_export(export, spec.function_id)
+            task_args, kw_names = await self._build_args(args, kwargs)
+        except Exception as e:
+            self._complete_task_error(spec, e, retry=False)
+            return
+        if spec.task_id not in self.pending_tasks:
+            return  # cancelled before dispatch
+        spec.args = task_args
+        if kw_names:
+            spec.runtime_env = {"kwarg_names": kw_names}
+        self.pending_tasks[spec.task_id].arg_refs = self._pin_arg_refs(spec)
+        await self._submit_to_cluster(spec)
 
     def _pin_arg_refs(self, spec: TaskSpec) -> List[ObjectRef]:
         """Task args count as references until the task completes
@@ -1043,19 +1099,33 @@ class CoreWorker:
     # ==================================================================
 
     async def create_actor(self, class_function_id: str, args: tuple,
+                           kwargs: dict, **opts) -> ActorID:
+        actor_id, done = self.create_actor_local(class_function_id, args,
+                                                 kwargs, **opts)
+        await done  # propagate registration errors to threaded callers
+        return actor_id
+
+    def create_actor_local(self, class_function_id: str, args: tuple,
                            kwargs: dict, *, class_name: str = "",
                            resources: Optional[Dict[str, float]] = None,
                            scheduling=None, max_restarts: int = 0,
                            max_task_retries: int = 0, max_concurrency: int = 1,
                            is_async: bool = False, name: str = "",
-                           namespace: str = "", lifetime: str = "") -> ActorID:
+                           namespace: str = "", lifetime: str = "",
+                           export: Optional[Any] = None):
+        """Synchronous actor creation: returns (actor_id, done_future).
+
+        Must run on the core loop thread. Arg serialization, optional class
+        export, and GCS registration run in the background; method calls
+        submitted before registration park in the submit queue until the
+        actor goes ALIVE (or DEAD on registration failure).
+        """
         from ray_tpu._private.common import SchedulingStrategy
         actor_id = ActorID.of(self.job_id)
         task_id = self._next_task_id()
-        task_args, kw_names = await self._build_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, name=class_name,
-            function_id=class_function_id, args=task_args,
+            function_id=class_function_id, args=[],
             resources=resources or {"CPU": 1.0},
             scheduling=scheduling or SchedulingStrategy(),
             owner_address=self.address, owner_worker_id=self.worker_id,
@@ -1064,23 +1134,49 @@ class CoreWorker:
             max_concurrency=max_concurrency, is_async_actor=is_async,
             actor_name=name, namespace=namespace,
         )
-        spec.runtime_env = {"kwarg_names": kw_names, "lifetime": lifetime}
+        spec.runtime_env = {"lifetime": lifetime}
         q = ActorSubmitQueue(actor_id)
         self.actor_queues[actor_id] = q
-        await self.gcs.request("register_actor", {"spec": spec})
-        return actor_id
+        done = asyncio.ensure_future(
+            self._finish_actor_creation(q, spec, args, kwargs, lifetime,
+                                        export))
+        return actor_id, done
+
+    async def _finish_actor_creation(self, q: "ActorSubmitQueue",
+                                     spec: TaskSpec, args, kwargs,
+                                     lifetime: str, export=None):
+        try:
+            await self._await_export(export, spec.function_id)
+            task_args, kw_names = await self._build_args(args, kwargs)
+            spec.args = task_args
+            spec.runtime_env = {"kwarg_names": kw_names, "lifetime": lifetime}
+            await self.gcs.request("register_actor", {"spec": spec})
+        except Exception as e:
+            q.set_state("DEAD", reason=f"actor registration failed: {e!r}")
+            raise
 
     async def submit_actor_task(self, actor_id: ActorID, method_name: str,
                                 args: tuple, kwargs: dict,
                                 num_returns: int = 1,
                                 max_task_retries: int = 0) -> List[ObjectRef]:
-        q = self.actor_queues.get(actor_id)
-        if q is None:
-            q = await self._connect_actor_queue(actor_id)
-        # Reserve the sequence number and register the spec in the inflight
-        # map BEFORE any await so concurrent submissions cannot race to
-        # duplicate/skip seq numbers, and restart renumbering sees every
-        # reserved slot.
+        return self.submit_actor_task_local(actor_id, method_name, args,
+                                            kwargs, num_returns,
+                                            max_task_retries)
+
+    def submit_actor_task_local(self, actor_id: ActorID, method_name: str,
+                                args: tuple, kwargs: dict,
+                                num_returns: int = 1,
+                                max_task_retries: int = 0) -> List[ObjectRef]:
+        """Synchronous actor-task submission (core loop thread only).
+
+        The sequence number is reserved and the spec registered in the
+        inflight map immediately, so concurrent submissions cannot
+        duplicate/skip seq numbers and restart renumbering sees every
+        reserved slot. Arg serialization + the network send run in the
+        background; the receiver reorders by seq_no, so out-of-order sends
+        (args of call N+1 serializing faster than call N's) are safe.
+        """
+        q = self._ensure_actor_queue(actor_id)
         seq_no = q.next_seq()
         task_id = TaskID.for_actor_task(self.job_id, actor_id, seq_no, q.epoch)
         spec = TaskSpec(
@@ -1091,9 +1187,6 @@ class CoreWorker:
             max_retries=max_task_retries,
         )
         q.inflight[seq_no] = spec
-        task_args, kw_names = await self._build_args(args, kwargs)
-        spec.args = task_args
-        spec.runtime_env = {"kwarg_names": kw_names} if kw_names else None
         refs, returns = [], []
         for i in range(num_returns):
             oid = ObjectID.for_task_return(task_id, i)
@@ -1102,20 +1195,58 @@ class CoreWorker:
             refs.append(ObjectRef(oid, self.address))
         self.pending_tasks[task_id] = PendingTask(
             spec=spec, retries_left=max_task_retries, returns=returns,
-            arg_refs=self._pin_arg_refs(spec))
-        asyncio.ensure_future(self._submit_actor_task(q, spec))
+            arg_refs=[])
+        asyncio.ensure_future(
+            self._finish_actor_task_submission(q, spec, args, kwargs))
         return refs
 
+    async def _finish_actor_task_submission(self, q: "ActorSubmitQueue",
+                                            spec: TaskSpec, args, kwargs):
+        try:
+            task_args, kw_names = await self._build_args(args, kwargs)
+        except Exception as e:
+            q.inflight.pop(spec.seq_no, None)
+            self._complete_task_error(spec, e, retry=False)
+            return
+        if spec.task_id not in self.pending_tasks:
+            return  # cancelled before dispatch
+        spec.args = task_args
+        spec.runtime_env = {"kwarg_names": kw_names} if kw_names else None
+        self.pending_tasks[spec.task_id].arg_refs = self._pin_arg_refs(spec)
+        await self._submit_actor_task(q, spec)
+
+    def _ensure_actor_queue(self, actor_id: ActorID) -> ActorSubmitQueue:
+        q = self.actor_queues.get(actor_id)
+        if q is None:
+            q = ActorSubmitQueue(actor_id)
+            self.actor_queues[actor_id] = q
+            asyncio.ensure_future(self._populate_actor_queue(q))
+        return q
+
+    async def _populate_actor_queue(self, q: ActorSubmitQueue):
+        last_err = None
+        for attempt in range(3):
+            try:
+                info: Optional[ActorInfo] = await self.gcs.request(
+                    "get_actor_info", {"actor_id": q.actor_id})
+            except Exception as e:
+                last_err = e
+                await asyncio.sleep(0.5 * (attempt + 1))
+                continue
+            if info is not None and q.state not in ("ALIVE", "DEAD"):
+                # Don't clobber a state already delivered by pubsub.
+                if info.state == ACTOR_ALIVE:
+                    q.set_state("ALIVE", info.address)
+                elif info.state == ACTOR_DEAD:
+                    q.set_state("DEAD", reason=info.death_cause)
+            return
+        # GCS unreachable: fail queued tasks instead of hanging forever.
+        if q.state not in ("ALIVE", "DEAD"):
+            q.set_state("DEAD",
+                        reason=f"could not resolve actor state: {last_err!r}")
+
     async def _connect_actor_queue(self, actor_id: ActorID) -> ActorSubmitQueue:
-        info: Optional[ActorInfo] = await self.gcs.request(
-            "get_actor_info", {"actor_id": actor_id})
-        q = ActorSubmitQueue(actor_id)
-        if info is not None:
-            if info.state == ACTOR_ALIVE:
-                q.set_state("ALIVE", info.address)
-            elif info.state == ACTOR_DEAD:
-                q.set_state("DEAD", reason=info.death_cause)
-        self.actor_queues[actor_id] = q
+        q = self._ensure_actor_queue(actor_id)
         return q
 
     async def _submit_actor_task(self, q: ActorSubmitQueue, spec: TaskSpec):

@@ -197,13 +197,19 @@ def wait(refs: List[ObjectRef], *, num_returns: int = 1,
     return _call_on_core_loop(core, coro, None)
 
 
+def _on_core_loop(core: CoreWorker) -> bool:
+    """True when the caller is executing on the core event loop thread
+    (async actor methods, serve replicas/controller)."""
+    try:
+        return asyncio.get_running_loop() is core.loop
+    except RuntimeError:
+        return False
+
+
 def _call_on_core_loop(core: CoreWorker, coro, timeout):
     """Run coro on the core loop from whatever thread we're on."""
-    try:
-        running = asyncio.get_running_loop()
-    except RuntimeError:
-        running = None
-    if running is core.loop:
+    if _on_core_loop(core):
+        coro.close()
         raise RuntimeError(
             "blocking API called from the core event loop; use await/async "
             "variants inside async actors")
@@ -216,6 +222,10 @@ def kill(actor, *, no_restart: bool = True):
     if not isinstance(actor, ActorHandle):
         raise TypeError("kill() expects an ActorHandle")
     core = get_core()
+    if _on_core_loop(core):
+        # Async-actor context: fire and forget (kill is idempotent).
+        asyncio.ensure_future(core.kill_actor(actor._actor_id, no_restart))
+        return
     _call_on_core_loop(core, core.kill_actor(actor._actor_id, no_restart), 10)
 
 

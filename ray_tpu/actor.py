@@ -32,11 +32,19 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         core = worker_api.get_core()
-        refs = worker_api._call_on_core_loop(core, core.submit_actor_task(
-            self._handle._actor_id, self._name, args, kwargs,
-            num_returns=self._num_returns,
-            max_task_retries=self._handle._max_task_retries,
-        ), None)
+        if worker_api._on_core_loop(core):
+            # Async-actor context: submission is synchronous bookkeeping +
+            # deferred dispatch, legal on the loop thread.
+            refs = core.submit_actor_task_local(
+                self._handle._actor_id, self._name, args, kwargs,
+                num_returns=self._num_returns,
+                max_task_retries=self._handle._max_task_retries)
+        else:
+            refs = worker_api._call_on_core_loop(core, core.submit_actor_task(
+                self._handle._actor_id, self._name, args, kwargs,
+                num_returns=self._num_returns,
+                max_task_retries=self._handle._max_task_retries,
+            ), None)
         if self._num_returns == 1:
             return refs[0]
         return refs
@@ -132,12 +140,19 @@ class ActorClass:
 
     def _create(self, args, kwargs) -> ActorHandle:
         core = worker_api.get_core()
+        on_loop = worker_api._on_core_loop(core)
         if self._class_id is None:
             data = cloudpickle.dumps(self._cls)
             self._class_id = "actor:" + hashlib.sha1(data).hexdigest()
+        export = None
         if not worker_api._state.exported_functions.get(self._class_id):
-            worker_api._call_on_core_loop(
-                core, core.export_function(self._cls, self._class_id), 30)
+            if on_loop:
+                # Deferred: chained before GCS registration inside
+                # create_actor_local's background task.
+                export = (self._cls, self._class_id)
+            else:
+                worker_api._call_on_core_loop(
+                    core, core.export_function(self._cls, self._class_id), 30)
             worker_api._state.exported_functions[self._class_id] = True
         opts = self._options
         is_async = self._is_async()
@@ -152,8 +167,7 @@ class ActorClass:
         namespace = opts.get("namespace")
         if namespace is None:
             namespace = worker_api._state.namespace
-        actor_id = worker_api._call_on_core_loop(core, core.create_actor(
-            self._class_id, args, kwargs,
+        create_kwargs = dict(
             class_name=self.__name__,
             resources=resources,
             scheduling=_resolve_scheduling(opts),
@@ -164,7 +178,13 @@ class ActorClass:
             name=opts.get("name", ""),
             namespace=namespace,
             lifetime=opts.get("lifetime", ""),
-        ), None)
+        )
+        if on_loop:
+            actor_id, _done = core.create_actor_local(
+                self._class_id, args, kwargs, export=export, **create_kwargs)
+        else:
+            actor_id = worker_api._call_on_core_loop(core, core.create_actor(
+                self._class_id, args, kwargs, **create_kwargs), None)
         methods = [n for n, _ in inspect.getmembers(self._cls,
                                                     inspect.isfunction)
                    if not n.startswith("__")]

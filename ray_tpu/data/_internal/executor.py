@@ -399,15 +399,23 @@ class StreamingExecutor:
             return [InputOp(list(zip(refs, metas)), self.ctx, self.stats)]
         chain: List[LogicalOperator] = []
         cur = op
+        non_linear_input = None
         while True:
             chain.append(cur)
             if not cur.inputs:
                 break
             if len(cur.inputs) > 1 or isinstance(cur.inputs[0], (Union, Zip)):
+                # Chain bottoms out on a Union/Zip: bulk-materialize it and
+                # feed the linear chain from an InputOp.
+                non_linear_input = cur.inputs[0]
                 break
             cur = cur.inputs[0]
         chain.reverse()
         phys: List[PhysOp] = []
+        if non_linear_input is not None:
+            refs, metas = _materialize_logical(non_linear_input, self.ctx,
+                                               self.stats)
+            phys.append(InputOp(list(zip(refs, metas)), self.ctx, self.stats))
         for node in chain:
             if isinstance(node, Read):
                 phys.append(ReadOp(node.name, node.read_tasks, self.ctx,

@@ -84,8 +84,13 @@ def repartition_bulk(refs, metas, num_blocks: int):
     """Split/merge to exactly num_blocks without changing row order."""
     total = sum(m.num_rows for m in metas)
     if total == 0:
-        empty = ray_tpu.put([])
-        return [empty], [_meta_of([])]
+        # Still honor the requested block count (split(n) callers index
+        # one shard per worker).
+        refs_out, metas_out = [], []
+        for _ in range(num_blocks):
+            refs_out.append(ray_tpu.put([]))
+            metas_out.append(_meta_of([]))
+        return refs_out, metas_out
     # Target row ranges per output block.
     base, rem = divmod(total, num_blocks)
     targets = [base + (1 if i < rem else 0) for i in range(num_blocks)]

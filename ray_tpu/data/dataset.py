@@ -29,14 +29,17 @@ from ray_tpu.data._internal import shuffle as _shuffle
 
 @dataclass
 class ActorPoolStrategy:
-    """compute= strategy for stateful map_batches (reference: ActorPoolStrategy)."""
-    size: int = 2
+    """compute= strategy for stateful map_batches (reference:
+    ActorPoolStrategy). The pool is fixed-size: an explicit `size` wins;
+    otherwise min_size (max_size is accepted for API compatibility but the
+    pool does not autoscale yet)."""
+    size: Optional[int] = None
     min_size: Optional[int] = None
     max_size: Optional[int] = None
 
     def __post_init__(self):
-        if self.min_size:
-            self.size = self.min_size
+        if self.size is None:
+            self.size = self.min_size if self.min_size is not None else 2
 
 
 class Dataset:
@@ -351,9 +354,12 @@ class Dataset:
                          shuffle: bool = False,
                          seed: Optional[int] = None):
         ds = self.random_shuffle(seed=seed) if shuffle else self
-        total = ds.count()
+        # Materialize once; counting then splitting would execute the whole
+        # plan twice.
+        mat = ds.materialize()
+        total = sum(m.num_rows for m in mat._op.metas)  # type: ignore
         n_test = int(total * test_size)
-        train, test = ds.split_at_indices([total - n_test])
+        train, test = mat.split_at_indices([total - n_test])
         return train, test
 
     def streaming_split(self, n: int, *, equal: bool = False,

@@ -84,18 +84,36 @@ class RemoteFunction:
 
     def remote(self, *args, **kwargs):
         core = worker_api.get_core()
-        fid = self._ensure_exported(core)
         opts = self._options
         num_returns = opts.get("num_returns", 1)
-        refs = worker_api._call_on_core_loop(core, core.submit_task(
-            fid, args, kwargs,
+        on_loop = worker_api._on_core_loop(core)
+        export = None
+        if on_loop:
+            # Async-actor context: defer the function export; it is chained
+            # before dispatch inside the submission's background task.
+            if self._function_id is None:
+                data = cloudpickle.dumps(self._function)
+                self._function_id = "fn:" + hashlib.sha1(data).hexdigest()
+            fid = self._function_id
+            if not worker_api._state.exported_functions.get(fid):
+                export = (self._function, fid)
+                worker_api._state.exported_functions[fid] = True
+        else:
+            fid = self._ensure_exported(core)
+        submit_kwargs = dict(
             name=self.__name__,
             num_returns=num_returns,
             resources=_resources_from_options(opts),
             scheduling=_resolve_scheduling(opts),
             max_retries=opts.get("max_retries", -1),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
-        ), None)
+        )
+        if on_loop:
+            refs = core.submit_task_local(fid, args, kwargs, export=export,
+                                          **submit_kwargs)
+        else:
+            refs = worker_api._call_on_core_loop(core, core.submit_task(
+                fid, args, kwargs, **submit_kwargs), None)
         if num_returns == 1:
             return refs[0]
         return refs

@@ -1,0 +1,25 @@
+"""ray_tpu.serve: scalable model serving on actors.
+
+Reference parity: python/ray/serve (serve.run api.py:523, ServeController
+_private/controller.py:91, replicas _private/replica.py:233, power-of-two
+router _private/replica_scheduler/pow_2_scheduler.py:44, batching
+serve/batching.py, multiplexing serve/multiplex.py). Replicas are async
+ray_tpu actors; the TPU-first twist is that a replica typically holds a
+jitted JAX callable and `@serve.batch` feeds it fixed-size batches to avoid
+recompilation.
+"""
+
+from ray_tpu.serve.api import (delete, get_app_handle, get_deployment_handle,
+                               run, shutdown, start, status)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.config import AutoscalingConfig, HTTPOptions
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+
+__all__ = [
+    "deployment", "Deployment", "Application", "run", "start", "shutdown",
+    "delete", "status", "get_app_handle", "get_deployment_handle",
+    "DeploymentHandle", "DeploymentResponse", "batch", "multiplexed",
+    "get_multiplexed_model_id", "AutoscalingConfig", "HTTPOptions",
+]

@@ -1,0 +1,130 @@
+"""Public serve API (reference: python/ray/serve/api.py — serve.start :62,
+serve.run :523, serve.shutdown, status)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.handle import DeploymentHandle
+
+_controller = None
+
+
+def _get_controller():
+    global _controller
+    if _controller is not None:
+        return _controller
+    try:
+        _controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        cls = ray_tpu.remote(num_cpus=0.1, name=CONTROLLER_NAME,
+                             get_if_exists=True)(ServeController)
+        _controller = cls.remote()
+    return _controller
+
+
+async def _get_controller_async():
+    """Controller lookup legal on the core loop (replicas/proxy)."""
+    global _controller
+    if _controller is None:
+        from ray_tpu._private import worker_api
+        from ray_tpu.actor import ActorHandle
+        core = worker_api.get_core()
+        info = await core.get_named_actor(CONTROLLER_NAME, "")
+        _controller = ActorHandle._from_actor_info(info)
+    return _controller
+
+
+def start(*, http_options=None, proxy: bool = False):
+    """Start the Serve control plane (controller, optionally HTTP proxy)."""
+    ctrl = _get_controller()
+    if proxy or http_options is not None:
+        from ray_tpu.serve.config import HTTPOptions
+        opts = http_options or HTTPOptions()
+        ray_tpu.get(ctrl.ensure_proxy.remote(opts.host, opts.port),
+                    timeout=30)
+    return ctrl
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/",
+        _blocking_until_ready: bool = True) -> DeploymentHandle:
+    """Deploy an application graph; returns a handle to its ingress."""
+    import cloudpickle
+    if isinstance(app, Deployment):
+        app = app.bind()
+    ctrl = _get_controller()
+    flat = app.flatten()
+    payload = []
+    for dep_name, a in flat.items():
+        d = a.deployment
+        payload.append({
+            "name": dep_name,
+            "version": d.version,
+            "config": d.config,
+            "blob": cloudpickle.dumps({
+                "func_or_class": d.func_or_class,
+                "init_args": a.init_args,
+                "init_kwargs": a.init_kwargs,
+                "app_name": name,
+            }),
+        })
+    ingress = app.deployment.name
+    ray_tpu.get(ctrl.deploy_app.remote(name, payload, route_prefix, ingress),
+                timeout=120)
+    handle = DeploymentHandle(ingress, app_name=name)
+    if _blocking_until_ready:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            _v, reps = ray_tpu.get(
+                ctrl.get_replicas.remote(name, ingress), timeout=30)
+            if reps:
+                break
+            time.sleep(0.1)
+    return handle
+
+
+def delete(name: str):
+    ctrl = _get_controller()
+    ray_tpu.get(ctrl.delete_app.remote(name), timeout=60)
+
+
+def status() -> Dict[str, Any]:
+    ctrl = _get_controller()
+    return ray_tpu.get(ctrl.status.remote(), timeout=30)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    ctrl = _get_controller()
+    routes = ray_tpu.get(ctrl.get_route_table.remote(), timeout=30)
+    for _route, (app, ingress) in routes.items():
+        if app == name:
+            return DeploymentHandle(ingress, app_name=name)
+    st = ray_tpu.get(ctrl.status.remote(), timeout=30)
+    if name in st and st[name]:
+        return DeploymentHandle(next(iter(st[name])), app_name=name)
+    raise ValueError(f"no app named {name!r}")
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name=app_name)
+
+
+def shutdown():
+    global _controller
+    try:
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        _controller = None
+        return
+    try:
+        ray_tpu.get(ctrl.shutdown.remote(), timeout=60)
+        ray_tpu.kill(ctrl)
+    except Exception:
+        pass
+    _controller = None

@@ -1,0 +1,107 @@
+"""@serve.batch: dynamic request batching.
+
+Reference parity: python/ray/serve/batching.py. Calls to the decorated
+async function are queued; a flusher invokes the underlying function with a
+list of requests once max_batch_size accumulate or batch_wait_timeout_s
+elapses. On TPU this is the lever that keeps the jitted callable fed with a
+fixed batch dimension (pad to max_batch_size to avoid recompilation).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._timeout = batch_wait_timeout_s
+        self._queue: List = []   # (args_tuple, future)
+        self._flusher: Optional[asyncio.Task] = None
+
+    async def submit(self, args: tuple):
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._queue.append((args, fut))
+        if len(self._queue) >= self._max:
+            self._flush_now()
+        elif self._flusher is None or self._flusher.done():
+            self._flusher = asyncio.ensure_future(self._delayed_flush())
+        return await fut
+
+    async def _delayed_flush(self):
+        await asyncio.sleep(self._timeout)
+        self._flush_now()
+
+    def _flush_now(self):
+        if not self._queue:
+            return
+        batch, self._queue = self._queue, []
+        asyncio.ensure_future(self._run_batch(batch))
+
+    async def _run_batch(self, batch):
+        args_lists = None
+        futures = [f for _a, f in batch]
+        try:
+            # Transpose: fn(self?, [x0, x1...], [y0, y1...])
+            n_args = len(batch[0][0])
+            args_lists = tuple([a[i] for a, _f in batch]
+                               for i in range(n_args))
+            results = self._fn(*args_lists)
+            if asyncio.iscoroutine(results):
+                results = await results
+            if len(results) != len(batch):
+                raise ValueError(
+                    f"@serve.batch function returned {len(results)} results "
+                    f"for a batch of {len(batch)}")
+            for f, r in zip(futures, results):
+                if not f.done():
+                    f.set_result(r)
+        except Exception as e:
+            for f in futures:
+                if not f.done():
+                    f.set_exception(e)
+
+
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: async fn(self, item) -> result, executed as fn(self,
+    [items]) -> [results]."""
+
+    def wrap(fn):
+        attr = f"__serve_batch_queue_{fn.__name__}"
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            # Methods: the wrapper is a class attribute of args[0]'s type
+            # (descriptor check — NOT duck-typing on args[0], which would
+            # misroute plain functions whose first argument happens to be
+            # an object). Each instance gets its own queue.
+            is_method = bool(args) and getattr(
+                type(args[0]), fn.__name__, None) is wrapper
+            if is_method:
+                owner = args[0]
+                bound_args = args[1:]
+                q = getattr(owner, attr, None)
+                if q is None:
+                    q = _BatchQueue(
+                        lambda *ls: fn(owner, *ls),
+                        max_batch_size, batch_wait_timeout_s)
+                    setattr(owner, attr, q)
+            else:
+                bound_args = args
+                q = getattr(wrapper, "_queue", None)
+                if q is None:
+                    q = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+                    wrapper._queue = q
+            return await q.submit(bound_args)
+
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -1,0 +1,52 @@
+"""Serve configuration schemas (reference: python/ray/serve/config.py,
+serve/schema.py — dataclasses here instead of pydantic)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Queue-depth-driven replica autoscaling
+    (reference: serve/autoscaling_policy.py)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 10.0
+
+    def decide(self, num_replicas: int, total_ongoing: float) -> int:
+        """Desired replica count from current load."""
+        if num_replicas == 0:
+            return self.min_replicas
+        per = total_ongoing / num_replicas
+        desired = num_replicas
+        if per > self.target_ongoing_requests:
+            import math
+            desired = math.ceil(
+                total_ongoing / self.target_ongoing_requests)
+        elif per < self.target_ongoing_requests / 2:
+            import math
+            desired = max(1, math.ceil(
+                total_ongoing / self.target_ongoing_requests))
+        return max(self.min_replicas, min(self.max_replicas, desired))
+
+
+@dataclass
+class HTTPOptions:
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    user_config: Optional[Any] = None
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 2.0
+    graceful_shutdown_timeout_s: float = 5.0

@@ -1,0 +1,262 @@
+"""ServeController: the reconciliation brain.
+
+Reference parity: python/ray/serve/_private/controller.py:91 and
+deployment_state.py:1226 (DeploymentState/DeploymentStateManager). One named
+actor holds target state per app/deployment, reconciles replicas (create,
+remove, rolling-update by version), health-checks them, and applies
+queue-depth autoscaling. Routers poll get_replicas() with a version counter
+(the long-poll analogue).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class _ReplicaInfo:
+    def __init__(self, handle, version: str):
+        self.handle = handle
+        self.version = version
+        self.started = time.monotonic()
+        self.ever_healthy = False
+
+
+class _DeploymentState:
+    STARTUP_GRACE_S = 60.0
+
+    def __init__(self, app_name: str, name: str, blob: bytes, config,
+                 version: str):
+        self.app_name = app_name
+        self.name = name
+        self.blob = blob
+        self.config = config
+        self.version = version
+        self.replicas: List[_ReplicaInfo] = []
+        self.target_num = config.num_replicas
+        self.list_version = 0              # bumped on any replica-set change
+        self.last_scale_change = 0.0
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[tuple, _DeploymentState] = {}
+        self._routes: Dict[str, tuple] = {}  # route_prefix -> (app, ingress)
+        self._proxy = None
+        self._reconcile_task = None
+        self._started = False
+
+    async def _ensure_loops(self):
+        if not self._started:
+            self._started = True
+            self._reconcile_task = asyncio.ensure_future(
+                self._reconcile_loop())
+
+    # ------------------------------------------------------------------
+    # Deployment API
+    # ------------------------------------------------------------------
+    async def deploy_app(self, app_name: str, deployments: List[dict],
+                         route_prefix: Optional[str], ingress: str):
+        """deployments: [{name, blob, config, version}]"""
+        await self._ensure_loops()
+        incoming = set()
+        for d in deployments:
+            key = (app_name, d["name"])
+            incoming.add(key)
+            cur = self._deployments.get(key)
+            if cur is None:
+                self._deployments[key] = _DeploymentState(
+                    app_name, d["name"], d["blob"], d["config"], d["version"])
+            else:
+                cur.blob = d["blob"]
+                cur.config = d["config"]
+                cur.version = d["version"]
+                cur.target_num = d["config"].num_replicas
+        # Remove deployments no longer in the app.
+        for key in [k for k in self._deployments
+                    if k[0] == app_name and k not in incoming]:
+            await self._remove_deployment(key)
+        if route_prefix is not None:
+            self._routes[route_prefix] = (app_name, ingress)
+        await self._reconcile_once()
+        return True
+
+    async def delete_app(self, app_name: str):
+        for key in [k for k in self._deployments if k[0] == app_name]:
+            await self._remove_deployment(key)
+        self._routes = {r: v for r, v in self._routes.items()
+                        if v[0] != app_name}
+        return True
+
+    async def _remove_deployment(self, key):
+        st = self._deployments.pop(key, None)
+        if st is None:
+            return
+        for r in st.replicas:
+            await self._stop_replica(st, r.handle)
+
+    async def _stop_replica(self, st, rep):
+        try:
+            await asyncio.wait_for(
+                rep.drain.remote(st.config.graceful_shutdown_timeout_s).future(),
+                timeout=st.config.graceful_shutdown_timeout_s + 2)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(rep)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # Reconciliation
+    # ------------------------------------------------------------------
+    async def _start_replica(self, st: _DeploymentState):
+        from ray_tpu.serve.replica import ReplicaActor
+        opts = dict(st.config.ray_actor_options)
+        opts.setdefault("num_cpus", 0.1)
+        opts.setdefault("max_concurrency", st.config.max_ongoing_requests)
+        cls = ray_tpu.remote(**opts)(ReplicaActor)
+        rep = cls.remote(st.blob, st.config.user_config)
+        info = _ReplicaInfo(rep, st.version)
+        st.replicas.append(info)
+        st.list_version += 1
+        return info
+
+    async def _reconcile_once(self):
+        for st in list(self._deployments.values()):
+            # Rolling update: replace replicas built from an older version.
+            stale = [i for i, r in enumerate(st.replicas)
+                     if r.version != st.version]
+            for i in sorted(stale, reverse=True):
+                old = st.replicas[i]
+                del st.replicas[i]
+                st.list_version += 1
+                new = await self._start_replica(st)
+                # Wait for the new replica to come up before killing the old
+                # one (rolling, not big-bang).
+                try:
+                    await asyncio.wait_for(
+                        new.handle.check_health.remote().future(), timeout=30)
+                    new.ever_healthy = True
+                except Exception:
+                    pass
+                await self._stop_replica(st, old.handle)
+            # Scale to target.
+            while len(st.replicas) < st.target_num:
+                await self._start_replica(st)
+            while len(st.replicas) > st.target_num:
+                r = st.replicas.pop()
+                st.list_version += 1
+                await self._stop_replica(st, r.handle)
+
+    async def _reconcile_loop(self):
+        while True:
+            try:
+                await self._reconcile_once()
+                await self._health_check()
+                await self._autoscale()
+            except Exception:
+                logger.exception("serve controller reconcile error")
+            await asyncio.sleep(0.5)
+
+    async def _health_check(self):
+        now = time.monotonic()
+        for st in list(self._deployments.values()):
+            for i, r in reversed(list(enumerate(st.replicas))):
+                try:
+                    ok = await asyncio.wait_for(
+                        r.handle.check_health.remote().future(), timeout=5)
+                except Exception:
+                    ok = False
+                if ok:
+                    r.ever_healthy = True
+                    continue
+                # A replica that has never come up yet may simply still be
+                # starting (worker spawn under load): give it a grace
+                # period before declaring it dead, else the controller
+                # kills replicas mid-creation.
+                if (not r.ever_healthy
+                        and now - r.started < st.STARTUP_GRACE_S):
+                    continue
+                del st.replicas[i]
+                st.list_version += 1
+                try:
+                    ray_tpu.kill(r.handle)
+                except Exception:
+                    pass
+        # reconcile_once (caller loop) will top the count back up
+
+    async def _autoscale(self):
+        now = time.monotonic()
+        for st in list(self._deployments.values()):
+            asc = st.config.autoscaling_config
+            if asc is None or not st.replicas:
+                continue
+            total = 0.0
+            for r in st.replicas:
+                try:
+                    m = await asyncio.wait_for(
+                        r.handle.get_metrics.remote().future(), timeout=5)
+                    total += m["ongoing"]
+                except Exception:
+                    pass
+            desired = asc.decide(len(st.replicas), total)
+            delay = (asc.upscale_delay_s if desired > st.target_num
+                     else asc.downscale_delay_s)
+            if desired != st.target_num:
+                if now - st.last_scale_change >= delay:
+                    logger.info("autoscale %s: %d -> %d (ongoing=%.1f)",
+                                st.name, st.target_num, desired, total)
+                    st.target_num = desired
+                    st.last_scale_change = now
+            else:
+                st.last_scale_change = now
+
+    # ------------------------------------------------------------------
+    # Queries
+    # ------------------------------------------------------------------
+    def get_replicas(self, app_name: str, deployment_name: str):
+        st = self._deployments.get((app_name, deployment_name))
+        if st is None:
+            return (0, [])
+        return (st.list_version, [r.handle for r in st.replicas])
+
+    def get_route_table(self):
+        return dict(self._routes)
+
+    def status(self):
+        out = {}
+        for (app, name), st in self._deployments.items():
+            out.setdefault(app, {})[name] = {
+                "target": st.target_num,
+                "running": len(st.replicas),
+                "version": st.version,
+            }
+        return out
+
+    async def ensure_proxy(self, host: str, port: int):
+        if self._proxy is None:
+            from ray_tpu.serve.proxy import ProxyActor
+            cls = ray_tpu.remote(num_cpus=0.1)(ProxyActor)
+            self._proxy = cls.remote(host, port)
+            await self._proxy.ready.remote()
+        return True
+
+    async def shutdown(self):
+        for key in list(self._deployments):
+            await self._remove_deployment(key)
+        if self._proxy is not None:
+            try:
+                ray_tpu.kill(self._proxy)
+            except Exception:
+                pass
+            self._proxy = None
+        return True

@@ -1,0 +1,106 @@
+"""Deployment + Application graph nodes.
+
+Reference parity: python/ray/serve/deployment.py (Deployment, .options,
+.bind producing an Application). A bound Application may have other
+Applications among its init args — they resolve to DeploymentHandles at
+replica construction time (deployment graph composition).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+
+
+@dataclass
+class Deployment:
+    func_or_class: Union[Callable, type]
+    name: str
+    version: str = "1"
+    config: DeploymentConfig = field(default_factory=DeploymentConfig)
+    route_prefix: Optional[str] = None
+
+    def options(self, *, name: Optional[str] = None,
+                version: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None,
+                user_config: Any = None,
+                ray_actor_options: Optional[dict] = None,
+                autoscaling_config: Optional[AutoscalingConfig] = None,
+                route_prefix: Optional[str] = None) -> "Deployment":
+        cfg = replace(self.config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if user_config is not None:
+            cfg.user_config = user_config
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = dict(ray_actor_options)
+        if autoscaling_config is not None:
+            cfg.autoscaling_config = autoscaling_config
+        return Deployment(
+            func_or_class=self.func_or_class,
+            name=name or self.name,
+            version=version or self.version,
+            config=cfg,
+            route_prefix=(route_prefix if route_prefix is not None
+                          else self.route_prefix),
+        )
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def __call__(self, *a, **kw):
+        raise RuntimeError(
+            "Deployments are not directly callable; use .bind() + serve.run "
+            "and call the returned handle.")
+
+
+@dataclass
+class Application:
+    deployment: Deployment
+    init_args: Tuple = ()
+    init_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def flatten(self) -> Dict[str, "Application"]:
+        """All applications in this graph keyed by deployment name."""
+        out: Dict[str, Application] = {}
+
+        def visit(app: Application):
+            out[app.deployment.name] = app
+            for a in list(app.init_args) + list(app.init_kwargs.values()):
+                if isinstance(a, Application):
+                    visit(a)
+        visit(self)
+        return out
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               version: str = "1", num_replicas: int = 1,
+               max_ongoing_requests: int = 100,
+               user_config: Any = None,
+               ray_actor_options: Optional[dict] = None,
+               autoscaling_config: Optional[AutoscalingConfig] = None,
+               route_prefix: Optional[str] = None):
+    """@serve.deployment decorator (reference: serve/api.py deployment)."""
+
+    def wrap(f_or_c):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            user_config=user_config,
+            ray_actor_options=dict(ray_actor_options or {}),
+            autoscaling_config=autoscaling_config,
+        )
+        return Deployment(func_or_class=f_or_c,
+                          name=name or f_or_c.__name__,
+                          version=version, config=cfg,
+                          route_prefix=route_prefix)
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap

@@ -1,0 +1,247 @@
+"""DeploymentHandle + power-of-two-choices routing.
+
+Reference parity: python/ray/serve/handle.py (DeploymentHandle) and
+_private/replica_scheduler/pow_2_scheduler.py:44. The router keeps local
+in-flight counts per replica and picks the lighter of two random choices —
+locality/queue-aware without a round trip per request.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference: DeploymentResponse).
+
+    Sync callers (driver threads): wraps an ObjectRef; use .result().
+    Async callers (replicas/proxy on the core loop): wraps a coroutine that
+    performs routing + the call; use `await response`.
+    """
+
+    def __init__(self, ref=None, on_done=None, coro=None):
+        self._ref = ref
+        self._on_done = on_done or (lambda: None)
+        self._coro = coro
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None):
+        if self._coro is not None:
+            raise RuntimeError(
+                "result() is not available in async context; use "
+                "`await response` instead")
+        try:
+            out = ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            self._settle()
+        return out
+
+    def _settle(self):
+        if not self._done:
+            self._done = True
+            self._on_done()
+
+    def __del__(self):
+        # Fire-and-forget callers never consume the response; settle on GC
+        # so the router's in-flight counter doesn't leak and skew p2c.
+        try:
+            self._settle()
+        except Exception:
+            pass
+
+    def __await__(self):
+        if self._coro is not None:
+            return self._coro.__await__()
+        return self._awaitable(self._ref).__await__()
+
+    async def _awaitable(self, ref):
+        try:
+            return await ref
+        finally:
+            self._settle()
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class Router:
+    """Client-side replica picker with periodic replica-list refresh."""
+
+    REFRESH_S = 1.0
+
+    def __init__(self, deployment_name: str, app_name: str):
+        self._dep = deployment_name
+        self._app = app_name
+        self._replicas: List[Any] = []
+        self._version = -1
+        self._inflight: Dict[int, int] = {}
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+
+    def _apply(self, now, version, replicas):
+        with self._lock:
+            self._last_refresh = now
+            if version != self._version:
+                self._version = version
+                self._replicas = replicas
+                self._inflight = {i: 0 for i in range(len(replicas))}
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self.REFRESH_S:
+            return
+        from ray_tpu.serve.api import _get_controller
+        ctrl = _get_controller()
+        version, replicas = ray_tpu.get(
+            ctrl.get_replicas.remote(self._app, self._dep), timeout=30)
+        self._apply(now, version, replicas)
+
+    async def refresh_async(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self.REFRESH_S:
+            return
+        from ray_tpu.serve.api import _get_controller_async
+        ctrl = await _get_controller_async()
+        version, replicas = await ctrl.get_replicas.remote(
+            self._app, self._dep)
+        self._apply(now, version, replicas)
+
+    def pick_cached(self):
+        """Power of two choices on local in-flight counts (no refresh)."""
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"deployment {self._dep!r} has no running replicas")
+            if n == 1:
+                i = 0
+            else:
+                a, b = random.sample(range(n), 2)
+                i = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) \
+                    else b
+            self._inflight[i] = self._inflight.get(i, 0) + 1
+            return i, self._replicas[i]
+
+    def pick(self):
+        self._refresh()
+        return self.pick_cached()
+
+    def release(self, i: int):
+        with self._lock:
+            if i in self._inflight and self._inflight[i] > 0:
+                self._inflight[i] -= 1
+
+    def drop_replicas(self):
+        with self._lock:
+            self._version = -1
+            self._last_refresh = 0.0
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method = method_name
+        self._mux_id = multiplexed_model_id
+        self._router: Optional[Router] = None
+
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name or self._method,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._mux_id)
+        h._router = self._router
+        return h
+
+    def _get_router(self) -> Router:
+        if self._router is None:
+            self._router = Router(self.deployment_name, self.app_name)
+        return self._router
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        import asyncio
+        try:
+            asyncio.get_running_loop()
+            in_async = True
+        except RuntimeError:
+            in_async = False
+        if in_async:
+            # Replica/proxy context: routing must not block the loop.
+            return DeploymentResponse(
+                coro=self._call_async(args, kwargs))
+        router = self._get_router()
+        last_err = None
+        for attempt in range(5):
+            try:
+                i, replica = router.pick()
+            except RuntimeError as e:
+                # Momentarily empty replica set (rolling update / health
+                # replacement): force-refresh and retry.
+                last_err = e
+                router.drop_replicas()
+                time.sleep(0.2 * (attempt + 1))
+                continue
+            try:
+                ref = replica.handle_request.remote(
+                    self._method, self._mux_id, args, kwargs)
+                return DeploymentResponse(ref,
+                                          on_done=lambda i=i: router.release(i))
+            except Exception as e:
+                router.release(i)
+                router.drop_replicas()  # replica may be dead: force refresh
+                last_err = e
+        raise last_err
+
+    async def _call_async(self, args, kwargs):
+        import asyncio
+        from ray_tpu import exceptions as exc
+        router = self._get_router()
+        last_err = None
+        for attempt in range(5):
+            await router.refresh_async(force=attempt > 0)
+            try:
+                i, replica = router.pick_cached()
+            except RuntimeError as e:
+                last_err = e
+                router.drop_replicas()
+                await asyncio.sleep(0.2 * (attempt + 1))
+                continue
+            try:
+                ref = replica.handle_request.remote(
+                    self._method, self._mux_id, args, kwargs)
+            except Exception as e:
+                router.release(i)
+                router.drop_replicas()
+                last_err = e
+                continue
+            try:
+                return await ref
+            except exc.ActorDiedError as e:
+                # Dead replica: refresh the set and retry. Application
+                # exceptions propagate to the caller unchanged.
+                router.drop_replicas()
+                last_err = e
+            finally:
+                router.release(i)
+        raise last_err
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._method,
+                 self._mux_id))

@@ -1,0 +1,63 @@
+"""Model multiplexing: many models share a replica pool.
+
+Reference parity: python/ray/serve/multiplex.py (_ModelMultiplexWrapper) and
+serve.get_multiplexed_model_id. The loader is LRU-bounded per replica; the
+requested model id rides the request context set by the replica actor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from collections import OrderedDict
+from typing import Any, Callable
+
+from ray_tpu.serve.replica import get_request_context
+
+
+def get_multiplexed_model_id() -> str:
+    ctx = get_request_context()
+    return ctx.multiplexed_model_id if ctx else ""
+
+
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorator over `async def load_model(self, model_id)`; calling the
+    wrapper with a model id returns a cached model, evicting LRU."""
+
+    def wrap(fn):
+        attr = f"__serve_mux_cache_{fn.__name__}"
+
+        @functools.wraps(fn)
+        async def wrapper(owner, model_id: str):
+            cache: OrderedDict = getattr(owner, attr, None)
+            if cache is None:
+                cache = OrderedDict()
+                setattr(owner, attr, cache)
+            if model_id in cache:
+                cache.move_to_end(model_id)
+                return cache[model_id]
+            model = fn(owner, model_id)
+            if asyncio.iscoroutine(model):
+                model = await model
+            cache[model_id] = model
+            cache.move_to_end(model_id)
+            while len(cache) > max_num_models_per_replica:
+                _old_id, old_model = cache.popitem(last=False)
+                # Give the model an explicit release hook (device memory is
+                # not guaranteed to free on refcount drop alone).
+                unload = getattr(old_model, "unload", None)
+                if callable(unload):
+                    try:
+                        res = unload()
+                        if asyncio.iscoroutine(res):
+                            await res
+                    except Exception:
+                        pass
+                del old_model
+            return model
+
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -1,0 +1,181 @@
+"""HTTP proxy: asyncio HTTP/1.1 server routing to deployment handles.
+
+Reference parity: python/ray/serve/_private/proxy.py (HTTPProxy :745,
+ProxyActor :1109) — built on asyncio streams instead of uvicorn (no external
+deps). Routes by longest matching route_prefix from the controller's route
+table; request bodies are handed to the ingress deployment as a Request.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlsplit
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: Dict[str, list]
+    headers: Dict[str, str]
+    body: bytes = b""
+
+    def json(self) -> Any:
+        return json.loads(self.body or b"null")
+
+    @property
+    def query_params(self) -> Dict[str, str]:
+        return {k: v[0] for k, v in self.query.items()}
+
+
+class ProxyActor:
+    ROUTE_REFRESH_S = 1.0
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._server = None
+        self._routes: Dict[str, tuple] = {}
+        self._handles: Dict[tuple, Any] = {}
+        self._last_refresh = 0.0
+        self._num_requests = 0
+
+    async def ready(self):
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._handle_conn, self._host, self._port)
+        return self._port
+
+    async def _refresh_routes(self):
+        now = time.monotonic()
+        if now - self._last_refresh < self.ROUTE_REFRESH_S:
+            return
+        self._last_refresh = now
+        from ray_tpu.serve.api import _get_controller_async
+        ctrl = await _get_controller_async()
+        self._routes = await ctrl.get_route_table.remote()
+
+    def _match_route(self, path: str):
+        best = None
+        for prefix, target in self._routes.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(
+                    norm if norm == "/" else norm + "/"):
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, target)
+        return best
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        try:
+            request_line = await reader.readline()
+            if not request_line:
+                return
+            parts = request_line.decode("latin1").strip().split()
+            if len(parts) != 3:
+                await self._respond(writer, 400, b"bad request")
+                return
+            method, target, _version = parts
+            headers: Dict[str, str] = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode("latin1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = b""
+            if "content-length" in headers:
+                body = await reader.readexactly(int(headers["content-length"]))
+            url = urlsplit(target)
+            path = url.path
+            await self._refresh_routes()
+            if path == "/-/routes":
+                await self._respond(writer, 200, json.dumps(
+                    {k: v[0] for k, v in self._routes.items()}).encode())
+                return
+            if path == "/-/healthz":
+                await self._respond(writer, 200, b"success")
+                return
+            match = self._match_route(path)
+            if match is None:
+                await self._respond(writer, 404,
+                                    f"no route for {path}".encode())
+                return
+            prefix, (app_name, ingress) = match
+            key = (app_name, ingress)
+            handle = self._handles.get(key)
+            if handle is None:
+                from ray_tpu.serve.handle import DeploymentHandle
+                handle = DeploymentHandle(ingress, app_name=app_name)
+                self._handles[key] = handle
+            sub_path = path[len(prefix):] if prefix != "/" else path
+            req = Request(method=method, path=sub_path or "/",
+                          query=parse_qs(url.query), headers=headers,
+                          body=body)
+            self._num_requests += 1
+            try:
+                resp = handle.remote(req)
+                result = await resp
+            except Exception as e:
+                await self._respond(writer, 500, repr(e).encode())
+                return
+            await self._send_result(writer, result)
+        except Exception:
+            try:
+                await self._respond(writer, 500, b"internal error")
+            except Exception:
+                pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _send_result(self, writer, result):
+        if isinstance(result, bytes):
+            await self._respond(writer, 200, result,
+                                ctype="application/octet-stream")
+        elif isinstance(result, str):
+            await self._respond(writer, 200, result.encode(),
+                                ctype="text/plain")
+        else:
+            await self._respond(writer, 200,
+                                json.dumps(_jsonable(result)).encode(),
+                                ctype="application/json")
+
+    async def _respond(self, writer, code: int, body: bytes,
+                       ctype: str = "text/plain"):
+        status = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  500: "Internal Server Error"}.get(code, "OK")
+        writer.write(
+            f"HTTP/1.1 {code} {status}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: close\r\n\r\n".encode() + body)
+        await writer.drain()
+
+    def get_num_requests(self):
+        return self._num_requests
+
+
+def _jsonable(x):
+    import numpy as np
+    if isinstance(x, dict):
+        return {k: _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    if isinstance(x, np.generic):
+        return x.item()
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    try:
+        import jax
+        if isinstance(x, jax.Array):
+            return np.asarray(x).tolist()
+    except ImportError:
+        pass
+    return x

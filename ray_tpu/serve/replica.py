@@ -1,0 +1,111 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Reference parity: python/ray/serve/_private/replica.py (ReplicaActor :233,
+UserCallableWrapper :715). Async ray_tpu actor with high max_concurrency;
+tracks ongoing requests for the power-of-two router and autoscaler.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+from typing import Any, Dict, Optional
+
+_request_context: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_request_context", default=None)
+
+
+class RequestContext:
+    def __init__(self, multiplexed_model_id: str = ""):
+        self.multiplexed_model_id = multiplexed_model_id
+
+
+def get_request_context() -> Optional[RequestContext]:
+    return _request_context.get()
+
+
+class ReplicaActor:
+    def __init__(self, blob: bytes, user_config: Any = None):
+        import cloudpickle
+        spec = cloudpickle.loads(blob)
+        func_or_class = spec["func_or_class"]
+        init_args = spec["init_args"]
+        init_kwargs = spec["init_kwargs"]
+        # Resolve nested Applications to handles (deployment graphs).
+        from ray_tpu.serve.handle import DeploymentHandle
+        from ray_tpu.serve.deployment import Application
+
+        def resolve(a):
+            if isinstance(a, Application):
+                return DeploymentHandle(a.deployment.name,
+                                        app_name=spec["app_name"])
+            return a
+
+        init_args = tuple(resolve(a) for a in init_args)
+        init_kwargs = {k: resolve(v) for k, v in init_kwargs.items()}
+        if isinstance(func_or_class, type):
+            self._callable = func_or_class(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self._callable = func_or_class
+            self._is_function = True
+        self._ongoing = 0
+        self._total = 0
+        if user_config is not None:
+            self._apply_user_config(user_config)
+
+    def _apply_user_config(self, user_config):
+        recon = getattr(self._callable, "reconfigure", None)
+        if recon is None:
+            raise ValueError(
+                "user_config was set but the deployment has no "
+                "reconfigure(user_config) method")
+        res = recon(user_config)
+        if inspect.iscoroutine(res):
+            asyncio.ensure_future(res)
+
+    async def reconfigure(self, user_config):
+        self._apply_user_config(user_config)
+        return True
+
+    async def handle_request(self, method_name: str, mux_model_id: str,
+                             args: tuple, kwargs: dict):
+        self._ongoing += 1
+        self._total += 1
+        token = _request_context.set(RequestContext(mux_model_id))
+        try:
+            if self._is_function:
+                target = self._callable
+            elif method_name in ("__call__", ""):
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            _request_context.reset(token)
+            self._ongoing -= 1
+
+    def get_metrics(self) -> Dict[str, float]:
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    async def check_health(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if user_check is not None:
+            res = user_check()
+            if inspect.iscoroutine(res):
+                res = await res
+            return bool(res) if res is not None else True
+        return True
+
+    async def drain(self, timeout_s: float = 5.0) -> bool:
+        """Graceful shutdown: wait for in-flight requests to finish."""
+        deadline = asyncio.get_event_loop().time() + timeout_s
+        while self._ongoing > 0:
+            if asyncio.get_event_loop().time() > deadline:
+                return False
+            await asyncio.sleep(0.02)
+        return True

@@ -114,6 +114,17 @@ def test_zip_and_union(ray_mod):
     assert u.count() == 12
 
 
+def test_union_zip_followed_by_transforms(ray_mod):
+    # Regression: Union/Zip upstream of other operators must still feed the
+    # chain (the planner used to drop the source and hang).
+    a = rd.range(4, parallelism=2)
+    b = rd.from_items([{"y": i} for i in range(4)], parallelism=2)
+    out = a.zip(b).map(lambda r: {"s": r["id"] + r["y"]}).take_all()
+    assert [r["s"] for r in out] == [0, 2, 4, 6]
+    u = a.union(a).filter(lambda r: r["id"] < 2).take_all()
+    assert sorted(r["id"] for r in u) == [0, 0, 1, 1]
+
+
 def test_split_and_split_at_indices(ray_mod):
     ds = rd.range(10, parallelism=5)
     shards = ds.split(2)

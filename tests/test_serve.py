@@ -1,0 +1,243 @@
+"""ray_tpu.serve tests (reference strategy: python/ray/serve/tests/)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def ray_mod():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_apps(ray_mod):
+    yield
+    # Delete all apps between tests but keep the controller alive.
+    try:
+        for app in list(serve.status().keys()):
+            serve.delete(app)
+    except Exception:
+        pass
+
+
+def test_function_deployment_and_handle(ray_mod):
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind(), name="d1", route_prefix="/double")
+    assert handle.remote(21).result(timeout=30) == 42
+
+
+def test_class_deployment_replicas_and_routing(ray_mod):
+    @serve.deployment(num_replicas=2)
+    class Counter:
+        def __init__(self, start):
+            self.count = start
+
+        def __call__(self, inc):
+            self.count += inc
+            return self.count
+
+        def whoami(self):
+            return id(self)
+
+    h = serve.run(Counter.bind(100), name="d2", route_prefix="/counter")
+    results = [h.remote(1).result(timeout=30) for _ in range(6)]
+    assert all(r > 100 for r in results)
+    # Two distinct replicas served requests.
+    ids = {h.whoami.remote().result(timeout=30) for _ in range(8)}
+    assert len(ids) == 2
+
+
+def test_status_and_delete(ray_mod):
+    @serve.deployment
+    def f():
+        return "ok"
+
+    serve.run(f.bind(), name="d3", route_prefix="/f")
+    st = serve.status()
+    assert "d3" in st and st["d3"]["f"]["running"] >= 1
+    serve.delete("d3")
+    assert "d3" not in serve.status()
+
+
+def test_composition_deployment_graph(ray_mod):
+    @serve.deployment
+    class Adder:
+        def __init__(self, inc):
+            self.inc = inc
+
+        def __call__(self, x):
+            return x + self.inc
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, adder):
+            self.adder = adder
+
+        async def __call__(self, x):
+            return await self.adder.remote(x)
+
+    app = Ingress.bind(Adder.bind(10))
+    h = serve.run(app, name="d4", route_prefix="/compose")
+    assert h.remote(5).result(timeout=30) == 15
+
+
+def test_http_proxy(ray_mod):
+    @serve.deployment
+    class Echo:
+        def __call__(self, request):
+            data = request.json()
+            return {"path": request.path, "got": data}
+
+    serve.start(proxy=True)
+    serve.run(Echo.bind(), name="d5", route_prefix="/echo")
+    time.sleep(1.0)
+    req = urllib.request.Request(
+        "http://127.0.0.1:8000/echo/sub?a=1",
+        data=json.dumps({"v": 7}).encode(),
+        headers={"Content-Type": "application/json"})
+    deadline = time.time() + 30
+    body = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                body = json.loads(resp.read())
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert body == {"path": "/sub", "got": {"v": 7}}
+    with urllib.request.urlopen(
+            "http://127.0.0.1:8000/-/healthz", timeout=5) as resp:
+        assert resp.read() == b"success"
+
+
+def test_batching(ray_mod):
+    @serve.deployment
+    class Batcher:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        async def handle(self, xs):
+            self.batch_sizes.append(len(xs))
+            return [x * 10 for x in xs]
+
+        async def __call__(self, x):
+            return await self.handle(x)
+
+        def get_batch_sizes(self):
+            return self.batch_sizes
+
+    h = serve.run(Batcher.bind(), name="d6", route_prefix="/batch")
+    resps = [h.remote(i) for i in range(8)]
+    out = sorted(r.result(timeout=30) for r in resps)
+    assert out == [i * 10 for i in range(8)]
+    sizes = h.get_batch_sizes.remote().result(timeout=30)
+    assert max(sizes) > 1  # some requests were actually batched
+
+
+def test_multiplex(ray_mod):
+    @serve.deployment
+    class MuxModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": int(model_id[-1])}
+
+        async def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id()
+            model = await self.get_model(model_id)
+            return x * model["scale"]
+
+        def get_loads(self):
+            return self.loads
+
+    h = serve.run(MuxModel.bind(), name="d7", route_prefix="/mux")
+    h2 = h.options(multiplexed_model_id="m2")
+    h3 = h.options(multiplexed_model_id="m3")
+    assert h2.remote(10).result(timeout=30) == 20
+    assert h3.remote(10).result(timeout=30) == 30
+    assert h2.remote(5).result(timeout=30) == 10
+    loads = h.get_loads.remote().result(timeout=30)
+    assert loads.count("m2") == 1  # cached on second call
+
+
+def test_rolling_update_version(ray_mod):
+    @serve.deployment(version="1")
+    def which():
+        return "v1"
+
+    serve.run(which.bind(), name="d8", route_prefix="/which")
+    h = serve.get_app_handle("d8")
+    assert h.remote().result(timeout=30) == "v1"
+
+    @serve.deployment(version="2")
+    def which():  # noqa: F811
+        return "v2"
+
+    h = serve.run(which.bind(), name="d8", route_prefix="/which")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if h.remote().result(timeout=30) == "v2":
+            break
+        time.sleep(0.2)
+    assert h.remote().result(timeout=30) == "v2"
+
+
+def test_replica_failure_recovery(ray_mod):
+    @serve.deployment(num_replicas=1)
+    class Fragile:
+        def __call__(self):
+            return "alive"
+
+        def crash(self):
+            import os
+            os._exit(1)
+
+    h = serve.run(Fragile.bind(), name="d9", route_prefix="/fragile")
+    assert h.remote().result(timeout=30) == "alive"
+    try:
+        h.crash.remote().result(timeout=10)
+    except Exception:
+        pass
+    # Controller should replace the dead replica.
+    deadline = time.time() + 40
+    ok = False
+    while time.time() < deadline:
+        try:
+            if h.remote().result(timeout=10) == "alive":
+                ok = True
+                break
+        except Exception:
+            time.sleep(0.5)
+    assert ok
+
+
+def test_user_config_reconfigure(ray_mod):
+    @serve.deployment(user_config={"threshold": 5})
+    class Thresh:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, cfg):
+            self.threshold = cfg["threshold"]
+
+        def __call__(self):
+            return self.threshold
+
+    h = serve.run(Thresh.bind(), name="d10", route_prefix="/thresh")
+    assert h.remote().result(timeout=30) == 5
