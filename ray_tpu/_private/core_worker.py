@@ -41,6 +41,10 @@ logger = logging.getLogger(__name__)
 
 META_EXCEPTION = b"EXC"
 
+# Marker method occupying a reserved-but-failed actor-task seq slot: the
+# receiver advances its ordering cursor without executing anything.
+SEQ_SKIP_METHOD = "__ray_tpu_seq_skip__"
+
 
 @dataclass
 class OwnedObject:
@@ -184,6 +188,8 @@ class CoreWorker:
         # actor state
         self.actor_queues: Dict[ActorID, ActorSubmitQueue] = {}
         self.actor_handles: Dict[ActorID, Any] = {}
+        # Refs pinning actor-creation args until instantiation completes.
+        self._actor_creation_pins: Dict[ActorID, List[ObjectRef]] = {}
 
         # executor state (worker mode)
         self.executing_actor = None
@@ -322,6 +328,7 @@ class CoreWorker:
                 q.set_state("RESTARTING")
             elif event == "dead":
                 q.set_state("DEAD", reason=msg.get("reason", "actor died"))
+                self._actor_creation_pins.pop(q.actor_id, None)
         elif channel == "nodes" and msg.get("event") == "dead":
             # Trigger reconstruction checks for objects on that node lazily.
             pass
@@ -744,9 +751,15 @@ class CoreWorker:
         self._function_cache[function_id] = func
         return func
 
-    async def _build_args(self, args: tuple, kwargs: dict) -> Tuple[List[TaskArg], List[str]]:
+    async def _build_args(self, args: tuple, kwargs: dict
+                          ) -> Tuple[List[TaskArg], List[str], List[ObjectRef]]:
+        """-> (task_args, kw_names, pin_refs). pin_refs holds the refs
+        created here for large inlined-to-plasma args; the CALLER must keep
+        them alive (e.g. in PendingTask.arg_refs) until the task completes,
+        or the refcounter frees the objects before the worker fetches them."""
         task_args: List[TaskArg] = []
         kw_names: List[str] = []
+        pin_refs: List[ObjectRef] = []
         for v in list(args) + list(kwargs.values()):
             if isinstance(v, ObjectRef):
                 task_args.append(TaskArg(ARG_REF, object_id=v.id,
@@ -755,16 +768,22 @@ class CoreWorker:
                 ser = self.serialization.serialize(v)
                 if ser.total_size > self.config.max_direct_call_object_size:
                     ref = await self.put_async(v)
+                    pin_refs.append(ref)
                     task_args.append(TaskArg(ARG_REF, object_id=ref.id,
                                              owner_address=self.address))
                 else:
                     task_args.append(TaskArg(ARG_INLINE, data=ser.to_bytes()))
         kw_names = list(kwargs.keys())
-        return task_args, kw_names
+        return task_args, kw_names, pin_refs
 
     async def submit_task(self, function_id: str, args: tuple, kwargs: dict,
                           **opts) -> List[ObjectRef]:
-        return self.submit_task_local(function_id, args, kwargs, **opts)
+        # Threaded-caller path keeps the original semantics: args are
+        # serialized BEFORE .remote() returns (mutation-after-submit is
+        # safe, serialization errors raise at the callsite).
+        prebuilt = await self._build_args(args, kwargs)
+        return self.submit_task_local(function_id, args, kwargs,
+                                      _prebuilt=prebuilt, **opts)
 
     def submit_task_local(self, function_id: str, args: tuple, kwargs: dict,
                           *, name: str = "", num_returns: int = 1,
@@ -772,7 +791,8 @@ class CoreWorker:
                           scheduling=None, max_retries: int = -1,
                           retry_exceptions: bool = False,
                           is_generator: bool = False,
-                          export: Optional[Any] = None) -> List[ObjectRef]:
+                          export: Optional[Any] = None,
+                          _prebuilt=None) -> List[ObjectRef]:
         """Synchronous submission: allocates ids/refs immediately and defers
         arg serialization + cluster dispatch to a background task.
 
@@ -809,7 +829,8 @@ class CoreWorker:
             arg_refs=[])
         self._record_task_event(spec, "PENDING")
         asyncio.ensure_future(
-            self._finish_task_submission(spec, args, kwargs, export))
+            self._finish_task_submission(spec, args, kwargs, export,
+                                         _prebuilt))
         return refs
 
     async def _await_export(self, export, function_id: str):
@@ -840,10 +861,12 @@ class CoreWorker:
             await self._pending_exports[function_id]
 
     async def _finish_task_submission(self, spec: TaskSpec, args, kwargs,
-                                      export=None):
+                                      export=None, prebuilt=None):
         try:
             await self._await_export(export, spec.function_id)
-            task_args, kw_names = await self._build_args(args, kwargs)
+            task_args, kw_names, pin_refs = (
+                prebuilt if prebuilt is not None
+                else await self._build_args(args, kwargs))
         except Exception as e:
             self._complete_task_error(spec, e, retry=False)
             return
@@ -852,7 +875,8 @@ class CoreWorker:
         spec.args = task_args
         if kw_names:
             spec.runtime_env = {"kwarg_names": kw_names}
-        self.pending_tasks[spec.task_id].arg_refs = self._pin_arg_refs(spec)
+        self.pending_tasks[spec.task_id].arg_refs = (
+            self._pin_arg_refs(spec) + pin_refs)
         await self._submit_to_cluster(spec)
 
     def _pin_arg_refs(self, spec: TaskSpec) -> List[ObjectRef]:
@@ -1100,8 +1124,10 @@ class CoreWorker:
 
     async def create_actor(self, class_function_id: str, args: tuple,
                            kwargs: dict, **opts) -> ActorID:
+        prebuilt = await self._build_args(args, kwargs)
         actor_id, done = self.create_actor_local(class_function_id, args,
-                                                 kwargs, **opts)
+                                                 kwargs, _prebuilt=prebuilt,
+                                                 **opts)
         await done  # propagate registration errors to threaded callers
         return actor_id
 
@@ -1112,7 +1138,7 @@ class CoreWorker:
                            max_task_retries: int = 0, max_concurrency: int = 1,
                            is_async: bool = False, name: str = "",
                            namespace: str = "", lifetime: str = "",
-                           export: Optional[Any] = None):
+                           export: Optional[Any] = None, _prebuilt=None):
         """Synchronous actor creation: returns (actor_id, done_future).
 
         Must run on the core loop thread. Arg serialization, optional class
@@ -1139,17 +1165,25 @@ class CoreWorker:
         self.actor_queues[actor_id] = q
         done = asyncio.ensure_future(
             self._finish_actor_creation(q, spec, args, kwargs, lifetime,
-                                        export))
+                                        export, _prebuilt))
         return actor_id, done
 
     async def _finish_actor_creation(self, q: "ActorSubmitQueue",
                                      spec: TaskSpec, args, kwargs,
-                                     lifetime: str, export=None):
+                                     lifetime: str, export=None,
+                                     prebuilt=None):
         try:
             await self._await_export(export, spec.function_id)
-            task_args, kw_names = await self._build_args(args, kwargs)
+            task_args, kw_names, pin_refs = (
+                prebuilt if prebuilt is not None
+                else await self._build_args(args, kwargs))
             spec.args = task_args
             spec.runtime_env = {"kwarg_names": kw_names, "lifetime": lifetime}
+            # Creation args must survive as long as the actor can be
+            # (re)instantiated — restarts re-fetch them — so the pins are
+            # released only on the DEAD pubsub event.
+            self._actor_creation_pins[spec.actor_id] = \
+                self._pin_arg_refs(spec) + pin_refs
             await self.gcs.request("register_actor", {"spec": spec})
         except Exception as e:
             q.set_state("DEAD", reason=f"actor registration failed: {e!r}")
@@ -1159,14 +1193,17 @@ class CoreWorker:
                                 args: tuple, kwargs: dict,
                                 num_returns: int = 1,
                                 max_task_retries: int = 0) -> List[ObjectRef]:
+        prebuilt = await self._build_args(args, kwargs)
         return self.submit_actor_task_local(actor_id, method_name, args,
                                             kwargs, num_returns,
-                                            max_task_retries)
+                                            max_task_retries,
+                                            _prebuilt=prebuilt)
 
     def submit_actor_task_local(self, actor_id: ActorID, method_name: str,
                                 args: tuple, kwargs: dict,
                                 num_returns: int = 1,
-                                max_task_retries: int = 0) -> List[ObjectRef]:
+                                max_task_retries: int = 0,
+                                _prebuilt=None) -> List[ObjectRef]:
         """Synchronous actor-task submission (core loop thread only).
 
         The sequence number is reserved and the spec registered in the
@@ -1197,22 +1234,34 @@ class CoreWorker:
             spec=spec, retries_left=max_task_retries, returns=returns,
             arg_refs=[])
         asyncio.ensure_future(
-            self._finish_actor_task_submission(q, spec, args, kwargs))
+            self._finish_actor_task_submission(q, spec, args, kwargs,
+                                               _prebuilt))
         return refs
 
     async def _finish_actor_task_submission(self, q: "ActorSubmitQueue",
-                                            spec: TaskSpec, args, kwargs):
+                                            spec: TaskSpec, args, kwargs,
+                                            prebuilt=None):
         try:
-            task_args, kw_names = await self._build_args(args, kwargs)
+            task_args, kw_names, pin_refs = (
+                prebuilt if prebuilt is not None
+                else await self._build_args(args, kwargs))
         except Exception as e:
-            q.inflight.pop(spec.seq_no, None)
+            # Fail the caller's refs, but the reserved seq number MUST still
+            # reach the actor: the receiver gates task start on contiguous
+            # seq numbers, so a silent gap would hang every later call from
+            # this caller. Send a no-op marker occupying the slot.
             self._complete_task_error(spec, e, retry=False)
+            spec.method_name = SEQ_SKIP_METHOD
+            spec.args = []
+            spec.runtime_env = None
+            await self._submit_actor_task(q, spec)
             return
         if spec.task_id not in self.pending_tasks:
             return  # cancelled before dispatch
         spec.args = task_args
         spec.runtime_env = {"kwarg_names": kw_names} if kw_names else None
-        self.pending_tasks[spec.task_id].arg_refs = self._pin_arg_refs(spec)
+        self.pending_tasks[spec.task_id].arg_refs = (
+            self._pin_arg_refs(spec) + pin_refs)
         await self._submit_actor_task(q, spec)
 
     def _ensure_actor_queue(self, actor_id: ActorID) -> ActorSubmitQueue:
@@ -1284,7 +1333,8 @@ class CoreWorker:
                             q.actor_id, "actor worker died mid-call"),
                         retry=False)
                     return
-                self._handle_task_reply(spec, reply, "")
+                if spec.method_name != SEQ_SKIP_METHOD:
+                    self._handle_task_reply(spec, reply, "")
                 return
         finally:
             q.inflight.pop(spec.seq_no, None)
@@ -1412,11 +1462,18 @@ class CoreWorker:
 
     async def _rpc_instantiate_actor(self, conn, payload):
         spec: TaskSpec = payload["spec"]
-        cls = await self._load_function(spec.function_id)
-        args, kwargs = await self._resolve_task_args(spec)
-        loop = asyncio.get_running_loop()
-        instance = await loop.run_in_executor(
-            self._exec_pool, lambda: cls(*args, **kwargs))
+        try:
+            cls = await self._load_function(spec.function_id)
+            args, kwargs = await self._resolve_task_args(spec)
+            loop = asyncio.get_running_loop()
+            instance = await loop.run_in_executor(
+                self._exec_pool, lambda: cls(*args, **kwargs))
+        except Exception:
+            # Application error in the constructor: report it as data, not
+            # an RPC failure — the GCS must count it against max_restarts
+            # instead of rescheduling forever.
+            import traceback
+            return {"app_error": traceback.format_exc()}
         self.executing_actor = instance
         self.executing_actor_info = {
             "spec": spec, "max_concurrency": spec.max_concurrency,
@@ -1449,6 +1506,10 @@ class CoreWorker:
         nxt = buf.pop(spec.seq_no + 1, None)
         if nxt is not None and not nxt.done():
             nxt.set_result(None)
+        if spec.method_name == SEQ_SKIP_METHOD:
+            # Seq-slot placeholder for a submission that failed caller-side
+            # (e.g. unserializable args): ordering advanced, nothing to run.
+            return {"returns": []}
         return await self._execute_actor_task(spec)
 
     async def _execute_actor_task(self, spec: TaskSpec) -> dict:

@@ -314,6 +314,16 @@ class GcsServer:
             if actor.state != ACTOR_DEAD:
                 asyncio.ensure_future(self._schedule_actor(actor, delay=0.5))
             return
+        if isinstance(result, dict) and result.get("app_error"):
+            # The constructor itself raised — an application error, counted
+            # against max_restarts (infinite rescheduling would hang every
+            # caller with a buggy __init__).
+            logger.warning("actor %s constructor failed:\n%s",
+                           actor.actor_id.hex()[:12], result["app_error"])
+            await self._handle_actor_failure(
+                actor,
+                f"actor constructor raised:\n{result['app_error']}")
+            return
         if actor.state == ACTOR_DEAD:
             # Killed while creation was in flight: tear the worker down so
             # its lease and resources return to the node.

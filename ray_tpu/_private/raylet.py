@@ -545,7 +545,8 @@ class Raylet:
         worker.lease_pg = pg_key
         self._resources_dirty = True
         try:
-            await self.clients.request(worker.address, "instantiate_actor", {
+            reply = await self.clients.request(worker.address,
+                                               "instantiate_actor", {
                 "spec": spec, "num_restarts": payload.get("num_restarts", 0)},
                 timeout=self.config.worker_start_timeout_s)
         except Exception:
@@ -554,6 +555,20 @@ class Raylet:
             worker.actor_id = None
             self.pool.release(spec.resources, pg_key)
             raise
+        if isinstance(reply, dict) and reply.get("app_error"):
+            # Constructor raised: the worker is still healthy — return it
+            # to the idle pool (it was popped by _get_idle_worker; without
+            # this it would leak, unleasable, one process per attempt) and
+            # surface the error to the GCS as data.
+            worker.leased = False
+            worker.is_actor_worker = False
+            worker.actor_id = None
+            worker.idle_since = time.time()
+            if worker not in self._idle_workers:
+                self._idle_workers.append(worker)
+            self.pool.release(spec.resources, pg_key)
+            self._resources_dirty = True
+            return {"app_error": reply["app_error"]}
         return {"actor_address": worker.address, "worker_id": worker.worker_id}
 
     async def rpc_kill_worker(self, conn, payload):

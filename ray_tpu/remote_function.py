@@ -70,12 +70,14 @@ class RemoteFunction:
         rf._function_id = self._function_id
         return rf
 
-    def _ensure_exported(self, core) -> str:
+    def _fid(self) -> str:
         if self._function_id is None:
             data = cloudpickle.dumps(self._function)
             self._function_id = "fn:" + hashlib.sha1(data).hexdigest()
-            self._export_payload = data
-        fid = self._function_id
+        return self._function_id
+
+    def _ensure_exported(self, core) -> str:
+        fid = self._fid()
         if not worker_api._state.exported_functions.get(fid):
             worker_api._call_on_core_loop(
                 core, core.export_function(self._function, fid), 30)
@@ -91,10 +93,7 @@ class RemoteFunction:
         if on_loop:
             # Async-actor context: defer the function export; it is chained
             # before dispatch inside the submission's background task.
-            if self._function_id is None:
-                data = cloudpickle.dumps(self._function)
-                self._function_id = "fn:" + hashlib.sha1(data).hexdigest()
-            fid = self._function_id
+            fid = self._fid()
             if not worker_api._state.exported_functions.get(fid):
                 export = (self._function, fid)
                 worker_api._state.exported_functions[fid] = True

@@ -1,0 +1,24 @@
+"""ray_tpu.rllib: reinforcement learning on the actor substrate.
+
+Reference parity: rllib/ (Algorithm algorithms/algorithm.py:202,
+AlgorithmConfig algorithms/algorithm_config.py:125, EnvRunner
+env/env_runner.py:15, SampleBatch policy/sample_batch.py:99, PPO
+algorithms/ppo/ppo.py:405, IMPALA algorithms/impala/impala.py:667).
+
+TPU-first deltas: policies/learners are pure JAX (init/apply + jitted
+update); rollout workers are CPU actors; the learner batch is a single
+device_put + one fused jit step instead of a torch DDP loop.
+"""
+
+from ray_tpu.rllib.env import CartPoleEnv, EnvSpec, make_env, register_env
+from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.impala import Impala, ImpalaConfig
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Impala",
+    "ImpalaConfig", "EnvSpec", "CartPoleEnv", "make_env", "register_env",
+    "SampleBatch", "concat_samples", "ReplayBuffer",
+]

@@ -1,0 +1,183 @@
+"""Algorithm base + fluent AlgorithmConfig.
+
+Reference parity: rllib/algorithms/algorithm.py:202 (Algorithm extends the
+Tune Trainable so `tune.Tuner(PPO)` works) and algorithm_config.py:125
+(fluent .environment()/.env_runners()/.training() builder).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Type
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        self.env = "CartPole-v1"
+        self.env_config: Dict[str, Any] = {}
+        self.num_env_runners = 2
+        self.num_envs_per_env_runner = 1
+        self.rollout_fragment_length = 200
+        self.gamma = 0.99
+        self.lr = 5e-4
+        self.train_batch_size = 0  # 0 => runners * envs * fragment
+        self.minibatch_size = 128
+        self.num_epochs = 8
+        self.hidden = (64, 64)
+        self.seed = 0
+        self.extra: Dict[str, Any] = {}
+
+    # -- fluent sections (reference: AlgorithmConfig.environment etc.) ----
+    def environment(self, env=None, *, env_config=None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def env_runners(self, *, num_env_runners=None,
+                    num_envs_per_env_runner=None,
+                    rollout_fragment_length=None) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, gamma=None, lr=None, train_batch_size=None,
+                 minibatch_size=None, num_epochs=None,
+                 model=None, **extra) -> "AlgorithmConfig":
+        if gamma is not None:
+            self.gamma = gamma
+        if lr is not None:
+            self.lr = lr
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        if minibatch_size is not None:
+            self.minibatch_size = minibatch_size
+        if num_epochs is not None:
+            self.num_epochs = num_epochs
+        if model is not None and "fcnet_hiddens" in model:
+            self.hidden = tuple(model["fcnet_hiddens"])
+        self.extra.update(extra)
+        return self
+
+    def debugging(self, *, seed=None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self) -> "Algorithm":
+        cls = self.algo_class
+        if cls is None:
+            raise ValueError("no algo_class bound to this config")
+        return cls(config=self)
+
+    def to_dict(self) -> dict:
+        d = {k: v for k, v in self.__dict__.items()
+             if k not in ("algo_class",)}
+        return d
+
+
+class Algorithm(Trainable):
+    """Base: owns EnvRunner actors; subclasses implement training_step().
+
+    As a tune.Trainable, config may be an AlgorithmConfig or a plain dict
+    (Tune param_space path).
+    """
+
+    config_class: Type[AlgorithmConfig] = AlgorithmConfig
+
+    def __init__(self, config=None):
+        if isinstance(config, AlgorithmConfig):
+            self.algo_config = config
+        else:
+            self.algo_config = self.config_class(type(self))
+            for k, v in (config or {}).items():
+                if hasattr(self.algo_config, k):
+                    setattr(self.algo_config, k, v)
+                else:
+                    self.algo_config.extra[k] = v
+        self._iteration = 0
+        super().__init__(self.algo_config.to_dict()
+                         if isinstance(config, AlgorithmConfig)
+                         else (config or {}))
+
+    # -- Trainable API ------------------------------------------------------
+    def setup(self, config: Dict[str, Any]):
+        from ray_tpu.rllib.env import get_env_creator
+        from ray_tpu.rllib.env_runner import EnvRunner
+        cfg = self.algo_config
+        # Resolve the env creator here (driver-side registry) so custom
+        # registered envs work inside worker processes.
+        creator = get_env_creator(cfg.env)
+        runner_cls = ray_tpu.remote(num_cpus=1)(EnvRunner)
+        self.env_runners = [
+            runner_cls.remote(creator, cfg.env_config,
+                              cfg.num_envs_per_env_runner,
+                              seed=cfg.seed + 1000 * i,
+                              hidden=cfg.hidden)
+            for i in range(cfg.num_env_runners)
+        ]
+        self._episode_rewards: List[float] = []
+        self.build_learner()
+
+    def build_learner(self):
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self) -> Dict[str, Any]:
+        self._iteration += 1
+        result = self.training_step()
+        rewards = []
+        for r in ray_tpu.get(
+                [er.episode_rewards.remote() for er in self.env_runners]):
+            rewards.extend(r)
+        self._episode_rewards.extend(rewards)
+        recent = self._episode_rewards[-100:]
+        result.setdefault("episode_reward_mean",
+                          float(np.mean(recent)) if recent else float("nan"))
+        result.setdefault("episodes_total", len(self._episode_rewards))
+        result.setdefault("training_iteration", self._iteration)
+        return result
+
+    def train(self) -> Dict[str, Any]:
+        return self.step()
+
+    def sample_all_runners(self) -> List:
+        """Fan out one rollout per runner; returns refs (pipelining is the
+        caller's choice)."""
+        cfg = self.algo_config
+        return [er.sample.remote(cfg.rollout_fragment_length, cfg.gamma,
+                                 self.gae_lambda())
+                for er in self.env_runners]
+
+    def gae_lambda(self) -> float:
+        return getattr(self.algo_config, "lambda_", 0.95)
+
+    def broadcast_weights(self, params):
+        ray_tpu.get([er.set_weights.remote(params)
+                     for er in self.env_runners])
+
+    def cleanup(self):
+        for er in getattr(self, "env_runners", []):
+            try:
+                ray_tpu.kill(er)
+            except Exception:
+                pass
+
+    def stop(self):
+        self.cleanup()

@@ -1,0 +1,78 @@
+"""IMPALA-style asynchronous PPO variant.
+
+Reference parity: rllib/algorithms/impala/impala.py:667 — rollouts are
+pipelined: the learner consumes whichever runner finishes first and
+immediately re-dispatches it, so sampling and learning overlap and weight
+broadcast is off the critical path. Off-policy drift is corrected by the
+PPO clip (a lightweight stand-in for V-trace).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.sample_batch import concat_samples
+
+
+class ImpalaConfig(PPOConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or Impala)
+        self.num_batches_per_step = 4
+        self.broadcast_interval = 2
+
+    def training(self, *, num_batches_per_step=None,
+                 broadcast_interval=None, **kw) -> "ImpalaConfig":
+        super().training(**kw)
+        if num_batches_per_step is not None:
+            self.num_batches_per_step = num_batches_per_step
+        if broadcast_interval is not None:
+            self.broadcast_interval = broadcast_interval
+        return self
+
+
+class Impala(PPO):
+    config_class = ImpalaConfig
+
+    def setup(self, config):
+        super().setup(config)
+        cfg = self.algo_config
+        # Prime the pipeline: one in-flight rollout per runner.
+        self._inflight = {
+            er.sample.remote(cfg.rollout_fragment_length, cfg.gamma,
+                             self.gae_lambda()): er
+            for er in self.env_runners
+        }
+        self._consumed_since_broadcast = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        metrics: Dict[str, Any] = {}
+        steps = 0
+        for _ in range(cfg.num_batches_per_step):
+            done, _ = ray_tpu.wait(list(self._inflight.keys()),
+                                   num_returns=1, timeout=60.0)
+            if not done:
+                break
+            ref = done[0]
+            runner = self._inflight.pop(ref)
+            batch = ray_tpu.get(ref)
+            # Immediately re-dispatch the runner (async pipelining).
+            self._inflight[runner.sample.remote(
+                cfg.rollout_fragment_length, cfg.gamma,
+                self.gae_lambda())] = runner
+            m = self.learner.update(
+                batch, minibatch_size=min(cfg.minibatch_size, len(batch)),
+                num_epochs=1, seed=cfg.seed + self._iteration)
+            steps += len(batch)
+            metrics.update(m)
+            self._consumed_since_broadcast += 1
+            if self._consumed_since_broadcast >= cfg.broadcast_interval:
+                # Off the critical path: fire-and-forget weight pushes.
+                params = self.learner.get_weights()
+                for er in self.env_runners:
+                    er.set_weights.remote(params)
+                self._consumed_since_broadcast = 0
+        metrics["num_env_steps_sampled"] = steps
+        return metrics

@@ -1,0 +1,72 @@
+"""PPO (reference: rllib/algorithms/ppo/ppo.py:405 training_step).
+
+Synchronous: fan out rollouts to all EnvRunners, GAE on the runners,
+minibatch-SGD the jitted learner, broadcast weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.learner import PPOLearner
+from ray_tpu.rllib.sample_batch import concat_samples
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or PPO)
+        self.lambda_ = 0.95
+        self.clip_param = 0.2
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+
+    def training(self, *, lambda_=None, clip_param=None, vf_loss_coeff=None,
+                 entropy_coeff=None, **kw) -> "PPOConfig":
+        super().training(**kw)
+        if lambda_ is not None:
+            self.lambda_ = lambda_
+        if clip_param is not None:
+            self.clip_param = clip_param
+        if vf_loss_coeff is not None:
+            self.vf_loss_coeff = vf_loss_coeff
+        if entropy_coeff is not None:
+            self.entropy_coeff = entropy_coeff
+        return self
+
+
+class PPO(Algorithm):
+    config_class = PPOConfig
+
+    def build_learner(self):
+        cfg = self.algo_config
+        probe = make_env(cfg.env, cfg.env_config)
+        self.learner = PPOLearner(
+            probe.observation_dim, probe.num_actions,
+            hidden=cfg.hidden, lr=cfg.lr,
+            clip_param=getattr(cfg, "clip_param", 0.2),
+            vf_coeff=getattr(cfg, "vf_loss_coeff", 0.5),
+            entropy_coeff=getattr(cfg, "entropy_coeff", 0.0),
+            seed=cfg.seed)
+        self.broadcast_weights(self.learner.get_weights())
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        batch = concat_samples(ray_tpu.get(self.sample_all_runners()))
+        metrics = self.learner.update(
+            batch, minibatch_size=min(cfg.minibatch_size, len(batch)),
+            num_epochs=cfg.num_epochs, seed=cfg.seed + self._iteration)
+        self.broadcast_weights(self.learner.get_weights())
+        metrics["num_env_steps_sampled"] = len(batch)
+        return metrics
+
+    def save_checkpoint(self):
+        return {"params": self.learner.get_weights(),
+                "iteration": self._iteration}
+
+    def load_checkpoint(self, ckpt):
+        self.learner.set_weights(ckpt["params"])
+        self._iteration = ckpt.get("iteration", 0)
+        self.broadcast_weights(self.learner.get_weights())

@@ -1,0 +1,112 @@
+"""Environments: gym-style API + a dependency-free CartPole.
+
+Reference parity: rllib/env/ (EnvRunner-compatible envs). The registry
+mirrors rllib's tune.register_env; CartPole-v1 dynamics follow the classic
+control formulation so learning curves are comparable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class Env:
+    """Minimal gym-style interface: reset() -> (obs, info);
+    step(a) -> (obs, reward, terminated, truncated, info)."""
+
+    observation_dim: int
+    num_actions: int
+
+    def reset(self, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action):
+        raise NotImplementedError
+
+
+class CartPoleEnv(Env):
+    """CartPole-v1 (no gym dependency; same constants/termination)."""
+
+    observation_dim = 4
+    num_actions = 2
+
+    def __init__(self, max_steps: int = 500):
+        self._rng = np.random.RandomState()
+        self._max_steps = max_steps
+        self._g = 9.8
+        self._mc = 1.0
+        self._mp = 0.1
+        self._l = 0.5
+        self._force = 10.0
+        self._dt = 0.02
+        self._theta_lim = 12 * 2 * np.pi / 360
+        self._x_lim = 2.4
+        self._state = None
+        self._t = 0
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._t = 0
+        return self._state.astype(np.float32).copy(), {}
+
+    def step(self, action):
+        x, x_dot, th, th_dot = self._state
+        force = self._force if action == 1 else -self._force
+        costh, sinth = np.cos(th), np.sin(th)
+        total_m = self._mc + self._mp
+        pml = self._mp * self._l
+        temp = (force + pml * th_dot ** 2 * sinth) / total_m
+        th_acc = (self._g * sinth - costh * temp) / (
+            self._l * (4.0 / 3.0 - self._mp * costh ** 2 / total_m))
+        x_acc = temp - pml * th_acc * costh / total_m
+        x = x + self._dt * x_dot
+        x_dot = x_dot + self._dt * x_acc
+        th = th + self._dt * th_dot
+        th_dot = th_dot + self._dt * th_acc
+        self._state = np.array([x, x_dot, th, th_dot])
+        self._t += 1
+        terminated = bool(abs(x) > self._x_lim or abs(th) > self._theta_lim)
+        truncated = self._t >= self._max_steps
+        return (self._state.astype(np.float32).copy(), 1.0, terminated,
+                truncated, {})
+
+
+_ENV_REGISTRY: Dict[str, Callable[[dict], Env]] = {
+    "CartPole-v1": lambda cfg: CartPoleEnv(**cfg),
+}
+
+
+def register_env(name: str, creator: Callable[[dict], Env]):
+    """tune.register_env equivalent (reference: rllib env registry)."""
+    _ENV_REGISTRY[name] = creator
+
+
+def get_env_creator(spec) -> Callable[[dict], Env]:
+    """Resolve a spec to its creator callable ON THE DRIVER, so the callable
+    (not a registry name) ships to EnvRunner actors — worker processes have
+    their own empty registry."""
+    if isinstance(spec, str):
+        if spec not in _ENV_REGISTRY:
+            raise ValueError(f"unknown env {spec!r}; "
+                             f"register_env() it first")
+        return _ENV_REGISTRY[spec]
+    if callable(spec):
+        return spec
+    raise TypeError(f"env spec must be str or callable, got {type(spec)}")
+
+
+def make_env(spec, config: Optional[dict] = None) -> Env:
+    return get_env_creator(spec)(config or {})
+
+
+class EnvSpec:
+    def __init__(self, spec, config: Optional[dict] = None):
+        self.spec = spec
+        self.config = config or {}
+
+    def make(self) -> Env:
+        return make_env(self.spec, self.config)

@@ -1,0 +1,103 @@
+"""EnvRunner: actor that steps environments with the current policy.
+
+Reference parity: rllib/env/env_runner.py:15 + evaluation/rollout_worker.py
+:159. Runs on CPU actors; the policy forward is a small jitted JAX function
+on the host. Weights are broadcast from the learner via set_weights (a
+plasma object, zero-copy to all runners on one node).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.models import policy_value_apply, policy_value_init
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
+
+
+class EnvRunner:
+    def __init__(self, env_spec, env_config: dict, num_envs: int,
+                 seed: int, hidden=(64, 64)):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        self._envs = [make_env(env_spec, env_config) for _ in range(num_envs)]
+        self._obs = []
+        self._ep_rewards = [0.0] * num_envs
+        self._done_rewards: List[float] = []
+        for i, e in enumerate(self._envs):
+            obs, _ = e.reset(seed=seed + i)
+            self._obs.append(obs)
+        self._rng = np.random.RandomState(seed)
+        obs_dim = self._envs[0].observation_dim
+        n_act = self._envs[0].num_actions
+        self._params = policy_value_init(jax.random.PRNGKey(seed), obs_dim,
+                                         hidden=tuple(hidden),
+                                         num_actions=n_act)
+        self._jit_forward = jax.jit(policy_value_apply)
+
+    def set_weights(self, params):
+        self._params = params
+
+    def sample(self, num_steps: int, gamma: float = 0.99,
+               lam: float = 0.95) -> SampleBatch:
+        """Collect num_steps per env; returns a postprocessed batch with
+        GAE advantages."""
+        import jax.nn
+        n_envs = len(self._envs)
+        cols = (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.TERMINATEDS,
+                sb.TRUNCATEDS, sb.LOGPS, sb.VF_PREDS, sb.BOOTSTRAP_VALUES)
+        per_env: List[Dict[str, List]] = [
+            {k: [] for k in cols} for _ in range(n_envs)]
+        for _t in range(num_steps):
+            obs_arr = np.stack(self._obs)
+            logits, values = self._jit_forward(self._params, obs_arr)
+            logits = np.asarray(logits)
+            values = np.asarray(values)
+            probs = np.exp(logits - logits.max(-1, keepdims=True))
+            probs /= probs.sum(-1, keepdims=True)
+            for i, env in enumerate(self._envs):
+                a = self._rng.choice(len(probs[i]), p=probs[i])
+                logp = np.log(probs[i][a] + 1e-10)
+                obs2, r, term, trunc, _ = env.step(a)
+                rec = per_env[i]
+                rec[sb.OBS].append(self._obs[i])
+                rec[sb.ACTIONS].append(a)
+                rec[sb.REWARDS].append(r)
+                rec[sb.TERMINATEDS].append(term)
+                rec[sb.TRUNCATEDS].append(trunc)
+                rec[sb.LOGPS].append(logp)
+                rec[sb.VF_PREDS].append(values[i])
+                # Truncated (not terminated) steps bootstrap from V of the
+                # next obs BEFORE the reset wipes it.
+                boot = 0.0
+                if trunc and not term:
+                    _lg, bv = self._jit_forward(self._params, obs2[None, :])
+                    boot = float(np.asarray(bv)[0])
+                rec[sb.BOOTSTRAP_VALUES].append(boot)
+                self._ep_rewards[i] += r
+                if term or trunc:
+                    self._done_rewards.append(self._ep_rewards[i])
+                    self._ep_rewards[i] = 0.0
+                    obs2, _ = env.reset()
+                self._obs[i] = obs2
+        batches = []
+        obs_arr = np.stack(self._obs)
+        _, last_values = self._jit_forward(self._params, obs_arr)
+        last_values = np.asarray(last_values)
+        for i in range(n_envs):
+            b = SampleBatch({k: np.asarray(v) for k, v in per_env[i].items()})
+            last_v = 0.0 if b[sb.TERMINATEDS][-1] else float(last_values[i])
+            batches.append(compute_gae(b, last_v, gamma, lam))
+        return sb.concat_samples(batches)
+
+    def episode_rewards(self, clear: bool = True) -> List[float]:
+        out = list(self._done_rewards)
+        if clear:
+            self._done_rewards.clear()
+        return out
+
+    def ping(self):
+        return True

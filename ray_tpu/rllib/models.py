@@ -1,0 +1,63 @@
+"""Policy/value networks as pure JAX functions.
+
+Reference parity: rllib/models/ (the default fully-connected nets) +
+rllib/core/rl_module/rl_module.py:237 conceptually — a module is
+(init_fn, apply_fn) over a params pytree, jit/pmap-able by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+
+def mlp_init(rng, sizes: List[int], dtype=None) -> List[Dict[str, Any]]:
+    import jax
+    import jax.numpy as jnp
+    dtype = dtype or jnp.float32
+    params = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for k, (fan_in, fan_out) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        w = jax.random.orthogonal(k, max(fan_in, fan_out))[:fan_in, :fan_out]
+        w = w * np.sqrt(2.0)
+        params.append({"w": jnp.asarray(w, dtype),
+                       "b": jnp.zeros((fan_out,), dtype)})
+    return params
+
+
+def mlp_apply(params, x, final_scale: float = 1.0):
+    import jax.numpy as jnp
+    h = x
+    for i, layer in enumerate(params):
+        h = h @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            h = jnp.tanh(h)
+    return h * final_scale
+
+
+def policy_value_init(rng, obs_dim: int, num_actions: int,
+                      hidden: Tuple[int, ...] = (64, 64)):
+    """Separate policy and value MLPs (rllib default fcnet)."""
+    import jax
+    k1, k2 = jax.random.split(rng)
+    return {
+        "pi": mlp_init(k1, [obs_dim, *hidden, num_actions]),
+        "vf": mlp_init(k2, [obs_dim, *hidden, 1]),
+    }
+
+
+def policy_value_apply(params, obs):
+    """-> (logits, value)."""
+    logits = mlp_apply(params["pi"], obs, final_scale=0.01)
+    value = mlp_apply(params["vf"], obs)[..., 0]
+    return logits, value
+
+
+def sample_action(rng, logits):
+    """Categorical sample + log-prob."""
+    import jax
+    import jax.numpy as jnp
+    a = jax.random.categorical(rng, logits)
+    logp = jax.nn.log_softmax(logits)[jnp.arange(logits.shape[0]), a]
+    return a, logp

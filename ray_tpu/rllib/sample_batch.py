@@ -1,0 +1,110 @@
+"""SampleBatch: columnar trajectory storage.
+
+Reference parity: rllib/policy/sample_batch.py:99 (standard keys, concat,
+minibatch iteration). Columns are numpy arrays; a batch converts to a jax
+pytree with one device_put at the learner boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+TERMINATEDS = "terminateds"
+TRUNCATEDS = "truncateds"
+NEXT_OBS = "next_obs"
+LOGPS = "action_logp"
+VF_PREDS = "vf_preds"
+ADVANTAGES = "advantages"
+VALUE_TARGETS = "value_targets"
+EPS_ID = "eps_id"
+
+
+class SampleBatch(dict):
+    """dict[str, np.ndarray] with batch helpers."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        for k, v in list(self.items()):
+            if not isinstance(v, np.ndarray):
+                self[k] = np.asarray(v)
+
+    def __len__(self) -> int:
+        for v in self.values():
+            return len(v)
+        return 0
+
+    @property
+    def count(self) -> int:
+        return len(self)
+
+    def shuffle(self, seed: Optional[int] = None) -> "SampleBatch":
+        rng = np.random.RandomState(seed)
+        idx = rng.permutation(len(self))
+        return SampleBatch({k: v[idx] for k, v in self.items()})
+
+    def minibatches(self, minibatch_size: int,
+                    num_epochs: int = 1,
+                    seed: Optional[int] = None) -> Iterator["SampleBatch"]:
+        n = len(self)
+        rng = np.random.RandomState(seed)
+        for _ in range(num_epochs):
+            idx = rng.permutation(n)
+            for start in range(0, n - minibatch_size + 1, minibatch_size):
+                sel = idx[start:start + minibatch_size]
+                yield SampleBatch({k: v[sel] for k, v in self.items()})
+
+    def slice(self, start: int, end: int) -> "SampleBatch":
+        return SampleBatch({k: v[start:end] for k, v in self.items()})
+
+
+def concat_samples(batches: List[SampleBatch]) -> SampleBatch:
+    batches = [b for b in batches if len(b)]
+    if not batches:
+        return SampleBatch()
+    keys = batches[0].keys()
+    return SampleBatch({k: np.concatenate([b[k] for b in batches])
+                        for k in keys})
+
+
+BOOTSTRAP_VALUES = "bootstrap_values"
+
+
+def compute_gae(batch: SampleBatch, last_value: float, gamma: float,
+                lam: float) -> SampleBatch:
+    """Generalized advantage estimation over one rollout fragment.
+
+    Reference parity: rllib/evaluation/postprocessing.py
+    (compute_advantages). Episode boundaries inside the fragment cut the
+    recursion; truncated (not terminated) steps bootstrap from
+    batch["bootstrap_values"] — V(s_{t+1}) computed by the env runner
+    BEFORE the env reset — and the fragment tail bootstraps from
+    last_value.
+    """
+    rewards = batch[REWARDS]
+    values = batch[VF_PREDS]
+    terminateds = batch[TERMINATEDS]
+    truncateds = batch.get(TRUNCATEDS, np.zeros_like(terminateds))
+    bootstrap = batch.get(BOOTSTRAP_VALUES, np.zeros_like(values))
+    n = len(rewards)
+    adv = np.zeros(n, dtype=np.float32)
+    last_gae = 0.0
+    for t in reversed(range(n)):
+        if terminateds[t]:
+            delta = rewards[t] - values[t]
+            last_gae = delta
+        elif truncateds[t]:
+            delta = rewards[t] + gamma * bootstrap[t] - values[t]
+            last_gae = delta
+        else:
+            next_v = last_value if t == n - 1 else values[t + 1]
+            delta = rewards[t] + gamma * next_v - values[t]
+            last_gae = delta + gamma * lam * last_gae
+        adv[t] = last_gae
+    batch[ADVANTAGES] = adv
+    batch[VALUE_TARGETS] = (adv + values).astype(np.float32)
+    return batch

@@ -170,12 +170,18 @@ class ServeController:
     async def _health_check(self):
         now = time.monotonic()
         for st in list(self._deployments.values()):
-            for i, r in reversed(list(enumerate(st.replicas))):
+            async def check(r):
                 try:
-                    ok = await asyncio.wait_for(
+                    return await asyncio.wait_for(
                         r.handle.check_health.remote().future(), timeout=5)
                 except Exception:
-                    ok = False
+                    return False
+            # Probe all replicas concurrently: serial checks would make one
+            # slow/dead replica delay the whole reconcile pass by its
+            # timeout multiplied by the replica count.
+            oks = await asyncio.gather(*[check(r) for r in st.replicas])
+            for i, r in reversed(list(enumerate(st.replicas))):
+                ok = oks[i]
                 if ok:
                     r.ever_healthy = True
                     continue
@@ -200,14 +206,15 @@ class ServeController:
             asc = st.config.autoscaling_config
             if asc is None or not st.replicas:
                 continue
-            total = 0.0
-            for r in st.replicas:
+            async def metrics(r):
                 try:
-                    m = await asyncio.wait_for(
+                    return await asyncio.wait_for(
                         r.handle.get_metrics.remote().future(), timeout=5)
-                    total += m["ongoing"]
                 except Exception:
-                    pass
+                    return None
+            results = await asyncio.gather(
+                *[metrics(r) for r in st.replicas])
+            total = sum(m["ongoing"] for m in results if m)
             desired = asc.decide(len(st.replicas), total)
             delay = (asc.upscale_delay_s if desired > st.target_num
                      else asc.downscale_delay_s)

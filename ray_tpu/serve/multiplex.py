@@ -26,6 +26,7 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
 
     def wrap(fn):
         attr = f"__serve_mux_cache_{fn.__name__}"
+        loading_attr = f"__serve_mux_loading_{fn.__name__}"
 
         @functools.wraps(fn)
         async def wrapper(owner, model_id: str):
@@ -36,9 +37,28 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
             if model_id in cache:
                 cache.move_to_end(model_id)
                 return cache[model_id]
-            model = fn(owner, model_id)
-            if asyncio.iscoroutine(model):
-                model = await model
+            # Single-flight per model id: concurrent requests for the same
+            # uncached model share one load (a duplicate load would be
+            # dropped without its unload hook — a device-memory leak).
+            loading: dict = getattr(owner, loading_attr, None)
+            if loading is None:
+                loading = {}
+                setattr(owner, loading_attr, loading)
+            if model_id in loading:
+                return await asyncio.shield(loading[model_id])
+
+            async def load():
+                model = fn(owner, model_id)
+                if asyncio.iscoroutine(model):
+                    model = await model
+                return model
+
+            task = asyncio.ensure_future(load())
+            loading[model_id] = task
+            try:
+                model = await task
+            finally:
+                loading.pop(model_id, None)
             cache[model_id] = model
             cache.move_to_end(model_id)
             while len(cache) > max_num_models_per_replica:

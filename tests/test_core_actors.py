@@ -195,3 +195,48 @@ class TestActorResources:
                 break
             time.sleep(0.1)
         assert ray.available_resources()["CPU"] == 4.0
+
+
+class TestActorCreationFailure:
+    def test_constructor_error_fails_fast(self, ray_shared):
+        """A raising __init__ must mark the actor DEAD after restarts are
+        exhausted (not reschedule forever and hang every caller)."""
+        import pytest
+        from ray_tpu import exceptions as exc
+        ray = ray_shared
+
+        @ray.remote
+        class Broken:
+            def __init__(self):
+                raise ValueError("constructor boom")
+
+            def ping(self):
+                return 1
+
+        b = Broken.remote()
+        with pytest.raises(exc.ActorDiedError) as ei:
+            ray.get(b.ping.remote(), timeout=30)
+        assert "constructor" in str(ei.value)
+
+    def test_bad_arg_does_not_wedge_actor_queue(self, ray_shared):
+        """A submission whose args fail to serialize must error that call
+        only — later calls to the same actor must still run (the reserved
+        seq slot is released with a no-op marker)."""
+        import pytest
+        ray = ray_shared
+
+        class Unserializable:
+            def __reduce__(self):
+                raise RuntimeError("cannot pickle me")
+
+        @ray.remote
+        class Echo:
+            def echo(self, x):
+                return x
+
+        a = Echo.remote()
+        assert ray.get(a.echo.remote(1), timeout=30) == 1
+        with pytest.raises(Exception):
+            ray.get(a.echo.remote(Unserializable()), timeout=30)
+        # The queue must not be wedged by the failed seq slot.
+        assert ray.get(a.echo.remote(2), timeout=30) == 2
