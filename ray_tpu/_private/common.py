@@ -25,6 +25,12 @@ class TaskArg:
     object_id: Optional[ObjectID] = None    # for ARG_REF
     owner_address: str = ""
 
+    def __reduce__(self):
+        # Positional tuple: every task/actor call pickles specs, so skip the
+        # dataclass default of shipping __dict__ with field-name strings.
+        return (TaskArg, (self.kind, self.data, self.object_id,
+                          self.owner_address))
+
 
 @dataclass
 class SchedulingStrategy:
@@ -35,6 +41,12 @@ class SchedulingStrategy:
     placement_group_id: Optional[PlacementGroupID] = None
     bundle_index: int = -1
     capture_child_tasks: bool = False
+
+    def __reduce__(self):
+        return (SchedulingStrategy,
+                (self.kind, self.node_id, self.soft,
+                 self.placement_group_id, self.bundle_index,
+                 self.capture_child_tasks))
 
 
 @dataclass
@@ -78,6 +90,19 @@ class TaskSpec:
             self.scheduling.bundle_index,
             self.runtime_env is not None and tuple(sorted(map(str, self.runtime_env.items()))),
         )
+
+    def __reduce__(self):
+        # Hot path: pickled once per task/actor call. Positional tuple in
+        # dataclass field order (init assigns them straight back).
+        return (TaskSpec, (
+            self.task_id, self.job_id, self.name, self.function_id,
+            self.args, self.num_returns, self.resources, self.scheduling,
+            self.max_retries, self.retry_exceptions, self.owner_address,
+            self.owner_worker_id, self.actor_id, self.method_name,
+            self.seq_no, self.is_actor_creation, self.max_restarts,
+            self.max_task_retries, self.max_concurrency,
+            self.is_async_actor, self.actor_name, self.namespace,
+            self.runtime_env, self.is_generator))
 
 
 @dataclass

@@ -50,6 +50,9 @@ class Config:
     scheduler_spread_threshold: float = 0.5
     # Max worker leases a submitter requests in parallel per scheduling class.
     max_pending_lease_requests: int = 10
+    # Tasks pushed to a leased worker without waiting for the previous reply
+    # (the worker executes sequentially; pipelining hides the RPC round trip).
+    task_pipeline_depth: int = 2
     # Lease reuse idle timeout (s): a leased idle worker is returned after this.
     idle_worker_lease_timeout_s: float = 0.5
     worker_lease_timeout_s: float = 30.0

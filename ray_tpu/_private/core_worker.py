@@ -76,7 +76,9 @@ class LeaseEntry:
     worker_id: WorkerID
     worker_address: str
     raylet_address: str
-    busy: bool = False
+    # Tasks pushed but not yet replied; up to config.task_pipeline_depth are
+    # pipelined per lease (the worker executes them sequentially).
+    inflight: int = 0
     returning: bool = False
     last_used: float = field(default_factory=time.time)
 
@@ -90,7 +92,8 @@ class ActorSubmitQueue:
     direct_actor_task_submitter.h resend-on-restart semantics).
     """
 
-    def __init__(self, actor_id: ActorID):
+    def __init__(self, actor_id: ActorID,
+                 lock: Optional[threading.RLock] = None):
         self.actor_id = actor_id
         self.seq = 0
         self.epoch = 0               # observed num_restarts
@@ -100,16 +103,21 @@ class ActorSubmitQueue:
         self.wakeup: List[asyncio.Future] = []
         # seq -> spec of tasks submitted but not yet acknowledged.
         self.inflight: Dict[int, TaskSpec] = {}
+        # Shared with the CoreWorker: seq reservation may happen on a user
+        # thread (threadsafe submission) while renumbering runs on the loop.
+        self.lock = lock or threading.RLock()
 
     def next_seq(self) -> int:
-        s = self.seq
-        self.seq += 1
-        return s
+        with self.lock:
+            s = self.seq
+            self.seq += 1
+            return s
 
     def set_state(self, state: str, address: str = "", reason: str = "",
                   num_restarts: int = 0):
         if state == "ALIVE" and num_restarts > self.epoch:
-            self._renumber_for_epoch(num_restarts)
+            with self.lock:
+                self._renumber_for_epoch(num_restarts)
         self.state = state
         self.address = address
         if reason:
@@ -205,6 +213,12 @@ class CoreWorker:
         self._task_events_buffer: List[dict] = []
         self._shutdown = False
         self._bg_tasks: List[asyncio.Task] = []
+        # Guards id/seq reservation + owned/pending registration so the
+        # threadsafe submission fast paths (user thread) can't race the loop.
+        self.submission_lock = threading.RLock()
+        # Worker mode: pipelined push_task requests execute one at a time
+        # (a leased worker represents one resource grant).
+        self._task_exec_lock = asyncio.Lock()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -215,18 +229,29 @@ class CoreWorker:
         self._register_handlers()
         port = await self.server.start("127.0.0.1", 0)
         self.address = f"127.0.0.1:{port}"
-        self.gcs = await rpc.connect(self.gcs_address, self._on_gcs_push)
+        self.gcs = rpc.ReconnectingConnection(
+            self.gcs_address, self._on_gcs_push,
+            on_reconnect=self._on_gcs_reconnect)
+        await self.gcs.connect()
         await self.gcs.request("subscribe", {"channels": ["actors", "nodes"]})
         self.raylet = await rpc.connect(self.raylet_address)
-        self.store = ObjectStoreClient(self._raylet_request)
+        self.store = ObjectStoreClient(self._raylet_request,
+                                       self._raylet_notify)
         object_ref_mod._set_core_worker_hooks(
             self._on_ref_created, self._on_ref_deleted,
             self.get_sync, self.get_async)
         self._bg_tasks.append(asyncio.ensure_future(self._flush_task_events_loop()))
         self._bg_tasks.append(asyncio.ensure_future(self._lease_janitor_loop()))
 
+    async def _on_gcs_reconnect(self, conn: rpc.Connection):
+        """Re-establish subscriptions on a fresh (restarted-GCS) connection."""
+        await conn.request("subscribe", {"channels": ["actors", "nodes"]})
+
     async def _raylet_request(self, method, payload):
         return await self.raylet.request(method, payload)
+
+    async def _raylet_notify(self, method, payload):
+        await self.raylet.notify(method, payload)
 
     def start_driver_background(self):
         """Driver mode: run the loop in a daemon thread; block until ready."""
@@ -338,7 +363,8 @@ class CoreWorker:
     # ==================================================================
 
     def _next_task_id(self) -> TaskID:
-        self.task_id_counter += 1
+        with self.submission_lock:
+            self.task_id_counter += 1
         return TaskID.of(self.job_id)
 
     def _on_ref_created(self, ref: ObjectRef):
@@ -486,23 +512,53 @@ class CoreWorker:
 
     # ---- put / get ----
 
-    async def put_async(self, value: Any, _pin_object: bool = True) -> ObjectRef:
-        self.put_counter += 1
+    def _reserve_put_oid(self) -> ObjectID:
+        with self.submission_lock:
+            self.put_counter += 1
+            counter = self.put_counter
         task_id = self.current_task_id or TaskID.of(self.job_id)
-        oid = ObjectID.for_put(task_id, self.put_counter)
+        return ObjectID.for_put(task_id, counter)
+
+    def _register_inline_put(self, oid: ObjectID, value: Any,
+                             ser: SerializedObject) -> ObjectRef:
+        ent = OwnedObject(object_id=oid, ready=True)
+        ent.inline_value = ser.to_bytes()
+        with self.submission_lock:
+            self.owned[oid] = ent
+            self.inproc[oid] = value
+        return ObjectRef(oid, self.address)
+
+    async def put_async(self, value: Any, _pin_object: bool = True) -> ObjectRef:
+        oid = self._reserve_put_oid()
         ser = self.serialization.serialize(value)
+        if ser.total_size <= self.config.max_direct_call_object_size:
+            return self._register_inline_put(oid, value, ser)
+        return await self._put_large(oid, ser)
+
+    async def _put_large(self, oid: ObjectID, ser: SerializedObject
+                         ) -> ObjectRef:
         ent = OwnedObject(object_id=oid, ready=True)
         self.owned[oid] = ent
-        if ser.total_size <= self.config.max_direct_call_object_size:
-            ent.inline_value = ser.to_bytes()
-            self.inproc[oid] = value
-        else:
-            await self.store.put(oid.binary(), ser, owner_address=self.address)
-            ent.locations.append(self.raylet_address)
+        await self.store.put(oid.binary(), ser, owner_address=self.address)
+        ent.locations.append(self.raylet_address)
         return ObjectRef(oid, self.address)
 
     def put_sync(self, value: Any) -> ObjectRef:
-        return self.run_sync(self.put_async(value))
+        """Thread-safe put. Inline-size values never touch the loop; large
+        values serialize on the caller and only the store RPCs cross over."""
+        oid = self._reserve_put_oid()
+        ser = self.serialization.serialize(value)
+        if ser.total_size <= self.config.max_direct_call_object_size:
+            return self._register_inline_put(oid, value, ser)
+        try:
+            on_loop = asyncio.get_running_loop() is self.loop
+        except RuntimeError:
+            on_loop = False
+        if on_loop:
+            raise RuntimeError(
+                "blocking put() of a large object from the core event loop "
+                "(async actor context); await the async API instead")
+        return self.run_sync(self._put_large(oid, ser))
 
     async def get_async(self, ref_or_refs, timeout: Optional[float] = None):
         if isinstance(ref_or_refs, list):
@@ -833,6 +889,78 @@ class CoreWorker:
                                          _prebuilt))
         return refs
 
+    def _try_build_args_sync(self, args: tuple, kwargs: dict):
+        """Thread-safe synchronous arg build; None if any arg needs plasma.
+
+        Serializing on the CALLER thread keeps the loop free and preserves
+        .remote() copy-on-submit semantics without a cross-thread round trip.
+        """
+        task_args: List[TaskArg] = []
+        for v in list(args) + list(kwargs.values()):
+            if isinstance(v, ObjectRef):
+                task_args.append(TaskArg(
+                    ARG_REF, object_id=v.id,
+                    owner_address=v.owner_address or self.address))
+            else:
+                ser = self.serialization.serialize(v)
+                if ser.total_size > self.config.max_direct_call_object_size:
+                    return None  # needs async plasma put; use the loop path
+                task_args.append(TaskArg(ARG_INLINE, data=ser.to_bytes()))
+        return task_args, list(kwargs.keys()), []
+
+    def submit_task_threadsafe(self, function_id: str, args: tuple,
+                               kwargs: dict, *, name: str = "",
+                               num_returns: int = 1,
+                               resources: Optional[Dict[str, float]] = None,
+                               scheduling=None, max_retries: int = -1,
+                               retry_exceptions: bool = False,
+                               is_generator: bool = False,
+                               export: Optional[Any] = None) -> List[ObjectRef]:
+        """Non-blocking submission from a user (non-loop) thread.
+
+        Reserves ids and registers bookkeeping under the submission lock,
+        then hands dispatch to the loop fire-and-forget — no blocking
+        cross-thread round trip per call (the round-1 latency killer;
+        reference equivalent: CoreWorker::SubmitTask is non-blocking).
+        """
+        from ray_tpu._private.common import SchedulingStrategy
+        prebuilt = self._try_build_args_sync(args, kwargs)
+        task_id = self._next_task_id()
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id, name=name,
+            function_id=function_id, args=[],
+            num_returns=num_returns,
+            resources=resources or {"CPU": 1.0},
+            scheduling=scheduling or SchedulingStrategy(),
+            max_retries=(self.config.task_max_retries_default
+                         if max_retries < 0 else max_retries),
+            retry_exceptions=retry_exceptions,
+            owner_address=self.address, owner_worker_id=self.worker_id,
+            is_generator=is_generator,
+        )
+        refs: List[ObjectRef] = []
+        returns: List[ObjectID] = []
+        with self.submission_lock:
+            for i in range(num_returns):
+                oid = ObjectID.for_task_return(task_id, i)
+                self.owned[oid] = OwnedObject(object_id=oid,
+                                              creating_spec=spec)
+                returns.append(oid)
+                refs.append(ObjectRef(oid, self.address))
+            self.pending_tasks[task_id] = PendingTask(
+                spec=spec, retries_left=spec.max_retries, returns=returns,
+                arg_refs=[])
+        self._record_task_event(spec, "PENDING")
+        self.loop.call_soon_threadsafe(
+            self._post_threadsafe_task_submit, spec, args, kwargs, export,
+            prebuilt)
+        return refs
+
+    def _post_threadsafe_task_submit(self, spec, args, kwargs, export,
+                                     prebuilt):
+        asyncio.ensure_future(
+            self._finish_task_submission(spec, args, kwargs, export, prebuilt))
+
     async def _await_export(self, export, function_id: str):
         """Serialize deferred function exports: the first submission for a
         function id starts the export; later submissions (which skipped the
@@ -895,15 +1023,15 @@ class CoreWorker:
         queue = self._task_queue.get(sched_class)
         if not queue:
             return
-        # Use an existing idle lease
+        # Use existing leases, pipelining up to depth tasks per worker.
+        depth = max(1, self.config.task_pipeline_depth)
         leases = self.leases.setdefault(sched_class, [])
         for lease in leases:
-            if not queue:
-                return
-            if not lease.busy and not lease.returning:
+            while queue and not lease.returning and lease.inflight < depth:
                 spec = queue.pop(0)
-                lease.busy = True
-                asyncio.ensure_future(self._run_on_lease(sched_class, lease, spec))
+                lease.inflight += 1
+                asyncio.ensure_future(
+                    self._run_on_lease(sched_class, lease, spec))
         if not queue:
             return
         inflight = self._lease_requests_inflight.get(sched_class, 0)
@@ -968,10 +1096,11 @@ class CoreWorker:
                 lease.worker_address, "push_task", {"spec": spec}, timeout=None)
         except rpc.RpcError:
             # Worker died: release lease, maybe retry the task.
+            lease.inflight -= 1
             self._drop_lease(sched_class, lease)
             self._handle_task_worker_death(spec)
             return
-        lease.busy = False
+        lease.inflight -= 1
         lease.last_used = time.time()
         self._handle_task_reply(spec, reply, lease.raylet_address)
         queue = self._task_queue.get(sched_class, [])
@@ -985,7 +1114,7 @@ class CoreWorker:
         await self._return_lease(sched_class, lease)
 
     async def _return_lease(self, sched_class: tuple, lease: LeaseEntry):
-        if lease.busy or lease.returning:
+        if lease.inflight > 0 or lease.returning:
             return
         if self._task_queue.get(sched_class, []):
             return
@@ -1009,7 +1138,7 @@ class CoreWorker:
             now = time.time()
             for sched_class, leases in list(self.leases.items()):
                 for lease in list(leases):
-                    if (not lease.busy and not lease.returning and
+                    if (lease.inflight == 0 and not lease.returning and
                             now - lease.last_used >
                             self.config.idle_worker_lease_timeout_s):
                         asyncio.ensure_future(
@@ -1161,7 +1290,7 @@ class CoreWorker:
             actor_name=name, namespace=namespace,
         )
         spec.runtime_env = {"lifetime": lifetime}
-        q = ActorSubmitQueue(actor_id)
+        q = ActorSubmitQueue(actor_id, self.submission_lock)
         self.actor_queues[actor_id] = q
         done = asyncio.ensure_future(
             self._finish_actor_creation(q, spec, args, kwargs, lifetime,
@@ -1238,6 +1367,58 @@ class CoreWorker:
                                                _prebuilt))
         return refs
 
+    def submit_actor_task_threadsafe(self, actor_id: ActorID,
+                                     method_name: str, args: tuple,
+                                     kwargs: dict, num_returns: int = 1,
+                                     max_task_retries: int = 0
+                                     ) -> List[ObjectRef]:
+        """Non-blocking actor-task submission from a user (non-loop) thread.
+
+        Same contract as submit_actor_task_local, but callable from any
+        thread: args serialize on the caller, seq/ids reserve under the
+        submission lock, and dispatch is handed to the loop fire-and-forget.
+        """
+        prebuilt = self._try_build_args_sync(args, kwargs)
+        with self.submission_lock:
+            q = self.actor_queues.get(actor_id)
+            new_q = q is None
+            if new_q:
+                q = ActorSubmitQueue(actor_id, self.submission_lock)
+                self.actor_queues[actor_id] = q
+            seq_no = q.next_seq()
+            task_id = TaskID.for_actor_task(self.job_id, actor_id, seq_no,
+                                            q.epoch)
+            spec = TaskSpec(
+                task_id=task_id, job_id=self.job_id, name=method_name,
+                args=[], num_returns=num_returns,
+                owner_address=self.address, owner_worker_id=self.worker_id,
+                actor_id=actor_id, method_name=method_name, seq_no=seq_no,
+                max_retries=max_task_retries,
+            )
+            q.inflight[seq_no] = spec
+            refs: List[ObjectRef] = []
+            returns: List[ObjectID] = []
+            for i in range(num_returns):
+                oid = ObjectID.for_task_return(task_id, i)
+                self.owned[oid] = OwnedObject(object_id=oid)
+                returns.append(oid)
+                refs.append(ObjectRef(oid, self.address))
+            self.pending_tasks[task_id] = PendingTask(
+                spec=spec, retries_left=max_task_retries, returns=returns,
+                arg_refs=[])
+        self.loop.call_soon_threadsafe(
+            self._post_threadsafe_actor_submit, q, spec, args, kwargs,
+            prebuilt, new_q)
+        return refs
+
+    def _post_threadsafe_actor_submit(self, q, spec, args, kwargs, prebuilt,
+                                      new_q):
+        if new_q:
+            asyncio.ensure_future(self._populate_actor_queue(q))
+        asyncio.ensure_future(
+            self._finish_actor_task_submission(q, spec, args, kwargs,
+                                               prebuilt))
+
     async def _finish_actor_task_submission(self, q: "ActorSubmitQueue",
                                             spec: TaskSpec, args, kwargs,
                                             prebuilt=None):
@@ -1265,11 +1446,12 @@ class CoreWorker:
         await self._submit_actor_task(q, spec)
 
     def _ensure_actor_queue(self, actor_id: ActorID) -> ActorSubmitQueue:
-        q = self.actor_queues.get(actor_id)
-        if q is None:
-            q = ActorSubmitQueue(actor_id)
-            self.actor_queues[actor_id] = q
-            asyncio.ensure_future(self._populate_actor_queue(q))
+        with self.submission_lock:
+            q = self.actor_queues.get(actor_id)
+            if q is None:
+                q = ActorSubmitQueue(actor_id, self.submission_lock)
+                self.actor_queues[actor_id] = q
+                asyncio.ensure_future(self._populate_actor_queue(q))
         return q
 
     async def _populate_actor_queue(self, q: ActorSubmitQueue):
@@ -1348,12 +1530,13 @@ class CoreWorker:
             "get_named_actor", {"name": name, "namespace": namespace})
         if info is None or info.state == ACTOR_DEAD:
             raise ValueError(f"named actor '{name}' not found")
-        q = self.actor_queues.get(info.actor_id)
-        if q is None:
-            q = ActorSubmitQueue(info.actor_id)
-            if info.state == ACTOR_ALIVE:
-                q.set_state("ALIVE", info.address)
-            self.actor_queues[info.actor_id] = q
+        with self.submission_lock:
+            q = self.actor_queues.get(info.actor_id)
+            if q is None:
+                q = ActorSubmitQueue(info.actor_id, self.submission_lock)
+                if info.state == ACTOR_ALIVE:
+                    q.set_state("ALIVE", info.address)
+                self.actor_queues[info.actor_id] = q
         return info
 
     # ==================================================================
@@ -1400,6 +1583,10 @@ class CoreWorker:
         return out
 
     async def _rpc_push_task(self, conn, payload):
+        async with self._task_exec_lock:  # pipelined pushes run one-by-one
+            return await self._push_task_locked(payload)
+
+    async def _push_task_locked(self, payload):
         spec: TaskSpec = payload["spec"]
         self.current_task_id = spec.task_id
         try:
@@ -1574,7 +1761,14 @@ class CoreWorker:
             "worker_id": self.worker_id.hex(),
         })
         if len(self._task_events_buffer) > 1000:
-            asyncio.ensure_future(self._flush_task_events())
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                # Threadsafe submission path: flush from the loop.
+                self.loop.call_soon_threadsafe(
+                    lambda: asyncio.ensure_future(self._flush_task_events()))
+            else:
+                asyncio.ensure_future(self._flush_task_events())
 
     async def _flush_task_events(self):
         if not self._task_events_buffer or self.gcs is None or self.gcs.closed:

@@ -98,21 +98,67 @@ class GcsServer:
         self._pg_lock = asyncio.Lock()
         self._actor_reschedule_lock = asyncio.Lock()
         self._health_task: Optional[asyncio.Task] = None
+        self._persist_task: Optional[asyncio.Task] = None
+        self._dirty = False
         self.address = ""
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+    async def start(self, host: str = "127.0.0.1", port: int = 0,
+                    restore: bool = True) -> str:
+        """Start the server; restore==True replays the session snapshot if
+        one exists (head fault tolerance — reference:
+        src/ray/gcs/store_client/redis_store_client.h persistence +
+        gcs reconnect, ray_config_def.h:441)."""
+        if restore:
+            self._maybe_restore()
         self.server.register_all(self)
         actual = await self.server.start(host, port)
         self.address = f"{host}:{actual}"
         self._health_task = asyncio.ensure_future(self._health_loop())
+        if self.session_dir:
+            self._persist_task = asyncio.ensure_future(self._persist_loop())
         logger.info("GCS started at %s", self.address)
         return self.address
 
     async def stop(self):
         if self._health_task:
             self._health_task.cancel()
+        if self._persist_task:
+            self._persist_task.cancel()
         await self.server.stop()
         await self.clients.close_all()
+
+    # ------------- persistence plumbing -------------
+
+    def _mark_dirty(self):
+        self._dirty = True
+
+    def _snapshot_path(self) -> str:
+        return os.path.join(self.session_dir, "gcs_snapshot.bin")
+
+    def _maybe_restore(self):
+        path = self._snapshot_path() if self.session_dir else ""
+        if not path or not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            self.restore(f.read())
+        now = time.time()
+        for info in self.nodes.values():
+            # Give every restored node a fresh heartbeat window to reconnect
+            # before the health loop declares it dead.
+            info.last_heartbeat = now
+        logger.info("GCS restored %d nodes / %d actors / %d PGs from %s",
+                    len(self.nodes), len(self.actors),
+                    len(self.placement_groups), path)
+
+    async def _persist_loop(self):
+        while True:
+            await asyncio.sleep(self.config.heartbeat_interval_s)
+            if self._dirty:
+                self._dirty = False
+                try:
+                    self.save_snapshot()
+                except Exception:
+                    logger.exception("GCS snapshot failed")
 
     # ------------- node management -------------
 
@@ -122,6 +168,7 @@ class GcsServer:
         logger.info("node %s registered at %s (resources=%s)",
                     info.node_id.hex()[:12], info.address, info.resources_total)
         self.pubsub.publish("nodes", {"event": "alive", "node_info": info})
+        self._mark_dirty()
         self._publish_resources(info)
         return {"node_id": info.node_id, "config": self.config.to_dict(),
                 "cluster_view": self._resource_view()}
@@ -178,6 +225,7 @@ class GcsServer:
         info.alive = False
         self.pubsub.publish("nodes", {"event": "dead", "node_id": node_id,
                                       "reason": reason})
+        self._mark_dirty()
         # Fail over actors that lived on that node.
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (ACTOR_ALIVE, ACTOR_PENDING):
@@ -227,6 +275,7 @@ class GcsServer:
         if not overwrite and payload["key"] in ns:
             return False
         ns[payload["key"]] = payload["value"]
+        self._mark_dirty()
         return True
 
     async def rpc_kv_get(self, conn, payload):
@@ -234,7 +283,10 @@ class GcsServer:
 
     async def rpc_kv_del(self, conn, payload):
         ns = self.kv.get(payload.get("namespace", ""), {})
-        return ns.pop(payload["key"], None) is not None
+        removed = ns.pop(payload["key"], None) is not None
+        if removed:
+            self._mark_dirty()
+        return removed
 
     async def rpc_kv_exists(self, conn, payload):
         return payload["key"] in self.kv.get(payload.get("namespace", ""), {})
@@ -252,6 +304,7 @@ class GcsServer:
         info = JobInfo(job_id=job_id, driver_address=payload.get("driver_address", ""),
                        entrypoint=payload.get("entrypoint", ""))
         self.jobs[job_id] = info
+        self._mark_dirty()
         return job_id
 
     async def rpc_finish_job(self, conn, payload):
@@ -260,6 +313,7 @@ class GcsServer:
             info.alive = False
             info.end_time = time.time()
         self.pubsub.publish("jobs", {"event": "finished", "job_id": payload["job_id"]})
+        self._mark_dirty()
         return True
 
     async def rpc_get_all_jobs(self, conn, payload):
@@ -268,8 +322,12 @@ class GcsServer:
     # ------------- actor management -------------
 
     async def rpc_register_actor(self, conn, payload):
-        """Register + schedule an actor creation task."""
+        """Register + schedule an actor creation task. Idempotent: a client
+        retrying after a connection loss must not double-schedule."""
         spec = payload["spec"]  # TaskSpec with is_actor_creation
+        existing = self.actors.get(spec.actor_id)
+        if existing is not None and existing.state != ACTOR_DEAD:
+            return True
         actor = ActorInfo(
             actor_id=spec.actor_id, job_id=spec.job_id,
             name=spec.actor_name, namespace=spec.namespace,
@@ -287,6 +345,7 @@ class GcsServer:
                     f"namespace '{spec.namespace}'")
             self.named_actors[key] = spec.actor_id
         self.actors[spec.actor_id] = actor
+        self._mark_dirty()
         asyncio.ensure_future(self._schedule_actor(actor))
         return True
 
@@ -339,6 +398,7 @@ class GcsServer:
         actor.address = result["actor_address"]
         actor.worker_id = result["worker_id"]
         actor.node_id = node.node_id
+        self._mark_dirty()
         self.pubsub.publish("actors", {"event": "alive", "actor_info": actor})
 
     def _pick_node_for(self, resources: Dict[str, float], scheduling=None):
@@ -385,10 +445,12 @@ class GcsServer:
                 self.pubsub.publish("actors", {
                     "event": "restarting", "actor_id": actor.actor_id,
                     "actor_info": actor})
+                self._mark_dirty()
                 asyncio.ensure_future(self._schedule_actor(actor))
             else:
                 actor.state = ACTOR_DEAD
                 actor.death_cause = reason
+                self._mark_dirty()
                 self.pubsub.publish("actors", {
                     "event": "dead", "actor_id": actor.actor_id,
                     "reason": reason, "actor_info": actor})
@@ -408,6 +470,7 @@ class GcsServer:
         if no_restart:
             actor.state = ACTOR_DEAD
             actor.death_cause = "ray.kill"
+            self._mark_dirty()
         if actor.name:
             key = (actor.namespace, actor.name)
             if self.named_actors.get(key) == actor.actor_id and no_restart:
@@ -454,6 +517,7 @@ class GcsServer:
     async def rpc_create_placement_group(self, conn, payload):
         pg: PlacementGroupInfo = payload["pg"]
         self.placement_groups[pg.pg_id] = pg
+        self._mark_dirty()
         asyncio.ensure_future(self._schedule_pg(pg))
         return True
 
@@ -498,6 +562,7 @@ class GcsServer:
                 return
             pg.bundle_nodes = dict(placement)
             pg.state = PG_CREATED
+            self._mark_dirty()
             self.pubsub.publish("placement_groups", {"event": "created", "pg": pg})
 
     def _place_bundles(self, pg: PlacementGroupInfo) -> Optional[Dict[int, NodeID]]:
@@ -578,6 +643,7 @@ class GcsServer:
         if pg is None:
             return False
         pg.state = PG_REMOVED
+        self._mark_dirty()
         for idx, node_id in pg.bundle_nodes.items():
             node = self.nodes.get(node_id)
             if node is None or not node.alive:
@@ -644,9 +710,11 @@ class GcsServer:
         self._job_counter = state["job_counter"]
 
     def save_snapshot(self, path: str = ""):
-        path = path or os.path.join(self.session_dir, "gcs_snapshot.bin")
-        with open(path, "wb") as f:
+        path = path or self._snapshot_path()
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
             f.write(self.snapshot())
+        os.replace(tmp, path)  # atomic: restore never sees a torn snapshot
 
 
 def _fits(request: Dict[str, float], available: Dict[str, float]) -> bool:

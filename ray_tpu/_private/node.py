@@ -45,8 +45,8 @@ class HeadNode:
         self._labels = labels
         self._object_store_memory = object_store_memory
 
-    async def start(self) -> str:
-        gcs_address = await self.gcs.start()
+    async def start(self, port: int = 0) -> str:
+        gcs_address = await self.gcs.start(port=port)
         self.raylet = Raylet(
             self.config, gcs_address, self.session_dir,
             resources=self._resources, labels=self._labels, is_head=True,

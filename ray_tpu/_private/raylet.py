@@ -160,18 +160,7 @@ class Raylet:
         self.address = f"{host}:{actual}"
         # Register with GCS and subscribe to cluster events.
         self.gcs_conn = await rpc.connect(self.gcs_address, self._on_gcs_push)
-        info = NodeInfo(
-            node_id=self.node_id, address=self.address,
-            resources_total=dict(self.pool.total),
-            resources_available=dict(self.pool.available),
-            labels=self.labels, is_head=self.is_head,
-        )
-        reply = await self.gcs_conn.request("register_node", {"node_info": info})
-        for node_id, view in reply.get("cluster_view", {}).items():
-            if node_id != self.node_id:
-                self.cluster_view[node_id] = view
-        await self.gcs_conn.request(
-            "subscribe", {"channels": ["resources", "nodes", "actors"]})
+        await self._register_with_gcs()
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._idle_worker_reaper()))
         logger.info("raylet %s started at %s", self.node_name, self.address)
@@ -200,21 +189,55 @@ class Raylet:
         await self.clients.close_all()
         self.store.destroy()
 
+    async def _register_with_gcs(self):
+        info = NodeInfo(
+            node_id=self.node_id, address=self.address,
+            resources_total=dict(self.pool.total),
+            resources_available=dict(self.pool.available),
+            labels=self.labels, is_head=self.is_head,
+        )
+        reply = await self.gcs_conn.request("register_node",
+                                            {"node_info": info})
+        for node_id, view in reply.get("cluster_view", {}).items():
+            if node_id != self.node_id:
+                self.cluster_view[node_id] = view
+        await self.gcs_conn.request(
+            "subscribe", {"channels": ["resources", "nodes", "actors"]})
+
     async def _heartbeat_loop(self):
-        while True:
+        while not self._stopped:
             await asyncio.sleep(self.config.heartbeat_interval_s)
             try:
-                await self.gcs_conn.request("heartbeat", {
+                reply = await self.gcs_conn.request("heartbeat", {
                     "node_id": self.node_id,
                     "resources_available": dict(self.pool.available),
                 })
+                if reply.get("reregister"):
+                    # GCS restarted without our node in its (restored) table.
+                    await self._register_with_gcs()
                 self._check_worker_deaths()
                 if self._resources_dirty:
                     self._resources_dirty = False
                     await self._report_resources()
             except rpc.RpcError:
-                logger.warning("raylet %s lost GCS connection", self.node_name)
+                # Head fault tolerance: keep dialing until the GCS (or its
+                # restarted replacement on the same address) answers.
+                logger.warning("raylet %s lost GCS connection; reconnecting",
+                               self.node_name)
+                await self._reconnect_gcs()
+
+    async def _reconnect_gcs(self):
+        while not self._stopped:
+            try:
+                self.gcs_conn = await rpc.connect(self.gcs_address,
+                                                  self._on_gcs_push)
+                await self._register_with_gcs()
+                logger.info("raylet %s re-registered with GCS",
+                            self.node_name)
                 return
+            except Exception:
+                await asyncio.sleep(
+                    min(1.0, self.config.heartbeat_interval_s))
 
     async def _report_resources(self):
         try:
@@ -345,7 +368,19 @@ class Raylet:
         return None
 
     def _ensure_worker_supply(self):
-        demand = len(self._pending_leases)
+        # Count only leases the pool could actually serve concurrently:
+        # spawning workers for requests that can't get resources just burns
+        # CPU on process startup (round-1 regression on small boxes).
+        avail = dict(self.pool.available)
+        demand = 0
+        for spec, _pg_key, fut in self._pending_leases:
+            if fut.done():
+                continue
+            if all(avail.get(k, 0) >= v
+                   for k, v in spec.resources.items() if v > 0):
+                for k, v in spec.resources.items():
+                    avail[k] = avail.get(k, 0) - v
+                demand += 1
         supply = len(self._idle_workers) + self._starting_workers
         can_start = self.config.max_workers_per_node - len(self.workers)
         for _ in range(min(max(0, demand - supply), max(0, can_start))):
@@ -633,6 +668,15 @@ class Raylet:
 
     async def rpc_store_stats(self, conn, payload):
         return self.store.stats()
+
+    async def rpc_store_list(self, conn, payload):
+        """Object inventory for the state API (`ray_tpu list objects`)."""
+        out = []
+        for oid, ent in list(self.store.objects.items()):
+            out.append({"object_id": oid.hex(), "size": ent.size,
+                        "pins": ent.pins, "state": ent.state,
+                        "owner": ent.owner_address})
+        return out
 
     async def rpc_store_put_bytes(self, conn, payload):
         """Put raw serialized bytes (used by small-RPC path and transfers)."""

@@ -69,6 +69,8 @@ class Connection:
 
     _ids = itertools.count(1)
 
+    HIGH_WATER = 1 << 20  # drain (backpressure) only past this buffer size
+
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
                  push_handler: Optional[Callable] = None):
         self.reader = reader
@@ -76,7 +78,6 @@ class Connection:
         self.push_handler = push_handler
         self._pending: Dict[int, asyncio.Future] = {}
         self._closed = False
-        self._write_lock = asyncio.Lock()
         self.on_close: Optional[Callable] = None
         # Set by server loop: peer-provided identity metadata.
         self.peer_info: dict = {}
@@ -85,12 +86,22 @@ class Connection:
     def closed(self) -> bool:
         return self._closed
 
+    def send_nowait(self, kind: int, msg_id: int, method: str, payload: Any):
+        """Queue a message on the transport without awaiting the flush.
+
+        asyncio coalesces buffered writes into single syscalls, so pipelined
+        calls (task pushes, replies) batch instead of paying one write+drain
+        per message (the round-1 throughput killer). Loop thread only.
+        """
+        if self._closed:
+            raise ConnectionLost("connection closed")
+        self.writer.write(_encode(kind, msg_id, method, payload))
+
     async def send(self, kind: int, msg_id: int, method: str, payload: Any):
-        data = _encode(kind, msg_id, method, payload)
-        async with self._write_lock:
-            if self._closed:
-                raise ConnectionLost("connection closed")
-            self.writer.write(data)
+        self.send_nowait(kind, msg_id, method, payload)
+        transport = self.writer.transport
+        if (transport is not None
+                and transport.get_write_buffer_size() > self.HIGH_WATER):
             await self.writer.drain()
 
     async def request(self, method: str, payload: Any = None,
@@ -257,6 +268,80 @@ async def connect(address: str, push_handler: Optional[Callable] = None,
     conn = Connection(reader, writer, push_handler)
     asyncio.ensure_future(conn.client_loop())
     return conn
+
+
+class ReconnectingConnection:
+    """Client connection that redials the same address on loss.
+
+    Used for the GCS channel (head fault tolerance): a restarted GCS comes
+    back on the same address, clients re-dial, run `on_reconnect` (e.g.
+    resubscribe), and retry the in-flight request once per successful dial.
+    """
+
+    def __init__(self, address: str, push_handler: Optional[Callable] = None,
+                 on_reconnect: Optional[Callable] = None,
+                 retry_window_s: float = 30.0):
+        self.address = address
+        self.push_handler = push_handler
+        self.on_reconnect = on_reconnect
+        self.retry_window_s = retry_window_s
+        self._conn: Optional[Connection] = None
+        self._closed = False
+        self._dial_lock = asyncio.Lock()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed or self._conn is None or self._conn.closed
+
+    async def connect(self):
+        self._conn = await connect(self.address, self.push_handler)
+        return self
+
+    async def _redial(self):
+        async with self._dial_lock:
+            if self._closed:
+                raise ConnectionLost("channel closed")
+            if self._conn is not None and not self._conn.closed:
+                return  # another caller already reconnected
+            deadline = asyncio.get_running_loop().time() + self.retry_window_s
+            while not self._closed:
+                try:
+                    conn = await connect(self.address, self.push_handler,
+                                         timeout=2.0)
+                    if self.on_reconnect is not None:
+                        await self.on_reconnect(conn)
+                    self._conn = conn
+                    return
+                except Exception as e:
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise ConnectionLost(
+                            f"reconnect to {self.address} failed: {e}")
+                    await asyncio.sleep(0.3)
+            raise ConnectionLost("channel closed")
+
+    async def request(self, method: str, payload: Any = None,
+                      timeout: Optional[float] = None) -> Any:
+        for _attempt in range(2):
+            if self._conn is None or self._conn.closed:
+                await self._redial()
+            try:
+                return await self._conn.request(method, payload, timeout)
+            except ConnectionLost:
+                if self._closed:
+                    raise
+                continue
+        await self._redial()
+        return await self._conn.request(method, payload, timeout)
+
+    async def notify(self, method: str, payload: Any = None):
+        if self._conn is None or self._conn.closed:
+            await self._redial()
+        await self._conn.notify(method, payload)
+
+    async def close(self):
+        self._closed = True
+        if self._conn is not None:
+            self._conn.abort(ConnectionLost("closed"))
 
 
 class ClientPool:

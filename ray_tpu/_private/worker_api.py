@@ -96,6 +96,11 @@ def init(address: Optional[str] = None, *,
         if ignore_reinit_error:
             return _state
         raise RuntimeError("ray_tpu already initialized")
+    if address in (None, "auto"):
+        # Job entrypoints / CLI children inherit the cluster address
+        # (reference: RAY_ADDRESS handling in ray.init).
+        import os as _os
+        address = _os.environ.get("RAY_TPU_ADDRESS") or None
     logging.basicConfig(level=log_level)
     config = Config.load(system_config)
     set_config(config)
@@ -160,15 +165,9 @@ def shutdown():
 
 def put(value: Any) -> ObjectRef:
     core = get_core()
-    return core.run_sync(core.put_async(value)) \
-        if core.mode == "driver" else _worker_put(core, value)
-
-
-def _worker_put(core: CoreWorker, value: Any) -> ObjectRef:
-    # Inside a worker the loop is the current thread's loop when called from
-    # async actor code, else we're on an executor thread.
-    return asyncio.run_coroutine_threadsafe(
-        core.put_async(value), core.loop).result()
+    # put_sync is thread-safe: inline-size values never cross threads; large
+    # values only hop to the loop for the store RPCs.
+    return core.put_sync(value)
 
 
 def get(refs, timeout: Optional[float] = None):
@@ -276,6 +275,33 @@ def available_resources() -> Dict[str, float]:
         for k, v in info["available"].items():
             out[k] = out.get(k, 0) + v
     return out
+
+
+def internal_kv_put(key: bytes, value: bytes, namespace: str = "kv",
+                    overwrite: bool = True) -> bool:
+    """Cluster-wide KV (reference: ray.experimental.internal_kv)."""
+    core = get_core()
+    return _call_on_core_loop(core, core.gcs.request("kv_put", {
+        "namespace": namespace, "key": key, "value": value,
+        "overwrite": overwrite}), 30)
+
+
+def internal_kv_get(key: bytes, namespace: str = "kv") -> Optional[bytes]:
+    core = get_core()
+    return _call_on_core_loop(core, core.gcs.request("kv_get", {
+        "namespace": namespace, "key": key}), 30)
+
+
+def internal_kv_del(key: bytes, namespace: str = "kv") -> bool:
+    core = get_core()
+    return _call_on_core_loop(core, core.gcs.request("kv_del", {
+        "namespace": namespace, "key": key}), 30)
+
+
+def internal_kv_keys(prefix: bytes = b"", namespace: str = "kv") -> List[bytes]:
+    core = get_core()
+    return _call_on_core_loop(core, core.gcs.request("kv_keys", {
+        "namespace": namespace, "prefix": prefix}), 30)
 
 
 def timeline(job_id=None) -> List[dict]:

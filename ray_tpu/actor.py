@@ -40,11 +40,12 @@ class ActorMethod:
                 num_returns=self._num_returns,
                 max_task_retries=self._handle._max_task_retries)
         else:
-            refs = worker_api._call_on_core_loop(core, core.submit_actor_task(
+            # User thread: reserve ids synchronously, dispatch fire-and-forget
+            # (no blocking cross-thread round trip per call).
+            refs = core.submit_actor_task_threadsafe(
                 self._handle._actor_id, self._name, args, kwargs,
                 num_returns=self._num_returns,
-                max_task_retries=self._handle._max_task_retries,
-            ), None)
+                max_task_retries=self._handle._max_task_retries)
         if self._num_returns == 1:
             return refs[0]
         return refs

@@ -79,6 +79,19 @@ class Cluster:
         self._run(_remove())
         self.raylets.remove(raylet)
 
+    def restart_gcs(self):
+        """Kill the GCS process-equivalent and restart it on the SAME
+        address, restoring the session snapshot (head fault tolerance).
+        Raylets and workers re-register via their reconnect loops."""
+        host, port = self.gcs_address.rsplit(":", 1)
+
+        async def _restart():
+            await self.gcs.stop()
+            self.gcs = GcsServer(self.config, self.session_dir)
+            await self.gcs.start(host, int(port), restore=True)
+
+        self._run(_restart())
+
     def connect(self, namespace: str = ""):
         """Attach a driver to this cluster."""
         import ray_tpu

@@ -1,0 +1,185 @@
+"""Job submission: run an entrypoint against a live cluster, with status and
+log capture.
+
+Reference parity: dashboard/modules/job/job_manager.py (:525 JobManager,
+:140 JobSupervisor) + python/ray/dashboard/modules/job/sdk.py
+JobSubmissionClient. TPU-first simplification: no REST hop — the client
+talks straight to the GCS; the supervisor is a detached-style actor that
+runs the entrypoint subprocess on a cluster node and streams its output to
+a log file in the session dir.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu._private import worker_api
+
+JOBS_NS = "job_submissions"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+def _supervisor_name(submission_id: str) -> str:
+    return f"_rtpu_job_supervisor_{submission_id}"
+
+
+class _JobSupervisor:
+    """Actor: owns the entrypoint subprocess (JobSupervisor :140)."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 gcs_address: str, session_dir: str,
+                 env_vars: Optional[Dict[str, str]] = None):
+        import subprocess
+        import threading
+
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        log_dir = os.path.join(session_dir or "/tmp/ray_tpu", "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_path = os.path.join(log_dir, f"job-{submission_id}.log")
+        env = dict(os.environ)
+        env["RAY_TPU_ADDRESS"] = gcs_address
+        env.update(env_vars or {})
+        self._set_status(JobStatus.RUNNING)
+        self._log = open(self.log_path, "wb")
+        self.proc = subprocess.Popen(
+            entrypoint, shell=True, stdout=self._log,
+            stderr=subprocess.STDOUT, env=env)
+        self._waiter = threading.Thread(target=self._wait, daemon=True)
+        self._waiter.start()
+
+    def _set_status(self, status: str, message: str = ""):
+        import json
+        worker_api.internal_kv_put(
+            self.submission_id.encode(),
+            json.dumps({"status": status, "message": message,
+                        "entrypoint": self.entrypoint,
+                        "log_path": self.log_path,
+                        "time": time.time()}).encode(),
+            namespace=JOBS_NS)
+
+    def _wait(self):
+        rc = self.proc.wait()
+        self._log.flush()
+        if rc == 0:
+            self._set_status(JobStatus.SUCCEEDED)
+        elif rc in (-15, -9):
+            self._set_status(JobStatus.STOPPED, f"terminated (rc={rc})")
+        else:
+            self._set_status(JobStatus.FAILED, f"exit code {rc}")
+
+    def stop(self) -> bool:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            return True
+        return False
+
+    def logs(self) -> str:
+        self._log.flush()
+        try:
+            with open(self.log_path, "rb") as f:
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    def poll(self):
+        return self.proc.poll()
+
+
+class JobSubmissionClient:
+    """Submit/inspect/stop jobs (reference: JobSubmissionClient SDK)."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+        if not worker_api.is_initialized():
+            ray_tpu.init(address=address)
+        self._core = worker_api.get_core()
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   env_vars: Optional[Dict[str, str]] = None) -> str:
+        import json
+
+        import ray_tpu
+        submission_id = submission_id or f"rtpu-{uuid.uuid4().hex[:10]}"
+        worker_api.internal_kv_put(
+            submission_id.encode(),
+            json.dumps({"status": JobStatus.PENDING,
+                        "entrypoint": entrypoint,
+                        "time": time.time()}).encode(),
+            namespace=JOBS_NS)
+        supervisor = ray_tpu.remote(_JobSupervisor).options(
+            name=_supervisor_name(submission_id), num_cpus=0).remote(
+            submission_id, entrypoint, self._core.gcs_address,
+            self._core.session_dir, env_vars)
+        self._supervisor = supervisor
+        return submission_id
+
+    def _info(self, submission_id: str) -> dict:
+        import json
+        raw = worker_api.internal_kv_get(submission_id.encode(),
+                                         namespace=JOBS_NS)
+        if raw is None:
+            raise ValueError(f"unknown job '{submission_id}'")
+        return json.loads(raw)
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self._info(submission_id)["status"]
+
+    def get_job_info(self, submission_id: str) -> dict:
+        return self._info(submission_id)
+
+    def get_job_logs(self, submission_id: str) -> str:
+        import ray_tpu
+        try:
+            sup = ray_tpu.get_actor(_supervisor_name(submission_id))
+            return ray_tpu.get(sup.logs.remote(), timeout=30)
+        except ValueError:
+            info = self._info(submission_id)
+            path = info.get("log_path")
+            if path and os.path.exists(path):
+                with open(path, "rb") as f:
+                    return f.read().decode(errors="replace")
+            return ""
+
+    def stop_job(self, submission_id: str) -> bool:
+        import ray_tpu
+        try:
+            sup = ray_tpu.get_actor(_supervisor_name(submission_id))
+        except ValueError:
+            return False
+        return ray_tpu.get(sup.stop.remote(), timeout=30)
+
+    def list_jobs(self) -> List[dict]:
+        import json
+        out = []
+        for key in worker_api.internal_kv_keys(namespace=JOBS_NS):
+            raw = worker_api.internal_kv_get(key, namespace=JOBS_NS)
+            if raw:
+                info = json.loads(raw)
+                info["submission_id"] = key.decode()
+                out.append(info)
+        return sorted(out, key=lambda i: i.get("time", 0))
+
+    def wait_until_finish(self, submission_id: str,
+                          timeout: float = 300) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"job {submission_id} still {status} after {timeout}s")

@@ -42,7 +42,11 @@ class ShardingRules:
     def spec_for(self, path: str, shape: Tuple[int, ...]):
         for pattern, spec in self.rules:
             if re.search(pattern, path):
-                return spec if spec is FSDP_LARGEST else _truncate_spec(spec, shape)
+                if spec is FSDP_LARGEST:
+                    return spec
+                if spec is PP_STACKED:
+                    return P("pipeline", *([None] * (max(len(shape), 1) - 1)))
+                return _truncate_spec(spec, shape)
         if self.default is FSDP_LARGEST:
             return self.default
         return _truncate_spec(self.default, shape)
@@ -128,6 +132,28 @@ class ShardingStrategy:
         return ShardingStrategy("tp_fsdp", rules, P(("data", "fsdp")))
 
     @staticmethod
+    def pp() -> "ShardingStrategy":
+        """Pipeline parallel: stacked layer params sharded on the leading
+        (layer) axis over 'pipeline' (see ray_tpu.parallel.pipeline for the
+        GPipe schedule those shardings feed)."""
+        rules = ShardingRules(rules=[(r"stacked/", PP_STACKED)], default=P())
+        return ShardingStrategy("pp", rules, P("data"))
+
+    @staticmethod
+    def pp_tp() -> "ShardingStrategy":
+        """Pipeline outer + Megatron tensor parallel inside each stage."""
+        t = "tensor"
+        pl = "pipeline"
+        rules = ShardingRules(rules=[
+            (r"stacked/attn/(wq|wk|wv)", P(pl, None, t)),
+            (r"stacked/attn/wo", P(pl, t, None)),
+            (r"stacked/mlp/(w_gate|w_up)", P(pl, None, t)),
+            (r"stacked/mlp/w_down", P(pl, t, None)),
+            (r"stacked/", PP_STACKED),
+        ], default=P())
+        return ShardingStrategy("pp_tp", rules, P("data"))
+
+    @staticmethod
     def sp() -> "ShardingStrategy":
         """Sequence/context parallel: tokens sharded over 'sequence';
         used with ring attention (ray_tpu.ops.ring_attention)."""
@@ -162,6 +188,16 @@ class _FsdpLargestMarker:
 FSDP_LARGEST = _FsdpLargestMarker()
 
 
+class _PpStackedMarker:
+    """Sentinel: shard the leading (stacked-layer) dim over 'pipeline'."""
+
+    def __repr__(self):
+        return "PP_STACKED"
+
+
+PP_STACKED = _PpStackedMarker()
+
+
 def _subdivide_largest(spec, shape: Tuple[int, ...], mesh: Mesh) -> P:
     if spec is not FSDP_LARGEST:
         return spec
@@ -185,6 +221,8 @@ def strategy_from_name(name: str) -> ShardingStrategy:
         "tp": ShardingStrategy.tp_transformer,
         "tp_fsdp": ShardingStrategy.tp_fsdp,
         "sp": ShardingStrategy.sp,
+        "pp": ShardingStrategy.pp,
+        "pp_tp": ShardingStrategy.pp_tp,
     }
     if name not in presets:
         raise ValueError(f"unknown strategy '{name}'; one of {list(presets)}")

@@ -111,8 +111,10 @@ class RemoteFunction:
             refs = core.submit_task_local(fid, args, kwargs, export=export,
                                           **submit_kwargs)
         else:
-            refs = worker_api._call_on_core_loop(core, core.submit_task(
-                fid, args, kwargs, **submit_kwargs), None)
+            # User thread: reserve ids synchronously, dispatch fire-and-forget
+            # (no blocking cross-thread round trip per call).
+            refs = core.submit_task_threadsafe(fid, args, kwargs,
+                                               **submit_kwargs)
         if num_returns == 1:
             return refs[0]
         return refs

@@ -1,0 +1,211 @@
+"""CLI: cluster lifecycle, state inspection, job control.
+
+Reference parity: python/ray/scripts/scripts.py (command registry
+:2545-2604 — start/stop/status/timeline/job/list). Invoke as
+`python -m ray_tpu <command>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def _address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get(
+        "RAY_TPU_ADDRESS", "")
+    if not addr:
+        sys.exit("error: --address (or RAY_TPU_ADDRESS) is required")
+    return addr
+
+
+def _connect(args):
+    import ray_tpu
+    ray_tpu.init(address=_address(args))
+    return ray_tpu
+
+
+# ---------------------------------------------------------------- start/stop
+
+def cmd_start(args):
+    """Run a head (GCS + raylet) or worker (raylet) node in the foreground."""
+    import asyncio
+
+    from ray_tpu._private.config import Config, set_config
+    from ray_tpu._private.node import HeadNode, detect_node_resources
+    from ray_tpu._private.raylet import Raylet
+    from ray_tpu._private.node import new_session_dir
+
+    config = Config.load(None)
+    set_config(config)
+    res = detect_node_resources(args.num_cpus, args.num_tpus, None, config)
+
+    async def _run_head():
+        head = HeadNode(config, resources=res)
+        gcs_address = await head.start(port=args.port)
+        print(f"ray_tpu head started; GCS at {gcs_address}", flush=True)
+        print(f"connect with: ray_tpu.init(address='{gcs_address}') or "
+              f"RAY_TPU_ADDRESS={gcs_address}", flush=True)
+        return head
+
+    async def _run_worker():
+        session_dir = new_session_dir(config)
+        raylet = Raylet(config, args.address, session_dir, resources=res)
+        await raylet.start()
+        print(f"ray_tpu worker node joined {args.address}", flush=True)
+        return raylet
+
+    async def _main():
+        node = await (_run_head() if args.head else _run_worker())
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await node.stop()
+
+    if not args.head and not args.address:
+        sys.exit("error: worker nodes need --address=<gcs host:port>")
+    asyncio.run(_main())
+
+
+def cmd_status(args):
+    ray_tpu = _connect(args)
+    from ray_tpu.util.state import cluster_status
+    st = cluster_status()
+    print(f"nodes: {st['nodes_alive']} alive, {st['nodes_dead']} dead")
+    print("resources:")
+    avail = st["available_resources"]
+    for k, v in sorted(st["cluster_resources"].items()):
+        print(f"  {k}: {avail.get(k, 0):g}/{v:g} available")
+    if st["actors"]:
+        print("actors:", dict(st["actors"]))
+    if st["placement_groups"]:
+        print("placement groups:", dict(st["placement_groups"]))
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- state
+
+def cmd_list(args):
+    ray_tpu = _connect(args)
+    from ray_tpu.util import state
+    fns = {
+        "nodes": state.list_nodes, "actors": state.list_actors,
+        "tasks": state.list_tasks, "jobs": state.list_jobs,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+    }
+    rows = fns[args.entity]()
+    print(json.dumps(rows, indent=2, default=str))
+    ray_tpu.shutdown()
+
+
+def cmd_summary(args):
+    ray_tpu = _connect(args)
+    from ray_tpu.util.state import summarize_tasks
+    print(json.dumps(summarize_tasks(), indent=2))
+    ray_tpu.shutdown()
+
+
+def cmd_timeline(args):
+    ray_tpu = _connect(args)
+    trace = ray_tpu.timeline()
+    out = args.output or "timeline.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} events to {out}")
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- jobs
+
+def cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+    client = JobSubmissionClient(_address(args))
+    if args.job_cmd == "submit":
+        sid = client.submit_job(entrypoint=" ".join(args.entrypoint))
+        print(sid)
+        if args.wait:
+            status = client.wait_until_finish(sid, timeout=args.timeout)
+            print(status)
+            print(client.get_job_logs(sid), end="")
+            sys.exit(0 if status == "SUCCEEDED" else 1)
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.submission_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.submission_id), end="")
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.submission_id))
+    elif args.job_cmd == "list":
+        print(json.dumps(client.list_jobs(), indent=2))
+
+
+# ---------------------------------------------------------------- parser
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("start", help="start a head or worker node")
+    s.add_argument("--head", action="store_true")
+    s.add_argument("--address", default="", help="GCS address (worker mode)")
+    s.add_argument("--port", type=int, default=6379)
+    s.add_argument("--num-cpus", type=float, default=None, dest="num_cpus")
+    s.add_argument("--num-tpus", type=float, default=None, dest="num_tpus")
+    s.set_defaults(fn=cmd_start)
+
+    s = sub.add_parser("status", help="cluster status")
+    s.add_argument("--address", default=None)
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("list", help="list cluster entities")
+    s.add_argument("entity", choices=["nodes", "actors", "tasks", "jobs",
+                                      "objects", "placement-groups"])
+    s.add_argument("--address", default=None)
+    s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("summary", help="task state summary")
+    s.add_argument("--address", default=None)
+    s.set_defaults(fn=cmd_summary)
+
+    s = sub.add_parser("timeline", help="dump chrome-trace timeline")
+    s.add_argument("--address", default=None)
+    s.add_argument("-o", "--output", default=None)
+    s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("job", help="job submission")
+    jsub = s.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--address", default=None)
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("--timeout", type=float, default=300)
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    j = jsub.add_parser("status")
+    j.add_argument("submission_id")
+    j.add_argument("--address", default=None)
+    j = jsub.add_parser("logs")
+    j.add_argument("submission_id")
+    j.add_argument("--address", default=None)
+    j = jsub.add_parser("stop")
+    j.add_argument("submission_id")
+    j.add_argument("--address", default=None)
+    j = jsub.add_parser("list")
+    j.add_argument("--address", default=None)
+    s.set_defaults(fn=cmd_job)
+
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

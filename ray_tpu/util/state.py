@@ -1,0 +1,111 @@
+"""State API: list/inspect cluster entities.
+
+Reference parity: python/ray/util/state/api.py (list_actors :782,
+list_tasks :1014, summarize_tasks :1376) — fed directly from the GCS tables
+(the reference proxies through the dashboard's state head; this framework's
+GCS answers the same queries over its RPC surface).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional
+
+from ray_tpu._private import worker_api
+
+
+def _gcs(method: str, payload: Optional[dict] = None, timeout: float = 30):
+    core = worker_api.get_core()
+    return worker_api._call_on_core_loop(
+        core, core.gcs.request(method, payload or {}), timeout)
+
+
+def list_nodes() -> List[dict]:
+    return [{
+        "node_id": n.node_id.hex(), "address": n.address, "alive": n.alive,
+        "is_head": n.is_head, "resources_total": n.resources_total,
+        "labels": n.labels,
+    } for n in _gcs("get_all_nodes")]
+
+
+def list_actors(state: Optional[str] = None) -> List[dict]:
+    out = []
+    for a in _gcs("get_all_actors"):
+        if state is not None and a.state != state:
+            continue
+        out.append({
+            "actor_id": a.actor_id.hex(), "class_name": a.class_name,
+            "state": a.state, "name": a.name, "namespace": a.namespace,
+            "node_id": a.node_id.hex() if a.node_id else None,
+            "address": a.address, "num_restarts": a.num_restarts,
+            "death_cause": a.death_cause,
+        })
+    return out
+
+
+def list_tasks(job_id: Optional[str] = None, limit: int = 1000) -> List[dict]:
+    """Latest-state view of task events."""
+    events = _gcs("get_task_events", {"job_id": job_id, "limit": 100000})
+    latest: Dict[str, dict] = {}
+    for e in events:
+        latest[e["task_id"]] = e
+    rows = [{
+        "task_id": e["task_id"], "name": e["name"], "state": e["state"],
+        "job_id": e["job_id"], "actor_id": e.get("actor_id"),
+        "worker_id": e.get("worker_id"),
+    } for e in latest.values()]
+    return rows[-limit:]
+
+
+def summarize_tasks(job_id: Optional[str] = None) -> Dict[str, Dict[str, int]]:
+    """name -> {state: count} (reference: summarize_tasks)."""
+    summary: Dict[str, Counter] = {}
+    for row in list_tasks(job_id, limit=10**9):
+        summary.setdefault(row["name"], Counter())[row["state"]] += 1
+    return {k: dict(v) for k, v in summary.items()}
+
+
+def list_jobs() -> List[dict]:
+    return [{
+        "job_id": j.job_id.hex(), "alive": j.alive,
+        "entrypoint": j.entrypoint, "start_time": j.start_time,
+        "end_time": j.end_time,
+    } for j in _gcs("get_all_jobs")]
+
+
+def list_placement_groups() -> List[dict]:
+    from ray_tpu.util.placement_group import placement_group_table
+    return placement_group_table()
+
+
+def list_objects() -> List[dict]:
+    """Per-node object-store contents (id, size, pins, state)."""
+    core = worker_api.get_core()
+    rows: List[dict] = []
+    for n in _gcs("get_all_nodes"):
+        if not n.alive:
+            continue
+        try:
+            stats = worker_api._call_on_core_loop(
+                core, core.clients.request(n.address, "store_list", {}), 10)
+        except Exception:
+            continue
+        for row in stats:
+            row["node_id"] = n.node_id.hex()
+            rows.append(row)
+    return rows
+
+
+def cluster_status() -> dict:
+    """One-shot status blob for `ray_tpu status`."""
+    nodes = list_nodes()
+    import ray_tpu
+    return {
+        "nodes_alive": sum(1 for n in nodes if n["alive"]),
+        "nodes_dead": sum(1 for n in nodes if not n["alive"]),
+        "cluster_resources": ray_tpu.cluster_resources(),
+        "available_resources": ray_tpu.available_resources(),
+        "actors": Counter(a["state"] for a in list_actors()),
+        "placement_groups": Counter(
+            p["state"] for p in list_placement_groups()),
+    }

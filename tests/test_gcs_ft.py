@@ -1,0 +1,98 @@
+"""GCS fault tolerance: snapshot persistence + head restart.
+
+Reference: src/ray/gcs/store_client/redis_store_client.h (persistence) and
+GCS client reconnect (ray_config_def.h:441 gcs_rpc_server_reconnect_timeout).
+Here: snapshot file in the session dir + raylet/worker reconnect loops.
+"""
+
+import time
+
+import pytest
+
+
+def test_named_actor_survives_gcs_restart(ray_cluster):
+    ray_cluster.connect()
+    import ray_tpu
+
+    @ray_tpu.remote
+    class KV:
+        def __init__(self):
+            self.d = {}
+
+        def put(self, k, v):
+            self.d[k] = v
+            return True
+
+        def get(self, k):
+            return self.d.get(k)
+
+    a = KV.options(name="store", lifetime="detached").remote()
+    assert ray_tpu.get(a.put.remote("x", 42), timeout=60)
+
+    # Let the persistence loop write the snapshot, then "crash" the head.
+    time.sleep(1.0)
+    ray_cluster.restart_gcs()
+
+    # The actor's worker never died: after clients reconnect, lookup and
+    # calls work and in-memory actor state is intact.
+    deadline = time.time() + 20
+    last = None
+    while time.time() < deadline:
+        try:
+            b = ray_tpu.get_actor("store")
+            last = ray_tpu.get(b.get.remote("x"), timeout=10)
+            break
+        except Exception as e:  # noqa: BLE001
+            last = e
+            time.sleep(0.3)
+    assert last == 42, last
+
+
+def test_kv_and_nodes_survive_gcs_restart(ray_cluster):
+    extra = ray_cluster.add_node(num_cpus=1, resources={"tag": 1})
+    ray_cluster.connect()
+    import ray_tpu
+    ray_cluster.wait_for_nodes()
+
+    time.sleep(1.0)  # persistence interval
+    ray_cluster.restart_gcs()
+
+    # Nodes table restored + raylets re-register within their heartbeat.
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+            if len(alive) == 2:
+                break
+        except Exception:
+            pass
+        time.sleep(0.3)
+    assert len(alive) == 2
+
+    # Scheduling still works end-to-end after the restart.
+    @ray_tpu.remote
+    def where():
+        import os
+        return os.environ.get("RAY_TPU_NODE_ID", "")
+
+    got = ray_tpu.get(where.options(resources={"tag": 1}).remote(),
+                      timeout=60)
+    assert got == extra.node_id.hex()
+
+
+def test_snapshot_written_and_atomic(ray_cluster):
+    import os
+    ray_cluster.connect()
+    import ray_tpu
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    assert ray_tpu.get(one.remote(), timeout=60) == 1
+    deadline = time.time() + 10
+    path = os.path.join(ray_cluster.session_dir, "gcs_snapshot.bin")
+    while time.time() < deadline and not os.path.exists(path):
+        time.sleep(0.2)
+    assert os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")

@@ -1,0 +1,127 @@
+"""Job submission + state API + CLI tests.
+
+Reference patterns: dashboard/modules/job/tests, python/ray/tests/test_state_api.py,
+python/ray/tests/test_cli.py.
+"""
+
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def cluster(request):
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_job_submit_succeeds(cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+    status = client.wait_until_finish(sid, timeout=120)
+    assert status == JobStatus.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(sid)
+    jobs = client.list_jobs()
+    assert any(j["submission_id"] == sid for j in jobs)
+
+
+def test_job_failure_reported(cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"raise SystemExit(3)\"")
+    status = client.wait_until_finish(sid, timeout=120)
+    assert status == JobStatus.FAILED
+    assert "exit code 3" in client.get_job_info(sid)["message"]
+
+
+def test_job_stop(cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; time.sleep(60)\"")
+    deadline = time.time() + 60
+    while (time.time() < deadline
+           and client.get_job_status(sid) != JobStatus.RUNNING):
+        time.sleep(0.2)
+    assert client.stop_job(sid)
+    status = client.wait_until_finish(sid, timeout=60)
+    assert status == JobStatus.STOPPED
+
+
+def test_job_entrypoint_can_use_cluster(cluster):
+    """The entrypoint connects back to THIS cluster via RAY_TPU_ADDRESS."""
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    client = JobSubmissionClient()
+    script = ("import ray_tpu; ray_tpu.init(); "
+              "print('cpus', ray_tpu.cluster_resources()['CPU'])")
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c \"{script}\"")
+    status = client.wait_until_finish(sid, timeout=180)
+    logs = client.get_job_logs(sid)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "cpus 4.0" in logs
+
+
+def test_state_list_actors(cluster):
+    from ray_tpu.util import state
+
+    @cluster.remote
+    class Marker:
+        def ping(self):
+            return 1
+
+    a = Marker.options(name="state-probe").remote()
+    assert cluster.get(a.ping.remote(), timeout=60) == 1
+    actors = state.list_actors(state="ALIVE")
+    assert any(x["class_name"] == "Marker" and x["name"] == "state-probe"
+               for x in actors)
+
+
+def test_state_list_tasks_and_summary(cluster):
+    from ray_tpu.util import state
+
+    @cluster.remote
+    def tracked():
+        return 1
+
+    cluster.get([tracked.remote() for _ in range(3)], timeout=60)
+    time.sleep(1.5)  # task-event flush interval
+    rows = state.list_tasks()
+    assert any(r["name"] == "tracked" for r in rows)
+    summary = state.summarize_tasks()
+    assert "tracked" in summary
+
+
+def test_state_list_nodes_and_objects(cluster):
+    import numpy as np
+
+    from ray_tpu.util import state
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+
+    ref = cluster.put(np.ones(1_000_000))  # plasma-sized
+    objs = state.list_objects()
+    assert any(o["size"] >= 8_000_000 for o in objs)
+    del ref
+
+
+def test_cluster_status_blob(cluster):
+    from ray_tpu.util.state import cluster_status
+    st = cluster_status()
+    assert st["nodes_alive"] == 1
+    assert st["cluster_resources"]["CPU"] == 4.0
+
+
+def test_cli_help_and_parser():
+    from ray_tpu.scripts.cli import build_parser
+    p = build_parser()
+    args = p.parse_args(["list", "actors", "--address", "x:1"])
+    assert args.entity == "actors"
+    args = p.parse_args(["job", "submit", "--address", "x:1", "--", "echo",
+                         "hi"])
+    assert args.job_cmd == "submit"

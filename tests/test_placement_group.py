@@ -1,0 +1,152 @@
+"""Placement group scheduling tests (PACK/SPREAD/STRICT_*).
+
+Reference pattern: python/ray/tests/test_placement_group*.py over
+ray_start_cluster; strategies per
+src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h.
+"""
+
+import os
+import time
+
+import pytest
+
+from ray_tpu.util.placement_group import (placement_group,
+                                          placement_group_table,
+                                          remove_placement_group)
+from ray_tpu.util.scheduling_strategies import \
+    PlacementGroupSchedulingStrategy
+
+
+def _table_entry(pg):
+    for row in placement_group_table():
+        if row["placement_group_id"] == pg.id.hex():
+            return row
+    return None
+
+
+def test_pack_single_node(ray_cluster):
+    ray_cluster.connect()
+    import ray_tpu
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+    row = _table_entry(pg)
+    assert row["state"] == "CREATED"
+    # PACK on one feasible node: both bundles on the same node.
+    assert len(set(row["bundle_nodes"].values())) == 1
+
+    @ray_tpu.remote
+    def where():
+        return os.environ.get("RAY_TPU_NODE_ID", "")
+
+    node = ray_tpu.get(where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0),
+        num_cpus=1).remote(), timeout=60)
+    assert node == list(row["bundle_nodes"].values())[0]
+
+
+def test_spread_uses_two_nodes(ray_cluster):
+    ray_cluster.add_node(num_cpus=2)
+    ray_cluster.connect()
+    ray_cluster.wait_for_nodes()
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="SPREAD")
+    assert pg.wait(30)
+    row = _table_entry(pg)
+    assert len(set(row["bundle_nodes"].values())) == 2
+    remove_placement_group(pg)
+
+
+def test_strict_spread_waits_for_nodes(ray_cluster):
+    ray_cluster.add_node(num_cpus=2)
+    ray_cluster.connect()
+    ray_cluster.wait_for_nodes()
+
+    # 3 bundles, 2 nodes: STRICT_SPREAD must stay pending...
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert not pg.wait(2)
+    # ...until a third node joins.
+    ray_cluster.add_node(num_cpus=2)
+    assert pg.wait(30)
+    row = _table_entry(pg)
+    assert len(set(row["bundle_nodes"].values())) == 3
+
+
+def test_strict_pack_one_node(ray_cluster):
+    ray_cluster.add_node(num_cpus=2)
+    ray_cluster.connect()
+    ray_cluster.wait_for_nodes()
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+    assert pg.wait(30)
+    row = _table_entry(pg)
+    assert len(set(row["bundle_nodes"].values())) == 1
+
+
+def test_pg_reserves_resources(ray_cluster):
+    ray_cluster.connect()
+    import ray_tpu
+
+    before = ray_tpu.available_resources().get("CPU", 0)
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        after = ray_tpu.available_resources().get("CPU", 0)
+        if after == before - 1:
+            break
+        time.sleep(0.1)
+    assert after == before - 1
+
+    remove_placement_group(pg)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        restored = ray_tpu.available_resources().get("CPU", 0)
+        if restored == before:
+            break
+        time.sleep(0.1)
+    assert restored == before
+
+
+def test_pg_actor_lands_in_bundle(ray_cluster):
+    target = ray_cluster.add_node(num_cpus=2, resources={"pgnode": 1})
+    ray_cluster.connect()
+    import ray_tpu
+    ray_cluster.wait_for_nodes()
+
+    pg = placement_group([{"CPU": 1, "pgnode": 0.1}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray_tpu.remote
+    class Probe:
+        def where(self):
+            return os.environ.get("RAY_TPU_NODE_ID", "")
+
+    a = Probe.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0),
+        num_cpus=1).remote()
+    assert ray_tpu.get(a.where.remote(), timeout=60) == target.node_id.hex()
+
+
+def test_remove_pg_state(ray_cluster):
+    ray_cluster.connect()
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+    remove_placement_group(pg)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        row = _table_entry(pg)
+        if row and row["state"] == "REMOVED":
+            break
+        time.sleep(0.1)
+    assert row["state"] == "REMOVED"
+
+
+def test_infeasible_pg_stays_pending(ray_cluster):
+    ray_cluster.connect()
+    pg = placement_group([{"CPU": 64}], strategy="PACK")
+    assert not pg.wait(2)
+    row = _table_entry(pg)
+    assert row["state"] in ("PENDING", "RESCHEDULING")
