@@ -60,6 +60,7 @@ class OwnedObject:
     ready: bool = False
     waiters: List[asyncio.Future] = field(default_factory=list)
     spilled: bool = False
+    reconstructions: int = 0   # lineage re-executions consumed (bounded)
 
 
 @dataclass
@@ -697,9 +698,14 @@ class CoreWorker:
             return await self.store.get(key, timeout=t)
         if not locations:
             return None
-        ok = await self.raylet.request("store_fetch_remote", {
-            "object_id": key, "locations": list(locations),
-            "owner_address": owner}, timeout=120.0)
+        try:
+            ok = await self.raylet.request("store_fetch_remote", {
+                "object_id": key, "locations": list(locations),
+                "owner_address": owner}, timeout=120.0)
+        except rpc.RpcError:
+            # Holder nodes unreachable: treat as lost copies so the owned
+            # path can attempt lineage reconstruction.
+            ok = False
         if not ok:
             return None
         # Record the new location with the owner.
@@ -718,16 +724,41 @@ class CoreWorker:
         return await self.store.get(key, timeout=timeout)
 
     async def _reconstruct(self, ent: OwnedObject) -> bool:
-        """Lineage reconstruction: resubmit the creating task."""
+        """Lineage reconstruction: resubmit the creating task.
+
+        Reference semantics (object_recovery_manager.h): tasks with
+        max_retries=0 are not reconstructable, and reconstruction cycles
+        are bounded per object rather than refreshing the retry budget.
+        """
         spec = ent.creating_spec
-        if spec is None:
+        if spec is None or spec.max_retries == 0:
             return False
+        if spec.task_id in self.pending_tasks:
+            # A reconstruction of this object is already in flight
+            # (concurrent get()s race to _reconstruct): don't resubmit the
+            # same TaskSpec twice or burn budget on the duplicate.
+            return True
+        budget = spec.max_retries if spec.max_retries > 0 else 1
+        if ent.reconstructions >= budget:
+            return False
+        ent.reconstructions += 1
         logger.warning("reconstructing object %s by resubmitting task %s",
                        ent.object_id.hex()[:12], spec.name)
         ent.ready = False
         ent.locations = []
         ent.inline_value = None
         self.inproc.pop(ent.object_id, None)
+        self._inproc_exc.discard(ent.object_id)
+        # Re-register the pending entry: the resubmission may land on a
+        # stale cached lease pointing at the dead node's worker, and the
+        # worker-death handler consults pending_tasks for retry budget.
+        # Arg refs are re-pinned for the re-execution, exactly like the
+        # original submission (_finish_task_submission).
+        returns = [ObjectID.for_task_return(spec.task_id, i)
+                   for i in range(spec.num_returns)]
+        self.pending_tasks[spec.task_id] = PendingTask(
+            spec=spec, retries_left=1, returns=returns,
+            arg_refs=self._pin_arg_refs(spec))
         await self._submit_to_cluster(spec)
         return True
 
@@ -789,9 +820,14 @@ class CoreWorker:
     # ==================================================================
 
     async def export_function(self, func: Any, function_id: str):
-        """Push a cloudpickled function/class to the GCS function table."""
-        import cloudpickle
-        data = cloudpickle.dumps(func)
+        """Push a cloudpickled function/class to the GCS function table.
+
+        Driver-local modules ship by value (serialization.dumps_function) so
+        workers on other nodes can deserialize without the driver's sys.path
+        — reference: python/ray/_private/function_manager.py export path.
+        """
+        from ray_tpu._private.serialization import dumps_function
+        data = dumps_function(func)
         await self.gcs.request("kv_put", {
             "namespace": "funcs", "key": function_id.encode(), "value": data})
 
@@ -1023,11 +1059,15 @@ class CoreWorker:
         queue = self._task_queue.get(sched_class)
         if not queue:
             return
-        # Use existing leases, pipelining up to depth tasks per worker.
+        # First pass: one task per idle lease. Deeper pipelining is applied
+        # only to tasks that cannot get their own lease request — otherwise
+        # long tasks serialize on cached local leases while other nodes sit
+        # idle (reference keeps max_tasks_in_flight_per_worker=1 by default,
+        # direct_task_transport.h).
         depth = max(1, self.config.task_pipeline_depth)
         leases = self.leases.setdefault(sched_class, [])
         for lease in leases:
-            while queue and not lease.returning and lease.inflight < depth:
+            if queue and not lease.returning and lease.inflight == 0:
                 spec = queue.pop(0)
                 lease.inflight += 1
                 asyncio.ensure_future(
@@ -1040,6 +1080,17 @@ class CoreWorker:
             self._lease_requests_inflight[sched_class] = \
                 self._lease_requests_inflight.get(sched_class, 0) + 1
             asyncio.ensure_future(self._acquire_lease(sched_class, queue[0]))
+            inflight += 1
+        # Overflow beyond outstanding lease demand: pipeline onto live leases.
+        overflow = len(queue) - inflight
+        for lease in leases:
+            while overflow > 0 and queue and not lease.returning \
+                    and lease.inflight < depth:
+                spec = queue.pop(0)
+                lease.inflight += 1
+                overflow -= 1
+                asyncio.ensure_future(
+                    self._run_on_lease(sched_class, lease, spec))
 
     async def _acquire_lease(self, sched_class: tuple, sample_spec: TaskSpec):
         try:

@@ -32,6 +32,142 @@ from ray_tpu._private.object_store import ObjectStoreHost
 logger = logging.getLogger(__name__)
 
 
+class _SharedForkServer:
+    """Process-wide zygote client (worker_forkserver.py).
+
+    One warm template process serves every raylet in this OS process (the
+    fake cluster runs many raylets per process) and survives across
+    cluster setups, so only the first cluster in a test run pays the
+    template's import cost. Spawn requests carry the per-worker env, so
+    the template is raylet-agnostic.
+    """
+
+    _inst: Optional["_SharedForkServer"] = None
+
+    def __init__(self):
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self.ready = False
+        self.dead = False
+        self.handlers: Dict[str, "Raylet"] = {}   # worker_id hex -> raylet
+        self._starting = False
+        self._ready_callbacks: List = []
+        self._pending_spawns: List[bytes] = []    # buffered before proc is up
+        self._base_env: Optional[Dict[str, str]] = None
+
+    @classmethod
+    def get(cls) -> "_SharedForkServer":
+        if cls._inst is None or cls._inst.dead:
+            prev = cls._inst
+            cls._inst = cls()
+            if prev is not None:
+                cls._inst._base_env = prev._base_env
+        return cls._inst
+
+    async def ensure_started(self, env: Dict[str, str]):
+        if self.proc is not None or self._starting or self.dead:
+            return
+        self._base_env = dict(env)
+        self._starting = True
+        try:
+            self.proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "ray_tpu._private.worker_forkserver",
+                env=env,
+                stdin=asyncio.subprocess.PIPE,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                start_new_session=True)
+        except Exception:
+            self.dead = True
+            self._fail_pending()
+            return
+        finally:
+            self._starting = False
+        for line in self._pending_spawns:
+            try:
+                self.proc.stdin.write(line)
+            except Exception:
+                self.dead = True
+                break
+        self._pending_spawns.clear()
+        asyncio.ensure_future(self._reader())
+
+    async def _reader(self):
+        import json
+        proc = self.proc
+        try:
+            while True:
+                line = await proc.stdout.readline()
+                if not line:
+                    break
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                event = msg.get("event")
+                if event == "ready":
+                    self.ready = True
+                    for cb in self._ready_callbacks:
+                        try:
+                            cb()
+                        except Exception:
+                            pass
+                    self._ready_callbacks.clear()
+                elif event in ("spawned", "exit"):
+                    raylet = self.handlers.get(msg.get("worker_id", ""))
+                    if raylet is not None:
+                        raylet._on_forkserver_event(event, msg)
+                    if event == "exit":
+                        self.handlers.pop(msg.get("worker_id", ""), None)
+        finally:
+            self.dead = True
+            self.ready = False
+            self._fail_pending()
+
+    def _fail_pending(self):
+        """Zygote died (or could not start): every worker it still tracked
+        is gone or will never be forked. Tell the owning raylets so supply
+        accounting doesn't leak phantom handles."""
+        self._pending_spawns.clear()
+        for wid, raylet in list(self.handlers.items()):
+            try:
+                raylet._on_forkserver_event(
+                    "exit", {"worker_id": wid, "pid": -1, "status": -1})
+            except Exception:
+                pass
+        self.handlers.clear()
+
+    def on_ready(self, cb):
+        if self.ready:
+            cb()
+        else:
+            self._ready_callbacks.append(cb)
+
+    def spawn(self, env: Dict[str, str], log_path: str,
+              raylet: "Raylet") -> bool:
+        if self.dead:
+            return False
+        import json
+        line = (json.dumps({"spawn": {"env": env,
+                                      "log_path": log_path}}) + "\n").encode()
+        if self.proc is None or self.proc.stdin is None:
+            # Buffer (flushed on start). If no start is in flight — e.g.
+            # this is a fresh instance replacing a dead zygote — kick one
+            # off so buffered spawns don't sit forever.
+            if not self._starting:
+                if self._base_env is None:
+                    return False  # nothing can start it: use Popen fallback
+                asyncio.ensure_future(self.ensure_started(self._base_env))
+            self._pending_spawns.append(line)
+        else:
+            try:
+                self.proc.stdin.write(line)
+            except Exception:
+                self.dead = True
+                return False
+        self.handlers[env["RAY_TPU_WORKER_ID"]] = raylet
+        return True
+
+
 @dataclass
 class WorkerHandle:
     worker_id: WorkerID
@@ -144,6 +280,9 @@ class Raylet:
         self._worker_env = dict(os.environ)
         self._stopped = False
         self._resources_dirty = False
+        # Fork-server (zygote) for fast worker spawn; Popen is the fallback
+        # if it is unavailable (worker_forkserver.py).
+        self._workers_by_hex: Dict[str, WorkerHandle] = {}
 
     def _default_resources(self) -> Dict[str, float]:
         cpus = os.cpu_count() or 1
@@ -163,6 +302,7 @@ class Raylet:
         await self._register_with_gcs()
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._idle_worker_reaper()))
+        self._tasks.append(asyncio.ensure_future(self._start_forkserver()))
         logger.info("raylet %s started at %s", self.node_name, self.address)
         return self.address
 
@@ -175,6 +315,11 @@ class Raylet:
                 try:
                     w.proc.terminate()
                 except Exception:
+                    pass
+            elif w.pid > 0:
+                try:
+                    os.kill(w.pid, 15)
+                except OSError:
                     pass
         for w in self.workers.values():
             if w.proc is not None:
@@ -248,6 +393,21 @@ class Raylet:
         except rpc.RpcError:
             pass
 
+    def _mark_resources_dirty(self):
+        """Push the new resource view to the GCS now (coalesced), so
+        available_resources() reads don't race the heartbeat period."""
+        if self._resources_dirty:
+            return
+        self._resources_dirty = True
+
+        async def _flush():
+            await asyncio.sleep(0)  # coalesce a burst of acquire/release
+            if self._resources_dirty and not self._stopped:
+                self._resources_dirty = False
+                await self._report_resources()
+
+        asyncio.ensure_future(_flush())
+
     def _on_gcs_push(self, method: str, payload):
         if method != "pub":
             return
@@ -258,6 +418,9 @@ class Raylet:
                 self.cluster_view[msg["node_id"]] = {
                     "available": msg["available"], "total": msg["total"],
                     "address": msg.get("address", "")}
+                # A peer freeing resources may unblock queued lease
+                # requests via spillback.
+                self._try_dispatch()
         elif channel == "nodes":
             if msg["event"] == "dead":
                 self.cluster_view.pop(msg.get("node_id"), None)
@@ -265,7 +428,7 @@ class Raylet:
     # ------------------------------------------------------------------
     # Worker pool
 
-    def _spawn_worker(self) -> WorkerHandle:
+    def _worker_env_for(self, worker_id: WorkerID) -> Dict[str, str]:
         env = dict(self._worker_env)
         # Workers must import ray_tpu regardless of the driver's cwd/sys.path.
         import ray_tpu
@@ -278,11 +441,63 @@ class Raylet:
         env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
-        worker_id = WorkerID.from_random()
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        return env
+
+    def _worker_log_path(self, worker_id: WorkerID) -> str:
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
-        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"), "wb")
+        return os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log")
+
+    async def _start_forkserver(self):
+        """Bring up (or join) the process-wide zygote and prestart workers."""
+        fs = _SharedForkServer.get()
+        await fs.ensure_started(self._worker_env_for(WorkerID.from_random()))
+        if not fs.dead and not self._stopped:
+            fs.on_ready(self._prestart_workers)
+
+    def _on_forkserver_event(self, event: str, msg: dict):
+        if event == "spawned":
+            if self._stopped:
+                # Forked after our stop(): nothing will ever lease it.
+                try:
+                    os.kill(msg["pid"], 15)
+                except OSError:
+                    pass
+                return
+            handle = self._workers_by_hex.get(msg.get("worker_id"))
+            if handle is not None:
+                handle.pid = msg["pid"]
+            return
+        if self._stopped:
+            return
+        # exit
+        handle = self._workers_by_hex.pop(msg.get("worker_id"), None)
+        if handle is not None and handle.worker_id in self.workers:
+            if handle.registered and handle.conn is not None \
+                    and not handle.conn.closed:
+                handle.conn.abort(rpc.ConnectionLost("process exited"))
+            else:
+                asyncio.ensure_future(
+                    self._on_worker_disconnect(handle.worker_id))
+
+    def _spawn_worker(self) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        env = self._worker_env_for(worker_id)
+        log_path = self._worker_log_path(worker_id)
+        fs = _SharedForkServer.get()
+        # Fast path: ask the zygote to fork a worker (~ms, vs seconds for a
+        # cold python+jax start). Requests written before the zygote finishes
+        # importing are buffered in the pipe. The FULL worker env ships with
+        # the request (the child resets os.environ to it) — the zygote is a
+        # long-lived singleton whose template env can be stale.
+        if fs.spawn(env, log_path, self):
+            handle = WorkerHandle(worker_id=worker_id, pid=-1, proc=None)
+            self.workers[worker_id] = handle
+            self._workers_by_hex[worker_id.hex()] = handle
+            self._starting_workers += 1
+            return handle
+        out = open(log_path, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             env=env, stdout=out, stderr=subprocess.STDOUT,
@@ -290,6 +505,7 @@ class Raylet:
         )
         handle = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc)
         self.workers[worker_id] = handle
+        self._workers_by_hex[worker_id.hex()] = handle
         self._starting_workers += 1
         return handle
 
@@ -318,6 +534,7 @@ class Raylet:
 
     async def _on_worker_disconnect(self, worker_id: WorkerID):
         handle = self.workers.pop(worker_id, None)
+        self._workers_by_hex.pop(worker_id.hex(), None)
         if handle is None:
             return
         if not handle.registered:
@@ -327,7 +544,7 @@ class Raylet:
             self._idle_workers.remove(handle)
         if handle.leased:
             self.pool.release(handle.lease_resources, handle.lease_pg)
-            self._resources_dirty = True
+            self._mark_resources_dirty()
         if handle.is_actor_worker and handle.actor_id is not None:
             try:
                 await self.gcs_conn.request("report_actor_failure", {
@@ -464,14 +681,37 @@ class Raylet:
             if fut.done():
                 continue
             if not self.pool.fits(spec.resources, pg_key):
-                remaining.append((spec, pg_key, fut))
+                # Re-evaluate spillback for queued requests: the entry-time
+                # decision can race with concurrent grants that drained the
+                # local pool (reference: each scheduling tick may spill,
+                # cluster_task_manager.h). PG-pinned and affinity tasks
+                # never spill.
+                if pg_key is None and spec.scheduling.kind in ("DEFAULT",
+                                                               "SPREAD"):
+                    for node_id, view in self.cluster_view.items():
+                        avail = view.get("available", {})
+                        if view.get("address") and all(
+                                avail.get(k, 0) >= v
+                                for k, v in spec.resources.items() if v > 0):
+                            # Debit our local copy of the peer's view so a
+                            # burst of queued requests doesn't all spill to
+                            # the same (about-to-be-full) node; the next
+                            # resource pub refreshes the real numbers.
+                            for k, v in spec.resources.items():
+                                if v > 0:
+                                    avail[k] = avail.get(k, 0) - v
+                            fut.set_result(
+                                {"spillback": view["address"]})
+                            break
+                if not fut.done():
+                    remaining.append((spec, pg_key, fut))
                 continue
             worker = self._get_idle_worker()
             if worker is None:
                 remaining.append((spec, pg_key, fut))
                 continue
             self.pool.acquire(spec.resources, pg_key)
-            self._resources_dirty = True
+            self._mark_resources_dirty()
             worker.leased = True
             worker.lease_class = spec.scheduling_class()
             worker.lease_resources = dict(spec.resources)
@@ -493,7 +733,7 @@ class Raylet:
             return False
         handle.leased = False
         self.pool.release(handle.lease_resources, handle.lease_pg)
-        self._resources_dirty = True
+        self._mark_resources_dirty()
         handle.lease_resources = {}
         handle.lease_pg = None
         if payload.get("kill", False):
@@ -578,7 +818,7 @@ class Raylet:
         worker.actor_id = spec.actor_id
         worker.lease_resources = dict(spec.resources)
         worker.lease_pg = pg_key
-        self._resources_dirty = True
+        self._mark_resources_dirty()
         try:
             reply = await self.clients.request(worker.address,
                                                "instantiate_actor", {
@@ -602,9 +842,20 @@ class Raylet:
             if worker not in self._idle_workers:
                 self._idle_workers.append(worker)
             self.pool.release(spec.resources, pg_key)
-            self._resources_dirty = True
+            self._mark_resources_dirty()
             return {"app_error": reply["app_error"]}
         return {"actor_address": worker.address, "worker_id": worker.worker_id}
+
+    def _prestart_workers(self):
+        """Warm the pool so first leases don't wait on worker boot
+        (reference: WorkerPool prestart, worker_pool.h)."""
+        if self._stopped:
+            return
+        floor = min(int(self.pool.total.get("CPU", 1)), 4,
+                    self.config.max_workers_per_node - len(self.workers))
+        supply = len(self._idle_workers) + self._starting_workers
+        for _ in range(max(0, floor - supply)):
+            self._spawn_worker()
 
     async def rpc_kill_worker(self, conn, payload):
         handle = self.workers.get(payload["worker_id"])
@@ -615,6 +866,11 @@ class Raylet:
                 handle.proc.kill()
             except Exception:
                 pass
+        elif handle.pid > 0:
+            try:
+                os.kill(handle.pid, 9)
+            except OSError:
+                pass
         return True
 
     # ------------------------------------------------------------------
@@ -624,13 +880,13 @@ class Raylet:
         key = (payload["pg_id"].binary(), payload["bundle_index"])
         ok = self.pool.reserve_bundle(key, payload["resources"])
         if ok:
-            self._resources_dirty = True
+            self._mark_resources_dirty()
         return ok
 
     async def rpc_return_bundle(self, conn, payload):
         key = (payload["pg_id"].binary(), payload["bundle_index"])
         self.pool.return_bundle(key)
-        self._resources_dirty = True
+        self._mark_resources_dirty()
         return True
 
     # ------------------------------------------------------------------
@@ -742,7 +998,9 @@ class Raylet:
                     pos += len(d)
                 self.store.seal(oid)
                 return True
-            except rpc.RpcError:
+            except (rpc.RpcError, OSError):
+                # RpcError or raw socket errors (ConnectionRefused when the
+                # holder node died): try the next location.
                 if created:
                     # Roll back so another location (or retry) can recreate.
                     self.store.abort_create(oid)

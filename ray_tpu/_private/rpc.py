@@ -263,8 +263,14 @@ class RpcServer:
 async def connect(address: str, push_handler: Optional[Callable] = None,
                   timeout: float = 10.0) -> Connection:
     host, port = address.rsplit(":", 1)
-    reader, writer = await asyncio.wait_for(
-        asyncio.open_connection(host, int(port)), timeout)
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, int(port)), timeout)
+    except (OSError, asyncio.TimeoutError) as e:
+        # Normalize socket-level dial failures (ConnectionRefused when the
+        # peer died) into the RPC error hierarchy so call sites only need
+        # to catch RpcError.
+        raise ConnectionLost(f"connect to {address} failed: {e}")
     conn = Connection(reader, writer, push_handler)
     asyncio.ensure_future(conn.client_loop())
     return conn

@@ -13,14 +13,115 @@ Wire layout (8-byte aligned so numpy views map directly onto shm):
 
 from __future__ import annotations
 
+import os
 import pickle
 import struct
+import sys
+import sysconfig
 import threading
 from typing import Any, Callable, List, Optional
 
 import cloudpickle
 
 from ray_tpu._private.object_ref import ObjectRef
+
+# ---------------------------------------------------------------------------
+# Driver-module pickle-by-value registration.
+#
+# cloudpickle serializes functions/classes defined in *importable* modules by
+# reference (module name + qualname). That is correct for installed libraries
+# but wrong for driver-local modules (a user script, a pytest test module):
+# workers on other nodes do not have the driver's sys.path, so unpickling
+# dies with ModuleNotFoundError. The reference ships code through the GCS
+# function table with by-value pickling of the function AND the driver-module
+# globals it references (python/ray/_private/function_manager.py). We get the
+# same effect by registering any non-stdlib/site-packages module with
+# cloudpickle.register_pickle_by_value before pickling user functions — the
+# whole closure (referenced module globals included) then travels by value.
+# ---------------------------------------------------------------------------
+
+_LIB_PATHS = tuple(
+    os.path.abspath(p) + os.sep
+    for p in {
+        sysconfig.get_paths().get("stdlib"),
+        sysconfig.get_paths().get("platstdlib"),
+        sysconfig.get_paths().get("purelib"),
+        sysconfig.get_paths().get("platlib"),
+    }
+    if p
+)
+_by_value_registered: set = set()
+
+
+def _is_driver_local_module(mod) -> bool:
+    """True for modules that exist only on the driver's sys.path.
+
+    Known limitation: an editable install (`pip install -e`) lives outside
+    site-packages and is treated as driver-local, so it ships by value even
+    though workers could import it — wasteful but correct for same-code
+    clusters. The reference has the inverse problem (by-reference pickling
+    of genuinely driver-local modules), which is the worse failure mode.
+    """
+    if mod is None:
+        return False
+    name = getattr(mod, "__name__", "")
+    if not name or name in ("__main__", "__mp_main__"):
+        return False  # cloudpickle already pickles __main__ by value
+    if name.split(".")[0] == "ray_tpu":
+        return False  # the framework itself is importable on every worker
+    path = getattr(mod, "__file__", None)
+    if path is None:
+        return False  # builtin / C extension
+    path = os.path.abspath(path)
+    if "site-packages" in path or "dist-packages" in path:
+        return False
+    return not any(path.startswith(p) for p in _LIB_PATHS)
+
+
+def _register_module_tree(mod) -> None:
+    """Register a driver-local module and, recursively, every driver-local
+    module reachable through its globals (``import helpers`` in a test
+    module must also travel by value, or functions it defines would still
+    pickle by reference and fail on remote nodes)."""
+    name = getattr(mod, "__name__", None)
+    if not name or name in _by_value_registered:
+        return
+    _by_value_registered.add(name)
+    if not _is_driver_local_module(mod):
+        return
+    try:
+        cloudpickle.register_pickle_by_value(mod)
+    except Exception:
+        return
+    import types
+    for attr in list(vars(mod).values()):
+        if isinstance(attr, types.ModuleType):
+            _register_module_tree(attr)
+        else:
+            sub_name = getattr(attr, "__module__", None)
+            if sub_name and sub_name not in _by_value_registered:
+                sub = sys.modules.get(sub_name)
+                if sub is not None:
+                    _register_module_tree(sub)
+
+
+def ensure_pickle_by_value(obj) -> None:
+    """Register obj's defining module (if driver-local) for by-value pickling."""
+    mod_name = getattr(obj, "__module__", None)
+    if not mod_name or mod_name in _by_value_registered:
+        return
+    mod = sys.modules.get(mod_name)
+    if mod is not None:
+        _register_module_tree(mod)
+    else:
+        _by_value_registered.add(mod_name)
+
+
+def dumps_function(obj) -> bytes:
+    """cloudpickle.dumps for user functions/classes, shipping driver-local
+    modules by value so remote nodes can always deserialize them."""
+    ensure_pickle_by_value(obj)
+    return cloudpickle.dumps(obj)
 
 MAGIC = 0x52545055  # "RTPU"
 _ALIGN = 8

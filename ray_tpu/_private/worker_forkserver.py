@@ -1,0 +1,134 @@
+"""Worker fork-server (zygote): pay the interpreter + framework import cost
+once per node, then fork workers in milliseconds.
+
+The reference hides worker startup latency by prestarting idle worker
+processes in the raylet's WorkerPool (src/ray/raylet/worker_pool.h). In this
+environment a cold ``python`` start costs seconds (sitecustomize registers the
+TPU PJRT plugin, importing jax), which serializes badly on small CI boxes —
+so we go further: one warm template process per raylet that ``fork()``s a
+worker per request. Children inherit the warmed import state but create their
+own event loop and RPC connections; no threads or event loops exist in the
+template at fork time, so the fork is safe.
+
+Protocol (line-delimited JSON over stdin/stdout):
+  raylet -> forkserver: {"spawn": {"env": {...}, "log_path": "..."}}
+  forkserver -> raylet: {"event": "ready"}
+                        {"event": "spawned", "pid": N, "worker_id": "..."}
+                        {"event": "exit", "pid": N, "worker_id": "...",
+                         "status": N}
+On stdin EOF (raylet death) the forkserver kills its children and exits.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import signal
+import sys
+
+
+def _send(msg: dict) -> None:
+    sys.stdout.write(json.dumps(msg) + "\n")
+    sys.stdout.flush()
+
+
+def _run_child(req: dict) -> None:
+    """Forked child: detach, redirect output, become a worker. Never returns."""
+    try:
+        os.setsid()
+    except OSError:
+        pass
+    log_path = req.get("log_path")
+    if log_path:
+        fd = os.open(log_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        os.dup2(fd, 1)
+        os.dup2(fd, 2)
+        if fd > 2:
+            os.close(fd)
+    devnull = os.open(os.devnull, os.O_RDONLY)
+    os.dup2(devnull, 0)
+    if devnull > 2:
+        os.close(devnull)
+    # Reset to exactly the requested env: the template's env belongs to the
+    # raylet that started the zygote and may be stale for this spawn.
+    env = req.get("env", {})
+    if env:
+        os.environ.clear()
+        os.environ.update(env)
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    signal.signal(signal.SIGINT, signal.SIG_IGN)
+    try:
+        from ray_tpu._private import worker_main
+        worker_main.main()
+    except SystemExit:
+        pass
+    except BaseException:
+        import traceback
+        traceback.print_exc()
+    finally:
+        os._exit(0)
+
+
+def main() -> None:
+    # Warm the worker's import tree while we are still single-threaded.
+    import ray_tpu._private.worker_main  # noqa: F401
+    import ray_tpu._private.serialization  # noqa: F401
+
+    children: dict = {}  # pid -> worker_id hex
+    _send({"event": "ready"})
+    stdin_fd = sys.stdin.fileno()
+    buf = b""
+    eof = False
+    while True:
+        try:
+            readable, _, _ = select.select([stdin_fd], [], [], 0.2)
+        except InterruptedError:
+            readable = []
+        # Reap exited children and report them.
+        while children:
+            try:
+                pid, status = os.waitpid(-1, os.WNOHANG)
+            except ChildProcessError:
+                break
+            if pid == 0:
+                break
+            wid = children.pop(pid, None)
+            _send({"event": "exit", "pid": pid, "worker_id": wid,
+                   "status": status})
+        if eof and not children:
+            return
+        if not readable or eof:
+            continue
+        chunk = os.read(stdin_fd, 1 << 16)
+        if not chunk:
+            # Raylet died or closed us: terminate children, drain, exit.
+            eof = True
+            for pid in list(children):
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except OSError:
+                    pass
+            continue
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            if not line.strip():
+                continue
+            try:
+                req = json.loads(line)
+            except ValueError:
+                continue
+            spawn = req.get("spawn")
+            if spawn is None:
+                continue
+            pid = os.fork()
+            if pid == 0:
+                _run_child(spawn)  # never returns
+            wid = spawn.get("env", {}).get("RAY_TPU_WORKER_ID", "")
+            children[pid] = wid
+            _send({"event": "spawned", "pid": pid, "worker_id": wid})
+
+
+if __name__ == "__main__":
+    main()

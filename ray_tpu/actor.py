@@ -12,8 +12,6 @@ import hashlib
 import inspect
 from typing import Any, Dict, Optional
 
-import cloudpickle
-
 from ray_tpu._private import worker_api
 from ray_tpu._private.ids import ActorID
 from ray_tpu.remote_function import _resolve_scheduling, _resources_from_options
@@ -143,7 +141,8 @@ class ActorClass:
         core = worker_api.get_core()
         on_loop = worker_api._on_core_loop(core)
         if self._class_id is None:
-            data = cloudpickle.dumps(self._cls)
+            from ray_tpu._private.serialization import dumps_function
+            data = dumps_function(self._cls)
             self._class_id = "actor:" + hashlib.sha1(data).hexdigest()
         export = None
         if not worker_api._state.exported_functions.get(self._class_id):

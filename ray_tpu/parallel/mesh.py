@@ -56,7 +56,7 @@ class MeshConfig:
             auto = n_devices // fixed
             sizes = {k: (auto if v <= 0 else v) for k, v in sizes.items()}
         total = math.prod(sizes.values())
-        if total != n_devices:
+        if total > n_devices:
             raise ValueError(
                 f"mesh axes {sizes} need {total} devices, have {n_devices}")
         return sizes
@@ -88,7 +88,10 @@ def build_mesh(config: Optional[MeshConfig] = None,
         axis_sizes = config.axis_sizes(n)
     import numpy as np
     shape = tuple(axis_sizes[a] for a in AXIS_ORDER)
-    dev_array = np.asarray(devices).reshape(shape)
+    # A config whose axis product is smaller than the device count uses the
+    # first prod(shape) devices (e.g. a pipeline=4 experiment on an
+    # 8-device host).
+    dev_array = np.asarray(devices[: math.prod(shape)]).reshape(shape)
     return Mesh(dev_array, AXIS_ORDER)
 
 

@@ -9,8 +9,6 @@ from __future__ import annotations
 import hashlib
 from typing import Any, Dict, Optional
 
-import cloudpickle
-
 from ray_tpu._private import worker_api
 from ray_tpu._private.common import SchedulingStrategy
 
@@ -72,7 +70,8 @@ class RemoteFunction:
 
     def _fid(self) -> str:
         if self._function_id is None:
-            data = cloudpickle.dumps(self._function)
+            from ray_tpu._private.serialization import dumps_function
+            data = dumps_function(self._function)
             self._function_id = "fn:" + hashlib.sha1(data).hexdigest()
         return self._function_id
 

@@ -168,28 +168,34 @@ class ServeController:
             await asyncio.sleep(0.5)
 
     async def _health_check(self):
+        from ray_tpu import exceptions as exc
         now = time.monotonic()
         for st in list(self._deployments.values()):
             async def check(r):
                 try:
-                    return await asyncio.wait_for(
+                    await asyncio.wait_for(
                         r.handle.check_health.remote().future(), timeout=5)
+                    return True
+                except exc.ActorDiedError:
+                    return "dead"      # definitive: GCS marked it dead
                 except Exception:
-                    return False
+                    return False       # slow/unreachable: maybe starting
             # Probe all replicas concurrently: serial checks would make one
             # slow/dead replica delay the whole reconcile pass by its
             # timeout multiplied by the replica count.
             oks = await asyncio.gather(*[check(r) for r in st.replicas])
             for i, r in reversed(list(enumerate(st.replicas))):
                 ok = oks[i]
-                if ok:
+                if ok is True:
                     r.ever_healthy = True
                     continue
                 # A replica that has never come up yet may simply still be
                 # starting (worker spawn under load): give it a grace
-                # period before declaring it dead, else the controller
-                # kills replicas mid-creation.
-                if (not r.ever_healthy
+                # period before declaring it dead — unless its death is
+                # definitive (a replica can crash before its first health
+                # check ever succeeds; waiting out the grace would stall
+                # recovery for a minute).
+                if (ok is False and not r.ever_healthy
                         and now - r.started < st.STARTUP_GRACE_S):
                     continue
                 del st.replicas[i]

@@ -68,8 +68,17 @@ class AsyncHyperBandScheduler(TrialScheduler):
         while t < max_t:
             self._levels.append(t)
             t *= reduction_factor
-        # rung level -> list of scores recorded at that rung
-        self._rungs: Dict[int, List[float]] = {}
+        # rung level -> {trial_id: score recorded when the trial crossed}
+        self._rungs: Dict[int, Dict[str, float]] = {}
+
+    def _below_cutoff(self, level: int, trial_id: str) -> bool:
+        rung = self._rungs.get(level, {})
+        s = rung.get(trial_id)
+        if s is None or len(rung) < 2:
+            return False
+        k = max(1, len(rung) // self._rf)
+        top_k = sorted(rung.values(), reverse=True)[:k]
+        return s < top_k[-1]
 
     def on_trial_result(self, trial: Trial, result: dict,
                         all_trials: List[Trial]) -> str:
@@ -86,11 +95,19 @@ class AsyncHyperBandScheduler(TrialScheduler):
         while trial.rung < len(self._levels) and t >= self._levels[trial.rung]:
             level = self._levels[trial.rung]
             trial.rung += 1
-            rung = self._rungs.setdefault(level, [])
-            rung.append(s)
-            k = max(1, len(rung) // self._rf)
-            top_k = sorted(rung, reverse=True)[:k]
-            if s < top_k[-1]:
+            rung = self._rungs.setdefault(level, {})
+            rung[trial.trial_id] = s
+            if self._below_cutoff(level, trial.trial_id):
+                decision = STOP
+        # Retroactive demotion: a trial that crossed its last rung early
+        # (when the rung was near-empty, so promotion was optimistic) is
+        # stopped once later arrivals push its recorded score out of the
+        # top 1/rf — otherwise lockstep trials arriving weakest-first are
+        # never cut and ASHA degrades to FIFO (successive-halving
+        # semantics: only the top fraction of a rung is promoted).
+        if decision == CONTINUE and trial.rung > 0:
+            if self._below_cutoff(self._levels[trial.rung - 1],
+                                  trial.trial_id):
                 decision = STOP
         return decision
 
