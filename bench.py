@@ -94,11 +94,21 @@ def bench_core():
 
 
 def bench_model():
-    """GPT-2-small train-step throughput on the local chip (samples/s/chip).
+    """GPT-2-small train-step throughput on the local chip.
 
     Runs in a FRESH process (see main): the core bench forks workers and maps
     shm segments, which in round 1 left the TPU backend uninitializable
     (axon UNAVAILABLE). Isolation + running first fixes that.
+
+    Methodology notes (hard-won on the tunneled v5e):
+    - Sync via an actual host readback (np.asarray); block_until_ready
+      returns early through the axon tunnel and produces impossible numbers.
+    - No `with mesh:` around step calls and no donation on the tunnel —
+      both measured as 25-50x slowdowns (see train_step.py).
+    - Batch sizes try large->small with OOM fallback; the memory ceiling
+      is the optimizer state + remat residuals now that the LM-head loss
+      is chunked (models/gpt.py chunked_xent).
+    Returns a dict of model metrics or None.
     """
     try:
         import jax
@@ -113,43 +123,76 @@ def bench_model():
         from ray_tpu.parallel.mesh import build_mesh, MeshConfig
         from ray_tpu.train.train_step import init_train_state, make_train_step
 
-        cfg = GPTConfig()  # GPT-2 small, bf16, flash attention
+        cfg = GPTConfig()  # GPT-2 small, bf16, flash attention, remat
         mesh = build_mesh(MeshConfig(data=len(jax.devices())))
         opt = optax.adamw(3e-4)
         state = init_train_state(
             lambda: gpt_init(jax.random.PRNGKey(0), cfg), opt, mesh, "dp")
         step = make_train_step(lambda p, b: gpt_loss(p, b, cfg), opt, mesh,
                                "dp", sample_params=state.params)
-        bs, seq = 8, 1024
-        tokens = jnp.array(np.random.randint(0, cfg.vocab_size, (bs, seq + 1)),
-                           jnp.int32)
-        batch = {"tokens": tokens}
-        t0 = time.perf_counter()
-        state, m = step(state, batch)
-        jax.block_until_ready(m["loss"])
-        log(f"gpt2 compile+first step: {time.perf_counter()-t0:.1f}s "
-            f"loss={float(m['loss']):.3f}")
-        iters = 10
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            state, m = step(state, batch)
-        jax.block_until_ready(m["loss"])
-        dt = (time.perf_counter() - t0) / iters
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
+        seq = 1024
+
+        def sync(x):
+            return float(np.asarray(x))
+
+        result = None
+        first_attempt = True
+        for bs in (64, 32, 16, 8):
+            try:
+                if not first_attempt:
+                    # On donation-capable backends the failed attempt consumed
+                    # (donated) the state's buffers; rebuild before retrying.
+                    state = init_train_state(
+                        lambda: gpt_init(jax.random.PRNGKey(0), cfg), opt,
+                        mesh, "dp")
+                first_attempt = False
+                tokens = jnp.array(
+                    np.random.randint(0, cfg.vocab_size, (bs, seq + 1)),
+                    jnp.int32)
+                batch = {"tokens": tokens}
+                t0 = time.perf_counter()
+                st, m = step(state, batch)
+                loss0 = sync(m["loss"])
+                log(f"bs={bs} compile+first step: "
+                    f"{time.perf_counter()-t0:.1f}s loss={loss0:.3f}")
+                iters = 10
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    st, m = step(st, batch)
+                sync(m["loss"])
+                dt = (time.perf_counter() - t0) / iters
+                result = (bs, dt)
+                break
+            except Exception as e:  # OOM at this bs: try smaller
+                log(f"bs={bs} failed ({type(e).__name__}); trying smaller")
+                continue
+        if result is None:
+            return None
+        bs, dt = result
         sps = bs / dt
         tok_s = bs * seq / dt
         # MFU: 6*N flops/token (fwd+bwd) + attention 12*L*H*S flops/token.
-        n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
         flops_tok = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
         achieved = flops_tok * tok_s
         kind = jax.devices()[0].device_kind.lower()
         peaks = {"v4": 275e12, "v5e": 197e12, "v5 lite": 197e12,
                  "v5p": 459e12, "v5": 459e12, "v6e": 918e12, "v6": 918e12}
         peak = next((v for k, v in peaks.items() if k in kind), None)
-        mfu = f" MFU={achieved / peak * 100:.1f}%" if peak else ""
-        log(f"gpt2-small train: {sps:.2f} samples/s/chip "
+        mfu = round(achieved / peak * 100, 1) if peak else None
+        log(f"gpt2-small train: bs={bs} {sps:.2f} samples/s/chip "
             f"({tok_s:,.0f} tok/s, step {dt*1e3:.0f} ms, "
-            f"{achieved/1e12:.1f} TFLOP/s on {kind}{mfu})")
-        return sps
+            f"{achieved/1e12:.1f} TFLOP/s on {kind}"
+            f"{f' MFU={mfu}%' if mfu else ''})")
+        return {
+            "model_sps": round(sps, 2),
+            "model_tok_per_s": round(tok_s, 1),
+            "model_step_ms": round(dt * 1e3, 1),
+            "model_tflops": round(achieved / 1e12, 2),
+            "model_mfu_pct": mfu,
+            "model_batch_size": bs,
+            "device_kind": kind,
+        }
     except Exception as e:  # noqa: BLE001
         log(f"model bench skipped: {type(e).__name__}: {e}")
         return None
@@ -177,8 +220,8 @@ def _run_model_bench_subprocess():
             if line.startswith("{"):
                 try:
                     d = json.loads(line)
-                    if d.get("model_sps") is not None:
-                        return float(d["model_sps"])
+                    if d.get("model") is not None:
+                        return d["model"]
                 except json.JSONDecodeError:
                     pass
         tail = (proc.stderr or "").strip().splitlines()[-3:]
@@ -189,11 +232,11 @@ def _run_model_bench_subprocess():
 
 def main():
     if "--model-only" in sys.argv:
-        sps = bench_model()
-        print(json.dumps({"model_sps": sps}), flush=True)
+        model = bench_model()
+        print(json.dumps({"model": model}), flush=True)
         return
     # Model bench FIRST, isolated — before the core bench forks anything.
-    model_sps = _run_model_bench_subprocess()
+    model = _run_model_bench_subprocess()
     core = bench_core()
     value = core["actor_calls_async"]
     baseline = 9183.0  # BASELINE.md 1_1_actor_calls_async (m5.16xlarge)
@@ -203,8 +246,9 @@ def main():
         "unit": "calls/s",
         "vs_baseline": round(value / baseline, 3),
     }
-    if model_sps is not None:
-        out["gpt2_small_samples_per_s_chip"] = round(model_sps, 2)
+    if isinstance(model, dict):
+        out["gpt2_small_samples_per_s_chip"] = model.get("model_sps")
+        out.update({k: v for k, v in model.items() if k != "model_sps"})
     print(json.dumps(out), flush=True)
 
 

@@ -192,6 +192,18 @@ def _moe_block(layer, x, cfg: GPTConfig):
 
 def gpt_forward(params, tokens, cfg: GPTConfig, mesh=None):
     """tokens: [B, S] int32 -> logits [B, S, vocab] (cfg.dtype)."""
+    dt = cfg.dtype
+    x, aux_total = gpt_backbone(params, tokens, cfg, mesh)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            params["embed"]["table"].astype(dt))
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(dt))
+    return logits, aux_total
+
+
+def gpt_backbone(params, tokens, cfg: GPTConfig, mesh=None):
+    """tokens: [B, S] -> final hidden states [B, S, D] (pre-LM-head)."""
     b, s = tokens.shape
     dt = cfg.dtype
     x = params["embed"]["table"].astype(dt)[tokens]
@@ -213,25 +225,68 @@ def gpt_forward(params, tokens, cfg: GPTConfig, mesh=None):
     for layer in params["layers"]:
         x, aux = layer_fn(x, layer)
         aux_total = aux_total + aux
-    x = _rmsnorm(x, params["final_norm"]["scale"], cfg.rmsnorm_eps)
-    if cfg.tie_embeddings:
-        logits = jnp.einsum("bsd,vd->bsv", x,
-                            params["embed"]["table"].astype(dt))
-    else:
-        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(dt))
-    return logits, aux_total
+    return _rmsnorm(x, params["final_norm"]["scale"], cfg.rmsnorm_eps), \
+        aux_total
+
+
+def chunked_xent(x, w_head, targets, mask, chunk_rows: int = 16384):
+    """Next-token cross-entropy WITHOUT materializing full [N, vocab] fp32
+    logits (12.8 GB at bs=64/seq=1024/vocab=50k — an HBM-capacity bug for
+    any capacity-size batch). Rows are processed in chunks under
+    jax.checkpoint, so the backward recomputes each chunk's logits instead
+    of saving them. TPU-native analogue of fused linear+cross-entropy.
+
+    x: [N, D] (model dtype), w_head: [D, V], targets: [N] int32,
+    mask: [N] fp32. Returns (sum_nll, sum_mask).
+    """
+    n, d = x.shape
+    # Never chunk coarser than the batch itself: padding a small batch up
+    # to a full 16k-row chunk would both waste LM-head FLOPs and raise the
+    # HBM peak the chunking exists to cut.
+    chunk_rows = min(chunk_rows, max(128, n))
+    pad = (-n) % chunk_rows
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        targets = jnp.pad(targets, (0, pad))
+        mask = jnp.pad(mask, (0, pad))
+    n_chunks = (n + pad) // chunk_rows
+    xc = x.reshape(n_chunks, chunk_rows, d)
+    tc = targets.reshape(n_chunks, chunk_rows)
+    mc = mask.reshape(n_chunks, chunk_rows)
+
+    @jax.checkpoint
+    def body(carry, args):
+        xk, tk, mk = args
+        logits = (xk @ w_head).astype(jnp.float32)       # [chunk, V]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, tk[:, None], axis=-1)[:, 0]
+        nll = lse - picked
+        return (carry[0] + jnp.sum(nll * mk), carry[1] + jnp.sum(mk)), None
+
+    (total, denom), _ = jax.lax.scan(body, (0.0, 0.0), (xc, tc, mc))
+    return total, denom
 
 
 def gpt_loss(params, batch, cfg: GPTConfig, mesh=None):
-    """batch: {"tokens": [B, S+1]} -> mean next-token cross-entropy."""
+    """batch: {"tokens": [B, S+1]} -> mean next-token cross-entropy.
+
+    The LM-head matmul + softmax run chunked (chunked_xent) so the full
+    fp32 logits tensor never exists in HBM.
+    """
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits, aux = gpt_forward(params, inputs, cfg, mesh)
-    logits = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    x, aux = gpt_backbone(params, inputs, cfg, mesh)
+    b, s, d = x.shape
+    dt = cfg.dtype
+    if cfg.tie_embeddings:
+        w_head = params["embed"]["table"].astype(dt).T
+    else:
+        w_head = params["lm_head"].astype(dt)
     mask = (targets >= 0).astype(jnp.float32)
-    loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    total, denom = chunked_xent(x.reshape(b * s, d), w_head,
+                                targets.reshape(b * s),
+                                mask.reshape(b * s))
+    loss = total / jnp.maximum(denom, 1.0)
     if cfg.n_experts > 0:
         loss = loss + 0.01 * aux / cfg.n_layers
     return loss

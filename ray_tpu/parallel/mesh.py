@@ -90,8 +90,15 @@ def build_mesh(config: Optional[MeshConfig] = None,
     shape = tuple(axis_sizes[a] for a in AXIS_ORDER)
     # A config whose axis product is smaller than the device count uses the
     # first prod(shape) devices (e.g. a pipeline=4 experiment on an
-    # 8-device host).
-    dev_array = np.asarray(devices[: math.prod(shape)]).reshape(shape)
+    # 8-device host). Warn: silent under-subscription would hide a 4x
+    # throughput loss from a mis-sized axis.
+    used = math.prod(shape)
+    if used < n:
+        import logging
+        logging.getLogger(__name__).warning(
+            "mesh axes %s use %d of %d devices; the rest are idle",
+            dict(axis_sizes), used, n)
+    dev_array = np.asarray(devices[:used]).reshape(shape)
     return Mesh(dev_array, AXIS_ORDER)
 
 
