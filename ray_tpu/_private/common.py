@@ -76,9 +76,18 @@ class TaskSpec:
     is_async_actor: bool = False
     actor_name: str = ""
     namespace: str = ""
+    # Real runtime environment (env_vars/working_dir/py_modules, with a
+    # precomputed "_hash"); see _private/runtime_env.py.
     runtime_env: Optional[dict] = None
     # Generator tasks
     is_generator: bool = False
+    # Keyword-argument names for the trailing args (executor rebuilds kwargs)
+    kwarg_names: Tuple[str, ...] = ()
+    # Actor lifetime ("" | "detached")
+    lifetime: str = ""
+
+    def env_hash(self) -> str:
+        return (self.runtime_env or {}).get("_hash", "")
 
     def scheduling_class(self) -> Tuple:
         """Tasks with the same class can reuse worker leases."""
@@ -88,7 +97,7 @@ class TaskSpec:
             self.scheduling.node_id,
             self.scheduling.placement_group_id,
             self.scheduling.bundle_index,
-            self.runtime_env is not None and tuple(sorted(map(str, self.runtime_env.items()))),
+            self.env_hash(),
         )
 
     def __reduce__(self):
@@ -102,7 +111,8 @@ class TaskSpec:
             self.seq_no, self.is_actor_creation, self.max_restarts,
             self.max_task_retries, self.max_concurrency,
             self.is_async_actor, self.actor_name, self.namespace,
-            self.runtime_env, self.is_generator))
+            self.runtime_env, self.is_generator, self.kwarg_names,
+            self.lifetime))
 
 
 @dataclass

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import threading
 import time
 import traceback
@@ -209,6 +210,11 @@ class CoreWorker:
         self._caller_next_seq: Dict[bytes, int] = {}
         self._caller_buffer: Dict[bytes, Dict[int, tuple]] = {}
         self._function_cache: Dict[str, Any] = {}
+        # Runtime envs: worker-side applier + driver-side package caches.
+        from ray_tpu._private.runtime_env import RuntimeEnvManager
+        self.runtime_env_manager = RuntimeEnvManager()
+        self._pkg_uri_by_path: Dict[tuple, str] = {}  # (path, sig) -> uri
+        self._uploaded_pkgs: set = set()              # uris known in KV
         self._running_tasks: Dict[TaskID, Any] = {}
         self._cancelled_tasks: set = set()
         self._task_events_buffer: List[dict] = []
@@ -785,9 +791,27 @@ class CoreWorker:
                                       return_when=asyncio.FIRST_COMPLETED)
             if not d:
                 break
+            # Count successful completions first: if num_returns healthy
+            # refs are ready, wait() succeeds deterministically even when a
+            # dead-owner ref is also in the list.
+            failed = None
             for k, f in pending.items():
                 if f.done() and k not in done:
-                    done.add(k)
+                    if f.exception() is not None:
+                        failed = failed or f
+                    else:
+                        done.add(k)
+                if len(done) >= num_returns:
+                    break
+            if failed is not None and len(done) < num_returns:
+                # e.g. OwnerDiedError: the ref can never become ready and
+                # its value is unrecoverable — surface instead of reporting
+                # "ready" (reference: python/ray/exceptions.py
+                # OwnerDiedError).
+                for other in pending.values():
+                    if not other.done():
+                        other.cancel()
+                raise failed.exception()
         for f in pending.values():
             if not f.done():
                 f.cancel()
@@ -808,12 +832,19 @@ class CoreWorker:
             return True
         if ref.id in self.inproc:
             return True
-        try:
-            await self.clients.request(ref.owner_address, "owner_locate",
-                                       {"object_id": ref.id, "timeout": None})
-        except rpc.RpcError:
-            pass
-        return True
+        # One retry with a short pause before declaring the owner dead: a
+        # transient connection reset (owner under load) must not convert a
+        # recoverable blip into a terminal OwnerDiedError.
+        for attempt in (0, 1):
+            try:
+                await self.clients.request(
+                    ref.owner_address, "owner_locate",
+                    {"object_id": ref.id, "timeout": None})
+                return True
+            except rpc.RpcError:
+                if attempt == 0:
+                    await asyncio.sleep(0.5)
+        raise exc.OwnerDiedError(ref)
 
     # ==================================================================
     # Task submission (normal tasks)
@@ -830,6 +861,53 @@ class CoreWorker:
         data = dumps_function(func)
         await self.gcs.request("kv_put", {
             "namespace": "funcs", "key": function_id.encode(), "value": data})
+
+    async def prepare_runtime_env(self, env: dict) -> dict:
+        """Driver side: package local dirs -> content-addressed KV uploads,
+        stamp the canonical env hash (reference: runtime_env/packaging.py
+        upload_package_if_needed)."""
+        from ray_tpu._private import runtime_env as re_mod
+        env = dict(env)
+        wd = env.get("working_dir")
+        if wd and not wd.startswith("pkg://"):
+            env["working_dir"] = await self._upload_package(wd)
+        if env.get("py_modules"):
+            env["py_modules"] = [
+                p if p.startswith("pkg://") else await self._upload_package(p)
+                for p in env["py_modules"]]
+        env["_hash"] = re_mod.env_hash(env)
+        return env
+
+    async def _upload_package(self, path: str) -> str:
+        from ray_tpu._private.runtime_env import package_dir, tree_signature
+        path = os.path.abspath(path)
+        # Cache key includes a cheap stat signature of the tree so edits
+        # after the first submission re-package instead of shipping stale
+        # code (reference: packaging.py re-hashes on every upload).
+        sig = await asyncio.get_running_loop().run_in_executor(
+            self._exec_pool, tree_signature, path)
+        uri = self._pkg_uri_by_path.get((path, sig))
+        if uri is None:
+            uri, data = await asyncio.get_running_loop().run_in_executor(
+                self._exec_pool, package_dir, path)
+            if uri not in self._uploaded_pkgs:
+                key = ("pkg:" + uri[len("pkg://"):]).encode()
+                exists = await self.gcs.request("kv_exists", {
+                    "namespace": "packages", "key": key})
+                if not exists:
+                    await self.gcs.request("kv_put", {
+                        "namespace": "packages", "key": key, "value": data})
+                self._uploaded_pkgs.add(uri)
+            self._pkg_uri_by_path[(path, sig)] = uri
+        return uri
+
+    async def _fetch_package(self, key: str) -> Optional[bytes]:
+        return await self.gcs.request("kv_get", {
+            "namespace": "packages", "key": key.encode()})
+
+    async def _ensure_runtime_env(self, env: Optional[dict]):
+        if env:
+            await self.runtime_env_manager.ensure(env, self._fetch_package)
 
     async def _load_function(self, function_id: str):
         if function_id in self._function_cache:
@@ -883,6 +961,7 @@ class CoreWorker:
                           scheduling=None, max_retries: int = -1,
                           retry_exceptions: bool = False,
                           is_generator: bool = False,
+                          runtime_env: Optional[dict] = None,
                           export: Optional[Any] = None,
                           _prebuilt=None) -> List[ObjectRef]:
         """Synchronous submission: allocates ids/refs immediately and defers
@@ -906,7 +985,7 @@ class CoreWorker:
                          if max_retries < 0 else max_retries),
             retry_exceptions=retry_exceptions,
             owner_address=self.address, owner_worker_id=self.worker_id,
-            is_generator=is_generator,
+            is_generator=is_generator, runtime_env=runtime_env,
         )
         refs = []
         returns = []
@@ -951,6 +1030,7 @@ class CoreWorker:
                                scheduling=None, max_retries: int = -1,
                                retry_exceptions: bool = False,
                                is_generator: bool = False,
+                               runtime_env: Optional[dict] = None,
                                export: Optional[Any] = None) -> List[ObjectRef]:
         """Non-blocking submission from a user (non-loop) thread.
 
@@ -972,7 +1052,7 @@ class CoreWorker:
                          if max_retries < 0 else max_retries),
             retry_exceptions=retry_exceptions,
             owner_address=self.address, owner_worker_id=self.worker_id,
-            is_generator=is_generator,
+            is_generator=is_generator, runtime_env=runtime_env,
         )
         refs: List[ObjectRef] = []
         returns: List[ObjectID] = []
@@ -1038,7 +1118,9 @@ class CoreWorker:
             return  # cancelled before dispatch
         spec.args = task_args
         if kw_names:
-            spec.runtime_env = {"kwarg_names": kw_names}
+            spec.kwarg_names = tuple(kw_names)
+        if spec.runtime_env:
+            spec.runtime_env = await self.prepare_runtime_env(spec.runtime_env)
         self.pending_tasks[spec.task_id].arg_refs = (
             self._pin_arg_refs(spec) + pin_refs)
         await self._submit_to_cluster(spec)
@@ -1318,6 +1400,7 @@ class CoreWorker:
                            max_task_retries: int = 0, max_concurrency: int = 1,
                            is_async: bool = False, name: str = "",
                            namespace: str = "", lifetime: str = "",
+                           runtime_env: Optional[dict] = None,
                            export: Optional[Any] = None, _prebuilt=None):
         """Synchronous actor creation: returns (actor_id, done_future).
 
@@ -1338,9 +1421,9 @@ class CoreWorker:
             actor_id=actor_id, is_actor_creation=True,
             max_restarts=max_restarts, max_task_retries=max_task_retries,
             max_concurrency=max_concurrency, is_async_actor=is_async,
-            actor_name=name, namespace=namespace,
+            actor_name=name, namespace=namespace, lifetime=lifetime,
+            runtime_env=runtime_env,
         )
-        spec.runtime_env = {"lifetime": lifetime}
         q = ActorSubmitQueue(actor_id, self.submission_lock)
         self.actor_queues[actor_id] = q
         done = asyncio.ensure_future(
@@ -1358,7 +1441,10 @@ class CoreWorker:
                 prebuilt if prebuilt is not None
                 else await self._build_args(args, kwargs))
             spec.args = task_args
-            spec.runtime_env = {"kwarg_names": kw_names, "lifetime": lifetime}
+            spec.kwarg_names = tuple(kw_names)
+            if spec.runtime_env:
+                spec.runtime_env = await self.prepare_runtime_env(
+                    spec.runtime_env)
             # Creation args must survive as long as the actor can be
             # (re)instantiated — restarts re-fetch them — so the pins are
             # released only on the DEAD pubsub event.
@@ -1485,13 +1571,13 @@ class CoreWorker:
             self._complete_task_error(spec, e, retry=False)
             spec.method_name = SEQ_SKIP_METHOD
             spec.args = []
-            spec.runtime_env = None
+            spec.kwarg_names = ()
             await self._submit_actor_task(q, spec)
             return
         if spec.task_id not in self.pending_tasks:
             return  # cancelled before dispatch
         spec.args = task_args
-        spec.runtime_env = {"kwarg_names": kw_names} if kw_names else None
+        spec.kwarg_names = tuple(kw_names)
         self.pending_tasks[spec.task_id].arg_refs = (
             self._pin_arg_refs(spec) + pin_refs)
         await self._submit_actor_task(q, spec)
@@ -1595,7 +1681,7 @@ class CoreWorker:
     # ==================================================================
 
     async def _resolve_task_args(self, spec: TaskSpec) -> Tuple[list, dict]:
-        kw_names = (spec.runtime_env or {}).get("kwarg_names") or []
+        kw_names = spec.kwarg_names
         values = []
         for arg in spec.args:
             if arg.kind == ARG_INLINE:
@@ -1641,10 +1727,16 @@ class CoreWorker:
         spec: TaskSpec = payload["spec"]
         self.current_task_id = spec.task_id
         try:
+            await self._ensure_runtime_env(spec.runtime_env)
             func = await self._load_function(spec.function_id)
             args, kwargs = await self._resolve_task_args(spec)
         except _DependencyError as e:
             return {"app_error": e.error, "returns": None}
+        except exc.RuntimeEnvSetupError as e:
+            err = exc.TaskError(e, str(e), spec.task_id, os.getpid())
+            returns = await self._store_returns(
+                spec, [err] * spec.num_returns, is_exception=True)
+            return {"app_error": err, "returns": returns}
         except Exception as e:  # noqa: BLE001
             return {"system_error": f"{type(e).__name__}: {e}"}
         try:
@@ -1701,6 +1793,7 @@ class CoreWorker:
     async def _rpc_instantiate_actor(self, conn, payload):
         spec: TaskSpec = payload["spec"]
         try:
+            await self._ensure_runtime_env(spec.runtime_env)
             cls = await self._load_function(spec.function_id)
             args, kwargs = await self._resolve_task_args(spec)
             loop = asyncio.get_running_loop()

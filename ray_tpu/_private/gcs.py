@@ -313,6 +313,15 @@ class GcsServer:
             info.alive = False
             info.end_time = time.time()
         self.pubsub.publish("jobs", {"event": "finished", "job_id": payload["job_id"]})
+        # Non-detached actors die with their job (reference:
+        # gcs_actor_manager.h OnJobFinished); lifetime="detached" survives.
+        for actor in list(self.actors.values()):
+            if (actor.job_id == payload["job_id"]
+                    and actor.state != ACTOR_DEAD
+                    and (actor.creation_spec is None
+                         or actor.creation_spec.lifetime != "detached")):
+                asyncio.ensure_future(self.rpc_kill_actor(
+                    None, {"actor_id": actor.actor_id, "no_restart": True}))
         self._mark_dirty()
         return True
 

@@ -184,6 +184,10 @@ class WorkerHandle:
     actor_id: Optional[object] = None
     idle_since: float = field(default_factory=time.time)
     conn: Optional[rpc.Connection] = None
+    # Runtime env this worker has applied ("" = fresh). A tagged worker is
+    # dedicated: it only serves tasks with the same env hash (reference:
+    # worker_pool.h dedicated workers per runtime env).
+    env_hash: str = ""
 
 
 class ResourcePool:
@@ -576,19 +580,31 @@ class Raylet:
                 except Exception:
                     pass
 
-    def _get_idle_worker(self) -> Optional[WorkerHandle]:
-        while self._idle_workers:
-            handle = self._idle_workers.pop()
-            if handle.registered and handle.worker_id in self.workers \
-                    and not (handle.conn and handle.conn.closed):
+    def _get_idle_worker(self, env_hash: str = "") -> Optional[WorkerHandle]:
+        """Pop a live idle worker compatible with `env_hash`: exact-match
+        tagged workers preferred, fresh ("") workers serve any env."""
+        fallback = None
+        for i in range(len(self._idle_workers) - 1, -1, -1):
+            handle = self._idle_workers[i]
+            if not (handle.registered and handle.worker_id in self.workers
+                    and not (handle.conn and handle.conn.closed)):
+                self._idle_workers.pop(i)
+                continue
+            if handle.env_hash == env_hash:
+                self._idle_workers.pop(i)
                 return handle
-        return None
+            if handle.env_hash == "" and fallback is None:
+                fallback = handle
+        if fallback is not None:
+            self._idle_workers.remove(fallback)
+        return fallback
 
     def _ensure_worker_supply(self):
         # Count only leases the pool could actually serve concurrently:
         # spawning workers for requests that can't get resources just burns
         # CPU on process startup (round-1 regression on small boxes).
         avail = dict(self.pool.available)
+        free_hashes = [h.env_hash for h in self._idle_workers]
         demand = 0
         for spec, _pg_key, fut in self._pending_leases:
             if fut.done():
@@ -597,11 +613,38 @@ class Raylet:
                    for k, v in spec.resources.items() if v > 0):
                 for k, v in spec.resources.items():
                     avail[k] = avail.get(k, 0) - v
-                demand += 1
-        supply = len(self._idle_workers) + self._starting_workers
+                eh = spec.env_hash()
+                if eh in free_hashes:
+                    free_hashes.remove(eh)
+                elif "" in free_hashes:
+                    free_hashes.remove("")
+                else:
+                    demand += 1
+        supply = self._starting_workers
         can_start = self.config.max_workers_per_node - len(self.workers)
+        if demand > supply and can_start <= 0:
+            # The worker cap is consumed but pending leases can't use what's
+            # idle: evict env-dedicated idle workers (oldest first) to make
+            # room — otherwise distinct runtime envs permanently pin worker
+            # slots and scheduling deadlocks (reference: worker_pool.cc
+            # kills idle dedicated workers under pressure).
+            for handle in sorted(
+                    [h for h in self._idle_workers if h.env_hash != ""],
+                    key=lambda h: h.idle_since)[:demand - supply]:
+                self._idle_workers.remove(handle)
+                self.workers.pop(handle.worker_id, None)
+                self._workers_by_hex.pop(handle.worker_id.hex(), None)
+                if handle.conn:
+                    asyncio.ensure_future(self._push_shutdown(handle))
+                can_start += 1
         for _ in range(min(max(0, demand - supply), max(0, can_start))):
             self._spawn_worker()
+
+    async def _push_shutdown(self, handle: WorkerHandle):
+        try:
+            await handle.conn.push("shutdown", {})
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     # Lease protocol (normal tasks)
@@ -706,13 +749,15 @@ class Raylet:
                 if not fut.done():
                     remaining.append((spec, pg_key, fut))
                 continue
-            worker = self._get_idle_worker()
+            worker = self._get_idle_worker(spec.env_hash())
             if worker is None:
                 remaining.append((spec, pg_key, fut))
                 continue
             self.pool.acquire(spec.resources, pg_key)
             self._mark_resources_dirty()
             worker.leased = True
+            if spec.env_hash():
+                worker.env_hash = spec.env_hash()
             worker.lease_class = spec.scheduling_class()
             worker.lease_resources = dict(spec.resources)
             worker.lease_pg = pg_key
@@ -803,17 +848,19 @@ class Raylet:
             pg_key = (spec.scheduling.placement_group_id.binary(), idx)
         if not self.pool.acquire(spec.resources, pg_key):
             raise RuntimeError("resources no longer available for actor")
-        worker = self._get_idle_worker()
+        worker = self._get_idle_worker(spec.env_hash())
         if worker is None:
             self._spawn_worker()
             deadline = time.time() + self.config.worker_start_timeout_s
             while worker is None and time.time() < deadline:
                 await asyncio.sleep(0.02)
-                worker = self._get_idle_worker()
+                worker = self._get_idle_worker(spec.env_hash())
             if worker is None:
                 self.pool.release(spec.resources, pg_key)
                 raise RuntimeError("worker failed to start for actor")
         worker.leased = True
+        if spec.env_hash():
+            worker.env_hash = spec.env_hash()
         worker.is_actor_worker = True
         worker.actor_id = spec.actor_id
         worker.lease_resources = dict(spec.resources)

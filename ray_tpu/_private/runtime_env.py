@@ -1,0 +1,218 @@
+"""Runtime environments: per-task/actor env_vars, working_dir, py_modules.
+
+Reference: python/ray/_private/runtime_env/ (plugin.py, packaging.py,
+working_dir.py). Design here: packages are content-addressed zips in the
+GCS KV ("packages" namespace). The driver zips local dirs at submission
+time (cached per path), workers download + unpack into a node-local cache
+directory and prepend it to sys.path; env_vars apply to the worker process
+environment. Workers that applied a runtime env are dedicated to it — the
+raylet only re-leases them to tasks with the same env hash (the reference
+starts dedicated workers per env the same way, worker_pool.h).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+import zipfile
+from typing import Dict, List, Optional, Tuple
+
+MAX_PACKAGE_BYTES = 256 * 1024 * 1024
+EXCLUDE_DIRS = {"__pycache__", ".git", ".hg", ".venv", "node_modules",
+                ".eggs", ".mypy_cache", ".pytest_cache"}
+_KNOWN_KEYS = {"env_vars", "working_dir", "py_modules", "config", "_hash"}
+
+
+def _default_cache_dir() -> str:
+    return os.path.join(tempfile.gettempdir(), "ray_tpu", "pkg_cache")
+
+
+def package_dir(path: str) -> Tuple[str, bytes]:
+    """Deterministically zip a directory; return (uri, zip_bytes).
+
+    The uri is content-addressed (sha256 of the archive), so identical
+    trees dedupe in the KV and in every node's cache.
+    """
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env path {path!r} is not a directory")
+    entries = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in EXCLUDE_DIRS)
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            entries.append((os.path.relpath(full, path), full))
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for rel, full in entries:
+            try:
+                with open(full, "rb") as fh:
+                    data = fh.read()
+            except OSError:
+                continue
+            total += len(data)
+            if total > MAX_PACKAGE_BYTES:
+                raise ValueError(
+                    f"runtime_env package {path!r} exceeds "
+                    f"{MAX_PACKAGE_BYTES >> 20} MiB")
+            # Fixed ZipInfo date -> byte-identical archive for identical
+            # trees -> stable content hash.
+            z.writestr(zipfile.ZipInfo(rel), data)
+    data = buf.getvalue()
+    uri = "pkg://" + hashlib.sha256(data).hexdigest()[:32]
+    return uri, data
+
+
+def tree_signature(path: str) -> tuple:
+    """Cheap stat-based change detector for a directory tree: (file count,
+    total size, max mtime_ns). Used to invalidate the driver's per-path
+    package cache without re-reading file contents."""
+    count = total = mtime = 0
+    for root, dirs, files in os.walk(path):
+        dirs[:] = [d for d in dirs if d not in EXCLUDE_DIRS]
+        for f in files:
+            try:
+                st = os.stat(os.path.join(root, f))
+            except OSError:
+                continue
+            count += 1
+            total += st.st_size
+            mtime = max(mtime, st.st_mtime_ns)
+    return (count, total, mtime)
+
+
+def env_hash(env: dict) -> str:
+    canon = json.dumps({k: v for k, v in env.items() if k != "_hash"},
+                       sort_keys=True, default=str)
+    return hashlib.sha1(canon.encode()).hexdigest()[:16]
+
+
+def validate(env: Optional[dict]) -> Optional[dict]:
+    """Validate + shallow-copy a user runtime_env dict (driver side)."""
+    if not env:
+        return None
+    if not isinstance(env, dict):
+        raise TypeError(f"runtime_env must be a dict, got {type(env)}")
+    unknown = set(env) - _KNOWN_KEYS
+    if unknown:
+        raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)} "
+                         f"(supported: {sorted(_KNOWN_KEYS - {'_hash'})})")
+    out = dict(env)
+    ev = out.get("env_vars")
+    if ev is not None:
+        if (not isinstance(ev, dict)
+                or not all(isinstance(k, str) and isinstance(v, str)
+                           for k, v in ev.items())):
+            raise TypeError("runtime_env['env_vars'] must be Dict[str, str]")
+    wd = out.get("working_dir")
+    if wd is not None and not isinstance(wd, str):
+        raise TypeError("runtime_env['working_dir'] must be a path or pkg:// uri")
+    pm = out.get("py_modules")
+    if pm is not None and (not isinstance(pm, (list, tuple))
+                           or not all(isinstance(p, str) for p in pm)):
+        raise TypeError("runtime_env['py_modules'] must be a list of paths/uris")
+    return out
+
+
+class RuntimeEnvManager:
+    """Worker-side: download/unpack packages, apply env to THIS process.
+
+    A worker applies at most one runtime env in its lifetime (the raylet
+    dedicates it to that env's hash afterwards), so apply() mutates
+    process state (os.environ, sys.path, cwd) without needing undo.
+    """
+
+    def __init__(self, cache_dir: Optional[str] = None):
+        self.cache_dir = cache_dir or _default_cache_dir()
+        self.applied_hash: Optional[str] = None
+
+    async def ensure(self, env: Optional[dict], kv_fetch) -> None:
+        """Apply `env` to this process. kv_fetch: async (key: str) -> bytes.
+
+        Raises RuntimeEnvSetupError on any failure (missing package, bad
+        zip); idempotent for the same env hash.
+        """
+        from ray_tpu import exceptions as exc
+        if not env:
+            return
+        h = env.get("_hash") or env_hash(env)
+        if self.applied_hash == h:
+            return
+        if self.applied_hash is not None:
+            raise exc.RuntimeEnvSetupError(
+                f"worker already dedicated to runtime env "
+                f"{self.applied_hash}; got {h}")
+        try:
+            for k, v in (env.get("env_vars") or {}).items():
+                os.environ[k] = v
+            for uri in (env.get("py_modules") or []):
+                root = await self._fetch_unpack(uri, kv_fetch)
+                if root not in sys.path:
+                    sys.path.insert(0, root)
+            wd = env.get("working_dir")
+            if wd:
+                root = await self._fetch_unpack(wd, kv_fetch)
+                if root not in sys.path:
+                    sys.path.insert(0, root)
+                os.chdir(root)
+        except exc.RuntimeEnvSetupError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            raise exc.RuntimeEnvSetupError(
+                f"runtime env setup failed: {type(e).__name__}: {e}") from e
+        self.applied_hash = h
+
+    async def _fetch_unpack(self, uri: str, kv_fetch) -> str:
+        from ray_tpu import exceptions as exc
+        if not uri.startswith("pkg://"):
+            # Local path env on a single-node cluster (driver == worker
+            # node): use the directory in place.
+            if os.path.isdir(uri):
+                return os.path.abspath(uri)
+            raise exc.RuntimeEnvSetupError(
+                f"runtime env uri {uri!r} is neither pkg:// nor a local dir")
+        digest = uri[len("pkg://"):]
+        final = os.path.join(self.cache_dir, digest)
+        if os.path.isdir(final):
+            return final
+        data = await kv_fetch("pkg:" + digest)
+        if data is None:
+            raise exc.RuntimeEnvSetupError(
+                f"package {uri} not found in cluster KV")
+        os.makedirs(self.cache_dir, exist_ok=True)
+        tmp = tempfile.mkdtemp(dir=self.cache_dir, prefix=digest + ".tmp")
+        try:
+            with zipfile.ZipFile(io.BytesIO(data)) as z:
+                z.extractall(tmp)
+            os.rename(tmp, final)  # atomic publish; loser cleans up below
+        except OSError:
+            if not os.path.isdir(final):
+                raise
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        return final
+
+
+def merge(base: Optional[dict], override: Optional[dict]) -> Optional[dict]:
+    """Per-option override of a job-level default env (reference semantics:
+    task env replaces keys wholesale except env_vars, which merge)."""
+    if not base:
+        return override
+    if not override:
+        return dict(base)
+    out = dict(base)
+    for k, v in override.items():
+        if k == "env_vars" and base.get("env_vars"):
+            ev = dict(base["env_vars"])
+            ev.update(v or {})
+            out[k] = ev
+        else:
+            out[k] = v
+    out.pop("_hash", None)
+    return out

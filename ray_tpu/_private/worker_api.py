@@ -31,6 +31,9 @@ class _GlobalState:
         self.namespace = ""
         self.gcs_address = ""
         self.exported_functions: Dict[str, bool] = {}
+        # Job-level default runtime env (init(runtime_env=...)); merged
+        # under per-task/actor envs by resolve_runtime_env.
+        self.job_runtime_env: Optional[dict] = None
 
     def run(self, coro, timeout: Optional[float] = None):
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
@@ -88,6 +91,7 @@ def init(address: Optional[str] = None, *,
          labels: Optional[Dict[str, str]] = None,
          object_store_memory: Optional[int] = None,
          namespace: str = "",
+         runtime_env: Optional[dict] = None,
          system_config: Optional[dict] = None,
          ignore_reinit_error: bool = True,
          log_level: int = logging.WARNING):
@@ -96,6 +100,8 @@ def init(address: Optional[str] = None, *,
         if ignore_reinit_error:
             return _state
         raise RuntimeError("ray_tpu already initialized")
+    from ray_tpu._private import runtime_env as _re
+    _state.job_runtime_env = _re.validate(runtime_env)
     if address in (None, "auto"):
         # Job entrypoints / CLI children inherit the cluster address
         # (reference: RAY_ADDRESS handling in ray.init).
@@ -161,6 +167,14 @@ def shutdown():
     _state.head = None
     _state.initialized = False
     _state.exported_functions.clear()
+    _state.job_runtime_env = None
+
+
+def resolve_runtime_env(env: Optional[dict]) -> Optional[dict]:
+    """Merge a per-task/actor env over the job default and validate."""
+    from ray_tpu._private import runtime_env as _re
+    merged = _re.merge(_state.job_runtime_env, _re.validate(env))
+    return merged
 
 
 def put(value: Any) -> ObjectRef:

@@ -178,6 +178,8 @@ class ActorClass:
             name=opts.get("name", ""),
             namespace=namespace,
             lifetime=opts.get("lifetime", ""),
+            runtime_env=worker_api.resolve_runtime_env(
+                opts.get("runtime_env")),
         )
         if on_loop:
             actor_id, _done = core.create_actor_local(

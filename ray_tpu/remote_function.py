@@ -105,6 +105,8 @@ class RemoteFunction:
             scheduling=_resolve_scheduling(opts),
             max_retries=opts.get("max_retries", -1),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            runtime_env=worker_api.resolve_runtime_env(
+                opts.get("runtime_env")),
         )
         if on_loop:
             refs = core.submit_task_local(fid, args, kwargs, export=export,

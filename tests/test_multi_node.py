@@ -177,6 +177,35 @@ def test_lineage_reconstruction_after_node_death(ray_cluster):
     assert float(arr[0]) == 7.0
 
 
+def test_wait_on_dead_owner_raises(ray_start):
+    """wait() on a ref whose owner died must raise OwnerDiedError, not
+    report ready (reference: python/ray/exceptions.py OwnerDiedError)."""
+    import ray_tpu
+    from ray_tpu.exceptions import OwnerDiedError
+
+    @ray_tpu.remote
+    class Owner:
+        def make(self):
+            # Large put: owner = this actor's worker process.
+            return ray_tpu.put(np.ones(500_000, dtype=np.float64))
+
+        def pid(self):
+            return os.getpid()
+
+    a = Owner.remote()
+    inner_ref = ray_tpu.get(a.make.remote(), timeout=30)
+    ray_tpu.kill(a)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            ray_tpu.wait([inner_ref], timeout=5)
+        except OwnerDiedError:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("wait() kept reporting a dead-owner ref as ready")
+
+
 def test_object_spill_under_pressure(ray_start):
     import ray_tpu
     # Store is 2 GiB default in tests? Use explicit small puts against the

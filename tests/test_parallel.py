@@ -88,10 +88,38 @@ class TestAttention:
         q = jax.random.normal(k1, (1, 2, 64, 16))
         k = jax.random.normal(k2, (1, 2, 64, 16))
         v = jax.random.normal(k3, (1, 2, 64, 16))
-        g_ref = jax.grad(lambda q: mha_reference(q, k, v).sum())(q)
-        g_fl = jax.grad(lambda q: flash_attention(
-            q, k, v, block_q=32, block_k=32).sum())(q)
-        assert float(jnp.abs(g_ref - g_fl).max()) < 2e-4
+        for causal in (True, False):
+            g_ref = jax.grad(
+                lambda q, k, v: (mha_reference(q, k, v, causal=causal)
+                                 * v.sum(2, keepdims=True)).sum(),
+                argnums=(0, 1, 2))(q, k, v)
+            g_fl = jax.grad(
+                lambda q, k, v: (flash_attention(q, k, v, causal=causal,
+                                                 block_q=32, block_k=32)
+                                 * v.sum(2, keepdims=True)).sum(),
+                argnums=(0, 1, 2))(q, k, v)
+            for name, a, b in zip("qkv", g_ref, g_fl):
+                assert float(jnp.abs(a - b).max()) < 2e-4, name
+
+    def test_flash_grad_cross_lengths(self, jx):
+        """seq_q != seq_k exercises the bottom-right causal offset in the
+        backward kernels too."""
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu.ops.attention import flash_attention, mha_reference
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(k1, (1, 2, 32, 16))
+        k = jax.random.normal(k2, (1, 2, 96, 16))
+        v = jax.random.normal(k3, (1, 2, 96, 16))
+        g_ref = jax.grad(
+            lambda q, k, v: mha_reference(q, k, v, causal=True).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        g_fl = jax.grad(
+            lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                            block_q=32, block_k=32).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g_ref, g_fl):
+            assert float(jnp.abs(a - b).max()) < 2e-4, name
 
     def test_ring_attention_matches(self, jx):
         import jax
