@@ -240,7 +240,8 @@ class CoreWorker:
             self.gcs_address, self._on_gcs_push,
             on_reconnect=self._on_gcs_reconnect)
         await self.gcs.connect()
-        await self.gcs.request("subscribe", {"channels": ["actors", "nodes"]})
+        await self.gcs.request("subscribe",
+                               {"channels": self._pubsub_channels()})
         self.raylet = await rpc.connect(self.raylet_address)
         self.store = ObjectStoreClient(self._raylet_request,
                                        self._raylet_notify)
@@ -249,10 +250,34 @@ class CoreWorker:
             self.get_sync, self.get_async)
         self._bg_tasks.append(asyncio.ensure_future(self._flush_task_events_loop()))
         self._bg_tasks.append(asyncio.ensure_future(self._lease_janitor_loop()))
+        self._bg_tasks.append(asyncio.ensure_future(self._report_metrics_loop()))
+
+    async def _report_metrics_loop(self):
+        """Ship this process's metric registry to the GCS periodically
+        (reference: metrics_agent.py push path)."""
+        from ray_tpu.util import metrics as metrics_mod
+        reporter = f"{self.mode}:{self.worker_id.hex()[:12]}"
+        while not self._shutdown:
+            await asyncio.sleep(self.config.metrics_report_interval_s)
+            snap = metrics_mod.snapshot()
+            if not snap:
+                continue
+            try:
+                await self.gcs.request("report_metrics", {
+                    "reporter": reporter, "metrics": snap})
+            except rpc.RpcError:
+                pass
+
+    def _pubsub_channels(self) -> list:
+        channels = ["actors", "nodes"]
+        if self.mode == "driver" and self.config.log_to_driver:
+            channels.append("logs")
+        return channels
 
     async def _on_gcs_reconnect(self, conn: rpc.Connection):
         """Re-establish subscriptions on a fresh (restarted-GCS) connection."""
-        await conn.request("subscribe", {"channels": ["actors", "nodes"]})
+        await conn.request("subscribe",
+                           {"channels": self._pubsub_channels()})
 
     async def _raylet_request(self, method, payload):
         return await self.raylet.request(method, payload)
@@ -346,6 +371,14 @@ class CoreWorker:
         if method != "pub":
             return
         channel, msg = payload["channel"], payload["message"]
+        if channel == "logs":
+            # The (pid=..., node=...) worker-output stream (reference:
+            # worker.py print_worker_logs).
+            import sys as _sys
+            prefix = f"(pid={msg.get('pid')}, node={msg.get('node')})"
+            for line in msg.get("lines", []):
+                print(f"{prefix} {line}", file=_sys.stderr)
+            return
         if channel == "actors":
             info: Optional[ActorInfo] = msg.get("actor_info")
             actor_id = info.actor_id if info is not None else msg.get("actor_id")
@@ -1893,9 +1926,19 @@ class CoreWorker:
     # task events
     # ==================================================================
 
+    _TASK_STATE_COUNTERS: Dict[str, Any] = {}
+
     def _record_task_event(self, spec: TaskSpec, state: str):
         if not self.config.task_events_enabled:
             return
+        counter = self._TASK_STATE_COUNTERS.get(state)
+        if counter is None:
+            from ray_tpu.util.metrics import Counter as _Counter
+            counter = _Counter("ray_tpu_tasks_total",
+                               "task state transitions", tag_keys=("State",)
+                               ).set_default_tags({"State": state})
+            self._TASK_STATE_COUNTERS[state] = counter
+        counter.inc()
         self._task_events_buffer.append({
             "task_id": spec.task_id.hex(), "job_id": spec.job_id.hex(),
             "name": spec.name or spec.method_name or spec.function_id,

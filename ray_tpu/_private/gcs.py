@@ -25,8 +25,8 @@ from typing import Dict, List, Optional
 from ray_tpu._private import rpc
 from ray_tpu._private.common import (ACTOR_ALIVE, ACTOR_DEAD, ACTOR_PENDING,
                                      ACTOR_RESTARTING, PG_CREATED, PG_PENDING,
-                                     PG_REMOVED, ActorInfo, JobInfo, NodeInfo,
-                                     PlacementGroupInfo)
+                                     PG_REMOVED, PG_RESCHEDULING, ActorInfo,
+                                     JobInfo, NodeInfo, PlacementGroupInfo)
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
 
@@ -93,8 +93,13 @@ class GcsServer:
         self.jobs: Dict[JobID, JobInfo] = {}
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self.kv: Dict[str, Dict[bytes, bytes]] = {}     # namespace -> {key: val}
+        self.node_demand: Dict[NodeID, list] = {}       # queued lease shapes
+        self.metrics_reports: Dict[str, list] = {}      # reporter -> snapshot
+        self.metrics_http_address = ""
+        self._http_server = None
         self.task_events: List[dict] = []
         self._job_counter = 0
+        self._autoscaler_seen = 0.0   # last get_autoscaler_state poll
         self._pg_lock = asyncio.Lock()
         self._actor_reschedule_lock = asyncio.Lock()
         self._health_task: Optional[asyncio.Task] = None
@@ -116,6 +121,7 @@ class GcsServer:
         self._health_task = asyncio.ensure_future(self._health_loop())
         if self.session_dir:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
+        await self._start_http(host)
         logger.info("GCS started at %s", self.address)
         return self.address
 
@@ -124,6 +130,8 @@ class GcsServer:
             self._health_task.cancel()
         if self._persist_task:
             self._persist_task.cancel()
+        if self._http_server is not None:
+            self._http_server.close()
         await self.server.stop()
         await self.clients.close_all()
 
@@ -196,14 +204,174 @@ class GcsServer:
         info.last_heartbeat = time.time()
         if "resources_available" in payload:
             info.resources_available = payload["resources_available"]
-        return {"reregister": False}
+        if "pending_demand" in payload:
+            self.node_demand[node_id] = payload["pending_demand"]
+        # Raylets queue (instead of fail) infeasible leases only while an
+        # autoscaler is polling — it may be about to add the node.
+        return {"reregister": False,
+                "autoscaler_active":
+                    time.time() - self._autoscaler_seen < 60.0}
+
+    # ------------- metrics / observability plane -------------
+
+    async def _start_http(self, host: str):
+        """Tiny HTTP endpoint: /metrics (Prometheus text) and /api/status
+        (JSON) — reference: metrics_agent.py Prometheus exporter +
+        dashboard REST, scoped to the head."""
+        async def handle(reader, writer):
+            try:
+                request_line = await asyncio.wait_for(reader.readline(), 5)
+                parts = request_line.decode("latin1").split()
+                path = parts[1] if len(parts) >= 2 else "/"
+                while (await asyncio.wait_for(reader.readline(), 5)) \
+                        not in (b"\r\n", b"\n", b""):
+                    pass
+                if path.startswith("/metrics"):
+                    from ray_tpu.util import metrics as m
+                    body = m.to_prometheus(self._merged_metrics())
+                    ctype = "text/plain; version=0.0.4"
+                    code = "200 OK"
+                elif path.startswith("/api/status"):
+                    import json as _json
+                    body = _json.dumps(self._status_summary(), default=str)
+                    ctype = "application/json"
+                    code = "200 OK"
+                else:
+                    body, ctype, code = "not found", "text/plain", "404 Not Found"
+                data = body.encode()
+                writer.write(
+                    f"HTTP/1.1 {code}\r\nContent-Type: {ctype}\r\n"
+                    f"Content-Length: {len(data)}\r\n"
+                    f"Connection: close\r\n\r\n".encode() + data)
+                await writer.drain()
+            except Exception:  # noqa: BLE001
+                pass
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+        try:
+            self._http_server = await asyncio.start_server(handle, host, 0)
+            port = self._http_server.sockets[0].getsockname()[1]
+            self.metrics_http_address = f"{host}:{port}"
+        except Exception:  # noqa: BLE001
+            logger.exception("metrics HTTP endpoint failed to start")
+
+    def _internal_metrics(self) -> list:
+        g = []
+
+        def gauge(name, value, desc="", **tags):
+            g.append({"name": name, "type": "gauge", "description": desc,
+                      "tags": tags, "value": float(value)})
+
+        gauge("ray_tpu_nodes_alive",
+              sum(1 for n in self.nodes.values() if n.alive),
+              "alive raylets")
+        for state in (ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING,
+                      ACTOR_DEAD):
+            gauge("ray_tpu_actors", sum(
+                1 for a in self.actors.values() if a.state == state),
+                "actors by state", State=state)
+        gauge("ray_tpu_placement_groups", len([
+            p for p in self.placement_groups.values()
+            if p.state != PG_REMOVED]), "live placement groups")
+        gauge("ray_tpu_jobs_alive",
+              sum(1 for j in self.jobs.values() if j.alive), "alive jobs")
+        totals: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.resources_total.items():
+                totals[k] = totals.get(k, 0.0) + v
+            for k, v in n.resources_available.items():
+                avail[k] = avail.get(k, 0.0) + v
+        for k in totals:
+            gauge("ray_tpu_resource_total", totals[k], "", Resource=k)
+            gauge("ray_tpu_resource_available", avail.get(k, 0.0), "",
+                  Resource=k)
+        return g
+
+    def _merged_metrics(self) -> list:
+        from ray_tpu.util import metrics as m
+        # Dead reporters (reaped workers, finished drivers) stop pushing;
+        # drop their snapshots after a grace period so gauges don't sum
+        # stale values forever and the table stays bounded.
+        now = time.time()
+        ttl = max(30.0, 10 * self.config.metrics_report_interval_s)
+        for reporter in [r for r, (ts, _) in self.metrics_reports.items()
+                         if now - ts > ttl]:
+            del self.metrics_reports[reporter]
+        merged = m.merge_snapshots(
+            [snap for _, snap in self.metrics_reports.values()])
+        return merged + self._internal_metrics()
+
+    def _status_summary(self) -> dict:
+        return {
+            "gcs_address": self.address,
+            "metrics_address": self.metrics_http_address,
+            "nodes": [{
+                "node_id": n.node_id.hex(), "alive": n.alive,
+                "is_head": n.is_head, "address": n.address,
+                "resources_total": n.resources_total,
+                "resources_available": n.resources_available,
+            } for n in self.nodes.values()],
+            "actors_alive": sum(1 for a in self.actors.values()
+                                if a.state == ACTOR_ALIVE),
+            "jobs_alive": sum(1 for j in self.jobs.values() if j.alive),
+            "pending_demand": sum(len(v) for v in self.node_demand.values()),
+        }
+
+    async def rpc_report_metrics(self, conn, payload):
+        self.metrics_reports[payload["reporter"]] = (time.time(),
+                                                     payload["metrics"])
+        return True
+
+    async def rpc_get_metrics_address(self, conn, payload):
+        return self.metrics_http_address
+
+    async def rpc_get_status_summary(self, conn, payload):
+        return self._status_summary()
+
+    async def rpc_get_autoscaler_state(self, conn, payload):
+        """Cluster view for the autoscaler: per-node capacity/usage, queued
+        lease demand, and unplaced placement groups (reference:
+        gcs_autoscaler_state_manager.h GetClusterResourceState)."""
+        self._autoscaler_seen = time.time()
+        pending_pgs = [
+            {"pg_id": pg.pg_id, "strategy": pg.strategy,
+             "bundles": list(pg.bundles)}
+            for pg in self.placement_groups.values()
+            if pg.state in (PG_PENDING, PG_RESCHEDULING)]
+        demand = []
+        for node_id, shapes in self.node_demand.items():
+            info = self.nodes.get(node_id)
+            if info is not None and info.alive:
+                demand.extend(shapes)
+        return {
+            "nodes": {
+                n.node_id: {"total": n.resources_total,
+                            "available": n.resources_available,
+                            "alive": n.alive, "is_head": n.is_head,
+                            "labels": n.labels}
+                for n in self.nodes.values()},
+            "pending_demand": demand,
+            "pending_placement_groups": pending_pgs,
+        }
 
     async def rpc_get_all_nodes(self, conn, payload):
         return list(self.nodes.values())
 
     async def rpc_drain_node(self, conn, payload):
         """Graceful removal (autoscaler downscale)."""
-        node_id = payload["node_id"]
+        node_id = payload.get("node_id")
+        if node_id is None and payload.get("node_id_hex"):
+            node_id = next((n for n in self.nodes
+                            if n.hex() == payload["node_id_hex"]), None)
+        if node_id is None:
+            return False
         await self._mark_node_dead(node_id, reason="drained")
         return True
 
@@ -223,6 +391,7 @@ class GcsServer:
         if info is None or not info.alive:
             return
         info.alive = False
+        self.node_demand.pop(node_id, None)
         self.pubsub.publish("nodes", {"event": "dead", "node_id": node_id,
                                       "reason": reason})
         self._mark_dirty()

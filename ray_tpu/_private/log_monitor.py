@@ -1,0 +1,114 @@
+"""Log monitor: tails worker log files and publishes new lines to the GCS
+"logs" pubsub channel; drivers subscribe and echo them with a
+"(worker=... node=...)" prefix.
+
+Reference: python/ray/_private/log_monitor.py:103 (LogMonitor tails
+/tmp/ray/session_*/logs and publishes over GCS pubsub — the `(pid=...)`
+stream every Ray user knows). One monitor runs inside each raylet.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import logging
+import os
+from typing import Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+MAX_LINES_PER_BATCH = 200
+MAX_LINE_LEN = 4096
+
+
+class LogMonitor:
+    def __init__(self, session_dir: str, node_name: str,
+                 publish,  # async callable(message: dict)
+                 pid_of: Optional[Callable[[str], int]] = None,
+                 owns: Optional[Callable[[str], bool]] = None,
+                 interval_s: float = 0.25):
+        self.log_dir = os.path.join(session_dir, "logs")
+        self.node_name = node_name
+        self.publish = publish
+        self.pid_of = pid_of or (lambda wid: -1)
+        # Multiple raylets can share one session dir (fake cluster): each
+        # monitor tails only the workers its raylet spawned.
+        self.owns = owns or (lambda wid: True)
+        self.interval_s = interval_s
+        self._offsets: Dict[str, int] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self):
+        self._task = asyncio.ensure_future(self._run())
+
+    def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _run(self):
+        # Skip history that predates this monitor (e.g. a restarted raylet
+        # sharing the session dir): start tailing from current EOF.
+        for path in glob.glob(os.path.join(self.log_dir, "worker-*.log")):
+            try:
+                self._offsets[path] = os.path.getsize(path)
+            except OSError:
+                pass
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                batches = self._scan()
+            except Exception:  # noqa: BLE001
+                logger.exception("log monitor scan failed")
+                continue
+            for worker_hex, lines in batches:
+                try:
+                    await self.publish({
+                        "node": self.node_name,
+                        "worker": worker_hex,
+                        "pid": self.pid_of(worker_hex),
+                        "lines": lines,
+                    })
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001
+                    # Transient GCS failure: this batch is lost (best-effort
+                    # stream) but the monitor keeps running — the raylet's
+                    # reconnect loop restores the connection underneath us.
+                    break
+
+    def _scan(self):
+        batches = []
+        for path in glob.glob(os.path.join(self.log_dir, "worker-*.log")):
+            if not self.owns(os.path.basename(path)
+                             [len("worker-"):-len(".log")]):
+                continue
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            offset = self._offsets.get(path, 0)
+            if size <= offset:
+                if size < offset:           # truncated/rotated
+                    self._offsets[path] = 0
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(1 << 20)
+            except OSError:
+                continue
+            # Only consume complete lines; partial tail stays for next scan.
+            end = data.rfind(b"\n")
+            if end < 0:
+                continue
+            self._offsets[path] = offset + end + 1
+            lines = [ln.decode("utf-8", "replace")[:MAX_LINE_LEN]
+                     for ln in data[:end].split(b"\n")]
+            worker_hex = os.path.basename(path)[len("worker-"):-len(".log")]
+            # Chunk (don't drop) bursts: every line ships, bounded per
+            # message; the 1 MiB read above bounds a single scan.
+            for i in range(0, len(lines), MAX_LINES_PER_BATCH):
+                batches.append((worker_hex,
+                                lines[i:i + MAX_LINES_PER_BATCH]))
+        return batches

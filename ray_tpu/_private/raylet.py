@@ -275,6 +275,8 @@ class Raylet:
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self._idle_workers: List[WorkerHandle] = []
         self._pending_leases: List[tuple] = []   # (spec, future)
+        self._autoscaler_active = False
+        self._spawned_worker_prefixes: set = set()
         self._starting_workers = 0
         self.gcs_conn: Optional[rpc.Connection] = None
         # Cluster resource view: node_id -> {available, total, address}
@@ -307,11 +309,31 @@ class Raylet:
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._idle_worker_reaper()))
         self._tasks.append(asyncio.ensure_future(self._start_forkserver()))
+        # Worker stdout/stderr -> GCS "logs" pubsub -> driver echo
+        # (reference: log_monitor.py LogMonitor).
+        from ray_tpu._private.log_monitor import LogMonitor
+
+        async def _publish_logs(message):
+            await self.gcs_conn.request(
+                "publish", {"channel": "logs", "message": message})
+
+        def _pid_of(worker_hex12: str) -> int:
+            for full, h in self._workers_by_hex.items():
+                if full.startswith(worker_hex12):
+                    return h.pid
+            return -1
+
+        self.log_monitor = LogMonitor(
+            self.session_dir, self.node_name, _publish_logs, pid_of=_pid_of,
+            owns=lambda h: h in self._spawned_worker_prefixes)
+        self.log_monitor.start()
         logger.info("raylet %s started at %s", self.node_name, self.address)
         return self.address
 
     async def stop(self):
         self._stopped = True
+        if getattr(self, "log_monitor", None) is not None:
+            self.log_monitor.stop()
         for t in self._tasks:
             t.cancel()
         for w in self.workers.values():
@@ -360,10 +382,18 @@ class Raylet:
                 reply = await self.gcs_conn.request("heartbeat", {
                     "node_id": self.node_id,
                     "resources_available": dict(self.pool.available),
+                    # Queued lease shapes feed the autoscaler's demand
+                    # bin-packing (reference: resource_demand_scheduler.py).
+                    "pending_demand": [
+                        dict(spec.resources)
+                        for spec, _pg, fut in self._pending_leases[:64]
+                        if not fut.done()],
                 })
                 if reply.get("reregister"):
                     # GCS restarted without our node in its (restored) table.
                     await self._register_with_gcs()
+                self._autoscaler_active = bool(
+                    reply.get("autoscaler_active"))
                 self._check_worker_deaths()
                 if self._resources_dirty:
                     self._resources_dirty = False
@@ -489,6 +519,7 @@ class Raylet:
         worker_id = WorkerID.from_random()
         env = self._worker_env_for(worker_id)
         log_path = self._worker_log_path(worker_id)
+        self._spawned_worker_prefixes.add(worker_id.hex()[:12])
         fs = _SharedForkServer.get()
         # Fast path: ask the zygote to fork a worker (~ms, vs seconds for a
         # cold python+jax start). Requests written before the zygote finishes
@@ -688,7 +719,12 @@ class Raylet:
                             total.get(k, 0) >= v
                             for k, v in spec.resources.items() if v > 0):
                         return {"spillback": view["address"]}
-                return {"infeasible": True}
+                if not self._autoscaler_active:
+                    return {"infeasible": True}
+                # Autoscaler live: queue the request so the heartbeat
+                # reports it as demand and a new node can absorb it
+                # (reference: infeasible tasks wait + warn, they don't
+                # fail, cluster_task_manager.cc).
         elif pg_key is None and spec.scheduling.kind == "SPREAD":
             best = self._pick_spread_node(spec.resources)
             if best is not None and best != self.node_id:

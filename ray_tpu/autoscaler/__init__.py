@@ -1,0 +1,14 @@
+"""Autoscaler: demand-driven node provisioning (SURVEY.md §2.3 autoscaler
+row; reference python/ray/autoscaler/)."""
+
+from ray_tpu.autoscaler.autoscaler import (AutoscalerConfig, NodeTypeConfig,
+                                           StandardAutoscaler)
+from ray_tpu.autoscaler.node_provider import (FakeMultiNodeProvider,
+                                              NodeProvider, TPUPodProvider)
+from ray_tpu.autoscaler.monitor import Monitor, make_gcs_request
+
+__all__ = [
+    "AutoscalerConfig", "NodeTypeConfig", "StandardAutoscaler",
+    "NodeProvider", "FakeMultiNodeProvider", "TPUPodProvider",
+    "Monitor", "make_gcs_request",
+]

@@ -1,0 +1,244 @@
+"""Demand-driven autoscaler.
+
+Reference: python/ray/autoscaler/_private/autoscaler.py:171
+(StandardAutoscaler) + resource_demand_scheduler.py:102 (demand
+bin-packing). TPU-first deltas: node types can declare `slice_hosts` so a
+TPU pod slice scales as one gang unit, and STRICT_SPREAD placement groups
+count one node per bundle (the slice/gang unit of the scheduler).
+
+The autoscaler is deliberately a pure control loop over GCS state:
+  demand  = queued worker-lease shapes (raylet heartbeats)
+          + bundles of unplaced placement groups
+  supply  = alive nodes' available resources + capacity of launching nodes
+  unmet demand -> bin-pack onto node types -> provider.create_node
+  idle nodes (available == total for > idle_timeout) -> terminate.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class NodeTypeConfig:
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+    # TPU: hosts per slice; create_node launches the whole gang.
+    slice_hosts: int = 1
+
+    def fits(self, shape: Dict[str, float]) -> bool:
+        return all(self.resources.get(k, 0.0) >= v
+                   for k, v in shape.items() if v > 0)
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: Dict[str, NodeTypeConfig] = field(default_factory=dict)
+    idle_timeout_s: float = 60.0
+    max_launch_batch: int = 8
+    update_interval_s: float = 5.0
+
+    @staticmethod
+    def from_dict(d: dict) -> "AutoscalerConfig":
+        types = {
+            name: NodeTypeConfig(
+                name=name, resources=dict(t.get("resources", {"CPU": 1})),
+                min_workers=int(t.get("min_workers", 0)),
+                max_workers=int(t.get("max_workers", 10)),
+                slice_hosts=int(t.get("slice_hosts", 1)))
+            for name, t in d.get("node_types", {}).items()}
+        return AutoscalerConfig(
+            node_types=types,
+            idle_timeout_s=float(d.get("idle_timeout_s", 60.0)),
+            max_launch_batch=int(d.get("max_launch_batch", 8)),
+            update_interval_s=float(d.get("update_interval_s", 5.0)))
+
+
+class StandardAutoscaler:
+    """One update() = one reconcile pass. Drive it from Monitor (live) or
+    directly from tests (deterministic)."""
+
+    def __init__(self, config: AutoscalerConfig, provider: NodeProvider,
+                 gcs_request):
+        """gcs_request: callable(method: str, payload: dict) -> result
+        (synchronous; the Monitor wraps the async GCS client)."""
+        self.config = config
+        self.provider = provider
+        self.gcs_request = gcs_request
+        self._idle_since: Dict[tuple, float] = {}   # gang/unit key -> ts
+        self._last_state: Optional[dict] = None
+        # Slice gangs: provider node id -> tuple of all ids launched in the
+        # same create_node gang (slice_hosts > 1 scales whole slices).
+        self._gang_of: Dict[str, tuple] = {}
+
+    # ---------------- slice (gang) accounting ----------------
+
+    def _slices_of_type(self, type_name: str,
+                        t: "NodeTypeConfig") -> int:
+        """Number of gang units of a type: tracked gangs count once, nodes
+        launched outside this autoscaler count host/slice_hosts rounded up."""
+        gangs = set()
+        loose = 0
+        for pid in self.provider.non_terminated_nodes():
+            if self.provider.node_tags(pid).get("node_type") != type_name:
+                continue
+            gang = self._gang_of.get(pid)
+            if gang is not None:
+                gangs.add(gang)
+            else:
+                loose += 1
+        per = max(1, t.slice_hosts)
+        return len(gangs) + -(-loose // per)
+
+    def _launch_slice(self, t: "NodeTypeConfig") -> int:
+        pids = self.provider.create_node(
+            t.name, {"resources": dict(t.resources)}, max(1, t.slice_hosts))
+        gang = tuple(pids)
+        for pid in pids:
+            self._gang_of[pid] = gang
+        return len(pids)
+
+    # ---------------- demand/supply computation ----------------
+
+    def _demand_shapes(self, state: dict) -> List[Dict[str, float]]:
+        shapes = [dict(s) for s in state.get("pending_demand", [])]
+        for pg in state.get("pending_placement_groups", []):
+            if pg["strategy"] == "STRICT_SPREAD":
+                # One node per bundle: inflate each bundle to a full-node
+                # claim by tagging it; the packer places each on its own
+                # (possibly new) node.
+                for b in pg["bundles"]:
+                    s = dict(b)
+                    s["__exclusive__"] = 1.0
+                    shapes.append(s)
+            else:
+                shapes.extend(dict(b) for b in pg["bundles"])
+        return shapes
+
+    def update(self) -> dict:
+        """One reconcile pass; returns {launched: {type: n}, terminated: [...]}.
+        """
+        state = self.gcs_request("get_autoscaler_state", {})
+        self._last_state = state
+        launched: Dict[str, int] = {}
+        terminated: List[str] = []
+
+        # ---- supply view: available capacity per alive node ----
+        # Each entry: {"cap": resources, "exclusive_taken": bool}.
+        gcs_node_ids = {nid.hex() if hasattr(nid, "hex") else str(nid)
+                        for nid in state["nodes"]}
+        bins: List[dict] = [
+            {"cap": dict(n["available"]), "exclusive_taken": False}
+            for n in state["nodes"].values() if n["alive"]]
+        # Nodes the provider launched that haven't registered with the GCS
+        # yet (startup race): count their full declared shape so a second
+        # update() pass doesn't double-launch.
+        for pid in self.provider.non_terminated_nodes():
+            tags = self.provider.node_tags(pid)
+            if tags.get("node_id", "") not in gcs_node_ids:
+                t = self.config.node_types.get(tags.get("node_type", ""))
+                if t:
+                    bins.append({"cap": dict(t.resources),
+                                 "exclusive_taken": False})
+
+        def try_place(shape: Dict[str, float], exclusive: bool) -> bool:
+            for b in bins:
+                if exclusive and b["exclusive_taken"]:
+                    continue
+                if all(b["cap"].get(k, 0.0) >= v
+                       for k, v in shape.items() if v > 0):
+                    for k, v in shape.items():
+                        if v > 0:
+                            b["cap"][k] = b["cap"].get(k, 0.0) - v
+                    if exclusive:
+                        b["exclusive_taken"] = True
+                    return True
+            return False
+
+        # ---- bin-pack demand; launch the smallest type that fits ----
+        # All caps/counts below are in SLICES (gang units): one slice =
+        # slice_hosts provider nodes, launched and terminated together.
+        to_launch: Dict[str, int] = {}
+        for shape in self._demand_shapes(state):
+            exclusive = shape.pop("__exclusive__", 0.0) > 0
+            if try_place(shape, exclusive):
+                continue
+            for t in sorted(self.config.node_types.values(),
+                            key=lambda t: sum(t.resources.values())):
+                current = self._slices_of_type(t.name, t)
+                if t.fits(shape) and current + to_launch.get(t.name, 0) \
+                        < t.max_workers:
+                    to_launch[t.name] = to_launch.get(t.name, 0) + 1
+                    cap = dict(t.resources)
+                    for k, v in shape.items():
+                        if v > 0:
+                            cap[k] = cap.get(k, 0.0) - v
+                    bins.append({"cap": cap, "exclusive_taken": exclusive})
+                    break
+            else:
+                logger.warning("autoscaler: demand %s fits no node type",
+                               shape)
+
+        # ---- honor min_workers (in slices) ----
+        for t in self.config.node_types.values():
+            current = self._slices_of_type(t.name, t)
+            short = t.min_workers - current - to_launch.get(t.name, 0)
+            if short > 0:
+                to_launch[t.name] = to_launch.get(t.name, 0) + short
+
+        # ---- launch ----
+        for type_name, count in to_launch.items():
+            t = self.config.node_types[type_name]
+            count = min(count, self.config.max_launch_batch)
+            n_created = sum(self._launch_slice(t) for _ in range(count))
+            launched[type_name] = n_created
+            logger.info("autoscaler: launched %d hosts (%d slices) of %s",
+                        n_created, count, type_name)
+
+        # ---- scale down idle slices (whole gangs only) ----
+        now = time.time()
+        demand_left = bool(self._demand_shapes(state))
+        gcs_by_hex = {
+            (gid.hex() if hasattr(gid, "hex") else str(gid)): info
+            for gid, info in state["nodes"].items()}
+
+        def node_idle(pid: str) -> bool:
+            n = gcs_by_hex.get(self.provider.node_tags(pid)
+                               .get("node_id", ""))
+            if n is None or not n["alive"]:
+                return False
+            return all(abs(n["available"].get(k, 0.0) - v) < 1e-6
+                       for k, v in n["total"].items()
+                       if k not in ("memory", "object_store_memory"))
+
+        units: Dict[tuple, List[str]] = {}
+        for pid in self.provider.non_terminated_nodes():
+            key = self._gang_of.get(pid, (pid,))
+            units.setdefault(key, []).append(pid)
+        for key, pids in units.items():
+            tags = self.provider.node_tags(pids[0])
+            t = self.config.node_types.get(tags.get("node_type", ""))
+            if not all(node_idle(p) for p in pids) or demand_left:
+                self._idle_since.pop(key, None)
+                continue
+            first = self._idle_since.setdefault(key, now)
+            if (now - first >= self.config.idle_timeout_s and t is not None
+                    and self._slices_of_type(t.name, t) > t.min_workers):
+                logger.info("autoscaler: terminating idle slice %s", pids)
+                for pid in pids:
+                    nid = self.provider.node_tags(pid).get("node_id", "")
+                    self.gcs_request("drain_node", {"node_id_hex": nid})
+                    self.provider.terminate_node(pid)
+                    self._gang_of.pop(pid, None)
+                    terminated.append(pid)
+                self._idle_since.pop(key, None)
+        return {"launched": launched, "terminated": terminated}

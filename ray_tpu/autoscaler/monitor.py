@@ -1,0 +1,60 @@
+"""Autoscaler monitor: the live control loop around StandardAutoscaler.
+
+Reference: python/ray/autoscaler/_private/monitor.py:126 — a process on the
+head node that wakes periodically, reads GCS state, and reconciles. Here it
+is a daemon thread (the GCS client rides the shared background event loop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+def make_gcs_request(gcs_address: str, loop: asyncio.AbstractEventLoop):
+    """Synchronous GCS request bridge for the autoscaler/thread context."""
+    from ray_tpu._private import rpc
+    holder = {}
+
+    async def _conn():
+        c = holder.get("c")
+        if c is None or c.closed:
+            holder["c"] = c = await rpc.connect(gcs_address)
+        return c
+
+    def request(method: str, payload: dict):
+        async def _r():
+            return await (await _conn()).request(method, payload)
+        return asyncio.run_coroutine_threadsafe(_r(), loop).result(30)
+
+    return request
+
+
+class Monitor:
+    def __init__(self, autoscaler, interval_s: Optional[float] = None):
+        self.autoscaler = autoscaler
+        self.interval_s = (interval_s if interval_s is not None
+                           else autoscaler.config.update_interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ray_tpu-autoscaler")
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.autoscaler.update()
+            except Exception:  # noqa: BLE001
+                logger.exception("autoscaler update failed")
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,137 @@
+"""Node providers: pluggable machinery the autoscaler uses to launch and
+terminate nodes.
+
+Reference: python/ray/autoscaler/node_provider.py (NodeProvider ABC) and
+python/ray/autoscaler/_private/fake_multi_node/node_provider.py:237
+(FakeMultiNodeProvider — fake nodes for tests without a cloud). TPU-first
+deltas: a "node" is a TPU host (or a whole slice when `slice_hosts` > 1 in
+the node type), so create_node must gang-create every host of a slice.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """ABC. Provider node ids are provider-scoped opaque strings."""
+
+    def __init__(self, provider_config: Optional[dict] = None):
+        self.provider_config = provider_config or {}
+
+    def create_node(self, node_type: str, node_config: dict,
+                    count: int) -> List[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def node_tags(self, provider_node_id: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def internal_ip(self, provider_node_id: str) -> str:
+        return ""
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Launches in-process raylets against a live GCS — the test provider.
+
+    Each "node" is a Raylet started on the caller's event loop (same
+    mechanism as cluster_utils.Cluster.add_node), so autoscaler behavior is
+    testable with zero cloud access and real scheduling.
+    """
+
+    def __init__(self, gcs_address: str, config, session_dir: str = "",
+                 loop: Optional[asyncio.AbstractEventLoop] = None):
+        super().__init__()
+        self.gcs_address = gcs_address
+        self.config = config
+        self.session_dir = session_dir
+        self.loop = loop
+        self._nodes: Dict[str, object] = {}     # provider id -> Raylet
+        self._tags: Dict[str, Dict[str, str]] = {}
+
+    def _run(self, coro):
+        if self.loop is None:
+            raise RuntimeError("FakeMultiNodeProvider needs a background loop")
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self.loop:
+            raise RuntimeError(
+                "provider must not be driven from its own event loop")
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(60)
+
+    def create_node(self, node_type: str, node_config: dict,
+                    count: int) -> List[str]:
+        from ray_tpu._private.raylet import Raylet
+        created = []
+        for _ in range(count):
+            pid = f"fake-{node_type}-{uuid.uuid4().hex[:8]}"
+            resources = dict(node_config.get("resources") or {"CPU": 1.0})
+            resources.setdefault("memory", 2.0 * 1024**3)
+            resources.setdefault("object_store_memory", 128.0 * 1024**2)
+
+            async def _start():
+                raylet = Raylet(self.config, self.gcs_address,
+                                self.session_dir, resources=resources,
+                                labels={"ray_tpu.io/node-type": node_type},
+                                object_store_memory=int(
+                                    resources["object_store_memory"]),
+                                node_name=pid)
+                await raylet.start()
+                return raylet
+
+            raylet = self._run(_start())
+            self._nodes[pid] = raylet
+            self._tags[pid] = {"node_type": node_type,
+                               "launched_at": str(time.time()),
+                               "node_id": raylet.node_id.hex()}
+            created.append(pid)
+        return created
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raylet = self._nodes.pop(provider_node_id, None)
+        self._tags.pop(provider_node_id, None)
+        if raylet is None:
+            return
+
+        async def _stop():
+            await raylet.stop()
+
+        self._run(_stop())
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._nodes)
+
+    def node_tags(self, provider_node_id: str) -> Dict[str, str]:
+        return dict(self._tags.get(provider_node_id, {}))
+
+    def node_id_of(self, provider_node_id: str) -> str:
+        return self._tags.get(provider_node_id, {}).get("node_id", "")
+
+
+class TPUPodProvider(NodeProvider):
+    """GCE TPU-VM provider skeleton: slice-granular create/delete via the
+    TPU API. Gated: requires GCP credentials + the cloud SDK at runtime
+    (not available in CI), so every method raises with instructions.
+
+    Reference analogue: python/ray/autoscaler/_private/gcp/node_provider.py;
+    TPU specifics per python/ray/_private/accelerators/tpu.py (slice
+    topology, TPU-<type>-head resource).
+    """
+
+    def __init__(self, provider_config: Optional[dict] = None):
+        super().__init__(provider_config)
+        raise RuntimeError(
+            "TPUPodProvider requires GCP credentials and the TPU API; "
+            "configure provider_config={project, zone, accelerator_type} "
+            "on a GCE deployment. Use FakeMultiNodeProvider for local "
+            "testing.")

@@ -98,7 +98,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     acc, m, l = jax.lax.fori_loop(0, num_blocks, body, init)
     l = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    lse_ref[0, 0] = m + jnp.log(l)
 
 
 def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
@@ -121,11 +121,13 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            # lse rides as [bh, 1, seq_q]: TPU Pallas needs the last two
+            # block dims divisible by (8, 128) or equal to the array dims.
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, seq_q), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr)
@@ -142,8 +144,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     """
     q = q_ref[0].astype(jnp.float32)                      # [bq, d]
     do = do_ref[0].astype(jnp.float32)                    # [bq, d]
-    lse = lse_ref[0]                                      # [bq]
-    delta = delta_ref[0]                                  # [bq]
+    lse = lse_ref[0, 0]                                   # [bq]
+    delta = delta_ref[0, 0]                               # [bq]
     bq, d = q.shape
     q_idx = pl.program_id(1)
     q_start = q_idx * bq
@@ -203,8 +205,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q_blk = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
         do_blk = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(j * block_q, block_q)]
-        delta_blk = delta_ref[0, pl.ds(j * block_q, block_q)]
+        lse_blk = lse_ref[0, 0, pl.ds(j * block_q, block_q)]
+        delta_blk = delta_ref[0, 0, pl.ds(j * block_q, block_q)]
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
@@ -252,7 +254,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
     gr = g.reshape(bh, seq_q, d)
     # delta_i = rowsum(dO_i * O_i): cheap elementwise, fused by XLA.
     delta = jnp.sum(gr.astype(jnp.float32)
-                    * out.reshape(bh, seq_q, d).astype(jnp.float32), axis=-1)
+                    * out.reshape(bh, seq_q, d).astype(jnp.float32),
+                    axis=-1).reshape(bh, 1, seq_q)
     offset = seq_k - seq_q
 
     dq_kernel = functools.partial(
@@ -266,8 +269,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),     # k
             pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),     # v
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # do
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),         # lse
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),         # delta
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),   # lse
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),   # delta
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
@@ -285,8 +288,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),   # k
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),   # v
             pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),     # do
-            pl.BlockSpec((1, seq_q), lambda b, i: (b, 0)),           # lse
-            pl.BlockSpec((1, seq_q), lambda b, i: (b, 0)),           # delta
+            pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),     # lse
+            pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),     # delta
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),

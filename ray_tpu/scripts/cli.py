@@ -86,6 +86,16 @@ def cmd_status(args):
         print("actors:", dict(st["actors"]))
     if st["placement_groups"]:
         print("placement groups:", dict(st["placement_groups"]))
+    try:
+        from ray_tpu._private import worker_api
+        core = worker_api.get_core()
+        addr = worker_api._call_on_core_loop(
+            core, core.gcs.request("get_metrics_address", {}), 10)
+        if addr:
+            print(f"metrics: http://{addr}/metrics "
+                  f"(status: http://{addr}/api/status)")
+    except Exception:
+        pass
     ray_tpu.shutdown()
 
 

@@ -1,0 +1,180 @@
+"""User-facing metrics API + process-local registry.
+
+Reference: python/ray/util/metrics.py (Counter/Gauge/Histogram) over
+src/ray/stats/metric.h:103-190; export path per
+python/ray/_private/metrics_agent.py (per-node agent -> Prometheus scrape
+endpoint). Here: every process keeps one registry; CoreWorkers and raylets
+push snapshots to the GCS with their report loops, and the head exposes the
+aggregate in Prometheus text format over HTTP (gcs.py _MetricsHttpServer).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                   5.0, 10.0, 30.0, 60.0)
+
+_lock = threading.Lock()
+_registry: Dict[Tuple[str, tuple], dict] = {}
+
+
+def _key(name: str, tags: Optional[dict]) -> Tuple[str, tuple]:
+    return (name, tuple(sorted((tags or {}).items())))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[tuple] = None):
+        if not name or not name.replace("_", "").replace(":", "").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: dict = {}
+
+    def set_default_tags(self, tags: dict) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _tags(self, tags: Optional[dict]) -> dict:
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        extra = set(merged) - set(self._tag_keys)
+        if extra:
+            raise ValueError(f"undeclared metric tags {sorted(extra)} "
+                             f"(declared: {self._tag_keys})")
+        return merged
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = _key(self._name, self._tags(tags))
+        with _lock:
+            ent = _registry.setdefault(k, {
+                "name": self._name, "type": self.TYPE,
+                "description": self._description,
+                "tags": dict(self._tags(tags)), "value": 0.0})
+            ent["value"] += value
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float, tags: Optional[dict] = None):
+        k = _key(self._name, self._tags(tags))
+        with _lock:
+            _registry[k] = {
+                "name": self._name, "type": self.TYPE,
+                "description": self._description,
+                "tags": dict(self._tags(tags)), "value": float(value)}
+
+
+class Histogram(Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Optional[tuple] = None):
+        super().__init__(name, description, tag_keys)
+        self._bounds = tuple(boundaries or DEFAULT_BUCKETS)
+
+    def observe(self, value: float, tags: Optional[dict] = None):
+        k = _key(self._name, self._tags(tags))
+        with _lock:
+            ent = _registry.setdefault(k, {
+                "name": self._name, "type": self.TYPE,
+                "description": self._description,
+                "tags": dict(self._tags(tags)), "bounds": self._bounds,
+                "bucket_counts": [0] * (len(self._bounds) + 1),
+                "sum": 0.0, "count": 0})
+            idx = len(self._bounds)
+            for i, b in enumerate(self._bounds):
+                if value <= b:
+                    idx = i
+                    break
+            ent["bucket_counts"][idx] += 1
+            ent["sum"] += value
+            ent["count"] += 1
+
+
+def snapshot() -> List[dict]:
+    """Copy of this process's metric state (shipped to the GCS)."""
+    with _lock:
+        return [dict(v, bucket_counts=list(v["bucket_counts"]))
+                if v["type"] == "histogram" else dict(v)
+                for v in _registry.values()]
+
+
+def clear() -> None:
+    with _lock:
+        _registry.clear()
+
+
+def merge_snapshots(snapshots: List[List[dict]]) -> List[dict]:
+    """Aggregate reporter snapshots: counters/histograms sum, gauges sum
+    (Ray dashboards default to sum across workers too)."""
+    out: Dict[Tuple[str, tuple], dict] = {}
+    for snap in snapshots:
+        for m in snap:
+            k = _key(m["name"], m.get("tags"))
+            cur = out.get(k)
+            if cur is None:
+                out[k] = (dict(m, bucket_counts=list(m["bucket_counts"]))
+                          if m["type"] == "histogram" else dict(m))
+            elif m["type"] == "histogram":
+                cur["sum"] += m["sum"]
+                cur["count"] += m["count"]
+                cur["bucket_counts"] = [
+                    a + b for a, b in zip(cur["bucket_counts"],
+                                          m["bucket_counts"])]
+            else:
+                cur["value"] += m["value"]
+    return list(out.values())
+
+
+def _escape_label(v) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _sample(name: str, tags: dict, value, extra: Optional[dict] = None):
+    t = dict(tags or {})
+    if extra:
+        t.update(extra)
+    label = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(t.items()))
+    return f"{name}{{{label}}} {value}" if label else f"{name} {value}"
+
+
+def to_prometheus(metrics: List[dict]) -> str:
+    """Render merged metrics in Prometheus text exposition format."""
+    lines: List[str] = []
+    seen_header = set()
+    for m in sorted(metrics, key=lambda m: m["name"]):
+        name = m["name"]
+        if name not in seen_header:
+            seen_header.add(name)
+            if m.get("description"):
+                lines.append(f"# HELP {name} {m['description']}")
+            lines.append(f"# TYPE {name} {m['type']}")
+        tags = m.get("tags", {})
+        if m["type"] == "histogram":
+            cum = 0
+            for b, c in zip(m["bounds"], m["bucket_counts"]):
+                cum += c
+                lines.append(_sample(name + "_bucket", tags, cum,
+                                     {"le": b}))
+            cum += m["bucket_counts"][-1]
+            lines.append(_sample(name + "_bucket", tags, cum,
+                                 {"le": "+Inf"}))
+            lines.append(_sample(name + "_sum", tags, m["sum"]))
+            lines.append(_sample(name + "_count", tags, m["count"]))
+        else:
+            lines.append(_sample(name, tags, m["value"]))
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,147 @@
+"""Autoscaler tests with the FakeMultiNodeProvider (reference pattern:
+python/ray/tests/test_autoscaler_fake_multinode.py)."""
+
+import time
+
+import pytest
+
+
+def _mk(cluster, node_types, **cfg):
+    from ray_tpu._private import worker_api
+    from ray_tpu.autoscaler import (AutoscalerConfig, FakeMultiNodeProvider,
+                                    StandardAutoscaler, make_gcs_request)
+    provider = FakeMultiNodeProvider(
+        cluster.gcs_address, cluster.config, cluster.session_dir,
+        loop=worker_api._state.loop)
+    config = AutoscalerConfig.from_dict(
+        {"node_types": node_types, **cfg})
+    gcs_request = make_gcs_request(cluster.gcs_address,
+                                   worker_api._state.loop)
+    scaler = StandardAutoscaler(config, provider, gcs_request)
+    # Prime: raylets learn "autoscaler active" from the next heartbeat and
+    # queue infeasible leases instead of failing them fast.
+    scaler.gcs_request("get_autoscaler_state", {})
+    time.sleep(0.5)
+    return scaler, provider
+
+
+def _wait(pred, timeout=20, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.2)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+def test_scale_up_on_pending_task(ray_cluster):
+    """A queued task needing a resource no node has launches a fake node."""
+    ray_cluster.connect()
+    import ray_tpu
+
+    scaler, provider = _mk(ray_cluster, {
+        "gpuless": {"resources": {"CPU": 1, "special": 1}, "max_workers": 2},
+    })
+
+    @ray_tpu.remote(resources={"special": 1})
+    def needs_special():
+        return "ran"
+
+    ref = needs_special.remote()
+    # Demand reaches the GCS via the raylet heartbeat (0.2 s in tests).
+    _wait(lambda: scaler.gcs_request("get_autoscaler_state", {})
+          ["pending_demand"], msg="demand visible in GCS")
+    result = scaler.update()
+    assert result["launched"].get("gpuless") == 1
+    assert ray_tpu.get(ref, timeout=60) == "ran"
+
+
+def test_scale_up_strict_spread_pg(ray_cluster):
+    """A pending STRICT_SPREAD PG gets one new node per unplaceable bundle
+    and reaches CREATED."""
+    ray_cluster.connect()
+    import ray_tpu
+    from ray_tpu.util.placement_group import placement_group
+
+    scaler, provider = _mk(ray_cluster, {
+        "worker": {"resources": {"CPU": 2}, "max_workers": 4},
+    })
+
+    # Head has 2 CPU; 3 strict-spread bundles need 3 distinct nodes.
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    _wait(lambda: scaler.gcs_request("get_autoscaler_state", {})
+          ["pending_placement_groups"], msg="pending PG in GCS")
+    result = scaler.update()
+    assert sum(result["launched"].values()) == 2  # head serves one bundle
+    assert pg.wait(timeout_seconds=30)
+
+
+def test_scale_down_idle_node(ray_cluster):
+    """An idle provider node terminates after idle_timeout_s."""
+    ray_cluster.connect()
+    import ray_tpu  # noqa: F401
+
+    scaler, provider = _mk(ray_cluster, {
+        "worker": {"resources": {"CPU": 1, "special": 1}, "max_workers": 2},
+    }, idle_timeout_s=0.5)
+    provider.create_node("worker", {"resources": {"CPU": 1, "special": 1}}, 1)
+    _wait(lambda: sum(
+        1 for n in scaler.gcs_request("get_autoscaler_state", {})
+        ["nodes"].values() if n["alive"]) == 2, msg="fake node registered")
+
+    scaler.update()          # records idle_since
+    time.sleep(0.7)
+    result = scaler.update()
+    assert len(result["terminated"]) == 1
+    assert provider.non_terminated_nodes() == []
+
+
+def test_slice_gang_scaling(ray_cluster):
+    """slice_hosts > 1: one demand unit launches the whole slice gang; the
+    max_workers cap counts slices; idle scale-down removes whole gangs."""
+    ray_cluster.connect()
+    scaler, provider = _mk(ray_cluster, {
+        "v4slice": {"resources": {"CPU": 1, "TPU": 4}, "max_workers": 1,
+                    "slice_hosts": 2},
+    }, idle_timeout_s=0.3)
+
+    import ray_tpu
+    from ray_tpu.util.placement_group import placement_group
+    pg = placement_group([{"TPU": 4}], strategy="PACK")
+    _wait(lambda: scaler.gcs_request("get_autoscaler_state", {})
+          ["pending_placement_groups"], msg="pending PG")
+    result = scaler.update()
+    assert result["launched"].get("v4slice") == 2   # 2 hosts = 1 slice
+    assert pg.wait(timeout_seconds=30)
+    # max_workers=1 slice: no further launches even with more demand.
+    pg2 = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="STRICT_SPREAD")
+    _wait(lambda: scaler.gcs_request("get_autoscaler_state", {})
+          ["pending_placement_groups"], msg="pending PG2")
+    result2 = scaler.update()
+    assert not result2["launched"]
+    from ray_tpu.util.placement_group import remove_placement_group
+    remove_placement_group(pg2)
+    remove_placement_group(pg)
+    # Whole gang terminates together once idle.
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        result3 = scaler.update()
+        if len(result3["terminated"]) == 2:
+            break
+        time.sleep(0.3)
+    else:
+        pytest.fail("idle slice gang never terminated")
+    assert provider.non_terminated_nodes() == []
+
+
+def test_min_workers_maintained(ray_cluster):
+    ray_cluster.connect()
+    scaler, provider = _mk(ray_cluster, {
+        "base": {"resources": {"CPU": 1}, "min_workers": 2,
+                 "max_workers": 4},
+    })
+    result = scaler.update()
+    assert result["launched"].get("base") == 2
+    # Idempotent: a second pass launches nothing more.
+    result2 = scaler.update()
+    assert not result2["launched"]
