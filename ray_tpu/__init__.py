@@ -8,7 +8,7 @@ map against the reference system.
 
 from ray_tpu._version import __version__
 from ray_tpu import exceptions
-from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu._private.worker_api import (available_resources, cancel,
                                          cluster_resources, get, get_actor,
                                          init, is_initialized, kill, nodes,
@@ -51,5 +51,6 @@ __all__ = [
     "__version__", "init", "shutdown", "is_initialized", "remote", "method",
     "get", "put", "wait", "kill", "cancel", "get_actor", "nodes",
     "cluster_resources", "available_resources", "timeline",
-    "ObjectRef", "ActorClass", "ActorHandle", "RemoteFunction", "exceptions",
+    "ObjectRef", "ObjectRefGenerator", "ActorClass", "ActorHandle",
+    "RemoteFunction", "exceptions",
 ]

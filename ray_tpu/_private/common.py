@@ -85,6 +85,17 @@ class TaskSpec:
     kwarg_names: Tuple[str, ...] = ()
     # Actor lifetime ("" | "detached")
     lifetime: str = ""
+    # Concurrency groups (reference: concurrency_group_manager.h):
+    # declared on the actor-creation spec {name: max_concurrency}; actor
+    # tasks carry the group they execute in ("" = default group).
+    concurrency_groups: Optional[Dict[str, int]] = None
+    concurrency_group: str = ""
+    # Out-of-order actor execution: receiver skips per-caller seq gating
+    # (reference: out_of_order_actor_scheduling_queue.cc).
+    execute_out_of_order: bool = False
+    # @method-decorator defaults per method name (num_returns,
+    # concurrency_group); persisted so get_actor handles honor them.
+    method_options: Optional[Dict[str, dict]] = None
 
     def env_hash(self) -> str:
         return (self.runtime_env or {}).get("_hash", "")
@@ -112,7 +123,8 @@ class TaskSpec:
             self.max_task_retries, self.max_concurrency,
             self.is_async_actor, self.actor_name, self.namespace,
             self.runtime_env, self.is_generator, self.kwarg_names,
-            self.lifetime))
+            self.lifetime, self.concurrency_groups, self.concurrency_group,
+            self.execute_out_of_order, self.method_options))
 
 
 @dataclass

@@ -65,6 +65,10 @@ class Config:
     prestart_workers: bool = True
 
     # --- health / fault tolerance ---
+    # OOM defense: kill a leased worker when system memory usage crosses
+    # the threshold (reference: memory_monitor.h memory_usage_threshold).
+    memory_usage_threshold: float = 0.95
+    memory_monitor_interval_s: float = 1.0
     heartbeat_interval_s: float = 0.5
     node_death_timeout_s: float = 5.0
     task_max_retries_default: int = 3

@@ -74,6 +74,24 @@ class PendingTask:
 
 
 @dataclass
+class GeneratorStream:
+    """Owner-side state of a streaming-generator task
+    (reference: task_manager.h ObjectRefStream, num_returns='streaming')."""
+    task_id: TaskID
+    spec: Optional[TaskSpec] = None
+    received: int = 0               # items registered so far
+    total: Optional[int] = None     # set when the task finishes
+    error: Optional[Exception] = None
+    waiters: List[asyncio.Future] = field(default_factory=list)
+
+    def wake(self):
+        for fut in self.waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self.waiters.clear()
+
+
+@dataclass
 class LeaseEntry:
     worker_id: WorkerID
     worker_address: str
@@ -217,6 +235,7 @@ class CoreWorker:
         self._uploaded_pkgs: set = set()              # uris known in KV
         self._running_tasks: Dict[TaskID, Any] = {}
         self._cancelled_tasks: set = set()
+        self.generator_streams: Dict[TaskID, GeneratorStream] = {}
         self._task_events_buffer: List[dict] = []
         self._shutdown = False
         self._bg_tasks: List[asyncio.Task] = []
@@ -353,6 +372,7 @@ class CoreWorker:
         s.register("owner_add_borrower", self._rpc_owner_add_borrower)
         s.register("owner_remove_borrower", self._rpc_owner_remove_borrower)
         s.register("owner_add_location", self._rpc_owner_add_location)
+        s.register("generator_item", self._rpc_generator_item)
         s.register("shutdown", self._rpc_shutdown)
         s.register("ping", self._rpc_ping)
 
@@ -1028,6 +1048,12 @@ class CoreWorker:
             self.owned[oid] = ent
             returns.append(oid)
             refs.append(ObjectRef(oid, self.address))
+        if is_generator:
+            # Streamed returns have no refs upfront; items register as
+            # they arrive (generator_item) and are consumed via
+            # generator_next (reference: ObjectRefStream).
+            self.generator_streams[task_id] = GeneratorStream(task_id,
+                                                              spec=spec)
         self.pending_tasks[task_id] = PendingTask(
             spec=spec, retries_left=spec.max_retries, returns=returns,
             arg_refs=[])
@@ -1035,6 +1061,9 @@ class CoreWorker:
         asyncio.ensure_future(
             self._finish_task_submission(spec, args, kwargs, export,
                                          _prebuilt))
+        if is_generator:
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+            return [ObjectRefGenerator(task_id, self)]
         return refs
 
     def _try_build_args_sync(self, args: tuple, kwargs: dict):
@@ -1096,6 +1125,9 @@ class CoreWorker:
                                               creating_spec=spec)
                 returns.append(oid)
                 refs.append(ObjectRef(oid, self.address))
+            if is_generator:
+                self.generator_streams[task_id] = GeneratorStream(task_id,
+                                                                  spec=spec)
             self.pending_tasks[task_id] = PendingTask(
                 spec=spec, retries_left=spec.max_retries, returns=returns,
                 arg_refs=[])
@@ -1103,6 +1135,9 @@ class CoreWorker:
         self.loop.call_soon_threadsafe(
             self._post_threadsafe_task_submit, spec, args, kwargs, export,
             prebuilt)
+        if is_generator:
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+            return [ObjectRefGenerator(task_id, self)]
         return refs
 
     def _post_threadsafe_task_submit(self, spec, args, kwargs, export,
@@ -1346,36 +1381,97 @@ class CoreWorker:
                 return
             self._complete_task_error(spec, app_error, retry=False)
             return
+        if "generator_done" in reply:
+            self.pending_tasks.pop(spec.task_id, None)
+            self._record_task_event(spec, "FINISHED")
+            stream = self.generator_streams.get(spec.task_id)
+            if stream is not None:
+                stream.total = reply["generator_done"]
+                stream.wake()
+            return
         returns = reply["returns"]  # list of {"inline": bytes}|{"stored": addr, "size": n}
         self._complete_task_ok(spec, returns, exec_raylet)
+
+    def _register_return_object(self, spec: TaskSpec, index: int, ret: dict,
+                                exec_raylet: str) -> ObjectID:
+        """Make return slot `index` of `spec` a ready owned object."""
+        oid = ObjectID.for_task_return(spec.task_id, index)
+        ent = self.owned.get(oid)
+        if ent is None:
+            ent = OwnedObject(object_id=oid, creating_spec=spec)
+            self.owned[oid] = ent
+        if "inline" in ret:
+            ent.inline_value = ret["inline"]
+        else:
+            loc = ret.get("stored", exec_raylet)
+            if loc not in ent.locations:
+                ent.locations.append(loc)
+        ent.is_exception = bool(ret.get("is_exception"))
+        ent.ready = True
+        for fut in ent.waiters:
+            if not fut.done():
+                fut.set_result(True)
+        ent.waiters.clear()
+        return oid
+
+    async def _rpc_generator_item(self, conn, payload):
+        """Owner side: one streamed item from an executing generator task."""
+        task_id: TaskID = payload["task_id"]
+        stream = self.generator_streams.get(task_id)
+        if stream is None or stream.spec is None:
+            return False  # stream consumed/cancelled; drop late items
+        self._register_return_object(stream.spec, payload["index"],
+                                     payload["ret"],
+                                     payload.get("exec_raylet", ""))
+        stream.received = max(stream.received, payload["index"] + 1)
+        stream.wake()
+        return True
+
+    async def generator_next(self, task_id: TaskID,
+                             cursor: int) -> Optional[ObjectRef]:
+        """Next ref of a streaming task, or None when exhausted."""
+        stream = self.generator_streams.get(task_id)
+        if stream is None:
+            return None  # already exhausted/released: StopIteration persists
+        while True:
+            if cursor < stream.received:
+                return ObjectRef(ObjectID.for_task_return(task_id, cursor),
+                                 self.address)
+            if stream.error is not None:
+                raise stream.error
+            if stream.total is not None and cursor >= stream.total:
+                self.generator_streams.pop(task_id, None)
+                return None
+            fut = asyncio.get_running_loop().create_future()
+            stream.waiters.append(fut)
+            await fut
+
+    def release_generator(self, task_id: TaskID, consumed: int):
+        """Consumer dropped the ObjectRefGenerator: free the stream and the
+        never-handed-out return objects (indices >= consumed). Items the
+        consumer did take are governed by normal ref counting."""
+        stream = self.generator_streams.pop(task_id, None)
+        if stream is None:
+            return
+        stream.wake()
+        for i in range(consumed, stream.received):
+            self.owned.pop(ObjectID.for_task_return(task_id, i), None)
 
     def _complete_task_ok(self, spec: TaskSpec, returns: List[dict],
                           exec_raylet: str):
         self.pending_tasks.pop(spec.task_id, None)
         self._record_task_event(spec, "FINISHED")
         for i, ret in enumerate(returns):
-            oid = ObjectID.for_task_return(spec.task_id, i)
-            ent = self.owned.get(oid)
-            if ent is None:
-                ent = OwnedObject(object_id=oid, creating_spec=spec)
-                self.owned[oid] = ent
-            if "inline" in ret:
-                ent.inline_value = ret["inline"]
-            else:
-                loc = ret.get("stored", exec_raylet)
-                if loc not in ent.locations:
-                    ent.locations.append(loc)
-            ent.is_exception = bool(ret.get("is_exception"))
-            ent.ready = True
-            for fut in ent.waiters:
-                if not fut.done():
-                    fut.set_result(True)
-            ent.waiters.clear()
+            self._register_return_object(spec, i, ret, exec_raylet)
 
     def _complete_task_error(self, spec: TaskSpec, error: Exception,
                              retry: bool):
         self.pending_tasks.pop(spec.task_id, None)
         self._record_task_event(spec, "FAILED")
+        stream = self.generator_streams.get(spec.task_id)
+        if stream is not None:
+            stream.error = error
+            stream.wake()
         ser = self.serialization.serialize(error).to_bytes()
         for i in range(spec.num_returns):
             oid = ObjectID.for_task_return(spec.task_id, i)
@@ -1434,6 +1530,9 @@ class CoreWorker:
                            is_async: bool = False, name: str = "",
                            namespace: str = "", lifetime: str = "",
                            runtime_env: Optional[dict] = None,
+                           concurrency_groups: Optional[dict] = None,
+                           execute_out_of_order: bool = False,
+                           method_options: Optional[dict] = None,
                            export: Optional[Any] = None, _prebuilt=None):
         """Synchronous actor creation: returns (actor_id, done_future).
 
@@ -1455,7 +1554,9 @@ class CoreWorker:
             max_restarts=max_restarts, max_task_retries=max_task_retries,
             max_concurrency=max_concurrency, is_async_actor=is_async,
             actor_name=name, namespace=namespace, lifetime=lifetime,
-            runtime_env=runtime_env,
+            runtime_env=runtime_env, concurrency_groups=concurrency_groups,
+            execute_out_of_order=execute_out_of_order,
+            method_options=method_options,
         )
         q = ActorSubmitQueue(actor_id, self.submission_lock)
         self.actor_queues[actor_id] = q
@@ -1502,6 +1603,8 @@ class CoreWorker:
                                 args: tuple, kwargs: dict,
                                 num_returns: int = 1,
                                 max_task_retries: int = 0,
+                                concurrency_group: str = "",
+                                is_generator: bool = False,
                                 _prebuilt=None) -> List[ObjectRef]:
         """Synchronous actor-task submission (core loop thread only).
 
@@ -1520,7 +1623,8 @@ class CoreWorker:
             args=[], num_returns=num_returns,
             owner_address=self.address, owner_worker_id=self.worker_id,
             actor_id=actor_id, method_name=method_name, seq_no=seq_no,
-            max_retries=max_task_retries,
+            max_retries=max_task_retries, concurrency_group=concurrency_group,
+            is_generator=is_generator,
         )
         q.inflight[seq_no] = spec
         refs, returns = [], []
@@ -1529,18 +1633,26 @@ class CoreWorker:
             self.owned[oid] = OwnedObject(object_id=oid)
             returns.append(oid)
             refs.append(ObjectRef(oid, self.address))
+        if is_generator:
+            self.generator_streams[task_id] = GeneratorStream(task_id,
+                                                              spec=spec)
         self.pending_tasks[task_id] = PendingTask(
             spec=spec, retries_left=max_task_retries, returns=returns,
             arg_refs=[])
         asyncio.ensure_future(
             self._finish_actor_task_submission(q, spec, args, kwargs,
                                                _prebuilt))
+        if is_generator:
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+            return [ObjectRefGenerator(task_id, self)]
         return refs
 
     def submit_actor_task_threadsafe(self, actor_id: ActorID,
                                      method_name: str, args: tuple,
                                      kwargs: dict, num_returns: int = 1,
-                                     max_task_retries: int = 0
+                                     max_task_retries: int = 0,
+                                     concurrency_group: str = "",
+                                     is_generator: bool = False
                                      ) -> List[ObjectRef]:
         """Non-blocking actor-task submission from a user (non-loop) thread.
 
@@ -1564,6 +1676,8 @@ class CoreWorker:
                 owner_address=self.address, owner_worker_id=self.worker_id,
                 actor_id=actor_id, method_name=method_name, seq_no=seq_no,
                 max_retries=max_task_retries,
+                concurrency_group=concurrency_group,
+                is_generator=is_generator,
             )
             q.inflight[seq_no] = spec
             refs: List[ObjectRef] = []
@@ -1573,12 +1687,18 @@ class CoreWorker:
                 self.owned[oid] = OwnedObject(object_id=oid)
                 returns.append(oid)
                 refs.append(ObjectRef(oid, self.address))
+            if is_generator:
+                self.generator_streams[task_id] = GeneratorStream(task_id,
+                                                                  spec=spec)
             self.pending_tasks[task_id] = PendingTask(
                 spec=spec, retries_left=max_task_retries, returns=returns,
                 arg_refs=[])
         self.loop.call_soon_threadsafe(
             self._post_threadsafe_actor_submit, q, spec, args, kwargs,
             prebuilt, new_q)
+        if is_generator:
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+            return [ObjectRefGenerator(task_id, self)]
         return refs
 
     def _post_threadsafe_actor_submit(self, q, spec, args, kwargs, prebuilt,
@@ -1777,6 +1897,9 @@ class CoreWorker:
                 self._cancelled_tasks.discard(spec.task_id)
                 return {"cancelled": True}
             loop = asyncio.get_running_loop()
+            if spec.is_generator:
+                return await self._execute_generator_task(spec, func, args,
+                                                          kwargs)
             if asyncio.iscoroutinefunction(func):
                 task = asyncio.ensure_future(func(*args, **kwargs))
                 self._running_tasks[spec.task_id] = task
@@ -1801,6 +1924,64 @@ class CoreWorker:
         finally:
             self._running_tasks.pop(spec.task_id, None)
             self.current_task_id = None
+
+    async def _execute_generator_task(self, spec: TaskSpec, func, args,
+                                      kwargs) -> dict:
+        """Streamed execution: each yielded value ships to the owner as its
+        own return object the moment it is produced (reference:
+        num_returns='streaming', task_manager.h ObjectRefStream)."""
+        import inspect as _inspect
+        loop = asyncio.get_running_loop()
+        index = 0
+        try:
+            owner = await self.clients.get(spec.owner_address)
+        except rpc.RpcError:
+            return {"system_error": "generator owner unreachable"}
+
+        async def emit(value, is_exception=False):
+            nonlocal index
+            r = self._serialize_return(value, is_exception)
+            if "__large__" in r:
+                ser = r.pop("__large__")
+                oid = ObjectID.for_task_return(spec.task_id, index)
+                meta = META_EXCEPTION if is_exception else b""
+                await self.store.put(oid.binary(), ser, metadata=meta,
+                                     owner_address=spec.owner_address)
+                r["stored"] = self.raylet_address
+            await owner.notify("generator_item", {
+                "task_id": spec.task_id, "index": index, "ret": r,
+                "exec_raylet": self.raylet_address})
+            index += 1
+
+        try:
+            if _inspect.isasyncgenfunction(func):
+                async for item in func(*args, **kwargs):
+                    await emit(item)
+            else:
+                gen = func(*args, **kwargs)
+                if not _inspect.isgenerator(gen):
+                    raise TypeError(
+                        f"num_returns='streaming' requires a generator "
+                        f"function, got {type(gen)} from {spec.name}")
+
+                def _next():
+                    try:
+                        return True, next(gen)
+                    except StopIteration:
+                        return False, None
+
+                while True:
+                    more, item = await loop.run_in_executor(self._exec_pool,
+                                                            _next)
+                    if not more:
+                        break
+                    await emit(item)
+        except Exception as e:  # noqa: BLE001
+            import os as _os
+            err = exc.TaskError(e, traceback.format_exc(), spec.task_id,
+                                _os.getpid())
+            await emit(err, is_exception=True)
+        return {"generator_done": index}
 
     @staticmethod
     def _split_returns(result: Any, num_returns: int) -> List[Any]:
@@ -1846,6 +2027,13 @@ class CoreWorker:
         }
         self.current_actor_id = spec.actor_id
         self._actor_semaphore = asyncio.Semaphore(max(1, spec.max_concurrency))
+        # Named concurrency groups: each gets an independent semaphore, so
+        # e.g. an "io" group keeps serving while "compute" is saturated
+        # (reference: concurrency_group_manager.h).
+        self._group_semaphores = {
+            name: asyncio.Semaphore(max(1, int(limit)))
+            for name, limit in (spec.concurrency_groups or {}).items()}
+        self._execute_out_of_order = spec.execute_out_of_order
         self._caller_next_seq = {}
         self._caller_buffer = {}
         return True
@@ -1854,6 +2042,12 @@ class CoreWorker:
         spec: TaskSpec = payload["spec"]
         if self.executing_actor is None:
             return {"system_error": "no actor instantiated on this worker"}
+        if getattr(self, "_execute_out_of_order", False):
+            # Out-of-order mode: no per-caller seq gating — tasks start as
+            # they arrive (reference: out_of_order_actor_scheduling_queue).
+            if spec.method_name == SEQ_SKIP_METHOD:
+                return {"returns": []}
+            return await self._execute_actor_task(spec)
         caller = spec.owner_worker_id.binary()
         next_seq = self._caller_next_seq.setdefault(caller, 0)
         if spec.seq_no > next_seq:
@@ -1877,11 +2071,18 @@ class CoreWorker:
         return await self._execute_actor_task(spec)
 
     async def _execute_actor_task(self, spec: TaskSpec) -> dict:
-        async with self._actor_semaphore:
+        sem = self._actor_semaphore
+        if spec.concurrency_group:
+            sem = getattr(self, "_group_semaphores", {}).get(
+                spec.concurrency_group, sem)
+        async with sem:
             self.current_task_id = spec.task_id
             try:
                 method = getattr(self.executing_actor, spec.method_name)
                 args, kwargs = await self._resolve_task_args(spec)
+                if spec.is_generator:
+                    return await self._execute_generator_task(
+                        spec, method, args, kwargs)
                 if asyncio.iscoroutinefunction(method):
                     task = asyncio.ensure_future(method(*args, **kwargs))
                     self._running_tasks[spec.task_id] = task

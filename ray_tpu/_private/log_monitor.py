@@ -98,10 +98,15 @@ class LogMonitor:
                     data = f.read(1 << 20)
             except OSError:
                 continue
-            # Only consume complete lines; partial tail stays for next scan.
+            # Only consume complete lines; partial tail stays for next scan
+            # — unless the read window is full (a single line >1 MiB with
+            # no newline would otherwise stall this file forever): consume
+            # the whole window as one truncated line.
             end = data.rfind(b"\n")
             if end < 0:
-                continue
+                if len(data) < (1 << 20):
+                    continue
+                end = len(data) - 1
             self._offsets[path] = offset + end + 1
             lines = [ln.decode("utf-8", "replace")[:MAX_LINE_LEN]
                      for ln in data[:end].split(b"\n")]

@@ -1,0 +1,98 @@
+"""Memory monitor + worker-killing policy (OOM defense).
+
+Reference: src/ray/common/memory_monitor.h (threshold polling of system
+memory) and src/ray/raylet/worker_killing_policy_group_by_owner.h (victim
+selection: group leased workers by submitting owner, kill the newest
+worker of the largest group, so one runaway map_batches does not take the
+whole node down). The raylet runs one monitor; the usage reader is
+injectable so tests can simulate pressure deterministically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def system_memory_usage_fraction() -> float:
+    """1 - MemAvailable/MemTotal from /proc/meminfo (Linux)."""
+    total = avail = None
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = float(line.split()[1])
+                elif line.startswith("MemAvailable:"):
+                    avail = float(line.split()[1])
+                if total is not None and avail is not None:
+                    break
+    except OSError:
+        return 0.0
+    if not total:
+        return 0.0
+    return 1.0 - (avail or 0.0) / total
+
+
+def process_rss_bytes(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * 4096
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def pick_victim(workers: List) -> Optional[object]:
+    """Group-by-owner policy over leased worker handles.
+
+    Expects objects with .leased, .is_actor_worker, .lease_owner,
+    .idle_since (last grant time), .pid. Returns the newest worker of the
+    owner with the most leased workers; task workers are preferred over
+    actor workers (actors lose state on kill).
+    """
+    leased = [w for w in workers if w.leased]
+    if not leased:
+        return None
+    for pool in ([w for w in leased if not w.is_actor_worker],
+                 [w for w in leased if w.is_actor_worker]):
+        if not pool:
+            continue
+        groups: dict = {}
+        for w in pool:
+            groups.setdefault(getattr(w, "lease_owner", ""), []).append(w)
+        biggest = max(groups.values(), key=len)
+        return max(biggest, key=lambda w: w.idle_since)
+    return None
+
+
+class MemoryMonitor:
+    def __init__(self, threshold: float, interval_s: float,
+                 on_pressure: Callable[[float], None],
+                 usage_reader: Optional[Callable[[], float]] = None):
+        self.threshold = threshold
+        self.interval_s = interval_s
+        self.on_pressure = on_pressure
+        self.usage_reader = usage_reader or system_memory_usage_fraction
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self):
+        self._task = asyncio.ensure_future(self._run())
+
+    def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _run(self):
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                usage = self.usage_reader()
+                if usage >= self.threshold:
+                    self.on_pressure(usage)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                logger.exception("memory monitor tick failed")

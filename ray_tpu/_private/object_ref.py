@@ -80,3 +80,62 @@ class ObjectRef:
         # Serialization of a bare ref outside the serializer context still
         # round-trips, but does not register a borrower.
         return (ObjectRef, (self.id, self.owner_address, True))
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's return refs
+    (num_returns="streaming"; reference: python/ray/_raylet.pyx
+    ObjectRefGenerator over task_manager.h ObjectRefStream).
+
+    Yields ObjectRefs as the executing generator produces items; works as a
+    sync iterator from user threads and an async iterator inside async
+    actors.
+    """
+
+    def __init__(self, task_id, core):
+        self._task_id = task_id
+        self._core = core
+        self._cursor = 0
+        self._exhausted = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        from ray_tpu._private import worker_api
+        ref = worker_api._call_on_core_loop(
+            self._core, self._core.generator_next(self._task_id,
+                                                  self._cursor), None)
+        if ref is None:
+            self._exhausted = True
+            raise StopIteration
+        self._cursor += 1
+        return ref
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        if self._exhausted:
+            raise StopAsyncIteration
+        ref = await self._core.generator_next(self._task_id, self._cursor)
+        if ref is None:
+            self._exhausted = True
+            raise StopAsyncIteration
+        self._cursor += 1
+        return ref
+
+    def __del__(self):
+        # Abandoned mid-stream: free owner-side stream state + unconsumed
+        # items so long-lived drivers don't leak (the stream entry is gone
+        # already if iteration completed).
+        if self._exhausted:
+            return
+        try:
+            core = self._core
+            core.loop.call_soon_threadsafe(core.release_generator,
+                                           self._task_id, self._cursor)
+        except Exception:
+            pass

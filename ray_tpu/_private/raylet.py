@@ -188,6 +188,8 @@ class WorkerHandle:
     # dedicated: it only serves tasks with the same env hash (reference:
     # worker_pool.h dedicated workers per runtime env).
     env_hash: str = ""
+    # Owner (submitter) of the current lease; OOM victim grouping key.
+    lease_owner: str = ""
 
 
 class ResourcePool:
@@ -327,13 +329,41 @@ class Raylet:
             self.session_dir, self.node_name, _publish_logs, pid_of=_pid_of,
             owns=lambda h: h in self._spawned_worker_prefixes)
         self.log_monitor.start()
+        # OOM defense (reference: memory_monitor.h + worker killing
+        # policies): above the threshold, kill the newest leased worker of
+        # the owner with the most leases.
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+        if self.config.memory_monitor_interval_s > 0:
+            self.memory_monitor = MemoryMonitor(
+                self.config.memory_usage_threshold,
+                self.config.memory_monitor_interval_s,
+                self._on_memory_pressure)
+            self.memory_monitor.start()
         logger.info("raylet %s started at %s", self.node_name, self.address)
         return self.address
+
+    def _on_memory_pressure(self, usage: float):
+        from ray_tpu._private.memory_monitor import pick_victim
+        victim = pick_victim(list(self.workers.values()))
+        if victim is None:
+            return
+        logger.warning(
+            "node memory usage %.0f%% above threshold; OOM-killing worker "
+            "pid=%s (owner %s) — the task will retry per its budget "
+            "(reference: task_oom_retries)", usage * 100, victim.pid,
+            victim.lease_owner)
+        try:
+            if victim.pid > 0:
+                os.kill(victim.pid, 9)
+        except OSError:
+            pass
 
     async def stop(self):
         self._stopped = True
         if getattr(self, "log_monitor", None) is not None:
             self.log_monitor.stop()
+        if getattr(self, "memory_monitor", None) is not None:
+            self.memory_monitor.stop()
         for t in self._tasks:
             t.cancel()
         for w in self.workers.values():
@@ -792,6 +822,7 @@ class Raylet:
             self.pool.acquire(spec.resources, pg_key)
             self._mark_resources_dirty()
             worker.leased = True
+            worker.lease_owner = spec.owner_address
             if spec.env_hash():
                 worker.env_hash = spec.env_hash()
             worker.lease_class = spec.scheduling_class()
@@ -895,6 +926,7 @@ class Raylet:
                 self.pool.release(spec.resources, pg_key)
                 raise RuntimeError("worker failed to start for actor")
         worker.leased = True
+        worker.lease_owner = spec.owner_address
         if spec.env_hash():
             worker.env_hash = spec.env_hash()
         worker.is_actor_worker = True

@@ -19,32 +19,43 @@ from ray_tpu.remote_function import _resolve_scheduling, _resources_from_options
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1, concurrency_group: str = ""):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def options(self, **opts) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name,
-                           opts.get("num_returns", self._num_returns))
+        return ActorMethod(
+            self._handle, self._name,
+            opts.get("num_returns", self._num_returns),
+            opts.get("concurrency_group", self._concurrency_group))
 
     def remote(self, *args, **kwargs):
         core = worker_api.get_core()
+        num_returns = self._num_returns
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 0
         if worker_api._on_core_loop(core):
             # Async-actor context: submission is synchronous bookkeeping +
             # deferred dispatch, legal on the loop thread.
             refs = core.submit_actor_task_local(
                 self._handle._actor_id, self._name, args, kwargs,
-                num_returns=self._num_returns,
-                max_task_retries=self._handle._max_task_retries)
+                num_returns=num_returns,
+                max_task_retries=self._handle._max_task_retries,
+                concurrency_group=self._concurrency_group,
+                is_generator=streaming)
         else:
             # User thread: reserve ids synchronously, dispatch fire-and-forget
             # (no blocking cross-thread round trip per call).
             refs = core.submit_actor_task_threadsafe(
                 self._handle._actor_id, self._name, args, kwargs,
-                num_returns=self._num_returns,
-                max_task_retries=self._handle._max_task_retries)
-        if self._num_returns == 1:
+                num_returns=num_returns,
+                max_task_retries=self._handle._max_task_retries,
+                concurrency_group=self._concurrency_group,
+                is_generator=streaming)
+        if num_returns == 1 or streaming:
             return refs[0]
         return refs
 
@@ -56,31 +67,44 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, method_names=None,
-                 max_task_retries: int = 0, class_name: str = ""):
+                 max_task_retries: int = 0, class_name: str = "",
+                 method_options: Optional[Dict[str, dict]] = None):
         self._actor_id = actor_id
         self._method_names = method_names or []
         self._max_task_retries = max_task_retries
         self._class_name = class_name
+        # Per-method defaults from the @ray_tpu.method decorator
+        # (num_returns, concurrency_group).
+        self._method_options = method_options or {}
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        mo = self._method_options.get(name, {})
+        return ActorMethod(self, name,
+                           num_returns=mo.get("num_returns", 1),
+                           concurrency_group=mo.get("concurrency_group", ""))
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
 
     def __reduce__(self):
         return (_rebuild_handle, (self._actor_id, self._method_names,
-                                  self._max_task_retries, self._class_name))
+                                  self._max_task_retries, self._class_name,
+                                  self._method_options))
 
     @classmethod
     def _from_actor_info(cls, info):
-        return cls(info.actor_id, class_name=info.class_name)
+        spec = getattr(info, "creation_spec", None)
+        return cls(info.actor_id, class_name=info.class_name,
+                   method_options=getattr(spec, "method_options", None)
+                   if spec is not None else None)
 
 
-def _rebuild_handle(actor_id, method_names, max_task_retries, class_name):
-    return ActorHandle(actor_id, method_names, max_task_retries, class_name)
+def _rebuild_handle(actor_id, method_names, max_task_retries, class_name,
+                    method_options=None):
+    return ActorHandle(actor_id, method_names, max_task_retries, class_name,
+                       method_options)
 
 
 class ActorClass:
@@ -167,6 +191,15 @@ class ActorClass:
         namespace = opts.get("namespace")
         if namespace is None:
             namespace = worker_api._state.namespace
+        # Concurrency groups: accept {name: limit} or the reference's list
+        # form [{"name": ..., "max_concurrency": ...}].
+        cgs = opts.get("concurrency_groups")
+        if isinstance(cgs, (list, tuple)):
+            cgs = {g["name"]: int(g["max_concurrency"]) for g in cgs}
+        method_options = {
+            n: dict(m.__ray_tpu_method_options__)
+            for n, m in inspect.getmembers(self._cls, inspect.isfunction)
+            if getattr(m, "__ray_tpu_method_options__", None)}
         create_kwargs = dict(
             class_name=self.__name__,
             resources=resources,
@@ -180,6 +213,10 @@ class ActorClass:
             lifetime=opts.get("lifetime", ""),
             runtime_env=worker_api.resolve_runtime_env(
                 opts.get("runtime_env")),
+            concurrency_groups=cgs,
+            execute_out_of_order=bool(opts.get("execute_out_of_order",
+                                               False)),
+            method_options=method_options,
         )
         if on_loop:
             actor_id, _done = core.create_actor_local(
@@ -191,4 +228,5 @@ class ActorClass:
                                                     inspect.isfunction)
                    if not n.startswith("__")]
         return ActorHandle(actor_id, methods,
-                           opts.get("max_task_retries", 0), self.__name__)
+                           opts.get("max_task_retries", 0), self.__name__,
+                           method_options)

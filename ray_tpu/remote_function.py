@@ -87,6 +87,9 @@ class RemoteFunction:
         core = worker_api.get_core()
         opts = self._options
         num_returns = opts.get("num_returns", 1)
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 0
         on_loop = worker_api._on_core_loop(core)
         export = None
         if on_loop:
@@ -103,8 +106,10 @@ class RemoteFunction:
             num_returns=num_returns,
             resources=_resources_from_options(opts),
             scheduling=_resolve_scheduling(opts),
-            max_retries=opts.get("max_retries", -1),
+            max_retries=(0 if streaming
+                         else opts.get("max_retries", -1)),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            is_generator=streaming,
             runtime_env=worker_api.resolve_runtime_env(
                 opts.get("runtime_env")),
         )
@@ -116,6 +121,6 @@ class RemoteFunction:
             # (no blocking cross-thread round trip per call).
             refs = core.submit_task_threadsafe(fid, args, kwargs,
                                                **submit_kwargs)
-        if num_returns == 1:
+        if num_returns == 1 or streaming:
             return refs[0]
         return refs

@@ -1,0 +1,132 @@
+"""Streaming generator tasks (num_returns="streaming").
+
+Reference: src/ray/core_worker/task_manager.h:98 ObjectRefStream (round-2
+VERDICT missing #7): each yielded value becomes its own return object,
+shipped to the owner the moment it is produced.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError
+
+
+def test_basic_streaming(ray_shared):
+    @ray_tpu.remote(num_returns="streaming")
+    def produce(n):
+        for i in range(n):
+            yield i * 10
+
+    gen = produce.remote(5)
+    assert isinstance(gen, ray_tpu.ObjectRefGenerator)
+    values = [ray_tpu.get(ref, timeout=30) for ref in gen]
+    assert values == [0, 10, 20, 30, 40]
+
+
+def test_items_stream_before_task_finishes(ray_shared):
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_produce():
+        yield "first"
+        time.sleep(1.5)
+        yield "second"
+
+    gen = slow_produce.remote()
+    t0 = time.time()
+    first = ray_tpu.get(next(gen), timeout=30)
+    first_latency = time.time() - t0
+    assert first == "first"
+    # The first item must arrive while the producer still sleeps.
+    assert first_latency < 1.2
+    assert ray_tpu.get(next(gen), timeout=30) == "second"
+    with pytest.raises(StopIteration):
+        next(gen)
+
+
+def test_large_items_via_store(ray_shared):
+    @ray_tpu.remote(num_returns="streaming")
+    def big(n):
+        for i in range(n):
+            yield np.full(300_000, i, dtype=np.float64)  # 2.4 MB each
+
+    total = 0.0
+    for i, ref in enumerate(big.remote(3)):
+        arr = ray_tpu.get(ref, timeout=60)
+        assert arr.shape == (300_000,) and float(arr[0]) == float(i)
+        total += float(arr[0])
+    assert total == 3.0
+
+
+def test_error_mid_stream(ray_shared):
+    @ray_tpu.remote(num_returns="streaming")
+    def flaky():
+        yield 1
+        yield 2
+        raise RuntimeError("boom at 3")
+
+    gen = flaky.remote()
+    assert ray_tpu.get(next(gen), timeout=30) == 1
+    assert ray_tpu.get(next(gen), timeout=30) == 2
+    with pytest.raises(TaskError, match="boom"):
+        ray_tpu.get(next(gen), timeout=30)
+    with pytest.raises(StopIteration):
+        next(gen)
+
+
+def test_non_generator_function_errors(ray_shared):
+    @ray_tpu.remote(num_returns="streaming")
+    def not_gen():
+        return 42
+
+    gen = not_gen.remote()
+    with pytest.raises(TaskError, match="generator"):
+        ray_tpu.get(next(gen), timeout=30)
+
+
+def test_actor_streaming_method(ray_shared):
+    @ray_tpu.remote
+    class Producer:
+        def stream(self, n):
+            for i in range(n):
+                yield i + 100
+
+    p = Producer.remote()
+    gen = p.stream.options(num_returns="streaming").remote(3)
+    assert [ray_tpu.get(r, timeout=30) for r in gen] == [100, 101, 102]
+    # Exhausted iterator stays exhausted (iterator protocol).
+    assert next(gen, "sentinel") == "sentinel"
+
+
+def test_abandoned_stream_releases_state(ray_shared):
+    from ray_tpu._private import worker_api
+
+    @ray_tpu.remote(num_returns="streaming")
+    def produce():
+        for i in range(5):
+            yield i
+
+    gen = produce.remote()
+    ray_tpu.get(next(gen), timeout=30)
+    task_id = gen._task_id
+    core = worker_api.get_core()
+    del gen   # abandoned mid-stream
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if task_id not in core.generator_streams:
+            return
+        time.sleep(0.1)
+    pytest.fail("abandoned generator stream never released")
+
+
+def test_async_generator_actorless(ray_shared):
+    @ray_tpu.remote(num_returns="streaming")
+    async def aproduce(n):
+        import asyncio
+        for i in range(n):
+            await asyncio.sleep(0.01)
+            yield i
+
+    values = [ray_tpu.get(r, timeout=30) for r in aproduce.remote(4)]
+    assert values == [0, 1, 2, 3]
