@@ -2019,6 +2019,18 @@ class CoreWorker:
             # instead of rescheduling forever.
             import traceback
             return {"app_error": traceback.format_exc()}
+        # Compiled-DAG hook: every actor can host a channel loop without
+        # the class opting in (reference: compiled_dag_node.py pins
+        # internal executables onto participating actors).
+        import types as _types
+        from ray_tpu.dag.compiled import _dag_loop_method
+        try:
+            instance.__ray_tpu_dag_loop__ = _types.MethodType(
+                _dag_loop_method, instance)
+        except Exception:  # noqa: BLE001
+            # __slots__ or validating __setattr__ (e.g. pydantic): the
+            # actor works normally, it just can't host compiled DAGs.
+            pass
         self.executing_actor = instance
         self.executing_actor_info = {
             "spec": spec, "max_concurrency": spec.max_concurrency,

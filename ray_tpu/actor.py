@@ -64,6 +64,11 @@ class ActorMethod:
             f"Actor method '{self._name}' cannot be called directly; use "
             f"'.{self._name}.remote()'.")
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node (reference: dag_node.py bind)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+        return ClassMethodNode(self, args, kwargs)
+
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, method_names=None,

@@ -1,0 +1,8 @@
+"""DAG / compiled-graph API (reference: python/ray/dag/)."""
+
+from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, FunctionNode,
+                                  InputNode, MultiOutputNode)
+from ray_tpu.dag.compiled import CompiledDAG
+
+__all__ = ["DAGNode", "InputNode", "FunctionNode", "ClassMethodNode",
+           "MultiOutputNode", "CompiledDAG"]

@@ -1,0 +1,230 @@
+"""Compiled DAGs: the graph lowered onto persistent executors + mutable
+shared-memory channels.
+
+Reference: python/ray/dag/compiled_dag_node.py:141 (CompiledDAG /
+CompiledTask). Instead of one task/actor RPC round trip per node per
+call (~1 ms each), compilation starts ONE long-running loop per executor
+that blocks on its input channels, runs its bound functions/methods, and
+writes output channels — execute() then costs one channel write + one
+read. All nodes bound to the same actor run inside a single loop (the
+reference runs an actor's compiled tasks on one executable loop too), so
+an actor is pinned by exactly one long-running task until teardown().
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, FunctionNode,
+                                  InputNode, MultiOutputNode)
+from ray_tpu.experimental.channel import Channel, ChannelClosedError
+
+
+class _DagError:
+    """Error marker shipped through a channel; re-raised at the consumer."""
+
+    def __init__(self, error: Exception):
+        self.error = error
+
+
+def _run_compiled_loop(fns: List, node_specs: List[tuple]):
+    """One executor loop driving one or more compiled nodes.
+
+    node_specs[i] = (in_channels, arg_template, kw_template, out_channel)
+    for fns[i], in topological order — intra-executor edges resolve
+    because the producer's channel was written earlier in the same pass.
+    pickle memoization can alias two in_channels entries to one attached
+    object; each distinct channel is read once per pass.
+    """
+    while True:
+        read_cache: Dict[int, Any] = {}
+        closed = False
+        for fn, (in_channels, arg_t, kw_t, out_channel) in zip(fns,
+                                                               node_specs):
+            if closed:
+                out_channel.close()
+                continue
+            values = []
+            try:
+                for ch in in_channels:
+                    if id(ch) not in read_cache:
+                        read_cache[id(ch)] = ch.read()
+                    values.append(read_cache[id(ch)])
+            except ChannelClosedError:
+                out_channel.close()
+                closed = True
+                continue
+            err = next((v for v in values if isinstance(v, _DagError)),
+                       None)
+            if err is not None:
+                out_channel.write(err)
+                read_cache[id(out_channel)] = err
+                continue
+            args = [values[i] if kind == "chan" else const
+                    for kind, i, const in arg_t]
+            kwargs = {key: (values[i] if kind == "chan" else const)
+                      for key, kind, i, const in kw_t}
+            try:
+                result = fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001
+                result = _DagError(e)
+            out_channel.write(result)
+            # Intra-executor consumers read the fresh value from cache
+            # (their reader cursor may lag the channel version).
+            read_cache[id(out_channel)] = result
+        if closed:
+            return "closed"
+
+
+def _dag_loop_method(self, method_names: List[str], node_specs: List[tuple]):
+    """Injected onto every actor instance (core_worker instantiation) so a
+    compiled DAG can pin a loop to a user actor without the class opting
+    in (reference: aDAG's internal actor executables)."""
+    return _run_compiled_loop([getattr(self, m) for m in method_names],
+                              node_specs)
+
+
+_EXECUTOR_OPTION_KEYS = ("num_cpus", "num_tpus", "num_gpus", "resources",
+                         "scheduling_strategy", "runtime_env")
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, max_message_size: int = 1 << 20):
+        self._root = root
+        self._max_size = max_message_size
+        self._nodes = root._topo()
+        self._input_channel = Channel(max_message_size)
+        self._channels: Dict[int, Channel] = {}
+        self._loop_refs: List[Any] = []
+        self._executor_actors: List[Any] = []
+        self._torn_down = False
+
+        multi = isinstance(root, MultiOutputNode)
+        compute_nodes: List[DAGNode] = []
+        for node in self._nodes:
+            if isinstance(node, InputNode):
+                self._channels[id(node)] = self._input_channel
+            elif isinstance(node, (FunctionNode, ClassMethodNode)):
+                self._channels[id(node)] = Channel(max_message_size)
+                compute_nodes.append(node)
+            elif isinstance(node, MultiOutputNode):
+                if node is not root:
+                    raise ValueError("MultiOutputNode must be the DAG root")
+            else:
+                raise TypeError(f"cannot compile node {node!r}")
+        if multi:
+            self._output_channels = [self._channels[id(o)]
+                                     for o in root._bound_args]
+        else:
+            self._output_channels = [self._channels[id(root)]]
+
+        # Group nodes into executors: one per FunctionNode, one per ACTOR
+        # (all of an actor's nodes share a single loop; separate loops
+        # would deadlock on the actor's concurrency slot).
+        actor_groups: Dict[Any, List[ClassMethodNode]] = {}
+        for node in compute_nodes:
+            spec = self._node_spec(node)
+            if isinstance(node, FunctionNode):
+                opts = {k: v for k, v in node._remote_fn._options.items()
+                        if k in _EXECUTOR_OPTION_KEYS}
+                executor = _executor_actor_class().options(
+                    max_concurrency=1, **opts).remote(
+                        node._remote_fn._function)
+                self._executor_actors.append(executor)
+                self._loop_refs.append(
+                    executor.run_loop.remote([spec]))
+            else:
+                handle = node._actor_method._handle
+                actor_groups.setdefault(handle._actor_id, (handle, []))
+                actor_groups[handle._actor_id][1].append(node)
+        for handle, nodes in actor_groups.values():
+            from ray_tpu.actor import ActorMethod
+            loop_method = ActorMethod(handle, "__ray_tpu_dag_loop__")
+            self._loop_refs.append(loop_method.remote(
+                [n._actor_method._name for n in nodes],
+                [self._node_spec(n) for n in nodes]))
+
+    def _node_spec(self, node: DAGNode) -> tuple:
+        in_channels: List[Channel] = []
+        arg_t: List[tuple] = []
+        kw_t: List[tuple] = []
+
+        def wire(value):
+            if isinstance(value, DAGNode):
+                in_channels.append(self._channels[id(value)])
+                return ("chan", len(in_channels) - 1, None)
+            return ("const", -1, value)
+
+        for a in node._bound_args:
+            arg_t.append(wire(a))
+        for k, v in node._bound_kwargs.items():
+            kind, i, const = wire(v)
+            kw_t.append((k, kind, i, const))
+        if not in_channels:
+            # Const-only node: the input channel is its trigger, else the
+            # loop would spin hot and never observe teardown.
+            in_channels.append(self._input_channel)
+        return (in_channels, arg_t, kw_t, self._channels[id(node)])
+
+    def execute(self, *args) -> Any:
+        """One synchronous pass through the pipeline: channel write + read."""
+        if self._torn_down:
+            raise RuntimeError("compiled DAG was torn down")
+        value = args[0] if len(args) == 1 else args
+        self._input_channel.write(value)
+        # Drain EVERY output before raising: an unread channel would hand
+        # this pass's value to the next execute() (stale-read hazard).
+        outs = [ch.read() for ch in self._output_channels]
+        err = next((o for o in outs if isinstance(o, _DagError)), None)
+        if err is not None:
+            raise err.error
+        return outs if len(outs) > 1 else outs[0]
+
+    def teardown(self):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        import ray_tpu
+        self._input_channel.close()
+        for ref in self._loop_refs:
+            try:
+                ray_tpu.get(ref, timeout=10)
+            except Exception:
+                pass
+        for a in self._executor_actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        for ch in self._channels.values():
+            ch.destroy()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
+
+_executor_cls = None
+
+
+def _executor_actor_class():
+    """Defers the @remote wrapping until first use (import order)."""
+    global _executor_cls
+    if _executor_cls is None:
+        import ray_tpu
+
+        @ray_tpu.remote
+        class _DAGExecutor:
+            """Hosts FunctionNode loops (reference: CompiledTask worker)."""
+
+            def __init__(self, fn):
+                self._fn = fn
+
+            def run_loop(self, node_specs):
+                return _run_compiled_loop([self._fn] * len(node_specs),
+                                          node_specs)
+
+        _executor_cls = _DAGExecutor
+    return _executor_cls

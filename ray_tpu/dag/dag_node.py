@@ -1,0 +1,139 @@
+"""Lazy DAG API: .bind() builds a graph, .execute() runs it.
+
+Reference: python/ray/dag/dag_node.py:25 (DAGNode / bind / execute),
+InputNode/MultiOutputNode per python/ray/dag/input_node.py,
+output_node.py. Execution lowers to ordinary task/actor submissions with
+ObjectRef wiring; experimental_compile() (compiled.py) lowers the same
+graph onto persistent actors + mutable channels instead.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+_input_node_tls = threading.local()
+
+
+class DAGNode:
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # -- traversal ----------------------------------------------------
+
+    def _deps(self) -> List["DAGNode"]:
+        out = []
+        for a in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                out.append(a)
+        return out
+
+    def _topo(self) -> List["DAGNode"]:
+        order: List[DAGNode] = []
+        seen = set()
+
+        def visit(node: "DAGNode"):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for dep in node._deps():
+                visit(dep)
+            order.append(node)
+
+        visit(self)
+        return order
+
+    # -- execution ----------------------------------------------------
+
+    def execute(self, *input_args, **input_kwargs):
+        """Run the DAG with ordinary task/actor calls; returns ObjectRef(s).
+
+        InputNode resolves to input_args[0] (or the full tuple when the
+        node was indexed)."""
+        results: Dict[int, Any] = {}
+        for node in self._topo():
+            results[id(node)] = node._execute_one(results, input_args,
+                                                  input_kwargs)
+        return results[id(self)]
+
+    def _resolve(self, value, results):
+        if isinstance(value, DAGNode):
+            return results[id(value)]
+        return value
+
+    def _execute_one(self, results, input_args, input_kwargs):
+        raise NotImplementedError
+
+    def experimental_compile(self, max_message_size: int = 1 << 20):
+        from ray_tpu.dag.compiled import CompiledDAG
+        return CompiledDAG(self, max_message_size)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the runtime input. Usable as a context manager for
+    parity with the reference (`with InputNode() as inp:`)."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_one(self, results, input_args, input_kwargs):
+        if input_kwargs:
+            # Reference semantics need InputAttributeNode for named access;
+            # silently mapping kwargs to () would corrupt downstream args.
+            raise ValueError(
+                "DAG inputs must be positional (dag.execute(x), not "
+                "dag.execute(x=...))")
+        if len(input_args) == 1:
+            return input_args[0]
+        return input_args
+
+    def __repr__(self):
+        return "InputNode()"
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_one(self, results, input_args, input_kwargs):
+        args = [self._resolve(a, results) for a in self._bound_args]
+        kwargs = {k: self._resolve(v, results)
+                  for k, v in self._bound_kwargs.items()}
+        return self._remote_fn.remote(*args, **kwargs)
+
+    def __repr__(self):
+        return f"FunctionNode({self._remote_fn.__name__})"
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, actor_method, args, kwargs):
+        super().__init__(args, kwargs)
+        self._actor_method = actor_method
+
+    def _execute_one(self, results, input_args, input_kwargs):
+        args = [self._resolve(a, results) for a in self._bound_args]
+        kwargs = {k: self._resolve(v, results)
+                  for k, v in self._bound_kwargs.items()}
+        return self._actor_method.remote(*args, **kwargs)
+
+    def __repr__(self):
+        return f"ClassMethodNode({self._actor_method._name})"
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})
+
+    def _execute_one(self, results, input_args, input_kwargs):
+        return [self._resolve(o, results) for o in self._bound_args]
+
+    def __repr__(self):
+        return f"MultiOutputNode({len(self._bound_args)})"

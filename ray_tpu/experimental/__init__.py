@@ -1,0 +1,5 @@
+"""Experimental APIs (reference: python/ray/experimental/)."""
+
+from ray_tpu.experimental.channel import Channel, ChannelClosedError
+
+__all__ = ["Channel", "ChannelClosedError"]

@@ -1,0 +1,128 @@
+"""Mutable shared-memory channels: the low-latency substrate for compiled
+DAGs (reference: python/ray/experimental/channel.py:49 over
+src/ray/core_worker/experimental_mutable_object_manager.h).
+
+A Channel is a fixed-capacity shared-memory segment that is REUSED for
+every message — no per-message allocation, sealing, or RPC. Writes bump a
+seqlock version header; readers spin (with microsleeps) until a new
+consistent version appears. Same-node process pairs see single-digit-µs
+hand-off, which is what Serve replica chains and MPMD pipeline stages need
+— the task/actor RPC path costs ~1ms per hop.
+
+Layout: [version u64][length u64][payload ...]. The version is odd while a
+write is in flight (seqlock), even when stable; readers re-check the
+version after copying to guard torn reads.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import time
+from multiprocessing import shared_memory
+from typing import Any, Optional
+
+_HEADER = struct.Struct("<QQ")
+HEADER_SIZE = _HEADER.size
+_CLOSED_TAG = b"__RAY_TPU_CHANNEL_CLOSED__"
+
+
+class ChannelClosedError(Exception):
+    pass
+
+
+class Channel:
+    def __init__(self, max_size: int = 1 << 20, *, _name: Optional[str] = None):
+        self.max_size = max_size
+        if _name is None:
+            self._shm = shared_memory.SharedMemory(
+                create=True, size=HEADER_SIZE + max_size)
+            self._buf = self._shm.buf
+            _HEADER.pack_into(self._buf, 0, 0, 0)
+            self._creator = True
+        else:
+            # Untracked attach: SharedMemory(name=...) would spawn a
+            # resource-tracker process per attaching worker, and (observed
+            # on this box) those trackers spin a full core after fork.
+            from ray_tpu._private.object_store import _attach_untracked
+            self._shm = _attach_untracked(_name)
+            self._buf = self._shm.buf
+            self._creator = False
+        self._last_read_version = 0
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    # -- writer side --------------------------------------------------
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        data = pickle.dumps(value, protocol=5)
+        self._write_bytes(data)
+
+    def _write_bytes(self, data: bytes) -> None:
+        if len(data) > self.max_size:
+            raise ValueError(
+                f"message of {len(data)} bytes exceeds channel capacity "
+                f"{self.max_size}; size channels for the largest message")
+        version, _ = _HEADER.unpack_from(self._buf, 0)
+        # Odd = write in flight (seqlock).
+        _HEADER.pack_into(self._buf, 0, version + 1, len(data))
+        self._buf[HEADER_SIZE:HEADER_SIZE + len(data)] = data
+        _HEADER.pack_into(self._buf, 0, version + 2, len(data))
+
+    def close(self) -> None:
+        """Wake readers with ChannelClosedError on their next read."""
+        try:
+            self._write_bytes(_CLOSED_TAG)
+        except Exception:
+            pass
+
+    # -- reader side --------------------------------------------------
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        """Block until a version newer than the last read; return value."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spin = 0
+        while True:
+            version, length = _HEADER.unpack_from(self._buf, 0)
+            if version % 2 == 0 and version > self._last_read_version:
+                payload = bytes(
+                    self._buf[HEADER_SIZE:HEADER_SIZE + length])
+                v2, _ = _HEADER.unpack_from(self._buf, 0)
+                if v2 == version:               # no torn read
+                    self._last_read_version = version
+                    if payload == _CLOSED_TAG:
+                        raise ChannelClosedError(self._shm.name)
+                    return pickle.loads(payload)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel read timed out ({timeout}s)")
+            # Micro-backoff: tight spin first (latency), 50 µs naps next,
+            # 2 ms naps once clearly idle (don't burn a core forever).
+            spin += 1
+            if spin > 20000:
+                time.sleep(2e-3)
+            elif spin > 200:
+                time.sleep(5e-5)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def destroy(self) -> None:
+        try:
+            self._shm.close()
+        except Exception:
+            pass
+        if self._creator:
+            try:
+                self._shm.unlink()
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        # Ships by segment name: the receiving process attaches to the
+        # same memory.
+        return (_attach_channel, (self._shm.name, self.max_size))
+
+
+def _attach_channel(name: str, max_size: int) -> "Channel":
+    return Channel(max_size, _name=name)

