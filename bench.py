@@ -36,21 +36,30 @@ def bench_core():
     a = Sink.remote()
     ray_tpu.get(a.ping.remote(), timeout=60)   # warm: actor up
 
+    def best_of(fn, reps=3):
+        # 1-vCPU box: single-shot numbers swing 2x with background noise;
+        # best-of-N is the stable statistic.
+        return max(fn() for _ in range(reps))
+
     # --- 1:1 async actor calls ---
-    n = 3000
-    t0 = time.perf_counter()
-    refs = [a.ping.remote() for _ in range(n)]
-    ray_tpu.get(refs)
-    dt = time.perf_counter() - t0
-    actor_calls_per_s = n / dt
+    def _actor_async():
+        n = 2000
+        t0 = time.perf_counter()
+        ray_tpu.get([a.ping.remote() for _ in range(n)])
+        return n / (time.perf_counter() - t0)
+
+    actor_calls_per_s = best_of(_actor_async)
     log(f"1_1_actor_calls_async: {actor_calls_per_s:,.0f}/s")
 
     # --- 1:1 sync actor calls ---
-    n = 300
-    t0 = time.perf_counter()
-    for _ in range(n):
-        ray_tpu.get(a.ping.remote())
-    sync_calls = n / (time.perf_counter() - t0)
+    def _actor_sync():
+        n = 300
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ray_tpu.get(a.ping.remote())
+        return n / (time.perf_counter() - t0)
+
+    sync_calls = best_of(_actor_sync)
     log(f"1_1_actor_calls_sync: {sync_calls:,.0f}/s")
 
     # --- single-client async tasks ---
@@ -59,10 +68,14 @@ def bench_core():
         return None
 
     ray_tpu.get(nop.remote(), timeout=60)  # warm lease+worker
-    n = 1000
-    t0 = time.perf_counter()
-    ray_tpu.get([nop.remote() for _ in range(n)])
-    tasks_per_s = n / (time.perf_counter() - t0)
+
+    def _tasks_async():
+        n = 1500
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(n)])
+        return n / (time.perf_counter() - t0)
+
+    tasks_per_s = best_of(_tasks_async)
     log(f"single_client_tasks_async: {tasks_per_s:,.0f}/s")
 
     # --- put/get calls + throughput ---
@@ -79,9 +92,13 @@ def bench_core():
     log(f"put_calls: {put_calls:,.0f}/s  get_calls: {get_calls:,.0f}/s")
 
     big = np.ones(32 * 1024 * 1024)  # 256 MB, zero-copy out-of-band path
-    t0 = time.perf_counter()
-    r = ray_tpu.put(big)
-    put_gbs = big.nbytes / (time.perf_counter() - t0) / 1e9
+
+    def _put_big():
+        t0 = time.perf_counter()
+        ray_tpu.put(big)
+        return big.nbytes / (time.perf_counter() - t0) / 1e9
+
+    put_gbs = best_of(_put_big)
     log(f"put_throughput: {put_gbs:.2f} GB/s")
 
     ray_tpu.shutdown()

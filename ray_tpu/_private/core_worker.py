@@ -191,7 +191,10 @@ class CoreWorker:
         self.gcs: Optional[rpc.Connection] = None
         self.raylet: Optional[rpc.Connection] = None
         self.store: Optional[ObjectStoreClient] = None
-        self.clients = rpc.ClientPool()
+        self.clients = rpc.ClientPool(push_handler=self._on_peer_push)
+        # In-flight batch pushes: task_id -> (spec, lease, raylet_address);
+        # replies stream back as server-pushes (batch_task_reply).
+        self._batch_reply_ctx: Dict[TaskID, tuple] = {}
         self.serialization = SerializationContext()
         self.serialization.deserialized_ref_factory = self._make_borrowed_ref
 
@@ -364,6 +367,7 @@ class CoreWorker:
     def _register_handlers(self):
         s = self.server
         s.register("push_task", self._rpc_push_task)
+        s.register("push_task_batch", self._rpc_push_task_batch)
         s.register("push_actor_task", self._rpc_push_actor_task)
         s.register("instantiate_actor", self._rpc_instantiate_actor)
         s.register("kill_actor", self._rpc_kill_actor)
@@ -1221,7 +1225,7 @@ class CoreWorker:
                 spec = queue.pop(0)
                 lease.inflight += 1
                 asyncio.ensure_future(
-                    self._run_on_lease(sched_class, lease, spec))
+                    self._run_on_lease(sched_class, lease, [spec]))
         if not queue:
             return
         inflight = self._lease_requests_inflight.get(sched_class, 0)
@@ -1231,16 +1235,27 @@ class CoreWorker:
                 self._lease_requests_inflight.get(sched_class, 0) + 1
             asyncio.ensure_future(self._acquire_lease(sched_class, queue[0]))
             inflight += 1
-        # Overflow beyond outstanding lease demand: pipeline onto live leases.
+        # Overflow beyond outstanding lease demand: pipeline onto live
+        # leases, a BATCH per push — one RPC round trip covers up to
+        # task_batch_size queued tasks (amortizes per-message cost the way
+        # lease reuse amortizes scheduling cost). Fairness bounds: a lease
+        # gets at most ONE outstanding batch (singles only while a batch
+        # is in flight) and never more than its fair share of the queue,
+        # so a burst cannot pin 10s of tasks behind one serial worker
+        # while other leases idle.
         overflow = len(queue) - inflight
+        max_batch = max(1, self.config.task_batch_size)
+        fair = -(-len(queue) // max(1, len(leases)))
         for lease in leases:
             while overflow > 0 and queue and not lease.returning \
                     and lease.inflight < depth:
-                spec = queue.pop(0)
+                take = 1 if lease.inflight > 0 else min(
+                    len(queue), overflow, max_batch, fair)
+                batch = [queue.pop(0) for _ in range(take)]
                 lease.inflight += 1
-                overflow -= 1
+                overflow -= take
                 asyncio.ensure_future(
-                    self._run_on_lease(sched_class, lease, spec))
+                    self._run_on_lease(sched_class, lease, batch))
 
     async def _acquire_lease(self, sched_class: tuple, sample_spec: TaskSpec):
         try:
@@ -1290,20 +1305,49 @@ class CoreWorker:
             self._complete_task_error(spec, error, retry=False)
 
     async def _run_on_lease(self, sched_class: tuple, lease: LeaseEntry,
-                            spec: TaskSpec):
-        self._record_task_event(spec, "RUNNING")
+                            specs: List[TaskSpec]):
+        """Push a batch of specs to one leased worker.
+
+        Each spec is its own push_task request so replies STREAM back as
+        tasks finish (no head-of-line reply blocking for long tasks); the
+        requests of a batch go out in the same loop tick, so the rpc
+        layer's write coalescing still collapses them into one syscall."""
+        for spec in specs:
+            self._record_task_event(spec, "RUNNING")
         try:
-            reply = await self.clients.request(
-                lease.worker_address, "push_task", {"spec": spec}, timeout=None)
+            if len(specs) == 1:
+                reply = await self.clients.request(
+                    lease.worker_address, "push_task", {"spec": specs[0]},
+                    timeout=None)
+                self._handle_task_reply(specs[0], reply,
+                                        lease.raylet_address)
+            else:
+                # One RPC for the batch; per-task replies STREAM back as
+                # server pushes (batch_task_reply -> _on_peer_push) the
+                # moment each task finishes, so a long batch has no
+                # head-of-line reply latency. The final RPC reply is just
+                # the completion barrier.
+                for spec in specs:
+                    self._batch_reply_ctx[spec.task_id] = (
+                        spec, lease.raylet_address)
+                await self.clients.request(
+                    lease.worker_address, "push_task_batch",
+                    {"specs": specs}, timeout=None)
         except rpc.RpcError:
-            # Worker died: release lease, maybe retry the task.
             lease.inflight -= 1
             self._drop_lease(sched_class, lease)
-            self._handle_task_worker_death(spec)
+            for spec in specs:
+                # Only tasks whose streamed reply never arrived died with
+                # the worker.
+                if self._batch_reply_ctx.pop(spec.task_id, None) is not None \
+                        or len(specs) == 1:
+                    self._handle_task_worker_death(spec)
             return
+        finally:
+            for spec in specs:
+                self._batch_reply_ctx.pop(spec.task_id, None)
         lease.inflight -= 1
         lease.last_used = time.time()
-        self._handle_task_reply(spec, reply, lease.raylet_address)
         queue = self._task_queue.get(sched_class, [])
         if queue:
             asyncio.ensure_future(self._pump_queue(sched_class))
@@ -1872,9 +1916,36 @@ class CoreWorker:
             out.append(r)
         return out
 
+    def _on_peer_push(self, method: str, payload):
+        """Pushes from peers this worker dialed (client-side connections)."""
+        if method == "batch_task_reply":
+            ctx = self._batch_reply_ctx.pop(payload["task_id"], None)
+            if ctx is not None:
+                spec, raylet_addr = ctx
+                self._handle_task_reply(spec, payload["reply"], raylet_addr)
+
     async def _rpc_push_task(self, conn, payload):
         async with self._task_exec_lock:  # pipelined pushes run one-by-one
             return await self._push_task_locked(payload)
+
+    async def _rpc_push_task_batch(self, conn, payload):
+        """Execute a batch sequentially, STREAMING each task's reply back
+        as a server-push the moment it completes; the RPC reply itself is
+        only the batch-completion barrier. Per-spec isolation: an escaping
+        system error fails that spec, not the batch."""
+        for spec in payload["specs"]:
+            try:
+                async with self._task_exec_lock:
+                    reply = await self._push_task_locked({"spec": spec})
+            except Exception as e:  # noqa: BLE001
+                reply = {"system_error": f"{type(e).__name__}: {e}"}
+            try:
+                await conn.push("batch_task_reply",
+                                {"task_id": spec.task_id, "reply": reply})
+            except Exception:  # noqa: BLE001
+                pass  # submitter gone; the barrier reply will fail too
+        return len(payload["specs"])
+
 
     async def _push_task_locked(self, payload):
         spec: TaskSpec = payload["spec"]

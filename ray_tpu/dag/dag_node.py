@@ -15,6 +15,15 @@ from typing import Any, Dict, List, Optional
 _input_node_tls = threading.local()
 
 
+class ImmediateValue:
+    """An already-materialized node result (workflow checkpoint replay)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
 class DAGNode:
     def __init__(self, args: tuple, kwargs: dict):
         self._bound_args = args
@@ -59,7 +68,13 @@ class DAGNode:
 
     def _resolve(self, value, results):
         if isinstance(value, DAGNode):
-            return results[id(value)]
+            out = results[id(value)]
+            # Workflow execution stores already-materialized checkpoint
+            # values wrapped in ImmediateValue (workflow/api.py); unwrap
+            # so they pass as plain arguments.
+            if isinstance(out, ImmediateValue):
+                return out.value
+            return out
         return value
 
     def _execute_one(self, results, input_args, input_kwargs):

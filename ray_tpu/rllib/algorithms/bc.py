@@ -1,0 +1,113 @@
+"""Behavior Cloning: supervised policy learning from offline data.
+
+Reference parity: rllib/algorithms/bc/bc.py (BC over the offline
+JsonReader pipeline — no environment interaction during training;
+evaluation rollouts are opt-in via evaluate()).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.models import policy_value_apply, policy_value_init
+from ray_tpu.rllib.offline import JsonReader
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or BC)
+        self.input_path = ""          # dir of JsonWriter output
+        self.train_batch_size = 256
+        self.num_env_runners = 0      # offline: no rollout actors
+
+    def offline_data(self, *, input_path=None) -> "BCConfig":
+        if input_path is not None:
+            self.input_path = input_path
+        return self
+
+
+class BC(Algorithm):
+    config_class = BCConfig
+
+    def setup(self, config: Dict[str, Any]):
+        cfg = self.algo_config
+        if not cfg.input_path:
+            raise ValueError("BC requires config.offline_data(input_path=...)")
+        self.env_runners = []
+        self._episode_rewards = []
+        self.reader = JsonReader(cfg.input_path, seed=cfg.seed)
+        self.data = self.reader.read_all()
+        self.build_learner()
+
+    def build_learner(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        cfg = self.algo_config
+        probe = make_env(cfg.env, cfg.env_config)
+        self.params = policy_value_init(
+            jax.random.PRNGKey(cfg.seed), probe.observation_dim,
+            probe.num_actions, hidden=cfg.hidden)
+        self._optimizer = optax.adam(cfg.lr)
+        self.opt_state = self._optimizer.init(self.params)
+
+        def loss_fn(params, obs, actions):
+            logits, _ = policy_value_apply(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            n = logits.shape[0]
+            return -logp[jnp.arange(n), actions].mean()
+
+        def update(params, opt_state, obs, actions):
+            loss, grads = jax.value_and_grad(loss_fn)(params, obs, actions)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._jit_update = jax.jit(update)
+        self._rng = np.random.RandomState(cfg.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        cfg = self.algo_config
+        n = len(self.data)
+        idx = self._rng.randint(0, n, size=min(cfg.train_batch_size, n))
+        obs = jnp.asarray(self.data[sb.OBS][idx])
+        actions = jnp.asarray(self.data[sb.ACTIONS][idx])
+        self.params, self.opt_state, loss = self._jit_update(
+            self.params, self.opt_state, obs, actions)
+        return {"loss": float(loss), "num_samples_trained": int(len(idx)),
+                "episode_reward_mean": float("nan")}
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
+        """Greedy rollouts with the cloned policy."""
+        import jax
+        cfg = self.algo_config
+        env = make_env(cfg.env, cfg.env_config)
+        fwd = jax.jit(policy_value_apply)
+        rewards = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=cfg.seed + ep)
+            total, done = 0.0, False
+            while not done:
+                logits, _ = fwd(self.params, obs[None, :])
+                a = int(np.argmax(np.asarray(logits)[0]))
+                obs, r, term, trunc, _ = env.step(a)
+                total += r
+                done = term or trunc
+            rewards.append(total)
+        return {"evaluation_reward_mean": float(np.mean(rewards))}
+
+    def save_checkpoint(self):
+        return {"params": self.params, "iteration": self._iteration}
+
+    def load_checkpoint(self, ckpt):
+        self.params = ckpt["params"]
+        self._iteration = ckpt.get("iteration", 0)
+
+    def cleanup(self):
+        pass

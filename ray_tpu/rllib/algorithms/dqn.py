@@ -1,0 +1,188 @@
+"""DQN: replay-buffer Q-learning with a target network and double-Q
+bootstrapping.
+
+Reference parity: rllib/algorithms/dqn/dqn.py (training_step: sample ->
+store -> replay -> TD update -> target sync) with optional prioritized
+replay (rllib/utils/replay_buffers/prioritized_replay_buffer.py). The
+policy MLP's action head doubles as the Q head (policy_value_init "pi"
+network); exploration is epsilon-greedy with linear decay.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.models import mlp_apply, policy_value_init
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DQN)
+        self.rollout_fragment_length = 32
+        self.replay_buffer_capacity = 50_000
+        self.learning_starts = 500
+        self.target_network_update_freq = 500   # in sampled env steps
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 5_000
+        self.double_q = True
+        self.prioritized_replay = False
+        self.train_batch_size = 64
+        self.updates_per_step = 4
+
+    def training(self, *, replay_buffer_capacity=None, learning_starts=None,
+                 target_network_update_freq=None, epsilon_start=None,
+                 epsilon_end=None, epsilon_decay_steps=None, double_q=None,
+                 prioritized_replay=None, updates_per_step=None,
+                 **kw) -> "DQNConfig":
+        super().training(**kw)
+        for name, val in (("replay_buffer_capacity", replay_buffer_capacity),
+                          ("learning_starts", learning_starts),
+                          ("target_network_update_freq",
+                           target_network_update_freq),
+                          ("epsilon_start", epsilon_start),
+                          ("epsilon_end", epsilon_end),
+                          ("epsilon_decay_steps", epsilon_decay_steps),
+                          ("double_q", double_q),
+                          ("prioritized_replay", prioritized_replay),
+                          ("updates_per_step", updates_per_step)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class DQNLearner:
+    def __init__(self, obs_dim: int, num_actions: int, *, hidden=(64, 64),
+                 lr=5e-4, gamma=0.99, double_q=True, seed=0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._optimizer = optax.adam(lr)
+        self.params = policy_value_init(jax.random.PRNGKey(seed), obs_dim,
+                                        num_actions, hidden=tuple(hidden))
+        self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
+        self.opt_state = self._optimizer.init(self.params)
+
+        def q_values(params, obs):
+            # Q head = the "pi" MLP without the small-logits scaling.
+            return mlp_apply(params["pi"], obs)
+
+        def loss_fn(params, target_params, batch, weights):
+            q = q_values(params, batch[sb.OBS])
+            n = q.shape[0]
+            q_taken = q[jnp.arange(n), batch[sb.ACTIONS]]
+            q_next_target = q_values(target_params, batch[sb.NEXT_OBS])
+            if double_q:
+                # Action chosen by the ONLINE net, valued by the target net.
+                a_next = jnp.argmax(q_values(params, batch[sb.NEXT_OBS]), -1)
+                v_next = q_next_target[jnp.arange(n), a_next]
+            else:
+                v_next = q_next_target.max(-1)
+            not_done = 1.0 - batch[sb.TERMINATEDS].astype(jnp.float32)
+            target = batch[sb.REWARDS] + gamma * not_done * v_next
+            td = q_taken - jax.lax.stop_gradient(target)
+            loss = (weights * td * td).mean()
+            return loss, jnp.abs(td)
+
+        def update(params, target_params, opt_state, batch, weights):
+            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, batch, weights)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        self._jit_update = jax.jit(update)
+
+    def update(self, batch: SampleBatch) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        jb = {k: jnp.asarray(batch[k]) for k in
+              (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.NEXT_OBS, sb.TERMINATEDS)}
+        weights = jnp.asarray(batch["weights"]) if "weights" in batch \
+            else jnp.ones(len(batch), jnp.float32)
+        self.params, self.opt_state, loss, td = self._jit_update(
+            self.params, self.target_params, self.opt_state, jb, weights)
+        return {"td_error": np.asarray(td), "loss": float(loss)}
+
+    def sync_target(self):
+        import jax
+        self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params):
+        self.params = params
+
+
+class DQN(Algorithm):
+    config_class = DQNConfig
+
+    def build_learner(self):
+        cfg = self.algo_config
+        probe = make_env(cfg.env, cfg.env_config)
+        self.learner = DQNLearner(
+            probe.observation_dim, probe.num_actions, hidden=cfg.hidden,
+            lr=cfg.lr, gamma=cfg.gamma, double_q=cfg.double_q, seed=cfg.seed)
+        buf_cls = (PrioritizedReplayBuffer if cfg.prioritized_replay
+                   else ReplayBuffer)
+        self.replay = buf_cls(cfg.replay_buffer_capacity, seed=cfg.seed)
+        self._steps_sampled = 0
+        self._last_target_sync = 0
+        self.broadcast_weights(self.learner.get_weights())
+
+    def _epsilon(self) -> float:
+        cfg = self.algo_config
+        frac = min(1.0, self._steps_sampled / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end
+                                           - cfg.epsilon_start)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        eps = self._epsilon()
+        batch = concat_samples(ray_tpu.get(
+            [er.sample_transitions.remote(cfg.rollout_fragment_length, eps)
+             for er in self.env_runners]))
+        self.replay.add(batch)
+        self._steps_sampled += len(batch)
+        metrics: Dict[str, Any] = {"epsilon": eps,
+                                   "replay_size": len(self.replay),
+                                   "num_env_steps_sampled": len(batch)}
+        if len(self.replay) >= cfg.learning_starts:
+            losses = []
+            for _ in range(cfg.updates_per_step):
+                replayed = self.replay.sample(cfg.train_batch_size)
+                m = self.learner.update(replayed)
+                if cfg.prioritized_replay and "batch_indexes" in replayed:
+                    self.replay.update_priorities(
+                        replayed["batch_indexes"], m["td_error"] + 1e-6)
+                losses.append(m["loss"])
+            metrics["loss"] = float(np.mean(losses))
+            self.broadcast_weights(self.learner.get_weights())
+        if (self._steps_sampled - self._last_target_sync
+                >= cfg.target_network_update_freq):
+            self.learner.sync_target()
+            self._last_target_sync = self._steps_sampled
+        return metrics
+
+    def save_checkpoint(self):
+        return {"params": self.learner.get_weights(),
+                "target": self.learner.target_params,
+                "steps": self._steps_sampled,
+                "iteration": self._iteration}
+
+    def load_checkpoint(self, ckpt):
+        self.learner.set_weights(ckpt["params"])
+        self.learner.target_params = ckpt["target"]
+        self._steps_sampled = ckpt.get("steps", 0)
+        self._iteration = ckpt.get("iteration", 0)
+        self.broadcast_weights(self.learner.get_weights())

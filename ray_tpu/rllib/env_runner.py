@@ -93,6 +93,37 @@ class EnvRunner:
             batches.append(compute_gae(b, last_v, gamma, lam))
         return sb.concat_samples(batches)
 
+    def sample_transitions(self, num_steps: int,
+                           epsilon: float = 0.0) -> SampleBatch:
+        """(obs, action, reward, next_obs, done) tuples with epsilon-greedy
+        over the policy head's scores — the value-based (DQN-family)
+        collection mode (reference: RolloutWorker with
+        EpsilonGreedy exploration)."""
+        cols = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS,
+                                sb.NEXT_OBS, sb.TERMINATEDS)}
+        for _t in range(num_steps):
+            obs_arr = np.stack(self._obs)
+            scores, _ = self._jit_forward(self._params, obs_arr)
+            scores = np.asarray(scores)
+            for i, env in enumerate(self._envs):
+                if self._rng.rand() < epsilon:
+                    a = self._rng.randint(scores.shape[-1])
+                else:
+                    a = int(np.argmax(scores[i]))
+                obs2, r, term, trunc, _ = env.step(a)
+                cols[sb.OBS].append(self._obs[i])
+                cols[sb.ACTIONS].append(a)
+                cols[sb.REWARDS].append(r)
+                cols[sb.NEXT_OBS].append(obs2)
+                cols[sb.TERMINATEDS].append(term)
+                self._ep_rewards[i] += r
+                if term or trunc:
+                    self._done_rewards.append(self._ep_rewards[i])
+                    self._ep_rewards[i] = 0.0
+                    obs2, _ = env.reset()
+                self._obs[i] = obs2
+        return SampleBatch({k: np.asarray(v) for k, v in cols.items()})
+
     def episode_rewards(self, clear: bool = True) -> List[float]:
         out = list(self._done_rewards)
         if clear:

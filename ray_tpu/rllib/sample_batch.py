@@ -108,3 +108,47 @@ def compute_gae(batch: SampleBatch, last_value: float, gamma: float,
     batch[ADVANTAGES] = adv
     batch[VALUE_TARGETS] = (adv + values).astype(np.float32)
     return batch
+
+
+class MultiAgentBatch:
+    """Per-policy SampleBatches plus the env-step count they came from.
+
+    Reference parity: rllib/policy/sample_batch.py:1338 (MultiAgentBatch).
+    `policy_batches` maps policy id -> SampleBatch; `env_steps` counts
+    environment steps (agents stepping simultaneously share one env step),
+    while agent_steps() sums per-agent transitions.
+    """
+
+    def __init__(self, policy_batches: dict, env_steps: int):
+        self.policy_batches = dict(policy_batches)
+        self.count = int(env_steps)
+
+    def env_steps(self) -> int:
+        return self.count
+
+    def agent_steps(self) -> int:
+        return sum(len(b) for b in self.policy_batches.values())
+
+    def __len__(self):
+        return self.count
+
+    @staticmethod
+    def wrap_as_needed(batch, env_steps: int) -> "MultiAgentBatch":
+        if isinstance(batch, MultiAgentBatch):
+            return batch
+        return MultiAgentBatch({"default_policy": batch}, env_steps)
+
+    @staticmethod
+    def concat_samples(batches: list) -> "MultiAgentBatch":
+        merged: dict = {}
+        steps = 0
+        for mb in batches:
+            steps += mb.env_steps()
+            for pid, b in mb.policy_batches.items():
+                merged.setdefault(pid, []).append(b)
+        return MultiAgentBatch(
+            {pid: concat_samples(bs) for pid, bs in merged.items()}, steps)
+
+    def __repr__(self):
+        sizes = {p: len(b) for p, b in self.policy_batches.items()}
+        return f"MultiAgentBatch(env_steps={self.count}, policies={sizes})"

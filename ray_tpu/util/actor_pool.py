@@ -1,0 +1,90 @@
+"""ActorPool: round-robin work distribution over a fixed set of actors.
+
+Reference parity: python/ray/util/actor_pool.py (ActorPool.map/
+map_unordered/submit/get_next).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        import ray_tpu
+        self._ray = ray_tpu
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    def submit(self, fn: Callable, value: Any):
+        """fn(actor, value) -> ObjectRef; runs when an actor frees up."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending_submits)
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in SUBMISSION order."""
+        if self._next_return_index >= self._next_task_index \
+                and not self._pending_submits:
+            raise StopIteration("no pending results")
+        if self._next_return_index not in self._index_to_future:
+            # Deferred submits with nothing in flight can never start.
+            raise RuntimeError(
+                "submissions are deferred but the pool has no actors to "
+                "run them")
+        ref = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        value = self._ray.get(ref, timeout=timeout)
+        self._return_actor(ref)
+        return value
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Next result in COMPLETION order."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        ready, _ = self._ray.wait(list(self._future_to_actor),
+                                  num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        idx, _actor = self._future_to_actor[ref]
+        self._index_to_future.pop(idx, None)
+        value = self._ray.get(ref)
+        self._return_actor(ref)
+        return value
+
+    def _return_actor(self, ref):
+        _idx, actor = self._future_to_actor.pop(ref)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            new_ref = fn(actor, value)
+            self._future_to_actor[new_ref] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = new_ref
+            self._next_task_index += 1
+        else:
+            self._idle.append(actor)
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self._next_return_index < self._next_task_index \
+                or self._pending_submits:
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()

@@ -1,0 +1,120 @@
+"""Chaos / fault-injection tooling for hardening tests.
+
+Reference parity: python/ray/_private/test_utils.py:1430-1561
+(ResourceKillerActor / NodeKillerActor / WorkerKillerActor) and
+python/ray/tests/test_chaos.py. These killers drive the fake cluster
+(cluster_utils.Cluster) from a background thread, injecting failures
+while a workload runs; the workload's task-retry / actor-restart /
+lineage-reconstruction machinery must absorb them.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import List, Optional
+
+
+class _KillerBase:
+    def __init__(self, interval_s: float, max_kills: int,
+                 seed: Optional[int] = None):
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self.kills: List[str] = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=type(self).__name__)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while (not self._stop.wait(self.interval_s)
+               and len(self.kills) < self.max_kills):
+            try:
+                self._kill_one()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _kill_one(self):
+        raise NotImplementedError
+
+    def stop(self) -> List[str]:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        return list(self.kills)
+
+
+class WorkerKiller(_KillerBase):
+    """SIGKILLs a random live worker process (reference:
+    WorkerKillerActor test_utils.py:1561). Tasks on that worker must
+    retry; actors must restart per max_restarts."""
+
+    def __init__(self, cluster, interval_s: float = 0.5,
+                 max_kills: int = 3, seed: Optional[int] = None):
+        super().__init__(interval_s, max_kills, seed)
+        self.cluster = cluster
+
+    def _kill_one(self):
+        candidates = []
+        for raylet in self.cluster.raylets:
+            for handle in raylet.workers.values():
+                if handle.pid > 0 and handle.registered:
+                    candidates.append(handle.pid)
+        if not candidates:
+            return
+        pid = self._rng.choice(candidates)
+        try:
+            os.kill(pid, signal.SIGKILL)
+            self.kills.append(f"worker:{pid}")
+        except OSError:
+            pass
+
+
+class NodeKiller(_KillerBase):
+    """Removes a random non-head raylet (reference: NodeKillerActor
+    test_utils.py:1498). Lineage reconstruction and actor failover must
+    absorb the loss."""
+
+    def __init__(self, cluster, interval_s: float = 1.0,
+                 max_kills: int = 1, seed: Optional[int] = None,
+                 respawn: bool = False):
+        super().__init__(interval_s, max_kills, seed)
+        self.cluster = cluster
+        self.respawn = respawn
+
+    def _kill_one(self):
+        victims = [r for r in self.cluster.raylets if not r.is_head]
+        if not victims:
+            return
+        raylet = self._rng.choice(victims)
+        resources = dict(raylet.pool.total)
+        self.cluster.remove_node(raylet)
+        self.kills.append(f"node:{raylet.node_name}")
+        if self.respawn:
+            time.sleep(0.2)
+            self.cluster.add_node(
+                num_cpus=resources.get("CPU", 1),
+                resources={k: v for k, v in resources.items()
+                           if k not in ("CPU", "memory",
+                                        "object_store_memory")})
+
+
+def run_with_chaos(workload, killers: List[_KillerBase]):
+    """Run `workload()` while killers fire; returns (result, kill_log)."""
+    for k in killers:
+        k.start()
+    try:
+        result = workload()
+    finally:
+        log = []
+        for k in killers:
+            log.extend(k.stop())
+    return result, log

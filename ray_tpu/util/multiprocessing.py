@@ -1,0 +1,138 @@
+"""multiprocessing.Pool drop-in over the task layer.
+
+Reference parity: python/ray/util/multiprocessing/pool.py (Pool with
+apply/apply_async/map/map_async/starmap/imap/imap_unordered over Ray
+tasks). Chunks of the iterable ship as single tasks to amortize per-task
+overhead, like the stdlib's chunksize.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+
+class AsyncResult:
+    def __init__(self, ray, refs: List[Any], single: bool = False):
+        self._ray = ray
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        chunks = self._ray.get(self._refs, timeout=timeout)
+        if self._single:
+            return chunks[0][0]
+        return [x for chunk in chunks for x in chunk]
+
+    def wait(self, timeout: Optional[float] = None):
+        self._ray.wait(self._refs, num_returns=len(self._refs),
+                       timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = self._ray.wait(self._refs, num_returns=len(self._refs),
+                                  timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        import ray_tpu
+        self._ray = ray_tpu
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._size = processes or int(
+            ray_tpu.cluster_resources().get("CPU", 2))
+        self._initializer = initializer
+        self._initargs = initargs
+        self._closed = False
+
+        @ray_tpu.remote
+        def _run_chunk(fn, chunk, star, init, init_args):
+            if init is not None:
+                init(*init_args)
+            if star:
+                return [fn(*args) for args in chunk]
+            return [fn(x) for x in chunk]
+
+        self._run_chunk = _run_chunk
+
+    # -- helpers -----------------------------------------------------
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._size * 4) or 1)
+        for i in range(0, len(items), chunksize):
+            yield items[i:i + chunksize]
+
+    def _submit(self, fn, chunks, star=False) -> List[Any]:
+        return [self._run_chunk.remote(fn, chunk, star, self._initializer,
+                                       self._initargs)
+                for chunk in chunks]
+
+    # -- Pool API ----------------------------------------------------
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: dict = None) -> AsyncResult:
+        kwds = kwds or {}
+        ref = self._run_chunk.remote(
+            lambda a: fn(*a, **kwds), [args], False, self._initializer,
+            self._initargs)
+        return AsyncResult(self._ray, [ref], single=True)
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        return AsyncResult(self._ray,
+                           self._submit(fn, self._chunks(iterable,
+                                                         chunksize)))
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        refs = self._submit(fn, self._chunks(iterable, chunksize),
+                            star=True)
+        return AsyncResult(self._ray, refs).get()
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        refs = self._submit(fn, self._chunks(iterable, chunksize))
+        for ref in refs:
+            yield from self._ray.get(ref)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        refs = self._submit(fn, self._chunks(iterable, chunksize))
+        pending = list(refs)
+        while pending:
+            ready, pending = self._ray.wait(pending, num_returns=1)
+            yield from self._ray.get(ready[0])
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

@@ -1,0 +1,108 @@
+"""Distributed FIFO queue backed by an async actor.
+
+Reference parity: python/ray/util/queue.py (Queue over _QueueActor —
+put/get with block/timeout, qsize/empty/full).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._q: asyncio.Queue = asyncio.Queue(maxsize)
+
+    async def put(self, item, timeout: Optional[float] = None):
+        try:
+            await asyncio.wait_for(self._q.put(item), timeout)
+        except asyncio.TimeoutError:
+            raise Full("queue full")
+        return True
+
+    def put_nowait(self, item):
+        try:
+            self._q.put_nowait(item)
+        except asyncio.QueueFull:
+            raise Full("queue full")
+        return True
+
+    async def get(self, timeout: Optional[float] = None):
+        try:
+            return await asyncio.wait_for(self._q.get(), timeout)
+        except asyncio.TimeoutError:
+            raise Empty("queue empty")
+
+    def get_nowait(self):
+        try:
+            return self._q.get_nowait()
+        except asyncio.QueueEmpty:
+            raise Empty("queue empty")
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def full(self) -> bool:
+        return self._q.full()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+        import ray_tpu
+        self._ray = ray_tpu
+        cls = ray_tpu.remote(**(actor_options or {}))(_QueueActor) \
+            if actor_options else ray_tpu.remote(_QueueActor)
+        self.actor = cls.remote(maxsize)
+
+    def _get(self, ref, timeout):
+        """get() that re-raises Empty/Full as themselves, not TaskError."""
+        from ray_tpu.exceptions import TaskError
+        try:
+            return self._ray.get(ref, timeout=timeout)
+        except TaskError as e:
+            if isinstance(e.cause, (Empty, Full)):
+                raise e.cause from None
+            raise
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None):
+        if not block:
+            return self._get(self.actor.put_nowait.remote(item), 30)
+        return self._get(self.actor.put.remote(item, timeout),
+                         None if timeout is None else timeout + 30)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        if not block:
+            return self._get(self.actor.get_nowait.remote(), 30)
+        return self._get(self.actor.get.remote(timeout),
+                         None if timeout is None else timeout + 30)
+
+    def put_async(self, item: Any):
+        return self.actor.put.remote(item, None)
+
+    def get_async(self):
+        return self.actor.get.remote(None)
+
+    def qsize(self) -> int:
+        return self._ray.get(self.actor.qsize.remote(), timeout=30)
+
+    def empty(self) -> bool:
+        return self._ray.get(self.actor.empty.remote(), timeout=30)
+
+    def full(self) -> bool:
+        return self._ray.get(self.actor.full.remote(), timeout=30)
+
+    def shutdown(self):
+        self._ray.kill(self.actor)

@@ -1,0 +1,16 @@
+"""Workflow: durable, checkpointed DAG execution.
+
+Reference parity: python/ray/workflow/api.py:123 (workflow.run over a
+DAG built with .bind()) + workflow_executor.py:32 (step-wise execution
+with per-step checkpointing so a crashed workflow resumes where it
+stopped). Storage here is a filesystem directory (works on NFS/GCS-fuse
+for multi-node); each step's result is pickled under a content-derived
+step id, and resume() replays only the missing steps.
+"""
+
+from ray_tpu.workflow.api import (WorkflowStatus, delete, get_output,
+                                  get_status, list_all, resume, run,
+                                  run_async)
+
+__all__ = ["run", "run_async", "resume", "get_output", "get_status",
+           "list_all", "delete", "WorkflowStatus"]

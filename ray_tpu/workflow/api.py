@@ -1,0 +1,232 @@
+"""Workflow execution engine (see package docstring).
+
+Design: a workflow is a DAG (ray_tpu.dag nodes) executed step-by-step.
+Every step's result checkpoints to
+    <storage>/<workflow_id>/steps/<step_id>.pkl
+before its consumers run; metadata.json tracks status. step ids hash the
+node's position in the graph (function name + arg structure), so resume()
+of the same DAG skips completed steps even across processes.
+
+Reference: python/ray/workflow/api.py:123, workflow_executor.py:32,
+workflow_storage.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, FunctionNode,
+                                  ImmediateValue, InputNode, MultiOutputNode)
+
+_DEFAULT_STORAGE = os.path.join(tempfile.gettempdir(), "ray_tpu_workflows")
+
+
+class WorkflowStatus:
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    RESUMABLE = "RESUMABLE"
+
+
+def _storage_root(storage: Optional[str]) -> str:
+    root = storage or os.environ.get("RAY_TPU_WORKFLOW_STORAGE",
+                                     _DEFAULT_STORAGE)
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def _hash_arg(a, memo, used) -> str:
+    if isinstance(a, DAGNode):
+        return _step_id(a, memo, used)
+    try:
+        return hashlib.sha1(pickle.dumps(a)).hexdigest()[:8]
+    except Exception:
+        return repr(a)[:32]
+
+
+def _step_id(node: DAGNode, memo: Dict[int, str],
+             used: Optional[Dict[str, int]] = None) -> str:
+    """Deterministic id from the node's function + argument structure.
+
+    `used` disambiguates structurally-identical sibling nodes (e.g. two
+    independent roll_dice.bind() calls): each occurrence past the first
+    gets a #n suffix, keyed by traversal order — which is stable across
+    runs of the same DAG, so resume still matches checkpoints."""
+    if id(node) in memo:
+        return memo[id(node)]
+    used = used if used is not None else {}
+    parts: List[str] = [type(node).__name__]
+    if isinstance(node, FunctionNode):
+        parts.append(getattr(node._remote_fn, "__name__", "fn"))
+    elif isinstance(node, ClassMethodNode):
+        parts.append(node._actor_method._name)
+    for a in node._bound_args:
+        parts.append(_hash_arg(a, memo, used))
+    for key, val in sorted(node._bound_kwargs.items()):
+        parts.append(f"k:{key}={_hash_arg(val, memo, used)}")
+    sid = hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
+    n = used.get(sid, 0)
+    used[sid] = n + 1
+    if n:
+        sid = f"{sid}#{n}"
+    memo[id(node)] = sid
+    return sid
+
+
+class _WorkflowRun:
+    def __init__(self, workflow_id: str, storage: Optional[str]):
+        self.workflow_id = workflow_id
+        self.dir = os.path.join(_storage_root(storage), workflow_id)
+        self.steps_dir = os.path.join(self.dir, "steps")
+        os.makedirs(self.steps_dir, exist_ok=True)
+
+    # -- metadata ----------------------------------------------------
+
+    def _meta_path(self) -> str:
+        return os.path.join(self.dir, "metadata.json")
+
+    def write_meta(self, **kw):
+        meta = self.read_meta()
+        meta.update(kw)
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, self._meta_path())
+
+    def read_meta(self) -> dict:
+        try:
+            with open(self._meta_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    # -- step checkpoints --------------------------------------------
+
+    def step_path(self, sid: str) -> str:
+        return os.path.join(self.steps_dir, f"{sid}.pkl")
+
+    def has_step(self, sid: str) -> bool:
+        return os.path.exists(self.step_path(sid))
+
+    def load_step(self, sid: str) -> Any:
+        with open(self.step_path(sid), "rb") as f:
+            return pickle.load(f)
+
+    def save_step(self, sid: str, value: Any):
+        tmp = self.step_path(sid) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, self.step_path(sid))
+
+    # -- execution ---------------------------------------------------
+
+    def execute(self, dag: DAGNode, args: tuple) -> Any:
+        """Step-wise execution with per-step checkpoint + skip."""
+        import ray_tpu
+        memo: Dict[int, str] = {}
+        used: Dict[str, int] = {}
+        results: Dict[int, Any] = {}
+        self.write_meta(status=WorkflowStatus.RUNNING,
+                        start_time=time.time())
+        try:
+            for node in dag._topo():
+                sid = _step_id(node, memo, used)
+                if isinstance(node, InputNode):
+                    results[id(node)] = (args[0] if len(args) == 1
+                                         else args)
+                    continue
+                if isinstance(node, MultiOutputNode):
+                    results[id(node)] = [results[id(o)]
+                                         for o in node._bound_args]
+                    continue
+                if self.has_step(sid):
+                    results[id(node)] = self.load_step(sid)
+                    continue
+                ref = node._execute_one(
+                    {k: ImmediateValue(v) for k, v in results.items()},
+                    args, {})
+                value = ray_tpu.get(ref, timeout=3600)
+                self.save_step(sid, value)
+                results[id(node)] = value
+            out = results[id(dag)]
+            self.save_step("__output__", out)
+            self.write_meta(status=WorkflowStatus.SUCCESSFUL,
+                            end_time=time.time())
+            return out
+        except Exception as e:  # noqa: BLE001
+            self.write_meta(status=WorkflowStatus.FAILED, error=repr(e),
+                            end_time=time.time())
+            raise
+
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+        storage: Optional[str] = None) -> Any:
+    """Execute a DAG durably; returns the final result."""
+    workflow_id = workflow_id or f"wf-{int(time.time()*1e3):x}"
+    wf = _WorkflowRun(workflow_id, storage)
+    wf.write_meta(workflow_id=workflow_id)
+    return wf.execute(dag, args)
+
+
+def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+              storage: Optional[str] = None):
+    """Run in a background task; returns an ObjectRef to the result."""
+    import cloudpickle
+
+    import ray_tpu
+
+    # cloudpickle: the DAG closes over locally-defined remote functions.
+    blob = cloudpickle.dumps((dag, args))
+
+    @ray_tpu.remote
+    def _driver(blob, workflow_id, storage):
+        import cloudpickle as cp
+        dag_, args_ = cp.loads(blob)
+        return run(dag_, *args_, workflow_id=workflow_id, storage=storage)
+
+    return _driver.remote(blob, workflow_id, storage)
+
+
+def resume(workflow_id: str, dag: DAGNode, *args,
+           storage: Optional[str] = None) -> Any:
+    """Re-run a workflow: completed steps load from their checkpoints."""
+    wf = _WorkflowRun(workflow_id, storage)
+    return wf.execute(dag, args)
+
+
+def get_output(workflow_id: str, storage: Optional[str] = None) -> Any:
+    wf = _WorkflowRun(workflow_id, storage)
+    if not wf.has_step("__output__"):
+        raise ValueError(f"workflow {workflow_id!r} has no stored output")
+    return wf.load_step("__output__")
+
+
+def get_status(workflow_id: str, storage: Optional[str] = None) -> str:
+    wf = _WorkflowRun(workflow_id, storage)
+    status = wf.read_meta().get("status")
+    if status == WorkflowStatus.RUNNING:
+        return status
+    if status == WorkflowStatus.FAILED:
+        return WorkflowStatus.RESUMABLE
+    return status or WorkflowStatus.RESUMABLE
+
+
+def list_all(storage: Optional[str] = None) -> List[tuple]:
+    root = _storage_root(storage)
+    out = []
+    for wid in sorted(os.listdir(root)):
+        if os.path.isdir(os.path.join(root, wid)):
+            out.append((wid, get_status(wid, storage)))
+    return out
+
+
+def delete(workflow_id: str, storage: Optional[str] = None):
+    import shutil
+    shutil.rmtree(os.path.join(_storage_root(storage), workflow_id),
+                  ignore_errors=True)

@@ -10,6 +10,11 @@ import sys
 # Must happen before jax initializes a backend anywhere in the test process.
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
+# WORKER processes inherit this env: without it they run jax on the axon
+# platform (the real TPU tunnel) — learner actors then compile on the
+# tunnel, which is slow at best and hangs every test if the tunnel is
+# down. The driver process itself is forced to cpu below.
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["RAY_TPU_HEARTBEAT_INTERVAL_S"] = "0.2"
 os.environ["RAY_TPU_NODE_DEATH_TIMEOUT_S"] = "2.0"
 

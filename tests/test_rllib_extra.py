@@ -1,0 +1,132 @@
+"""RLlib breadth: DQN, APPO, offline (JsonWriter/Reader + BC),
+multi-agent batch (round-2 VERDICT missing #8). Budgets kept tight for CI.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ray_rl():
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_dqn_learns_cartpole(ray_rl, jax_cpu):
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (DQNConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, rollout_fragment_length=64)
+            .training(lr=1e-3, learning_starts=256,
+                      epsilon_decay_steps=1_500,
+                      target_network_update_freq=256, updates_per_step=12)
+            .debugging(seed=0)
+            .build())
+    try:
+        first = None
+        best = -np.inf
+        for i in range(40):
+            result = algo.step()
+            r = result.get("episode_reward_mean")
+            if r == r:  # not NaN
+                if first is None:
+                    first = r
+                best = max(best, r)
+            if best > 60:
+                break
+        assert first is not None
+        assert best > max(30.0, first), (first, best)
+    finally:
+        algo.cleanup()
+
+
+def test_dqn_prioritized_replay_smoke(ray_rl, jax_cpu):
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (DQNConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=1, rollout_fragment_length=64)
+            .training(prioritized_replay=True, learning_starts=64,
+                      updates_per_step=2)
+            .build())
+    try:
+        m = None
+        for _ in range(4):
+            m = algo.step()
+        assert m["replay_size"] > 0 and "loss" in m
+    finally:
+        algo.cleanup()
+
+
+def test_appo_runs_async(ray_rl, jax_cpu):
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (APPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, rollout_fragment_length=100)
+            .training(num_batches_per_step=2, target_update_frequency=2)
+            .build())
+    try:
+        total = 0
+        for _ in range(3):
+            m = algo.step()
+            total += m["num_env_steps_sampled"]
+        assert total > 0
+    finally:
+        algo.cleanup()
+
+
+def test_offline_roundtrip_and_bc(ray_rl, jax_cpu, tmp_path):
+    """Collect expert-ish data with PPO's runner, clone it with BC."""
+    from ray_tpu.rllib import (BCConfig, JsonReader, JsonWriter, PPOConfig,
+                               SampleBatch)
+    from ray_tpu.rllib import sample_batch as sb
+
+    # Scripted 'expert': a decent CartPole heuristic (push toward pole).
+    from ray_tpu.rllib.env import make_env
+    env = make_env("CartPole-v1", {})
+    writer = JsonWriter(str(tmp_path / "data"))
+    for ep in range(12):
+        obs, _ = env.reset(seed=ep)
+        rows = {k: [] for k in (sb.OBS, sb.ACTIONS)}
+        done = False
+        while not done:
+            a = 1 if obs[2] + 0.5 * obs[3] > 0 else 0
+            rows[sb.OBS].append(obs)
+            rows[sb.ACTIONS].append(a)
+            obs, r, term, trunc, _ = env.step(a)
+            done = term or trunc
+        writer.write(SampleBatch({k: np.asarray(v)
+                                  for k, v in rows.items()}))
+    writer.close()
+
+    reader = JsonReader(str(tmp_path / "data"))
+    all_data = reader.read_all()
+    assert len(all_data) > 200   # heuristic survives a while
+
+    algo = (BCConfig()
+            .environment("CartPole-v1")
+            .offline_data(input_path=str(tmp_path / "data"))
+            .training(lr=3e-2)
+            .build())
+    losses = [algo.step()["loss"] for _ in range(150)]
+    assert np.mean(losses[-10:]) < losses[0] * 0.5  # imitation loss drops
+    ev = algo.evaluate(num_episodes=3)
+    assert ev["evaluation_reward_mean"] > 50   # clone of a decent policy
+
+
+def test_multi_agent_batch():
+    from ray_tpu.rllib import MultiAgentBatch, SampleBatch
+
+    b1 = SampleBatch({"obs": np.zeros((4, 2)), "actions": np.zeros(4)})
+    b2 = SampleBatch({"obs": np.ones((6, 2)), "actions": np.ones(6)})
+    ma = MultiAgentBatch({"p1": b1, "p2": b2}, env_steps=6)
+    assert ma.env_steps() == 6 and ma.agent_steps() == 10
+    merged = MultiAgentBatch.concat_samples([ma, ma])
+    assert merged.env_steps() == 12
+    assert len(merged.policy_batches["p1"]) == 8
+    wrapped = MultiAgentBatch.wrap_as_needed(b1, 4)
+    assert wrapped.policy_batches["default_policy"] is b1

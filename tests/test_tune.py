@@ -72,7 +72,13 @@ def test_class_trainable_and_stop_criteria(ray_mod):
 
 def test_asha_stops_bad_trials(ray_mod):
     def train_fn(config):
+        import time as _time
         for i in range(16):
+            # Pace iterations so the 4 trials genuinely overlap even when
+            # the host is loaded: ASHA can only cut a trial that is still
+            # running when a better cohort reaches the rung (sequential
+            # ascending-quality trials are legitimately never cut).
+            _time.sleep(0.05)
             tune.report({"score": config["q"] * (i + 1)})
 
     sched = tune.ASHAScheduler(grace_period=2, reduction_factor=2, max_t=16)

@@ -1,0 +1,181 @@
+"""Workflow durable execution, ecosystem shims (Pool/Queue/ActorPool),
+and chaos tooling (round-2 VERDICT missing #9/#10)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+# ---------------------------------------------------------------- workflow
+
+class TestWorkflow:
+    def test_run_and_output(self, ray_shared, tmp_path):
+        from ray_tpu import workflow
+
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def add(x, y):
+            return x + y
+
+        with InputNode() as inp:
+            dag = add.bind(double.bind(inp), 5)
+        out = workflow.run(dag, 10, workflow_id="wf-basic",
+                           storage=str(tmp_path))
+        assert out == 25
+        assert workflow.get_output("wf-basic", storage=str(tmp_path)) == 25
+        assert workflow.get_status("wf-basic", storage=str(tmp_path)) \
+            == workflow.WorkflowStatus.SUCCESSFUL
+        assert ("wf-basic",
+                workflow.WorkflowStatus.SUCCESSFUL) in \
+            workflow.list_all(storage=str(tmp_path))
+
+    def test_resume_skips_completed_steps(self, ray_shared, tmp_path):
+        from ray_tpu import workflow
+
+        calls = {"n": 0}
+
+        @ray_tpu.remote
+        def expensive(x):
+            import os
+            # Count executions via a file (task runs in another process).
+            marker = x["marker"]
+            with open(marker, "a") as f:
+                f.write("x")
+            return x["value"] * 10
+
+        @ray_tpu.remote
+        def flaky(x, fail_marker):
+            import os
+            if not os.path.exists(fail_marker):
+                open(fail_marker, "w").close()
+                raise RuntimeError("first attempt fails")
+            return x + 1
+
+        marker = str(tmp_path / "exec_count")
+        fail_marker = str(tmp_path / "failed_once")
+        with InputNode() as inp:
+            dag = flaky.bind(expensive.bind(inp), fail_marker)
+
+        arg = {"marker": marker, "value": 4}
+        with pytest.raises(Exception):
+            workflow.run(dag, arg, workflow_id="wf-resume",
+                         storage=str(tmp_path))
+        assert workflow.get_status("wf-resume", storage=str(tmp_path)) \
+            == workflow.WorkflowStatus.RESUMABLE
+        # Resume: the expensive step replays from its checkpoint.
+        out = workflow.resume("wf-resume", dag, arg, storage=str(tmp_path))
+        assert out == 41
+        with open(marker) as f:
+            assert f.read() == "x"   # expensive ran exactly once
+
+    def test_run_async(self, ray_shared, tmp_path):
+        from ray_tpu import workflow
+
+        @ray_tpu.remote
+        def inc(x):
+            return x + 1
+
+        with InputNode() as inp:
+            dag = inc.bind(inp)
+        ref = workflow.run_async(dag, 7, workflow_id="wf-async",
+                                 storage=str(tmp_path))
+        assert ray_tpu.get(ref, timeout=60) == 8
+
+
+# ---------------------------------------------------------------- shims
+
+class TestPool:
+    def test_map_and_starmap(self, ray_shared):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as p:
+            assert p.map(lambda x: x * x, range(10)) == \
+                [x * x for x in range(10)]
+            assert p.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+            assert p.apply(lambda a, b: a * b, (6, 7)) == 42
+
+    def test_imap_unordered(self, ray_shared):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as p:
+            out = sorted(p.imap_unordered(lambda x: x + 1, range(8),
+                                          chunksize=2))
+            assert out == list(range(1, 9))
+
+
+class TestQueue:
+    def test_fifo_and_timeout(self, ray_shared):
+        from ray_tpu.util.queue import Empty, Queue
+
+        q = Queue(maxsize=4)
+        q.put("a")
+        q.put("b")
+        assert q.qsize() == 2
+        assert q.get() == "a"
+        assert q.get() == "b"
+        with pytest.raises(Empty):
+            q.get(block=False)
+        q.shutdown()
+
+    def test_cross_actor_handoff(self, ray_shared):
+        from ray_tpu.util.queue import Queue
+
+        q = Queue()
+
+        @ray_tpu.remote
+        def producer(q, n):
+            for i in range(n):
+                q.put(i)
+            return "done"
+
+        ref = producer.remote(q, 5)
+        got = [q.get(timeout=30) for _ in range(5)]
+        assert got == list(range(5))
+        assert ray_tpu.get(ref, timeout=30) == "done"
+        q.shutdown()
+
+
+class TestActorPool:
+    def test_map_ordered_and_unordered(self, ray_shared):
+        from ray_tpu.util.actor_pool import ActorPool
+
+        @ray_tpu.remote
+        class Worker:
+            def mul(self, x):
+                return x * 3
+
+        pool = ActorPool([Worker.remote() for _ in range(2)])
+        out = list(pool.map(lambda a, v: a.mul.remote(v), range(6)))
+        assert out == [x * 3 for x in range(6)]
+        out2 = sorted(pool.map_unordered(lambda a, v: a.mul.remote(v),
+                                         range(6)))
+        assert out2 == sorted(x * 3 for x in range(6))
+
+
+# ---------------------------------------------------------------- chaos
+
+def test_chaos_worker_killer_workload_survives(ray_cluster):
+    """Tasks with retries complete despite a worker killer firing."""
+    ray_cluster.add_node(num_cpus=2)
+    ray_cluster.connect()
+    from ray_tpu.util.chaos import WorkerKiller, run_with_chaos
+
+    @ray_tpu.remote(max_retries=8)
+    def chunk(i):
+        time.sleep(0.3)
+        return i
+
+    def workload():
+        return sum(ray_tpu.get([chunk.remote(i) for i in range(60)],
+                               timeout=180))
+
+    killer = WorkerKiller(ray_cluster, interval_s=0.3, max_kills=3, seed=7)
+    total, kill_log = run_with_chaos(workload, [killer])
+    assert total == sum(range(60))
+    assert kill_log, "chaos killer never fired"
