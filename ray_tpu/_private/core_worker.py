@@ -966,6 +966,16 @@ class CoreWorker:
         if env:
             await self.runtime_env_manager.ensure(env, self._fetch_package)
 
+    async def export_function_raw(self, data: bytes, function_id: str):
+        """Push an already-cloudpickled function/class blob to the GCS
+        function table (client-server path: the blob was pickled on the
+        remote client)."""
+        if function_id in self._function_cache:
+            return
+        await self.gcs.request("kv_put", {
+            "namespace": "funcs", "key": function_id.encode(),
+            "value": data, "overwrite": False})
+
     async def _load_function(self, function_id: str):
         if function_id in self._function_cache:
             return self._function_cache[function_id]

@@ -41,6 +41,9 @@ class HeadNode:
         self.session_dir = session_dir or new_session_dir(config)
         self.gcs = GcsServer(config, self.session_dir)
         self.raylet: Optional[Raylet] = None
+        # Optional ray_tpu:// proxy (util/client); owned by this node's
+        # lifecycle when attached (cli --client-server-port).
+        self.client_server = None
         self._resources = resources
         self._labels = labels
         self._object_store_memory = object_store_memory
@@ -55,6 +58,11 @@ class HeadNode:
         return gcs_address
 
     async def stop(self):
+        if self.client_server is not None:
+            try:
+                await self.client_server.stop()
+            except Exception:
+                pass
         if self.raylet:
             await self.raylet.stop()
         await self.gcs.stop()

@@ -34,6 +34,9 @@ class _GlobalState:
         # Job-level default runtime env (init(runtime_env=...)); merged
         # under per-task/actor envs by resolve_runtime_env.
         self.job_runtime_env: Optional[dict] = None
+        # Ray-client mode (init(address="ray_tpu://...")): every API call
+        # proxies through this context instead of a local CoreWorker.
+        self.client = None
 
     def run(self, coro, timeout: Optional[float] = None):
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
@@ -100,6 +103,21 @@ def init(address: Optional[str] = None, *,
         if ignore_reinit_error:
             return _state
         raise RuntimeError("ray_tpu already initialized")
+    if isinstance(address, str) and (address.startswith("ray_tpu://")
+                                     or address.startswith("ray://")):
+        # Client mode (reference: ray.init("ray://...")): the process
+        # never joins the cluster network; the whole API proxies through
+        # the head's ClientServer.
+        if runtime_env is not None:
+            raise NotImplementedError(
+                "runtime_env is not supported in client mode yet")
+        from ray_tpu.util.client import ClientContext
+        endpoint = address.split("://", 1)[1]
+        _state.client = ClientContext(endpoint, namespace=namespace)
+        _state.namespace = namespace
+        _state.initialized = True
+        atexit.register(shutdown)
+        return _state
     from ray_tpu._private import runtime_env as _re
     _state.job_runtime_env = _re.validate(runtime_env)
     if address in (None, "auto"):
@@ -150,8 +168,20 @@ def init(address: Optional[str] = None, *,
     return _state
 
 
+def client_mode():
+    return _state.client
+
+
 def shutdown():
     if not _state.initialized:
+        return
+    if _state.client is not None:
+        try:
+            _state.client.disconnect()
+        except Exception:
+            pass
+        _state.client = None
+        _state.initialized = False
         return
     try:
         if _state.core is not None:
@@ -178,6 +208,8 @@ def resolve_runtime_env(env: Optional[dict]) -> Optional[dict]:
 
 
 def put(value: Any) -> ObjectRef:
+    if _state.client is not None:
+        return _state.client.put(value)
     core = get_core()
     # put_sync is thread-safe: inline-size values never cross threads; large
     # values only hop to the loop for the store RPCs.
@@ -185,6 +217,8 @@ def put(value: Any) -> ObjectRef:
 
 
 def get(refs, timeout: Optional[float] = None):
+    if _state.client is not None:
+        return _state.client.get(refs, timeout)
     core = get_core()
     if isinstance(refs, (list, tuple)):
         bad = [r for r in refs if not isinstance(r, ObjectRef)]
@@ -202,6 +236,9 @@ def get(refs, timeout: Optional[float] = None):
 
 def wait(refs: List[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None, fetch_local: bool = True):
+    if _state.client is not None:
+        return _state.client.wait(list(refs), num_returns=num_returns,
+                                  timeout=timeout)
     core = get_core()
     refs = list(refs)
     if any(not isinstance(r, ObjectRef) for r in refs):
@@ -231,6 +268,8 @@ def _call_on_core_loop(core: CoreWorker, coro, timeout):
 
 
 def kill(actor, *, no_restart: bool = True):
+    if _state.client is not None:
+        return _state.client.kill(actor, no_restart)
     from ray_tpu.actor import ActorHandle
     if not isinstance(actor, ActorHandle):
         raise TypeError("kill() expects an ActorHandle")
@@ -243,11 +282,15 @@ def kill(actor, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False):
+    if _state.client is not None:
+        return _state.client.cancel(ref, force)
     core = get_core()
     _call_on_core_loop(core, core.cancel_task(ref, force), 10)
 
 
 def get_actor(name: str, namespace: Optional[str] = None):
+    if _state.client is not None:
+        return _state.client.get_actor(name, namespace)
     from ray_tpu.actor import ActorHandle
     core = get_core()
     ns = namespace if namespace is not None else _state.namespace
@@ -256,6 +299,8 @@ def get_actor(name: str, namespace: Optional[str] = None):
 
 
 def nodes() -> List[dict]:
+    if _state.client is not None:
+        return _state.client.nodes()
     core = get_core()
     infos = _call_on_core_loop(core, core.gcs.request("get_all_nodes", {}), 10)
     return [{
@@ -266,6 +311,8 @@ def nodes() -> List[dict]:
 
 
 def cluster_resources() -> Dict[str, float]:
+    if _state.client is not None:
+        return _state.client.cluster_resources()
     core = get_core()
     view = _call_on_core_loop(core,
                               core.gcs.request("get_cluster_resources", {}), 10)

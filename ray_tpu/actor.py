@@ -136,7 +136,10 @@ class ActorClass:
                    for _, m in inspect.getmembers(self._cls,
                                                   inspect.isfunction))
 
-    def remote(self, *args, **kwargs) -> ActorHandle:
+    def remote(self, *args, **kwargs):
+        client = worker_api.client_mode()
+        if client is not None:
+            return client.create_actor(self, args, kwargs, self._options)
         opts = self._options
         name = opts.get("name", "")
         if opts.get("get_if_exists") and name:

@@ -89,6 +89,9 @@ class RemoteFunction:
         return fid
 
     def remote(self, *args, **kwargs):
+        client = worker_api.client_mode()
+        if client is not None:
+            return client.submit_function(self, args, kwargs, self._options)
         core = worker_api.get_core()
         opts = self._options
         num_returns = opts.get("num_returns", 1)

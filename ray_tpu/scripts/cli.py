@@ -50,6 +50,12 @@ def cmd_start(args):
         print(f"ray_tpu head started; GCS at {gcs_address}", flush=True)
         print(f"connect with: ray_tpu.init(address='{gcs_address}') or "
               f"RAY_TPU_ADDRESS={gcs_address}", flush=True)
+        if args.client_server_port:
+            from ray_tpu.util.client import ClientServer
+            cs = ClientServer(gcs_address)
+            addr = await cs.start(port=args.client_server_port)
+            head.client_server = cs
+            print(f"client server at ray_tpu://{addr}", flush=True)
         return head
 
     async def _run_worker():
@@ -168,6 +174,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--port", type=int, default=6379)
     s.add_argument("--num-cpus", type=float, default=None, dest="num_cpus")
     s.add_argument("--num-tpus", type=float, default=None, dest="num_tpus")
+    s.add_argument("--client-server-port", type=int, default=0,
+                   dest="client_server_port",
+                   help="serve remote ray_tpu:// clients on this port")
     s.set_defaults(fn=cmd_start)
 
     s = sub.add_parser("status", help="cluster status")

@@ -1,0 +1,302 @@
+"""Ray-client-equivalent: remote driver over one proxied connection.
+
+Reference parity: python/ray/util/client/ (`ray.init("ray://host:port")`)
+— the client machine never joins the cluster network; every API call
+tunnels through the head's ClientServer, which owns a real server-side
+driver per session. Connect via ``ray_tpu.init(address="ray_tpu://host:port")``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.util.client.server import ClientServer
+
+__all__ = ["ClientServer", "ClientContext", "ClientObjectRef"]
+
+
+class ClientObjectRef:
+    __slots__ = ("_id", "_owner", "_ctx")
+
+    def __init__(self, ref_id: bytes, owner: str, ctx: "ClientContext"):
+        self._id = ref_id
+        self._owner = owner
+        self._ctx = ctx
+
+    def binary(self) -> bytes:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def __repr__(self):
+        return f"ClientObjectRef({self._id.hex()[:16]})"
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ClientObjectRef) and other._id == self._id
+
+    def __reduce__(self):
+        # Nested inside an argument/value, a client ref pickles into the
+        # same wire form as a contained ObjectRef — the server-side driver
+        # deserializes it into a real borrowed ref (serialization.py
+        # _restore_ref), so f.remote([ref]) works like the local path.
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.serialization import _restore_ref
+        return (_restore_ref, (ObjectID(self._id), self._owner))
+
+    def __del__(self):
+        try:
+            self._ctx._release(self._id)
+        except Exception:
+            pass
+
+
+class ClientActorMethod:
+    def __init__(self, handle: "ClientActorHandle", name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, **opts) -> "ClientActorMethod":
+        return ClientActorMethod(self._handle, self._name,
+                                 opts.get("num_returns", self._num_returns))
+
+    def remote(self, *args, **kwargs):
+        if kwargs:
+            raise ValueError("client mode supports positional args only")
+        if self._num_returns == "streaming":
+            raise NotImplementedError(
+                "num_returns='streaming' is not supported in client mode")
+        ctx = self._handle._ctx
+        refs = ctx._call("client_submit_actor_task", {
+            "actor_id": self._handle._actor_id,
+            "method": self._name,
+            "args": ctx._tag_args(args),
+            "num_returns": self._num_returns,
+        })
+        out = [ClientObjectRef(r, o, ctx) for r, o in refs]
+        return out[0] if self._num_returns == 1 else out
+
+
+class ClientActorHandle:
+    def __init__(self, actor_id: bytes, ctx: "ClientContext"):
+        self._actor_id = actor_id
+        self._ctx = ctx
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ClientActorMethod(self, name)
+
+
+class ClientContext:
+    """Client-side driver façade; one RPC connection to the ClientServer."""
+
+    def __init__(self, address: str, namespace: str = ""):
+        from ray_tpu._private.serialization import SerializationContext
+        self.address = address
+        self.namespace = namespace
+        self.session = uuid.uuid4().hex
+        self.serialization = SerializationContext()
+        self._exported: set = set()     # function/class ids the server has
+        self._loop = asyncio.new_event_loop()
+        self._conn = None
+        ready = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(self._loop)
+            ready.set()
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="ray_tpu-client")
+        self._thread.start()
+        ready.wait(10)
+        self.job_id_hex = self._call("client_connect", {})["job_id"]
+
+    # ------------------------------------------------------------------
+
+    def _call(self, method: str, payload: dict, timeout: float = 60.0):
+        from ray_tpu._private import rpc
+
+        async def go():
+            if self._conn is None or self._conn.closed:
+                self._conn = await rpc.connect(self.address)
+            payload["session"] = self.session
+            return await self._conn.request(method, payload, timeout)
+
+        return asyncio.run_coroutine_threadsafe(go(), self._loop).result(
+            timeout + 10 if timeout else None)
+
+    def _tag_args(self, args) -> list:
+        out = []
+        for a in args:
+            if isinstance(a, ClientObjectRef):
+                out.append(("ref", a._id))
+            else:
+                out.append(("val",
+                            self.serialization.serialize(a).to_bytes()))
+        return out
+
+    def _maybe_raise(self, result):
+        """Server ships task/application errors as data so the original
+        exception type survives the proxy (a raw handler raise would reach
+        us as an opaque RemoteRpcError)."""
+        if isinstance(result, dict) and "__client_error__" in result:
+            raise self.serialization.deserialize(result["__client_error__"])
+        return result
+
+    def _release(self, ref_id: bytes):
+        if self._conn is None or self._conn.closed:
+            return
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self._conn.notify("client_release",
+                                  {"session": self.session,
+                                   "refs": [ref_id]}), self._loop)
+        except Exception:
+            pass
+
+    # -- public API ----------------------------------------------------
+
+    def put(self, value: Any) -> ClientObjectRef:
+        data = self.serialization.serialize(value).to_bytes()
+        rid, owner = self._call("client_put", {"data": data})
+        return ClientObjectRef(rid, owner, self)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        ref_list = [refs] if single else list(refs)
+        for r in ref_list:
+            if not isinstance(r, ClientObjectRef):
+                raise TypeError(f"client get() takes ClientObjectRefs, "
+                                f"got {type(r)}")
+        result = self._maybe_raise(self._call(
+            "client_get", {"refs": [r._id for r in ref_list],
+                           "timeout": timeout},
+            timeout=(timeout or 3600.0) + 10))
+        values = [self.serialization.deserialize(b) for b in result]
+        return values[0] if single else values
+
+    def wait(self, refs: List[ClientObjectRef], *, num_returns: int = 1,
+             timeout: Optional[float] = None):
+        ready, not_ready = self._maybe_raise(self._call(
+            "client_wait", {"refs": [r._id for r in refs],
+                            "num_returns": num_returns,
+                            "timeout": timeout},
+            timeout=(timeout or 3600.0) + 10))
+        by_id = {r._id: r for r in refs}
+        return ([by_id[r] for r in ready], [by_id[r] for r in not_ready])
+
+    def submit_function(self, remote_fn, args, kwargs, opts: dict):
+        if kwargs:
+            raise ValueError("client mode supports positional args only")
+        from ray_tpu.remote_function import _resources_from_options
+        num_returns = opts.get("num_returns", 1)
+        if num_returns == "streaming":
+            raise NotImplementedError(
+                "num_returns='streaming' is not supported in client mode")
+        fid, blob = self._function_blob(remote_fn._function, "fn")
+        refs = self._call("client_submit_task", {
+            "function_blob": blob, "function_id": fid,
+            "name": getattr(remote_fn, "__name__", "fn"),
+            "args": self._tag_args(args),
+            "num_returns": num_returns,
+            "resources": _resources_from_options(opts),
+            "max_retries": opts.get("max_retries", -1),
+        })
+        out = [ClientObjectRef(r, o, self) for r, o in refs]
+        return out[0] if num_returns == 1 else out
+
+    def _function_blob(self, func, kind: str):
+        """Pickle once per function; ship the blob only on first export —
+        later submissions send just the id."""
+        from ray_tpu._private.serialization import dumps_function
+        fid = getattr(func, "__ray_tpu_client_fid__", None)
+        blob = None
+        if fid is None:
+            blob = dumps_function(func)
+            fid = f"{kind}:" + hashlib.sha1(blob).hexdigest()
+            try:
+                func.__ray_tpu_client_fid__ = fid
+            except (AttributeError, TypeError):
+                pass
+        if fid in self._exported:
+            return fid, None
+        if blob is None:
+            blob = dumps_function(func)
+        self._exported.add(fid)
+        return fid, blob
+
+    def create_actor(self, actor_cls, args, kwargs, opts: dict):
+        if kwargs:
+            raise ValueError("client mode supports positional args only")
+        from ray_tpu.remote_function import _resources_from_options
+        cid, blob = self._function_blob(actor_cls._cls, "actor")
+        is_async = actor_cls._is_async()
+        res = _resources_from_options(opts) if (
+            opts.get("num_cpus") is not None
+            or opts.get("num_tpus") is not None
+            or opts.get("num_gpus") is not None
+            or opts.get("resources")) else {"CPU": 0.0}
+        actor_id = self._call("client_create_actor", {
+            "class_blob": blob, "class_id": cid,
+            "class_name": actor_cls.__name__,
+            "args": self._tag_args(args),
+            "resources": res,
+            "max_restarts": opts.get("max_restarts", 0),
+            "max_concurrency": opts.get(
+                "max_concurrency", 1000 if is_async else 1),
+            "is_async": is_async,
+            "name": opts.get("name", ""),
+            "namespace": opts.get("namespace") or self.namespace,
+        }, timeout=120.0)
+        return ClientActorHandle(actor_id, self)
+
+    def kill(self, handle: ClientActorHandle, no_restart: bool = True):
+        self._call("client_kill_actor", {"actor_id": handle._actor_id,
+                                         "no_restart": no_restart})
+
+    def cancel(self, ref: ClientObjectRef, force: bool = False):
+        self._call("client_cancel", {"ref": ref._id, "force": force})
+
+    def get_actor(self, name: str, namespace: Optional[str] = None):
+        actor_id = self._call(
+            "client_get_named_actor",
+            {"name": name,
+             "namespace": namespace if namespace is not None
+             else self.namespace})
+        return ClientActorHandle(actor_id, self)
+
+    def cluster_resources(self) -> Dict[str, float]:
+        view = self._call("client_cluster_resources", {})
+        total: Dict[str, float] = {}
+        for info in view.values():
+            if info.get("alive", True):
+                for k, v in info.get("total", {}).items():
+                    total[k] = total.get(k, 0.0) + v
+        return total
+
+    def nodes(self) -> List[dict]:
+        return self._call("client_nodes", {})
+
+    def disconnect(self):
+        try:
+            self._call("client_disconnect", {})
+        except Exception:
+            pass
+        try:
+            if self._conn is not None:
+                asyncio.run_coroutine_threadsafe(
+                    self._conn.close(), self._loop).result(5)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)

@@ -1,0 +1,239 @@
+"""Client server: hosts server-side driver sessions for remote clients.
+
+Reference parity: python/ray/util/client/server/ (the ray:// proxy —
+a remote machine that cannot join the cluster network tunnels the whole
+API through ONE connection to this server, which owns a real driver
+CoreWorker per client session). Sessions are reaped when the client
+connection drops: their named resources follow normal job semantics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import get_config
+from ray_tpu._private.core_worker import CoreWorker
+from ray_tpu._private.ids import ActorID, ObjectID
+from ray_tpu._private.object_ref import ObjectRef
+
+logger = logging.getLogger(__name__)
+
+
+class _ClientSession:
+    """One remote client's server-side driver."""
+
+    def __init__(self, core: CoreWorker):
+        self.core = core
+        # Refs the client holds, keyed by binary id (pin against GC).
+        self.refs: Dict[bytes, ObjectRef] = {}
+        self.actors: Dict[bytes, ActorID] = {}
+
+    def track(self, ref: ObjectRef):
+        self.refs[ref.id.binary()] = ref
+        return (ref.id.binary(), ref.owner_address)
+
+    def resolve(self, ref_id: bytes) -> ObjectRef:
+        ref = self.refs.get(ref_id)
+        if ref is None:
+            raise ValueError(f"unknown client ref {ref_id.hex()[:12]}")
+        return ref
+
+
+class ClientServer:
+    def __init__(self, gcs_address: str):
+        self.gcs_address = gcs_address
+        self.server = rpc.RpcServer("client-server")
+        self.sessions: Dict[str, _ClientSession] = {}
+        self.address = ""
+
+    async def start(self, host: str = "0.0.0.0", port: int = 10001) -> str:
+        for name in ("connect", "put", "get", "wait", "submit_task",
+                     "create_actor", "submit_actor_task", "kill_actor",
+                     "get_named_actor", "release", "cluster_resources",
+                     "nodes", "cancel", "disconnect"):
+            self.server.register(f"client_{name}",
+                                 getattr(self, f"rpc_{name}"))
+        actual = await self.server.start(host, port)
+        self.address = f"{host}:{actual}"
+        logger.info("client server at %s", self.address)
+        return self.address
+
+    async def stop(self):
+        for session in self.sessions.values():
+            await session.core.shutdown_async()
+        self.sessions.clear()
+        await self.server.stop()
+
+    def _session(self, payload) -> _ClientSession:
+        s = self.sessions.get(payload["session"])
+        if s is None:
+            raise ValueError("client session not connected")
+        return s
+
+    # ------------------------------------------------------------------
+
+    async def rpc_connect(self, conn, payload):
+        session_id = payload["session"]
+        config = get_config()
+        gcs = await rpc.connect(self.gcs_address)
+        job_id = await gcs.request("register_job", {
+            "driver_address": "", "entrypoint": "ray-client"})
+        nodes = await gcs.request("get_all_nodes", {})
+        await gcs.close()
+        alive = [n for n in nodes if n.alive]
+        heads = [n for n in alive if n.is_head]
+        raylet_address = (heads[0] if heads else alive[0]).address
+        core = CoreWorker("driver", self.gcs_address, raylet_address,
+                          config, job_id=job_id)
+        await core.start_async()
+        self.sessions[session_id] = _ClientSession(core)
+
+        prev_on_close = conn.on_close
+
+        def on_close(c):
+            if prev_on_close is not None:
+                try:
+                    prev_on_close(c)
+                except Exception:
+                    pass
+            asyncio.ensure_future(self._reap(session_id))
+
+        conn.on_close = on_close
+        return {"job_id": job_id.hex()}
+
+    async def _reap(self, session_id: str):
+        session = self.sessions.pop(session_id, None)
+        if session is not None:
+            try:
+                await session.core.gcs.request(
+                    "finish_job", {"job_id": session.core.job_id})
+            except Exception:
+                pass
+            await session.core.shutdown_async()
+
+    async def rpc_disconnect(self, conn, payload):
+        await self._reap(payload["session"])
+        return True
+
+    async def rpc_put(self, conn, payload):
+        s = self._session(payload)
+        value = s.core.serialization.deserialize(payload["data"])
+        ref = await s.core.put_async(value)
+        return s.track(ref)
+
+    async def rpc_get(self, conn, payload):
+        s = self._session(payload)
+        refs = [s.resolve(r) for r in payload["refs"]]
+        try:
+            values = await s.core.get_async(refs, payload.get("timeout"))
+        except Exception as e:  # noqa: BLE001
+            # Ship the ORIGINAL exception as data: a handler raise would
+            # reach the client as an opaque RemoteRpcError, breaking
+            # `except MyAppError:` parity with the local path.
+            return {"__client_error__":
+                    s.core.serialization.serialize(e).to_bytes()}
+        return [s.core.serialization.serialize(v).to_bytes() for v in values]
+
+    async def rpc_wait(self, conn, payload):
+        s = self._session(payload)
+        refs = [s.resolve(r) for r in payload["refs"]]
+        try:
+            ready, not_ready = await s.core.wait_async(
+                refs, num_returns=payload["num_returns"],
+                timeout=payload.get("timeout"))
+        except Exception as e:  # noqa: BLE001
+            return {"__client_error__":
+                    s.core.serialization.serialize(e).to_bytes()}
+        return ([r.id.binary() for r in ready],
+                [r.id.binary() for r in not_ready])
+
+    @staticmethod
+    def _args_of(s: _ClientSession, tagged) -> list:
+        """args ship as ("ref", id) | ("val", pickled) pairs — no
+        ambiguity between a ref id and a bytes value."""
+        return [s.resolve(v) if kind == "ref"
+                else s.core.serialization.deserialize(v)
+                for kind, v in tagged]
+
+    async def rpc_submit_task(self, conn, payload):
+        s = self._session(payload)
+        if payload.get("function_blob"):
+            await s.core.export_function_raw(payload["function_blob"],
+                                             payload["function_id"])
+        args = self._args_of(s, payload["args"])
+        refs = s.core.submit_task_local(
+            payload["function_id"], tuple(args), {},
+            name=payload.get("name", ""),
+            num_returns=payload.get("num_returns", 1),
+            resources=payload.get("resources"),
+            max_retries=payload.get("max_retries", -1))
+        return [s.track(r) for r in refs]
+
+    async def rpc_create_actor(self, conn, payload):
+        s = self._session(payload)
+        if payload.get("class_blob"):
+            await s.core.export_function_raw(payload["class_blob"],
+                                             payload["class_id"])
+        args = self._args_of(s, payload["args"])
+        actor_id, done = s.core.create_actor_local(
+            payload["class_id"], tuple(args), {},
+            class_name=payload.get("class_name", ""),
+            resources=payload.get("resources"),
+            max_restarts=payload.get("max_restarts", 0),
+            max_concurrency=payload.get("max_concurrency", 1),
+            is_async=payload.get("is_async", False),
+            name=payload.get("name", ""),
+            namespace=payload.get("namespace", ""))
+        await done
+        s.actors[actor_id.binary()] = actor_id
+        return actor_id.binary()
+
+    async def rpc_submit_actor_task(self, conn, payload):
+        s = self._session(payload)
+        actor_id = ActorID(payload["actor_id"])
+        args = self._args_of(s, payload["args"])
+        refs = s.core.submit_actor_task_local(
+            actor_id, payload["method"], tuple(args), {},
+            num_returns=payload.get("num_returns", 1))
+        return [s.track(r) for r in refs]
+
+    async def rpc_kill_actor(self, conn, payload):
+        s = self._session(payload)
+        await s.core.kill_actor(ActorID(payload["actor_id"]),
+                                payload.get("no_restart", True))
+        return True
+
+    async def rpc_get_named_actor(self, conn, payload):
+        s = self._session(payload)
+        info = await s.core.get_named_actor(payload["name"],
+                                            payload.get("namespace", ""))
+        s.actors[info.actor_id.binary()] = info.actor_id
+        return info.actor_id.binary()
+
+    async def rpc_release(self, conn, payload):
+        s = self._session(payload)
+        for r in payload["refs"]:
+            s.refs.pop(r, None)
+        return True
+
+    async def rpc_cluster_resources(self, conn, payload):
+        s = self._session(payload)
+        return await s.core.gcs.request("get_cluster_resources", {})
+
+    async def rpc_nodes(self, conn, payload):
+        s = self._session(payload)
+        infos = await s.core.gcs.request("get_all_nodes", {})
+        return [{
+            "NodeID": n.node_id.hex(), "Alive": n.alive,
+            "Address": n.address, "Resources": n.resources_total,
+            "Labels": n.labels, "IsHead": n.is_head,
+        } for n in infos]
+
+    async def rpc_cancel(self, conn, payload):
+        s = self._session(payload)
+        ref = s.resolve(payload["ref"])
+        await s.core.cancel_task(ref, payload.get("force", False))
+        return True
