@@ -140,7 +140,11 @@ def bench_model():
         from ray_tpu.parallel.mesh import build_mesh, MeshConfig
         from ray_tpu.train.train_step import init_train_state, make_train_step
 
-        cfg = GPTConfig()  # GPT-2 small, bf16, flash attention, remat
+        attention = "flash"
+        for a in sys.argv:
+            if a.startswith("--attention="):
+                attention = a.split("=", 1)[1]
+        cfg = GPTConfig(attention=attention)  # GPT-2 small, bf16, remat
         mesh = build_mesh(MeshConfig(data=len(jax.devices())))
         opt = optax.adamw(3e-4)
         state = init_train_state(
@@ -208,6 +212,7 @@ def bench_model():
             "model_tflops": round(achieved / 1e12, 2),
             "model_mfu_pct": mfu,
             "model_batch_size": bs,
+            "model_attention": attention,
             "device_kind": kind,
         }
     except Exception as e:  # noqa: BLE001
@@ -223,10 +228,14 @@ def _run_model_bench_subprocess():
     """
     import subprocess
 
-    for attempt, tmo in ((1, 900), (2, 300)):
+    # Attempt 1: Pallas flash kernels. Attempt 2: plain XLA attention —
+    # covers slow/failed remote Mosaic compiles through the chip tunnel.
+    for attempt, tmo, extra in ((1, 900, []),
+                                (2, 600, ["--attention=reference"])):
         try:
             proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--model-only"],
+                [sys.executable, os.path.abspath(__file__), "--model-only",
+                 *extra],
                 capture_output=True, text=True, timeout=tmo,
                 cwd=os.path.dirname(os.path.abspath(__file__)))
         except subprocess.TimeoutExpired:

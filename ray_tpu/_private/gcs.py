@@ -236,6 +236,10 @@ class GcsServer:
                     body = _json.dumps(self._status_summary(), default=str)
                     ctype = "application/json"
                     code = "200 OK"
+                elif path == "/" or path.startswith("/dashboard"):
+                    body = _DASHBOARD_HTML
+                    ctype = "text/html"
+                    code = "200 OK"
                 else:
                     body, ctype, code = "not found", "text/plain", "404 Not Found"
                 data = body.encode()
@@ -897,3 +901,54 @@ class GcsServer:
 
 def _fits(request: Dict[str, float], available: Dict[str, float]) -> bool:
     return all(available.get(k, 0.0) >= v for k, v in request.items() if v > 0)
+
+
+# Minimal live dashboard (reference: dashboard/ React client — here a
+# dependency-free page polling /api/status + /metrics).
+_DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;color:#222}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+ table{border-collapse:collapse;min-width:40rem}
+ td,th{border:1px solid #ccc;padding:.35rem .6rem;text-align:left;
+       font-size:.85rem}
+ th{background:#f3f3f3} .dead{color:#b00} .ok{color:#080}
+ pre{background:#f7f7f7;padding:.8rem;max-height:22rem;overflow:auto}
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<div id="summary"></div>
+<h2>Nodes</h2><table id="nodes"><thead><tr>
+<th>node</th><th>state</th><th>head</th><th>address</th>
+<th>CPU</th><th>TPU</th></tr></thead><tbody></tbody></table>
+<h2>Metrics</h2><pre id="metrics">loading…</pre>
+<script>
+async function tick(){
+ try{
+  const st = await (await fetch('/api/status')).json();
+  document.getElementById('summary').textContent =
+    `alive jobs: ${st.jobs_alive} · alive actors: ${st.actors_alive}` +
+    ` · pending demand: ${st.pending_demand}`;
+  const tb = document.querySelector('#nodes tbody'); tb.innerHTML='';
+  for(const n of st.nodes){
+   const avail=(r)=> (n.resources_available[r]??0)+'/'+
+                     (n.resources_total[r]??0);
+   // Node fields are untrusted (any registrant chooses them): build the
+   // row with textContent, never innerHTML.
+   const tr=document.createElement('tr');
+   const cells=[n.node_id.slice(0,12), n.alive?'ALIVE':'DEAD',
+                n.is_head?'yes':'', n.address, avail('CPU'), avail('TPU')];
+   for(const [i,v] of cells.entries()){
+    const td=document.createElement('td');
+    td.textContent=String(v);
+    if(i===1) td.className = n.alive?'ok':'dead';
+    tr.appendChild(td);
+   }
+   tb.appendChild(tr);
+  }
+  document.getElementById('metrics').textContent =
+    await (await fetch('/metrics')).text();
+ }catch(e){ document.getElementById('summary').textContent = 'error: '+e; }
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>"""

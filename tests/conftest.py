@@ -15,6 +15,12 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
 # tunnel, which is slow at best and hangs every test if the tunnel is
 # down. The driver process itself is forced to cpu below.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Persistent XLA compilation cache: the model tests are compile-bound on
+# this 1-vCPU box (~6 of the suite's ~12 minutes); repeat runs hit the
+# cache. Workers inherit the env var.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/ray_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 os.environ["RAY_TPU_HEARTBEAT_INTERVAL_S"] = "0.2"
 os.environ["RAY_TPU_NODE_DEATH_TIMEOUT_S"] = "2.0"
 
