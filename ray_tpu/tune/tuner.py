@@ -171,6 +171,98 @@ class Tuner:
         self._run_config = run_config or RunConfig()
         self._resources = getattr(trainable, "_tune_resources",
                                   {"num_cpus": 1})
+        self._restored_trials: Optional[List[Trial]] = None
+        self._restored_dir: Optional[str] = None
+        self._trainable_blob: Optional[bytes] = None
+        self._last_state_save = 0.0
+
+    # -- experiment persistence (reference: Tuner.restore /
+    #    tune/execution/experiment_state.py) ---------------------------
+
+    def _experiment_dir(self) -> Optional[str]:
+        # A restored experiment keeps persisting to the directory it was
+        # restored FROM (the tree may have been moved between machines).
+        if self._restored_dir is not None:
+            return self._restored_dir
+        rc = self._run_config
+        if not rc.storage_path:
+            return None
+        return os.path.join(rc.storage_path, rc.name or "tune_experiment")
+
+    def _save_experiment_state(self, trials: List[Trial],
+                               min_interval: float = 1.0):
+        exp_dir = self._experiment_dir()
+        if exp_dir is None:
+            return
+        now = time.time()
+        if now - self._last_state_save < min_interval:
+            return  # rate limit: terminate bursts / per-result hooks
+        self._last_state_save = now
+        import cloudpickle
+        try:
+            os.makedirs(exp_dir, exist_ok=True)
+            snapshot = []
+            for t in trials:
+                snapshot.append({
+                    "config": t.config, "trial_id": t.trial_id,
+                    "status": t.status, "results": t.results,
+                    "error": t.error, "iteration": t.iteration,
+                    "checkpoint": t.checkpoint, "rung": t.rung,
+                })
+            if self._trainable_blob is None:
+                self._trainable_blob = cloudpickle.dumps(self._trainable)
+            state = {"trials": snapshot,
+                     "param_space": self._param_space,
+                     "tune_config": self._tune_config,
+                     "run_config": self._run_config,
+                     "trainable": self._trainable_blob}
+            tmp = os.path.join(exp_dir, ".experiment_state.tmp")
+            with open(tmp, "wb") as f:
+                cloudpickle.dump(state, f)
+            os.replace(tmp, os.path.join(exp_dir, "experiment_state.pkl"))
+        except Exception:  # noqa: BLE001
+            # Persistence must never kill the live experiment (disk full,
+            # flaky mount): the run continues, resume just gets older state.
+            import logging
+            logging.getLogger(__name__).exception(
+                "experiment state save failed (continuing)")
+
+    @classmethod
+    def restore(cls, path: str, trainable: Union[Callable, type, None] = None
+                ) -> "Tuner":
+        """Resume an interrupted experiment from its storage directory.
+
+        Finished trials keep their results; trials that were RUNNING or
+        PENDING restart from their latest checkpoint + iteration
+        (reference: python/ray/tune/tuner.py Tuner.restore)."""
+        import cloudpickle
+        state_file = os.path.join(path, "experiment_state.pkl")
+        with open(state_file, "rb") as f:
+            state = cloudpickle.load(f)
+        tuner = cls(trainable if trainable is not None
+                    else cloudpickle.loads(state["trainable"]),
+                    param_space=state["param_space"],
+                    tune_config=state["tune_config"],
+                    run_config=state["run_config"])
+        trials = []
+        for s in state["trials"]:
+            t = Trial(config=s["config"], trial_id=s["trial_id"])
+            t.results = s["results"]
+            t.error = s["error"]
+            t.iteration = s["iteration"]
+            t.checkpoint = s["checkpoint"]
+            t.rung = s.get("rung", 0)
+            # Interrupted trials resume; finished ones stay finished.
+            t.status = (s["status"] if s["status"] in (TERMINATED, ERROR)
+                        else PENDING)
+            trials.append(t)
+        tuner._restored_trials = trials
+        tuner._restored_dir = path
+        return tuner
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        return os.path.exists(os.path.join(path, "experiment_state.pkl"))
 
     def fit(self) -> ResultGrid:
         import cloudpickle
@@ -180,9 +272,12 @@ class Tuner:
             scheduler.set_metric(tc.metric, tc.mode)
         elif not isinstance(scheduler, FIFOScheduler):
             raise ValueError("schedulers other than FIFO require a metric")
-        variants = BasicVariantGenerator(
-            self._param_space, tc.num_samples, tc.seed).variants()
-        trials = [Trial(config=cfg) for cfg in variants]
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+        else:
+            variants = BasicVariantGenerator(
+                self._param_space, tc.num_samples, tc.seed).variants()
+            trials = [Trial(config=cfg) for cfg in variants]
         blob = cloudpickle.dumps(self._trainable)
         stop = self._run_config.stop or {}
 
@@ -216,6 +311,7 @@ class Tuner:
                     pass
                 t.actor = None
             t.pending_ref = None
+            self._save_experiment_state(trials)
 
         def should_stop(t: Trial, metrics: dict) -> bool:
             for k, v in stop.items():
@@ -259,7 +355,9 @@ class Tuner:
                 break
             while pending and len(running) < max_conc:
                 t = pending.pop(0)
-                start(t)
+                # Restored trials resume from their checkpoint/iteration.
+                start(t, checkpoint=t.checkpoint,
+                      start_iteration=t.iteration)
                 running.append(t)
             resume_if_caught_up()
             ref_to_trial = {t.pending_ref: t for t in running
@@ -312,6 +410,9 @@ class Tuner:
                         self._exploit(t, scheduler, start, terminate)
                     else:
                         submit_next(t)
+                    if t.results and len(t.results) % 10 == 0:
+                        self._save_experiment_state(trials)
+        self._save_experiment_state(trials, min_interval=0.0)
         return ResultGrid(trials, tc.metric, tc.mode)
 
     def _exploit(self, t: Trial, scheduler, start, terminate):

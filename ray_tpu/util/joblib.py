@@ -1,0 +1,84 @@
+"""joblib backend over the task layer.
+
+Reference parity: python/ray/util/joblib/ (register_ray +
+RayBackend): ``register_ray(); with joblib.parallel_backend("ray_tpu"):``
+routes scikit-learn's joblib.Parallel fan-outs onto cluster tasks.
+Gated: a no-op stub when joblib isn't installed.
+"""
+
+from __future__ import annotations
+
+
+def register_ray() -> bool:
+    """Register the 'ray_tpu' joblib parallel backend; False if joblib is
+    unavailable in this environment."""
+    try:
+        from joblib import register_parallel_backend
+        from joblib._parallel_backends import ThreadingBackend
+    except ImportError:
+        return False
+
+    class RayTpuBackend(ThreadingBackend):
+        """Each joblib batch ships as one task (like the reference's
+        actor-pool backend, amortizing per-call overhead)."""
+
+        supports_timeout = True
+
+        def configure(self, n_jobs=1, parallel=None, **kw):
+            import ray_tpu
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            self._ray = ray_tpu
+            if n_jobs in (-1, None):
+                n_jobs = max(1, int(
+                    ray_tpu.cluster_resources().get("CPU", 1)))
+            return super().configure(n_jobs, parallel, **kw)
+
+        def apply_async(self, func, callback=None):
+            import cloudpickle
+
+            from ray_tpu.util.multiprocessing import AsyncResult
+            ref = _run_joblib_batch.remote(cloudpickle.dumps(func))
+            fut = AsyncResult(self._ray, [ref], single=True)
+            if callback is not None:
+                import threading
+
+                def waiter():
+                    try:
+                        callback(fut.get())
+                    except Exception:
+                        # Task failure still surfaces via retrieve()'s
+                        # get(), matching multiprocessing.pool semantics.
+                        pass
+
+                threading.Thread(target=waiter, daemon=True).start()
+            return fut
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)
+    return True
+
+
+def _make_run_batch():
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _run_joblib_batch(blob):
+        import cloudpickle
+        return [cloudpickle.loads(blob)()]
+
+    return _run_joblib_batch
+
+
+class _LazyRemote:
+    """One shared remote function for all backends (module-level, created
+    on first use so importing this module never initializes the cluster)."""
+
+    _fn = None
+
+    def remote(self, *args, **kwargs):
+        if _LazyRemote._fn is None:
+            _LazyRemote._fn = _make_run_batch()
+        return _LazyRemote._fn.remote(*args, **kwargs)
+
+
+_run_joblib_batch = _LazyRemote()
