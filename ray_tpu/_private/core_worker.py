@@ -1483,22 +1483,38 @@ class CoreWorker:
 
     async def generator_next(self, task_id: TaskID,
                              cursor: int) -> Optional[ObjectRef]:
-        """Next ref of a streaming task, or None when exhausted."""
-        stream = self.generator_streams.get(task_id)
-        if stream is None:
-            return None  # already exhausted/released: StopIteration persists
+        """Next ref of a streaming task, or None when exhausted (blocking
+        form of generator_try_next)."""
         while True:
-            if cursor < stream.received:
-                return ObjectRef(ObjectID.for_task_return(task_id, cursor),
-                                 self.address)
-            if stream.error is not None:
-                raise stream.error
-            if stream.total is not None and cursor >= stream.total:
-                self.generator_streams.pop(task_id, None)
+            kind, ref = await self.generator_try_next(task_id, cursor)
+            if kind == "item":
+                return ref
+            if kind == "done":
+                return None
+            stream = self.generator_streams.get(task_id)
+            if stream is None:
                 return None
             fut = asyncio.get_running_loop().create_future()
             stream.waiters.append(fut)
             await fut
+
+    async def generator_try_next(self, task_id: TaskID, cursor: int):
+        """Non-blocking generator_next: ("item", ref) | ("pending", None) |
+        ("done", None). Lets pull-based consumers (Data streaming reads)
+        poll without parking a thread per stream."""
+        stream = self.generator_streams.get(task_id)
+        if stream is None:
+            return ("done", None)
+        if cursor < stream.received:
+            return ("item",
+                    ObjectRef(ObjectID.for_task_return(task_id, cursor),
+                              self.address))
+        if stream.error is not None:
+            raise stream.error
+        if stream.total is not None and cursor >= stream.total:
+            self.generator_streams.pop(task_id, None)
+            return ("done", None)
+        return ("pending", None)
 
     def release_generator(self, task_id: TaskID, consumed: int):
         """Consumer dropped the ObjectRefGenerator: free the stream and the

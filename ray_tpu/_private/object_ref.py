@@ -114,6 +114,24 @@ class ObjectRefGenerator:
         self._cursor += 1
         return ref
 
+    def try_next(self):
+        """Non-blocking __next__: the next ObjectRef if an item is ready,
+        None when the producer hasn't yielded it yet; StopIteration when
+        the stream is exhausted."""
+        if self._exhausted:
+            raise StopIteration
+        from ray_tpu._private import worker_api
+        kind, ref = worker_api._call_on_core_loop(
+            self._core, self._core.generator_try_next(self._task_id,
+                                                      self._cursor), 30)
+        if kind == "done":
+            self._exhausted = True
+            raise StopIteration
+        if kind == "pending":
+            return None
+        self._cursor += 1
+        return ref
+
     def __aiter__(self):
         return self
 
