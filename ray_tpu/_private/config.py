@@ -54,8 +54,8 @@ class Config:
     # (the worker executes sequentially; pipelining hides the RPC round trip).
     task_pipeline_depth: int = 2
     # Queued tasks shipped per push RPC once pipelining engages (one round
-    # trip covers the whole batch).
-    task_batch_size: int = 16
+    # trip covers the whole batch; also bounds head-of-line reply latency).
+    task_batch_size: int = 8
     # Lease reuse idle timeout (s): a leased idle worker is returned after this.
     idle_worker_lease_timeout_s: float = 0.5
     worker_lease_timeout_s: float = 30.0
