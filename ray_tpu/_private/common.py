@@ -96,6 +96,9 @@ class TaskSpec:
     # @method-decorator defaults per method name (num_returns,
     # concurrency_group); persisted so get_actor handles honor them.
     method_options: Optional[Dict[str, dict]] = None
+    # Tracing context (trace_id, parent_span_id) — reference:
+    # tracing_helper.py _DictPropagator inside task specs.
+    trace_ctx: Optional[Tuple[str, str]] = None
 
     def env_hash(self) -> str:
         return (self.runtime_env or {}).get("_hash", "")
@@ -124,7 +127,8 @@ class TaskSpec:
             self.is_async_actor, self.actor_name, self.namespace,
             self.runtime_env, self.is_generator, self.kwarg_names,
             self.lifetime, self.concurrency_groups, self.concurrency_group,
-            self.execute_out_of_order, self.method_options))
+            self.execute_out_of_order, self.method_options,
+            self.trace_ctx))
 
 
 @dataclass

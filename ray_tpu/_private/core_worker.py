@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
+from ray_tpu.util import tracing as _tracing
 from ray_tpu._private import object_ref as object_ref_mod
 from ray_tpu._private import rpc
 from ray_tpu._private.common import (ACTOR_ALIVE, ACTOR_DEAD, ARG_INLINE,
@@ -1051,6 +1052,7 @@ class CoreWorker:
             owner_address=self.address, owner_worker_id=self.worker_id,
             is_generator=is_generator, runtime_env=runtime_env,
         )
+        self._stamp_trace(spec)
         refs = []
         returns = []
         for i in range(num_returns):
@@ -1127,6 +1129,7 @@ class CoreWorker:
             owner_address=self.address, owner_worker_id=self.worker_id,
             is_generator=is_generator, runtime_env=runtime_env,
         )
+        self._stamp_trace(spec)
         refs: List[ObjectRef] = []
         returns: List[ObjectID] = []
         with self.submission_lock:
@@ -1685,6 +1688,7 @@ class CoreWorker:
             max_retries=max_task_retries, concurrency_group=concurrency_group,
             is_generator=is_generator,
         )
+        self._stamp_trace(spec)
         q.inflight[seq_no] = spec
         refs, returns = [], []
         for i in range(num_returns):
@@ -1738,6 +1742,7 @@ class CoreWorker:
                 concurrency_group=concurrency_group,
                 is_generator=is_generator,
             )
+            self._stamp_trace(spec)
             q.inflight[seq_no] = spec
             refs: List[ObjectRef] = []
             returns: List[ObjectID] = []
@@ -1968,6 +1973,7 @@ class CoreWorker:
             return {"app_error": err, "returns": returns}
         except Exception as e:  # noqa: BLE001
             return {"system_error": f"{type(e).__name__}: {e}"}
+        span = self._maybe_start_span(spec)
         try:
             if spec.task_id in self._cancelled_tasks:
                 self._cancelled_tasks.discard(spec.task_id)
@@ -1981,8 +1987,7 @@ class CoreWorker:
                 self._running_tasks[spec.task_id] = task
                 result = await task
             else:
-                fut = loop.run_in_executor(self._exec_pool,
-                                           lambda: func(*args, **kwargs))
+                fut = self._run_in_pool(func, *args, **kwargs)
                 self._running_tasks[spec.task_id] = fut
                 result = await fut
             values = self._split_returns(result, spec.num_returns)
@@ -1998,8 +2003,38 @@ class CoreWorker:
                 spec, [err] * spec.num_returns, is_exception=True)
             return {"app_error": err, "returns": returns}
         finally:
+            self._finish_span(span)
             self._running_tasks.pop(spec.task_id, None)
             self.current_task_id = None
+
+    @staticmethod
+    def _stamp_trace(spec: TaskSpec):
+        """Attach the caller's trace context to an outgoing spec (no-op
+        unless a span is active or this process enabled tracing)."""
+        ctx = _tracing.current_context()
+        if ctx is not None:
+            spec.trace_ctx = ctx
+
+    def _run_in_pool(self, fn, *args, **kwargs):
+        """User code on the exec pool WITH contextvars (run_in_executor
+        alone would orphan child spans and any submission context)."""
+        import contextvars
+        ctx = contextvars.copy_context()
+        return asyncio.get_running_loop().run_in_executor(
+            self._exec_pool, lambda: ctx.run(fn, *args, **kwargs))
+
+    def _maybe_start_span(self, spec: TaskSpec):
+        # Spans record exactly when the submitter traced this task.
+        if spec.trace_ctx is None:
+            return None
+        return _tracing.start_span(
+            spec.name or spec.method_name or spec.function_id,
+            spec.trace_ctx, spec.task_id.hex())
+
+    def _finish_span(self, span):
+        if span is None:
+            return
+        self._task_events_buffer.append(_tracing.end_span(span))
 
     async def _execute_generator_task(self, spec: TaskSpec, func, args,
                                       kwargs) -> dict:
@@ -2165,9 +2200,12 @@ class CoreWorker:
                 spec.concurrency_group, sem)
         async with sem:
             self.current_task_id = spec.task_id
+            span = None
             try:
                 method = getattr(self.executing_actor, spec.method_name)
                 args, kwargs = await self._resolve_task_args(spec)
+                # Span covers user code only (same as normal tasks).
+                span = self._maybe_start_span(spec)
                 if spec.is_generator:
                     return await self._execute_generator_task(
                         spec, method, args, kwargs)
@@ -2176,9 +2214,7 @@ class CoreWorker:
                     self._running_tasks[spec.task_id] = task
                     result = await task
                 else:
-                    loop = asyncio.get_running_loop()
-                    fut = loop.run_in_executor(self._exec_pool,
-                                               lambda: method(*args, **kwargs))
+                    fut = self._run_in_pool(method, *args, **kwargs)
                     self._running_tasks[spec.task_id] = fut
                     result = await fut
                 values = self._split_returns(result, spec.num_returns)
@@ -2196,6 +2232,7 @@ class CoreWorker:
                     spec, [err] * spec.num_returns, is_exception=True)
                 return {"app_error": err, "returns": returns}
             finally:
+                self._finish_span(span)
                 self._running_tasks.pop(spec.task_id, None)
                 self.current_task_id = None
 

@@ -1,0 +1,105 @@
+"""Distributed tracing: spans with cross-task context propagation.
+
+Reference parity: python/ray/util/tracing/tracing_helper.py — the
+reference injects OpenTelemetry spans around task submit/execute and
+propagates the trace context inside task specs (_DictPropagator :165).
+Here the span context (trace_id, parent span_id) rides TaskSpec.trace_ctx;
+executing workers open a span, child submissions inherit it through a
+contextvar, and finished spans flush through the task-event channel to
+the GCS, where `get_spans()` reassembles the tree.
+
+Enable per driver with ``tracing.enable()`` (spans cost one 16-byte id
+pair per task; off by default).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+_current_span: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_span", default=None)
+# Process-local: workers never enable this themselves — they record spans
+# exactly when the incoming spec carries a trace context, so disable() on
+# the driver stops the whole tree immediately (no stale env inheritance).
+_enabled = False
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def current_context() -> Optional[tuple]:
+    """(trace_id, span_id) to stamp onto an outgoing task spec, or None.
+
+    An ACTIVE span always propagates (a worker executing a traced task
+    has tracing 'off' locally yet must parent its children); otherwise a
+    fresh root trace starts only where tracing is enabled."""
+    span = _current_span.get()
+    if span is not None:
+        return (span["trace_id"], span["span_id"])
+    if is_enabled():
+        return (os.urandom(8).hex(), "")
+    return None
+
+
+def start_span(name: str, trace_ctx: Optional[tuple], task_id: str) -> dict:
+    trace_id, parent = trace_ctx if trace_ctx else (os.urandom(8).hex(), "")
+    span = {"kind": "span", "trace_id": trace_id,
+            "span_id": os.urandom(8).hex(), "parent_id": parent,
+            "name": name, "task_id": task_id, "start": time.time(),
+            "end": None}
+    token = _current_span.set(span)
+    span["_token"] = token
+    return span
+
+
+def end_span(span: dict) -> dict:
+    span["end"] = time.time()
+    token = span.pop("_token", None)
+    if token is not None:
+        _current_span.reset(token)
+    return {k: v for k, v in span.items()}
+
+
+def get_spans(trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """All finished spans (optionally one trace), oldest first, from the
+    GCS task-event stream."""
+    from ray_tpu._private import worker_api
+    core = worker_api.get_core()
+    events = worker_api._call_on_core_loop(
+        core, core.gcs.request("get_task_events", {"limit": 100000}), 30)
+    spans = [e for e in events if e.get("kind") == "span"]
+    if trace_id is not None:
+        spans = [s for s in spans if s["trace_id"] == trace_id]
+    return sorted(spans, key=lambda s: s["start"])
+
+
+def span_tree(trace_id: str) -> str:
+    """Render a trace as an indented tree (debug helper)."""
+    spans = get_spans(trace_id)
+    children: Dict[str, list] = {}
+    for s in spans:
+        children.setdefault(s["parent_id"], []).append(s)
+    lines: List[str] = []
+
+    def walk(parent: str, depth: int):
+        for s in children.get(parent, []):
+            dur = (s["end"] - s["start"]) * 1e3 if s["end"] else float("nan")
+            lines.append(f"{'  ' * depth}{s['name']}  {dur:.1f} ms")
+            walk(s["span_id"], depth + 1)
+
+    walk("", 0)
+    return "\n".join(lines)

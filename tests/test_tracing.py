@@ -1,0 +1,85 @@
+"""Distributed tracing spans (reference: tracing_helper.py span
+propagation inside task specs)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+def test_spans_propagate_across_nested_tasks():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    tracing.enable()
+    try:
+        @ray_tpu.remote
+        def child(x):
+            time.sleep(0.05)
+            return x + 1
+
+        @ray_tpu.remote
+        def parent(x):
+            return ray_tpu.get(child.remote(x)) * 10
+
+        assert ray_tpu.get(parent.remote(1), timeout=60) == 20
+
+        deadline = time.time() + 15
+        spans = []
+        while time.time() < deadline:
+            spans = tracing.get_spans()
+            names = {s["name"] for s in spans}
+            if {"parent", "child"} <= names:
+                break
+            time.sleep(0.3)
+        by_name = {s["name"]: s for s in spans}
+        assert "parent" in by_name and "child" in by_name
+        p, c = by_name["parent"], by_name["child"]
+        # Same trace; the child's parent pointer is the parent's span.
+        assert c["trace_id"] == p["trace_id"]
+        assert c["parent_id"] == p["span_id"]
+        assert p["end"] is not None and p["end"] > p["start"]
+        # Child nests temporally inside the parent.
+        assert p["start"] <= c["start"] and c["end"] <= p["end"] + 0.5
+
+        tree = tracing.span_tree(p["trace_id"])
+        assert "parent" in tree and "  child" in tree
+    finally:
+        tracing.disable()
+        ray_tpu.shutdown()
+
+
+def test_actor_method_spans():
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    tracing.enable()
+    try:
+        @ray_tpu.remote
+        class A:
+            def work(self):
+                return 7
+
+        a = A.remote()
+        assert ray_tpu.get(a.work.remote(), timeout=60) == 7
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if any(s["name"] == "work" for s in tracing.get_spans()):
+                return
+            time.sleep(0.3)
+        pytest.fail("actor method span never recorded")
+    finally:
+        tracing.disable()
+        ray_tpu.shutdown()
+
+
+def test_tracing_off_by_default():
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        assert ray_tpu.get(f.remote(), timeout=60) == 1
+        time.sleep(1.0)
+        assert tracing.get_spans() == []
+    finally:
+        ray_tpu.shutdown()
