@@ -190,10 +190,10 @@ def _moe_block(layer, x, cfg: GPTConfig):
     return y.astype(dt), aux
 
 
-def gpt_forward(params, tokens, cfg: GPTConfig, mesh=None):
+def gpt_forward(params, tokens, cfg: GPTConfig, mesh=None, act_sharding=None):
     """tokens: [B, S] int32 -> logits [B, S, vocab] (cfg.dtype)."""
     dt = cfg.dtype
-    x, aux_total = gpt_backbone(params, tokens, cfg, mesh)
+    x, aux_total = gpt_backbone(params, tokens, cfg, mesh, act_sharding)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x,
                             params["embed"]["table"].astype(dt))
@@ -202,23 +202,36 @@ def gpt_forward(params, tokens, cfg: GPTConfig, mesh=None):
     return logits, aux_total
 
 
-def gpt_backbone(params, tokens, cfg: GPTConfig, mesh=None):
-    """tokens: [B, S] -> final hidden states [B, S, D] (pre-LM-head)."""
+def gpt_backbone(params, tokens, cfg: GPTConfig, mesh=None, act_sharding=None):
+    """tokens: [B, S] -> final hidden states [B, S, D] (pre-LM-head).
+
+    act_sharding (a NamedSharding for [B, S, D] activations, usually
+    ``strategy.activation_sharding(mesh)``) pins the residual stream at
+    layer boundaries so GSPMD never back-propagates weight shardings onto
+    activation gradients (the "involuntary full rematerialization" failure
+    mode on 2D tp_fsdp meshes).
+    """
     b, s = tokens.shape
     dt = cfg.dtype
-    x = params["embed"]["table"].astype(dt)[tokens]
+
+    def _c(x):
+        if act_sharding is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, act_sharding)
+
+    x = _c(params["embed"]["table"].astype(dt)[tokens])
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     aux_total = 0.0
 
     def layer_fn(x, layer):
-        h = x + _attention_block(layer, _rmsnorm(
-            x, layer["ln1"]["scale"], cfg.rmsnorm_eps), cfg, positions, mesh)
+        h = _c(x + _attention_block(layer, _rmsnorm(
+            x, layer["ln1"]["scale"], cfg.rmsnorm_eps), cfg, positions, mesh))
         normed = _rmsnorm(h, layer["ln2"]["scale"], cfg.rmsnorm_eps)
         if cfg.n_experts > 0:
             delta, aux = _moe_block(layer, normed, cfg)
         else:
             delta, aux = _mlp_block(layer, normed, cfg), 0.0
-        return h + delta, aux
+        return _c(h + delta), aux
 
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
@@ -267,7 +280,7 @@ def chunked_xent(x, w_head, targets, mask, chunk_rows: int = 16384):
     return total, denom
 
 
-def gpt_loss(params, batch, cfg: GPTConfig, mesh=None):
+def gpt_loss(params, batch, cfg: GPTConfig, mesh=None, act_sharding=None):
     """batch: {"tokens": [B, S+1]} -> mean next-token cross-entropy.
 
     The LM-head matmul + softmax run chunked (chunked_xent) so the full
@@ -275,7 +288,7 @@ def gpt_loss(params, batch, cfg: GPTConfig, mesh=None):
     """
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    x, aux = gpt_backbone(params, inputs, cfg, mesh)
+    x, aux = gpt_backbone(params, inputs, cfg, mesh, act_sharding)
     b, s, d = x.shape
     dt = cfg.dtype
     if cfg.tie_embeddings:

@@ -123,7 +123,12 @@ class ShardingStrategy:
             (r"attn/wo", P(t, f)),
             (r"mlp/(w_up|w_gate)", P(f, t)),
             (r"mlp/w_down", P(t, f)),
-            (r"embed/table", P(t, f)),
+            # Vocab over both axes, d_model replicated: a d-sharded gather
+            # output cannot transition to batch-sharded activations without
+            # an involuntary full rematerialization (permuted tile order),
+            # while a vocab-sharded gather resolves via masked lookup +
+            # all-reduce and reshards to the batch spec cheaply.
+            (r"embed/table", P((t, f), None)),
             (r"lm_head", P(f, t)),
             (r"moe/.*w_up", P("expert", f, t)),
             (r"moe/.*w_down", P("expert", t, f)),
@@ -160,6 +165,23 @@ class ShardingStrategy:
         return ShardingStrategy(
             "sp", ShardingRules(), P(("data",), "sequence"),
         )
+
+    @property
+    def activation_spec(self) -> P:
+        """Canonical sharding for [batch, seq, d_model] activations.
+
+        Constraining the residual stream to this spec at layer boundaries
+        stops GSPMD from propagating conflicting weight shardings onto
+        activation gradients (which shows up as "involuntary full
+        rematerialization" warnings and replicated resharding on the
+        backward add_any accumulations).
+        """
+        parts = tuple(self.batch_spec)
+        assert len(parts) <= 3, f"batch_spec {self.batch_spec} has rank > 3"
+        return P(*(parts + (None,) * (3 - len(parts))))
+
+    def activation_sharding(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.activation_spec)
 
     def batch_sharding(self, mesh: Mesh) -> NamedSharding:
         return NamedSharding(mesh, self.batch_spec)
