@@ -106,6 +106,16 @@ class TaskID(BaseID):
         return cls(os.urandom(16) + job_id.binary())
 
     @classmethod
+    def for_index(cls, job_id: JobID, seed: bytes, index: int):
+        """Deterministic per-submitter id: 8 seed bytes + 8 counter bytes.
+
+        Avoids an os.urandom syscall on the submission hot path (reference
+        derives TaskIDs from parent task + counter the same way,
+        src/ray/common/id.h)."""
+        import struct
+        return cls(seed[:8] + struct.pack("<Q", index) + job_id.binary())
+
+    @classmethod
     def for_actor_task(cls, job_id: JobID, actor_id: ActorID, seq: int,
                        epoch: int = 0):
         # epoch (actor restart count at submission) keeps post-restart task

@@ -89,9 +89,28 @@ def main():
     raylet_conn.push_handler = push_handler
     # notify-style shutdown also arrives as a request on our server (handled).
 
+    profile_dir = os.environ.get("RAY_TPU_PROFILE_WORKER")
+    prof = None
+    if profile_dir:
+        import cProfile
+        import signal as _sig
+        prof = cProfile.Profile()
+
+        def _dump_profile(*_a):
+            prof.disable()
+            prof.dump_stats(
+                os.path.join(profile_dir, f"worker-{os.getpid()}.prof"))
+            os._exit(0)
+
+        _sig.signal(_sig.SIGTERM, _dump_profile)
+        prof.enable()
     try:
         loop.run_forever()
     finally:
+        if prof is not None:
+            prof.disable()
+            prof.dump_stats(
+                os.path.join(profile_dir, f"worker-{os.getpid()}.prof"))
         try:
             loop.run_until_complete(core.shutdown_async())
         except Exception:
