@@ -1987,14 +1987,35 @@ class CoreWorker:
         return await fut
 
     def _bounce_push(self, q: ActorSubmitQueue, spec: TaskSpec,
-                     fut: Optional[asyncio.Future], err: Exception):
+                     fut: Optional[asyncio.Future], err: Exception,
+                     attempted: bool = False):
         """Fail one outbox entry: slow-path futures get the exception (their
-        retry loop handles it); fast-path entries re-enter the retry loop."""
+        retry loop handles it); fast-path entries re-enter the retry loop.
+
+        attempted=True means the push RPC may have REACHED the worker (the
+        task may have executed): re-pushing then consumes one of the task's
+        retries, and a task with max_task_retries=0 must fail instead of
+        risking double execution (at-most-once; reference:
+        direct_actor_task_submitter.h resend semantics)."""
         if fut is not None:
             if not fut.done():
                 fut.set_exception(err)
-        else:
-            asyncio.ensure_future(self._submit_actor_task(q, spec))
+            return
+        if attempted:
+            pt = self.pending_tasks.get(spec.task_id)
+            if pt is None:
+                q.inflight.pop(spec.seq_no, None)
+                return
+            if pt.retries_left == 0:
+                q.inflight.pop(spec.seq_no, None)
+                self._complete_task_error(
+                    spec, exc.ActorDiedError(
+                        q.actor_id, "actor worker died mid-call"),
+                    retry=False)
+                return
+            if pt.retries_left > 0:
+                pt.retries_left -= 1
+        asyncio.ensure_future(self._submit_actor_task(q, spec))
 
     async def _flush_actor_outbox(self, q: ActorSubmitQueue):
         q.flush_scheduled = False
@@ -2043,7 +2064,8 @@ class CoreWorker:
                     q.inflight.pop(spec.seq_no, None)
                     self._complete_task_error(spec, err, retry=False)
                 else:
-                    self._bounce_push(q, spec, fut, err)
+                    # The request was sent: the worker may have executed it.
+                    self._bounce_push(q, spec, fut, err, attempted=True)
             return
         for (spec, fut), reply in zip(live, replies):
             if fut is not None:
@@ -2122,6 +2144,54 @@ class CoreWorker:
         async with self._task_exec_lock:  # pipelined pushes run one-by-one
             return await self._push_task_locked(payload)
 
+    _CANCELLED = object()  # run_all sentinel: task cancelled pre-start
+
+    async def _run_sync_jobs(self, jobs: list, replies: list):
+        """Execute (idx, spec, fn, args, kwargs) jobs in ONE pool job and
+        fill replies[idx] with the single-task reply envelopes. Shared by
+        the plain-task and actor batch paths — keep their semantics in one
+        place. Cancellation is re-checked immediately before each task runs
+        (a cancel mid-batch skips everything not yet started; the currently
+        running sync call is not interruptible, same as a pool future that
+        already started)."""
+
+        def run_all():
+            out = []
+            for _i, _spec, fn, args, kwargs in jobs:
+                if _spec.task_id in self._cancelled_tasks:
+                    out.append((self._CANCELLED, None))
+                    continue
+                self.current_task_id = _spec.task_id
+                try:
+                    out.append((True, fn(*args, **kwargs)))
+                except BaseException as e:  # noqa: BLE001 — per-task fault
+                    out.append((False, (e, traceback.format_exc())))
+            return out
+
+        results = await self._run_in_pool(run_all)
+        for (i, spec, _f, _a, _kw), (ok, res) in zip(jobs, results):
+            self.current_task_id = spec.task_id
+            try:
+                if ok is self._CANCELLED:
+                    replies[i] = {"cancelled": True}
+                elif ok:
+                    values = self._split_returns(res, spec.num_returns)
+                    returns = await self._store_returns(spec, values)
+                    replies[i] = {"returns": returns}
+                else:
+                    e, tb_str = res
+                    err = exc.TaskError(e, tb_str, spec.task_id, os.getpid())
+                    returns = await self._store_returns(
+                        spec, [err] * spec.num_returns, is_exception=True)
+                    replies[i] = {"app_error": err, "returns": returns}
+            except Exception as e:  # noqa: BLE001 — e.g. bad num_returns
+                replies[i] = {"system_error": f"{type(e).__name__}: {e}"}
+            finally:
+                # Drop a cancel marker once it has been acted on (or raced
+                # a task that already started).
+                self._cancelled_tasks.discard(spec.task_id)
+        self.current_task_id = None
+
     async def _rpc_push_task_batch(self, conn, payload):
         """Execute a batch sequentially; one reply list for all. Per-spec
         isolation: an escaping system error fails that spec, not the
@@ -2140,38 +2210,7 @@ class CoreWorker:
                 return
             jobs = list(sync_jobs)
             sync_jobs.clear()
-
-            def run_all():
-                out = []
-                for _i, _spec, func, args, kwargs in jobs:
-                    self.current_task_id = _spec.task_id
-                    try:
-                        out.append((True, func(*args, **kwargs)))
-                    except BaseException as e:  # noqa: BLE001
-                        out.append((False, (e, traceback.format_exc())))
-                return out
-
-            results = await self._run_in_pool(run_all)
-            for (i, spec, _f, _a, _kw), (ok, res) in zip(jobs, results):
-                try:
-                    if ok:
-                        values = self._split_returns(res, spec.num_returns)
-                        returns = await self._store_returns(spec, values)
-                        replies[i] = {"returns": returns}
-                    else:
-                        e, tb_str = res
-                        err = exc.TaskError(e, tb_str, spec.task_id,
-                                            os.getpid())
-                        returns = await self._store_returns(
-                            spec, [err] * spec.num_returns,
-                            is_exception=True)
-                        replies[i] = {"app_error": err, "returns": returns}
-                except Exception as e:  # noqa: BLE001
-                    replies[i] = {"system_error": f"{type(e).__name__}: {e}"}
-                finally:
-                    # Drop a cancel marker that raced execution start.
-                    self._cancelled_tasks.discard(spec.task_id)
-            self.current_task_id = None
+            await self._run_sync_jobs(jobs, replies)
 
         async with self._task_exec_lock:
             for i, spec in enumerate(specs):
@@ -2504,42 +2543,8 @@ class CoreWorker:
                          args, kwargs))
         if not jobs:
             return replies
-
-        def run_all():
-            out = []
-            for _i, _spec, method, args, kwargs in jobs:
-                self.current_task_id = _spec.task_id
-                try:
-                    out.append((True, method(*args, **kwargs)))
-                except BaseException as e:  # noqa: BLE001 — per-task fault
-                    out.append((False, (e, traceback.format_exc())))
-            return out
-
-        import os as _os
         async with self._actor_semaphore:
-            results = await self._run_in_pool(run_all)
-            for (i, spec, _m, _a, _kw), (ok, res) in zip(jobs, results):
-                self.current_task_id = spec.task_id
-                try:
-                    if ok:
-                        values = self._split_returns(res, spec.num_returns)
-                        returns = await self._store_returns(spec, values)
-                        replies[i] = {"returns": returns}
-                    else:
-                        e, tb_str = res
-                        err = exc.TaskError(e, tb_str, spec.task_id,
-                                            _os.getpid())
-                        returns = await self._store_returns(
-                            spec, [err] * spec.num_returns,
-                            is_exception=True)
-                        replies[i] = {"app_error": err, "returns": returns}
-                except Exception as e:  # noqa: BLE001 — e.g. bad num_returns
-                    replies[i] = {"system_error": f"{type(e).__name__}: {e}"}
-                finally:
-                    # A cancel that raced execution start parked the id in
-                    # _cancelled_tasks; the task ran, so drop the marker.
-                    self._cancelled_tasks.discard(spec.task_id)
-                    self.current_task_id = None
+            await self._run_sync_jobs(jobs, replies)
         return replies
 
     async def _rpc_push_actor_task(self, conn, payload):

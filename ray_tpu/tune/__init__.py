@@ -8,7 +8,8 @@ Trials run as ray_tpu actors; the controller event-loop drives them with
 """
 
 from ray_tpu.tune.search import (BasicVariantGenerator, Categorical, Domain,
-                                 Float, Integer, choice, grid_search,
+                                 Float, Integer, SearchAlgorithm,
+                                 TPESearcher, choice, grid_search,
                                  lograndint, loguniform, qrandint, quniform,
                                  randint, randn, sample_from, uniform)
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
@@ -27,6 +28,7 @@ __all__ = [
     "grid_search", "uniform", "quniform", "loguniform", "choice", "randint",
     "qrandint", "lograndint", "randn", "sample_from",
     "Domain", "Float", "Integer", "Categorical", "BasicVariantGenerator",
+    "SearchAlgorithm", "TPESearcher",
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
     "ASHAScheduler", "MedianStoppingRule", "PopulationBasedTraining",
 ]

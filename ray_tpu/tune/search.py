@@ -144,13 +144,250 @@ def _resolve(space, rng, out):
 
 
 class SearchAlgorithm:
-    """ABC (reference: search/search_algorithm.py:10)."""
+    """ABC (reference: search/search_algorithm.py:10 + searcher.py Searcher).
+
+    Incremental protocol: the controller calls ``suggest(trial_id)`` for
+    each new trial slot and feeds results back via ``on_trial_complete``;
+    model-based searchers condition later suggestions on earlier results.
+    """
+
+    def set_space(self, space: dict):
+        self._space = space
+
+    def set_metric(self, metric: str, mode: str):
+        self._metric = metric
+        self._mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        """Next config to evaluate, or None when exhausted."""
+        raise NotImplementedError
 
     def next_configs(self, n: int) -> List[dict]:
         raise NotImplementedError
 
     def on_trial_complete(self, trial_id: str, result: Optional[dict]):
         pass
+
+
+def _flatten_domains(space, prefix=()):
+    """Yield (path, Domain-or-constant) for every non-grid leaf."""
+    for k, v in space.items():
+        if isinstance(v, dict) and set(v.keys()) == {"grid_search"}:
+            raise ValueError("grid_search is not supported by model-based "
+                             "searchers; use BasicVariantGenerator")
+        if isinstance(v, dict):
+            yield from _flatten_domains(v, prefix + (k,))
+        else:
+            yield prefix + (k,), v
+
+
+class TPESearcher(SearchAlgorithm):
+    """Native Tree-structured Parzen Estimator (Bergstra et al., NeurIPS'11).
+
+    Reference capability: python/ray/tune/search/optuna/optuna_search.py and
+    hyperopt/hyperopt_search.py wrap external TPE implementations; here the
+    estimator is built in (no dependency):
+
+    - observations are split at the gamma-quantile into good (l) and bad (g)
+    - numeric dims: Parzen window (gaussian KDE, Scott bandwidth with a
+      floor) per side, in log space for log domains; n_candidates are drawn
+      from l and the one maximizing l(x)/g(x) wins (expected-improvement
+      maximizer for the TPE objective)
+    - categorical dims: smoothed category frequencies on each side, same
+      ratio criterion
+    - first n_initial suggestions are random (seeded) to prime the model
+    """
+
+    def __init__(self, space: Optional[dict] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 n_initial: int = 10, n_candidates: int = 24,
+                 gamma: float = 0.25, seed: Optional[int] = None):
+        if space is not None:
+            self.set_space(space)
+        self._metric = metric
+        self._mode = mode
+        self._n_initial = n_initial
+        self._n_candidates = n_candidates
+        self._gamma = gamma
+        self._rng = random.Random(seed)
+        self._np_rng = np.random.RandomState(seed)
+        # trial_id -> (flat config dict, score or None)
+        self._live: Dict[str, dict] = {}
+        self._obs: List[Tuple[dict, float]] = []
+        self._n_suggested = 0
+
+    # -- protocol ------------------------------------------------------
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        domains = dict(_flatten_domains(self._space))
+        self._n_suggested += 1
+        # Every 4th post-warmup suggestion samples the prior: the factorized
+        # estimator can lock onto a local basin (observed on both numeric
+        # and categorical dims); guaranteed exploration lets the model jump
+        # to a better basin the moment one random trial lands in it.
+        explore = (len(self._obs) >= self._n_initial
+                   and self._n_suggested % 4 == 0)
+        if len(self._obs) < self._n_initial or explore:
+            flat = {p: (d.sample(self._rng) if isinstance(d, Domain) else d)
+                    for p, d in domains.items()}
+        else:
+            split = self._split()  # dimension-independent: compute once
+            flat = {p: self._suggest_dim(p, d, split)
+                    for p, d in domains.items()}
+        self._live[trial_id] = flat
+        cfg: dict = {}
+        for path, v in flat.items():
+            _set_path(cfg, path, v)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict]):
+        flat = self._live.pop(trial_id, None)
+        if flat is None or not result or self._metric not in result:
+            return
+        sign = 1.0 if self._mode == "max" else -1.0
+        self._obs.append((flat, sign * float(result[self._metric])))
+
+    # -- estimator -----------------------------------------------------
+
+    def _split(self):
+        """(good, bad) observation lists, each entry (flat, score, age_w).
+
+        age_w implements hyperopt-style linear forgetting: the latest 25
+        observations weigh 1.0, older ones ramp down linearly. Early trials
+        mis-blame dimensions (a good category tried with a bad numeric
+        lands in the bad set and is never retried — observed lock-in);
+        decaying stale evidence lets the marginal recover.
+        """
+        n = len(self._obs)
+        ramp = 25
+
+        def age_w(idx):
+            if n <= ramp or idx >= n - ramp:
+                return 1.0
+            return max(1.0 / ramp, (idx + 1) / (n - ramp))
+
+        obs = sorted(
+            ((flat, score, age_w(i))
+             for i, (flat, score) in enumerate(self._obs)),
+            key=lambda o: -o[1])
+        # Hyperopt's split size: ceil(gamma * sqrt(n)) capped at 25 — a
+        # small elite set means one newly-found better basin immediately
+        # dominates the good-side density (a linear-in-n good set keeps the
+        # incumbent cluster in charge and relocks).
+        n_good = max(1, min(25, int(np.ceil(self._gamma * np.sqrt(n)))))
+        return obs[:n_good], obs[n_good:]
+
+    def _suggest_dim(self, path, dom, split):
+        if not isinstance(dom, Domain):
+            return dom
+        good, bad = split
+        gx = [(o[0][path], o[2]) for o in good if path in o[0]]
+        bx = [(o[0][path], o[2]) for o in bad if path in o[0]]
+        if isinstance(dom, Categorical):
+            return self._suggest_categorical(dom, gx, bx)
+        if isinstance(dom, (Float, Integer, Normal)):
+            return self._suggest_numeric(dom, [v for v, _ in gx],
+                                         [v for v, _ in bx])
+        return dom.sample(self._rng)
+
+    def _suggest_categorical(self, dom: Categorical, gx, bx):
+        cats = dom.categories
+        # Laplace-smoothed, age-weighted frequencies on each side.
+        def freqs(xs):
+            counts = np.array([1.0 + sum(w for x, w in xs if x == c)
+                               for c in cats])
+            return counts / counts.sum()
+        lf, gf = freqs(gx), freqs(bx)
+        # Every category competes on the l/g ratio (the domain is small, so
+        # no need to subsample candidates — and it removes draw-order luck).
+        best = max(range(len(cats)), key=lambda i: lf[i] / gf[i])
+        return cats[int(best)]
+
+    def _numeric_transform(self, dom, x):
+        x = np.asarray(x, dtype=np.float64)
+        return np.log(x) if getattr(dom, "log", False) else x
+
+    def _numeric_untransform(self, dom, x):
+        v = float(np.exp(x)) if getattr(dom, "log", False) else float(x)
+        if isinstance(dom, Integer):
+            v = int(round(v))
+            if dom.q:
+                v = int(round(v / dom.q) * dom.q)
+            return max(dom.lo, min(v, dom.hi - 1))
+        if isinstance(dom, Float):
+            if dom.q:
+                v = round(v / dom.q) * dom.q
+            return min(max(v, dom.lo), dom.hi)
+        return v
+
+    def _bounds(self, dom):
+        if isinstance(dom, (Float, Integer)):
+            lo, hi = float(dom.lo), float(dom.hi)
+            if getattr(dom, "log", False):
+                return np.log(lo), np.log(hi)
+            return lo, hi
+        return -np.inf, np.inf
+
+    def _kde(self, dom, xs):
+        """Per-component (means, bandwidths) of the Parzen mixture.
+
+        Hyperopt-style: each observation gets a bandwidth equal to its
+        larger neighbor gap (clipped to [span/50, span]), and a wide prior
+        component at the domain center joins the mixture — without it the
+        estimator collapses onto the incumbent cluster and crawls
+        (measured: ~0.01/step drift on a 1D quadratic)."""
+        lo, hi = self._bounds(dom)
+        if np.isfinite(hi - lo):
+            span = hi - lo
+            prior_mu = (hi + lo) / 2
+        else:
+            span = (np.std(xs) * 6 + 1.0) if len(xs) else 1.0
+            prior_mu = float(np.mean(xs)) if len(xs) else 0.0
+        if len(xs) == 0:
+            return (np.array([prior_mu]), np.array([max(span, 1e-12)]),
+                    np.array([1.0]))
+        xs = np.sort(np.asarray(xs, dtype=np.float64))
+        gaps_left = np.diff(xs, prepend=xs[0] - span)
+        gaps_right = np.diff(xs, append=xs[-1] + span)
+        bws = np.clip(np.maximum(gaps_left, gaps_right),
+                      span / 50.0, span)
+        means = np.append(xs, prior_mu)
+        bws = np.append(bws, span)
+        # The prior keeps ~25% of the mixture mass: pure observation
+        # mixtures collapse onto the incumbent cluster and crawl toward
+        # distant optima one bandwidth per round.
+        weights = np.append(np.ones(len(xs)), max(1.0, 0.33 * len(xs)))
+        return means, bws, weights / weights.sum()
+
+    @staticmethod
+    def _log_pdf(x, means, bws, weights):
+        z = (x[:, None] - means[None, :]) / bws[None, :]
+        comp = (-0.5 * z * z - np.log(bws[None, :] * np.sqrt(2 * np.pi))
+                + np.log(weights[None, :]))
+        m = comp.max(axis=1)
+        return m + np.log(np.sum(np.exp(comp - m[:, None]), axis=1))
+
+    def _suggest_numeric(self, dom, gx, bx):
+        gt = self._numeric_transform(dom, gx) if len(gx) else np.array([])
+        bt = self._numeric_transform(dom, bx) if len(bx) else np.array([])
+        l_means, l_bws, l_w = self._kde(dom, gt)
+        g_means, g_bws, g_w = self._kde(dom, bt)
+        lo, hi = self._bounds(dom)
+        # Sample candidates from l (components by weight).
+        picks = self._np_rng.choice(len(l_means), size=self._n_candidates,
+                                    p=l_w)
+        cands = (l_means[picks]
+                 + self._np_rng.randn(self._n_candidates) * l_bws[picks])
+        if np.isfinite(lo):
+            # Reflect out-of-range candidates back inside instead of
+            # clipping: clipping piles a point-mass on the boundary that
+            # self-reinforces (observed: lr stuck at the domain edge).
+            span = hi - lo
+            cands = np.abs(cands - lo) % (2 * span)
+            cands = lo + np.where(cands > span, 2 * span - cands, cands)
+        score = (self._log_pdf(cands, l_means, l_bws, l_w)
+                 - self._log_pdf(cands, g_means, g_bws, g_w))
+        return self._numeric_untransform(dom, cands[int(np.argmax(score))])
 
 
 class BasicVariantGenerator(SearchAlgorithm):

@@ -32,6 +32,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[TrialScheduler] = None
+    # Model-based searcher (e.g. tune.search.TPESearcher): trials are
+    # created lazily so each suggestion conditions on completed results.
+    search_alg: Optional[Any] = None
     seed: Optional[int] = None
 
     def __post_init__(self):
@@ -272,8 +275,16 @@ class Tuner:
             scheduler.set_metric(tc.metric, tc.mode)
         elif not isinstance(scheduler, FIFOScheduler):
             raise ValueError("schedulers other than FIFO require a metric")
+        searcher = tc.search_alg if self._restored_trials is None else None
+        if searcher is not None:
+            if not tc.metric:
+                raise ValueError("search_alg requires TuneConfig.metric")
+            searcher.set_space(self._param_space)
+            searcher.set_metric(tc.metric, tc.mode)
         if self._restored_trials is not None:
             trials = self._restored_trials
+        elif searcher is not None:
+            trials = []  # created lazily from searcher suggestions
         else:
             variants = BasicVariantGenerator(
                 self._param_space, tc.num_samples, tc.seed).variants()
@@ -298,6 +309,11 @@ class Tuner:
 
         def terminate(t: Trial, status: str):
             t.status = status
+            if searcher is not None:
+                try:
+                    searcher.on_trial_complete(t.trial_id, t.last_result)
+                except Exception:
+                    pass
             if t.actor is not None:
                 try:
                     # Run the Trainable.cleanup() hook before killing the
@@ -348,9 +364,30 @@ class Tuner:
             else:
                 t.pending_ref = t.actor.next_result.remote()
 
+        searcher_done = searcher is None
+
+        def spawn_from_searcher(running, pending):
+            """Lazily create trials so each suggestion sees prior results."""
+            nonlocal searcher_done
+            import uuid as _uuid
+            while (not searcher_done and len(trials) < tc.num_samples
+                   and len(running) + len(pending) < max_conc):
+                tid = _uuid.uuid4().hex[:8]
+                cfg = searcher.suggest(tid)
+                if cfg is None:
+                    searcher_done = True
+                    return
+                nt = Trial(config=cfg, trial_id=tid)
+                trials.append(nt)
+                pending.append(nt)
+            if len(trials) >= tc.num_samples:
+                searcher_done = True
+
         while True:
             running = [t for t in trials if t.status == RUNNING]
             pending = [t for t in trials if t.status == PENDING]
+            if searcher is not None:
+                spawn_from_searcher(running, pending)
             if not running and not pending:
                 break
             while pending and len(running) < max_conc:
