@@ -107,13 +107,12 @@ def init(address: Optional[str] = None, *,
                                      or address.startswith("ray://")):
         # Client mode (reference: ray.init("ray://...")): the process
         # never joins the cluster network; the whole API proxies through
-        # the head's ClientServer.
-        if runtime_env is not None:
-            raise NotImplementedError(
-                "runtime_env is not supported in client mode yet")
+        # the head's ClientServer. runtime_env packages are zipped locally
+        # and shipped with the first submission that references them.
         from ray_tpu.util.client import ClientContext
         endpoint = address.split("://", 1)[1]
-        _state.client = ClientContext(endpoint, namespace=namespace)
+        _state.client = ClientContext(endpoint, namespace=namespace,
+                                      runtime_env=runtime_env)
         _state.namespace = namespace
         _state.initialized = True
         atexit.register(shutdown)

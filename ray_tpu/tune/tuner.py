@@ -309,7 +309,10 @@ class Tuner:
 
         def terminate(t: Trial, status: str):
             t.status = status
-            if searcher is not None:
+            # Terminal statuses only: PBT's exploit path calls
+            # terminate(t, RUNNING) to restart an actor mid-trial, which
+            # must not feed a bogus completion into the searcher.
+            if searcher is not None and status in (TERMINATED, ERROR):
                 try:
                     searcher.on_trial_complete(t.trial_id, t.last_result)
                 except Exception:

@@ -58,6 +58,48 @@ class ClientObjectRef:
             pass
 
 
+class ClientObjectRefGenerator:
+    """Client-side iterator over a streaming task's return refs: each
+    __next__ round-trips to the proxy, which forwards the server-side
+    ObjectRefStream (reference: ray_client.proto streaming generators)."""
+
+    def __init__(self, task_id: bytes, ctx: "ClientContext"):
+        self._task_id = task_id
+        self._ctx = ctx
+        self._cursor = 0
+        self._exhausted = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ClientObjectRef":
+        if self._exhausted:
+            raise StopIteration
+        out = self._ctx._maybe_raise(self._ctx._call(
+            "client_generator_next",
+            {"task_id": self._task_id, "cursor": self._cursor},
+            timeout=3600.0))
+        if out is None:
+            self._exhausted = True
+            raise StopIteration
+        self._cursor += 1
+        rid, owner = out
+        return ClientObjectRef(rid, owner, self._ctx)
+
+    def __del__(self):
+        # Abandoned mid-stream: tell the server to free the stream and the
+        # never-consumed return objects (locally this is
+        # core.release_generator via ObjectRefGenerator.__del__).
+        if self._exhausted:
+            return
+        try:
+            self._ctx._notify("client_generator_release",
+                              {"task_id": self._task_id,
+                               "consumed": self._cursor})
+        except Exception:
+            pass
+
+
 class ClientActorMethod:
     def __init__(self, handle: "ClientActorHandle", name: str,
                  num_returns: int = 1):
@@ -70,19 +112,19 @@ class ClientActorMethod:
                                  opts.get("num_returns", self._num_returns))
 
     def remote(self, *args, **kwargs):
-        if kwargs:
-            raise ValueError("client mode supports positional args only")
-        if self._num_returns == "streaming":
-            raise NotImplementedError(
-                "num_returns='streaming' is not supported in client mode")
         ctx = self._handle._ctx
-        refs = ctx._call("client_submit_actor_task", {
+        streaming = self._num_returns == "streaming"
+        reply = ctx._call("client_submit_actor_task", {
             "actor_id": self._handle._actor_id,
             "method": self._name,
             "args": ctx._tag_args(args),
-            "num_returns": self._num_returns,
+            "kwargs": ctx._tag_kwargs(kwargs),
+            "num_returns": 0 if streaming else self._num_returns,
+            "is_generator": streaming,
         })
-        out = [ClientObjectRef(r, o, ctx) for r, o in refs]
+        if streaming:
+            return ClientObjectRefGenerator(reply, ctx)
+        out = [ClientObjectRef(r, o, ctx) for r, o in reply]
         return out[0] if self._num_returns == 1 else out
 
 
@@ -100,13 +142,19 @@ class ClientActorHandle:
 class ClientContext:
     """Client-side driver façade; one RPC connection to the ClientServer."""
 
-    def __init__(self, address: str, namespace: str = ""):
+    def __init__(self, address: str, namespace: str = "",
+                 runtime_env: Optional[dict] = None):
         from ray_tpu._private.serialization import SerializationContext
+        from ray_tpu._private import runtime_env as re_mod
         self.address = address
         self.namespace = namespace
         self.session = uuid.uuid4().hex
         self.serialization = SerializationContext()
+        self.job_runtime_env = re_mod.validate(runtime_env)
         self._exported: set = set()     # function/class ids the server has
+        self._shipped_pkgs: set = set()  # uris CONFIRMED stored server-side
+        self._pkg_uri_by_path: Dict[tuple, str] = {}  # (path, sig) -> uri
+        self._pkg_data: Dict[str, bytes] = {}  # unconfirmed payloads
         self._loop = asyncio.new_event_loop()
         self._conn = None
         ready = threading.Event()
@@ -146,6 +194,56 @@ class ClientContext:
                             self.serialization.serialize(a).to_bytes()))
         return out
 
+    def _tag_kwargs(self, kwargs: dict) -> dict:
+        return {k: self._tag_args([v])[0] for k, v in kwargs.items()}
+
+    def _prepare_runtime_env(self, env: Optional[dict]):
+        """Merge over the job env, package LOCAL dirs on the client, and
+        ship missing package payloads with the call (the server has no
+        access to the client's filesystem — reference:
+        runtime_env/packaging.py upload_package_if_needed over ray_client).
+        Returns (env_with_pkg_uris, {uri: zip_bytes}) or (None, {}).
+        """
+        from ray_tpu._private import runtime_env as re_mod
+        env = re_mod.merge(self.job_runtime_env, re_mod.validate(env))
+        if not env:
+            return None, {}
+        env = dict(env)
+        packages: Dict[str, bytes] = {}
+
+        def pack(path: str) -> str:
+            import os as _os
+            if path.startswith("pkg://"):
+                return path
+            path = _os.path.abspath(path)
+            # Cheap stat signature gates the re-zip: repeat submissions of
+            # an unchanged dir must not walk+zip it every call.
+            sig = re_mod.tree_signature(path)
+            uri = self._pkg_uri_by_path.get((path, sig))
+            if uri is None:
+                uri, data = re_mod.package_dir(path)
+                self._pkg_uri_by_path[(path, sig)] = uri
+                if uri not in self._shipped_pkgs:
+                    self._pkg_data[uri] = data
+            # Attach the payload on every call until a carrying RPC
+            # SUCCEEDS (_confirm_pkgs) — marking shipped optimistically
+            # would strand the package for the session if the first
+            # submission fails.
+            if uri not in self._shipped_pkgs and uri in self._pkg_data:
+                packages[uri] = self._pkg_data[uri]
+            return uri
+
+        if env.get("working_dir"):
+            env["working_dir"] = pack(env["working_dir"])
+        if env.get("py_modules"):
+            env["py_modules"] = [pack(p) for p in env["py_modules"]]
+        return env, packages
+
+    def _confirm_pkgs(self, packages: Dict[str, bytes]):
+        for uri in packages:
+            self._shipped_pkgs.add(uri)
+            self._pkg_data.pop(uri, None)
+
     def _maybe_raise(self, result):
         """Server ships task/application errors as data so the original
         exception type survives the proxy (a raw handler raise would reach
@@ -154,16 +252,19 @@ class ClientContext:
             raise self.serialization.deserialize(result["__client_error__"])
         return result
 
-    def _release(self, ref_id: bytes):
+    def _notify(self, method: str, payload: dict):
+        """Fire-and-forget notification (safe from __del__/GC contexts)."""
         if self._conn is None or self._conn.closed:
             return
         try:
+            payload["session"] = self.session
             asyncio.run_coroutine_threadsafe(
-                self._conn.notify("client_release",
-                                  {"session": self.session,
-                                   "refs": [ref_id]}), self._loop)
+                self._conn.notify(method, payload), self._loop)
         except Exception:
             pass
+
+    def _release(self, ref_id: bytes):
+        self._notify("client_release", {"refs": [ref_id]})
 
     # -- public API ----------------------------------------------------
 
@@ -197,23 +298,27 @@ class ClientContext:
         return ([by_id[r] for r in ready], [by_id[r] for r in not_ready])
 
     def submit_function(self, remote_fn, args, kwargs, opts: dict):
-        if kwargs:
-            raise ValueError("client mode supports positional args only")
         from ray_tpu.remote_function import _resources_from_options
         num_returns = opts.get("num_returns", 1)
-        if num_returns == "streaming":
-            raise NotImplementedError(
-                "num_returns='streaming' is not supported in client mode")
+        streaming = num_returns == "streaming"
         fid, blob = self._function_blob(remote_fn._function, "fn")
-        refs = self._call("client_submit_task", {
+        env, packages = self._prepare_runtime_env(opts.get("runtime_env"))
+        reply = self._call("client_submit_task", {
             "function_blob": blob, "function_id": fid,
             "name": getattr(remote_fn, "__name__", "fn"),
             "args": self._tag_args(args),
-            "num_returns": num_returns,
+            "kwargs": self._tag_kwargs(kwargs),
+            "num_returns": 0 if streaming else num_returns,
+            "is_generator": streaming,
             "resources": _resources_from_options(opts),
             "max_retries": opts.get("max_retries", -1),
+            "runtime_env": env,
+            "packages": packages,
         })
-        out = [ClientObjectRef(r, o, self) for r, o in refs]
+        self._confirm_pkgs(packages)
+        if streaming:
+            return ClientObjectRefGenerator(reply, self)
+        out = [ClientObjectRef(r, o, self) for r, o in reply]
         return out[0] if num_returns == 1 else out
 
     def _function_blob(self, func, kind: str):
@@ -237,8 +342,6 @@ class ClientContext:
         return fid, blob
 
     def create_actor(self, actor_cls, args, kwargs, opts: dict):
-        if kwargs:
-            raise ValueError("client mode supports positional args only")
         from ray_tpu.remote_function import _resources_from_options
         cid, blob = self._function_blob(actor_cls._cls, "actor")
         is_async = actor_cls._is_async()
@@ -247,10 +350,14 @@ class ClientContext:
             or opts.get("num_tpus") is not None
             or opts.get("num_gpus") is not None
             or opts.get("resources")) else {"CPU": 0.0}
+        env, packages = self._prepare_runtime_env(opts.get("runtime_env"))
         actor_id = self._call("client_create_actor", {
             "class_blob": blob, "class_id": cid,
             "class_name": actor_cls.__name__,
             "args": self._tag_args(args),
+            "kwargs": self._tag_kwargs(kwargs),
+            "runtime_env": env,
+            "packages": packages,
             "resources": res,
             "max_restarts": opts.get("max_restarts", 0),
             "max_concurrency": opts.get(
@@ -259,6 +366,7 @@ class ClientContext:
             "name": opts.get("name", ""),
             "namespace": opts.get("namespace") or self.namespace,
         }, timeout=120.0)
+        self._confirm_pkgs(packages)
         return ClientActorHandle(actor_id, self)
 
     def kill(self, handle: ClientActorHandle, no_restart: bool = True):

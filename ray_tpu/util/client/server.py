@@ -30,6 +30,8 @@ class _ClientSession:
         # Refs the client holds, keyed by binary id (pin against GC).
         self.refs: Dict[bytes, ObjectRef] = {}
         self.actors: Dict[bytes, ActorID] = {}
+        # Streaming generators the client iterates, keyed by task id.
+        self.generators: Dict[bytes, Any] = {}
 
     def track(self, ref: ObjectRef):
         self.refs[ref.id.binary()] = ref
@@ -53,7 +55,8 @@ class ClientServer:
         for name in ("connect", "put", "get", "wait", "submit_task",
                      "create_actor", "submit_actor_task", "kill_actor",
                      "get_named_actor", "release", "cluster_resources",
-                     "nodes", "cancel", "disconnect"):
+                     "nodes", "cancel", "disconnect", "generator_next",
+                     "generator_release"):
             self.server.register(f"client_{name}",
                                  getattr(self, f"rpc_{name}"))
         actual = await self.server.start(host, port)
@@ -158,18 +161,44 @@ class ClientServer:
                 else s.core.serialization.deserialize(v)
                 for kind, v in tagged]
 
+    def _kwargs_of(self, s: _ClientSession, tagged: Optional[dict]) -> dict:
+        if not tagged:
+            return {}
+        return {k: self._args_of(s, [v])[0] for k, v in tagged.items()}
+
+    async def _store_packages(self, s: _ClientSession,
+                              packages: Optional[dict]):
+        """Client-shipped runtime-env packages -> GCS KV (the server never
+        sees the client filesystem)."""
+        for uri, data in (packages or {}).items():
+            key = ("pkg:" + uri[len("pkg://"):]).encode()
+            exists = await s.core.gcs.request("kv_exists", {
+                "namespace": "packages", "key": key})
+            if not exists:
+                await s.core.gcs.request("kv_put", {
+                    "namespace": "packages", "key": key, "value": data})
+
     async def rpc_submit_task(self, conn, payload):
         s = self._session(payload)
         if payload.get("function_blob"):
             await s.core.export_function_raw(payload["function_blob"],
                                              payload["function_id"])
+        await self._store_packages(s, payload.get("packages"))
         args = self._args_of(s, payload["args"])
+        kwargs = self._kwargs_of(s, payload.get("kwargs"))
+        is_gen = payload.get("is_generator", False)
         refs = s.core.submit_task_local(
-            payload["function_id"], tuple(args), {},
+            payload["function_id"], tuple(args), kwargs,
             name=payload.get("name", ""),
             num_returns=payload.get("num_returns", 1),
             resources=payload.get("resources"),
-            max_retries=payload.get("max_retries", -1))
+            max_retries=payload.get("max_retries", -1),
+            is_generator=is_gen,
+            runtime_env=payload.get("runtime_env"))
+        if is_gen:
+            gen = refs[0]  # ObjectRefGenerator
+            s.generators[gen._task_id.binary()] = gen
+            return gen._task_id.binary()
         return [s.track(r) for r in refs]
 
     async def rpc_create_actor(self, conn, payload):
@@ -177,16 +206,19 @@ class ClientServer:
         if payload.get("class_blob"):
             await s.core.export_function_raw(payload["class_blob"],
                                              payload["class_id"])
+        await self._store_packages(s, payload.get("packages"))
         args = self._args_of(s, payload["args"])
+        kwargs = self._kwargs_of(s, payload.get("kwargs"))
         actor_id, done = s.core.create_actor_local(
-            payload["class_id"], tuple(args), {},
+            payload["class_id"], tuple(args), kwargs,
             class_name=payload.get("class_name", ""),
             resources=payload.get("resources"),
             max_restarts=payload.get("max_restarts", 0),
             max_concurrency=payload.get("max_concurrency", 1),
             is_async=payload.get("is_async", False),
             name=payload.get("name", ""),
-            namespace=payload.get("namespace", ""))
+            namespace=payload.get("namespace", ""),
+            runtime_env=payload.get("runtime_env"))
         await done
         s.actors[actor_id.binary()] = actor_id
         return actor_id.binary()
@@ -195,10 +227,46 @@ class ClientServer:
         s = self._session(payload)
         actor_id = ActorID(payload["actor_id"])
         args = self._args_of(s, payload["args"])
+        kwargs = self._kwargs_of(s, payload.get("kwargs"))
+        is_gen = payload.get("is_generator", False)
         refs = s.core.submit_actor_task_local(
-            actor_id, payload["method"], tuple(args), {},
-            num_returns=payload.get("num_returns", 1))
+            actor_id, payload["method"], tuple(args), kwargs,
+            num_returns=payload.get("num_returns", 1),
+            is_generator=is_gen)
+        if is_gen:
+            gen = refs[0]
+            s.generators[gen._task_id.binary()] = gen
+            return gen._task_id.binary()
         return [s.track(r) for r in refs]
+
+    async def rpc_generator_next(self, conn, payload):
+        """Next ref of a streaming generator; None when exhausted. The
+        client passes an explicit cursor so a retried request cannot skip
+        an item."""
+        s = self._session(payload)
+        tid = payload["task_id"]
+        gen = s.generators.get(tid)
+        if gen is None:
+            raise ValueError(f"unknown generator {tid.hex()[:12]}")
+        try:
+            ref = await s.core.generator_next(gen._task_id,
+                                              payload["cursor"])
+        except Exception as e:  # noqa: BLE001 — ship original error
+            return {"__client_error__":
+                    s.core.serialization.serialize(e).to_bytes()}
+        if ref is None:
+            s.generators.pop(tid, None)
+            return None
+        return s.track(ref)
+
+    async def rpc_generator_release(self, conn, payload):
+        """Client abandoned a stream: free it + unconsumed return objects."""
+        s = self._session(payload)
+        gen = s.generators.pop(payload["task_id"], None)
+        if gen is not None:
+            s.core.release_generator(gen._task_id,
+                                     payload.get("consumed", 0))
+        return True
 
     async def rpc_kill_actor(self, conn, payload):
         s = self._session(payload)

@@ -105,6 +105,52 @@ CLIENT_SCRIPT = textwrap.dedent("""
     assert any(n["IsHead"] for n in ray_tpu.nodes())
     # cluster view crosses the proxy
     assert ray_tpu.cluster_resources().get("CPU", 0) >= 2
+
+    # ---- kwargs in .remote() (tasks, actors, actor methods) ----
+    @ray_tpu.remote
+    def kw(a, b=0, c=0):
+        return a + 10 * b + 100 * c
+
+    assert ray_tpu.get(kw.remote(1, c=3, b=2), timeout=60) == 321
+
+    @ray_tpu.remote
+    class KwActor:
+        def __init__(self, base, scale=1):
+            self.base = base * scale
+        def calc(self, x, mul=1):
+            return self.base + x * mul
+
+    ka = KwActor.remote(5, scale=2)
+    assert ray_tpu.get(ka.calc.remote(3, mul=4), timeout=60) == 22
+    ray_tpu.kill(ka)
+
+    # ---- streaming generators over the proxy ----
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    vals = [ray_tpu.get(r, timeout=30) for r in gen.remote(4)]
+    assert vals == [0, 1, 4, 9], vals
+
+    # ---- runtime_env: env_vars + working_dir shipped from the client ----
+    import tempfile, pathlib
+    wd = tempfile.mkdtemp()
+    pathlib.Path(wd, "payload.txt").write_text("from-the-client")
+
+    @ray_tpu.remote
+    def read_env():
+        import os
+        return (os.environ.get("CLIENT_FLAG"),
+                open("payload.txt").read())
+
+    flag, text = ray_tpu.get(
+        read_env.options(runtime_env={{
+            "env_vars": {{"CLIENT_FLAG": "yes"}},
+            "working_dir": wd,
+        }}).remote(), timeout=120)
+    assert flag == "yes" and text == "from-the-client", (flag, text)
+
     ray_tpu.shutdown()
     print("CLIENT-OK")
 """)
