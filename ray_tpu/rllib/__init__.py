@@ -10,7 +10,9 @@ update); rollout workers are CPU actors; the learner batch is a single
 device_put + one fused jit step instead of a torch DDP loop.
 """
 
-from ray_tpu.rllib.env import CartPoleEnv, EnvSpec, make_env, register_env
+from ray_tpu.rllib.env import (CartPoleEnv, EnvSpec, MultiAgentEnv,
+                               MultiCartPole, PendulumEnv, make_env,
+                               register_env)
 from ray_tpu.rllib.sample_batch import (MultiAgentBatch, SampleBatch,
                                         concat_samples)
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
@@ -19,13 +21,15 @@ from ray_tpu.rllib.algorithms.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.offline import JsonReader, JsonWriter
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Impala",
     "ImpalaConfig", "APPO", "APPOConfig", "DQN", "DQNConfig", "BC",
-    "BCConfig", "EnvSpec", "CartPoleEnv", "make_env", "register_env",
-    "SampleBatch", "MultiAgentBatch", "concat_samples", "ReplayBuffer",
-    "PrioritizedReplayBuffer", "JsonReader", "JsonWriter",
+    "BCConfig", "SAC", "SACConfig", "EnvSpec", "CartPoleEnv",
+    "PendulumEnv", "MultiAgentEnv", "MultiCartPole", "make_env",
+    "register_env", "SampleBatch", "MultiAgentBatch", "concat_samples",
+    "ReplayBuffer", "PrioritizedReplayBuffer", "JsonReader", "JsonWriter",
 ]

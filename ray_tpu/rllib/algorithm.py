@@ -31,6 +31,10 @@ class AlgorithmConfig:
         self.num_epochs = 8
         self.hidden = (64, 64)
         self.seed = 0
+        # Multi-agent (set via .multi_agent()); declared here so the plain
+        # dict config path (Tune param_space) round-trips them too.
+        self.policies: Optional[List[str]] = None
+        self.policy_mapping_fn: Optional[Callable[[str], str]] = None
         self.extra: Dict[str, Any] = {}
 
     # -- fluent sections (reference: AlgorithmConfig.environment etc.) ----
@@ -69,6 +73,20 @@ class AlgorithmConfig:
             self.hidden = tuple(model["fcnet_hiddens"])
         self.extra.update(extra)
         return self
+
+    def multi_agent(self, *, policies=None,
+                    policy_mapping_fn=None) -> "AlgorithmConfig":
+        """Declare policies + the agent->policy mapping (reference:
+        algorithm_config.py multi_agent())."""
+        if policies is not None:
+            self.policies = list(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    @property
+    def is_multi_agent(self) -> bool:
+        return bool(getattr(self, "policies", None))
 
     def debugging(self, *, seed=None) -> "AlgorithmConfig":
         if seed is not None:
@@ -117,19 +135,30 @@ class Algorithm(Trainable):
     # -- Trainable API ------------------------------------------------------
     def setup(self, config: Dict[str, Any]):
         from ray_tpu.rllib.env import get_env_creator
-        from ray_tpu.rllib.env_runner import EnvRunner
+        from ray_tpu.rllib.env_runner import EnvRunner, MultiAgentEnvRunner
         cfg = self.algo_config
         # Resolve the env creator here (driver-side registry) so custom
         # registered envs work inside worker processes.
         creator = get_env_creator(cfg.env)
-        runner_cls = ray_tpu.remote(num_cpus=1)(EnvRunner)
-        self.env_runners = [
-            runner_cls.remote(creator, cfg.env_config,
-                              cfg.num_envs_per_env_runner,
-                              seed=cfg.seed + 1000 * i,
-                              hidden=cfg.hidden)
-            for i in range(cfg.num_env_runners)
-        ]
+        if cfg.is_multi_agent:
+            runner_cls = ray_tpu.remote(num_cpus=1)(MultiAgentEnvRunner)
+            self.env_runners = [
+                runner_cls.remote(creator, cfg.env_config,
+                                  cfg.policies, cfg.policy_mapping_fn,
+                                  num_envs=cfg.num_envs_per_env_runner,
+                                  seed=cfg.seed + 1000 * i,
+                                  hidden=cfg.hidden)
+                for i in range(cfg.num_env_runners)
+            ]
+        else:
+            runner_cls = ray_tpu.remote(num_cpus=1)(EnvRunner)
+            self.env_runners = [
+                runner_cls.remote(creator, cfg.env_config,
+                                  cfg.num_envs_per_env_runner,
+                                  seed=cfg.seed + 1000 * i,
+                                  hidden=cfg.hidden)
+                for i in range(cfg.num_env_runners)
+            ]
         self._episode_rewards: List[float] = []
         self.build_learner()
 

@@ -40,20 +40,35 @@ class PPOConfig(AlgorithmConfig):
 class PPO(Algorithm):
     config_class = PPOConfig
 
-    def build_learner(self):
+    def _make_learner(self, probe, seed_offset: int = 0):
         cfg = self.algo_config
-        probe = make_env(cfg.env, cfg.env_config)
-        self.learner = PPOLearner(
+        return PPOLearner(
             probe.observation_dim, probe.num_actions,
             hidden=cfg.hidden, lr=cfg.lr,
             clip_param=getattr(cfg, "clip_param", 0.2),
             vf_coeff=getattr(cfg, "vf_loss_coeff", 0.5),
             entropy_coeff=getattr(cfg, "entropy_coeff", 0.0),
-            seed=cfg.seed)
-        self.broadcast_weights(self.learner.get_weights())
+            seed=cfg.seed + seed_offset)
+
+    def build_learner(self):
+        cfg = self.algo_config
+        probe = make_env(cfg.env, cfg.env_config)
+        if cfg.is_multi_agent:
+            # One learner per policy (reference: Learner per module in the
+            # MultiRLModule); distinct seeds so policies don't start as
+            # clones; weights broadcast as a policy-keyed dict.
+            self.learners = {pid: self._make_learner(probe, seed_offset=j)
+                             for j, pid in enumerate(cfg.policies)}
+            self.broadcast_weights({pid: ln.get_weights()
+                                    for pid, ln in self.learners.items()})
+        else:
+            self.learner = self._make_learner(probe)
+            self.broadcast_weights(self.learner.get_weights())
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.algo_config
+        if cfg.is_multi_agent:
+            return self._multi_agent_training_step()
         batch = concat_samples(ray_tpu.get(self.sample_all_runners()))
         metrics = self.learner.update(
             batch, minibatch_size=min(cfg.minibatch_size, len(batch)),
@@ -62,11 +77,42 @@ class PPO(Algorithm):
         metrics["num_env_steps_sampled"] = len(batch)
         return metrics
 
+    def _multi_agent_training_step(self) -> Dict[str, Any]:
+        from ray_tpu.rllib.sample_batch import MultiAgentBatch
+        cfg = self.algo_config
+        ma = MultiAgentBatch.concat_samples(
+            ray_tpu.get(self.sample_all_runners()))
+        metrics: Dict[str, Any] = {}
+        for pid, pbatch in ma.policy_batches.items():
+            if not len(pbatch):
+                continue
+            m = self.learners[pid].update(
+                pbatch, minibatch_size=min(cfg.minibatch_size, len(pbatch)),
+                num_epochs=cfg.num_epochs, seed=cfg.seed + self._iteration)
+            for k, v in m.items():
+                metrics[f"{pid}/{k}"] = v
+        self.broadcast_weights({pid: ln.get_weights()
+                                for pid, ln in self.learners.items()})
+        metrics["num_env_steps_sampled"] = ma.env_steps()
+        metrics["num_agent_steps_sampled"] = ma.agent_steps()
+        return metrics
+
     def save_checkpoint(self):
+        if self.algo_config.is_multi_agent:
+            return {"params": {pid: ln.get_weights()
+                               for pid, ln in self.learners.items()},
+                    "iteration": self._iteration}
         return {"params": self.learner.get_weights(),
                 "iteration": self._iteration}
 
     def load_checkpoint(self, ckpt):
+        if self.algo_config.is_multi_agent:
+            for pid, w in ckpt["params"].items():
+                self.learners[pid].set_weights(w)
+            self._iteration = ckpt.get("iteration", 0)
+            self.broadcast_weights({pid: ln.get_weights()
+                                    for pid, ln in self.learners.items()})
+            return
         self.learner.set_weights(ckpt["params"])
         self._iteration = ckpt.get("iteration", 0)
         self.broadcast_weights(self.learner.get_weights())

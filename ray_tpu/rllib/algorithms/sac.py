@@ -1,0 +1,236 @@
+"""SAC: Soft Actor-Critic for continuous control.
+
+Reference parity: rllib/algorithms/sac/sac.py (+ sac_torch_policy losses):
+tanh-squashed Gaussian actor, clipped double-Q critics with Polyak-averaged
+targets, and automatic entropy-temperature tuning (target entropy
+-action_dim). The whole update (critic + actor + alpha + target sync) is
+ONE jitted JAX function; collection runs on ContinuousEnvRunner actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SAC)
+        self.env = "Pendulum-v1"
+        self.tau = 0.005
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.initial_alpha = 1.0
+        self.target_entropy = None          # None => -action_dim
+        self.buffer_capacity = 100_000
+        self.random_warmup_steps = 500
+        self.grad_steps_per_iter = 0        # 0 => one per sampled step
+        self.train_batch_size = 256
+        self.rollout_fragment_length = 64
+
+    def training(self, *, tau=None, actor_lr=None, critic_lr=None,
+                 alpha_lr=None, initial_alpha=None, target_entropy=None,
+                 buffer_capacity=None, random_warmup_steps=None,
+                 grad_steps_per_iter=None, **kw) -> "SACConfig":
+        super().training(**kw)
+        for name, v in (("tau", tau), ("actor_lr", actor_lr),
+                        ("critic_lr", critic_lr), ("alpha_lr", alpha_lr),
+                        ("initial_alpha", initial_alpha),
+                        ("target_entropy", target_entropy),
+                        ("buffer_capacity", buffer_capacity),
+                        ("random_warmup_steps", random_warmup_steps),
+                        ("grad_steps_per_iter", grad_steps_per_iter)):
+            if v is not None:
+                setattr(self, name, v)
+        return self
+
+
+class SACLearner:
+    """One jitted SAC update: critic TD + actor reparameterized + alpha."""
+
+    def __init__(self, obs_dim: int, action_dim: int, low: float,
+                 high: float, *, hidden=(64, 64), actor_lr=3e-4,
+                 critic_lr=3e-4, alpha_lr=3e-4, gamma=0.99, tau=0.005,
+                 initial_alpha=1.0, target_entropy=None, seed=0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from ray_tpu.rllib.models import (squashed_gaussian_init,
+                                          squashed_gaussian_sample,
+                                          twin_q_init, twin_q_apply)
+        if target_entropy is None:
+            target_entropy = -float(action_dim)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        self.state = {
+            "actor": squashed_gaussian_init(k1, obs_dim, action_dim,
+                                            hidden=tuple(hidden)),
+            "critic": twin_q_init(k2, obs_dim, action_dim,
+                                  hidden=tuple(hidden)),
+            "log_alpha": jnp.log(jnp.float32(initial_alpha)),
+        }
+        self.state["target_critic"] = jax.tree_util.tree_map(
+            lambda x: x, self.state["critic"])
+        self._opt_actor = optax.adam(actor_lr)
+        self._opt_critic = optax.adam(critic_lr)
+        self._opt_alpha = optax.adam(alpha_lr)
+        self.opt_state = {
+            "actor": self._opt_actor.init(self.state["actor"]),
+            "critic": self._opt_critic.init(self.state["critic"]),
+            "alpha": self._opt_alpha.init(self.state["log_alpha"]),
+        }
+
+        def critic_loss(critic, state, batch, rng):
+            a2, logp2 = squashed_gaussian_sample(
+                rng, state["actor"], batch[sb.NEXT_OBS], low, high)
+            tq1, tq2 = twin_q_apply(state["target_critic"],
+                                    batch[sb.NEXT_OBS], a2)
+            alpha = jnp.exp(state["log_alpha"])
+            target = batch[sb.REWARDS] + gamma * (
+                1.0 - batch[sb.TERMINATEDS]) * (
+                    jnp.minimum(tq1, tq2) - alpha * logp2)
+            target = jax.lax.stop_gradient(target)
+            q1, q2 = twin_q_apply(critic, batch[sb.OBS], batch[sb.ACTIONS])
+            return ((q1 - target) ** 2 + (q2 - target) ** 2).mean(), \
+                0.5 * (q1.mean() + q2.mean())
+
+        def actor_loss(actor, state, batch, rng):
+            a, logp = squashed_gaussian_sample(rng, actor, batch[sb.OBS],
+                                               low, high)
+            q1, q2 = twin_q_apply(state["critic"], batch[sb.OBS], a)
+            alpha = jnp.exp(state["log_alpha"])
+            return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp.mean()
+
+        def alpha_loss(log_alpha, mean_logp):
+            return -(log_alpha * jax.lax.stop_gradient(
+                mean_logp + target_entropy))
+
+        def update(state, opt_state, batch, rng):
+            rng_c, rng_a = jax.random.split(rng)
+            (c_loss, q_mean), c_grads = jax.value_and_grad(
+                critic_loss, has_aux=True)(state["critic"], state, batch,
+                                           rng_c)
+            upd, opt_state["critic"] = self._opt_critic.update(
+                c_grads, opt_state["critic"], state["critic"])
+            state["critic"] = optax.apply_updates(state["critic"], upd)
+
+            (a_loss, mean_logp), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(state["actor"], state, batch,
+                                          rng_a)
+            upd, opt_state["actor"] = self._opt_actor.update(
+                a_grads, opt_state["actor"], state["actor"])
+            state["actor"] = optax.apply_updates(state["actor"], upd)
+
+            al_loss, al_grad = jax.value_and_grad(alpha_loss)(
+                state["log_alpha"], mean_logp)
+            upd, opt_state["alpha"] = self._opt_alpha.update(
+                al_grad, opt_state["alpha"], state["log_alpha"])
+            state["log_alpha"] = optax.apply_updates(state["log_alpha"],
+                                                     upd)
+
+            state["target_critic"] = jax.tree_util.tree_map(
+                lambda t, s: (1 - tau) * t + tau * s,
+                state["target_critic"], state["critic"])
+            return state, opt_state, {
+                "critic_loss": c_loss, "actor_loss": a_loss,
+                "alpha_loss": al_loss, "alpha": jnp.exp(state["log_alpha"]),
+                "mean_q": q_mean, "entropy": -mean_logp,
+            }
+
+        self._jit_update = jax.jit(update)
+        self._key = jax.random.PRNGKey(seed + 1)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+        jb = {
+            sb.OBS: jnp.asarray(batch[sb.OBS], jnp.float32),
+            sb.ACTIONS: jnp.asarray(batch[sb.ACTIONS],
+                                    jnp.float32).reshape(len(batch), -1),
+            sb.REWARDS: jnp.asarray(batch[sb.REWARDS], jnp.float32),
+            sb.NEXT_OBS: jnp.asarray(batch[sb.NEXT_OBS], jnp.float32),
+            sb.TERMINATEDS: jnp.asarray(batch[sb.TERMINATEDS], jnp.float32),
+        }
+        self._key, sub = jax.random.split(self._key)
+        self.state, self.opt_state, m = self._jit_update(
+            self.state, self.opt_state, jb, sub)
+        return {k: float(v) for k, v in m.items()}
+
+    def get_actor_weights(self):
+        return self.state["actor"]
+
+    def get_weights(self):
+        return self.state
+
+    def set_weights(self, state):
+        self.state = state
+
+
+class SAC(Algorithm):
+    config_class = SACConfig
+
+    def setup(self, config: Dict[str, Any]):
+        from ray_tpu.rllib.env import get_env_creator
+        from ray_tpu.rllib.env_runner import ContinuousEnvRunner
+        cfg = self.algo_config
+        creator = get_env_creator(cfg.env)
+        runner_cls = ray_tpu.remote(num_cpus=1)(ContinuousEnvRunner)
+        self.env_runners = [
+            runner_cls.remote(creator, cfg.env_config,
+                              cfg.num_envs_per_env_runner,
+                              seed=cfg.seed + 1000 * i, hidden=cfg.hidden)
+            for i in range(cfg.num_env_runners)
+        ]
+        self._episode_rewards = []
+        self._steps_sampled = 0
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        self.build_learner()
+
+    def build_learner(self):
+        cfg = self.algo_config
+        probe = make_env(cfg.env, cfg.env_config)
+        self.learner = SACLearner(
+            probe.observation_dim, probe.action_dim, probe.action_low,
+            probe.action_high, hidden=cfg.hidden, actor_lr=cfg.actor_lr,
+            critic_lr=cfg.critic_lr, alpha_lr=cfg.alpha_lr,
+            gamma=cfg.gamma, tau=cfg.tau,
+            initial_alpha=cfg.initial_alpha,
+            target_entropy=cfg.target_entropy, seed=cfg.seed)
+        self.broadcast_weights(self.learner.get_actor_weights())
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        refs = [er.sample_transitions.remote(
+            cfg.rollout_fragment_length, cfg.random_warmup_steps,
+            self._steps_sampled) for er in self.env_runners]
+        batch = concat_samples(ray_tpu.get(refs))
+        self.buffer.add(batch)
+        self._steps_sampled += len(batch)
+        grad_steps = cfg.grad_steps_per_iter or len(batch)
+        metrics: Dict[str, Any] = {}
+        if len(self.buffer) >= cfg.train_batch_size:
+            for _ in range(grad_steps):
+                m = self.learner.update(
+                    self.buffer.sample(cfg.train_batch_size))
+            metrics.update(m)
+        self.broadcast_weights(self.learner.get_actor_weights())
+        metrics["num_env_steps_sampled"] = self._steps_sampled
+        metrics["buffer_size"] = len(self.buffer)
+        return metrics
+
+    def save_checkpoint(self):
+        return {"state": self.learner.get_weights(),
+                "iteration": self._iteration}
+
+    def load_checkpoint(self, ckpt):
+        self.learner.set_weights(ckpt["state"])
+        self._iteration = ckpt.get("iteration", 0)
+        self.broadcast_weights(self.learner.get_actor_weights())

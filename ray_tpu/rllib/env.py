@@ -18,6 +18,11 @@ class Env:
 
     observation_dim: int
     num_actions: int
+    # Continuous-control envs set these instead of num_actions.
+    continuous: bool = False
+    action_dim: int = 0
+    action_low: float = -1.0
+    action_high: float = 1.0
 
     def reset(self, seed: Optional[int] = None):
         raise NotImplementedError
@@ -75,8 +80,118 @@ class CartPoleEnv(Env):
                 truncated, {})
 
 
+class PendulumEnv(Env):
+    """Pendulum-v1 (classic control; no gym dependency): continuous torque
+    in [-2, 2], obs (cos th, sin th, th_dot), reward
+    -(th^2 + 0.1 th_dot^2 + 0.001 a^2); 200-step episodes."""
+
+    observation_dim = 3
+    num_actions = 0
+    continuous = True
+    action_dim = 1
+    action_low = -2.0
+    action_high = 2.0
+
+    def __init__(self, max_steps: int = 200):
+        self._rng = np.random.RandomState()
+        self._max_steps = max_steps
+        self._g = 10.0
+        self._m = 1.0
+        self._l = 1.0
+        self._dt = 0.05
+        self._state = None
+        self._t = 0
+
+    def _obs(self):
+        th, th_dot = self._state
+        return np.array([np.cos(th), np.sin(th), th_dot], np.float32)
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._state = np.array([self._rng.uniform(-np.pi, np.pi),
+                                self._rng.uniform(-1.0, 1.0)])
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        th, th_dot = self._state
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          self.action_low, self.action_high))
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th ** 2 + 0.1 * th_dot ** 2 + 0.001 * u ** 2
+        th_dot = th_dot + (3 * self._g / (2 * self._l) * np.sin(th)
+                           + 3.0 / (self._m * self._l ** 2) * u) * self._dt
+        th_dot = np.clip(th_dot, -8.0, 8.0)
+        th = th + th_dot * self._dt
+        self._state = np.array([th, th_dot])
+        self._t += 1
+        return self._obs(), -float(cost), False, self._t >= self._max_steps, {}
+
+
+class MultiAgentEnv:
+    """Multi-agent interface (reference: rllib/env/multi_agent_env.py):
+    dict-keyed observations/actions/rewards per agent id. Agents may
+    finish at different times; a terminated/truncated agent stops
+    appearing in later observation dicts. The special "__all__" key
+    signals episode end."""
+
+    agents: List[str]
+    observation_dim: int      # per-agent (uniform)
+    num_actions: int          # per-agent (uniform)
+
+    def reset(self, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        raise NotImplementedError
+
+
+class MultiCartPole(MultiAgentEnv):
+    """N independent CartPoles with distinct agent ids — the standard
+    smoke-test topology for multi-agent sampling (each agent's stream must
+    reach its mapped policy with correct credit)."""
+
+    def __init__(self, num_agents: int = 2, max_steps: int = 200):
+        self.agents = [f"agent_{i}" for i in range(num_agents)]
+        self._envs = {a: CartPoleEnv(max_steps=max_steps)
+                      for a in self.agents}
+        self._done: Dict[str, bool] = {}
+        self.observation_dim = 4
+        self.num_actions = 2
+
+    def reset(self, seed: Optional[int] = None):
+        self._done = {a: False for a in self.agents}
+        obs = {}
+        for i, (a, e) in enumerate(self._envs.items()):
+            o, _ = e.reset(seed=None if seed is None else seed + i)
+            obs[a] = o
+        return obs, {}
+
+    def step(self, action_dict: Dict[str, Any]):
+        # A finished agent's FINAL obs stays in the dict (flagged done) so
+        # samplers can bootstrap truncated episodes; it simply stops
+        # appearing in subsequent steps (reference: multi_agent_env.py
+        # returns last observations alongside the done flags).
+        obs, rewards, terms, truncs = {}, {}, {}, {}
+        for a, act in action_dict.items():
+            if self._done[a]:
+                continue
+            o, r, te, tr, _ = self._envs[a].step(act)
+            obs[a], rewards[a] = o, r
+            terms[a], truncs[a] = te, tr
+            if te or tr:
+                self._done[a] = True
+        all_done = all(self._done.values())
+        terms["__all__"] = all_done
+        truncs["__all__"] = all_done
+        return obs, rewards, terms, truncs, {}
+
+
 _ENV_REGISTRY: Dict[str, Callable[[dict], Env]] = {
     "CartPole-v1": lambda cfg: CartPoleEnv(**cfg),
+    "Pendulum-v1": lambda cfg: PendulumEnv(**cfg),
+    "MultiCartPole": lambda cfg: MultiCartPole(**cfg),
 }
 
 

@@ -132,3 +132,234 @@ class EnvRunner:
 
     def ping(self):
         return True
+
+
+class _RewardTracker:
+    """Shared episode-reward bookkeeping for all runner flavors."""
+
+    def _init_rewards(self):
+        self._done_rewards: List[float] = []
+
+    def episode_rewards(self, clear: bool = True) -> List[float]:
+        out = list(self._done_rewards)
+        if clear:
+            self._done_rewards.clear()
+        return out
+
+    def ping(self):
+        return True
+
+
+class ContinuousEnvRunner(_RewardTracker):
+    """Rollout actor for continuous-control (SAC family): actions sampled
+    from the tanh-squashed Gaussian actor; emits transition batches
+    (reference: rollout_worker.py with StochasticSampling exploration)."""
+
+    def __init__(self, env_spec, env_config: dict, num_envs: int,
+                 seed: int, hidden=(64, 64)):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from ray_tpu.rllib.models import (squashed_gaussian_init,
+                                          squashed_gaussian_sample)
+        self._envs = [make_env(env_spec, env_config) for _ in range(num_envs)]
+        e0 = self._envs[0]
+        assert e0.continuous, "ContinuousEnvRunner needs a continuous env"
+        self._low, self._high = e0.action_low, e0.action_high
+        self._seed = seed
+        self._obs = []
+        self._ep_rewards = [0.0] * num_envs
+        self._init_rewards()
+        for i, e in enumerate(self._envs):
+            obs, _ = e.reset(seed=seed + i)
+            self._obs.append(obs)
+        self._key = jax.random.PRNGKey(seed)
+        self._params = squashed_gaussian_init(
+            self._key, e0.observation_dim, e0.action_dim,
+            hidden=tuple(hidden))
+        self._jit_sample = jax.jit(
+            lambda k, p, o: squashed_gaussian_sample(
+                k, p, o, self._low, self._high))
+
+    def set_weights(self, params):
+        self._params = params
+
+    def sample_transitions(self, num_steps: int,
+                           random_until: int = 0,
+                           steps_done: int = 0) -> SampleBatch:
+        """(obs, action, reward, next_obs, done) transitions. The first
+        `random_until` total env steps act uniformly at random (SAC warmup
+        exploration; reference: sac.py num_steps_sampled_before_learning).
+        The warmup RNG mixes the runner seed so parallel runners explore
+        independently."""
+        import jax
+        cols = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS,
+                                sb.NEXT_OBS, sb.TERMINATEDS)}
+        rng = np.random.RandomState(
+            (self._seed * 9973 + steps_done + 1) % (2 ** 31))
+        for t in range(num_steps):
+            obs_arr = np.stack(self._obs)
+            if steps_done + t < random_until:
+                acts = rng.uniform(self._low, self._high,
+                                   size=(len(self._envs),
+                                         self._envs[0].action_dim))
+            else:
+                self._key, sub = jax.random.split(self._key)
+                acts, _ = self._jit_sample(sub, self._params, obs_arr)
+                acts = np.asarray(acts)
+            for i, env in enumerate(self._envs):
+                obs2, r, term, trunc, _ = env.step(acts[i])
+                cols[sb.OBS].append(self._obs[i])
+                cols[sb.ACTIONS].append(acts[i])
+                cols[sb.REWARDS].append(r)
+                cols[sb.NEXT_OBS].append(obs2)
+                cols[sb.TERMINATEDS].append(term)
+                self._ep_rewards[i] += r
+                if term or trunc:
+                    self._done_rewards.append(self._ep_rewards[i])
+                    self._ep_rewards[i] = 0.0
+                    obs2, _ = env.reset()
+                self._obs[i] = obs2
+        return SampleBatch({k: np.asarray(v) for k, v in cols.items()})
+
+
+class MultiAgentEnvRunner(_RewardTracker):
+    """Multi-agent sampling: per-agent episode streams routed to policies
+    via policy_mapping_fn, GAE per completed trajectory, one
+    MultiAgentBatch out (reference: rllib/env/multi_agent_env.py +
+    evaluation/rollout_worker.py:159 multi-policy sampling).
+
+    Vectorized over num_envs env copies; trajectories are keyed
+    (env index, agent id) so parallel episodes never mix."""
+
+    _COLS = (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.TERMINATEDS, sb.TRUNCATEDS,
+             sb.LOGPS, sb.VF_PREDS, sb.BOOTSTRAP_VALUES)
+
+    def __init__(self, env_spec, env_config: dict, policies: List[str],
+                 policy_mapping_fn, num_envs: int = 1, seed: int = 0,
+                 hidden=(64, 64)):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        self._envs = [make_env(env_spec, env_config)
+                      for _ in range(num_envs)]
+        self._mapping = policy_mapping_fn
+        self._rng = np.random.RandomState(seed)
+        e0 = self._envs[0]
+        self._params = {
+            pid: policy_value_init(jax.random.PRNGKey(seed + j),
+                                   e0.observation_dim,
+                                   hidden=tuple(hidden),
+                                   num_actions=e0.num_actions)
+            for j, pid in enumerate(policies)
+        }
+        self._jit_forward = jax.jit(policy_value_apply)
+        self._obs: List[Dict[str, Any]] = []
+        for i, e in enumerate(self._envs):
+            obs, _ = e.reset(seed=seed + i)
+            self._obs.append(obs)
+        self._ep_rewards: Dict[tuple, float] = {}
+        self._init_rewards()
+        # (env idx, agent id) -> in-progress trajectory columns
+        self._traj: Dict[tuple, Dict[str, list]] = {}
+
+    def set_weights(self, params: Dict[str, Any]):
+        self._params.update(params)
+
+    def _forward(self, pid: str, obs_batch: np.ndarray):
+        lg, vl = self._jit_forward(self._params[pid], obs_batch)
+        return np.asarray(lg), np.asarray(vl)
+
+    def _finish_traj(self, key: tuple, out: Dict[str, list],
+                     last_value: float, gamma: float, lam: float):
+        cols = self._traj.pop(key, None)
+        if not cols or not cols[sb.OBS]:
+            return
+        b = SampleBatch({k: np.asarray(v) for k, v in cols.items()})
+        pid = self._mapping(key[1])
+        out.setdefault(pid, []).append(
+            compute_gae(b, last_value, gamma, lam))
+
+    def sample(self, num_steps: int, gamma: float = 0.99,
+               lam: float = 0.95):
+        """Collect num_steps steps PER ENV; returns MultiAgentBatch keyed
+        by policy id."""
+        from ray_tpu.rllib.sample_batch import MultiAgentBatch
+        done_batches: Dict[str, list] = {}
+        for _t in range(num_steps):
+            # Gather live (env, agent) pairs across all env copies.
+            pairs = []
+            for i in range(len(self._envs)):
+                if not self._obs[i]:  # every agent finished: new episode
+                    self._obs[i], _ = self._envs[i].reset()
+                pairs.extend((i, a) for a in self._obs[i])
+            obs_arr = np.stack([self._obs[i][a] for i, a in pairs])
+            n_act = self._envs[0].num_actions
+            logits = np.zeros((len(pairs), n_act), np.float32)
+            values = np.zeros((len(pairs),), np.float32)
+            by_pid: Dict[str, list] = {}
+            for idx, (i, a) in enumerate(pairs):
+                by_pid.setdefault(self._mapping(a), []).append(idx)
+            for pid, idxs in by_pid.items():
+                lg, vl = self._forward(pid, obs_arr[idxs])
+                logits[idxs] = lg
+                values[idxs] = vl
+            probs = np.exp(logits - logits.max(-1, keepdims=True))
+            probs /= probs.sum(-1, keepdims=True)
+            actions = [
+                int(self._rng.choice(n_act, p=probs[idx]))
+                for idx in range(len(pairs))
+            ]
+            # Step each env with its agents' actions.
+            stepped = []
+            for i, env in enumerate(self._envs):
+                acts = {a: actions[idx]
+                        for idx, (j, a) in enumerate(pairs) if j == i}
+                if acts:
+                    stepped.append((i, *env.step(acts)))
+            results = {i: (obs2, rew, te, tr)
+                       for i, obs2, rew, te, tr, _ in stepped}
+            for idx, (i, a) in enumerate(pairs):
+                obs2, rewards, terms, truncs = results[i]
+                term = bool(terms.get(a, False))
+                trunc = bool(truncs.get(a, False))
+                rec = self._traj.setdefault(
+                    (i, a), {k: [] for k in self._COLS})
+                rec[sb.OBS].append(self._obs[i][a])
+                rec[sb.ACTIONS].append(actions[idx])
+                rec[sb.REWARDS].append(rewards.get(a, 0.0))
+                rec[sb.TERMINATEDS].append(term)
+                rec[sb.TRUNCATEDS].append(trunc)
+                rec[sb.LOGPS].append(
+                    np.log(probs[idx][actions[idx]] + 1e-10))
+                rec[sb.VF_PREDS].append(values[idx])
+                boot = 0.0
+                if trunc and not term and a in obs2:
+                    _lg, bv = self._forward(self._mapping(a),
+                                            obs2[a][None, :])
+                    boot = float(bv[0])
+                rec[sb.BOOTSTRAP_VALUES].append(boot)
+                k = (i, a)
+                self._ep_rewards[k] = (self._ep_rewards.get(k, 0.0)
+                                       + rewards.get(a, 0.0))
+                if term or trunc:
+                    self._done_rewards.append(self._ep_rewards.pop(k, 0.0))
+                    self._finish_traj(k, done_batches, 0.0, gamma, lam)
+            # Done agents leave the tracked obs (their final obs was only
+            # needed for the truncation bootstrap above).
+            for i, *_rest in stepped:
+                obs2, rewards, terms, truncs = results[i]
+                self._obs[i] = {
+                    a: o for a, o in obs2.items()
+                    if not (terms.get(a, False) or truncs.get(a, False))}
+        # Rollout boundary: close out in-progress trajectories with a
+        # bootstrap value from the current obs.
+        for (i, a) in list(self._traj.keys()):
+            last_v = 0.0
+            if a in self._obs[i]:
+                _lg, bv = self._forward(self._mapping(a),
+                                        self._obs[i][a][None, :])
+                last_v = float(bv[0])
+            self._finish_traj((i, a), done_batches, last_v, gamma, lam)
+        return MultiAgentBatch(
+            {pid: sb.concat_samples(bs)
+             for pid, bs in done_batches.items()},
+            num_steps * len(self._envs))

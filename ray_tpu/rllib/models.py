@@ -61,3 +61,55 @@ def sample_action(rng, logits):
     a = jax.random.categorical(rng, logits)
     logp = jax.nn.log_softmax(logits)[jnp.arange(logits.shape[0]), a]
     return a, logp
+
+
+# ---- continuous control (SAC family) -----------------------------------
+
+def squashed_gaussian_init(rng, obs_dim: int, action_dim: int,
+                           hidden: Tuple[int, ...] = (64, 64)):
+    """Actor emitting (mean, log_std) for a tanh-squashed Gaussian
+    (reference: rllib/models catalog's SquashedGaussian distribution)."""
+    import jax
+    k = jax.random.split(rng, 1)[0]
+    return {"net": mlp_init(k, [obs_dim, *hidden, 2 * action_dim])}
+
+
+def squashed_gaussian_apply(params, obs):
+    """-> (mean, log_std), log_std clipped to a sane range."""
+    import jax.numpy as jnp
+    out = mlp_apply(params["net"], obs)
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    return mean, jnp.clip(log_std, -20.0, 2.0)
+
+
+def squashed_gaussian_sample(rng, params, obs, low: float, high: float):
+    """Reparameterized sample -> (action in [low, high], log_prob)."""
+    import jax
+    import jax.numpy as jnp
+    mean, log_std = squashed_gaussian_apply(params, obs)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(rng, mean.shape)
+    pre = mean + std * eps
+    tanh = jnp.tanh(pre)
+    # log N(pre) - log |d tanh/d pre|, summed over action dims.
+    logp = (-0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+            - jnp.log(1 - tanh ** 2 + 1e-6)).sum(-1)
+    scale = (high - low) / 2.0
+    mid = (high + low) / 2.0
+    return mid + scale * tanh, logp
+
+
+def twin_q_init(rng, obs_dim: int, action_dim: int,
+                hidden: Tuple[int, ...] = (64, 64)):
+    """Two independent Q(s, a) critics (clipped double-Q)."""
+    import jax
+    k1, k2 = jax.random.split(rng)
+    sizes = [obs_dim + action_dim, *hidden, 1]
+    return {"q1": mlp_init(k1, sizes), "q2": mlp_init(k2, sizes)}
+
+
+def twin_q_apply(params, obs, action):
+    import jax.numpy as jnp
+    x = jnp.concatenate([obs, action], axis=-1)
+    return (mlp_apply(params["q1"], x)[..., 0],
+            mlp_apply(params["q2"], x)[..., 0])

@@ -130,3 +130,64 @@ def test_multi_agent_batch():
     assert len(merged.policy_batches["p1"]) == 8
     wrapped = MultiAgentBatch.wrap_as_needed(b1, 4)
     assert wrapped.policy_batches["default_policy"] is b1
+
+
+def test_multi_agent_ppo_trains(ray_rl, jax_cpu):
+    """Multi-agent EnvRunner: policy mapping, per-agent episodes, and
+    per-policy PPO updates (reference: rllib/env/multi_agent_env.py +
+    rollout_worker.py:159 multi-policy sampling)."""
+    from ray_tpu.rllib import PPOConfig
+
+    config = (PPOConfig()
+              .environment("MultiCartPole", env_config={"num_agents": 2})
+              .env_runners(num_env_runners=2, rollout_fragment_length=256)
+              .multi_agent(
+                  policies=["pol_a", "pol_b"],
+                  policy_mapping_fn=lambda aid: (
+                      "pol_a" if aid == "agent_0" else "pol_b"))
+              .training(lr=3e-3, minibatch_size=128, num_epochs=8,
+                        entropy_coeff=0.01)
+              .debugging(seed=0))
+    algo = config.build()
+    first, last = None, None
+    for _ in range(10):
+        result = algo.train()
+        if first is None and result.get("episodes_total", 0) > 3:
+            first = result["episode_reward_mean"]
+        last = result["episode_reward_mean"]
+    ckpt = algo.save_checkpoint()
+    algo.stop()
+    assert set(ckpt["params"]) == {"pol_a", "pol_b"}
+    assert first is not None and np.isfinite(last)
+    # Both policies learn their own cartpole: mean episode reward rises
+    # well above the random-policy ~20.
+    assert last > first or last > 60, (first, last)
+
+
+def test_sac_learns_pendulum(ray_rl, jax_cpu):
+    """SAC (continuous control) improves Pendulum returns far beyond the
+    random policy (reference: rllib/algorithms/sac/sac.py)."""
+    from ray_tpu.rllib import SACConfig
+
+    algo = (SACConfig()
+            .environment("Pendulum-v1")
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=1,
+                         rollout_fragment_length=256)
+            .training(train_batch_size=256, random_warmup_steps=500,
+                      grad_steps_per_iter=192, lr=3e-4)
+            .debugging(seed=0)
+            .build())
+    early, late = [], []
+    for i in range(24):
+        algo.train()
+        rewards = algo._episode_rewards
+        if i < 8:
+            early = list(rewards)
+        late = rewards[-8:]
+    algo.stop()
+    # Random Pendulum returns run -1100..-1600; a learning SAC pulls the
+    # recent mean way up (locally reaches ~-150 by 6k steps).
+    assert early and late
+    assert np.mean(late) > -800, (np.mean(early), np.mean(late))
+    assert np.mean(late) > np.mean(early) + 200, (np.mean(early),
+                                                  np.mean(late))
