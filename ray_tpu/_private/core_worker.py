@@ -84,6 +84,9 @@ class GeneratorStream:
     total: Optional[int] = None     # set when the task finishes
     error: Optional[Exception] = None
     waiters: List[asyncio.Future] = field(default_factory=list)
+    # Producing worker's address (learned from generator_item): lets an
+    # abandoned stream cancel the still-running generator task.
+    exec_worker: str = ""
 
     def wake(self):
         for fut in self.waiters:
@@ -1541,6 +1544,7 @@ class CoreWorker:
         self._register_return_object(stream.spec, payload["index"],
                                      payload["ret"],
                                      payload.get("exec_raylet", ""))
+        stream.exec_worker = payload.get("exec_worker", stream.exec_worker)
         stream.received = max(stream.received, payload["index"] + 1)
         stream.wake()
         return True
@@ -1583,13 +1587,23 @@ class CoreWorker:
     def release_generator(self, task_id: TaskID, consumed: int):
         """Consumer dropped the ObjectRefGenerator: free the stream and the
         never-handed-out return objects (indices >= consumed). Items the
-        consumer did take are governed by normal ref counting."""
+        consumer did take are governed by normal ref counting. A producer
+        still running (total unset) gets a best-effort cancel so an
+        unbounded generator doesn't stream to nobody forever."""
         stream = self.generator_streams.pop(task_id, None)
         if stream is None:
             return
         stream.wake()
         for i in range(consumed, stream.received):
             self.owned.pop(ObjectID.for_task_return(task_id, i), None)
+        if stream.total is None and stream.exec_worker:
+            async def _cancel(addr=stream.exec_worker, tid=task_id):
+                try:
+                    await self.clients.request(
+                        addr, "cancel_task", {"task_id": tid}, timeout=5)
+                except Exception:  # noqa: BLE001 — best effort
+                    pass
+            asyncio.ensure_future(_cancel())
 
     def _complete_task_ok(self, spec: TaskSpec, returns: List[dict],
                           exec_raylet: str):
@@ -2354,12 +2368,29 @@ class CoreWorker:
                 r["stored"] = self.raylet_address
             await owner.notify("generator_item", {
                 "task_id": spec.task_id, "index": index, "ret": r,
-                "exec_raylet": self.raylet_address})
+                "exec_raylet": self.raylet_address,
+                "exec_worker": self.address})
             index += 1
+            # End the tick: an async generator that never truly suspends
+            # (e.g. wrapping a sync generator) would otherwise run to
+            # exhaustion inside ONE tick, so the write-coalescer holds every
+            # item after the first until the end — the opposite of
+            # streaming. sleep(0) lets the scheduled flush run per item.
+            await asyncio.sleep(0)
+
+        def _released() -> bool:
+            # Consumer dropped the stream (release_generator sent a
+            # cancel): stop producing.
+            if spec.task_id in self._cancelled_tasks:
+                self._cancelled_tasks.discard(spec.task_id)
+                return True
+            return False
 
         try:
             if _inspect.isasyncgenfunction(func):
                 async for item in func(*args, **kwargs):
+                    if _released():
+                        return {"generator_done": index, "cancelled": True}
                     await emit(item)
             else:
                 gen = func(*args, **kwargs)
@@ -2379,6 +2410,8 @@ class CoreWorker:
                                                             _next)
                     if not more:
                         break
+                    if _released():
+                        return {"generator_done": index, "cancelled": True}
                     await emit(item)
         except Exception as e:  # noqa: BLE001
             import os as _os

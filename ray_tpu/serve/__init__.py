@@ -10,16 +10,21 @@ recompilation.
 """
 
 from ray_tpu.serve.api import (delete, get_app_handle, get_deployment_handle,
-                               run, shutdown, start, status)
+                               get_grpc_address, run, shutdown, start,
+                               status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import Application, Deployment, deployment
-from ray_tpu.serve.config import AutoscalingConfig, HTTPOptions
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.config import AutoscalingConfig, HTTPOptions, gRPCOptions
+from ray_tpu.serve.grpc_proxy import ServeRpcClient
+from ray_tpu.serve.handle import (DeploymentHandle, DeploymentResponse,
+                                  DeploymentResponseGenerator)
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "shutdown",
     "delete", "status", "get_app_handle", "get_deployment_handle",
-    "DeploymentHandle", "DeploymentResponse", "batch", "multiplexed",
+    "get_grpc_address", "DeploymentHandle", "DeploymentResponse",
+    "DeploymentResponseGenerator", "ServeRpcClient", "batch", "multiplexed",
     "get_multiplexed_model_id", "AutoscalingConfig", "HTTPOptions",
+    "gRPCOptions",
 ]

@@ -39,15 +39,29 @@ async def _get_controller_async():
     return _controller
 
 
-def start(*, http_options=None, proxy: bool = False):
-    """Start the Serve control plane (controller, optionally HTTP proxy)."""
+def start(*, http_options=None, proxy: bool = False,
+          grpc_options=None, grpc_proxy: bool = False):
+    """Start the Serve control plane (controller, optionally the HTTP
+    proxy and/or the binary-RPC ingress — reference: gRPCProxy)."""
     ctrl = _get_controller()
     if proxy or http_options is not None:
         from ray_tpu.serve.config import HTTPOptions
         opts = http_options or HTTPOptions()
         ray_tpu.get(ctrl.ensure_proxy.remote(opts.host, opts.port),
                     timeout=30)
+    if grpc_proxy or grpc_options is not None:
+        from ray_tpu.serve.config import gRPCOptions
+        gopts = grpc_options or gRPCOptions()
+        ray_tpu.get(
+            ctrl.ensure_grpc_proxy.remote(gopts.host, gopts.port),
+            timeout=30)
     return ctrl
+
+
+def get_grpc_address() -> str:
+    """Address of the binary-RPC ingress (connect a ServeRpcClient)."""
+    return ray_tpu.get(
+        _get_controller().get_grpc_address.remote(), timeout=30)
 
 
 def run(app: Application, *, name: str = "default",

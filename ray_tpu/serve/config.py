@@ -42,6 +42,13 @@ class HTTPOptions:
 
 
 @dataclass
+class gRPCOptions:
+    """Binary-RPC ingress options (reference: serve gRPCOptions)."""
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = ephemeral
+
+
+@dataclass
 class DeploymentConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = 100

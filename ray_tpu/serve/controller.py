@@ -263,9 +263,40 @@ class ServeController:
             await self._proxy.ready.remote()
         return True
 
+    async def ensure_grpc_proxy(self, host: str, port: int) -> int:
+        """Start the binary-RPC ingress (reference: gRPCProxy); returns the
+        bound port."""
+        if getattr(self, "_grpc_proxy", None) is None:
+            from ray_tpu.serve.grpc_proxy import GrpcProxyActor
+            cls = ray_tpu.remote(num_cpus=0.1)(GrpcProxyActor)
+            actor = cls.remote(host, port)
+            try:
+                self._grpc_port = await actor.ready.remote()
+            except Exception:
+                # Failed startup (e.g. port in use) must stay retryable.
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
+                raise
+            self._grpc_host = host
+            self._grpc_proxy = actor
+        return self._grpc_port
+
+    def get_grpc_address(self) -> str:
+        if getattr(self, "_grpc_proxy", None) is None:
+            raise RuntimeError("binary-RPC ingress not started; "
+                               "serve.start(grpc_proxy=True)")
+        return f"{self._grpc_host}:{self._grpc_port}"
+
     async def shutdown(self):
         for key in list(self._deployments):
             await self._remove_deployment(key)
+        if getattr(self, "_grpc_proxy", None) is not None:
+            try:
+                ray_tpu.kill(self._grpc_proxy)
+            except Exception:
+                pass
         if self._proxy is not None:
             try:
                 ray_tpu.kill(self._proxy)

@@ -1,0 +1,195 @@
+"""Binary RPC ingress: the gRPC-proxy equivalent on the framed-RPC layer.
+
+Reference parity: python/ray/serve/_private/proxy.py:533 (gRPCProxy) — a
+second, non-HTTP ingress sharing the same deployment router, serving unary
+and server-streaming calls. The reference speaks protobuf/HTTP2; here the
+transport is the framework's own length-prefixed RPC
+(ray_tpu/_private/rpc.py), so clients use ServeRpcClient instead of a
+generated stub — same capability, no grpc dependency.
+
+Wire methods:
+  serve_unary  {app, deployment?, method?, args, kwargs} -> result
+  serve_stream {...same..., call_id}
+      -> PUSH "serve_stream_item" {call_id, item} per yielded item
+      -> response {"items": n} when the stream completes
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import rpc
+
+
+class GrpcProxyActor:
+    """Ingress actor: RpcServer in front of the deployment router."""
+
+    ROUTE_REFRESH_S = 1.0
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._server: Optional[rpc.RpcServer] = None
+        self._routes: Dict[str, tuple] = {}
+        self._handles: Dict[tuple, Any] = {}
+        self._last_refresh = 0.0
+        self._num_requests = 0
+
+    async def ready(self) -> int:
+        if self._server is None:
+            self._server = rpc.RpcServer("serve-grpc-proxy")
+            self._server.register("serve_unary", self._rpc_unary)
+            self._server.register("serve_stream", self._rpc_stream)
+            self._port = await self._server.start(self._host, self._port)
+        return self._port
+
+    async def _handle_for(self, payload) -> Any:
+        now = time.monotonic()
+        if now - self._last_refresh > self.ROUTE_REFRESH_S:
+            self._last_refresh = now
+            from ray_tpu.serve.api import _get_controller_async
+            ctrl = await _get_controller_async()
+            self._routes = await ctrl.get_route_table.remote()
+        app = payload.get("app", "default")
+        deployment = payload.get("deployment")
+        if deployment is None:
+            # Route to the app's ingress deployment.
+            for _prefix, (app_name, ingress) in self._routes.items():
+                if app_name == app:
+                    deployment = ingress
+                    break
+        if deployment is None:
+            raise ValueError(f"no application {app!r}")
+        key = (app, deployment, payload.get("method") or "__call__")
+        handle = self._handles.get(key)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+            handle = DeploymentHandle(deployment, app_name=app,
+                                      method_name=key[2])
+            self._handles[key] = handle
+        return handle
+
+    async def _rpc_unary(self, conn, payload):
+        self._num_requests += 1
+        handle = await self._handle_for(payload)
+        return await handle.remote(*payload.get("args", ()),
+                                   **payload.get("kwargs", {}))
+
+    async def _rpc_stream(self, conn, payload):
+        self._num_requests += 1
+        handle = await self._handle_for(payload)
+        call_id = payload["call_id"]
+        gen = handle.options(stream=True).remote(
+            *payload.get("args", ()), **payload.get("kwargs", {}))
+        n = 0
+        async for item in gen:
+            # Items stream as PUSH frames; the final RESPONSE closes the
+            # call (reference: gRPC server-streaming).
+            await conn.push("serve_stream_item",
+                            {"call_id": call_id, "item": item})
+            n += 1
+        return {"items": n}
+
+    def get_num_requests(self) -> int:
+        return self._num_requests
+
+
+class ServeRpcClient:
+    """Client for the binary ingress (the generated-stub equivalent).
+
+    Sync facade over a private loop thread, mirroring the ray_tpu client
+    pattern; `call` is unary, `stream` yields items as they arrive.
+    """
+
+    def __init__(self, address: str):
+        self.address = address
+        self._conn: Optional[rpc.Connection] = None
+        self._loop = asyncio.new_event_loop()
+        self._streams: Dict[str, asyncio.Queue] = {}
+        ready = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(self._loop)
+            ready.set()
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="serve-rpc-client")
+        self._thread.start()
+        ready.wait(10)
+
+    def _on_push(self, method: str, payload):
+        if method == "serve_stream_item":
+            q = self._streams.get(payload["call_id"])
+            if q is not None:
+                q.put_nowait(payload["item"])
+
+    async def _ensure_conn(self) -> rpc.Connection:
+        if self._conn is None or self._conn.closed:
+            self._conn = await rpc.connect(self.address, self._on_push)
+        return self._conn
+
+    def call(self, *args, app: str = "default",
+             deployment: Optional[str] = None, method: str = "__call__",
+             timeout: float = 60.0, **kwargs):
+        async def go():
+            conn = await self._ensure_conn()
+            return await conn.request(
+                "serve_unary",
+                {"app": app, "deployment": deployment, "method": method,
+                 "args": args, "kwargs": kwargs}, timeout)
+        return asyncio.run_coroutine_threadsafe(
+            go(), self._loop).result(timeout + 10)
+
+    def stream(self, *args, app: str = "default",
+               deployment: Optional[str] = None, method: str = "__call__",
+               idle_timeout: float = 60.0, **kwargs):
+        """Generator over streamed items (blocks between items).
+
+        idle_timeout bounds the wait for EACH item, not the whole stream —
+        a healthy long stream (e.g. token generation) never times out as
+        long as items keep arriving."""
+        call_id = uuid.uuid4().hex
+        q: "asyncio.Queue" = asyncio.Queue()
+        self._streams[call_id] = q
+        _END = object()
+
+        async def go():
+            try:
+                conn = await self._ensure_conn()
+                return await conn.request(
+                    "serve_stream",
+                    {"app": app, "deployment": deployment, "method": method,
+                     "args": args, "kwargs": kwargs, "call_id": call_id},
+                    timeout=None)
+            finally:
+                q.put_nowait(_END)
+
+        fut = asyncio.run_coroutine_threadsafe(go(), self._loop)
+
+        async def _next():
+            return await q.get()
+
+        try:
+            while True:
+                item = asyncio.run_coroutine_threadsafe(
+                    _next(), self._loop).result(idle_timeout)
+                if item is _END:
+                    break
+                yield item
+            fut.result(5)  # surface stream errors
+        finally:
+            self._streams.pop(call_id, None)
+
+    def close(self):
+        try:
+            if self._conn is not None:
+                asyncio.run_coroutine_threadsafe(
+                    self._conn.close(), self._loop).result(5)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)

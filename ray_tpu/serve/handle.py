@@ -70,6 +70,57 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment call's items (reference:
+    handle.py DeploymentResponseGenerator). Yields VALUES; works as a sync
+    iterator from driver threads and an async iterator on the core loop."""
+
+    def __init__(self, ref_gen=None, on_done=None, setup_coro=None):
+        self._gen = ref_gen
+        self._on_done = on_done or (lambda: None)
+        self._setup_coro = setup_coro  # async context: routing is deferred
+        self._done = False
+
+    def _settle(self):
+        if not self._done:
+            self._done = True
+            self._on_done()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._gen is None:
+            raise RuntimeError("streaming call was made in async context; "
+                               "iterate with `async for`")
+        try:
+            ref = next(self._gen)
+        except StopIteration:
+            self._settle()
+            raise
+        return ray_tpu.get(ref)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        if self._gen is None:
+            # First iteration in async context: run the deferred routing.
+            self._gen, self._on_done = await self._setup_coro
+        try:
+            ref = await self._gen.__anext__()
+        except StopAsyncIteration:
+            self._settle()
+            raise
+        return await ref
+
+    def __del__(self):
+        try:
+            self._settle()
+        except Exception:
+            pass
+
+
 class Router:
     """Client-side replica picker with periodic replica-list refresh."""
 
@@ -146,21 +197,23 @@ class Router:
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
                  method_name: str = "__call__",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", stream: bool = False):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method = method_name
         self._mux_id = multiplexed_model_id
+        self._stream = stream
         self._router: Optional[Router] = None
 
     def options(self, *, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         h = DeploymentHandle(
             self.deployment_name, self.app_name,
             method_name or self._method,
             multiplexed_model_id if multiplexed_model_id is not None
-            else self._mux_id)
+            else self._mux_id,
+            self._stream if stream is None else stream)
         h._router = self._router
         return h
 
@@ -169,7 +222,7 @@ class DeploymentHandle:
             self._router = Router(self.deployment_name, self.app_name)
         return self._router
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         import asyncio
         try:
             asyncio.get_running_loop()
@@ -178,6 +231,9 @@ class DeploymentHandle:
             in_async = False
         if in_async:
             # Replica/proxy context: routing must not block the loop.
+            if self._stream:
+                return DeploymentResponseGenerator(
+                    setup_coro=self._stream_setup_async(args, kwargs))
             return DeploymentResponse(
                 coro=self._call_async(args, kwargs))
         router = self._get_router()
@@ -193,6 +249,12 @@ class DeploymentHandle:
                 time.sleep(0.2 * (attempt + 1))
                 continue
             try:
+                if self._stream:
+                    gen = replica.handle_request_streaming.options(
+                        num_returns="streaming").remote(
+                            self._method, self._mux_id, args, kwargs)
+                    return DeploymentResponseGenerator(
+                        gen, on_done=lambda i=i: router.release(i))
                 ref = replica.handle_request.remote(
                     self._method, self._mux_id, args, kwargs)
                 return DeploymentResponse(ref,
@@ -200,6 +262,32 @@ class DeploymentHandle:
             except Exception as e:
                 router.release(i)
                 router.drop_replicas()  # replica may be dead: force refresh
+                last_err = e
+        raise last_err
+
+    async def _stream_setup_async(self, args, kwargs):
+        """Deferred routing for a streaming call made on the core loop:
+        returns (ObjectRefGenerator, release_fn)."""
+        import asyncio
+        router = self._get_router()
+        last_err = None
+        for attempt in range(5):
+            await router.refresh_async(force=attempt > 0)
+            try:
+                i, replica = router.pick_cached()
+            except RuntimeError as e:
+                last_err = e
+                router.drop_replicas()
+                await asyncio.sleep(0.2 * (attempt + 1))
+                continue
+            try:
+                gen = replica.handle_request_streaming.options(
+                    num_returns="streaming").remote(
+                        self._method, self._mux_id, args, kwargs)
+                return gen, (lambda i=i: router.release(i))
+            except Exception as e:  # noqa: BLE001
+                router.release(i)
+                router.drop_replicas()
                 last_err = e
         raise last_err
 
@@ -244,4 +332,4 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self._method,
-                 self._mux_id))
+                 self._mux_id, self._stream))

@@ -41,6 +41,7 @@ class ProxyActor:
         self._server = None
         self._routes: Dict[str, tuple] = {}
         self._handles: Dict[tuple, Any] = {}
+        self._streaming: Dict[tuple, bool] = {}  # ingress -> generator?
         self._last_refresh = 0.0
         self._num_requests = 0
 
@@ -57,7 +58,11 @@ class ProxyActor:
         self._last_refresh = now
         from ray_tpu.serve.api import _get_controller_async
         ctrl = await _get_controller_async()
-        self._routes = await ctrl.get_route_table.remote()
+        routes = await ctrl.get_route_table.remote()
+        if routes != self._routes:
+            # Redeploys may switch a handler generator <-> plain: re-probe.
+            self._streaming.clear()
+        self._routes = routes
 
     def _match_route(self, path: str):
         best = None
@@ -117,6 +122,25 @@ class ProxyActor:
                           query=parse_qs(url.query), headers=headers,
                           body=body)
             self._num_requests += 1
+            streaming = self._streaming.get(key)
+            if streaming is None:
+                # One probe per ingress: is the handler a generator
+                # function? (reference: proxy.py checks the response type;
+                # here the replica inspects its callable once.) A failed
+                # probe (e.g. empty replica set mid-rollout) is NOT cached:
+                # the next request retries it.
+                try:
+                    streaming = await self._probe_streaming(handle)
+                    self._streaming[key] = streaming
+                except Exception:
+                    streaming = False
+            if streaming:
+                try:
+                    gen = handle.options(stream=True).remote(req)
+                    await self._send_stream(writer, gen)
+                except Exception as e:
+                    await self._respond(writer, 500, repr(e).encode())
+                return
             try:
                 resp = handle.remote(req)
                 result = await resp
@@ -134,6 +158,64 @@ class ProxyActor:
                 writer.close()
             except Exception:
                 pass
+
+    async def _probe_streaming(self, handle) -> bool:
+        router = handle._get_router()
+        await router.refresh_async()
+        _i, replica = router.pick_cached()
+        try:
+            return bool(await replica.is_streaming_method.remote(
+                handle._method))
+        finally:
+            router.release(_i)
+
+    @staticmethod
+    def _as_chunk(item) -> bytes:
+        if isinstance(item, bytes):
+            return item
+        if isinstance(item, str):
+            return item.encode()
+        return (json.dumps(_jsonable(item)) + "\n").encode()
+
+    async def _send_stream(self, writer, gen):
+        """Chunked transfer encoding: each generator item is flushed as its
+        own chunk the moment the replica yields it (reference: proxy.py
+        :745 ASGI streaming responses).
+
+        The FIRST item (which also runs the deferred routing) is awaited
+        BEFORE the 200/chunked headers go out, so routing or immediate
+        handler errors still produce a clean 500 (they propagate to the
+        caller). A mid-stream failure after headers cannot inject a status
+        line into the chunk framing — the connection just closes, which a
+        chunked client sees as a truncated stream."""
+        it = gen.__aiter__()
+        have_first = True
+        try:
+            first = await it.__anext__()
+        except StopAsyncIteration:
+            have_first = False
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: application/octet-stream\r\n"
+                     b"Transfer-Encoding: chunked\r\n"
+                     b"Connection: close\r\n\r\n")
+        await writer.drain()
+        try:
+            if have_first:
+                chunk = self._as_chunk(first)
+                if chunk:
+                    writer.write(f"{len(chunk):x}\r\n".encode()
+                                 + chunk + b"\r\n")
+                    await writer.drain()
+            async for item in it:
+                chunk = self._as_chunk(item)
+                if not chunk:
+                    continue  # an empty chunk would terminate the stream
+                writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                await writer.drain()
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        except Exception:
+            return  # headers sent: truncate, never write a 500 mid-stream
 
     async def _send_result(self, writer, result):
         if isinstance(result, bytes):

@@ -75,16 +75,72 @@ class ReplicaActor:
         self._total += 1
         token = _request_context.set(RequestContext(mux_model_id))
         try:
-            if self._is_function:
-                target = self._callable
-            elif method_name in ("__call__", ""):
-                target = self._callable
-            else:
-                target = getattr(self._callable, method_name)
+            target = self._target_for(method_name)
             result = target(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = await result
             return result
+        finally:
+            _request_context.reset(token)
+            self._ongoing -= 1
+
+    def _target_for(self, method_name: str):
+        if self._is_function or method_name in ("__call__", ""):
+            return self._callable
+        return getattr(self._callable, method_name)
+
+    def is_streaming_method(self, method_name: str) -> bool:
+        """True when the handler is a (sync or async) generator function —
+        the proxy/handle use this to pick the streaming call path
+        (reference: proxy.py checks the ASGI response type)."""
+        target = self._target_for(method_name)
+        fn = target if inspect.isfunction(target) or inspect.ismethod(
+            target) else getattr(target, "__call__", target)
+        return (inspect.isgeneratorfunction(fn)
+                or inspect.isasyncgenfunction(fn))
+
+    async def handle_request_streaming(self, method_name: str,
+                                       mux_model_id: str, args: tuple,
+                                       kwargs: dict):
+        """Streamed variant of handle_request: iterates the handler's
+        generator, yielding each item as one stream element (delivered to
+        the caller as a streaming-generator actor call)."""
+        self._ongoing += 1
+        self._total += 1
+        token = _request_context.set(RequestContext(mux_model_id))
+        try:
+            target = self._target_for(method_name)
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            if inspect.isasyncgen(result):
+                async for item in result:
+                    yield item
+            elif inspect.isgenerator(result):
+                # Pull sync generators on the executor so a handler that
+                # blocks between yields (sleep, model step) doesn't freeze
+                # the replica loop (health checks, other requests). The
+                # request context must travel to the executor thread:
+                # run_in_executor submits the bare fn without contextvars,
+                # which would break get_multiplexed_model_id() in the body.
+                import contextvars
+                loop = asyncio.get_running_loop()
+                ctx = contextvars.copy_context()
+
+                def _next():
+                    try:
+                        return True, next(result)
+                    except StopIteration:
+                        return False, None
+
+                while True:
+                    ok, item = await loop.run_in_executor(
+                        None, lambda: ctx.run(_next))
+                    if not ok:
+                        break
+                    yield item
+            else:
+                yield result
         finally:
             _request_context.reset(token)
             self._ongoing -= 1
