@@ -397,6 +397,26 @@ class CoreWorker:
         s.register("generator_item", self._rpc_generator_item)
         s.register("shutdown", self._rpc_shutdown)
         s.register("ping", self._rpc_ping)
+        # On-demand profiling (reference: dashboard reporter
+        # profile_manager.py py-spy/memray; here built-in samplers).
+        s.register("profile_cpu", self._rpc_profile_cpu)
+        s.register("profile_memory", self._rpc_profile_memory)
+        s.register("stack_dump", self._rpc_stack_dump)
+
+    async def _rpc_profile_cpu(self, conn, payload):
+        from ray_tpu.util import profiling
+        duration = min(float(payload.get("duration_s", 2.0)), 30.0)
+        return await asyncio.get_running_loop().run_in_executor(
+            self._exec_pool, lambda: profiling.sample_cpu(duration))
+
+    async def _rpc_profile_memory(self, conn, payload):
+        from ray_tpu.util import profiling
+        return profiling.snapshot_memory(
+            top=int(payload.get("top", 30)))
+
+    async def _rpc_stack_dump(self, conn, payload):
+        from ray_tpu.util import profiling
+        return profiling.stack_dump()
 
     async def _rpc_ping(self, conn, payload):
         return {"worker_id": self.worker_id, "mode": self.mode}

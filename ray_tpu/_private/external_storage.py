@@ -1,0 +1,127 @@
+"""External spill storage: pluggable byte stores for object spilling.
+
+Reference parity: python/ray/_private/external_storage.py — the reference
+spills to local disk OR an S3-class URI ("smart_open" URIs); here the same
+choice is a Storage implementation keyed by URI scheme. The S3 backend
+takes an injectable client (boto3-compatible subset) so it unit-tests with
+a mock and gates on boto3 only at real use.
+
+Config: RAY_TPU_SPILL_STORAGE_URI, e.g.
+    file:///tmp/spill           (default: the session's spill dir)
+    s3://bucket/prefix          (needs boto3 or an injected client)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+_S3_CLIENT_FACTORY: Optional[Callable] = None
+
+
+def set_s3_client_factory(factory: Optional[Callable]):
+    """Test/deployment hook: inject a boto3-compatible client factory."""
+    global _S3_CLIENT_FACTORY
+    _S3_CLIENT_FACTORY = factory
+
+
+class ExternalStorage:
+    """put/get/delete of spilled object payloads, keyed by object hex id."""
+
+    def put(self, key: str, data) -> str:
+        """Store bytes; returns an opaque locator for get/delete."""
+        raise NotImplementedError
+
+    def get(self, locator: str) -> bytes:
+        raise NotImplementedError
+
+    def delete(self, locator: str) -> None:
+        raise NotImplementedError
+
+
+class FileStorage(ExternalStorage):
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def put(self, key: str, data) -> str:
+        path = os.path.join(self.directory, key)
+        with open(path, "wb") as f:
+            f.write(data)
+        return path
+
+    def get(self, locator: str) -> bytes:
+        with open(locator, "rb") as f:
+            return f.read()
+
+    def delete(self, locator: str) -> None:
+        try:
+            os.remove(locator)
+        except OSError:
+            pass
+
+
+class S3Storage(ExternalStorage):
+    """S3-class bucket spilling (reference: external_storage.py S3 URIs).
+
+    client: boto3-compatible subset — put_object(Bucket, Key, Body),
+    get_object(Bucket, Key) -> {"Body": file-like}, delete_object(...).
+    Injectable for tests/alternative stacks; without one, boto3 is
+    imported at first use (the runtime dependency gate).
+    """
+
+    def __init__(self, bucket: str, prefix: str = "", client=None):
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self._client = client
+
+    def _c(self):
+        if self._client is None:
+            if _S3_CLIENT_FACTORY is not None:
+                self._client = _S3_CLIENT_FACTORY()
+            else:
+                try:
+                    import boto3
+                except ImportError as e:
+                    raise ImportError(
+                        "s3:// spill storage requires boto3 (or inject a "
+                        "client via set_s3_client_factory)") from e
+                self._client = boto3.client("s3")
+        return self._client
+
+    def _key(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def put(self, key: str, data) -> str:
+        k = self._key(key)
+        self._c().put_object(Bucket=self.bucket, Key=k, Body=bytes(data))
+        return f"s3://{self.bucket}/{k}"
+
+    def get(self, locator: str) -> bytes:
+        _s, rest = locator.split("://", 1)
+        bucket, _, key = rest.partition("/")
+        return self._c().get_object(Bucket=bucket, Key=key)["Body"].read()
+
+    def delete(self, locator: str) -> None:
+        _s, rest = locator.split("://", 1)
+        bucket, _, key = rest.partition("/")
+        try:
+            self._c().delete_object(Bucket=bucket, Key=key)
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
+
+
+def storage_from_uri(uri: str, default_dir: str = "") -> ExternalStorage:
+    """Build the spill backend for a URI ("" -> local default_dir)."""
+    if not uri:
+        return FileStorage(default_dir)
+    if uri.startswith("file://"):
+        return FileStorage(uri[len("file://"):] or default_dir)
+    if uri.startswith("s3://"):
+        rest = uri[len("s3://"):]
+        bucket, _, prefix = rest.partition("/")
+        if not bucket:
+            raise ValueError(f"bad s3 spill uri {uri!r}")
+        return S3Storage(bucket, prefix)
+    raise ValueError(f"unsupported spill storage uri {uri!r} "
+                     "(file:// or s3://)")

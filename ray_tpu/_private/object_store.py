@@ -192,6 +192,12 @@ class ObjectStoreHost:
         self.arena = Arena(capacity)
         self.spill_dir = spill_dir
         os.makedirs(spill_dir, exist_ok=True)
+        # Spill backend: local disk by default, or an external store
+        # (s3://...) via RAY_TPU_SPILL_STORAGE_URI (reference:
+        # _private/external_storage.py S3-class spill URIs).
+        from ray_tpu._private.external_storage import storage_from_uri
+        self.spill_storage = storage_from_uri(
+            os.environ.get("RAY_TPU_SPILL_STORAGE_URI", ""), spill_dir)
         if prefault:
             self._start_prefault()
         self.objects: Dict[bytes, ObjectEntry] = {}
@@ -358,11 +364,9 @@ class ObjectStoreHost:
         # Note: fragmentation may still prevent the alloc; caller re-tries.
 
     def _spill(self, ent: ObjectEntry):
-        path = os.path.join(self.spill_dir, ent.object_id.hex())
-        with open(path, "wb") as f:
-            f.write(self.arena.view(ent.offset, ent.size))
+        ent.spill_path = self.spill_storage.put(
+            ent.object_id.hex(), self.arena.view(ent.offset, ent.size))
         self.arena.free(ent.offset, ent.size)
-        ent.spill_path = path
         ent.state = SPILLED
         self._lru.pop(ent.object_id, None)
         self.num_spilled += 1
@@ -370,8 +374,7 @@ class ObjectStoreHost:
         logger.debug("spilled object %s (%d bytes)", ent.object_id.hex()[:12], ent.size)
 
     def _restore(self, ent: ObjectEntry):
-        with open(ent.spill_path, "rb") as f:
-            data = f.read()
+        data = self.spill_storage.get(ent.spill_path)
         offset = self.arena.alloc(len(data))
         if offset is None:
             self._make_room(len(data))
@@ -383,10 +386,7 @@ class ObjectStoreHost:
         ent.offset, ent.size, ent.state = offset, len(data), SEALED
 
     def _delete_spill(self, ent: ObjectEntry):
-        try:
-            os.remove(ent.spill_path)
-        except OSError:
-            pass
+        self.spill_storage.delete(ent.spill_path)
         ent.spill_path = ""
 
     def stats(self) -> dict:

@@ -25,6 +25,46 @@ REQUEST, RESPONSE, ERROR, NOTIFY, PUSH = 0, 1, 2, 3, 4
 
 _MAX_MSG = 1 << 31
 
+# ---- deterministic race-shaking (reference: ray_config_def.h:838
+# RAY_testing_asio_delay_us) ------------------------------------------------
+# RAY_TPU_TESTING_RPC_DELAY_US="<method-glob>=<min_us>:<max_us>[,...]"
+# delays the START of matching handlers by a uniform random amount, which
+# also reorders concurrently-arriving messages — the asyncio analogue of
+# running the C++ core under randomized asio delays.
+_delay_spec: Optional[list] = None
+
+
+def _load_delay_spec() -> list:
+    import os
+    spec = []
+    raw = os.environ.get("RAY_TPU_TESTING_RPC_DELAY_US", "")
+    for part in raw.split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        pat, _, rng = part.partition("=")
+        lo, _, hi = rng.partition(":")
+        try:
+            spec.append((pat, int(lo), int(hi or lo)))
+        except ValueError:
+            logger.warning("bad RPC delay spec part %r", part)
+    return spec
+
+
+def _injected_delay(method: str) -> float:
+    """Seconds of injected delay for this method (0.0 = none)."""
+    global _delay_spec
+    if _delay_spec is None:
+        _delay_spec = _load_delay_spec()
+    if not _delay_spec:
+        return 0.0
+    import fnmatch
+    import random
+    for pat, lo, hi in _delay_spec:
+        if fnmatch.fnmatch(method, pat):
+            return random.uniform(lo, hi) / 1e6
+    return 0.0
+
 
 class RpcError(Exception):
     pass
@@ -252,12 +292,13 @@ class RpcServer:
                         await conn.send(ERROR, msg_id, method,
                                         (method, "KeyError", f"no handler {method}", ""))
                     continue
+                delay = _injected_delay(method)
                 if kind == REQUEST:
-                    asyncio.ensure_future(self._run_handler(conn, msg_id, method,
-                                                            handler, payload))
+                    asyncio.ensure_future(self._run_handler(
+                        conn, msg_id, method, handler, payload, delay))
                 else:  # NOTIFY
-                    asyncio.ensure_future(self._run_notify(conn, method, handler,
-                                                           payload))
+                    asyncio.ensure_future(self._run_notify(
+                        conn, method, handler, payload, delay))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         except Exception:
@@ -265,8 +306,11 @@ class RpcServer:
         finally:
             conn.abort(ConnectionLost("peer disconnected"))
 
-    async def _run_handler(self, conn, msg_id, method, handler, payload):
+    async def _run_handler(self, conn, msg_id, method, handler, payload,
+                           delay: float = 0.0):
         try:
+            if delay:
+                await asyncio.sleep(delay)
             result = await handler(conn, payload)
             await conn.send(RESPONSE, msg_id, method, result)
         except ConnectionLost:
@@ -279,8 +323,11 @@ class RpcServer:
             except Exception:
                 pass
 
-    async def _run_notify(self, conn, method, handler, payload):
+    async def _run_notify(self, conn, method, handler, payload,
+                          delay: float = 0.0):
         try:
+            if delay:
+                await asyncio.sleep(delay)
             await handler(conn, payload)
         except Exception:
             logger.exception("%s: notify handler %s failed", self.name, method)

@@ -12,6 +12,7 @@ starts dedicated workers per env the same way, worker_pool.h).
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import io
 import json
@@ -25,7 +26,8 @@ from typing import Dict, List, Optional, Tuple
 MAX_PACKAGE_BYTES = 256 * 1024 * 1024
 EXCLUDE_DIRS = {"__pycache__", ".git", ".hg", ".venv", "node_modules",
                 ".eggs", ".mypy_cache", ".pytest_cache"}
-_KNOWN_KEYS = {"env_vars", "working_dir", "py_modules", "config", "_hash"}
+_KNOWN_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "config",
+               "_hash"}
 
 
 def _default_cache_dir() -> str:
@@ -117,6 +119,17 @@ def validate(env: Optional[dict]) -> Optional[dict]:
     if pm is not None and (not isinstance(pm, (list, tuple))
                            or not all(isinstance(p, str) for p in pm)):
         raise TypeError("runtime_env['py_modules'] must be a list of paths/uris")
+    pip = out.get("pip")
+    if pip is not None:
+        # Accept ["pkg==1.0", ...] or {"packages": [...]} (reference
+        # pip.py accepts both forms).
+        if isinstance(pip, dict):
+            pip = pip.get("packages", [])
+        if (not isinstance(pip, (list, tuple))
+                or not all(isinstance(p, str) for p in pip)):
+            raise TypeError("runtime_env['pip'] must be a list of "
+                            "requirement strings")
+        out["pip"] = sorted(pip)
     return out
 
 
@@ -161,12 +174,41 @@ class RuntimeEnvManager:
                 if root not in sys.path:
                     sys.path.insert(0, root)
                 os.chdir(root)
+            pip = env.get("pip")
+            if pip:
+                await self._apply_pip(list(pip))
         except exc.RuntimeEnvSetupError:
             raise
         except Exception as e:  # noqa: BLE001
             raise exc.RuntimeEnvSetupError(
                 f"runtime env setup failed: {type(e).__name__}: {e}") from e
         self.applied_hash = h
+
+    async def _apply_pip(self, packages):
+        """Build (or reuse) the venv for `packages` and prepend its
+        site-packages to THIS worker's sys.path (reference: pip.py runtime
+        envs; the venv build is the slow part and is content-cached).
+
+        The default installer shells out to pip (needs network at deploy
+        time); tests inject one via RAY_TPU_PIP_INSTALLER="module:attr".
+        """
+        import sys as _sys
+        from ray_tpu._private.runtime_env_pip import PipEnvManager
+        installer = None
+        hook = os.environ.get("RAY_TPU_PIP_INSTALLER")
+        if hook:
+            mod_name, _, attr = hook.partition(":")
+            import importlib
+            installer = getattr(importlib.import_module(mod_name), attr)
+        mgr = PipEnvManager(os.path.join(self.cache_dir, "pip_envs"),
+                            installer=installer)
+        loop = asyncio.get_running_loop()
+        py = await loop.run_in_executor(None, mgr.ensure, list(packages))
+        venv_dir = os.path.dirname(os.path.dirname(py))
+        ver = f"python{_sys.version_info[0]}.{_sys.version_info[1]}"
+        sp = os.path.join(venv_dir, "lib", ver, "site-packages")
+        if sp not in _sys.path:
+            _sys.path.insert(0, sp)
 
     async def _fetch_unpack(self, uri: str, kv_fetch) -> str:
         from ray_tpu import exceptions as exc

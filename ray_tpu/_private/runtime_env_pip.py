@@ -1,0 +1,110 @@
+"""pip runtime environments: per-env virtualenvs for dependency isolation.
+
+Reference parity: python/ray/_private/runtime_env/pip.py — a task/actor
+declaring runtime_env={"pip": [...]} runs in a worker whose interpreter
+lives in a dedicated virtualenv with those packages. The venv is built
+with the stdlib `venv` module (inheriting site-packages so the base
+framework deps stay importable) and populated by an injectable installer —
+the default shells out to `<venv>/bin/python -m pip install`, which needs
+network access at deploy time (the runtime gate); tests inject a recording
+installer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import subprocess
+import sys
+from typing import Callable, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# installer(venv_python: str, packages: List[str]) -> None
+Installer = Callable[[str, List[str]], None]
+
+
+def pip_spec_hash(packages: List[str]) -> str:
+    canon = json.dumps(sorted(packages)).encode()
+    return hashlib.sha1(canon).hexdigest()[:16]
+
+
+def default_installer(venv_python: str, packages: List[str]) -> None:
+    """Real installer: pip inside the venv (needs network/index access)."""
+    cmd = [venv_python, "-m", "pip", "install", "--no-input", *packages]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"pip install failed ({proc.returncode}): "
+            f"{proc.stderr[-2000:]}")
+
+
+class PipEnvManager:
+    """Content-addressed venv cache: one venv per sorted package list."""
+
+    def __init__(self, cache_dir: str, installer: Optional[Installer] = None):
+        self.cache_dir = cache_dir
+        self.installer = installer or default_installer
+        os.makedirs(cache_dir, exist_ok=True)
+
+    def _venv_dir(self, spec_hash: str) -> str:
+        return os.path.join(self.cache_dir, f"pip-{spec_hash}")
+
+    @staticmethod
+    def venv_python(venv_dir: str) -> str:
+        return os.path.join(venv_dir, "bin", "python")
+
+    def ensure(self, packages: List[str]) -> str:
+        """Create-or-reuse the venv for `packages`; returns its python.
+
+        The venv inherits system site-packages so ray_tpu/jax remain
+        importable; the marker file is written only after a successful
+        install, so a crashed build is rebuilt, not reused.
+        """
+        packages = list(packages)
+        h = pip_spec_hash(packages)
+        venv_dir = self._venv_dir(h)
+        marker = os.path.join(venv_dir, ".ray_tpu_ready")
+        py = self.venv_python(venv_dir)
+        if os.path.exists(marker) and os.path.exists(py):
+            return py
+        # Cross-process build lock: a gang of workers starting the same
+        # env concurrently must not clear each other's half-built venv
+        # (reference pip plugin serializes builds the same way).
+        import fcntl
+        lock_path = os.path.join(self.cache_dir, f"pip-{h}.lock")
+        with open(lock_path, "w") as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            try:
+                return self._build_locked(packages, h, venv_dir, marker, py)
+            finally:
+                fcntl.flock(lock_f, fcntl.LOCK_UN)
+
+    def _build_locked(self, packages, h, venv_dir, marker, py):
+        if os.path.exists(marker) and os.path.exists(py):
+            return py  # another process built it while we waited
+        import venv as venv_mod
+        logger.info("building pip runtime env %s: %s", h, packages)
+        venv_mod.EnvBuilder(
+            system_site_packages=True, with_pip=False,
+            clear=os.path.isdir(venv_dir), symlinks=True).create(venv_dir)
+        # When the base interpreter is ITSELF a venv (common in container
+        # images), system_site_packages resolves to the SYSTEM python's
+        # site-packages, not the base venv's — the framework deps would
+        # vanish. A .pth file inheriting the parent's site-packages fixes
+        # it (reference pip plugin: "inherit base environment" path).
+        ver = f"python{sys.version_info[0]}.{sys.version_info[1]}"
+        sp = os.path.join(venv_dir, "lib", ver, "site-packages")
+        if os.path.isdir(sp):
+            parents = [p for p in sys.path
+                       if p.endswith("site-packages") and os.path.isdir(p)]
+            with open(os.path.join(sp, "_ray_tpu_inherit.pth"), "w") as f:
+                f.write("\n".join(parents) + "\n")
+        if packages:
+            self.installer(py, packages)
+        with open(marker, "w") as f:
+            json.dump({"packages": packages,
+                       "base_python": sys.executable}, f)
+        return py

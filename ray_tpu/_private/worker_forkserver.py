@@ -56,6 +56,13 @@ def _run_child(req: dict) -> None:
     if env:
         os.environ.clear()
         os.environ.update(env)
+        # os.environ alone doesn't retrofit sys.path — the zygote built its
+        # path from the PYTHONPATH it was STARTED with. Prepend any request
+        # PYTHONPATH entries the zygote didn't have (same staleness class
+        # as the env reset above).
+        for p in reversed(env.get("PYTHONPATH", "").split(os.pathsep)):
+            if p and p not in sys.path:
+                sys.path.insert(0, p)
     signal.signal(signal.SIGTERM, signal.SIG_DFL)
     signal.signal(signal.SIGINT, signal.SIG_IGN)
     try:

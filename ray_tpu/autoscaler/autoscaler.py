@@ -136,6 +136,22 @@ class StandardAutoscaler:
         # Each entry: {"cap": resources, "exclusive_taken": bool}.
         gcs_node_ids = {nid.hex() if hasattr(nid, "hex") else str(nid)
                         for nid in state["nodes"]}
+        # Cloud providers can't know GCS node ids (the cloud API never
+        # sees them): nodes register with a ray_tpu.io/provider-id label
+        # (TPUPodProvider startup script) and correlate through it.
+        gcs_hex_by_provider: Dict[str, str] = {}
+        for nid, info in state["nodes"].items():
+            p = (info.get("labels") or {}).get("ray_tpu.io/provider-id")
+            if p:
+                gcs_hex_by_provider[p] = (
+                    nid.hex() if hasattr(nid, "hex") else str(nid))
+
+        def gcs_hex_of(pid: str, tags: Dict[str, str]) -> str:
+            nid = tags.get("node_id", "")
+            if nid in gcs_node_ids:
+                return nid
+            return gcs_hex_by_provider.get(pid, "")
+
         bins: List[dict] = [
             {"cap": dict(n["available"]), "exclusive_taken": False}
             for n in state["nodes"].values() if n["alive"]]
@@ -144,7 +160,7 @@ class StandardAutoscaler:
         # update() pass doesn't double-launch.
         for pid in self.provider.non_terminated_nodes():
             tags = self.provider.node_tags(pid)
-            if tags.get("node_id", "") not in gcs_node_ids:
+            if not gcs_hex_of(pid, tags):
                 t = self.config.node_types.get(tags.get("node_type", ""))
                 if t:
                     bins.append({"cap": dict(t.resources),
@@ -212,8 +228,7 @@ class StandardAutoscaler:
             for gid, info in state["nodes"].items()}
 
         def node_idle(pid: str) -> bool:
-            n = gcs_by_hex.get(self.provider.node_tags(pid)
-                               .get("node_id", ""))
+            n = gcs_by_hex.get(gcs_hex_of(pid, self.provider.node_tags(pid)))
             if n is None or not n["alive"]:
                 return False
             return all(abs(n["available"].get(k, 0.0) - v) < 1e-6
@@ -235,7 +250,7 @@ class StandardAutoscaler:
                     and self._slices_of_type(t.name, t) > t.min_workers):
                 logger.info("autoscaler: terminating idle slice %s", pids)
                 for pid in pids:
-                    nid = self.provider.node_tags(pid).get("node_id", "")
+                    nid = gcs_hex_of(pid, self.provider.node_tags(pid))
                     self.gcs_request("drain_node", {"node_id_hex": nid})
                     self.provider.terminate_node(pid)
                     self._gang_of.pop(pid, None)

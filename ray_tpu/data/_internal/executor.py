@@ -240,19 +240,32 @@ class ActorMapOp(PhysOp):
     """Actor-pool map for stateful / class UDFs (compute=ActorPoolStrategy)."""
 
     def __init__(self, name, specs, remote_args: dict, pool_size: int,
-                 ctx, stats):
+                 ctx, stats, max_size: Optional[int] = None):
         super().__init__(name, ctx, stats)
         import cloudpickle
         blob = cloudpickle.dumps(specs)
         args = dict(remote_args)
         args.setdefault("num_cpus", 1)
-        cls = ray_tpu.remote(**args)(_MapWorker)
-        self._actors = [cls.remote(blob) for _ in range(pool_size)]
+        self._cls = ray_tpu.remote(**args)(_MapWorker)
+        self._blob = blob
+        self._min_size = pool_size
+        self._max_size = max(pool_size, max_size or pool_size)
+        self._actors = [self._cls.remote(blob) for _ in range(pool_size)]
         self._idle = deque(self._actors)
         self._inflight: Dict[Any, Tuple[int, Any, float]] = {}
         self._blockref: Dict[Any, Any] = {}
 
     def _dispatch(self):
+        # Autoscale up under backlog (reference: ActorPoolStrategy scales
+        # between min_size and max_size): more input waiting than idle
+        # actors, and room in the pool -> add workers until idle covers
+        # the queue. They join the idle deque and serve this same pass.
+        while (len(self.inq) > len(self._idle)
+               and len(self._actors) < self._max_size
+               and self.can_accept_work()):
+            actor = self._cls.remote(self._blob)
+            self._actors.append(actor)
+            self._idle.append(actor)
         while self.inq and self._idle and self.can_accept_work():
             seq, (ref, _meta) = self.inq.popleft()
             actor = self._idle.popleft()
@@ -551,10 +564,10 @@ class StreamingExecutor:
                                     self.stats))
             elif isinstance(node, AbstractMap):
                 if node.compute is not None:
-                    phys.append(ActorMapOp(node.name, node.specs,
-                                           node.ray_remote_args,
-                                           node.compute.size, self.ctx,
-                                           self.stats))
+                    phys.append(ActorMapOp(
+                        node.name, node.specs, node.ray_remote_args,
+                        node.compute.size, self.ctx, self.stats,
+                        max_size=getattr(node.compute, "max_size", None)))
                 else:
                     phys.append(TaskMapOp(node.name, node.specs,
                                           node.ray_remote_args, self.ctx,

@@ -30,16 +30,20 @@ from ray_tpu.data._internal import shuffle as _shuffle
 @dataclass
 class ActorPoolStrategy:
     """compute= strategy for stateful map_batches (reference:
-    ActorPoolStrategy). The pool is fixed-size: an explicit `size` wins;
-    otherwise min_size (max_size is accepted for API compatibility but the
-    pool does not autoscale yet)."""
+    ActorPoolStrategy). An explicit `size` pins the pool; otherwise it
+    starts at min_size and autoscales up to max_size under backlog."""
     size: Optional[int] = None
     min_size: Optional[int] = None
     max_size: Optional[int] = None
 
     def __post_init__(self):
-        if self.size is None:
-            self.size = self.min_size if self.min_size is not None else 2
+        if self.size is not None:
+            # Explicit size pins the pool: no autoscaling.
+            self.max_size = self.size
+            return
+        self.size = self.min_size if self.min_size is not None else 2
+        if self.max_size is None:
+            self.max_size = self.size
 
 
 class Dataset:

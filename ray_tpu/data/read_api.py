@@ -35,6 +35,47 @@ def range(n: int, *, parallelism: int = -1) -> "Dataset":
     return read_datasource(RangeDatasource(n), parallelism=parallelism)
 
 
+def read_tfrecords(paths, *, parallelism: int = -1) -> "Dataset":
+    """Raw TFRecord records as {"bytes": ...} rows (reference:
+    ray.data.read_tfrecords)."""
+    from ray_tpu.data.datasources import TFRecordDatasource
+    return read_datasource(TFRecordDatasource(paths),
+                           parallelism=parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = -1) -> "Dataset":
+    """WebDataset tar shards -> one row per sample (reference:
+    ray.data.read_webdataset)."""
+    from ray_tpu.data.datasources import WebDatasetDatasource
+    return read_datasource(WebDatasetDatasource(paths),
+                           parallelism=parallelism)
+
+
+def read_images(paths, *, size=None, mode=None,
+                parallelism: int = -1) -> "Dataset":
+    from ray_tpu.data.datasources import ImageDatasource
+    return read_datasource(ImageDatasource(paths, size=size, mode=mode),
+                           parallelism=parallelism)
+
+
+def read_orc(paths, *, parallelism: int = -1) -> "Dataset":
+    from ray_tpu.data.datasources import ORCDatasource
+    return read_datasource(ORCDatasource(paths), parallelism=parallelism)
+
+
+def read_avro(paths, *, parallelism: int = -1) -> "Dataset":
+    from ray_tpu.data.datasources import AvroDatasource
+    return read_datasource(AvroDatasource(paths), parallelism=parallelism)
+
+
+def read_sql(sql: str, connection_factory, *,
+             parallelism: int = -1) -> "Dataset":
+    """DBAPI2 query -> Dataset (reference: ray.data.read_sql)."""
+    from ray_tpu.data.datasources import SQLDatasource
+    return read_datasource(SQLDatasource(sql, connection_factory),
+                           parallelism=parallelism)
+
+
 def range_tensor(n: int, *, shape: tuple = (1,),
                  parallelism: int = -1) -> "Dataset":
     return read_datasource(RangeDatasource(n, tensor_shape=tuple(shape)),

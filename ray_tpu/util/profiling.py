@@ -1,0 +1,94 @@
+"""On-demand in-process profiling: CPU stack sampling + heap snapshots.
+
+Reference parity: dashboard/modules/reporter/profile_manager.py (:75 CPU
+via py-spy, :186 memory via memray) — the reference shells out to external
+profilers; here the equivalents are built in (no dependencies): a
+sampling profiler over sys._current_frames() and tracemalloc heap
+snapshots, exposed as worker RPCs ("profile_cpu", "profile_memory") and
+surfaced through the state API / dashboard.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Dict, List, Optional
+
+
+def sample_cpu(duration_s: float = 2.0, interval_s: float = 0.01,
+               top: int = 40) -> dict:
+    """Sample all threads' stacks for duration_s; returns aggregated stacks
+    sorted by sample count (a textual flamegraph: leaf-first frames joined
+    with ';')."""
+    counts: Counter = Counter()
+    thread_names = {}
+    me = threading.get_ident()
+    n_samples = 0
+    deadline = time.monotonic() + duration_s
+    for t in threading.enumerate():
+        thread_names[t.ident] = t.name
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue  # the sampler itself is noise
+            stack: List[str] = []
+            f = frame
+            depth = 0
+            while f is not None and depth < 60:
+                code = f.f_code
+                stack.append(f"{code.co_name} "
+                             f"({code.co_filename.rsplit('/', 1)[-1]}"
+                             f":{f.f_lineno})")
+                f = f.f_back
+                depth += 1
+            key = (thread_names.get(ident, str(ident)),
+                   ";".join(reversed(stack)))
+            counts[key] += 1
+        n_samples += 1
+        time.sleep(interval_s)
+    stacks = [{"thread": th, "stack": st, "count": c}
+              for (th, st), c in counts.most_common(top)]
+    return {"duration_s": duration_s, "samples": n_samples,
+            "stacks": stacks}
+
+
+_tracemalloc_started = False
+
+
+def snapshot_memory(top: int = 30, group_by: str = "lineno") -> dict:
+    """Heap snapshot via tracemalloc. The first call starts tracing and
+    reports only allocations made AFTER it (tracemalloc semantics) — call
+    once early, then again to diff, like memray attach."""
+    import tracemalloc
+    global _tracemalloc_started
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(8)
+        _tracemalloc_started = True
+        return {"started": True, "note": "tracing started; snapshot again "
+                                         "to see allocations", "top": []}
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics(group_by)[:top]
+    current, peak = tracemalloc.get_traced_memory()
+    return {
+        "started": False,
+        "traced_current_bytes": current,
+        "traced_peak_bytes": peak,
+        "top": [{
+            "location": str(s.traceback[0]) if s.traceback else "?",
+            "size_bytes": s.size,
+            "count": s.count,
+        } for s in stats],
+    }
+
+
+def stack_dump() -> Dict[str, str]:
+    """One-shot stack dump of every thread (the `ray stack` equivalent)."""
+    import traceback
+    out = {}
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in sys._current_frames().items():
+        out[names.get(ident, str(ident))] = "".join(
+            traceback.format_stack(frame))
+    return out

@@ -1,0 +1,16 @@
+"""Test installer for pip runtime envs: instead of calling pip (no
+network in CI), drop a tiny module into the venv's site-packages."""
+
+import os
+import sys
+
+
+def install(venv_python, packages):
+    venv_dir = os.path.dirname(os.path.dirname(venv_python))
+    ver = f"python{sys.version_info[0]}.{sys.version_info[1]}"
+    sp = os.path.join(venv_dir, "lib", ver, "site-packages")
+    os.makedirs(sp, exist_ok=True)
+    for pkg in packages:
+        name = pkg.split("==")[0].replace("-", "_")
+        with open(os.path.join(sp, f"{name}.py"), "w") as f:
+            f.write(f"SPEC = {pkg!r}\n")

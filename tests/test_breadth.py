@@ -1,0 +1,344 @@
+"""Breadth subsystems: extended datasources, external spill storage,
+on-demand profiling, pip runtime envs (round-4 VERDICT missing #6-#9)."""
+
+import os
+import sqlite3
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# datasources
+# ---------------------------------------------------------------------------
+
+def _read_all(ds):
+    rows = []
+    for task in ds.get_read_tasks(4):
+        for block in task():
+            rows.append(block)
+    return rows
+
+
+def test_tfrecord_roundtrip(tmp_path):
+    from ray_tpu.data.datasources import (TFRecordDatasource,
+                                          read_tfrecord_file,
+                                          write_tfrecord_file)
+    path = str(tmp_path / "data.tfrecord")
+    recs = [b"alpha", b"bravo" * 100, b""]
+    write_tfrecord_file(path, recs)
+    assert list(read_tfrecord_file(path)) == recs
+    blocks = _read_all(TFRecordDatasource(path))
+    assert list(blocks[0]["bytes"]) == recs
+
+
+def test_webdataset_tar(tmp_path):
+    import tarfile
+    from ray_tpu.data.datasources import WebDatasetDatasource
+    tar_path = str(tmp_path / "shard-000.tar")
+    (tmp_path / "s1.txt").write_bytes(b"hello")
+    (tmp_path / "s1.json").write_bytes(b'{"y": 1}')
+    (tmp_path / "s2.txt").write_bytes(b"world")
+    with tarfile.open(tar_path, "w") as tar:
+        for f in ("s1.txt", "s1.json", "s2.txt"):
+            tar.add(str(tmp_path / f), arcname=f)
+    rows = _read_all(WebDatasetDatasource(tar_path))[0]
+    by_key = {r["__key__"]: r for r in rows}
+    assert by_key["s1"]["txt"] == b"hello"
+    assert by_key["s1"]["json"] == b'{"y": 1}'
+    assert by_key["s2"]["txt"] == b"world"
+
+
+def test_sql_datasource():
+    from ray_tpu.data.datasources import SQLDatasource
+
+    def factory():
+        conn = sqlite3.connect(":memory:")
+        conn.execute("CREATE TABLE t (a INTEGER, b TEXT)")
+        conn.executemany("INSERT INTO t VALUES (?, ?)",
+                         [(1, "x"), (2, "y"), (3, "z")])
+        return conn
+
+    blocks = _read_all(SQLDatasource("SELECT a, b FROM t ORDER BY a",
+                                     factory))
+    assert list(blocks[0]["a"]) == [1, 2, 3]
+    assert list(blocks[0]["b"]) == ["x", "y", "z"]
+
+
+def test_image_datasource(tmp_path):
+    PIL = pytest.importorskip("PIL")  # noqa: F841
+    from PIL import Image
+    from ray_tpu.data.datasources import ImageDatasource
+    p = str(tmp_path / "img.png")
+    Image.fromarray(np.zeros((6, 8, 3), np.uint8)).save(p)
+    blocks = _read_all(ImageDatasource(p, size=(4, 4), mode="RGB"))
+    assert blocks[0]["image"].shape == (1, 4, 4, 3)
+
+
+def test_gated_connectors_raise():
+    from ray_tpu.data.datasources import (BigQueryDatasource,
+                                          MongoDatasource)
+    with pytest.raises(ImportError):
+        MongoDatasource("uri")
+    with pytest.raises(ImportError):
+        BigQueryDatasource("project")
+
+
+# ---------------------------------------------------------------------------
+# external spill storage
+# ---------------------------------------------------------------------------
+
+class MockS3Client:
+    def __init__(self):
+        self.objects = {}
+
+    def put_object(self, Bucket, Key, Body):
+        self.objects[(Bucket, Key)] = bytes(Body)
+
+    def get_object(self, Bucket, Key):
+        import io
+        return {"Body": io.BytesIO(self.objects[(Bucket, Key)])}
+
+    def delete_object(self, Bucket, Key):
+        self.objects.pop((Bucket, Key), None)
+
+
+def test_file_storage_roundtrip(tmp_path):
+    from ray_tpu._private.external_storage import storage_from_uri
+    st = storage_from_uri(f"file://{tmp_path}/spill")
+    loc = st.put("abc123", b"payload")
+    assert st.get(loc) == b"payload"
+    st.delete(loc)
+    assert not os.path.exists(loc)
+
+
+def test_s3_storage_with_mock_client():
+    from ray_tpu._private.external_storage import S3Storage
+    client = MockS3Client()
+    st = S3Storage("bkt", "pre/fix", client=client)
+    loc = st.put("objid", b"\x00" * 64)
+    assert loc == "s3://bkt/pre/fix/objid"
+    assert st.get(loc) == b"\x00" * 64
+    st.delete(loc)
+    assert client.objects == {}
+
+
+def test_storage_uri_validation():
+    from ray_tpu._private.external_storage import storage_from_uri
+    with pytest.raises(ValueError):
+        storage_from_uri("gcs://nope")
+    with pytest.raises(ValueError):
+        storage_from_uri("s3://")
+
+
+# ---------------------------------------------------------------------------
+# on-demand profiling
+# ---------------------------------------------------------------------------
+
+def test_cpu_sampler_catches_hot_function():
+    import threading
+    from ray_tpu.util.profiling import sample_cpu
+
+    stop = threading.Event()
+
+    def hot_spot():
+        while not stop.is_set():
+            sum(i * i for i in range(200))
+
+    t = threading.Thread(target=hot_spot, name="hot-thread", daemon=True)
+    t.start()
+    try:
+        prof = sample_cpu(duration_s=0.5, interval_s=0.01)
+    finally:
+        stop.set()
+        t.join(2)
+    assert prof["samples"] > 5
+    hot = [s for s in prof["stacks"] if "hot_spot" in s["stack"]]
+    assert hot, prof["stacks"][:3]
+
+
+def test_memory_snapshot():
+    from ray_tpu.util.profiling import snapshot_memory
+    first = snapshot_memory()
+    if first.get("started"):
+        big = [bytearray(100_000) for _ in range(20)]  # noqa: F841
+        snap = snapshot_memory()
+    else:
+        big = [bytearray(100_000) for _ in range(20)]  # noqa: F841
+        snap = snapshot_memory()
+    assert snap["traced_current_bytes"] > 0
+    assert snap["top"]
+
+
+def test_stack_dump():
+    from ray_tpu.util.profiling import stack_dump
+    dump = stack_dump()
+    assert any("test_stack_dump" in v for v in dump.values())
+
+
+# ---------------------------------------------------------------------------
+# pip runtime envs (mock-installed)
+# ---------------------------------------------------------------------------
+
+def test_pip_env_manager_builds_and_caches(tmp_path):
+    from ray_tpu._private.runtime_env_pip import PipEnvManager
+
+    calls = []
+
+    def recording_installer(python, packages):
+        calls.append((python, tuple(packages)))
+
+    mgr = PipEnvManager(str(tmp_path), installer=recording_installer)
+    py = mgr.ensure(["left-pad==1.0", "emoji"])
+    assert os.path.exists(py), py
+    assert len(calls) == 1 and calls[0][1] == ("left-pad==1.0", "emoji")
+    # Same spec -> cached venv, no reinstall.
+    py2 = mgr.ensure(["emoji", "left-pad==1.0"])
+    assert py2 == py and len(calls) == 1
+    # Different spec -> new venv.
+    py3 = mgr.ensure(["other"])
+    assert py3 != py and len(calls) == 2
+    # The venv python is runnable and sees the base interpreter's packages.
+    import subprocess
+    out = subprocess.run([py, "-c", "import numpy; print('NPOK')"],
+                         capture_output=True, text=True, timeout=60)
+    assert "NPOK" in out.stdout, out.stderr
+
+
+def test_pip_env_failed_build_retries(tmp_path):
+    from ray_tpu._private.runtime_env_pip import PipEnvManager
+
+    boom = {"n": 0}
+
+    def flaky_installer(python, packages):
+        boom["n"] += 1
+        if boom["n"] == 1:
+            raise RuntimeError("index unreachable")
+
+    mgr = PipEnvManager(str(tmp_path), installer=flaky_installer)
+    with pytest.raises(RuntimeError):
+        mgr.ensure(["pkg"])
+    # No ready-marker was written: the next ensure() rebuilds.
+    py = mgr.ensure(["pkg"])
+    assert os.path.exists(py) and boom["n"] == 2
+
+
+# ---------------------------------------------------------------------------
+# integration: pip env in a real task, dataset reads, profile RPC
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ray_breadth(jax_cpu):
+    import sys
+    import ray_tpu
+    helpers = os.path.join(os.path.dirname(__file__), "helpers")
+    os.environ["RAY_TPU_PIP_INSTALLER"] = "fake_pip_installer:install"
+    os.environ["PYTHONPATH"] = (helpers + os.pathsep
+                                + os.environ.get("PYTHONPATH", ""))
+    sys.path.insert(0, helpers)
+    ray_tpu.init(num_cpus=3, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    del os.environ["RAY_TPU_PIP_INSTALLER"]
+
+
+def test_pip_runtime_env_in_task(ray_breadth):
+    """A task declaring runtime_env={"pip": [...]} imports the installed
+    package inside the worker (installer mocked: no network)."""
+    ray_tpu = ray_breadth
+
+    @ray_tpu.remote(runtime_env={"pip": ["fancy-dep==2.1"]})
+    def use_dep():
+        import fancy_dep
+        return fancy_dep.SPEC
+
+    assert ray_tpu.get(use_dep.remote(), timeout=120) == "fancy-dep==2.1"
+
+
+def test_dataset_reads_new_sources(ray_breadth, tmp_path):
+    from ray_tpu import data as rdata
+    from ray_tpu.data.datasources import write_tfrecord_file
+
+    p = str(tmp_path / "x.tfrecord")
+    write_tfrecord_file(p, [b"a", b"bb", b"ccc"])
+    ds = rdata.read_tfrecords(p)
+    rows = ds.take_all()
+    assert sorted(r["bytes"] for r in rows) == [b"a", b"bb", b"ccc"]
+
+    def factory():
+        conn = sqlite3.connect(":memory:")
+        conn.execute("CREATE TABLE t (a INTEGER)")
+        conn.executemany("INSERT INTO t VALUES (?)", [(i,) for i in range(5)])
+        return conn
+
+    ds = rdata.read_sql("SELECT a FROM t ORDER BY a", factory)
+    assert [r["a"] for r in ds.take_all()] == [0, 1, 2, 3, 4]
+
+
+def test_actor_pool_autoscales(ray_breadth):
+    """ActorPoolStrategy(min_size=1, max_size=3) grows under backlog."""
+    from ray_tpu import data as rdata
+    from ray_tpu.data.dataset import ActorPoolStrategy
+
+    class AddPid:
+        def __call__(self, batch):
+            import os as _os
+            import time as _t
+            _t.sleep(0.4)  # slow stage: forces a backlog on one actor
+            batch["pid"] = np.full(len(next(iter(batch.values()))),
+                                   _os.getpid())
+            return batch
+
+    ds = rdata.range(200, parallelism=8).map_batches(
+        AddPid, batch_size=25,
+        compute=ActorPoolStrategy(min_size=1, max_size=3))
+    pids = {int(r["pid"]) for r in ds.take_all()}
+    # Backlog (8 blocks, 1 slow initial actor) must scale the pool up.
+    assert len(pids) >= 2, pids
+
+
+def test_profile_rpc_on_worker(ray_breadth):
+    """profile_cpu / stack_dump RPCs answer on a live worker."""
+    import asyncio
+    from ray_tpu._private import worker_api
+    ray_tpu = ray_breadth
+
+    @ray_tpu.remote
+    class Busy:
+        def spin(self, n):
+            return sum(i * i for i in range(n))
+
+        def addr(self):
+            from ray_tpu._private import worker_api as wa
+            return wa.get_core().address
+
+    b = Busy.remote()
+    addr = ray_tpu.get(b.addr.remote(), timeout=30)
+    core = worker_api.get_core()
+
+    async def probe():
+        dump = await core.clients.request(addr, "stack_dump", {}, timeout=30)
+        prof = await core.clients.request(
+            addr, "profile_cpu", {"duration_s": 0.3}, timeout=30)
+        mem = await core.clients.request(addr, "profile_memory", {},
+                                         timeout=30)
+        return dump, prof, mem
+
+    dump, prof, mem = worker_api._call_on_core_loop(core, probe(), 60)
+    assert isinstance(dump, dict) and dump
+    assert prof["samples"] >= 1
+    assert "started" in mem or mem.get("top") is not None
+
+
+def test_spill_to_external_storage(tmp_path, monkeypatch):
+    """Object spilling goes through the storage-URI backend."""
+    from ray_tpu._private.object_store import ObjectStoreHost
+
+    spill_uri_dir = tmp_path / "ext"
+    monkeypatch.setenv("RAY_TPU_SPILL_STORAGE_URI",
+                       f"file://{spill_uri_dir}")
+    host = ObjectStoreHost(capacity=1 << 20,
+                           spill_dir=str(tmp_path / "local"),
+                           prefault=False)
+    assert type(host.spill_storage).__name__ == "FileStorage"
+    assert host.spill_storage.directory == str(spill_uri_dir)

@@ -1,0 +1,93 @@
+"""Race-shaking: the core suite's hairiest paths under injected RPC delays.
+
+Reference: RAY_testing_asio_delay_us (src/ray/common/ray_config_def.h:838)
+— randomized handler-start delays reorder concurrently-arriving messages,
+which is how the reference shakes out ordering races under TSAN. Here the
+equivalent knob is RAY_TPU_TESTING_RPC_DELAY_US, applied in rpc.py.
+"""
+
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ray_delayed(jax_cpu):
+    # Delay every handler's start by 0-3ms: enough to reorder same-tick
+    # messages everywhere (pushes, replies, pubsub) without slowing the
+    # suite much. Must be set before init so workers inherit it.
+    from ray_tpu._private import rpc
+    os.environ["RAY_TPU_TESTING_RPC_DELAY_US"] = "*=0:3000"
+    rpc._delay_spec = None  # this process may have cached the empty spec
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    del os.environ["RAY_TPU_TESTING_RPC_DELAY_US"]
+    rpc._delay_spec = None
+
+
+def test_task_burst_under_delay(ray_delayed):
+    ray_tpu = ray_delayed
+
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    assert ray_tpu.get([sq.remote(i) for i in range(200)],
+                       timeout=120) == [i * i for i in range(200)]
+
+
+def test_actor_seq_order_under_delay(ray_delayed):
+    """Per-caller actor-call ordering must survive reordered pushes."""
+    ray_tpu = ray_delayed
+
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.seen = []
+
+        def add(self, i):
+            self.seen.append(i)
+            return i
+
+        def all(self):
+            return self.seen
+
+    a = Log.remote()
+    ray_tpu.get([a.add.remote(i) for i in range(100)], timeout=120)
+    # Execution order == submission order despite randomized delivery.
+    assert ray_tpu.get(a.all.remote(), timeout=30) == list(range(100))
+
+
+def test_streaming_generator_under_delay(ray_delayed):
+    """Stream items must come back in index order even when the
+    generator_item notifications are delivered shuffled."""
+    ray_tpu = ray_delayed
+
+    @ray_tpu.remote
+    def gen(n):
+        for i in range(n):
+            yield i
+
+    vals = [ray_tpu.get(r, timeout=60)
+            for r in gen.options(num_returns="streaming").remote(30)]
+    assert vals == list(range(30))
+
+
+def test_object_transfer_and_wait_under_delay(ray_delayed):
+    ray_tpu = ray_delayed
+    import numpy as np
+
+    big = np.arange(300_000)
+    ref = ray_tpu.put(big)
+
+    @ray_tpu.remote
+    def total(x):
+        return int(x.sum())
+
+    refs = [total.remote(ref) for _ in range(8)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=8, timeout=120)
+    assert len(ready) == 8 and not not_ready
+    expect = int(big.sum())
+    assert all(v == expect for v in ray_tpu.get(refs, timeout=60))

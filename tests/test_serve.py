@@ -54,8 +54,13 @@ def test_class_deployment_replicas_and_routing(ray_mod):
     h = serve.run(Counter.bind(100), name="d2", route_prefix="/counter")
     results = [h.remote(1).result(timeout=30) for _ in range(6)]
     assert all(r > 100 for r in results)
-    # Two distinct replicas served requests.
-    ids = {h.whoami.remote().result(timeout=30) for _ in range(8)}
+    # Two distinct replicas serve requests (power-of-two-choices is
+    # probabilistic and the second replica may still be starting on a
+    # loaded box: sample until both appear, bounded).
+    ids = set()
+    deadline = time.time() + 30
+    while len(ids) < 2 and time.time() < deadline:
+        ids.add(h.whoami.remote().result(timeout=30))
     assert len(ids) == 2
 
 
