@@ -80,6 +80,12 @@ class Config:
     task_max_retries_default: int = 3
     actor_max_restarts_default: int = 0
     gcs_rpc_timeout_s: float = 30.0
+    # External GCS state store (the Redis-equivalent): "host:port" of a
+    # `ray_tpu kv-store` server. When set, the GCS persists its snapshot
+    # there (keyed by gcs_storage_namespace) so a head restarted anywhere
+    # can recover cluster state. Empty = file snapshot in the session dir.
+    gcs_storage_address: str = ""
+    gcs_storage_namespace: str = "default"
 
     # --- pubsub / sync ---
     resource_broadcast_interval_s: float = 0.2

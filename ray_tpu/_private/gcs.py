@@ -105,6 +105,7 @@ class GcsServer:
         self._health_task: Optional[asyncio.Task] = None
         self._persist_task: Optional[asyncio.Task] = None
         self._dirty = False
+        self._ext_store = None  # ExternalStoreClient when configured
         self.address = ""
 
     async def start(self, host: str = "127.0.0.1", port: int = 0,
@@ -113,13 +114,21 @@ class GcsServer:
         one exists (head fault tolerance — reference:
         src/ray/gcs/store_client/redis_store_client.h persistence +
         gcs reconnect, ray_config_def.h:441)."""
+        if self.config.gcs_storage_address:
+            from ray_tpu._private.kv_store import ExternalStoreClient
+            self._ext_store = ExternalStoreClient(
+                self.config.gcs_storage_address, pool=self.clients)
         if restore:
-            self._maybe_restore()
+            restored = False
+            if self._ext_store is not None:
+                restored = await self._maybe_restore_external()
+            if not restored:
+                self._maybe_restore()
         self.server.register_all(self)
         actual = await self.server.start(host, port)
         self.address = f"{host}:{actual}"
         self._health_task = asyncio.ensure_future(self._health_loop())
-        if self.session_dir:
+        if self.session_dir or self._ext_store is not None:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
         await self._start_http(host)
         logger.info("GCS started at %s", self.address)
@@ -158,15 +167,52 @@ class GcsServer:
                     len(self.nodes), len(self.actors),
                     len(self.placement_groups), path)
 
+    def _ext_key(self) -> str:
+        return f"gcs_snapshot:{self.config.gcs_storage_namespace}"
+
+    async def _maybe_restore_external(self) -> bool:
+        """Recover state from the external store (Redis-equivalent). The
+        external copy wins over any local file: it is the one a head
+        restarted on a different machine can still reach."""
+        try:
+            blob = await self._ext_store.get(self._ext_key())
+        except Exception:
+            logger.exception("external store unreachable at startup; "
+                             "falling back to local snapshot")
+            return False
+        if blob is None:
+            return False
+        self.restore(blob)
+        now = time.time()
+        for info in self.nodes.values():
+            info.last_heartbeat = now
+        logger.info("GCS restored %d nodes / %d actors / %d PGs from "
+                    "external store %s", len(self.nodes), len(self.actors),
+                    len(self.placement_groups),
+                    self.config.gcs_storage_address)
+        return True
+
     async def _persist_loop(self):
         while True:
             await asyncio.sleep(self.config.heartbeat_interval_s)
             if self._dirty:
                 self._dirty = False
+                blob = None
                 try:
-                    self.save_snapshot()
+                    blob = self.snapshot()
+                    if self.session_dir:
+                        self.save_snapshot(data=blob)
                 except Exception:
                     logger.exception("GCS snapshot failed")
+                if self._ext_store is not None and blob is not None:
+                    try:
+                        await self._ext_store.set(self._ext_key(), blob)
+                    except Exception:
+                        # Re-arm the dirty flag: the external copy is now
+                        # stale and restore prefers it, so it MUST be
+                        # retried next tick even with no new mutations.
+                        self._dirty = True
+                        logger.exception("external store write failed")
 
     # ------------- node management -------------
 
@@ -226,14 +272,23 @@ class GcsServer:
                 while (await asyncio.wait_for(reader.readline(), 5)) \
                         not in (b"\r\n", b"\n", b""):
                     pass
+                api_routes = {
+                    "/api/status": self._status_summary,
+                    "/api/actors": self._actors_table,
+                    "/api/jobs": self._jobs_table,
+                    "/api/pgs": self._pgs_table,
+                    "/api/tasks": self._tasks_summary,
+                }
+                route = next((fn for p, fn in api_routes.items()
+                              if path.startswith(p)), None)
                 if path.startswith("/metrics"):
                     from ray_tpu.util import metrics as m
                     body = m.to_prometheus(self._merged_metrics())
                     ctype = "text/plain; version=0.0.4"
                     code = "200 OK"
-                elif path.startswith("/api/status"):
+                elif route is not None:
                     import json as _json
-                    body = _json.dumps(self._status_summary(), default=str)
+                    body = _json.dumps(route(), default=str)
                     ctype = "application/json"
                     code = "200 OK"
                 elif path == "/" or path.startswith("/dashboard"):
@@ -327,6 +382,47 @@ class GcsServer:
             "jobs_alive": sum(1 for j in self.jobs.values() if j.alive),
             "pending_demand": sum(len(v) for v in self.node_demand.values()),
         }
+
+    # ------------- dashboard REST tables (reference: dashboard/ REST
+    # endpoints backed by the GCS tables; here rendered by the tabbed
+    # /dashboard page) -------------
+
+    def _actors_table(self) -> list:
+        return [{
+            "actor_id": a.actor_id.hex(), "name": a.name,
+            "class_name": a.class_name, "state": a.state,
+            "node_id": a.node_id.hex() if a.node_id else "",
+            "address": a.address, "num_restarts": a.num_restarts,
+            "namespace": a.namespace,
+        } for a in self.actors.values()]
+
+    def _jobs_table(self) -> list:
+        return [{
+            "job_id": j.job_id.hex(), "entrypoint": j.entrypoint,
+            "alive": j.alive, "start_time": j.start_time,
+            "end_time": j.end_time,
+            "metadata": j.metadata,
+        } for j in self.jobs.values()]
+
+    def _pgs_table(self) -> list:
+        return [{
+            "pg_id": p.pg_id.hex(), "name": p.name,
+            "strategy": p.strategy, "state": p.state,
+            "bundles": len(p.bundles),
+            "placed": len(p.bundle_nodes),
+        } for p in self.placement_groups.values()]
+
+    def _tasks_summary(self) -> list:
+        """Counts by (task name, latest state) — `ray summary tasks`."""
+        latest: Dict[tuple, str] = {}
+        for e in self.task_events:
+            key = (e.get("name", ""), e.get("task_id"))
+            latest[key] = e.get("state", "")
+        counts: Dict[tuple, int] = {}
+        for (name, _tid), state in latest.items():
+            counts[(name, state)] = counts.get((name, state), 0) + 1
+        return [{"name": n, "state": s, "count": c}
+                for (n, s), c in sorted(counts.items())]
 
     async def rpc_report_metrics(self, conn, payload):
         self.metrics_reports[payload["reporter"]] = (time.time(),
@@ -891,11 +987,11 @@ class GcsServer:
         self.kv = state["kv"]
         self._job_counter = state["job_counter"]
 
-    def save_snapshot(self, path: str = ""):
+    def save_snapshot(self, path: str = "", data: bytes = None):
         path = path or self._snapshot_path()
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(self.snapshot())
+            f.write(data if data is not None else self.snapshot())
         os.replace(tmp, path)  # atomic: restore never sees a torn snapshot
 
 
@@ -921,6 +1017,18 @@ _DASHBOARD_HTML = """<!doctype html>
 <h2>Nodes</h2><table id="nodes"><thead><tr>
 <th>node</th><th>state</th><th>head</th><th>address</th>
 <th>CPU</th><th>TPU</th></tr></thead><tbody></tbody></table>
+<h2>Actors</h2><table id="actors"><thead><tr>
+<th>actor</th><th>name</th><th>class</th><th>state</th><th>node</th>
+<th>restarts</th></tr></thead><tbody></tbody></table>
+<h2>Jobs</h2><table id="jobs"><thead><tr>
+<th>job</th><th>entrypoint</th><th>state</th><th>started</th>
+<th>ended</th></tr></thead><tbody></tbody></table>
+<h2>Placement groups</h2><table id="pgs"><thead><tr>
+<th>pg</th><th>name</th><th>strategy</th><th>state</th>
+<th>bundles placed</th></tr></thead><tbody></tbody></table>
+<h2>Tasks</h2><table id="tasks"><thead><tr>
+<th>name</th><th>state</th><th>count</th></tr></thead><tbody></tbody>
+</table>
 <h2>Metrics</h2><pre id="metrics">loading…</pre>
 <script>
 async function tick(){
@@ -948,7 +1056,38 @@ async function tick(){
   }
   document.getElementById('metrics').textContent =
     await (await fetch('/metrics')).text();
+  await fillTable('/api/actors', '#actors',
+    a=>[a.actor_id.slice(0,12), a.name, a.class_name, a.state,
+        a.node_id.slice(0,12), a.num_restarts],
+    (a,i,td)=>{ if(i===3) td.className = a.state==='ALIVE'?'ok':
+                (a.state==='DEAD'?'dead':''); });
+  await fillTable('/api/jobs', '#jobs',
+    j=>[j.job_id.slice(0,12), j.entrypoint, j.alive?'RUNNING':'FINISHED',
+        new Date(j.start_time*1000).toLocaleTimeString(),
+        j.end_time? new Date(j.end_time*1000).toLocaleTimeString():''],
+    (j,i,td)=>{ if(i===2) td.className = j.alive?'ok':''; });
+  await fillTable('/api/pgs', '#pgs',
+    p=>[p.pg_id.slice(0,12), p.name, p.strategy, p.state,
+        `${p.placed}/${p.bundles}`]);
+  await fillTable('/api/tasks', '#tasks',
+    t=>[t.name, t.state, t.count]);
  }catch(e){ document.getElementById('summary').textContent = 'error: '+e; }
+}
+// All table fields are untrusted (any registrant chooses them): rows are
+// built with textContent, never innerHTML.
+async function fillTable(url, sel, cells, decorate){
+ const rows = await (await fetch(url)).json();
+ const tb = document.querySelector(sel+' tbody'); tb.innerHTML='';
+ for(const row of rows){
+  const tr=document.createElement('tr');
+  for(const [i,v] of cells(row).entries()){
+   const td=document.createElement('td');
+   td.textContent=String(v);
+   if(decorate) decorate(row,i,td);
+   tr.appendChild(td);
+  }
+  tb.appendChild(tr);
+ }
 }
 tick(); setInterval(tick, 2000);
 </script></body></html>"""

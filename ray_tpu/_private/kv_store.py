@@ -1,0 +1,155 @@
+"""Standalone external KV store — the Redis-equivalent for GCS state.
+
+Reference parity: the reference GCS persists its tables to an external
+Redis so a restarted head (possibly on another machine) can recover
+cluster state (src/ray/gcs/store_client/redis_store_client.h,
+python/ray/_private/gcs_utils.py). Here the external store is a tiny
+asyncio RPC server speaking the framework's own framed protocol
+(`_private/rpc.py`), with per-key files on disk so the store itself
+survives restarts.
+
+Run it standalone:  python -m ray_tpu kv-store --port 6379 --dir /data
+Point the head at it:  RAY_TPU_GCS_STORAGE_ADDRESS=host:6379 ray_tpu start --head
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import re
+from typing import Dict, Optional
+
+from ray_tpu._private import rpc
+
+logger = logging.getLogger(__name__)
+
+_SAFE_KEY = re.compile(r"[^A-Za-z0-9._-]")
+
+
+def _key_path(root: str, key: str) -> str:
+    return os.path.join(root, _SAFE_KEY.sub("_", key) + ".kv")
+
+
+class KVStoreServer:
+    """Blob store: set/get/delete/keys, everything persisted to disk.
+
+    Values are opaque bytes. Writes are atomic (tmp + rename) so a
+    concurrent reader or a crash mid-write never sees a torn value.
+    """
+
+    def __init__(self, data_dir: str = ""):
+        self.data_dir = data_dir
+        self.data: Dict[str, bytes] = {}
+        self.server = rpc.RpcServer()
+        self.address = ""
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._load()
+
+    def _load(self):
+        for name in os.listdir(self.data_dir):
+            if not name.endswith(".kv"):
+                continue
+            with open(os.path.join(self.data_dir, name), "rb") as f:
+                blob = f.read()
+            # first line = original key (files use a sanitised name)
+            nl = blob.index(b"\n")
+            self.data[blob[:nl].decode()] = blob[nl + 1:]
+        if self.data:
+            logger.info("kv-store loaded %d keys from %s",
+                        len(self.data), self.data_dir)
+
+    def _persist(self, key: str, value: Optional[bytes]):
+        if not self.data_dir:
+            return
+        path = _key_path(self.data_dir, key)
+        if value is None:
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(key.encode() + b"\n" + value)
+        os.replace(tmp, path)
+
+    # ------------- RPC handlers -------------
+
+    async def rpc_store_set(self, conn, payload) -> dict:
+        key, value = payload["key"], payload["value"]
+        self.data[key] = value
+        self._persist(key, value)
+        return {"ok": True}
+
+    async def rpc_store_get(self, conn, payload) -> dict:
+        return {"value": self.data.get(payload["key"])}
+
+    async def rpc_store_del(self, conn, payload) -> dict:
+        existed = self.data.pop(payload["key"], None) is not None
+        if existed:
+            self._persist(payload["key"], None)
+        return {"deleted": existed}
+
+    async def rpc_store_keys(self, conn, payload) -> dict:
+        prefix = payload.get("prefix", "")
+        return {"keys": [k for k in self.data if k.startswith(prefix)]}
+
+    async def rpc_store_ping(self, conn, payload) -> dict:
+        return {"ok": True, "keys": len(self.data)}
+
+    # ------------- lifecycle -------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self.server.register_all(self)
+        actual = await self.server.start(host, port)
+        self.address = f"{host}:{actual}"
+        logger.info("kv-store listening at %s (dir=%s)",
+                    self.address, self.data_dir or "<memory>")
+        return self.address
+
+    async def stop(self):
+        await self.server.stop()
+
+
+class ExternalStoreClient:
+    """Async client the GCS uses to push/pull its snapshot blob."""
+
+    def __init__(self, address: str, pool: Optional[rpc.ClientPool] = None):
+        self.address = address
+        self._pool = pool or rpc.ClientPool()
+        self._own_pool = pool is None
+
+    async def set(self, key: str, value: bytes):
+        await self._pool.request(self.address, "store_set",
+                                 {"key": key, "value": value}, timeout=30)
+
+    async def get(self, key: str) -> Optional[bytes]:
+        out = await self._pool.request(self.address, "store_get",
+                                       {"key": key}, timeout=30)
+        return out["value"]
+
+    async def delete(self, key: str):
+        await self._pool.request(self.address, "store_del", {"key": key},
+                                 timeout=30)
+
+    async def ping(self) -> dict:
+        return await self._pool.request(self.address, "store_ping", {},
+                                        timeout=10)
+
+    async def close(self):
+        if self._own_pool:
+            await self._pool.close_all()
+
+
+def run_server(host: str, port: int, data_dir: str):
+    """Blocking entry point for `python -m ray_tpu kv-store`."""
+
+    async def main():
+        srv = KVStoreServer(data_dir)
+        addr = await srv.start(host, port)
+        print(f"ray_tpu kv-store running at {addr}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(main())

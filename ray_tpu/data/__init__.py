@@ -12,7 +12,8 @@ from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.datasource import Datasource, ReadTask
 from ray_tpu.data.iterator import DataIterator
-from ray_tpu.data.read_api import (from_items, from_numpy, from_pandas, range,
+from ray_tpu.data.read_api import (from_arrow, from_arrow_refs, from_items,
+                                   from_numpy, from_pandas, range,
                                    range_tensor, read_avro,
                                    read_binary_files, read_csv, read_images,
                                    read_json, read_numpy, read_orc,
@@ -24,7 +25,8 @@ from ray_tpu.data.aggregate import (AggregateFn, Count, Max, Mean, Min, Std,
 __all__ = [
     "Block", "BlockAccessor", "BlockMetadata", "DataContext", "Dataset",
     "Datasource", "ReadTask", "DataIterator",
-    "from_items", "from_numpy", "from_pandas", "range", "range_tensor",
+    "from_arrow", "from_arrow_refs", "from_items", "from_numpy",
+    "from_pandas", "range", "range_tensor",
     "read_avro", "read_binary_files", "read_csv", "read_images",
     "read_json", "read_numpy", "read_orc", "read_parquet", "read_sql",
     "read_text", "read_tfrecords", "read_webdataset",

@@ -31,14 +31,16 @@ def _shuffle_map(block, n_out: int, seed):
     counts = np.bincount(assignment, minlength=n_out)
     if isinstance(block, dict):
         shuffled = {k: v[order] for k, v in block.items()}
-    else:
+    elif isinstance(block, list):
         shuffled = [block[i] for i in order]
+    else:  # pyarrow.Table: take() reorders without materialising rows
+        shuffled = block.take(order)
     acc = BlockAccessor.for_block(shuffled)
     parts, start = [], 0
     for c in counts:
         parts.append(acc.slice(start, start + int(c)))
         start += int(c)
-    return tuple(parts)
+    return parts[0] if len(parts) == 1 else tuple(parts)
 
 
 def _shuffle_reduce(seed, *parts):
@@ -49,8 +51,10 @@ def _shuffle_reduce(seed, *parts):
     order = rng.permutation(n)
     if isinstance(merged, dict):
         out = {k: v[order] for k, v in merged.items()}
-    else:
+    elif isinstance(merged, list):
         out = [merged[i] for i in order]
+    else:
+        out = merged.take(order)
     return out, _meta_of(out)
 
 
@@ -123,7 +127,9 @@ def repartition_bulk(refs, metas, num_blocks: int):
 def _sort_map(block, boundaries, key, descending):
     sb = sort_block(block, key, descending)
     parts = partition_sorted_block(sb, boundaries, key, descending)
-    return tuple(parts)
+    # num_returns == 1 does NOT unpack a 1-tuple: return the lone part
+    # bare or the reducer would concat a tuple as if it were a block.
+    return parts[0] if len(parts) == 1 else tuple(parts)
 
 
 def _sort_reduce(key, descending, *parts):

@@ -8,6 +8,10 @@ of numpy arrays (columnar), so a block IS a host batch ready for
 A block is one of:
   * dict[str, np.ndarray]  — columnar ("numpy") block, the canonical form
   * list[Any]              — simple block (rows of arbitrary objects)
+  * pyarrow.Table          — Arrow block (reference:
+                             python/ray/data/_internal/arrow_block.py);
+                             zero-copy slicing, IPC-friendly, used for
+                             tabular interchange (parquet/ORC/pandas).
 """
 
 from __future__ import annotations
@@ -19,7 +23,15 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Union
 
 import numpy as np
 
-Block = Union[Dict[str, np.ndarray], List[Any]]
+Block = Union[Dict[str, np.ndarray], List[Any], "pyarrow.Table"]
+
+
+def _is_arrow_table(obj: Any) -> bool:
+    """True for pyarrow.Table without importing pyarrow eagerly."""
+    if "pyarrow" not in sys.modules:
+        return False
+    import pyarrow as pa
+    return isinstance(obj, pa.Table)
 
 
 @dataclass
@@ -50,6 +62,8 @@ class BlockAccessor:
             return _ColumnarAccessor(block)
         if isinstance(block, list):
             return _SimpleAccessor(block)
+        if _is_arrow_table(block):
+            return _ArrowAccessor(block)
         raise TypeError(f"not a block: {type(block).__name__}")
 
     @staticmethod
@@ -62,6 +76,8 @@ class BlockAccessor:
             return batch
         if isinstance(batch, np.ndarray):
             return {"data": batch}
+        if _is_arrow_table(batch):
+            return batch
         try:  # pandas.DataFrame without importing pandas eagerly
             import pandas as pd
             if isinstance(batch, pd.DataFrame):
@@ -116,6 +132,9 @@ class BlockAccessor:
         if isinstance(blocks[0], dict):
             keys = list(blocks[0].keys())
             return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+        if _is_arrow_table(blocks[0]):
+            import pyarrow as pa
+            return pa.concat_tables(blocks)
         out: List[Any] = []
         for b in blocks:
             out.extend(b)
@@ -149,6 +168,10 @@ class _ColumnarAccessor(BlockAccessor):
             import pandas as pd
             return pd.DataFrame({k: list(v) if v.ndim > 1 else v
                                  for k, v in self._block.items()})
+        if batch_format == "pyarrow":
+            import pyarrow as pa
+            return pa.table({k: (list(v) if v.ndim > 1 else v)
+                             for k, v in self._block.items()})
         if batch_format in ("rows", "native"):
             return list(self.iter_rows())
         raise ValueError(f"unknown batch_format {batch_format!r}")
@@ -184,6 +207,9 @@ class _SimpleAccessor(BlockAccessor):
         if batch_format == "pandas":
             import pandas as pd
             return pd.DataFrame({"item": self._block})
+        if batch_format == "pyarrow":
+            import pyarrow as pa
+            return pa.table({"item": self._block})
         if batch_format in ("rows", "native"):
             return list(self._block)
         raise ValueError(f"unknown batch_format {batch_format!r}")
@@ -193,6 +219,58 @@ class _SimpleAccessor(BlockAccessor):
             return []
         idx = np.random.randint(0, len(self._block), size=min(n, len(self._block)))
         return [key(self._block[i]) if key else self._block[i] for i in idx]
+
+
+class _ArrowAccessor(BlockAccessor):
+    """pyarrow.Table blocks (reference arrow_block.py). Slicing is
+    zero-copy; numpy conversion materialises only on demand."""
+
+    def num_rows(self) -> int:
+        return self._block.num_rows
+
+    def size_bytes(self) -> int:
+        return int(self._block.nbytes)
+
+    def schema(self) -> Optional[List[str]]:
+        return list(self._block.column_names)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for batch in self._block.to_batches():
+            cols = {name: batch.column(i)
+                    for i, name in enumerate(batch.schema.names)}
+            for i in range(batch.num_rows):
+                yield {k: v[i].as_py() for k, v in cols.items()}
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._block.slice(start, end - start)
+
+    def to_batch(self, batch_format: str = "numpy") -> Any:
+        if batch_format == "pyarrow":
+            return self._block
+        if batch_format in ("numpy", "default"):
+            out = {}
+            for name in self._block.column_names:
+                col = self._block.column(name)
+                try:
+                    out[name] = col.combine_chunks().to_numpy(
+                        zero_copy_only=False)
+                except Exception:
+                    out[name] = np.asarray(col.to_pylist(), dtype=object)
+            return out
+        if batch_format == "pandas":
+            return self._block.to_pandas()
+        if batch_format in ("rows", "native"):
+            return list(self.iter_rows())
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def sample(self, n: int, key=None) -> List[Any]:
+        nrows = self.num_rows()
+        if nrows == 0:
+            return []
+        idx = np.random.randint(0, nrows, size=min(n, nrows))
+        rows = [{k: self._block.column(k)[int(i)].as_py()
+                 for k in self._block.column_names} for i in idx]
+        return [key(r) if key else r for r in rows]
 
 
 class BlockOutputBuffer:
@@ -238,6 +316,9 @@ def split_block_at(block: Block, indices: List[int]) -> List[Block]:
 
 def sort_block(block: Block, key, descending: bool = False) -> Block:
     """Sort one block by key (column name or callable)."""
+    if _is_arrow_table(block) and isinstance(key, str):
+        return block.sort_by([(key, "descending" if descending
+                               else "ascending")])
     acc = BlockAccessor.for_block(block)
     rows = list(acc.iter_rows())
     kf = key if callable(key) else (lambda r: r[key])
@@ -246,6 +327,12 @@ def sort_block(block: Block, key, descending: bool = False) -> Block:
         if not rows:
             return block
         return {k: np.asarray([r[k] for r in rows]) for k in block.keys()}
+    if _is_arrow_table(block):
+        import pyarrow as pa
+        if not rows:
+            return block
+        return pa.table({k: [r[k] for r in rows]
+                         for k in block.column_names})
     return rows
 
 

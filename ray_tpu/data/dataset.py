@@ -195,6 +195,28 @@ class Dataset:
         """[(ObjectRef[Block], BlockMetadata)] — executes the plan."""
         return list(self._execute())
 
+    def to_arrow_refs(self):
+        """[ObjectRef[pyarrow.Table]] — one per block (reference:
+        Dataset.to_arrow_refs). Blocks already in Arrow form pass
+        through untouched."""
+        out = []
+        for ref, _meta in self._execute():
+            block = ray_tpu.get(ref)
+            acc = BlockAccessor.for_block(block)
+            table = acc.to_batch("pyarrow")
+            out.append(ref if table is block else ray_tpu.put(table))
+        return out
+
+    def to_pandas(self):
+        """Materialize the whole dataset as one pandas DataFrame."""
+        import pandas as pd
+        frames = [BlockAccessor.for_block(b).to_batch("pandas")
+                  for b in self.iter_blocks()]
+        frames = [f for f in frames if len(f)]
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
+
     def iter_blocks(self) -> Iterator[Block]:
         for ref, _meta in self._execute():
             yield ray_tpu.get(ref)
@@ -413,8 +435,8 @@ class Dataset:
             raise ImportError("write_parquet requires pyarrow") from e
         os.makedirs(path, exist_ok=True)
         for i, block in enumerate(self.iter_blocks()):
-            batch = BlockAccessor.for_block(block).to_batch("numpy")
-            table = pa.table({k: list(v) for k, v in batch.items()})
+            acc = BlockAccessor.for_block(block)
+            table = acc.to_batch("pyarrow")
             pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
 
     def __repr__(self):

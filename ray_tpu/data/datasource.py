@@ -193,6 +193,14 @@ class NumpyDatasource(FileBasedDatasource):
 
 
 class ParquetDatasource(FileBasedDatasource):
+    """Parquet files -> Arrow blocks (reference keeps parquet reads in
+    Arrow form; downstream ops see them through BlockAccessor and numpy
+    conversion happens only where a numpy batch is asked for)."""
+
+    def __init__(self, paths, arrow_blocks: bool = True):
+        super().__init__(paths)
+        self._arrow_blocks = arrow_blocks
+
     def _read_file(self, path):
         try:
             import pyarrow.parquet as pq
@@ -201,5 +209,7 @@ class ParquetDatasource(FileBasedDatasource):
                 "read_parquet requires pyarrow, which is not installed"
             ) from e
         table = pq.read_table(path)
+        if self._arrow_blocks:
+            return [table]
         return [{c: table[c].to_numpy(zero_copy_only=False)
                  for c in table.column_names}]

@@ -123,6 +123,31 @@ def from_numpy(arr: Union[np.ndarray, List[np.ndarray]],
     return _make_dataset(InputData(refs, metas))
 
 
+def from_arrow(tables) -> "Dataset":
+    """Create a Dataset from pyarrow.Table(s), kept as Arrow blocks
+    (reference: python/ray/data/read_api.py from_arrow)."""
+    import ray_tpu
+    if not isinstance(tables, list):
+        tables = [tables]
+    refs, metas = [], []
+    for t in tables:
+        refs.append(ray_tpu.put(t))
+        metas.append(BlockAccessor.for_block(t).get_metadata())
+    return _make_dataset(InputData(refs, metas))
+
+
+def from_arrow_refs(refs) -> "Dataset":
+    import ray_tpu
+    if not isinstance(refs, list):
+        refs = [refs]
+    # Metadata is computed next to each block — never pull the tables
+    # into the driver.
+    meta_of = ray_tpu.remote(
+        lambda b: BlockAccessor.for_block(b).get_metadata())
+    metas = ray_tpu.get([meta_of.remote(r) for r in refs])
+    return _make_dataset(InputData(list(refs), metas))
+
+
 def from_pandas(dfs) -> "Dataset":
     import ray_tpu
     if not isinstance(dfs, list):
@@ -156,5 +181,7 @@ def read_binary_files(paths, *, parallelism: int = -1) -> "Dataset":
     return read_datasource(BinaryDatasource(paths), parallelism=parallelism)
 
 
-def read_parquet(paths, *, parallelism: int = -1) -> "Dataset":
-    return read_datasource(ParquetDatasource(paths), parallelism=parallelism)
+def read_parquet(paths, *, parallelism: int = -1,
+                 arrow_blocks: bool = True) -> "Dataset":
+    return read_datasource(ParquetDatasource(paths, arrow_blocks),
+                           parallelism=parallelism)

@@ -1,0 +1,250 @@
+"""CQL: Conservative Q-Learning for offline continuous control.
+
+Reference parity: rllib/algorithms/cql/cql.py (+ cql_torch_policy loss —
+Kumar et al. 2020): SAC machinery trained purely from an offline dataset,
+with a conservative regularizer that pushes down Q on out-of-distribution
+actions (logsumexp over sampled actions) and up on dataset actions.
+
+TPU-first: the conservative logsumexp is vectorised over `num_ood_actions`
+uniform + policy samples in one batched twin-Q evaluation inside the same
+jitted update as the SAC losses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.offline import JsonReader
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class CQLConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or CQL)
+        self.env = "Pendulum-v1"
+        self.input_path = ""
+        self.tau = 0.005
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.initial_alpha = 1.0
+        self.target_entropy = None
+        self.cql_alpha = 1.0            # conservative penalty weight
+        self.num_ood_actions = 4        # sampled actions per state for lse
+        self.train_batch_size = 256
+        self.num_env_runners = 0        # offline: no rollout actors
+
+    def offline_data(self, *, input_path=None) -> "CQLConfig":
+        if input_path is not None:
+            self.input_path = input_path
+        return self
+
+    def training(self, *, tau=None, actor_lr=None, critic_lr=None,
+                 alpha_lr=None, cql_alpha=None, num_ood_actions=None,
+                 **kw) -> "CQLConfig":
+        super().training(**kw)
+        for name, v in (("tau", tau), ("actor_lr", actor_lr),
+                        ("critic_lr", critic_lr), ("alpha_lr", alpha_lr),
+                        ("cql_alpha", cql_alpha),
+                        ("num_ood_actions", num_ood_actions)):
+            if v is not None:
+                setattr(self, name, v)
+        return self
+
+
+class CQLLearner:
+    """SAC update + conservative penalty, one jitted function."""
+
+    def __init__(self, obs_dim: int, action_dim: int, low: float,
+                 high: float, *, hidden=(64, 64), actor_lr=3e-4,
+                 critic_lr=3e-4, alpha_lr=3e-4, gamma=0.99, tau=0.005,
+                 initial_alpha=1.0, target_entropy=None, cql_alpha=1.0,
+                 num_ood_actions=4, seed=0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from ray_tpu.rllib.models import (squashed_gaussian_init,
+                                          squashed_gaussian_sample,
+                                          twin_q_apply, twin_q_init)
+        if target_entropy is None:
+            target_entropy = -float(action_dim)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        self.state = {
+            "actor": squashed_gaussian_init(k1, obs_dim, action_dim,
+                                            hidden=tuple(hidden)),
+            "critic": twin_q_init(k2, obs_dim, action_dim,
+                                  hidden=tuple(hidden)),
+            "log_alpha": jnp.log(jnp.float32(initial_alpha)),
+        }
+        self.state["target_critic"] = jax.tree_util.tree_map(
+            lambda x: x, self.state["critic"])
+        self._opt_actor = optax.adam(actor_lr)
+        self._opt_critic = optax.adam(critic_lr)
+        self._opt_alpha = optax.adam(alpha_lr)
+        self.opt_state = {
+            "actor": self._opt_actor.init(self.state["actor"]),
+            "critic": self._opt_critic.init(self.state["critic"]),
+            "alpha": self._opt_alpha.init(self.state["log_alpha"]),
+        }
+        n_ood = num_ood_actions
+
+        def _q_on_sampled(critic, obs, actions):
+            """actions: [n, B, A] -> stacked (q1, q2): [n, B] each."""
+            def one(a):
+                return twin_q_apply(critic, obs, a)
+            q1s, q2s = jax.vmap(one)(actions)
+            return q1s, q2s
+
+        def critic_loss(critic, state, batch, rng):
+            r_td, r_ood, r_pi, r_pi2 = jax.random.split(rng, 4)
+            # --- standard SAC TD target
+            a2, logp2 = squashed_gaussian_sample(
+                r_td, state["actor"], batch[sb.NEXT_OBS], low, high)
+            tq1, tq2 = twin_q_apply(state["target_critic"],
+                                    batch[sb.NEXT_OBS], a2)
+            alpha = jnp.exp(state["log_alpha"])
+            target = batch[sb.REWARDS] + gamma * (
+                1.0 - batch[sb.TERMINATEDS]) * (
+                    jnp.minimum(tq1, tq2) - alpha * logp2)
+            target = jax.lax.stop_gradient(target)
+            q1, q2 = twin_q_apply(critic, batch[sb.OBS], batch[sb.ACTIONS])
+            td = ((q1 - target) ** 2 + (q2 - target) ** 2).mean()
+
+            # --- conservative regularizer: logsumexp over OOD actions
+            B = batch[sb.OBS].shape[0]
+            a_dim = batch[sb.ACTIONS].shape[-1]
+            rand_a = jax.random.uniform(r_ood, (n_ood, B, a_dim),
+                                        minval=low, maxval=high)
+            pi_a, _ = squashed_gaussian_sample(
+                r_pi, state["actor"],
+                jnp.broadcast_to(batch[sb.OBS], (n_ood, B, obs_dim)
+                                 ).reshape(n_ood * B, obs_dim), low, high)
+            pi_a = pi_a.reshape(n_ood, B, a_dim)
+            cat = jnp.concatenate([rand_a, pi_a], axis=0)   # [2n, B, A]
+            cq1, cq2 = _q_on_sampled(critic, batch[sb.OBS], cat)
+            lse1 = jax.nn.logsumexp(cq1, axis=0)
+            lse2 = jax.nn.logsumexp(cq2, axis=0)
+            conservative = ((lse1 - q1) + (lse2 - q2)).mean()
+            return td + cql_alpha * conservative, (
+                0.5 * (q1.mean() + q2.mean()), conservative)
+
+        def actor_loss(actor, state, batch, rng):
+            a, logp = squashed_gaussian_sample(rng, actor, batch[sb.OBS],
+                                               low, high)
+            q1, q2 = twin_q_apply(state["critic"], batch[sb.OBS], a)
+            alpha = jnp.exp(state["log_alpha"])
+            return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp.mean()
+
+        def alpha_loss(log_alpha, mean_logp):
+            return -(log_alpha * jax.lax.stop_gradient(
+                mean_logp + target_entropy))
+
+        def update(state, opt_state, batch, rng):
+            rng_c, rng_a = jax.random.split(rng)
+            (c_loss, (q_mean, gap)), c_grads = jax.value_and_grad(
+                critic_loss, has_aux=True)(state["critic"], state, batch,
+                                           rng_c)
+            upd, opt_state["critic"] = self._opt_critic.update(
+                c_grads, opt_state["critic"], state["critic"])
+            state["critic"] = optax.apply_updates(state["critic"], upd)
+
+            (a_loss, mean_logp), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(state["actor"], state, batch,
+                                          rng_a)
+            upd, opt_state["actor"] = self._opt_actor.update(
+                a_grads, opt_state["actor"], state["actor"])
+            state["actor"] = optax.apply_updates(state["actor"], upd)
+
+            al_loss, al_grad = jax.value_and_grad(alpha_loss)(
+                state["log_alpha"], mean_logp)
+            upd, opt_state["alpha"] = self._opt_alpha.update(
+                al_grad, opt_state["alpha"], state["log_alpha"])
+            state["log_alpha"] = optax.apply_updates(state["log_alpha"], upd)
+
+            state["target_critic"] = jax.tree_util.tree_map(
+                lambda t, s: (1 - tau) * t + tau * s,
+                state["target_critic"], state["critic"])
+            return state, opt_state, {
+                "critic_loss": c_loss, "actor_loss": a_loss,
+                "cql_gap": gap, "mean_q": q_mean,
+                "alpha": jnp.exp(state["log_alpha"]),
+            }
+
+        self._jit_update = jax.jit(update)
+        self._key = jax.random.PRNGKey(seed + 1)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+        jb = {
+            sb.OBS: jnp.asarray(batch[sb.OBS], jnp.float32),
+            sb.ACTIONS: jnp.asarray(batch[sb.ACTIONS],
+                                    jnp.float32).reshape(len(batch), -1),
+            sb.REWARDS: jnp.asarray(batch[sb.REWARDS], jnp.float32),
+            sb.NEXT_OBS: jnp.asarray(batch[sb.NEXT_OBS], jnp.float32),
+            sb.TERMINATEDS: jnp.asarray(batch[sb.TERMINATEDS], jnp.float32),
+        }
+        self._key, sub = jax.random.split(self._key)
+        self.state, self.opt_state, m = self._jit_update(
+            self.state, self.opt_state, jb, sub)
+        return {k: float(v) for k, v in m.items()}
+
+    def get_weights(self):
+        return self.state
+
+    def set_weights(self, state):
+        self.state = state
+
+
+class CQL(Algorithm):
+    config_class = CQLConfig
+
+    def setup(self, config: Dict[str, Any]):
+        cfg = self.algo_config
+        if not cfg.input_path:
+            raise ValueError(
+                "CQL requires config.offline_data(input_path=...)")
+        self.env_runners = []
+        self._episode_rewards = []
+        self.reader = JsonReader(cfg.input_path, seed=cfg.seed)
+        self.data = self.reader.read_all()
+        self._rng = np.random.RandomState(cfg.seed)
+        self.build_learner()
+
+    def build_learner(self):
+        cfg = self.algo_config
+        probe = make_env(cfg.env, cfg.env_config)
+        self.learner = CQLLearner(
+            probe.observation_dim, probe.action_dim, probe.action_low,
+            probe.action_high, hidden=cfg.hidden, actor_lr=cfg.actor_lr,
+            critic_lr=cfg.critic_lr, alpha_lr=cfg.alpha_lr,
+            gamma=cfg.gamma, tau=cfg.tau,
+            initial_alpha=cfg.initial_alpha,
+            target_entropy=cfg.target_entropy, cql_alpha=cfg.cql_alpha,
+            num_ood_actions=cfg.num_ood_actions, seed=cfg.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        n = len(self.data)
+        idx = self._rng.randint(0, n, size=min(cfg.train_batch_size, n))
+        batch = SampleBatch({k: v[idx] for k, v in self.data.items()})
+        m = self.learner.update(batch)
+        m["num_samples_trained"] = int(len(idx))
+        m["episode_reward_mean"] = float("nan")
+        return m
+
+    def save_checkpoint(self):
+        return {"state": self.learner.get_weights(),
+                "iteration": self._iteration}
+
+    def load_checkpoint(self, ckpt):
+        self.learner.set_weights(ckpt["state"])
+        self._iteration = ckpt.get("iteration", 0)
+
+    def cleanup(self):
+        pass

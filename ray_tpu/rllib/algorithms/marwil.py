@@ -1,0 +1,171 @@
+"""MARWIL: Monotonic Advantage Re-Weighted Imitation Learning.
+
+Reference parity: rllib/algorithms/marwil/marwil.py (Wang et al. 2018):
+offline imitation where each action's log-likelihood is weighted by
+exp(beta * advantage), with a learned value baseline — beta=0 degrades to
+plain BC (the reference's BC literally subclasses MARWIL with beta=0).
+
+Returns-to-go are computed per stored episode fragment at load time from
+the REWARDS/TERMINATEDS columns.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.models import policy_value_apply, policy_value_init
+from ray_tpu.rllib.offline import JsonReader
+from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MARWIL)
+        self.input_path = ""
+        self.beta = 1.0                 # advantage exponent; 0 => BC
+        self.vf_coeff = 1.0
+        self.moving_average_sqd_adv_norm_update_rate = 1e-2
+        self.train_batch_size = 256
+        self.num_env_runners = 0
+
+    def offline_data(self, *, input_path=None) -> "MARWILConfig":
+        if input_path is not None:
+            self.input_path = input_path
+        return self
+
+    def training(self, *, beta=None, vf_coeff=None, **kw) -> "MARWILConfig":
+        super().training(**kw)
+        if beta is not None:
+            self.beta = beta
+        if vf_coeff is not None:
+            self.vf_coeff = vf_coeff
+        return self
+
+
+def _returns_to_go(batch: SampleBatch, gamma: float) -> np.ndarray:
+    """Discounted returns within one stored fragment; episode boundaries
+    from TERMINATEDS (reference: marwil postprocesses with
+    compute_advantages over complete episodes)."""
+    r = np.asarray(batch[sb.REWARDS], np.float32)
+    done = np.asarray(batch.get(sb.TERMINATEDS, np.zeros_like(r)),
+                      np.float32)
+    out = np.zeros_like(r)
+    acc = 0.0
+    for i in range(len(r) - 1, -1, -1):
+        acc = r[i] + gamma * acc * (1.0 - done[i])
+        out[i] = acc
+    return out
+
+
+class MARWIL(Algorithm):
+    config_class = MARWILConfig
+
+    def setup(self, config: Dict[str, Any]):
+        cfg = self.algo_config
+        if not cfg.input_path:
+            raise ValueError(
+                "MARWIL requires config.offline_data(input_path=...)")
+        self.env_runners = []
+        self._episode_rewards = []
+        reader = JsonReader(cfg.input_path, seed=cfg.seed)
+        frags = []
+        for frag in reader.iter_batches():
+            frag["returns"] = _returns_to_go(frag, cfg.gamma)
+            frags.append(frag)
+        self.data = concat_samples(frags)
+        self._rng = np.random.RandomState(cfg.seed)
+        self.build_learner()
+
+    def build_learner(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        cfg = self.algo_config
+        probe = make_env(cfg.env, cfg.env_config)
+        self.params = policy_value_init(
+            jax.random.PRNGKey(cfg.seed), probe.observation_dim,
+            probe.num_actions, hidden=cfg.hidden)
+        self._optimizer = optax.adam(cfg.lr)
+        self.opt_state = self._optimizer.init(self.params)
+        # running normalizer for squared advantages (reference:
+        # marwil_torch_policy ma_adv_norm) kept as a jax scalar carry.
+        self._adv_norm = jnp.float32(100.0)
+        beta, vf_coeff = cfg.beta, cfg.vf_coeff
+        rate = cfg.moving_average_sqd_adv_norm_update_rate
+
+        def loss_fn(params, adv_norm, obs, actions, returns):
+            logits, values = policy_value_apply(params, obs)
+            adv = returns - values
+            new_norm = adv_norm + rate * (
+                jax.lax.stop_gradient((adv ** 2).mean()) - adv_norm)
+            w = jnp.exp(beta * jax.lax.stop_gradient(
+                adv / jnp.sqrt(new_norm + 1e-8)))
+            w = jnp.minimum(w, 20.0)  # clip exploding weights
+            logp = jax.nn.log_softmax(logits)
+            n = logits.shape[0]
+            policy_loss = -(w * logp[jnp.arange(n), actions]).mean()
+            vf_loss = (adv ** 2).mean()
+            return policy_loss + vf_coeff * vf_loss, (
+                new_norm, policy_loss, vf_loss)
+
+        def update(params, opt_state, adv_norm, obs, actions, returns):
+            (loss, (new_norm, p_loss, v_loss)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, adv_norm, obs, actions,
+                                       returns)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            return (optax.apply_updates(params, updates), opt_state,
+                    new_norm, loss, p_loss, v_loss)
+
+        self._jit_update = jax.jit(update)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        cfg = self.algo_config
+        n = len(self.data)
+        idx = self._rng.randint(0, n, size=min(cfg.train_batch_size, n))
+        obs = jnp.asarray(self.data[sb.OBS][idx])
+        actions = jnp.asarray(self.data[sb.ACTIONS][idx])
+        returns = jnp.asarray(self.data["returns"][idx])
+        (self.params, self.opt_state, self._adv_norm, loss, p_loss,
+         v_loss) = self._jit_update(self.params, self.opt_state,
+                                    self._adv_norm, obs, actions, returns)
+        return {"loss": float(loss), "policy_loss": float(p_loss),
+                "vf_loss": float(v_loss),
+                "num_samples_trained": int(len(idx)),
+                "episode_reward_mean": float("nan")}
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
+        import jax
+        cfg = self.algo_config
+        env = make_env(cfg.env, cfg.env_config)
+        fwd = jax.jit(policy_value_apply)
+        rewards = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=cfg.seed + ep)
+            total, done = 0.0, False
+            while not done:
+                logits, _ = fwd(self.params, obs[None, :])
+                a = int(np.argmax(np.asarray(logits)[0]))
+                obs, r, term, trunc, _ = env.step(a)
+                total += r
+                done = term or trunc
+            rewards.append(total)
+        return {"evaluation_reward_mean": float(np.mean(rewards))}
+
+    def save_checkpoint(self):
+        return {"params": self.params, "adv_norm": self._adv_norm,
+                "iteration": self._iteration}
+
+    def load_checkpoint(self, ckpt):
+        self.params = ckpt["params"]
+        self._adv_norm = ckpt.get("adv_norm", self._adv_norm)
+        self._iteration = ckpt.get("iteration", 0)
+
+    def cleanup(self):
+        pass

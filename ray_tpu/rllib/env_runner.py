@@ -156,10 +156,13 @@ class ContinuousEnvRunner(_RewardTracker):
     (reference: rollout_worker.py with StochasticSampling exploration)."""
 
     def __init__(self, env_spec, env_config: dict, num_envs: int,
-                 seed: int, hidden=(64, 64)):
+                 seed: int, hidden=(64, 64), policy: str = "squashed_gaussian",
+                 expl_noise: float = 0.1):
         import jax
+        import jax.numpy as jnp
         jax.config.update("jax_platforms", "cpu")
-        from ray_tpu.rllib.models import (squashed_gaussian_init,
+        from ray_tpu.rllib.models import (det_actor_apply, det_actor_init,
+                                          squashed_gaussian_init,
                                           squashed_gaussian_sample)
         self._envs = [make_env(env_spec, env_config) for _ in range(num_envs)]
         e0 = self._envs[0]
@@ -173,12 +176,26 @@ class ContinuousEnvRunner(_RewardTracker):
             obs, _ = e.reset(seed=seed + i)
             self._obs.append(obs)
         self._key = jax.random.PRNGKey(seed)
-        self._params = squashed_gaussian_init(
-            self._key, e0.observation_dim, e0.action_dim,
-            hidden=tuple(hidden))
-        self._jit_sample = jax.jit(
-            lambda k, p, o: squashed_gaussian_sample(
-                k, p, o, self._low, self._high))
+        if policy == "deterministic":
+            # DDPG/TD3 exploration: mu(s) + N(0, expl_noise*scale), clipped
+            # (reference: rllib/algorithms/ddpg GaussianNoise exploration).
+            self._params = det_actor_init(self._key, e0.observation_dim,
+                                          e0.action_dim, hidden=tuple(hidden))
+            sigma = expl_noise * (self._high - self._low) / 2.0
+
+            def det_sample(k, p, o):
+                a = det_actor_apply(p, o, self._low, self._high)
+                a = a + sigma * jax.random.normal(k, a.shape)
+                return jnp.clip(a, self._low, self._high), None
+
+            self._jit_sample = jax.jit(det_sample)
+        else:
+            self._params = squashed_gaussian_init(
+                self._key, e0.observation_dim, e0.action_dim,
+                hidden=tuple(hidden))
+            self._jit_sample = jax.jit(
+                lambda k, p, o: squashed_gaussian_sample(
+                    k, p, o, self._low, self._high))
 
     def set_weights(self, params):
         self._params = params

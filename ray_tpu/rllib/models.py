@@ -99,6 +99,23 @@ def squashed_gaussian_sample(rng, params, obs, low: float, high: float):
     return mid + scale * tanh, logp
 
 
+def det_actor_init(rng, obs_dim: int, action_dim: int,
+                   hidden: Tuple[int, ...] = (64, 64)):
+    """Deterministic policy mu(s) for DDPG/TD3 (reference:
+    rllib/algorithms/ddpg deterministic actor)."""
+    import jax
+    k = jax.random.split(rng, 1)[0]
+    return {"net": mlp_init(k, [obs_dim, *hidden, action_dim])}
+
+
+def det_actor_apply(params, obs, low: float, high: float):
+    """tanh-bounded deterministic action in [low, high]."""
+    import jax.numpy as jnp
+    scale = (high - low) / 2.0
+    mid = (high + low) / 2.0
+    return mid + scale * jnp.tanh(mlp_apply(params["net"], obs))
+
+
 def twin_q_init(rng, obs_dim: int, action_dim: int,
                 hidden: Tuple[int, ...] = (64, 64)):
     """Two independent Q(s, a) critics (clipped double-Q)."""

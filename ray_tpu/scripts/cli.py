@@ -138,6 +138,39 @@ def cmd_timeline(args):
     ray_tpu.shutdown()
 
 
+def cmd_stack(args):
+    """`ray stack` equivalent: thread dumps / CPU samples / heap snapshots
+    from a live worker over its profiling RPCs (reference:
+    dashboard/modules/reporter/profile_manager.py)."""
+    ray_tpu = _connect(args)
+    from ray_tpu._private import worker_api
+    core = worker_api.get_core()
+
+    method = {"stack": "stack_dump", "cpu": "profile_cpu",
+              "memory": "profile_memory"}[args.kind]
+    payload = {"duration_s": args.duration} if args.kind == "cpu" else {}
+
+    async def probe():
+        return await core.clients.request(args.worker_address, method,
+                                          payload, timeout=60)
+
+    out = worker_api._call_on_core_loop(core, probe(), 90)
+    if args.kind == "stack":
+        for thread, stack in out.items():
+            print(f"--- {thread} ---\n{stack}")
+    else:
+        print(json.dumps(out, indent=2, default=str))
+    ray_tpu.shutdown()
+
+
+def cmd_kv_store(args):
+    """Standalone external GCS state store (the Redis-equivalent;
+    reference: redis_store_client.h). Point heads at it with
+    RAY_TPU_GCS_STORAGE_ADDRESS=host:port."""
+    from ray_tpu._private.kv_store import run_server
+    run_server(args.host, args.port, args.dir)
+
+
 # ---------------------------------------------------------------- jobs
 
 def cmd_job(args):
@@ -197,6 +230,22 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--address", default=None)
     s.add_argument("-o", "--output", default=None)
     s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("profile", help="profile a live worker "
+                                       "(stack/cpu/memory)")
+    s.add_argument("kind", choices=["stack", "cpu", "memory"])
+    s.add_argument("worker_address", help="worker RPC address host:port "
+                                          "(see `list workers`)")
+    s.add_argument("--address", default=None)
+    s.add_argument("--duration", type=float, default=2.0)
+    s.set_defaults(fn=cmd_stack)
+
+    s = sub.add_parser("kv-store", help="run the standalone external "
+                                        "GCS state store")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=0)
+    s.add_argument("--dir", default="/tmp/ray_tpu_kv_store")
+    s.set_defaults(fn=cmd_kv_store)
 
     s = sub.add_parser("job", help="job submission")
     jsub = s.add_subparsers(dest="job_cmd", required=True)

@@ -19,6 +19,7 @@ from ray_tpu.train.backend_executor import (BackendConfig, BackendExecutor,
                                             JaxBackendConfig,
                                             TrainingFailedError)
 from ray_tpu.train.worker_group import WorkerGroup
+from ray_tpu.train.sklearn import SklearnTrainer
 
 __all__ = [
     "Checkpoint", "save_pytree", "load_pytree", "new_checkpoint_dir",
@@ -27,5 +28,5 @@ __all__ = [
     "TrainContext", "TrainState", "init_train_state", "make_train_step",
     "make_eval_step", "JaxTrainer", "Result", "BackendConfig",
     "JaxBackendConfig", "BackendExecutor", "WorkerGroup",
-    "TrainingFailedError",
+    "TrainingFailedError", "SklearnTrainer",
 ]

@@ -1,0 +1,111 @@
+"""SklearnTrainer: classic-ML model fitting on the cluster.
+
+Reference parity: python/ray/train/sklearn/sklearn_trainer.py — fit an
+sklearn estimator as a remote task (CPU-heavy fitting moves off the
+driver), with ray_tpu.data Datasets as inputs, optional cross-validation,
+and the fitted model wrapped in a Checkpoint.
+
+Joblib-backed estimators parallelize across the cluster when combined
+with `ray_tpu.util.joblib.register_ray` (the joblib backend shim).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.trainer import Result
+
+MODEL_FILE = "model.pkl"
+
+
+def _dataset_to_xy(ds: Any, label_column: str):
+    """Materialize a Dataset (or (X, y) tuple / dict) into numpy arrays."""
+    if isinstance(ds, tuple) and len(ds) == 2:
+        return np.asarray(ds[0]), np.asarray(ds[1])
+    if hasattr(ds, "to_batch_columns"):
+        cols = ds.to_batch_columns()
+    elif hasattr(ds, "iter_batches"):
+        cols: Dict[str, list] = {}
+        for batch in ds.iter_batches(batch_size=4096, batch_format="numpy"):
+            for k, v in batch.items():
+                cols.setdefault(k, []).append(v)
+        cols = {k: np.concatenate(v) for k, v in cols.items()}
+    elif isinstance(ds, dict):
+        cols = {k: np.asarray(v) for k, v in ds.items()}
+    else:
+        raise TypeError(f"unsupported dataset type {type(ds).__name__}")
+    y = cols.pop(label_column)
+    feats = [cols[k] for k in sorted(cols)]
+    X = np.column_stack([f.reshape(len(f), -1) for f in feats])
+    return X, y
+
+
+@ray_tpu.remote
+def _fit_remote(estimator_bytes: bytes, X, y, X_val, y_val,
+                scoring_on_train: bool, fit_params: dict) -> dict:
+    """Fit in a worker process; returns pickled model + metrics."""
+    t0 = time.time()
+    est = pickle.loads(estimator_bytes)
+    est.fit(X, y, **fit_params)
+    out: Dict[str, Any] = {"fit_time": time.time() - t0}
+    if scoring_on_train:
+        out["train_score"] = float(est.score(X, y))
+    if X_val is not None:
+        out["valid_score"] = float(est.score(X_val, y_val))
+    out["model"] = pickle.dumps(est)
+    return out
+
+
+class SklearnTrainer:
+    def __init__(self, *, estimator: Any, datasets: Dict[str, Any],
+                 label_column: str,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 fit_params: Optional[dict] = None,
+                 scoring_on_train: bool = True):
+        if "train" not in datasets:
+            raise ValueError("datasets must contain a 'train' entry")
+        self.estimator = estimator
+        self.datasets = datasets
+        self.label_column = label_column
+        self.scaling = scaling_config or ScalingConfig(num_workers=1)
+        self.run_config = run_config or RunConfig()
+        self.fit_params = fit_params or {}
+        self.scoring_on_train = scoring_on_train
+
+    def fit(self) -> Result:
+        X, y = _dataset_to_xy(self.datasets["train"], self.label_column)
+        X_val = y_val = None
+        if "valid" in self.datasets:
+            X_val, y_val = _dataset_to_xy(self.datasets["valid"],
+                                          self.label_column)
+        out = ray_tpu.get(_fit_remote.options(
+            num_cpus=self.scaling.num_workers).remote(
+                pickle.dumps(self.estimator), X, y, X_val, y_val,
+                self.scoring_on_train, self.fit_params))
+        model_blob = out.pop("model")
+        ckpt_dir = os.path.join(
+            self.run_config.storage_path or tempfile.gettempdir(),
+            self.run_config.name or f"SklearnTrainer_{int(time.time())}")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        with open(os.path.join(ckpt_dir, MODEL_FILE), "wb") as f:
+            f.write(model_blob)
+        ckpt = Checkpoint(ckpt_dir)
+        return Result(metrics=out, checkpoint=ckpt, error=None,
+                      metrics_dataframe=[out])
+
+    @staticmethod
+    def get_model(checkpoint: Checkpoint):
+        """Load the fitted estimator back from a checkpoint."""
+        path = os.path.join(checkpoint.path, MODEL_FILE)
+        with open(path, "rb") as f:
+            return pickle.loads(f.read())

@@ -59,15 +59,27 @@ class ClientObjectRef:
 
 
 class ClientObjectRefGenerator:
-    """Client-side iterator over a streaming task's return refs: each
-    __next__ round-trips to the proxy, which forwards the server-side
-    ObjectRefStream (reference: ray_client.proto streaming generators)."""
+    """Client-side iterator over a streaming task's return refs.
+
+    Server-PUSH delivery (reference: ray_client.proto server-streamed
+    DataResponses): on construction the client subscribes once; the proxy
+    then pumps (ref, prefetched value) items over the connection under a
+    credit window. __next__ pops a local queue — zero per-item round
+    trips — and the prefetched value makes the following get() local too.
+    """
+
+    WINDOW = 16
 
     def __init__(self, task_id: bytes, ctx: "ClientContext"):
+        import queue as _queue
         self._task_id = task_id
         self._ctx = ctx
         self._cursor = 0
         self._exhausted = False
+        self._queue: "_queue.Queue" = _queue.Queue()
+        ctx._gen_queues[task_id] = self._queue
+        ctx._call("client_generator_subscribe",
+                  {"task_id": task_id, "window": self.WINDOW})
 
     def __iter__(self):
         return self
@@ -75,16 +87,32 @@ class ClientObjectRefGenerator:
     def __next__(self) -> "ClientObjectRef":
         if self._exhausted:
             raise StopIteration
-        out = self._ctx._maybe_raise(self._ctx._call(
-            "client_generator_next",
-            {"task_id": self._task_id, "cursor": self._cursor},
-            timeout=3600.0))
-        if out is None:
-            self._exhausted = True
+        item = self._queue.get(timeout=3600.0)
+        if item.get("closed"):
+            self._finish()
+            raise ConnectionError("client connection lost mid-stream")
+        if "stream_error" in item:
+            self._finish()
+            raise self._ctx.serialization.deserialize(item["stream_error"])
+        if item.get("end"):
+            self._finish()
             raise StopIteration
         self._cursor += 1
-        rid, owner = out
-        return ClientObjectRef(rid, owner, self._ctx)
+        # replenish the server's window as we consume
+        self._ctx._notify("client_generator_credit",
+                          {"task_id": self._task_id, "n": 1})
+        rid = item["ref"]
+        if item.get("error") is not None:
+            self._ctx._value_cache[rid] = ("err", item["error"])
+        elif item.get("data") is not None:
+            # values above the server's prefetch threshold ship ref-only;
+            # get() falls back to one round trip for those
+            self._ctx._value_cache[rid] = ("val", item["data"])
+        return ClientObjectRef(rid, item["owner"], self._ctx)
+
+    def _finish(self):
+        self._exhausted = True
+        self._ctx._gen_queues.pop(self._task_id, None)
 
     def __del__(self):
         # Abandoned mid-stream: tell the server to free the stream and the
@@ -93,6 +121,7 @@ class ClientObjectRefGenerator:
         if self._exhausted:
             return
         try:
+            self._ctx._gen_queues.pop(self._task_id, None)
             self._ctx._notify("client_generator_release",
                               {"task_id": self._task_id,
                                "consumed": self._cursor})
@@ -152,6 +181,8 @@ class ClientContext:
         self.serialization = SerializationContext()
         self.job_runtime_env = re_mod.validate(runtime_env)
         self._exported: set = set()     # function/class ids the server has
+        self._gen_queues: Dict[bytes, Any] = {}   # streaming push queues
+        self._value_cache: Dict[bytes, tuple] = {}  # prefetched gen values
         self._shipped_pkgs: set = set()  # uris CONFIRMED stored server-side
         self._pkg_uri_by_path: Dict[tuple, str] = {}  # (path, sig) -> uri
         self._pkg_data: Dict[str, bytes] = {}  # unconfirmed payloads
@@ -172,12 +203,27 @@ class ClientContext:
 
     # ------------------------------------------------------------------
 
+    def _on_push(self, method: str, payload: dict):
+        """Runs on the client loop thread: route server-pushed stream
+        items to their consumer queue."""
+        if method == "client_generator_item":
+            q = self._gen_queues.get(payload.get("task_id"))
+            if q is not None:
+                q.put(payload)
+
+    def _on_conn_close(self, _conn):
+        # Wake any generator consumer blocked on its queue.
+        for q in list(self._gen_queues.values()):
+            q.put({"closed": True})
+
     def _call(self, method: str, payload: dict, timeout: float = 60.0):
         from ray_tpu._private import rpc
 
         async def go():
             if self._conn is None or self._conn.closed:
-                self._conn = await rpc.connect(self.address)
+                self._conn = await rpc.connect(self.address,
+                                               push_handler=self._on_push)
+                self._conn.on_close = self._on_conn_close
             payload["session"] = self.session
             return await self._conn.request(method, payload, timeout)
 
@@ -264,6 +310,7 @@ class ClientContext:
             pass
 
     def _release(self, ref_id: bytes):
+        self._value_cache.pop(ref_id, None)
         self._notify("client_release", {"refs": [ref_id]})
 
     # -- public API ----------------------------------------------------
@@ -280,6 +327,17 @@ class ClientContext:
             if not isinstance(r, ClientObjectRef):
                 raise TypeError(f"client get() takes ClientObjectRefs, "
                                 f"got {type(r)}")
+        # Streaming-push prefetch: values that arrived with generator
+        # items resolve locally, no round trip.
+        if all(r._id in self._value_cache for r in ref_list):
+            values = []
+            for r in ref_list:
+                kind, data = self._value_cache[r._id]
+                obj = self.serialization.deserialize(data)
+                if kind == "err":
+                    raise obj
+                values.append(obj)
+            return values[0] if single else values
         result = self._maybe_raise(self._call(
             "client_get", {"refs": [r._id for r in ref_list],
                            "timeout": timeout},

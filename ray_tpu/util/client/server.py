@@ -32,6 +32,9 @@ class _ClientSession:
         self.actors: Dict[bytes, ActorID] = {}
         # Streaming generators the client iterates, keyed by task id.
         self.generators: Dict[bytes, Any] = {}
+        # Server-push pumps per subscribed generator: task + credit sem.
+        self.gen_pumps: Dict[bytes, asyncio.Task] = {}
+        self.gen_credits: Dict[bytes, asyncio.Semaphore] = {}
 
     def track(self, ref: ObjectRef):
         self.refs[ref.id.binary()] = ref
@@ -56,7 +59,8 @@ class ClientServer:
                      "create_actor", "submit_actor_task", "kill_actor",
                      "get_named_actor", "release", "cluster_resources",
                      "nodes", "cancel", "disconnect", "generator_next",
-                     "generator_release"):
+                     "generator_release", "generator_subscribe",
+                     "generator_credit"):
             self.server.register(f"client_{name}",
                                  getattr(self, f"rpc_{name}"))
         actual = await self.server.start(host, port)
@@ -66,6 +70,8 @@ class ClientServer:
 
     async def stop(self):
         for session in self.sessions.values():
+            for pump in list(session.gen_pumps.values()):
+                pump.cancel()
             await session.core.shutdown_async()
         self.sessions.clear()
         await self.server.stop()
@@ -110,6 +116,8 @@ class ClientServer:
     async def _reap(self, session_id: str):
         session = self.sessions.pop(session_id, None)
         if session is not None:
+            for pump in list(session.gen_pumps.values()):
+                pump.cancel()
             try:
                 await session.core.gcs.request(
                     "finish_job", {"job_id": session.core.job_id})
@@ -259,9 +267,89 @@ class ClientServer:
             return None
         return s.track(ref)
 
+    async def rpc_generator_subscribe(self, conn, payload):
+        """Switch a streaming generator to server-push delivery: the
+        server iterates the stream and pushes (ref, value) items over the
+        client connection under a credit window, so the client consumes
+        with ZERO per-item round trips (reference: ray_client.proto's
+        server-streamed DataResponse path)."""
+        s = self._session(payload)
+        tid = payload["task_id"]
+        gen = s.generators.get(tid)
+        if gen is None:
+            raise ValueError(f"unknown generator {tid.hex()[:12]}")
+        window = max(1, int(payload.get("window", 16)))
+        s.gen_credits[tid] = asyncio.Semaphore(window)
+        s.gen_pumps[tid] = asyncio.ensure_future(
+            self._pump_generator(conn, s, tid, gen))
+        return True
+
+    # Streamed values at/below this ship inline with the item push (the
+    # following client get() is then local); larger values stay server-side
+    # until the client actually asks (ref-forwarding streams never pay the
+    # transfer).
+    PREFETCH_MAX_BYTES = 256 * 1024
+
+    async def _pump_generator(self, conn, s: _ClientSession, tid: bytes,
+                              gen):
+        cursor = 0
+        try:
+            while True:
+                await s.gen_credits[tid].acquire()
+                try:
+                    ref = await s.core.generator_next(gen._task_id, cursor)
+                except Exception as e:  # noqa: BLE001 — ship to client
+                    # The stream died mid-iteration: free it and the
+                    # unconsumed returns NOW (the client marks itself
+                    # exhausted on stream_error and will not send a
+                    # release).
+                    s.core.release_generator(gen._task_id, cursor)
+                    await conn.push("client_generator_item", {
+                        "task_id": tid, "stream_error":
+                        s.core.serialization.serialize(e).to_bytes()})
+                    return
+                if ref is None:
+                    await conn.push("client_generator_item",
+                                    {"task_id": tid, "end": True})
+                    return
+                data = err = None
+                try:
+                    [val] = await s.core.get_async([ref])
+                    blob = s.core.serialization.serialize(val).to_bytes()
+                    if len(blob) <= self.PREFETCH_MAX_BYTES:
+                        data = blob
+                except Exception as e:  # noqa: BLE001 — value IS an error
+                    err = s.core.serialization.serialize(e).to_bytes()
+                rid, owner = s.track(ref)
+                await conn.push("client_generator_item", {
+                    "task_id": tid, "cursor": cursor, "ref": rid,
+                    "owner": owner, "data": data, "error": err})
+                cursor += 1
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("generator pump failed")
+        finally:
+            s.generators.pop(tid, None)
+            s.gen_pumps.pop(tid, None)
+            s.gen_credits.pop(tid, None)
+
+    async def rpc_generator_credit(self, conn, payload):
+        """Client consumed items: replenish the pump's window."""
+        s = self._session(payload)
+        sem = s.gen_credits.get(payload["task_id"])
+        if sem is not None:
+            for _ in range(int(payload.get("n", 1))):
+                sem.release()
+        return True
+
     async def rpc_generator_release(self, conn, payload):
         """Client abandoned a stream: free it + unconsumed return objects."""
         s = self._session(payload)
+        pump = s.gen_pumps.pop(payload["task_id"], None)
+        if pump is not None:
+            pump.cancel()
+        s.gen_credits.pop(payload["task_id"], None)
         gen = s.generators.pop(payload["task_id"], None)
         if gen is not None:
             s.core.release_generator(gen._task_id,

@@ -342,3 +342,83 @@ def test_spill_to_external_storage(tmp_path, monkeypatch):
                            prefault=False)
     assert type(host.spill_storage).__name__ == "FileStorage"
     assert host.spill_storage.directory == str(spill_uri_dir)
+
+
+# ------------------------------------------------------- dask-on-ray_tpu
+
+def test_dask_graph_scheduler(ray_breadth):
+    """Execute a dask-spec task graph (plain dicts — no dask needed) on
+    the cluster: shared intermediates computed once, branches parallel
+    (reference: ray/util/dask/scheduler.py ray_dask_get)."""
+    from operator import add, mul
+
+    from ray_tpu.util.dask import ray_dask_get
+
+    dsk = {
+        "a": 1,
+        "b": (add, "a", 2),            # 3
+        "c": (mul, "b", "b"),          # 9
+        "d": (add, "c", (mul, "a", 5)),  # 9 + 5 = 14 (nested task)
+        "e": [(add, "b", 1), (add, "c", 1)],  # [4, 10] list of tasks
+    }
+    assert ray_dask_get(dsk, "d") == 14
+    assert ray_dask_get(dsk, ["b", "c"]) == [3, 9]
+    assert ray_dask_get(dsk, [["b"], ["d", "c"]]) == [[3], [14, 9]]
+    assert ray_dask_get(dsk, "e") == [4, 10]
+
+
+def test_dask_graph_cycle_detected(ray_breadth):
+    from operator import add
+
+    from ray_tpu.util.dask import ray_dask_get
+
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get({"x": (add, "y", 1), "y": (add, "x", 1)}, "x")
+
+
+def test_dask_tuple_keys(ray_breadth):
+    """Dask collections use tuple keys like ('x', 0)."""
+    import numpy as _np
+    from ray_tpu.util.dask import ray_dask_get
+
+    dsk = {
+        ("x", 0): (_np.arange, 4),
+        ("x", 1): (_np.arange, 4, 8),
+        "total": (_np.sum, [("x", 0), ("x", 1)]),
+    }
+    assert int(ray_dask_get(dsk, "total")) == 28
+
+
+# ------------------------------------------------------- sklearn trainer
+
+def test_sklearn_trainer_fits_and_checkpoints(ray_breadth, tmp_path):
+    """SklearnTrainer fits off-driver, scores train/valid, and the model
+    round-trips through a Checkpoint (reference:
+    ray/train/sklearn/sklearn_trainer.py)."""
+    from sklearn.linear_model import LogisticRegression
+
+    from ray_tpu import data as rd
+    from ray_tpu.train import RunConfig
+    from ray_tpu.train.sklearn import SklearnTrainer
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 3)
+    y = (X @ [1.0, -2.0, 0.5] > 0).astype(int)
+    train_ds = rd.from_items(
+        [{"f0": X[i, 0], "f1": X[i, 1], "f2": X[i, 2], "y": int(y[i])}
+         for i in range(150)])
+    valid_ds = rd.from_items(
+        [{"f0": X[i, 0], "f1": X[i, 1], "f2": X[i, 2], "y": int(y[i])}
+         for i in range(150, 200)])
+
+    trainer = SklearnTrainer(
+        estimator=LogisticRegression(),
+        datasets={"train": train_ds, "valid": valid_ds},
+        label_column="y",
+        run_config=RunConfig(name="sk", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["train_score"] > 0.9
+    assert result.metrics["valid_score"] > 0.85
+    model = SklearnTrainer.get_model(result.checkpoint)
+    assert model.predict(X[:5]).shape == (5,)

@@ -130,8 +130,24 @@ CLIENT_SCRIPT = textwrap.dedent("""
         for i in range(n):
             yield i * i
 
-    vals = [ray_tpu.get(r, timeout=30) for r in gen.remote(4)]
+    # Server-push delivery: items arrive over the connection without
+    # per-item round trips, values prefetched -> get() resolves from the
+    # local cache (client_get never called for streamed refs).
+    from ray_tpu._private import worker_api as _wapi
+    _ctx = _wapi._state.client
+    _orig_call = _ctx._call
+    _get_calls = []
+    def _counting_call(method, payload, timeout=60.0):
+        if method == "client_get":
+            _get_calls.append(method)
+        return _orig_call(method, payload, timeout)
+    _ctx._call = _counting_call
+    try:
+        vals = [ray_tpu.get(r, timeout=30) for r in gen.remote(4)]
+    finally:
+        _ctx._call = _orig_call
     assert vals == [0, 1, 4, 9], vals
+    assert not _get_calls, f"streamed gets round-tripped: {{_get_calls}}"
 
     # ---- runtime_env: env_vars + working_dir shipped from the client ----
     import tempfile, pathlib

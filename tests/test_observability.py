@@ -74,6 +74,58 @@ def test_status_endpoint(ray_start):
     assert st["jobs_alive"] >= 1
 
 
+def test_dashboard_rest_tables(ray_start):
+    """The dashboard REST endpoints expose actors/jobs/pgs/task-summary
+    tables from the GCS (reference: dashboard REST over GCS tables)."""
+    import json
+    import time
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Probe:
+        def ping(self):
+            return 1
+
+    a = Probe.options(name="dash-probe").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+    addr = _get_metrics_address(ray_tpu)
+
+    def fetch(path):
+        with urllib.request.urlopen(f"http://{addr}{path}", timeout=5) as r:
+            return json.loads(r.read())
+
+    actors = fetch("/api/actors")
+    mine = [x for x in actors if x["name"] == "dash-probe"]
+    assert mine and mine[0]["state"] == "ALIVE"
+    assert mine[0]["class_name"] == "Probe"
+
+    jobs = fetch("/api/jobs")
+    assert any(j["alive"] for j in jobs)
+
+    # task events flush on a 1s cadence — poll up to 6s
+    deadline = time.time() + 6
+    tasks = []
+    while time.time() < deadline:
+        tasks = fetch("/api/tasks")
+        if any(t["state"] == "FINISHED" and t["count"] >= 1
+               for t in tasks):
+            break
+        time.sleep(0.3)
+    assert any(t["state"] == "FINISHED" and t["count"] >= 1
+               for t in tasks), tasks
+
+    assert fetch("/api/pgs") == []
+
+    # dashboard page renders the new tables
+    with urllib.request.urlopen(f"http://{addr}/dashboard",
+                                timeout=5) as r:
+        page = r.read().decode()
+    for table in ("actors", "jobs", "pgs", "tasks"):
+        assert f'id="{table}"' in page
+    ray_tpu.kill(a)
+
+
 def test_metrics_api_validation():
     from ray_tpu.util.metrics import Counter, Gauge, Histogram, clear
 

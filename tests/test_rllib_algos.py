@@ -1,0 +1,197 @@
+"""RLlib algorithm-family breadth: TD3/DDPG (deterministic-policy
+continuous control), CQL (offline conservative Q), MARWIL
+(advantage-weighted imitation). Reference: rllib/algorithms/{td3,ddpg,
+cql,marwil}. Budgets kept tight for CI.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ray_rl():
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_td3_learns_pendulum(ray_rl, jax_cpu):
+    from ray_tpu.rllib import TD3Config
+
+    algo = (TD3Config()
+            .environment("Pendulum-v1")
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=1,
+                         rollout_fragment_length=256)
+            .training(train_batch_size=256, random_warmup_steps=500,
+                      grad_steps_per_iter=192)
+            .debugging(seed=0)
+            .build())
+    early, late = [], []
+    for i in range(24):
+        algo.train()
+        rewards = algo._episode_rewards
+        if i < 8:
+            early = list(rewards)
+        late = rewards[-8:]
+    algo.stop()
+    assert early and late
+    # Random Pendulum ~-1100..-1600; TD3 pulls recent returns way up.
+    assert np.mean(late) > -800, (np.mean(early), np.mean(late))
+    assert np.mean(late) > np.mean(early) + 200, (np.mean(early),
+                                                  np.mean(late))
+
+
+def test_ddpg_smoke(ray_rl, jax_cpu):
+    """DDPG (= TD3 config with delay 1 / no smoothing) trains without
+    divergence and syncs weights to runners."""
+    from ray_tpu.rllib import DDPGConfig
+
+    algo = (DDPGConfig()
+            .environment("Pendulum-v1")
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=1,
+                         rollout_fragment_length=128)
+            .training(train_batch_size=128, random_warmup_steps=128,
+                      grad_steps_per_iter=16)
+            .debugging(seed=0)
+            .build())
+    assert algo.algo_config.policy_delay == 1
+    assert algo.algo_config.target_noise == 0.0
+    for _ in range(4):
+        m = algo.train()
+    algo.stop()
+    assert np.isfinite(m["critic_loss"]) and np.isfinite(m["mean_q"])
+    ckpt = algo.save_checkpoint()
+    assert "actor" in ckpt["state"] and "target_actor" in ckpt["state"]
+
+
+def _collect_pendulum_data(path, episodes=6, seed=0):
+    from ray_tpu.rllib import JsonWriter, SampleBatch
+    from ray_tpu.rllib import sample_batch as sb
+    from ray_tpu.rllib.env import make_env
+    env = make_env("Pendulum-v1", {})
+    rng = np.random.RandomState(seed)
+    writer = JsonWriter(str(path))
+    for ep in range(episodes):
+        obs, _ = env.reset(seed=seed + ep)
+        rows = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS,
+                                sb.NEXT_OBS, sb.TERMINATEDS)}
+        done = False
+        while not done:
+            a = rng.uniform(env.action_low, env.action_high,
+                            size=(env.action_dim,))
+            obs2, r, term, trunc, _ = env.step(a)
+            rows[sb.OBS].append(obs)
+            rows[sb.ACTIONS].append(a)
+            rows[sb.REWARDS].append(r)
+            rows[sb.NEXT_OBS].append(obs2)
+            rows[sb.TERMINATEDS].append(float(term))
+            obs = obs2
+            done = term or trunc
+        writer.write(SampleBatch({k: np.asarray(v)
+                                  for k, v in rows.items()}))
+    writer.close()
+
+
+def test_cql_learner_conservatism(jax_cpu):
+    """The conservative penalty vs its cql_alpha=0 ablation on the SAME
+    data and seed: with the penalty ON, the OOD-vs-data Q gap
+    (logsumexp(Q_sampled) - Q(data)) is driven down and the learned Q is
+    held lower; with it OFF the gap drifts up (offline overestimation —
+    the failure mode CQL exists to prevent)."""
+    from ray_tpu.rllib import sample_batch as sb
+    from ray_tpu.rllib.algorithms.cql import CQLLearner
+    from ray_tpu.rllib.sample_batch import SampleBatch
+
+    rng = np.random.RandomState(0)
+    n = 256
+    batch = SampleBatch({
+        sb.OBS: rng.randn(n, 3).astype(np.float32),
+        sb.ACTIONS: rng.uniform(-2, 2, (n, 1)).astype(np.float32),
+        sb.REWARDS: rng.randn(n).astype(np.float32),
+        sb.NEXT_OBS: rng.randn(n, 3).astype(np.float32),
+        sb.TERMINATEDS: np.zeros(n, np.float32),
+    })
+
+    def run(alpha):
+        learner = CQLLearner(3, 1, -2.0, 2.0, cql_alpha=alpha,
+                             critic_lr=3e-3, seed=0)
+        gaps, q = [], 0.0
+        for _ in range(200):
+            m = learner.update(batch)
+            gaps.append(m["cql_gap"])
+            q = m["mean_q"]
+        return np.mean(gaps[:10]) - np.mean(gaps[-10:]), q
+
+    drop_on, q_on = run(50.0)
+    drop_off, q_off = run(0.0)
+    assert drop_on > 0.3, drop_on          # measured ~0.65
+    assert drop_off < 0.1, drop_off        # measured ~-0.09 (gap grows)
+    assert q_on < q_off, (q_on, q_off)     # penalty holds Q down
+
+
+def test_cql_trains_from_offline_data(ray_rl, jax_cpu, tmp_path):
+    """End-to-end: CQL builds from JsonReader data, trains with finite
+    metrics, and checkpoints round-trip."""
+    from ray_tpu.rllib import CQLConfig
+
+    _collect_pendulum_data(tmp_path / "data", episodes=3)
+    algo = (CQLConfig()
+            .environment("Pendulum-v1")
+            .offline_data(input_path=str(tmp_path / "data"))
+            .training(train_batch_size=128, cql_alpha=5.0,
+                      num_ood_actions=4)
+            .debugging(seed=0)
+            .build())
+    for _ in range(10):
+        m = algo.step()
+    assert np.isfinite(m["critic_loss"]) and np.isfinite(m["cql_gap"])
+    ckpt = algo.save_checkpoint()
+    algo.load_checkpoint(ckpt)
+    assert algo._iteration == ckpt["iteration"]
+
+
+def test_marwil_beats_bc_weighting(ray_rl, jax_cpu, tmp_path):
+    """MARWIL imitates mixed-quality CartPole data; advantage weighting
+    (beta>0) recovers a policy at least as good as the data mean."""
+    from ray_tpu.rllib import JsonWriter, MARWILConfig, SampleBatch
+    from ray_tpu.rllib import sample_batch as sb
+    from ray_tpu.rllib.env import make_env
+
+    # Mixed data: half decent heuristic, half random.
+    env = make_env("CartPole-v1", {})
+    rng = np.random.RandomState(0)
+    writer = JsonWriter(str(tmp_path / "data"))
+    for ep in range(14):
+        obs, _ = env.reset(seed=ep)
+        rows = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS,
+                                sb.TERMINATEDS)}
+        done = False
+        use_expert = ep % 2 == 0
+        while not done:
+            if use_expert:
+                a = 1 if obs[2] + 0.5 * obs[3] > 0 else 0
+            else:
+                a = int(rng.randint(2))
+            obs2, r, term, trunc, _ = env.step(a)
+            rows[sb.OBS].append(obs)
+            rows[sb.ACTIONS].append(a)
+            rows[sb.REWARDS].append(r)
+            rows[sb.TERMINATEDS].append(float(term))
+            obs = obs2
+            done = term or trunc
+        writer.write(SampleBatch({k: np.asarray(v)
+                                  for k, v in rows.items()}))
+    writer.close()
+
+    algo = (MARWILConfig()
+            .environment("CartPole-v1")
+            .offline_data(input_path=str(tmp_path / "data"))
+            .training(lr=1e-2, beta=1.0)
+            .debugging(seed=0)
+            .build())
+    losses = [algo.step()["loss"] for _ in range(200)]
+    assert np.isfinite(losses[-1])
+    ev = algo.evaluate(num_episodes=3)
+    # advantage-weighted cloning filters out the random half
+    assert ev["evaluation_reward_mean"] > 60, ev
