@@ -1,0 +1,355 @@
+// Minimal pickle codec for the ray_tpu C++ client.
+//
+// The ray_tpu wire protocol frames pickled plain data (rpc.py:102
+// pickle.dumps([kind, msg_id, method, payload], protocol=5)). A non-Python
+// client therefore needs to read and write the *plain-data subset* of
+// pickle: None, bool, int, float, bytes, str, list, tuple, dict.
+//
+// ENCODER emits protocol-3 opcodes (every CPython accepts them).
+// DECODER handles what CPython's protocol-5 pickler emits for plain data
+// (FRAME/MEMOIZE/SHORT_BINUNICODE/...). Anything beyond the plain-data
+// subset (classes, reducers) raises — by design: cross-language payloads
+// are data, not code (reference: the language-independent msgpack layer
+// in src/ray/common/serialization.h plays this role for the reference's
+// C++ worker).
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace raytpu {
+
+// ---------------------------------------------------------------- value
+
+struct PyValue;
+using PyValuePtr = std::shared_ptr<PyValue>;
+
+struct PyValue {
+  enum class Kind { None, Bool, Int, Float, Bytes, Str, List, Tuple, Dict };
+  Kind kind = Kind::None;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;  // Bytes and Str payloads
+  std::vector<PyValuePtr> items;                      // List / Tuple
+  std::vector<std::pair<PyValuePtr, PyValuePtr>> kv;  // Dict
+
+  static PyValuePtr none() { return std::make_shared<PyValue>(); }
+  static PyValuePtr boolean(bool v) {
+    auto p = std::make_shared<PyValue>();
+    p->kind = Kind::Bool; p->b = v; return p;
+  }
+  static PyValuePtr integer(int64_t v) {
+    auto p = std::make_shared<PyValue>();
+    p->kind = Kind::Int; p->i = v; return p;
+  }
+  static PyValuePtr real(double v) {
+    auto p = std::make_shared<PyValue>();
+    p->kind = Kind::Float; p->f = v; return p;
+  }
+  static PyValuePtr bytes(std::string v) {
+    auto p = std::make_shared<PyValue>();
+    p->kind = Kind::Bytes; p->s = std::move(v); return p;
+  }
+  static PyValuePtr str(std::string v) {
+    auto p = std::make_shared<PyValue>();
+    p->kind = Kind::Str; p->s = std::move(v); return p;
+  }
+  static PyValuePtr list(std::vector<PyValuePtr> v = {}) {
+    auto p = std::make_shared<PyValue>();
+    p->kind = Kind::List; p->items = std::move(v); return p;
+  }
+  static PyValuePtr tuple(std::vector<PyValuePtr> v = {}) {
+    auto p = std::make_shared<PyValue>();
+    p->kind = Kind::Tuple; p->items = std::move(v); return p;
+  }
+  static PyValuePtr dict() {
+    auto p = std::make_shared<PyValue>();
+    p->kind = Kind::Dict; return p;
+  }
+
+  void set(const std::string& key, PyValuePtr v) {
+    kv.emplace_back(str(key), std::move(v));
+  }
+  // Dict lookup by string key; nullptr when missing.
+  PyValuePtr get(const std::string& key) const {
+    for (const auto& [k, v] : kv)
+      if (k->kind == Kind::Str && k->s == key) return v;
+    return nullptr;
+  }
+};
+
+// ---------------------------------------------------------------- encode
+
+class PickleEncoder {
+ public:
+  static std::string dumps(const PyValuePtr& v) {
+    PickleEncoder e;
+    e.out_.push_back('\x80');  // PROTO
+    e.out_.push_back('\x03');
+    e.emit(v);
+    e.out_.push_back('.');     // STOP
+    return e.out_;
+  }
+
+ private:
+  std::string out_;
+
+  void raw(const void* p, size_t n) {
+    out_.append(reinterpret_cast<const char*>(p), n);
+  }
+  void u32le(uint32_t v) { raw(&v, 4); }  // little-endian hosts only
+
+  void emit(const PyValuePtr& v) {
+    using K = PyValue::Kind;
+    switch (v->kind) {
+      case K::None: out_.push_back('N'); break;
+      case K::Bool: out_.push_back(v->b ? '\x88' : '\x89'); break;
+      case K::Int: {
+        // LONG1: length byte + minimal little-endian two's complement.
+        uint8_t buf[9];
+        int n = 0;
+        int64_t x = v->i;
+        while (true) {
+          buf[n++] = static_cast<uint8_t>(x & 0xff);
+          int64_t rest = x >> 8;
+          bool done = (rest == 0 && !(buf[n - 1] & 0x80)) ||
+                      (rest == -1 && (buf[n - 1] & 0x80));
+          if (done || n == 8) { if (!done) buf[n++] = x < 0 ? 0xff : 0x00; break; }
+          x = rest;
+        }
+        out_.push_back('\x8a');
+        out_.push_back(static_cast<char>(n));
+        raw(buf, n);
+        break;
+      }
+      case K::Float: {
+        // BINFLOAT: big-endian IEEE754.
+        uint64_t bits;
+        std::memcpy(&bits, &v->f, 8);
+        uint8_t be[8];
+        for (int k = 0; k < 8; k++) be[k] = (bits >> (8 * (7 - k))) & 0xff;
+        out_.push_back('G');
+        raw(be, 8);
+        break;
+      }
+      case K::Bytes:
+        if (v->s.size() < 256) {
+          out_.push_back('C');  // SHORT_BINBYTES
+          out_.push_back(static_cast<char>(v->s.size()));
+        } else {
+          out_.push_back('B');  // BINBYTES
+          u32le(static_cast<uint32_t>(v->s.size()));
+        }
+        out_.append(v->s);
+        break;
+      case K::Str:
+        out_.push_back('X');  // BINUNICODE (utf-8 expected)
+        u32le(static_cast<uint32_t>(v->s.size()));
+        out_.append(v->s);
+        break;
+      case K::List:
+        out_.push_back(']');  // EMPTY_LIST
+        if (!v->items.empty()) {
+          out_.push_back('(');  // MARK
+          for (const auto& it : v->items) emit(it);
+          out_.push_back('e');  // APPENDS
+        }
+        break;
+      case K::Tuple:
+        if (v->items.empty()) { out_.push_back(')'); break; }
+        if (v->items.size() <= 3) {
+          for (const auto& it : v->items) emit(it);
+          out_.push_back(static_cast<char>('\x85' + v->items.size() - 1));
+        } else {
+          out_.push_back('(');
+          for (const auto& it : v->items) emit(it);
+          out_.push_back('t');  // TUPLE
+        }
+        break;
+      case K::Dict:
+        out_.push_back('}');  // EMPTY_DICT
+        if (!v->kv.empty()) {
+          out_.push_back('(');
+          for (const auto& [k, val] : v->kv) { emit(k); emit(val); }
+          out_.push_back('u');  // SETITEMS
+        }
+        break;
+    }
+  }
+};
+
+// ---------------------------------------------------------------- decode
+
+class PickleDecoder {
+ public:
+  static PyValuePtr loads(const std::string& data) {
+    PickleDecoder d(data);
+    return d.run();
+  }
+
+ private:
+  const std::string& data_;
+  size_t pos_ = 0;
+  std::vector<PyValuePtr> stack_;
+  std::vector<size_t> marks_;
+  std::vector<PyValuePtr> memo_;
+
+  explicit PickleDecoder(const std::string& d) : data_(d) {}
+
+  [[noreturn]] void fail(const std::string& what) {
+    throw std::runtime_error("pickle decode: " + what + " at offset " +
+                             std::to_string(pos_));
+  }
+  uint8_t u8() {
+    if (pos_ >= data_.size()) fail("truncated");
+    return static_cast<uint8_t>(data_[pos_++]);
+  }
+  std::string take(size_t n) {
+    if (pos_ + n > data_.size()) fail("truncated");
+    std::string s = data_.substr(pos_, n);
+    pos_ += n;
+    return s;
+  }
+  uint32_t u16le() { uint32_t v = u8(); v |= u8() << 8; return v; }
+  uint32_t u32() {
+    uint32_t v = 0;
+    for (int k = 0; k < 4; k++) v |= static_cast<uint32_t>(u8()) << (8 * k);
+    return v;
+  }
+  uint64_t u64() {
+    uint64_t v = 0;
+    for (int k = 0; k < 8; k++) v |= static_cast<uint64_t>(u8()) << (8 * k);
+    return v;
+  }
+  PyValuePtr pop() {
+    if (stack_.empty()) fail("stack underflow");
+    auto v = stack_.back();
+    stack_.pop_back();
+    return v;
+  }
+  PyValuePtr& top() {
+    if (stack_.empty()) fail("stack underflow");
+    return stack_.back();
+  }
+  std::vector<PyValuePtr> pop_to_mark() {
+    if (marks_.empty()) fail("no mark");
+    size_t m = marks_.back();
+    marks_.pop_back();
+    std::vector<PyValuePtr> out(stack_.begin() + m, stack_.end());
+    stack_.resize(m);
+    return out;
+  }
+
+  PyValuePtr run() {
+    while (true) {
+      uint8_t op = u8();
+      switch (op) {
+        case 0x80: u8(); break;                      // PROTO n
+        case 0x95: u64(); break;                     // FRAME len (ignored)
+        case '.': {                                  // STOP
+          if (stack_.size() != 1) fail("bad final stack");
+          return stack_.back();
+        }
+        case 'N': stack_.push_back(PyValue::none()); break;
+        case 0x88: stack_.push_back(PyValue::boolean(true)); break;
+        case 0x89: stack_.push_back(PyValue::boolean(false)); break;
+        case 'J': {                                  // BININT i32
+          int32_t v = static_cast<int32_t>(u32());
+          stack_.push_back(PyValue::integer(v));
+          break;
+        }
+        case 'K': stack_.push_back(PyValue::integer(u8())); break;
+        case 'M': stack_.push_back(PyValue::integer(u16le())); break;
+        case 0x8a: {                                 // LONG1
+          int n = u8();
+          if (n > 8) fail("LONG1 too wide for int64");
+          uint64_t v = 0;
+          uint8_t last = 0;
+          for (int k = 0; k < n; k++) { last = u8(); v |= static_cast<uint64_t>(last) << (8 * k); }
+          if (n > 0 && (last & 0x80))               // sign-extend
+            for (int k = n; k < 8; k++) v |= 0xffULL << (8 * k);
+          stack_.push_back(PyValue::integer(static_cast<int64_t>(v)));
+          break;
+        }
+        case 'G': {                                  // BINFLOAT (BE)
+          uint64_t bits = 0;
+          for (int k = 0; k < 8; k++) bits = (bits << 8) | u8();
+          double d;
+          std::memcpy(&d, &bits, 8);
+          stack_.push_back(PyValue::real(d));
+          break;
+        }
+        case 'C': { size_t n = u8(); stack_.push_back(PyValue::bytes(take(n))); break; }
+        case 'B': { size_t n = u32(); stack_.push_back(PyValue::bytes(take(n))); break; }
+        case 0x8e: { size_t n = u64(); stack_.push_back(PyValue::bytes(take(n))); break; }
+        case 0x8c: { size_t n = u8(); stack_.push_back(PyValue::str(take(n))); break; }
+        case 'X': { size_t n = u32(); stack_.push_back(PyValue::str(take(n))); break; }
+        case 0x8d: { size_t n = u64(); stack_.push_back(PyValue::str(take(n))); break; }
+        case ']': stack_.push_back(PyValue::list()); break;
+        case ')': stack_.push_back(PyValue::tuple()); break;
+        case '}': stack_.push_back(PyValue::dict()); break;
+        case '(': marks_.push_back(stack_.size()); break;
+        case 'a': {                                  // APPEND
+          auto v = pop(); auto& lst = top();
+          if (lst->kind != PyValue::Kind::List) fail("APPEND to non-list");
+          lst->items.push_back(v);
+          break;
+        }
+        case 'e': {                                  // APPENDS
+          auto vals = pop_to_mark(); auto& lst = top();
+          if (lst->kind != PyValue::Kind::List) fail("APPENDS to non-list");
+          for (auto& v : vals) lst->items.push_back(v);
+          break;
+        }
+        case 's': {                                  // SETITEM
+          auto v = pop(); auto k = pop(); auto& d = top();
+          if (d->kind != PyValue::Kind::Dict) fail("SETITEM to non-dict");
+          d->kv.emplace_back(k, v);
+          break;
+        }
+        case 'u': {                                  // SETITEMS
+          auto vals = pop_to_mark(); auto& d = top();
+          if (d->kind != PyValue::Kind::Dict) fail("SETITEMS to non-dict");
+          for (size_t k = 0; k + 1 < vals.size(); k += 2)
+            d->kv.emplace_back(vals[k], vals[k + 1]);
+          break;
+        }
+        case 0x85: case 0x86: case 0x87: {           // TUPLE1/2/3
+          int n = op - 0x85 + 1;
+          std::vector<PyValuePtr> v(n);
+          for (int k = n - 1; k >= 0; k--) v[k] = pop();
+          stack_.push_back(PyValue::tuple(std::move(v)));
+          break;
+        }
+        case 't': stack_.push_back(PyValue::tuple(pop_to_mark())); break;
+        case 0x94: memo_.push_back(top()); break;           // MEMOIZE
+        case 'q': { u8(); memo_.push_back(top()); break; }          // BINPUT
+        case 'r': { u32(); memo_.push_back(top()); break; }         // LONG_BINPUT
+        case 'h': {                                  // BINGET
+          size_t k = u8();
+          if (k >= memo_.size()) fail("BINGET out of range");
+          stack_.push_back(memo_[k]);
+          break;
+        }
+        case 'j': {                                  // LONG_BINGET
+          size_t k = u32();
+          if (k >= memo_.size()) fail("LONG_BINGET out of range");
+          stack_.push_back(memo_[k]);
+          break;
+        }
+        default:
+          fail("unsupported opcode 0x" + std::to_string(op) +
+               " (plain-data subset only)");
+      }
+    }
+  }
+};
+
+}  // namespace raytpu

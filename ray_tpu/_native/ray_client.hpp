@@ -1,0 +1,277 @@
+// ray_tpu C++ client: a native driver for a ray_tpu cluster.
+//
+// Reference parity: cpp/ (the reference's C++ worker API — ray::Init,
+// ray::Put/Get/Wait, ray::Task(...).Remote()). Here the C++ process is a
+// remote DRIVER speaking the client-server protocol
+// (ray_tpu/util/client/server.py) over one TCP connection, the same
+// surface the ray_tpu:// Python client uses:
+//   * framing: 4-byte LE length + pickle([kind, msg_id, method, payload])
+//     (ray_tpu/_private/rpc.py:93-104)
+//   * values: "RTPU"-magic buffer wrap around a pickled plain-data body
+//     (ray_tpu/_private/serialization.py:126-160)
+//   * tasks: cross-language submission by "module:function" name
+//     (rpc_submit_named — the reference's cross_language descriptor path).
+//
+// Synchronous, single-connection, plain-data args/results. Compile with:
+//   g++ -std=c++17 -O2 demo_client.cpp -o demo
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <random>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "pickle_codec.hpp"
+
+namespace raytpu {
+
+// rpc.py:24 — REQUEST, RESPONSE, ERROR, NOTIFY, PUSH
+enum MsgKind { kRequest = 0, kResponse = 1, kError = 2, kNotify = 3,
+               kPush = 4 };
+
+struct ObjectRef {
+  std::string id;     // binary object id
+  std::string owner;  // owner address
+};
+
+class RayTpuClient {
+ public:
+  RayTpuClient(const std::string& host, int port) {
+    dial(host, port);
+    session_ = random_hex(32);
+    auto reply = request("client_connect", PyValue::dict());
+    auto job = reply->get("job_id");
+    if (!job) throw std::runtime_error("connect: no job id");
+    job_id_ = job->s;
+  }
+
+  ~RayTpuClient() {
+    try {
+      request("client_disconnect", PyValue::dict());
+    } catch (...) {}
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  const std::string& job_id() const { return job_id_; }
+
+  // ---- object store ------------------------------------------------
+
+  ObjectRef Put(const PyValuePtr& value) {
+    auto payload = PyValue::dict();
+    payload->set("data", PyValue::bytes(wrap_value(value)));
+    auto reply = request("client_put", payload);
+    return ref_of(reply);
+  }
+
+  PyValuePtr Get(const ObjectRef& ref, double timeout_s = 60.0) {
+    auto payload = PyValue::dict();
+    auto refs = PyValue::list();
+    refs->items.push_back(PyValue::bytes(ref.id));
+    payload->set("refs", refs);
+    payload->set("timeout", PyValue::real(timeout_s));
+    auto reply = request("client_get", payload);
+    if (reply->kind == PyValue::Kind::Dict && reply->get("__client_error__"))
+      throw std::runtime_error("remote task failed (see server logs)");
+    if (reply->kind != PyValue::Kind::List || reply->items.empty())
+      throw std::runtime_error("get: bad reply");
+    return unwrap_value(reply->items[0]->s);
+  }
+
+  // ready-count after waiting up to timeout (client_wait).
+  size_t Wait(const std::vector<ObjectRef>& refs, size_t num_returns,
+              double timeout_s) {
+    auto payload = PyValue::dict();
+    auto lst = PyValue::list();
+    for (const auto& r : refs) lst->items.push_back(PyValue::bytes(r.id));
+    payload->set("refs", lst);
+    payload->set("num_returns",
+                 PyValue::integer(static_cast<int64_t>(num_returns)));
+    payload->set("timeout", PyValue::real(timeout_s));
+    auto reply = request("client_wait", payload);
+    if (reply->kind == PyValue::Kind::Dict && reply->get("__client_error__"))
+      throw std::runtime_error("wait failed server-side (see server logs)");
+    if (reply->kind != PyValue::Kind::Tuple || reply->items.size() != 2)
+      throw std::runtime_error("wait: bad reply");
+    return reply->items[0]->items.size();
+  }
+
+  // ---- tasks -------------------------------------------------------
+
+  // Submit an importable Python function by "module:function" name.
+  // Args are plain data or ObjectRefs.
+  ObjectRef Submit(const std::string& qualname,
+                   const std::vector<PyValuePtr>& args,
+                   const std::vector<ObjectRef>& ref_args = {}) {
+    auto payload = PyValue::dict();
+    payload->set("func", PyValue::str(qualname));
+    auto tagged = PyValue::list();
+    for (const auto& a : args) {
+      auto pair = PyValue::tuple({PyValue::str("val"),
+                                  PyValue::bytes(wrap_value(a))});
+      tagged->items.push_back(pair);
+    }
+    for (const auto& r : ref_args) {
+      auto pair = PyValue::tuple({PyValue::str("ref"),
+                                  PyValue::bytes(r.id)});
+      tagged->items.push_back(pair);
+    }
+    payload->set("args", tagged);
+    payload->set("num_returns", PyValue::integer(1));
+    auto reply = request("client_submit_named", payload);
+    if (reply->kind != PyValue::Kind::List || reply->items.empty())
+      throw std::runtime_error("submit: bad reply");
+    return ref_of(reply->items[0]);
+  }
+
+  // ---- cluster -----------------------------------------------------
+
+  PyValuePtr Nodes() { return request("client_nodes", PyValue::dict()); }
+
+  // ---- protocol internals (public for tests) -----------------------
+
+  PyValuePtr request(const std::string& method, PyValuePtr payload) {
+    payload->set("session", PyValue::str(session_));
+    int64_t msg_id = next_id_++;
+    auto frame = PyValue::list({PyValue::integer(kRequest),
+                                PyValue::integer(msg_id),
+                                PyValue::str(method), payload});
+    send_frame(PickleEncoder::dumps(frame));
+    while (true) {
+      auto msg = PickleDecoder::loads(recv_frame());
+      if (msg->kind != PyValue::Kind::List || msg->items.size() != 4)
+        throw std::runtime_error("bad frame");
+      int64_t kind = msg->items[0]->i;
+      if (kind == kPush || kind == kNotify) continue;  // not subscribed
+      if (msg->items[1]->i != msg_id) continue;        // stale reply
+      if (kind == kError) {
+        const auto& err = msg->items[3];
+        std::string what = "rpc error";
+        if (err->kind == PyValue::Kind::Tuple && err->items.size() >= 3)
+          what = err->items[1]->s + ": " + err->items[2]->s;
+        throw std::runtime_error(what);
+      }
+      return msg->items[3];
+    }
+  }
+
+  // serialization.py value wrap: MAGIC u32 | n u32 | sizes u64[n] | pad8
+  // | buffers (single in-band pickle buffer from this client).
+  static std::string wrap_value(const PyValuePtr& v) {
+    std::string body = PickleEncoder::dumps(v);
+    size_t header = 8 + 8;
+    size_t off = pad8(header);
+    std::string out(off + body.size(), '\0');
+    uint32_t magic = 0x52545055, n = 1;
+    uint64_t sz = body.size();
+    std::memcpy(&out[0], &magic, 4);
+    std::memcpy(&out[4], &n, 4);
+    std::memcpy(&out[8], &sz, 8);
+    std::memcpy(&out[off], body.data(), body.size());
+    return out;
+  }
+
+  static PyValuePtr unwrap_value(const std::string& data) {
+    if (data.size() < 16) throw std::runtime_error("value too short");
+    uint32_t magic, n;
+    std::memcpy(&magic, &data[0], 4);
+    std::memcpy(&n, &data[4], 4);
+    if (magic != 0x52545055) throw std::runtime_error("bad value magic");
+    if (n != 1)
+      throw std::runtime_error(
+          "value uses out-of-band buffers (not plain data)");
+    uint64_t sz;
+    std::memcpy(&sz, &data[8], 8);
+    size_t off = pad8(8 + 8);
+    return PickleDecoder::loads(data.substr(off, sz));
+  }
+
+ private:
+  int fd_ = -1;
+  int64_t next_id_ = 1;
+  std::string session_;
+  std::string job_id_;
+
+  static size_t pad8(size_t n) { return (n + 7) / 8 * 8; }
+
+  static std::string random_hex(size_t n) {
+    // Full-entropy session id: draw from random_device per nibble-pair
+    // and fold in pid + clock (a 32-bit-seeded PRNG would cap the id
+    // space at 2^32 and a collision cross-wires two client sessions).
+    static const char* hex = "0123456789abcdef";
+    std::random_device rd;
+    uint64_t mix = static_cast<uint64_t>(::getpid()) ^
+                   static_cast<uint64_t>(
+                       std::chrono::steady_clock::now()
+                           .time_since_epoch().count());
+    std::string s;
+    for (size_t k = 0; k < n; k++) {
+      uint32_t r = rd() ^ static_cast<uint32_t>(mix >> ((k % 8) * 8));
+      s.push_back(hex[r % 16]);
+    }
+    return s;
+  }
+
+  static ObjectRef ref_of(const PyValuePtr& pair) {
+    if (pair->kind != PyValue::Kind::Tuple || pair->items.size() != 2)
+      throw std::runtime_error("bad ref reply");
+    return ObjectRef{pair->items[0]->s, pair->items[1]->s};
+  }
+
+  void dial(const std::string& host, int port) {
+    struct addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                    &res) != 0 || res == nullptr)
+      throw std::runtime_error("resolve failed: " + host);
+    fd_ = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd_ < 0 || ::connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+      freeaddrinfo(res);
+      throw std::runtime_error("connect failed: " + host + ":" +
+                               std::to_string(port));
+    }
+    freeaddrinfo(res);
+  }
+
+  void send_all(const void* p, size_t n) {
+    const char* c = static_cast<const char*>(p);
+    while (n) {
+      ssize_t w = ::send(fd_, c, n, 0);
+      if (w <= 0) throw std::runtime_error("send failed");
+      c += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+  void recv_all(void* p, size_t n) {
+    char* c = static_cast<char*>(p);
+    while (n) {
+      ssize_t r = ::recv(fd_, c, n, 0);
+      if (r <= 0) throw std::runtime_error("connection lost");
+      c += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+  void send_frame(const std::string& body) {
+    uint32_t len = static_cast<uint32_t>(body.size());
+    send_all(&len, 4);
+    send_all(body.data(), body.size());
+  }
+  std::string recv_frame() {
+    uint32_t len = 0;
+    recv_all(&len, 4);
+    std::string body(len, '\0');
+    recv_all(&body[0], len);
+    return body;
+  }
+};
+
+}  // namespace raytpu

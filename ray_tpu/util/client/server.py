@@ -35,6 +35,8 @@ class _ClientSession:
         # Server-push pumps per subscribed generator: task + credit sem.
         self.gen_pumps: Dict[bytes, asyncio.Task] = {}
         self.gen_credits: Dict[bytes, asyncio.Semaphore] = {}
+        # qualname -> content-hashed function_id already exported.
+        self.named_exports: Dict[str, str] = {}
 
     def track(self, ref: ObjectRef):
         self.refs[ref.id.binary()] = ref
@@ -60,7 +62,7 @@ class ClientServer:
                      "get_named_actor", "release", "cluster_resources",
                      "nodes", "cancel", "disconnect", "generator_next",
                      "generator_release", "generator_subscribe",
-                     "generator_credit"):
+                     "generator_credit", "submit_named"):
             self.server.register(f"client_{name}",
                                  getattr(self, f"rpc_{name}"))
         actual = await self.server.start(host, port)
@@ -208,6 +210,35 @@ class ClientServer:
             s.generators[gen._task_id.binary()] = gen
             return gen._task_id.binary()
         return [s.track(r) for r in refs]
+
+    async def rpc_submit_named(self, conn, payload):
+        """Cross-language task submission: invoke an importable Python
+        function by "module:function" name (the reference's cross-language
+        descriptor path, python/ray/cross_language.py — how its C++/Java
+        workers call Python). Non-Python drivers (the C++ client in
+        ray_tpu/_native/) use this because they cannot ship cloudpickled
+        function blobs."""
+        s = self._session(payload)
+        qualname = payload["func"]
+        fid = s.named_exports.get(qualname)
+        if fid is None:
+            import hashlib
+            import importlib
+            mod_name, _, fn_name = qualname.partition(":")
+            fn = getattr(importlib.import_module(mod_name), fn_name)
+            from ray_tpu._private.serialization import dumps_function
+            blob = dumps_function(fn)
+            # Content-hashed id: a redefined function body gets a fresh
+            # export (function exports are immutable in the GCS KV, and
+            # workers cache by function_id).
+            fid = (f"named:{qualname}:"
+                   + hashlib.sha1(blob).hexdigest()[:12])
+            await s.core.export_function_raw(blob, fid)
+            s.named_exports[qualname] = fid
+        # Delegate the submission tail to the one shared path.
+        payload = dict(payload, function_id=fid, function_blob=None,
+                       name=qualname)
+        return await self.rpc_submit_task(conn, payload)
 
     async def rpc_create_actor(self, conn, payload):
         s = self._session(payload)

@@ -1,0 +1,17 @@
+"""Target functions the C++ client invokes by qualified name
+(rpc_submit_named — the cross-language descriptor path)."""
+
+import time
+
+
+def add_all(xs):
+    return sum(xs)
+
+
+def describe(d):
+    return f"dict named {d['name']} with {len(d['xs'])} xs"
+
+
+def slow_echo(delay, msg):
+    time.sleep(delay)
+    return msg

@@ -1,0 +1,92 @@
+"""C++ client: a native driver speaking the client-server protocol.
+
+Reference parity: cpp/ (the reference's C++ worker API: Init/Put/Get/
+Wait/Task(...).Remote()). Here `ray_tpu/_native/` holds a header-only
+C++17 client (framed-RPC + plain-data pickle codec) compiled with g++ in
+the test and driven end-to-end against a live cluster + ClientServer.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "ray_tpu", "_native")
+HELPERS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "helpers")
+
+
+@pytest.fixture(scope="module")
+def demo_binary(tmp_path_factory):
+    import shutil
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("g++ not available")
+    out = str(tmp_path_factory.mktemp("cpp") / "demo")
+    proc = subprocess.run(
+        [gxx, "-std=c++17", "-O0", os.path.join(NATIVE_DIR,
+                                                "demo_client.cpp"),
+         "-o", out],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    return out
+
+
+@pytest.fixture
+def client_server(ray_cluster):
+    from ray_tpu._private import worker_api
+    from ray_tpu.util.client import ClientServer
+
+    # cpp_targets must be importable by the SERVER process (it resolves
+    # "module:function" names there, then ships the function by value).
+    sys.path.insert(0, HELPERS)
+    ray_cluster.connect()
+    server = ClientServer(ray_cluster.gcs_address)
+    loop = worker_api._state.loop
+    addr = asyncio.run_coroutine_threadsafe(
+        server.start(host="127.0.0.1", port=0), loop).result(30)
+    yield addr
+    asyncio.run_coroutine_threadsafe(server.stop(), loop).result(30)
+    sys.path.remove(HELPERS)
+
+
+def test_cpp_client_end_to_end(demo_binary, client_server):
+    host, port = client_server.rsplit(":", 1)
+    proc = subprocess.run([demo_binary, host, port],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    for marker in ("put/get ok", "task by name ok: 42", "ref arg ok",
+                   "wait ok", "CPP-CLIENT-OK"):
+        assert marker in proc.stdout, proc.stdout
+
+
+def test_pickle_codec_roundtrip_against_python(demo_binary, tmp_path):
+    """The C++ encoder's output loads in Python and CPython pickles load
+    in the C++ decoder — validated through the live protocol above; here
+    additionally check the C++ encoder's LONG1 edge cases survive a
+    Python round trip via a put/get through the wire in the e2e test.
+    This test documents the plain-data contract."""
+    import pickle
+    # Python protocol-5 output of plain data uses only opcodes the C++
+    # decoder implements; this guards against new opcodes sneaking into
+    # the frames we exchange.
+    sample = [0, 1, "client_connect",
+              {"session": "ab" * 16, "n": -(2 ** 40), "f": 1.5,
+               "b": b"\x00\x01", "t": (1, 2, 3, 4),
+               "nested": [{"k": None, "ok": True}]}]
+    blob = pickle.dumps(sample, protocol=5)
+    import pickletools
+    implemented = {
+        "PROTO", "FRAME", "STOP", "NONE", "NEWTRUE", "NEWFALSE",
+        "BININT", "BININT1", "BININT2", "LONG1", "BINFLOAT",
+        "SHORT_BINBYTES", "BINBYTES", "BINBYTES8", "SHORT_BINUNICODE",
+        "BINUNICODE", "BINUNICODE8", "EMPTY_LIST", "EMPTY_TUPLE",
+        "EMPTY_DICT", "MARK", "APPEND", "APPENDS", "SETITEM", "SETITEMS",
+        "TUPLE1", "TUPLE2", "TUPLE3", "TUPLE", "MEMOIZE", "BINGET",
+        "LONG_BINGET", "BINPUT", "LONG_BINPUT",
+    }
+    used = {op.name for op, _arg, _pos in pickletools.genops(blob)}
+    assert used <= implemented, used - implemented
