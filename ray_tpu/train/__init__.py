@@ -20,6 +20,8 @@ from ray_tpu.train.backend_executor import (BackendConfig, BackendExecutor,
                                             TrainingFailedError)
 from ray_tpu.train.worker_group import WorkerGroup
 from ray_tpu.train.sklearn import SklearnTrainer
+from ray_tpu.train.torch import (TorchConfig, TorchTrainer, prepare_model,
+                                 prepare_data_loader)
 
 __all__ = [
     "Checkpoint", "save_pytree", "load_pytree", "new_checkpoint_dir",
@@ -28,5 +30,6 @@ __all__ = [
     "TrainContext", "TrainState", "init_train_state", "make_train_step",
     "make_eval_step", "JaxTrainer", "Result", "BackendConfig",
     "JaxBackendConfig", "BackendExecutor", "WorkerGroup",
-    "TrainingFailedError", "SklearnTrainer",
+    "TrainingFailedError", "SklearnTrainer", "TorchTrainer",
+    "TorchConfig", "prepare_model", "prepare_data_loader",
 ]

@@ -1,0 +1,140 @@
+"""TorchTrainer: distributed PyTorch training on the worker gang.
+
+Reference parity: python/ray/train/torch/torch_trainer.py +
+train/torch/config.py:64 (_setup_torch_process_group) +
+train/torch/train_loop_utils.py (prepare_model/prepare_data_loader).
+
+On this TPU-first stack the JAX path is the accelerator path; torch runs
+CPU-side (aux models, preprocessing, parity workloads). The backend hook
+forms a real torch.distributed gloo process group across the gang (one
+rendezvous address, ranks = worker ranks), so DDP gradients all-reduce
+across workers exactly as the reference's TorchTrainer does.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import cloudpickle
+
+from ray_tpu.train.backend_executor import BackendConfig
+from ray_tpu.train.trainer import JaxTrainer
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    """Forms the torch.distributed process group over the gang
+    (reference: train/torch/config.py TorchConfig)."""
+
+    backend: str = "gloo"
+    init_timeout_s: float = 60.0
+
+    def on_start(self, executor) -> None:
+        import ray_tpu
+        infos = executor.node_info_per_worker
+        master_addr = infos[0]["ip"]
+        world = executor.world_size
+        backend = self.backend
+        timeout_s = self.init_timeout_s
+
+        # Rank 0 picks a free port on ITS host so concurrent trainers
+        # (e.g. parallel Tune trials on one node) never share a TCPStore
+        # (reference: train/torch/config.py uses get_free_port on rank 0).
+        def _free_port():
+            import socket
+            with socket.socket() as s:
+                s.bind(("", 0))
+                return s.getsockname()[1]
+
+        master_port = ray_tpu.get(
+            executor.worker_group.workers[0].execute.remote(
+                cloudpickle.dumps(_free_port)), timeout=30)
+
+        def _init(rank, addr, port, world_size):
+            import datetime
+            import os
+
+            import torch.distributed as dist
+            os.environ["MASTER_ADDR"] = addr
+            os.environ["MASTER_PORT"] = str(port)
+            if not dist.is_initialized():
+                dist.init_process_group(
+                    backend, rank=rank, world_size=world_size,
+                    timeout=datetime.timedelta(seconds=timeout_s))
+            return dist.get_rank()
+
+        fn_b = cloudpickle.dumps(_init)
+        refs = [w.execute.remote(fn_b, rank, master_addr, master_port,
+                                 world)
+                for rank, w in enumerate(executor.worker_group.workers)]
+        ray_tpu.get(refs, timeout=timeout_s + 60)
+
+    def on_shutdown(self, executor) -> None:
+        import ray_tpu
+
+        def _teardown():
+            import torch.distributed as dist
+            if dist.is_initialized():
+                dist.destroy_process_group()
+            return True
+
+        fn_b = cloudpickle.dumps(_teardown)
+        try:
+            refs = [w.execute.remote(fn_b)
+                    for w in executor.worker_group.workers]
+            ray_tpu.get(refs, timeout=30)
+        except Exception:
+            pass
+
+
+class TorchTrainer(JaxTrainer):
+    """`JaxTrainer` harness + torch process-group backend: same gang
+    scheduling, fault tolerance, checkpointing, and session API; the
+    train loop uses torch + torch.distributed."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 torch_config: Optional[TorchConfig] = None, **kwargs):
+        kwargs.setdefault("backend_config", torch_config or TorchConfig())
+        super().__init__(train_loop_per_worker, **kwargs)
+
+
+def prepare_model(model):
+    """Wrap in DDP when the process group spans >1 worker (reference:
+    train_loop_utils.py prepare_model; device move is a no-op on CPU)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(loader):
+    """Re-wrap a DataLoader with a DistributedSampler so each worker sees
+    its shard (reference: train_loop_utils.py prepare_data_loader).
+
+    Shuffling follows the ORIGINAL loader (a sequential eval loader stays
+    ordered). For epoch-varying shuffles call
+    ``loader.sampler.set_epoch(epoch)`` each epoch, as with any
+    DistributedSampler."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, RandomSampler
+    from torch.utils.data.distributed import DistributedSampler
+    if not (dist.is_initialized() and dist.get_world_size() > 1):
+        return loader
+    if loader.batch_size is None:
+        raise ValueError(
+            "prepare_data_loader needs a batch_size-based DataLoader "
+            "(custom batch_sampler loaders must shard themselves)")
+    shuffle = isinstance(loader.sampler, RandomSampler)
+    sampler = DistributedSampler(loader.dataset,
+                                 num_replicas=dist.get_world_size(),
+                                 rank=dist.get_rank(),
+                                 shuffle=shuffle)
+    return DataLoader(loader.dataset, batch_size=loader.batch_size,
+                      sampler=sampler,
+                      num_workers=loader.num_workers,
+                      collate_fn=loader.collate_fn,
+                      pin_memory=loader.pin_memory,
+                      worker_init_fn=loader.worker_init_fn,
+                      drop_last=loader.drop_last)

@@ -7,8 +7,9 @@ Trials run as ray_tpu actors; the controller event-loop drives them with
 `wait` and applies scheduler decisions between reports.
 """
 
-from ray_tpu.tune.search import (BasicVariantGenerator, Categorical, Domain,
-                                 Float, Integer, SearchAlgorithm,
+from ray_tpu.tune.search import (BasicVariantGenerator, BOHBSearcher,
+                                 Categorical, Domain,
+                                 Float, GPSearcher, Integer, SearchAlgorithm,
                                  TPESearcher, choice, grid_search,
                                  lograndint, loguniform, qrandint, quniform,
                                  randint, randn, sample_from, uniform)
@@ -28,7 +29,7 @@ __all__ = [
     "grid_search", "uniform", "quniform", "loguniform", "choice", "randint",
     "qrandint", "lograndint", "randn", "sample_from",
     "Domain", "Float", "Integer", "Categorical", "BasicVariantGenerator",
-    "SearchAlgorithm", "TPESearcher",
+    "SearchAlgorithm", "TPESearcher", "GPSearcher", "BOHBSearcher",
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
     "ASHAScheduler", "MedianStoppingRule", "PopulationBasedTraining",
 ]

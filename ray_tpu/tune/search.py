@@ -225,9 +225,10 @@ class TPESearcher(SearchAlgorithm):
         # estimator can lock onto a local basin (observed on both numeric
         # and categorical dims); guaranteed exploration lets the model jump
         # to a better basin the moment one random trial lands in it.
-        explore = (len(self._obs) >= self._n_initial
+        obs = self._observations()
+        explore = (self._warmed_up(obs)
                    and self._n_suggested % 4 == 0)
-        if len(self._obs) < self._n_initial or explore:
+        if not self._warmed_up(obs) or explore:
             flat = {p: (d.sample(self._rng) if isinstance(d, Domain) else d)
                     for p, d in domains.items()}
         else:
@@ -247,6 +248,15 @@ class TPESearcher(SearchAlgorithm):
         sign = 1.0 if self._mode == "max" else -1.0
         self._obs.append((flat, sign * float(result[self._metric])))
 
+    def _observations(self):
+        """Observation list the estimator conditions on (BOHB overrides
+        this with a per-budget selection)."""
+        return self._obs
+
+    def _warmed_up(self, obs) -> bool:
+        """Random warmup is over: the estimator may model."""
+        return len(obs) >= self._n_initial
+
     # -- estimator -----------------------------------------------------
 
     def _split(self):
@@ -258,7 +268,8 @@ class TPESearcher(SearchAlgorithm):
         lands in the bad set and is never retried — observed lock-in);
         decaying stale evidence lets the marginal recover.
         """
-        n = len(self._obs)
+        obs_src = self._observations()
+        n = len(obs_src)
         ramp = 25
 
         def age_w(idx):
@@ -268,7 +279,7 @@ class TPESearcher(SearchAlgorithm):
 
         obs = sorted(
             ((flat, score, age_w(i))
-             for i, (flat, score) in enumerate(self._obs)),
+             for i, (flat, score) in enumerate(obs_src)),
             key=lambda o: -o[1])
         # Hyperopt's split size: ceil(gamma * sqrt(n)) capped at 25 — a
         # small elite set means one newly-found better basin immediately
@@ -388,6 +399,232 @@ class TPESearcher(SearchAlgorithm):
         score = (self._log_pdf(cands, l_means, l_bws, l_w)
                  - self._log_pdf(cands, g_means, g_bws, g_w))
         return self._numeric_untransform(dom, cands[int(np.argmax(score))])
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model side (Falkner et al., ICML'18): TPE conditioned on the
+    LARGEST budget that has enough observations.
+
+    Reference capability: python/ray/tune/search/bohb/bohb_search.py wraps
+    the external hpbandster package; here it reuses the native TPE
+    estimator. Pair with AsyncHyperBandScheduler (the ASHA rungs supply
+    the budgets): results report their budget via `budget_key`
+    (default "training_iteration"), and suggestions are conditioned on
+    the highest budget whose observation count reaches `min_points`,
+    pooling everything when no budget qualifies yet.
+    """
+
+    def __init__(self, *args, budget_key: str = "training_iteration",
+                 min_points: Optional[int] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._budget_key = budget_key
+        self._min_points = min_points
+        self._budget_obs: Dict[float, list] = {}
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict]):
+        flat = self._live.pop(trial_id, None)
+        if flat is None or not result or self._metric not in result:
+            return
+        sign = 1.0 if self._mode == "max" else -1.0
+        budget = float(result.get(self._budget_key, 1.0))
+        entry = (flat, sign * float(result[self._metric]))
+        self._budget_obs.setdefault(budget, []).append(entry)
+        self._obs.append(entry)  # pooled fallback
+
+    def _observations(self):
+        dims = sum(1 for _p, d in _flatten_domains(self._space)
+                   if isinstance(d, Domain))
+        need = self._min_points or max(dims + 1, self._n_initial)
+        for budget in sorted(self._budget_obs, reverse=True):
+            if len(self._budget_obs[budget]) >= need:
+                return self._budget_obs[budget]
+        return self._obs
+
+    def _warmed_up(self, obs) -> bool:
+        # BOHB warms up on the POOLED count: once enough total trials
+        # exist the model runs, even when the selected (highest adequate)
+        # budget's own list is smaller than n_initial — min_points
+        # declared that list big enough to condition on.
+        return len(self._obs) >= self._n_initial
+
+
+class GPSearcher(SearchAlgorithm):
+    """Native Gaussian-process Bayesian optimization with Expected
+    Improvement.
+
+    Reference capability: python/ray/tune/search/bayesopt/bayesopt_search.py
+    wraps the external `bayes_opt` package (GP + acquisition); here the GP
+    is built in (numpy Cholesky posterior):
+
+    - numeric dims normalized to [0,1] (log-space for log domains);
+      Categorical dims are one-hot relaxed (argmax on suggestion)
+    - Matérn-5/2 kernel with a fitted-by-grid lengthscale and noise floor
+    - acquisition: EI maximized over a quasi-random candidate sweep plus
+      jittered copies of the incumbent (local refinement)
+    - first n_initial suggestions random (seeded) to prime the GP
+    """
+
+    def __init__(self, space: Optional[dict] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 n_initial: int = 8, n_candidates: int = 512,
+                 xi: float = 0.01, seed: Optional[int] = None):
+        if space is not None:
+            self.set_space(space)
+        self._metric = metric
+        self._mode = mode
+        self._n_initial = n_initial
+        self._n_candidates = n_candidates
+        self._xi = xi
+        self._rng = random.Random(seed)
+        self._np_rng = np.random.RandomState(seed)
+        self._live: Dict[str, dict] = {}
+        self._obs: List[tuple] = []   # (unit-cube vector, flat cfg, score)
+
+    # -- dimension encoding --------------------------------------------
+
+    def _dims(self):
+        out = []
+        for path, dom in _flatten_domains(self._space):
+            if isinstance(dom, (Float, Integer)):
+                out.append((path, dom, 1))
+            elif isinstance(dom, Categorical):
+                out.append((path, dom, len(dom.categories)))
+            elif isinstance(dom, Domain):
+                raise ValueError(f"GPSearcher cannot model {type(dom).__name__}"
+                                 f" at {path}; use TPESearcher")
+            else:
+                out.append((path, dom, 0))  # constant
+        return out
+
+    def _to_unit(self, dom, v):
+        lo, hi = float(dom.lo), float(dom.hi)
+        if getattr(dom, "log", False):
+            return (np.log(v) - np.log(lo)) / (np.log(hi) - np.log(lo))
+        return (float(v) - lo) / (hi - lo)
+
+    def _from_unit(self, dom, u):
+        u = min(max(float(u), 0.0), 1.0)
+        lo, hi = float(dom.lo), float(dom.hi)
+        if getattr(dom, "log", False):
+            v = float(np.exp(np.log(lo) + u * (np.log(hi) - np.log(lo))))
+        else:
+            v = lo + u * (hi - lo)
+        if isinstance(dom, Integer):
+            v = int(round(v))
+            if dom.q:
+                v = int(round(v / dom.q) * dom.q)
+            return max(dom.lo, min(v, dom.hi - 1))
+        if dom.q:
+            v = round(v / dom.q) * dom.q
+        return min(max(v, dom.lo), dom.hi)
+
+    def _vec_of(self, flat):
+        parts = []
+        for path, dom, width in self._dims():
+            if width == 0:
+                continue
+            v = flat[path]
+            if isinstance(dom, Categorical):
+                one = np.zeros(width)
+                one[dom.categories.index(v)] = 1.0
+                parts.append(one)
+            else:
+                parts.append(np.array([self._to_unit(dom, v)]))
+        return np.concatenate(parts) if parts else np.zeros(1)
+
+    def _flat_of(self, vec):
+        flat, off = {}, 0
+        for path, dom, width in self._dims():
+            if width == 0:
+                flat[path] = dom
+                continue
+            if isinstance(dom, Categorical):
+                flat[path] = dom.categories[int(np.argmax(
+                    vec[off:off + width]))]
+            else:
+                flat[path] = self._from_unit(dom, vec[off])
+            off += width
+        return flat
+
+    # -- protocol ------------------------------------------------------
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        dims = self._dims()
+        if len(self._obs) < self._n_initial:
+            flat = {p: (d.sample(self._rng) if isinstance(d, Domain) else d)
+                    for p, d, _ in dims}
+        else:
+            flat = self._flat_of(self._acquire())
+        self._live[trial_id] = flat
+        cfg: dict = {}
+        for path, v in flat.items():
+            _set_path(cfg, path, v)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict]):
+        flat = self._live.pop(trial_id, None)
+        if flat is None or not result or self._metric not in result:
+            return
+        sign = 1.0 if self._mode == "max" else -1.0
+        self._obs.append((self._vec_of(flat), flat,
+                          sign * float(result[self._metric])))
+
+    # -- GP ------------------------------------------------------------
+
+    @staticmethod
+    def _matern52(X1, X2, ls):
+        d = np.sqrt(np.maximum(
+            ((X1[:, None, :] - X2[None, :, :]) ** 2).sum(-1), 1e-18)) / ls
+        return (1 + np.sqrt(5) * d + 5 * d * d / 3) * np.exp(-np.sqrt(5) * d)
+
+    def _posterior(self, Xc):
+        X = np.stack([v for v, _f, _s in self._obs])
+        y = np.array([s for _v, _f, s in self._obs], dtype=np.float64)
+        mu0, sd = y.mean(), max(y.std(), 1e-9)
+        yn = (y - mu0) / sd
+        noise = 1e-6
+        best_ls, best_ll = 0.5, -np.inf
+        for ls in (0.1, 0.2, 0.5, 1.0, 2.0):   # marginal-likelihood grid
+            K = self._matern52(X, X, ls) + noise * np.eye(len(X))
+            try:
+                L = np.linalg.cholesky(K)
+            except np.linalg.LinAlgError:
+                continue
+            a = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+            ll = (-0.5 * yn @ a - np.log(np.diag(L)).sum())
+            if ll > best_ll:
+                best_ls, best_ll = ls, ll
+        K = self._matern52(X, X, best_ls) + noise * np.eye(len(X))
+        L = np.linalg.cholesky(K + 1e-12 * np.eye(len(X)))
+        a = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        Ks = self._matern52(Xc, X, best_ls)
+        mu = Ks @ a
+        v = np.linalg.solve(L, Ks.T)
+        var = np.maximum(1.0 - (v * v).sum(axis=0), 1e-12)
+        return mu * sd + mu0, np.sqrt(var) * sd, y.max()
+
+    def _acquire(self):
+        dim = sum(w for _p, _d, w in self._dims() if w)
+        cands = self._np_rng.rand(self._n_candidates, dim)
+        # local refinement: jittered copies of the best few observations
+        top = sorted(self._obs, key=lambda o: -o[2])[:4]
+        local = np.concatenate([
+            np.clip(v[None, :] + 0.05 * self._np_rng.randn(16, dim), 0, 1)
+            for v, _f, _s in top]) if top else np.zeros((0, dim))
+        Xc = np.vstack([cands, local])
+        mu, sigma, best = self._posterior(Xc)
+        imp = mu - best - self._xi
+        z = imp / sigma
+        # EI = imp * Phi(z) + sigma * phi(z)
+        phi = np.exp(-0.5 * z * z) / np.sqrt(2 * np.pi)
+        Phi = 0.5 * (1 + _erf_vec(z / np.sqrt(2)))
+        ei = imp * Phi + sigma * phi
+        return Xc[int(np.argmax(ei))]
+
+
+def _erf_vec(x):
+    from math import erf
+    return np.vectorize(erf)(x)
 
 
 class BasicVariantGenerator(SearchAlgorithm):
