@@ -53,6 +53,14 @@ class OwnedObject:
     object_id: ObjectID
     local_refs: int = 0
     borrowers: int = 0
+    # Outstanding handoff credits: borrows pre-registered at serialization
+    # time for values that left this process with the ref inside (each is
+    # counted in `borrowers` and consumed when the receiver registers).
+    handoff_credits: int = 0
+    # For locally-stored containers: contained oids credited when THIS
+    # object's value was serialized — freeing the container without it
+    # ever being deserialized returns those credits.
+    credited_contained: List["ObjectID"] = field(default_factory=list)
     # Where the primary copy lives (raylet addresses).
     locations: List[str] = field(default_factory=list)
     inline_value: Optional[bytes] = None       # serialized, for small objects
@@ -80,7 +88,14 @@ class GeneratorStream:
     (reference: task_manager.h ObjectRefStream, num_returns='streaming')."""
     task_id: TaskID
     spec: Optional[TaskSpec] = None
-    received: int = 0               # items registered so far
+    # CONTIGUOUS items registered: every index < received has an owned
+    # entry. Item notifies can be handled out of order (concurrent
+    # handler dispatch), so a plain high-water mark would hand out refs
+    # to not-yet-registered indices — their fetch then sees "freed"
+    # (found via RPC delay injection on the data suite).
+    received: int = 0
+    # Registered indices at/after `received` (arrival holes).
+    registered_ahead: set = field(default_factory=set)
     total: Optional[int] = None     # set when the task finishes
     error: Optional[Exception] = None
     waiters: List[asyncio.Future] = field(default_factory=list)
@@ -206,6 +221,8 @@ class CoreWorker:
         self.clients = rpc.ClientPool()
         self.serialization = SerializationContext()
         self.serialization.deserialized_ref_factory = self._make_borrowed_ref
+        from ray_tpu._private.serialization import _set_handoff_credit_cb
+        _set_handoff_credit_cb(self._grant_handoff_credit)
 
         # object state
         self.owned: Dict[ObjectID, OwnedObject] = {}
@@ -255,6 +272,15 @@ class CoreWorker:
         # Guards id/seq reservation + owned/pending registration so the
         # threadsafe submission fast paths (user thread) can't race the loop.
         self.submission_lock = threading.RLock()
+        # Guards the distributed refcounts (local_refs/borrowers/
+        # borrowed_refs): ObjectRef __init__/__del__ fire on ARBITRARY
+        # threads and "ent.local_refs += 1" is three bytecodes — an
+        # unlocked interleave loses an increment and frees an object that
+        # live refs still point to (symptom: intermittent ObjectFreedError
+        # / a forever-pending fetch of the freed object, shaken out by
+        # RAY_TPU_TESTING_RPC_DELAY_US on the data suite). RLock: GC can
+        # re-enter __del__ on the thread already holding it.
+        self._ref_lock = threading.RLock()
         # Cross-thread posting with wakeup coalescing: a tight .remote()
         # burst on a user thread pays ONE self-pipe write for the whole
         # burst instead of one per call (~36us of syscall each on this box).
@@ -471,44 +497,49 @@ class CoreWorker:
         return TaskID.for_index(self.job_id, self.worker_id.binary(), idx)
 
     def _on_ref_created(self, ref: ObjectRef):
-        ent = self.owned.get(ref.id)
-        if ent is not None:
-            ent.local_refs += 1
-        elif ref.owner_address and ref.owner_address != self.address:
-            oid = ref.id
-            owner, count = self.borrowed_refs.get(oid, (ref.owner_address, 0))
-            self.borrowed_refs[oid] = (owner, count + 1)
+        with self._ref_lock:
+            ent = self.owned.get(ref.id)
+            if ent is not None:
+                ent.local_refs += 1
+            elif ref.owner_address and ref.owner_address != self.address:
+                oid = ref.id
+                owner, count = self.borrowed_refs.get(
+                    oid, (ref.owner_address, 0))
+                self.borrowed_refs[oid] = (owner, count + 1)
 
     def _on_ref_deleted(self, ref: ObjectRef):
         if self.loop is None or self._shutdown:
             return
-        ent = self.owned.get(ref.id)
-        if ent is not None:
-            ent.local_refs -= 1
-            if ent.local_refs <= 0 and ent.borrowers <= 0:
-                self._post_to_loop(self._schedule_free, ref.id)
-        else:
+        with self._ref_lock:
+            ent = self.owned.get(ref.id)
+            if ent is not None:
+                ent.local_refs -= 1
+                if ent.local_refs <= 0 and ent.borrowers <= 0:
+                    self._post_to_loop(self._schedule_free, ref.id)
+                return
             rec = self.borrowed_refs.get(ref.id)
-            if rec is not None:
-                owner, count = rec
-                if count <= 1:
-                    del self.borrowed_refs[ref.id]
-                    self.inproc.pop(ref.id, None)
-                    self._inproc_exc.discard(ref.id)
-                    npins = self._pinned.pop(ref.id, 0)
-                    if npins:
-                        oid_bytes = ref.id.binary()
-                        async def _rel(n=npins, ob=oid_bytes):
-                            for _ in range(n):
-                                await self.store.release(ob)
-                        try:
-                            self.loop.call_soon_threadsafe(
-                                lambda: asyncio.ensure_future(_rel()))
-                        except RuntimeError:
-                            pass
-                    self._notify_owner_deref(ref.id, owner)
-                else:
-                    self.borrowed_refs[ref.id] = (owner, count - 1)
+            if rec is None:
+                return
+            owner, count = rec
+            if count > 1:
+                self.borrowed_refs[ref.id] = (owner, count - 1)
+                return
+            del self.borrowed_refs[ref.id]
+            self.inproc.pop(ref.id, None)
+            self._inproc_exc.discard(ref.id)
+            npins = self._pinned.pop(ref.id, 0)
+        if npins:
+            oid_bytes = ref.id.binary()
+
+            async def _rel(n=npins, ob=oid_bytes):
+                for _ in range(n):
+                    await self.store.release(ob)
+            try:
+                self.loop.call_soon_threadsafe(
+                    lambda: asyncio.ensure_future(_rel()))
+            except RuntimeError:
+                pass
+        self._notify_owner_deref(ref.id, owner)
 
     def _notify_owner_deref(self, oid: ObjectID, owner: str):
         async def _go():
@@ -523,16 +554,37 @@ class CoreWorker:
             pass
 
     def _schedule_free(self, oid: ObjectID):
-        ent = self.owned.get(oid)
-        if ent is None or ent.local_refs > 0 or ent.borrowers > 0:
-            return
+        with self._ref_lock:
+            ent = self.owned.get(oid)
+            if ent is None or ent.local_refs > 0 or ent.borrowers > 0:
+                return
         asyncio.ensure_future(self._free_object(oid))
 
     async def _free_object(self, oid: ObjectID):
-        ent = self.owned.pop(oid, None)
-        self.inproc.pop(oid, None)
-        self._inproc_exc.discard(oid)
-        npins = self._pinned.pop(oid, 0)
+        followups = []
+        with self._ref_lock:
+            ent = self.owned.get(oid)
+            if ent is not None and (ent.local_refs > 0
+                                    or ent.borrowers > 0):
+                return  # resurrected between schedule and free
+            ent = self.owned.pop(oid, None)
+            self.inproc.pop(oid, None)
+            self._inproc_exc.discard(oid)
+            npins = self._pinned.pop(oid, 0)
+            # The container's value was never deserialized: return the
+            # handoff credits its serialization granted to contained
+            # self-owned refs, or they stay pinned forever.
+            if ent is not None:
+                for sub in ent.credited_contained:
+                    sub_ent = self.owned.get(sub)
+                    if sub_ent is not None and sub_ent.handoff_credits > 0:
+                        sub_ent.handoff_credits -= 1
+                        sub_ent.borrowers -= 1
+                        if (sub_ent.local_refs <= 0
+                                and sub_ent.borrowers <= 0):
+                            followups.append(sub)
+        for sub in followups:
+            self._schedule_free(sub)
         for _ in range(npins):
             try:
                 await self.store.release(oid.binary())
@@ -547,16 +599,52 @@ class CoreWorker:
             except Exception:
                 pass
 
-    def _make_borrowed_ref(self, object_id: ObjectID, owner_address: str):
-        """Called when a contained ObjectRef is deserialized in this process."""
+    def _grant_handoff_credit(self, ref: ObjectRef) -> bool:
+        """Serialization hook: a ref to a SELF-OWNED object is leaving the
+        process inside a value. Pre-register one borrow (a handoff
+        credit) so the object survives until the receiver's own borrow
+        registration lands — closes the async-notify window where the
+        owner's count hits zero mid-flight."""
+        with self._ref_lock:
+            ent = self.owned.get(ref.id)
+            if ent is None:
+                return False  # borrowed/unknown: legacy best-effort path
+            ent.borrowers += 1
+            ent.handoff_credits += 1
+            return True
+
+    def _make_borrowed_ref(self, object_id: ObjectID, owner_address: str,
+                           credited: bool = False):
+        """Called when a contained ObjectRef is deserialized in this
+        process. `credited`: the serializer granted a handoff credit."""
+        if object_id in self.owned:
+            # Our own object came back to us: the local ObjectRef tracks
+            # it; a granted credit is surplus — cancel it.
+            if credited:
+                with self._ref_lock:
+                    ent = self.owned.get(object_id)
+                    if ent is not None and ent.handoff_credits > 0:
+                        ent.handoff_credits -= 1
+                        ent.borrowers -= 1
+            return ObjectRef(object_id, owner_address)
+        first = object_id not in self.borrowed_refs
         ref = ObjectRef(object_id, owner_address)
-        if owner_address and owner_address != self.address \
-                and object_id not in self.owned:
-            # Register as borrower with the owner (best effort, async).
+        if not owner_address or owner_address == self.address:
+            return ref
+        payload = None
+        if first:
+            # Register as borrower; a credit converts into this borrow
+            # (owner count unchanged — it was pre-counted at serialize).
+            payload = {"object_id": object_id, "handoff": credited}
+        elif credited:
+            # Already registered: the extra credit must be returned.
+            payload = {"object_id": object_id, "handoff": True,
+                       "cancel": True}
+        if payload is not None:
             async def _reg():
                 try:
                     conn = await self.clients.get(owner_address)
-                    await conn.notify("owner_add_borrower", {"object_id": object_id})
+                    await conn.notify("owner_add_borrower", payload)
                 except Exception:
                     pass
             try:
@@ -591,18 +679,38 @@ class CoreWorker:
                 "is_exception": ent.is_exception}
 
     async def _rpc_owner_add_borrower(self, conn, payload):
-        ent = self.owned.get(payload["object_id"])
-        if ent is not None:
-            ent.borrowers += 1
+        free = False
+        oid = payload["object_id"]
+        with self._ref_lock:
+            ent = self.owned.get(oid)
+            if ent is not None:
+                if payload.get("cancel"):
+                    # surplus handoff credit returned by a receiver that
+                    # was already registered
+                    if ent.handoff_credits > 0:
+                        ent.handoff_credits -= 1
+                        ent.borrowers -= 1
+                        free = (ent.local_refs <= 0 and ent.borrowers <= 0)
+                elif payload.get("handoff") and ent.handoff_credits > 0:
+                    # borrow replaces its pre-counted credit: net zero
+                    ent.handoff_credits -= 1
+                else:
+                    ent.borrowers += 1
+        if free:
+            self._schedule_free(oid)
         return True
 
     async def _rpc_owner_remove_borrower(self, conn, payload):
         oid = payload["object_id"]
-        ent = self.owned.get(oid)
-        if ent is not None:
-            ent.borrowers -= 1
-            if ent.local_refs <= 0 and ent.borrowers <= 0:
-                self._schedule_free(oid)
+        with self._ref_lock:
+            ent = self.owned.get(oid)
+            if ent is not None:
+                ent.borrowers -= 1
+                free = ent.local_refs <= 0 and ent.borrowers <= 0
+            else:
+                free = False
+        if free:
+            self._schedule_free(oid)
         return True
 
     async def _rpc_owner_add_location(self, conn, payload):
@@ -626,6 +734,7 @@ class CoreWorker:
                              ser: SerializedObject) -> ObjectRef:
         ent = OwnedObject(object_id=oid, ready=True)
         ent.inline_value = ser.to_bytes()
+        ent.credited_contained = list(ser.credited_ids)
         with self.submission_lock:
             self.owned[oid] = ent
             self.inproc[oid] = value
@@ -641,6 +750,7 @@ class CoreWorker:
     async def _put_large(self, oid: ObjectID, ser: SerializedObject
                          ) -> ObjectRef:
         ent = OwnedObject(object_id=oid, ready=True)
+        ent.credited_contained = list(ser.credited_ids)
         self.owned[oid] = ent
         await self.store.put(oid.binary(), ser, owner_address=self.address)
         ent.locations.append(self.raylet_address)
@@ -1565,7 +1675,10 @@ class CoreWorker:
                                      payload["ret"],
                                      payload.get("exec_raylet", ""))
         stream.exec_worker = payload.get("exec_worker", stream.exec_worker)
-        stream.received = max(stream.received, payload["index"] + 1)
+        stream.registered_ahead.add(payload["index"])
+        while stream.received in stream.registered_ahead:
+            stream.registered_ahead.discard(stream.received)
+            stream.received += 1
         stream.wake()
         return True
 
@@ -1614,7 +1727,11 @@ class CoreWorker:
         if stream is None:
             return
         stream.wake()
-        for i in range(consumed, stream.received):
+        # never-handed-out items: the contiguous tail plus arrival holes
+        unconsumed = set(range(consumed, stream.received))
+        unconsumed.update(i for i in stream.registered_ahead
+                          if i >= consumed)
+        for i in unconsumed:
             self.owned.pop(ObjectID.for_task_return(task_id, i), None)
         if stream.total is None and stream.exec_worker:
             async def _cancel(addr=stream.exec_worker, tid=task_id):

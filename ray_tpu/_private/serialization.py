@@ -132,11 +132,18 @@ def _pad(n: int) -> int:
 
 
 class SerializedObject:
-    __slots__ = ("buffers", "contained_refs")
+    __slots__ = ("buffers", "contained_refs", "credited_ids")
 
-    def __init__(self, buffers: List[memoryview], contained_refs: List[ObjectRef]):
+    def __init__(self, buffers: List[memoryview],
+                 contained_refs: List[ObjectRef],
+                 credited_ids: Optional[list] = None):
         self.buffers = buffers
         self.contained_refs = contained_refs
+        # ObjectIDs that received a handoff credit during THIS
+        # serialization (self-owned refs leaving the process). A
+        # container stored locally records these so freeing the
+        # never-deserialized container returns the credits.
+        self.credited_ids = credited_ids or []
 
     @property
     def total_size(self) -> int:
@@ -201,18 +208,45 @@ class SerializedObject:
 # Thread-local context used to thread contained-ref collection through pickle.
 _ctx = threading.local()
 
+# Set by the core worker: called for every serialized contained ref.
+# Returns True when a HANDOFF CREDIT was granted — the serializing
+# process owns the object and pre-registered one borrow on it, so the
+# object cannot be freed while the serialized value (and the async
+# borrow registration of whoever deserializes it) is in flight. Without
+# the credit there is a window where the owner's refcount hits zero
+# after the value left the process but before the receiver's
+# owner_add_borrower notify lands (premature free, shaken out by RPC
+# delay injection on the data suite).
+_handoff_credit_cb = None
+
+
+def _set_handoff_credit_cb(cb):
+    global _handoff_credit_cb
+    _handoff_credit_cb = cb
+
 
 def _objectref_reducer(ref: ObjectRef):
     lst = getattr(_ctx, "refs", None)
     if lst is not None:
         lst.append(ref)
-    return (_restore_ref, (ref.id, ref.owner_address))
+    credited = False
+    cb = _handoff_credit_cb
+    if cb is not None:
+        try:
+            credited = bool(cb(ref))
+        except Exception:
+            credited = False
+    if credited:
+        cl = getattr(_ctx, "credited", None)
+        if cl is not None:
+            cl.append(ref.id)
+    return (_restore_ref, (ref.id, ref.owner_address, credited))
 
 
-def _restore_ref(object_id, owner_address):
+def _restore_ref(object_id, owner_address, credited: bool = False):
     cb = getattr(_ctx, "deser_ref_cb", None)
     if cb is not None:
-        return cb(object_id, owner_address)
+        return cb(object_id, owner_address, credited)
     return ObjectRef(object_id, owner_address, skip_refcount=True)
 
 
@@ -249,6 +283,7 @@ class SerializationContext:
         import io
 
         _ctx.refs = []
+        _ctx.credited = []
         buffers: List[pickle.PickleBuffer] = []
         try:
             f = io.BytesIO()
@@ -256,12 +291,14 @@ class SerializationContext:
             p.dump(value)
             inband = f.getvalue()
             refs = list(_ctx.refs)
+            credited = list(_ctx.credited)
         finally:
             _ctx.refs = None
+            _ctx.credited = None
         views = [memoryview(inband)]
         for pb in buffers:
             views.append(pb.raw())
-        return SerializedObject(views, refs)
+        return SerializedObject(views, refs, credited)
 
     def deserialize(self, data) -> Any:
         if isinstance(data, (bytes, bytearray)):

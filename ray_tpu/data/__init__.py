@@ -13,7 +13,8 @@ from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.datasource import Datasource, ReadTask
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (from_arrow, from_arrow_refs, from_items,
-                                   from_numpy, from_pandas, range,
+                                   from_numpy, from_pandas, from_torch,
+                                   range,
                                    range_tensor, read_avro,
                                    read_binary_files, read_csv, read_images,
                                    read_json, read_numpy, read_orc,
@@ -26,7 +27,7 @@ __all__ = [
     "Block", "BlockAccessor", "BlockMetadata", "DataContext", "Dataset",
     "Datasource", "ReadTask", "DataIterator",
     "from_arrow", "from_arrow_refs", "from_items", "from_numpy",
-    "from_pandas", "range", "range_tensor",
+    "from_pandas", "from_torch", "range", "range_tensor",
     "read_avro", "read_binary_files", "read_csv", "read_images",
     "read_json", "read_numpy", "read_orc", "read_parquet", "read_sql",
     "read_text", "read_tfrecords", "read_webdataset",

@@ -250,22 +250,47 @@ class ActorMapOp(PhysOp):
         self._blob = blob
         self._min_size = pool_size
         self._max_size = max(pool_size, max_size or pool_size)
+        self._actor_cpus = float(args.get("num_cpus", 1) or 0)
+        self._avail_cache: Tuple[float, float] = (0.0, 0.0)  # (ts, cpus)
         self._actors = [self._cls.remote(blob) for _ in range(pool_size)]
         self._idle = deque(self._actors)
         self._inflight: Dict[Any, Tuple[int, Any, float]] = {}
         self._blockref: Dict[Any, Any] = {}
+
+    def _spare_cpus(self) -> float:
+        """Cluster CPUs not currently claimed (cached ~0.5s)."""
+        now = time.monotonic()
+        ts, cpus = self._avail_cache
+        if now - ts < 0.5:
+            return cpus
+        try:
+            from ray_tpu._private import worker_api
+            cpus = float(worker_api.available_resources().get("CPU", 0.0))
+        except Exception:
+            cpus = float("inf")  # can't tell: keep legacy behavior
+        self._avail_cache = (now, cpus)
+        return cpus
 
     def _dispatch(self):
         # Autoscale up under backlog (reference: ActorPoolStrategy scales
         # between min_size and max_size): more input waiting than idle
         # actors, and room in the pool -> add workers until idle covers
         # the queue. They join the idle deque and serve this same pass.
+        # A new actor is added ONLY when the cluster would still have a
+        # CPU to spare afterwards — pool actors hold their CPU for the
+        # pipeline's lifetime, and a pool that absorbs every CPU starves
+        # the upstream read/map TASKS feeding it: a deadlock (pool waits
+        # for input; input can never schedule). Found by the suite hanging
+        # here under CPU contention.
         while (len(self.inq) > len(self._idle)
                and len(self._actors) < self._max_size
-               and self.can_accept_work()):
+               and self.can_accept_work()
+               and self._spare_cpus() >= self._actor_cpus + 1.0):
             actor = self._cls.remote(self._blob)
             self._actors.append(actor)
             self._idle.append(actor)
+            ts, cpus = self._avail_cache
+            self._avail_cache = (ts, cpus - self._actor_cpus)
         while self.inq and self._idle and self.can_accept_work():
             seq, (ref, _meta) = self.inq.popleft()
             actor = self._idle.popleft()

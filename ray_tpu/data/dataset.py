@@ -426,6 +426,27 @@ class Dataset:
                 w.writeheader()
                 w.writerows(rows)
 
+    def write_tfrecords(self, path: str, column: str = "bytes"):
+        """Write raw records in TFRecord framing (reference:
+        tfrecords_datasource write path; records are the given column's
+        bytes — proto encoding is the caller's choice, matching the
+        read side which returns raw record bytes)."""
+        import os
+        from ray_tpu.data.datasources import write_tfrecord_file
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            acc = BlockAccessor.for_block(block)
+            records = []
+            for row in acc.iter_rows():
+                rec = row[column] if isinstance(row, dict) else row
+                if isinstance(rec, np.ndarray):
+                    rec = rec.tobytes()
+                elif isinstance(rec, str):
+                    rec = rec.encode()
+                records.append(bytes(rec))
+            write_tfrecord_file(
+                os.path.join(path, f"part-{i:05d}.tfrecords"), records)
+
     def write_parquet(self, path: str):
         import os
         try:

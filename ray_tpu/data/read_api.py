@@ -82,7 +82,11 @@ def range_tensor(n: int, *, shape: tuple = (1,),
                            parallelism=parallelism)
 
 
-def from_items(items: List[Any], *, parallelism: int = -1) -> "Dataset":
+def _blocks_from_list(items: List[Any], parallelism: int,
+                      columnar: bool) -> "Dataset":
+    """Chunk a materialized row list into blocks (shared by
+    from_items/from_torch). columnar=True converts dict rows into the
+    canonical columnar form."""
     import ray_tpu
     if parallelism <= 0:
         parallelism = min(DataContext.get_current().read_op_min_num_blocks,
@@ -98,7 +102,7 @@ def from_items(items: List[Any], *, parallelism: int = -1) -> "Dataset":
         start += cnt
         if not chunk and n:
             continue
-        if chunk and isinstance(chunk[0], dict):
+        if columnar and chunk and isinstance(chunk[0], dict):
             block = {k: np.asarray([r[k] for r in chunk]) for k in chunk[0]}
         else:
             block = list(chunk)
@@ -109,6 +113,10 @@ def from_items(items: List[Any], *, parallelism: int = -1) -> "Dataset":
         refs = [ray_tpu.put(block)]
         metas = [BlockAccessor.for_block(block).get_metadata()]
     return _make_dataset(InputData(refs, metas))
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> "Dataset":
+    return _blocks_from_list(items, parallelism, columnar=True)
 
 
 def from_numpy(arr: Union[np.ndarray, List[np.ndarray]],
@@ -146,6 +154,14 @@ def from_arrow_refs(refs) -> "Dataset":
         lambda b: BlockAccessor.for_block(b).get_metadata())
     metas = ray_tpu.get([meta_of.remote(r) for r in refs])
     return _make_dataset(InputData(list(refs), metas))
+
+
+def from_torch(dataset, *, parallelism: int = -1) -> "Dataset":
+    """Materialize a map-style torch.utils.data.Dataset into rows of
+    {"item": sample} (reference: read_api.from_torch). Simple blocks:
+    samples are arbitrary objects (tensors, tuples, ...)."""
+    items = [{"item": dataset[i]} for i in builtins.range(len(dataset))]
+    return _blocks_from_list(items, parallelism, columnar=False)
 
 
 def from_pandas(dfs) -> "Dataset":

@@ -23,6 +23,10 @@ class Request:
     query: Dict[str, list]
     headers: Dict[str, str]
     body: bytes = b""
+    # WebSocketChannel on upgraded connections (method == "WEBSOCKET"):
+    # the handler awaits request.ws.receive() for client messages and
+    # yields to send (serve/websocket.py).
+    ws: Any = None
 
     def json(self) -> Any:
         return json.loads(self.body or b"null")
@@ -44,6 +48,7 @@ class ProxyActor:
         self._streaming: Dict[tuple, bool] = {}  # ingress -> generator?
         self._last_refresh = 0.0
         self._num_requests = 0
+        self._ws_queues: Dict[str, asyncio.Queue] = {}
 
     async def ready(self):
         if self._server is None:
@@ -110,14 +115,15 @@ class ProxyActor:
                 await self._respond(writer, 404,
                                     f"no route for {path}".encode())
                 return
+            if (headers.get("upgrade", "").lower() == "websocket"
+                    and "sec-websocket-key" in headers):
+                await self._handle_websocket(reader, writer, match, path,
+                                             url, headers)
+                return
             prefix, (app_name, ingress) = match
             key = (app_name, ingress)
-            handle = self._handles.get(key)
-            if handle is None:
-                from ray_tpu.serve.handle import DeploymentHandle
-                handle = DeploymentHandle(ingress, app_name=app_name)
-                self._handles[key] = handle
-            sub_path = path[len(prefix):] if prefix != "/" else path
+            handle = self._handle_for(key)
+            sub_path = self._sub_path(prefix, path)
             req = Request(method=method, path=sub_path or "/",
                           query=parse_qs(url.query), headers=headers,
                           body=body)
@@ -158,6 +164,118 @@ class ProxyActor:
                 writer.close()
             except Exception:
                 pass
+
+    def _handle_for(self, key):
+        handle = self._handles.get(key)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+            handle = DeploymentHandle(key[1], app_name=key[0])
+            self._handles[key] = handle
+        return handle
+
+    @staticmethod
+    def _sub_path(prefix: str, path: str) -> str:
+        return (path[len(prefix):] if prefix != "/" else path) or "/"
+
+    # ------------------------------------------------- websockets
+
+    def _self_handle(self):
+        from ray_tpu._private import worker_api
+        from ray_tpu.actor import ActorHandle
+        return ActorHandle(worker_api.get_core().current_actor_id,
+                           class_name="ProxyActor")
+
+    async def _handle_websocket(self, reader, writer, match, path, url,
+                                headers):
+        """RFC 6455 upgrade + duplex bridge to the replica handler
+        (reference: serve's ASGI websocket scope). Handler yields ->
+        frames out; client frames -> ws_receive() long-polls."""
+        import uuid as _uuid
+
+        from ray_tpu.serve import websocket as ws
+        prefix, (app_name, ingress) = match
+        handle = self._handle_for((app_name, ingress))
+
+        writer.write(
+            b"HTTP/1.1 101 Switching Protocols\r\n"
+            b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            b"Sec-WebSocket-Accept: "
+            + ws.accept_key(headers["sec-websocket-key"]).encode()
+            + b"\r\n\r\n")
+        await writer.drain()
+
+        conn_id = _uuid.uuid4().hex
+        queue: asyncio.Queue = asyncio.Queue()
+        self._ws_queues[conn_id] = queue
+        self._num_requests += 1
+
+        async def read_loop():
+            try:
+                while True:
+                    opcode, payload = await ws.read_frame(reader)
+                    if opcode == ws.OP_PING:
+                        writer.write(ws.encode_frame(ws.OP_PONG, payload))
+                        await writer.drain()
+                    elif opcode == ws.OP_TEXT:
+                        await queue.put(payload.decode())
+                    elif opcode == ws.OP_BINARY:
+                        await queue.put(payload)
+                    elif opcode == ws.OP_CLOSE:
+                        await queue.put(None)
+                        return
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                await queue.put(None)
+
+        reader_task = asyncio.ensure_future(read_loop())
+        req = Request(method="WEBSOCKET", path=self._sub_path(prefix, path),
+                      query=parse_qs(url.query), headers=headers,
+                      ws=ws.WebSocketChannel(self._self_handle(), conn_id))
+        try:
+            gen = handle.options(stream=True).remote(req)
+            async for item in gen:
+                if isinstance(item, str):
+                    frame = ws.encode_frame(ws.OP_TEXT, item.encode())
+                else:
+                    frame = ws.encode_frame(
+                        ws.OP_BINARY,
+                        item if isinstance(item, bytes) else
+                        json.dumps(_jsonable(item)).encode())
+                writer.write(frame)
+                await writer.drain()
+            writer.write(ws.encode_frame(ws.OP_CLOSE, b"\x03\xe8"))
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+        except Exception:
+            # handler failed: close with 1011 (internal error)
+            try:
+                writer.write(ws.encode_frame(ws.OP_CLOSE, b"\x03\xf3"))
+                await writer.drain()
+            except Exception:
+                pass
+        finally:
+            reader_task.cancel()
+            self._ws_queues.pop(conn_id, None)
+
+    async def ws_receive(self, conn_id: str, timeout=None):
+        """Next client message for an open websocket. Tagged result so
+        the channel can distinguish closed from idle:
+        {"msg": m} | {"closed": True} | {"timeout": True}.
+        Called BY the replica through an actor call."""
+        queue = self._ws_queues.get(conn_id)
+        if queue is None:
+            return {"closed": True}
+        try:
+            if timeout is not None:
+                msg = await asyncio.wait_for(queue.get(), timeout)
+            else:
+                msg = await queue.get()
+        except asyncio.TimeoutError:
+            return {"timeout": True}
+        if msg is None:
+            await queue.put(None)  # keep returning closed
+            return {"closed": True}
+        return {"msg": msg}
 
     async def _probe_streaming(self, handle) -> bool:
         router = handle._get_router()

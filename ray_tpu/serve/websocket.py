@@ -1,0 +1,98 @@
+"""WebSocket support for the Serve proxy.
+
+Reference parity: python/ray/serve supports websocket endpoints through
+its ASGI/starlette integration (serve._private.proxy handles the ASGI
+`websocket` scope). Here the proxy speaks RFC 6455 directly (no external
+deps): it performs the upgrade handshake, decodes masked client frames,
+and bridges a duplex session to the replica —
+
+  * server -> client: the deployment handler is an async generator; each
+    yielded str/bytes becomes a text/binary frame the moment it is
+    produced (same streaming path as chunked HTTP).
+  * client -> server: the handler awaits `request.ws.receive()`, which
+    long-polls the PROXY actor (the socket owner) for the next message
+    through a normal actor call.
+
+Unfragmented messages only (fin=1), which covers every common client;
+pings are answered by the proxy, close frames end the session.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import struct
+from typing import Optional, Tuple
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0D21AD85"
+
+OP_CONT, OP_TEXT, OP_BINARY, OP_CLOSE, OP_PING, OP_PONG = (
+    0x0, 0x1, 0x2, 0x8, 0x9, 0xA)
+
+
+def accept_key(client_key: str) -> str:
+    digest = hashlib.sha1((client_key + _WS_GUID).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+def encode_frame(opcode: int, payload: bytes, mask: bool = False) -> bytes:
+    """One unfragmented frame. Servers send unmasked; clients MUST mask."""
+    head = bytes([0x80 | opcode])
+    n = len(payload)
+    mask_bit = 0x80 if mask else 0
+    if n < 126:
+        head += bytes([mask_bit | n])
+    elif n < (1 << 16):
+        head += bytes([mask_bit | 126]) + struct.pack(">H", n)
+    else:
+        head += bytes([mask_bit | 127]) + struct.pack(">Q", n)
+    if mask:
+        key = os.urandom(4)
+        masked = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+        return head + key + masked
+    return head + payload
+
+
+async def read_frame(reader) -> Tuple[int, bytes]:
+    """-> (opcode, payload); unmasks client frames."""
+    b0, b1 = await reader.readexactly(2)
+    opcode = b0 & 0x0F
+    masked = bool(b1 & 0x80)
+    n = b1 & 0x7F
+    if n == 126:
+        (n,) = struct.unpack(">H", await reader.readexactly(2))
+    elif n == 127:
+        (n,) = struct.unpack(">Q", await reader.readexactly(8))
+    key = await reader.readexactly(4) if masked else None
+    payload = await reader.readexactly(n) if n else b""
+    if key:
+        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+    return opcode, payload
+
+
+class WebSocketChannel:
+    """Replica-side receive channel: `request.ws` in a websocket handler.
+
+    Wraps the proxy actor handle + connection id; receive() long-polls
+    the proxy for the next client message. Returns None when the client
+    closed."""
+
+    def __init__(self, proxy_handle, conn_id: str):
+        self._proxy = proxy_handle
+        self._conn_id = conn_id
+
+    async def receive(self, timeout: Optional[float] = None):
+        """Next client message; None when the client CLOSED. An idle
+        client past `timeout` raises TimeoutError instead (so a handler
+        can keep the session alive through silence)."""
+        out = await self._proxy.ws_receive.remote(self._conn_id, timeout)
+        if out.get("closed"):
+            return None
+        if out.get("timeout"):
+            raise TimeoutError(
+                f"no websocket message within {timeout}s")
+        return out["msg"]
+
+    def __reduce__(self):
+        return (WebSocketChannel, (self._proxy, self._conn_id))

@@ -56,23 +56,39 @@ class AsyncHyperBandScheduler(TrialScheduler):
     def __init__(self, *, time_attr: str = "training_iteration",
                  grace_period: int = 1, reduction_factor: int = 4,
                  max_t: int = 100, brackets: int = 1):
-        if brackets != 1:
-            raise NotImplementedError(
-                "multi-bracket ASHA is not implemented; use brackets=1")
         self._time_attr = time_attr
         self._grace = grace_period
         self._rf = reduction_factor
         self._max_t = max_t
-        self._levels = []
-        t = grace_period
-        while t < max_t:
-            self._levels.append(t)
-            t *= reduction_factor
-        # rung level -> {trial_id: score recorded when the trial crossed}
-        self._rungs: Dict[int, Dict[str, float]] = {}
+        # Hyperband brackets: bracket s starts halving at
+        # grace * rf^s (more brackets = some trials get more slack before
+        # their first cut; reference: async_hyperband.py brackets arg).
+        # Trials are assigned round-robin on first sight.
+        self._num_brackets = max(1, brackets)
+        self._bracket_levels: List[List[int]] = []
+        for s in range(self._num_brackets):
+            t = grace_period * (reduction_factor ** s)
+            levels = []
+            while t < max_t:
+                levels.append(t)
+                t *= reduction_factor
+            self._bracket_levels.append(levels)
+        self._assignment: Dict[str, int] = {}
+        self._next_bracket = 0
+        # (bracket, rung level) -> {trial_id: score when it crossed}
+        self._rungs: Dict[tuple, Dict[str, float]] = {}
 
-    def _below_cutoff(self, level: int, trial_id: str) -> bool:
-        rung = self._rungs.get(level, {})
+    def _bracket_of(self, trial_id: str) -> int:
+        b = self._assignment.get(trial_id)
+        if b is None:
+            b = self._next_bracket % self._num_brackets
+            self._next_bracket += 1
+            self._assignment[trial_id] = b
+        return b
+
+    def _below_cutoff(self, bracket: int, level: int,
+                      trial_id: str) -> bool:
+        rung = self._rungs.get((bracket, level), {})
         s = rung.get(trial_id)
         if s is None or len(rung) < 2:
             return False
@@ -88,16 +104,18 @@ class AsyncHyperBandScheduler(TrialScheduler):
         if t >= self._max_t:
             return STOP
         s = self.score(result)
+        bracket = self._bracket_of(trial.trial_id)
+        levels = self._bracket_levels[bracket]
         # Cross every rung level passed since the last report (time_attr may
         # advance in jumps, e.g. timesteps_total — exact equality would let
         # trials skip rungs and degrade ASHA to FIFO).
         decision = CONTINUE
-        while trial.rung < len(self._levels) and t >= self._levels[trial.rung]:
-            level = self._levels[trial.rung]
+        while trial.rung < len(levels) and t >= levels[trial.rung]:
+            level = levels[trial.rung]
             trial.rung += 1
-            rung = self._rungs.setdefault(level, {})
+            rung = self._rungs.setdefault((bracket, level), {})
             rung[trial.trial_id] = s
-            if self._below_cutoff(level, trial.trial_id):
+            if self._below_cutoff(bracket, level, trial.trial_id):
                 decision = STOP
         # Retroactive demotion: a trial that crossed its last rung early
         # (when the rung was near-empty, so promotion was optimistic) is
@@ -106,7 +124,7 @@ class AsyncHyperBandScheduler(TrialScheduler):
         # never cut and ASHA degrades to FIFO (successive-halving
         # semantics: only the top fraction of a rung is promoted).
         if decision == CONTINUE and trial.rung > 0:
-            if self._below_cutoff(self._levels[trial.rung - 1],
+            if self._below_cutoff(bracket, levels[trial.rung - 1],
                                   trial.trial_id):
                 decision = STOP
         return decision

@@ -91,3 +91,27 @@ def test_object_transfer_and_wait_under_delay(ray_delayed):
     assert len(ready) == 8 and not not_ready
     expect = int(big.sum())
     assert all(v == expect for v in ray_tpu.get(refs, timeout=60))
+
+
+def test_data_pipeline_under_delay(ray_delayed):
+    """Regression: streaming-read items arrive as independently-delayed
+    notifies, so their handlers run OUT OF ORDER. The stream's received
+    counter must only cover the contiguous registered prefix — a
+    high-water mark hands out refs to unregistered indices and their
+    consumers see 'freed by owner'. Also exercises the handoff-credit
+    path (refs inside values leaving their owner)."""
+    from ray_tpu import data as rd
+
+    ds = rd.range(120, parallelism=6).map_batches(
+        lambda b: {"id": b["id"] * 2}, batch_size=10)
+    assert ds.sum("id") == sum(2 * i for i in range(120))
+    assert sorted(r["id"] for r in
+                  ds.random_shuffle(seed=3).take_all()) == [
+        2 * i for i in range(120)]
+    # streaming split: coordinator actor owns blocks, driver borrows
+    it1, it2 = ds.streaming_split(2)
+    got = []
+    for it in (it1, it2):
+        for batch in it.iter_batches(batch_size=16):
+            got.extend(int(v) for v in batch["id"])
+    assert sorted(got) == [2 * i for i in range(120)]

@@ -335,3 +335,80 @@ def test_grpc_ingress_unary_and_stream(ray_mod):
         assert got == [0, 10, 20], got
     finally:
         client.close()
+
+
+def test_websocket_echo_duplex(ray_mod):
+    """RFC 6455 upgrade through the proxy, full duplex: client messages
+    reach the handler via request.ws.receive(); handler yields become
+    frames (reference: serve's ASGI websocket scope)."""
+    import asyncio
+    import base64
+    import os as _os
+
+    from ray_tpu.serve import websocket as wsmod
+
+    @serve.deployment
+    class Chat:
+        async def __call__(self, request):
+            assert request.method == "WEBSOCKET"
+            yield "hello"                      # server-initiated push
+            while True:
+                msg = await request.ws.receive(timeout=30)
+                if msg is None:
+                    return
+                if msg == "quit":
+                    yield "bye"
+                    return
+                yield f"echo:{msg}"
+
+    serve.start(proxy=True)
+    serve.run(Chat.bind(), name="ws1", route_prefix="/chat")
+    time.sleep(1.0)
+
+    async def client():
+        deadline = time.time() + 30
+        while True:
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", 8000)
+                key = base64.b64encode(_os.urandom(16)).decode()
+                writer.write(
+                    f"GET /chat HTTP/1.1\r\nHost: x\r\n"
+                    f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                    f"Sec-WebSocket-Key: {key}\r\n"
+                    f"Sec-WebSocket-Version: 13\r\n\r\n".encode())
+                await writer.drain()
+                status = await reader.readline()
+                if b"101" not in status:
+                    writer.close()
+                    await asyncio.sleep(0.5)
+                    continue
+                while (await reader.readline()) not in (b"\r\n", b""):
+                    pass
+                expected = wsmod.accept_key(key)
+                got = []
+                # first frame: server push
+                op, payload = await wsmod.read_frame(reader)
+                got.append((op, payload.decode()))
+                # send two messages, read echoes
+                for msg in ("one", "quit"):
+                    writer.write(wsmod.encode_frame(
+                        wsmod.OP_TEXT, msg.encode(), mask=True))
+                    await writer.drain()
+                    op, payload = await wsmod.read_frame(reader)
+                    got.append((op, payload.decode()))
+                # close frame from server after handler returns
+                op, _ = await wsmod.read_frame(reader)
+                got.append((op, ""))
+                writer.close()
+                return expected, got
+            except (ConnectionError, asyncio.IncompleteReadError, OSError):
+                if time.time() > deadline:
+                    raise
+                await asyncio.sleep(0.5)
+
+    expected, got = asyncio.run(asyncio.wait_for(client(), 60))
+    assert got[0] == (wsmod.OP_TEXT, "hello")
+    assert got[1] == (wsmod.OP_TEXT, "echo:one")
+    assert got[2] == (wsmod.OP_TEXT, "bye")
+    assert got[3][0] == wsmod.OP_CLOSE

@@ -356,3 +356,41 @@ def test_bohb_with_asha_end_to_end(ray_mod):
     assert len(results) == 10
     best = results.get_best_result("loss", "min")
     assert best.metrics["loss"] < 0.6
+
+
+def test_asha_multi_bracket():
+    """brackets>1: round-robin assignment; deeper brackets delay the
+    first cut to grace*rf^s (reference: async_hyperband brackets)."""
+    from ray_tpu.tune.schedulers import (CONTINUE, STOP,
+                                         AsyncHyperBandScheduler)
+    from ray_tpu.tune.trial import Trial
+
+    sched = AsyncHyperBandScheduler(grace_period=1, reduction_factor=2,
+                                    max_t=64, brackets=3)
+    sched.set_metric("score", "max")
+
+    def mk(tid):
+        return Trial(config={}, trial_id=tid)
+
+    trials = [mk(f"t{i}") for i in range(6)]
+    # assignment is round-robin over 3 brackets
+    brackets = [sched._bracket_of(t.trial_id) for t in trials]
+    assert brackets == [0, 1, 2, 0, 1, 2]
+    # bracket 1 starts halving at 2, bracket 2 at 4
+    assert sched._bracket_levels[0][0] == 1
+    assert sched._bracket_levels[1][0] == 2
+    assert sched._bracket_levels[2][0] == 4
+
+    # Two bracket-0 trials at t=1: the weaker is cut at the first rung.
+    weak, strong = trials[0], trials[3]
+    assert sched.on_trial_result(
+        strong, {"score": 10, "training_iteration": 1}, trials) == CONTINUE
+    assert sched.on_trial_result(
+        weak, {"score": 1, "training_iteration": 1}, trials) == STOP
+    # A bracket-2 trial with the same weak score is NOT cut at t=1 or
+    # t=2 (its first rung is 4).
+    late = trials[2]
+    for t_at in (1, 2):
+        assert sched.on_trial_result(
+            late, {"score": 1, "training_iteration": t_at},
+            trials) == CONTINUE
