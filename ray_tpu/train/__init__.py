@@ -22,6 +22,7 @@ from ray_tpu.train.worker_group import WorkerGroup
 from ray_tpu.train.sklearn import SklearnTrainer
 from ray_tpu.train.torch import (TorchConfig, TorchTrainer, prepare_model,
                                  prepare_data_loader)
+from ray_tpu.train.huggingface import TransformersTrainer, prepare_trainer
 
 __all__ = [
     "Checkpoint", "save_pytree", "load_pytree", "new_checkpoint_dir",
@@ -32,4 +33,5 @@ __all__ = [
     "JaxBackendConfig", "BackendExecutor", "WorkerGroup",
     "TrainingFailedError", "SklearnTrainer", "TorchTrainer",
     "TorchConfig", "prepare_model", "prepare_data_loader",
+    "TransformersTrainer", "prepare_trainer",
 ]

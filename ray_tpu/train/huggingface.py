@@ -1,0 +1,45 @@
+"""TransformersTrainer: HuggingFace Trainer runs on the worker gang.
+
+Reference parity: python/ray/train/huggingface/transformers/
+(TransformersTrainer + prepare_trainer): the user's
+`transformers.Trainer` training loop executes on every gang worker with
+the torch.distributed gloo process group already formed (TorchConfig),
+so HF's built-in DDP/distributed-sampler logic engages exactly as under
+torchrun. Per-epoch metrics flow back through a report callback.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.train.torch import TorchConfig, TorchTrainer  # noqa: F401
+
+
+class TransformersTrainer(TorchTrainer):
+    """`TorchTrainer` whose train loop builds and runs a
+    transformers.Trainer. The loop receives the train_loop_config and
+    must call `trainer.train()` itself (the reference's v2 API shape:
+    a plain train_loop_per_worker + prepare_trainer). The torchrun-style
+    env exported by TorchConfig makes HF/accelerate engage its
+    distributed (MULTI_CPU/DDP + DistributedSampler) path."""
+
+
+def prepare_trainer(trainer):
+    """Attach the ray_tpu report bridge to a transformers.Trainer
+    (reference: ray.train.huggingface.transformers.prepare_trainer):
+    every `on_log` from HF becomes a ray_tpu.train.report() so metrics
+    land in Result.metrics_dataframe, and HF's own distributed setup is
+    left to the already-initialized process group."""
+    from transformers import TrainerCallback
+
+    from ray_tpu.train.session import report
+
+    class _ReportCallback(TrainerCallback):
+        def on_log(self, args, state, control, logs=None, **kw):
+            if logs:
+                payload = {k: v for k, v in logs.items()
+                           if isinstance(v, (int, float))}
+                payload["step"] = state.global_step
+                payload["epoch"] = float(state.epoch or 0.0)
+                report(payload)
+
+    trainer.add_callback(_ReportCallback())
+    return trainer

@@ -51,13 +51,30 @@ class TorchConfig(BackendConfig):
             executor.worker_group.workers[0].execute.remote(
                 cloudpickle.dumps(_free_port)), timeout=30)
 
-        def _init(rank, addr, port, world_size):
+        # torchrun-compatible local ranks: position among the workers
+        # sharing this worker's node.
+        node_of = [i["hostname"] for i in infos]
+        local_rank, local_world, seen = [], [], {}
+        for host in node_of:
+            local_rank.append(seen.get(host, 0))
+            seen[host] = seen.get(host, 0) + 1
+        local_world = [seen[h] for h in node_of]
+
+        def _init(rank, addr, port, world_size, lrank, lworld):
             import datetime
             import os
 
             import torch.distributed as dist
             os.environ["MASTER_ADDR"] = addr
             os.environ["MASTER_PORT"] = str(port)
+            # torchrun-style env: libraries that self-configure from the
+            # environment (HF accelerate picks MULTI_CPU/DDP only when
+            # these are present) must see the same world the process
+            # group describes.
+            os.environ["RANK"] = str(rank)
+            os.environ["WORLD_SIZE"] = str(world_size)
+            os.environ["LOCAL_RANK"] = str(lrank)
+            os.environ["LOCAL_WORLD_SIZE"] = str(lworld)
             if not dist.is_initialized():
                 dist.init_process_group(
                     backend, rank=rank, world_size=world_size,
@@ -66,7 +83,8 @@ class TorchConfig(BackendConfig):
 
         fn_b = cloudpickle.dumps(_init)
         refs = [w.execute.remote(fn_b, rank, master_addr, master_port,
-                                 world)
+                                 world, local_rank[rank],
+                                 local_world[rank])
                 for rank, w in enumerate(executor.worker_group.workers)]
         ray_tpu.get(refs, timeout=timeout_s + 60)
 

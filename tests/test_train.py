@@ -223,3 +223,56 @@ def test_torch_trainer_ddp_allreduce(ray_start):
     assert result.error is None, result.error
     assert result.metrics["in_sync"] is True
     assert result.metrics["loss"] < 100.0
+
+
+def test_transformers_trainer_tiny_bert(ray_start, tmp_path):
+    """HF Trainer runs on the gang with the gloo process group formed;
+    metrics flow back through prepare_trainer's report bridge
+    (reference: ray.train.huggingface.transformers). Offline: the tiny
+    BERT is built from a config, never downloaded."""
+    from ray_tpu.train import ScalingConfig, TransformersTrainer
+
+    out_dir = str(tmp_path / "hf")
+
+    def train_fn(config):
+        import numpy as np
+        import torch
+        from torch.utils.data import Dataset as TorchDataset
+        from transformers import (BertConfig,
+                                  BertForSequenceClassification,
+                                  Trainer, TrainingArguments)
+
+        from ray_tpu.train import prepare_trainer
+
+        class Synth(TorchDataset):
+            def __len__(self):
+                return 64
+
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                ids = torch.tensor(rng.randint(0, 64, size=16))
+                return {"input_ids": ids,
+                        "attention_mask": torch.ones(16, dtype=torch.long),
+                        "labels": torch.tensor(int(i % 2))}
+
+        model = BertForSequenceClassification(BertConfig(
+            vocab_size=64, hidden_size=16, num_hidden_layers=1,
+            num_attention_heads=2, intermediate_size=32,
+            max_position_embeddings=32))
+        args = TrainingArguments(
+            output_dir=config["out"], num_train_epochs=1,
+            per_device_train_batch_size=8, logging_steps=2,
+            report_to=[], save_strategy="no", use_cpu=True,
+            disable_tqdm=True)
+        trainer = Trainer(model=model, args=args, train_dataset=Synth())
+        trainer = prepare_trainer(trainer)
+        # torchrun-style env must have engaged HF's distributed path
+        assert args.world_size == 2, args.world_size
+        trainer.train()
+
+    result = TransformersTrainer(
+        train_fn, train_loop_config={"out": out_dir},
+        scaling_config=ScalingConfig(num_workers=2)).fit()
+    assert result.error is None, result.error
+    assert result.metrics_dataframe, "no metrics reported"
+    assert any("loss" in row for row in result.metrics_dataframe)
