@@ -12,8 +12,10 @@ from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.datasource import Datasource, ReadTask
 from ray_tpu.data.iterator import DataIterator
-from ray_tpu.data.read_api import (from_arrow, from_arrow_refs, from_items,
-                                   from_numpy, from_pandas, from_torch,
+from ray_tpu.data.read_api import (from_arrow, from_arrow_refs,
+                                   from_huggingface, from_items,
+                                   from_numpy, from_pandas,
+                                   from_pandas_refs, from_torch,
                                    range,
                                    range_tensor, read_avro,
                                    read_binary_files, read_csv, read_images,
@@ -26,8 +28,9 @@ from ray_tpu.data.aggregate import (AggregateFn, Count, Max, Mean, Min, Std,
 __all__ = [
     "Block", "BlockAccessor", "BlockMetadata", "DataContext", "Dataset",
     "Datasource", "ReadTask", "DataIterator",
-    "from_arrow", "from_arrow_refs", "from_items", "from_numpy",
-    "from_pandas", "from_torch", "range", "range_tensor",
+    "from_arrow", "from_arrow_refs", "from_huggingface", "from_items",
+    "from_numpy", "from_pandas", "from_pandas_refs", "from_torch",
+    "range", "range_tensor",
     "read_avro", "read_binary_files", "read_csv", "read_images",
     "read_json", "read_numpy", "read_orc", "read_parquet", "read_sql",
     "read_text", "read_tfrecords", "read_webdataset",

@@ -207,6 +207,13 @@ class Dataset:
             out.append(ref if table is block else ray_tpu.put(table))
         return out
 
+    def to_numpy_refs(self):
+        """[ObjectRef[dict[str, ndarray]]] — numpy-columnar form of each
+        block, converted next to the data (reference: to_numpy_refs)."""
+        conv = ray_tpu.remote(
+            lambda b: BlockAccessor.for_block(b).to_batch("numpy"))
+        return [conv.remote(ref) for ref, _meta in self._execute()]
+
     def to_pandas(self):
         """Materialize the whole dataset as one pandas DataFrame."""
         import pandas as pd

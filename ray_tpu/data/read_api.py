@@ -82,36 +82,41 @@ def range_tensor(n: int, *, shape: tuple = (1,),
                            parallelism=parallelism)
 
 
+def _chunk_bounds(n: int, parallelism: int):
+    """(start, count) block boundaries splitting n rows into at most
+    `parallelism` near-equal chunks (empty chunks skipped unless n==0,
+    where one empty chunk is emitted so the dataset has a block)."""
+    if parallelism <= 0:
+        parallelism = min(DataContext.get_current().read_op_min_num_blocks,
+                          max(1, n))
+    base, rem = builtins.divmod(n, parallelism) if n else (0, 0)
+    start = 0
+    out = []
+    for i in builtins.range(parallelism):
+        cnt = base + (1 if i < rem else 0)
+        if cnt == 0 and n:
+            continue
+        out.append((start, cnt))
+        start += cnt
+    return out or [(0, 0)]
+
+
 def _blocks_from_list(items: List[Any], parallelism: int,
                       columnar: bool) -> "Dataset":
     """Chunk a materialized row list into blocks (shared by
     from_items/from_torch). columnar=True converts dict rows into the
     canonical columnar form."""
     import ray_tpu
-    if parallelism <= 0:
-        parallelism = min(DataContext.get_current().read_op_min_num_blocks,
-                          max(1, len(items)))
     items = list(items)
     refs, metas = [], []
-    n = len(items)
-    base, rem = builtins.divmod(n, parallelism) if n else (0, 0)
-    start = 0
-    for i in builtins.range(parallelism):
-        cnt = base + (1 if i < rem else 0)
+    for start, cnt in _chunk_bounds(len(items), parallelism):
         chunk = items[start:start + cnt]
-        start += cnt
-        if not chunk and n:
-            continue
         if columnar and chunk and isinstance(chunk[0], dict):
             block = {k: np.asarray([r[k] for r in chunk]) for k in chunk[0]}
         else:
             block = list(chunk)
         refs.append(ray_tpu.put(block))
         metas.append(BlockAccessor.for_block(block).get_metadata())
-    if not refs:
-        block = []
-        refs = [ray_tpu.put(block)]
-        metas = [BlockAccessor.for_block(block).get_metadata()]
     return _make_dataset(InputData(refs, metas))
 
 
@@ -164,13 +169,49 @@ def from_torch(dataset, *, parallelism: int = -1) -> "Dataset":
     return _blocks_from_list(items, parallelism, columnar=False)
 
 
+def from_huggingface(dataset, *, parallelism: int = -1) -> "Dataset":
+    """HuggingFace datasets.Dataset -> ray_tpu Dataset, zero-copy: HF
+    datasets are Arrow-backed and the table slices become Arrow blocks
+    (reference: read_api.from_huggingface)."""
+    if getattr(dataset, "_indices", None) is not None:
+        # select/shuffle/filter views keep an indices mapping over the
+        # ORIGINAL table; materialize it or we'd return the wrong rows.
+        dataset = dataset.flatten_indices()
+    table = dataset.data.table
+    import ray_tpu
+    refs, metas = [], []
+    for start, cnt in _chunk_bounds(table.num_rows, parallelism):
+        block = table.slice(start, cnt)
+        refs.append(ray_tpu.put(block))
+        metas.append(BlockAccessor.for_block(block).get_metadata())
+    return _make_dataset(InputData(refs, metas))
+
+
+def _df_to_block(df):
+    return {c: df[c].to_numpy() for c in df.columns}
+
+
+def from_pandas_refs(refs) -> "Dataset":
+    """ObjectRefs of pandas DataFrames -> Dataset (blocks converted
+    columnar next to the data)."""
+    import ray_tpu
+    if not isinstance(refs, list):
+        refs = [refs]
+    to_block = ray_tpu.remote(_df_to_block)
+    block_refs = [to_block.remote(r) for r in refs]
+    meta_of = ray_tpu.remote(
+        lambda b: BlockAccessor.for_block(b).get_metadata())
+    metas = ray_tpu.get([meta_of.remote(r) for r in block_refs])
+    return _make_dataset(InputData(block_refs, metas))
+
+
 def from_pandas(dfs) -> "Dataset":
     import ray_tpu
     if not isinstance(dfs, list):
         dfs = [dfs]
     refs, metas = [], []
     for df in dfs:
-        block = {c: df[c].to_numpy() for c in df.columns}
+        block = _df_to_block(df)
         refs.append(ray_tpu.put(block))
         metas.append(BlockAccessor.for_block(block).get_metadata())
     return _make_dataset(InputData(refs, metas))

@@ -395,3 +395,36 @@ def test_from_torch_and_write_tfrecords(ray_mod, tmp_path):
     back = rd.read_tfrecords(str(out) + "/*.tfrecords")
     assert sorted(r["bytes"] for r in back.take_all()) == [
         b"rec0", b"rec1", b"rec2", b"rec3", b"rec4"]
+
+
+def test_from_huggingface_and_ref_converters(ray_mod):
+    """HF datasets (Arrow-backed) come in zero-copy; from_pandas_refs /
+    to_numpy_refs convert next to the data."""
+    import datasets as hfd
+    import pandas as pd
+    import pyarrow as pa
+
+    hf = hfd.Dataset.from_dict({"a": list(range(10)),
+                                "b": [f"s{i}" for i in range(10)]})
+    ds = rd.from_huggingface(hf, parallelism=3)
+    assert ds.count() == 10 and ds.sum("a") == 45
+    blocks = [ray_tpu.get(r) for r, _ in ds.to_block_refs()]
+    assert all(isinstance(b, pa.Table) for b in blocks)
+
+    refs = [ray_tpu.put(pd.DataFrame({"v": [i, i + 1]})) for i in (0, 2)]
+    ds2 = rd.from_pandas_refs(refs)
+    assert sorted(r["v"] for r in ds2.take_all()) == [0, 1, 2, 3]
+
+    np_refs = rd.range(6, parallelism=2).to_numpy_refs()
+    batches = ray_tpu.get(np_refs)
+    assert sum(len(b["id"]) for b in batches) == 6
+    assert all(isinstance(b["id"], np.ndarray) for b in batches)
+
+
+def test_from_huggingface_respects_indices(ray_mod):
+    """select/shuffle views carry an indices mapping over the original
+    table — from_huggingface must materialize it."""
+    import datasets as hfd
+    hf = hfd.Dataset.from_dict({"a": list(range(10))}).select([1, 3, 5])
+    ds = rd.from_huggingface(hf)
+    assert sorted(r["a"] for r in ds.take_all()) == [1, 3, 5]
