@@ -23,6 +23,7 @@ from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.td3 import DDPG, DDPGConfig, TD3, TD3Config
+from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.offline import JsonReader, JsonWriter
@@ -33,7 +34,7 @@ __all__ = [
     "ImpalaConfig", "APPO", "APPOConfig", "DQN", "DQNConfig", "BC",
     "BCConfig", "SAC", "SACConfig", "TD3", "TD3Config", "DDPG",
     "DDPGConfig", "CQL", "CQLConfig", "MARWIL", "MARWILConfig",
-    "EnvSpec", "CartPoleEnv",
+    "A2C", "A2CConfig", "EnvSpec", "CartPoleEnv",
     "PendulumEnv", "MultiAgentEnv", "MultiCartPole", "make_env",
     "register_env", "SampleBatch", "MultiAgentBatch", "concat_samples",
     "ReplayBuffer", "PrioritizedReplayBuffer", "JsonReader", "JsonWriter",

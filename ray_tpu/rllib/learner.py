@@ -25,6 +25,7 @@ class PPOLearner:
         import optax
 
         self._optimizer = optax.adam(lr)
+        self._clip_param = clip_param
         self.params = policy_value_init(
             jax.random.PRNGKey(seed), obs_dim, num_actions,
             hidden=tuple(hidden))
@@ -35,12 +36,9 @@ class PPOLearner:
             logp_all = jax.nn.log_softmax(logits)
             n = logits.shape[0]
             logp = logp_all[jnp.arange(n), batch[sb.ACTIONS]]
-            ratio = jnp.exp(logp - batch[sb.LOGPS])
             adv = batch[sb.ADVANTAGES]
             adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-            pg1 = ratio * adv
-            pg2 = jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv
-            pg_loss = -jnp.minimum(pg1, pg2).mean()
+            pg_loss = self._pg_loss(logp, batch[sb.LOGPS], adv)
             vf_loss = ((values - batch[sb.VALUE_TARGETS]) ** 2).mean()
             entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
             total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
@@ -59,6 +57,16 @@ class PPOLearner:
 
         import jax
         self._jit_update = jax.jit(update)
+
+    def _pg_loss(self, logp, old_logp, adv):
+        """Clipped-surrogate policy gradient (overridden by A2C with the
+        vanilla advantage gradient)."""
+        import jax.numpy as jnp
+        ratio = jnp.exp(logp - old_logp)
+        pg1 = ratio * adv
+        pg2 = jnp.clip(ratio, 1 - self._clip_param,
+                       1 + self._clip_param) * adv
+        return -jnp.minimum(pg1, pg2).mean()
 
     def update(self, batch, *, minibatch_size: int, num_epochs: int,
                seed=0) -> Dict[str, float]:
