@@ -6,9 +6,15 @@ from ray_tpu.autoscaler.autoscaler import (AutoscalerConfig, NodeTypeConfig,
 from ray_tpu.autoscaler.node_provider import (FakeMultiNodeProvider,
                                               NodeProvider, TPUPodProvider)
 from ray_tpu.autoscaler.monitor import Monitor, make_gcs_request
+from ray_tpu.autoscaler.commands import (ClusterLauncher,
+                                         create_or_update_cluster,
+                                         load_cluster_config,
+                                         teardown_cluster)
 
 __all__ = [
     "AutoscalerConfig", "NodeTypeConfig", "StandardAutoscaler",
     "NodeProvider", "FakeMultiNodeProvider", "TPUPodProvider",
     "Monitor", "make_gcs_request",
+    "ClusterLauncher", "create_or_update_cluster", "load_cluster_config",
+    "teardown_cluster",
 ]

@@ -1,0 +1,179 @@
+"""Cluster launcher: config-driven cluster lifecycle (`ray_tpu up/down`).
+
+Reference parity: python/ray/autoscaler/_private/commands.py
+(create_or_update_cluster/teardown_cluster behind `ray up`/`ray down`) +
+the cluster-config YAML schema (autoscaler/ray-schema.json, trimmed to
+the fields this stack uses):
+
+    cluster_name: demo
+    max_workers: 8
+    provider:
+      type: fake            # or: tpu_pod (GCE Cloud TPU API, gated)
+      ...provider-specific keys...
+    head_node_type: head
+    available_node_types:
+      head:
+        resources: {CPU: 4}
+        max_workers: 0
+      worker:
+        resources: {CPU: 2}
+        min_workers: 1
+        max_workers: 4
+
+`up` starts the head in THIS process, builds the configured NodeProvider,
+and runs the StandardAutoscaler monitor so min_workers come up and demand
+scales the rest. `down` terminates every provider node and stops the head.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+_REQUIRED = ("provider", "available_node_types", "head_node_type")
+
+
+def load_cluster_config(path_or_dict) -> Dict[str, Any]:
+    if isinstance(path_or_dict, dict):
+        cfg = dict(path_or_dict)
+    else:
+        import yaml
+        with open(path_or_dict) as f:
+            cfg = yaml.safe_load(f)
+    for key in _REQUIRED:
+        if key not in cfg:
+            raise ValueError(f"cluster config missing {key!r}")
+    head_type = cfg["head_node_type"]
+    if head_type not in cfg["available_node_types"]:
+        raise ValueError(f"head_node_type {head_type!r} not in "
+                         f"available_node_types")
+    cfg.setdefault("cluster_name", "ray_tpu")
+    cfg.setdefault("max_workers", 8)
+    return cfg
+
+
+def _build_provider(cfg: dict, gcs_address: str, session_dir: str):
+    provider_cfg = dict(cfg["provider"])
+    # The cluster name scopes provider-side node labels: without it two
+    # clusters in one project would share the default label and `down`
+    # on one would terminate the other's nodes.
+    provider_cfg.setdefault("cluster_name", cfg["cluster_name"])
+    ptype = provider_cfg.get("type", "fake")
+    if ptype == "fake":
+        from ray_tpu._private import worker_api
+        from ray_tpu._private.config import get_config
+        from ray_tpu.autoscaler.node_provider import FakeMultiNodeProvider
+        return FakeMultiNodeProvider(gcs_address, get_config(), session_dir,
+                                     loop=worker_api._state.loop)
+    if ptype == "tpu_pod":
+        from ray_tpu.autoscaler.node_provider import TPUPodProvider
+        return TPUPodProvider(provider_cfg)
+    raise ValueError(f"unknown provider type {ptype!r}")
+
+
+def _autoscaler_node_types(cfg: dict) -> dict:
+    """Launcher YAML node types -> AutoscalerConfig node-type dicts."""
+    out = {}
+    for name, t in cfg["available_node_types"].items():
+        if name == cfg["head_node_type"]:
+            continue
+        out[name] = {
+            "resources": t.get("resources", {}),
+            "min_workers": t.get("min_workers", 0),
+            "max_workers": t.get("max_workers", cfg["max_workers"]),
+            "slice_hosts": t.get("slice_hosts", 1),
+        }
+    return out
+
+
+class ClusterLauncher:
+    """Handle for a launched cluster: head + provider + monitor."""
+
+    def __init__(self, config: dict):
+        self.config = config
+        self.cluster = None       # cluster_utils.Cluster hosting the head
+        self.provider = None
+        self.monitor = None
+        self.gcs_address = ""
+
+    def start(self) -> str:
+        from ray_tpu._private import worker_api
+        from ray_tpu.autoscaler import (AutoscalerConfig, Monitor,
+                                        StandardAutoscaler,
+                                        make_gcs_request)
+        from ray_tpu.cluster_utils import Cluster
+
+        head_type = self.config["available_node_types"][
+            self.config["head_node_type"]]
+        head_res = dict(head_type.get("resources", {}))
+        num_cpus = head_res.pop("CPU", 2)
+        num_tpus = head_res.pop("TPU", 0)
+        self.cluster = Cluster(
+            initialize_head=True,
+            head_node_args={"num_cpus": num_cpus, "num_tpus": num_tpus,
+                            "resources": head_res})
+        self.gcs_address = self.cluster.gcs_address
+        try:
+            self.provider = _build_provider(self.config, self.gcs_address,
+                                            self.cluster.session_dir)
+            as_config = AutoscalerConfig.from_dict({
+                "node_types": _autoscaler_node_types(self.config),
+                "max_workers": self.config["max_workers"],
+            })
+            gcs_request = make_gcs_request(self.gcs_address,
+                                           worker_api._state.loop)
+            scaler = StandardAutoscaler(as_config, self.provider,
+                                        gcs_request)
+            scaler.gcs_request("get_autoscaler_state", {})  # mark active
+            self.monitor = Monitor(scaler)
+            self.monitor.start()
+        except Exception:
+            # Never leak a running head (GCS + raylet on the daemon
+            # loop) behind a failed bring-up.
+            self.teardown()
+            raise
+        logger.info("cluster %s up: GCS at %s",
+                    self.config["cluster_name"], self.gcs_address)
+        return self.gcs_address
+
+    def teardown(self):
+        if self.monitor is not None:
+            # full join: an in-flight update() may still be creating a
+            # node; sweeping before it finishes would leak that node
+            self.monitor.stop(join_timeout=None)
+        if self.provider is not None:
+            for pid in list(self.provider.non_terminated_nodes()):
+                try:
+                    self.provider.terminate_node(pid)
+                except Exception:
+                    logger.exception("terminate %s failed", pid)
+        if self.cluster is not None:
+            self.cluster.shutdown()
+
+
+def create_or_update_cluster(path_or_dict) -> ClusterLauncher:
+    """`ray up`: bring the cluster up; returns the live handle."""
+    launcher = ClusterLauncher(load_cluster_config(path_or_dict))
+    launcher.start()
+    return launcher
+
+
+def teardown_cluster(path_or_dict,
+                     launcher: Optional[ClusterLauncher] = None) -> int:
+    """`ray down`: terminate provider nodes (and the head when the
+    in-process launcher handle is given). Returns the number of provider
+    nodes terminated."""
+    if launcher is not None:
+        n = len(launcher.provider.non_terminated_nodes()) \
+            if launcher.provider is not None else 0
+        launcher.teardown()
+        return n
+    cfg = load_cluster_config(path_or_dict)
+    # Out-of-process teardown only reaches provider-managed nodes.
+    provider = _build_provider(cfg, gcs_address="", session_dir="")
+    nodes = list(provider.non_terminated_nodes())
+    for pid in nodes:
+        provider.terminate_node(pid)
+    return len(nodes)

@@ -54,7 +54,10 @@ class Monitor:
             except Exception:  # noqa: BLE001
                 logger.exception("autoscaler update failed")
 
-    def stop(self):
+    def stop(self, join_timeout: Optional[float] = 5.0):
+        """join_timeout=None waits for the in-flight update to finish —
+        teardown needs that, or a create completing after the node sweep
+        leaks a node."""
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=join_timeout)

@@ -163,6 +163,38 @@ def cmd_stack(args):
     ray_tpu.shutdown()
 
 
+def cmd_up(args):
+    """`ray up` equivalent: config-driven cluster bring-up, attached
+    (head + provider + autoscaler run in this process until Ctrl-C)."""
+    import time as _time
+
+    from ray_tpu.autoscaler import create_or_update_cluster
+
+    launcher = create_or_update_cluster(args.config)
+    print(f"cluster '{launcher.config['cluster_name']}' up; GCS at "
+          f"{launcher.gcs_address}", flush=True)
+    print(f"connect with: ray_tpu.init(address='{launcher.gcs_address}')",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("tearing down...", flush=True)
+        launcher.teardown()
+
+
+def cmd_down(args):
+    from ray_tpu.autoscaler import load_cluster_config, teardown_cluster
+    cfg = load_cluster_config(args.config)
+    if cfg["provider"].get("type", "fake") == "fake":
+        print("fake-provider clusters live in the `up` process — stop "
+              "them with Ctrl-C there; nothing to terminate from here",
+              flush=True)
+        return
+    n = teardown_cluster(args.config)
+    print(f"terminated {n} provider node(s)", flush=True)
+
+
 def cmd_kv_store(args):
     """Standalone external GCS state store (the Redis-equivalent;
     reference: redis_store_client.h). Point heads at it with
@@ -239,6 +271,14 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--address", default=None)
     s.add_argument("--duration", type=float, default=2.0)
     s.set_defaults(fn=cmd_stack)
+
+    s = sub.add_parser("up", help="bring up a cluster from a config YAML")
+    s.add_argument("config")
+    s.set_defaults(fn=cmd_up)
+
+    s = sub.add_parser("down", help="terminate a cluster's provider nodes")
+    s.add_argument("config")
+    s.set_defaults(fn=cmd_down)
 
     s = sub.add_parser("kv-store", help="run the standalone external "
                                         "GCS state store")

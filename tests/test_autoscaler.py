@@ -291,3 +291,67 @@ def test_autoscaler_reconciles_with_tpu_provider():
     assert sorted(report["terminated"]) == sorted(ids)
     assert provider.non_terminated_nodes() == []
     assert sorted(drained) == ["g0", "g1"]
+
+
+def test_cluster_launcher_up_down(tmp_path):
+    """`ray_tpu up` equivalent: YAML config -> head + provider + monitor;
+    min_workers come up, demand scales further, `down` terminates all
+    (reference: autoscaler/_private/commands.py create_or_update/teardown)."""
+    import yaml
+
+    import ray_tpu
+    from ray_tpu.autoscaler import create_or_update_cluster, teardown_cluster
+
+    cfg = {
+        "cluster_name": "launcher-test",
+        "max_workers": 3,
+        "provider": {"type": "fake"},
+        "head_node_type": "head",
+        "available_node_types": {
+            "head": {"resources": {"CPU": 1}, "max_workers": 0},
+            "worker": {"resources": {"CPU": 2, "tag": 1},
+                       "min_workers": 1, "max_workers": 3},
+        },
+    }
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+
+    launcher = create_or_update_cluster(str(path))
+    try:
+        ray_tpu.init(address=launcher.gcs_address)
+        # min_workers: one worker node must join beyond the head
+        _wait(lambda: len([n for n in ray_tpu.nodes() if n["Alive"]]) >= 2,
+              msg="min_workers up")
+
+        # demand beyond min: tasks needing the worker-only resource
+        @ray_tpu.remote(resources={"tag": 0.5}, num_cpus=1)
+        def where():
+            import os
+            return os.getpid()
+
+        pids = set(ray_tpu.get([where.remote() for _ in range(4)],
+                               timeout=90))
+        assert pids
+        assert len(launcher.provider.non_terminated_nodes()) >= 1
+    finally:
+        ray_tpu.shutdown()
+        teardown_cluster(str(path), launcher=launcher)
+    assert launcher.provider.non_terminated_nodes() == []
+
+
+def test_cluster_config_validation(tmp_path):
+    from ray_tpu.autoscaler import load_cluster_config
+
+    with pytest.raises(ValueError, match="missing"):
+        load_cluster_config({"provider": {"type": "fake"}})
+    with pytest.raises(ValueError, match="head_node_type"):
+        load_cluster_config({
+            "provider": {"type": "fake"},
+            "available_node_types": {"a": {}},
+            "head_node_type": "missing"})
+    cfg = load_cluster_config({
+        "provider": {"type": "fake"},
+        "available_node_types": {"h": {}},
+        "head_node_type": "h"})
+    assert cfg["cluster_name"] == "ray_tpu"
+    assert cfg["max_workers"] == 8
