@@ -203,6 +203,30 @@ def cmd_kv_store(args):
     run_server(args.host, args.port, args.dir)
 
 
+def cmd_serve(args):
+    """Serve CLI (reference: python/ray/serve/scripts.py): deploy a
+    config file, run an import path, or print app status — against the
+    cluster at --address."""
+    ray_tpu = _connect(args)
+    from ray_tpu import serve
+    if args.serve_cmd == "deploy":
+        deployed = serve.deploy_config(args.config)
+        print(f"deployed applications: {', '.join(deployed)}")
+    elif args.serve_cmd == "run":
+        serve.run_import_path(args.import_path, name=args.name,
+                              route_prefix=args.route_prefix)
+        print(f"app '{args.name}' running at route {args.route_prefix}; "
+              f"Ctrl-C to exit", flush=True)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            serve.delete(args.name)
+    elif args.serve_cmd == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+    ray_tpu.shutdown()
+
+
 # ---------------------------------------------------------------- jobs
 
 def cmd_job(args):
@@ -286,6 +310,22 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--port", type=int, default=0)
     s.add_argument("--dir", default="/tmp/ray_tpu_kv_store")
     s.set_defaults(fn=cmd_kv_store)
+
+    s = sub.add_parser("serve", help="serve deploy/run/status")
+    ssub = s.add_subparsers(dest="serve_cmd", required=True)
+    sd = ssub.add_parser("deploy")
+    sd.add_argument("config")
+    sd.add_argument("--address", default=None)
+    sd.set_defaults(fn=cmd_serve)
+    sr = ssub.add_parser("run")
+    sr.add_argument("import_path")
+    sr.add_argument("--name", default="default")
+    sr.add_argument("--route-prefix", default="/", dest="route_prefix")
+    sr.add_argument("--address", default=None)
+    sr.set_defaults(fn=cmd_serve)
+    st = ssub.add_parser("status")
+    st.add_argument("--address", default=None)
+    st.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("job", help="job submission")
     jsub = s.add_subparsers(dest="job_cmd", required=True)

@@ -19,6 +19,9 @@ from ray_tpu.serve.grpc_proxy import ServeRpcClient
 from ray_tpu.serve.handle import (DeploymentHandle, DeploymentResponse,
                                   DeploymentResponseGenerator)
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.config_deploy import (deploy_config, import_application,
+                                         load_serve_config,
+                                         run_import_path)
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "shutdown",
@@ -26,5 +29,6 @@ __all__ = [
     "get_grpc_address", "DeploymentHandle", "DeploymentResponse",
     "DeploymentResponseGenerator", "ServeRpcClient", "batch", "multiplexed",
     "get_multiplexed_model_id", "AutoscalingConfig", "HTTPOptions",
-    "gRPCOptions",
+    "gRPCOptions", "deploy_config", "import_application",
+    "load_serve_config", "run_import_path",
 ]

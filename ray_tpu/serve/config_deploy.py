@@ -1,0 +1,133 @@
+"""Declarative Serve deployment: config files + import paths.
+
+Reference parity: the Serve CLI (`serve run module:app`,
+`serve deploy config.yaml`, `serve status` — python/ray/serve/scripts.py)
+and the multi-application config schema
+(serve/schema.py ServeDeploySchema, trimmed to the fields this stack
+uses):
+
+    proxy: true
+    applications:
+      - name: app1
+        route_prefix: /app1
+        import_path: my_module:app
+        deployments:              # per-deployment overrides (optional)
+          - name: Model
+            num_replicas: 2
+            max_ongoing_requests: 8
+
+`import_path` is "module:attr" where attr is an Application (the result
+of `.bind()`) or a Deployment (bound with no args).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.serve.deployment import Application, Deployment
+
+
+def _copy_graph(app: Application) -> Application:
+    """Fresh Application nodes for the whole graph: the imported object
+    lives on a sys.modules-cached module, so overrides applied in place
+    would leak into every later deploy of the same import_path."""
+    def visit(a: Application) -> Application:
+        new_args = tuple(visit(x) if isinstance(x, Application) else x
+                         for x in a.init_args)
+        new_kwargs = {k: (visit(v) if isinstance(v, Application) else v)
+                      for k, v in a.init_kwargs.items()}
+        return Application(deployment=a.deployment, init_args=new_args,
+                           init_kwargs=new_kwargs)
+    return visit(app)
+
+
+def import_application(import_path: str) -> Application:
+    mod_name, _, attr = import_path.partition(":")
+    if not attr:
+        raise ValueError(
+            f"import path {import_path!r} must be 'module:attribute'")
+    obj = importlib.import_module(mod_name)
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    if isinstance(obj, Deployment):
+        obj = obj.bind()
+    if not isinstance(obj, Application):
+        raise TypeError(f"{import_path!r} is {type(obj).__name__}, "
+                        f"expected an Application (call .bind()) or "
+                        f"Deployment")
+    return _copy_graph(obj)
+
+
+def _apply_overrides(app: Application,
+                     overrides: List[Dict[str, Any]]) -> Application:
+    """Per-deployment option overrides by deployment name (reference:
+    schema-driven option merging in serve/_private/deploy_utils.py)."""
+    by_name = {o["name"]: {k: v for k, v in o.items() if k != "name"}
+               for o in (overrides or [])}
+    if not by_name:
+        return app
+    flat = app.flatten()
+    unknown = set(by_name) - set(flat)
+    if unknown:
+        raise ValueError(f"config overrides unknown deployments: "
+                         f"{sorted(unknown)}; app has {sorted(flat)}")
+    for name, opts in by_name.items():
+        target = flat[name]
+        target.deployment = target.deployment.options(**opts)
+    return app
+
+
+def load_serve_config(path_or_dict) -> Dict[str, Any]:
+    if isinstance(path_or_dict, dict):
+        import copy
+        # deep copy: validation fills defaults into the nested app dicts
+        # and must not mutate the caller's config
+        cfg = copy.deepcopy(path_or_dict)
+    else:
+        import yaml
+        with open(path_or_dict) as f:
+            cfg = yaml.safe_load(f)
+    apps = cfg.get("applications")
+    if not apps:
+        raise ValueError("serve config needs a non-empty 'applications' "
+                         "list")
+    seen = set()
+    for a in apps:
+        if "import_path" not in a:
+            raise ValueError("every application needs an import_path")
+        name = a.setdefault("name", "default")
+        if name in seen:
+            raise ValueError(f"duplicate application name {name!r}")
+        seen.add(name)
+        a.setdefault("route_prefix", "/" if len(apps) == 1
+                     else f"/{name}")
+    return cfg
+
+
+def deploy_config(path_or_dict, *, _blocking: bool = True) -> List[str]:
+    """`serve deploy`: bring up every application in the config. Returns
+    the deployed application names."""
+    from ray_tpu import serve
+
+    cfg = load_serve_config(path_or_dict)
+    serve.start(proxy=bool(cfg.get("proxy", True)),
+                http_options=cfg.get("http_options"))
+    deployed = []
+    for a in cfg["applications"]:
+        app = import_application(a["import_path"])
+        app = _apply_overrides(app, a.get("deployments"))
+        serve.run(app, name=a["name"], route_prefix=a["route_prefix"],
+                  _blocking_until_ready=_blocking)
+        deployed.append(a["name"])
+    return deployed
+
+
+def run_import_path(import_path: str, *, name: str = "default",
+                    route_prefix: str = "/", proxy: bool = True):
+    """`serve run module:app` — single-application convenience."""
+    from ray_tpu import serve
+
+    serve.start(proxy=proxy)
+    app = import_application(import_path)
+    return serve.run(app, name=name, route_prefix=route_prefix)

@@ -1,0 +1,18 @@
+"""Importable serve applications for config-deploy tests."""
+
+from ray_tpu import serve
+
+
+@serve.deployment
+class Greeter:
+    def __init__(self, greeting="hello"):
+        self.greeting = greeting
+
+    def __call__(self, request):
+        return f"{self.greeting}:{request.path}"
+
+
+app = Greeter.bind("hi")
+
+# bare Deployment (config deploy must bind it)
+plain = Greeter

@@ -412,3 +412,101 @@ def test_websocket_echo_duplex(ray_mod):
     assert got[1] == (wsmod.OP_TEXT, "echo:one")
     assert got[2] == (wsmod.OP_TEXT, "bye")
     assert got[3][0] == wsmod.OP_CLOSE
+
+
+def test_config_deploy_and_run_import_path(ray_mod, tmp_path):
+    """Declarative deployment: serve deploy config.yaml + serve run
+    module:app (reference: serve/scripts.py + ServeDeploySchema)."""
+    import os
+    import sys
+    import urllib.request
+
+    import yaml
+
+    helpers = os.path.join(os.path.dirname(__file__), "helpers")
+    if helpers not in sys.path:
+        sys.path.insert(0, helpers)
+
+    cfg = {
+        "proxy": True,
+        "applications": [
+            {"name": "greet", "route_prefix": "/greet",
+             "import_path": "serve_apps:app",
+             "deployments": [{"name": "Greeter", "num_replicas": 2}]},
+            {"name": "plain", "route_prefix": "/plain",
+             "import_path": "serve_apps:plain"},
+        ],
+    }
+    path = tmp_path / "serve.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+
+    deployed = serve.deploy_config(str(path))
+    assert deployed == ["greet", "plain"]
+
+    st = serve.status()
+    assert "greet" in st and "plain" in st
+    # override applied: two replicas for the greet app's Greeter
+    h = serve.get_app_handle("greet")
+    assert h.remote(type("R", (), {"path": "/x"})()).result(
+        timeout=60) == "hi:/x"
+
+    deadline = time.time() + 30
+    body = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:8000/greet/yo", timeout=5) as r:
+                body = r.read().decode()
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert body == "hi:/yo", body
+
+    serve.delete("greet")
+    serve.delete("plain")
+
+    # serve run module:app
+    h2 = serve.run_import_path("serve_apps:app", name="runpath",
+                               route_prefix="/rp")
+    assert h2.remote(type("R", (), {"path": "/z"})()).result(
+        timeout=60) == "hi:/z"
+    serve.delete("runpath")
+
+
+def test_config_deploy_validation(tmp_path):
+    from ray_tpu.serve import load_serve_config
+
+    with pytest.raises(ValueError, match="applications"):
+        load_serve_config({})
+    with pytest.raises(ValueError, match="import_path"):
+        load_serve_config({"applications": [{"name": "x"}]})
+    with pytest.raises(ValueError, match="duplicate"):
+        load_serve_config({"applications": [
+            {"name": "a", "import_path": "m:x"},
+            {"name": "a", "import_path": "m:y"}]})
+    cfg = load_serve_config({"applications": [
+        {"import_path": "m:x"}]})
+    assert cfg["applications"][0]["route_prefix"] == "/"
+
+
+def test_config_overrides_do_not_leak_into_module(ray_mod, tmp_path):
+    """Overrides apply to a COPY of the imported graph: redeploying the
+    same import_path without overrides gets decorator defaults back."""
+    import os
+    import sys
+
+    helpers = os.path.join(os.path.dirname(__file__), "helpers")
+    if helpers not in sys.path:
+        sys.path.insert(0, helpers)
+    from ray_tpu.serve.config_deploy import (_apply_overrides,
+                                             import_application)
+
+    app1 = import_application("serve_apps:app")
+    _apply_overrides(app1, [{"name": "Greeter", "num_replicas": 5}])
+    assert app1.deployment.config.num_replicas == 5
+    app2 = import_application("serve_apps:app")
+    assert app2.deployment.config.num_replicas == 1  # default, not 5
+
+    cfg = {"applications": [{"import_path": "m:x"}]}
+    serve.load_serve_config(cfg)
+    assert "name" not in cfg["applications"][0]  # caller dict untouched
