@@ -282,6 +282,27 @@ class Dataset:
                 acc = agg.accumulate(acc, row)
         return agg.finalize(acc)
 
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of a column (reference: Dataset.unique) —
+        per-block dedup next to the data, union on the driver."""
+        def block_unique(block):
+            acc = BlockAccessor.for_block(block)
+            batch = acc.to_batch("numpy")
+            if column not in batch:
+                raise KeyError(f"no column {column!r}; have "
+                               f"{sorted(batch)}")
+            return set(np.asarray(batch[column]).tolist())
+
+        uniq = ray_tpu.remote(block_unique)
+        out: set = set()
+        for part in ray_tpu.get([uniq.remote(ref)
+                                 for ref, _m in self._execute()]):
+            out |= part
+        try:
+            return sorted(out)
+        except TypeError:  # mixed/unorderable types: stable repr order
+            return sorted(out, key=repr)
+
     def sum(self, on=None):
         return self._agg(Sum(on))
 

@@ -1,0 +1,69 @@
+"""Serializability inspection (reference: python/ray/util/check_serialize.py
+`inspect_serializability` — pinpoint WHICH member of an object fails to
+pickle instead of surfacing one opaque error from deep inside a task
+submission)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Set, Tuple
+
+
+def _try_pickle(obj: Any) -> Tuple[bool, str]:
+    from ray_tpu._private.serialization import get_serialization_context
+    try:
+        get_serialization_context().serialize(obj)
+        return True, ""
+    except Exception as e:  # noqa: BLE001 — reporting, not handling
+        return False, f"{type(e).__name__}: {e}"
+
+
+def inspect_serializability(obj: Any, name: str = "",
+                            _depth: int = 0,
+                            _seen: Set[int] = None,
+                            _failures: List[tuple] = None,
+                            print_report: bool = True):
+    """Recursively locate unserializable members.
+
+    Returns (ok, failures) where failures is a list of
+    (path, type_name, error) for every leaf that fails on its own.
+    """
+    name = name or type(obj).__name__
+    top = _failures is None
+    _seen = _seen if _seen is not None else set()
+    _failures = _failures if _failures is not None else []
+    ok, err = _try_pickle(obj)
+    if ok:
+        if top and print_report:
+            print(f"{name}: serializable")
+        return True, []
+    if id(obj) in _seen or _depth > 4:
+        return False, _failures
+    _seen.add(id(obj))
+
+    children: List[Tuple[str, Any]] = []
+    if hasattr(obj, "__dict__") and isinstance(getattr(obj, "__dict__"),
+                                               dict):
+        children += [(f"{name}.{k}", v) for k, v in vars(obj).items()]
+    if callable(obj) and getattr(obj, "__closure__", None):
+        names = obj.__code__.co_freevars
+        children += [(f"{name} closure '{n}'", c.cell_contents)
+                     for n, c in zip(names, obj.__closure__)]
+    if isinstance(obj, dict):
+        children += [(f"{name}[{k!r}]", v) for k, v in obj.items()]
+    elif isinstance(obj, (list, tuple, set)):
+        children += [(f"{name}[{i}]", v) for i, v in enumerate(obj)]
+
+    found_deeper = False
+    for child_name, child in children:
+        cok, _ = _try_pickle(child)
+        if not cok:
+            found_deeper = True
+            inspect_serializability(child, child_name, _depth + 1, _seen,
+                                    _failures, print_report=False)
+    if not found_deeper:
+        _failures.append((name, type(obj).__name__, err))
+    if top and print_report:
+        print(f"{name}: NOT serializable; culprits:")
+        for path, tname, e in _failures:
+            print(f"  {path} ({tname}): {e}")
+    return False, _failures

@@ -202,3 +202,34 @@ class TestClusterInfo:
         ray = ray_shared
         ns = ray.nodes()
         assert len(ns) == 1 and ns[0]["Alive"] and ns[0]["IsHead"]
+
+
+def test_inspect_serializability(ray_shared):
+    """Pinpoints the unserializable member (reference:
+    ray.util.inspect_serializability)."""
+    import threading
+
+    from ray_tpu.util.serialization_helpers import inspect_serializability
+
+    ok, failures = inspect_serializability({"x": 1}, print_report=False)
+    assert ok and failures == []
+
+    lock = threading.Lock()
+
+    class Holder:
+        def __init__(self):
+            self.fine = 42
+            self.bad = lock
+
+    ok, failures = inspect_serializability(Holder(), print_report=False)
+    assert not ok
+    assert any("bad" in path for path, _t, _e in failures), failures
+
+    captured = threading.Lock()
+
+    def closure_fn():
+        return captured
+
+    ok, failures = inspect_serializability(closure_fn, print_report=False)
+    assert not ok
+    assert any("captured" in path for path, _t, _e in failures), failures

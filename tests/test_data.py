@@ -428,3 +428,16 @@ def test_from_huggingface_respects_indices(ray_mod):
     hf = hfd.Dataset.from_dict({"a": list(range(10))}).select([1, 3, 5])
     ds = rd.from_huggingface(hf)
     assert sorted(r["a"] for r in ds.take_all()) == [1, 3, 5]
+
+
+def test_dataset_unique(ray_mod):
+    ds = rd.from_items([{"k": v} for v in (3, 1, 3, 2, 1)])
+    assert ds.unique("k") == [1, 2, 3]
+    # natural numeric order, not repr order
+    assert rd.from_items([{"k": v} for v in (10, 2, 1)]).unique("k") == [
+        1, 2, 10]
+    import pyarrow as pa
+    assert rd.from_arrow(pa.table({"s": ["b", "a", "b"]})).unique("s") == [
+        "a", "b"]
+    with pytest.raises(Exception):
+        ds.unique("missing")
