@@ -34,19 +34,23 @@ class TaskArg:
 
 @dataclass
 class SchedulingStrategy:
-    """DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP"""
+    """DEFAULT | SPREAD | NODE_AFFINITY | NODE_LABEL | PLACEMENT_GROUP"""
     kind: str = "DEFAULT"
     node_id: Optional[NodeID] = None
     soft: bool = False
     placement_group_id: Optional[PlacementGroupID] = None
     bundle_index: int = -1
     capture_child_tasks: bool = False
+    # NODE_LABEL: label -> list of allowed values (In semantics)
+    labels_hard: Optional[Dict[str, list]] = None
+    labels_soft: Optional[Dict[str, list]] = None
 
     def __reduce__(self):
         return (SchedulingStrategy,
                 (self.kind, self.node_id, self.soft,
                  self.placement_group_id, self.bundle_index,
-                 self.capture_child_tasks))
+                 self.capture_child_tasks, self.labels_hard,
+                 self.labels_soft))
 
 
 @dataclass
@@ -105,12 +109,21 @@ class TaskSpec:
 
     def scheduling_class(self) -> Tuple:
         """Tasks with the same class can reuse worker leases."""
+        def freeze(constraint):
+            if not constraint:
+                return None
+            return tuple(sorted((k, tuple(v))
+                                for k, v in constraint.items()))
         return (
             tuple(sorted(self.resources.items())),
             self.scheduling.kind,
             self.scheduling.node_id,
             self.scheduling.placement_group_id,
             self.scheduling.bundle_index,
+            # label constraints are part of the class: a lease on a node
+            # matching one constraint must not serve a different one
+            freeze(self.scheduling.labels_hard),
+            freeze(self.scheduling.labels_soft),
             self.env_hash(),
         )
 

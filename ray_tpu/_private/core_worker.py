@@ -1496,9 +1496,11 @@ class CoreWorker:
                     raylet_addr = reply["spillback"]
                     continue
                 if "infeasible" in reply:
-                    self._fail_queued_tasks(sched_class, exc.RayTpuSystemError(
+                    why = reply.get("why") or (
                         f"no node can satisfy resources "
-                        f"{sample_spec.resources}"))
+                        f"{sample_spec.resources}")
+                    self._fail_queued_tasks(
+                        sched_class, exc.RayTpuSystemError(why))
                     return
                 # retry
                 await asyncio.sleep(0.05)

@@ -233,12 +233,14 @@ class GcsServer:
             "available": info.resources_available,
             "total": info.resources_total,
             "address": info.address,
+            "labels": info.labels,
         })
 
     def _resource_view(self) -> dict:
         return {
             n.node_id: {"available": n.resources_available,
-                        "total": n.resources_total, "address": n.address}
+                        "total": n.resources_total, "address": n.address,
+                        "labels": n.labels}
             for n in self.nodes.values() if n.alive
         }
 

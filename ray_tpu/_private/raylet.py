@@ -481,7 +481,8 @@ class Raylet:
             if msg["node_id"] != self.node_id:
                 self.cluster_view[msg["node_id"]] = {
                     "available": msg["available"], "total": msg["total"],
-                    "address": msg.get("address", "")}
+                    "address": msg.get("address", ""),
+                    "labels": msg.get("labels", {})}
                 # A peer freeing resources may unblock queued lease
                 # requests via spillback.
                 self._try_dispatch()
@@ -768,6 +769,34 @@ class Raylet:
                     return {"spillback": view["address"]}
                 if not spec.scheduling.soft:
                     return {"infeasible": True}
+        elif pg_key is None and spec.scheduling.kind == "NODE_LABEL":
+            # Label-constrained placement (reference:
+            # NodeLabelSchedulingStrategy): hard must match the executing
+            # node; soft prefers matching nodes among the eligible;
+            # availability outranks soft preference (a preference must
+            # not route onto a saturated node past an idle eligible one).
+            hard = spec.scheduling.labels_hard or {}
+            soft = spec.scheduling.labels_soft or {}
+            local_ok = (_labels_match(self.labels, hard)
+                        and self.pool.feasible(spec.resources))
+            local_soft = local_ok and (not soft
+                                       or _labels_match(self.labels, soft))
+            if not local_soft:
+                target = self._label_spill_target(
+                    spec.resources, hard, soft,
+                    # a feasible local node only yields to a peer that is
+                    # BOTH soft-matching and immediately available
+                    need_beat_local=local_ok)
+                if target is not None:
+                    return {"spillback": target}
+            if not local_ok:
+                if self._autoscaler_active:
+                    pass  # queue: demand heartbeat lets a labeled node spawn
+                else:
+                    return {"infeasible": True,
+                            "why": (f"no node satisfies label constraints "
+                                    f"hard={hard} (and resources "
+                                    f"{spec.resources})")}
 
         fut = asyncio.get_running_loop().create_future()
         self._pending_leases.append((spec, pg_key, fut))
@@ -781,6 +810,39 @@ class Raylet:
             except ValueError:
                 pass
             return {"retry": True}
+
+    def _label_spill_target(self, resources: dict, hard: dict, soft: dict,
+                            need_beat_local: bool = False):
+        """Best peer for a label-constrained request, or None.
+
+        Ranking (higher wins): soft-matching AND available(4) >
+        hard-only available(3) > soft-matching feasible-by-totals(2) >
+        hard-only feasible(1). With need_beat_local (the local node can
+        already run it), only rank-4 peers justify a hop."""
+        def fits(view, key):
+            caps = view.get(key, {})
+            return all(caps.get(k, 0) >= v
+                       for k, v in resources.items() if v > 0)
+
+        best_rank, best_addr = 0, None
+        for _nid, view in self.cluster_view.items():
+            if not view.get("address"):
+                continue
+            labels = view.get("labels", {})
+            if not _labels_match(labels, hard):
+                continue
+            soft_ok = bool(soft) and _labels_match(labels, soft)
+            if fits(view, "available"):
+                rank = 4 if soft_ok else 3
+            elif fits(view, "total"):
+                rank = 2 if soft_ok else 1
+            else:
+                continue
+            if rank > best_rank:
+                best_rank, best_addr = rank, view["address"]
+        if need_beat_local and best_rank < 4:
+            return None
+        return best_addr
 
     def _try_dispatch(self):
         if not self._pending_leases:
@@ -1123,3 +1185,8 @@ class Raylet:
             except MemoryError:
                 raise
         return False
+
+
+def _labels_match(labels: dict, constraint: dict) -> bool:
+    """Every constrained label must be present with an allowed value."""
+    return all(labels.get(k) in v for k, v in constraint.items())

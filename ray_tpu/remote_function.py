@@ -21,10 +21,18 @@ def _resolve_scheduling(options: dict) -> SchedulingStrategy:
         return SchedulingStrategy(kind="SPREAD")
     # Strategy objects from ray_tpu.util.scheduling_strategies
     from ray_tpu.util.scheduling_strategies import (
-        NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+        NodeAffinitySchedulingStrategy, NodeLabelSchedulingStrategy,
+        PlacementGroupSchedulingStrategy)
     if isinstance(strategy, NodeAffinitySchedulingStrategy):
         return SchedulingStrategy(kind="NODE_AFFINITY", node_id=strategy.node_id,
                                   soft=strategy.soft)
+    if isinstance(strategy, NodeLabelSchedulingStrategy):
+        def norm(c):
+            return {k: ([v] if isinstance(v, str) else list(v))
+                    for k, v in (c or {}).items()}
+        return SchedulingStrategy(kind="NODE_LABEL",
+                                  labels_hard=norm(strategy.hard),
+                                  labels_soft=norm(strategy.soft))
     if isinstance(strategy, PlacementGroupSchedulingStrategy):
         pg = strategy.placement_group
         return SchedulingStrategy(

@@ -19,6 +19,24 @@ class NodeAffinitySchedulingStrategy:
 
 
 @dataclass
+class NodeLabelSchedulingStrategy:
+    """Label-constrained placement (reference: scheduling_strategies.py
+    NodeLabelSchedulingStrategy). `hard` must match for a node to be
+    eligible; `soft` expresses preference among eligible nodes. Values
+    are a string or a list of allowed strings (In semantics)."""
+
+    hard: dict
+    soft: Optional[dict] = None
+
+    def __post_init__(self):
+        for name, constraint in (("hard", self.hard),
+                                 ("soft", self.soft or {})):
+            if not isinstance(constraint, dict):
+                raise TypeError(f"{name} must be a dict of "
+                                f"label -> value(s)")
+
+
+@dataclass
 class PlacementGroupSchedulingStrategy:
     placement_group: "object"
     placement_group_bundle_index: int = -1

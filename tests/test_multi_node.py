@@ -242,3 +242,49 @@ def test_cluster_resources_aggregate(ray_cluster):
     total = ray_tpu.cluster_resources()
     assert total.get("CPU", 0) == 5.0  # 2 head + 3
     assert total.get("extra", 0) == 5.0
+
+
+def test_node_label_scheduling(ray_cluster):
+    """NodeLabelSchedulingStrategy: hard constraints route to matching
+    nodes (spillback through the label-aware cluster view); soft prefers
+    matches among eligible nodes; impossible hard labels fail fast
+    (reference: util/scheduling_strategies.py NodeLabelSchedulingStrategy)."""
+    east = ray_cluster.add_node(num_cpus=1,
+                                labels={"region": "east", "disk": "ssd"})
+    west = ray_cluster.add_node(num_cpus=1,
+                                labels={"region": "west", "disk": "hdd"})
+    ray_cluster.connect()
+    import ray_tpu
+    from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+    ray_cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def where():
+        return _current_node_id()
+
+    # hard: must land on the east node (driver submits via the head)
+    got = ray_tpu.get(where.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"region": "east"})).remote(), timeout=60)
+    assert got == east.node_id.hex()
+
+    # hard list + soft preference: both nodes match hard; soft picks hdd
+    got = ray_tpu.get(where.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"region": ["east", "west"]},
+            soft={"disk": "hdd"})).remote(), timeout=60)
+    assert got == west.node_id.hex()
+
+    # impossible hard constraint: fails fast with a label-specific error
+    with pytest.raises(Exception, match="label constraints"):
+        ray_tpu.get(where.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"region": "mars"})).remote(), timeout=30)
+
+    # labels match a node whose RESOURCES can't fit: fails fast too
+    # (feasibility is part of the label branch, not an infinite queue)
+    with pytest.raises(Exception, match="label constraints"):
+        ray_tpu.get(where.options(
+            num_cpus=64,
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"region": "east"})).remote(), timeout=30)
