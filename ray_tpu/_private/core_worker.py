@@ -16,6 +16,7 @@ runs on a background thread with a thread-safe sync facade.
 from __future__ import annotations
 
 import asyncio
+import copy
 import logging
 import os
 import threading
@@ -80,6 +81,13 @@ class PendingTask:
     returns: List[ObjectID] = field(default_factory=list)
     # Holding real ObjectRefs pins arg objects (refcount) until completion.
     arg_refs: List[ObjectRef] = field(default_factory=list)
+    # Handoff credits granted when the task's inline args were serialized
+    # (self-owned refs contained in arg values). Cleared when the spec
+    # actually ships to an executor (the receiver's deserialization
+    # consumes them); returned via _return_handoff_credits if the spec is
+    # discarded unshipped (cancel/queue-failure) — otherwise the contained
+    # objects stay pinned forever (ADVICE r4).
+    arg_credits: List[ObjectID] = field(default_factory=list)
 
 
 @dataclass
@@ -222,7 +230,8 @@ class CoreWorker:
         self.serialization = SerializationContext()
         self.serialization.deserialized_ref_factory = self._make_borrowed_ref
         from ray_tpu._private.serialization import _set_handoff_credit_cb
-        _set_handoff_credit_cb(self._grant_handoff_credit)
+        _set_handoff_credit_cb(self._grant_handoff_credit,
+                               self._return_handoff_credits)
 
         # object state
         self.owned: Dict[ObjectID, OwnedObject] = {}
@@ -612,6 +621,36 @@ class CoreWorker:
             ent.borrowers += 1
             ent.handoff_credits += 1
             return True
+
+    def _return_handoff_credits(self, ids):
+        """Return handoff credits for serialized bytes that will never be
+        deserialized by a receiver (arg-probe discard, cancel before
+        dispatch, queued-task failure, failed actor registration).
+
+        Thread-safe: the decrement runs under the ref lock; any resulting
+        free is posted to the loop when called from a user thread."""
+        if not ids:
+            return
+        followups = []
+        with self._ref_lock:
+            for oid in ids:
+                ent = self.owned.get(oid)
+                if ent is not None and ent.handoff_credits > 0:
+                    ent.handoff_credits -= 1
+                    ent.borrowers -= 1
+                    if ent.local_refs <= 0 and ent.borrowers <= 0:
+                        followups.append(oid)
+        if not followups:
+            return
+        try:
+            on_loop = asyncio.get_running_loop() is self.loop
+        except RuntimeError:
+            on_loop = False
+        for oid in followups:
+            if on_loop:
+                self._schedule_free(oid)
+            else:
+                self._post_to_loop(self._schedule_free, oid)
 
     def _make_borrowed_ref(self, object_id: ObjectID, owner_address: str,
                            credited: bool = False):
@@ -1138,29 +1177,42 @@ class CoreWorker:
         return func
 
     async def _build_args(self, args: tuple, kwargs: dict
-                          ) -> Tuple[List[TaskArg], List[str], List[ObjectRef]]:
-        """-> (task_args, kw_names, pin_refs). pin_refs holds the refs
-        created here for large inlined-to-plasma args; the CALLER must keep
-        them alive (e.g. in PendingTask.arg_refs) until the task completes,
-        or the refcounter frees the objects before the worker fetches them."""
+                          ) -> Tuple[List[TaskArg], List[str],
+                                     List[ObjectRef], List[ObjectID]]:
+        """-> (task_args, kw_names, pin_refs, credits). pin_refs holds the
+        refs created here for large inlined-to-plasma args; the CALLER must
+        keep them alive (e.g. in PendingTask.arg_refs) until the task
+        completes, or the refcounter frees the objects before the worker
+        fetches them. `credits` are the handoff credits granted while
+        serializing inline args — track them with the spec and return them
+        if the bytes are discarded unshipped."""
         task_args: List[TaskArg] = []
         kw_names: List[str] = []
         pin_refs: List[ObjectRef] = []
-        for v in list(args) + list(kwargs.values()):
-            if isinstance(v, ObjectRef):
-                task_args.append(TaskArg(ARG_REF, object_id=v.id,
-                                         owner_address=v.owner_address or self.address))
-            else:
-                ser = self.serialization.serialize(v)
-                if ser.total_size > self.config.max_direct_call_object_size:
-                    ref = await self.put_async(v)
-                    pin_refs.append(ref)
-                    task_args.append(TaskArg(ARG_REF, object_id=ref.id,
-                                             owner_address=self.address))
+        credits: List[ObjectID] = []
+        try:
+            for v in list(args) + list(kwargs.values()):
+                if isinstance(v, ObjectRef):
+                    task_args.append(TaskArg(ARG_REF, object_id=v.id,
+                                             owner_address=v.owner_address or self.address))
                 else:
-                    task_args.append(TaskArg(ARG_INLINE, data=ser.to_bytes()))
+                    ser = self.serialization.serialize(v)
+                    if ser.total_size > self.config.max_direct_call_object_size:
+                        ref = await self.put_async(v)
+                        pin_refs.append(ref)
+                        task_args.append(TaskArg(ARG_REF, object_id=ref.id,
+                                                 owner_address=self.address))
+                    else:
+                        credits.extend(ser.credited_ids)
+                        task_args.append(TaskArg(ARG_INLINE,
+                                                 data=ser.to_bytes()))
+        except Exception:
+            # A later arg failed to serialize: the earlier args' bytes are
+            # dead — return their credits before propagating.
+            self._return_handoff_credits(credits)
+            raise
         kw_names = list(kwargs.keys())
-        return task_args, kw_names, pin_refs
+        return task_args, kw_names, pin_refs, credits
 
     async def submit_task(self, function_id: str, args: tuple, kwargs: dict,
                           **opts) -> List[ObjectRef]:
@@ -1235,19 +1287,30 @@ class CoreWorker:
 
         Serializing on the CALLER thread keeps the loop free and preserves
         .remote() copy-on-submit semantics without a cross-thread round trip.
-        """
+        On abort (an arg needs plasma, or serialization fails) the credits
+        granted by the probe serializations are returned — the probe's
+        bytes are discarded and _build_args re-serializes from scratch
+        (ADVICE r4: the probe credit leaked, pinning contained refs)."""
         task_args: List[TaskArg] = []
-        for v in list(args) + list(kwargs.values()):
-            if isinstance(v, ObjectRef):
-                task_args.append(TaskArg(
-                    ARG_REF, object_id=v.id,
-                    owner_address=v.owner_address or self.address))
-            else:
-                ser = self.serialization.serialize(v)
-                if ser.total_size > self.config.max_direct_call_object_size:
-                    return None  # needs async plasma put; use the loop path
-                task_args.append(TaskArg(ARG_INLINE, data=ser.to_bytes()))
-        return task_args, list(kwargs.keys()), []
+        credits: List[ObjectID] = []
+        try:
+            for v in list(args) + list(kwargs.values()):
+                if isinstance(v, ObjectRef):
+                    task_args.append(TaskArg(
+                        ARG_REF, object_id=v.id,
+                        owner_address=v.owner_address or self.address))
+                else:
+                    ser = self.serialization.serialize(v)
+                    if ser.total_size > self.config.max_direct_call_object_size:
+                        credits.extend(ser.credited_ids)
+                        self._return_handoff_credits(credits)
+                        return None  # needs async plasma put; loop path
+                    credits.extend(ser.credited_ids)
+                    task_args.append(TaskArg(ARG_INLINE, data=ser.to_bytes()))
+        except Exception:
+            self._return_handoff_credits(credits)
+            raise
+        return task_args, list(kwargs.keys()), [], credits
 
     def submit_task_threadsafe(self, function_id: str, args: tuple,
                                kwargs: dict, *, name: str = "",
@@ -1364,21 +1427,25 @@ class CoreWorker:
                                       export=None, prebuilt=None):
         try:
             await self._await_export(export, spec.function_id)
-            task_args, kw_names, pin_refs = (
+            task_args, kw_names, pin_refs, credits = (
                 prebuilt if prebuilt is not None
                 else await self._build_args(args, kwargs))
         except Exception as e:
+            if prebuilt is not None:
+                self._return_handoff_credits(prebuilt[3])
             self._complete_task_error(spec, e, retry=False)
             return
         if spec.task_id not in self.pending_tasks:
+            self._return_handoff_credits(credits)
             return  # cancelled before dispatch
         spec.args = task_args
         if kw_names:
             spec.kwarg_names = tuple(kw_names)
         if spec.runtime_env:
             spec.runtime_env = await self.prepare_runtime_env(spec.runtime_env)
-        self.pending_tasks[spec.task_id].arg_refs = (
-            self._pin_arg_refs(spec) + pin_refs)
+        pt = self.pending_tasks[spec.task_id]
+        pt.arg_refs = self._pin_arg_refs(spec) + pin_refs
+        pt.arg_credits = credits
         await self._submit_to_cluster(spec)
 
     def _pin_arg_refs(self, spec: TaskSpec) -> List[ObjectRef]:
@@ -1527,6 +1594,12 @@ class CoreWorker:
         layer's write coalescing still collapses them into one syscall."""
         for spec in specs:
             self._record_task_event(spec, "RUNNING")
+            # The receiver deserializes the inline args: that consumes the
+            # handoff credits (owner_add_borrower handoff=True), so they
+            # are no longer ours to return on later failure paths.
+            pt = self.pending_tasks.get(spec.task_id)
+            if pt is not None:
+                pt.arg_credits = []
         t_push = time.monotonic()
         try:
             if len(specs) == 1:
@@ -1753,7 +1826,13 @@ class CoreWorker:
 
     def _complete_task_error(self, spec: TaskSpec, error: Exception,
                              retry: bool):
-        self.pending_tasks.pop(spec.task_id, None)
+        pt = self.pending_tasks.pop(spec.task_id, None)
+        if pt is not None and pt.arg_credits:
+            # Spec died before its arg bytes ever shipped (queue failure,
+            # cancel, export error): return the serialize-time credits or
+            # the contained objects stay pinned forever (ADVICE r4).
+            self._return_handoff_credits(pt.arg_credits)
+            pt.arg_credits = []
         self._record_task_event(spec, "FAILED")
         stream = self.generator_streams.get(spec.task_id)
         if stream is not None:
@@ -1856,9 +1935,10 @@ class CoreWorker:
                                      spec: TaskSpec, args, kwargs,
                                      lifetime: str, export=None,
                                      prebuilt=None):
+        credits: List[ObjectID] = list(prebuilt[3]) if prebuilt else []
         try:
             await self._await_export(export, spec.function_id)
-            task_args, kw_names, pin_refs = (
+            task_args, kw_names, pin_refs, credits = (
                 prebuilt if prebuilt is not None
                 else await self._build_args(args, kwargs))
             spec.args = task_args
@@ -1873,6 +1953,9 @@ class CoreWorker:
                 self._pin_arg_refs(spec) + pin_refs
             await self.gcs.request("register_actor", {"spec": spec})
         except Exception as e:
+            # Spec never reached an executor: its inline-arg credits would
+            # pin the contained objects forever.
+            self._return_handoff_credits(credits)
             q.set_state("DEAD", reason=f"actor registration failed: {e!r}")
             raise
 
@@ -2001,11 +2084,13 @@ class CoreWorker:
             # dispatches the reply. Failures fall back to the retry loop.
             pt = self.pending_tasks.get(spec.task_id)
             if pt is None:
+                self._return_handoff_credits(prebuilt[3])
                 return  # cancelled before dispatch
-            task_args, kw_names, pin_refs = prebuilt
+            task_args, kw_names, pin_refs, credits = prebuilt
             spec.args = task_args
             spec.kwarg_names = tuple(kw_names)
             pt.arg_refs = self._pin_arg_refs(spec) + pin_refs
+            pt.arg_credits = credits
             self._enqueue_actor_push(q, spec, None)
             return
         asyncio.ensure_future(
@@ -2016,7 +2101,7 @@ class CoreWorker:
                                             spec: TaskSpec, args, kwargs,
                                             prebuilt=None):
         try:
-            task_args, kw_names, pin_refs = (
+            task_args, kw_names, pin_refs, credits = (
                 prebuilt if prebuilt is not None
                 else await self._build_args(args, kwargs))
         except Exception as e:
@@ -2031,11 +2116,13 @@ class CoreWorker:
             await self._submit_actor_task(q, spec)
             return
         if spec.task_id not in self.pending_tasks:
+            self._return_handoff_credits(credits)
             return  # cancelled before dispatch
         spec.args = task_args
         spec.kwarg_names = tuple(kw_names)
-        self.pending_tasks[spec.task_id].arg_refs = (
-            self._pin_arg_refs(spec) + pin_refs)
+        pt = self.pending_tasks[spec.task_id]
+        pt.arg_refs = self._pin_arg_refs(spec) + pin_refs
+        pt.arg_credits = credits
         await self._submit_actor_task(q, spec)
 
     def _ensure_actor_queue(self, actor_id: ActorID) -> ActorSubmitQueue:
@@ -2093,6 +2180,14 @@ class CoreWorker:
                     # (restart or death) then retry/fail.
                     if q.address == address and q.epoch == epoch:
                         q.set_state("RESTARTING")
+                    if spec.method_name == SEQ_SKIP_METHOD:
+                        # The marker's task is already completed (it has no
+                        # pending entry) but the slot it fills is load-
+                        # bearing: dropping it would hang every later call
+                        # from this caller. Keep retrying until the actor
+                        # state resolves.
+                        await q.wait_for_change()
+                        continue
                     pt = self.pending_tasks.get(spec.task_id)
                     if pt is None:
                         return
@@ -2193,6 +2288,12 @@ class CoreWorker:
             return
         address = q.address
         epoch = q.epoch
+        for spec, _fut in live:
+            # Shipping: the receiver's arg deserialization consumes the
+            # handoff credits from here on.
+            pt = self.pending_tasks.get(spec.task_id)
+            if pt is not None:
+                pt.arg_credits = []
         try:
             if len(live) == 1:
                 replies = [await self.clients.request(
@@ -2210,12 +2311,49 @@ class CoreWorker:
                 # Connection-level failure with no fresh state from the GCS
                 # yet: park the queue so retry loops wait for the verdict.
                 q.set_state("RESTARTING")
+            if not conn_lost and len(live) > 1:
+                # Frame-level reply failure: one spec's reply can poison
+                # the whole batch (ADVICE r4). Isolate by re-pushing each
+                # spec as its OWN RPC so only the culprit fails. The tasks
+                # may have EXECUTED (only the reply was lost), so a
+                # re-push is a re-execution: it must honor at-most-once —
+                # specs with no retries left fail instead (their seq slot
+                # is filled with a SEQ_SKIP marker to keep batch-mates
+                # and later calls live). The seq gate tolerates replayed
+                # seqs (cursor never regresses).
+                repush: List[tuple] = []
+                for spec, fut in live:
+                    if fut is not None:
+                        # Slow path: its retry loop owns the accounting.
+                        self._bounce_push(q, spec, fut, err, attempted=True)
+                        continue
+                    pt = self.pending_tasks.get(spec.task_id)
+                    if pt is None:
+                        q.inflight.pop(spec.seq_no, None)
+                        continue
+                    if pt.retries_left == 0:
+                        self._fail_and_fill_seq(q, spec, exc.ActorDiedError(
+                            q.actor_id,
+                            "reply lost for a batched actor call "
+                            "(max_task_retries=0 forbids re-execution)"))
+                        continue
+                    if pt.retries_left > 0:
+                        pt.retries_left -= 1
+                    repush.append((spec, fut))
+                if repush:
+                    # ONE coroutine, seq order: concurrent re-pushes of
+                    # replayed seqs would bypass the receiver's start gate
+                    # (replays are <= the cursor) and could interleave out
+                    # of order on a serial actor.
+                    repush.sort(key=lambda it: it[0].seq_no)
+                    asyncio.ensure_future(self._repush_sequentially(
+                        q, repush, address, epoch))
+                return
             for spec, fut in live:
                 if fut is None and not conn_lost:
                     # Non-connection failure (e.g. a reply the handler could
                     # not produce): deterministic — retrying would hot-loop.
-                    q.inflight.pop(spec.seq_no, None)
-                    self._complete_task_error(spec, err, retry=False)
+                    self._fail_and_fill_seq(q, spec, err)
                 else:
                     # The request was sent: the worker may have executed it.
                     self._bounce_push(q, spec, fut, err, attempted=True)
@@ -2231,6 +2369,67 @@ class CoreWorker:
                 self._handle_task_reply(spec, reply, "")
             except Exception:
                 logger.exception("actor task reply dispatch failed")
+
+    def _fail_and_fill_seq(self, q: ActorSubmitQueue, spec: TaskSpec,
+                           error: Exception):
+        """Fail one actor task AND fill its reserved seq slot.
+
+        The receiver gates task start on contiguous per-caller seq
+        numbers: completing a spec with an error without its seq ever
+        reaching the actor leaves a gap that hangs every later call from
+        this caller. Ship a SEQ_SKIP no-op marker occupying the slot
+        (same invariant as the failed-arg-serialization path). If the
+        worker already saw the original seq, the marker replay is benign
+        (the seq cursor never regresses)."""
+        q.inflight.pop(spec.seq_no, None)
+        self._complete_task_error(spec, error, retry=False)
+        marker = copy.copy(spec)
+        marker.method_name = SEQ_SKIP_METHOD
+        marker.args = []
+        marker.kwarg_names = ()
+        q.inflight[marker.seq_no] = marker
+        asyncio.ensure_future(self._submit_actor_task(q, marker))
+
+    async def _repush_sequentially(self, q: ActorSubmitQueue, items,
+                                   address: str, epoch: int):
+        for spec, fut in items:
+            await self._repush_single(q, spec, fut, address, epoch)
+
+    async def _repush_single(self, q: ActorSubmitQueue, spec: TaskSpec,
+                             fut: Optional[asyncio.Future], address: str,
+                             epoch: int):
+        """Re-push ONE spec of a failed batch frame as its own RPC.
+
+        Isolation fallback (ADVICE r4): only the spec whose reply genuinely
+        cannot be produced fails; its batch-mates complete normally. The
+        caller has already consumed one retry (the original frame may have
+        executed)."""
+        try:
+            reply = await self.clients.request(
+                address, "push_actor_task", {"spec": spec}, timeout=None)
+        except Exception as e:  # noqa: BLE001
+            err = e if isinstance(e, rpc.RpcError) else rpc.RpcError(str(e))
+            conn_lost = isinstance(e, rpc.ConnectionLost)
+            if conn_lost and q.address == address \
+                    and q.epoch == epoch and q.state == "ALIVE":
+                q.set_state("RESTARTING")
+            if fut is None and not conn_lost:
+                # Deterministic failure even alone: the reply for THIS
+                # spec cannot be produced. Fail it but keep the caller's
+                # seq stream contiguous.
+                self._fail_and_fill_seq(q, spec, err)
+            else:
+                self._bounce_push(q, spec, fut, err, attempted=True)
+            return
+        if fut is not None:
+            if not fut.done():
+                fut.set_result(reply)
+            return
+        q.inflight.pop(spec.seq_no, None)
+        try:
+            self._handle_task_reply(spec, reply, "")
+        except Exception:
+            logger.exception("actor task reply dispatch failed")
 
     async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         await self.gcs.request("kill_actor", {"actor_id": actor_id,
@@ -2336,7 +2535,7 @@ class CoreWorker:
                     err = exc.TaskError(e, tb_str, spec.task_id, os.getpid())
                     returns = await self._store_returns(
                         spec, [err] * spec.num_returns, is_exception=True)
-                    replies[i] = {"app_error": err, "returns": returns}
+                    replies[i] = self._app_error_envelope(err, returns)
             except Exception as e:  # noqa: BLE001 — e.g. bad num_returns
                 replies[i] = {"system_error": f"{type(e).__name__}: {e}"}
             finally:
@@ -2344,6 +2543,24 @@ class CoreWorker:
                 # a task that already started).
                 self._cancelled_tasks.discard(spec.task_id)
         self.current_task_id = None
+
+    def _app_error_envelope(self, err, returns) -> dict:
+        """Reply envelope for an application error, guaranteed picklable.
+
+        The rpc layer pickles replies with plain pickle: an unpicklable
+        user exception would fail the WHOLE reply (and for batched frames,
+        poison every batch-mate — ADVICE r4). Probe the error alone
+        (returns entries are already serialized bytes) and degrade to a
+        picklable placeholder that still carries `app_error` so the
+        caller's retry_exceptions handling keeps working."""
+        import pickle as _pickle
+        try:
+            _pickle.dumps(err, protocol=5)
+        except Exception as e:  # noqa: BLE001
+            err = exc.RayTpuError(
+                f"unpicklable task error {type(getattr(err, 'cause', err)).__name__}: "
+                f"{err}"[:4096])
+        return {"app_error": err, "returns": returns}
 
     async def _rpc_push_task_batch(self, conn, payload):
         """Execute a batch sequentially; one reply list for all. Per-spec
@@ -2365,21 +2582,34 @@ class CoreWorker:
             sync_jobs.clear()
             await self._run_sync_jobs(jobs, replies)
 
+        # Applying a spec's runtime env mutates PROCESS-WIDE state (chdir,
+        # sys.path, pip venv): queued sync jobs from earlier specs must run
+        # BEFORE a different env is applied, or they execute under the
+        # later spec's env (ADVICE r4 — caller-side scheduling-class
+        # homogeneity makes mixed-env batches unlikely, but the handler
+        # must enforce it itself).
+        current_env_key: Any = ()
+
         async with self._task_exec_lock:
             for i, spec in enumerate(specs):
                 # Mirror _push_task_locked's prep + error envelope.
                 try:
+                    env_key = (repr(sorted(spec.runtime_env.items()))
+                               if spec.runtime_env else None)
+                    if env_key != current_env_key:
+                        await flush_jobs()
+                        current_env_key = env_key
                     await self._ensure_runtime_env(spec.runtime_env)
                     func = await self._load_function(spec.function_id)
                     args, kwargs = await self._resolve_task_args(spec)
                 except _DependencyError as e:
-                    replies[i] = {"app_error": e.error, "returns": None}
+                    replies[i] = self._app_error_envelope(e.error, None)
                     continue
                 except exc.RuntimeEnvSetupError as e:
                     err = exc.TaskError(e, str(e), spec.task_id, os.getpid())
                     returns = await self._store_returns(
                         spec, [err] * spec.num_returns, is_exception=True)
-                    replies[i] = {"app_error": err, "returns": returns}
+                    replies[i] = self._app_error_envelope(err, returns)
                     continue
                 except Exception as e:  # noqa: BLE001
                     replies[i] = {"system_error": f"{type(e).__name__}: {e}"}
@@ -2411,12 +2641,12 @@ class CoreWorker:
             func = await self._load_function(spec.function_id)
             args, kwargs = await self._resolve_task_args(spec)
         except _DependencyError as e:
-            return {"app_error": e.error, "returns": None}
+            return self._app_error_envelope(e.error, None)
         except exc.RuntimeEnvSetupError as e:
             err = exc.TaskError(e, str(e), spec.task_id, os.getpid())
             returns = await self._store_returns(
                 spec, [err] * spec.num_returns, is_exception=True)
-            return {"app_error": err, "returns": returns}
+            return self._app_error_envelope(err, returns)
         except Exception as e:  # noqa: BLE001
             return {"system_error": f"{type(e).__name__}: {e}"}
         span = self._maybe_start_span(spec)
@@ -2447,7 +2677,7 @@ class CoreWorker:
                                 _os.getpid())
             returns = await self._store_returns(
                 spec, [err] * spec.num_returns, is_exception=True)
-            return {"app_error": err, "returns": returns}
+            return self._app_error_envelope(err, returns)
         finally:
             self._finish_span(span)
             self._running_tasks.pop(spec.task_id, None)
@@ -2637,10 +2867,15 @@ class CoreWorker:
         gate and semaphore impose the actual ordering)."""
         specs = payload["specs"]
         if self._can_batch_execute(specs):
-            return await self._execute_actor_batch(specs)
-        return list(await asyncio.gather(*[
-            self._rpc_push_actor_task(conn, {"spec": s})
-            for s in specs]))
+            replies = await self._execute_actor_batch(specs)
+        else:
+            replies = list(await asyncio.gather(*[
+                self._rpc_push_actor_task(conn, {"spec": s})
+                for s in specs]))
+        # Reply picklability is guaranteed per-entry at envelope-build time
+        # (_app_error_envelope): one task's unpicklable error can no longer
+        # poison the frame for its batch-mates (ADVICE r4).
+        return replies
 
     async def _gate_actor_seq(self, spec: TaskSpec):
         """Per-caller in-order start gate (reference:
@@ -2659,7 +2894,11 @@ class CoreWorker:
             fut = asyncio.get_running_loop().create_future()
             buf[spec.seq_no] = fut
             await fut
-        self._caller_next_seq[caller] = spec.seq_no + 1
+        # max(): a REPLAYED seq (client re-push after a frame-level reply
+        # failure — the task may have already run here) must not regress
+        # the cursor, or every later seq buffers forever (liveness).
+        self._caller_next_seq[caller] = max(
+            self._caller_next_seq.get(caller, 0), spec.seq_no + 1)
         buf = self._caller_buffer.get(caller, {})
         nxt = buf.pop(spec.seq_no + 1, None)
         if nxt is not None and not nxt.done():
@@ -2705,7 +2944,7 @@ class CoreWorker:
             try:
                 args, kwargs = await self._resolve_task_args(spec)
             except _DependencyError as e:
-                replies[i] = {"app_error": e.error, "returns": None}
+                replies[i] = self._app_error_envelope(e.error, None)
                 continue
             except Exception as e:  # noqa: BLE001
                 replies[i] = {"system_error": f"{type(e).__name__}: {e}"}
@@ -2758,7 +2997,7 @@ class CoreWorker:
                 returns = await self._store_returns(spec, values)
                 return {"returns": returns}
             except _DependencyError as e:
-                return {"app_error": e.error, "returns": None}
+                return self._app_error_envelope(e.error, None)
             except asyncio.CancelledError:
                 return {"cancelled": True}
             except Exception as e:  # noqa: BLE001
@@ -2767,7 +3006,7 @@ class CoreWorker:
                                     _os.getpid())
                 returns = await self._store_returns(
                     spec, [err] * spec.num_returns, is_exception=True)
-                return {"app_error": err, "returns": returns}
+                return self._app_error_envelope(err, returns)
             finally:
                 self._finish_span(span)
                 self._running_tasks.pop(spec.task_id, None)

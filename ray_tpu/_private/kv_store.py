@@ -15,20 +15,29 @@ Point the head at it:  RAY_TPU_GCS_STORAGE_ADDRESS=host:6379 ray_tpu start --hea
 from __future__ import annotations
 
 import asyncio
+import base64
 import logging
 import os
-import re
 from typing import Dict, Optional
 
 from ray_tpu._private import rpc
 
 logger = logging.getLogger(__name__)
 
-_SAFE_KEY = re.compile(r"[^A-Za-z0-9._-]")
-
 
 def _key_path(root: str, key: str) -> str:
-    return os.path.join(root, _SAFE_KEY.sub("_", key) + ".kv")
+    # Collision-free filename encoding (ADVICE r4: lossy sanitization
+    # mapped distinct keys like 'a:b' and 'a_b' onto the same file, so one
+    # persisted value silently clobbered the other). Long keys hash to a
+    # fixed-width digest — base64 inflates 4/3 and would hit the 255-byte
+    # filename limit for keys the old scheme persisted fine; the filename
+    # need not be reversible (the real key is stored inside the file).
+    kb = key.encode()
+    name = base64.urlsafe_b64encode(kb).decode().rstrip("=")
+    if len(name) > 180:
+        import hashlib
+        name = "h_" + hashlib.sha256(kb).hexdigest()
+    return os.path.join(root, name + ".kv")
 
 
 class KVStoreServer:
@@ -48,14 +57,40 @@ class KVStoreServer:
             self._load()
 
     def _load(self):
+        legacy: list = []
         for name in os.listdir(self.data_dir):
             if not name.endswith(".kv"):
                 continue
-            with open(os.path.join(self.data_dir, name), "rb") as f:
-                blob = f.read()
-            # first line = original key (files use a sanitised name)
-            nl = blob.index(b"\n")
-            self.data[blob[:nl].decode()] = blob[nl + 1:]
+            path = os.path.join(self.data_dir, name)
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+                # first line = original key (files use an encoded name)
+                nl = blob.index(b"\n")
+                key = blob[:nl].decode()
+            except (OSError, ValueError, UnicodeDecodeError) as e:
+                # A malformed/truncated file must not abort store startup
+                # (ADVICE r4): skip it with a warning and keep serving the
+                # rest of the persisted state.
+                logger.warning("kv-store: skipping malformed file %s (%s)",
+                               path, e)
+                continue
+            if _key_path(self.data_dir, key) != path:
+                # Pre-upgrade sanitized filename: queue for migration so a
+                # stale old-named file can't clobber or resurrect the
+                # current-encoding value on a later restart.
+                legacy.append((key, blob[nl + 1:], path))
+                continue
+            self.data[key] = blob[nl + 1:]
+        for key, value, old_path in legacy:
+            if key not in self.data:  # current-encoding file wins
+                self.data[key] = value
+                self._persist(key, value)
+            try:
+                os.remove(old_path)
+            except OSError:
+                pass
+            logger.info("kv-store: migrated legacy file %s", old_path)
         if self.data:
             logger.info("kv-store loaded %d keys from %s",
                         len(self.data), self.data_dir)

@@ -218,11 +218,17 @@ _ctx = threading.local()
 # owner_add_borrower notify lands (premature free, shaken out by RPC
 # delay injection on the data suite).
 _handoff_credit_cb = None
+# Inverse of the grant callback: called with a list of ObjectIDs whose
+# granted credits must be RETURNED because the serialization that granted
+# them failed partway (the bytes never exist, so no receiver will ever
+# consume the credits).
+_handoff_return_cb = None
 
 
-def _set_handoff_credit_cb(cb):
-    global _handoff_credit_cb
+def _set_handoff_credit_cb(cb, return_cb=None):
+    global _handoff_credit_cb, _handoff_return_cb
     _handoff_credit_cb = cb
+    _handoff_return_cb = return_cb
 
 
 def _objectref_reducer(ref: ObjectRef):
@@ -292,6 +298,18 @@ class SerializationContext:
             inband = f.getvalue()
             refs = list(_ctx.refs)
             credited = list(_ctx.credited)
+        except Exception:
+            # A later field failed to pickle AFTER contained refs already
+            # granted handoff credits: those bytes will never exist, so
+            # return the in-flight grants here (the caller only sees the
+            # exception, never the partial credited list).
+            inflight = list(_ctx.credited or [])
+            if inflight and _handoff_return_cb is not None:
+                try:
+                    _handoff_return_cb(inflight)
+                except Exception:
+                    pass
+            raise
         finally:
             _ctx.refs = None
             _ctx.credited = None

@@ -223,6 +223,17 @@ class ProxyActor:
                     elif opcode == ws.OP_CLOSE:
                         await queue.put(None)
                         return
+            except ws.FrameTooLarge:
+                # 1009 = Message Too Big; drop the connection (the
+                # declared bytes were never read, so the stream is
+                # unsynchronized beyond recovery anyway).
+                try:
+                    writer.write(ws.encode_frame(ws.OP_CLOSE, b"\x03\xf1"))
+                    await writer.drain()
+                    writer.close()
+                except (ConnectionError, OSError):
+                    pass
+                await queue.put(None)
             except (asyncio.IncompleteReadError, ConnectionError, OSError):
                 await queue.put(None)
 

@@ -30,6 +30,23 @@ _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0D21AD85"
 OP_CONT, OP_TEXT, OP_BINARY, OP_CLOSE, OP_PING, OP_PONG = (
     0x0, 0x1, 0x2, 0x8, 0x9, 0xA)
 
+# Ingress DoS guard (ADVICE r4): the 64-bit length field is
+# client-controlled; without a cap a single frame header makes the proxy
+# attempt an arbitrarily large allocation. Overridable for legit
+# big-message deployments.
+MAX_FRAME_PAYLOAD = int(os.environ.get(
+    "RAY_TPU_SERVE_WS_MAX_FRAME", 8 * 1024 * 1024))
+
+
+class FrameTooLarge(Exception):
+    """Client declared a frame above MAX_FRAME_PAYLOAD; close with 1009."""
+
+    def __init__(self, n: int):
+        super().__init__(
+            f"websocket frame of {n} bytes exceeds the "
+            f"{MAX_FRAME_PAYLOAD}-byte limit")
+        self.declared = n
+
 
 def accept_key(client_key: str) -> str:
     digest = hashlib.sha1((client_key + _WS_GUID).encode()).digest()
@@ -64,6 +81,8 @@ async def read_frame(reader) -> Tuple[int, bytes]:
         (n,) = struct.unpack(">H", await reader.readexactly(2))
     elif n == 127:
         (n,) = struct.unpack(">Q", await reader.readexactly(8))
+    if n > MAX_FRAME_PAYLOAD:
+        raise FrameTooLarge(n)
     key = await reader.readexactly(4) if masked else None
     payload = await reader.readexactly(n) if n else b""
     if key:

@@ -148,6 +148,37 @@ class TestObjects:
 
         assert ray.get(deref.remote(ray.get(outer_ref))) == 42
 
+    def test_handoff_credit_returned_on_probe_discard(self, ray_shared):
+        """ADVICE r4 regression: the sync arg-probe serializes small args
+        (granting handoff credits for contained self-owned refs), then
+        discards the bytes when another arg needs plasma. The probe's
+        credits must be returned, or the contained object's refcount is
+        pinned one-high forever."""
+        ray = ray_shared
+        from ray_tpu._private import worker_api
+        cw = worker_api._state.core
+        inner = ray.put(12345)
+        big = np.ones(300_000)  # plasma-sized: aborts the sync probe
+
+        @ray.remote
+        def f(d, a):
+            import ray_tpu
+            return ray_tpu.get(d["ref"]) + int(a.shape[0])
+
+        assert ray.get(f.remote({"ref": inner}, big)) == 12345 + 300_000
+        ent = cw.owned.get(inner.id)
+        assert ent is not None
+        # The real (loop-path) serialization's credit is consumed by the
+        # worker's borrow registration; the discarded probe's credit must
+        # have been returned — leaving zero outstanding once the worker's
+        # borrow drains.
+        for _ in range(100):
+            if ent.handoff_credits == 0 and ent.borrowers == 0:
+                break
+            time.sleep(0.05)
+        assert ent.handoff_credits == 0
+        assert ent.borrowers == 0
+
     def test_get_timeout(self, ray_shared):
         ray = ray_shared
 
