@@ -2164,6 +2164,12 @@ class CoreWorker:
         try:
             while True:
                 if q.state == "DEAD":
+                    if spec.method_name == SEQ_SKIP_METHOD:
+                        # Marker's task already completed with its REAL
+                        # error; completing again would overwrite it with
+                        # ActorDiedError. A dead actor has no seq stream
+                        # left to keep contiguous — just drop the marker.
+                        return
                     self._complete_task_error(
                         spec, exc.ActorDiedError(q.actor_id, q.death_reason),
                         retry=False)

@@ -39,12 +39,24 @@ class JaxBackendConfig(BackendConfig):
     """Sets up the JAX distributed runtime across hosts when needed.
 
     distributed='auto': initialize jax.distributed only when >1 node hosts
-    workers AND a TPU platform is present. On a single host (or CPU tests)
-    each worker keeps its private local backend.
+    workers. On a single host (or CPU tests) each worker keeps its private
+    local backend. distributed='force': ALWAYS form the multi-controller
+    gang — the real multi-host path (one process per host, global device
+    list spanning every process) — even when the worker processes share a
+    host, which is how CI proves multi-process correctness without
+    multi-host hardware (reference: backend_executor.py:347 rank mapping +
+    train/torch/config.py:64 process-group bootstrap).
+
+    platform='cpu' (tests): each worker process binds
+    `local_device_count` virtual CPU devices and cross-process
+    collectives run over gloo; '' leaves the worker's platform alone
+    (TPU workers own their host's chips natively).
     """
 
-    distributed: str = "auto"
-    coordinator_port: int = 7311
+    distributed: str = "auto"  # auto | off | force
+    coordinator_port: int = 0  # 0 = pick a free port on worker 0
+    platform: str = ""
+    local_device_count: int = 0
 
     def on_start(self, executor: "BackendExecutor") -> None:
         infos = executor.node_info_per_worker
@@ -53,22 +65,26 @@ class JaxBackendConfig(BackendConfig):
             return
         if self.distributed == "auto" and n_nodes <= 1:
             return
-        coord = f"{infos[0]['ip']}:{self.coordinator_port}"
+        from ray_tpu.parallel.mp_check import free_port, init_process
+        port = self.coordinator_port
+        if not port:
+            # The coordinator binds on WORKER 0's host, so the free-port
+            # probe must run there — a driver-side probe checks the wrong
+            # machine on real multi-host clusters.
+            w0 = executor.worker_group.workers[0]
+            import ray_tpu as _rt
+            port = _rt.get(w0.execute.remote(cloudpickle.dumps(free_port)),
+                           timeout=60)
+        coord = f"{infos[0]['ip']}:{port}"
         world = executor.world_size
-
-        def _init(coord_addr, num_procs, rank):
-            import jax
-            jax.distributed.initialize(
-                coordinator_address=coord_addr, num_processes=num_procs,
-                process_id=rank)
-
-        fn_b = cloudpickle.dumps(_init)
+        fn_b = cloudpickle.dumps(init_process)
         import ray_tpu
         refs = [
-            w.execute.remote(fn_b, coord, world, rank)
+            w.execute.remote(fn_b, rank, world, coord,
+                             self.local_device_count, self.platform)
             for rank, w in enumerate(executor.worker_group.workers)
         ]
-        ray_tpu.get(refs, timeout=120)
+        ray_tpu.get(refs, timeout=180)
 
 
 class TrainingFailedError(RuntimeError):
