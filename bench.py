@@ -65,6 +65,21 @@ def bench_calibration() -> float:
     return n / dt / 1e3
 
 
+def bench_memcpy() -> float:
+    """Warm single-thread memcpy bandwidth (GB/s) — the physical ceiling
+    for ray_tpu.put of big buffers (put = serialize zero-copy + one memcpy
+    into shm). Reported so put_gbs has an explicit box-relative target:
+    COLD (never-touched) pages on ballooned VMs fault at ~0.1 GB/s, which
+    is why the store pre-warms its arena (object_store._start_prefault)."""
+    import numpy as np
+    a = np.ones(16 << 20)  # 128 MB
+    b = np.empty_like(a)
+    b[:] = a  # warm dest
+    t0 = time.perf_counter()
+    b[:] = a
+    return a.nbytes / (time.perf_counter() - t0) / 1e9
+
+
 def bench_core(partial: dict):
     import ray_tpu
 
@@ -353,8 +368,11 @@ def main():
     partial: dict = {}
     calib = bench_calibration()
     partial["calib_single_core_kops"] = round(calib, 1)
+    memcpy = bench_memcpy()
+    partial["calib_memcpy_gbs"] = round(memcpy, 2)
     _persist(partial)
-    log(f"calibration: {calib:.1f} k-ops/s single-core")
+    log(f"calibration: {calib:.1f} k-ops/s single-core, "
+        f"memcpy {memcpy:.1f} GB/s warm")
     # Model bench FIRST, isolated — before the core bench forks anything.
     model = _run_model_bench_subprocess(partial)
     core = bench_core(partial)

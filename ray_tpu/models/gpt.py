@@ -40,6 +40,12 @@ class GPTConfig:
     n_experts: int = 0
     expert_top_k: int = 2
     remat: bool = True
+    # Remat granularity: None -> "full" if remat else "none".
+    #   "full": recompute the whole layer in backward (min HBM, max FLOPs)
+    #   "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable —
+    #           weight-matmul outputs saved, elementwise recomputed
+    #   "none": save everything (max HBM, min FLOPs)
+    remat_policy: Optional[str] = None
     attention: str = "flash"          # flash | reference | ring
     tie_embeddings: bool = False
 
@@ -233,8 +239,13 @@ def gpt_backbone(params, tokens, cfg: GPTConfig, mesh=None, act_sharding=None):
             delta, aux = _mlp_block(layer, normed, cfg), 0.0
         return _c(h + delta), aux
 
-    if cfg.remat:
+    policy = cfg.remat_policy or ("full" if cfg.remat else "none")
+    if policy == "full":
         layer_fn = jax.checkpoint(layer_fn)
+    elif policy == "dots":
+        layer_fn = jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
     for layer in params["layers"]:
         x, aux = layer_fn(x, layer)
         aux_total = aux_total + aux

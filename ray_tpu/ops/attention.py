@@ -344,7 +344,17 @@ def flash_attention(q, k, v, *, causal: bool = True,
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     seq_q, seq_k = q.shape[2], k.shape[2]
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        # Interpret (software-emulate) only on non-TPU platforms. The axon
+        # transport exposes the real chip under backend name "axon", not
+        # "tpu" — matching on the device platform keeps the Mosaic kernel
+        # compiled for hardware there (interpret mode on a real chip was a
+        # measured 1.4x whole-step slowdown at gpt2-small bs=64).
+        try:
+            plat = jax.devices()[0].platform.lower()
+        except Exception:
+            plat = jax.default_backend()
+        interpret = not ("tpu" in plat or plat == "axon"
+                         or "tpu" in jax.default_backend())
     block_q = min(block_q, seq_q)
     block_k = min(block_k, seq_k)
     if seq_q % block_q or seq_k % block_k:

@@ -93,20 +93,46 @@ def _donation_supported() -> bool:
 def make_train_step(loss_fn: Callable, optimizer, mesh: Mesh,
                     strategy: "ShardingStrategy | str",
                     sample_params: Any = None,
-                    donate: Optional[bool] = None):
+                    donate: Optional[bool] = None,
+                    accum_steps: int = 0):
     """Build the jitted sharded train step.
 
     loss_fn(params, batch) -> scalar. Returns step(state, batch) ->
     (state, metrics) compiled with GSPMD shardings from the strategy.
     donate=None resolves per-platform (_donation_supported).
+
+    accum_steps > 0: gradient accumulation INSIDE the compiled program —
+    every batch leaf carries a leading [accum_steps] dim and a lax.scan
+    runs that many microbatch fwd+bwd passes before ONE optimizer update.
+    Besides the usual large-effective-batch use, this amortizes any
+    per-dispatch transport overhead (the tunneled-chip case) across
+    accum_steps of compute in a single executable launch.
     """
     if isinstance(strategy, str):
         strategy = strategy_from_name(strategy)
     if donate is None:
         donate = _donation_supported()
 
+    def _grads(params, batch):
+        return jax.value_and_grad(loss_fn)(params, batch)
+
     def _step(state: TrainState, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        if accum_steps:
+            def micro(carry, mb):
+                loss_sum, gacc = carry
+                loss, g = _grads(state.params, mb)
+                gacc = jax.tree_util.tree_map(
+                    lambda a, gi: a + gi.astype(a.dtype), gacc, g)
+                return (loss_sum + loss.astype(jnp.float32), gacc), None
+            gzero = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (loss_sum, gsum), _ = jax.lax.scan(
+                micro, (jnp.float32(0.0), gzero), batch)
+            inv = 1.0 / accum_steps
+            grads = jax.tree_util.tree_map(lambda g: g * inv, gsum)
+            loss = loss_sum * inv
+        else:
+            loss, grads = _grads(state.params, batch)
         updates, opt_state = optimizer.update(grads, state.opt_state,
                                               state.params)
         params = optax.apply_updates(state.params, updates)
@@ -115,7 +141,10 @@ def make_train_step(loss_fn: Callable, optimizer, mesh: Mesh,
                 {"loss": loss.astype(jnp.float32), "grad_norm": gnorm,
                  "step": state.step + 1})
 
-    batch_sh = NamedSharding(mesh, strategy.batch_spec)
+    bspec = strategy.batch_spec
+    if accum_steps:
+        bspec = P(*((None,) + tuple(bspec)))
+    batch_sh = NamedSharding(mesh, bspec)
     kwargs = {}
     if donate:
         kwargs["donate_argnums"] = (0,)

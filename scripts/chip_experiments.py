@@ -1,0 +1,237 @@
+"""One-window TPU experiment ladder (round-5, VERDICT #1: MFU >= 35%).
+
+The tunnel serves in short (~5-10 min) windows. When one opens, this script
+runs a prioritized sequence of timed probes — each guarded, each persisted
+immediately to CHIP_EXPERIMENTS_r05.json — so even a window that closes
+mid-run leaves data. Probes answer, in order:
+
+  1. matmul      — pure MXU ceiling through the tunnel (4096^3 bf16 chain).
+                   If this is far below 197 TFLOP/s the box/tunnel itself is
+                   the limit, not the model code.
+  2. dispatch    — per-executable-launch overhead (dependent tiny jits).
+  3. fwd_only    — forward loss only: splits fwd vs bwd cost.
+  4. step_remat_dots / step_remat_none — remat policy cost at the bench's
+                   GPT-2-small bs=64 config.
+  5. flash_iso   — standalone flash-attention fwd+bwd vs XLA reference at
+                   the exact bench shape [64, 12, 1024, 64].
+  6. step_accum  — K microbatches scanned inside ONE jit dispatch
+                   (amortizes any tunnel per-dispatch overhead).
+
+Run: python scripts/chip_experiments.py [--only=name,name]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+OUT = os.path.join(HERE, "CHIP_EXPERIMENTS_r05.json")
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/ray_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+
+def log(msg):
+    print(f"[exp {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def persist(name, data):
+    cur = {}
+    if os.path.exists(OUT):
+        try:
+            with open(OUT) as f:
+                cur = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            cur = {}
+    cur[name] = data
+    cur["_ts"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    with open(OUT, "w") as f:
+        json.dump(cur, f, indent=1)
+    log(f"{name}: {json.dumps(data)}")
+
+
+def exp_matmul():
+    import jax, jax.numpy as jnp, numpy as np
+
+    @jax.jit
+    def mm(x, y, n):
+        def body(i, acc):
+            return jax.lax.dot(acc, y, preferred_element_type=jnp.bfloat16)
+        return jax.lax.fori_loop(0, n, body, x)
+
+    x = jnp.full((4096, 4096), 1e-4, jnp.bfloat16)
+    y = jnp.full((4096, 4096), 1e-4, jnp.bfloat16)
+    t0 = time.perf_counter()
+    np.asarray(mm(x, y, 4))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    np.asarray(mm(x, y, 100))
+    dt = time.perf_counter() - t0
+    fl = 100 * 2 * 4096 ** 3
+    return {"compile_s": round(compile_s, 1), "time_s": round(dt, 3),
+            "tflops": round(fl / dt / 1e12, 1),
+            "pct_peak": round(fl / dt / 197e12 * 100, 1)}
+
+
+def exp_dispatch():
+    import jax, jax.numpy as jnp, numpy as np
+
+    @jax.jit
+    def tiny(x):
+        return x + 1.0
+
+    z = jnp.zeros(())
+    np.asarray(tiny(z))
+    t0 = time.perf_counter()
+    for _ in range(30):
+        z = tiny(z)
+    np.asarray(z)
+    ms = (time.perf_counter() - t0) * 1e3
+    return {"total_ms_30": round(ms, 1), "per_dispatch_ms": round(ms / 30, 2)}
+
+
+def _bench_step(remat_policy, iters=6, bs=64, accum=0, attention="flash"):
+    import jax, jax.numpy as jnp, numpy as np, optax
+    from ray_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss
+    from ray_tpu.parallel.mesh import build_mesh, MeshConfig
+    from ray_tpu.train.train_step import init_train_state, make_train_step
+
+    cfg = GPTConfig(remat_policy=remat_policy, attention=attention)
+    mesh = build_mesh(MeshConfig(data=len(jax.devices())))
+    opt = optax.adamw(3e-4)
+    seq = 1024
+    last_err = None
+    while bs >= 8:
+        try:
+            state = init_train_state(
+                lambda: gpt_init(jax.random.PRNGKey(0), cfg), opt, mesh, "dp")
+            step = make_train_step(lambda p, b: gpt_loss(p, b, cfg), opt,
+                                   mesh, "dp", sample_params=state.params,
+                                   accum_steps=accum)
+            shape = (accum, bs, seq + 1) if accum else (bs, seq + 1)
+            tokens = jnp.array(
+                np.random.randint(0, cfg.vocab_size, shape), jnp.int32)
+            batch = {"tokens": tokens}
+            t0 = time.perf_counter()
+            st, m = step(state, batch)
+            loss0 = float(np.asarray(m["loss"]))
+            compile_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                st, m = step(st, batch)
+            float(np.asarray(m["loss"]))
+            dt = (time.perf_counter() - t0) / iters
+            eff_bs = bs * max(accum, 1)
+            return {"compile_s": round(compile_s, 1),
+                    "step_ms": round(dt * 1e3, 1),
+                    "sps": round(eff_bs / dt, 2), "loss0": round(loss0, 3),
+                    "bs": eff_bs}
+        except Exception as e:  # OOM at this bs: halve
+            last_err = e
+            log(f"bs={bs} failed ({type(e).__name__}); halving")
+            bs //= 2
+    raise RuntimeError(f"all batch sizes failed: {last_err}")
+
+
+def exp_fwd_only():
+    import jax, jax.numpy as jnp, numpy as np
+    from ray_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss
+
+    cfg = GPTConfig()
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    loss_fn = jax.jit(lambda p, b: gpt_loss(p, b, cfg))
+    tokens = jnp.array(np.random.randint(0, cfg.vocab_size, (64, 1025)),
+                       jnp.int32)
+    batch = {"tokens": tokens}
+    t0 = time.perf_counter()
+    float(np.asarray(loss_fn(params, batch)))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(6):
+        r = loss_fn(params, batch)
+    float(np.asarray(r))
+    dt = (time.perf_counter() - t0) / 6
+    return {"compile_s": round(compile_s, 1), "fwd_ms": round(dt * 1e3, 1)}
+
+
+def exp_step_remat_full():
+    return _bench_step("full")
+
+
+def exp_step_remat_dots():
+    return _bench_step("dots")
+
+
+def exp_step_remat_none():
+    try:
+        return _bench_step("none", bs=32)
+    except Exception as e:  # OOM likely
+        return {"error": f"{type(e).__name__}"}
+
+
+def exp_flash_iso():
+    import jax, jax.numpy as jnp, numpy as np
+    from ray_tpu.ops.attention import flash_attention, mha_reference
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (64, 12, 1024, 64),
+                          jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (64, 12, 1024, 64),
+                          jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (64, 12, 1024, 64),
+                          jnp.bfloat16)
+    out = {}
+    for name, fn in (("flash", flash_attention), ("ref", mha_reference)):
+        f = jax.jit(jax.grad(
+            lambda q, k, v: fn(q, k, v, causal=True).astype(
+                jnp.float32).sum()))
+        t0 = time.perf_counter()
+        np.asarray(f(q, k, v))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(8):
+            r = f(q, k, v)
+        np.asarray(r)
+        out[name + "_fwdbwd_ms"] = round(
+            (time.perf_counter() - t0) / 8 * 1e3, 1)
+        out[name + "_compile_s"] = round(compile_s, 1)
+    return out
+
+
+def exp_step_accum4():
+    return _bench_step("dots", iters=3, bs=64, accum=4)
+
+
+EXPERIMENTS = [
+    ("matmul", exp_matmul),
+    ("dispatch", exp_dispatch),
+    ("fwd_only", exp_fwd_only),
+    ("step_remat_dots", exp_step_remat_dots),
+    ("flash_iso", exp_flash_iso),
+    ("step_remat_full", exp_step_remat_full),
+    ("step_remat_none", exp_step_remat_none),
+    ("step_accum4", exp_step_accum4),
+]
+
+
+def main():
+    only = None
+    for a in sys.argv:
+        if a.startswith("--only="):
+            only = set(a.split("=", 1)[1].split(","))
+    for name, fn in EXPERIMENTS:
+        if only and name not in only:
+            continue
+        try:
+            t0 = time.perf_counter()
+            data = fn()
+            data["wall_s"] = round(time.perf_counter() - t0, 1)
+            persist(name, data)
+        except Exception as e:  # noqa: BLE001
+            persist(name, {"error": f"{type(e).__name__}: {e}"[:300]})
+
+
+if __name__ == "__main__":
+    main()
