@@ -170,7 +170,208 @@ def bench_core(partial: dict):
     _persist(partial)
     log(f"put_throughput: {put_gbs:.2f} GB/s")
 
+    # ---- breadth phases (BASELINE.md rows beyond the headline six;
+    # ref: python/ray/_private/ray_perf.py microbenchmark suite) ----
+
+    # 1:1 async-actor calls (async def method; ref 1_1_async_actor_calls)
+    @ray_tpu.remote
+    class AsyncSink:
+        async def ping(self, x=None):
+            return x
+
+    aa = AsyncSink.remote()
+    ray_tpu.get(aa.ping.remote(), timeout=60)
+
+    def _async_actor():
+        n = 1500
+        t0 = time.perf_counter()
+        ray_tpu.get([aa.ping.remote() for _ in range(n)])
+        return n / (time.perf_counter() - t0)
+
+    v = median_of(_async_actor, reps=3)
+    partial["async_actor_calls_1_1"] = round(v, 1)
+    _persist(partial)
+    log(f"1_1_async_actor_calls_async: {v:,.0f}/s")
+
+    # 1:n actor calls (one driver fanning out to 4 sinks)
+    sinks = [Sink.remote() for _ in range(4)]
+    ray_tpu.get([s.ping.remote() for s in sinks], timeout=60)
+
+    def _one_to_n():
+        n = 400
+        t0 = time.perf_counter()
+        ray_tpu.get([s.ping.remote() for _ in range(n) for s in sinks])
+        return 4 * n / (time.perf_counter() - t0)
+
+    v = median_of(_one_to_n, reps=3)
+    partial["actor_calls_1_n"] = round(v, 1)
+    _persist(partial)
+    log(f"1_n_actor_calls_async: {v:,.0f}/s")
+
+    # n:n actor calls: 4 caller actors, each bursting at its own sink.
+    # Callers run inside workers (true multi-client core paths).
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self):
+            self.sink = Sink.remote()
+            ray_tpu.get(self.sink.ping.remote(), timeout=60)
+
+        def burst(self, n, arg=None):
+            t0 = time.perf_counter()
+            ray_tpu.get([self.sink.ping.remote(arg) for _ in range(n)])
+            return n / (time.perf_counter() - t0)
+
+        def burst_tasks(self, n):
+            t0 = time.perf_counter()
+            ray_tpu.get([nop.remote() for _ in range(n)])
+            return n / (time.perf_counter() - t0)
+
+    callers = [Caller.remote() for _ in range(4)]
+    ray_tpu.get([c.burst.remote(5) for c in callers], timeout=120)
+
+    def _n_n():
+        n = 250
+        t0 = time.perf_counter()
+        ray_tpu.get([c.burst.remote(n) for c in callers])
+        return 4 * n / (time.perf_counter() - t0)
+
+    v = median_of(_n_n, reps=3)
+    partial["n_n_actor_calls"] = round(v, 1)
+    _persist(partial)
+    log(f"n_n_actor_calls_async: {v:,.0f}/s")
+
+    # n:n actor calls with an ObjectRef arg (forces arg resolution per call)
+    ref_arg = ray_tpu.put(np.zeros(1024))
+
+    def _n_n_arg():
+        n = 150
+        t0 = time.perf_counter()
+        ray_tpu.get([c.burst.remote(n, ref_arg) for c in callers])
+        return 4 * n / (time.perf_counter() - t0)
+
+    v = median_of(_n_n_arg, reps=3)
+    partial["n_n_actor_calls_with_arg"] = round(v, 1)
+    _persist(partial)
+    log(f"n_n_actor_calls_with_arg_async: {v:,.0f}/s")
+
+    # multi-client tasks: 4 in-worker drivers each submitting nop bursts
+    def _multi_client_tasks():
+        n = 250
+        t0 = time.perf_counter()
+        ray_tpu.get([c.burst_tasks.remote(n) for c in callers])
+        return 4 * n / (time.perf_counter() - t0)
+
+    v = median_of(_multi_client_tasks, reps=3)
+    partial["multi_client_tasks_async"] = round(v, 1)
+    _persist(partial)
+    log(f"multi_client_tasks_async: {v:,.0f}/s")
+
+    # ray.wait over 1k plasma refs (ref single_client_wait_1k_refs)
+    wait_refs = [ray_tpu.put(small) for _ in range(1000)]
+
+    def _wait_1k():
+        t0 = time.perf_counter()
+        ray_tpu.wait(wait_refs, num_returns=len(wait_refs), timeout=30)
+        return 1.0 / (time.perf_counter() - t0)
+
+    v = median_of(_wait_1k, reps=3)
+    partial["wait_1k_refs_per_s"] = round(v, 2)
+    _persist(partial)
+    log(f"wait_1k_refs: {v:.2f}/s")
+    del wait_refs
+
+    # task with 10,000 ObjectRef args (ref scalability 10000_args_time)
+    @ray_tpu.remote
+    def count_args(*args):
+        return len(args)
+
+    arg_refs = [ray_tpu.put(0) for _ in range(10000)]
+    t0 = time.perf_counter()
+    assert ray_tpu.get(count_args.remote(*arg_refs), timeout=600) == 10000
+    partial["args_10k_s"] = round(time.perf_counter() - t0, 2)
+    _persist(partial)
+    log(f"task with 10k args: {partial['args_10k_s']}s")
+    del arg_refs
+
+    # task returning 3,000 objects (ref scalability 3000_returns_time)
+    @ray_tpu.remote
+    def many_returns():
+        return tuple(range(3000))
+
+    t0 = time.perf_counter()
+    out = many_returns.options(num_returns=3000).remote()
+    got = ray_tpu.get(list(out), timeout=600)
+    assert len(got) == 3000 and got[-1] == 2999
+    partial["returns_3000_s"] = round(time.perf_counter() - t0, 2)
+    _persist(partial)
+    log(f"task returning 3000 objects: {partial['returns_3000_s']}s")
+
+    # queued-task drain, scaled probe (ref 1M queued; 30k here — report
+    # drain rate so the number is box-size independent)
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(30000)], timeout=900)
+    dt = time.perf_counter() - t0
+    partial["queued_30k_drain_s"] = round(dt, 1)
+    partial["queued_drain_tasks_per_s"] = round(30000 / dt, 1)
+    _persist(partial)
+    log(f"30k queued drained: {dt:.1f}s ({30000/dt:,.0f}/s)")
+
     ray_tpu.shutdown()
+    return partial
+
+
+def bench_cluster(partial: dict):
+    """Fake-3-node phases: actor launch rate + placement-group latency
+    (ref release many_actors.json actors_per_second,
+    stress_test_placement_group.json)."""
+    from ray_tpu.cluster_utils import Cluster
+    import ray_tpu
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 64})
+    for _ in range(2):
+        cluster.add_node(num_cpus=64)
+    cluster.connect()
+    try:
+        @ray_tpu.remote(num_cpus=0.01)
+        class Tiny:
+            def ready(self):
+                return 1
+
+        # warm the worker pools
+        warm = [Tiny.remote() for _ in range(8)]
+        ray_tpu.get([a.ready.remote() for a in warm], timeout=120)
+
+        n = 150
+        t0 = time.perf_counter()
+        actors = [Tiny.remote() for _ in range(n)]
+        ray_tpu.get([a.ready.remote() for a in actors], timeout=300)
+        rate = n / (time.perf_counter() - t0)
+        partial["actor_launch_per_s"] = round(rate, 1)
+        _persist(partial)
+        log(f"actor_launch_rate (3-node fake): {rate:,.0f}/s")
+
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  remove_placement_group)
+        create_ms, remove_ms = [], []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            pg = placement_group([{"CPU": 1}] * 3, strategy="PACK")
+            ray_tpu.get(pg.ready(), timeout=60)
+            create_ms.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            remove_placement_group(pg)
+            remove_ms.append((time.perf_counter() - t0) * 1e3)
+        partial["pg_create_ms"] = round(statistics.median(create_ms), 2)
+        partial["pg_remove_ms"] = round(statistics.median(remove_ms), 2)
+        _persist(partial)
+        log(f"pg create/remove: {partial['pg_create_ms']}/"
+            f"{partial['pg_remove_ms']} ms")
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
     return partial
 
 
@@ -376,6 +577,10 @@ def main():
     # Model bench FIRST, isolated — before the core bench forks anything.
     model = _run_model_bench_subprocess(partial)
     core = bench_core(partial)
+    try:
+        bench_cluster(partial)
+    except Exception as e:  # noqa: BLE001 — cluster phase must not kill bench
+        log(f"cluster phase skipped: {type(e).__name__}: {e}")
     value = core["actor_calls_async"]
     baseline = 9183.0  # BASELINE.md 1_1_actor_calls_async (m5.16xlarge)
     out = {
@@ -384,6 +589,33 @@ def main():
         "unit": "calls/s",
         "vs_baseline": round(value / baseline, 3),
     }
+    # Per-row reference numbers (BASELINE.md, m5.16xlarge 64-vCPU / release
+    # scalability suite). higher_is_better=False rows are wall-times.
+    _BASE = {
+        "actor_calls_async": (9183.0, True),
+        "actor_calls_sync": (2138.0, True),
+        "tasks_async": (8159.0, True),
+        "multi_client_tasks_async": (26697.0, True),
+        "async_actor_calls_1_1": (3443.0, True),
+        "actor_calls_1_n": (9023.0, True),
+        "n_n_actor_calls": (28922.0, True),
+        "n_n_actor_calls_with_arg": (2858.0, True),
+        "put_calls_per_s": (5627.0, True),
+        "get_calls_per_s": (10739.0, True),
+        "put_gbs": (19.45, True),
+        "wait_1k_refs_per_s": (5.2, True),
+        "args_10k_s": (17.4, False),
+        "returns_3000_s": (6.8, False),
+        "actor_launch_per_s": (651.0, True),
+        "pg_create_ms": (0.88, False),
+        "pg_remove_ms": (0.86, False),
+    }
+    vs = {}
+    for k, (base, higher) in _BASE.items():
+        v = partial.get(k)
+        if isinstance(v, (int, float)) and v > 0:
+            vs[k] = round(v / base if higher else base / v, 3)
+    out["vs_baseline_rows"] = vs
     out.update({k: v for k, v in partial.items() if k != "model_sps"})
     if isinstance(model, dict):
         out["gpt2_small_samples_per_s_chip"] = model.get("model_sps")

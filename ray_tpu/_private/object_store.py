@@ -51,10 +51,6 @@ class _AttachedSegment:
         self._mmap = mmap.mmap(self._file.fileno(), size)
         self.buf = memoryview(self._mmap)
 
-    @property
-    def pwrite_fd(self) -> int:
-        return self._file.fileno()
-
     def close(self):
         self.buf.release()
         self._mmap.close()
@@ -119,41 +115,37 @@ class Arena:
             self.shm = shared_memory.SharedMemory(create=True, size=capacity,
                                                   name=name)
         self.name = self.shm.name
-        # free list: sorted list of (offset, size). The lock makes
-        # alloc/free callable off the store's event loop (the page warmer
-        # thread claims regions through the allocator — see
-        # ObjectStoreHost._start_prefault).
+        # free list: sorted list of (offset, size). Only touched from the
+        # store's event-loop thread (the page warmer needs no allocator
+        # coordination — madvise populates pages without modifying data).
         self._free: List[Tuple[int, int]] = [(0, capacity)]
-        self._lock = threading.Lock()
         self.used = 0
 
     def alloc(self, size: int) -> Optional[int]:
         size = (size + _ALIGN - 1) // _ALIGN * _ALIGN
-        with self._lock:
-            for i, (off, sz) in enumerate(self._free):
-                if sz >= size:
-                    if sz == size:
-                        self._free.pop(i)
-                    else:
-                        self._free[i] = (off + size, sz - size)
-                    self.used += size
-                    return off
-            return None
+        for i, (off, sz) in enumerate(self._free):
+            if sz >= size:
+                if sz == size:
+                    self._free.pop(i)
+                else:
+                    self._free[i] = (off + size, sz - size)
+                self.used += size
+                return off
+        return None
 
     def free(self, offset: int, size: int):
         size = (size + _ALIGN - 1) // _ALIGN * _ALIGN
-        with self._lock:
-            self.used -= size
-            # insert and coalesce
-            self._free.append((offset, size))
-            self._free.sort()
-            merged: List[Tuple[int, int]] = []
-            for off, sz in self._free:
-                if merged and merged[-1][0] + merged[-1][1] == off:
-                    merged[-1] = (merged[-1][0], merged[-1][1] + sz)
-                else:
-                    merged.append((off, sz))
-            self._free = merged
+        self.used -= size
+        # insert and coalesce
+        self._free.append((offset, size))
+        self._free.sort()
+        merged: List[Tuple[int, int]] = []
+        for off, sz in self._free:
+            if merged and merged[-1][0] + merged[-1][1] == off:
+                merged[-1] = (merged[-1][0], merged[-1][1] + sz)
+            else:
+                merged.append((off, sz))
+        self._free = merged
 
     def view(self, offset: int, size: int) -> memoryview:
         return memoryview(self.shm.buf)[offset : offset + size]
@@ -216,7 +208,7 @@ class ObjectStoreHost:
         self.num_evicted = 0
         self.bytes_spilled = 0
 
-    _PREFAULT_CAP = 2 << 30
+    _PREFAULT_CAP = 1 << 30
     _PREFAULT_CHUNK = 32 << 20
 
     def _start_prefault(self):
@@ -237,7 +229,20 @@ class ObjectStoreHost:
         mm = getattr(self.arena.shm, "_mmap", None)
         if mm is None:
             return
-        n = min(self.arena.capacity, self._PREFAULT_CAP)
+        # POPULATE makes pages physically resident, so cap by the box's
+        # available memory (an 8th) as well as the absolute cap — a fake
+        # multi-node test cluster runs several stores in one process.
+        avail = None
+        try:
+            with open("/proc/meminfo") as f:
+                for ln in f:
+                    if ln.startswith("MemAvailable:"):
+                        avail = int(ln.split()[1]) * 1024
+                        break
+        except OSError:
+            pass
+        n = min(self.arena.capacity, self._PREFAULT_CAP,
+                *( [avail // 8] if avail else [] ))
         stop = self._prefault_stop = threading.Event()
         chunk = self._PREFAULT_CHUNK
         MADV_POPULATE_WRITE = 23  # Linux 5.14+
@@ -426,6 +431,9 @@ class ObjectStoreHost:
         }
 
     def destroy(self):
+        stop = getattr(self, "_prefault_stop", None)
+        if stop is not None:
+            stop.set()
         self.arena.destroy()
 
 
@@ -470,7 +478,7 @@ class ObjectStoreClient:
             # (never-touched) pages are hypervisor-fault-bound at ~0.1 GB/s
             # either way, and the store warms its arena in the background
             # (ObjectStoreHost._start_prefault) so steady-state puts land
-            # on warm pages. pwrite (write_to_fd) remains for spill I/O.
+            # on warm pages.
             dest = memoryview(shm.buf)[offset : offset + size]
             loop = asyncio.get_running_loop()
             await loop.run_in_executor(None, serialized.write_to, dest)

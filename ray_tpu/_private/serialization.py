@@ -175,39 +175,6 @@ class SerializedObject:
             off = _pad(off + b.nbytes)
         return off
 
-    @staticmethod
-    def _pwrite_all(fd: int, view, offset: int) -> None:
-        """pwrite until done: a single pwrite caps at ~2 GiB on Linux and
-        may short-write; ignoring the return value would silently seal a
-        truncated object."""
-        import os as _os
-        mv = view if isinstance(view, memoryview) else memoryview(view)
-        while mv.nbytes:
-            n = _os.pwrite(fd, mv, offset)
-            mv = mv[n:]
-            offset += n
-
-    def write_to_fd(self, fd: int, base: int) -> int:
-        """Same layout as write_to, but via pwrite on the segment file.
-
-        Cold tmpfs regions take ~2x fewer cycles through the syscall path
-        than through a fresh mmap (no per-page fault + PTE churn), and
-        big puts nearly always hit cold regions."""
-        n = len(self.buffers)
-        hdr = bytearray(8 + 8 * n)
-        struct.pack_into("<II", hdr, 0, MAGIC, n)
-        off = 8
-        for b in self.buffers:
-            struct.pack_into("<Q", hdr, off, b.nbytes)
-            off += 8
-        self._pwrite_all(fd, hdr, base)
-        off = _pad(off)
-        for b in self.buffers:
-            self._pwrite_all(fd, b.cast("B") if b.format != "B" else b,
-                             base + off)
-            off = _pad(off + b.nbytes)
-        return off
-
     def to_bytes(self) -> bytes:
         out = bytearray(self.total_size)
         self.write_to(memoryview(out))

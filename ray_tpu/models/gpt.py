@@ -246,6 +246,9 @@ def gpt_backbone(params, tokens, cfg: GPTConfig, mesh=None, act_sharding=None):
         layer_fn = jax.checkpoint(
             layer_fn,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    elif policy != "none":
+        raise ValueError(f"unknown remat_policy {policy!r} "
+                         "(expected 'full' | 'dots' | 'none')")
     for layer in params["layers"]:
         x, aux = layer_fn(x, layer)
         aux_total = aux_total + aux

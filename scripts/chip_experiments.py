@@ -221,6 +221,7 @@ def main():
     for a in sys.argv:
         if a.startswith("--only="):
             only = set(a.split("=", 1)[1].split(","))
+    failed = 0
     for name, fn in EXPERIMENTS:
         if only and name not in only:
             continue
@@ -230,7 +231,12 @@ def main():
             data["wall_s"] = round(time.perf_counter() - t0, 1)
             persist(name, data)
         except Exception as e:  # noqa: BLE001
+            # An experiment that raised (vs returning an error record) means
+            # the window likely died mid-run: exit nonzero so the retry
+            # loop does NOT stamp this code version as profiled.
+            failed += 1
             persist(name, {"error": f"{type(e).__name__}: {e}"[:300]})
+    sys.exit(2 if failed else 0)
 
 
 if __name__ == "__main__":

@@ -94,6 +94,8 @@ def run_experiments():
         for ln in p.stdout.splitlines():
             if ln.strip():
                 log(f"  {ln.strip()}")
+        # rc!=0 = some experiment raised (window likely closed mid-ladder):
+        # leave the stamp unwritten so the ladder re-runs next window.
         if p.returncode == 0:
             with open(EXPSTAMP, "w") as f:
                 f.write(ver)
