@@ -70,6 +70,9 @@ def _build_provider(cfg: dict, gcs_address: str, session_dir: str):
     if ptype == "tpu_pod":
         from ray_tpu.autoscaler.node_provider import TPUPodProvider
         return TPUPodProvider(provider_cfg)
+    if ptype == "k8s":
+        from ray_tpu.autoscaler.node_provider import K8sPodProvider
+        return K8sPodProvider(provider_cfg)
     raise ValueError(f"unknown provider type {ptype!r}")
 
 

@@ -11,6 +11,7 @@ the node type), so create_node must gang-create every host of a slice.
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 import uuid
 from typing import Dict, List, Optional
@@ -347,3 +348,280 @@ class TPUPodProvider(NodeProvider):
     def internal_ip(self, provider_node_id: str) -> str:
         eps = self._get_node(provider_node_id).get("networkEndpoints") or []
         return eps[0].get("ipAddress", "") if eps else ""
+
+
+class K8sPodProvider(NodeProvider):
+    """Kubernetes provider: each ray_tpu node is a pod, created/listed/
+    deleted through the apiserver REST API — the KubeRay-equivalent layer.
+
+    Reference analogue: python/ray/autoscaler/_private/kuberay/
+    node_provider.py (KubeRayNodeProvider: pods with ray.io/* labels,
+    patched replica counts). TPU-first deltas: node types may declare GKE
+    TPU podslices (`tpu_accelerator` + `tpu_topology` + `chips_per_host`) —
+    create_node then emits pods with `google.com/tpu` resource limits and
+    the GKE nodeSelectors, gang-creating `slice_hosts` pods that share a
+    `ray.io/slice-id` label so a multi-host slice schedules (and dies)
+    together.
+
+    All HTTP goes through an injectable ``transport(method, url, body) ->
+    (status, json_dict)``; without one, a default transport authenticates
+    with the in-cluster service-account token (the runtime credential
+    gate — constructing the provider off-cluster works for tests/config
+    validation, real calls raise with instructions).
+    """
+
+    LIVE_PHASES = ("Pending", "Running")
+
+    def __init__(self, provider_config: Optional[dict] = None,
+                 transport=None):
+        super().__init__(provider_config)
+        cfg = self.provider_config
+        self.namespace = cfg.get("namespace", "default")
+        self.cluster_name = cfg.get("cluster_name", "ray-tpu")
+        self.api_server = cfg.get(
+            "api_server", "https://kubernetes.default.svc")
+        self.image = cfg.get("image", "")
+        self._transport = transport
+        self._list_cache: Optional[List[dict]] = None
+        self._list_cache_t = 0.0
+        self._list_cache_ttl = float(cfg.get("list_cache_ttl_s", 2.0))
+
+    # ---- transport / auth (the runtime gate) -------------------------
+
+    _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    def _default_transport(self):
+        import json as _json
+        import ssl
+        import urllib.error
+        import urllib.request
+
+        token_path = self.provider_config.get(
+            "token_path", f"{self._SA_DIR}/token")
+        ca_path = self.provider_config.get(
+            "ca_cert_path", f"{self._SA_DIR}/ca.crt")
+        try:
+            with open(token_path) as f:
+                token = f.read().strip()
+        except OSError as e:
+            raise RuntimeError(
+                "K8sPodProvider needs in-cluster credentials: run inside a "
+                f"pod with a service account ({token_path} unreadable: "
+                f"{e!r}) or inject a transport") from e
+        ctx = ssl.create_default_context(
+            cafile=ca_path if os.path.exists(ca_path) else None)
+        if not os.path.exists(ca_path):
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+
+        def transport(method: str, url: str, body: Optional[dict] = None):
+            req = urllib.request.Request(
+                url, method=method,
+                data=None if body is None else _json.dumps(body).encode(),
+                headers={"Authorization": f"Bearer {token}",
+                         "Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=60,
+                                            context=ctx) as r:
+                    return r.status, _json.loads(r.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                try:
+                    detail = _json.loads(e.read() or b"{}")
+                except Exception:  # noqa: BLE001
+                    detail = {}
+                return e.code, detail
+
+        return transport
+
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None) -> dict:
+        if self._transport is None:
+            self._transport = self._default_transport()
+        status, data = self._transport(
+            method, f"{self.api_server}{path}", body)
+        if status >= 400:
+            raise RuntimeError(
+                f"k8s API {method} {path} failed ({status}): "
+                f"{data.get('message', data)}")
+        return data
+
+    # ---- pod manifest ------------------------------------------------
+
+    def _pod_manifest(self, name: str, node_type: str, type_cfg: dict,
+                      slice_id: str = "") -> dict:
+        cfg = self.provider_config
+        labels = {
+            "ray.io/cluster": self.cluster_name,
+            "ray.io/node-type": node_type,
+        }
+        if slice_id:
+            labels["ray.io/slice-id"] = slice_id
+        container: dict = {
+            "name": "ray-node",
+            "image": type_cfg.get("image") or self.image or "ray-tpu:latest",
+            "command": type_cfg.get("command") or [
+                "python", "-m", "ray_tpu.scripts.cli", "start",
+                "--address", cfg.get("head_address", "auto"),
+                "--provider-id", name, "--block"],
+            "resources": {"limits": {}, "requests": {}},
+        }
+        spec: dict = {"restartPolicy": "Never", "containers": [container]}
+        req = container["resources"]["requests"]
+        lim = container["resources"]["limits"]
+        if type_cfg.get("cpu"):
+            req["cpu"] = str(type_cfg["cpu"])
+        if type_cfg.get("memory"):
+            req["memory"] = str(type_cfg["memory"])
+        chips = int(type_cfg.get("chips_per_host", 0))
+        if chips:
+            # GKE TPU podslice: google.com/tpu limits + the two GKE
+            # nodeSelectors route the pod onto the right slice nodepool.
+            lim["google.com/tpu"] = str(chips)
+            req["google.com/tpu"] = str(chips)
+            sel = spec.setdefault("nodeSelector", {})
+            if type_cfg.get("tpu_accelerator"):
+                sel["cloud.google.com/gke-tpu-accelerator"] = \
+                    type_cfg["tpu_accelerator"]
+            if type_cfg.get("tpu_topology"):
+                sel["cloud.google.com/gke-tpu-topology"] = \
+                    type_cfg["tpu_topology"]
+        if type_cfg.get("node_selector"):
+            spec.setdefault("nodeSelector", {}).update(
+                type_cfg["node_selector"])
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": name, "labels": labels,
+                         "namespace": self.namespace},
+            "spec": spec,
+        }
+        # Deep-merge a user pod_template last so anything above is
+        # overridable without this provider growing a knob per field.
+        template = type_cfg.get("pod_template") or cfg.get("pod_template")
+        if template:
+            pod = _deep_merge(template, pod)
+        return pod
+
+    # ---- NodeProvider API --------------------------------------------
+
+    def create_node(self, node_type: str, node_config: dict,
+                    count: int) -> List[str]:
+        cfg = self.provider_config
+        type_cfg = dict((cfg.get("node_types") or {}).get(node_type, {}))
+        type_cfg.update(node_config or {})
+        slice_hosts = int(type_cfg.get("slice_hosts", 1))
+        created: List[str] = []
+        try:
+            for _ in range(count):
+                slice_id = (f"{self.cluster_name}-"
+                            f"{uuid.uuid4().hex[:8]}")
+                for host in range(slice_hosts):
+                    name = (f"ray-{slice_id}-{host}"
+                            if slice_hosts > 1 else f"ray-{slice_id}")
+                    self._request(
+                        "POST",
+                        f"/api/v1/namespaces/{self.namespace}/pods",
+                        self._pod_manifest(
+                            name, node_type, type_cfg,
+                            slice_id=slice_id if slice_hosts > 1 else ""))
+                    created.append(name)
+        except Exception:
+            # Compensate a partial gang — pods the autoscaler never
+            # learns about must not keep running.
+            for name in created:
+                try:
+                    self._request(
+                        "DELETE",
+                        f"/api/v1/namespaces/{self.namespace}/pods/{name}")
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+            self._invalidate_listing()
+            raise
+        self._invalidate_listing()
+        return created
+
+    def _invalidate_listing(self):
+        self._list_cache = None
+
+    def _list_pods(self) -> List[dict]:
+        now = time.monotonic()
+        if (self._list_cache is not None
+                and now - self._list_cache_t < self._list_cache_ttl):
+            return self._list_cache
+        sel = f"ray.io%2Fcluster%3D{self.cluster_name}"
+        out: List[dict] = []
+        page = self._request(
+            "GET", f"/api/v1/namespaces/{self.namespace}/pods"
+                   f"?labelSelector={sel}")
+        out.extend(page.get("items", []))
+        while page.get("metadata", {}).get("continue"):
+            page = self._request(
+                "GET", f"/api/v1/namespaces/{self.namespace}/pods"
+                       f"?labelSelector={sel}"
+                       f"&continue={page['metadata']['continue']}")
+            out.extend(page.get("items", []))
+        self._list_cache = out
+        self._list_cache_t = now
+        return out
+
+    def _get_pod(self, provider_node_id: str) -> dict:
+        for p in self._list_pods():
+            if p.get("metadata", {}).get("name") == provider_node_id:
+                return p
+        raise RuntimeError(f"pod {provider_node_id!r} not found")
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [
+            p["metadata"]["name"] for p in self._list_pods()
+            if p.get("status", {}).get("phase", "Pending")
+            in self.LIVE_PHASES
+        ]
+
+    def node_tags(self, provider_node_id: str) -> Dict[str, str]:
+        p = self._get_pod(provider_node_id)
+        labels = p.get("metadata", {}).get("labels", {})
+        return {
+            "node_type": labels.get("ray.io/node-type", ""),
+            "node_id": "",
+            "state": p.get("status", {}).get("phase", ""),
+            "slice_id": labels.get("ray.io/slice-id", ""),
+            "launched_at": p.get("metadata", {})
+                            .get("creationTimestamp", ""),
+        }
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        # Terminating one host of a multi-host slice kills the gang — a
+        # podslice is an atomic scheduling unit (mirrors TPU slice
+        # semantics and KubeRay worker-group scaling).
+        try:
+            tags = self.node_tags(provider_node_id)
+        except RuntimeError:
+            tags = {}
+        victims = [provider_node_id]
+        slice_id = tags.get("slice_id", "")
+        if slice_id:
+            victims = [
+                p["metadata"]["name"] for p in self._list_pods()
+                if p.get("metadata", {}).get("labels", {})
+                    .get("ray.io/slice-id") == slice_id
+            ]
+        for name in victims:
+            self._request(
+                "DELETE",
+                f"/api/v1/namespaces/{self.namespace}/pods/{name}")
+        self._invalidate_listing()
+
+    def internal_ip(self, provider_node_id: str) -> str:
+        return self._get_pod(provider_node_id).get(
+            "status", {}).get("podIP", "")
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    """Recursive dict merge: override wins on scalars, merges on dicts."""
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
