@@ -98,6 +98,54 @@ def test_composition_deployment_graph(ray_mod):
     assert h.remote(5).result(timeout=30) == 15
 
 
+def test_diamond_deployment_graph(ray_mod):
+    """Diamond DAG (ref deployment_graph_build: a shared leaf Application
+    bound into two mid deployments must deploy ONCE and serve both):
+
+        ingress -> {left, right} -> scale  (shared leaf)
+    """
+    @serve.deployment
+    class Scale:
+        def __init__(self, k):
+            self.k = k
+
+        def __call__(self, x):
+            return x * self.k
+
+    @serve.deployment
+    class Left:
+        def __init__(self, scale):
+            self.scale = scale
+
+        async def __call__(self, x):
+            return await self.scale.remote(x + 1)
+
+    @serve.deployment
+    class Right:
+        def __init__(self, scale):
+            self.scale = scale
+
+        async def __call__(self, x):
+            return await self.scale.remote(x + 2)
+
+    @serve.deployment
+    class Fan:
+        def __init__(self, left, right):
+            self.left, self.right = left, right
+
+        async def __call__(self, x):
+            return (await self.left.remote(x)) + \
+                   (await self.right.remote(x))
+
+    shared = Scale.bind(10)
+    app = Fan.bind(Left.bind(shared), Right.bind(shared))
+    # Shared leaf appears once in the flattened graph.
+    assert sorted(app.flatten().keys()) == ["Fan", "Left", "Right", "Scale"]
+    h = serve.run(app, name="d4b", route_prefix="/diamond")
+    # (5+1)*10 + (5+2)*10
+    assert h.remote(5).result(timeout=30) == 130
+
+
 def test_http_proxy(ray_mod):
     @serve.deployment
     class Echo:
