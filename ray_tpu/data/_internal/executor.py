@@ -72,19 +72,29 @@ def _map_task(specs_blob, block):
     return out, acc.get_metadata()
 
 
-def _read_task(fn):
+def _read_task(fn, specs_blob=None):
     blocks = list(fn())
     out = BlockAccessor.concat(blocks) if len(blocks) != 1 else blocks[0]
+    if specs_blob:
+        import cloudpickle
+        out = apply_specs(out, cloudpickle.loads(specs_blob))
     return out, BlockAccessor.for_block(out).get_metadata()
 
 
-def _read_stream(fn):
+def _read_stream(fn, specs_blob=None):
     """Streaming read: each block the datasource yields ships the moment
     it is produced (reference: streaming generators feeding the executor,
     task_manager.h ObjectRefStream) — block and metadata as alternating
     stream items so the driver can consume metadata without pulling the
-    block."""
+    block. specs_blob (read->map fusion) transforms each block inside
+    this task before it ever leaves the worker."""
+    specs = None
+    if specs_blob:
+        import cloudpickle
+        specs = cloudpickle.loads(specs_blob)
     for block in fn():
+        if specs:
+            block = apply_specs(block, specs)
         yield block
         yield BlockAccessor.for_block(block).get_metadata()
 
@@ -338,12 +348,17 @@ class ReadOp(PhysOp):
     _PREFETCH = 4
     _STREAM_RETRIES = 2
 
-    def __init__(self, name, read_tasks: List[Callable], ctx, stats):
+    def __init__(self, name, read_tasks: List[Callable], ctx, stats,
+                 map_specs=None):
         super().__init__(name, ctx, stats)
         from ray_tpu._private import worker_api
         # Client mode can't host streams (no local stream state): fall
         # back to the materializing one-task-one-block read.
         self._streaming = worker_api.client_mode() is None
+        self._specs_blob = None
+        if map_specs:
+            import cloudpickle
+            self._specs_blob = cloudpickle.dumps(list(map_specs))
         if self._streaming:
             self._fn = ray_tpu.remote(_read_stream).options(
                 num_returns="streaming")
@@ -361,7 +376,7 @@ class ReadOp(PhysOp):
             while (self._reads and len(self._inflight) < self._cap
                    and self.can_accept_work()):
                 seq, task = self._reads.popleft()
-                bref, mref = self._fn.remote(task)
+                bref, mref = self._fn.remote(task, self._specs_blob)
                 self._inflight[mref] = (seq, time.perf_counter())
                 self._blockref[mref] = bref
             return
@@ -371,7 +386,8 @@ class ReadOp(PhysOp):
             self._active[seq] = self._fresh_state(task)
 
     def _fresh_state(self, task, retries: int = 0):
-        return {"gen": self._fn.remote(task), "task": task, "buf": deque(),
+        return {"gen": self._fn.remote(task, self._specs_blob),
+                "task": task, "buf": deque(),
                 "block": None, "done": False, "emitted": False,
                 "retries": retries, "t0": time.perf_counter()}
 
@@ -579,7 +595,7 @@ class StreamingExecutor:
         for node in chain:
             if isinstance(node, Read):
                 phys.append(ReadOp(node.name, node.read_tasks, self.ctx,
-                                   self.stats))
+                                   self.stats, map_specs=node.map_specs))
             elif isinstance(node, InputData):
                 phys.append(InputOp(list(zip(node.block_refs, node.metas)),
                                     self.ctx, self.stats))

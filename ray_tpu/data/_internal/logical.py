@@ -28,6 +28,11 @@ class Read(LogicalOperator):
     def __init__(self, read_tasks: List[Callable], name: str = "Read"):
         super().__init__(name, [])
         self.read_tasks = read_tasks
+        # Map stages fused INTO the read tasks (read->map fusion rule):
+        # each block a datasource yields is transformed inside the read
+        # task itself, so no intermediate block ever ships through the
+        # object store (reference: logical/rules/operator_fusion.py).
+        self.map_specs: List["MapSpec"] = []
 
 
 class InputData(LogicalOperator):
@@ -125,7 +130,7 @@ class ExecutionStats:
 
 
 def fuse_plan(op: LogicalOperator) -> LogicalOperator:
-    """Bottom-up fusion of consecutive AbstractMap stages."""
+    """Bottom-up rule pass: map->map fusion, then read->map fusion."""
     new_inputs = [fuse_plan(i) for i in op.inputs]
     op.inputs = new_inputs
     if (isinstance(op, AbstractMap) and len(new_inputs) == 1
@@ -134,5 +139,15 @@ def fuse_plan(op: LogicalOperator) -> LogicalOperator:
         parent = new_inputs[0]
         fused = parent.fused(op)
         fused.inputs = parent.inputs
+        return fuse_plan(fused)  # re-apply: parent's input may be a Read
+    if (isinstance(op, AbstractMap) and len(new_inputs) == 1
+            and isinstance(new_inputs[0], Read)
+            and op.compute is None and not op.ray_remote_args):
+        # read->map: run the transform chain inside the read task, per
+        # yielded block. Only for default task compute — actor pools and
+        # custom remote args need their own stage.
+        rd = new_inputs[0]
+        fused = Read(rd.read_tasks, name=f"{rd.name}->{op.name}")
+        fused.map_specs = rd.map_specs + op.specs
         return fused
     return op

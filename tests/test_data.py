@@ -257,8 +257,36 @@ def test_stats_and_fusion(ray_mod):
     ds = rd.range(10, parallelism=2).map(lambda r: r).map(lambda r: r)
     ds.count()
     s = ds.stats()
-    # Fused map stages execute as one operator.
-    assert "Map->Map" in s
+    # Map->Map fused, then the pair fused INTO the read (read->map rule):
+    # one operator does everything, no intermediate blocks ship.
+    assert "->Map->Map" in s
+    assert "\n  Map" not in s  # no standalone Map stage executed
+
+
+def test_read_map_fusion_applies_transform(ray_mod):
+    """Fused read->map yields transformed blocks (values, not just names)
+    and stats shows the single fused operator."""
+    ds = rd.range(8, parallelism=2).map(lambda r: {"id": r["id"] * 10})
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == [0, 10, 20, 30, 40, 50, 60, 70]
+    assert "->Map" in ds.stats()
+
+
+def test_read_map_no_fusion_for_actor_compute(ray_mod):
+    """ActorPoolStrategy map stages must NOT fuse into the read."""
+    from ray_tpu.data.dataset import ActorPoolStrategy
+
+    class AddOne:
+        def __call__(self, batch):
+            batch["id"] = batch["id"] + 1
+            return batch
+
+    ds = rd.range(8, parallelism=2).map_batches(
+        AddOne, compute=ActorPoolStrategy(size=1), batch_size=4)
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == list(range(1, 9))
+    s = ds.stats()
+    assert "MapBatches" in s and "->MapBatches" not in s
 
 
 def test_streaming_read_first_block_before_read_finishes(ray_mod):
