@@ -35,6 +35,11 @@ class AlgorithmConfig:
         # dict config path (Tune param_space) round-trips them too.
         self.policies: Optional[List[str]] = None
         self.policy_mapping_fn: Optional[Callable[[str], str]] = None
+        # Connector pipelines (reference: rllib/connectors/): extra
+        # env->module obs connectors and module->env action connectors
+        # appended to each runner's default pipeline.
+        self.obs_connectors: Optional[List[Any]] = None
+        self.action_connectors: Optional[List[Any]] = None
         self.extra: Dict[str, Any] = {}
 
     # -- fluent sections (reference: AlgorithmConfig.environment etc.) ----
@@ -47,13 +52,19 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners=None,
                     num_envs_per_env_runner=None,
-                    rollout_fragment_length=None) -> "AlgorithmConfig":
+                    rollout_fragment_length=None,
+                    obs_connectors=None,
+                    action_connectors=None) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if obs_connectors is not None:
+            self.obs_connectors = list(obs_connectors)
+        if action_connectors is not None:
+            self.action_connectors = list(action_connectors)
         return self
 
     def training(self, *, gamma=None, lr=None, train_batch_size=None,
@@ -156,7 +167,8 @@ class Algorithm(Trainable):
                 runner_cls.remote(creator, cfg.env_config,
                                   cfg.num_envs_per_env_runner,
                                   seed=cfg.seed + 1000 * i,
-                                  hidden=cfg.hidden)
+                                  hidden=cfg.hidden,
+                                  obs_connectors=cfg.obs_connectors)
                 for i in range(cfg.num_env_runners)
             ]
         self._episode_rewards: List[float] = []

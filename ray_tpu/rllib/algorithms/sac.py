@@ -17,7 +17,8 @@ import ray_tpu
 from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.env import make_env
-from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
+                                         ReplayBuffer)
 from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
 
 
@@ -36,11 +37,20 @@ class SACConfig(AlgorithmConfig):
         self.grad_steps_per_iter = 0        # 0 => one per sampled step
         self.train_batch_size = 256
         self.rollout_fragment_length = 64
+        # Prioritized experience replay (reference: sac.py
+        # replay_buffer_config prioritized_replay*): proportional
+        # priorities from |TD error|, importance weights into the
+        # critic loss.
+        self.prioritized_replay = False
+        self.prioritized_replay_alpha = 0.6
+        self.prioritized_replay_beta = 0.4
 
     def training(self, *, tau=None, actor_lr=None, critic_lr=None,
                  alpha_lr=None, initial_alpha=None, target_entropy=None,
                  buffer_capacity=None, random_warmup_steps=None,
-                 grad_steps_per_iter=None, **kw) -> "SACConfig":
+                 grad_steps_per_iter=None, prioritized_replay=None,
+                 prioritized_replay_alpha=None,
+                 prioritized_replay_beta=None, **kw) -> "SACConfig":
         super().training(**kw)
         for name, v in (("tau", tau), ("actor_lr", actor_lr),
                         ("critic_lr", critic_lr), ("alpha_lr", alpha_lr),
@@ -48,7 +58,12 @@ class SACConfig(AlgorithmConfig):
                         ("target_entropy", target_entropy),
                         ("buffer_capacity", buffer_capacity),
                         ("random_warmup_steps", random_warmup_steps),
-                        ("grad_steps_per_iter", grad_steps_per_iter)):
+                        ("grad_steps_per_iter", grad_steps_per_iter),
+                        ("prioritized_replay", prioritized_replay),
+                        ("prioritized_replay_alpha",
+                         prioritized_replay_alpha),
+                        ("prioritized_replay_beta",
+                         prioritized_replay_beta)):
             if v is not None:
                 setattr(self, name, v)
         return self
@@ -99,8 +114,12 @@ class SACLearner:
                     jnp.minimum(tq1, tq2) - alpha * logp2)
             target = jax.lax.stop_gradient(target)
             q1, q2 = twin_q_apply(critic, batch[sb.OBS], batch[sb.ACTIONS])
-            return ((q1 - target) ** 2 + (q2 - target) ** 2).mean(), \
-                0.5 * (q1.mean() + q2.mean())
+            # Per-sample importance weights (PER; ones when uniform) and
+            # |TD| out for priority updates.
+            w = batch["weights"]
+            td = jnp.abs(q1 - target)
+            loss = (w * ((q1 - target) ** 2 + (q2 - target) ** 2)).mean()
+            return loss, (0.5 * (q1.mean() + q2.mean()), td)
 
         def actor_loss(actor, state, batch, rng):
             a, logp = squashed_gaussian_sample(rng, actor, batch[sb.OBS],
@@ -115,7 +134,7 @@ class SACLearner:
 
         def update(state, opt_state, batch, rng):
             rng_c, rng_a = jax.random.split(rng)
-            (c_loss, q_mean), c_grads = jax.value_and_grad(
+            (c_loss, (q_mean, td)), c_grads = jax.value_and_grad(
                 critic_loss, has_aux=True)(state["critic"], state, batch,
                                            rng_c)
             upd, opt_state["critic"] = self._opt_critic.update(
@@ -143,7 +162,7 @@ class SACLearner:
                 "critic_loss": c_loss, "actor_loss": a_loss,
                 "alpha_loss": al_loss, "alpha": jnp.exp(state["log_alpha"]),
                 "mean_q": q_mean, "entropy": -mean_logp,
-            }
+            }, td
 
         self._jit_update = jax.jit(update)
         self._key = jax.random.PRNGKey(seed + 1)
@@ -151,6 +170,9 @@ class SACLearner:
     def update(self, batch: SampleBatch) -> Dict[str, float]:
         import jax
         import jax.numpy as jnp
+        import numpy as _np
+        w = batch["weights"] if "weights" in batch.keys() else \
+            _np.ones(len(batch), _np.float32)
         jb = {
             sb.OBS: jnp.asarray(batch[sb.OBS], jnp.float32),
             sb.ACTIONS: jnp.asarray(batch[sb.ACTIONS],
@@ -158,10 +180,12 @@ class SACLearner:
             sb.REWARDS: jnp.asarray(batch[sb.REWARDS], jnp.float32),
             sb.NEXT_OBS: jnp.asarray(batch[sb.NEXT_OBS], jnp.float32),
             sb.TERMINATEDS: jnp.asarray(batch[sb.TERMINATEDS], jnp.float32),
+            "weights": jnp.asarray(w, jnp.float32),
         }
         self._key, sub = jax.random.split(self._key)
-        self.state, self.opt_state, m = self._jit_update(
+        self.state, self.opt_state, m, td = self._jit_update(
             self.state, self.opt_state, jb, sub)
+        self.last_td_error = _np.asarray(td)
         return {k: float(v) for k, v in m.items()}
 
     def get_actor_weights(self):
@@ -186,12 +210,19 @@ class SAC(Algorithm):
         self.env_runners = [
             runner_cls.remote(creator, cfg.env_config,
                               cfg.num_envs_per_env_runner,
-                              seed=cfg.seed + 1000 * i, hidden=cfg.hidden)
+                              seed=cfg.seed + 1000 * i, hidden=cfg.hidden,
+                              obs_connectors=cfg.obs_connectors,
+                              action_connectors=cfg.action_connectors)
             for i in range(cfg.num_env_runners)
         ]
         self._episode_rewards = []
         self._steps_sampled = 0
-        self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        if cfg.prioritized_replay:
+            self.buffer = PrioritizedReplayBuffer(
+                cfg.buffer_capacity, alpha=cfg.prioritized_replay_alpha,
+                seed=cfg.seed)
+        else:
+            self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
         self.build_learner()
 
     def build_learner(self):
@@ -217,9 +248,19 @@ class SAC(Algorithm):
         grad_steps = cfg.grad_steps_per_iter or len(batch)
         metrics: Dict[str, Any] = {}
         if len(self.buffer) >= cfg.train_batch_size:
+            per = cfg.prioritized_replay
             for _ in range(grad_steps):
-                m = self.learner.update(
-                    self.buffer.sample(cfg.train_batch_size))
+                if per:
+                    sample = self.buffer.sample(
+                        cfg.train_batch_size,
+                        beta=cfg.prioritized_replay_beta)
+                else:
+                    sample = self.buffer.sample(cfg.train_batch_size)
+                m = self.learner.update(sample)
+                if per:
+                    self.buffer.update_priorities(
+                        sample["batch_indexes"],
+                        self.learner.last_td_error + 1e-6)
             metrics.update(m)
         self.broadcast_weights(self.learner.get_actor_weights())
         metrics["num_env_steps_sampled"] = self._steps_sampled

@@ -204,7 +204,9 @@ class TD3(Algorithm):
                               cfg.num_envs_per_env_runner,
                               seed=cfg.seed + 1000 * i, hidden=cfg.hidden,
                               policy="deterministic",
-                              expl_noise=cfg.expl_noise)
+                              expl_noise=cfg.expl_noise,
+                              obs_connectors=cfg.obs_connectors,
+                              action_connectors=cfg.action_connectors)
             for i in range(cfg.num_env_runners)
         ]
         self._episode_rewards = []

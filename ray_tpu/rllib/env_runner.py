@@ -20,9 +20,10 @@ from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
 class EnvRunner:
     def __init__(self, env_spec, env_config: dict, num_envs: int,
-                 seed: int, hidden=(64, 64)):
+                 seed: int, hidden=(64, 64), obs_connectors=None):
         import jax
         jax.config.update("jax_platforms", "cpu")
+        from ray_tpu.rllib.connectors import default_obs_pipeline
         self._envs = [make_env(env_spec, env_config) for _ in range(num_envs)]
         self._obs = []
         self._ep_rewards = [0.0] * num_envs
@@ -31,6 +32,10 @@ class EnvRunner:
             obs, _ = e.reset(seed=seed + i)
             self._obs.append(obs)
         self._rng = np.random.RandomState(seed)
+        # env->module connector pipeline: every obs batch goes through it
+        # before the policy forward AND before storage, so the learner
+        # trains in the same (preprocessed) observation space.
+        self._obs_conn = default_obs_pipeline(obs_connectors)
         obs_dim = self._envs[0].observation_dim
         n_act = self._envs[0].num_actions
         self._params = policy_value_init(jax.random.PRNGKey(seed), obs_dim,
@@ -52,7 +57,7 @@ class EnvRunner:
         per_env: List[Dict[str, List]] = [
             {k: [] for k in cols} for _ in range(n_envs)]
         for _t in range(num_steps):
-            obs_arr = np.stack(self._obs)
+            obs_arr = self._obs_conn(np.stack(self._obs))
             logits, values = self._jit_forward(self._params, obs_arr)
             logits = np.asarray(logits)
             values = np.asarray(values)
@@ -63,7 +68,7 @@ class EnvRunner:
                 logp = np.log(probs[i][a] + 1e-10)
                 obs2, r, term, trunc, _ = env.step(a)
                 rec = per_env[i]
-                rec[sb.OBS].append(self._obs[i])
+                rec[sb.OBS].append(obs_arr[i])
                 rec[sb.ACTIONS].append(a)
                 rec[sb.REWARDS].append(r)
                 rec[sb.TERMINATEDS].append(term)
@@ -74,7 +79,8 @@ class EnvRunner:
                 # next obs BEFORE the reset wipes it.
                 boot = 0.0
                 if trunc and not term:
-                    _lg, bv = self._jit_forward(self._params, obs2[None, :])
+                    nxt = self._obs_conn(obs2[None, :], update=False)
+                    _lg, bv = self._jit_forward(self._params, nxt)
                     boot = float(np.asarray(bv)[0])
                 rec[sb.BOOTSTRAP_VALUES].append(boot)
                 self._ep_rewards[i] += r
@@ -84,7 +90,7 @@ class EnvRunner:
                     obs2, _ = env.reset()
                 self._obs[i] = obs2
         batches = []
-        obs_arr = np.stack(self._obs)
+        obs_arr = self._obs_conn(np.stack(self._obs), update=False)
         _, last_values = self._jit_forward(self._params, obs_arr)
         last_values = np.asarray(last_values)
         for i in range(n_envs):
@@ -102,7 +108,7 @@ class EnvRunner:
         cols = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS,
                                 sb.NEXT_OBS, sb.TERMINATEDS)}
         for _t in range(num_steps):
-            obs_arr = np.stack(self._obs)
+            obs_arr = self._obs_conn(np.stack(self._obs))
             scores, _ = self._jit_forward(self._params, obs_arr)
             scores = np.asarray(scores)
             for i, env in enumerate(self._envs):
@@ -111,10 +117,11 @@ class EnvRunner:
                 else:
                     a = int(np.argmax(scores[i]))
                 obs2, r, term, trunc, _ = env.step(a)
-                cols[sb.OBS].append(self._obs[i])
+                cols[sb.OBS].append(obs_arr[i])
                 cols[sb.ACTIONS].append(a)
                 cols[sb.REWARDS].append(r)
-                cols[sb.NEXT_OBS].append(obs2)
+                cols[sb.NEXT_OBS].append(
+                    self._obs_conn(obs2[None, :], update=False)[0])
                 cols[sb.TERMINATEDS].append(term)
                 self._ep_rewards[i] += r
                 if term or trunc:
@@ -157,10 +164,13 @@ class ContinuousEnvRunner(_RewardTracker):
 
     def __init__(self, env_spec, env_config: dict, num_envs: int,
                  seed: int, hidden=(64, 64), policy: str = "squashed_gaussian",
-                 expl_noise: float = 0.1):
+                 expl_noise: float = 0.1, obs_connectors=None,
+                 action_connectors=None):
         import jax
         import jax.numpy as jnp
         jax.config.update("jax_platforms", "cpu")
+        from ray_tpu.rllib.connectors import (default_action_pipeline,
+                                              default_obs_pipeline)
         from ray_tpu.rllib.models import (det_actor_apply, det_actor_init,
                                           squashed_gaussian_init,
                                           squashed_gaussian_sample)
@@ -168,6 +178,9 @@ class ContinuousEnvRunner(_RewardTracker):
         e0 = self._envs[0]
         assert e0.continuous, "ContinuousEnvRunner needs a continuous env"
         self._low, self._high = e0.action_low, e0.action_high
+        self._obs_conn = default_obs_pipeline(obs_connectors)
+        self._act_conn = default_action_pipeline(self._low, self._high,
+                                                 action_connectors)
         self._seed = seed
         self._obs = []
         self._ep_rewards = [0.0] * num_envs
@@ -214,7 +227,7 @@ class ContinuousEnvRunner(_RewardTracker):
         rng = np.random.RandomState(
             (self._seed * 9973 + steps_done + 1) % (2 ** 31))
         for t in range(num_steps):
-            obs_arr = np.stack(self._obs)
+            obs_arr = self._obs_conn(np.stack(self._obs))
             if steps_done + t < random_until:
                 acts = rng.uniform(self._low, self._high,
                                    size=(len(self._envs),
@@ -223,12 +236,14 @@ class ContinuousEnvRunner(_RewardTracker):
                 self._key, sub = jax.random.split(self._key)
                 acts, _ = self._jit_sample(sub, self._params, obs_arr)
                 acts = np.asarray(acts)
+            acts = self._act_conn(acts)
             for i, env in enumerate(self._envs):
                 obs2, r, term, trunc, _ = env.step(acts[i])
-                cols[sb.OBS].append(self._obs[i])
+                cols[sb.OBS].append(obs_arr[i])
                 cols[sb.ACTIONS].append(acts[i])
                 cols[sb.REWARDS].append(r)
-                cols[sb.NEXT_OBS].append(obs2)
+                cols[sb.NEXT_OBS].append(
+                    self._obs_conn(obs2[None, :], update=False)[0])
                 cols[sb.TERMINATEDS].append(term)
                 self._ep_rewards[i] += r
                 if term or trunc:
