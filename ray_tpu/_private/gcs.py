@@ -274,15 +274,22 @@ class GcsServer:
                 while (await asyncio.wait_for(reader.readline(), 5)) \
                         not in (b"\r\n", b"\n", b""):
                     pass
+                from urllib.parse import parse_qs, urlsplit
+                q = parse_qs(urlsplit(path).query)
                 api_routes = {
                     "/api/status": self._status_summary,
                     "/api/actors": self._actors_table,
                     "/api/jobs": self._jobs_table,
                     "/api/pgs": self._pgs_table,
                     "/api/tasks": self._tasks_summary,
+                    "/api/timeline": self._timeline_trace,
+                    "/api/logs": self._logs_index,
+                    "/api/logtail": lambda: self._log_tail(
+                        q.get("file", [""])[0],
+                        int(q.get("n", ["200"])[0] or 200)),
                 }
                 route = next((fn for p, fn in api_routes.items()
-                              if path.startswith(p)), None)
+                              if urlsplit(path).path == p), None)
                 if path.startswith("/metrics"):
                     from ray_tpu.util import metrics as m
                     body = m.to_prometheus(self._merged_metrics())
@@ -413,6 +420,66 @@ class GcsServer:
             "bundles": len(p.bundles),
             "placed": len(p.bundle_nodes),
         } for p in self.placement_groups.values()]
+
+    def _timeline_trace(self) -> list:
+        """Chrome-trace 'X' events from the task-event buffer (server-side
+        twin of ray_tpu.timeline(); feeds the dashboard timeline panel)."""
+        trace = []
+        starts: Dict[str, dict] = {}
+        for e in self.task_events:
+            if e.get("state") == "RUNNING":
+                starts[e["task_id"]] = e
+            elif e.get("state") in ("FINISHED", "FAILED") \
+                    and e.get("task_id") in starts:
+                s = starts.pop(e["task_id"])
+                trace.append({
+                    "cat": "task", "name": e.get("name", ""), "ph": "X",
+                    "ts": s["time"] * 1e6,
+                    "dur": (e["time"] - s["time"]) * 1e6,
+                    "pid": e.get("worker_id", "")[:8], "tid": 0,
+                    "state": e.get("state"),
+                })
+        return trace
+
+    def _logs_dir(self) -> str:
+        return os.path.join(self.session_dir, "logs") \
+            if self.session_dir else ""
+
+    def _logs_index(self) -> list:
+        """Head-node log files (worker/raylet/driver streams). Per-node
+        agents would extend this to remote nodes; the head covers the
+        single-node and driver cases the dashboard panel needs."""
+        d = self._logs_dir()
+        if not d or not os.path.isdir(d):
+            return []
+        out = []
+        for name in sorted(os.listdir(d)):
+            p = os.path.join(d, name)
+            try:
+                out.append({"file": name, "bytes": os.path.getsize(p),
+                            "mtime": os.path.getmtime(p)})
+            except OSError:
+                continue
+        return out
+
+    def _log_tail(self, fname: str, n_lines: int = 200) -> dict:
+        d = self._logs_dir()
+        # basename() strips any traversal; the join must stay inside the
+        # session's logs dir (untrusted query input).
+        safe = os.path.basename(fname or "")
+        path = os.path.join(d, safe) if d else ""
+        if not safe or not d or not os.path.isfile(path):
+            return {"file": safe, "lines": [], "error": "not found"}
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 256 * 1024))
+                tail = f.read().decode("utf-8", "replace")
+        except OSError as e:
+            return {"file": safe, "lines": [], "error": str(e)}
+        lines = tail.splitlines()[-max(1, min(n_lines, 2000)):]
+        return {"file": safe, "lines": lines}
 
     def _tasks_summary(self) -> list:
         """Counts by (task name, latest state) — `ray summary tasks`."""
@@ -1001,79 +1068,183 @@ def _fits(request: Dict[str, float], available: Dict[str, float]) -> bool:
     return all(available.get(k, 0.0) >= v for k, v in request.items() if v > 0)
 
 
-# Minimal live dashboard (reference: dashboard/ React client — here a
-# dependency-free page polling /api/status + /metrics).
+# Live dashboard SPA (reference capability: dashboard/ React client +
+# per-module REST — here a single self-contained page served from the GCS:
+# tabbed tables, a canvas task-timeline, per-worker log tail, and
+# sparkline metrics built client-side from /metrics polling).
 _DASHBOARD_HTML = """<!doctype html>
 <html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
 <style>
- body{font-family:system-ui,sans-serif;margin:2rem;color:#222}
- h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
- table{border-collapse:collapse;min-width:40rem}
- td,th{border:1px solid #ccc;padding:.35rem .6rem;text-align:left;
-       font-size:.85rem}
+ body{font-family:system-ui,sans-serif;margin:1.2rem;color:#222}
+ h1{font-size:1.25rem;margin:.2rem 0 .8rem}
+ nav{display:flex;gap:.4rem;margin-bottom:1rem;flex-wrap:wrap}
+ nav button{border:1px solid #bbb;background:#f6f6f6;padding:.35rem .9rem;
+   border-radius:.4rem;cursor:pointer;font-size:.9rem}
+ nav button.active{background:#1a73e8;color:#fff;border-color:#1a73e8}
+ table{border-collapse:collapse;min-width:40rem;margin-bottom:1rem}
+ td,th{border:1px solid #ccc;padding:.3rem .55rem;text-align:left;
+   font-size:.85rem}
  th{background:#f3f3f3} .dead{color:#b00} .ok{color:#080}
- pre{background:#f7f7f7;padding:.8rem;max-height:22rem;overflow:auto}
+ pre{background:#0e1116;color:#cdd5e0;padding:.8rem;max-height:26rem;
+   overflow:auto;font-size:.78rem;border-radius:.4rem}
+ .cards{display:flex;gap:1rem;flex-wrap:wrap;margin-bottom:1rem}
+ .card{border:1px solid #ddd;border-radius:.5rem;padding:.6rem .9rem;
+   min-width:11rem}
+ .card b{font-size:1.3rem;display:block}
+ .card span{font-size:.78rem;color:#666}
+ canvas.spark{display:block;margin-top:.3rem}
+ #timelineC{border:1px solid #ddd;width:100%;height:420px}
+ .loglist button{margin:.1rem;border:1px solid #ccc;background:#fafafa;
+   padding:.2rem .5rem;border-radius:.3rem;cursor:pointer;font-size:.78rem}
+ .panel{display:none}.panel.active{display:block}
 </style></head><body>
 <h1>ray_tpu dashboard</h1>
-<div id="summary"></div>
-<h2>Nodes</h2><table id="nodes"><thead><tr>
-<th>node</th><th>state</th><th>head</th><th>address</th>
-<th>CPU</th><th>TPU</th></tr></thead><tbody></tbody></table>
-<h2>Actors</h2><table id="actors"><thead><tr>
-<th>actor</th><th>name</th><th>class</th><th>state</th><th>node</th>
-<th>restarts</th></tr></thead><tbody></tbody></table>
-<h2>Jobs</h2><table id="jobs"><thead><tr>
-<th>job</th><th>entrypoint</th><th>state</th><th>started</th>
-<th>ended</th></tr></thead><tbody></tbody></table>
-<h2>Placement groups</h2><table id="pgs"><thead><tr>
-<th>pg</th><th>name</th><th>strategy</th><th>state</th>
-<th>bundles placed</th></tr></thead><tbody></tbody></table>
-<h2>Tasks</h2><table id="tasks"><thead><tr>
-<th>name</th><th>state</th><th>count</th></tr></thead><tbody></tbody>
-</table>
-<h2>Metrics</h2><pre id="metrics">loading…</pre>
+<nav id="tabs"></nav>
+<div class="panel" id="p-overview">
+ <div class="cards" id="cards"></div>
+ <h2>Nodes</h2><table id="nodes"><thead><tr>
+ <th>node</th><th>state</th><th>head</th><th>address</th>
+ <th>CPU</th><th>TPU</th></tr></thead><tbody></tbody></table>
+</div>
+<div class="panel" id="p-actors">
+ <table id="actors"><thead><tr>
+ <th>actor</th><th>name</th><th>class</th><th>state</th><th>node</th>
+ <th>restarts</th></tr></thead><tbody></tbody></table>
+</div>
+<div class="panel" id="p-jobs">
+ <table id="jobs"><thead><tr>
+ <th>job</th><th>entrypoint</th><th>state</th><th>started</th>
+ <th>ended</th></tr></thead><tbody></tbody></table>
+ <h2>Placement groups</h2><table id="pgs"><thead><tr>
+ <th>pg</th><th>name</th><th>strategy</th><th>state</th>
+ <th>bundles placed</th></tr></thead><tbody></tbody></table>
+</div>
+<div class="panel" id="p-tasks">
+ <table id="tasks"><thead><tr>
+ <th>name</th><th>state</th><th>count</th></tr></thead><tbody></tbody>
+ </table>
+</div>
+<div class="panel" id="p-timeline">
+ <p style="font-size:.8rem;color:#666">Completed task spans per worker
+ (latest buffer; darker = FAILED).</p>
+ <canvas id="timelineC"></canvas>
+</div>
+<div class="panel" id="p-logs">
+ <div class="loglist" id="loglist"></div>
+ <pre id="logview">(pick a file)</pre>
+</div>
+<div class="panel" id="p-metrics">
+ <pre id="metrics">loading…</pre>
+</div>
 <script>
-async function tick(){
- try{
-  const st = await (await fetch('/api/status')).json();
-  document.getElementById('summary').textContent =
-    `alive jobs: ${st.jobs_alive} · alive actors: ${st.actors_alive}` +
-    ` · pending demand: ${st.pending_demand}`;
-  const tb = document.querySelector('#nodes tbody'); tb.innerHTML='';
-  for(const n of st.nodes){
-   const avail=(r)=> (n.resources_available[r]??0)+'/'+
-                     (n.resources_total[r]??0);
-   // Node fields are untrusted (any registrant chooses them): build the
-   // row with textContent, never innerHTML.
-   const tr=document.createElement('tr');
-   const cells=[n.node_id.slice(0,12), n.alive?'ALIVE':'DEAD',
-                n.is_head?'yes':'', n.address, avail('CPU'), avail('TPU')];
-   for(const [i,v] of cells.entries()){
-    const td=document.createElement('td');
-    td.textContent=String(v);
-    if(i===1) td.className = n.alive?'ok':'dead';
-    tr.appendChild(td);
-   }
-   tb.appendChild(tr);
-  }
-  document.getElementById('metrics').textContent =
-    await (await fetch('/metrics')).text();
-  await fillTable('/api/actors', '#actors',
-    a=>[a.actor_id.slice(0,12), a.name, a.class_name, a.state,
-        a.node_id.slice(0,12), a.num_restarts],
-    (a,i,td)=>{ if(i===3) td.className = a.state==='ALIVE'?'ok':
-                (a.state==='DEAD'?'dead':''); });
-  await fillTable('/api/jobs', '#jobs',
-    j=>[j.job_id.slice(0,12), j.entrypoint, j.alive?'RUNNING':'FINISHED',
-        new Date(j.start_time*1000).toLocaleTimeString(),
-        j.end_time? new Date(j.end_time*1000).toLocaleTimeString():''],
-    (j,i,td)=>{ if(i===2) td.className = j.alive?'ok':''; });
-  await fillTable('/api/pgs', '#pgs',
-    p=>[p.pg_id.slice(0,12), p.name, p.strategy, p.state,
-        `${p.placed}/${p.bundles}`]);
-  await fillTable('/api/tasks', '#tasks',
-    t=>[t.name, t.state, t.count]);
- }catch(e){ document.getElementById('summary').textContent = 'error: '+e; }
+const TABS=[["overview","Overview"],["actors","Actors"],["jobs","Jobs/PGs"],
+  ["tasks","Tasks"],["timeline","Timeline"],["logs","Logs"],
+  ["metrics","Metrics"]];
+let active="overview", logFile=null;
+const nav=document.getElementById('tabs');
+for(const [id,label] of TABS){
+ const b=document.createElement('button');
+ b.textContent=label; b.id='tab-'+id;
+ b.onclick=()=>{active=id; render(); tick();};
+ nav.appendChild(b);
+}
+function render(){
+ for(const [id] of TABS){
+  document.getElementById('p-'+id).classList.toggle('active',id===active);
+  document.getElementById('tab-'+id).classList.toggle('active',id===active);
+ }
+}
+// Sparkline history built client-side from /metrics polls.
+const hist={}; const HIST_N=90;
+function pushHist(name,v){
+ (hist[name]=hist[name]||[]).push(v);
+ if(hist[name].length>HIST_N) hist[name].shift();
+}
+function sparkline(canvas,vals){
+ const w=canvas.width=160, h=canvas.height=34;
+ const g=canvas.getContext('2d'); g.clearRect(0,0,w,h);
+ if(vals.length<2) return;
+ const mx=Math.max(...vals), mn=Math.min(...vals), r=(mx-mn)||1;
+ g.strokeStyle='#1a73e8'; g.lineWidth=1.4; g.beginPath();
+ vals.forEach((v,i)=>{
+  const x=i*(w-2)/(vals.length-1)+1, y=h-3-(v-mn)*(h-6)/r;
+  i?g.lineTo(x,y):g.moveTo(x,y);
+ });
+ g.stroke();
+}
+function parseProm(text){
+ const out={};
+ for(const ln of text.split('\n')){
+  if(!ln||ln.startsWith('#')) continue;
+  const sp=ln.lastIndexOf(' ');
+  if(sp>0){ out[ln.slice(0,sp)]=(out[ln.slice(0,sp)]||0)+
+            (parseFloat(ln.slice(sp+1))||0); }
+ }
+ return out;
+}
+const CARD_METRICS=[
+ ["ray_tpu_nodes_alive","nodes alive"],
+ ['ray_tpu_actors{State="ALIVE"}',"actors alive"],
+ ["ray_tpu_jobs_alive","jobs alive"],
+ ["ray_tpu_placement_groups","placement groups"],
+];
+function drawCards(prom,st){
+ const cards=document.getElementById('cards'); cards.innerHTML='';
+ for(const [key,label] of CARD_METRICS){
+  const v=prom[key]??0; pushHist(key,v);
+  const d=document.createElement('div'); d.className='card';
+  const b=document.createElement('b'); b.textContent=String(v);
+  const s=document.createElement('span'); s.textContent=label;
+  const c=document.createElement('canvas'); c.className='spark';
+  d.append(b,s,c); cards.appendChild(d);
+  sparkline(c,hist[key]);
+ }
+ const d=document.createElement('div'); d.className='card';
+ const b=document.createElement('b');
+ b.textContent=String(st.pending_demand);
+ const s=document.createElement('span'); s.textContent='pending demand';
+ d.append(b,s); cards.appendChild(d);
+}
+function drawTimeline(trace){
+ const c=document.getElementById('timelineC');
+ c.width=c.clientWidth; c.height=420;
+ const g=c.getContext('2d'); g.clearRect(0,0,c.width,c.height);
+ if(!trace.length){ g.fillStyle='#888';
+   g.fillText('no completed tasks yet',20,20); return; }
+ const t0=Math.min(...trace.map(e=>e.ts));
+ const t1=Math.max(...trace.map(e=>e.ts+e.dur));
+ const span=(t1-t0)||1;
+ const lanes=[...new Set(trace.map(e=>e.pid))];
+ const laneH=Math.min(26,(c.height-30)/Math.max(lanes.length,1));
+ g.font='11px system-ui';
+ lanes.forEach((p,i)=>{ g.fillStyle='#555';
+   g.fillText(p||'driver',2,18+i*laneH); });
+ for(const e of trace){
+  const x=60+(e.ts-t0)/span*(c.width-70);
+  const w=Math.max(2,e.dur/span*(c.width-70));
+  const y=8+lanes.indexOf(e.pid)*laneH;
+  g.fillStyle=e.state==='FAILED'?'#b00020':'#4a90d9';
+  g.fillRect(x,y,w,laneH-6);
+ }
+ g.fillStyle='#555';
+ g.fillText(((span)/1e6).toFixed(3)+' s span',c.width-90,c.height-6);
+}
+async function drawLogs(){
+ const files=await (await fetch('/api/logs')).json();
+ const list=document.getElementById('loglist'); list.innerHTML='';
+ for(const f of files){
+  const b=document.createElement('button');
+  b.textContent=f.file+' ('+f.bytes+'B)';
+  b.onclick=async()=>{ logFile=f.file; await tailLog(); };
+  list.appendChild(b);
+ }
+ if(logFile) await tailLog();
+}
+async function tailLog(){
+ const r=await (await fetch('/api/logtail?file='+
+   encodeURIComponent(logFile)+'&n=300')).json();
+ document.getElementById('logview').textContent=
+   (r.error? 'error: '+r.error : r.lines.join('\n')) || '(empty)';
 }
 // All table fields are untrusted (any registrant chooses them): rows are
 // built with textContent, never innerHTML.
@@ -1091,5 +1262,52 @@ async function fillTable(url, sel, cells, decorate){
   tb.appendChild(tr);
  }
 }
-tick(); setInterval(tick, 2000);
+async function tick(){
+ try{
+  const st = await (await fetch('/api/status')).json();
+  const promText = await (await fetch('/metrics')).text();
+  if(active==='overview'){
+   drawCards(parseProm(promText), st);
+   const tb = document.querySelector('#nodes tbody'); tb.innerHTML='';
+   for(const n of st.nodes){
+    const avail=(r)=> (n.resources_available[r]??0)+'/'+
+                      (n.resources_total[r]??0);
+    const tr=document.createElement('tr');
+    const cells=[n.node_id.slice(0,12), n.alive?'ALIVE':'DEAD',
+                 n.is_head?'yes':'', n.address, avail('CPU'),
+                 avail('TPU')];
+    for(const [i,v] of cells.entries()){
+     const td=document.createElement('td');
+     td.textContent=String(v);
+     if(i===1) td.className = n.alive?'ok':'dead';
+     tr.appendChild(td);
+    }
+    tb.appendChild(tr);
+   }
+  }
+  if(active==='actors') await fillTable('/api/actors', '#actors',
+    a=>[a.actor_id.slice(0,12), a.name, a.class_name, a.state,
+        a.node_id.slice(0,12), a.num_restarts],
+    (a,i,td)=>{ if(i===3) td.className = a.state==='ALIVE'?'ok':
+                (a.state==='DEAD'?'dead':''); });
+  if(active==='jobs'){
+   await fillTable('/api/jobs', '#jobs',
+     j=>[j.job_id.slice(0,12), j.entrypoint, j.alive?'RUNNING':'FINISHED',
+         new Date(j.start_time*1000).toLocaleTimeString(),
+         j.end_time? new Date(j.end_time*1000).toLocaleTimeString():''],
+     (j,i,td)=>{ if(i===2) td.className = j.alive?'ok':''; });
+   await fillTable('/api/pgs', '#pgs',
+     p=>[p.pg_id.slice(0,12), p.name, p.strategy, p.state,
+         `${p.placed}/${p.bundles}`]);
+  }
+  if(active==='tasks') await fillTable('/api/tasks', '#tasks',
+    t=>[t.name, t.state, t.count]);
+  if(active==='timeline')
+    drawTimeline(await (await fetch('/api/timeline')).json());
+  if(active==='logs') await drawLogs();
+  if(active==='metrics')
+    document.getElementById('metrics').textContent = promText;
+ }catch(e){ /* transient poll errors: keep last view */ }
+}
+render(); tick(); setInterval(tick, 2000);
 </script></body></html>"""
