@@ -856,8 +856,27 @@ class GcsServer:
                     out.append({"namespace": namespace, "name": name})
         return out
 
+    @staticmethod
+    def _match_filters(obj, filters) -> bool:
+        """Server-side filter predicates: [(attr, op, value)] with op in
+        {'=', '!='} (reference: util/state/common.py supported ops). Attr
+        values compare as strings so hex ids and enums both work."""
+        for attr, op, want in filters or []:
+            have = obj.get(attr) if isinstance(obj, dict) \
+                else getattr(obj, attr, None)
+            if hasattr(have, "hex"):
+                have = have.hex()
+            eq = str(have) == str(want)
+            if (op == "=" and not eq) or (op == "!=" and eq):
+                return False
+        return True
+
     async def rpc_get_all_actors(self, conn, payload):
-        return list(self.actors.values())
+        filters = (payload or {}).get("filters")
+        limit = (payload or {}).get("limit")
+        out = [a for a in self.actors.values()
+               if self._match_filters(a, filters)]
+        return out[:limit] if limit else out
 
     # ------------- placement groups -------------
 
@@ -1032,8 +1051,10 @@ class GcsServer:
     async def rpc_get_task_events(self, conn, payload):
         job_id = payload.get("job_id")
         limit = payload.get("limit", 10000)
+        filters = payload.get("filters")
         out = [e for e in self.task_events
-               if job_id is None or e.get("job_id") == job_id]
+               if (job_id is None or e.get("job_id") == job_id)
+               and self._match_filters(e, filters)]
         return out[-limit:]
 
     # ------------- persistence (GCS fault tolerance) -------------

@@ -28,11 +28,21 @@ def list_nodes() -> List[dict]:
     } for n in _gcs("get_all_nodes")]
 
 
-def list_actors(state: Optional[str] = None) -> List[dict]:
+Filter = tuple  # (attribute, "=" | "!=", value)
+
+
+def list_actors(state: Optional[str] = None,
+                filters: Optional[List[Filter]] = None,
+                limit: Optional[int] = None) -> List[dict]:
+    """Filters evaluate SERVER-side in the GCS (reference:
+    list_actors(filters=[("state", "=", "ALIVE")]), api.py:782) — only
+    matching rows cross the wire, so a 40k-actor cluster doesn't ship its
+    whole table per query."""
+    filters = list(filters or [])
+    if state is not None:
+        filters.append(("state", "=", state))
     out = []
-    for a in _gcs("get_all_actors"):
-        if state is not None and a.state != state:
-            continue
+    for a in _gcs("get_all_actors", {"filters": filters, "limit": limit}):
         out.append({
             "actor_id": a.actor_id.hex(), "class_name": a.class_name,
             "state": a.state, "name": a.name, "namespace": a.namespace,
@@ -43,9 +53,12 @@ def list_actors(state: Optional[str] = None) -> List[dict]:
     return out
 
 
-def list_tasks(job_id: Optional[str] = None, limit: int = 1000) -> List[dict]:
-    """Latest-state view of task events."""
-    events = _gcs("get_task_events", {"job_id": job_id, "limit": 100000})
+def list_tasks(job_id: Optional[str] = None, limit: int = 1000,
+               filters: Optional[List[Filter]] = None) -> List[dict]:
+    """Latest-state view of task events; `filters` evaluate server-side
+    over raw events (attrs: name/state/task_id/worker_id...)."""
+    events = _gcs("get_task_events", {"job_id": job_id, "limit": 100000,
+                                      "filters": list(filters or [])})
     latest: Dict[str, dict] = {}
     for e in events:
         latest[e["task_id"]] = e

@@ -80,6 +80,17 @@ def test_state_list_actors(cluster):
     actors = state.list_actors(state="ALIVE")
     assert any(x["class_name"] == "Marker" and x["name"] == "state-probe"
                for x in actors)
+    # Server-side filters (reference list_actors(filters=...) api.py:782):
+    # only matching rows cross the wire.
+    mine = state.list_actors(filters=[("class_name", "=", "Marker"),
+                                      ("state", "=", "ALIVE")])
+    assert mine and all(x["class_name"] == "Marker" for x in mine)
+    none = state.list_actors(filters=[("class_name", "=", "NoSuch")])
+    assert none == []
+    neg = state.list_actors(filters=[("class_name", "!=", "Marker")])
+    assert all(x["class_name"] != "Marker" for x in neg)
+    # limit caps rows server-side
+    assert len(state.list_actors(limit=1)) <= 1
 
 
 def test_state_list_tasks_and_summary(cluster):
@@ -93,6 +104,9 @@ def test_state_list_tasks_and_summary(cluster):
     time.sleep(1.5)  # task-event flush interval
     rows = state.list_tasks()
     assert any(r["name"] == "tracked" for r in rows)
+    only = state.list_tasks(filters=[("name", "=", "tracked"),
+                                     ("state", "=", "FINISHED")])
+    assert only and all(r["name"] == "tracked" for r in only)
     summary = state.summarize_tasks()
     assert "tracked" in summary
 
