@@ -8,9 +8,11 @@ for multi-node); each step's result is pickled under a content-derived
 step id, and resume() replays only the missing steps.
 """
 
-from ray_tpu.workflow.api import (WorkflowStatus, delete, get_output,
-                                  get_status, list_all, resume, run,
-                                  run_async)
+from ray_tpu.workflow.api import (Continuation, EventListener,
+                                  WorkflowStatus, continuation, delete,
+                                  get_output, get_status, list_all, resume,
+                                  run, run_async, wait_for_event)
 
 __all__ = ["run", "run_async", "resume", "get_output", "get_status",
-           "list_all", "delete", "WorkflowStatus"]
+           "list_all", "delete", "WorkflowStatus", "continuation",
+           "Continuation", "EventListener", "wait_for_event"]

@@ -128,33 +128,10 @@ class _WorkflowRun:
 
     def execute(self, dag: DAGNode, args: tuple) -> Any:
         """Step-wise execution with per-step checkpoint + skip."""
-        import ray_tpu
-        memo: Dict[int, str] = {}
-        used: Dict[str, int] = {}
-        results: Dict[int, Any] = {}
         self.write_meta(status=WorkflowStatus.RUNNING,
                         start_time=time.time())
         try:
-            for node in dag._topo():
-                sid = _step_id(node, memo, used)
-                if isinstance(node, InputNode):
-                    results[id(node)] = (args[0] if len(args) == 1
-                                         else args)
-                    continue
-                if isinstance(node, MultiOutputNode):
-                    results[id(node)] = [results[id(o)]
-                                         for o in node._bound_args]
-                    continue
-                if self.has_step(sid):
-                    results[id(node)] = self.load_step(sid)
-                    continue
-                ref = node._execute_one(
-                    {k: ImmediateValue(v) for k, v in results.items()},
-                    args, {})
-                value = ray_tpu.get(ref, timeout=3600)
-                self.save_step(sid, value)
-                results[id(node)] = value
-            out = results[id(dag)]
+            out = self._exec_dag(dag, args, prefix="")
             self.save_step("__output__", out)
             self.write_meta(status=WorkflowStatus.SUCCESSFUL,
                             end_time=time.time())
@@ -163,6 +140,103 @@ class _WorkflowRun:
             self.write_meta(status=WorkflowStatus.FAILED, error=repr(e),
                             end_time=time.time())
             raise
+
+    def _exec_dag(self, dag: DAGNode, args: tuple, prefix: str) -> Any:
+        import ray_tpu
+        memo: Dict[int, str] = {}
+        used: Dict[str, int] = {}
+        results: Dict[int, Any] = {}
+        for node in dag._topo():
+            sid = prefix + _step_id(node, memo, used)
+            if isinstance(node, InputNode):
+                results[id(node)] = (args[0] if len(args) == 1
+                                     else args)
+                continue
+            if isinstance(node, MultiOutputNode):
+                results[id(node)] = [results[id(o)]
+                                     for o in node._bound_args]
+                continue
+            if self.has_step(sid):
+                results[id(node)] = self.load_step(sid)
+                continue
+            ref = node._execute_one(
+                {k: ImmediateValue(v) for k, v in results.items()},
+                args, {})
+            value = ray_tpu.get(ref, timeout=3600)
+            # Dynamic continuation (reference: workflow.continuation,
+            # python/ray/workflow/api.py:123): a step may RETURN a new
+            # DAG; the engine keeps executing it in place of the step's
+            # value, sub-step checkpoints scoped under this step's id so
+            # a tail-recursive workflow resumes at the deepest completed
+            # frame.
+            depth = 0
+            while isinstance(value, Continuation):
+                depth += 1
+                value = self._exec_dag(value.dag, value.args,
+                                       prefix=f"{sid}~c{depth}~")
+            self.save_step(sid, value)
+            results[id(node)] = value
+        return results[id(dag)]
+
+
+class Continuation:
+    """A step's returned 'rest of the workflow' (see continuation())."""
+
+    __slots__ = ("dag", "args")
+
+    def __init__(self, dag: DAGNode, args: tuple = ()):
+        self.dag = dag
+        self.args = args
+
+
+def continuation(dag: DAGNode, *args) -> Continuation:
+    """Return this from a workflow step to CONTINUE the workflow with a
+    dynamically-built DAG (reference: workflow.continuation,
+    python/ray/workflow/api.py:123). The engine executes the new DAG in
+    place of the step's value, checkpointing its sub-steps, so recursive
+    workflows (the reference's factorial example) resume mid-recursion.
+    """
+    return Continuation(dag, args)
+
+
+class EventListener:
+    """Pollable external-event source (reference:
+    python/ray/workflow/event_listener.py). Subclass and implement
+    poll_for_event(*args) -> payload | None; the workflow step completes
+    (and checkpoints the payload) when it returns non-None, so a resumed
+    workflow never re-waits a received event."""
+
+    def poll_for_event(self, *args) -> Any:
+        raise NotImplementedError
+
+
+def wait_for_event(listener_cls, *args, poll_interval_s: float = 0.2,
+                   timeout_s: Optional[float] = None) -> DAGNode:
+    """A workflow step that completes when the listener reports an event
+    (reference: workflow.wait_for_event). Returns a bindable DAG node;
+    compose it like any other step."""
+    import cloudpickle
+
+    import ray_tpu
+
+    blob = cloudpickle.dumps((listener_cls, args))
+
+    @ray_tpu.remote
+    def wait_for_event_step(blob):
+        import cloudpickle as cp
+        cls, a = cp.loads(blob)
+        listener = cls()
+        deadline = None if timeout_s is None else time.time() + timeout_s
+        while True:
+            evt = listener.poll_for_event(*a)
+            if evt is not None:
+                return evt
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(
+                    f"no event from {cls.__name__} within {timeout_s}s")
+            time.sleep(poll_interval_s)
+
+    return wait_for_event_step.bind(blob)
 
 
 def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,

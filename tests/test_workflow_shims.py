@@ -1,6 +1,7 @@
 """Workflow durable execution, ecosystem shims (Pool/Queue/ActorPool),
 and chaos tooling (round-2 VERDICT missing #9/#10)."""
 
+import os
 import time
 
 import pytest
@@ -73,6 +74,83 @@ class TestWorkflow:
         assert out == 41
         with open(marker) as f:
             assert f.read() == "x"   # expensive ran exactly once
+
+
+
+    def test_continuation_recursive_factorial(self, ray_shared, tmp_path):
+        """Dynamic continuations (reference workflow.continuation factorial
+        example): a step returns a new DAG and the engine keeps going,
+        checkpointing each recursion frame."""
+        from ray_tpu import workflow
+
+        @ray_tpu.remote
+        def fact(n, acc=1):
+            if n <= 1:
+                return acc
+            return workflow.continuation(fact.bind(n - 1, acc * n))
+
+        out = workflow.run(fact.bind(5), workflow_id="wf-cont",
+                           storage=str(tmp_path))
+        assert out == 120
+        # Every recursion frame checkpointed under prefixed step ids.
+        steps = os.listdir(os.path.join(str(tmp_path), "wf-cont", "steps"))
+        assert sum("~c" in s for s in steps) >= 3
+        # Resume loads the checkpointed output without recomputing.
+        assert workflow.resume("wf-cont", fact.bind(5),
+                               storage=str(tmp_path)) == 120
+
+    def test_wait_for_event(self, ray_shared, tmp_path):
+        """Event steps (reference workflow.wait_for_event): the step
+        completes when the listener reports, and the checkpointed event is
+        not re-awaited on resume."""
+        from ray_tpu import workflow
+
+        flag = os.path.join(str(tmp_path), "evt.txt")
+
+        class FileEvent(workflow.EventListener):
+            def __init__(self):
+                self.path = flag
+
+            def poll_for_event(self):
+                if os.path.exists(self.path):
+                    with open(self.path) as f:
+                        return f.read()
+                return None
+
+        @ray_tpu.remote
+        def combine(evt, y):
+            return f"{evt}+{y}"
+
+        import threading
+
+        def arm():
+            time.sleep(0.6)
+            with open(flag, "w") as f:
+                f.write("fired")
+
+        threading.Thread(target=arm, daemon=True).start()
+        t0 = time.time()
+        dag = combine.bind(workflow.wait_for_event(FileEvent), 7)
+        out = workflow.run(dag, workflow_id="wf-evt", storage=str(tmp_path))
+        assert out == "fired+7"
+        assert time.time() - t0 >= 0.5  # actually waited
+        # Resume: event step is checkpointed, no re-wait even if flag gone.
+        os.unlink(flag)
+        dag2 = combine.bind(workflow.wait_for_event(FileEvent), 7)
+        assert workflow.resume("wf-evt", dag2,
+                               storage=str(tmp_path)) == "fired+7"
+
+    def test_wait_for_event_timeout(self, ray_shared, tmp_path):
+        from ray_tpu import workflow
+
+        class Never(workflow.EventListener):
+            def poll_for_event(self):
+                return None
+
+        with pytest.raises(Exception, match="no event"):
+            workflow.run(workflow.wait_for_event(
+                Never, timeout_s=0.5, poll_interval_s=0.1),
+                workflow_id="wf-evt-to", storage=str(tmp_path))
 
     def test_run_async(self, ray_shared, tmp_path):
         from ray_tpu import workflow
