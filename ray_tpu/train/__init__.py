@@ -20,6 +20,8 @@ from ray_tpu.train.backend_executor import (BackendConfig, BackendExecutor,
                                             TrainingFailedError)
 from ray_tpu.train.worker_group import WorkerGroup
 from ray_tpu.train.sklearn import SklearnTrainer
+from ray_tpu.train.gbdt import (GBDTTrainer, LightGBMTrainer,
+                                XGBoostTrainer)
 from ray_tpu.train.torch import (TorchConfig, TorchTrainer, prepare_model,
                                  prepare_data_loader)
 from ray_tpu.train.huggingface import TransformersTrainer, prepare_trainer

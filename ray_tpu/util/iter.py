@@ -1,0 +1,258 @@
+"""ParallelIterator: sharded, lazily-transformed iteration over actors.
+
+Reference parity: python/ray/util/iter.py (from_items/from_range/
+from_iterators -> ParallelIterator with for_each/filter/batch/flatten/
+local_shuffle, gathered into a LocalIterator via gather_sync /
+gather_async, plus union and take/show).
+
+Design: transforms stay DRIVER-side as a closure chain until a gather
+materializes one shard actor per shard; each actor applies the chain
+lazily over its base iterator and serves batches on demand, so an
+unbounded source streams without materializing.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+import ray_tpu
+
+__all__ = ["from_items", "from_range", "from_iterators",
+           "ParallelIterator", "LocalIterator"]
+
+
+class _ShardActor:
+    """One shard: base iterable + transform chain, pulled in chunks."""
+
+    def __init__(self, base_blob: bytes, ops_blob: bytes):
+        import cloudpickle
+        base = cloudpickle.loads(base_blob)
+        ops = cloudpickle.loads(ops_blob)
+        it = iter(base() if callable(base) else base)
+        for kind, arg in ops:
+            it = _apply_op(it, kind, arg)
+        self._it = it
+
+    def next_chunk(self, n: int = 64):
+        """Up to n items; None signals exhaustion (vs [] for 'not yet')."""
+        out = []
+        try:
+            for _ in range(n):
+                out.append(next(self._it))
+        except StopIteration:
+            if not out:
+                return None
+        return out
+
+
+def _apply_op(it: Iterator, kind: str, arg) -> Iterator:
+    if kind == "for_each":
+        return (arg(x) for x in it)
+    if kind == "filter":
+        return (x for x in it if arg(x))
+    if kind == "batch":
+        def _batches(src=it, n=arg):
+            buf = []
+            for x in src:
+                buf.append(x)
+                if len(buf) == n:
+                    yield buf
+                    buf = []
+            if buf:
+                yield buf
+        return _batches()
+    if kind == "flatten":
+        return (y for x in it for y in x)
+    if kind == "local_shuffle":
+        def _shuffled(src=it, spec=arg):
+            buf_size, seed = spec
+            rng = random.Random(seed)
+            buf: List[Any] = []
+            for x in src:
+                buf.append(x)
+                if len(buf) >= buf_size:
+                    i = rng.randrange(len(buf))
+                    buf[i], buf[-1] = buf[-1], buf[i]
+                    yield buf.pop()
+            rng.shuffle(buf)
+            yield from buf
+        return _shuffled()
+    raise ValueError(f"unknown op {kind!r}")
+
+
+class ParallelIterator:
+    def __init__(self, bases: List[Any], ops: Optional[List[tuple]] = None,
+                 name: str = "ParallelIterator"):
+        self._bases = bases
+        self._ops = list(ops or [])
+        self._name = name
+
+    # -- lazy transforms (reference: ParallelIterator.for_each etc.) ----
+
+    def _derive(self, kind: str, arg, label: str) -> "ParallelIterator":
+        return ParallelIterator(self._bases, self._ops + [(kind, arg)],
+                                f"{self._name}.{label}")
+
+    def for_each(self, fn: Callable[[Any], Any]) -> "ParallelIterator":
+        return self._derive("for_each", fn, "for_each()")
+
+    def filter(self, fn: Callable[[Any], bool]) -> "ParallelIterator":
+        return self._derive("filter", fn, "filter()")
+
+    def batch(self, n: int) -> "ParallelIterator":
+        return self._derive("batch", n, f"batch({n})")
+
+    def flatten(self) -> "ParallelIterator":
+        return self._derive("flatten", None, "flatten()")
+
+    def local_shuffle(self, shuffle_buffer_size: int,
+                      seed: Optional[int] = None) -> "ParallelIterator":
+        return self._derive("local_shuffle",
+                            (shuffle_buffer_size, seed),
+                            "local_shuffle()")
+
+    def union(self, other: "ParallelIterator") -> "ParallelIterator":
+        if self._ops != other._ops:
+            # Bake each side's chain into its bases so the union is exact.
+            return ParallelIterator(
+                [_baked(b, self._ops) for b in self._bases]
+                + [_baked(b, other._ops) for b in other._bases],
+                [], f"{self._name}.union()")
+        return ParallelIterator(self._bases + other._bases, self._ops,
+                                f"{self._name}.union()")
+
+    def num_shards(self) -> int:
+        return len(self._bases)
+
+    def __repr__(self):
+        return f"{self._name}[{self.num_shards()} shards]"
+
+    # -- gather ---------------------------------------------------------
+
+    def _spawn(self):
+        import cloudpickle
+        actor_cls = ray_tpu.remote(num_cpus=0.1)(_ShardActor)
+        ops_blob = cloudpickle.dumps(self._ops)
+        return [actor_cls.remote(cloudpickle.dumps(b), ops_blob)
+                for b in self._bases]
+
+    def gather_sync(self) -> "LocalIterator":
+        """Round-robin over shards in shard order (deterministic)."""
+        actors = self._spawn()
+
+        def gen():
+            live = list(actors)
+            try:
+                while live:
+                    for a in list(live):
+                        chunk = ray_tpu.get(a.next_chunk.remote(),
+                                            timeout=300)
+                        if chunk is None:
+                            live.remove(a)
+                        else:
+                            yield from chunk
+            finally:
+                for a in actors:
+                    ray_tpu.kill(a)
+
+        return LocalIterator(gen)
+
+    def gather_async(self) -> "LocalIterator":
+        """Items in completion order: whichever shard produces first is
+        consumed first (reference: gather_async out-of-order fetch)."""
+        actors = self._spawn()
+
+        def gen():
+            pending = {a.next_chunk.remote(): a for a in actors}
+            try:
+                while pending:
+                    done, _ = ray_tpu.wait(list(pending), num_returns=1,
+                                           timeout=300)
+                    for ref in done:
+                        a = pending.pop(ref)
+                        chunk = ray_tpu.get(ref)
+                        if chunk is None:
+                            continue
+                        pending[a.next_chunk.remote()] = a
+                        yield from chunk
+            finally:
+                for a in actors:
+                    ray_tpu.kill(a)
+
+        return LocalIterator(gen)
+
+    def take(self, n: int) -> List[Any]:
+        return self.gather_sync().take(n)
+
+    def show(self, n: int = 20):
+        for x in self.take(n):
+            print(x)
+
+
+def _baked(base, ops):
+    """Fold a transform chain into a base thunk (for union of differing
+    chains)."""
+    import cloudpickle
+    base_blob = cloudpickle.dumps(base)
+    ops_blob = cloudpickle.dumps(ops)
+
+    def thunk():
+        b = cloudpickle.loads(base_blob)
+        it = iter(b() if callable(b) else b)
+        for kind, arg in cloudpickle.loads(ops_blob):
+            it = _apply_op(it, kind, arg)
+        return it
+
+    return thunk
+
+
+class LocalIterator:
+    """Driver-local view over the gathered stream."""
+
+    def __init__(self, gen_factory: Callable[[], Iterator]):
+        self._factory = gen_factory
+
+    def __iter__(self):
+        return self._factory()
+
+    def take(self, n: int) -> List[Any]:
+        out = []
+        for x in self:
+            out.append(x)
+            if len(out) >= n:
+                break
+        return out
+
+
+def from_items(items: List[Any], num_shards: int = 2,
+               repeat: bool = False) -> ParallelIterator:
+    shards = [list(items[i::num_shards]) for i in range(num_shards)]
+    if repeat:
+        import itertools
+        bases = [(lambda s=s: itertools.cycle(s)) for s in shards]
+    else:
+        bases = shards
+    return ParallelIterator(bases,
+                            name=f"from_items[{len(items)}]")
+
+
+def from_range(n: int, num_shards: int = 2,
+               repeat: bool = False) -> ParallelIterator:
+    return from_items(list(range(n)), num_shards, repeat)
+
+
+def from_iterators(generators: List[Any],
+                   repeat: bool = False) -> ParallelIterator:
+    """Each element is an iterable or a zero-arg callable returning one."""
+    if repeat:
+        import itertools
+
+        def rep(g):
+            def thunk():
+                while True:
+                    yield from (g() if callable(g) else g)
+            return thunk
+        generators = [rep(g) for g in generators]
+    return ParallelIterator(list(generators),
+                            name=f"from_iterators[{len(generators)}]")
