@@ -375,6 +375,44 @@ def bench_cluster(partial: dict):
     return partial
 
 
+def _tuned_model_config() -> dict:
+    """Pick GPTConfig perf knobs from the on-chip experiment ladder
+    (scripts/chip_experiments.py -> CHIP_EXPERIMENTS_r05.json): best
+    measured remat policy and flash tile sizes. Empty dict -> defaults."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "CHIP_EXPERIMENTS_r05.json")
+    try:
+        with open(path) as f:
+            exp = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    out: dict = {}
+    best_sps, best_policy = 0.0, None
+    for policy in ("full", "dots", "none"):
+        d = exp.get(f"step_remat_{policy}") or {}
+        sps = d.get("sps")
+        # Only trust full-batch measurements: a policy that only fit a
+        # smaller bs isn't comparable.
+        if sps and d.get("bs") == 64 and sps > best_sps:
+            best_sps, best_policy = sps, policy
+    if best_policy:
+        out["remat_policy"] = best_policy
+    iso = exp.get("flash_iso") or {}
+    best_ms, best_blocks = None, None
+    for key, v in iso.items():
+        if key.startswith("flash_") and key.endswith("_fwdbwd_ms"):
+            shape = key[len("flash_"):-len("_fwdbwd_ms")]
+            try:
+                bq, bk = (int(x) for x in shape.split("x"))
+            except ValueError:
+                continue
+            if best_ms is None or v < best_ms:
+                best_ms, best_blocks = v, (bq, bk)
+    if best_blocks and best_blocks != (128, 128):
+        out["flash_block_q"], out["flash_block_k"] = best_blocks
+    return out
+
+
 def bench_model():
     """GPT-2-small train-step throughput on the local chip.
 
@@ -412,7 +450,10 @@ def bench_model():
                 attention = a.split("=", 1)[1]
             if a.startswith("--iters="):
                 iters = int(a.split("=", 1)[1])
-        cfg = GPTConfig(attention=attention)  # GPT-2 small, bf16, remat
+        tuned = _tuned_model_config()
+        cfg = GPTConfig(attention=attention, **tuned)  # GPT-2 small, bf16
+        if tuned:
+            log(f"model bench tuned config from experiments: {tuned}")
         mesh = build_mesh(MeshConfig(data=len(jax.devices())))
         opt = optax.adamw(3e-4)
         state = init_train_state(

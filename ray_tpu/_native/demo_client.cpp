@@ -64,6 +64,22 @@ int main(int argc, char** argv) {
     if (client.Get(slow)->s != "done") return 1;
     std::printf("wait ok\n");
 
+
+    // Cross-language actor: create a Python class by descriptor, call
+    // methods (value + ref args), look it up by name, kill it.
+    auto actor = client.CreateActor(
+        "cpp_targets:Counter", {PyValue::integer(100)}, "cpp-counter");
+    auto r1 = client.CallActor(actor, "add", {PyValue::integer(5)});
+    if (client.Get(r1)->i != 105) return 1;
+    auto r2 = client.CallActor(actor, "add", {PyValue::integer(7)});
+    if (client.Get(r2)->i != 112) return 1;
+    auto found = client.GetNamedActor("cpp-counter");
+    if (found.id != actor.id) return 1;
+    auto r3 = client.CallActor(found, "get");
+    if (client.Get(r3)->i != 112) return 1;
+    client.KillActor(actor);
+    std::printf("actor ok\n");
+
     // Cluster view.
     auto nodes = client.Nodes();
     if (nodes->kind != PyValue::Kind::List || nodes->items.empty()) return 1;

@@ -113,18 +113,7 @@ class RayTpuClient {
                    const std::vector<ObjectRef>& ref_args = {}) {
     auto payload = PyValue::dict();
     payload->set("func", PyValue::str(qualname));
-    auto tagged = PyValue::list();
-    for (const auto& a : args) {
-      auto pair = PyValue::tuple({PyValue::str("val"),
-                                  PyValue::bytes(wrap_value(a))});
-      tagged->items.push_back(pair);
-    }
-    for (const auto& r : ref_args) {
-      auto pair = PyValue::tuple({PyValue::str("ref"),
-                                  PyValue::bytes(r.id)});
-      tagged->items.push_back(pair);
-    }
-    payload->set("args", tagged);
+    payload->set("args", tagged_args(args, ref_args));
     payload->set("num_returns", PyValue::integer(1));
     auto reply = request("client_submit_named", payload);
     if (reply->kind != PyValue::Kind::List || reply->items.empty())
@@ -132,11 +121,79 @@ class RayTpuClient {
     return ref_of(reply->items[0]);
   }
 
+  // ---- actors ------------------------------------------------------
+  // Cross-language actor lifecycle (reference: cpp/include/ray/api.h
+  // ray::Actor(...).Remote() + cross_language.py): the class is an
+  // importable Python "module:Class" descriptor; this driver creates it,
+  // calls methods, and kills it over the client protocol.
+
+  struct ActorHandle {
+    std::string id;  // binary actor id
+  };
+
+  ActorHandle CreateActor(const std::string& class_path,
+                          const std::vector<PyValuePtr>& args = {},
+                          const std::string& name = "") {
+    auto payload = PyValue::dict();
+    payload->set("class_path", PyValue::str(class_path));
+    payload->set("args", tagged_args(args, {}));
+    if (!name.empty()) payload->set("name", PyValue::str(name));
+    auto reply = request("client_create_actor", payload);
+    if (reply->kind != PyValue::Kind::Bytes)
+      throw std::runtime_error("create_actor: bad reply");
+    return ActorHandle{reply->s};
+  }
+
+  ObjectRef CallActor(const ActorHandle& actor, const std::string& method,
+                      const std::vector<PyValuePtr>& args = {},
+                      const std::vector<ObjectRef>& ref_args = {}) {
+    auto payload = PyValue::dict();
+    payload->set("actor_id", PyValue::bytes(actor.id));
+    payload->set("method", PyValue::str(method));
+    payload->set("args", tagged_args(args, ref_args));
+    payload->set("num_returns", PyValue::integer(1));
+    auto reply = request("client_submit_actor_task", payload);
+    if (reply->kind != PyValue::Kind::List || reply->items.empty())
+      throw std::runtime_error("call_actor: bad reply");
+    return ref_of(reply->items[0]);
+  }
+
+  void KillActor(const ActorHandle& actor) {
+    auto payload = PyValue::dict();
+    payload->set("actor_id", PyValue::bytes(actor.id));
+    request("client_kill_actor", payload);
+  }
+
+  ActorHandle GetNamedActor(const std::string& name) {
+    auto payload = PyValue::dict();
+    payload->set("name", PyValue::str(name));
+    auto reply = request("client_get_named_actor", payload);
+    if (reply->kind != PyValue::Kind::Bytes)
+      throw std::runtime_error("get_named_actor: bad reply");
+    return ActorHandle{reply->s};
+  }
+
   // ---- cluster -----------------------------------------------------
 
   PyValuePtr Nodes() { return request("client_nodes", PyValue::dict()); }
 
   // ---- protocol internals (public for tests) -----------------------
+
+  // [("val", wrapped-bytes) | ("ref", id-bytes)] argument list, the
+  // client-server protocol's tagged-arg shape (server.py _args_of).
+  PyValuePtr tagged_args(const std::vector<PyValuePtr>& args,
+                         const std::vector<ObjectRef>& ref_args) {
+    auto tagged = PyValue::list();
+    for (const auto& a : args) {
+      tagged->items.push_back(PyValue::tuple(
+          {PyValue::str("val"), PyValue::bytes(wrap_value(a))}));
+    }
+    for (const auto& r : ref_args) {
+      tagged->items.push_back(PyValue::tuple(
+          {PyValue::str("ref"), PyValue::bytes(r.id)}));
+    }
+    return tagged;
+  }
 
   PyValuePtr request(const std::string& method, PyValuePtr payload) {
     payload->set("session", PyValue::str(session_));

@@ -546,11 +546,34 @@ class Raylet:
                 asyncio.ensure_future(
                     self._on_worker_disconnect(handle.worker_id))
 
-    def _spawn_worker(self) -> WorkerHandle:
+    def _spawn_worker(self, container_env: Optional[dict] = None
+                      ) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = self._worker_env_for(worker_id)
         log_path = self._worker_log_path(worker_id)
         self._spawned_worker_prefixes.add(worker_id.hex()[:12])
+        if container_env is not None:
+            # Containerized worker (runtime_env={"container": ...}): start
+            # the worker inside the image via podman/docker (or the test
+            # hook), pre-dedicated to this env's hash so only matching
+            # leases ever use it (reference: runtime_env/container.py).
+            from ray_tpu._private import runtime_env_container as rec
+            from ray_tpu._private.runtime_env import env_hash as _ehash
+            argv = rec.build_worker_command(
+                container_env["container"], env=env,
+                session_dir=self.session_dir)
+            out = open(log_path, "ab")
+            proc = subprocess.Popen(argv, env=env, stdout=out,
+                                    stderr=subprocess.STDOUT,
+                                    start_new_session=True)
+            handle = WorkerHandle(worker_id=worker_id, pid=proc.pid,
+                                  proc=proc)
+            handle.env_hash = (container_env.get("_hash")
+                               or _ehash(container_env))
+            self.workers[worker_id] = handle
+            self._workers_by_hex[worker_id.hex()] = handle
+            self._starting_workers += 1
+            return handle
         fs = _SharedForkServer.get()
         # Fast path: ask the zygote to fork a worker (~ms, vs seconds for a
         # cold python+jax start). Requests written before the zygote finishes
@@ -642,9 +665,12 @@ class Raylet:
                 except Exception:
                     pass
 
-    def _get_idle_worker(self, env_hash: str = "") -> Optional[WorkerHandle]:
+    def _get_idle_worker(self, env_hash: str = "",
+                         exact: bool = False) -> Optional[WorkerHandle]:
         """Pop a live idle worker compatible with `env_hash`: exact-match
-        tagged workers preferred, fresh ("") workers serve any env."""
+        tagged workers preferred, fresh ("") workers serve any env.
+        exact=True (container envs) never falls back to a fresh worker —
+        a generic process cannot retroactively enter the container."""
         fallback = None
         for i in range(len(self._idle_workers) - 1, -1, -1):
             handle = self._idle_workers[i]
@@ -657,9 +683,16 @@ class Raylet:
                 return handle
             if handle.env_hash == "" and fallback is None:
                 fallback = handle
+        if exact:
+            return None
         if fallback is not None:
             self._idle_workers.remove(fallback)
         return fallback
+
+    @staticmethod
+    def _container_env(spec) -> Optional[dict]:
+        env = getattr(spec, "runtime_env", None) or {}
+        return env if env.get("container") else None
 
     def _ensure_worker_supply(self):
         # Count only leases the pool could actually serve concurrently:
@@ -668,6 +701,12 @@ class Raylet:
         avail = dict(self.pool.available)
         free_hashes = [h.env_hash for h in self._idle_workers]
         demand = 0
+        container_demand: list = []
+        # Container workers still starting (spawned, not yet registered):
+        # their env hash is pre-set at spawn.
+        starting_hashes = [h.env_hash for h in self.workers.values()
+                           if not h.registered and h.env_hash]
+        n_starting_container = len(starting_hashes)
         for spec, _pg_key, fut in self._pending_leases:
             if fut.done():
                 continue
@@ -676,13 +715,37 @@ class Raylet:
                 for k, v in spec.resources.items():
                     avail[k] = avail.get(k, 0) - v
                 eh = spec.env_hash()
+                cenv = self._container_env(spec)
+                if cenv is not None:
+                    # Containerized lease: only an exact-hash worker (idle
+                    # or already starting) can serve it.
+                    if eh in free_hashes:
+                        free_hashes.remove(eh)
+                    elif eh in starting_hashes:
+                        starting_hashes.remove(eh)
+                    else:
+                        container_demand.append(cenv)
+                    continue
                 if eh in free_hashes:
                     free_hashes.remove(eh)
                 elif "" in free_hashes:
                     free_hashes.remove("")
                 else:
                     demand += 1
-        supply = self._starting_workers
+        spawned_container = 0
+        for cenv in container_demand:
+            if self.config.max_workers_per_node - len(self.workers) <= 0:
+                break
+            try:
+                self._spawn_worker(container_env=cenv)
+                spawned_container += 1
+            except Exception:
+                logger.exception("containerized worker spawn failed")
+                break
+        # Container spawns count in _starting_workers but serve only their
+        # own env hash — exclude them from the generic supply.
+        supply = max(0, self._starting_workers - n_starting_container
+                     - spawned_container)
         can_start = self.config.max_workers_per_node - len(self.workers)
         if demand > supply and can_start <= 0:
             # The worker cap is consumed but pending leases can't use what's
@@ -717,6 +780,14 @@ class Raylet:
         Reply: {"granted": {...}} | {"spillback": address} | {"infeasible": True}
         """
         spec: TaskSpec = payload["spec"]
+        if self._container_env(spec) is not None:
+            from ray_tpu._private import runtime_env_container as _rec
+            if not _rec.runner_available():
+                return {"infeasible": True,
+                        "why": ("container runtime env needs podman or "
+                                "docker on the node (or a "
+                                "RAY_TPU_CONTAINER_RUNNER hook); none "
+                                "found")}
         pg_key = None
         if spec.scheduling.placement_group_id is not None:
             idx = spec.scheduling.bundle_index
@@ -877,7 +948,8 @@ class Raylet:
                 if not fut.done():
                     remaining.append((spec, pg_key, fut))
                 continue
-            worker = self._get_idle_worker(spec.env_hash())
+            worker = self._get_idle_worker(
+                spec.env_hash(), exact=self._container_env(spec) is not None)
             if worker is None:
                 remaining.append((spec, pg_key, fut))
                 continue
@@ -971,19 +1043,28 @@ class Raylet:
 
     async def rpc_create_actor(self, conn, payload):
         spec: TaskSpec = payload["spec"]
+        cenv = self._container_env(spec)
+        if cenv is not None:
+            from ray_tpu._private import runtime_env_container as _rec
+            if not _rec.runner_available():
+                raise RuntimeError(
+                    "container runtime env needs podman or docker on the "
+                    "node (or a RAY_TPU_CONTAINER_RUNNER hook); none found")
         pg_key = None
         if spec.scheduling.placement_group_id is not None:
             idx = max(0, spec.scheduling.bundle_index)
             pg_key = (spec.scheduling.placement_group_id.binary(), idx)
         if not self.pool.acquire(spec.resources, pg_key):
             raise RuntimeError("resources no longer available for actor")
-        worker = self._get_idle_worker(spec.env_hash())
+        worker = self._get_idle_worker(spec.env_hash(),
+                                       exact=cenv is not None)
         if worker is None:
-            self._spawn_worker()
+            self._spawn_worker(container_env=cenv)
             deadline = time.time() + self.config.worker_start_timeout_s
             while worker is None and time.time() < deadline:
                 await asyncio.sleep(0.02)
-                worker = self._get_idle_worker(spec.env_hash())
+                worker = self._get_idle_worker(spec.env_hash(),
+                                               exact=cenv is not None)
             if worker is None:
                 self.pool.release(spec.resources, pg_key)
                 raise RuntimeError("worker failed to start for actor")

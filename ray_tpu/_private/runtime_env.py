@@ -26,8 +26,8 @@ from typing import Dict, List, Optional, Tuple
 MAX_PACKAGE_BYTES = 256 * 1024 * 1024
 EXCLUDE_DIRS = {"__pycache__", ".git", ".hg", ".venv", "node_modules",
                 ".eggs", ".mypy_cache", ".pytest_cache"}
-_KNOWN_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "config",
-               "_hash"}
+_KNOWN_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "container",
+               "config", "_hash"}
 
 
 def _default_cache_dir() -> str:
@@ -130,6 +130,24 @@ def validate(env: Optional[dict]) -> Optional[dict]:
             raise TypeError("runtime_env['pip'] must be a list of "
                             "requirement strings")
         out["pip"] = sorted(pip)
+    cont = out.get("container")
+    if cont is not None:
+        # {"image": str, "run_options": [...], "python": str?} — the
+        # raylet starts the worker INSIDE the image (runtime gate:
+        # podman/docker must exist on the node; see
+        # runtime_env_container.py). Workers themselves treat the key as
+        # already satisfied.
+        if isinstance(cont, str):
+            cont = {"image": cont}
+        if not isinstance(cont, dict) or not cont.get("image"):
+            raise TypeError("runtime_env['container'] must be an image "
+                            "name or {'image': ..., 'run_options': [...]}")
+        ro = cont.get("run_options")
+        if ro is not None and (not isinstance(ro, (list, tuple)) or
+                               not all(isinstance(o, str) for o in ro)):
+            raise TypeError("container run_options must be a list of "
+                            "strings")
+        out["container"] = dict(cont)
     return out
 
 

@@ -1,0 +1,85 @@
+"""Container runtime environments: workers spawned inside images.
+
+Reference parity: python/ray/_private/runtime_env/container.py — a task or
+actor declaring runtime_env={"container": {"image": ..., "run_options":
+[...]}} executes in a worker process started INSIDE that container
+(podman/docker), with the session dir and framework source bind-mounted
+and the worker env passed through.
+
+Runtime gate: neither podman nor docker ships in this image, so the
+raylet checks runner availability at lease time and fails container
+leases with an actionable error when absent. Tests (and exotic runtimes)
+inject a runner via RAY_TPU_CONTAINER_RUNNER="module:attr" — a callable
+(image, run_options, inner_argv, env, mounts) -> argv.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+from typing import Dict, List, Optional
+
+_RUNNERS = ("podman", "docker")
+
+
+def resolve_runner():
+    """-> (name, builder) or None. builder(image, run_options, inner_argv,
+    env, mounts) -> argv to Popen."""
+    hook = os.environ.get("RAY_TPU_CONTAINER_RUNNER")
+    if hook:
+        import importlib
+        mod_name, _, attr = hook.partition(":")
+        return ("hook", getattr(importlib.import_module(mod_name), attr))
+    for name in _RUNNERS:
+        if shutil.which(name):
+            return (name, _cli_builder(name))
+    return None
+
+
+def runner_available() -> bool:
+    return resolve_runner() is not None
+
+
+def _cli_builder(runner: str):
+    def build(image: str, run_options: List[str], inner_argv: List[str],
+              env: Dict[str, str], mounts: List[str]) -> List[str]:
+        argv = [runner, "run", "--rm", "--network=host"]
+        for m in mounts:
+            argv += ["-v", f"{m}:{m}"]
+        for k, v in env.items():
+            argv += ["--env", f"{k}={v}"]
+        argv += list(run_options or [])
+        argv.append(image)
+        argv += inner_argv
+        return argv
+
+    return build
+
+
+def build_worker_command(container: dict, env: Dict[str, str],
+                         session_dir: str,
+                         python: Optional[str] = None) -> List[str]:
+    """argv that starts a ray_tpu worker inside the container.
+
+    Mounts: the session dir (logs, shm handshake files) and the framework
+    source root, so the image only needs a compatible python. The worker
+    dials the raylet over the host network.
+    """
+    resolved = resolve_runner()
+    if resolved is None:
+        raise RuntimeError(
+            "container runtime env needs podman or docker on the node "
+            "(or a RAY_TPU_CONTAINER_RUNNER hook); none found")
+    _name, builder = resolved
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    inner = [python or container.get("python") or "python3",
+             "-m", "ray_tpu._private.worker_main"]
+    mounts = [session_dir, repo_root, "/dev/shm"]
+    env = dict(env, PYTHONPATH=(repo_root + os.pathsep
+                                + env.get("PYTHONPATH", "")).rstrip(
+                                    os.pathsep))
+    return builder(container["image"],
+                   list(container.get("run_options") or []),
+                   inner, env, mounts)

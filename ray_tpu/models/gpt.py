@@ -47,6 +47,9 @@ class GPTConfig:
     #   "none": save everything (max HBM, min FLOPs)
     remat_policy: Optional[str] = None
     attention: str = "flash"          # flash | reference | ring
+    # Flash kernel tile sizes (perf knob; correctness-invariant).
+    flash_block_q: int = 128
+    flash_block_k: int = 128
     tie_embeddings: bool = False
 
     @property
@@ -155,7 +158,9 @@ def _attention_block(layer, x, cfg: GPTConfig, positions, mesh):
     elif cfg.attention == "reference":
         o = mha_reference(q, k, v, causal=True)
     else:
-        o = flash_attention(q, k, v, causal=True)
+        o = flash_attention(q, k, v, causal=True,
+                            block_q=cfg.flash_block_q,
+                            block_k=cfg.flash_block_k)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
     return jnp.einsum("bsd,de->bse", o, layer["attn"]["wo"].astype(dt))
 

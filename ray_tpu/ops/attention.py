@@ -54,7 +54,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     mha_reference (query i attends keys <= i + offset). Also emits the
     per-row logsumexp (lse) residual consumed by the backward kernels.
     """
-    q = q_ref[0].astype(jnp.float32) * sm_scale          # [bq, d]
+    # Dots run in the INPUT dtype (bf16 on the model path) with fp32
+    # accumulation: an fp32 x fp32 MXU matmul is several times slower
+    # than bf16 x bf16 -> fp32 on v5e, and upcasting q/k/v before the
+    # dot was this kernel's original whole-step slowdown. Softmax math
+    # stays fp32.
+    q = q_ref[0]                                         # [bq, d] (in dt)
     bq = q.shape[0]
     d = q.shape[1]
     q_idx = pl.program_id(1)
@@ -64,11 +69,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
 
     def body(i, carry):
         acc, m_prev, l_prev = carry
-        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [bq, bk]
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk] f32
         if causal:
             q_pos = q_start + causal_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
@@ -81,7 +86,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
@@ -142,8 +147,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     p = exp(s - lse); dS = p * (dO·Vᵀ - delta); dQ = scale · dS·K
     (standard flash-attention backward, FlashAttention-2 form).
     """
-    q = q_ref[0].astype(jnp.float32)                      # [bq, d]
-    do = do_ref[0].astype(jnp.float32)                    # [bq, d]
+    # bf16 dot inputs + fp32 accumulation (see _flash_kernel dtype note).
+    q = q_ref[0]                                          # [bq, d]
+    do = do_ref[0]                                        # [bq, d]
     lse = lse_ref[0, 0]                                   # [bq]
     delta = delta_ref[0, 0]                               # [bq]
     bq, d = q.shape
@@ -152,8 +158,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     num_k_blocks = pl.cdiv(seq_k, block_k)
 
     def body(i, dq):
-        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -172,7 +178,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)           # [bq, bk]
         ds = p * (dp - delta[:, None])
         return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -194,8 +200,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dV = Pᵀ·dO; dK = scale · dSᵀ·Q. Causal skip: k block starting at ks
     only sees q rows with q_pos >= k_pos, i.e. q >= ks - causal_offset.
     """
-    k_blk = k_ref[0].astype(jnp.float32)                  # [bk, d]
-    v_blk = v_ref[0].astype(jnp.float32)                  # [bk, d]
+    # bf16 dot inputs + fp32 accumulation (see _flash_kernel dtype note).
+    k_blk = k_ref[0]                                      # [bk, d]
+    v_blk = v_ref[0]                                      # [bk, d]
     bk, d = k_blk.shape
     k_idx = pl.program_id(1)
     k_start = k_idx * bk
@@ -203,8 +210,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(j, carry):
         dk, dv = carry
-        q_blk = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[0, pl.ds(j * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(j * block_q, block_q), :]
         lse_blk = lse_ref[0, 0, pl.ds(j * block_q, block_q)]
         delta_blk = delta_ref[0, 0, pl.ds(j * block_q, block_q)]
         s = jax.lax.dot_general(
@@ -220,14 +227,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.where(s > NEG_INF / 2,
                       jnp.exp(s - lse_blk[:, None]), 0.0)  # [bq, bk]
         dv = dv + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bk, d]
         dp = jax.lax.dot_general(
             do_blk, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bq, bk]
         ds = p * (dp - delta_blk[:, None])
         dk = dk + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bk, d]
         return dk, dv
 

@@ -26,15 +26,18 @@ from ray_tpu.rllib.algorithms.td3 import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
+from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.offline import JsonReader, JsonWriter
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib import connectors
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Impala",
     "ImpalaConfig", "APPO", "APPOConfig", "DQN", "DQNConfig", "BC",
     "BCConfig", "SAC", "SACConfig", "TD3", "TD3Config", "DDPG",
     "DDPGConfig", "CQL", "CQLConfig", "MARWIL", "MARWILConfig",
-    "A2C", "A2CConfig", "EnvSpec", "CartPoleEnv",
+    "A2C", "A2CConfig", "ES", "ESConfig", "ARS", "ARSConfig",
+    "connectors", "EnvSpec", "CartPoleEnv",
     "PendulumEnv", "MultiAgentEnv", "MultiCartPole", "make_env",
     "register_env", "SampleBatch", "MultiAgentBatch", "concat_samples",
     "ReplayBuffer", "PrioritizedReplayBuffer", "JsonReader", "JsonWriter",

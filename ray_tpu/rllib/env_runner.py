@@ -24,6 +24,8 @@ class EnvRunner:
         import jax
         jax.config.update("jax_platforms", "cpu")
         from ray_tpu.rllib.connectors import default_obs_pipeline
+        self._env_spec = env_spec
+        self._env_config = dict(env_config or {})
         self._envs = [make_env(env_spec, env_config) for _ in range(num_envs)]
         self._obs = []
         self._ep_rewards = [0.0] * num_envs
@@ -130,6 +132,51 @@ class EnvRunner:
                     obs2, _ = env.reset()
                 self._obs[i] = obs2
         return SampleBatch({k: np.asarray(v) for k, v in cols.items()})
+
+    def evaluate_return(self, params, episodes: int = 1,
+                        max_steps: int = 500) -> float:
+        """Mean greedy-episode return under `params` on a FRESH env (the
+        evaluation-worker primitive; also the ES/ARS fitness fn)."""
+        env = make_env(self._env_spec, self._env_config)
+        total = 0.0
+        for _ep in range(episodes):
+            obs, _ = env.reset(seed=int(self._rng.randint(2 ** 31)))
+            for _ in range(max_steps):
+                x = self._obs_conn(np.asarray(obs)[None, :], update=False)
+                logits, _v = self._jit_forward(params, x)
+                obs, r, term, trunc, _ = env.step(
+                    int(np.argmax(np.asarray(logits)[0])))
+                total += r
+                if term or trunc:
+                    break
+        return total / episodes
+
+    def evaluate_perturbations(self, flat_params, seeds: List[int],
+                               sigma: float, episodes: int = 1,
+                               max_steps: int = 500):
+        """Antithetic ES/ARS evaluations: each seed's noise vector is
+        REBUILT from the seed (no noise shipping — the reference's
+        shared-noise-table trick, rllib/algorithms/es) and scored as
+        (R(theta + sigma*eps), R(theta - sigma*eps))."""
+        from jax.flatten_util import ravel_pytree
+        _flat0, unravel = ravel_pytree(self._params)
+        flat = np.asarray(flat_params, np.float32)
+        out = []
+        for seed in seeds:
+            eps = np.random.RandomState(seed).standard_normal(
+                flat.shape).astype(np.float32)
+            r_pos = self.evaluate_return(
+                unravel(flat + sigma * eps), episodes, max_steps)
+            r_neg = self.evaluate_return(
+                unravel(flat - sigma * eps), episodes, max_steps)
+            out.append((r_pos, r_neg))
+        return out
+
+    def get_flat_params(self):
+        from jax.flatten_util import ravel_pytree
+        flat, _ = ravel_pytree(self._params)
+        return np.asarray(flat, np.float32)
+
 
     def episode_rewards(self, clear: bool = True) -> List[float]:
         out = list(self._done_rewards)

@@ -10,16 +10,50 @@ torchrun. Per-epoch metrics flow back through a report callback.
 
 from __future__ import annotations
 
+from typing import Callable, Optional
+
 from ray_tpu.train.torch import TorchConfig, TorchTrainer  # noqa: F401
 
 
 class TransformersTrainer(TorchTrainer):
-    """`TorchTrainer` whose train loop builds and runs a
-    transformers.Trainer. The loop receives the train_loop_config and
-    must call `trainer.train()` itself (the reference's v2 API shape:
-    a plain train_loop_per_worker + prepare_trainer). The torchrun-style
-    env exported by TorchConfig makes HF/accelerate engage its
-    distributed (MULTI_CPU/DDP + DistributedSampler) path."""
+    """HF Trainer on the gang, two construction shapes:
+
+    1. v2 / loop shape (reference current API): pass a
+       ``train_loop_per_worker`` that builds the transformers.Trainer,
+       calls prepare_trainer() and .train() itself.
+    2. legacy shape (reference TransformersTrainer): pass
+       ``trainer_init_per_worker(train_dataset, eval_dataset, **config)``
+       returning an un-run transformers.Trainer — this class wraps it in
+       a loop that attaches the report bridge and runs .train(), with
+       datasets forwarded per worker.
+
+    Either way the torchrun-style env exported by TorchConfig makes
+    HF/accelerate engage its distributed (MULTI_CPU/DDP +
+    DistributedSampler) path.
+    """
+
+    def __init__(self, train_loop_per_worker: Optional[Callable] = None, *,
+                 trainer_init_per_worker: Optional[Callable] = None,
+                 datasets: Optional[dict] = None,
+                 torch_config: Optional[TorchConfig] = None, **kwargs):
+        if (train_loop_per_worker is None) == \
+                (trainer_init_per_worker is None):
+            raise ValueError(
+                "pass exactly one of train_loop_per_worker or "
+                "trainer_init_per_worker")
+        if trainer_init_per_worker is not None:
+            datasets = dict(datasets or {})
+            init_fn = trainer_init_per_worker
+
+            def train_loop_per_worker(config):
+                hf_trainer = init_fn(datasets.get("train"),
+                                     datasets.get("evaluation"),
+                                     **(config or {}))
+                prepare_trainer(hf_trainer)
+                hf_trainer.train()
+
+        super().__init__(train_loop_per_worker,
+                         torch_config=torch_config, **kwargs)
 
 
 def prepare_trainer(trainer):

@@ -242,7 +242,28 @@ class ClientServer:
 
     async def rpc_create_actor(self, conn, payload):
         s = self._session(payload)
-        if payload.get("class_blob"):
+        if payload.get("class_path"):
+            # Cross-language actor creation: an importable "module:Class"
+            # descriptor instead of a cloudpickle blob (reference:
+            # cross_language.py — how C++/Java drivers instantiate Python
+            # actors). Content-hashed export id, same as rpc_submit_named.
+            qualname = payload["class_path"]
+            cid = s.named_exports.get("actor:" + qualname)
+            if cid is None:
+                import hashlib
+                import importlib
+                mod_name, _, cls_name = qualname.partition(":")
+                cls = getattr(importlib.import_module(mod_name), cls_name)
+                from ray_tpu._private.serialization import dumps_function
+                blob = dumps_function(cls)
+                cid = (f"named-actor:{qualname}:"
+                       + hashlib.sha1(blob).hexdigest()[:12])
+                await s.core.export_function_raw(blob, cid)
+                s.named_exports["actor:" + qualname] = cid
+            payload = dict(payload, class_id=cid,
+                           class_name=payload.get("class_name")
+                           or qualname.rpartition(":")[2])
+        elif payload.get("class_blob"):
             await s.core.export_function_raw(payload["class_blob"],
                                              payload["class_id"])
         await self._store_packages(s, payload.get("packages"))

@@ -15,3 +15,18 @@ def describe(d):
 def slow_echo(delay, msg):
     time.sleep(delay)
     return msg
+
+
+class Counter:
+    """Actor the C++ client instantiates by "module:Class" descriptor
+    (cross-language actor creation)."""
+
+    def __init__(self, start=0):
+        self.value = start
+
+    def add(self, n):
+        self.value += n
+        return self.value
+
+    def get(self):
+        return self.value

@@ -1,0 +1,23 @@
+"""Test stand-in for podman/docker (RAY_TPU_CONTAINER_RUNNER hook).
+
+Records the container request (image / run_options / mounts) to the file
+named by FAKE_CONTAINER_LOG, then returns the INNER worker argv so the
+"containerized" worker just runs directly — proving the raylet's spawn
+wiring without a container runtime in the image.
+"""
+
+import json
+import os
+
+
+def build(image, run_options, inner_argv, env, mounts):
+    log = os.environ.get("FAKE_CONTAINER_LOG")
+    if log:
+        with open(log, "a") as f:
+            f.write(json.dumps({
+                "image": image,
+                "run_options": list(run_options or []),
+                "mounts": list(mounts),
+                "inner": list(inner_argv),
+            }) + "\n")
+    return list(inner_argv)
