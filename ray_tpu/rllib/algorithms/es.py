@@ -60,9 +60,11 @@ class ES(Algorithm):
 
     def build_learner(self):
         cfg = self.algo_config
-        self.theta = np.asarray(ray_tpu.get(
+        # copy: ray_tpu.get of a numpy array is a READ-ONLY zero-copy
+        # view into plasma; theta is updated in place every iteration.
+        self.theta = np.array(ray_tpu.get(
             self.env_runners[0].get_flat_params.remote(), timeout=120),
-            np.float32)
+            np.float32, copy=True)
         self._seed_counter = cfg.seed * 100003 + 1
         # Adam-style moments keep the step scale stable across iterations
         # (the reference's Adam optimizer over the flat theta).

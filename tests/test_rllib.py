@@ -61,6 +61,7 @@ def test_replay_buffers():
     pbuf.update_priorities(s["batch_indexes"], np.full(16, 10.0))
 
 
+@pytest.mark.timeout(360)
 def test_ppo_learns_cartpole(ray_mod):
     config = (rllib.PPOConfig()
               .environment("CartPole-v1")
@@ -85,6 +86,7 @@ def test_ppo_learns_cartpole(ray_mod):
     assert last > first
 
 
+@pytest.mark.timeout(360)
 def test_ppo_checkpoint_restore(ray_mod):
     config = (rllib.PPOConfig()
               .environment("CartPole-v1")
@@ -103,6 +105,7 @@ def test_ppo_checkpoint_restore(ray_mod):
     algo2.stop()
 
 
+@pytest.mark.timeout(360)
 def test_impala_async_pipeline(ray_mod):
     config = (rllib.ImpalaConfig()
               .environment("CartPole-v1")
@@ -116,6 +119,7 @@ def test_impala_async_pipeline(ray_mod):
     assert r2["num_env_steps_sampled"] > 0
 
 
+@pytest.mark.timeout(360)
 def test_custom_env_registration(ray_mod):
     class ConstEnv(rllib.CartPoleEnv):
         pass
@@ -130,6 +134,7 @@ def test_custom_env_registration(ray_mod):
     assert result["num_env_steps_sampled"] == 32
 
 
+@pytest.mark.timeout(360)
 def test_tune_integration(ray_mod):
     from ray_tpu import tune
     from ray_tpu.train.config import RunConfig
@@ -255,6 +260,7 @@ def test_per_beats_uniform_chain_mdp():
     assert err_per < err_uniform * 0.7, (err_per, err_uniform)
 
 
+@pytest.mark.timeout(360)
 def test_sac_prioritized_replay_config(ray_mod):
     """SAC with prioritized_replay=True runs an iteration, uses the PER
     buffer, and updates priorities away from their initial value."""

@@ -14,6 +14,7 @@ def ray_rl():
     ray_tpu.shutdown()
 
 
+@pytest.mark.timeout(360)
 def test_dqn_learns_cartpole(ray_rl, jax_cpu):
     from ray_tpu.rllib import DQNConfig
 
@@ -193,6 +194,7 @@ def test_sac_learns_pendulum(ray_rl, jax_cpu):
                                                   np.mean(late))
 
 
+@pytest.mark.timeout(360)
 def test_es_learns_cartpole(ray_rl, jax_cpu):
     """ES (derivative-free, reference rllib/algorithms/es) improves
     CartPole return without any gradient computation."""
