@@ -327,11 +327,34 @@ def bench_cluster(partial: dict):
     from ray_tpu.cluster_utils import Cluster
     import ray_tpu
 
-    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 64})
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 64},
+                      system_config={"worker_start_timeout_s": 120.0})
     for _ in range(2):
         cluster.add_node(num_cpus=64)
     cluster.connect()
     try:
+        # PG latency first: it needs no worker processes, so it isn't
+        # starved by the actor-launch storm below.
+        try:
+            from ray_tpu.util.placement_group import (
+                placement_group, remove_placement_group)
+            create_ms, remove_ms = [], []
+            for _ in range(10):
+                t0 = time.perf_counter()
+                pg = placement_group([{"CPU": 1}] * 3, strategy="PACK")
+                ray_tpu.get(pg.ready(), timeout=60)
+                create_ms.append((time.perf_counter() - t0) * 1e3)
+                t0 = time.perf_counter()
+                remove_placement_group(pg)
+                remove_ms.append((time.perf_counter() - t0) * 1e3)
+            partial["pg_create_ms"] = round(statistics.median(create_ms), 2)
+            partial["pg_remove_ms"] = round(statistics.median(remove_ms), 2)
+            _persist(partial)
+            log(f"pg create/remove: {partial['pg_create_ms']}/"
+                f"{partial['pg_remove_ms']} ms")
+        except Exception as e:  # noqa: BLE001
+            log(f"pg phase skipped: {type(e).__name__}: {e}")
+
         @ray_tpu.remote(num_cpus=0.01)
         class Tiny:
             def ready(self):
@@ -341,31 +364,17 @@ def bench_cluster(partial: dict):
         warm = [Tiny.remote() for _ in range(8)]
         ray_tpu.get([a.ready.remote() for a in warm], timeout=120)
 
-        n = 150
+        # Every actor is its own OS process: 40 is the storm a 1-vCPU box
+        # can absorb inside the worker-start timeout (the 651/s baseline
+        # ran on 64x64-core nodes — vs_baseline carries the context).
+        n = 40
         t0 = time.perf_counter()
         actors = [Tiny.remote() for _ in range(n)]
         ray_tpu.get([a.ready.remote() for a in actors], timeout=300)
         rate = n / (time.perf_counter() - t0)
         partial["actor_launch_per_s"] = round(rate, 1)
         _persist(partial)
-        log(f"actor_launch_rate (3-node fake): {rate:,.0f}/s")
-
-        from ray_tpu.util.placement_group import (placement_group,
-                                                  remove_placement_group)
-        create_ms, remove_ms = [], []
-        for _ in range(20):
-            t0 = time.perf_counter()
-            pg = placement_group([{"CPU": 1}] * 3, strategy="PACK")
-            ray_tpu.get(pg.ready(), timeout=60)
-            create_ms.append((time.perf_counter() - t0) * 1e3)
-            t0 = time.perf_counter()
-            remove_placement_group(pg)
-            remove_ms.append((time.perf_counter() - t0) * 1e3)
-        partial["pg_create_ms"] = round(statistics.median(create_ms), 2)
-        partial["pg_remove_ms"] = round(statistics.median(remove_ms), 2)
-        _persist(partial)
-        log(f"pg create/remove: {partial['pg_create_ms']}/"
-            f"{partial['pg_remove_ms']} ms")
+        log(f"actor_launch_rate (3-node fake): {rate:,.1f}/s")
     finally:
         try:
             ray_tpu.shutdown()

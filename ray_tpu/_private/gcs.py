@@ -712,7 +712,12 @@ class GcsServer:
             result = await self.clients.request(
                 node.address, "create_actor",
                 {"spec": spec, "num_restarts": actor.num_restarts},
-                timeout=self.config.gcs_rpc_timeout_s * 4,
+                # Must outlive the raylet's own worker-start wait: timing
+                # out earlier just respawns the create while the first
+                # one still progresses (thundering retries under a worker
+                # spawn storm on small boxes).
+                timeout=max(self.config.gcs_rpc_timeout_s * 4,
+                            self.config.worker_start_timeout_s + 30.0),
             )
         except Exception as e:
             logger.warning("actor %s creation on %s failed: %s",

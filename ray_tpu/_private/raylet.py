@@ -276,6 +276,9 @@ class Raylet:
         self.clients = rpc.ClientPool()
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self._idle_workers: List[WorkerHandle] = []
+        # Actor creates waiting for a worker: (env_hash, exact, future),
+        # FIFO-served by rpc_register_worker.
+        self._actor_worker_waiters: List[tuple] = []
         self._pending_leases: List[tuple] = []   # (spec, future)
         self._autoscaler_active = False
         self._spawned_worker_prefixes: set = set()
@@ -610,7 +613,23 @@ class Raylet:
         handle.conn = conn
         handle.idle_since = time.time()
         self._starting_workers = max(0, self._starting_workers - 1)
-        self._idle_workers.append(handle)
+        # Serve the oldest compatible waiting actor-create first (see
+        # rpc_create_actor): a dedicated-env worker only matches its
+        # exact hash; a fresh worker serves any non-exact waiter.
+        claimed = False
+        for waiter in list(self._actor_worker_waiters):
+            eh, exact, fut = waiter
+            if fut.done():
+                self._actor_worker_waiters.remove(waiter)
+                continue
+            if handle.env_hash == eh or (handle.env_hash == ""
+                                         and not exact):
+                self._actor_worker_waiters.remove(waiter)
+                fut.set_result(handle)
+                claimed = True
+                break
+        if not claimed:
+            self._idle_workers.append(handle)
         conn.peer_info["worker_id"] = worker_id
         prev = conn.on_close
         def _on_close(c, _prev=prev):
@@ -1060,9 +1079,24 @@ class Raylet:
                                        exact=cenv is not None)
         if worker is None:
             self._spawn_worker(container_env=cenv)
-            deadline = time.time() + self.config.worker_start_timeout_s
-            while worker is None and time.time() < deadline:
-                await asyncio.sleep(0.02)
+            # FIFO hand-off: freshly registered workers go to the OLDEST
+            # waiting create (rpc_register_worker serves this queue).
+            # Polling here instead let N concurrent creates steal each
+            # other's spawns — under a 40-actor storm on one node some
+            # handlers starved to the timeout (measured: 4s -> 240s).
+            fut = asyncio.get_event_loop().create_future()
+            waiter = (spec.env_hash(), cenv is not None, fut)
+            self._actor_worker_waiters.append(waiter)
+            try:
+                worker = await asyncio.wait_for(
+                    fut, timeout=self.config.worker_start_timeout_s)
+            except asyncio.TimeoutError:
+                worker = None
+            finally:
+                if waiter in self._actor_worker_waiters:
+                    self._actor_worker_waiters.remove(waiter)
+            if worker is None:
+                # Last chance: a worker freed via the idle path.
                 worker = self._get_idle_worker(spec.env_hash(),
                                                exact=cenv is not None)
             if worker is None:
