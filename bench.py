@@ -254,17 +254,46 @@ def bench_core(partial: dict):
     _persist(partial)
     log(f"n_n_actor_calls_with_arg_async: {v:,.0f}/s")
 
-    # multi-client tasks: 4 in-worker drivers each submitting nop bursts
-    def _multi_client_tasks():
-        n = 250
-        t0 = time.perf_counter()
-        ray_tpu.get([c.burst_tasks.remote(n) for c in callers])
-        return 4 * n / (time.perf_counter() - t0)
-
-    v = median_of(_multi_client_tasks, reps=3)
-    partial["multi_client_tasks_async"] = round(v, 1)
-    _persist(partial)
-    log(f"multi_client_tasks_async: {v:,.0f}/s")
+    # multi-client tasks: 3 real DRIVER processes join the cluster by
+    # address and burst async nops concurrently (the reference's
+    # multi_client shape — ray_perf.py forks drivers).
+    import subprocess
+    from ray_tpu._private import worker_api as _wapi
+    gcs_addr = _wapi._state.gcs_address
+    script = (
+        "import os, sys, time\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        f"sys.path.insert(0, {repr(os.path.dirname(os.path.abspath(__file__)))})\n"
+        "import ray_tpu\n"
+        f"ray_tpu.init(address={gcs_addr!r})\n"
+        "@ray_tpu.remote\n"
+        "def nop():\n"
+        "    return None\n"
+        "ray_tpu.get(nop.remote(), timeout=60)\n"
+        "n = 600\n"
+        "t0 = time.perf_counter()\n"
+        "ray_tpu.get([nop.remote() for _ in range(n)], timeout=120)\n"
+        "print('RATE', n / (time.perf_counter() - t0))\n"
+        "ray_tpu.shutdown()\n")
+    try:
+        procs = [subprocess.Popen([sys.executable, "-c", script],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True)
+                 for _ in range(3)]
+        rates = []
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            for ln in out.splitlines():
+                if ln.startswith("RATE "):
+                    rates.append(float(ln.split()[1]))
+        if rates:
+            v = sum(rates)
+            partial["multi_client_tasks_async"] = round(v, 1)
+            _persist(partial)
+            log(f"multi_client_tasks_async: {v:,.0f}/s "
+                f"({len(rates)} drivers)")
+    except Exception as e:  # noqa: BLE001
+        log(f"multi-client phase skipped: {type(e).__name__}: {e}")
 
     # ray.wait over 1k plasma refs (ref single_client_wait_1k_refs)
     wait_refs = [ray_tpu.put(small) for _ in range(1000)]
