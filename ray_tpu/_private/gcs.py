@@ -712,12 +712,13 @@ class GcsServer:
             result = await self.clients.request(
                 node.address, "create_actor",
                 {"spec": spec, "num_restarts": actor.num_restarts},
-                # Must outlive the raylet's own worker-start wait: timing
-                # out earlier just respawns the create while the first
-                # one still progresses (thundering retries under a worker
-                # spawn storm on small boxes).
+                # Must outlive the raylet's FULL create path: up to one
+                # worker-start wait for a worker + another for the
+                # instantiate request (compile-heavy constructors). Timing
+                # out earlier respawns the create while the first still
+                # progresses (thundering retries / duplicate construction).
                 timeout=max(self.config.gcs_rpc_timeout_s * 4,
-                            self.config.worker_start_timeout_s + 30.0),
+                            2 * self.config.worker_start_timeout_s + 30.0),
             )
         except Exception as e:
             logger.warning("actor %s creation on %s failed: %s",

@@ -613,23 +613,7 @@ class Raylet:
         handle.conn = conn
         handle.idle_since = time.time()
         self._starting_workers = max(0, self._starting_workers - 1)
-        # Serve the oldest compatible waiting actor-create first (see
-        # rpc_create_actor): a dedicated-env worker only matches its
-        # exact hash; a fresh worker serves any non-exact waiter.
-        claimed = False
-        for waiter in list(self._actor_worker_waiters):
-            eh, exact, fut = waiter
-            if fut.done():
-                self._actor_worker_waiters.remove(waiter)
-                continue
-            if handle.env_hash == eh or (handle.env_hash == ""
-                                         and not exact):
-                self._actor_worker_waiters.remove(waiter)
-                fut.set_result(handle)
-                claimed = True
-                break
-        if not claimed:
-            self._idle_workers.append(handle)
+        self._offer_idle_worker(handle)
         conn.peer_info["worker_id"] = worker_id
         prev = conn.on_close
         def _on_close(c, _prev=prev):
@@ -683,6 +667,24 @@ class Raylet:
                         await handle.conn.push("shutdown", {})
                 except Exception:
                     pass
+
+    def _offer_idle_worker(self, handle: "WorkerHandle"):
+        """A worker became available: serve the oldest compatible waiting
+        actor-create (FIFO — see rpc_create_actor) or return it to the
+        idle pool. Every idle-return path goes through here so a freed
+        worker can rescue a waiting create whose own spawn died."""
+        for waiter in list(self._actor_worker_waiters):
+            eh, exact, fut = waiter
+            if fut.done():
+                self._actor_worker_waiters.remove(waiter)
+                continue
+            if handle.env_hash == eh or (handle.env_hash == ""
+                                         and not exact):
+                self._actor_worker_waiters.remove(waiter)
+                fut.set_result(handle)
+                return
+        if handle not in self._idle_workers:
+            self._idle_workers.append(handle)
 
     def _get_idle_worker(self, env_hash: str = "",
                          exact: bool = False) -> Optional[WorkerHandle]:
@@ -1009,7 +1011,7 @@ class Raylet:
                 pass
         else:
             handle.idle_since = time.time()
-            self._idle_workers.append(handle)
+            self._offer_idle_worker(handle)
         self._try_dispatch()
         return True
 
@@ -1078,7 +1080,13 @@ class Raylet:
         worker = self._get_idle_worker(spec.env_hash(),
                                        exact=cenv is not None)
         if worker is None:
-            self._spawn_worker(container_env=cenv)
+            try:
+                self._spawn_worker(container_env=cenv)
+            except Exception:
+                # Spawn failure (e.g. container runner vanished) must not
+                # leak the acquired resources.
+                self.pool.release(spec.resources, pg_key)
+                raise
             # FIFO hand-off: freshly registered workers go to the OLDEST
             # waiting create (rpc_register_worker serves this queue).
             # Polling here instead let N concurrent creates steal each
@@ -1131,8 +1139,7 @@ class Raylet:
             worker.is_actor_worker = False
             worker.actor_id = None
             worker.idle_since = time.time()
-            if worker not in self._idle_workers:
-                self._idle_workers.append(worker)
+            self._offer_idle_worker(worker)
             self.pool.release(spec.resources, pg_key)
             self._mark_resources_dirty()
             return {"app_error": reply["app_error"]}

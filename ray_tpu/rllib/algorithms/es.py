@@ -120,9 +120,8 @@ class ES(Algorithm):
         seeds = self._next_seeds(cfg.num_perturbations)
         r_pos, r_neg = self._perturbation_returns(seeds)
         self._update_theta(self._gradient(seeds, r_pos, r_neg))
-        # Push theta to the runners (they unravel into their pytree) and
-        # measure the deterministic policy's return.
-        from jax.flatten_util import ravel_pytree  # noqa: PLC0415
+        # Score the updated policy: a zero-sigma "perturbation" evaluates
+        # exactly theta (the runner unravels the flat vector itself).
         eval_ref = self.env_runners[0].evaluate_perturbations.remote(
             self.theta, [0], 0.0, 1, cfg.max_episode_steps)
         cur = float(ray_tpu.get(eval_ref, timeout=600)[0][0])

@@ -103,12 +103,17 @@ class NormalizeObs(Connector):
         if self.mean is None:
             self.mean = np.zeros(batch.shape[-1], np.float64)
             self.m2 = np.zeros(batch.shape[-1], np.float64)
-        if update:
-            for row in batch:
-                self.count += 1.0
-                delta = row - self.mean
-                self.mean += delta / self.count
-                self.m2 += delta * (row - self.mean)
+        if update and len(batch):
+            # Chan parallel-variance merge: one vectorized update per
+            # batch instead of a per-row Python loop (hot sampling path).
+            n_b = float(len(batch))
+            mean_b = batch.mean(axis=0, dtype=np.float64)
+            m2_b = ((batch - mean_b) ** 2).sum(axis=0, dtype=np.float64)
+            delta = mean_b - self.mean
+            total = self.count + n_b
+            self.mean += delta * (n_b / total)
+            self.m2 += m2_b + delta * delta * (self.count * n_b / total)
+            self.count = total
         if self.count < 2:
             return x
         std = np.sqrt(self.m2 / (self.count - 1)) + self.eps

@@ -55,18 +55,34 @@ def list_actors(state: Optional[str] = None,
 
 def list_tasks(job_id: Optional[str] = None, limit: int = 1000,
                filters: Optional[List[Filter]] = None) -> List[dict]:
-    """Latest-state view of task events; `filters` evaluate server-side
-    over raw events (attrs: name/state/task_id/worker_id...)."""
+    """Latest-state view of task events. Identity filters (name/task_id/
+    worker_id...) evaluate SERVER-side over raw events; `state` filters
+    evaluate HERE over the latest-state reduction — filtering raw events
+    by state would resurrect superseded states (a FINISHED task still has
+    an old RUNNING event that would match state="RUNNING")."""
+    filters = list(filters or [])
+    state_filters = [f for f in filters if f[0] == "state"]
+    other_filters = [f for f in filters if f[0] != "state"]
     events = _gcs("get_task_events", {"job_id": job_id, "limit": 100000,
-                                      "filters": list(filters or [])})
+                                      "filters": other_filters})
     latest: Dict[str, dict] = {}
     for e in events:
         latest[e["task_id"]] = e
-    rows = [{
-        "task_id": e["task_id"], "name": e["name"], "state": e["state"],
-        "job_id": e["job_id"], "actor_id": e.get("actor_id"),
-        "worker_id": e.get("worker_id"),
-    } for e in latest.values()]
+    rows = []
+    for e in latest.values():
+        ok = True
+        for _attr, op, want in state_filters:
+            eq = str(e.get("state")) == str(want)
+            if (op == "=" and not eq) or (op == "!=" and eq):
+                ok = False
+                break
+        if ok:
+            rows.append({
+                "task_id": e["task_id"], "name": e["name"],
+                "state": e["state"], "job_id": e["job_id"],
+                "actor_id": e.get("actor_id"),
+                "worker_id": e.get("worker_id"),
+            })
     return rows[-limit:]
 
 
