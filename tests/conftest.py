@@ -17,9 +17,12 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
 os.environ["JAX_PLATFORMS"] = "cpu"
 # Persistent XLA compilation cache: the model tests are compile-bound on
 # this 1-vCPU box (~6 of the suite's ~12 minutes); repeat runs hit the
-# cache. Workers inherit the env var.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      "/tmp/ray_tpu_jax_cache")
+# cache. Workers inherit the env var. MUST be a CPU-only dir, separate
+# from the chip/axon cache: with PALLAS_AXON_REMOTE_COMPILE the tunnel
+# compiles on the REMOTE host, whose CPU AOT artifacts carry different
+# machine features — loading them here warns "could lead to SIGILL" and
+# crashing workers mid-actor-construction wedged whole suite runs.
+os.environ["JAX_COMPILATION_CACHE_DIR"] = "/tmp/ray_tpu_jax_cache_cpu"
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 os.environ["RAY_TPU_HEARTBEAT_INTERVAL_S"] = "0.2"
 os.environ["RAY_TPU_NODE_DEATH_TIMEOUT_S"] = "2.0"
