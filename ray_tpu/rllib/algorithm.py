@@ -30,6 +30,9 @@ class AlgorithmConfig:
         self.minibatch_size = 128
         self.num_epochs = 8
         self.hidden = (64, 64)
+        # Full catalog model config dict (fcnet_hiddens / conv_filters /
+        # use_lstm / lstm_cell_size); None -> legacy default MLP.
+        self.model: Optional[Dict[str, Any]] = None
         self.seed = 0
         # Multi-agent (set via .multi_agent()); declared here so the plain
         # dict config path (Tune param_space) round-trips them too.
@@ -80,8 +83,10 @@ class AlgorithmConfig:
             self.minibatch_size = minibatch_size
         if num_epochs is not None:
             self.num_epochs = num_epochs
-        if model is not None and "fcnet_hiddens" in model:
-            self.hidden = tuple(model["fcnet_hiddens"])
+        if model is not None:
+            self.model = dict(model)
+            if "fcnet_hiddens" in model:
+                self.hidden = tuple(model["fcnet_hiddens"])
         self.extra.update(extra)
         return self
 
@@ -127,6 +132,10 @@ class Algorithm(Trainable):
     """
 
     config_class: Type[AlgorithmConfig] = AlgorithmConfig
+    # Algorithms whose learner builds through the model catalog set this;
+    # others keep the legacy MLP even if a model config is present (their
+    # learner's param layout must match the runner's).
+    supports_model_config = False
 
     def __init__(self, config=None):
         if isinstance(config, AlgorithmConfig):
@@ -168,7 +177,10 @@ class Algorithm(Trainable):
                                   cfg.num_envs_per_env_runner,
                                   seed=cfg.seed + 1000 * i,
                                   hidden=cfg.hidden,
-                                  obs_connectors=cfg.obs_connectors)
+                                  obs_connectors=cfg.obs_connectors,
+                                  model=(cfg.model
+                                         if self.supports_model_config
+                                         else None))
                 for i in range(cfg.num_env_runners)
             ]
         self._episode_rewards: List[float] = []

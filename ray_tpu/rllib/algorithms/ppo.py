@@ -39,6 +39,7 @@ class PPOConfig(AlgorithmConfig):
 
 class PPO(Algorithm):
     config_class = PPOConfig
+    supports_model_config = True
 
     def _make_learner(self, probe, seed_offset: int = 0):
         cfg = self.algo_config
@@ -48,7 +49,12 @@ class PPO(Algorithm):
             clip_param=getattr(cfg, "clip_param", 0.2),
             vf_coeff=getattr(cfg, "vf_loss_coeff", 0.5),
             entropy_coeff=getattr(cfg, "entropy_coeff", 0.0),
-            seed=cfg.seed + seed_offset)
+            seed=cfg.seed + seed_offset,
+            obs_shape=tuple(probe.observation_shape) or None,
+            # MultiAgentEnvRunner builds the legacy MLP; the catalog path
+            # is single-agent (matches runner-side construction).
+            model=None if cfg.is_multi_agent else cfg.model,
+            seq_len=cfg.rollout_fragment_length)
 
     def build_learner(self):
         cfg = self.algo_config

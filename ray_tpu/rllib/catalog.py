@@ -1,0 +1,289 @@
+"""Model catalog: obs-space-driven network construction.
+
+Reference parity: rllib/models/catalog.py (ModelCatalog.get_model_v2 picks
+a default fcnet / vision net / adds an LSTM wrapper from the model config
+dict) and rllib/models/torch/{fcnet,visionnet,recurrent_net}.py. Here the
+catalog emits pure (init, apply) JAX functions over a params pytree:
+
+  - flat observations  -> MLP torso (tanh, orthogonal init)
+  - image observations -> CNN torso (relu, NHWC conv stack) + dense
+  - use_lstm=True      -> an LSTM cell between torso and heads; sequence
+    training runs the cell under lax.scan with carry resets at episode
+    boundaries (done_prev), so one compiled program handles fragments
+    containing any number of episode ends — no Python-side unrolling.
+
+Model config keys mirror the reference's (fcnet_hiddens, conv_filters,
+use_lstm, lstm_cell_size, vf_share_layers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ModelConfig:
+    """Catalog knobs (subset of the reference MODEL_DEFAULTS that matters
+    for the nets we build)."""
+
+    def __init__(self,
+                 fcnet_hiddens: Sequence[int] = (64, 64),
+                 conv_filters: Optional[Sequence[Tuple[int, int, int]]] = None,
+                 use_lstm: bool = False,
+                 lstm_cell_size: int = 64,
+                 vf_share_layers: bool = False):
+        self.fcnet_hiddens = tuple(fcnet_hiddens)
+        # [(out_channels, kernel, stride), ...]; None -> auto for the input.
+        self.conv_filters = (None if conv_filters is None
+                             else [tuple(f) for f in conv_filters])
+        self.use_lstm = bool(use_lstm)
+        self.lstm_cell_size = int(lstm_cell_size)
+        self.vf_share_layers = bool(vf_share_layers)
+
+    _KEYS = ("fcnet_hiddens", "conv_filters", "use_lstm",
+             "lstm_cell_size", "vf_share_layers")
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "ModelConfig":
+        d = dict(d or {})
+        unknown = set(d) - set(ModelConfig._KEYS)
+        if unknown:
+            raise ValueError(
+                f"unknown model config keys {sorted(unknown)}; "
+                f"supported: {list(ModelConfig._KEYS)}")
+        return ModelConfig(**d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"fcnet_hiddens": list(self.fcnet_hiddens),
+                "conv_filters": self.conv_filters,
+                "use_lstm": self.use_lstm,
+                "lstm_cell_size": self.lstm_cell_size,
+                "vf_share_layers": self.vf_share_layers}
+
+
+def _default_conv_filters(obs_shape) -> List[Tuple[int, int, int]]:
+    """Small-input defaults (the reference ships 84x84 Atari filters; our
+    built-in image envs are small grids, so scale to the input)."""
+    h = obs_shape[0]
+    if h >= 32:
+        return [(16, 8, 4), (32, 4, 2), (64, 3, 1)]
+    if h >= 10:
+        return [(16, 4, 2), (32, 3, 2)]
+    return [(16, 3, 1), (32, 3, 1)]
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def _dense_init(rng, fan_in: int, fan_out: int, scale: float = np.sqrt(2.0)):
+    import jax
+    import jax.numpy as jnp
+    w = jax.random.orthogonal(rng, max(fan_in, fan_out))[:fan_in, :fan_out]
+    return {"w": jnp.asarray(w * scale, jnp.float32),
+            "b": jnp.zeros((fan_out,), jnp.float32)}
+
+
+def _mlp_init(rng, sizes: List[int]):
+    import jax
+    keys = jax.random.split(rng, max(len(sizes) - 1, 1))
+    return [_dense_init(k, i, o)
+            for k, (i, o) in zip(keys, zip(sizes[:-1], sizes[1:]))]
+
+
+def _conv_init(rng, in_ch: int, out_ch: int, kernel: int):
+    import jax
+    import jax.numpy as jnp
+    fan_in = kernel * kernel * in_ch
+    w = jax.random.normal(rng, (kernel, kernel, in_ch, out_ch),
+                          jnp.float32) * np.sqrt(2.0 / fan_in)
+    return {"w": w, "b": jnp.zeros((out_ch,), jnp.float32)}
+
+
+def _normalize_obs_shape(obs_shape) -> Tuple[int, ...]:
+    shape = tuple(int(s) for s in obs_shape)
+    if len(shape) == 2:          # (H, W) grayscale -> (H, W, 1)
+        shape = shape + (1,)
+    return shape
+
+
+def _torso_init(rng, obs_shape, cfg: ModelConfig):
+    """-> (params, feature_dim). CNN for rank>=2 obs, MLP otherwise.
+
+    Params hold ONLY arrays (jax pytree leaves); the static structure
+    (mlp-vs-cnn, strides) is re-derived from (cfg, obs shape) at apply
+    time so the same config built runner- and learner-side agrees."""
+    import jax
+    shape = _normalize_obs_shape(obs_shape)
+    if len(shape) == 1:
+        sizes = [shape[0], *cfg.fcnet_hiddens]
+        return {"layers": _mlp_init(rng, sizes)}, sizes[-1]
+    filters = cfg.conv_filters or _default_conv_filters(shape)
+    h, w, ch = shape
+    keys = jax.random.split(rng, len(filters) + 1)
+    convs = []
+    for k, (out_ch, kernel, stride) in zip(keys, filters):
+        convs.append(_conv_init(k, ch, out_ch, kernel))
+        # SAME padding: ceil-div spatial reduction.
+        h = -(-h // stride)
+        w = -(-w // stride)
+        ch = out_ch
+    flat = h * w * ch
+    post = list(cfg.fcnet_hiddens) or [64]
+    dense = _mlp_init(keys[-1], [flat, *post])
+    return {"convs": convs, "dense": dense}, post[-1]
+
+
+def _lstm_init(rng, in_dim: int, cell: int):
+    import jax
+    import jax.numpy as jnp
+    k1, k2 = jax.random.split(rng)
+    scale_x = np.sqrt(1.0 / in_dim)
+    scale_h = np.sqrt(1.0 / cell)
+    return {
+        "wx": jax.random.normal(k1, (in_dim, 4 * cell),
+                                jnp.float32) * scale_x,
+        "wh": jax.random.normal(k2, (cell, 4 * cell),
+                                jnp.float32) * scale_h,
+        "b": jnp.zeros((4 * cell,), jnp.float32),
+    }
+
+
+def catalog_init(rng, obs_shape, num_outputs: int, cfg: ModelConfig):
+    """Build the policy/value params pytree for an observation space.
+
+    num_outputs is the pi-head width (action logits for PG-family, Q-values
+    for the DQN family — the reference catalog makes the same dual use).
+    """
+    import jax
+    k_torso, k_lstm, k_pi, k_vf, k_vt = jax.random.split(rng, 5)
+    torso, feat = _torso_init(k_torso, obs_shape, cfg)
+    params = {"torso": torso}
+    head_in = feat
+    if cfg.use_lstm:
+        params["lstm"] = _lstm_init(k_lstm, feat, cfg.lstm_cell_size)
+        head_in = cfg.lstm_cell_size
+    params["pi"] = _mlp_init(k_pi, [head_in, num_outputs])
+    if cfg.vf_share_layers or cfg.use_lstm:
+        # Recurrent nets share the torso+cell (reference recurrent_net.py
+        # always shares); feed the value head from the same features.
+        params["vf"] = _mlp_init(k_vf, [head_in, 1])
+    else:
+        vt, vfeat = _torso_init(k_vt, obs_shape, cfg)
+        params["vf_torso"] = vt
+        params["vf"] = _mlp_init(k_vf, [vfeat, 1])
+    return params
+
+
+# ---------------------------------------------------------------------------
+# apply
+# ---------------------------------------------------------------------------
+
+def _mlp_apply(layers, x, final_act: bool = True):
+    import jax.numpy as jnp
+    for i, layer in enumerate(layers):
+        x = x @ layer["w"] + layer["b"]
+        if final_act or i < len(layers) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+def _torso_apply(torso, obs, cfg: ModelConfig):
+    import jax
+    if "layers" in torso:        # MLP
+        return _mlp_apply(torso["layers"], obs)
+    x = obs
+    if x.ndim == 3:              # (B, H, W) -> (B, H, W, 1)
+        x = x[..., None]
+    filters = cfg.conv_filters or _default_conv_filters(x.shape[1:])
+    for conv, (_oc, _k, stride) in zip(torso["convs"], filters):
+        x = jax.lax.conv_general_dilated(
+            x, conv["w"], window_strides=(stride, stride), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + conv["b"]
+        x = jax.nn.relu(x)
+    x = x.reshape(x.shape[0], -1)
+    return _mlp_apply(torso["dense"], x)
+
+
+def _pi_head(params, feat):
+    # 0.01 logit scale: near-uniform initial policy (matches the legacy
+    # policy_value nets so learning curves are comparable).
+    return _mlp_apply(params["pi"], feat, final_act=False) * 0.01
+
+
+def _vf_head(params, feat):
+    return _mlp_apply(params["vf"], feat, final_act=False)[..., 0]
+
+
+def _heads(params, feat):
+    return _pi_head(params, feat), _vf_head(params, feat)
+
+
+def _lstm_cell(lstm, x, h, c):
+    import jax
+    import jax.numpy as jnp
+    gates = x @ lstm["wx"] + h @ lstm["wh"] + lstm["b"]
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    # Forget-gate bias +1: standard recurrent-net stabilization.
+    c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+def initial_state(batch_size: int, cfg: ModelConfig):
+    """Zero (h, c) carry for a recurrent model."""
+    import jax.numpy as jnp
+    z = jnp.zeros((batch_size, cfg.lstm_cell_size), jnp.float32)
+    return (z, z)
+
+
+def catalog_apply(params, obs, cfg: ModelConfig):
+    """Stateless forward [B, ...] -> (logits [B, A], values [B])."""
+    assert not cfg.use_lstm, "recurrent model: use catalog_apply_step/seq"
+    feat = _torso_apply(params["torso"], obs, cfg)
+    pi = _pi_head(params, feat)
+    if "vf_torso" in params:
+        vfeat = _torso_apply(params["vf_torso"], obs, cfg)
+    else:
+        vfeat = feat
+    return pi, _vf_head(params, vfeat)
+
+
+def catalog_apply_step(params, obs, state, cfg: ModelConfig):
+    """One recurrent step [B, ...] + (h, c) -> (logits, values, state')."""
+    feat = _torso_apply(params["torso"], obs, cfg)
+    h, c = _lstm_cell(params["lstm"], feat, *state)
+    pi, vf = _heads(params, h)
+    return pi, vf, (h, c)
+
+
+def catalog_apply_seq(params, obs_seq, done_prev, state_in,
+                      cfg: ModelConfig):
+    """Sequence forward for BPTT training.
+
+    obs_seq [B, T, ...], done_prev [B, T] (1.0 where step t-1 ended an
+    episode — the carry resets there), state_in (h, c) each [B, cell]
+    (the sampler's carry at fragment start). -> (logits [B, T, A],
+    values [B, T], state_out).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    obs_tm = jnp.moveaxis(obs_seq, 1, 0)       # [T, B, ...]
+    done_tm = jnp.moveaxis(done_prev, 1, 0)    # [T, B]
+
+    def tick(carry, inp):
+        h, c = carry
+        obs_t, done_t = inp
+        mask = (1.0 - done_t)[:, None]
+        h, c = h * mask, c * mask
+        feat = _torso_apply(params["torso"], obs_t, cfg)
+        h, c = _lstm_cell(params["lstm"], feat, h, c)
+        pi, vf = _heads(params, h)
+        return (h, c), (pi, vf)
+
+    state_out, (pi_tm, vf_tm) = jax.lax.scan(
+        tick, state_in, (obs_tm, done_tm))
+    return (jnp.moveaxis(pi_tm, 0, 1), jnp.moveaxis(vf_tm, 0, 1),
+            state_out)

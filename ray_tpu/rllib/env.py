@@ -17,6 +17,9 @@ class Env:
     step(a) -> (obs, reward, terminated, truncated, info)."""
 
     observation_dim: int
+    # Image envs set the full shape, e.g. (H, W, C); flat envs leave it
+    # empty and the catalog uses (observation_dim,).
+    observation_shape: Tuple[int, ...] = ()
     num_actions: int
     # Continuous-control envs set these instead of num_actions.
     continuous: bool = False
@@ -129,6 +132,110 @@ class PendulumEnv(Env):
         return self._obs(), -float(cost), False, self._t >= self._max_steps, {}
 
 
+class StatelessCartPole(CartPoleEnv):
+    """CartPole with the velocity components hidden (obs = [x, theta]) —
+    the standard recurrent-model benchmark (reference:
+    rllib/examples/envs/classes/stateless_cartpole.py): only a policy with
+    memory can estimate the derivatives it needs to balance."""
+
+    observation_dim = 2
+
+    def _mask(self, obs):
+        return obs[[0, 2]].astype(np.float32)
+
+    def reset(self, seed: Optional[int] = None):
+        obs, info = super().reset(seed)
+        return self._mask(obs), info
+
+    def step(self, action):
+        obs, r, term, trunc, info = super().step(action)
+        return self._mask(obs), r, term, trunc, info
+
+
+class MemoryCueEnv(Env):
+    """Cue-recall memory task: a one-hot cue is visible ONLY at t=0; after
+    `delay` blank steps the agent must emit the matching action. Expected
+    reward is 1/num_cues for any memoryless policy and 1.0 for a recurrent
+    one — a fast, discriminating LSTM test (the T-maze/recall family the
+    reference exercises with its RepeatAfterMeEnv example env)."""
+
+    def __init__(self, num_cues: int = 2, delay: int = 3):
+        self._n = num_cues
+        self._delay = delay
+        self.observation_dim = num_cues + 2  # cue one-hot, cue-phase, t/T
+        self.num_actions = num_cues
+        self._rng = np.random.RandomState()
+        self._cue = 0
+        self._t = 0
+
+    def _obs(self):
+        o = np.zeros(self.observation_dim, np.float32)
+        if self._t == 0:
+            o[self._cue] = 1.0
+            o[self._n] = 1.0
+        o[self._n + 1] = self._t / (self._delay + 1)
+        return o
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._cue = int(self._rng.randint(self._n))
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        last = self._t == self._delay
+        reward = float(int(action) == self._cue) if last else 0.0
+        self._t += 1
+        return self._obs(), reward, last, False, {}
+
+
+class GridGoalEnv(Env):
+    """Image-observation navigation: an agent (pixel=1.0) moves on an
+    n x n grid toward a fixed goal (pixel=0.5). Exercises the catalog's
+    CNN torso end-to-end (the vision-net slot of the reference catalog,
+    rllib/models/torch/visionnet.py) without any game dependency."""
+
+    def __init__(self, size: int = 5, max_steps: int = 24):
+        self._size = size
+        self._max_steps = max_steps
+        self.observation_shape = (size, size, 1)
+        self.observation_dim = size * size
+        self.num_actions = 4  # up, down, left, right
+        self._rng = np.random.RandomState()
+        self._pos = (0, 0)
+        self._goal = (size - 1, size - 1)
+        self._t = 0
+
+    def _obs(self):
+        o = np.zeros(self.observation_shape, np.float32)
+        o[self._goal[0], self._goal[1], 0] = 0.5
+        o[self._pos[0], self._pos[1], 0] = 1.0
+        return o
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        while True:
+            self._pos = (int(self._rng.randint(self._size)),
+                         int(self._rng.randint(self._size)))
+            if self._pos != self._goal:
+                break
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        dr, dc = ((-1, 0), (1, 0), (0, -1), (0, 1))[int(action)]
+        r = min(max(self._pos[0] + dr, 0), self._size - 1)
+        c = min(max(self._pos[1] + dc, 0), self._size - 1)
+        self._pos = (r, c)
+        self._t += 1
+        at_goal = self._pos == self._goal
+        reward = 1.0 if at_goal else -0.02
+        return (self._obs(), reward, at_goal,
+                self._t >= self._max_steps, {})
+
+
 class MultiAgentEnv:
     """Multi-agent interface (reference: rllib/env/multi_agent_env.py):
     dict-keyed observations/actions/rewards per agent id. Agents may
@@ -192,6 +299,9 @@ _ENV_REGISTRY: Dict[str, Callable[[dict], Env]] = {
     "CartPole-v1": lambda cfg: CartPoleEnv(**cfg),
     "Pendulum-v1": lambda cfg: PendulumEnv(**cfg),
     "MultiCartPole": lambda cfg: MultiCartPole(**cfg),
+    "StatelessCartPole": lambda cfg: StatelessCartPole(**cfg),
+    "MemoryCue": lambda cfg: MemoryCueEnv(**cfg),
+    "GridGoal": lambda cfg: GridGoalEnv(**cfg),
 }
 
 

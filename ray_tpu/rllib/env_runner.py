@@ -20,7 +20,8 @@ from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
 class EnvRunner:
     def __init__(self, env_spec, env_config: dict, num_envs: int,
-                 seed: int, hidden=(64, 64), obs_connectors=None):
+                 seed: int, hidden=(64, 64), obs_connectors=None,
+                 model=None):
         import jax
         jax.config.update("jax_platforms", "cpu")
         from ray_tpu.rllib.connectors import default_obs_pipeline
@@ -38,12 +39,37 @@ class EnvRunner:
         # before the policy forward AND before storage, so the learner
         # trains in the same (preprocessed) observation space.
         self._obs_conn = default_obs_pipeline(obs_connectors)
-        obs_dim = self._envs[0].observation_dim
-        n_act = self._envs[0].num_actions
-        self._params = policy_value_init(jax.random.PRNGKey(seed), obs_dim,
-                                         hidden=tuple(hidden),
-                                         num_actions=n_act)
-        self._jit_forward = jax.jit(policy_value_apply)
+        e0 = self._envs[0]
+        obs_dim = e0.observation_dim
+        n_act = e0.num_actions
+        self._recurrent = False
+        if model is not None:
+            # Catalog path (reference: ModelCatalog.get_model_v2): obs
+            # shape drives CNN-vs-MLP; use_lstm threads a carry through
+            # sampling (state rows reset on episode end).
+            from ray_tpu.rllib.catalog import (ModelConfig, catalog_apply,
+                                               catalog_apply_step,
+                                               catalog_init, initial_state)
+            self._mcfg = ModelConfig.from_dict(model)
+            obs_shape = tuple(e0.observation_shape) or (obs_dim,)
+            self._params = catalog_init(jax.random.PRNGKey(seed), obs_shape,
+                                        n_act, self._mcfg)
+            self._recurrent = self._mcfg.use_lstm
+            if self._recurrent:
+                h, c = initial_state(len(self._envs), self._mcfg)
+                self._state = [np.asarray(h), np.asarray(c)]
+                mcfg = self._mcfg
+                self._jit_step = jax.jit(
+                    lambda p, o, s: catalog_apply_step(p, o, s, mcfg))
+            else:
+                mcfg = self._mcfg
+                self._jit_forward = jax.jit(
+                    lambda p, o: catalog_apply(p, o, mcfg))
+        else:
+            self._params = policy_value_init(
+                jax.random.PRNGKey(seed), obs_dim, hidden=tuple(hidden),
+                num_actions=n_act)
+            self._jit_forward = jax.jit(policy_value_apply)
 
     def set_weights(self, params):
         self._params = params
@@ -52,6 +78,8 @@ class EnvRunner:
                lam: float = 0.95) -> SampleBatch:
         """Collect num_steps per env; returns a postprocessed batch with
         GAE advantages."""
+        if self._recurrent:
+            return self._sample_recurrent(num_steps, gamma, lam)
         import jax.nn
         n_envs = len(self._envs)
         cols = (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.TERMINATEDS,
@@ -101,12 +129,86 @@ class EnvRunner:
             batches.append(compute_gae(b, last_v, gamma, lam))
         return sb.concat_samples(batches)
 
+    def _sample_recurrent(self, num_steps: int, gamma: float,
+                          lam: float) -> SampleBatch:
+        """Recurrent rollout: per-env (h, c) carry threads across
+        fragments; rows reset to zero on episode end. Each env's T steps
+        form one contiguous training sequence, with per-step done_prev and
+        state_in columns so the learner's scan replays the exact carries
+        (reference: recurrent sampling in rollout_worker + the
+        max_seq_len trajectory-view machinery)."""
+        n_envs = len(self._envs)
+        cols = (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.TERMINATEDS,
+                sb.TRUNCATEDS, sb.LOGPS, sb.VF_PREDS, sb.BOOTSTRAP_VALUES,
+                sb.DONE_PREV, sb.STATE_IN_H, sb.STATE_IN_C)
+        per_env: List[Dict[str, List]] = [
+            {k: [] for k in cols} for _ in range(n_envs)]
+        done_prev = np.zeros(n_envs, np.float32)
+        for _t in range(num_steps):
+            obs_arr = self._obs_conn(np.stack(self._obs))
+            h_in, c_in = self._state
+            logits, values, (h2, c2) = self._jit_step(
+                self._params, obs_arr, (h_in, c_in))
+            logits = np.asarray(logits)
+            values = np.asarray(values)
+            h2, c2 = np.array(h2), np.array(c2)
+            probs = np.exp(logits - logits.max(-1, keepdims=True))
+            probs /= probs.sum(-1, keepdims=True)
+            for i, env in enumerate(self._envs):
+                a = self._rng.choice(len(probs[i]), p=probs[i])
+                obs2, r, term, trunc, _ = env.step(a)
+                rec = per_env[i]
+                rec[sb.OBS].append(obs_arr[i])
+                rec[sb.ACTIONS].append(a)
+                rec[sb.REWARDS].append(r)
+                rec[sb.TERMINATEDS].append(term)
+                rec[sb.TRUNCATEDS].append(trunc)
+                rec[sb.LOGPS].append(np.log(probs[i][a] + 1e-10))
+                rec[sb.VF_PREDS].append(values[i])
+                rec[sb.DONE_PREV].append(done_prev[i])
+                # Per-step carry rows (the learner reads only each
+                # sequence's first row): SampleBatch columns must be
+                # equal-length, and cell-size rows are small next to obs.
+                rec[sb.STATE_IN_H].append(h_in[i])
+                rec[sb.STATE_IN_C].append(c_in[i])
+                boot = 0.0
+                if trunc and not term:
+                    nxt = self._obs_conn(obs2[None], update=False)
+                    _lg, bv, _st = self._jit_step(
+                        self._params, nxt, (h2[i:i + 1], c2[i:i + 1]))
+                    boot = float(np.asarray(bv)[0])
+                rec[sb.BOOTSTRAP_VALUES].append(boot)
+                self._ep_rewards[i] += r
+                done_prev[i] = 0.0
+                if term or trunc:
+                    self._done_rewards.append(self._ep_rewards[i])
+                    self._ep_rewards[i] = 0.0
+                    obs2, _ = env.reset()
+                    h2[i] = 0.0
+                    c2[i] = 0.0
+                    done_prev[i] = 1.0
+                self._obs[i] = obs2
+            self._state = [h2, c2]
+        obs_arr = self._obs_conn(np.stack(self._obs), update=False)
+        _lg, last_values, _st = self._jit_step(
+            self._params, obs_arr, tuple(self._state))
+        last_values = np.asarray(last_values)
+        batches = []
+        for i in range(n_envs):
+            b = SampleBatch({k: np.asarray(v) for k, v in per_env[i].items()})
+            last_v = 0.0 if b[sb.TERMINATEDS][-1] else float(last_values[i])
+            batches.append(compute_gae(b, last_v, gamma, lam))
+        return sb.concat_samples(batches)
+
     def sample_transitions(self, num_steps: int,
                            epsilon: float = 0.0) -> SampleBatch:
         """(obs, action, reward, next_obs, done) tuples with epsilon-greedy
         over the policy head's scores — the value-based (DQN-family)
         collection mode (reference: RolloutWorker with
         EpsilonGreedy exploration)."""
+        assert not self._recurrent, (
+            "DQN-family transition sampling does not support use_lstm "
+            "(the reference gates this behind R2D2)")
         cols = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS,
                                 sb.NEXT_OBS, sb.TERMINATEDS)}
         for _t in range(num_steps):
@@ -141,9 +243,16 @@ class EnvRunner:
         total = 0.0
         for _ep in range(episodes):
             obs, _ = env.reset(seed=int(self._rng.randint(2 ** 31)))
+            state = None
+            if self._recurrent:
+                from ray_tpu.rllib.catalog import initial_state
+                state = initial_state(1, self._mcfg)
             for _ in range(max_steps):
-                x = self._obs_conn(np.asarray(obs)[None, :], update=False)
-                logits, _v = self._jit_forward(params, x)
+                x = self._obs_conn(np.asarray(obs)[None], update=False)
+                if self._recurrent:
+                    logits, _v, state = self._jit_step(params, x, state)
+                else:
+                    logits, _v = self._jit_forward(params, x)
                 obs, r, term, trunc, _ = env.step(
                     int(np.argmax(np.asarray(logits)[0])))
                 total += r

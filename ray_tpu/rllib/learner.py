@@ -4,11 +4,19 @@ Reference parity: rllib/core/learner/learner.py:106 — but where the
 reference runs a torch DDP loop, this is a single jit-compiled
 loss+grad+apply on whatever backend hosts the learner (TPU when available).
 Scaling across chips is a pmap/pjit axis, not a process group.
+
+With a `model` config the learner builds through the catalog
+(rllib/models/catalog.py parity): CNN torsos for image observations and,
+with use_lstm, sequence training — fragments become [B, T] sequences, the
+LSTM replays the sampler's exact carries (state_in columns) under
+lax.scan with carry resets at episode boundaries, and minibatching
+permutes whole sequences (the reference's max_seq_len padding machinery,
+minus padding: fragments are fixed-length by construction).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -19,32 +27,75 @@ from ray_tpu.rllib.models import policy_value_apply, policy_value_init
 class PPOLearner:
     def __init__(self, obs_dim: int, num_actions: int, *,
                  hidden=(64, 64), lr=5e-4, clip_param=0.2,
-                 vf_coeff=0.5, entropy_coeff=0.0, seed=0):
+                 vf_coeff=0.5, entropy_coeff=0.0, seed=0,
+                 obs_shape: Optional[Tuple[int, ...]] = None,
+                 model: Optional[Dict[str, Any]] = None,
+                 seq_len: Optional[int] = None):
         import jax
         import jax.numpy as jnp
         import optax
 
         self._optimizer = optax.adam(lr)
         self._clip_param = clip_param
-        self.params = policy_value_init(
-            jax.random.PRNGKey(seed), obs_dim, num_actions,
-            hidden=tuple(hidden))
+        self._recurrent = False
+        if model is not None:
+            from ray_tpu.rllib.catalog import (ModelConfig,
+                                               catalog_apply,
+                                               catalog_apply_seq,
+                                               catalog_init)
+            mcfg = ModelConfig.from_dict(model)
+            shape = tuple(obs_shape) if obs_shape else (obs_dim,)
+            self.params = catalog_init(jax.random.PRNGKey(seed), shape,
+                                       num_actions, mcfg)
+            self._recurrent = mcfg.use_lstm
+            self._seq_len = seq_len
+            if self._recurrent and not seq_len:
+                raise ValueError("recurrent model needs seq_len "
+                                 "(= rollout_fragment_length)")
+            if self._recurrent:
+                seq_apply = (lambda p, o, d, s:
+                             catalog_apply_seq(p, o, d, s, mcfg))
+            else:
+                fwd = lambda p, o: catalog_apply(p, o, mcfg)  # noqa: E731
+        else:
+            self.params = policy_value_init(
+                jax.random.PRNGKey(seed), obs_dim, num_actions,
+                hidden=tuple(hidden))
+            fwd = policy_value_apply
         self.opt_state = self._optimizer.init(self.params)
 
-        def loss_fn(params, batch):
-            logits, values = policy_value_apply(params, batch[sb.OBS])
+        def ppo_terms(logits, values, actions, old_logp, adv, vtarg):
+            """Shared PPO loss math over flat [N] tensors."""
             logp_all = jax.nn.log_softmax(logits)
             n = logits.shape[0]
-            logp = logp_all[jnp.arange(n), batch[sb.ACTIONS]]
-            adv = batch[sb.ADVANTAGES]
+            logp = logp_all[jnp.arange(n), actions]
             adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-            pg_loss = self._pg_loss(logp, batch[sb.LOGPS], adv)
-            vf_loss = ((values - batch[sb.VALUE_TARGETS]) ** 2).mean()
+            pg_loss = self._pg_loss(logp, old_logp, adv)
+            vf_loss = ((values - vtarg) ** 2).mean()
             entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
             total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
             return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
                            "entropy": entropy,
-                           "kl": (batch[sb.LOGPS] - logp).mean()}
+                           "kl": (old_logp - logp).mean()}
+
+        if self._recurrent:
+            def loss_fn(params, batch):
+                logits, values, _ = seq_apply(
+                    params, batch[sb.OBS], batch[sb.DONE_PREV],
+                    (batch[sb.STATE_IN_H], batch[sb.STATE_IN_C]))
+                a = logits.shape[-1]
+                return ppo_terms(
+                    logits.reshape(-1, a), values.reshape(-1),
+                    batch[sb.ACTIONS].reshape(-1),
+                    batch[sb.LOGPS].reshape(-1),
+                    batch[sb.ADVANTAGES].reshape(-1),
+                    batch[sb.VALUE_TARGETS].reshape(-1))
+        else:
+            def loss_fn(params, batch):
+                logits, values = fwd(params, batch[sb.OBS])
+                return ppo_terms(logits, values, batch[sb.ACTIONS],
+                                 batch[sb.LOGPS], batch[sb.ADVANTAGES],
+                                 batch[sb.VALUE_TARGETS])
 
         def update(params, opt_state, batch):
             (loss, metrics), grads = jax.value_and_grad(
@@ -55,7 +106,6 @@ class PPOLearner:
             metrics["total_loss"] = loss
             return params, opt_state, metrics
 
-        import jax
         self._jit_update = jax.jit(update)
 
     def _pg_loss(self, logp, old_logp, adv):
@@ -71,6 +121,9 @@ class PPOLearner:
     def update(self, batch, *, minibatch_size: int, num_epochs: int,
                seed=0) -> Dict[str, float]:
         import jax.numpy as jnp
+        if self._recurrent:
+            return self._update_recurrent(batch, minibatch_size,
+                                          num_epochs, seed)
         metrics = {}
         needed = (sb.OBS, sb.ACTIONS, sb.LOGPS, sb.ADVANTAGES,
                   sb.VALUE_TARGETS)
@@ -82,6 +135,44 @@ class PPOLearner:
             n_updates += 1
             for k, v in m.items():
                 metrics[k] = metrics.get(k, 0.0) + float(v)
+        if n_updates:
+            metrics = {k: v / n_updates for k, v in metrics.items()}
+        metrics["num_minibatch_updates"] = n_updates
+        return metrics
+
+    def _update_recurrent(self, batch, minibatch_size: int,
+                          num_epochs: int, seed=0) -> Dict[str, float]:
+        """Sequence-major update: [N] -> [B, T], permute sequences (never
+        steps), replay carries from the fragment-start state_in rows."""
+        import jax.numpy as jnp
+        t = self._seq_len
+        n = len(batch)
+        if n % t:
+            raise ValueError(f"batch of {n} not divisible by seq_len {t}")
+        rows = n // t
+        seq_cols = (sb.OBS, sb.ACTIONS, sb.LOGPS, sb.ADVANTAGES,
+                    sb.VALUE_TARGETS, sb.DONE_PREV)
+        arrs = {k: np.asarray(batch[k]).reshape(
+            rows, t, *np.asarray(batch[k]).shape[1:]) for k in seq_cols}
+        # state_in of each sequence = the sampler's carry at its 1st step.
+        arrs[sb.STATE_IN_H] = np.asarray(
+            batch[sb.STATE_IN_H]).reshape(rows, t, -1)[:, 0]
+        arrs[sb.STATE_IN_C] = np.asarray(
+            batch[sb.STATE_IN_C]).reshape(rows, t, -1)[:, 0]
+        per_mb = max(1, minibatch_size // t)
+        rng = np.random.RandomState(seed)
+        metrics: Dict[str, float] = {}
+        n_updates = 0
+        for _ in range(num_epochs):
+            order = rng.permutation(rows)
+            for start in range(0, rows - per_mb + 1, per_mb):
+                sel = order[start:start + per_mb]
+                jb = {k: jnp.asarray(v[sel]) for k, v in arrs.items()}
+                self.params, self.opt_state, m = self._jit_update(
+                    self.params, self.opt_state, jb)
+                n_updates += 1
+                for k, v in m.items():
+                    metrics[k] = metrics.get(k, 0.0) + float(v)
         if n_updates:
             metrics = {k: v / n_updates for k, v in metrics.items()}
         metrics["num_minibatch_updates"] = n_updates

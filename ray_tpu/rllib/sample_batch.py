@@ -22,6 +22,11 @@ VF_PREDS = "vf_preds"
 ADVANTAGES = "advantages"
 VALUE_TARGETS = "value_targets"
 EPS_ID = "eps_id"
+# Recurrent-model columns (reference: SampleBatch "state_in_*" keys +
+# the seq_lens machinery; here sequences are fixed-length fragments).
+DONE_PREV = "done_prev"
+STATE_IN_H = "state_in_h"
+STATE_IN_C = "state_in_c"
 
 
 class SampleBatch(dict):

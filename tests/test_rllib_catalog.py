@@ -1,0 +1,134 @@
+"""Model catalog: CNN torso for image obs, LSTM sequence training.
+
+Reference parity: rllib/models/catalog.py (get_model_v2 vision/fcnet
+selection + use_lstm wrapper) and rllib/models/torch/recurrent_net.py
+(sequence replay with carry resets). The learning tests are the
+discriminating kind: GridGoal needs the CNN to read pixel positions;
+MemoryCue is unsolvable above chance without memory.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ray_rl():
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_catalog_builds_cnn_for_image_obs(jax_cpu):
+    import jax
+    from ray_tpu.rllib.catalog import (ModelConfig, catalog_apply,
+                                       catalog_init)
+
+    cfg = ModelConfig.from_dict({"fcnet_hiddens": [32]})
+    params = catalog_init(jax.random.PRNGKey(0), (5, 5, 1), 4, cfg)
+    assert "convs" in params["torso"]
+    obs = np.random.rand(7, 5, 5, 1).astype(np.float32)
+    logits, values = catalog_apply(params, obs, cfg)
+    assert logits.shape == (7, 4)
+    assert values.shape == (7,)
+
+
+def test_catalog_builds_mlp_for_flat_obs(jax_cpu):
+    import jax
+    from ray_tpu.rllib.catalog import (ModelConfig, catalog_apply,
+                                       catalog_init)
+
+    cfg = ModelConfig.from_dict({"fcnet_hiddens": [16, 16]})
+    params = catalog_init(jax.random.PRNGKey(0), (3,), 2, cfg)
+    assert "layers" in params["torso"]
+    logits, values = catalog_apply(
+        params, np.random.rand(5, 3).astype(np.float32), cfg)
+    assert logits.shape == (5, 2)
+
+
+def test_lstm_seq_apply_matches_stepwise(jax_cpu):
+    """catalog_apply_seq(scan) must equal step-by-step catalog_apply_step,
+    including a mid-sequence episode-boundary carry reset."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.rllib.catalog import (ModelConfig, catalog_apply_seq,
+                                       catalog_apply_step, catalog_init,
+                                       initial_state)
+
+    cfg = ModelConfig.from_dict({"fcnet_hiddens": [8], "use_lstm": True,
+                                 "lstm_cell_size": 8})
+    params = catalog_init(jax.random.PRNGKey(0), (3,), 2, cfg)
+    B, T = 2, 6
+    obs = jnp.asarray(np.random.randn(B, T, 3).astype(np.float32))
+    done_prev = np.zeros((B, T), np.float32)
+    done_prev[0, 3] = 1.0  # env 0's episode ended at t=2
+    done_prev = jnp.asarray(done_prev)
+    state = initial_state(B, cfg)
+
+    seq_logits, seq_values, _ = catalog_apply_seq(
+        params, obs, done_prev, state, cfg)
+
+    h, c = state
+    for t in range(T):
+        mask = (1.0 - done_prev[:, t])[:, None]
+        lg, vl, (h, c) = catalog_apply_step(
+            params, obs[:, t], (h * mask, c * mask), cfg)
+        np.testing.assert_allclose(np.asarray(lg),
+                                   np.asarray(seq_logits[:, t]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(vl),
+                                   np.asarray(seq_values[:, t]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_ppo_cnn_learns_gridgoal(ray_rl, jax_cpu):
+    """PPO with the auto-CNN torso solves the 4x4 image gridworld."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment("GridGoal", env_config={"size": 4,
+                                                 "max_steps": 16})
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                         rollout_fragment_length=64)
+            .training(lr=8e-3, minibatch_size=128, num_epochs=8,
+                      entropy_coeff=0.005,
+                      model={"fcnet_hiddens": [32]})
+            .debugging(seed=0)
+            .build())
+    assert "convs" in algo.learner.params["torso"]
+    best = -np.inf
+    for _ in range(25):
+        r = algo.train()
+        if r["episodes_total"]:
+            best = max(best, r["episode_reward_mean"])
+    algo.stop()
+    # A random walk on the 4x4 grid averages ~0.03 (measured over 2k
+    # episodes); a policy that reads the pixels heads to the goal and
+    # repeatedly clears +0.6 per episode.
+    assert best > 0.45, best
+
+
+def test_ppo_lstm_learns_memory_cue(ray_rl, jax_cpu):
+    """PPO+LSTM must recall the t=0 cue after the delay (chance = 0.5)."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment("MemoryCue", env_config={"num_cues": 2,
+                                                  "delay": 3})
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                         rollout_fragment_length=32)
+            .training(lr=2e-2, minibatch_size=64, num_epochs=8,
+                      entropy_coeff=0.003,
+                      model={"fcnet_hiddens": [32], "use_lstm": True,
+                             "lstm_cell_size": 32})
+            .debugging(seed=0)
+            .build())
+    assert algo.learner._recurrent
+    recent = []
+    for i in range(25):
+        r = algo.train()
+        if r["episodes_total"]:
+            recent.append(r["episode_reward_mean"])
+    algo.stop()
+    best = max(recent[-10:])
+    assert best > 0.85, recent[-10:]
