@@ -171,7 +171,8 @@ class Algorithm(Trainable):
                 for i in range(cfg.num_env_runners)
             ]
         else:
-            runner_cls = ray_tpu.remote(num_cpus=1)(EnvRunner)
+            runner_cls = ray_tpu.remote(num_cpus=1)(self._runner_class())
+            extra = self._extra_runner_kwargs()
             self.env_runners = [
                 runner_cls.remote(creator, cfg.env_config,
                                   cfg.num_envs_per_env_runner,
@@ -180,11 +181,22 @@ class Algorithm(Trainable):
                                   obs_connectors=cfg.obs_connectors,
                                   model=(cfg.model
                                          if self.supports_model_config
-                                         else None))
+                                         else None),
+                                  **extra)
                 for i in range(cfg.num_env_runners)
             ]
         self._episode_rewards: List[float] = []
         self.build_learner()
+
+    def _runner_class(self):
+        """Rollout-actor class for the single-agent path; algorithms with
+        a custom sampler (e.g. C51's expected-Q scoring) override this
+        instead of copying setup()."""
+        from ray_tpu.rllib.env_runner import EnvRunner
+        return EnvRunner
+
+    def _extra_runner_kwargs(self) -> Dict[str, Any]:
+        return {}
 
     def build_learner(self):
         raise NotImplementedError

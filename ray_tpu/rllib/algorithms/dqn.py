@@ -127,12 +127,19 @@ class DQNLearner:
 class DQN(Algorithm):
     config_class = DQNConfig
 
+    def _make_q_learner(self, probe):
+        """Q-learner factory; the distributional variant (C51) overrides
+        just this instead of copying build_learner."""
+        cfg = self.algo_config
+        return DQNLearner(
+            probe.observation_dim, probe.num_actions, hidden=cfg.hidden,
+            lr=cfg.lr, gamma=cfg.gamma, double_q=cfg.double_q,
+            seed=cfg.seed)
+
     def build_learner(self):
         cfg = self.algo_config
         probe = make_env(cfg.env, cfg.env_config)
-        self.learner = DQNLearner(
-            probe.observation_dim, probe.num_actions, hidden=cfg.hidden,
-            lr=cfg.lr, gamma=cfg.gamma, double_q=cfg.double_q, seed=cfg.seed)
+        self.learner = self._make_q_learner(probe)
         buf_cls = (PrioritizedReplayBuffer if cfg.prioritized_replay
                    else ReplayBuffer)
         self.replay = buf_cls(cfg.replay_buffer_capacity, seed=cfg.seed)

@@ -39,10 +39,16 @@ class EnvRunner:
         # before the policy forward AND before storage, so the learner
         # trains in the same (preprocessed) observation space.
         self._obs_conn = default_obs_pipeline(obs_connectors)
+        self._recurrent = False
+        self._build_policy(seed, hidden, model)
+
+    def _build_policy(self, seed: int, hidden, model):
+        """Construct self._params + the jitted forward. Subclasses with a
+        different head (e.g. C51's distributional Q) override JUST this."""
+        import jax
         e0 = self._envs[0]
         obs_dim = e0.observation_dim
         n_act = e0.num_actions
-        self._recurrent = False
         if model is not None:
             # Catalog path (reference: ModelCatalog.get_model_v2): obs
             # shape drives CNN-vs-MLP; use_lstm threads a carry through
