@@ -10,9 +10,12 @@ from ray_tpu.autoscaler.commands import (ClusterLauncher,
                                          create_or_update_cluster,
                                          load_cluster_config,
                                          teardown_cluster)
+from ray_tpu.autoscaler.v2 import (AutoscalerV2, InstanceManager,
+                                   Reconciler)
 
 __all__ = [
     "AutoscalerConfig", "NodeTypeConfig", "StandardAutoscaler",
+    "AutoscalerV2", "InstanceManager", "Reconciler",
     "NodeProvider", "FakeMultiNodeProvider", "TPUPodProvider",
     "Monitor", "make_gcs_request",
     "ClusterLauncher", "create_or_update_cluster", "load_cluster_config",

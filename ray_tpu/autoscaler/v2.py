@@ -1,0 +1,408 @@
+"""Autoscaler v2: instance-manager architecture.
+
+Reference parity: python/ray/autoscaler/v2/ — the v2 redesign splits the
+monolithic StandardAutoscaler loop into:
+
+  - InstanceManager (instance_manager/instance_manager.py): the ONLY
+    writer of a versioned instance table; every instance walks an explicit
+    lifecycle state machine and every transition is validated + recorded.
+  - Reconciler (instance_manager/reconciler.py): diffs the table against
+    the two external views — the cloud provider's instance list and the
+    GCS node table — and applies the resulting transitions.
+  - Scheduler (scheduler.py): pure demand -> target-shape computation.
+
+The v1 loop (autoscaler.py StandardAutoscaler) stays as the default; v2
+runs against the SAME NodeProvider implementations (fake / GCE TPU / k8s)
+and the same GCS autoscaler-state RPC, so either engine can drive a
+cluster. TPU slice gangs scale as one instance whose `count` is the
+slice's host count (the gang unit is an instance, not a host).
+
+Instance lifecycle (reference: instance_manager/common.py InstanceUtil
+transition graph):
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING -> RAY_STOPPING
+                 |             |             |              |
+                 v             v             v              v
+          ALLOCATION_FAILED  TERMINATED <- TERMINATING <----+
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, NodeTypeConfig
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# instance states
+# ---------------------------------------------------------------------------
+
+QUEUED = "QUEUED"                        # wanted; no cloud call yet
+REQUESTED = "REQUESTED"                  # create_node issued
+ALLOCATED = "ALLOCATED"                  # provider lists the node(s)
+RAY_RUNNING = "RAY_RUNNING"              # registered with the GCS
+RAY_STOPPING = "RAY_STOPPING"            # drain requested
+TERMINATING = "TERMINATING"             # terminate_node issued
+TERMINATED = "TERMINATED"               # gone from the provider
+ALLOCATION_FAILED = "ALLOCATION_FAILED"  # create_node raised
+
+_TRANSITIONS: Dict[str, Tuple[str, ...]] = {
+    QUEUED: (REQUESTED,),
+    REQUESTED: (ALLOCATED, ALLOCATION_FAILED),
+    ALLOCATED: (RAY_RUNNING, TERMINATING, TERMINATED),
+    RAY_RUNNING: (RAY_STOPPING, TERMINATING, TERMINATED),
+    RAY_STOPPING: (TERMINATING, TERMINATED),
+    TERMINATING: (TERMINATED,),
+    TERMINATED: (),
+    ALLOCATION_FAILED: (QUEUED,),        # retry path
+}
+
+
+class InvalidTransitionError(ValueError):
+    pass
+
+
+class VersionConflictError(RuntimeError):
+    pass
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    state: str = QUEUED
+    # Provider node ids backing this instance (slice gangs: all hosts).
+    provider_ids: Tuple[str, ...] = ()
+    gcs_node_ids: Tuple[str, ...] = ()
+    version: int = 0
+    launch_attempts: int = 0
+    # [(state, unix_ts)] — the reference keeps the same audit trail.
+    history: List[Tuple[str, float]] = field(default_factory=list)
+
+    def seen(self, state: str) -> bool:
+        return any(s == state for s, _ in self.history)
+
+
+class InstanceManager:
+    """Versioned instance table; the only mutation path is
+    update_instance, which validates the lifecycle transition and bumps
+    the version (optimistic concurrency, reference
+    instance_manager.py:update_instance_manager_state)."""
+
+    def __init__(self):
+        self._instances: Dict[str, Instance] = {}
+        self._next = itertools.count()
+
+    def add_instance(self, node_type: str) -> Instance:
+        iid = f"inst-{next(self._next)}"
+        inst = Instance(instance_id=iid, node_type=node_type,
+                        history=[(QUEUED, time.time())])
+        self._instances[iid] = inst
+        return inst
+
+    def get(self, instance_id: str) -> Instance:
+        return self._instances[instance_id]
+
+    def instances(self, states: Optional[Tuple[str, ...]] = None
+                  ) -> List[Instance]:
+        out = list(self._instances.values())
+        if states is not None:
+            out = [i for i in out if i.state in states]
+        return out
+
+    def update_instance(self, instance_id: str, new_state: str, *,
+                        expected_version: Optional[int] = None,
+                        provider_ids: Optional[Tuple[str, ...]] = None,
+                        gcs_node_ids: Optional[Tuple[str, ...]] = None
+                        ) -> Instance:
+        inst = self._instances[instance_id]
+        if expected_version is not None and \
+                inst.version != expected_version:
+            raise VersionConflictError(
+                f"{instance_id}: version {inst.version} != "
+                f"expected {expected_version}")
+        if new_state not in _TRANSITIONS[inst.state]:
+            raise InvalidTransitionError(
+                f"{instance_id}: {inst.state} -> {new_state} not allowed")
+        inst.state = new_state
+        inst.version += 1
+        inst.history.append((new_state, time.time()))
+        if provider_ids is not None:
+            inst.provider_ids = tuple(provider_ids)
+        if gcs_node_ids is not None:
+            inst.gcs_node_ids = tuple(gcs_node_ids)
+        return inst
+
+
+# ---------------------------------------------------------------------------
+# scheduler: demand -> per-type launch/terminate decisions (pure)
+# ---------------------------------------------------------------------------
+
+def compute_scaling_decision(
+        demand_shapes: List[Dict[str, float]],
+        node_types: Dict[str, NodeTypeConfig],
+        available_bins: List[Dict[str, float]],
+        active_counts: Dict[str, int]) -> Dict[str, int]:
+    """Bin-pack unmet demand onto the cheapest fitting node type.
+
+    Pure function (reference: v2/scheduler.py ResourceDemandScheduler):
+    no provider or table access, fully unit-testable. Returns
+    {node_type: instances_to_launch}. available_bins are mutated copies
+    of per-node available resources; active_counts are CURRENT instance
+    counts per type (for max_workers enforcement).
+    """
+    bins = [{"cap": dict(b), "exclusive_taken": False}
+            for b in available_bins]
+    to_launch: Dict[str, int] = {}
+
+    def try_place(shape: Dict[str, float], exclusive: bool) -> bool:
+        for b in bins:
+            if exclusive and b["exclusive_taken"]:
+                continue
+            if all(b["cap"].get(k, 0.0) >= v
+                   for k, v in shape.items() if v > 0):
+                for k, v in shape.items():
+                    if v > 0:
+                        b["cap"][k] = b["cap"].get(k, 0.0) - v
+                if exclusive:
+                    b["exclusive_taken"] = True
+                return True
+        return False
+
+    for shape in demand_shapes:
+        shape = dict(shape)
+        exclusive = shape.pop("__exclusive__", 0.0) > 0
+        if try_place(shape, exclusive):
+            continue
+        for t in sorted(node_types.values(),
+                        key=lambda t: sum(t.resources.values())):
+            current = (active_counts.get(t.name, 0)
+                       + to_launch.get(t.name, 0))
+            if t.fits(shape) and current < t.max_workers:
+                to_launch[t.name] = to_launch.get(t.name, 0) + 1
+                cap = dict(t.resources)
+                for k, v in shape.items():
+                    if v > 0:
+                        cap[k] = cap.get(k, 0.0) - v
+                bins.append({"cap": cap, "exclusive_taken": exclusive})
+                break
+        else:
+            logger.warning("v2 scheduler: demand %s fits no node type",
+                           shape)
+    # min_workers floor
+    for t in node_types.values():
+        short = (t.min_workers - active_counts.get(t.name, 0)
+                 - to_launch.get(t.name, 0))
+        if short > 0:
+            to_launch[t.name] = to_launch.get(t.name, 0) + short
+    return to_launch
+
+
+# ---------------------------------------------------------------------------
+# reconciler
+# ---------------------------------------------------------------------------
+
+class Reconciler:
+    """Applies the table <-> world diff (reference: v2 reconciler.py):
+
+      QUEUED            -> issue create_node         -> REQUESTED/ALLOCATED
+      REQUESTED/ALLOCATED + GCS sees the node        -> RAY_RUNNING
+      any active + provider no longer lists its ids  -> TERMINATED
+      RAY_STOPPING      -> drain done                -> TERMINATING
+      TERMINATING       -> issue terminate_node      -> TERMINATED
+      ALLOCATION_FAILED -> requeue (bounded retries) -> QUEUED
+    """
+
+    def __init__(self, provider: NodeProvider,
+                 node_types: Dict[str, NodeTypeConfig],
+                 max_launch_retries: int = 3):
+        self.provider = provider
+        self.node_types = node_types
+        self.max_launch_retries = max_launch_retries
+
+    def reconcile(self, im: InstanceManager, gcs_state: dict,
+                  gcs_request=None) -> Dict[str, Any]:
+        events: List[str] = []
+        # GCS hex ids by the provider-id label (cloud nodes register with
+        # a ray_tpu.io/provider-id label; same correlation as v1).
+        gcs_by_provider: Dict[str, str] = {}
+        gcs_alive: Dict[str, bool] = {}
+        gcs_idle: Dict[str, bool] = {}
+        for nid, info in gcs_state.get("nodes", {}).items():
+            hexid = nid.hex() if hasattr(nid, "hex") else str(nid)
+            gcs_alive[hexid] = bool(info.get("alive"))
+            gcs_idle[hexid] = all(
+                abs(info.get("available", {}).get(k, 0.0) - v) < 1e-6
+                for k, v in info.get("total", {}).items()
+                if k not in ("memory", "object_store_memory"))
+            p = (info.get("labels") or {}).get("ray_tpu.io/provider-id")
+            if p:
+                gcs_by_provider[p] = hexid
+
+        # 1) launch QUEUED instances.
+        for inst in im.instances((QUEUED,)):
+            t = self.node_types[inst.node_type]
+            im.update_instance(inst.instance_id, REQUESTED)
+            inst.launch_attempts += 1
+            try:
+                pids = self.provider.create_node(
+                    t.name, {"resources": dict(t.resources)},
+                    max(1, t.slice_hosts))
+                im.update_instance(inst.instance_id, ALLOCATED,
+                                   provider_ids=tuple(pids))
+                events.append(f"{inst.instance_id}: allocated {pids}")
+            except Exception as e:  # noqa: BLE001 — cloud call failed
+                im.update_instance(inst.instance_id, ALLOCATION_FAILED)
+                events.append(f"{inst.instance_id}: allocation failed {e}")
+
+        # 2) requeue bounded allocation failures.
+        for inst in im.instances((ALLOCATION_FAILED,)):
+            if inst.launch_attempts < self.max_launch_retries:
+                im.update_instance(inst.instance_id, QUEUED)
+                events.append(f"{inst.instance_id}: requeued "
+                              f"(attempt {inst.launch_attempts})")
+
+        # Refresh the provider view: step 1 just created nodes, and the
+        # vanished-node check below must not see them as missing.
+        alive_provider = set(self.provider.non_terminated_nodes())
+
+        def gcs_hex_of(pid: str) -> str:
+            # Two correlation channels (same as v1): local providers tag
+            # nodes with the GCS id directly; cloud nodes register a
+            # ray_tpu.io/provider-id label from their startup script.
+            nid = self.provider.node_tags(pid).get("node_id", "")
+            if nid in gcs_alive:
+                return nid
+            return gcs_by_provider.get(pid, "")
+
+        # 3) ALLOCATED -> RAY_RUNNING once every host registered alive.
+        for inst in im.instances((ALLOCATED,)):
+            hexes = [gcs_hex_of(p) for p in inst.provider_ids]
+            if all(h and gcs_alive.get(h) for h in hexes):
+                im.update_instance(inst.instance_id, RAY_RUNNING,
+                                   gcs_node_ids=tuple(hexes))
+                events.append(f"{inst.instance_id}: ray running")
+
+        # 4) instances whose provider nodes vanished -> TERMINATED.
+        for inst in im.instances((ALLOCATED, RAY_RUNNING, RAY_STOPPING)):
+            if inst.provider_ids and not \
+                    (set(inst.provider_ids) & alive_provider):
+                im.update_instance(inst.instance_id, TERMINATED)
+                events.append(f"{inst.instance_id}: provider gone")
+
+        # 5) RAY_STOPPING: request the drain, then hand to TERMINATING
+        # only once every host is idle (or gone) — terminating a node
+        # with in-flight work would kill it instead of draining.
+        for inst in im.instances((RAY_STOPPING,)):
+            if gcs_request is not None:
+                # Idempotent: the GCS marks the node draining; re-sending
+                # across passes is harmless.
+                for h in inst.gcs_node_ids:
+                    gcs_request("drain_node", {"node_id_hex": h})
+            drained = all(
+                not gcs_alive.get(h, False) or gcs_idle.get(h, False)
+                for h in inst.gcs_node_ids)
+            if drained:
+                im.update_instance(inst.instance_id, TERMINATING)
+
+        # 6) TERMINATING: issue provider terminations.
+        for inst in im.instances((TERMINATING,)):
+            for pid in inst.provider_ids:
+                if pid in alive_provider:
+                    self.provider.terminate_node(pid)
+            im.update_instance(inst.instance_id, TERMINATED)
+            events.append(f"{inst.instance_id}: terminated")
+        return {"events": events}
+
+
+# ---------------------------------------------------------------------------
+# the v2 engine
+# ---------------------------------------------------------------------------
+
+class AutoscalerV2:
+    """update() = scheduler decision + reconcile, driven by the same GCS
+    autoscaler-state RPC as v1 (drop-in alternative engine)."""
+
+    def __init__(self, config: AutoscalerConfig, provider: NodeProvider,
+                 gcs_request):
+        self.config = config
+        self.provider = provider
+        self.gcs_request = gcs_request
+        self.im = InstanceManager()
+        self.reconciler = Reconciler(provider, config.node_types)
+        self._idle_since: Dict[str, float] = {}
+
+    def _demand_shapes(self, state: dict) -> List[Dict[str, float]]:
+        shapes = [dict(s) for s in state.get("pending_demand", [])]
+        for pg in state.get("pending_placement_groups", []):
+            for b in pg["bundles"]:
+                s = dict(b)
+                if pg["strategy"] == "STRICT_SPREAD":
+                    s["__exclusive__"] = 1.0
+                shapes.append(s)
+        return shapes
+
+    def update(self) -> dict:
+        state = self.gcs_request("get_autoscaler_state", {})
+        active = (QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING)
+        counts: Dict[str, int] = {}
+        for inst in self.im.instances(active):
+            counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
+        bins = [dict(n["available"]) for n in state["nodes"].values()
+                if n["alive"]]
+        # Capacity already requested but not yet registered with the GCS
+        # counts as supply too (prevents double-launch across passes).
+        for inst in self.im.instances((QUEUED, REQUESTED, ALLOCATED)):
+            bins.append(dict(
+                self.config.node_types[inst.node_type].resources))
+        to_launch = compute_scaling_decision(
+            self._demand_shapes(state), self.config.node_types, bins,
+            counts)
+        for node_type, n in to_launch.items():
+            for _ in range(min(n, self.config.max_launch_batch)):
+                self.im.add_instance(node_type)
+        self._scale_down_idle(state)
+        result = self.reconciler.reconcile(self.im, state,
+                                           self.gcs_request)
+        result["instances"] = {
+            i.instance_id: i.state for i in self.im.instances()}
+        return result
+
+    def _scale_down_idle(self, state: dict):
+        now = time.time()
+        if self._demand_shapes(state):
+            self._idle_since.clear()
+            return
+        gcs_by_hex = {
+            (nid.hex() if hasattr(nid, "hex") else str(nid)): info
+            for nid, info in state["nodes"].items()}
+
+        def idle(hexid: str) -> bool:
+            n = gcs_by_hex.get(hexid)
+            if n is None or not n["alive"]:
+                return False
+            return all(abs(n["available"].get(k, 0.0) - v) < 1e-6
+                       for k, v in n["total"].items()
+                       if k not in ("memory", "object_store_memory"))
+
+        counts: Dict[str, int] = {}
+        for inst in self.im.instances((RAY_RUNNING,)):
+            counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
+        for inst in self.im.instances((RAY_RUNNING,)):
+            t = self.config.node_types[inst.node_type]
+            if not inst.gcs_node_ids or \
+                    not all(idle(h) for h in inst.gcs_node_ids):
+                self._idle_since.pop(inst.instance_id, None)
+                continue
+            first = self._idle_since.setdefault(inst.instance_id, now)
+            if (now - first >= self.config.idle_timeout_s
+                    and counts.get(inst.node_type, 0) > t.min_workers):
+                self.im.update_instance(inst.instance_id, RAY_STOPPING)
+                counts[inst.node_type] -= 1
+                self._idle_since.pop(inst.instance_id, None)

@@ -24,7 +24,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, DQNLearner
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer
 from ray_tpu.rllib.sample_batch import SampleBatch
 
@@ -75,10 +75,7 @@ class ApexDQN(DQN):
         from ray_tpu.rllib.env import make_env
         cfg = self.algo_config
         probe = make_env(cfg.env, cfg.env_config)
-        self.learner = DQNLearner(
-            probe.observation_dim, probe.num_actions, hidden=cfg.hidden,
-            lr=cfg.lr, gamma=cfg.gamma, double_q=cfg.double_q,
-            seed=cfg.seed)
+        self.learner = self._make_q_learner(probe)
         self.replay_actor = ray_tpu.remote(num_cpus=0)(ReplayActor).remote(
             cfg.replay_buffer_capacity, seed=cfg.seed)
         self._steps_sampled = 0

@@ -43,9 +43,12 @@ def cmd_start(args):
     config = Config.load(None)
     set_config(config)
     res = detect_node_resources(args.num_cpus, args.num_tpus, None, config)
+    if args.memory is not None:
+        res["memory"] = float(args.memory)
 
     async def _run_head():
-        head = HeadNode(config, resources=res)
+        head = HeadNode(config, resources=res,
+                        object_store_memory=args.object_store_memory)
         gcs_address = await head.start(port=args.port)
         print(f"ray_tpu head started; GCS at {gcs_address}", flush=True)
         print(f"connect with: ray_tpu.init(address='{gcs_address}') or "
@@ -60,7 +63,8 @@ def cmd_start(args):
 
     async def _run_worker():
         session_dir = new_session_dir(config)
-        raylet = Raylet(config, args.address, session_dir, resources=res)
+        raylet = Raylet(config, args.address, session_dir, resources=res,
+                        object_store_memory=args.object_store_memory)
         await raylet.start()
         print(f"ray_tpu worker node joined {args.address}", flush=True)
         return raylet
@@ -263,6 +267,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--port", type=int, default=6379)
     s.add_argument("--num-cpus", type=float, default=None, dest="num_cpus")
     s.add_argument("--num-tpus", type=float, default=None, dest="num_tpus")
+    s.add_argument("--memory", type=int, default=None,
+                   help="node memory resource in bytes")
+    s.add_argument("--object-store-memory", type=int, default=None,
+                   dest="object_store_memory",
+                   help="plasma arena size in bytes")
     s.add_argument("--client-server-port", type=int, default=0,
                    dest="client_server_port",
                    help="serve remote ray_tpu:// clients on this port")

@@ -1,0 +1,206 @@
+"""Ray-on-Spark: launch a ray_tpu cluster inside a Spark application.
+
+Reference parity: python/ray/util/spark/cluster_init.py
+(setup_ray_cluster / shutdown_ray_cluster / MAX_NUM_WORKER_NODES). The
+head runs on the Spark driver; each worker node is pinned inside a Spark
+barrier-mode task so Spark's resource accounting owns the capacity.
+
+pyspark is not bundled in this image, so every public entry point gates
+on its presence; the resource-splitting math is pure and unit-tested
+without Spark (tests/test_workflow_shims.py).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+# Sentinel: "use every executor Spark will give us" (reference
+# cluster_init.py MAX_NUM_WORKER_NODES).
+MAX_NUM_WORKER_NODES = -1
+
+_cluster = None
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+        return pyspark
+    except ImportError as e:
+        raise ImportError(
+            "ray_tpu.util.spark needs pyspark (`pip install pyspark`); "
+            "it launches ray_tpu inside a live Spark application") from e
+
+
+def compute_worker_resources(
+        executor_cores: int, executor_memory_bytes: int,
+        heap_memory_fraction: float = 0.4,
+        object_store_fraction: float = 0.3
+        ) -> Dict[str, int]:
+    """Split one Spark executor's allocation into a ray_tpu worker's
+    num_cpus / memory / object_store_memory (pure; reference:
+    spark/utils.py get_avail_mem_per_ray_worker_node). The remaining
+    fraction is headroom for the executor JVM itself."""
+    if executor_cores <= 0:
+        raise ValueError("executor_cores must be positive")
+    if executor_memory_bytes <= 0:
+        raise ValueError("executor_memory_bytes must be positive")
+    heap = int(executor_memory_bytes * heap_memory_fraction)
+    store = int(executor_memory_bytes * object_store_fraction)
+    return {"num_cpus": executor_cores, "memory": heap,
+            "object_store_memory": store}
+
+
+def parse_memory_string(s: str) -> int:
+    """'4g' / '512m' / '1024k' / '123' (Spark conf syntax) -> bytes."""
+    s = s.strip().lower()
+    units = {"k": 1024, "m": 1024 ** 2, "g": 1024 ** 3, "t": 1024 ** 4}
+    if s and s[-1] in units:
+        return int(float(s[:-1]) * units[s[-1]])
+    return int(s)
+
+
+def _executor_conf(spark) -> Tuple[int, int]:
+    conf = spark.sparkContext.getConf()
+    cores = int(conf.get("spark.executor.cores", "1"))
+    mem = parse_memory_string(conf.get("spark.executor.memory", "4g"))
+    return cores, mem
+
+
+class _RayClusterOnSpark:
+    def __init__(self, address: str, job_group: str, spark, head_proc):
+        self.address = address
+        self._job_group = job_group
+        self._spark = spark
+        self._head_proc = head_proc
+
+    def shutdown(self):
+        # Cancelling the barrier job group tears down every worker task;
+        # then stop the head subprocess on the driver.
+        self._spark.sparkContext.cancelJobGroup(self._job_group)
+        if self._head_proc is not None:
+            self._head_proc.terminate()
+            self._head_proc.wait(timeout=30)
+
+
+def _start_head_subprocess(options: Optional[Dict[str, Any]] = None
+                           ) -> Tuple[Any, str]:
+    """`python -m ray_tpu start --head` on the driver; parse the GCS
+    address from its startup banner. options become --key=value flags."""
+    import re
+    import subprocess
+    import sys
+    cmd = [sys.executable, "-m", "ray_tpu", "start", "--head",
+           "--num-cpus=0"]
+    for k, v in (options or {}).items():
+        cmd.append(f"--{k.replace('_', '-')}={v}")
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+
+    # Banner read with a wall-clock deadline: readline() alone would hang
+    # forever if the head wedges before printing (e.g. port bind stall).
+    import queue
+    import threading
+    lines: "queue.Queue[str]" = queue.Queue()
+
+    def _pump():
+        for line in proc.stdout:
+            lines.put(line)
+
+    threading.Thread(target=_pump, daemon=True).start()
+    address = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            line = lines.get(timeout=1.0)
+        except queue.Empty:
+            if proc.poll() is not None:
+                break
+            continue
+        m = re.search(r"GCS at (\S+)", line)
+        if m:
+            address = m.group(1)
+            break
+    if address is None:
+        proc.terminate()
+        raise RuntimeError("ray_tpu head failed to report its address "
+                           "within 60s")
+    return proc, address
+
+
+def setup_ray_cluster(num_worker_nodes: int,
+                      num_cpus_per_node: Optional[int] = None,
+                      memory_per_node: Optional[int] = None,
+                      head_node_options: Optional[Dict[str, Any]] = None,
+                      ) -> str:
+    """Start a ray_tpu head on the Spark driver and `num_worker_nodes`
+    workers inside a background barrier-mode Spark job; returns the head
+    address (reference: cluster_init.py:setup_ray_cluster).
+    """
+    global _cluster
+    pyspark = _require_pyspark()
+    from pyspark.sql import SparkSession
+
+    if _cluster is not None:
+        raise RuntimeError("a ray-on-spark cluster is already running; "
+                           "call shutdown_ray_cluster() first")
+    spark = SparkSession.getActiveSession()
+    if spark is None:
+        raise RuntimeError("no active SparkSession")
+    if num_worker_nodes == MAX_NUM_WORKER_NODES:
+        num_worker_nodes = int(
+            spark.sparkContext.defaultParallelism
+            // max(1, _executor_conf(spark)[0]))
+    if num_worker_nodes <= 0:
+        raise ValueError("num_worker_nodes must be positive or "
+                         "MAX_NUM_WORKER_NODES")
+
+    cores, mem = _executor_conf(spark)
+    res = compute_worker_resources(num_cpus_per_node or cores,
+                                   memory_per_node or mem)
+
+    # Head on the driver (subprocess: the SparkSession owns this
+    # process's lifecycle, the head must outlive individual jobs).
+    head_proc, address = _start_head_subprocess(head_node_options)
+    job_group = f"ray-tpu-on-spark-{os.getpid()}"
+
+    def _worker_task(_it):
+        from pyspark import BarrierTaskContext
+        ctx = BarrierTaskContext.get()
+        ctx.barrier()
+        import subprocess
+        import sys
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu", "start",
+             f"--address={address}",
+             f"--num-cpus={res['num_cpus']}",
+             f"--memory={res['memory']}",
+             f"--object-store-memory={res['object_store_memory']}"])
+        proc.wait()
+        yield 0
+
+    sc = spark.sparkContext
+    rdd = sc.parallelize(range(num_worker_nodes), num_worker_nodes)
+
+    # The job group is a PER-THREAD SparkContext property (pinned-thread
+    # mode): it must be set on the thread that SUBMITS the barrier job,
+    # not the caller, or cancelJobGroup cancels nothing.
+    def _submit():
+        sc.setJobGroup(job_group, "ray_tpu worker nodes",
+                       interruptOnCancel=True)
+        rdd.barrier().mapPartitions(_worker_task).collect()
+
+    import threading
+    threading.Thread(target=_submit, daemon=True).start()
+    _cluster = _RayClusterOnSpark(address, job_group, spark, head_proc)
+    return address
+
+
+def shutdown_ray_cluster():
+    """Tear down the ray-on-spark cluster (reference:
+    cluster_init.py:shutdown_ray_cluster)."""
+    global _cluster
+    if _cluster is None:
+        raise RuntimeError("no ray-on-spark cluster is running")
+    _cluster.shutdown()
+    _cluster = None

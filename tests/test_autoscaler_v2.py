@@ -1,0 +1,259 @@
+"""Autoscaler v2: instance-manager state machine + reconciler.
+
+Reference parity: python/ray/autoscaler/v2/tests/ — transition validity,
+versioned updates, the launch -> allocate -> ray-running flow against a
+fake provider, allocation-failure retries, and idle scale-down through
+RAY_STOPPING -> TERMINATING -> TERMINATED.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import AutoscalerConfig
+from ray_tpu.autoscaler.v2 import (ALLOCATED, ALLOCATION_FAILED,
+                                   AutoscalerV2, InstanceManager,
+                                   InvalidTransitionError, QUEUED,
+                                   RAY_RUNNING, REQUESTED, Reconciler,
+                                   TERMINATED, VersionConflictError,
+                                   compute_scaling_decision)
+
+
+class FakeProvider:
+    """In-memory NodeProvider double (create/list/terminate)."""
+
+    def __init__(self, fail_launches: int = 0):
+        self._nodes = {}
+        self._n = 0
+        self.fail_launches = fail_launches
+
+    def create_node(self, node_type, node_config, count):
+        if self.fail_launches > 0:
+            self.fail_launches -= 1
+            raise RuntimeError("quota exceeded")
+        out = []
+        for _ in range(count):
+            pid = f"node-{self._n}"
+            self._n += 1
+            self._nodes[pid] = {"node_type": node_type}
+            out.append(pid)
+        return out
+
+    def terminate_node(self, pid):
+        self._nodes.pop(pid, None)
+
+    def non_terminated_nodes(self):
+        return list(self._nodes)
+
+    def node_tags(self, pid):
+        return dict(self._nodes.get(pid, {}))
+
+    def internal_ip(self, pid):
+        return "127.0.0.1"
+
+
+def _config(**over):
+    d = {"node_types": {"cpu4": {"resources": {"CPU": 4},
+                                 "max_workers": 5}}}
+    d.update(over)
+    return AutoscalerConfig.from_dict(d)
+
+
+def _gcs_state(nodes=None, demand=None):
+    return {"nodes": nodes or {}, "pending_demand": demand or [],
+            "pending_placement_groups": []}
+
+
+def test_instance_state_machine_rejects_invalid_transition():
+    im = InstanceManager()
+    inst = im.add_instance("cpu4")
+    assert inst.state == QUEUED
+    with pytest.raises(InvalidTransitionError):
+        im.update_instance(inst.instance_id, RAY_RUNNING)  # skip states
+    im.update_instance(inst.instance_id, REQUESTED)
+    with pytest.raises(InvalidTransitionError):
+        im.update_instance(inst.instance_id, QUEUED)
+
+
+def test_instance_versioned_updates_conflict():
+    im = InstanceManager()
+    inst = im.add_instance("cpu4")
+    v = inst.version
+    im.update_instance(inst.instance_id, REQUESTED, expected_version=v)
+    with pytest.raises(VersionConflictError):
+        # A second writer holding the stale version loses.
+        im.update_instance(inst.instance_id, ALLOCATED,
+                           expected_version=v)
+    im.update_instance(inst.instance_id, ALLOCATED,
+                       expected_version=v + 1)
+    assert im.get(inst.instance_id).state == ALLOCATED
+    # Full audit trail recorded.
+    assert [s for s, _ in im.get(inst.instance_id).history] == [
+        QUEUED, REQUESTED, ALLOCATED]
+
+
+def test_scheduler_pure_decision():
+    cfg = _config()
+    decision = compute_scaling_decision(
+        [{"CPU": 2}, {"CPU": 2}, {"CPU": 2}],
+        cfg.node_types, available_bins=[{"CPU": 2}], active_counts={})
+    # One demand fits the existing bin; two more pack onto ONE new cpu4.
+    assert decision == {"cpu4": 1}
+
+
+def test_scheduler_respects_max_workers():
+    cfg = _config()
+    decision = compute_scaling_decision(
+        [{"CPU": 4}] * 10, cfg.node_types, [], {"cpu4": 3})
+    assert decision == {"cpu4": 2}  # 3 active + 2 = max_workers 5
+
+
+def test_reconciler_launch_to_ray_running_flow():
+    cfg = _config()
+    provider = FakeProvider()
+    im = InstanceManager()
+    rec = Reconciler(provider, cfg.node_types)
+    inst = im.add_instance("cpu4")
+
+    # Pass 1: QUEUED -> ALLOCATED (provider called).
+    rec.reconcile(im, _gcs_state())
+    inst = im.get(inst.instance_id)
+    assert inst.state == ALLOCATED
+    assert provider.non_terminated_nodes() == list(inst.provider_ids)
+
+    # Pass 2: GCS registers the node -> RAY_RUNNING.
+    pid = inst.provider_ids[0]
+    nodes = {"aa" * 8: {"alive": True,
+                        "labels": {"ray_tpu.io/provider-id": pid},
+                        "available": {"CPU": 4}, "total": {"CPU": 4}}}
+    rec.reconcile(im, _gcs_state(nodes=nodes))
+    inst = im.get(inst.instance_id)
+    assert inst.state == RAY_RUNNING
+    assert inst.gcs_node_ids == ("aa" * 8,)
+
+
+def test_reconciler_allocation_failure_retries_bounded():
+    cfg = _config()
+    provider = FakeProvider(fail_launches=10)  # always fails
+    im = InstanceManager()
+    rec = Reconciler(provider, cfg.node_types, max_launch_retries=3)
+    inst = im.add_instance("cpu4")
+    for _ in range(6):
+        rec.reconcile(im, _gcs_state())
+    inst = im.get(inst.instance_id)
+    # 3 attempts then parked in ALLOCATION_FAILED (no infinite loop).
+    assert inst.launch_attempts == 3
+    assert inst.state == ALLOCATION_FAILED
+
+
+def test_reconciler_detects_vanished_provider_node():
+    cfg = _config()
+    provider = FakeProvider()
+    im = InstanceManager()
+    rec = Reconciler(provider, cfg.node_types)
+    inst = im.add_instance("cpu4")
+    rec.reconcile(im, _gcs_state())
+    pid = im.get(inst.instance_id).provider_ids[0]
+    provider.terminate_node(pid)  # dies out from under us
+    rec.reconcile(im, _gcs_state())
+    assert im.get(inst.instance_id).state == TERMINATED
+
+
+def test_autoscaler_v2_end_to_end_scale_up_and_down():
+    cfg = _config(idle_timeout_s=0.0)
+    provider = FakeProvider()
+    state = {"value": _gcs_state(demand=[{"CPU": 2}])}
+    drained = []
+
+    def gcs_request(method, payload):
+        if method == "get_autoscaler_state":
+            return state["value"]
+        if method == "drain_node":
+            drained.append(payload["node_id_hex"])
+            return {}
+        raise AssertionError(method)
+
+    a = AutoscalerV2(cfg, provider, gcs_request)
+    r1 = a.update()               # demand -> one instance queued+allocated
+    assert list(r1["instances"].values()) == [ALLOCATED]
+    assert len(provider.non_terminated_nodes()) == 1
+    pid = provider.non_terminated_nodes()[0]
+
+    # Node registers; demand gone; node fully idle.
+    nodes = {"bb" * 8: {"alive": True,
+                        "labels": {"ray_tpu.io/provider-id": pid},
+                        "available": {"CPU": 4}, "total": {"CPU": 4}}}
+    state["value"] = _gcs_state(nodes=nodes)
+    r2 = a.update()
+    assert list(r2["instances"].values()) == [RAY_RUNNING]
+
+    time.sleep(0.01)              # exceed idle_timeout_s=0
+    r3 = a.update()               # idle -> drained + terminated
+    assert list(r3["instances"].values()) == [TERMINATED]
+    assert drained == ["bb" * 8]
+    assert provider.non_terminated_nodes() == []
+
+
+def test_autoscaler_v2_no_double_launch_across_passes():
+    cfg = _config()
+    provider = FakeProvider()
+    state = {"value": _gcs_state(demand=[{"CPU": 2}])}
+
+    def gcs_request(method, payload):
+        assert method == "get_autoscaler_state"
+        return state["value"]
+
+    a = AutoscalerV2(cfg, provider, gcs_request)
+    a.update()
+    # Demand still pending (node not registered), but capacity is already
+    # allocated: a second pass must not launch another node.
+    a.update()
+    assert len(provider.non_terminated_nodes()) == 1
+
+
+def test_autoscaler_v2_against_real_cluster(ray_cluster):
+    """Full lifecycle against a live GCS + FakeMultiNodeProvider: an
+    infeasible task's demand drives QUEUED -> ALLOCATED -> RAY_RUNNING
+    (real raylet joins, task executes), then idleness drives
+    RAY_STOPPING -> TERMINATING -> TERMINATED."""
+    import ray_tpu
+    from ray_tpu._private import worker_api
+    from ray_tpu.autoscaler import FakeMultiNodeProvider, make_gcs_request
+
+    ray_cluster.connect()
+    provider = FakeMultiNodeProvider(
+        ray_cluster.gcs_address, ray_cluster.config,
+        ray_cluster.session_dir, loop=worker_api._state.loop)
+    config = AutoscalerConfig.from_dict(
+        {"node_types": {"cpu4": {"resources": {"CPU": 4},
+                                 "max_workers": 2}},
+         "idle_timeout_s": 1.0})
+    gcs_request = make_gcs_request(ray_cluster.gcs_address,
+                                   worker_api._state.loop)
+    v2 = AutoscalerV2(config, provider, gcs_request)
+    v2.update()        # prime: raylets queue infeasible leases
+    time.sleep(0.5)
+
+    @ray_tpu.remote(num_cpus=4)
+    def f():
+        return 42
+
+    ref = f.remote()   # head has 2 CPUs: infeasible until a node joins
+    time.sleep(1.0)
+    states = []
+    for _ in range(30):
+        states = sorted(v2.update()["instances"].values())
+        if RAY_RUNNING in states:
+            break
+        time.sleep(0.7)
+    assert RAY_RUNNING in states, states
+    assert ray_tpu.get(ref, timeout=60) == 42
+
+    r = {}
+    for _ in range(40):
+        r = v2.update()
+        if r["instances"] and all(s == TERMINATED
+                                  for s in r["instances"].values()):
+            break
+        time.sleep(0.7)
+    assert all(s == TERMINATED for s in r["instances"].values()), r

@@ -257,3 +257,51 @@ def test_chaos_worker_killer_workload_survives(ray_cluster):
     total, kill_log = run_with_chaos(workload, [killer])
     assert total == sum(range(60))
     assert kill_log, "chaos killer never fired"
+
+
+# ---------------------------------------------------------------- spark
+
+def test_spark_resource_math_pure():
+    """Executor allocation -> worker node split (reference:
+    util/spark/utils.py get_avail_mem_per_ray_worker_node)."""
+    from ray_tpu.util.spark import (compute_worker_resources,
+                                    parse_memory_string)
+
+    assert parse_memory_string("4g") == 4 * 1024 ** 3
+    assert parse_memory_string("512m") == 512 * 1024 ** 2
+    assert parse_memory_string("1024") == 1024
+    res = compute_worker_resources(8, 10 * 1024 ** 3)
+    assert res["num_cpus"] == 8
+    assert res["memory"] == 4 * 1024 ** 3
+    assert res["object_store_memory"] == 3 * 1024 ** 3
+    with pytest.raises(ValueError):
+        compute_worker_resources(0, 1)
+
+
+def test_spark_gates_on_pyspark():
+    from ray_tpu.util import spark
+
+    try:
+        import pyspark  # noqa: F401
+        pytest.skip("pyspark present in this image")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="pyspark"):
+        spark.setup_ray_cluster(2)
+
+
+def test_spark_head_subprocess_roundtrip():
+    """The driver-side head launcher must start a real head, report its
+    GCS address, and accept a worker-style connection."""
+    from ray_tpu.util.spark import _start_head_subprocess
+
+    proc, address = _start_head_subprocess()
+    try:
+        assert ":" in address
+        import ray_tpu
+        ray_tpu.init(address=address)
+        assert ray_tpu.cluster_resources() is not None
+        ray_tpu.shutdown()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
