@@ -413,10 +413,11 @@ def bench_cluster(partial: dict):
     return partial
 
 
-def _tuned_model_config() -> dict:
+def _tuned_model_config(attention: str = "flash") -> dict:
     """Pick GPTConfig perf knobs from the on-chip experiment ladder
     (scripts/chip_experiments.py -> CHIP_EXPERIMENTS_r05.json): best
-    measured remat policy and flash tile sizes. Empty dict -> defaults."""
+    measured remat policy (for the chosen attention path) and flash tile
+    sizes. Empty dict -> defaults."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "CHIP_EXPERIMENTS_r05.json")
     try:
@@ -425,9 +426,11 @@ def _tuned_model_config() -> dict:
     except (OSError, json.JSONDecodeError):
         return {}
     out: dict = {}
+    prefix = ("step_ref_remat_" if attention == "reference"
+              else "step_remat_")
     best_sps, best_policy = 0.0, None
     for policy in ("full", "dots", "none"):
-        d = exp.get(f"step_remat_{policy}") or {}
+        d = exp.get(f"{prefix}{policy}") or {}
         sps = d.get("sps")
         # Only trust full-batch measurements: a policy that only fit a
         # smaller bs isn't comparable.
@@ -501,7 +504,7 @@ def bench_model():
             except (OSError, json.JSONDecodeError):
                 pass
             attention = attention or "flash"
-        tuned = _tuned_model_config()
+        tuned = _tuned_model_config(attention)
         cfg = GPTConfig(attention=attention, **tuned)  # GPT-2 small, bf16
         if tuned:
             log(f"model bench tuned config from experiments: {tuned}")

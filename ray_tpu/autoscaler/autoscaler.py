@@ -63,6 +63,32 @@ class AutoscalerConfig:
             update_interval_s=float(d.get("update_interval_s", 5.0)))
 
 
+def node_is_idle(info: dict) -> bool:
+    """A GCS node is idle when every schedulable resource is fully
+    available (memory/object_store fluctuate with caches and are
+    excluded). POLICY shared by both autoscaler engines — change here,
+    not in a copy."""
+    if not info.get("alive"):
+        return False
+    return all(abs(info.get("available", {}).get(k, 0.0) - v) < 1e-6
+               for k, v in info.get("total", {}).items()
+               if k not in ("memory", "object_store_memory"))
+
+
+def demand_shapes(state: dict) -> List[Dict[str, float]]:
+    """Pending demand = queued lease shapes + unplaced PG bundles;
+    STRICT_SPREAD bundles are tagged __exclusive__ (one node each).
+    Shared by both engines."""
+    shapes = [dict(s) for s in state.get("pending_demand", [])]
+    for pg in state.get("pending_placement_groups", []):
+        for b in pg["bundles"]:
+            s = dict(b)
+            if pg["strategy"] == "STRICT_SPREAD":
+                s["__exclusive__"] = 1.0
+            shapes.append(s)
+    return shapes
+
+
 class StandardAutoscaler:
     """One update() = one reconcile pass. Drive it from Monitor (live) or
     directly from tests (deterministic)."""
@@ -110,19 +136,7 @@ class StandardAutoscaler:
     # ---------------- demand/supply computation ----------------
 
     def _demand_shapes(self, state: dict) -> List[Dict[str, float]]:
-        shapes = [dict(s) for s in state.get("pending_demand", [])]
-        for pg in state.get("pending_placement_groups", []):
-            if pg["strategy"] == "STRICT_SPREAD":
-                # One node per bundle: inflate each bundle to a full-node
-                # claim by tagging it; the packer places each on its own
-                # (possibly new) node.
-                for b in pg["bundles"]:
-                    s = dict(b)
-                    s["__exclusive__"] = 1.0
-                    shapes.append(s)
-            else:
-                shapes.extend(dict(b) for b in pg["bundles"])
-        return shapes
+        return demand_shapes(state)
 
     def update(self) -> dict:
         """One reconcile pass; returns {launched: {type: n}, terminated: [...]}.
@@ -229,11 +243,7 @@ class StandardAutoscaler:
 
         def node_idle(pid: str) -> bool:
             n = gcs_by_hex.get(gcs_hex_of(pid, self.provider.node_tags(pid)))
-            if n is None or not n["alive"]:
-                return False
-            return all(abs(n["available"].get(k, 0.0) - v) < 1e-6
-                       for k, v in n["total"].items()
-                       if k not in ("memory", "object_store_memory"))
+            return n is not None and node_is_idle(n)
 
         units: Dict[tuple, List[str]] = {}
         for pid in self.provider.non_terminated_nodes():

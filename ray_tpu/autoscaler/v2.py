@@ -34,7 +34,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, NodeTypeConfig
+from ray_tpu.autoscaler.autoscaler import (AutoscalerConfig,
+                                           NodeTypeConfig, demand_shapes,
+                                           node_is_idle)
 from ray_tpu.autoscaler.node_provider import NodeProvider
 
 logger = logging.getLogger(__name__)
@@ -115,6 +117,21 @@ class InstanceManager:
         if states is not None:
             out = [i for i in out if i.state in states]
         return out
+
+    def prune(self, keep_dead: int = 50) -> int:
+        """Drop all but the most recent `keep_dead` dead instances
+        (TERMINATED, and ALLOCATION_FAILED ones no longer being retried)
+        so a long-running autoscaler's table stays bounded; the audit
+        trail of recent churn is retained for debugging. Returns the
+        number removed."""
+        dead = [i for i in self._instances.values()
+                if i.state in (TERMINATED, ALLOCATION_FAILED)]
+        dead.sort(key=lambda i: i.history[-1][1])
+        removed = 0
+        for inst in dead[:max(0, len(dead) - keep_dead)]:
+            del self._instances[inst.instance_id]
+            removed += 1
+        return removed
 
     def update_instance(self, instance_id: str, new_state: str, *,
                         expected_version: Optional[int] = None,
@@ -237,10 +254,7 @@ class Reconciler:
         for nid, info in gcs_state.get("nodes", {}).items():
             hexid = nid.hex() if hasattr(nid, "hex") else str(nid)
             gcs_alive[hexid] = bool(info.get("alive"))
-            gcs_idle[hexid] = all(
-                abs(info.get("available", {}).get(k, 0.0) - v) < 1e-6
-                for k, v in info.get("total", {}).items()
-                if k not in ("memory", "object_store_memory"))
+            gcs_idle[hexid] = node_is_idle(info)
             p = (info.get("labels") or {}).get("ray_tpu.io/provider-id")
             if p:
                 gcs_by_provider[p] = hexid
@@ -339,14 +353,7 @@ class AutoscalerV2:
         self._idle_since: Dict[str, float] = {}
 
     def _demand_shapes(self, state: dict) -> List[Dict[str, float]]:
-        shapes = [dict(s) for s in state.get("pending_demand", [])]
-        for pg in state.get("pending_placement_groups", []):
-            for b in pg["bundles"]:
-                s = dict(b)
-                if pg["strategy"] == "STRICT_SPREAD":
-                    s["__exclusive__"] = 1.0
-                shapes.append(s)
-        return shapes
+        return demand_shapes(state)
 
     def update(self) -> dict:
         state = self.gcs_request("get_autoscaler_state", {})
@@ -370,6 +377,7 @@ class AutoscalerV2:
         self._scale_down_idle(state)
         result = self.reconciler.reconcile(self.im, state,
                                            self.gcs_request)
+        self.im.prune()
         result["instances"] = {
             i.instance_id: i.state for i in self.im.instances()}
         return result
@@ -385,11 +393,7 @@ class AutoscalerV2:
 
         def idle(hexid: str) -> bool:
             n = gcs_by_hex.get(hexid)
-            if n is None or not n["alive"]:
-                return False
-            return all(abs(n["available"].get(k, 0.0) - v) < 1e-6
-                       for k, v in n["total"].items()
-                       if k not in ("memory", "object_store_memory"))
+            return n is not None and node_is_idle(n)
 
         counts: Dict[str, int] = {}
         for inst in self.im.instances((RAY_RUNNING,)):

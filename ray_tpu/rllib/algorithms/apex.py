@@ -118,11 +118,15 @@ class ApexDQN(DQN):
                 metrics["loss"] = float(np.mean(losses))
             self.broadcast_weights(self.learner.get_weights())
         # 3) collect rollouts into the replay actor.
+        from ray_tpu.rllib.algorithms.dqn import nstep_transform
         add_refs = []
         steps_this_iter = 0
         for ref in rollout_refs:
             batch = ray_tpu.get(ref)
             steps_this_iter += len(batch)
+            if cfg.n_step > 1:
+                batch = nstep_transform(batch, cfg.n_step, cfg.gamma,
+                                        cfg.num_envs_per_env_runner)
             add_refs.append(self.replay_actor.add.remote(batch))
         self._steps_sampled += steps_this_iter
         replay_size = max(ray_tpu.get(add_refs)) if add_refs else 0

@@ -16,7 +16,7 @@ from typing import Any, Dict
 import numpy as np
 
 from ray_tpu.rllib import sample_batch as sb
-from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, NSTEP_GAMMAS
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.models import mlp_apply, policy_value_init
 from ray_tpu.rllib.sample_batch import SampleBatch
@@ -84,6 +84,7 @@ class C51Learner:
         import optax
 
         self._optimizer = optax.adam(lr)
+        self._gamma = gamma
         self.params = _dist_init(seed, obs_dim, num_actions, n_atoms,
                                  hidden)
         self.target_params = jax.tree_util.tree_map(lambda x: x,
@@ -113,8 +114,10 @@ class C51Learner:
             # Bellman-shift the support and project onto the fixed atoms.
             not_done = (1.0
                         - batch[sb.TERMINATEDS].astype(jnp.float32))[:, None]
-            tz = jnp.clip(batch[sb.REWARDS][:, None]
-                          + gamma * not_done * z[None, :], v_min, v_max)
+            tz = jnp.clip(
+                batch[sb.REWARDS][:, None]
+                + batch[NSTEP_GAMMAS][:, None] * not_done * z[None, :],
+                v_min, v_max)
             b = (tz - v_min) / dz                              # [B, N]
             low = jnp.floor(b).astype(jnp.int32)
             high = jnp.ceil(b).astype(jnp.int32)
@@ -144,6 +147,10 @@ class C51Learner:
         jb = {k: jnp.asarray(batch[k]) for k in
               (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.NEXT_OBS,
                sb.TERMINATEDS)}
+        jb[NSTEP_GAMMAS] = (jnp.asarray(batch[NSTEP_GAMMAS])
+                            if NSTEP_GAMMAS in batch
+                            else jnp.full(len(batch), self._gamma,
+                                          jnp.float32))
         weights = jnp.asarray(batch["weights"]) if "weights" in batch \
             else jnp.ones(len(batch), jnp.float32)
         self.params, self.opt_state, loss, ce = self._jit_update(

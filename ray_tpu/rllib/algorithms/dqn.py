@@ -18,6 +18,7 @@ import ray_tpu
 from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.models import mlp_apply, policy_value_init
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
@@ -27,6 +28,7 @@ class DQNConfig(AlgorithmConfig):
     def __init__(self, algo_class=None):
         super().__init__(algo_class or DQN)
         self.rollout_fragment_length = 32
+        self.n_step = 1
         self.replay_buffer_capacity = 50_000
         self.learning_starts = 500
         self.target_network_update_freq = 500   # in sampled env steps
@@ -34,6 +36,7 @@ class DQNConfig(AlgorithmConfig):
         self.epsilon_end = 0.05
         self.epsilon_decay_steps = 5_000
         self.double_q = True
+        self.dueling = False
         self.prioritized_replay = False
         self.train_batch_size = 64
         self.updates_per_step = 4
@@ -42,9 +45,11 @@ class DQNConfig(AlgorithmConfig):
                  target_network_update_freq=None, epsilon_start=None,
                  epsilon_end=None, epsilon_decay_steps=None, double_q=None,
                  prioritized_replay=None, updates_per_step=None,
-                 **kw) -> "DQNConfig":
+                 n_step=None, dueling=None, **kw) -> "DQNConfig":
         super().training(**kw)
-        for name, val in (("replay_buffer_capacity", replay_buffer_capacity),
+        for name, val in (("n_step", n_step),
+                          ("dueling", dueling),
+                          ("replay_buffer_capacity", replay_buffer_capacity),
                           ("learning_starts", learning_starts),
                           ("target_network_update_freq",
                            target_network_update_freq),
@@ -59,14 +64,66 @@ class DQNConfig(AlgorithmConfig):
         return self
 
 
+NSTEP_GAMMAS = "nstep_gammas"
+
+
+def nstep_transform(batch: SampleBatch, n: int, gamma: float,
+                    num_envs: int) -> SampleBatch:
+    """Collapse 1-step transitions into n-step ones (reference:
+    rllib/utils/replay_buffers/utils.py n-step logic).
+
+    sample_transitions interleaves env copies per timestep
+    ([t0e0, t0e1, t1e0, ...]); each env's stream is de-interleaved,
+    rewards are accumulated sum_{k<m} gamma^k r_{t+k} with the window
+    cut at terminations and the fragment tail, next_obs comes from the
+    window's last step, and a per-sample bootstrap discount gamma^m is
+    recorded (windows truncated by episode end or fragment end have
+    m < n, so a scalar gamma^n would be wrong).
+    """
+    if n <= 1:
+        return batch
+    size = len(batch)
+    t_steps = size // num_envs
+    out = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.NEXT_OBS,
+                           sb.TERMINATEDS, NSTEP_GAMMAS)}
+    trunc_all = batch.get(sb.TRUNCATEDS,
+                          np.zeros(size, dtype=bool))
+    for e in range(num_envs):
+        idx = np.arange(t_steps) * num_envs + e
+        rew = batch[sb.REWARDS][idx]
+        term = batch[sb.TERMINATEDS][idx]
+        trunc = trunc_all[idx]
+        for t in range(t_steps):
+            r_acc, m = 0.0, 0
+            for k in range(n):
+                if t + k >= t_steps:
+                    break
+                r_acc += (gamma ** k) * float(rew[t + k])
+                m = k + 1
+                # The env resets after term OR trunc: the window must not
+                # bridge into the next episode's stream.
+                if term[t + k] or trunc[t + k]:
+                    break
+            last = idx[t + m - 1]
+            out[sb.OBS].append(batch[sb.OBS][idx[t]])
+            out[sb.ACTIONS].append(batch[sb.ACTIONS][idx[t]])
+            out[sb.REWARDS].append(r_acc)
+            out[sb.NEXT_OBS].append(batch[sb.NEXT_OBS][last])
+            out[sb.TERMINATEDS].append(batch[sb.TERMINATEDS][last])
+            out[NSTEP_GAMMAS].append(gamma ** m)
+    return SampleBatch({k: np.asarray(v) for k, v in out.items()})
+
+
 class DQNLearner:
     def __init__(self, obs_dim: int, num_actions: int, *, hidden=(64, 64),
-                 lr=5e-4, gamma=0.99, double_q=True, seed=0):
+                 lr=5e-4, gamma=0.99, double_q=True, dueling=False,
+                 seed=0):
         import jax
         import jax.numpy as jnp
         import optax
 
         self._optimizer = optax.adam(lr)
+        self._gamma = gamma
         self.params = policy_value_init(jax.random.PRNGKey(seed), obs_dim,
                                         num_actions, hidden=tuple(hidden))
         self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
@@ -74,7 +131,15 @@ class DQNLearner:
 
         def q_values(params, obs):
             # Q head = the "pi" MLP without the small-logits scaling.
-            return mlp_apply(params["pi"], obs)
+            # Dueling (Wang et al. 2016; reference model config
+            # dueling=True): the "vf" stream is the state value and "pi"
+            # becomes the advantage stream, combined with the
+            # mean-advantage identifiability constraint.
+            adv = mlp_apply(params["pi"], obs)
+            if dueling:
+                v = mlp_apply(params["vf"], obs)
+                return v + adv - adv.mean(-1, keepdims=True)
+            return adv
 
         def loss_fn(params, target_params, batch, weights):
             q = q_values(params, batch[sb.OBS])
@@ -88,7 +153,10 @@ class DQNLearner:
             else:
                 v_next = q_next_target.max(-1)
             not_done = 1.0 - batch[sb.TERMINATEDS].astype(jnp.float32)
-            target = batch[sb.REWARDS] + gamma * not_done * v_next
+            # Per-sample bootstrap discount: gamma for 1-step, gamma^m
+            # for n-step windows (m < n at episode/fragment cuts).
+            target = (batch[sb.REWARDS]
+                      + batch[NSTEP_GAMMAS] * not_done * v_next)
             td = q_taken - jax.lax.stop_gradient(target)
             loss = (weights * td * td).mean()
             return loss, jnp.abs(td)
@@ -107,6 +175,10 @@ class DQNLearner:
         import jax.numpy as jnp
         jb = {k: jnp.asarray(batch[k]) for k in
               (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.NEXT_OBS, sb.TERMINATEDS)}
+        jb[NSTEP_GAMMAS] = (jnp.asarray(batch[NSTEP_GAMMAS])
+                            if NSTEP_GAMMAS in batch
+                            else jnp.full(len(batch), self._gamma,
+                                          jnp.float32))
         weights = jnp.asarray(batch["weights"]) if "weights" in batch \
             else jnp.ones(len(batch), jnp.float32)
         self.params, self.opt_state, loss, td = self._jit_update(
@@ -124,8 +196,32 @@ class DQNLearner:
         self.params = params
 
 
+class DuelingDQNRunner(EnvRunner):
+    """EnvRunner whose greedy scores combine the value + advantage
+    streams exactly as the dueling learner's q_values does."""
+
+    def _build_policy(self, seed, hidden, model):
+        import jax
+        e0 = self._envs[0]
+        self._params = policy_value_init(
+            jax.random.PRNGKey(seed), e0.observation_dim,
+            e0.num_actions, hidden=tuple(hidden))
+
+        def fwd(p, obs):
+            adv = mlp_apply(p["pi"], obs)
+            q = mlp_apply(p["vf"], obs) + adv \
+                - adv.mean(-1, keepdims=True)
+            return q, q.max(-1)
+
+        self._jit_forward = jax.jit(fwd)
+
+
 class DQN(Algorithm):
     config_class = DQNConfig
+
+    def _runner_class(self):
+        return (DuelingDQNRunner if self.algo_config.dueling
+                else EnvRunner)
 
     def _make_q_learner(self, probe):
         """Q-learner factory; the distributional variant (C51) overrides
@@ -134,7 +230,7 @@ class DQN(Algorithm):
         return DQNLearner(
             probe.observation_dim, probe.num_actions, hidden=cfg.hidden,
             lr=cfg.lr, gamma=cfg.gamma, double_q=cfg.double_q,
-            seed=cfg.seed)
+            dueling=cfg.dueling, seed=cfg.seed)
 
     def build_learner(self):
         cfg = self.algo_config
@@ -156,9 +252,15 @@ class DQN(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         cfg = self.algo_config
         eps = self._epsilon()
-        batch = concat_samples(ray_tpu.get(
+        batches = ray_tpu.get(
             [er.sample_transitions.remote(cfg.rollout_fragment_length, eps)
-             for er in self.env_runners]))
+             for er in self.env_runners])
+        if cfg.n_step > 1:
+            # Per-runner (each runner's batch has its own env interleave).
+            batches = [nstep_transform(b, cfg.n_step, cfg.gamma,
+                                       cfg.num_envs_per_env_runner)
+                       for b in batches]
+        batch = concat_samples(batches)
         self.replay.add(batch)
         self._steps_sampled += len(batch)
         metrics: Dict[str, Any] = {"epsilon": eps,

@@ -54,7 +54,8 @@ class PG(PPO):
             probe.observation_dim, probe.num_actions, hidden=cfg.hidden,
             lr=cfg.lr, vf_coeff=cfg.vf_loss_coeff,
             entropy_coeff=cfg.entropy_coeff, seed=cfg.seed + seed_offset,
-            obs_shape=tuple(probe.observation_shape) or None,
+            obs_shape=(tuple(getattr(probe, "observation_shape", ()))
+                       or None),
             model=None if cfg.is_multi_agent else cfg.model,
             seq_len=cfg.rollout_fragment_length)
 

@@ -50,7 +50,8 @@ class PPO(Algorithm):
             vf_coeff=getattr(cfg, "vf_loss_coeff", 0.5),
             entropy_coeff=getattr(cfg, "entropy_coeff", 0.0),
             seed=cfg.seed + seed_offset,
-            obs_shape=tuple(probe.observation_shape) or None,
+            obs_shape=(tuple(getattr(probe, "observation_shape", ()))
+                       or None),
             # MultiAgentEnvRunner builds the legacy MLP; the catalog path
             # is single-agent (matches runner-side construction).
             model=None if cfg.is_multi_agent else cfg.model,

@@ -1,0 +1,169 @@
+"""QR-DQN: quantile-regression distributional Q-learning.
+
+Reference parity: the reference exposes quantile heads through its DQN
+num_atoms/distributional config family (rllib/algorithms/dqn) — this is
+the Dabney et al. 2018 formulation: the net emits N quantile estimates
+of the return per action (no fixed support, unlike C51) and trains with
+the quantile Huber loss. The whole pairwise [B, N, N] loss is one jitted
+update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, NSTEP_GAMMAS
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.models import mlp_apply, policy_value_init
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class QRDQNConfig(DQNConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or QRDQN)
+        self.n_quantiles = 32
+        self.kappa = 1.0          # Huber threshold
+
+    def training(self, *, n_quantiles=None, kappa=None,
+                 **kw) -> "QRDQNConfig":
+        super().training(**kw)
+        if n_quantiles is not None:
+            self.n_quantiles = n_quantiles
+        if kappa is not None:
+            self.kappa = kappa
+        return self
+
+
+def _quantile_init(seed, obs_dim, num_actions, n_quantiles, hidden):
+    import jax
+    return policy_value_init(jax.random.PRNGKey(seed), obs_dim,
+                             num_actions * n_quantiles,
+                             hidden=tuple(hidden))
+
+
+class QRDQNRunner(EnvRunner):
+    """Greedy scores = mean over the quantile estimates per action."""
+
+    def __init__(self, *args, n_quantiles=32, **kw):
+        self._n_quantiles = n_quantiles
+        super().__init__(*args, **kw)
+
+    def _build_policy(self, seed, hidden, model):
+        import jax
+        e0 = self._envs[0]
+        n_act = e0.num_actions
+        n_q = self._n_quantiles
+        self._params = _quantile_init(seed, e0.observation_dim, n_act,
+                                      n_q, hidden)
+
+        def fwd(p, obs):
+            theta = mlp_apply(p["pi"], obs).reshape(
+                obs.shape[0], n_act, n_q)
+            q = theta.mean(-1)
+            return q, q.max(-1)
+
+        self._jit_forward = jax.jit(fwd)
+
+
+class QRDQNLearner:
+    def __init__(self, obs_dim: int, num_actions: int, *, hidden=(64, 64),
+                 lr=5e-4, gamma=0.99, n_quantiles=32, kappa=1.0,
+                 double_q=True, seed=0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._optimizer = optax.adam(lr)
+        self._gamma = gamma
+        self.params = _quantile_init(seed, obs_dim, num_actions,
+                                     n_quantiles, hidden)
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+        self.opt_state = self._optimizer.init(self.params)
+        # Quantile midpoints tau_hat_i = (2i+1)/(2N).
+        tau = (2 * jnp.arange(n_quantiles) + 1) / (2.0 * n_quantiles)
+
+        def thetas(params, obs):
+            return mlp_apply(params["pi"], obs).reshape(
+                obs.shape[0], num_actions, n_quantiles)
+
+        def loss_fn(params, target_params, batch, weights):
+            n = batch[sb.OBS].shape[0]
+            rows = jnp.arange(n)
+            th = thetas(params, batch[sb.OBS])[rows, batch[sb.ACTIONS]]
+            next_t = thetas(target_params, batch[sb.NEXT_OBS])
+            sel = thetas(params, batch[sb.NEXT_OBS]) if double_q \
+                else next_t
+            a_next = sel.mean(-1).argmax(-1)
+            next_q = next_t[rows, a_next]                      # [B, N]
+            not_done = (1.0 - batch[sb.TERMINATEDS].astype(
+                jnp.float32))[:, None]
+            target = jax.lax.stop_gradient(
+                batch[sb.REWARDS][:, None]
+                + batch[NSTEP_GAMMAS][:, None] * not_done * next_q)
+            # Pairwise TD errors u_ij = target_j - theta_i -> [B, N, N].
+            u = target[:, None, :] - th[:, :, None]
+            huber = jnp.where(
+                jnp.abs(u) <= kappa, 0.5 * u * u,
+                kappa * (jnp.abs(u) - 0.5 * kappa))
+            # Quantile weighting |tau_i - 1{u<0}| applied per theta row.
+            w = jnp.abs(tau[None, :, None] - (u < 0).astype(jnp.float32))
+            per_sample = (w * huber).mean(-1).sum(-1)          # [B]
+            return (weights * per_sample).mean(), per_sample
+
+        def update(params, target_params, opt_state, batch, weights):
+            (loss, per), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch,
+                                       weights)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, per
+
+        self._jit_update = jax.jit(update)
+
+    def update(self, batch: SampleBatch) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        jb = {k: jnp.asarray(batch[k]) for k in
+              (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.NEXT_OBS,
+               sb.TERMINATEDS)}
+        jb[NSTEP_GAMMAS] = (jnp.asarray(batch[NSTEP_GAMMAS])
+                            if NSTEP_GAMMAS in batch
+                            else jnp.full(len(batch), self._gamma,
+                                          jnp.float32))
+        weights = jnp.asarray(batch["weights"]) if "weights" in batch \
+            else jnp.ones(len(batch), jnp.float32)
+        self.params, self.opt_state, loss, per = self._jit_update(
+            self.params, self.target_params, self.opt_state, jb, weights)
+        return {"td_error": np.asarray(per), "loss": float(loss)}
+
+    def sync_target(self):
+        import jax
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params):
+        self.params = params
+
+
+class QRDQN(DQN):
+    config_class = QRDQNConfig
+
+    def _runner_class(self):
+        return QRDQNRunner
+
+    def _extra_runner_kwargs(self) -> Dict[str, Any]:
+        return {"n_quantiles": self.algo_config.n_quantiles}
+
+    def _make_q_learner(self, probe):
+        cfg = self.algo_config
+        return QRDQNLearner(
+            probe.observation_dim, probe.num_actions, hidden=cfg.hidden,
+            lr=cfg.lr, gamma=cfg.gamma, n_quantiles=cfg.n_quantiles,
+            kappa=cfg.kappa, double_q=cfg.double_q, seed=cfg.seed)

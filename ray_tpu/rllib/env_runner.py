@@ -216,7 +216,8 @@ class EnvRunner:
             "DQN-family transition sampling does not support use_lstm "
             "(the reference gates this behind R2D2)")
         cols = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS,
-                                sb.NEXT_OBS, sb.TERMINATEDS)}
+                                sb.NEXT_OBS, sb.TERMINATEDS,
+                                sb.TRUNCATEDS)}
         for _t in range(num_steps):
             obs_arr = self._obs_conn(np.stack(self._obs))
             scores, _ = self._jit_forward(self._params, obs_arr)
@@ -233,6 +234,7 @@ class EnvRunner:
                 cols[sb.NEXT_OBS].append(
                     self._obs_conn(obs2[None, :], update=False)[0])
                 cols[sb.TERMINATEDS].append(term)
+                cols[sb.TRUNCATEDS].append(trunc)
                 self._ep_rewards[i] += r
                 if term or trunc:
                     self._done_rewards.append(self._ep_rewards[i])

@@ -22,6 +22,8 @@ from ray_tpu.train.worker_group import WorkerGroup
 from ray_tpu.train.sklearn import SklearnTrainer
 from ray_tpu.train.gbdt import (GBDTTrainer, LightGBMTrainer,
                                 XGBoostTrainer)
+from ray_tpu.train.tensorflow import (TensorflowConfig, TensorflowTrainer,
+                                      build_tf_config)
 from ray_tpu.train.torch import (TorchConfig, TorchTrainer, prepare_model,
                                  prepare_data_loader)
 from ray_tpu.train.huggingface import TransformersTrainer, prepare_trainer
@@ -34,6 +36,7 @@ __all__ = [
     "make_eval_step", "JaxTrainer", "Result", "BackendConfig",
     "JaxBackendConfig", "BackendExecutor", "WorkerGroup",
     "TrainingFailedError", "SklearnTrainer", "TorchTrainer",
+    "TensorflowTrainer", "TensorflowConfig", "build_tf_config",
     "TorchConfig", "prepare_model", "prepare_data_loader",
     "TransformersTrainer", "prepare_trainer",
 ]

@@ -1,0 +1,101 @@
+"""TensorflowTrainer: multi-worker TF training on the worker gang.
+
+Reference parity: python/ray/train/tensorflow/tensorflow_trainer.py +
+train/tensorflow/config.py (_setup_tensorflow_environment). TensorFlow's
+MultiWorkerMirroredStrategy self-configures from the TF_CONFIG env var —
+the backend's only job is to assemble the cluster spec (every worker's
+host:port plus this worker's task index) and export it on each gang
+member before the user's train loop runs.
+
+tensorflow itself is NOT imported here: it is only needed inside the
+user's train_loop_per_worker (this image does not bundle TF; the trainer
+degrades to a clear ImportError in the loop, same as the reference on a
+TF-less cluster).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu.train.backend_executor import BackendConfig
+from ray_tpu.train.trainer import JaxTrainer
+
+
+def build_tf_config(workers: List[Tuple[str, int]], rank: int) -> str:
+    """TF_CONFIG JSON for one worker (pure; reference:
+    train/tensorflow/config.py _setup_tensorflow_environment)."""
+    if not 0 <= rank < len(workers):
+        raise ValueError(f"rank {rank} out of range for "
+                         f"{len(workers)} workers")
+    return json.dumps({
+        "cluster": {"worker": [f"{ip}:{port}" for ip, port in workers]},
+        "task": {"type": "worker", "index": rank},
+    })
+
+
+@dataclass
+class TensorflowConfig(BackendConfig):
+    """Exports TF_CONFIG across the gang so MultiWorkerMirroredStrategy
+    forms its collective ring over the workers."""
+
+    init_timeout_s: float = 60.0
+
+    def on_start(self, executor) -> None:
+        import ray_tpu
+        infos = executor.node_info_per_worker
+
+        def _free_port():
+            import socket
+            with socket.socket() as s:
+                s.bind(("", 0))
+                return s.getsockname()[1]
+
+        fn_b = cloudpickle.dumps(_free_port)
+        ports = ray_tpu.get(
+            [w.execute.remote(fn_b)
+             for w in executor.worker_group.workers], timeout=30)
+        workers = [(info["ip"], port)
+                   for info, port in zip(infos, ports)]
+
+        def _export(rank, workers):
+            import os
+            os.environ["TF_CONFIG"] = build_tf_config(workers, rank)
+            return True
+
+        fn_b = cloudpickle.dumps(_export)
+        refs = [w.execute.remote(fn_b, rank, workers)
+                for rank, w in enumerate(executor.worker_group.workers)]
+        ray_tpu.get(refs, timeout=self.init_timeout_s)
+
+    def on_shutdown(self, executor) -> None:
+        import ray_tpu
+
+        def _clear():
+            import os
+            os.environ.pop("TF_CONFIG", None)
+            return True
+
+        fn_b = cloudpickle.dumps(_clear)
+        try:
+            ray_tpu.get([w.execute.remote(fn_b)
+                         for w in executor.worker_group.workers],
+                        timeout=30)
+        except Exception:
+            pass
+
+
+class TensorflowTrainer(JaxTrainer):
+    """`JaxTrainer` gang harness + TF_CONFIG backend: the user's loop
+    builds `tf.distribute.MultiWorkerMirroredStrategy()` which reads the
+    exported cluster spec (reference: tensorflow_trainer.py)."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 tensorflow_config: Optional[TensorflowConfig] = None,
+                 **kwargs):
+        kwargs.setdefault("backend_config",
+                          tensorflow_config or TensorflowConfig())
+        super().__init__(train_loop_per_worker, **kwargs)

@@ -74,6 +74,11 @@ class _RayClusterOnSpark:
         self._job_group = job_group
         self._spark = spark
         self._head_proc = head_proc
+        # The barrier job runs in a daemon thread; its failure (or early
+        # completion = all workers exited) is recorded here so callers
+        # can diagnose a cluster that never got its workers.
+        self.worker_job_error: Optional[BaseException] = None
+        self.worker_job_done = False
 
     def shutdown(self):
         # Cancelling the barrier job group tears down every worker task;
@@ -156,8 +161,15 @@ def setup_ray_cluster(num_worker_nodes: int,
                          "MAX_NUM_WORKER_NODES")
 
     cores, mem = _executor_conf(spark)
-    res = compute_worker_resources(num_cpus_per_node or cores,
-                                   memory_per_node or mem)
+    if memory_per_node is not None:
+        # Explicit per-node memory is taken at face value (the JVM
+        # headroom fractions only apply when splitting the executor's
+        # own allocation); 30% of it backs the object store.
+        res = {"num_cpus": num_cpus_per_node or cores,
+               "memory": int(memory_per_node),
+               "object_store_memory": int(memory_per_node * 0.3)}
+    else:
+        res = compute_worker_resources(num_cpus_per_node or cores, mem)
 
     # Head on the driver (subprocess: the SparkSession owns this
     # process's lifecycle, the head must outlive individual jobs).
@@ -181,18 +193,29 @@ def setup_ray_cluster(num_worker_nodes: int,
 
     sc = spark.sparkContext
     rdd = sc.parallelize(range(num_worker_nodes), num_worker_nodes)
+    cluster = _RayClusterOnSpark(address, job_group, spark, head_proc)
 
     # The job group is a PER-THREAD SparkContext property (pinned-thread
     # mode): it must be set on the thread that SUBMITS the barrier job,
-    # not the caller, or cancelJobGroup cancels nothing.
+    # not the caller, or cancelJobGroup cancels nothing. NOTE: barrier
+    # mode needs `num_worker_nodes` simultaneous task slots; a job larger
+    # than the Spark cluster's capacity never launches — the recorded
+    # worker_job_error / worker_job_done flags are the diagnostic.
     def _submit():
-        sc.setJobGroup(job_group, "ray_tpu worker nodes",
-                       interruptOnCancel=True)
-        rdd.barrier().mapPartitions(_worker_task).collect()
+        try:
+            sc.setJobGroup(job_group, "ray_tpu worker nodes",
+                           interruptOnCancel=True)
+            rdd.barrier().mapPartitions(_worker_task).collect()
+        except BaseException as e:  # noqa: BLE001 — recorded for caller
+            cluster.worker_job_error = e
+        finally:
+            # Workers exiting immediately (e.g. bad head address) also
+            # lands here: a "done" barrier job means NO workers remain.
+            cluster.worker_job_done = True
 
     import threading
     threading.Thread(target=_submit, daemon=True).start()
-    _cluster = _RayClusterOnSpark(address, job_group, spark, head_proc)
+    _cluster = cluster
     return address
 
 

@@ -172,6 +172,76 @@ def exp_step_remat_none():
         return {"error": f"{type(e).__name__}"}
 
 
+def exp_step_ref_remat_full():
+    """Reference (XLA fused) attention beat flash 16.6% vs 11.7% MFU in
+    the 12:00Z window — measure its remat ladder too."""
+    return _bench_step("full", attention="reference")
+
+
+def exp_step_ref_remat_dots():
+    return _bench_step("dots", attention="reference")
+
+
+def exp_grad_only():
+    """Forward+backward WITHOUT the optimizer update/state: isolates how
+    much of the step the adamw apply + non-donated buffer copies cost
+    (step_ms - grad_ms - fwd-only overheads = optimizer tax)."""
+    import jax, jax.numpy as jnp, numpy as np
+    from ray_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss
+
+    cfg = GPTConfig(attention="reference")
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    grad_fn = jax.jit(jax.grad(lambda p, b: gpt_loss(p, b, cfg)))
+    tokens = jnp.array(np.random.randint(0, cfg.vocab_size, (64, 1025)),
+                       jnp.int32)
+    batch = {"tokens": tokens}
+    t0 = time.perf_counter()
+    g = grad_fn(params, batch)
+    jax.block_until_ready(g)
+    np.asarray(jax.tree_util.tree_leaves(g)[0])
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(6):
+        g = grad_fn(params, batch)
+    np.asarray(jax.tree_util.tree_leaves(g)[0])
+    dt = (time.perf_counter() - t0) / 6
+    return {"compile_s": round(compile_s, 1),
+            "grad_ms": round(dt * 1e3, 1)}
+
+
+def exp_xent_iso():
+    """Chunked LM-head cross-entropy alone (the [B*S, d] x [d, vocab]
+    matmul pair): if this dominates, the chunk size / layout is the
+    lever, not attention."""
+    import jax, jax.numpy as jnp, numpy as np
+    from ray_tpu.models.gpt import GPTConfig, chunked_xent
+
+    cfg = GPTConfig()
+    d, v = cfg.d_model, cfg.vocab_size
+    n = 64 * 1024
+    h = jax.random.normal(jax.random.PRNGKey(0), (n, d), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (d, v),
+                          jnp.bfloat16) * 0.02
+    tgt = jnp.array(np.random.randint(0, v, (n,)), jnp.int32)
+    mask = jnp.ones((n,), jnp.float32)
+
+    def loss(h, w):
+        s, m = chunked_xent(h, w, tgt, mask)
+        return (s / m).astype(jnp.float32)
+
+    f = jax.jit(jax.grad(loss, argnums=(0, 1)))
+    t0 = time.perf_counter()
+    np.asarray(f(h, w)[0][0, :1])
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(6):
+        r = f(h, w)
+    np.asarray(r[0][0, :1])
+    dt = (time.perf_counter() - t0) / 6
+    return {"compile_s": round(compile_s, 1),
+            "xent_fwdbwd_ms": round(dt * 1e3, 1)}
+
+
 def exp_flash_iso():
     """Standalone attention fwd+bwd at the bench shape, sweeping flash
     block sizes against the XLA reference."""
@@ -220,9 +290,16 @@ def exp_step_accum4():
 
 
 EXPERIMENTS = [
+    # Highest-value first: windows are short. The 12:00Z findings:
+    # reference attention 16.6% MFU > flash 11.7%; fwd=368 ms vs
+    # step=2520 ms — the next three probes locate the missing ~1 s.
+    ("grad_only", exp_grad_only),
+    ("xent_iso", exp_xent_iso),
+    ("step_ref_remat_dots", exp_step_ref_remat_dots),
+    ("step_ref_remat_full", exp_step_ref_remat_full),
+    ("fwd_only", exp_fwd_only),
     ("matmul", exp_matmul),
     ("dispatch", exp_dispatch),
-    ("fwd_only", exp_fwd_only),
     ("step_remat_dots", exp_step_remat_dots),
     ("flash_iso", exp_flash_iso),
     ("step_remat_full", exp_step_remat_full),

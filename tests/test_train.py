@@ -322,3 +322,45 @@ def test_transformers_trainer_tiny_bert(ray_start, tmp_path):
     assert result.error is None, result.error
     assert result.metrics_dataframe, "no metrics reported"
     assert any("loss" in row for row in result.metrics_dataframe)
+
+
+def test_build_tf_config_pure():
+    """TF_CONFIG cluster-spec assembly (reference:
+    train/tensorflow/config.py _setup_tensorflow_environment)."""
+    import json
+
+    from ray_tpu.train import build_tf_config
+
+    cfg = json.loads(build_tf_config([("10.0.0.1", 1111),
+                                      ("10.0.0.2", 2222)], rank=1))
+    assert cfg["cluster"]["worker"] == ["10.0.0.1:1111", "10.0.0.2:2222"]
+    assert cfg["task"] == {"type": "worker", "index": 1}
+    with pytest.raises(ValueError):
+        build_tf_config([("a", 1)], rank=3)
+
+
+def test_tensorflow_backend_exports_tf_config(ray_start):
+    """The TF backend must export a coherent TF_CONFIG on every gang
+    member (tensorflow itself is not needed: MultiWorkerMirroredStrategy
+    reads this env in the user loop)."""
+    import json
+
+    from ray_tpu.train import (ScalingConfig, TensorflowTrainer,
+                               get_context, report)
+
+    def train_fn():
+        import os
+        cfg = json.loads(os.environ["TF_CONFIG"])
+        # Coherence asserted in-loop: failures propagate through fit().
+        assert cfg["task"]["type"] == "worker"
+        assert cfg["task"]["index"] == get_context().get_world_rank()
+        assert len(set(cfg["cluster"]["worker"])) == 2
+        report({"workers": cfg["cluster"]["worker"]})
+
+    trainer = TensorflowTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}))
+    result = trainer.fit()
+    assert result.error is None
+    assert len(result.metrics["workers"]) == 2
