@@ -31,6 +31,7 @@ from ray_tpu.rllib.algorithms.pg import PG, PGConfig
 from ray_tpu.rllib.algorithms.c51 import C51, C51Config
 from ray_tpu.rllib.algorithms.apex import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.algorithms.qrdqn import QRDQN, QRDQNConfig
+from ray_tpu.rllib.algorithms.noisy import NoisyDQN, NoisyDQNConfig
 from ray_tpu.rllib.offline import JsonReader, JsonWriter
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rllib import connectors
@@ -42,7 +43,7 @@ __all__ = [
     "DDPGConfig", "CQL", "CQLConfig", "MARWIL", "MARWILConfig",
     "A2C", "A2CConfig", "ES", "ESConfig", "ARS", "ARSConfig",
     "PG", "PGConfig", "C51", "C51Config", "ApexDQN", "ApexDQNConfig",
-    "QRDQN", "QRDQNConfig",
+    "QRDQN", "QRDQNConfig", "NoisyDQN", "NoisyDQNConfig",
     "connectors", "EnvSpec", "CartPoleEnv",
     "PendulumEnv", "MultiAgentEnv", "MultiCartPole", "make_env",
     "register_env", "SampleBatch", "MultiAgentBatch", "concat_samples",

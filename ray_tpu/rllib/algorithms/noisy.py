@@ -1,0 +1,206 @@
+"""NoisyNet-DQN: learned parametric exploration.
+
+Reference parity: the reference's DQN exposes `noisy: True` in its model
+config (rllib/algorithms/dqn, NoisyLayer in rllib/models) — Fortunato et
+al. 2018 factorized Gaussian noisy linear layers replace epsilon-greedy:
+every weight is mu + sigma * (f(eps_in) f(eps_out)^T) with f(x) =
+sign(x)sqrt(|x|); exploration pressure comes from the learned sigmas and
+decays only where the data says it should. Epsilon is forced to zero.
+
+The noise is resampled OUTSIDE jit (a PRNG key per forward) so one
+compiled program serves every step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.dqn import (DQN, DQNConfig, NSTEP_GAMMAS)
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class NoisyDQNConfig(DQNConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or NoisyDQN)
+        self.sigma0 = 0.5          # initial sigma scale (paper default)
+        # Exploration is the noise itself.
+        self.epsilon_start = 0.0
+        self.epsilon_end = 0.0
+
+    def training(self, *, sigma0=None, **kw) -> "NoisyDQNConfig":
+        super().training(**kw)
+        if sigma0 is not None:
+            self.sigma0 = sigma0
+        return self
+
+
+def noisy_net_init(seed: int, sizes, sigma0: float = 0.5):
+    """Stack of factorized-noise linear layers: each layer holds
+    (mu_w, mu_b, sig_w, sig_b); sigma init = sigma0/sqrt(fan_in)."""
+    import jax
+    import jax.numpy as jnp
+    rng = jax.random.PRNGKey(seed)
+    layers = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for k, (fi, fo) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        bound = 1.0 / np.sqrt(fi)
+        k1, k2 = jax.random.split(k)
+        layers.append({
+            "mu_w": jax.random.uniform(k1, (fi, fo), jnp.float32,
+                                       -bound, bound),
+            "mu_b": jax.random.uniform(k2, (fo,), jnp.float32,
+                                       -bound, bound),
+            "sig_w": jnp.full((fi, fo), sigma0 / np.sqrt(fi), jnp.float32),
+            "sig_b": jnp.full((fo,), sigma0 / np.sqrt(fi), jnp.float32),
+        })
+    return layers
+
+
+def noisy_net_apply(layers, x, key):
+    """Forward with factorized noise drawn from `key`; key=None gives the
+    deterministic mu-only net (evaluation mode)."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(e):
+        return jnp.sign(e) * jnp.sqrt(jnp.abs(e))
+
+    for i, layer in enumerate(layers):
+        if key is None:
+            w, b = layer["mu_w"], layer["mu_b"]
+        else:
+            key, k1, k2 = jax.random.split(key, 3)
+            e_in = f(jax.random.normal(k1, (layer["mu_w"].shape[0],)))
+            e_out = f(jax.random.normal(k2, (layer["mu_w"].shape[1],)))
+            w = layer["mu_w"] + layer["sig_w"] * jnp.outer(e_in, e_out)
+            b = layer["mu_b"] + layer["sig_b"] * e_out
+        x = x @ w + b
+        if i < len(layers) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class NoisyDQNRunner(EnvRunner):
+    """Greedy over the noisy Q values — a fresh noise draw per forward is
+    the exploration policy (no epsilon)."""
+
+    def __init__(self, *args, sigma0=0.5, **kw):
+        self._sigma0 = sigma0
+        super().__init__(*args, **kw)
+
+    def _build_policy(self, seed, hidden, model):
+        import jax
+        e0 = self._envs[0]
+        self._params = {"q": noisy_net_init(
+            seed, [e0.observation_dim, *hidden, e0.num_actions],
+            self._sigma0)}
+        self._noise_key = jax.random.PRNGKey(seed + 77)
+        jit_q = jax.jit(lambda p, o, k: noisy_net_apply(p["q"], o, k))
+
+        def forward(p, obs):
+            self._noise_key, sub = jax.random.split(self._noise_key)
+            q = jit_q(p, obs, sub)
+            return q, q.max(-1)
+
+        # Plain callable: sample_transitions only calls it.
+        self._jit_forward = forward
+
+
+class NoisyDQNLearner:
+    def __init__(self, obs_dim: int, num_actions: int, *, hidden=(64, 64),
+                 lr=5e-4, gamma=0.99, double_q=True, sigma0=0.5, seed=0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._optimizer = optax.adam(lr)
+        self._gamma = gamma
+        self.params = {"q": noisy_net_init(
+            seed, [obs_dim, *hidden, num_actions], sigma0)}
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+        self.opt_state = self._optimizer.init(self.params)
+        self._key = jax.random.PRNGKey(seed + 13)
+
+        def loss_fn(params, target_params, batch, weights, keys):
+            # Independent noise draws for online, selection, and target
+            # nets (the paper's independent-noise TD estimate).
+            q = noisy_net_apply(params["q"], batch[sb.OBS], keys[0])
+            n = q.shape[0]
+            q_taken = q[jnp.arange(n), batch[sb.ACTIONS]]
+            q_next_t = noisy_net_apply(target_params["q"],
+                                       batch[sb.NEXT_OBS], keys[1])
+            if double_q:
+                q_next_sel = noisy_net_apply(params["q"],
+                                             batch[sb.NEXT_OBS], keys[2])
+                a_next = jnp.argmax(q_next_sel, -1)
+                v_next = q_next_t[jnp.arange(n), a_next]
+            else:
+                v_next = q_next_t.max(-1)
+            not_done = 1.0 - batch[sb.TERMINATEDS].astype(jnp.float32)
+            target = (batch[sb.REWARDS]
+                      + batch[NSTEP_GAMMAS] * not_done * v_next)
+            td = q_taken - jax.lax.stop_gradient(target)
+            return (weights * td * td).mean(), jnp.abs(td)
+
+        def update(params, target_params, opt_state, batch, weights,
+                   keys):
+            (loss, td), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch,
+                                       weights, keys)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        self._jit_update = jax.jit(update)
+
+    def update(self, batch: SampleBatch) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+        jb = {k: jnp.asarray(batch[k]) for k in
+              (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.NEXT_OBS,
+               sb.TERMINATEDS)}
+        jb[NSTEP_GAMMAS] = (jnp.asarray(batch[NSTEP_GAMMAS])
+                            if NSTEP_GAMMAS in batch
+                            else jnp.full(len(batch), self._gamma,
+                                          jnp.float32))
+        weights = jnp.asarray(batch["weights"]) if "weights" in batch \
+            else jnp.ones(len(batch), jnp.float32)
+        self._key, *keys = jax.random.split(self._key, 4)
+        self.params, self.opt_state, loss, td = self._jit_update(
+            self.params, self.target_params, self.opt_state, jb, weights,
+            tuple(keys))
+        return {"td_error": np.asarray(td), "loss": float(loss)}
+
+    def sync_target(self):
+        import jax
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params):
+        self.params = params
+
+
+class NoisyDQN(DQN):
+    config_class = NoisyDQNConfig
+
+    def _runner_class(self):
+        return NoisyDQNRunner
+
+    def _extra_runner_kwargs(self) -> Dict[str, Any]:
+        return {"sigma0": self.algo_config.sigma0}
+
+    def _make_q_learner(self, probe):
+        cfg = self.algo_config
+        return NoisyDQNLearner(
+            probe.observation_dim, probe.num_actions, hidden=cfg.hidden,
+            lr=cfg.lr, gamma=cfg.gamma, double_q=cfg.double_q,
+            sigma0=cfg.sigma0, seed=cfg.seed)

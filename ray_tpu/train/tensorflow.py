@@ -54,10 +54,7 @@ class TensorflowConfig(BackendConfig):
                 s.bind(("", 0))
                 return s.getsockname()[1]
 
-        fn_b = cloudpickle.dumps(_free_port)
-        ports = ray_tpu.get(
-            [w.execute.remote(fn_b)
-             for w in executor.worker_group.workers], timeout=30)
+        ports = executor.worker_group.execute(_free_port, timeout=30)
         workers = [(info["ip"], port)
                    for info, port in zip(infos, ports)]
 
@@ -72,18 +69,13 @@ class TensorflowConfig(BackendConfig):
         ray_tpu.get(refs, timeout=self.init_timeout_s)
 
     def on_shutdown(self, executor) -> None:
-        import ray_tpu
-
         def _clear():
             import os
             os.environ.pop("TF_CONFIG", None)
             return True
 
-        fn_b = cloudpickle.dumps(_clear)
         try:
-            ray_tpu.get([w.execute.remote(fn_b)
-                         for w in executor.worker_group.workers],
-                        timeout=30)
+            executor.worker_group.execute(_clear, timeout=30)
         except Exception:
             pass
 

@@ -162,12 +162,13 @@ def setup_ray_cluster(num_worker_nodes: int,
 
     cores, mem = _executor_conf(spark)
     if memory_per_node is not None:
-        # Explicit per-node memory is taken at face value (the JVM
-        # headroom fractions only apply when splitting the executor's
-        # own allocation); 30% of it backs the object store.
+        # Explicit per-node memory is the worker's TOTAL budget (no JVM
+        # headroom fractions): 30% of it backs the object store, the
+        # rest is heap — never more than the stated budget combined.
+        store = int(memory_per_node * 0.3)
         res = {"num_cpus": num_cpus_per_node or cores,
-               "memory": int(memory_per_node),
-               "object_store_memory": int(memory_per_node * 0.3)}
+               "memory": int(memory_per_node) - store,
+               "object_store_memory": store}
     else:
         res = compute_worker_resources(num_cpus_per_node or cores, mem)
 
