@@ -152,11 +152,17 @@ class Algorithm(Trainable):
                          if isinstance(config, AlgorithmConfig)
                          else (config or {}))
 
+    def _validate_config(self):
+        """Driver-side config rejection BEFORE any actor spawns (a bad
+        combo must fail with a clear error, not a traceback from inside
+        a remote runner's jit trace)."""
+
     # -- Trainable API ------------------------------------------------------
     def setup(self, config: Dict[str, Any]):
         from ray_tpu.rllib.env import get_env_creator
         from ray_tpu.rllib.env_runner import EnvRunner, MultiAgentEnvRunner
         cfg = self.algo_config
+        self._validate_config()
         # Resolve the env creator here (driver-side registry) so custom
         # registered envs work inside worker processes.
         creator = get_env_creator(cfg.env)

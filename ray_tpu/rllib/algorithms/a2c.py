@@ -9,6 +9,7 @@ checkpointing); only the policy-gradient term differs.
 
 from __future__ import annotations
 
+from ray_tpu.rllib.catalog import obs_shape_of
 from ray_tpu.rllib.algorithm import AlgorithmConfig
 from ray_tpu.rllib.algorithms.ppo import PPO
 from ray_tpu.rllib.learner import PPOLearner
@@ -53,7 +54,6 @@ class A2C(PPO):
             probe.observation_dim, probe.num_actions, hidden=cfg.hidden,
             lr=cfg.lr, vf_coeff=cfg.vf_loss_coeff,
             entropy_coeff=cfg.entropy_coeff, seed=cfg.seed + seed_offset,
-            obs_shape=(tuple(getattr(probe, "observation_shape", ()))
-                       or None),
+            obs_shape=obs_shape_of(probe),
             model=None if cfg.is_multi_agent else cfg.model,
             seq_len=cfg.rollout_fragment_length)

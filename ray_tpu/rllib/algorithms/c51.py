@@ -173,6 +173,7 @@ class C51Learner:
 
 class C51(DQN):
     config_class = C51Config
+    supports_model_config = False  # custom head, not catalog-built
 
     def _runner_class(self):
         return C51Runner

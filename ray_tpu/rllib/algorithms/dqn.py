@@ -18,6 +18,7 @@ import ray_tpu
 from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.catalog import obs_shape_of
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.models import mlp_apply, policy_value_init
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
@@ -117,29 +118,43 @@ def nstep_transform(batch: SampleBatch, n: int, gamma: float,
 class DQNLearner:
     def __init__(self, obs_dim: int, num_actions: int, *, hidden=(64, 64),
                  lr=5e-4, gamma=0.99, double_q=True, dueling=False,
-                 seed=0):
+                 obs_shape=None, model=None, seed=0):
         import jax
         import jax.numpy as jnp
         import optax
 
         self._optimizer = optax.adam(lr)
         self._gamma = gamma
-        self.params = policy_value_init(jax.random.PRNGKey(seed), obs_dim,
-                                        num_actions, hidden=tuple(hidden))
+        if model is not None:
+            # Catalog Q-net (CNN torso for image observations).
+            from ray_tpu.rllib.catalog import (ModelConfig,
+                                               catalog_q_apply,
+                                               catalog_q_init)
+            mcfg = ModelConfig.from_dict(model)
+            shape = tuple(obs_shape) if obs_shape else (obs_dim,)
+            self.params = catalog_q_init(jax.random.PRNGKey(seed), shape,
+                                         num_actions, mcfg)
+
+            def q_values(params, obs):
+                return catalog_q_apply(params, obs, mcfg)
+        else:
+            self.params = policy_value_init(
+                jax.random.PRNGKey(seed), obs_dim, num_actions,
+                hidden=tuple(hidden))
+
+            def q_values(params, obs):
+                # Q head = the "pi" MLP without the small-logits scaling.
+                # Dueling (Wang et al. 2016; reference model config
+                # dueling=True): the "vf" stream is the state value and
+                # "pi" becomes the advantage stream, combined with the
+                # mean-advantage identifiability constraint.
+                adv = mlp_apply(params["pi"], obs)
+                if dueling:
+                    v = mlp_apply(params["vf"], obs)
+                    return v + adv - adv.mean(-1, keepdims=True)
+                return adv
         self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
         self.opt_state = self._optimizer.init(self.params)
-
-        def q_values(params, obs):
-            # Q head = the "pi" MLP without the small-logits scaling.
-            # Dueling (Wang et al. 2016; reference model config
-            # dueling=True): the "vf" stream is the state value and "pi"
-            # becomes the advantage stream, combined with the
-            # mean-advantage identifiability constraint.
-            adv = mlp_apply(params["pi"], obs)
-            if dueling:
-                v = mlp_apply(params["vf"], obs)
-                return v + adv - adv.mean(-1, keepdims=True)
-            return adv
 
         def loss_fn(params, target_params, batch, weights):
             q = q_values(params, batch[sb.OBS])
@@ -196,6 +211,27 @@ class DQNLearner:
         self.params = params
 
 
+class CatalogQRunner(EnvRunner):
+    """EnvRunner whose greedy scores come from the catalog Q-net (CNN
+    torso for image observations) — matches DQNLearner's model path."""
+
+    def _build_policy(self, seed, hidden, model):
+        import jax
+        from ray_tpu.rllib.catalog import (ModelConfig, catalog_q_apply,
+                                           catalog_q_init, obs_shape_of)
+        e0 = self._envs[0]
+        mcfg = ModelConfig.from_dict(model)
+        self._params = catalog_q_init(jax.random.PRNGKey(seed),
+                                      obs_shape_of(e0), e0.num_actions,
+                                      mcfg)
+
+        def fwd(p, obs):
+            q = catalog_q_apply(p, obs, mcfg)
+            return q, q.max(-1)
+
+        self._jit_forward = jax.jit(fwd)
+
+
 class DuelingDQNRunner(EnvRunner):
     """EnvRunner whose greedy scores combine the value + advantage
     streams exactly as the dueling learner's q_values does."""
@@ -218,8 +254,25 @@ class DuelingDQNRunner(EnvRunner):
 
 class DQN(Algorithm):
     config_class = DQNConfig
+    # Catalog model configs (CNN Q-nets) supported by DQN/APEX; the
+    # distributional/noisy variants build their own heads and opt out.
+    supports_model_config = True
+
+    def _validate_config(self):
+        cfg = self.algo_config
+        if cfg.model is not None:
+            if cfg.dueling:
+                raise ValueError("dueling=True cannot combine with a "
+                                 "catalog model config")
+            from ray_tpu.rllib.catalog import ModelConfig
+            if ModelConfig.from_dict(cfg.model).use_lstm:
+                raise ValueError("use_lstm is not supported for "
+                                 "value-based Q networks (R2D2 "
+                                 "territory)")
 
     def _runner_class(self):
+        if self.algo_config.model is not None:
+            return CatalogQRunner
         return (DuelingDQNRunner if self.algo_config.dueling
                 else EnvRunner)
 
@@ -230,7 +283,8 @@ class DQN(Algorithm):
         return DQNLearner(
             probe.observation_dim, probe.num_actions, hidden=cfg.hidden,
             lr=cfg.lr, gamma=cfg.gamma, double_q=cfg.double_q,
-            dueling=cfg.dueling, seed=cfg.seed)
+            dueling=cfg.dueling, seed=cfg.seed,
+            obs_shape=obs_shape_of(probe), model=cfg.model)
 
     def build_learner(self):
         cfg = self.algo_config

@@ -191,6 +191,7 @@ class NoisyDQNLearner:
 
 class NoisyDQN(DQN):
     config_class = NoisyDQNConfig
+    supports_model_config = False  # custom head, not catalog-built
 
     def _runner_class(self):
         return NoisyDQNRunner

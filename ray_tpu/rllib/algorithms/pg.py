@@ -15,6 +15,7 @@ from typing import Any, Dict
 
 import ray_tpu
 from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.catalog import obs_shape_of
 from ray_tpu.rllib.algorithm import AlgorithmConfig
 from ray_tpu.rllib.algorithms.a2c import A2CLearner
 from ray_tpu.rllib.algorithms.ppo import PPO
@@ -54,8 +55,7 @@ class PG(PPO):
             probe.observation_dim, probe.num_actions, hidden=cfg.hidden,
             lr=cfg.lr, vf_coeff=cfg.vf_loss_coeff,
             entropy_coeff=cfg.entropy_coeff, seed=cfg.seed + seed_offset,
-            obs_shape=(tuple(getattr(probe, "observation_shape", ()))
-                       or None),
+            obs_shape=obs_shape_of(probe),
             model=None if cfg.is_multi_agent else cfg.model,
             seq_len=cfg.rollout_fragment_length)
 

@@ -9,6 +9,7 @@ from __future__ import annotations
 from typing import Any, Dict
 
 import ray_tpu
+from ray_tpu.rllib.catalog import obs_shape_of
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.env import make_env
 from ray_tpu.rllib.learner import PPOLearner
@@ -50,8 +51,7 @@ class PPO(Algorithm):
             vf_coeff=getattr(cfg, "vf_loss_coeff", 0.5),
             entropy_coeff=getattr(cfg, "entropy_coeff", 0.0),
             seed=cfg.seed + seed_offset,
-            obs_shape=(tuple(getattr(probe, "observation_shape", ()))
-                       or None),
+            obs_shape=obs_shape_of(probe),
             # MultiAgentEnvRunner builds the legacy MLP; the catalog path
             # is single-agent (matches runner-side construction).
             model=None if cfg.is_multi_agent else cfg.model,

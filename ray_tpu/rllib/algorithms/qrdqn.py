@@ -154,6 +154,7 @@ class QRDQNLearner:
 
 class QRDQN(DQN):
     config_class = QRDQNConfig
+    supports_model_config = False  # custom head, not catalog-built
 
     def _runner_class(self):
         return QRDQNRunner

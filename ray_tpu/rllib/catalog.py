@@ -150,6 +150,29 @@ def _lstm_init(rng, in_dim: int, cell: int):
     }
 
 
+def obs_shape_of(env) -> Tuple[int, ...]:
+    """Canonical observation shape for catalog construction: the env's
+    declared observation_shape, falling back to (observation_dim,).
+    The ONE place this fallback lives — runners and learners must agree
+    or they build different networks."""
+    shape = tuple(getattr(env, "observation_shape", ()) or ())
+    return shape or (int(env.observation_dim),)
+
+
+def catalog_q_init(rng, obs_shape, num_actions: int, cfg: ModelConfig):
+    """Q-network params for the value-based family: torso + Q head only
+    (no value torso/head — catalog_q_apply never reads them, and dead
+    params would still ride every target copy, adam state, and weight
+    broadcast)."""
+    import jax
+    if cfg.use_lstm:
+        raise ValueError("use_lstm is not supported for value-based "
+                         "Q networks (R2D2 territory)")
+    k_torso, k_pi = jax.random.split(rng)
+    torso, feat = _torso_init(k_torso, obs_shape, cfg)
+    return {"torso": torso, "pi": _mlp_init(k_pi, [feat, num_actions])}
+
+
 def catalog_init(rng, obs_shape, num_outputs: int, cfg: ModelConfig):
     """Build the policy/value params pytree for an observation space.
 
@@ -248,6 +271,15 @@ def catalog_apply(params, obs, cfg: ModelConfig):
     else:
         vfeat = feat
     return pi, _vf_head(params, vfeat)
+
+
+def catalog_q_apply(params, obs, cfg: ModelConfig):
+    """Q-network forward for the value-based family: the pi head WITHOUT
+    the 0.01 near-uniform-policy scale (Q targets grow to episode-return
+    magnitude; the policy-gradient init trick would just shrink the last
+    layer's effective learning rate). -> Q [B, A]."""
+    feat = _torso_apply(params["torso"], obs, cfg)
+    return _mlp_apply(params["pi"], feat, final_act=False)
 
 
 def catalog_apply_step(params, obs, state, cfg: ModelConfig):

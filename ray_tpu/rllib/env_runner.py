@@ -55,11 +55,12 @@ class EnvRunner:
             # sampling (state rows reset on episode end).
             from ray_tpu.rllib.catalog import (ModelConfig, catalog_apply,
                                                catalog_apply_step,
-                                               catalog_init, initial_state)
+                                               catalog_init, initial_state,
+                                               obs_shape_of)
             self._mcfg = ModelConfig.from_dict(model)
-            obs_shape = tuple(e0.observation_shape) or (obs_dim,)
-            self._params = catalog_init(jax.random.PRNGKey(seed), obs_shape,
-                                        n_act, self._mcfg)
+            self._params = catalog_init(jax.random.PRNGKey(seed),
+                                        obs_shape_of(e0), n_act,
+                                        self._mcfg)
             self._recurrent = self._mcfg.use_lstm
             if self._recurrent:
                 h, c = initial_state(len(self._envs), self._mcfg)
