@@ -155,7 +155,18 @@ class Algorithm(Trainable):
     def _validate_config(self):
         """Driver-side config rejection BEFORE any actor spawns (a bad
         combo must fail with a clear error, not a traceback from inside
-        a remote runner's jit trace)."""
+        a remote runner's jit trace). Subclasses extend via super()."""
+        cfg = self.algo_config
+        if cfg.model is not None and not self.supports_model_config:
+            # fcnet_hiddens alone still maps onto the legacy MLP (the
+            # base training() mirrors it into cfg.hidden); anything else
+            # would be silently dropped — reject instead.
+            dropped = set(cfg.model) - {"fcnet_hiddens"}
+            if dropped:
+                raise ValueError(
+                    f"{type(self).__name__} does not support model "
+                    f"config keys {sorted(dropped)} (only fcnet_hiddens "
+                    f"maps onto its legacy network)")
 
     # -- Trainable API ------------------------------------------------------
     def setup(self, config: Dict[str, Any]):

@@ -259,6 +259,7 @@ class DQN(Algorithm):
     supports_model_config = True
 
     def _validate_config(self):
+        super()._validate_config()
         cfg = self.algo_config
         if cfg.model is not None:
             if cfg.dueling:

@@ -231,6 +231,66 @@ def cmd_serve(args):
     ray_tpu.shutdown()
 
 
+# ---------------------------------------------------------------- rllib
+
+def cmd_rllib(args):
+    """RLlib CLI (reference: rllib/train.py `rllib train` +
+    rllib/evaluate.py `rllib evaluate`): run an algorithm on an env from
+    the command line; evaluate a saved checkpoint greedily."""
+    import cloudpickle
+
+    import ray_tpu
+    from ray_tpu import rllib as rl
+    config_cls = getattr(rl, f"{args.algo}Config", None)
+    if config_cls is None:
+        sys.exit(f"error: unknown algorithm {args.algo!r}; see "
+                 f"ray_tpu.rllib.__all__ for available *Config classes")
+    cfg = config_cls().environment(args.env)
+    if args.config:
+        cfg.training(**json.loads(args.config))
+    if args.rllib_cmd == "evaluate":
+        # Usage errors before paying for init + actor spawns.
+        if not args.checkpoint_path:
+            sys.exit("error: evaluate needs --checkpoint-path")
+        if cfg.is_multi_agent:
+            sys.exit("error: evaluate supports single-policy "
+                     "checkpoints only")
+    ray_tpu.init(num_cpus=args.num_cpus, num_tpus=0)
+    try:
+        algo = cfg.build()
+        if args.rllib_cmd == "train":
+            best = float("-inf")
+            for i in range(args.stop_iters):
+                r = algo.step()
+                rew = r.get("episode_reward_mean", float("nan"))
+                if rew == rew:
+                    best = max(best, rew)
+                print(f"iter {i + 1}: reward_mean="
+                      f"{rew if rew == rew else 'n/a'} "
+                      f"episodes={r.get('episodes_total', 0)}", flush=True)
+                if args.stop_reward is not None and rew == rew \
+                        and rew >= args.stop_reward:
+                    print(f"stop-reward {args.stop_reward} reached")
+                    break
+            if best > float("-inf"):
+                print(f"best reward_mean: {best:.2f}")
+            if args.checkpoint_path:
+                with open(args.checkpoint_path, "wb") as f:
+                    cloudpickle.dump(algo.save_checkpoint(), f)
+                print(f"checkpoint written to {args.checkpoint_path}")
+        else:  # evaluate
+            with open(args.checkpoint_path, "rb") as f:
+                algo.load_checkpoint(cloudpickle.load(f))
+            weights = algo.learner.get_weights()
+            ret = ray_tpu.get(
+                algo.env_runners[0].evaluate_return.remote(
+                    weights, episodes=args.episodes), timeout=600)
+            print(f"mean_return={ret:.2f} over {args.episodes} episodes")
+        algo.cleanup()
+    finally:
+        ray_tpu.shutdown()
+
+
 # ---------------------------------------------------------------- jobs
 
 def cmd_job(args):
@@ -335,6 +395,28 @@ def build_parser() -> argparse.ArgumentParser:
     st = ssub.add_parser("status")
     st.add_argument("--address", default=None)
     st.set_defaults(fn=cmd_serve)
+
+    s = sub.add_parser("rllib", help="rllib train/evaluate")
+    rsub = s.add_subparsers(dest="rllib_cmd", required=True)
+    for name in ("train", "evaluate"):
+        r = rsub.add_parser(name)
+        r.add_argument("--algo", default="PPO",
+                       help="algorithm name (PPO, DQN, SAC, ...)")
+        r.add_argument("--env", default="CartPole-v1")
+        r.add_argument("--config", default="",
+                       help="JSON dict of .training(...) overrides")
+        r.add_argument("--num-cpus", type=int, default=4,
+                       dest="num_cpus")
+        r.add_argument("--checkpoint-path", default="",
+                       dest="checkpoint_path")
+        if name == "train":
+            r.add_argument("--stop-iters", type=int, default=10,
+                           dest="stop_iters")
+            r.add_argument("--stop-reward", type=float, default=None,
+                           dest="stop_reward")
+        else:
+            r.add_argument("--episodes", type=int, default=5)
+        r.set_defaults(fn=cmd_rllib)
 
     s = sub.add_parser("job", help="job submission")
     jsub = s.add_subparsers(dest="job_cmd", required=True)

@@ -1,0 +1,33 @@
+"""RLlib CLI round-trip (reference: rllib/train.py, rllib/evaluate.py).
+
+Isolated in its own module: cmd_rllib owns a full init/shutdown cycle,
+which must never tear down another module's shared cluster fixture.
+"""
+
+def test_rllib_cli_train_and_evaluate(tmp_path, jax_cpu):
+    """`ray_tpu rllib train` + `rllib evaluate` round-trip (reference:
+    rllib/train.py, rllib/evaluate.py CLIs)."""
+    import io
+    from contextlib import redirect_stdout
+
+    from ray_tpu.scripts.cli import build_parser
+
+    ckpt = str(tmp_path / "ppo.ckpt")
+    parser = build_parser()
+    args = parser.parse_args(
+        ["rllib", "train", "--algo", "PPO", "--env", "CartPole-v1",
+         "--stop-iters", "2", "--checkpoint-path", ckpt,
+         "--config", '{"train_batch_size": 400, "minibatch_size": 128}'])
+    out = io.StringIO()
+    with redirect_stdout(out):
+        args.fn(args)
+    assert "iter 2:" in out.getvalue()
+    assert "checkpoint written" in out.getvalue()
+
+    args = parser.parse_args(
+        ["rllib", "evaluate", "--algo", "PPO", "--env", "CartPole-v1",
+         "--checkpoint-path", ckpt])
+    out = io.StringIO()
+    with redirect_stdout(out):
+        args.fn(args)
+    assert "mean_return=" in out.getvalue()
