@@ -261,7 +261,9 @@ class DQN(Algorithm):
     def _validate_config(self):
         super()._validate_config()
         cfg = self.algo_config
-        if cfg.model is not None:
+        # Catalog-combo checks only apply where the catalog is in play
+        # (opted-out variants route model=None and keep the legacy net).
+        if cfg.model is not None and self.supports_model_config:
             if cfg.dueling:
                 raise ValueError("dueling=True cannot combine with a "
                                  "catalog model config")

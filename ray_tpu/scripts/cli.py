@@ -245,16 +245,35 @@ def cmd_rllib(args):
     if config_cls is None:
         sys.exit(f"error: unknown algorithm {args.algo!r}; see "
                  f"ray_tpu.rllib.__all__ for available *Config classes")
-    cfg = config_cls().environment(args.env)
-    if args.config:
-        cfg.training(**json.loads(args.config))
+    config_json = args.config
     if args.rllib_cmd == "evaluate":
         # Usage errors before paying for init + actor spawns.
         if not args.checkpoint_path:
             sys.exit("error: evaluate needs --checkpoint-path")
+        with open(args.checkpoint_path, "rb") as f:
+            ckpt = cloudpickle.load(f)
+        # Train-time config rides in the checkpoint so evaluate builds
+        # the SAME network without the user repeating --config.
+        if not config_json:
+            config_json = ckpt.get("cli_config", "")
+    cfg = config_cls().environment(args.env)
+    if config_json:
+        try:
+            overrides = json.loads(config_json)
+            if not isinstance(overrides, dict):
+                raise ValueError("--config must be a JSON object")
+            cfg.training(**overrides)
+        except (json.JSONDecodeError, TypeError, ValueError) as e:
+            sys.exit(f"error: bad --config: {e}")
+    if args.rllib_cmd == "evaluate":
         if cfg.is_multi_agent:
             sys.exit("error: evaluate supports single-policy "
                      "checkpoints only")
+        from ray_tpu.rllib.env import make_env
+        if make_env(args.env, cfg.env_config).continuous:
+            sys.exit("error: evaluate supports discrete-action "
+                     "policy/Q algorithms only")
+        cfg.env_runners(num_env_runners=1)  # one greedy evaluator
     ray_tpu.init(num_cpus=args.num_cpus, num_tpus=0)
     try:
         algo = cfg.build()
@@ -275,12 +294,17 @@ def cmd_rllib(args):
             if best > float("-inf"):
                 print(f"best reward_mean: {best:.2f}")
             if args.checkpoint_path:
+                state = algo.save_checkpoint()
+                state["cli_config"] = args.config
                 with open(args.checkpoint_path, "wb") as f:
-                    cloudpickle.dump(algo.save_checkpoint(), f)
+                    cloudpickle.dump(state, f)
                 print(f"checkpoint written to {args.checkpoint_path}")
         else:  # evaluate
-            with open(args.checkpoint_path, "rb") as f:
-                algo.load_checkpoint(cloudpickle.load(f))
+            if not hasattr(algo, "learner"):
+                sys.exit(f"error: {args.algo} has no single-learner "
+                         f"checkpoint to evaluate")
+            ckpt.pop("cli_config", None)
+            algo.load_checkpoint(ckpt)
             weights = algo.learner.get_weights()
             ret = ray_tpu.get(
                 algo.env_runners[0].evaluate_return.remote(
@@ -401,7 +425,9 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("train", "evaluate"):
         r = rsub.add_parser(name)
         r.add_argument("--algo", default="PPO",
-                       help="algorithm name (PPO, DQN, SAC, ...)")
+                       help="algorithm name (PPO, A2C, PG, DQN, C51, "
+                            "QRDQN, ...; evaluate needs a "
+                            "discrete-action single-learner algo)")
         r.add_argument("--env", default="CartPole-v1")
         r.add_argument("--config", default="",
                        help="JSON dict of .training(...) overrides")

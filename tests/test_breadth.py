@@ -523,4 +523,3 @@ def test_util_iter_parallel_iterator(ray_breadth):
     # take() limits; from_iterators with generator thunks streams.
     inf = rit.from_iterators([lambda: iter(range(1000))], repeat=False)
     assert inf.take(5) == [0, 1, 2, 3, 4]
-

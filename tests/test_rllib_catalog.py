@@ -81,7 +81,7 @@ def test_lstm_seq_apply_matches_stepwise(jax_cpu):
                                    rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.timeout(360)
+@pytest.mark.timeout(600)
 def test_ppo_cnn_learns_gridgoal(ray_rl, jax_cpu):
     """PPO with the auto-CNN torso solves the 4x4 image gridworld."""
     from ray_tpu.rllib import PPOConfig
@@ -109,7 +109,7 @@ def test_ppo_cnn_learns_gridgoal(ray_rl, jax_cpu):
     assert best > 0.45, best
 
 
-@pytest.mark.timeout(360)
+@pytest.mark.timeout(600)
 def test_ppo_lstm_learns_memory_cue(ray_rl, jax_cpu):
     """PPO+LSTM must recall the t=0 cue after the delay (chance = 0.5)."""
     from ray_tpu.rllib import PPOConfig
@@ -139,7 +139,7 @@ def test_ppo_lstm_learns_memory_cue(ray_rl, jax_cpu):
     assert recent and max(recent[-10:]) > 0.85, recent[-10:]
 
 
-@pytest.mark.timeout(360)
+@pytest.mark.timeout(600)
 def test_dqn_cnn_learns_gridgoal(ray_rl, jax_cpu):
     """Value-based catalog path: DQN with the auto-CNN Q-network solves
     the image gridworld (reference: vision nets are shared across policy
