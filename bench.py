@@ -438,6 +438,16 @@ def _tuned_model_config(attention: str = "flash") -> dict:
             best_sps, best_policy = sps, policy
     if best_policy:
         out["remat_policy"] = best_policy
+    # Larger measured-better batch (overhead-bound steps): lead the
+    # bench's bs ladder with it — but ONLY when the tuned remat policy
+    # matches the one the bs-128 experiment ran ("full"); a different
+    # policy holds different residuals and was never measured at 128,
+    # so promoting it risks an OOM'd compile inside a short window.
+    big = exp.get("step_ref_bs128") or {}
+    if (attention == "reference" and big.get("sps")
+            and big.get("bs", 0) > 64 and big["sps"] > best_sps
+            and best_policy in (None, "full")):
+        out["_lead_bs"] = int(big["bs"])
     iso = exp.get("flash_iso") or {}
     best_ms, best_blocks = None, None
     for key, v in iso.items():
@@ -505,6 +515,7 @@ def bench_model():
                 pass
             attention = attention or "flash"
         tuned = _tuned_model_config(attention)
+        lead_bs = tuned.pop("_lead_bs", None)
         cfg = GPTConfig(attention=attention, **tuned)  # GPT-2 small, bf16
         if tuned:
             log(f"model bench tuned config from experiments: {tuned}")
@@ -522,7 +533,9 @@ def bench_model():
 
         result = None
         first_attempt = True
-        for bs in (64, 32, 16, 8):
+        bs_ladder = tuple(dict.fromkeys(
+            ([lead_bs] if lead_bs else []) + [64, 32, 16, 8]))
+        for bs in bs_ladder:
             try:
                 if not first_attempt:
                     # On donation-capable backends the failed attempt consumed

@@ -256,6 +256,10 @@ def cmd_rllib(args):
         # the SAME network without the user repeating --config.
         if not config_json:
             config_json = ckpt.get("cli_config", "")
+        saved_env = ckpt.get("cli_env")
+        if saved_env and saved_env != args.env:
+            sys.exit(f"error: checkpoint was trained on env "
+                     f"{saved_env!r}; pass --env {saved_env}")
     cfg = config_cls().environment(args.env)
     if config_json:
         try:
@@ -296,6 +300,7 @@ def cmd_rllib(args):
             if args.checkpoint_path:
                 state = algo.save_checkpoint()
                 state["cli_config"] = args.config
+                state["cli_env"] = args.env
                 with open(args.checkpoint_path, "wb") as f:
                     cloudpickle.dump(state, f)
                 print(f"checkpoint written to {args.checkpoint_path}")
@@ -304,6 +309,7 @@ def cmd_rllib(args):
                 sys.exit(f"error: {args.algo} has no single-learner "
                          f"checkpoint to evaluate")
             ckpt.pop("cli_config", None)
+            ckpt.pop("cli_env", None)
             algo.load_checkpoint(ckpt)
             weights = algo.learner.get_weights()
             ret = ray_tpu.get(

@@ -289,6 +289,13 @@ def exp_step_accum4():
     return _bench_step("dots", iters=3, bs=64, accum=4)
 
 
+def exp_step_ref_bs128():
+    """Reference attention at bs=128: if the step is overhead- or
+    latency-bound rather than FLOP-bound, doubling the batch raises
+    tokens/s (the result records the bs that actually fit)."""
+    return _bench_step("full", iters=4, bs=128, attention="reference")
+
+
 EXPERIMENTS = [
     # Highest-value first: windows are short. The 12:00Z findings:
     # reference attention 16.6% MFU > flash 11.7%; fwd=368 ms vs
@@ -297,6 +304,7 @@ EXPERIMENTS = [
     ("xent_iso", exp_xent_iso),
     ("step_ref_remat_dots", exp_step_ref_remat_dots),
     ("step_ref_remat_full", exp_step_ref_remat_full),
+    ("step_ref_bs128", exp_step_ref_bs128),
     ("fwd_only", exp_fwd_only),
     ("matmul", exp_matmul),
     ("dispatch", exp_dispatch),
