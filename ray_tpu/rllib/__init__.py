@@ -32,6 +32,7 @@ from ray_tpu.rllib.algorithms.c51 import C51, C51Config
 from ray_tpu.rllib.algorithms.apex import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.algorithms.qrdqn import QRDQN, QRDQNConfig
 from ray_tpu.rllib.algorithms.noisy import NoisyDQN, NoisyDQNConfig
+from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
 from ray_tpu.rllib.offline import JsonReader, JsonWriter
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rllib import connectors
@@ -44,6 +45,7 @@ __all__ = [
     "A2C", "A2CConfig", "ES", "ESConfig", "ARS", "ARSConfig",
     "PG", "PGConfig", "C51", "C51Config", "ApexDQN", "ApexDQNConfig",
     "QRDQN", "QRDQNConfig", "NoisyDQN", "NoisyDQNConfig",
+    "R2D2", "R2D2Config",
     "connectors", "EnvSpec", "CartPoleEnv",
     "PendulumEnv", "MultiAgentEnv", "MultiCartPole", "make_env",
     "register_env", "SampleBatch", "MultiAgentBatch", "concat_samples",

@@ -1,0 +1,329 @@
+"""R2D2: recurrent-replay distributed DQN.
+
+Reference parity: rllib/algorithms/r2d2 (Kapturowski et al. 2019) — the
+recurrent value-based family the feedforward DQN line cannot cover:
+
+  - runners collect fixed-length SEQUENCES with the sampler's LSTM carry
+    recorded at every step (the stored-state strategy; zero-state only at
+    true episode starts);
+  - the replay buffer holds whole sequences;
+  - the learner replays each sequence under lax.scan (carry resets at
+    in-sequence episode boundaries), computes double-Q TD targets from
+    the WITHIN-sequence next step (q[t+1]); the final step of each
+    sequence has no successor and is masked from the loss; an optional
+    burn-in prefix rebuilds the carry without contributing loss.
+
+TPU-first shape: both the online and target nets run as one scanned XLA
+program over [B, T] — no per-step Python in the update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
+
+
+class R2D2Config(DQNConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or R2D2)
+        self.rollout_fragment_length = 16   # = training sequence length
+        self.lstm_cell_size = 32
+        self.burn_in = 0                    # carry-rebuild prefix steps
+        self.train_batch_size = 16          # sequences per update
+
+    def training(self, *, lstm_cell_size=None, burn_in=None,
+                 **kw) -> "R2D2Config":
+        super().training(**kw)
+        if lstm_cell_size is not None:
+            self.lstm_cell_size = lstm_cell_size
+        if burn_in is not None:
+            self.burn_in = burn_in
+        return self
+
+
+def _mcfg(cfg_hidden, lstm_cell_size, model):
+    from ray_tpu.rllib.catalog import ModelConfig
+    d = dict(model or {})
+    d.setdefault("fcnet_hiddens", list(cfg_hidden))
+    d["use_lstm"] = True
+    d["lstm_cell_size"] = lstm_cell_size
+    return ModelConfig.from_dict(d)
+
+
+class R2D2Runner(EnvRunner):
+    """Collects [n_envs, T] sequences with per-step stored carries and
+    epsilon-greedy actions over the recurrent Q net."""
+
+    def __init__(self, *args, lstm_cell_size=32, **kw):
+        self._cell = lstm_cell_size
+        super().__init__(*args, **kw)
+
+    def _build_policy(self, seed, hidden, model):
+        import jax
+        from ray_tpu.rllib.catalog import (catalog_rq_apply_step,
+                                           catalog_rq_init, obs_shape_of)
+        e0 = self._envs[0]
+        mcfg = _mcfg(hidden, self._cell, model)
+        self._mcfg = mcfg
+        self._params = catalog_rq_init(jax.random.PRNGKey(seed),
+                                       obs_shape_of(e0), e0.num_actions,
+                                       mcfg)
+        z = np.zeros((len(self._envs), self._cell), np.float32)
+        self._state = [z.copy(), z.copy()]
+        self._jit_step = jax.jit(
+            lambda p, o, s: catalog_rq_apply_step(p, o, s, mcfg))
+        self._done_prev = np.zeros(len(self._envs), np.float32)
+
+    def evaluate_return(self, params, episodes: int = 1,
+                        max_steps: int = 500) -> float:
+        """Greedy recurrent evaluation (the base class's shapes don't
+        fit the (q, state) step signature)."""
+        import jax.numpy as jnp
+        from ray_tpu.rllib.env import make_env
+        env = make_env(self._env_spec, self._env_config)
+        total = 0.0
+        for _ep in range(episodes):
+            obs, _ = env.reset(seed=int(self._rng.randint(2 ** 31)))
+            z = jnp.zeros((1, self._cell), jnp.float32)
+            state = (z, z)
+            for _ in range(max_steps):
+                x = self._obs_conn(np.asarray(obs)[None], update=False)
+                q, state = self._jit_step(params, x, state)
+                obs, r, term, trunc, _ = env.step(
+                    int(np.argmax(np.asarray(q)[0])))
+                total += r
+                if term or trunc:
+                    break
+        return total / episodes
+
+    def sample_sequences(self, num_steps: int,
+                         epsilon: float) -> SampleBatch:
+        """One fragment per env: columns shaped [n_envs, T, ...] plus the
+        fragment-start carry [n_envs, cell] and per-step done flags."""
+        n_envs = len(self._envs)
+        cols: Dict[str, List] = {k: [] for k in (
+            sb.OBS, sb.ACTIONS, sb.REWARDS, "dones", sb.TERMINATEDS,
+            sb.DONE_PREV)}
+        h0, c0 = self._state[0].copy(), self._state[1].copy()
+        for _t in range(num_steps):
+            obs_arr = self._obs_conn(np.stack(self._obs))
+            q, (h2, c2) = self._jit_step(self._params, obs_arr,
+                                         tuple(self._state))
+            q = np.asarray(q)
+            h2, c2 = np.array(h2), np.array(c2)
+            step = {k: [] for k in cols}
+            for i, env in enumerate(self._envs):
+                if self._rng.rand() < epsilon:
+                    a = self._rng.randint(q.shape[-1])
+                else:
+                    a = int(np.argmax(q[i]))
+                obs2, r, term, trunc, _ = env.step(a)
+                step[sb.OBS].append(obs_arr[i])
+                step[sb.ACTIONS].append(a)
+                step[sb.REWARDS].append(r)
+                step["dones"].append(float(term or trunc))
+                step[sb.TERMINATEDS].append(float(term))
+                step[sb.DONE_PREV].append(self._done_prev[i])
+                self._ep_rewards[i] += r
+                self._done_prev[i] = 0.0
+                if term or trunc:
+                    self._done_rewards.append(self._ep_rewards[i])
+                    self._ep_rewards[i] = 0.0
+                    obs2, _ = env.reset()
+                    h2[i] = 0.0
+                    c2[i] = 0.0
+                    self._done_prev[i] = 1.0
+                self._obs[i] = obs2
+            for k, v in step.items():
+                cols[k].append(v)
+            self._state = [h2, c2]
+        # [T, n_envs, ...] -> [n_envs, T, ...]
+        out = {k: np.swapaxes(np.asarray(v), 0, 1)
+               for k, v in cols.items()}
+        out[sb.STATE_IN_H] = h0
+        out[sb.STATE_IN_C] = c0
+        return SampleBatch(out)
+
+
+class R2D2Learner:
+    def __init__(self, obs_shape, num_actions: int, *, hidden=(64, 64),
+                 lstm_cell_size=32, lr=5e-4, gamma=0.99, double_q=True,
+                 burn_in=0, model=None, seed=0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.catalog import (catalog_rq_apply_seq,
+                                           catalog_rq_init)
+        mcfg = _mcfg(hidden, lstm_cell_size, model)
+        self._optimizer = optax.adam(lr)
+        self.params = catalog_rq_init(jax.random.PRNGKey(seed), obs_shape,
+                                      num_actions, mcfg)
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+        self.opt_state = self._optimizer.init(self.params)
+
+        def loss_fn(params, target_params, batch, weights):
+            state_in = (batch[sb.STATE_IN_H], batch[sb.STATE_IN_C])
+            q, _ = catalog_rq_apply_seq(
+                params, batch[sb.OBS], batch[sb.DONE_PREV], state_in,
+                mcfg)                                     # [B, T, A]
+            q_tgt, _ = catalog_rq_apply_seq(
+                target_params, batch[sb.OBS], batch[sb.DONE_PREV],
+                state_in, mcfg)
+            bsz, t, _a = q.shape
+            rows = jnp.arange(bsz)[:, None]
+            ts = jnp.arange(t)[None, :]
+            q_taken = q[rows, ts, batch[sb.ACTIONS]]      # [B, T]
+            # Within-sequence targets from step t+1 (shift left).
+            if double_q:
+                a_next = jnp.argmax(q[:, 1:], -1)          # [B, T-1]
+                v_next = q_tgt[:, 1:][rows, ts[:, :t - 1], a_next]
+            else:
+                v_next = q_tgt[:, 1:].max(-1)
+            dones = batch["dones"][:, :t - 1]
+            terms = batch[sb.TERMINATEDS][:, :t - 1]
+            # done-but-truncated steps have no stored successor obs:
+            # drop them from the loss alongside the final step. A
+            # TERMINATED step needs no successor (target = reward).
+            target = (batch[sb.REWARDS][:, :t - 1]
+                      + gamma * (1.0 - terms) * v_next)
+            # The step AFTER a done belongs to a new episode; its value
+            # v_next is valid (carry was reset by done_prev) — but the
+            # done step itself must not bootstrap across the boundary.
+            trunc_no_succ = dones * (1.0 - terms)
+            mask = jnp.ones((bsz, t - 1))
+            mask = mask * (1.0 - trunc_no_succ)
+            if burn_in > 0:
+                mask = mask.at[:, :burn_in].set(0.0)
+            td = (q_taken[:, :t - 1]
+                  - jax.lax.stop_gradient(target)) * mask
+            denom = jnp.maximum(mask.sum(), 1.0)
+            # weights: per-SEQUENCE importance weights (sequence PER).
+            loss = (weights[:, None] * td * td).sum() / denom
+            # Per-sequence priority signal: mean |td|.
+            per_seq = jnp.abs(td).sum(-1) / jnp.maximum(
+                mask.sum(-1), 1.0)
+            return loss, per_seq
+
+        def update(params, target_params, opt_state, batch, weights):
+            (loss, per), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch,
+                                       weights)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, per
+
+        self._jit_update = jax.jit(update)
+
+    def update(self, batch: SampleBatch) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        jb = {k: jnp.asarray(batch[k]) for k in
+              (sb.OBS, sb.ACTIONS, sb.REWARDS, "dones", sb.TERMINATEDS,
+               sb.DONE_PREV, sb.STATE_IN_H, sb.STATE_IN_C)}
+        weights = jnp.asarray(batch["weights"]) if "weights" in batch \
+            else jnp.ones(len(batch), jnp.float32)
+        self.params, self.opt_state, loss, per = self._jit_update(
+            self.params, self.target_params, self.opt_state, jb, weights)
+        return {"td_error": np.asarray(per), "loss": float(loss)}
+
+    def sync_target(self):
+        import jax
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params):
+        self.params = params
+
+
+class R2D2(DQN):
+    config_class = R2D2Config
+    supports_model_config = True   # catalog-built (torso choice applies)
+
+    def _validate_config(self):
+        # R2D2 IS the recurrent Q algorithm: skip DQN's no-LSTM check;
+        # dueling heads and n-step returns are not implemented on the
+        # sequence loss (targets come from the within-sequence t+1).
+        if self.algo_config.dueling:
+            raise ValueError("R2D2 does not support dueling heads")
+        if self.algo_config.n_step != 1:
+            raise ValueError("R2D2 bootstraps within the sequence; "
+                             "n_step is not supported")
+
+    def _runner_class(self):
+        return R2D2Runner
+
+    def _extra_runner_kwargs(self) -> Dict[str, Any]:
+        return {"lstm_cell_size": self.algo_config.lstm_cell_size}
+
+    def _make_q_learner(self, probe):
+        from ray_tpu.rllib.catalog import obs_shape_of
+        cfg = self.algo_config
+        return R2D2Learner(
+            obs_shape_of(probe), probe.num_actions, hidden=cfg.hidden,
+            lstm_cell_size=cfg.lstm_cell_size, lr=cfg.lr,
+            gamma=cfg.gamma, double_q=cfg.double_q, burn_in=cfg.burn_in,
+            model=cfg.model, seed=cfg.seed)
+
+    def build_learner(self):
+        from ray_tpu.rllib.env import make_env
+        cfg = self.algo_config
+        probe = make_env(cfg.env, cfg.env_config)
+        self.learner = self._make_q_learner(probe)
+        # Sequence replay: a SampleBatch row = one whole sequence, so
+        # the step-denominated capacity knob converts to sequences
+        # (same memory budget as the feedforward family).
+        capacity = max(1, cfg.replay_buffer_capacity
+                       // cfg.rollout_fragment_length)
+        if cfg.prioritized_replay:
+            from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer
+            self.replay = PrioritizedReplayBuffer(capacity, seed=cfg.seed)
+        else:
+            self.replay = ReplayBuffer(capacity, seed=cfg.seed)
+        self._steps_sampled = 0
+        self._last_target_sync = 0
+        self.broadcast_weights(self.learner.get_weights())
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        eps = self._epsilon()
+        seq_batches = ray_tpu.get(
+            [er.sample_sequences.remote(cfg.rollout_fragment_length, eps)
+             for er in self.env_runners])
+        batch = concat_samples(seq_batches)
+        self.replay.add(batch)
+        self._steps_sampled += (len(batch)
+                                * cfg.rollout_fragment_length)
+        metrics: Dict[str, Any] = {
+            "epsilon": eps, "replay_sequences": len(self.replay),
+            "num_env_steps_sampled": len(batch)
+            * cfg.rollout_fragment_length}
+        if len(self.replay) * cfg.rollout_fragment_length \
+                >= cfg.learning_starts:
+            losses = []
+            for _ in range(cfg.updates_per_step):
+                replayed = self.replay.sample(cfg.train_batch_size)
+                m = self.learner.update(replayed)
+                if cfg.prioritized_replay and "batch_indexes" in replayed:
+                    self.replay.update_priorities(
+                        replayed["batch_indexes"], m["td_error"] + 1e-6)
+                losses.append(m["loss"])
+            metrics["loss"] = float(np.mean(losses))
+            self.broadcast_weights(self.learner.get_weights())
+        if (self._steps_sampled - self._last_target_sync
+                >= cfg.target_network_update_freq):
+            self.learner.sync_target()
+            self._last_target_sync = self._steps_sampled
+        return metrics

@@ -290,6 +290,48 @@ def catalog_apply_step(params, obs, state, cfg: ModelConfig):
     return pi, vf, (h, c)
 
 
+def catalog_rq_init(rng, obs_shape, num_actions: int, cfg: ModelConfig):
+    """Recurrent Q-network (R2D2 family): torso + LSTM + Q head, no
+    value stream, no policy-logit scaling."""
+    import jax
+    k_torso, k_lstm, k_q = jax.random.split(rng, 3)
+    torso, feat = _torso_init(k_torso, obs_shape, cfg)
+    return {"torso": torso,
+            "lstm": _lstm_init(k_lstm, feat, cfg.lstm_cell_size),
+            "pi": _mlp_init(k_q, [cfg.lstm_cell_size, num_actions])}
+
+
+def catalog_rq_apply_step(params, obs, state, cfg: ModelConfig):
+    """One recurrent Q step [B, ...] + (h, c) -> (q [B, A], state')."""
+    feat = _torso_apply(params["torso"], obs, cfg)
+    h, c = _lstm_cell(params["lstm"], feat, *state)
+    return _mlp_apply(params["pi"], h, final_act=False), (h, c)
+
+
+def catalog_rq_apply_seq(params, obs_seq, done_prev, state_in,
+                         cfg: ModelConfig):
+    """Recurrent Q over sequences: [B, T, ...] + done_prev [B, T] +
+    (h, c) [B, cell] -> (q [B, T, A], state_out); carry resets at
+    episode boundaries inside the scan."""
+    import jax
+    import jax.numpy as jnp
+
+    obs_tm = jnp.moveaxis(obs_seq, 1, 0)
+    done_tm = jnp.moveaxis(done_prev, 1, 0)
+
+    def tick(carry, inp):
+        h, c = carry
+        obs_t, done_t = inp
+        mask = (1.0 - done_t)[:, None]
+        h, c = h * mask, c * mask
+        feat = _torso_apply(params["torso"], obs_t, cfg)
+        h, c = _lstm_cell(params["lstm"], feat, h, c)
+        return (h, c), _mlp_apply(params["pi"], h, final_act=False)
+
+    state_out, q_tm = jax.lax.scan(tick, state_in, (obs_tm, done_tm))
+    return jnp.moveaxis(q_tm, 0, 1), state_out
+
+
 def catalog_apply_seq(params, obs_seq, done_prev, state_in,
                       cfg: ModelConfig):
     """Sequence forward for BPTT training.
