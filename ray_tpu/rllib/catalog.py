@@ -284,10 +284,9 @@ def catalog_q_apply(params, obs, cfg: ModelConfig):
 
 def catalog_apply_step(params, obs, state, cfg: ModelConfig):
     """One recurrent step [B, ...] + (h, c) -> (logits, values, state')."""
-    feat = _torso_apply(params["torso"], obs, cfg)
-    h, c = _lstm_cell(params["lstm"], feat, *state)
+    h, state = _recurrent_step(params, obs, state, cfg)
     pi, vf = _heads(params, h)
-    return pi, vf, (h, c)
+    return pi, vf, state
 
 
 def catalog_rq_init(rng, obs_shape, num_actions: int, cfg: ModelConfig):
@@ -301,18 +300,19 @@ def catalog_rq_init(rng, obs_shape, num_actions: int, cfg: ModelConfig):
             "pi": _mlp_init(k_q, [cfg.lstm_cell_size, num_actions])}
 
 
-def catalog_rq_apply_step(params, obs, state, cfg: ModelConfig):
-    """One recurrent Q step [B, ...] + (h, c) -> (q [B, A], state')."""
+def _recurrent_step(params, obs, state, cfg: ModelConfig):
+    """Shared torso+LSTM step: [B, ...] + (h, c) -> (h', (h', c'))."""
     feat = _torso_apply(params["torso"], obs, cfg)
     h, c = _lstm_cell(params["lstm"], feat, *state)
-    return _mlp_apply(params["pi"], h, final_act=False), (h, c)
+    return h, (h, c)
 
 
-def catalog_rq_apply_seq(params, obs_seq, done_prev, state_in,
-                         cfg: ModelConfig):
-    """Recurrent Q over sequences: [B, T, ...] + done_prev [B, T] +
-    (h, c) [B, cell] -> (q [B, T, A], state_out); carry resets at
-    episode boundaries inside the scan."""
+def _recurrent_scan(params, obs_seq, done_prev, state_in,
+                    cfg: ModelConfig, head_fn):
+    """Shared sequence driver: scan the torso+LSTM over [B, T, ...] with
+    carry resets where done_prev marks an episode boundary; head_fn maps
+    each step's hidden state to the output. The ONE place the boundary
+    machinery lives — the policy and Q families must not diverge."""
     import jax
     import jax.numpy as jnp
 
@@ -323,13 +323,32 @@ def catalog_rq_apply_seq(params, obs_seq, done_prev, state_in,
         h, c = carry
         obs_t, done_t = inp
         mask = (1.0 - done_t)[:, None]
-        h, c = h * mask, c * mask
-        feat = _torso_apply(params["torso"], obs_t, cfg)
-        h, c = _lstm_cell(params["lstm"], feat, h, c)
-        return (h, c), _mlp_apply(params["pi"], h, final_act=False)
+        h2, carry2 = _recurrent_step(params, obs_t,
+                                     (h * mask, c * mask), cfg)
+        return carry2, head_fn(params, h2)
 
-    state_out, q_tm = jax.lax.scan(tick, state_in, (obs_tm, done_tm))
-    return jnp.moveaxis(q_tm, 0, 1), state_out
+    state_out, out_tm = jax.lax.scan(tick, state_in, (obs_tm, done_tm))
+    return jax.tree_util.tree_map(
+        lambda a: jnp.moveaxis(a, 0, 1), out_tm), state_out
+
+
+def _q_head(params, h):
+    return _mlp_apply(params["pi"], h, final_act=False)
+
+
+def catalog_rq_apply_step(params, obs, state, cfg: ModelConfig):
+    """One recurrent Q step [B, ...] + (h, c) -> (q [B, A], state')."""
+    h, state = _recurrent_step(params, obs, state, cfg)
+    return _q_head(params, h), state
+
+
+def catalog_rq_apply_seq(params, obs_seq, done_prev, state_in,
+                         cfg: ModelConfig):
+    """Recurrent Q over sequences: [B, T, ...] + done_prev [B, T] +
+    (h, c) [B, cell] -> (q [B, T, A], state_out); carry resets at
+    episode boundaries inside the scan."""
+    return _recurrent_scan(params, obs_seq, done_prev, state_in, cfg,
+                           _q_head)
 
 
 def catalog_apply_seq(params, obs_seq, done_prev, state_in,
@@ -341,23 +360,6 @@ def catalog_apply_seq(params, obs_seq, done_prev, state_in,
     (the sampler's carry at fragment start). -> (logits [B, T, A],
     values [B, T], state_out).
     """
-    import jax
-    import jax.numpy as jnp
-
-    obs_tm = jnp.moveaxis(obs_seq, 1, 0)       # [T, B, ...]
-    done_tm = jnp.moveaxis(done_prev, 1, 0)    # [T, B]
-
-    def tick(carry, inp):
-        h, c = carry
-        obs_t, done_t = inp
-        mask = (1.0 - done_t)[:, None]
-        h, c = h * mask, c * mask
-        feat = _torso_apply(params["torso"], obs_t, cfg)
-        h, c = _lstm_cell(params["lstm"], feat, h, c)
-        pi, vf = _heads(params, h)
-        return (h, c), (pi, vf)
-
-    state_out, (pi_tm, vf_tm) = jax.lax.scan(
-        tick, state_in, (obs_tm, done_tm))
-    return (jnp.moveaxis(pi_tm, 0, 1), jnp.moveaxis(vf_tm, 0, 1),
-            state_out)
+    (pi, vf), state_out = _recurrent_scan(
+        params, obs_seq, done_prev, state_in, cfg, _heads)
+    return pi, vf, state_out

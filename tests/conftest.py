@@ -44,6 +44,25 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "timeout(seconds): per-test wall-clock limit "
         f"(default {_DEFAULT_TEST_TIMEOUT:.0f}s)")
+    # Killed runs leak plasma arenas (/dev/shm/rtpu_<pid>_*) — 4.3 GB
+    # piled up in one session and degraded a later full-suite run.
+    # Reap arenas whose creator pid is gone before this run starts.
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        names = []
+    for name in names:
+        if not name.startswith("rtpu_"):
+            continue
+        try:
+            pid = int(name.split("_")[1])
+        except (IndexError, ValueError):
+            continue
+        if not os.path.exists(f"/proc/{pid}"):
+            try:
+                os.unlink(os.path.join("/dev/shm", name))
+            except OSError:
+                pass  # raced with a concurrent reaper / foreign owner
 
 
 class _TestTimeout(Exception):

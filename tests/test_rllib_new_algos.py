@@ -379,7 +379,10 @@ def test_r2d2_learns_memory_cue(ray_rl, jax_cpu):
                          rollout_fragment_length=16)
             .training(lr=1e-3, learning_starts=256,
                       epsilon_decay_steps=1_500, lstm_cell_size=32,
-                      target_network_update_freq=500, updates_per_step=8)
+                      target_network_update_freq=500, updates_per_step=8,
+                      # Sequence PER on: covers the per-sequence IS
+                      # weights + priority-update path end to end.
+                      prioritized_replay=True)
             .debugging(seed=0)
             .build())
     try:

@@ -58,9 +58,11 @@ def test_class_deployment_replicas_and_routing(ray_mod):
     # probabilistic and the second replica may still be starting on a
     # loaded box: sample until both appear, bounded).
     ids = set()
-    deadline = time.time() + 30
+    deadline = time.time() + 90
     while len(ids) < 2 and time.time() < deadline:
         ids.add(h.whoami.remote().result(timeout=30))
+        if len(ids) < 2:
+            time.sleep(0.2)   # give the second replica time to start
     assert len(ids) == 2
 
 
