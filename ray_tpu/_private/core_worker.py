@@ -317,6 +317,13 @@ class CoreWorker:
         await self.gcs.request("subscribe",
                                {"channels": self._pubsub_channels()})
         self.raylet = await rpc.connect(self.raylet_address)
+        # Identify this client so the raylet can reclaim our leases (and
+        # the GCS our non-detached actors) if this process goes away.
+        try:
+            await self.raylet.request("announce_client",
+                                      {"owner_address": self.address})
+        except rpc.RpcError:
+            pass
         self.store = ObjectStoreClient(self._raylet_request,
                                        self._raylet_notify)
         object_ref_mod._set_core_worker_hooks(

@@ -666,6 +666,22 @@ class GcsServer:
     async def rpc_get_all_jobs(self, conn, payload):
         return list(self.jobs.values())
 
+    async def rpc_owner_disconnected(self, conn, payload):
+        """A core worker (driver or nested-task submitter) left the
+        cluster: its non-detached actors die with it (reference:
+        gcs_actor_manager.h OnWorkerDead). Raylets report this when the
+        owner's lease connection closes."""
+        owners = set(payload.get("owners") or [])
+        for actor in list(self.actors.values()):
+            if (actor.owner_address in owners
+                    and actor.state != ACTOR_DEAD
+                    and (actor.creation_spec is None
+                         or actor.creation_spec.lifetime != "detached")):
+                asyncio.ensure_future(self.rpc_kill_actor(
+                    None, {"actor_id": actor.actor_id,
+                           "no_restart": True}))
+        return True
+
     # ------------- actor management -------------
 
     async def rpc_register_actor(self, conn, payload):

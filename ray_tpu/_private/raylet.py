@@ -21,7 +21,7 @@ import subprocess
 import sys
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import rpc
 from ray_tpu._private.common import NodeInfo, TaskSpec
@@ -190,6 +190,9 @@ class WorkerHandle:
     env_hash: str = ""
     # Owner (submitter) of the current lease; OOM victim grouping key.
     lease_owner: str = ""
+    # The raylet connection the lease was granted over: when it closes
+    # (driver exited), the lease is reclaimed.
+    lease_conn: Optional[rpc.Connection] = None
 
 
 class ResourcePool:
@@ -279,7 +282,12 @@ class Raylet:
         # Actor creates waiting for a worker: (env_hash, exact, future),
         # FIFO-served by rpc_register_worker.
         self._actor_worker_waiters: List[tuple] = []
-        self._pending_leases: List[tuple] = []   # (spec, future)
+        self._pending_leases: List[tuple] = []   # (spec, pg, fut, conn)
+        # Driver conns that have been granted leases: on close, their
+        # leased workers are reclaimed (reference: leased workers of an
+        # exited job are destroyed, worker_pool.cc DisconnectClient).
+        self._lease_conns: set = set()
+        self._conn_owner: Dict[Any, str] = {}   # conn -> owner address
         self._autoscaler_active = False
         self._spawned_worker_prefixes: set = set()
         self._starting_workers = 0
@@ -419,7 +427,7 @@ class Raylet:
                     # bin-packing (reference: resource_demand_scheduler.py).
                     "pending_demand": [
                         dict(spec.resources)
-                        for spec, _pg, fut in self._pending_leases[:64]
+                        for spec, _pg, fut, _c in self._pending_leases[:64]
                         if not fut.done()],
                 })
                 if reply.get("reregister"):
@@ -728,7 +736,7 @@ class Raylet:
         starting_hashes = [h.env_hash for h in self.workers.values()
                            if not h.registered and h.env_hash]
         n_starting_container = len(starting_hashes)
-        for spec, _pg_key, fut in self._pending_leases:
+        for spec, _pg_key, fut, _conn in self._pending_leases:
             if fut.done():
                 continue
             if all(avail.get(k, 0) >= v
@@ -891,14 +899,15 @@ class Raylet:
                                     f"{spec.resources})")}
 
         fut = asyncio.get_running_loop().create_future()
-        self._pending_leases.append((spec, pg_key, fut))
+        self._pending_leases.append((spec, pg_key, fut, conn))
+        self._watch_lease_client(conn)
         self._try_dispatch()
         self._ensure_worker_supply()
         try:
             return await asyncio.wait_for(fut, self.config.worker_lease_timeout_s)
         except asyncio.TimeoutError:
             try:
-                self._pending_leases.remove((spec, pg_key, fut))
+                self._pending_leases.remove((spec, pg_key, fut, conn))
             except ValueError:
                 pass
             return {"retry": True}
@@ -936,11 +945,78 @@ class Raylet:
             return None
         return best_addr
 
+    async def rpc_announce_client(self, conn, payload):
+        """Core workers identify themselves right after connecting so a
+        later disconnect maps back to their owner address (driver OR
+        worker: nested-task submitters get the same reclamation)."""
+        self._conn_owner[conn] = payload.get("owner_address", "")
+        self._watch_lease_client(conn)
+        return True
+
+    def _watch_lease_client(self, conn):
+        """Reclaim a client's leases when its raylet connection closes
+        (clean shutdown or crash). Leased non-actor workers are killed —
+        any task still running on them is orphaned (reference: job exit
+        destroys its leased workers, worker_pool.cc DisconnectClient);
+        the client's non-detached ACTORS are killed via the GCS
+        owner-death notification (detached actors survive)."""
+        if conn is None or conn in self._lease_conns:
+            return
+        if getattr(conn, "closed", False):
+            # Lost the race: the conn died before we could watch it.
+            asyncio.ensure_future(self._reclaim_client_leases(conn))
+            return
+        self._lease_conns.add(conn)
+        prev = conn.on_close
+
+        def _on_close(c, _prev=prev):
+            self._lease_conns.discard(conn)
+            asyncio.ensure_future(self._reclaim_client_leases(conn))
+            if _prev:
+                _prev(c)
+
+        conn.on_close = _on_close
+
+    async def _reclaim_client_leases(self, conn):
+        # Pending (ungranted) requests from the dead client must not be
+        # granted to nobody: cancel their futures.
+        for spec, _pg, fut, req_conn in self._pending_leases:
+            if req_conn is conn and not fut.done():
+                fut.cancel()
+        self._pending_leases = [
+            e for e in self._pending_leases if not e[2].done()]
+        for handle in list(self.workers.values()):
+            if not (handle.leased and handle.lease_conn is conn):
+                continue
+            if handle.is_actor_worker:
+                continue
+            handle.leased = False
+            handle.lease_conn = None
+            self.pool.release(handle.lease_resources, handle.lease_pg)
+            self._mark_resources_dirty()
+            handle.lease_resources = {}
+            handle.lease_pg = None
+            try:
+                if handle.conn:
+                    await handle.conn.push("shutdown", {})
+            except Exception:
+                pass
+        owner = self._conn_owner.pop(conn, "")
+        if owner:
+            # Non-detached actors owned by the departed client die with
+            # it (reference: gcs_actor_manager OnWorkerDead).
+            try:
+                await self.gcs_conn.request("owner_disconnected",
+                                            {"owners": [owner]})
+            except rpc.RpcError:
+                pass
+        self._try_dispatch()
+
     def _try_dispatch(self):
         if not self._pending_leases:
             return
         remaining = []
-        for spec, pg_key, fut in self._pending_leases:
+        for spec, pg_key, fut, req_conn in self._pending_leases:
             if fut.done():
                 continue
             if not self.pool.fits(spec.resources, pg_key):
@@ -967,12 +1043,12 @@ class Raylet:
                                 {"spillback": view["address"]})
                             break
                 if not fut.done():
-                    remaining.append((spec, pg_key, fut))
+                    remaining.append((spec, pg_key, fut, req_conn))
                 continue
             worker = self._get_idle_worker(
                 spec.env_hash(), exact=self._container_env(spec) is not None)
             if worker is None:
-                remaining.append((spec, pg_key, fut))
+                remaining.append((spec, pg_key, fut, req_conn))
                 continue
             self.pool.acquire(spec.resources, pg_key)
             self._mark_resources_dirty()
@@ -983,6 +1059,7 @@ class Raylet:
             worker.lease_class = spec.scheduling_class()
             worker.lease_resources = dict(spec.resources)
             worker.lease_pg = pg_key
+            worker.lease_conn = req_conn
             worker.idle_since = time.time()
             fut.set_result({"granted": {
                 "worker_id": worker.worker_id,
@@ -999,6 +1076,7 @@ class Raylet:
         if handle is None or not handle.leased:
             return False
         handle.leased = False
+        handle.lease_conn = None
         self.pool.release(handle.lease_resources, handle.lease_pg)
         self._mark_resources_dirty()
         handle.lease_resources = {}
