@@ -319,9 +319,14 @@ class CoreWorker:
         self.raylet = await rpc.connect(self.raylet_address)
         # Identify this client so the raylet can reclaim our leases (and
         # the GCS our non-detached actors) if this process goes away.
+        # Fire-and-forget (0-RTT bootstrap). NOTE: handlers are only
+        # SCHEDULED in frame order, not serialized — correctness does
+        # not depend on announce running first: the lease path re-arms
+        # _watch_lease_client itself, and a late announce on a closed
+        # conn re-runs reclamation (raylet._watch_lease_client).
         try:
-            await self.raylet.request("announce_client",
-                                      {"owner_address": self.address})
+            await self.raylet.notify("announce_client",
+                                     {"owner_address": self.address})
         except rpc.RpcError:
             pass
         self.store = ObjectStoreClient(self._raylet_request,
