@@ -57,12 +57,15 @@ def test_class_deployment_replicas_and_routing(ray_mod):
     # Two distinct replicas serve requests (power-of-two-choices is
     # probabilistic and the second replica may still be starting on a
     # loaded box: sample until both appear, bounded).
+    # Sample until both replicas answer. NOTE: controller status counts
+    # replicas at actor-CREATION time, so it cannot gate readiness; calls
+    # to a still-starting replica simply queue until its __init__ ends.
+    # The budget absorbs worker-spawn latency on a loaded 1-vCPU box
+    # (measured >90 s under a full-suite run).
     ids = set()
-    deadline = time.time() + 90
+    deadline = time.time() + 150
     while len(ids) < 2 and time.time() < deadline:
         ids.add(h.whoami.remote().result(timeout=30))
-        if len(ids) < 2:
-            time.sleep(0.2)   # give the second replica time to start
     assert len(ids) == 2
 
 
