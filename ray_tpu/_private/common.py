@@ -154,6 +154,11 @@ class NodeInfo:
     labels: Dict[str, str] = field(default_factory=dict)
     alive: bool = True
     is_head: bool = False
+    # Two-phase removal (drain protocol): a draining node is still alive
+    # (running tasks finish, objects migrate off) but receives no new
+    # leases/actors/bundles; at drain_deadline it is marked dead.
+    draining: bool = False
+    drain_deadline: float = 0.0
     last_heartbeat: float = field(default_factory=time.time)
     # TPU topology: slice name / topology this host belongs to, if any.
     slice_id: str = ""
@@ -179,6 +184,10 @@ class ActorInfo:
     namespace: str = ""
     class_name: str = ""
     num_restarts: int = 0
+    # Restarts caused by planned node drains / preemptions: they bump
+    # num_restarts (the client-side seq epoch must advance) but are NOT
+    # charged against max_restarts. Budget = num_restarts - preempted_restarts.
+    preempted_restarts: int = 0
     max_restarts: int = 0
     death_cause: str = ""
     owner_address: str = ""

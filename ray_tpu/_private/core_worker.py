@@ -150,6 +150,10 @@ class ActorSubmitQueue:
         self.state = "PENDING"       # PENDING | ALIVE | RESTARTING | DEAD
         self.address = ""
         self.death_reason = ""
+        # Sticky marker: the most recent restart was drain/preemption
+        # caused. Push failures observed while set are retried WITHOUT
+        # consuming max_task_retries (planned loss charges no budgets).
+        self.preempted = False
         self.wakeup: List[asyncio.Future] = []
         # seq -> spec of tasks submitted but not yet acknowledged.
         self.inflight: Dict[int, TaskSpec] = {}
@@ -276,6 +280,16 @@ class CoreWorker:
         self._cancelled_tasks: set = set()
         self.generator_streams: Dict[TaskID, GeneratorStream] = {}
         self._task_events_buffer: List[dict] = []
+        # Drain/preemption awareness (nodes channel): raylet addresses that
+        # announced a drain, the event log (Train reads it to classify gang
+        # failures), and whether THIS process's node is draining (worker
+        # mode: feeds train.should_checkpoint / save-on-preempt).
+        self._draining_raylets: set = set()
+        self.drain_events: List[dict] = []
+        self.local_node_draining = False
+        # Lineage re-executions performed by this owner (drain acceptance
+        # tests assert the graceful path keeps this at zero).
+        self.reconstructions_total = 0
         self._shutdown = False
         self._bg_tasks: List[asyncio.Task] = []
         # Guards id/seq reservation + owned/pending registration so the
@@ -499,13 +513,71 @@ class CoreWorker:
                 q.set_state("ALIVE", info.address,
                             num_restarts=info.num_restarts)
             elif event == "restarting":
+                # Sticky until the NEXT (non-preempted) restart: push
+                # failures straggling in after the ALIVE event still
+                # classify as planned loss.
+                q.preempted = bool(msg.get("preempted"))
                 q.set_state("RESTARTING")
             elif event == "dead":
+                # Terminal death is never the drain's doing (migration
+                # restarts without charging, so a drained actor cannot
+                # exhaust its budget): a genuine crash after an earlier
+                # migration must not inherit the sticky preempted flag.
+                q.preempted = False
                 q.set_state("DEAD", reason=msg.get("reason", "actor died"))
                 self._actor_creation_pins.pop(q.actor_id, None)
-        elif channel == "nodes" and msg.get("event") == "dead":
-            # Trigger reconstruction checks for objects on that node lazily.
-            pass
+        elif channel == "nodes":
+            event = msg.get("event")
+            if event == "draining":
+                address = msg.get("address", "")
+                self.drain_events.append({
+                    "time": time.time(), "address": address,
+                    "node_id": msg.get("node_id"),
+                    "deadline": msg.get("deadline", 0.0)})
+                if address:
+                    self._draining_raylets.add(address)
+                    self._on_raylet_draining(address)
+                if self.node_id is not None \
+                        and msg.get("node_id") == self.node_id:
+                    # Our own host is going away: surface to the session
+                    # layer (Train save-on-preempt).
+                    self.local_node_draining = True
+            elif event == "dead":
+                # Reconstruction checks for objects on that node happen
+                # lazily (a failed fetch walks the location list itself).
+                # Prune the drained-address marker after a grace window:
+                # in-flight failures still classify as preemption, but a
+                # LATER raylet reusing the same host:port must not have
+                # its genuine crashes laundered into uncharged retries.
+                nid = msg.get("node_id")
+                stale = {ev["address"] for ev in self.drain_events
+                         if ev.get("node_id") == nid and ev.get("address")}
+                for addr in stale:
+                    self.loop.call_later(
+                        15.0, self._draining_raylets.discard, addr)
+
+    def _on_raylet_draining(self, address: str):
+        """Stop routing new tasks through leases on a draining node: drop
+        them from the lease tables (in-flight pushes still complete) and
+        hand idle ones back so the raylet can reach quiescence."""
+        for sched_class, leases in list(self.leases.items()):
+            for lease in list(leases):
+                if lease.raylet_address != address:
+                    continue
+                leases.remove(lease)
+                if lease.inflight == 0 and not lease.returning:
+                    lease.returning = True
+
+                    async def _ret(entry=lease):
+                        try:
+                            await self.clients.request(
+                                entry.raylet_address, "return_worker",
+                                {"worker_id": entry.worker_id}, timeout=5)
+                        except rpc.RpcError:
+                            pass
+                    asyncio.ensure_future(_ret())
+            if self._task_queue.get(sched_class):
+                self._schedule_pump(sched_class)
 
     # ==================================================================
     # Object API
@@ -1005,6 +1077,7 @@ class CoreWorker:
         if ent.reconstructions >= budget:
             return False
         ent.reconstructions += 1
+        self.reconstructions_total += 1
         logger.warning("reconstructing object %s by resubmitting task %s",
                        ent.object_id.hex()[:12], spec.name)
         ent.ready = False
@@ -1578,8 +1651,12 @@ class CoreWorker:
                     why = reply.get("why") or (
                         f"no node can satisfy resources "
                         f"{sample_spec.resources}")
-                    self._fail_queued_tasks(
-                        sched_class, exc.RayTpuSystemError(why))
+                    error: Exception = exc.RayTpuSystemError(why)
+                    if reply.get("drained"):
+                        # The only node that could host this work was
+                        # removed by a planned drain with no live peer.
+                        error = exc.NodeDrainedError(None, why)
+                    self._fail_queued_tasks(sched_class, error)
                     return
                 # retry
                 await asyncio.sleep(0.05)
@@ -1633,7 +1710,7 @@ class CoreWorker:
             lease.inflight -= 1
             self._drop_lease(sched_class, lease)
             for spec in specs:
-                self._handle_task_worker_death(spec)
+                self._handle_task_worker_death(spec, lease.raylet_address)
             return
         lease.inflight -= 1
         lease.last_used = time.time()
@@ -1688,16 +1765,31 @@ class CoreWorker:
         if lease in leases:
             leases.remove(lease)
 
-    def _handle_task_worker_death(self, spec: TaskSpec):
+    def _handle_task_worker_death(self, spec: TaskSpec,
+                                  raylet_address: str = ""):
         pt = self.pending_tasks.get(spec.task_id)
-        if pt is not None and pt.retries_left > 0:
+        preempted = raylet_address in self._draining_raylets
+        if pt is not None and preempted:
+            # Planned node loss (drain / spot reclaim): retry without
+            # consuming the task's max_retries budget — the user had no
+            # hand in this failure and the cluster had advance notice.
+            # DESIGN TRADEOFF: this applies even at max_retries=0, so a
+            # task that executed before its reply was lost to the drain
+            # runs again (at-least-once under preemption). Preemption
+            # survival is the contract here; tasks needing strict
+            # at-most-once must be idempotent on preemptible capacity.
+            logger.warning("task %s lost to draining node %s; retrying "
+                           "(budget uncharged)", spec.name, raylet_address)
+            asyncio.ensure_future(self._submit_to_cluster(spec))
+        elif pt is not None and pt.retries_left > 0:
             pt.retries_left -= 1
             logger.warning("task %s worker died; retrying (%d left)",
                            spec.name, pt.retries_left)
             asyncio.ensure_future(self._submit_to_cluster(spec))
         else:
             self._complete_task_error(spec, exc.WorkerCrashedError(
-                f"worker died while running task {spec.name}"), retry=False)
+                f"worker died while running task {spec.name}",
+                preempted=preempted), retry=False)
 
     def _handle_task_reply(self, spec: TaskSpec, reply: dict,
                            exec_raylet: str):
@@ -1708,7 +1800,7 @@ class CoreWorker:
         error = reply.get("system_error")
         if error is not None:
             logger.warning("task %s system error: %s", spec.name, error)
-            self._handle_task_worker_death(spec)
+            self._handle_task_worker_death(spec, exec_raylet)
             return
         app_error = reply.get("app_error")
         if app_error is not None:
@@ -2183,7 +2275,8 @@ class CoreWorker:
                         # left to keep contiguous — just drop the marker.
                         return
                     self._complete_task_error(
-                        spec, exc.ActorDiedError(q.actor_id, q.death_reason),
+                        spec, exc.ActorDiedError(q.actor_id, q.death_reason,
+                                                 preempted=q.preempted),
                         retry=False)
                     return
                 if q.state != "ALIVE":
@@ -2209,14 +2302,17 @@ class CoreWorker:
                     pt = self.pending_tasks.get(spec.task_id)
                     if pt is None:
                         return
-                    if pt.retries_left != 0:
-                        if pt.retries_left > 0:
+                    if q.preempted or pt.retries_left != 0:
+                        # Drain/preemption-caused restarts retry for free;
+                        # everything else consumes max_task_retries.
+                        if not q.preempted and pt.retries_left > 0:
                             pt.retries_left -= 1
                         await q.wait_for_change()
                         continue
                     self._complete_task_error(
                         spec, exc.ActorDiedError(
-                            q.actor_id, "actor worker died mid-call"),
+                            q.actor_id, "actor worker died mid-call",
+                            preempted=q.preempted),
                         retry=False)
                     return
                 if spec.method_name != SEQ_SKIP_METHOD:
@@ -2267,7 +2363,11 @@ class CoreWorker:
             if not fut.done():
                 fut.set_exception(err)
             return
-        if attempted:
+        # q.preempted relaxes at-most-once to at-least-once: a drained
+        # actor's in-flight calls re-push to the migrated instance even at
+        # max_task_retries=0 (same tradeoff as the plain-task path — the
+        # alternative is failing every preemption for at-most-once users).
+        if attempted and not q.preempted:
             pt = self.pending_tasks.get(spec.task_id)
             if pt is None:
                 q.inflight.pop(spec.seq_no, None)
@@ -2349,13 +2449,13 @@ class CoreWorker:
                     if pt is None:
                         q.inflight.pop(spec.seq_no, None)
                         continue
-                    if pt.retries_left == 0:
+                    if pt.retries_left == 0 and not q.preempted:
                         self._fail_and_fill_seq(q, spec, exc.ActorDiedError(
                             q.actor_id,
                             "reply lost for a batched actor call "
                             "(max_task_retries=0 forbids re-execution)"))
                         continue
-                    if pt.retries_left > 0:
+                    if pt.retries_left > 0 and not q.preempted:
                         pt.retries_left -= 1
                     repush.append((spec, fut))
                 if repush:

@@ -102,6 +102,10 @@ class GcsServer:
         self._autoscaler_seen = 0.0   # last get_autoscaler_state poll
         self._pg_lock = asyncio.Lock()
         self._actor_reschedule_lock = asyncio.Lock()
+        # Drain protocol state: futures resolved when a node goes dead, and
+        # the per-node deadline watchers.
+        self._drain_waiters: Dict[NodeID, List[asyncio.Future]] = {}
+        self._drain_tasks: Dict[NodeID, asyncio.Task] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._persist_task: Optional[asyncio.Task] = None
         self._dirty = False
@@ -127,6 +131,13 @@ class GcsServer:
         self.server.register_all(self)
         actual = await self.server.start(host, port)
         self.address = f"{host}:{actual}"
+        # Re-arm deadline watchers for nodes restored mid-drain: without
+        # this a DRAINING node would sit unschedulable forever after a GCS
+        # restart (its drain task died with the old process).
+        for node_id, info in self.nodes.items():
+            if info.alive and info.draining:
+                self._drain_tasks[node_id] = asyncio.ensure_future(
+                    self._drain_node_task(node_id, 0.0))
         self._health_task = asyncio.ensure_future(self._health_loop())
         if self.session_dir or self._ext_store is not None:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
@@ -135,6 +146,8 @@ class GcsServer:
         return self.address
 
     async def stop(self):
+        for task in self._drain_tasks.values():
+            task.cancel()
         if self._health_task:
             self._health_task.cancel()
         if self._persist_task:
@@ -234,15 +247,22 @@ class GcsServer:
             "total": info.resources_total,
             "address": info.address,
             "labels": info.labels,
+            "draining": info.draining,
         })
 
     def _resource_view(self) -> dict:
+        # Draining nodes are excluded: a freshly registered raylet must not
+        # learn a peer that is on its way out as a spillback target.
         return {
             n.node_id: {"available": n.resources_available,
                         "total": n.resources_total, "address": n.address,
                         "labels": n.labels}
-            for n in self.nodes.values() if n.alive
+            for n in self.nodes.values() if n.alive and not n.draining
         }
+
+    @staticmethod
+    def _schedulable(n: NodeInfo) -> bool:
+        return n.alive and not n.draining
 
     async def rpc_heartbeat(self, conn, payload):
         node_id = payload["node_id"]
@@ -337,6 +357,9 @@ class GcsServer:
         gauge("ray_tpu_nodes_alive",
               sum(1 for n in self.nodes.values() if n.alive),
               "alive raylets")
+        gauge("ray_tpu_nodes_draining",
+              sum(1 for n in self.nodes.values()
+                  if n.alive and n.draining), "draining raylets")
         for state in (ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING,
                       ACTOR_DEAD):
             gauge("ray_tpu_actors", sum(
@@ -383,6 +406,7 @@ class GcsServer:
             "nodes": [{
                 "node_id": n.node_id.hex(), "alive": n.alive,
                 "is_head": n.is_head, "address": n.address,
+                "draining": n.draining,
                 "resources_total": n.resources_total,
                 "resources_available": n.resources_available,
             } for n in self.nodes.values()],
@@ -524,6 +548,7 @@ class GcsServer:
                 n.node_id: {"total": n.resources_total,
                             "available": n.resources_available,
                             "alive": n.alive, "is_head": n.is_head,
+                            "draining": n.draining,
                             "labels": n.labels}
                 for n in self.nodes.values()},
             "pending_demand": demand,
@@ -533,16 +558,151 @@ class GcsServer:
     async def rpc_get_all_nodes(self, conn, payload):
         return list(self.nodes.values())
 
+    # ------------- drain protocol (planned node removal) -------------
+
     async def rpc_drain_node(self, conn, payload):
-        """Graceful removal (autoscaler downscale)."""
+        """Two-phase graceful removal (autoscaler downscale / preemption
+        notice). Reference: gcs_node_manager DrainNode + DrainNodeReply.
+
+        Phase 1 (immediately): the node stops receiving new leases, actor
+        placements, and PG bundles; the raylet is told to finish running
+        work and push its primary object copies to live peers; after a
+        short grace window (save-on-preempt hook for Train) its actors are
+        *migrated* — restarted elsewhere without charging max_restarts.
+        Phase 2 (at the deadline, or as soon as the raylet reports idle):
+        the node is marked dead.
+
+        payload: node_id | node_id_hex, deadline_s (default 30), grace_s
+        (default 0.5, actor-migration delay), wait (block until dead).
+        Idempotent: re-draining a draining node only re-arms `wait`.
+        """
         node_id = payload.get("node_id")
         if node_id is None and payload.get("node_id_hex"):
             node_id = next((n for n in self.nodes
                             if n.hex() == payload["node_id_hex"]), None)
-        if node_id is None:
+        info = self.nodes.get(node_id) if node_id is not None else None
+        if info is None:
             return False
-        await self._mark_node_dead(node_id, reason="drained")
+        if not info.alive:
+            return True
+        deadline_s = float(payload.get("deadline_s", 30.0))
+        grace_s = float(payload.get("grace_s", 0.5))
+        if not info.draining:
+            info.draining = True
+            info.drain_deadline = time.time() + deadline_s
+            self._mark_dirty()
+            logger.info("draining node %s (deadline in %.1fs)",
+                        node_id.hex()[:12], deadline_s)
+            self.pubsub.publish("nodes", {
+                "event": "draining", "node_id": node_id,
+                "address": info.address, "deadline": info.drain_deadline,
+                "reason": payload.get("reason", "drain requested")})
+            # Tell the raylet: reject new lease grants, let running tasks
+            # finish, push primary object copies to live nodes, report
+            # drain_complete when idle.
+            async def _notify_raylet():
+                try:
+                    await self.clients.request(
+                        info.address, "drain",
+                        {"deadline_s": deadline_s}, timeout=10.0)
+                except Exception:  # noqa: BLE001 — raylet may already be gone
+                    pass
+            asyncio.ensure_future(_notify_raylet())
+            self._drain_tasks[node_id] = asyncio.ensure_future(
+                self._drain_node_task(node_id, grace_s))
+        if payload.get("wait"):
+            # wait_timeout_s lets callers with their own RPC deadline (the
+            # autoscaler's sync bridge) bound the block below it.
+            await self._wait_node_dead(
+                node_id, float(payload.get("wait_timeout_s",
+                                           deadline_s + 10.0)))
         return True
+
+    async def _drain_node_task(self, node_id: NodeID, grace_s: float):
+        """Migration + deadline watcher for one draining node."""
+        info = self.nodes.get(node_id)
+        if info is None:
+            return
+        # Grace window: workers on the node see the `draining` pubsub and
+        # can act on it (Train's save-on-preempt checkpoint) before their
+        # actors are torn down.
+        if grace_s > 0:
+            await asyncio.sleep(min(grace_s,
+                                    max(0.0,
+                                        info.drain_deadline - time.time())))
+        if not info.alive:
+            return
+        # PG bundles on the node move first so PG-pinned actors have a live
+        # bundle to migrate onto.
+        for pg in list(self.placement_groups.values()):
+            if pg.state == PG_CREATED and node_id in pg.bundle_nodes.values():
+                await self._reschedule_pg(pg)
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (ACTOR_ALIVE,
+                                                            ACTOR_PENDING):
+                await self._migrate_actor(
+                    actor, f"node {node_id.hex()[:12]} draining")
+        # Wait out the rest of the deadline; the raylet's drain_complete
+        # normally beats this.
+        remaining = info.drain_deadline - time.time()
+        if remaining > 0:
+            await asyncio.sleep(remaining)
+        if info.alive:
+            await self._mark_node_dead(node_id, reason="drain deadline",
+                                       preempted=True)
+
+    async def rpc_drain_complete(self, conn, payload):
+        """Raylet-side report: running work finished / objects migrated —
+        the node can die before its deadline."""
+        node_id = payload["node_id"]
+        info = self.nodes.get(node_id)
+        if info is None or not info.draining:
+            return False
+        if info.alive:
+            await self._mark_node_dead(node_id, reason="drained (idle)",
+                                       preempted=True)
+        return True
+
+    async def _wait_node_dead(self, node_id: NodeID, timeout: float):
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._drain_waiters.setdefault(node_id, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            pass
+
+    async def _migrate_actor(self, actor: ActorInfo, reason: str):
+        """Restart an actor off a draining node WITHOUT charging its
+        max_restarts budget. num_restarts still advances (callers renumber
+        their seq stream per epoch); preempted_restarts records the credit.
+        """
+        async with self._actor_reschedule_lock:
+            if actor.state == ACTOR_DEAD:
+                return
+            old_address = actor.address
+            actor.num_restarts += 1
+            actor.preempted_restarts += 1
+            actor.state = ACTOR_RESTARTING
+            actor.address = ""
+            self._mark_dirty()
+            self.pubsub.publish("actors", {
+                "event": "restarting", "actor_id": actor.actor_id,
+                "actor_info": actor, "preempted": True})
+        # Let the restarting event fan out before the old instance dies so
+        # clients classify the RPC failures that follow as preemption.
+        await asyncio.sleep(0)
+        if old_address:
+            try:
+                await self.clients.request(
+                    old_address, "kill_actor",
+                    {"actor_id": actor.actor_id, "no_restart": False},
+                    timeout=5.0)
+            except Exception:  # noqa: BLE001 — worker may already be gone
+                pass
+        asyncio.ensure_future(self._schedule_actor(actor))
 
     async def _health_loop(self):
         cfg = self.config
@@ -553,25 +713,47 @@ class GcsServer:
                 if info.alive and now - info.last_heartbeat > cfg.node_death_timeout_s:
                     logger.warning("node %s missed heartbeats; marking dead",
                                    node_id.hex()[:12])
-                    await self._mark_node_dead(node_id, reason="heartbeat timeout")
+                    # A draining node that stops heartbeating was reclaimed
+                    # early (notice-then-kill race): still the planned-loss
+                    # path, so no budgets are charged.
+                    await self._mark_node_dead(node_id,
+                                               reason="heartbeat timeout",
+                                               preempted=info.draining)
 
-    async def _mark_node_dead(self, node_id: NodeID, reason: str):
+    async def _mark_node_dead(self, node_id: NodeID, reason: str,
+                              preempted: bool = False):
         info = self.nodes.get(node_id)
         if info is None or not info.alive:
             return
+        # A node that dies mid-drain is a planned loss however the death
+        # is reported (deadline watcher, raylet idle report, heartbeat
+        # timeout after the VM reclaim, or a test harness hard-stop):
+        # never charge budgets for it.
+        preempted = preempted or info.draining
         info.alive = False
         self.node_demand.pop(node_id, None)
+        task = self._drain_tasks.pop(node_id, None)
+        if task is not None and task is not asyncio.current_task():
+            task.cancel()
         self.pubsub.publish("nodes", {"event": "dead", "node_id": node_id,
                                       "reason": reason})
         self._mark_dirty()
-        # Fail over actors that lived on that node.
+        # Fail over actors that lived on that node. Planned loss (drain /
+        # preemption) migrates without charging max_restarts; crash failure
+        # charges as usual.
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (ACTOR_ALIVE, ACTOR_PENDING):
-                await self._handle_actor_failure(actor, f"node died: {reason}")
+                if preempted:
+                    await self._migrate_actor(actor, f"node drained: {reason}")
+                else:
+                    await self._handle_actor_failure(actor, f"node died: {reason}")
         # Release PG bundles on that node -> reschedule.
         for pg in self.placement_groups.values():
             if pg.state == PG_CREATED and node_id in pg.bundle_nodes.values():
                 asyncio.ensure_future(self._reschedule_pg(pg))
+        for fut in self._drain_waiters.pop(node_id, []):
+            if not fut.done():
+                fut.set_result(True)
 
     # ------------- resource view sync (RaySyncer equivalent) -------------
 
@@ -774,7 +956,8 @@ class GcsServer:
         """GCS-side node selection for actor creation (GcsActorScheduler)."""
         if scheduling is not None and scheduling.kind == "NODE_AFFINITY":
             node = self.nodes.get(scheduling.node_id)
-            if node is not None and node.alive and _fits(resources, node.resources_available):
+            if node is not None and self._schedulable(node) \
+                    and _fits(resources, node.resources_available):
                 return node
             if scheduling is not None and not scheduling.soft:
                 return None
@@ -785,9 +968,11 @@ class GcsServer:
             idx = scheduling.bundle_index if scheduling.bundle_index >= 0 else 0
             node_id = pg.bundle_nodes.get(idx)
             node = self.nodes.get(node_id)
-            return node if node is not None and node.alive else None
+            return node if node is not None and self._schedulable(node) \
+                else None
         candidates = [n for n in self.nodes.values()
-                      if n.alive and _fits(resources, n.resources_available)]
+                      if self._schedulable(n)
+                      and _fits(resources, n.resources_available)]
         if not candidates:
             return None
         # Hybrid: prefer most-utilized node under threshold (pack), else spread.
@@ -807,7 +992,10 @@ class GcsServer:
         async with self._actor_reschedule_lock:
             if actor.state == ACTOR_DEAD:
                 return
-            if actor.max_restarts == -1 or actor.num_restarts < actor.max_restarts:
+            # Budget excludes preemption-caused restarts (planned node
+            # loss must not consume max_restarts).
+            charged = actor.num_restarts - actor.preempted_restarts
+            if actor.max_restarts == -1 or charged < actor.max_restarts:
                 actor.num_restarts += 1
                 actor.state = ACTOR_RESTARTING
                 actor.address = ""
@@ -828,6 +1016,18 @@ class GcsServer:
         actor = self.actors.get(payload["actor_id"])
         if actor is None:
             return False
+        if actor.state == ACTOR_RESTARTING:
+            # Stale report about an instance the GCS already replaced (e.g.
+            # the old worker of a migrated/drained actor dying on cue):
+            # handling it would double-charge and double-schedule.
+            return True
+        wid = payload.get("worker_id")
+        if (wid is not None and actor.worker_id is not None
+                and wid != actor.worker_id):
+            # Report names a PREVIOUS instance's worker: migration already
+            # recreated the actor (warm-worker creation beats old-process
+            # exit detection) — acting on it would kill the live instance.
+            return True
         await self._handle_actor_failure(actor, payload.get("reason", "worker died"))
         return True
 
@@ -959,7 +1159,7 @@ class GcsServer:
         Reference semantics: bundle_scheduling_policy.h — STRICT_PACK all on
         one node; STRICT_SPREAD all on distinct nodes; PACK/SPREAD best-effort.
         """
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values() if self._schedulable(n)]
         if not alive:
             return None
         avail = {n.node_id: dict(n.resources_available) for n in alive}
@@ -1021,8 +1221,11 @@ class GcsServer:
 
     async def _reschedule_pg(self, pg: PlacementGroupInfo):
         pg.state = PG_PENDING
-        dead = {nid for nid, n in self.nodes.items() if not n.alive}
-        pg.bundle_nodes = {i: n for i, n in pg.bundle_nodes.items() if n not in dead}
+        # Bundles on dead AND draining nodes lose their placement; the
+        # re-placement below only considers schedulable nodes.
+        gone = {nid for nid, n in self.nodes.items()
+                if not self._schedulable(n)}
+        pg.bundle_nodes = {i: n for i, n in pg.bundle_nodes.items() if n not in gone}
         self.pubsub.publish("placement_groups", {"event": "rescheduling", "pg": pg})
         await self._schedule_pg(pg)
 

@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional
 from ray_tpu._private import rpc
 from ray_tpu._private.common import NodeInfo, TaskSpec
 from ray_tpu._private.config import Config
-from ray_tpu._private.ids import NodeID, PlacementGroupID, WorkerID
+from ray_tpu._private.ids import NodeID, ObjectID, PlacementGroupID, WorkerID
 from ray_tpu._private.object_store import ObjectStoreHost
 
 logger = logging.getLogger(__name__)
@@ -289,6 +289,11 @@ class Raylet:
         self._lease_conns: set = set()
         self._conn_owner: Dict[Any, str] = {}   # conn -> owner address
         self._autoscaler_active = False
+        # Drain protocol (planned removal): a draining raylet grants no new
+        # leases, lets running work finish until the deadline, and pushes
+        # its primary object copies to live peers.
+        self._draining = False
+        self._drain_deadline = 0.0
         self._spawned_worker_prefixes: set = set()
         self._starting_workers = 0
         self.gcs_conn: Optional[rpc.Connection] = None
@@ -489,7 +494,11 @@ class Raylet:
         channel = payload["channel"]
         msg = payload["message"]
         if channel == "resources":
-            if msg["node_id"] != self.node_id:
+            if msg.get("draining"):
+                # A draining peer must stop being a spillback/migration
+                # target.
+                self.cluster_view.pop(msg["node_id"], None)
+            elif msg["node_id"] != self.node_id:
                 self.cluster_view[msg["node_id"]] = {
                     "available": msg["available"], "total": msg["total"],
                     "address": msg.get("address", ""),
@@ -498,7 +507,7 @@ class Raylet:
                 # requests via spillback.
                 self._try_dispatch()
         elif channel == "nodes":
-            if msg["event"] == "dead":
+            if msg["event"] in ("dead", "draining"):
                 self.cluster_view.pop(msg.get("node_id"), None)
 
     # ------------------------------------------------------------------
@@ -649,6 +658,11 @@ class Raylet:
             try:
                 await self.gcs_conn.request("report_actor_failure", {
                     "actor_id": handle.actor_id,
+                    # The dying worker's id lets the GCS drop stale reports
+                    # about an instance it already replaced (migration can
+                    # recreate the actor faster than the old process exit
+                    # is detected).
+                    "worker_id": handle.worker_id,
                     "reason": f"worker process {handle.pid} died"})
             except rpc.RpcError:
                 pass
@@ -724,6 +738,8 @@ class Raylet:
         return env if env.get("container") else None
 
     def _ensure_worker_supply(self):
+        if self._draining:
+            return
         # Count only leases the pool could actually serve concurrently:
         # spawning workers for requests that can't get resources just burns
         # CPU on process startup (round-1 regression on small boxes).
@@ -801,6 +817,123 @@ class Raylet:
             pass
 
     # ------------------------------------------------------------------
+    # Drain protocol (planned removal)
+
+    async def rpc_drain(self, conn, payload):
+        """GCS -> raylet drain notice: stop granting leases, finish running
+        work up to the deadline, push primary object copies to live peers,
+        and report drain_complete once idle."""
+        if self._draining:
+            return True
+        self._draining = True
+        self._drain_deadline = time.time() + float(
+            payload.get("deadline_s", 30.0))
+        logger.info("raylet %s draining (deadline in %.1fs)",
+                    self.node_name, self._drain_deadline - time.time())
+        # Bounce queued lease requests: the submitter re-requests and the
+        # draining guard spills it to a live peer.
+        for _spec, _pg, fut, _c in self._pending_leases:
+            if not fut.done():
+                fut.set_result({"retry": True})
+        self._pending_leases.clear()
+        self._tasks.append(asyncio.ensure_future(self._drain_to_idle()))
+        return True
+
+    def _drain_spill_target(self, resources: Dict[str, float]):
+        """Best live peer for a lease rejected by the drain: available
+        capacity preferred, feasible-by-totals accepted."""
+        fallback = None
+        for _nid, view in self.cluster_view.items():
+            if not view.get("address"):
+                continue
+            if all(view.get("available", {}).get(k, 0) >= v
+                   for k, v in resources.items() if v > 0):
+                return view["address"]
+            if fallback is None and all(
+                    view.get("total", {}).get(k, 0) >= v
+                    for k, v in resources.items() if v > 0):
+                fallback = view["address"]
+        return fallback
+
+    async def _drain_to_idle(self):
+        """Background drain worker: migrate objects, wait for running work,
+        then tell the GCS this node is safe to kill."""
+        try:
+            await self._drain_push_objects()
+        except Exception:  # noqa: BLE001 — migration is best-effort
+            logger.exception("raylet %s object migration failed",
+                             self.node_name)
+        while (not self._stopped and time.time() < self._drain_deadline
+               and any(h.leased for h in self.workers.values())):
+            await asyncio.sleep(0.1)
+        if self._stopped:
+            return
+        try:
+            await self.gcs_conn.request("drain_complete",
+                                        {"node_id": self.node_id})
+        except rpc.RpcError:
+            pass
+
+    async def _drain_push_objects(self):
+        """Push sealed copies this node is the SOLE live holder of to a
+        live peer and register the new location with the object's owner,
+        so no owner ever needs lineage reconstruction for this
+        (about-to-die) node. Copies another live node already holds are
+        skipped — under a tight preemption deadline, re-copying cached
+        secondaries would crowd out the sole-copy primaries that actually
+        need saving."""
+        peers = [v["address"] for v in self.cluster_view.values()
+                 if v.get("address")]
+        if not peers:
+            return
+        peer_set = set(peers)
+        moved = 0
+        for oid in list(self.store.objects):
+            ent = self.store.objects.get(oid)
+            if ent is None or not self.store.contains(oid):
+                continue
+            if ent.owner_address:
+                try:
+                    info = await self.clients.request(
+                        ent.owner_address, "owner_locate",
+                        {"object_id": ObjectID(oid), "timeout": 0.5},
+                        timeout=2.0)
+                except (rpc.RpcError, OSError):
+                    info = None  # owner unreachable: assume sole copy
+                if isinstance(info, dict):
+                    if info.get("inline") is not None:
+                        continue  # owner holds the value inline: safe
+                    if any(loc in peer_set
+                           for loc in info.get("locations", [])):
+                        continue  # a live peer already has a copy
+            desc = self.store.pin(oid)
+            if desc is None:
+                continue
+            try:
+                _name, offset, size, metadata = desc
+                data = bytes(self.store.arena.view(offset, size))
+            finally:
+                self.store.unpin(oid)
+            target = peers[moved % len(peers)]
+            try:
+                await self.clients.request(target, "store_put_bytes", {
+                    "object_id": oid, "data": data, "metadata": metadata,
+                    "owner_address": ent.owner_address}, timeout=30.0)
+            except (rpc.RpcError, OSError):
+                continue
+            moved += 1
+            if ent.owner_address:
+                try:
+                    conn = await self.clients.get(ent.owner_address)
+                    await conn.notify("owner_add_location", {
+                        "object_id": ObjectID(oid), "location": target})
+                except Exception:  # noqa: BLE001 — owner may be gone
+                    pass
+        if moved:
+            logger.info("raylet %s migrated %d primary copies before drain",
+                        self.node_name, moved)
+
+    # ------------------------------------------------------------------
     # Lease protocol (normal tasks)
 
     async def rpc_request_worker_lease(self, conn, payload):
@@ -809,6 +942,20 @@ class Raylet:
         Reply: {"granted": {...}} | {"spillback": address} | {"infeasible": True}
         """
         spec: TaskSpec = payload["spec"]
+        if self._draining:
+            # Drain phase 1: no new grants here. Spill to a live peer when
+            # one could take the shape; otherwise ask the client to retry
+            # (it re-routes once the cluster view catches up). Past the
+            # deadline this node is as good as dead — fail fast so clients
+            # stop dialing it.
+            target = self._drain_spill_target(spec.resources)
+            if target is not None:
+                return {"spillback": target}
+            if time.time() > self._drain_deadline:
+                return {"infeasible": True, "drained": True,
+                        "why": (f"node {self.node_name} was drained and "
+                                "no live peer can take the lease")}
+            return {"retry": True, "draining": True}
         if self._container_env(spec) is not None:
             from ray_tpu._private import runtime_env_container as _rec
             if not _rec.runner_available():
@@ -1013,6 +1160,13 @@ class Raylet:
         self._try_dispatch()
 
     def _try_dispatch(self):
+        if self._draining:
+            # No grants during drain; bounce anything still queued.
+            for _spec, _pg, fut, _c in self._pending_leases:
+                if not fut.done():
+                    fut.set_result({"retry": True})
+            self._pending_leases.clear()
+            return
         if not self._pending_leases:
             return
         remaining = []
@@ -1142,6 +1296,10 @@ class Raylet:
 
     async def rpc_create_actor(self, conn, payload):
         spec: TaskSpec = payload["spec"]
+        if self._draining:
+            # The GCS already excludes draining nodes from placement; this
+            # covers the race where the pick happened pre-drain.
+            raise RuntimeError("node is draining; actor must go elsewhere")
         cenv = self._container_env(spec)
         if cenv is not None:
             from ray_tpu._private import runtime_env_container as _rec
@@ -1226,7 +1384,7 @@ class Raylet:
     def _prestart_workers(self):
         """Warm the pool so first leases don't wait on worker boot
         (reference: WorkerPool prestart, worker_pool.h)."""
-        if self._stopped:
+        if self._stopped or self._draining:
             return
         floor = min(int(self.pool.total.get("CPU", 1)), 4,
                     self.config.max_workers_per_node - len(self.workers))
@@ -1254,6 +1412,8 @@ class Raylet:
     # Placement group bundles
 
     async def rpc_reserve_bundle(self, conn, payload):
+        if self._draining:
+            return False
         key = (payload["pg_id"].binary(), payload["bundle_index"])
         ok = self.pool.reserve_bundle(key, payload["resources"])
         if ok:

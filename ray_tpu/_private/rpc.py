@@ -15,6 +15,7 @@ import asyncio
 import itertools
 import logging
 import pickle
+import random
 import struct
 import traceback
 from typing import Any, Awaitable, Callable, Dict, Optional
@@ -349,6 +350,17 @@ async def connect(address: str, push_handler: Optional[Callable] = None,
     return conn
 
 
+def backoff_delays(base: float = 0.1, cap: float = 2.0, rng=None):
+    """Infinite generator of reconnect delays: exponential growth capped at
+    `cap`, each sample jittered over [0.5x, 1.5x] so a fleet of clients
+    that lost the same peer at the same instant de-synchronizes."""
+    rng = rng or random.random
+    delay = base
+    while True:
+        yield delay * (0.5 + rng())
+        delay = min(delay * 2.0, cap)
+
+
 class ReconnectingConnection:
     """Client connection that redials the same address on loss.
 
@@ -383,6 +395,7 @@ class ReconnectingConnection:
             if self._conn is not None and not self._conn.closed:
                 return  # another caller already reconnected
             deadline = asyncio.get_running_loop().time() + self.retry_window_s
+            delays = backoff_delays()
             while not self._closed:
                 try:
                     conn = await connect(self.address, self.push_handler,
@@ -395,7 +408,10 @@ class ReconnectingConnection:
                     if asyncio.get_running_loop().time() > deadline:
                         raise ConnectionLost(
                             f"reconnect to {self.address} failed: {e}")
-                    await asyncio.sleep(0.3)
+                    # Exponential backoff with jitter: a dead GCS address
+                    # must not be hammered by every client in lockstep for
+                    # the whole retry window (thundering redials).
+                    await asyncio.sleep(next(delays))
             raise ConnectionLost("channel closed")
 
     async def request(self, method: str, payload: Any = None,

@@ -305,8 +305,24 @@ def nodes() -> List[dict]:
     return [{
         "NodeID": n.node_id.hex(), "Alive": n.alive, "Address": n.address,
         "Resources": n.resources_total, "Labels": n.labels,
-        "IsHead": n.is_head,
+        "IsHead": n.is_head, "Draining": getattr(n, "draining", False),
     } for n in infos]
+
+
+def drain_events() -> List[dict]:
+    """Drain/preemption notices observed by this process's core worker
+    ({"time", "node_id", "address", "deadline"} per event). Train uses
+    this to classify gang failures as planned (uncharged) losses."""
+    core = _worker_core.core or _state.core
+    return list(core.drain_events) if core is not None else []
+
+
+def local_node_draining() -> bool:
+    """True inside a process whose hosting node received a drain notice
+    (spot reclaim / downscale). The save-on-preempt hook: a training loop
+    should checkpoint now — this host is going away."""
+    core = _worker_core.core or _state.core
+    return bool(core is not None and core.local_node_draining)
 
 
 def cluster_resources() -> Dict[str, float]:

@@ -46,6 +46,14 @@ class AutoscalerConfig:
     idle_timeout_s: float = 60.0
     max_launch_batch: int = 8
     update_interval_s: float = 5.0
+    # Deadline handed to the GCS DrainNode when removing an idle node:
+    # idle nodes report drain-complete almost immediately, the deadline
+    # only bounds the wait.
+    drain_deadline_s: float = 15.0
+    # Deadline when reacting to a provider preemption notice (spot/
+    # preemptible reclaim): the cloud gives ~30s of warning, so object
+    # migration + actor moves must fit inside it.
+    preempt_deadline_s: float = 10.0
 
     @staticmethod
     def from_dict(d: dict) -> "AutoscalerConfig":
@@ -60,7 +68,9 @@ class AutoscalerConfig:
             node_types=types,
             idle_timeout_s=float(d.get("idle_timeout_s", 60.0)),
             max_launch_batch=int(d.get("max_launch_batch", 8)),
-            update_interval_s=float(d.get("update_interval_s", 5.0)))
+            update_interval_s=float(d.get("update_interval_s", 5.0)),
+            drain_deadline_s=float(d.get("drain_deadline_s", 15.0)),
+            preempt_deadline_s=float(d.get("preempt_deadline_s", 10.0)))
 
 
 def node_is_idle(info: dict) -> bool:
@@ -105,6 +115,9 @@ class StandardAutoscaler:
         # Slice gangs: provider node id -> tuple of all ids launched in the
         # same create_node gang (slice_hosts > 1 scales whole slices).
         self._gang_of: Dict[str, tuple] = {}
+        # Provider nodes whose preemption notice already triggered a drain;
+        # terminated (reaped) once the GCS no longer reports them alive.
+        self._preempt_draining: Dict[str, float] = {}   # pid -> drain ts
 
     # ---------------- slice (gang) accounting ----------------
 
@@ -138,6 +151,33 @@ class StandardAutoscaler:
     def _demand_shapes(self, state: dict) -> List[Dict[str, float]]:
         return demand_shapes(state)
 
+    def _correlate(self, state: dict):
+        """Provider-node ↔ GCS-node correlation, shared by every consumer
+        of one reconcile pass. Returns (alive_by_hex, gcs_hex_of):
+        alive_by_hex maps every known GCS node hex to its alive flag;
+        gcs_hex_of(pid, tags=None) resolves a provider node id through
+        either channel — the provider's own node_id tag (local providers)
+        or the ray_tpu.io/provider-id label cloud nodes register with
+        (the cloud API never sees GCS ids)."""
+        alive_by_hex: Dict[str, bool] = {}
+        hex_by_provider: Dict[str, str] = {}
+        for nid, info in state.get("nodes", {}).items():
+            h = nid.hex() if hasattr(nid, "hex") else str(nid)
+            alive_by_hex[h] = bool(info.get("alive"))
+            p = (info.get("labels") or {}).get("ray_tpu.io/provider-id")
+            if p:
+                hex_by_provider[p] = h
+
+        def gcs_hex_of(pid: str, tags: Optional[Dict[str, str]] = None) -> str:
+            if tags is None:
+                tags = self.provider.node_tags(pid)
+            nid = tags.get("node_id", "")
+            if nid in alive_by_hex:
+                return nid
+            return hex_by_provider.get(pid, "")
+
+        return alive_by_hex, gcs_hex_of
+
     def update(self) -> dict:
         """One reconcile pass; returns {launched: {type: n}, terminated: [...]}.
         """
@@ -145,30 +185,18 @@ class StandardAutoscaler:
         self._last_state = state
         launched: Dict[str, int] = {}
         terminated: List[str] = []
+        terminated.extend(self._handle_preemption_notices(state))
 
         # ---- supply view: available capacity per alive node ----
         # Each entry: {"cap": resources, "exclusive_taken": bool}.
-        gcs_node_ids = {nid.hex() if hasattr(nid, "hex") else str(nid)
-                        for nid in state["nodes"]}
-        # Cloud providers can't know GCS node ids (the cloud API never
-        # sees them): nodes register with a ray_tpu.io/provider-id label
-        # (TPUPodProvider startup script) and correlate through it.
-        gcs_hex_by_provider: Dict[str, str] = {}
-        for nid, info in state["nodes"].items():
-            p = (info.get("labels") or {}).get("ray_tpu.io/provider-id")
-            if p:
-                gcs_hex_by_provider[p] = (
-                    nid.hex() if hasattr(nid, "hex") else str(nid))
-
-        def gcs_hex_of(pid: str, tags: Dict[str, str]) -> str:
-            nid = tags.get("node_id", "")
-            if nid in gcs_node_ids:
-                return nid
-            return gcs_hex_by_provider.get(pid, "")
-
+        _known, gcs_hex_of = self._correlate(state)
+        # Draining nodes are NOT supply: the GCS refuses them new work, so
+        # counting their free capacity would suppress the replacement
+        # launch for exactly the demand their drain displaces.
         bins: List[dict] = [
             {"cap": dict(n["available"]), "exclusive_taken": False}
-            for n in state["nodes"].values() if n["alive"]]
+            for n in state["nodes"].values()
+            if n["alive"] and not n.get("draining")]
         # Nodes the provider launched that haven't registered with the GCS
         # yet (startup race): count their full declared shape so a second
         # update() pass doesn't double-launch.
@@ -259,11 +287,79 @@ class StandardAutoscaler:
             if (now - first >= self.config.idle_timeout_s and t is not None
                     and self._slices_of_type(t.name, t) > t.min_workers):
                 logger.info("autoscaler: terminating idle slice %s", pids)
+                # Two-phase removal: drain with a deadline and wait for
+                # the GCS to mark the nodes dead (idle nodes report
+                # drain-complete immediately) BEFORE reclaiming the VMs —
+                # terminating first would turn a planned removal into a
+                # crash for any straggler work. Drains for the whole slice
+                # are issued fire-and-forget and only the LAST carries the
+                # (bounded, well under make_gcs_request's 30s bridge)
+                # wait, so a 16-host gang pays one wait, not 16; one state
+                # fetch then confirms which hosts actually died. Hosts
+                # still alive defer to the preemption-reap path instead of
+                # being killed busy (or leaking on a bridge TimeoutError).
+                nid_of = {pid: gcs_hex_of(pid, self.provider.node_tags(pid))
+                          for pid in pids}
+                for i, pid in enumerate(pids):
+                    last = i == len(pids) - 1
+                    self.gcs_request("drain_node", {
+                        "node_id_hex": nid_of[pid],
+                        "deadline_s": self.config.drain_deadline_s,
+                        "grace_s": 0.0, "wait": last, "wait_timeout_s": 15.0,
+                        "reason": "autoscaler downscale (idle)"})
+                post = self.gcs_request("get_autoscaler_state", {})
+                alive_hexes = {
+                    (k.hex() if hasattr(k, "hex") else str(k))
+                    for k, n in post.get("nodes", {}).items()
+                    if n.get("alive")}
                 for pid in pids:
-                    nid = gcs_hex_of(pid, self.provider.node_tags(pid))
-                    self.gcs_request("drain_node", {"node_id_hex": nid})
+                    if nid_of[pid] in alive_hexes:
+                        self._preempt_draining[pid] = time.time()
+                        continue
                     self.provider.terminate_node(pid)
                     self._gang_of.pop(pid, None)
                     terminated.append(pid)
                 self._idle_since.pop(key, None)
         return {"launched": launched, "terminated": terminated}
+
+    # ---------------- preemption notices ----------------
+
+    def _handle_preemption_notices(self, state: dict) -> List[str]:
+        """Poll the provider's preemption-notice source (GCE spot reclaim
+        warnings, test hooks) and convert each notice into a drain with a
+        tight deadline; reap the provider node once the GCS reports it
+        gone. Returns the provider ids reaped this pass."""
+        reaped: List[str] = []
+        try:
+            notices = self.provider.preemption_notices()
+        except Exception:  # noqa: BLE001 — a flaky notice poll must not
+            logger.exception("preemption notice poll failed")  # stop scaling
+            notices = []
+        alive_by_hex, gcs_hex_of = self._correlate(state)
+
+        for pid in notices:
+            if pid in self._preempt_draining:
+                continue
+            nid = gcs_hex_of(pid)
+            if not nid:
+                continue
+            logger.warning("autoscaler: preemption notice for %s "
+                           "(gcs node %s); draining", pid, nid[:12])
+            self.gcs_request("drain_node", {
+                "node_id_hex": nid,
+                "deadline_s": self.config.preempt_deadline_s,
+                "reason": "preemption notice"})
+            self._preempt_draining[pid] = time.time()
+        for pid in list(self._preempt_draining):
+            gone_from_provider = pid not in self.provider.non_terminated_nodes()
+            nid = gcs_hex_of(pid)
+            if gone_from_provider or (nid and not alive_by_hex.get(nid, True)):
+                if not gone_from_provider:
+                    try:
+                        self.provider.terminate_node(pid)
+                    except Exception:  # noqa: BLE001 — cloud reclaimed it
+                        pass
+                self._gang_of.pop(pid, None)
+                self._preempt_draining.pop(pid, None)
+                reaped.append(pid)
+        return reaped

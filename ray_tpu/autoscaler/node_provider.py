@@ -39,6 +39,14 @@ class NodeProvider:
     def internal_ip(self, provider_node_id: str) -> str:
         return ""
 
+    def preemption_notices(self) -> List[str]:
+        """Provider node ids facing imminent reclamation (spot/preemptible
+        capacity). The autoscaler polls this each reconcile pass and
+        converts notices into GCS drains with a tight deadline, so the
+        planned-loss path (object migration, uncharged actor restarts)
+        runs inside the cloud's warning window. Default: none."""
+        return []
+
 
 class FakeMultiNodeProvider(NodeProvider):
     """Launches in-process raylets against a live GCS — the test provider.
@@ -57,6 +65,7 @@ class FakeMultiNodeProvider(NodeProvider):
         self.loop = loop
         self._nodes: Dict[str, object] = {}     # provider id -> Raylet
         self._tags: Dict[str, Dict[str, str]] = {}
+        self._preempt_announced: List[str] = []
 
     def _run(self, coro):
         if self.loop is None:
@@ -117,6 +126,14 @@ class FakeMultiNodeProvider(NodeProvider):
 
     def node_id_of(self, provider_node_id: str) -> str:
         return self._tags.get(provider_node_id, {}).get("node_id", "")
+
+    def announce_preemption(self, provider_node_id: str):
+        """Test hook: fake a cloud preemption notice for this node."""
+        if provider_node_id not in self._preempt_announced:
+            self._preempt_announced.append(provider_node_id)
+
+    def preemption_notices(self) -> List[str]:
+        return [p for p in self._preempt_announced if p in self._nodes]
 
 
 class TPUPodProvider(NodeProvider):
@@ -348,6 +365,32 @@ class TPUPodProvider(NodeProvider):
     def internal_ip(self, provider_node_id: str) -> str:
         eps = self._get_node(provider_node_id).get("networkEndpoints") or []
         return eps[0].get("ipAddress", "") if eps else ""
+
+    def preemption_notices(self) -> List[str]:
+        """Preemption-notice source for GCE preemptible/spot TPU capacity.
+
+        Two channels, both polled by the autoscaler's reconcile pass:
+        - the TPU API node state: a node the control plane already flagged
+          (PREEMPTED / TERMINATED while we still track it) is reported so
+          the drain at least runs the uncharged-recovery bookkeeping;
+        - an injectable ``preemption_hook() -> [provider_node_id]`` in
+          provider_config — in production a sidecar watching each VM's
+          metadata server preemption endpoint; in tests a plain closure.
+        """
+        out: List[str] = []
+        hook = self.provider_config.get("preemption_hook")
+        if callable(hook):
+            try:
+                out.extend(hook())
+            except Exception:  # noqa: BLE001 — a bad hook must not
+                pass           # break the reconcile loop
+        for n in self._list_nodes():
+            if (n.get("labels", {}).get("ray-cluster") == self.cluster_name
+                    and n.get("state") in ("PREEMPTED", "TERMINATED")):
+                pid = self._short_id(n)
+                if pid not in out:
+                    out.append(pid)
+        return out
 
 
 class K8sPodProvider(NodeProvider):

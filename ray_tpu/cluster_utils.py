@@ -69,15 +69,41 @@ class Cluster:
 
     def remove_node(self, raylet: Raylet, graceful: bool = False):
         """Kill a node (ungraceful: simulates node failure)."""
+        if graceful:
+            # Full two-phase drain with a short deadline, then tear down.
+            self.drain_node(raylet, deadline_s=5.0, grace_s=0.1, wait=True)
+            return
+
         async def _remove():
-            if graceful:
-                await self.gcs.rpc_drain_node(None, {"node_id": raylet.node_id})
             await raylet.stop()
-            if not graceful:
-                # Let the health checker notice, or force-mark dead now.
-                await self.gcs._mark_node_dead(raylet.node_id, "node removed")
+            # Let the health checker notice, or force-mark dead now.
+            await self.gcs._mark_node_dead(raylet.node_id, "node removed")
         self._run(_remove())
         self.raylets.remove(raylet)
+
+    def drain_node(self, raylet: Raylet, deadline_s: float = 5.0,
+                   grace_s: float = 0.5, wait: bool = True):
+        """Two-phase graceful drain (test API for the drain protocol).
+
+        Issues DrainNode on the GCS: the node stops taking new work, its
+        primary object copies migrate to live peers, its actors restart
+        elsewhere without charging max_restarts, and it is marked dead at
+        the deadline (or as soon as it reports idle). wait=True blocks
+        until the node is dead and then stops the raylet; wait=False
+        returns right after the notice (the notice-then-kill race is the
+        caller's to script — see util.chaos.PreemptionKiller).
+        """
+        async def _drain():
+            await self.gcs.rpc_drain_node(None, {
+                "node_id": raylet.node_id, "deadline_s": deadline_s,
+                "grace_s": grace_s, "wait": wait})
+        self._run(_drain(), timeout=deadline_s + 30)
+        if wait:
+            async def _stop():
+                await raylet.stop()
+            self._run(_stop())
+            if raylet in self.raylets:
+                self.raylets.remove(raylet)
 
     def restart_gcs(self):
         """Kill the GCS process-equivalent and restart it on the SAME

@@ -41,7 +41,26 @@ class TaskError(RayTpuError):
 
 
 class WorkerCrashedError(RayTpuError):
-    """The worker process executing the task died unexpectedly."""
+    """The worker process executing the task died unexpectedly.
+
+    ``preempted`` marks deaths caused by a planned node drain (autoscaler
+    downscale / spot reclaim): such failures are retried without charging
+    the task's ``max_retries`` budget.
+    """
+
+    def __init__(self, *args, preempted: bool = False):
+        self.preempted = preempted
+        super().__init__(*args)
+
+    def __reduce__(self):
+        # Keep the preempted flag across pickling (task errors ship
+        # serialized inside return objects; the default reduction replays
+        # only self.args).
+        return (_rebuild_worker_crashed, (self.args, self.preempted))
+
+
+def _rebuild_worker_crashed(args, preempted):
+    return WorkerCrashedError(*args, preempted=preempted)
 
 
 class ActorError(RayTpuError):
@@ -49,10 +68,17 @@ class ActorError(RayTpuError):
 
 
 class ActorDiedError(ActorError):
-    def __init__(self, actor_id=None, reason: str = "actor died"):
+    def __init__(self, actor_id=None, reason: str = "actor died",
+                 preempted: bool = False):
         self.actor_id = actor_id
         self.reason = reason
+        self.preempted = preempted
         super().__init__(f"Actor {actor_id} is dead: {reason}")
+
+    def __reduce__(self):
+        # Rebuild from the real fields: the default reduction would replay
+        # the formatted message into actor_id and drop preempted.
+        return (ActorDiedError, (self.actor_id, self.reason, self.preempted))
 
 
 class ActorUnavailableError(ActorError):
@@ -86,6 +112,23 @@ class GetTimeoutError(RayTpuError, TimeoutError):
 
 class NodeDiedError(RayTpuError):
     pass
+
+
+class NodeDrainedError(RayTpuError):
+    """Work was lost to a *planned* node removal (two-phase drain).
+
+    Raised only when the graceful path cannot absorb the loss (e.g. tasks
+    queued on a draining node with no feasible peer); drain-caused retries
+    themselves never charge the user's retry budgets.
+    """
+
+    def __init__(self, node_id=None, reason: str = "node drained"):
+        self.node_id = node_id
+        self.reason = reason
+        super().__init__(f"Node {node_id} drained: {reason}")
+
+    def __reduce__(self):
+        return (NodeDrainedError, (self.node_id, self.reason))
 
 
 class RuntimeEnvSetupError(RayTpuError):

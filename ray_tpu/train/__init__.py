@@ -11,7 +11,8 @@ from ray_tpu.train.checkpoint import (Checkpoint, load_pytree,
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
                                   ScalingConfig)
 from ray_tpu.train.session import (get_checkpoint, get_context,
-                                   get_dataset_shard, report, TrainContext)
+                                   get_dataset_shard, report,
+                                   should_checkpoint, TrainContext)
 from ray_tpu.train.train_step import (TrainState, init_train_state,
                                       make_eval_step, make_train_step)
 from ray_tpu.train.trainer import JaxTrainer, Result
@@ -32,6 +33,7 @@ __all__ = [
     "Checkpoint", "save_pytree", "load_pytree", "new_checkpoint_dir",
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "report", "get_checkpoint", "get_context", "get_dataset_shard",
+    "should_checkpoint",
     "TrainContext", "TrainState", "init_train_state", "make_train_step",
     "make_eval_step", "JaxTrainer", "Result", "BackendConfig",
     "JaxBackendConfig", "BackendExecutor", "WorkerGroup",

@@ -88,7 +88,13 @@ class JaxBackendConfig(BackendConfig):
 
 
 class TrainingFailedError(RuntimeError):
-    pass
+    """A training attempt failed. ``preempted`` marks attempts lost to a
+    planned node drain / spot reclaim: JaxTrainer retries those without
+    charging FailureConfig.max_failures (unless fail_on_preemption)."""
+
+    def __init__(self, *args, preempted: bool = False):
+        self.preempted = preempted
+        super().__init__(*args)
 
 
 class BackendExecutor:
@@ -106,11 +112,48 @@ class BackendExecutor:
         self.world_size = scaling.num_workers
 
     def start(self):
+        self._started_at = time.time()
+        self._save_pushed = False
         self.worker_group = WorkerGroup(
             self.scaling.num_workers, self.scaling.worker_resources(),
             self.scaling.placement_strategy)
         self.node_info_per_worker = self.worker_group.node_infos()
         self.backend.on_start(self)
+
+    def _preempted_since_start(self) -> bool:
+        """Did a node HOSTING THIS GANG receive a drain/preemption notice
+        after this attempt started? Gang failures observed afterwards
+        classify as planned loss (the SPMD gang co-fails with its slowest
+        host, so a single drained host explains the whole restart).
+        Events for unrelated nodes (routine downscales elsewhere) must
+        not launder genuine crashes into uncharged retries."""
+        from ray_tpu._private import worker_api
+        try:
+            events = worker_api.drain_events()
+        except Exception:  # noqa: BLE001 — not connected (unit tests)
+            return False
+        start = getattr(self, "_started_at", 0.0)
+        gang_nodes = {i.get("node_id", "") for i in self.node_info_per_worker}
+        gang_nodes.discard("")
+        for ev in events:
+            if ev.get("time", 0.0) < start:
+                continue
+            nid = ev.get("node_id")
+            ev_hex = nid.hex() if hasattr(nid, "hex") else str(nid or "")
+            # Unknown gang placement (old workers without node_id): keep
+            # the permissive classification rather than charging a
+            # possibly-planned loss.
+            if not gang_nodes or ev_hex in gang_nodes:
+                return True
+        return False
+
+    def request_save(self):
+        """Best-effort save-on-preempt push to every gang worker."""
+        for w in self.worker_group.workers if self.worker_group else []:
+            try:
+                w.request_save.remote()
+            except Exception:  # noqa: BLE001 — worker may be mid-restart
+                pass
 
     def _contexts(self) -> List[TrainContext]:
         """Global rank = position; local rank = index within its node
@@ -154,6 +197,13 @@ class BackendExecutor:
         results: List[Optional[dict]] = [None] * len(self.worker_group.workers)
         pending = set(range(len(results)))
         finished: Dict[int, dict] = {}
+        # Driver-side save-on-preempt push: if a gang node's drain notice
+        # reached the driver (it may land here before the workers see
+        # their own pubsub), tell every worker to checkpoint on its next
+        # report. Belt to the worker-side should_checkpoint() braces.
+        if not self._save_pushed and self._preempted_since_start():
+            self._save_pushed = True
+            self.request_save()
         while pending:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -161,12 +211,21 @@ class BackendExecutor:
             refs = {i: self.worker_group.workers[i].poll.remote(
                 min(5.0, remaining)) for i in pending}
             for i, ref in refs.items():
-                out = ray_tpu.get(ref, timeout=30)
+                try:
+                    out = ray_tpu.get(ref, timeout=30)
+                except Exception as e:  # noqa: BLE001 — gang worker lost
+                    self._interrupt()
+                    raise TrainingFailedError(
+                        f"{type(e).__name__}: {e}",
+                        preempted=(getattr(e, "preempted", False)
+                                   or self._preempted_since_start()))
                 if out is None:
                     continue
                 if out["type"] == "error":
                     self._interrupt()
-                    raise TrainingFailedError(out["error"])
+                    raise TrainingFailedError(
+                        out["error"],
+                        preempted=self._preempted_since_start())
                 if out["type"] == "done":
                     finished[i] = out
                     pending.discard(i)

@@ -48,9 +48,17 @@ class ScalingConfig:
 @dataclass
 class FailureConfig:
     """max_failures: retries of the whole training run (gang restart —
-    SPMD co-failure means one worker loss restarts the mesh)."""
+    SPMD co-failure means one worker loss restarts the mesh).
+
+    fail_on_preemption: False (default) means gang restarts caused by a
+    *planned* node loss — autoscaler drain or spot/preemptible reclaim —
+    do NOT count against max_failures: the run restarts from the
+    save-on-preempt checkpoint for free. Set True to charge them like any
+    other failure (the pre-drain-protocol behavior).
+    """
 
     max_failures: int = 0
+    fail_on_preemption: bool = False
 
 
 @dataclass

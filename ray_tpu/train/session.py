@@ -69,13 +69,36 @@ class _Session:
         self.datasets = datasets or {}
         self._results: "queue.Queue" = queue.Queue(maxsize=1)
         self._stop = threading.Event()
+        # Save-on-preempt: set by TrainWorker.request_save (driver push) or
+        # implied by a drain notice for this worker's node; cleared when a
+        # checkpoint is reported.
+        self._save_requested = threading.Event()
 
     # -- called from the user train fn (executor thread) --
+
+    def should_checkpoint(self) -> bool:
+        """True when the training loop should save NOW: this worker's host
+        received a drain/preemption notice (or the driver requested an
+        immediate save). A loop that checkpoints every N steps should also
+        checkpoint when this flips, so the post-preemption restart resumes
+        from the current step instead of the last periodic save."""
+        if self._save_requested.is_set():
+            return True
+        try:
+            from ray_tpu._private import worker_api
+            return worker_api.local_node_draining()
+        except Exception:  # noqa: BLE001 — outside a worker process
+            return False
+
+    def request_save(self):
+        self._save_requested.set()
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None):
         if self._stop.is_set():
             raise _StopTraining()
+        if checkpoint is not None:
+            self._save_requested.clear()
         self._results.put({"type": "report", "metrics": dict(metrics),
                            "checkpoint": checkpoint,
                            "rank": self.context.world_rank})
@@ -133,6 +156,15 @@ def get_checkpoint() -> Optional[Checkpoint]:
     if _session is None:
         return None
     return _session.starting_checkpoint
+
+
+def should_checkpoint() -> bool:
+    """Save-on-preempt hook: True when this worker's node is being drained
+    (spot reclaim / downscale) and the loop should checkpoint immediately.
+    Always False outside a train worker."""
+    if _session is None:
+        return False
+    return _session.should_checkpoint()
 
 
 def get_dataset_shard(name: str = "train"):

@@ -136,10 +136,20 @@ class JaxTrainer:
                 per_worker[i][name] = shards[i]
         return per_worker
 
+    # Backstop for pathological clusters that preempt every single attempt:
+    # uncharged (preemption) retries are not infinite in practice.
+    _MAX_UNCHARGED_ATTEMPTS = 50
+
+    @staticmethod
+    def _failure_cause_class(err: str) -> str:
+        """Best-effort failure *cause class* from a remote traceback string
+        (the last line of a formatted traceback is 'Class: message')."""
+        last = err.strip().splitlines()[-1] if err and err.strip() else ""
+        head = last.split(":", 1)[0].strip()
+        return head if head and " " not in head else "unknown"
+
     def fit(self) -> Result:
         failure = self.run_config.failure_config
-        attempts = max(1, 1 + failure.max_failures) \
-            if failure.max_failures >= 0 else 10 ** 9
         book = _CheckpointBook(self.run_config.checkpoint_config)
         rows: List[Dict[str, Any]] = []
         start_ckpt = self.resume_from_checkpoint
@@ -148,12 +158,15 @@ class JaxTrainer:
                                 self.run_config.name)
         os.makedirs(exp_path, exist_ok=True)
 
-        for attempt in range(attempts):
+        attempt = 0
+        charged = 0   # failures counted against FailureConfig.max_failures
+        while True:
+            attempt += 1
             executor = BackendExecutor(
                 self.scaling, self.backend_config,
                 experiment_name=self.run_config.name,
                 storage_path=self.run_config.storage_path,
-                trial_id=f"attempt_{attempt}")
+                trial_id=f"attempt_{attempt - 1}")
             try:
                 executor.start()
                 executor.start_training(
@@ -175,9 +188,27 @@ class JaxTrainer:
                 break
             except TrainingFailedError as e:
                 err = str(e)
-                logger.warning("training attempt %d failed: %s",
-                               attempt, err.splitlines()[-1] if err else "")
-                if attempt + 1 >= attempts:
+                preempted = getattr(e, "preempted", False)
+                charge = failure.fail_on_preemption or not preempted
+                if charge:
+                    charged += 1
+                logger.warning(
+                    "training attempt %d failed (cause=%s, %s; "
+                    "%d/%s failures charged): %s",
+                    attempt, self._failure_cause_class(err),
+                    "charged" if charge
+                    else "uncharged: preemption/drain",
+                    charged,
+                    failure.max_failures if failure.max_failures >= 0
+                    else "inf",
+                    err.splitlines()[-1] if err else "")
+                out_of_budget = (failure.max_failures >= 0
+                                 and charged > failure.max_failures)
+                # The backstop bounds only UNCHARGED (preemption) retries;
+                # charged attempts are governed solely by max_failures
+                # (max_failures=-1 keeps its effectively-infinite budget).
+                if out_of_budget \
+                        or attempt - charged >= self._MAX_UNCHARGED_ATTEMPTS:
                     break
             finally:
                 executor.shutdown()

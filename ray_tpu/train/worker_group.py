@@ -35,7 +35,8 @@ class TrainWorker:
     def node_info(self) -> Dict[str, Any]:
         import os
         return {"hostname": socket.gethostname(), "pid": os.getpid(),
-                "ip": "127.0.0.1"}
+                "ip": "127.0.0.1",
+                "node_id": os.environ.get("RAY_TPU_NODE_ID", "")}
 
     def set_env(self, env: Dict[str, str]) -> None:
         import os
@@ -77,6 +78,12 @@ class TrainWorker:
     def interrupt(self) -> None:
         if self._session is not None:
             self._session.stop()
+
+    def request_save(self) -> None:
+        """Driver-side save-on-preempt push: the next report should carry
+        a checkpoint (session.should_checkpoint() flips true)."""
+        if self._session is not None:
+            self._session.request_save()
 
     def execute(self, fn_bytes: bytes, *args, **kwargs):
         """Run an arbitrary fn inline on the worker (setup/teardown path)."""

@@ -107,6 +107,84 @@ class NodeKiller(_KillerBase):
                                         "object_store_memory")})
 
 
+class NodeDrainer(_KillerBase):
+    """Issues graceful two-phase drains with a deadline against random
+    non-head nodes (the planned-loss analogue of NodeKiller). The
+    workload's drain machinery — object migration, uncharged actor
+    migration, lease re-routing — must absorb each drain with zero
+    lineage reconstructions and zero retry-budget consumption.
+
+    kill_at_deadline=True simulates the cloud actually reclaiming the VM:
+    the drain notice is issued, the deadline is allowed to pass, then the
+    node's worker processes are SIGKILLed and the raylet torn down — the
+    notice-then-kill race preemptible capacity really exhibits.
+    """
+
+    def __init__(self, cluster, interval_s: float = 1.0,
+                 max_kills: int = 1, seed: Optional[int] = None,
+                 deadline_s: float = 3.0, grace_s: float = 0.3,
+                 kill_at_deadline: bool = False, respawn: bool = False):
+        super().__init__(interval_s, max_kills, seed)
+        self.cluster = cluster
+        self.deadline_s = deadline_s
+        self.grace_s = grace_s
+        self.kill_at_deadline = kill_at_deadline
+        self.respawn = respawn
+
+    def _kill_one(self):
+        victims = [r for r in self.cluster.raylets if not r.is_head]
+        if not victims:
+            return
+        raylet = self._rng.choice(victims)
+        resources = dict(raylet.pool.total)
+        if self.kill_at_deadline:
+            # Notice, wait out the deadline, then reclaim hard.
+            self.cluster.drain_node(raylet, deadline_s=self.deadline_s,
+                                    grace_s=self.grace_s, wait=False)
+            time.sleep(self.deadline_s)
+            self._hard_reclaim(raylet)
+            self.kills.append(f"preempt:{raylet.node_name}")
+        else:
+            self.cluster.drain_node(raylet, deadline_s=self.deadline_s,
+                                    grace_s=self.grace_s, wait=True)
+            self.kills.append(f"drain:{raylet.node_name}")
+        if self.respawn:
+            time.sleep(0.2)
+            self.cluster.add_node(
+                num_cpus=resources.get("CPU", 1),
+                resources={k: v for k, v in resources.items()
+                           if k not in ("CPU", "memory",
+                                        "object_store_memory")})
+
+    def _hard_reclaim(self, raylet):
+        """SIGKILL the node's workers, then stop the raylet — the reclaim
+        half of the notice-then-kill race."""
+        for handle in list(raylet.workers.values()):
+            if handle.pid > 0:
+                try:
+                    os.kill(handle.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+        if raylet in self.cluster.raylets:
+            try:
+                self.cluster.remove_node(raylet)
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass
+
+
+class PreemptionKiller(NodeDrainer):
+    """NodeDrainer preset for spot/preemptible semantics: short notice,
+    then the VM is reclaimed whether or not the drain finished."""
+
+    def __init__(self, cluster, interval_s: float = 1.0,
+                 max_kills: int = 1, seed: Optional[int] = None,
+                 deadline_s: float = 1.5, grace_s: float = 0.3,
+                 respawn: bool = False):
+        super().__init__(cluster, interval_s=interval_s, max_kills=max_kills,
+                         seed=seed, deadline_s=deadline_s, grace_s=grace_s,
+                         kill_at_deadline=True, respawn=respawn)
+
+
 def run_with_chaos(workload, killers: List[_KillerBase]):
     """Run `workload()` while killers fire; returns (result, kill_log)."""
     for k in killers:

@@ -283,6 +283,9 @@ def test_autoscaler_reconciles_with_tpu_provider():
     def gcs(m, p):
         if m == "drain_node":
             drained.append(p["node_id_hex"])
+            # An idle node completes its drain within the bounded wait:
+            # the GCS marks it dead before drain_node(wait=True) returns.
+            state["nodes"][p["node_id_hex"]]["alive"] = False
             return True
         return state
 
