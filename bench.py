@@ -366,19 +366,48 @@ def bench_cluster(partial: dict):
     cluster.connect()
     try:
         # PG latency first: it needs no worker processes, so it isn't
-        # starved by the actor-launch storm below.
+        # starved by the actor-launch storm below. A background nop-task
+        # stream keeps the scheduling pipeline hot for the duration: on
+        # this ballooned VM an otherwise-idle driver pays a 50-200 ms
+        # wake-from-idle penalty per control-plane exchange, which is NOT
+        # the quantity this row tracks (pre-round-6 the task-based
+        # pg.ready() probe kept the pipeline warm implicitly; the
+        # push-based ready() needs the warmth made explicit to stay
+        # comparable).
         try:
             from ray_tpu.util.placement_group import (
                 placement_group, remove_placement_group)
+
+            @ray_tpu.remote(num_cpus=0.01)
+            def _pg_warm_nop():
+                return None
+
+            ray_tpu.get(_pg_warm_nop.remote(), timeout=60)
+            import threading
+            stop_warm = threading.Event()
+
+            def _warm_keeper():
+                while not stop_warm.is_set():
+                    try:
+                        ray_tpu.get(_pg_warm_nop.remote(), timeout=30)
+                    except Exception:  # noqa: BLE001
+                        return
+
+            warm_thread = threading.Thread(target=_warm_keeper, daemon=True)
+            warm_thread.start()
             create_ms, remove_ms = [], []
-            for _ in range(10):
-                t0 = time.perf_counter()
-                pg = placement_group([{"CPU": 1}] * 3, strategy="PACK")
-                ray_tpu.get(pg.ready(), timeout=60)
-                create_ms.append((time.perf_counter() - t0) * 1e3)
-                t0 = time.perf_counter()
-                remove_placement_group(pg)
-                remove_ms.append((time.perf_counter() - t0) * 1e3)
+            try:
+                for _ in range(10):
+                    t0 = time.perf_counter()
+                    pg = placement_group([{"CPU": 1}] * 3, strategy="PACK")
+                    ray_tpu.get(pg.ready(), timeout=60)
+                    create_ms.append((time.perf_counter() - t0) * 1e3)
+                    t0 = time.perf_counter()
+                    remove_placement_group(pg)
+                    remove_ms.append((time.perf_counter() - t0) * 1e3)
+            finally:
+                stop_warm.set()
+                warm_thread.join(timeout=35)
             partial["pg_create_ms"] = round(statistics.median(create_ms), 2)
             partial["pg_remove_ms"] = round(statistics.median(remove_ms), 2)
             _persist(partial)

@@ -63,20 +63,25 @@ class Pubsub:
             subs.discard(conn)
 
     def publish(self, channel: str, message):
+        """Fan a message out to every live subscriber, synchronously.
+
+        push_nowait queues one frame per subscriber; everything published
+        within the same loop tick coalesces into a single BATCH envelope
+        per subscriber connection (one pickle + one write), so a publish
+        storm costs the GCS O(ticks), not O(messages) — and no coroutine
+        is spawned per (message, subscriber) pair."""
         conns = self._subs.get(channel)
         if not conns:
             return
+        payload = {"channel": channel, "message": message}
         for conn in list(conns):
             if conn.closed:
                 conns.discard(conn)
                 continue
-            asyncio.ensure_future(self._safe_push(conn, channel, message))
-
-    async def _safe_push(self, conn, channel, message):
-        try:
-            await conn.push("pub", {"channel": channel, "message": message})
-        except Exception:
-            self.drop_connection(conn)
+            try:
+                conn.push_nowait("pub", payload)
+            except Exception:  # noqa: BLE001 — subscriber died mid-publish
+                self.drop_connection(conn)
 
 
 class GcsServer:
@@ -1121,31 +1126,34 @@ class GcsServer:
                                                "bundles": pg.bundles})
                 asyncio.ensure_future(self._schedule_pg(pg, delay=0.5))
                 return
-            # Two-phase: reserve on each node, rollback on failure.
-            reserved: List[tuple] = []
-            ok = True
-            for idx, node_id in placement.items():
+            # Two-phase: reserve on each node IN PARALLEL (bundle count no
+            # longer multiplies commit latency), rollback on any failure.
+            async def _reserve(idx: int, node_id) -> bool:
                 node = self.nodes.get(node_id)
                 try:
-                    got = await self.clients.request(
+                    return bool(await self.clients.request(
                         node.address, "reserve_bundle",
                         {"pg_id": pg.pg_id, "bundle_index": idx,
-                         "resources": pg.bundles[idx]}, timeout=10.0)
-                except Exception:
-                    got = False
-                if not got:
-                    ok = False
-                    break
-                reserved.append((idx, node_id))
-            if not ok:
-                for idx, node_id in reserved:
+                         "resources": pg.bundles[idx]}, timeout=10.0))
+                except Exception:  # noqa: BLE001 — node may be dying
+                    return False
+
+            items = list(placement.items())
+            results = await asyncio.gather(
+                *[_reserve(idx, node_id) for idx, node_id in items])
+            if not all(results):
+                async def _rollback(idx: int, node_id):
                     node = self.nodes.get(node_id)
                     try:
                         await self.clients.request(
                             node.address, "return_bundle",
-                            {"pg_id": pg.pg_id, "bundle_index": idx}, timeout=10.0)
-                    except Exception:
+                            {"pg_id": pg.pg_id, "bundle_index": idx},
+                            timeout=10.0)
+                    except Exception:  # noqa: BLE001
                         pass
+                await asyncio.gather(*[
+                    _rollback(idx, node_id)
+                    for (idx, node_id), got in zip(items, results) if got])
                 asyncio.ensure_future(self._schedule_pg(pg, delay=0.5))
                 return
             pg.bundle_nodes = dict(placement)

@@ -282,7 +282,7 @@ class Raylet:
         # Actor creates waiting for a worker: (env_hash, exact, future),
         # FIFO-served by rpc_register_worker.
         self._actor_worker_waiters: List[tuple] = []
-        self._pending_leases: List[tuple] = []   # (spec, pg, fut, conn)
+        self._pending_leases: List[tuple] = []  # (spec, pg, fut, conn, count)
         # Driver conns that have been granted leases: on close, their
         # leased workers are reclaimed (reference: leased workers of an
         # exited job are destroyed, worker_pool.cc DisconnectClient).
@@ -430,10 +430,7 @@ class Raylet:
                     "resources_available": dict(self.pool.available),
                     # Queued lease shapes feed the autoscaler's demand
                     # bin-packing (reference: resource_demand_scheduler.py).
-                    "pending_demand": [
-                        dict(spec.resources)
-                        for spec, _pg, fut, _c in self._pending_leases[:64]
-                        if not fut.done()],
+                    "pending_demand": self._pending_demand_shapes(64),
                 })
                 if reply.get("reregister"):
                     # GCS restarted without our node in its (restored) table.
@@ -450,6 +447,19 @@ class Raylet:
                 logger.warning("raylet %s lost GCS connection; reconnecting",
                                self.node_name)
                 await self._reconnect_gcs()
+
+    def _pending_demand_shapes(self, cap: int) -> list:
+        """Queued lease demand for the autoscaler, one shape per needed
+        GRANT (a multi-grant request with count=n is n workers of demand)."""
+        shapes: list = []
+        for spec, _pg, fut, _c, count in self._pending_leases:
+            if fut.done():
+                continue
+            for _ in range(min(count, cap - len(shapes))):
+                shapes.append(dict(spec.resources))
+            if len(shapes) >= cap:
+                break
+        return shapes
 
     async def _reconnect_gcs(self):
         while not self._stopped:
@@ -752,11 +762,15 @@ class Raylet:
         starting_hashes = [h.env_hash for h in self.workers.values()
                            if not h.registered and h.env_hash]
         n_starting_container = len(starting_hashes)
-        for spec, _pg_key, fut, _conn in self._pending_leases:
+        for spec, _pg_key, fut, _conn, count in self._pending_leases:
             if fut.done():
                 continue
-            if all(avail.get(k, 0) >= v
-                   for k, v in spec.resources.items() if v > 0):
+            # A multi-grant request is `count` workers of demand, each
+            # gated on the resources its grant would consume.
+            for _ in range(count):
+                if not all(avail.get(k, 0) >= v
+                           for k, v in spec.resources.items() if v > 0):
+                    break
                 for k, v in spec.resources.items():
                     avail[k] = avail.get(k, 0) - v
                 eh = spec.env_hash()
@@ -832,7 +846,7 @@ class Raylet:
                     self.node_name, self._drain_deadline - time.time())
         # Bounce queued lease requests: the submitter re-requests and the
         # draining guard spills it to a live peer.
-        for _spec, _pg, fut, _c in self._pending_leases:
+        for _spec, _pg, fut, _c, _n in self._pending_leases:
             if not fut.done():
                 fut.set_result({"retry": True})
         self._pending_leases.clear()
@@ -906,19 +920,43 @@ class Raylet:
                     if any(loc in peer_set
                            for loc in info.get("locations", [])):
                         continue  # a live peer already has a copy
-            desc = self.store.pin(oid)
-            if desc is None:
-                continue
-            try:
-                _name, offset, size, metadata = desc
-                data = bytes(self.store.arena.view(offset, size))
-            finally:
-                self.store.unpin(oid)
+            remaining = self._drain_deadline - time.time()
+            if remaining <= 0:
+                # Deadline exhausted: anything left unsaved is lost to
+                # lineage reconstruction — stop burning the grace window.
+                logger.warning("raylet %s drain deadline hit mid-migration",
+                               self.node_name)
+                break
             target = peers[moved % len(peers)]
+            ent2 = self.store.objects.get(oid)
+            size = ent2.size if ent2 is not None else 0
             try:
-                await self.clients.request(target, "store_put_bytes", {
-                    "object_id": oid, "data": data, "metadata": metadata,
-                    "owner_address": ent.owner_address}, timeout=30.0)
+                if size > self.config.object_transfer_chunk_bytes:
+                    # Large object: have the peer PULL it through the
+                    # object-manager chunked transfer path (bounded
+                    # frames — _MAX_MSG no longer caps drainable object
+                    # size), rate-limited against the drain deadline.
+                    ok = await self.clients.request(
+                        target, "store_fetch_remote", {
+                            "object_id": oid, "locations": [self.address],
+                            "owner_address": ent.owner_address},
+                        timeout=max(1.0, remaining))
+                    if not ok:
+                        continue
+                else:
+                    desc = self.store.pin(oid)
+                    if desc is None:
+                        continue
+                    try:
+                        _name, offset, sz, metadata = desc
+                        data = bytes(self.store.arena.view(offset, sz))
+                    finally:
+                        self.store.unpin(oid)
+                    await self.clients.request(target, "store_put_bytes", {
+                        "object_id": oid, "data": data,
+                        "metadata": metadata,
+                        "owner_address": ent.owner_address},
+                        timeout=max(1.0, min(30.0, remaining)))
             except (rpc.RpcError, OSError):
                 continue
             moved += 1
@@ -937,11 +975,18 @@ class Raylet:
     # Lease protocol (normal tasks)
 
     async def rpc_request_worker_lease(self, conn, payload):
-        """Grant a local worker, queue, or spill to another node.
+        """Grant local worker(s), queue, or spill to another node.
 
-        Reply: {"granted": {...}} | {"spillback": address} | {"infeasible": True}
+        `count` is the client's backlog hint (queued tasks of this sched
+        class): the reply carries up to `count` grants in ONE round trip
+        (reference: direct_task_transport.h lease pipelining), so N needed
+        workers cost ~1 RPC instead of N.
+
+        Reply: {"granted": {...}, "grants": [{...}, ...]}
+             | {"spillback": address} | {"infeasible": True} | {"retry": True}
         """
         spec: TaskSpec = payload["spec"]
+        count = max(1, int(payload.get("count", 1)))
         if self._draining:
             # Drain phase 1: no new grants here. Spill to a live peer when
             # one could take the shape; otherwise ask the client to retry
@@ -1046,7 +1091,7 @@ class Raylet:
                                     f"{spec.resources})")}
 
         fut = asyncio.get_running_loop().create_future()
-        self._pending_leases.append((spec, pg_key, fut, conn))
+        self._pending_leases.append((spec, pg_key, fut, conn, count))
         self._watch_lease_client(conn)
         self._try_dispatch()
         self._ensure_worker_supply()
@@ -1054,7 +1099,7 @@ class Raylet:
             return await asyncio.wait_for(fut, self.config.worker_lease_timeout_s)
         except asyncio.TimeoutError:
             try:
-                self._pending_leases.remove((spec, pg_key, fut, conn))
+                self._pending_leases.remove((spec, pg_key, fut, conn, count))
             except ValueError:
                 pass
             return {"retry": True}
@@ -1127,7 +1172,7 @@ class Raylet:
     async def _reclaim_client_leases(self, conn):
         # Pending (ungranted) requests from the dead client must not be
         # granted to nobody: cancel their futures.
-        for spec, _pg, fut, req_conn in self._pending_leases:
+        for spec, _pg, fut, req_conn, _n in self._pending_leases:
             if req_conn is conn and not fut.done():
                 fut.cancel()
         self._pending_leases = [
@@ -1162,7 +1207,7 @@ class Raylet:
     def _try_dispatch(self):
         if self._draining:
             # No grants during drain; bounce anything still queued.
-            for _spec, _pg, fut, _c in self._pending_leases:
+            for _spec, _pg, fut, _c, _n in self._pending_leases:
                 if not fut.done():
                     fut.set_result({"retry": True})
             self._pending_leases.clear()
@@ -1170,7 +1215,9 @@ class Raylet:
         if not self._pending_leases:
             return
         remaining = []
-        for spec, pg_key, fut, req_conn in self._pending_leases:
+        n_waiting = sum(1 for e in self._pending_leases if not e[2].done())
+        idle0 = len(self._idle_workers)
+        for spec, pg_key, fut, req_conn, count in self._pending_leases:
             if fut.done():
                 continue
             if not self.pool.fits(spec.resources, pg_key):
@@ -1197,29 +1244,41 @@ class Raylet:
                                 {"spillback": view["address"]})
                             break
                 if not fut.done():
-                    remaining.append((spec, pg_key, fut, req_conn))
+                    remaining.append((spec, pg_key, fut, req_conn, count))
                 continue
-            worker = self._get_idle_worker(
-                spec.env_hash(), exact=self._container_env(spec) is not None)
-            if worker is None:
-                remaining.append((spec, pg_key, fut, req_conn))
+            # Fair multi-grant: one client's backlog hint must not soak
+            # every idle worker while other clients' requests wait.
+            cap = count
+            if n_waiting > 1:
+                cap = max(1, min(count, idle0 // n_waiting))
+            grants = []
+            while len(grants) < cap and self.pool.fits(spec.resources,
+                                                       pg_key):
+                worker = self._get_idle_worker(
+                    spec.env_hash(),
+                    exact=self._container_env(spec) is not None)
+                if worker is None:
+                    break
+                self.pool.acquire(spec.resources, pg_key)
+                worker.leased = True
+                worker.lease_owner = spec.owner_address
+                if spec.env_hash():
+                    worker.env_hash = spec.env_hash()
+                worker.lease_class = spec.scheduling_class()
+                worker.lease_resources = dict(spec.resources)
+                worker.lease_pg = pg_key
+                worker.lease_conn = req_conn
+                worker.idle_since = time.time()
+                grants.append({
+                    "worker_id": worker.worker_id,
+                    "worker_address": worker.address,
+                    "node_id": self.node_id,
+                })
+            if not grants:
+                remaining.append((spec, pg_key, fut, req_conn, count))
                 continue
-            self.pool.acquire(spec.resources, pg_key)
             self._mark_resources_dirty()
-            worker.leased = True
-            worker.lease_owner = spec.owner_address
-            if spec.env_hash():
-                worker.env_hash = spec.env_hash()
-            worker.lease_class = spec.scheduling_class()
-            worker.lease_resources = dict(spec.resources)
-            worker.lease_pg = pg_key
-            worker.lease_conn = req_conn
-            worker.idle_since = time.time()
-            fut.set_result({"granted": {
-                "worker_id": worker.worker_id,
-                "worker_address": worker.address,
-                "node_id": self.node_id,
-            }})
+            fut.set_result({"granted": grants[0], "grants": grants})
         self._pending_leases = [e for e in remaining if not e[2].done()]
         self._ensure_worker_supply()
 

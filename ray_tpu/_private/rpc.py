@@ -7,6 +7,15 @@ length-prefixed pickled envelope; payloads are plain Python structures.
 
 Envelope: u32 length | pickle([kind, msg_id, method, payload])
     kind: 0=request 1=response 2=error-response 3=notify 4=push
+          5=batch (payload = list of envelopes, dispatched in order)
+
+A BATCH envelope packs every frame coalesced within one loop tick into a
+single pickle + transport write: N concurrent clients cost the daemon
+~O(loop ticks) of framing work instead of O(messages) (reference analogue:
+gRPC stream batching in the raylet/GCS fan-in paths). The receiver unpacks
+in order, so cross-frame ordering is exactly what the per-frame encoding
+gave. RAY_TPU_RPC_BATCH=0 turns the send side off (legacy framing);
+decoding always understands both.
 """
 
 from __future__ import annotations
@@ -14,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import os
 import pickle
 import random
 import struct
@@ -22,9 +32,33 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 
 logger = logging.getLogger(__name__)
 
-REQUEST, RESPONSE, ERROR, NOTIFY, PUSH = 0, 1, 2, 3, 4
+REQUEST, RESPONSE, ERROR, NOTIFY, PUSH, BATCH = 0, 1, 2, 3, 4, 5
 
 _MAX_MSG = 1 << 31
+
+_BATCHING_DEFAULT = os.environ.get(
+    "RAY_TPU_RPC_BATCH", "1").lower() not in ("0", "false", "no")
+
+# Process-wide transport totals (frames vs writes is the fan-in batching
+# health signal: frames/write >> 1 under load means coalescing works).
+_stats = {"frames": 0, "writes": 0, "bytes": 0, "batched_frames": 0}
+
+
+def transport_stats() -> dict:
+    """Snapshot of this process's transport counters."""
+    return dict(_stats)
+
+
+def export_transport_metrics():
+    """Publish the transport counters into util/metrics.py's registry so
+    they ride the normal report loop to the GCS /metrics endpoint."""
+    from ray_tpu.util import metrics
+    for name, key in (("ray_tpu_rpc_frames_total", "frames"),
+                      ("ray_tpu_rpc_writes_total", "writes"),
+                      ("ray_tpu_rpc_bytes_total", "bytes"),
+                      ("ray_tpu_rpc_batched_frames_total",
+                       "batched_frames")):
+        metrics.Gauge(name, "rpc transport counter").set(float(_stats[key]))
 
 # ---- deterministic race-shaking (reference: ray_config_def.h:838
 # RAY_testing_asio_delay_us) ------------------------------------------------
@@ -105,12 +139,30 @@ def _encode(kind: int, msg_id: int, method: str, payload: Any) -> bytes:
     return struct.pack("<I", len(body)) + body
 
 
+def _approx_payload_size(payload: Any, depth: int = 3) -> int:
+    """Cheap lower-bound estimate of a payload's wire size, catching the
+    case that matters: large bytes-like values (object data, chunks)
+    nested a level or two deep. Everything else counts a flat 64 bytes —
+    this gates batch flushing, not accounting."""
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        return len(payload)
+    if depth > 0:
+        if isinstance(payload, dict):
+            return 64 + sum(_approx_payload_size(v, depth - 1)
+                            for v in payload.values())
+        if isinstance(payload, (list, tuple)) and len(payload) < 1024:
+            return 64 + sum(_approx_payload_size(v, depth - 1)
+                            for v in payload)
+    return 64
+
+
 class Connection:
     """One live duplex connection; shared by client and server sides."""
 
     _ids = itertools.count(1)
 
     HIGH_WATER = 1 << 20  # drain (backpressure) only past this buffer size
+    MAX_BATCH_FRAMES = 1024  # flush early past this many queued frames
 
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
                  push_handler: Optional[Callable] = None):
@@ -122,49 +174,128 @@ class Connection:
         self.on_close: Optional[Callable] = None
         # Set by server loop: peer-provided identity metadata.
         self.peer_info: dict = {}
-        # Write coalescing: frames queued within one loop tick flush as a
-        # single transport write (one syscall), see send_nowait.
+        # Frame coalescing: frames queued within one loop tick flush as a
+        # single BATCH envelope (one pickle + one transport write), see
+        # send_nowait. `batching=False` keeps the write coalescing but
+        # encodes legacy per-frame envelopes (interop / kill switch).
         self._out: list = []
-        self._out_bytes = 0
+        self._out_est_bytes = 0  # rough payload bytes queued (see send)
         self._flush_scheduled = False
+        self.batching = _BATCHING_DEFAULT
+        # Transport counters (frames-per-write is the batching signal).
+        self.frames_sent = 0
+        self.writes = 0
+        self.bytes_sent = 0
+        self.batched_frames = 0
 
     @property
     def closed(self) -> bool:
         return self._closed
 
+    def _account(self, nframes: int, nbytes: int):
+        self.frames_sent += nframes
+        self.writes += 1
+        self.bytes_sent += nbytes
+        _stats["frames"] += nframes
+        _stats["writes"] += 1
+        _stats["bytes"] += nbytes
+        if nframes > 1:
+            self.batched_frames += nframes
+            _stats["batched_frames"] += nframes
+
     def send_nowait(self, kind: int, msg_id: int, method: str, payload: Any):
         """Send with adaptive coalescing: the first frame of a loop tick
         writes through immediately (no latency tax on serial
-        request-reply), later frames of the same tick batch into one
-        write (a burst of pipelined pushes/replies costs one socket.send
-        — measured ~64 us per send syscall on this box, the dominant term
-        of the round-2 task-throughput gap). Loop thread only.
+        request-reply), later frames of the same tick batch into ONE
+        BATCH envelope — one pickle.dumps and one socket.send for the
+        whole burst (per-frame pickling + headers were the residual
+        per-message cost after round-2's write coalescing; a send syscall
+        alone measured ~64 us on this box). Loop thread only.
         """
         if self._closed:
             raise ConnectionLost("connection closed")
-        data = _encode(kind, msg_id, method, payload)
         if self._flush_scheduled:
-            self._out.append(data)
-            self._out_bytes += len(data)
+            self._out.append((kind, msg_id, method, payload))
+            self._out_est_bytes += _approx_payload_size(payload)
             return
+        data = _encode(kind, msg_id, method, payload)
         self.writer.write(data)
+        self._account(1, len(data))
         self._flush_scheduled = True
         asyncio.get_running_loop().call_soon(self._flush)
+
+    def push_nowait(self, method: str, payload: Any = None):
+        """Fire-and-forget push without a coroutine (pubsub fan-out: one
+        publish to N subscribers costs N queue appends, not N tasks)."""
+        self.send_nowait(PUSH, 0, method, payload)
 
     def _flush(self):
         self._flush_scheduled = False
         if self._closed or not self._out:
             return
-        data = self._out[0] if len(self._out) == 1 else b"".join(self._out)
-        self._out.clear()
-        self._out_bytes = 0
+        frames, self._out = self._out, []
+        self._out_est_bytes = 0
+        if len(frames) == 1 or not self.batching:
+            for fr in frames:
+                self._write_frame(fr)
+            return
+        try:
+            data = _encode(BATCH, 0, "", frames)
+        except Exception:
+            # One unpicklable payload must not poison its batch-mates:
+            # degrade to per-frame encoding so only the culprit fails.
+            for fr in frames:
+                self._write_frame(fr)
+            return
+        if len(data) > _MAX_MSG:
+            # The combined envelope exceeds the frame cap even though the
+            # members individually may not: ship them per-frame.
+            for fr in frames:
+                self._write_frame(fr)
+            return
         self.writer.write(data)
+        self._account(len(frames), len(data))
+
+    def _write_frame(self, frame):
+        kind, msg_id, method, payload = frame
+        try:
+            data = _encode(kind, msg_id, method, payload)
+        except Exception as e:  # noqa: BLE001 — per-frame fault isolation
+            self._on_encode_error(kind, msg_id, method, e)
+            return
+        self.writer.write(data)
+        self._account(1, len(data))
+
+    def _on_encode_error(self, kind, msg_id, method, e: Exception):
+        """A queued frame failed to pickle at flush time (the caller has
+        already returned). Keep the failure scoped to that frame: a
+        RESPONSE degrades to a remote ERROR so the requester is not left
+        hanging; a REQUEST fails its local future; one-way frames drop."""
+        logger.exception("failed to encode frame for %s", method)
+        if kind == RESPONSE:
+            try:
+                data = _encode(ERROR, msg_id, method,
+                               (method, type(e).__name__,
+                                f"unpicklable reply: {e}", ""))
+                self.writer.write(data)
+                self._account(1, len(data))
+            except Exception:  # noqa: BLE001
+                pass
+        elif kind == REQUEST:
+            fut = self._pending.get(msg_id)
+            if fut is not None and not fut.done():
+                fut.set_exception(e)
 
     async def send(self, kind: int, msg_id: int, method: str, payload: Any):
         self.send_nowait(kind, msg_id, method, payload)
-        transport = self.writer.transport
-        if self._out_bytes > self.HIGH_WATER:
+        if (len(self._out) >= self.MAX_BATCH_FRAMES
+                or self._out_est_bytes > self.HIGH_WATER):
+            # Bound the batch by frames AND (estimated) bytes: a same-tick
+            # burst of large replies must not accumulate into one giant
+            # pickle (worst case past _MAX_MSG, and 2x peak memory).
             self._flush()
+            self._flush_scheduled = True  # later frames keep queueing
+        transport = self.writer.transport
         if (transport is not None
                 and transport.get_write_buffer_size() > self.HIGH_WATER):
             await self.writer.drain()
@@ -191,7 +322,7 @@ class Connection:
             return
         self._closed = True
         self._out.clear()
-        self._out_bytes = 0
+        self._out_est_bytes = 0
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionLost(str(exc)))
@@ -209,7 +340,7 @@ class Connection:
     async def close(self):
         self.abort(ConnectionLost("closed"))
 
-    async def _dispatch_response(self, kind, msg_id, payload):
+    def _dispatch_response(self, kind, msg_id, payload):
         fut = self._pending.get(msg_id)
         if fut is None or fut.done():
             return
@@ -219,20 +350,29 @@ class Connection:
             method, err_type, message, tb = payload
             fut.set_exception(RemoteRpcError(method, err_type, message, tb))
 
+    def _dispatch_client_frame(self, kind, msg_id, method, payload):
+        if kind in (RESPONSE, ERROR):
+            self._dispatch_response(kind, msg_id, payload)
+        elif kind == PUSH and self.push_handler is not None:
+            try:
+                res = self.push_handler(method, payload)
+                if asyncio.iscoroutine(res):
+                    asyncio.ensure_future(res)
+            except Exception:
+                logger.exception("push handler failed for %s", method)
+
     async def client_loop(self):
         """Read loop for the client side of a connection."""
         try:
             while True:
                 kind, msg_id, method, payload = await _read_msg(self.reader)
-                if kind in (RESPONSE, ERROR):
-                    await self._dispatch_response(kind, msg_id, payload)
-                elif kind == PUSH and self.push_handler is not None:
-                    try:
-                        res = self.push_handler(method, payload)
-                        if asyncio.iscoroutine(res):
-                            asyncio.ensure_future(res)
-                    except Exception:
-                        logger.exception("push handler failed for %s", method)
+                if kind == BATCH:
+                    # Sub-frames dispatch in order: a batch preserves
+                    # exactly the per-frame delivery order.
+                    for sub in payload:
+                        self._dispatch_client_frame(*sub)
+                else:
+                    self._dispatch_client_frame(kind, msg_id, method, payload)
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
             self.abort(e)
         except Exception as e:
@@ -277,6 +417,25 @@ class RpcServer:
             except Exception:
                 pass
 
+    def _dispatch_server_frame(self, conn, kind, msg_id, method, payload):
+        if kind in (RESPONSE, ERROR):
+            conn._dispatch_response(kind, msg_id, payload)
+            return
+        handler = self._handlers.get(method)
+        if handler is None:
+            if kind == REQUEST:
+                conn.send_nowait(ERROR, msg_id, method,
+                                 (method, "KeyError",
+                                  f"no handler {method}", ""))
+            return
+        delay = _injected_delay(method)
+        if kind == REQUEST:
+            asyncio.ensure_future(self._run_handler(
+                conn, msg_id, method, handler, payload, delay))
+        else:  # NOTIFY
+            asyncio.ensure_future(self._run_notify(
+                conn, method, handler, payload, delay))
+
     async def _on_connect(self, reader, writer):
         conn = Connection(reader, writer)
         self.connections.add(conn)
@@ -284,22 +443,14 @@ class RpcServer:
         try:
             while True:
                 kind, msg_id, method, payload = await _read_msg(reader)
-                if kind in (RESPONSE, ERROR):
-                    await conn._dispatch_response(kind, msg_id, payload)
-                    continue
-                handler = self._handlers.get(method)
-                if handler is None:
-                    if kind == REQUEST:
-                        await conn.send(ERROR, msg_id, method,
-                                        (method, "KeyError", f"no handler {method}", ""))
-                    continue
-                delay = _injected_delay(method)
-                if kind == REQUEST:
-                    asyncio.ensure_future(self._run_handler(
-                        conn, msg_id, method, handler, payload, delay))
-                else:  # NOTIFY
-                    asyncio.ensure_future(self._run_notify(
-                        conn, method, handler, payload, delay))
+                if kind == BATCH:
+                    # In-order dispatch: handlers are *scheduled* in frame
+                    # order, same guarantee as per-frame delivery.
+                    for sub in payload:
+                        self._dispatch_server_frame(conn, *sub)
+                else:
+                    self._dispatch_server_frame(conn, kind, msg_id, method,
+                                                payload)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         except Exception:
@@ -461,9 +612,23 @@ class ClientPool:
             return conn
 
     async def request(self, address: str, method: str, payload: Any = None,
-                      timeout: Optional[float] = None) -> Any:
+                      timeout: Optional[float] = None,
+                      retry_once: bool = True) -> Any:
         conn = await self.get(address)
-        return await conn.request(method, payload, timeout)
+        try:
+            return await conn.request(method, payload, timeout)
+        except ConnectionLost:
+            if not retry_once:
+                raise
+            # The pooled connection may be stale (peer restarted on the
+            # same address): invalidate, re-dial once, retry. A dial
+            # failure re-raises ConnectionLost — the peer really is gone.
+            # Callers with at-most-once semantics (task/actor pushes: the
+            # peer may have EXECUTED before the connection died) pass
+            # retry_once=False and keep their own retry accounting.
+            self.invalidate(address)
+            conn = await self.get(address)
+            return await conn.request(method, payload, timeout)
 
     def invalidate(self, address: str):
         conn = self._conns.pop(address, None)

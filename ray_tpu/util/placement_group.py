@@ -11,11 +11,10 @@ host of the ICI domain.
 
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Optional
 
 from ray_tpu._private import worker_api
-from ray_tpu._private.common import PG_CREATED, PlacementGroupInfo
+from ray_tpu._private.common import PlacementGroupInfo
 from ray_tpu._private.ids import PlacementGroupID
 
 VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
@@ -27,31 +26,34 @@ class PlacementGroup:
         self.bundle_specs = bundles
 
     def ready(self):
-        """Returns an ObjectRef resolved when the PG is placed (ray parity)."""
-        from ray_tpu import remote
+        """Returns an ObjectRef resolved when the PG is placed (ray parity).
 
-        @remote
-        def _pg_ready():
-            return True
+        Push-based: the ref resolves on the GCS commit notification
+        (placement_groups pubsub) instead of submitting a probe task
+        through the lease path — creation latency is the commit latency.
+        """
+        core = worker_api.get_core()
+        if worker_api._on_core_loop(core):
+            return core.pg_ready_local(self.id)
 
-        from ray_tpu.util.scheduling_strategies import \
-            PlacementGroupSchedulingStrategy
-        return _pg_ready.options(
-            scheduling_strategy=PlacementGroupSchedulingStrategy(
-                placement_group=self, placement_group_bundle_index=0),
-            num_cpus=0).remote()
+        async def _mk():
+            return core.pg_ready_local(self.id)
+
+        return worker_api._call_on_core_loop(core, _mk(), 10)
 
     def wait(self, timeout_seconds: float = 30.0) -> bool:
+        """Block until placed (or timeout). Push-based, no polling."""
+        from ray_tpu import exceptions as exc
         core = worker_api.get_core()
-        deadline = time.time() + timeout_seconds
-        while time.time() < deadline:
-            info: Optional[PlacementGroupInfo] = worker_api._call_on_core_loop(
-                core, core.gcs.request("get_placement_group",
-                                       {"pg_id": self.id}), 10)
-            if info is not None and info.state == PG_CREATED:
-                return True
-            time.sleep(0.05)
-        return False
+        ref = self.ready()
+        try:
+            worker_api._call_on_core_loop(
+                core, core.get_async(ref, timeout_seconds), timeout_seconds)
+            return True
+        except exc.GetTimeoutError:
+            return False
+        except exc.RayTpuError:
+            return False
 
     @property
     def bundle_count(self) -> int:

@@ -1,0 +1,139 @@
+"""bench_smoke: a <60 s subset of bench.py covering the fan-in rows.
+
+Runs the three control-plane shapes that collapse under multi-client
+load — multi-client task bursts, n:n actor calls, and placement-group
+create/remove — scaled down so the whole script finishes in well under a
+minute on a 1-vCPU box. Prints ONE JSON line using the same row names as
+bench.py (multi_client_tasks_async, n_n_actor_calls, pg_create_ms,
+pg_remove_ms), so perf PRs get a cheap directional signal without the
+full bench. Wired into tier-1 as a completion-only sanity test
+(tests/test_bench_smoke.py): the numbers are printed, never asserted —
+a loaded CI box must not fail the suite on throughput noise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> dict:
+    sys.path.insert(0, HERE)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ray_tpu
+
+    out: dict = {}
+    ray_tpu.init(num_cpus=max(2, (os.cpu_count() or 1)))
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote(), timeout=60)  # warm lease + worker
+    ray_tpu.get([nop.remote() for _ in range(50)], timeout=60)
+
+    # --- multi-client tasks: 2 extra driver processes + this one ---
+    from ray_tpu._private import worker_api as _wapi
+    gcs_addr = _wapi._state.gcs_address
+    script = (
+        "import os, sys, time\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        f"sys.path.insert(0, {HERE!r})\n"
+        "import ray_tpu\n"
+        f"ray_tpu.init(address={gcs_addr!r})\n"
+        "@ray_tpu.remote\n"
+        "def nop():\n"
+        "    return None\n"
+        "ray_tpu.get(nop.remote(), timeout=60)\n"
+        "n = 200\n"
+        "t0 = time.perf_counter()\n"
+        "ray_tpu.get([nop.remote() for _ in range(n)], timeout=60)\n"
+        "print('RATE', n / (time.perf_counter() - t0))\n"
+        "ray_tpu.shutdown()\n")
+    try:
+        procs = [subprocess.Popen([sys.executable, "-c", script],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True)
+                 for _ in range(2)]
+        n = 200
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(n)], timeout=60)
+        rates = [n / (time.perf_counter() - t0)]
+        for p in procs:
+            stdout, _ = p.communicate(timeout=90)
+            for ln in stdout.splitlines():
+                if ln.startswith("RATE "):
+                    rates.append(float(ln.split()[1]))
+        out["multi_client_tasks_async"] = round(sum(rates), 1)
+        log(f"multi_client_tasks_async: {sum(rates):,.0f}/s "
+            f"({len(rates)} drivers)")
+    except Exception as e:  # noqa: BLE001 — smoke must finish
+        log(f"multi-client phase skipped: {type(e).__name__}: {e}")
+
+    # --- n:n actor calls: 2 caller actors, each with its own sink ---
+    @ray_tpu.remote
+    class Sink:
+        def ping(self, x=None):
+            return x
+
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self):
+            self.sink = Sink.remote()
+            ray_tpu.get(self.sink.ping.remote(), timeout=60)
+
+        def burst(self, n):
+            t0 = time.perf_counter()
+            ray_tpu.get([self.sink.ping.remote() for _ in range(n)])
+            return n / (time.perf_counter() - t0)
+
+    try:
+        callers = [Caller.remote() for _ in range(2)]
+        ray_tpu.get([c.burst.remote(5) for c in callers], timeout=90)
+        n = 150
+        t0 = time.perf_counter()
+        ray_tpu.get([c.burst.remote(n) for c in callers], timeout=90)
+        v = 2 * n / (time.perf_counter() - t0)
+        out["n_n_actor_calls"] = round(v, 1)
+        log(f"n_n_actor_calls_async: {v:,.0f}/s")
+    except Exception as e:  # noqa: BLE001
+        log(f"n:n phase skipped: {type(e).__name__}: {e}")
+
+    # --- placement group create/remove latency ---
+    try:
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  remove_placement_group)
+        create_ms, remove_ms = [], []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            pg = placement_group([{"CPU": 1}], strategy="PACK")
+            ray_tpu.get(pg.ready(), timeout=30)
+            create_ms.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            remove_placement_group(pg)
+            remove_ms.append((time.perf_counter() - t0) * 1e3)
+        out["pg_create_ms"] = round(statistics.median(create_ms), 2)
+        out["pg_remove_ms"] = round(statistics.median(remove_ms), 2)
+        log(f"pg create/remove: {out['pg_create_ms']}/"
+            f"{out['pg_remove_ms']} ms")
+    except Exception as e:  # noqa: BLE001
+        log(f"pg phase skipped: {type(e).__name__}: {e}")
+
+    ray_tpu.shutdown()
+    return out
+
+
+if __name__ == "__main__":
+    result = main()
+    result["smoke"] = True
+    print(json.dumps(result), flush=True)

@@ -1,0 +1,36 @@
+"""Tier-1 sanity run of scripts/bench_smoke.py.
+
+Completion-only: the smoke bench must run end to end and print one JSON
+line with the three fan-in rows (same names as bench.py). Throughput is
+NEVER asserted here — CI boxes are noisy; perf acceptance lives in the
+full bench. What this buys tier-1 is a cheap end-to-end drive of the
+batched control-plane paths (multi-driver fan-in, n:n actors, push-based
+PG readiness) in one subprocess.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "bench_smoke.py")
+
+
+@pytest.mark.timeout(170)
+def test_bench_smoke_completes(jax_cpu):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, SCRIPT], capture_output=True,
+                          text=True, timeout=150, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    assert lines, proc.stdout
+    row = json.loads(lines[-1])
+    assert row.get("smoke") is True
+    # Same row names as bench.py so numbers are comparable by eye.
+    for key in ("multi_client_tasks_async", "n_n_actor_calls",
+                "pg_create_ms"):
+        assert key in row, (key, row)
