@@ -259,7 +259,10 @@ def bench_core(partial: dict):
 
     # multi-client tasks: 3 real DRIVER processes join the cluster by
     # address and burst async nops concurrently (the reference's
-    # multi_client shape — ray_perf.py forks drivers).
+    # multi_client shape — ray_perf.py forks drivers). Runs twice: with
+    # the task-event flight recorder on (default) and off, so the
+    # recorder's own overhead is a tracked number in the trajectory —
+    # a regression in instrumentation cost shows up as a widening delta.
     import subprocess
     from ray_tpu._private import worker_api as _wapi
     gcs_addr = _wapi._state.gcs_address
@@ -278,10 +281,14 @@ def bench_core(partial: dict):
         "ray_tpu.get([nop.remote() for _ in range(n)], timeout=120)\n"
         "print('RATE', n / (time.perf_counter() - t0))\n"
         "ray_tpu.shutdown()\n")
-    try:
+
+    def _multi_client_rate(events_on: bool):
+        env = dict(os.environ)
+        env["RAY_TPU_TASK_EVENTS_ENABLED"] = "1" if events_on else "0"
         procs = [subprocess.Popen([sys.executable, "-c", script],
                                   stdout=subprocess.PIPE,
-                                  stderr=subprocess.STDOUT, text=True)
+                                  stderr=subprocess.STDOUT, text=True,
+                                  env=env)
                  for _ in range(3)]
         rates = []
         for p in procs:
@@ -289,12 +296,25 @@ def bench_core(partial: dict):
             for ln in out.splitlines():
                 if ln.startswith("RATE "):
                     rates.append(float(ln.split()[1]))
-        if rates:
-            v = sum(rates)
+        return (sum(rates), len(rates)) if rates else (0.0, 0)
+
+    try:
+        v, n_drivers = _multi_client_rate(events_on=True)
+        if v:
             partial["multi_client_tasks_async"] = round(v, 1)
             _persist(partial)
             log(f"multi_client_tasks_async: {v:,.0f}/s "
-                f"({len(rates)} drivers)")
+                f"({n_drivers} drivers)")
+        v_off, _n = _multi_client_rate(events_on=False)
+        if v_off:
+            partial["multi_client_tasks_async_no_events"] = round(v_off, 1)
+            if v:
+                partial["task_events_overhead_pct"] = round(
+                    max(0.0, (v_off - v) / v_off * 100.0), 2)
+                log(f"multi_client_tasks_async (events off): "
+                    f"{v_off:,.0f}/s — recorder overhead "
+                    f"{partial['task_events_overhead_pct']}%")
+            _persist(partial)
     except Exception as e:  # noqa: BLE001
         log(f"multi-client phase skipped: {type(e).__name__}: {e}")
 

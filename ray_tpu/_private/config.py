@@ -89,6 +89,11 @@ class Config:
 
     # --- pubsub / sync ---
     resource_broadcast_interval_s: float = 0.2
+    # Per-subscriber pubsub outbox cap (frames). A stalled subscriber's
+    # backlog drops OLDEST past this bound (counted in
+    # ray_tpu_pubsub_dropped_total) instead of growing GCS memory without
+    # limit.
+    pubsub_max_outbox: int = 2000
 
     # --- metrics / events ---
     task_events_enabled: bool = True

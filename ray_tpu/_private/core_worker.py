@@ -29,6 +29,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ray_tpu import exceptions as exc
 from ray_tpu.util import tracing as _tracing
 from ray_tpu._private import object_ref as object_ref_mod
+from ray_tpu._private.flightrec import (IDX_WORKER, N_STAMPS, PH_ARGS_READY,
+                                        PH_DISPATCHED, PH_EXEC_END,
+                                        PH_EXEC_START, PH_LEASE_GRANTED,
+                                        PH_LEASE_WAIT, PH_RECEIVED,
+                                        PH_REPLY_HANDLED, PH_RESULT_PUT,
+                                        PH_SUBMITTED, PHASE_ORDER,
+                                        RECORD_LEN)
 from ray_tpu._private import rpc
 from ray_tpu._private.common import (ACTOR_ALIVE, ACTOR_DEAD, ARG_INLINE,
                                      ARG_REF, ActorInfo, TaskArg, TaskSpec)
@@ -88,6 +95,14 @@ class PendingTask:
     # discarded unshipped (cancel/queue-failure) — otherwise the contained
     # objects stay pinned forever (ADVICE r4).
     arg_credits: List[ObjectID] = field(default_factory=list)
+    # Flight-recorder stamps: a fixed-size list indexed by flightrec's
+    # PH_* constants (wall-clock floats; None = not reached; last slot =
+    # executing worker hex). Owner-side stamps land here directly;
+    # executor stamps merge in from the task reply. A retry overwrites
+    # earlier stamps, so the record describes the attempt that actually
+    # completed. None until the first stamp (recorder off = never
+    # allocated).
+    phases: Optional[list] = None
 
 
 @dataclass
@@ -215,6 +230,10 @@ class CoreWorker:
         self.raylet_address = raylet_address
         self.job_id = job_id or JobID.from_int(0)
         self.worker_id = worker_id or WorkerID.from_random()
+        # Cached hex form: stamped onto every executor phase record and
+        # every flushed task event (bytes.hex() per call adds up on the
+        # reply hot path).
+        self._worker_hex = self.worker_id.hex()
         self.node_id = node_id
         self.session_dir = session_dir
         self.task_id_counter = 0
@@ -361,14 +380,43 @@ class CoreWorker:
         self._bg_tasks.append(asyncio.ensure_future(self._flush_task_events_loop()))
         self._bg_tasks.append(asyncio.ensure_future(self._lease_janitor_loop()))
         self._bg_tasks.append(asyncio.ensure_future(self._report_metrics_loop()))
+        from ray_tpu.util import metrics as metrics_mod
+        self._bg_tasks.append(metrics_mod.start_loop_lag_probe(self.mode))
+
+    def _update_pipeline_gauges(self):
+        """Depth/inflight gauges over the batching pipeline (tentpole:
+        the queues PR 2 built are now observable). Cheap scans, run once
+        per report tick, not per task."""
+        from ray_tpu.util import metrics as metrics_mod
+        g = metrics_mod.Gauge
+        g("ray_tpu_task_queue_depth",
+          "specs queued for dispatch across scheduling classes").set(
+            float(sum(len(q) for q in self._task_queue.values())))
+        g("ray_tpu_lease_rpcs_inflight",
+          "worker-lease RPCs in flight").set(
+            float(sum(self._lease_rpcs_inflight.values())))
+        g("ray_tpu_leases_held", "worker leases currently cached").set(
+            float(sum(len(v) for v in self.leases.values())))
+        g("ray_tpu_actor_outbox_depth",
+          "actor-call pushes queued in per-actor outboxes").set(
+            float(sum(len(q.outbox) for q in self.actor_queues.values())))
+        g("ray_tpu_pending_tasks",
+          "tasks submitted by this process and not yet completed").set(
+            float(len(self.pending_tasks)))
 
     async def _report_metrics_loop(self):
-        """Ship this process's metric registry to the GCS periodically
-        (reference: metrics_agent.py push path)."""
+        """Refresh pipeline gauges and ship this process's metric registry
+        to the GCS periodically (reference: metrics_agent.py push path).
+        Only ONE component per process pushes the (process-global)
+        registry — when the GCS or a raylet lives in this process it may
+        hold the claim instead, and this loop only maintains gauges."""
         from ray_tpu.util import metrics as metrics_mod
         reporter = f"{self.mode}:{self.worker_id.hex()[:12]}"
         while not self._shutdown:
             await asyncio.sleep(self.config.metrics_report_interval_s)
+            self._update_pipeline_gauges()
+            if not metrics_mod.claim_reporter(self):
+                continue
             rpc.export_transport_metrics()
             snap = metrics_mod.snapshot()
             if not snap:
@@ -460,6 +508,8 @@ class CoreWorker:
 
     async def shutdown_async(self):
         self._shutdown = True
+        from ray_tpu.util import metrics as metrics_mod
+        metrics_mod.release_reporter(self)
         for t in self._bg_tasks:
             t.cancel()
         await self._flush_task_events()
@@ -1546,6 +1596,7 @@ class CoreWorker:
         self.pending_tasks[task_id] = PendingTask(
             spec=spec, retries_left=spec.max_retries, returns=returns,
             arg_refs=[])
+        self._stamp_phase(task_id, PH_SUBMITTED)
         self._record_task_event(spec, "PENDING")
         asyncio.ensure_future(
             self._finish_task_submission(spec, args, kwargs, export,
@@ -1632,6 +1683,7 @@ class CoreWorker:
             self.pending_tasks[task_id] = PendingTask(
                 spec=spec, retries_left=spec.max_retries, returns=returns,
                 arg_refs=[])
+        self._stamp_phase(task_id, PH_SUBMITTED)
         self._record_task_event(spec, "PENDING")
         self._post_to_loop(
             self._post_threadsafe_task_submit, spec, args, kwargs, export,
@@ -1761,6 +1813,7 @@ class CoreWorker:
     def _enqueue_task_spec(self, spec: TaskSpec):
         sched_class = spec.scheduling_class()
         self._task_queue.setdefault(sched_class, []).append(spec)
+        self._stamp_phase(spec.task_id, PH_LEASE_WAIT)
         self._schedule_pump(sched_class)
 
     async def _submit_to_cluster(self, spec: TaskSpec):
@@ -1820,6 +1873,12 @@ class CoreWorker:
                     take = min(len(queue), max_batch,
                                max(1, -(-len(queue) // n_live)))
                 batch = self._take_batch(queue, take)
+                if self.config.task_events_enabled:
+                    now = time.time()
+                    for spec in batch:
+                        self._stamp_phase(spec.task_id,
+                                          PH_LEASE_GRANTED, now)
+                    self._observe_batch_size("task", len(batch))
                 lease.inflight += 1
                 asyncio.ensure_future(
                     self._run_on_lease(sched_class, lease, batch))
@@ -1934,6 +1993,7 @@ class CoreWorker:
         tasks finish (no head-of-line reply blocking for long tasks); the
         requests of a batch go out in the same loop tick, so the rpc
         layer's write coalescing still collapses them into one syscall."""
+        t_dispatch = time.time()
         for spec in specs:
             self._record_task_event(spec, "RUNNING")
             # The receiver deserializes the inline args: that consumes the
@@ -1942,15 +2002,18 @@ class CoreWorker:
             pt = self.pending_tasks.get(spec.task_id)
             if pt is not None:
                 pt.arg_credits = []
+                if self.config.task_events_enabled:
+                    ph = pt.phases
+                    if ph is None:
+                        ph = pt.phases = [None] * RECORD_LEN
+                    ph[PH_DISPATCHED] = t_dispatch
         t_push = time.monotonic()
         try:
             # retry_once=False: the worker may have EXECUTED before the
             # connection died — re-pushing bypasses the retries_left
             # accounting in _handle_task_worker_death (at-most-once).
             if len(specs) == 1:
-                replies = [await self.clients.request(
-                    lease.worker_address, "push_task", {"spec": specs[0]},
-                    timeout=None, retry_once=False)]
+                push_payload: dict = {"spec": specs[0]}
             else:
                 # One RPC round trip covers the whole batch; the worker
                 # executes sequentially and replies once. Head-of-line
@@ -1959,9 +2022,18 @@ class CoreWorker:
                 # batches only form for overflow beyond live lease demand.
                 # (A per-item streamed-reply variant measured ~2.4x slower
                 # on the microbenchmarks; reply latency lost.)
+                push_payload = {"specs": specs}
+            if not self.config.task_events_enabled:
+                # Owner recorder off: the executor skips its stamps too.
+                push_payload["ph"] = 0
+            if len(specs) == 1:
+                replies = [await self.clients.request(
+                    lease.worker_address, "push_task", push_payload,
+                    timeout=None, retry_once=False)]
+            else:
                 replies = await self.clients.request(
                     lease.worker_address, "push_task_batch",
-                    {"specs": specs}, timeout=None, retry_once=False)
+                    push_payload, timeout=None, retry_once=False)
         except rpc.RpcError:
             lease.inflight -= 1
             self._drop_lease(sched_class, lease)
@@ -2049,6 +2121,17 @@ class CoreWorker:
 
     def _handle_task_reply(self, spec: TaskSpec, reply: dict,
                            exec_raylet: str):
+        wphases = reply.get("phases")
+        if wphases is not None and self.config.task_events_enabled:
+            pt = self.pending_tasks.get(spec.task_id)
+            if pt is not None:
+                ph = pt.phases
+                if ph is None:
+                    ph = pt.phases = [None] * RECORD_LEN
+                for i in range(PH_RECEIVED, RECORD_LEN):
+                    v = wphases[i]
+                    if v is not None:
+                        ph[i] = v
         if reply.get("cancelled"):
             self._complete_task_error(spec, exc.TaskCancelledError(spec.task_id),
                                       retry=False)
@@ -2179,8 +2262,9 @@ class CoreWorker:
 
     def _complete_task_ok(self, spec: TaskSpec, returns: List[dict],
                           exec_raylet: str):
-        self.pending_tasks.pop(spec.task_id, None)
-        self._record_task_event(spec, "FINISHED")
+        pt = self.pending_tasks.pop(spec.task_id, None)
+        phases = self._finish_phase_record(pt)
+        self._record_task_event(spec, "FINISHED", phases)
         for i, ret in enumerate(returns):
             self._register_return_object(spec, i, ret, exec_raylet)
 
@@ -2193,7 +2277,11 @@ class CoreWorker:
             # the contained objects stay pinned forever (ADVICE r4).
             self._return_handoff_credits(pt.arg_credits)
             pt.arg_credits = []
-        self._record_task_event(spec, "FAILED")
+        # observe=True: failed tasks fold into ray_tpu_task_phase_seconds
+        # too, so /metrics agrees with /api/latency (both read "the same
+        # record") and a latency alert fires for slow failures as well.
+        self._record_task_event(spec, "FAILED",
+                                self._finish_phase_record(pt))
         stream = self.generator_streams.get(spec.task_id)
         if stream is not None:
             stream.error = error
@@ -2370,6 +2458,7 @@ class CoreWorker:
         self.pending_tasks[task_id] = PendingTask(
             spec=spec, retries_left=max_task_retries, returns=returns,
             arg_refs=[])
+        self._stamp_phase(task_id, PH_SUBMITTED)
         asyncio.ensure_future(
             self._finish_actor_task_submission(q, spec, args, kwargs,
                                                _prebuilt))
@@ -2425,6 +2514,7 @@ class CoreWorker:
             self.pending_tasks[task_id] = PendingTask(
                 spec=spec, retries_left=max_task_retries, returns=returns,
                 arg_refs=[])
+        self._stamp_phase(task_id, PH_SUBMITTED)
         self._post_to_loop(
             self._post_threadsafe_actor_submit, q, spec, args, kwargs,
             prebuilt, new_q)
@@ -2662,22 +2752,35 @@ class CoreWorker:
             return
         address = q.address
         epoch = q.epoch
+        record = self.config.task_events_enabled
+        if record:
+            self._observe_batch_size("actor", len(live))
+            t_dispatch = time.time()
         for spec, _fut in live:
             # Shipping: the receiver's arg deserialization consumes the
             # handoff credits from here on.
             pt = self.pending_tasks.get(spec.task_id)
             if pt is not None:
                 pt.arg_credits = []
+                if record:
+                    ph = pt.phases
+                    if ph is None:
+                        ph = pt.phases = [None] * RECORD_LEN
+                    ph[PH_DISPATCHED] = t_dispatch
         try:
             if len(live) == 1:
-                replies = [await self.clients.request(
-                    address, "push_actor_task", {"spec": live[0][0]},
-                    timeout=None, retry_once=False)]
+                push_payload: dict = {"spec": live[0][0]}
+                push_method = "push_actor_task"
             else:
-                replies = await self.clients.request(
-                    address, "push_actor_tasks",
-                    {"specs": [s for s, _ in live]}, timeout=None,
-                    retry_once=False)
+                push_payload = {"specs": [s for s, _ in live]}
+                push_method = "push_actor_tasks"
+            if not record:
+                push_payload["ph"] = 0  # executor skips its stamps too
+            replies = await self.clients.request(
+                address, push_method, push_payload, timeout=None,
+                retry_once=False)
+            if len(live) == 1:
+                replies = [replies]
         except Exception as e:  # noqa: BLE001 — fan the failure out
             err = e if isinstance(e, rpc.RpcError) else rpc.RpcError(str(e))
             conn_lost = isinstance(e, rpc.ConnectionLost)
@@ -2875,29 +2978,35 @@ class CoreWorker:
     _CANCELLED = object()  # run_all sentinel: task cancelled pre-start
 
     async def _run_sync_jobs(self, jobs: list, replies: list):
-        """Execute (idx, spec, fn, args, kwargs) jobs in ONE pool job and
-        fill replies[idx] with the single-task reply envelopes. Shared by
-        the plain-task and actor batch paths — keep their semantics in one
-        place. Cancellation is re-checked immediately before each task runs
-        (a cancel mid-batch skips everything not yet started; the currently
-        running sync call is not interruptible, same as a pool future that
-        already started)."""
+        """Execute (idx, spec, fn, args, kwargs, phases) jobs in ONE pool
+        job and fill replies[idx] with the single-task reply envelopes.
+        Shared by the plain-task and actor batch paths — keep their
+        semantics in one place. Cancellation is re-checked immediately
+        before each task runs (a cancel mid-batch skips everything not yet
+        started; the currently running sync call is not interruptible,
+        same as a pool future that already started). `phases` (dict or
+        None) collects the flight recorder's exec_start/exec_end stamps
+        per task even though the batch shares one pool hop."""
 
         def run_all():
             out = []
-            for _i, _spec, fn, args, kwargs in jobs:
+            for _i, _spec, fn, args, kwargs, _ph in jobs:
                 if _spec.task_id in self._cancelled_tasks:
                     out.append((self._CANCELLED, None))
                     continue
                 self.current_task_id = _spec.task_id
+                if _ph is not None:
+                    _ph[PH_EXEC_START] = time.time()
                 try:
                     out.append((True, fn(*args, **kwargs)))
                 except BaseException as e:  # noqa: BLE001 — per-task fault
                     out.append((False, (e, traceback.format_exc())))
+                if _ph is not None:
+                    _ph[PH_EXEC_END] = time.time()
             return out
 
         results = await self._run_in_pool(run_all)
-        for (i, spec, _f, _a, _kw), (ok, res) in zip(jobs, results):
+        for (i, spec, _f, _a, _kw, ph), (ok, res) in zip(jobs, results):
             self.current_task_id = spec.task_id
             try:
                 if ok is self._CANCELLED:
@@ -2906,12 +3015,17 @@ class CoreWorker:
                     values = self._split_returns(res, spec.num_returns)
                     returns = await self._store_returns(spec, values)
                     replies[i] = {"returns": returns}
+                    if ph is not None:
+                        ph[PH_RESULT_PUT] = time.time()
+                        replies[i]["phases"] = ph
                 else:
                     e, tb_str = res
                     err = exc.TaskError(e, tb_str, spec.task_id, os.getpid())
                     returns = await self._store_returns(
                         spec, [err] * spec.num_returns, is_exception=True)
                     replies[i] = self._app_error_envelope(err, returns)
+                    if ph is not None:
+                        replies[i]["phases"] = ph
             except Exception as e:  # noqa: BLE001 — e.g. bad num_returns
                 replies[i] = {"system_error": f"{type(e).__name__}: {e}"}
             finally:
@@ -2966,8 +3080,10 @@ class CoreWorker:
         # must enforce it itself).
         current_env_key: Any = ()
 
+        want_ph = payload.get("ph", 1)
         async with self._task_exec_lock:
             for i, spec in enumerate(specs):
+                ph = self._new_exec_phases(want_ph)
                 # Mirror _push_task_locked's prep + error envelope.
                 try:
                     env_key = (repr(sorted(spec.runtime_env.items()))
@@ -3001,6 +3117,8 @@ class CoreWorker:
                 except Exception as e:  # noqa: BLE001
                     replies[i] = {"system_error": f"{type(e).__name__}: {e}"}
                     continue
+                if ph is not None:
+                    ph[PH_ARGS_READY] = time.time()
                 if spec.task_id in self._cancelled_tasks:
                     self._cancelled_tasks.discard(spec.task_id)
                     replies[i] = {"cancelled": True}
@@ -3010,19 +3128,36 @@ class CoreWorker:
                     await flush_jobs()
                     try:
                         replies[i] = await self._push_task_locked(
-                            {"spec": spec})
+                            {"spec": spec, "ph": want_ph})
                     except Exception as e:  # noqa: BLE001
                         replies[i] = {
                             "system_error": f"{type(e).__name__}: {e}"}
                     continue
-                sync_jobs.append((i, spec, func, args, kwargs))
+                sync_jobs.append((i, spec, func, args, kwargs, ph))
             await flush_jobs()
         return replies
 
 
+    def _new_exec_phases(self, want: int = 1) -> Optional[list]:
+        """Executor-side flight-recorder record, stamped 'received' (None
+        with events off). Shipped back inside the reply envelope under
+        "phases"; the worker-id slot identifies this worker for the
+        cross-process flow events in the timeline. `want` is the OWNER's
+        recorder state (push payload "ph" key): an owner with events off
+        turns the executor-side stamping off too, so the off-mode (and
+        the bench's overhead delta) covers the whole pipeline, not just
+        the owner half."""
+        if not want or not self.config.task_events_enabled:
+            return None
+        ph = [None] * RECORD_LEN
+        ph[PH_RECEIVED] = time.time()
+        ph[IDX_WORKER] = self._worker_hex
+        return ph
+
     async def _push_task_locked(self, payload):
         spec: TaskSpec = payload["spec"]
         self.current_task_id = spec.task_id
+        ph = self._new_exec_phases(payload.get("ph", 1))
         try:
             await self._ensure_runtime_env(spec.runtime_env)
             func = await self._load_function(spec.function_id)
@@ -3036,6 +3171,8 @@ class CoreWorker:
             return self._app_error_envelope(err, returns)
         except Exception as e:  # noqa: BLE001
             return {"system_error": f"{type(e).__name__}: {e}"}
+        if ph is not None:
+            ph[PH_ARGS_READY] = time.time()
         span = self._maybe_start_span(spec)
         try:
             if spec.task_id in self._cancelled_tasks:
@@ -3045,6 +3182,8 @@ class CoreWorker:
             if spec.is_generator:
                 return await self._execute_generator_task(spec, func, args,
                                                           kwargs)
+            if ph is not None:
+                ph[PH_EXEC_START] = time.time()
             if asyncio.iscoroutinefunction(func):
                 task = asyncio.ensure_future(func(*args, **kwargs))
                 self._running_tasks[spec.task_id] = task
@@ -3053,9 +3192,15 @@ class CoreWorker:
                 fut = self._run_in_pool(func, *args, **kwargs)
                 self._running_tasks[spec.task_id] = fut
                 result = await fut
+            if ph is not None:
+                ph[PH_EXEC_END] = time.time()
             values = self._split_returns(result, spec.num_returns)
             returns = await self._store_returns(spec, values)
-            return {"returns": returns}
+            reply = {"returns": returns}
+            if ph is not None:
+                ph[PH_RESULT_PUT] = time.time()
+                reply["phases"] = ph
+            return reply
         except asyncio.CancelledError:
             return {"cancelled": True}
         except Exception as e:  # noqa: BLE001
@@ -3064,7 +3209,10 @@ class CoreWorker:
                                 _os.getpid())
             returns = await self._store_returns(
                 spec, [err] * spec.num_returns, is_exception=True)
-            return self._app_error_envelope(err, returns)
+            envelope = self._app_error_envelope(err, returns)
+            if ph is not None:
+                envelope["phases"] = ph
+            return envelope
         finally:
             self._finish_span(span)
             self._running_tasks.pop(spec.task_id, None)
@@ -3253,11 +3401,12 @@ class CoreWorker:
         Everything else runs concurrently via the per-spec path (the seq
         gate and semaphore impose the actual ordering)."""
         specs = payload["specs"]
+        want_ph = payload.get("ph", 1)
         if self._can_batch_execute(specs):
-            replies = await self._execute_actor_batch(specs)
+            replies = await self._execute_actor_batch(specs, want_ph)
         else:
             replies = list(await asyncio.gather(*[
-                self._rpc_push_actor_task(conn, {"spec": s})
+                self._rpc_push_actor_task(conn, {"spec": s, "ph": want_ph})
                 for s in specs]))
         # Reply picklability is guaranteed per-entry at envelope-build time
         # (_app_error_envelope): one task's unpicklable error can no longer
@@ -3313,13 +3462,14 @@ class CoreWorker:
                 return False
         return True
 
-    async def _execute_actor_batch(self, specs) -> list:
+    async def _execute_actor_batch(self, specs, want_ph: int = 1) -> list:
         """Batch execution with single-push semantics: per-spec error
         envelopes (one task's failure must never fail — or wedge — the
         whole frame) and cancellation honored up to execution start."""
         replies: list = [None] * len(specs)
-        jobs = []  # (reply index, spec, bound method, args, kwargs)
+        jobs = []  # (reply index, spec, bound method, args, kwargs, phases)
         for i, spec in enumerate(specs):
+            ph = self._new_exec_phases(want_ph)
             await self._gate_actor_seq(spec)
             if spec.method_name == SEQ_SKIP_METHOD:
                 replies[i] = {"returns": []}
@@ -3336,9 +3486,11 @@ class CoreWorker:
             except Exception as e:  # noqa: BLE001
                 replies[i] = {"system_error": f"{type(e).__name__}: {e}"}
                 continue
+            if ph is not None:
+                ph[PH_ARGS_READY] = time.time()
             jobs.append((i, spec,
                          getattr(self.executing_actor, spec.method_name),
-                         args, kwargs))
+                         args, kwargs, ph))
         if not jobs:
             return replies
         async with self._actor_semaphore:
@@ -3354,24 +3506,30 @@ class CoreWorker:
             # Seq-slot placeholder for a submission that failed caller-side
             # (e.g. unserializable args): ordering advanced, nothing to run.
             return {"returns": []}
-        return await self._execute_actor_task(spec)
+        return await self._execute_actor_task(spec, payload.get("ph", 1))
 
-    async def _execute_actor_task(self, spec: TaskSpec) -> dict:
+    async def _execute_actor_task(self, spec: TaskSpec,
+                                  want_ph: int = 1) -> dict:
         sem = self._actor_semaphore
         if spec.concurrency_group:
             sem = getattr(self, "_group_semaphores", {}).get(
                 spec.concurrency_group, sem)
+        ph = self._new_exec_phases(want_ph)
         async with sem:
             self.current_task_id = spec.task_id
             span = None
             try:
                 method = getattr(self.executing_actor, spec.method_name)
                 args, kwargs = await self._resolve_task_args(spec)
+                if ph is not None:
+                    ph[PH_ARGS_READY] = time.time()
                 # Span covers user code only (same as normal tasks).
                 span = self._maybe_start_span(spec)
                 if spec.is_generator:
                     return await self._execute_generator_task(
                         spec, method, args, kwargs)
+                if ph is not None:
+                    ph[PH_EXEC_START] = time.time()
                 if asyncio.iscoroutinefunction(method):
                     task = asyncio.ensure_future(method(*args, **kwargs))
                     self._running_tasks[spec.task_id] = task
@@ -3380,9 +3538,15 @@ class CoreWorker:
                     fut = self._run_in_pool(method, *args, **kwargs)
                     self._running_tasks[spec.task_id] = fut
                     result = await fut
+                if ph is not None:
+                    ph[PH_EXEC_END] = time.time()
                 values = self._split_returns(result, spec.num_returns)
                 returns = await self._store_returns(spec, values)
-                return {"returns": returns}
+                reply = {"returns": returns}
+                if ph is not None:
+                    ph[PH_RESULT_PUT] = time.time()
+                    reply["phases"] = ph
+                return reply
             except _DependencyError as e:
                 return self._app_error_envelope(e.error, None)
             except asyncio.CancelledError:
@@ -3393,7 +3557,10 @@ class CoreWorker:
                                     _os.getpid())
                 returns = await self._store_returns(
                     spec, [err] * spec.num_returns, is_exception=True)
-                return self._app_error_envelope(err, returns)
+                envelope = self._app_error_envelope(err, returns)
+                if ph is not None:
+                    envelope["phases"] = ph
+                return envelope
             finally:
                 self._finish_span(span)
                 self._running_tasks.pop(spec.task_id, None)
@@ -3416,10 +3583,114 @@ class CoreWorker:
     # ==================================================================
 
     _TASK_STATE_COUNTERS: Dict[str, Any] = {}
+    # Hot-path histogram slots for per-phase latencies: one registry slot
+    # per PHASE_ORDER index (+"total" at the end), resolved once per
+    # process (same caching pattern as the state counters). The caches
+    # remember the registry generation they were built at: a
+    # metrics.clear() discards the registry, and writing into orphaned
+    # slot dicts would silently drop every later sample.
+    _PHASE_HIST_SLOTS: Optional[list] = None
+    _BATCH_HIST_SLOTS: Dict[str, Any] = {}
+    _SLOT_CACHE_GEN: int = -1
+    # Buckets sized for a control plane whose phases span ~100us..10s.
+    _PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                      0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                      10.0)
 
-    def _record_task_event(self, spec: TaskSpec, state: str):
+    @classmethod
+    def _check_slot_caches(cls, generation: int):
+        """Drop slot caches built against a cleared registry."""
+        if cls._SLOT_CACHE_GEN != generation:
+            cls._SLOT_CACHE_GEN = generation
+            cls._PHASE_HIST_SLOTS = None
+            cls._BATCH_HIST_SLOTS = {}
+            cls._TASK_STATE_COUNTERS = {}
+
+    def _stamp_phase(self, task_id: TaskID, idx: int,
+                     t: Optional[float] = None):
+        """Owner-side flight-recorder stamp (no-op with events off)."""
         if not self.config.task_events_enabled:
             return
+        pt = self.pending_tasks.get(task_id)
+        if pt is None:
+            return
+        ph = pt.phases
+        if ph is None:
+            ph = pt.phases = [None] * RECORD_LEN
+        ph[idx] = time.time() if t is None else t
+
+    @classmethod
+    def _build_phase_slots(cls) -> list:
+        from ray_tpu.util import metrics as _metrics
+        hist = _metrics.Histogram(
+            "ray_tpu_task_phase_seconds",
+            "task lifecycle phase latency (flight recorder)",
+            boundaries=cls._PHASE_BUCKETS, tag_keys=("Phase",))
+        slots = [hist._slot({"Phase": name}) for name in PHASE_ORDER]
+        slots.append(hist._slot({"Phase": "total"}))
+        cls._PHASE_HIST_SLOTS = slots
+        return slots
+
+    def _observe_phases(self, ph: list):
+        """Fold one finished task's stamps into the per-phase histograms.
+
+        Hot path (runs per task reply): fixed-index walk, ONE lock round,
+        direct slot updates — no intermediate structures."""
+        from ray_tpu.util import metrics as _metrics
+        self._check_slot_caches(_metrics._generation)
+        slots = self._PHASE_HIST_SLOTS or self._build_phase_slots()
+        with _metrics._lock:
+            prev = None
+            for i in range(N_STAMPS):
+                t = ph[i]
+                if t is None:
+                    continue
+                if prev is not None:
+                    _metrics.observe_locked(slots[i], max(0.0, t - prev))
+                prev = t
+            t0, t1 = ph[PH_SUBMITTED], ph[PH_REPLY_HANDLED]
+            if t0 is not None and t1 is not None:
+                _metrics.observe_locked(slots[N_STAMPS],
+                                        max(0.0, t1 - t0))
+
+    def _observe_batch_size(self, kind: str, n: int):
+        """Dispatch batch-size distribution (the self-clocking pipeline's
+        health signal: 1 = singles, larger = coalescing works)."""
+        if not self.config.task_events_enabled:
+            return
+        from ray_tpu.util import metrics as _m
+        self._check_slot_caches(_m._generation)
+        ent = self._BATCH_HIST_SLOTS.get(kind)
+        if ent is None:
+            from ray_tpu.util import metrics as _metrics
+            hist = _metrics.Histogram(
+                "ray_tpu_dispatch_batch_size",
+                "specs per push RPC (task and actor dispatch pipelines)",
+                boundaries=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+                tag_keys=("Kind",))
+            ent = hist._slot({"Kind": kind})
+            self._BATCH_HIST_SLOTS[kind] = ent
+        from ray_tpu.util import metrics as _metrics
+        _metrics.observe_into(ent, float(n))
+
+    def _finish_phase_record(
+            self, pt: Optional[PendingTask]) -> Optional[list]:
+        """Stamp reply_handled, feed the histograms, and return the
+        merged phase record to ride the terminal task event."""
+        if pt is None or pt.phases is None \
+                or not self.config.task_events_enabled:
+            return None
+        ph = pt.phases
+        ph[PH_REPLY_HANDLED] = time.time()
+        self._observe_phases(ph)
+        return ph
+
+    def _record_task_event(self, spec: TaskSpec, state: str,
+                           phases: Optional[list] = None):
+        if not self.config.task_events_enabled:
+            return
+        from ray_tpu.util import metrics as _m
+        self._check_slot_caches(_m._generation)
         ent = self._TASK_STATE_COUNTERS.get(state)
         if ent is None:
             # Resolve the registry slot once per state: Metric.inc()'s
@@ -3444,7 +3715,7 @@ class CoreWorker:
             spec.task_id.binary(), spec.job_id.binary(),
             spec.name or spec.method_name or spec.function_id, state,
             time.time(), spec.actor_id.binary() if spec.actor_id else None,
-            spec.resources))
+            spec.resources, phases))
         if len(self._task_events_buffer) > 20000:
             # GCS unreachable for a long stretch: drop oldest, keep a window.
             del self._task_events_buffer[:10000]
@@ -3459,21 +3730,39 @@ class CoreWorker:
                 asyncio.ensure_future(self._flush_task_events())
 
     def _task_event_dict(self, task_id: bytes, job_id: bytes, name: str,
-                         state: str, t: float, actor_id, resources) -> dict:
-        return {
+                         state: str, t: float, actor_id, resources,
+                         phases=None) -> dict:
+        out = {
             "task_id": task_id.hex(), "job_id": job_id.hex(),
             "name": name, "state": state, "time": t,
             "actor_id": actor_id.hex() if actor_id else None,
             "resources": resources,
-            "worker_id": self.worker_id.hex(),
+            "worker_id": self._worker_hex,
         }
+        if phases:
+            out["phases"] = phases
+        return out
 
     async def _flush_task_events(self):
         if not self._task_events_buffer or self.gcs is None or self.gcs.closed:
             return
         buf, self._task_events_buffer = self._task_events_buffer, []
+        # Coalesce within the flush window: a task that reached a terminal
+        # state here ships ONLY its terminal event when that event carries
+        # the full phase record — its PENDING/RUNNING rows are superseded
+        # (the latest-state queries reduce them away anyway, and the
+        # timeline draws the slice from the phases). For a fast-task
+        # burst this cuts the wire+GCS load to a third. Tasks still in
+        # flight keep their intermediate rows.
+        done_with_phases = {
+            e[0] for e in buf
+            if not isinstance(e, dict) and e[7] is not None
+            and e[3] in ("FINISHED", "FAILED")}
         events = [e if isinstance(e, dict) else self._task_event_dict(*e)
-                  for e in buf]
+                  for e in buf
+                  if isinstance(e, dict)
+                  or e[3] in ("FINISHED", "FAILED")
+                  or e[0] not in done_with_phases]
         try:
             await self.gcs.request("report_task_events", {"events": events})
         except rpc.RpcError:

@@ -1,0 +1,216 @@
+"""Flight recorder: phase-stamp vocabulary + Chrome-trace assembly.
+
+The task lifecycle is stamped at every hop (owner submit -> lease wait ->
+lease grant -> dispatch -> worker receive -> args ready -> exec ->
+result put -> owner reply handling). Owners keep their stamps on the
+PendingTask; executors ship theirs back inside the task reply; the merged
+record rides the FINISHED/FAILED task event to the GCS, where every
+observability surface (timeline, /api/latency, summarize_tasks latency
+columns, per-phase Prometheus histograms) reads the same record.
+
+Wire/memory format: a phase record is a fixed-size LIST indexed by the
+PH_* constants below (stamps are wall-clock floats, missing = None; the
+last slot carries the executing worker's id). A positional list of
+floats costs a fraction of a string-keyed dict to stamp, pickle, and
+fold — the recorder rides the task hot path, so the dict form exists
+only at the query surfaces (as_dict).
+
+Stamps are wall-clock (`time.time()`): every daemon of this framework
+shares a host (127.0.0.1 control plane), so cross-process gaps are
+directly comparable; within-process durations are exact.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# Ordered stamp names. A phase duration is the gap between two consecutive
+# *present* stamps, reported under the LATER stamp's name (e.g. the
+# "exec_end" phase is the user-code execution time; "received" is the
+# dispatch->worker wire+decode gap). Not every task carries every stamp:
+# actor calls skip the lease stamps, failed tasks stop wherever they died.
+PHASE_ORDER = (
+    "submitted",       # owner: .remote() accepted the call
+    "lease_wait",      # owner: spec entered the per-class dispatch queue
+    "lease_granted",   # owner: spec assigned to a leased worker slot
+    "dispatched",      # owner: push RPC handed to the transport
+    "received",        # worker: push handler started processing the spec
+    "args_ready",      # worker: argument resolution finished
+    "exec_start",      # worker: user code entered
+    "exec_end",        # worker: user code returned
+    "result_put",      # worker: returns serialized/stored
+    "reply_handled",   # owner: reply applied, return objects ready
+)
+
+# Record-slot indices (a record is [*stamps, worker_hex]).
+(PH_SUBMITTED, PH_LEASE_WAIT, PH_LEASE_GRANTED, PH_DISPATCHED,
+ PH_RECEIVED, PH_ARGS_READY, PH_EXEC_START, PH_EXEC_END,
+ PH_RESULT_PUT, PH_REPLY_HANDLED) = range(10)
+N_STAMPS = 10
+IDX_WORKER = 10
+RECORD_LEN = 11
+
+
+def new_record() -> list:
+    return [None] * RECORD_LEN
+
+
+def as_dict(rec: Optional[Sequence]) -> Dict[str, Any]:
+    """Named view of a phase record (query surfaces / debugging only)."""
+    if not rec:
+        return {}
+    out = {PHASE_ORDER[i]: rec[i]
+           for i in range(N_STAMPS) if rec[i] is not None}
+    if len(rec) > IDX_WORKER and rec[IDX_WORKER]:
+        out["w"] = rec[IDX_WORKER]
+    return out
+
+
+def phase_durations(rec: Sequence) -> List[Tuple[str, float]]:
+    """(phase, seconds) for every consecutive pair of present stamps,
+    plus ("total", submit->reply) when both endpoints exist. Negative
+    gaps (cross-process clock skew) clamp to zero."""
+    out: List[Tuple[str, float]] = []
+    prev: Optional[float] = None
+    for i in range(N_STAMPS):
+        t = rec[i]
+        if t is None:
+            continue
+        if prev is not None:
+            out.append((PHASE_ORDER[i], max(0.0, t - prev)))
+        prev = t
+    t0, t1 = rec[PH_SUBMITTED], rec[PH_REPLY_HANDLED]
+    if t0 is not None and t1 is not None:
+        out.append(("total", max(0.0, t1 - t0)))
+    return out
+
+
+# Worker-lane sub-slices drawn inside the task slice on the timeline.
+SUB_SLICES = (
+    ("args_resolve", PH_RECEIVED, PH_ARGS_READY),
+    ("exec", PH_EXEC_START, PH_EXEC_END),
+    ("result_put", PH_EXEC_END, PH_RESULT_PUT),
+)
+
+_EMPTY: tuple = (None,) * RECORD_LEN
+
+
+def build_trace(events: List[dict]) -> List[dict]:
+    """Chrome-trace (chrome://tracing / Perfetto) event list from raw task
+    events.
+
+    Emits, per completed task:
+      - the task slice ("X", cat "task") on the executing worker's lane;
+      - phase sub-slices ("X", cat "phase", tid 1) nested inside it
+        (args_resolve / exec / result_put), clamped into the task slice;
+      - a "submit" slice on the owner's lane covering submit->dispatch;
+      - one flow-event pair (ph "s"/"f", shared id) connecting the submit
+        on the owner to the execution start on the worker across pids.
+    Span records (tracing.enable()) are skipped — get_spans() owns those.
+    """
+    trace: List[dict] = []
+    starts: Dict[str, dict] = {}
+    for e in events:
+        if not isinstance(e, dict) or e.get("kind") == "span":
+            continue
+        state = e.get("state")
+        task_id = e.get("task_id")
+        if state == "RUNNING":
+            starts[task_id] = e
+            continue
+        if state not in ("FINISHED", "FAILED"):
+            continue
+        s = starts.pop(task_id, None)
+        ph = e.get("phases") or _EMPTY
+        owner_pid = (e.get("worker_id") or "")[:8]
+        exec_pid = (ph[IDX_WORKER] or e.get("worker_id") or "")[:8]
+        name = e.get("name", "")
+        task_ts = task_end = None
+        if s is not None:
+            task_ts = s["time"] * 1e6
+        else:
+            # Coalesced flush dropped the RUNNING row (the terminal event
+            # carries the full phase record instead): the slice starts at
+            # the dispatch/receive stamp.
+            start = ph[PH_DISPATCHED] or ph[PH_RECEIVED]
+            if start is not None:
+                task_ts = start * 1e6
+        if task_ts is not None:
+            task_end = max(e["time"] * 1e6, task_ts)
+            trace.append({
+                "cat": "task", "name": name, "ph": "X",
+                "ts": task_ts, "dur": task_end - task_ts,
+                "pid": exec_pid, "tid": 0, "state": state,
+                "task_id": task_id,
+            })
+        for sub_name, a, b in SUB_SLICES:
+            ta, tb = ph[a], ph[b]
+            if ta is None or tb is None:
+                continue
+            ts, end = ta * 1e6, max(ta, tb) * 1e6
+            if task_ts is not None:
+                # Nest inside the task slice (clock skew must not push a
+                # sub-slice outside its parent).
+                ts = min(max(ts, task_ts), task_end)
+                end = min(max(end, ts), task_end)
+            trace.append({
+                "cat": "phase", "name": sub_name, "ph": "X",
+                "ts": ts, "dur": end - ts,
+                "pid": exec_pid, "tid": 1, "task_id": task_id,
+            })
+        submitted = ph[PH_SUBMITTED]
+        if submitted is None:
+            continue
+        sub_ts = submitted * 1e6
+        dispatch_end = max(
+            sub_ts, (ph[PH_DISPATCHED] or submitted) * 1e6)
+        trace.append({
+            "cat": "phase", "name": "submit", "ph": "X",
+            "ts": sub_ts, "dur": dispatch_end - sub_ts,
+            "pid": owner_pid, "tid": 0, "task_id": task_id,
+        })
+        exec_ts = ph[PH_EXEC_START]
+        flow_end = (exec_ts * 1e6 if exec_ts is not None else task_ts)
+        if flow_end is None:
+            continue
+        trace.append({
+            "cat": "flow", "name": "task_flow", "ph": "s", "id": task_id,
+            "ts": sub_ts, "pid": owner_pid, "tid": 0,
+            "task_id": task_id,
+        })
+        trace.append({
+            "cat": "flow", "name": "task_flow", "ph": "f", "bp": "e",
+            "id": task_id, "ts": max(flow_end, sub_ts), "pid": exec_pid,
+            "tid": 0, "task_id": task_id,
+        })
+    return trace
+
+
+def latency_summary(events: List[dict]) -> List[dict]:
+    """Per-(task name, phase) p50/p95 rows from task events with phases:
+    the data behind `ray_tpu summary`'s latency table and the dashboard
+    Latency panel."""
+    acc: Dict[Tuple[str, str], List[float]] = {}
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        ph = e.get("phases")
+        if not ph:
+            continue
+        name = e.get("name", "")
+        for phase, d in phase_durations(ph):
+            acc.setdefault((name, phase), []).append(d)
+    rows = []
+    for (name, phase), ds in sorted(acc.items()):
+        ds.sort()
+        n = len(ds)
+        # Nearest-rank percentiles: ceil(q*n)-1. (int(q*n) is one rank
+        # too high — for n<=20 it reports the sample MAX as the p95.)
+        p50 = ds[max(0, -(-n // 2) - 1)]
+        p95 = ds[max(0, -(-(n * 19) // 20) - 1)]
+        rows.append({
+            "name": name, "phase": phase, "count": n,
+            "p50_ms": round(p50 * 1e3, 3),
+            "p95_ms": round(p95 * 1e3, 3),
+        })
+    return rows

@@ -40,9 +40,18 @@ class Pubsub:
     their connection; publishes push to every subscribed live connection.
     """
 
-    def __init__(self):
+    def __init__(self, max_outbox: int = 2000):
         # channel -> set of connections
         self._subs: Dict[str, set] = {}
+        # Slow-consumer protection (ROADMAP follow-on): once a subscriber's
+        # transport buffer backs up, its frames divert into a bounded
+        # per-connection outbox drained by a flusher that respects the
+        # socket's backpressure. Past the cap the OLDEST frame drops —
+        # a stalled subscriber costs O(max_outbox), not unbounded memory.
+        self.max_outbox = max(1, int(max_outbox))
+        self._outboxes: Dict[rpc.Connection, object] = {}  # conn -> deque
+        self._flushing: set = set()
+        self.dropped_total = 0
 
     def subscribe(self, conn: rpc.Connection, channels: List[str]):
         for ch in channels:
@@ -61,15 +70,24 @@ class Pubsub:
     def drop_connection(self, conn: rpc.Connection):
         for subs in self._subs.values():
             subs.discard(conn)
+        self._outboxes.pop(conn, None)
+        self._flushing.discard(conn)
+
+    def outbox_depths(self) -> Dict[str, int]:
+        """Per-subscriber backlog depth (observability surface)."""
+        return {f"conn-{id(conn) & 0xffffff:06x}": len(box)
+                for conn, box in self._outboxes.items()}
 
     def publish(self, channel: str, message):
         """Fan a message out to every live subscriber, synchronously.
 
-        push_nowait queues one frame per subscriber; everything published
-        within the same loop tick coalesces into a single BATCH envelope
-        per subscriber connection (one pickle + one write), so a publish
-        storm costs the GCS O(ticks), not O(messages) — and no coroutine
-        is spawned per (message, subscriber) pair."""
+        Fast path: push_nowait queues one frame per subscriber;
+        everything published within the same loop tick coalesces into a
+        single BATCH envelope per subscriber connection (one pickle + one
+        write), so a publish storm costs the GCS O(ticks), not
+        O(messages) — and no coroutine is spawned per (message,
+        subscriber) pair. Subscribers whose socket has backed up divert
+        to the bounded outbox instead (see __init__)."""
         conns = self._subs.get(channel)
         if not conns:
             return
@@ -79,9 +97,44 @@ class Pubsub:
                 conns.discard(conn)
                 continue
             try:
-                conn.push_nowait("pub", payload)
+                self._deliver(conn, payload)
             except Exception:  # noqa: BLE001 — subscriber died mid-publish
                 self.drop_connection(conn)
+
+    def _deliver(self, conn: rpc.Connection, payload: dict):
+        box = self._outboxes.get(conn)
+        if box is None:
+            if not conn.write_backed_up():
+                conn.push_nowait("pub", payload)   # healthy: zero-copy path
+                return
+            from collections import deque
+            box = self._outboxes[conn] = deque()
+        box.append(payload)
+        if len(box) > self.max_outbox:
+            box.popleft()
+            self.dropped_total += 1
+        if conn not in self._flushing:
+            self._flushing.add(conn)
+            asyncio.ensure_future(self._flush_outbox(conn))
+
+    async def _flush_outbox(self, conn: rpc.Connection):
+        """Drain one subscriber's backlog at the pace its socket accepts
+        (conn.push awaits drain past the transport high-water mark).
+        Frames published while a backlog exists append to it, preserving
+        per-subscriber delivery order."""
+        try:
+            while not conn.closed:
+                box = self._outboxes.get(conn)
+                if not box:
+                    break
+                await conn.push("pub", box.popleft())
+        except Exception:  # noqa: BLE001 — subscriber died mid-drain
+            self.drop_connection(conn)
+        finally:
+            self._flushing.discard(conn)
+            box = self._outboxes.get(conn)
+            if not box:
+                self._outboxes.pop(conn, None)
 
 
 class GcsServer:
@@ -89,7 +142,7 @@ class GcsServer:
         self.config = config
         self.session_dir = session_dir
         self.server = rpc.RpcServer("gcs")
-        self.pubsub = Pubsub()
+        self.pubsub = Pubsub(max_outbox=config.pubsub_max_outbox)
         self.clients = rpc.ClientPool()
 
         self.nodes: Dict[NodeID, NodeInfo] = {}
@@ -113,6 +166,7 @@ class GcsServer:
         self._drain_tasks: Dict[NodeID, asyncio.Task] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._persist_task: Optional[asyncio.Task] = None
+        self._lag_task: Optional[asyncio.Task] = None
         self._dirty = False
         self._ext_store = None  # ExternalStoreClient when configured
         self.address = ""
@@ -146,17 +200,28 @@ class GcsServer:
         self._health_task = asyncio.ensure_future(self._health_loop())
         if self.session_dir or self._ext_store is not None:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
+        # The GCS claims the process's single metrics-reporter slot: when
+        # raylets and a driver core share this process (local init), their
+        # snapshots of the SAME registry must not be pushed on top of the
+        # local merge below (double counting).
+        from ray_tpu.util import metrics as _metrics
+        _metrics.claim_reporter(self, force=True)
+        self._lag_task = _metrics.start_loop_lag_probe("gcs")
         await self._start_http(host)
         logger.info("GCS started at %s", self.address)
         return self.address
 
     async def stop(self):
+        from ray_tpu.util import metrics as _metrics
+        _metrics.release_reporter(self)
         for task in self._drain_tasks.values():
             task.cancel()
         if self._health_task:
             self._health_task.cancel()
         if self._persist_task:
             self._persist_task.cancel()
+        if self._lag_task:
+            self._lag_task.cancel()
         if self._http_server is not None:
             self._http_server.close()
         await self.server.stop()
@@ -307,6 +372,7 @@ class GcsServer:
                     "/api/jobs": self._jobs_table,
                     "/api/pgs": self._pgs_table,
                     "/api/tasks": self._tasks_summary,
+                    "/api/latency": self._latency_summary,
                     "/api/timeline": self._timeline_trace,
                     "/api/logs": self._logs_index,
                     "/api/logtail": lambda: self._log_tail(
@@ -388,9 +454,22 @@ class GcsServer:
             gauge("ray_tpu_resource_total", totals[k], "", Resource=k)
             gauge("ray_tpu_resource_available", avail.get(k, 0.0), "",
                   Resource=k)
+        # Pubsub fan-out health (slow-consumer outboxes; tentpole gauges).
+        g.append({"name": "ray_tpu_pubsub_dropped_total", "type": "counter",
+                  "description": "pubsub frames dropped for stalled "
+                                 "subscribers (oldest-first past the "
+                                 "outbox cap)",
+                  "tags": {}, "value": float(self.pubsub.dropped_total)})
+        for sub, depth in self.pubsub.outbox_depths().items():
+            gauge("ray_tpu_pubsub_outbox_depth", depth,
+                  "queued pubsub frames per slow subscriber",
+                  Subscriber=sub)
+        gauge("ray_tpu_task_events_buffered", len(self.task_events),
+              "task events held in the GCS ring buffer")
         return g
 
     def _merged_metrics(self) -> list:
+        from ray_tpu._private import rpc as _rpc
         from ray_tpu.util import metrics as m
         # Dead reporters (reaped workers, finished drivers) stop pushing;
         # drop their snapshots after a grace period so gauges don't sum
@@ -400,8 +479,14 @@ class GcsServer:
         for reporter in [r for r, (ts, _) in self.metrics_reports.items()
                          if now - ts > ttl]:
             del self.metrics_reports[reporter]
-        merged = m.merge_snapshots(
-            [snap for _, snap in self.metrics_reports.values()])
+        snaps = [snap for _, snap in self.metrics_reports.values()]
+        if m.claim_reporter(self):
+            # This process's registry (GCS + any co-resident raylet/driver
+            # core) is served locally; nobody else pushes it (see
+            # claim_reporter), so add it exactly once here.
+            _rpc.export_transport_metrics()
+            snaps.append(m.snapshot())
+        merged = m.merge_snapshots(snaps)
         return merged + self._internal_metrics()
 
     def _status_summary(self) -> dict:
@@ -451,24 +536,32 @@ class GcsServer:
         } for p in self.placement_groups.values()]
 
     def _timeline_trace(self) -> list:
-        """Chrome-trace 'X' events from the task-event buffer (server-side
-        twin of ray_tpu.timeline(); feeds the dashboard timeline panel)."""
-        trace = []
-        starts: Dict[str, dict] = {}
-        for e in self.task_events:
-            if e.get("state") == "RUNNING":
-                starts[e["task_id"]] = e
-            elif e.get("state") in ("FINISHED", "FAILED") \
-                    and e.get("task_id") in starts:
-                s = starts.pop(e["task_id"])
-                trace.append({
-                    "cat": "task", "name": e.get("name", ""), "ph": "X",
-                    "ts": s["time"] * 1e6,
-                    "dur": (e["time"] - s["time"]) * 1e6,
-                    "pid": e.get("worker_id", "")[:8], "tid": 0,
-                    "state": e.get("state"),
-                })
-        return trace
+        """Chrome-trace events from the task-event buffer (server-side
+        twin of ray_tpu.timeline(); feeds the dashboard timeline panel):
+        per-task slices, phase sub-slices, and cross-process flow events
+        assembled by the shared flightrec builder."""
+        from ray_tpu._private import flightrec
+        return flightrec.build_trace(self.task_events)
+
+    def _latency_summary(self) -> list:
+        """Per-(task name, phase) p50/p95 latency rows — the dashboard
+        Latency panel and `ray_tpu summary`'s latency columns.
+
+        Memoized for 2s: the fold walks the whole event ring (up to 100k
+        rows) on the GCS loop, and the dashboard polls every 2s — without
+        the cache a busy ring would stall heartbeat/pubsub handling on
+        every poll (the very loop lag the recorder measures)."""
+        from ray_tpu._private import flightrec
+        now = time.time()
+        cached = getattr(self, "_latency_cache", None)
+        if cached is not None and now - cached[0] < 2.0:
+            return cached[1]
+        rows = flightrec.latency_summary(self.task_events)
+        self._latency_cache = (now, rows)
+        return rows
+
+    async def rpc_get_task_latency(self, conn, payload):
+        return self._latency_summary()
 
     def _logs_dir(self) -> str:
         return os.path.join(self.session_dir, "logs") \
@@ -711,8 +804,13 @@ class GcsServer:
 
     async def _health_loop(self):
         cfg = self.config
+        from ray_tpu.util import metrics as _metrics
         while True:
             await asyncio.sleep(cfg.heartbeat_interval_s)
+            # Keep the process's metrics-reporter claim fresh — and
+            # authoritative: a live GCS always owns its process's slot
+            # (see metrics.claim_reporter force semantics).
+            _metrics.claim_reporter(self, force=True)
             now = time.time()
             for node_id, info in list(self.nodes.items()):
                 if info.alive and now - info.last_heartbeat > cfg.node_death_timeout_s:
@@ -1282,12 +1380,37 @@ class GcsServer:
         return True
 
     async def rpc_get_task_events(self, conn, payload):
+        """Raw or reduced task-event query.
+
+        `latest_only=True` collapses to the newest event per task_id
+        SERVER-side before `limit` applies, so a `list_tasks(limit=10)`
+        ships 10 rows over the wire instead of the whole 100k-event ring
+        (satellite of the flight-recorder PR; previously every client
+        query shipped the raw buffer and reduced locally). State filters
+        evaluate after the reduction — filtering raw events by state
+        would resurrect superseded states (a FINISHED task still has an
+        old RUNNING event that would match state="RUNNING")."""
         job_id = payload.get("job_id")
         limit = payload.get("limit", 10000)
-        filters = payload.get("filters")
-        out = [e for e in self.task_events
-               if (job_id is None or e.get("job_id") == job_id)
-               and self._match_filters(e, filters)]
+        filters = list(payload.get("filters") or [])
+        state_filters = [f for f in filters if f[0] == "state"]
+        other_filters = [f for f in filters if f[0] != "state"]
+        if not payload.get("latest_only"):
+            out = [e for e in self.task_events
+                   if (job_id is None or e.get("job_id") == job_id)
+                   and self._match_filters(e, filters)]
+            return out[-limit:]
+        latest: Dict[str, dict] = {}
+        for e in self.task_events:
+            if job_id is not None and e.get("job_id") != job_id:
+                continue
+            if e.get("kind") == "span":
+                continue
+            if not self._match_filters(e, other_filters):
+                continue
+            latest[e.get("task_id")] = e
+        out = [e for e in latest.values()
+               if self._match_filters(e, state_filters)]
         return out[-limit:]
 
     # ------------- persistence (GCS fault tolerance) -------------
@@ -1378,6 +1501,14 @@ _DASHBOARD_HTML = """<!doctype html>
  <th>name</th><th>state</th><th>count</th></tr></thead><tbody></tbody>
  </table>
 </div>
+<div class="panel" id="p-latency">
+ <p style="font-size:.8rem;color:#666">Flight-recorder phase latency per
+ task name (p50/p95 over the event buffer; phases per
+ README&nbsp;metrics catalog).</p>
+ <table id="latency"><thead><tr>
+ <th>name</th><th>phase</th><th>count</th><th>p50 ms</th><th>p95 ms</th>
+ </tr></thead><tbody></tbody></table>
+</div>
 <div class="panel" id="p-timeline">
  <p style="font-size:.8rem;color:#666">Completed task spans per worker
  (latest buffer; darker = FAILED).</p>
@@ -1392,8 +1523,8 @@ _DASHBOARD_HTML = """<!doctype html>
 </div>
 <script>
 const TABS=[["overview","Overview"],["actors","Actors"],["jobs","Jobs/PGs"],
-  ["tasks","Tasks"],["timeline","Timeline"],["logs","Logs"],
-  ["metrics","Metrics"]];
+  ["tasks","Tasks"],["latency","Latency"],["timeline","Timeline"],
+  ["logs","Logs"],["metrics","Metrics"]];
 let active="overview", logFile=null;
 const nav=document.getElementById('tabs');
 for(const [id,label] of TABS){
@@ -1460,6 +1591,9 @@ function drawCards(prom,st){
  d.append(b,s); cards.appendChild(d);
 }
 function drawTimeline(trace){
+ // Lanes draw the task slices; the full export (flow events + phase
+ // sub-slices) is for chrome://tracing / Perfetto via `ray_tpu timeline`.
+ trace=trace.filter(e=>e.ph==='X'&&e.cat==='task');
  const c=document.getElementById('timelineC');
  c.width=c.clientWidth; c.height=420;
  const g=c.getContext('2d'); g.clearRect(0,0,c.width,c.height);
@@ -1556,6 +1690,8 @@ async function tick(){
   }
   if(active==='tasks') await fillTable('/api/tasks', '#tasks',
     t=>[t.name, t.state, t.count]);
+  if(active==='latency') await fillTable('/api/latency', '#latency',
+    r=>[r.name, r.phase, r.count, r.p50_ms, r.p95_ms]);
   if(active==='timeline')
     drawTimeline(await (await fetch('/api/timeline')).json());
   if(active==='logs') await drawLogs();

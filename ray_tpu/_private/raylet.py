@@ -327,6 +327,9 @@ class Raylet:
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._idle_worker_reaper()))
         self._tasks.append(asyncio.ensure_future(self._start_forkserver()))
+        self._tasks.append(asyncio.ensure_future(self._report_metrics_loop()))
+        from ray_tpu.util import metrics as _metrics
+        self._tasks.append(_metrics.start_loop_lag_probe("raylet"))
         # Worker stdout/stderr -> GCS "logs" pubsub -> driver echo
         # (reference: log_monitor.py LogMonitor).
         from ray_tpu._private.log_monitor import LogMonitor
@@ -376,6 +379,12 @@ class Raylet:
 
     async def stop(self):
         self._stopped = True
+        from ray_tpu.util import metrics as _metrics
+        _metrics.release_reporter(self)
+        for gname in ("ray_tpu_raylet_pending_leases",
+                      "ray_tpu_raylet_idle_workers",
+                      "ray_tpu_raylet_leased_workers"):
+            _metrics.remove(gname, {"Node": self.node_name})
         if getattr(self, "log_monitor", None) is not None:
             self.log_monitor.stop()
         if getattr(self, "memory_monitor", None) is not None:
@@ -420,6 +429,44 @@ class Raylet:
                 self.cluster_view[node_id] = view
         await self.gcs_conn.request(
             "subscribe", {"channels": ["resources", "nodes", "actors"]})
+
+    async def _report_metrics_loop(self):
+        """Node-side flight-recorder gauges (worker pool + lease queue
+        depth) plus the registry push for processes where the raylet is
+        the only daemon (`ray_tpu start` worker nodes). When the GCS or a
+        driver core shares this process, the per-process reporter claim
+        leaves the push to whoever claimed first — the gauges still
+        update in the shared registry either way."""
+        from ray_tpu.util import metrics as _metrics
+        reporter = f"raylet:{self.node_name}"
+        while not self._stopped:
+            await asyncio.sleep(self.config.metrics_report_interval_s)
+            tags = {"Node": self.node_name}
+
+            def g(name, desc):
+                return _metrics.Gauge(name, desc, tag_keys=("Node",))
+
+            g("ray_tpu_raylet_pending_leases",
+              "lease requests queued at the raylet").set(
+                float(len(self._pending_leases)), tags=tags)
+            g("ray_tpu_raylet_idle_workers",
+              "registered workers idle in the pool").set(
+                float(len(self._idle_workers)), tags=tags)
+            g("ray_tpu_raylet_leased_workers",
+              "workers currently leased out").set(
+                float(sum(1 for w in self.workers.values() if w.leased)),
+                tags=tags)
+            if not _metrics.claim_reporter(self):
+                continue
+            rpc.export_transport_metrics()
+            snap = _metrics.snapshot()
+            if not snap:
+                continue
+            try:
+                await self.gcs_conn.request("report_metrics", {
+                    "reporter": reporter, "metrics": snap})
+            except rpc.RpcError:
+                pass
 
     async def _heartbeat_loop(self):
         while not self._stopped:

@@ -41,7 +41,10 @@ _BATCHING_DEFAULT = os.environ.get(
 
 # Process-wide transport totals (frames vs writes is the fan-in batching
 # health signal: frames/write >> 1 under load means coalescing works).
-_stats = {"frames": 0, "writes": 0, "bytes": 0, "batched_frames": 0}
+# `inflight_requests` counts outstanding request() awaits across every
+# connection of the process — the transport-level pipeline depth.
+_stats = {"frames": 0, "writes": 0, "bytes": 0, "batched_frames": 0,
+          "inflight_requests": 0}
 
 
 def transport_stats() -> dict:
@@ -57,7 +60,9 @@ def export_transport_metrics():
                       ("ray_tpu_rpc_writes_total", "writes"),
                       ("ray_tpu_rpc_bytes_total", "bytes"),
                       ("ray_tpu_rpc_batched_frames_total",
-                       "batched_frames")):
+                       "batched_frames"),
+                      ("ray_tpu_rpc_inflight_requests",
+                       "inflight_requests")):
         metrics.Gauge(name, "rpc transport counter").set(float(_stats[key]))
 
 # ---- deterministic race-shaking (reference: ray_config_def.h:838
@@ -286,6 +291,14 @@ class Connection:
             if fut is not None and not fut.done():
                 fut.set_exception(e)
 
+    def write_backed_up(self) -> bool:
+        """Transport write buffer past the high-water mark: the peer is
+        not draining. Shared predicate for send()'s backpressure and the
+        GCS pubsub's slow-subscriber detection."""
+        transport = self.writer.transport
+        return (transport is not None
+                and transport.get_write_buffer_size() > self.HIGH_WATER)
+
     async def send(self, kind: int, msg_id: int, method: str, payload: Any):
         self.send_nowait(kind, msg_id, method, payload)
         if (len(self._out) >= self.MAX_BATCH_FRAMES
@@ -295,9 +308,7 @@ class Connection:
             # pickle (worst case past _MAX_MSG, and 2x peak memory).
             self._flush()
             self._flush_scheduled = True  # later frames keep queueing
-        transport = self.writer.transport
-        if (transport is not None
-                and transport.get_write_buffer_size() > self.HIGH_WATER):
+        if self.write_backed_up():
             await self.writer.drain()
 
     async def request(self, method: str, payload: Any = None,
@@ -305,10 +316,12 @@ class Connection:
         msg_id = next(self._ids)
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
+        _stats["inflight_requests"] += 1
         try:
             await self.send(REQUEST, msg_id, method, payload)
             return await asyncio.wait_for(fut, timeout)
         finally:
+            _stats["inflight_requests"] -= 1
             self._pending.pop(msg_id, None)
 
     async def notify(self, method: str, payload: Any = None):

@@ -381,20 +381,17 @@ def internal_kv_keys(prefix: bytes = b"", namespace: str = "kv") -> List[bytes]:
 
 
 def timeline(job_id=None) -> List[dict]:
-    """Chrome-trace-format task timeline (reference: ray.timeline)."""
+    """Chrome-trace-format task timeline (reference: ray.timeline).
+
+    Flight-recorder upgrade: besides one "X" slice per completed task,
+    the export carries per-phase sub-slices (args_resolve / exec /
+    result_put on the executing worker's lane, submit->dispatch on the
+    owner's) and `ph:"s"/"f"` flow events that connect a submission on
+    the driver to its execution on the worker across pids — load the
+    file in chrome://tracing or Perfetto to follow a task hop by hop."""
+    from ray_tpu._private import flightrec
     core = get_core()
     events = _call_on_core_loop(
-        core, core.gcs.request("get_task_events", {"job_id": None}), 30)
-    trace = []
-    starts: Dict[str, dict] = {}
-    for e in events:
-        if e["state"] == "RUNNING":
-            starts[e["task_id"]] = e
-        elif e["state"] in ("FINISHED", "FAILED") and e["task_id"] in starts:
-            s = starts.pop(e["task_id"])
-            trace.append({
-                "cat": "task", "name": e["name"], "ph": "X",
-                "ts": s["time"] * 1e6, "dur": (e["time"] - s["time"]) * 1e6,
-                "pid": e.get("worker_id", "")[:8], "tid": 0,
-            })
-    return trace
+        core, core.gcs.request("get_task_events",
+                               {"job_id": job_id, "limit": 100000}), 30)
+    return flightrec.build_trace(events)

@@ -127,8 +127,16 @@ def cmd_list(args):
 
 def cmd_summary(args):
     ray_tpu = _connect(args)
-    from ray_tpu.util.state import summarize_tasks
+    from ray_tpu.util.state import summarize_task_latency, summarize_tasks
     print(json.dumps(summarize_tasks(), indent=2))
+    rows = summarize_task_latency()
+    if rows:
+        # Flight-recorder latency columns: p50/p95 per lifecycle phase.
+        print(f"\n{'name':<24}{'phase':<16}{'count':>7}"
+              f"{'p50 ms':>10}{'p95 ms':>10}")
+        for r in rows:
+            print(f"{r['name']:<24.24}{r['phase']:<16}{r['count']:>7}"
+                  f"{r['p50_ms']:>10.3f}{r['p95_ms']:>10.3f}")
     ray_tpu.shutdown()
 
 

@@ -10,6 +10,7 @@ aggregate in Prometheus text format over HTTP (gcs.py _MetricsHttpServer).
 
 from __future__ import annotations
 
+import bisect
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -18,6 +19,43 @@ DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 
 _lock = threading.Lock()
 _registry: Dict[Tuple[str, tuple], dict] = {}
+
+# One metrics pusher per process: the registry is process-global, so when
+# several daemons share a process (local init runs GCS + raylet + driver
+# core in one), only ONE of them may ship/serve the registry or every
+# metric would be double-counted in the merge. First claimant wins and
+# must REFRESH its claim periodically (every report/health tick); a claim
+# not refreshed within _CLAIM_STALE_S is forfeited, so a claimant torn
+# down without release() (hard-killed daemon, chaos test) cannot starve
+# the rest of the process of a metrics pusher forever. release() frees
+# the slot immediately for the next cluster brought up in this process.
+_reporter_owner: Optional[object] = None
+_reporter_ts: float = 0.0
+_CLAIM_STALE_S = 6.0
+
+
+def claim_reporter(owner: object, force: bool = False) -> bool:
+    """force=True (the GCS): steal the slot even from a live claimant —
+    a GCS serves its process's registry directly from _merged_metrics,
+    and a zombie core worker (torn-down cluster, loop thread still
+    ticking) must not starve it by refreshing a stale claim forever."""
+    global _reporter_owner, _reporter_ts
+    import time
+    with _lock:
+        now = time.monotonic()
+        if (force or _reporter_owner is None or _reporter_owner is owner
+                or now - _reporter_ts > _CLAIM_STALE_S):
+            _reporter_owner = owner
+            _reporter_ts = now
+            return True
+        return False
+
+
+def release_reporter(owner: object) -> None:
+    global _reporter_owner
+    with _lock:
+        if _reporter_owner is owner:
+            _reporter_owner = None
 
 
 def _key(name: str, tags: Optional[dict]) -> Tuple[str, tuple]:
@@ -84,23 +122,39 @@ class Histogram(Metric):
         super().__init__(name, description, tag_keys)
         self._bounds = tuple(boundaries or DEFAULT_BUCKETS)
 
-    def observe(self, value: float, tags: Optional[dict] = None):
+    def _slot(self, tags: Optional[dict] = None) -> dict:
+        """Registry entry for one tag combination, created on demand.
+
+        Hot-path handle: resolving the key (tag merge + sort) once and
+        batching observes via observe_into/observe_many skips the
+        per-observe dict work that a naive .observe() pays."""
         k = _key(self._name, self._tags(tags))
         with _lock:
-            ent = _registry.setdefault(k, {
+            return _registry.setdefault(k, {
                 "name": self._name, "type": self.TYPE,
                 "description": self._description,
                 "tags": dict(self._tags(tags)), "bounds": self._bounds,
                 "bucket_counts": [0] * (len(self._bounds) + 1),
                 "sum": 0.0, "count": 0})
-            idx = len(self._bounds)
-            for i, b in enumerate(self._bounds):
-                if value <= b:
-                    idx = i
-                    break
-            ent["bucket_counts"][idx] += 1
-            ent["sum"] += value
-            ent["count"] += 1
+
+    def observe(self, value: float, tags: Optional[dict] = None):
+        observe_into(self._slot(tags), value)
+
+
+def observe_locked(ent: dict, value: float) -> None:
+    """Histogram slot update body — caller must hold `_lock`. The single
+    copy of the bucket semantics, shared by observe_into and hot-path
+    consumers (the flight recorder's per-phase fold) that batch several
+    updates under one lock round."""
+    ent["bucket_counts"][bisect.bisect_left(ent["bounds"], value)] += 1
+    ent["sum"] += value
+    ent["count"] += 1
+
+
+def observe_into(ent: dict, value: float) -> None:
+    """Record one sample into a histogram slot obtained via _slot()."""
+    with _lock:
+        observe_locked(ent, value)
 
 
 def snapshot() -> List[dict]:
@@ -111,9 +165,27 @@ def snapshot() -> List[dict]:
                 for v in _registry.values()]
 
 
+# Bumped by clear(): hot-path consumers that cache registry slot dicts
+# (the core worker's state counters / phase histograms) compare this to
+# drop caches that point into a discarded registry.
+_generation = 0
+
+
 def clear() -> None:
+    global _generation
     with _lock:
         _registry.clear()
+        _generation += 1
+
+
+def remove(name: str, tags: Optional[dict] = None) -> None:
+    """Drop one metric row. Daemons with per-instance tag values (e.g.
+    the raylet's Node-tagged gauges — node ids are random per cluster)
+    remove their rows at stop so a long-lived process that hosts many
+    clusters (test suites) doesn't accumulate stale rows that every
+    snapshot() then copies and ships forever."""
+    with _lock:
+        _registry.pop(_key(name, tags), None)
 
 
 def merge_snapshots(snapshots: List[List[dict]]) -> List[dict]:
@@ -150,6 +222,34 @@ def _sample(name: str, tags: dict, value, extra: Optional[dict] = None):
     label = ",".join(f'{k}="{_escape_label(v)}"'
                      for k, v in sorted(t.items()))
     return f"{name}{{{label}}} {value}" if label else f"{name} {value}"
+
+
+LOOP_LAG_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+async def _loop_lag_loop(process: str, interval: float):
+    import asyncio
+    hist = Histogram(
+        "ray_tpu_event_loop_lag_seconds",
+        "scheduling delay of the asyncio event loop (a loaded/blocked "
+        "loop wakes late)", boundaries=LOOP_LAG_BUCKETS,
+        tag_keys=("Process",))
+    slot = hist._slot({"Process": process})
+    loop = asyncio.get_running_loop()
+    while True:
+        t0 = loop.time()
+        await asyncio.sleep(interval)
+        observe_into(slot, max(0.0, loop.time() - t0 - interval))
+
+
+def start_loop_lag_probe(process: str, interval: float = 0.2):
+    """Background event-loop-lag sampler: sleeps `interval` and records
+    how late the wakeup lands. One per daemon (driver, worker, raylet,
+    GCS), tagged with the process kind. Returns the asyncio task so the
+    caller can cancel it at shutdown."""
+    import asyncio
+    return asyncio.ensure_future(_loop_lag_loop(process, interval))
 
 
 def to_prometheus(metrics: List[dict]) -> str:

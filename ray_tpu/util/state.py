@@ -55,35 +55,23 @@ def list_actors(state: Optional[str] = None,
 
 def list_tasks(job_id: Optional[str] = None, limit: int = 1000,
                filters: Optional[List[Filter]] = None) -> List[dict]:
-    """Latest-state view of task events. Identity filters (name/task_id/
-    worker_id...) evaluate SERVER-side over raw events; `state` filters
-    evaluate HERE over the latest-state reduction — filtering raw events
-    by state would resurrect superseded states (a FINISHED task still has
-    an old RUNNING event that would match state="RUNNING")."""
-    filters = list(filters or [])
-    state_filters = [f for f in filters if f[0] == "state"]
-    other_filters = [f for f in filters if f[0] != "state"]
-    events = _gcs("get_task_events", {"job_id": job_id, "limit": 100000,
-                                      "filters": other_filters})
-    latest: Dict[str, dict] = {}
-    for e in events:
-        latest[e["task_id"]] = e
-    rows = []
-    for e in latest.values():
-        ok = True
-        for _attr, op, want in state_filters:
-            eq = str(e.get("state")) == str(want)
-            if (op == "=" and not eq) or (op == "!=" and eq):
-                ok = False
-                break
-        if ok:
-            rows.append({
-                "task_id": e["task_id"], "name": e["name"],
-                "state": e["state"], "job_id": e["job_id"],
-                "actor_id": e.get("actor_id"),
-                "worker_id": e.get("worker_id"),
-            })
-    return rows[-limit:]
+    """Latest-state view of task events.
+
+    The reduction AND the limit run SERVER-side (`latest_only` in
+    rpc_get_task_events): at most `limit` rows cross the wire, where the
+    pre-flight-recorder version shipped up to 100k raw events per query
+    and reduced here. The server applies state filters after the
+    reduction (filtering raw events by state would resurrect superseded
+    states)."""
+    events = _gcs("get_task_events", {
+        "job_id": job_id, "limit": limit, "filters": list(filters or []),
+        "latest_only": True})
+    return [{
+        "task_id": e["task_id"], "name": e["name"],
+        "state": e["state"], "job_id": e["job_id"],
+        "actor_id": e.get("actor_id"),
+        "worker_id": e.get("worker_id"),
+    } for e in events]
 
 
 def summarize_tasks(job_id: Optional[str] = None) -> Dict[str, Dict[str, int]]:
@@ -92,6 +80,14 @@ def summarize_tasks(job_id: Optional[str] = None) -> Dict[str, Dict[str, int]]:
     for row in list_tasks(job_id, limit=10**9):
         summary.setdefault(row["name"], Counter())[row["state"]] += 1
     return {k: dict(v) for k, v in summary.items()}
+
+
+def summarize_task_latency() -> List[dict]:
+    """Flight-recorder latency table: one row per (task name, phase)
+    with count/p50_ms/p95_ms, reduced in the GCS from the phase stamps
+    on finished task events (`ray_tpu summary` prints it; the dashboard
+    Latency panel renders the same rows)."""
+    return _gcs("get_task_latency")
 
 
 def list_jobs() -> List[dict]:

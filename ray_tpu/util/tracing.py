@@ -76,14 +76,17 @@ def end_span(span: dict) -> dict:
 
 def get_spans(trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
     """All finished spans (optionally one trace), oldest first, from the
-    GCS task-event stream."""
+    GCS task-event stream. The kind/trace filters evaluate SERVER-side
+    (rpc_get_task_events filters), so only span rows cross the wire
+    instead of the whole raw event buffer."""
     from ray_tpu._private import worker_api
     core = worker_api.get_core()
-    events = worker_api._call_on_core_loop(
-        core, core.gcs.request("get_task_events", {"limit": 100000}), 30)
-    spans = [e for e in events if e.get("kind") == "span"]
+    filters = [("kind", "=", "span")]
     if trace_id is not None:
-        spans = [s for s in spans if s["trace_id"] == trace_id]
+        filters.append(("trace_id", "=", trace_id))
+    spans = worker_api._call_on_core_loop(
+        core, core.gcs.request("get_task_events",
+                               {"limit": 100000, "filters": filters}), 30)
     return sorted(spans, key=lambda s: s["start"])
 
 

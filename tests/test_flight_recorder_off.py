@@ -1,0 +1,27 @@
+"""Flight recorder off-switch (the bench's events-off mode) — own
+module so the shared cluster of test_flight_recorder.py is torn down
+before this test inits with task_events_enabled=False."""
+
+import time
+
+
+def test_recorder_disabled_records_nothing():
+    import ray_tpu
+    ray_tpu.init(num_cpus=2, num_tpus=0,
+                 system_config={"task_events_enabled": False})
+    try:
+        from ray_tpu._private import worker_api
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        assert ray_tpu.get(f.remote(), timeout=60) == 1
+        time.sleep(1.5)
+        core = worker_api.get_core()
+        events = worker_api._call_on_core_loop(
+            core, core.gcs.request("get_task_events", {"limit": 1000}), 30)
+        assert events == []
+        assert ray_tpu.timeline() == []
+    finally:
+        ray_tpu.shutdown()

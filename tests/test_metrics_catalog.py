@@ -1,0 +1,31 @@
+"""Tier-1 guard: metric names in code and the README catalog can't drift
+(satellite of the flight-recorder PR; scripts/check_metrics_catalog.py)."""
+
+import importlib.util
+import os
+
+
+def _load_checker():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "check_metrics_catalog.py")
+    spec = importlib.util.spec_from_file_location("check_metrics_catalog",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metrics_catalog_in_sync():
+    checker = _load_checker()
+    problems = checker.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_catalog_parser_sees_known_metrics():
+    # The check is only meaningful if both scans actually find things.
+    checker = _load_checker()
+    code = checker.code_metric_names()
+    catalog = checker.catalog_metric_names()
+    assert "ray_tpu_task_phase_seconds" in code
+    assert "ray_tpu_pubsub_dropped_total" in code
+    assert len(catalog) >= 20
