@@ -284,6 +284,16 @@ class CoreWorker:
         # GCS publishes the commit (push-based pg.ready(), no polling).
         self._pg_ready_waiters: Dict[Any, List[ObjectID]] = {}
         self._pg_sub_fut: Optional[asyncio.Future] = None
+        # Gang-aware retry: futures woken on any placement_groups state
+        # push for a pg_id (created/removed), so tasks that died with
+        # their slice wait for the replacement domain instead of spinning
+        # lease requests against a mid-reschedule PG.
+        self._pg_state_waiters: Dict[Any, List[asyncio.Future]] = {}
+        # (pg_id, bundle_index) -> raylet address, resolved via the GCS
+        # bundle map once per placement epoch; invalidated on any
+        # placement_groups push (and wholesale on node death) so steady-
+        # state PG-pinned leases skip the two GCS round trips.
+        self._pg_addr_cache: Dict[Any, str] = {}
 
         # actor state
         self.actor_queues: Dict[ActorID, ActorSubmitQueue] = {}
@@ -555,24 +565,29 @@ class CoreWorker:
         s.register("profile_memory", self._rpc_profile_memory)
         s.register("stack_dump", self._rpc_stack_dump)
 
+    @rpc.idempotent
     async def _rpc_profile_cpu(self, conn, payload):
         from ray_tpu.util import profiling
         duration = min(float(payload.get("duration_s", 2.0)), 30.0)
         return await asyncio.get_running_loop().run_in_executor(
             self._exec_pool, lambda: profiling.sample_cpu(duration))
 
+    @rpc.idempotent
     async def _rpc_profile_memory(self, conn, payload):
         from ray_tpu.util import profiling
         return profiling.snapshot_memory(
             top=int(payload.get("top", 30)))
 
+    @rpc.idempotent
     async def _rpc_stack_dump(self, conn, payload):
         from ray_tpu.util import profiling
         return profiling.stack_dump()
 
+    @rpc.idempotent
     async def _rpc_ping(self, conn, payload):
         return {"worker_id": self.worker_id, "mode": self.mode}
 
+    @rpc.idempotent
     async def _rpc_shutdown(self, conn, payload):
         self._shutdown = True
         self.loop.call_soon(self.loop.stop)
@@ -619,15 +634,40 @@ class CoreWorker:
                 self._actor_creation_pins.pop(q.actor_id, None)
         elif channel == "placement_groups":
             event = msg.get("event")
+            pg_id = msg["pg"].pg_id if "pg" in msg else msg.get("pg_id")
+            self._drop_pg_addr_cache(pg_id)
             if event == "created":
                 self._resolve_pg_ready(msg["pg"].pg_id, ok=True)
+                self._wake_pg_state_waiters(msg["pg"].pg_id)
             elif event == "removed":
                 self._resolve_pg_ready(
                     msg.get("pg_id"), ok=False,
                     why="placement group was removed before it was placed")
+                self._wake_pg_state_waiters(msg.get("pg_id"))
         elif channel == "nodes":
             event = msg.get("event")
-            if event == "draining":
+            if event == "gang_draining":
+                # A whole slice fault domain is going away at once: mark
+                # EVERY member address up front so failures racing the
+                # per-member events still classify as planned (uncharged)
+                # loss, gang-aware from the first notice.
+                addrs = [a for a in (msg.get("addresses") or []) if a]
+                node_ids = msg.get("node_ids") or []
+                self.drain_events.append({
+                    "time": time.time(),
+                    "address": addrs[0] if addrs else "",
+                    "addresses": addrs,
+                    "node_id": node_ids[0] if node_ids else None,
+                    "node_ids": node_ids,
+                    "slice_id": msg.get("slice_id", ""),
+                    "deadline": msg.get("deadline", 0.0)})
+                for a in addrs:
+                    self._draining_raylets.add(a)
+                    self._on_raylet_draining(a)
+                if self.node_id is not None and any(
+                        nid == self.node_id for nid in node_ids):
+                    self.local_node_draining = True
+            elif event == "draining":
                 address = msg.get("address", "")
                 self.drain_events.append({
                     "time": time.time(), "address": address,
@@ -649,8 +689,15 @@ class CoreWorker:
                 # LATER raylet reusing the same host:port must not have
                 # its genuine crashes laundered into uncharged retries.
                 nid = msg.get("node_id")
+                self._pg_addr_cache.clear()  # bundle homes may have moved
                 stale = {ev["address"] for ev in self.drain_events
                          if ev.get("node_id") == nid and ev.get("address")}
+                for ev in self.drain_events:
+                    if nid in (ev.get("node_ids") or []):
+                        idx = ev["node_ids"].index(nid)
+                        addrs = ev.get("addresses") or []
+                        if idx < len(addrs):
+                            stale.add(addrs[idx])
                 for addr in stale:
                     self.loop.call_later(
                         15.0, self._draining_raylets.discard, addr)
@@ -659,6 +706,12 @@ class CoreWorker:
         """Stop routing new tasks through leases on a draining node: drop
         them from the lease tables (in-flight pushes still complete) and
         hand idle ones back so the raylet can reach quiescence."""
+        # PG-pinned lease routing must not keep dialing a bundle home
+        # that is going away (the re-commit push will refill the cache
+        # with the replacement domain's address).
+        for key in [k for k, a in self._pg_addr_cache.items()
+                    if a == address]:
+            self._pg_addr_cache.pop(key, None)
         for sched_class, leases in list(self.leases.items()):
             for lease in list(leases):
                 if lease.raylet_address != address:
@@ -891,6 +944,7 @@ class CoreWorker:
 
     # ---- owner protocol handlers ----
 
+    @rpc.idempotent
     async def _rpc_owner_locate(self, conn, payload):
         oid: ObjectID = payload["object_id"]
         ent = self.owned.get(oid)
@@ -911,6 +965,7 @@ class CoreWorker:
                 "locations": list(ent.locations),
                 "is_exception": ent.is_exception}
 
+    @rpc.non_idempotent
     async def _rpc_owner_add_borrower(self, conn, payload):
         free = False
         oid = payload["object_id"]
@@ -933,6 +988,7 @@ class CoreWorker:
             self._schedule_free(oid)
         return True
 
+    @rpc.non_idempotent
     async def _rpc_owner_remove_borrower(self, conn, payload):
         oid = payload["object_id"]
         with self._ref_lock:
@@ -946,6 +1002,7 @@ class CoreWorker:
             self._schedule_free(oid)
         return True
 
+    @rpc.idempotent
     async def _rpc_owner_add_location(self, conn, payload):
         ent = self.owned.get(payload["object_id"])
         if ent is not None:
@@ -1390,6 +1447,115 @@ class CoreWorker:
         elif info.state == PG_REMOVED:
             self._resolve_pg_ready(pg_id, ok=False,
                                    why="placement group was removed")
+
+    def _wake_pg_state_waiters(self, pg_id):
+        for fut in self._pg_state_waiters.pop(pg_id, []):
+            if not fut.done():
+                fut.set_result(None)
+
+    async def _pg_state_wait(self, pg_id, delay: float):
+        """Park until the next placement_groups push for `pg_id`, or at
+        most `delay` seconds (poll fallback for pushes lost to a GCS
+        restart)."""
+        fut = asyncio.get_running_loop().create_future()
+        self._pg_state_waiters.setdefault(pg_id, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, delay)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            # A wake pops the whole list; on timeout, drop OUR future
+            # so retry loops can't grow the entry without bound.
+            waiters = self._pg_state_waiters.get(pg_id)
+            if waiters is not None:
+                if fut in waiters:
+                    waiters.remove(fut)
+                if not waiters:
+                    self._pg_state_waiters.pop(pg_id, None)
+
+    async def _wait_pg_routable(self, pg_id, bundle_index: int,
+                                timeout: float) -> Optional[str]:
+        """Block until `pg_id` is committed on a raylet we may route to,
+        returning that address; None when removed / timed out.
+
+        "Committed" alone is not enough: during a gang drain the GCS
+        still reports the PRE-move commit while the bundles sit on
+        draining members (the handoff flips state only when migration
+        starts), so a created-state check would happily route back into
+        the dying slice and waste the retry. Push-driven with a poll
+        fallback: a commit that landed before we registered the waiter
+        is seen by the state fetch."""
+        from ray_tpu._private.common import PG_REMOVED
+        deadline = time.monotonic() + timeout
+        while not self._shutdown:
+            try:
+                info = await self.gcs.request("get_placement_group",
+                                              {"pg_id": pg_id})
+            except rpc.RpcError:
+                info = None
+            if info is not None and info.state == PG_REMOVED:
+                return None
+            addr = await self._pg_lease_target(pg_id, bundle_index,
+                                               info=info)
+            if addr is not None:
+                return addr
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            await self._pg_state_wait(pg_id, min(0.5, remaining))
+        return None
+
+    def _drop_pg_addr_cache(self, pg_id):
+        if pg_id is None:
+            return
+        for key in [k for k in self._pg_addr_cache if k[0] == pg_id]:
+            self._pg_addr_cache.pop(key, None)
+
+    async def _pg_lease_target(self, pg_id, bundle_index: int,
+                               info=None) -> Optional[str]:
+        """Raylet address hosting a PG bundle, or None when unknown.
+
+        PG-pinned leases dial the raylet that actually holds the bundle
+        (the GCS's bundle_nodes map), which is what routes a gang-retried
+        task onto the REPLACEMENT fault domain after a slice drain —
+        the submitter's local raylet knows nothing about the new home.
+        Resolved once per placement epoch: the pubsub handler drops the
+        cache entry whenever the PG's placement changes, so the steady
+        state costs no GCS round trips. `info` lets a caller that just
+        fetched the PG record skip the refetch (_wait_pg_routable polls).
+        """
+        from ray_tpu._private.common import PG_CREATED
+        cached = self._pg_addr_cache.get((pg_id, bundle_index))
+        if cached is not None:
+            return cached
+        try:
+            if info is None:
+                info = await self.gcs.request("get_placement_group",
+                                              {"pg_id": pg_id})
+            if info is None or info.state != PG_CREATED:
+                return None
+            idx = bundle_index if bundle_index >= 0 else \
+                next(iter(info.bundle_nodes), None)
+            node_id = info.bundle_nodes.get(idx)
+            if node_id is None:
+                return None
+            node = await self.gcs.request("get_node_address",
+                                          {"node_id": node_id})
+            if node and node.get("alive") and not node.get("draining"):
+                addr = node.get("address") or None
+                # Never route (or cache) INTO a draining raylet: during a
+                # gang drain the GCS may still report the pre-move commit
+                # while the bundle's host is going away — treat the
+                # bundle as homeless until the handoff re-commits. The
+                # GCS-side draining flag covers notices this worker's
+                # pubsub hasn't delivered yet; _draining_raylets covers
+                # the reverse skew.
+                if addr and addr not in self._draining_raylets:
+                    self._pg_addr_cache[(pg_id, bundle_index)] = addr
+                    return addr
+        except rpc.RpcError:
+            pass
+        return None
 
     def _resolve_pg_ready(self, pg_id, ok: bool, why: str = ""):
         if pg_id is None:
@@ -1932,6 +2098,17 @@ class CoreWorker:
                              count: int = 1):
         try:
             raylet_addr = self.raylet_address
+            pg_id = sample_spec.scheduling.placement_group_id
+            pg_waited = False
+            if pg_id is not None:
+                # Route a PG-pinned lease to the raylet holding the
+                # bundle (after a slice gang drain this is the
+                # replacement fault domain, not anything we ever leased
+                # from before).
+                addr = await self._pg_lease_target(
+                    pg_id, sample_spec.scheduling.bundle_index)
+                if addr:
+                    raylet_addr = addr
             for _hop in range(8):
                 if self._shutdown:
                     return
@@ -1957,6 +2134,32 @@ class CoreWorker:
                     raylet_addr = reply["spillback"]
                     continue
                 if "infeasible" in reply:
+                    if pg_id is not None and not pg_waited:
+                        # The bundle may be mid-handoff (its slice was
+                        # drained and the GCS is re-placing the gang):
+                        # wait for a commit on a NON-draining home, then
+                        # re-route there. The raylet we just dialed said
+                        # it cannot host the bundle, so its cached
+                        # address is a dead end — drop it FIRST or the
+                        # wait would instantly return the same address
+                        # from cache (the 'created' push that would have
+                        # evicted it may be unprocessed or lost to a GCS
+                        # restart). A stale pre-move commit does not
+                        # satisfy the wait either (_wait_pg_routable),
+                        # so the one allowed wait cannot be burned
+                        # routing back into the dying slice. A PG that
+                        # never becomes routable fails below instead of
+                        # hanging.
+                        self._pg_addr_cache.pop(
+                            (pg_id, sample_spec.scheduling.bundle_index),
+                            None)
+                        pg_waited = True
+                        addr = await self._wait_pg_routable(
+                            pg_id, sample_spec.scheduling.bundle_index,
+                            30.0)
+                        if addr:
+                            raylet_addr = addr
+                            continue
                     why = reply.get("why") or (
                         f"no node can satisfy resources "
                         f"{sample_spec.resources}")
@@ -2183,6 +2386,7 @@ class CoreWorker:
         ent.waiters.clear()
         return oid
 
+    @rpc.idempotent
     async def _rpc_generator_item(self, conn, payload):
         """Owner side: one streamed item from an executing generator task."""
         task_id: TaskID = payload["task_id"]
@@ -2971,6 +3175,7 @@ class CoreWorker:
             out.append(r)
         return out
 
+    @rpc.non_idempotent
     async def _rpc_push_task(self, conn, payload):
         async with self._task_exec_lock:  # pipelined pushes run one-by-one
             return await self._push_task_locked(payload)
@@ -3052,6 +3257,7 @@ class CoreWorker:
                 f"{err}"[:4096])
         return {"app_error": err, "returns": returns}
 
+    @rpc.non_idempotent
     async def _rpc_push_task_batch(self, conn, payload):
         """Execute a batch sequentially; one reply list for all. Per-spec
         isolation: an escaping system error fails that spec, not the
@@ -3334,6 +3540,7 @@ class CoreWorker:
                 f"{type(result)}")
         return list(result)
 
+    @rpc.idempotent
     async def _rpc_cancel_task(self, conn, payload):
         task_id = payload["task_id"]
         running = self._running_tasks.get(task_id)
@@ -3345,6 +3552,7 @@ class CoreWorker:
 
     # ---- actor execution ----
 
+    @rpc.non_idempotent
     async def _rpc_instantiate_actor(self, conn, payload):
         spec: TaskSpec = payload["spec"]
         try:
@@ -3392,6 +3600,7 @@ class CoreWorker:
         self._caller_buffer = {}
         return True
 
+    @rpc.non_idempotent
     async def _rpc_push_actor_tasks(self, conn, payload):
         """Batched push: one frame of specs from one caller, replies as an
         aligned list. A plain serial actor (max_concurrency=1, sync
@@ -3497,6 +3706,7 @@ class CoreWorker:
             await self._run_sync_jobs(jobs, replies)
         return replies
 
+    @rpc.non_idempotent
     async def _rpc_push_actor_task(self, conn, payload):
         spec: TaskSpec = payload["spec"]
         if self.executing_actor is None:
@@ -3566,6 +3776,7 @@ class CoreWorker:
                 self._running_tasks.pop(spec.task_id, None)
                 self.current_task_id = None
 
+    @rpc.idempotent
     async def _rpc_kill_actor(self, conn, payload):
         if self.executing_actor is not None:
             inst = self.executing_actor

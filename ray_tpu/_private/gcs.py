@@ -164,6 +164,14 @@ class GcsServer:
         # the per-node deadline watchers.
         self._drain_waiters: Dict[NodeID, List[asyncio.Future]] = {}
         self._drain_tasks: Dict[NodeID, asyncio.Task] = {}
+        # Slice fault domains: one drain/migration task per draining gang
+        # (keyed by slice_id), plus lifetime counters for the gang paths.
+        self._gang_tasks: Dict[str, asyncio.Task] = {}
+        self.gang_drains_total = 0
+        self.gang_recoveries_total = 0
+        # Consecutive failed reserve-before-release attempts per PG (the
+        # release-and-replace liveness backstop in _schedule_pg).
+        self._pg_handoff_failures: Dict[PlacementGroupID, int] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._persist_task: Optional[asyncio.Task] = None
         self._lag_task: Optional[asyncio.Task] = None
@@ -192,11 +200,20 @@ class GcsServer:
         self.address = f"{host}:{actual}"
         # Re-arm deadline watchers for nodes restored mid-drain: without
         # this a DRAINING node would sit unschedulable forever after a GCS
-        # restart (its drain task died with the old process).
+        # restart (its drain task died with the old process). Draining
+        # members of one slice re-arm as a single gang task so the
+        # migration unit survives the restart too.
+        regang: Dict[str, List[NodeID]] = {}
         for node_id, info in self.nodes.items():
             if info.alive and info.draining:
-                self._drain_tasks[node_id] = asyncio.ensure_future(
-                    self._drain_node_task(node_id, 0.0))
+                if info.slice_id:
+                    regang.setdefault(info.slice_id, []).append(node_id)
+                else:
+                    self._drain_tasks[node_id] = asyncio.ensure_future(
+                        self._drain_node_task(node_id, 0.0))
+        for slice_id, members in regang.items():
+            self._gang_tasks[slice_id] = asyncio.ensure_future(
+                self._drain_gang_task(slice_id, members, 0.0))
         self._health_task = asyncio.ensure_future(self._health_loop())
         if self.session_dir or self._ext_store is not None:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
@@ -215,6 +232,8 @@ class GcsServer:
         from ray_tpu.util import metrics as _metrics
         _metrics.release_reporter(self)
         for task in self._drain_tasks.values():
+            task.cancel()
+        for task in self._gang_tasks.values():
             task.cancel()
         if self._health_task:
             self._health_task.cancel()
@@ -299,8 +318,20 @@ class GcsServer:
 
     # ------------- node management -------------
 
+    @rpc.idempotent
     async def rpc_register_node(self, conn, payload) -> dict:
         info: NodeInfo = payload["node_info"]
+        prev = self.nodes.get(info.node_id)
+        if prev is not None and prev.alive:
+            # Replay of a registration that already executed (reply lost
+            # with the connection): carry over the GCS-side mutable state
+            # the replay payload cannot know about. Without this, a
+            # drain begun in the redial window (e.g. the node's slice
+            # gang-draining off a preemption notice) would be silently
+            # undone and the node would keep taking work.
+            info.draining = prev.draining
+            info.drain_deadline = prev.drain_deadline
+            info.resources_available = prev.resources_available
         self.nodes[info.node_id] = info
         logger.info("node %s registered at %s (resources=%s)",
                     info.node_id.hex()[:12], info.address, info.resources_total)
@@ -334,6 +365,7 @@ class GcsServer:
     def _schedulable(n: NodeInfo) -> bool:
         return n.alive and not n.draining
 
+    @rpc.idempotent
     async def rpc_heartbeat(self, conn, payload):
         node_id = payload["node_id"]
         info = self.nodes.get(node_id)
@@ -466,6 +498,16 @@ class GcsServer:
                   Subscriber=sub)
         gauge("ray_tpu_task_events_buffered", len(self.task_events),
               "task events held in the GCS ring buffer")
+        # Slice fault domains: gang drains started / gangs whose
+        # replacement domain became ready within the drain window.
+        g.append({"name": "ray_tpu_gang_drains_total", "type": "counter",
+                  "description": "slice gang drains started",
+                  "tags": {}, "value": float(self.gang_drains_total)})
+        g.append({"name": "ray_tpu_gang_recoveries_total",
+                  "type": "counter",
+                  "description": "gang drains whose PGs re-placed on a "
+                                 "replacement domain before the deadline",
+                  "tags": {}, "value": float(self.gang_recoveries_total)})
         return g
 
     def _merged_metrics(self) -> list:
@@ -496,7 +538,7 @@ class GcsServer:
             "nodes": [{
                 "node_id": n.node_id.hex(), "alive": n.alive,
                 "is_head": n.is_head, "address": n.address,
-                "draining": n.draining,
+                "draining": n.draining, "slice_id": n.slice_id,
                 "resources_total": n.resources_total,
                 "resources_available": n.resources_available,
             } for n in self.nodes.values()],
@@ -560,6 +602,7 @@ class GcsServer:
         self._latency_cache = (now, rows)
         return rows
 
+    @rpc.idempotent
     async def rpc_get_task_latency(self, conn, payload):
         return self._latency_summary()
 
@@ -615,17 +658,21 @@ class GcsServer:
         return [{"name": n, "state": s, "count": c}
                 for (n, s), c in sorted(counts.items())]
 
+    @rpc.idempotent
     async def rpc_report_metrics(self, conn, payload):
         self.metrics_reports[payload["reporter"]] = (time.time(),
                                                      payload["metrics"])
         return True
 
+    @rpc.idempotent
     async def rpc_get_metrics_address(self, conn, payload):
         return self.metrics_http_address
 
+    @rpc.idempotent
     async def rpc_get_status_summary(self, conn, payload):
         return self._status_summary()
 
+    @rpc.idempotent
     async def rpc_get_autoscaler_state(self, conn, payload):
         """Cluster view for the autoscaler: per-node capacity/usage, queued
         lease demand, and unplaced placement groups (reference:
@@ -653,11 +700,13 @@ class GcsServer:
             "pending_placement_groups": pending_pgs,
         }
 
+    @rpc.idempotent
     async def rpc_get_all_nodes(self, conn, payload):
         return list(self.nodes.values())
 
     # ------------- drain protocol (planned node removal) -------------
 
+    @rpc.idempotent
     async def rpc_drain_node(self, conn, payload):
         """Two-phase graceful removal (autoscaler downscale / preemption
         notice). Reference: gcs_node_manager DrainNode + DrainNodeReply.
@@ -673,6 +722,14 @@ class GcsServer:
         payload: node_id | node_id_hex, deadline_s (default 30), grace_s
         (default 0.5, actor-migration delay), wait (block until dead).
         Idempotent: re-draining a draining node only re-arms `wait`.
+
+        Slice escalation: on TPU pods the failure unit is the slice, not
+        the host — draining any member of a slice fault domain
+        (NodeInfo.slice_id) atomically gang-drains EVERY member: one
+        DRAINING transition with a shared deadline, gang-coherent lease
+        rejection in the raylets, and PG/actor migration driven as a
+        single unit (_drain_gang_task). A half-drained slice can never
+        accept new work.
         """
         node_id = payload.get("node_id")
         if node_id is None and payload.get("node_id_hex"):
@@ -685,6 +742,15 @@ class GcsServer:
             return True
         deadline_s = float(payload.get("deadline_s", 30.0))
         grace_s = float(payload.get("grace_s", 0.5))
+        if info.slice_id:
+            self._start_gang_drain(info.slice_id, deadline_s, grace_s,
+                                   payload.get("reason",
+                                               "gang drain requested"))
+            if payload.get("wait"):
+                await self._wait_node_dead(
+                    node_id, float(payload.get("wait_timeout_s",
+                                               deadline_s + 10.0)))
+            return True
         if not info.draining:
             info.draining = True
             info.drain_deadline = time.time() + deadline_s
@@ -749,6 +815,224 @@ class GcsServer:
             await self._mark_node_dead(node_id, reason="drain deadline",
                                        preempted=True)
 
+    # ------------- slice fault domains (gang drain) -------------
+
+    def _slice_members(self, slice_id: str) -> List[NodeInfo]:
+        return [n for n in self.nodes.values()
+                if n.alive and n.slice_id == slice_id]
+
+    def _start_gang_drain(self, slice_id: str, deadline_s: float,
+                          grace_s: float, reason: str):
+        """Atomically transition every alive member of a slice fault
+        domain to DRAINING under one shared deadline.
+
+        Synchronous up to (and including) the pubsub publishes — no await
+        can interleave a lease grant or placement between two members'
+        transitions, so the slice is never half-drained. Raylet notices
+        (which carry the gang peer list for gang-coherent spill
+        rejection) and the migration task follow asynchronously.
+        """
+        members = self._slice_members(slice_id)
+        fresh = [n for n in members if not n.draining]
+        if not fresh:
+            return  # whole gang already draining: idempotent re-drain
+        deadline = time.time() + deadline_s
+        for n in fresh:
+            n.draining = True
+            n.drain_deadline = deadline
+        self._mark_dirty()
+        if len(fresh) == len(members):
+            # First drain notice for this gang (not a member that joined
+            # mid-drain): count the gang once.
+            self.gang_drains_total += 1
+        addresses = [n.address for n in members]
+        member_ids = [n.node_id for n in members]
+        logger.info("gang-draining slice %s: %d hosts (deadline in %.1fs)",
+                    slice_id, len(members), deadline_s)
+        self._record_gang_span(slice_id, "gang_drain_notice",
+                               time.time(), time.time())
+        # One gang event (gang-aware consumers: core worker retry
+        # classification, Train) plus the per-member events every
+        # single-node consumer already understands.
+        self.pubsub.publish("nodes", {
+            "event": "gang_draining", "slice_id": slice_id,
+            "node_ids": member_ids, "addresses": addresses,
+            "deadline": deadline, "reason": reason})
+        for n in fresh:
+            self.pubsub.publish("nodes", {
+                "event": "draining", "node_id": n.node_id,
+                "address": n.address, "deadline": deadline,
+                "reason": reason, "slice_id": slice_id})
+
+        async def _notify_raylet(node: NodeInfo):
+            try:
+                await self.clients.request(
+                    node.address, "drain",
+                    {"deadline_s": deadline_s,
+                     "gang_addresses": [a for a in addresses
+                                        if a != node.address]},
+                    timeout=10.0)
+            except Exception:  # noqa: BLE001 — raylet may already be gone
+                pass
+
+        for n in fresh:
+            asyncio.ensure_future(_notify_raylet(n))
+        prior = self._gang_tasks.get(slice_id)
+        if prior is None or prior.done():
+            self._gang_tasks[slice_id] = asyncio.ensure_future(
+                self._drain_gang_task(slice_id, member_ids, grace_s))
+
+    async def _drain_gang_task(self, slice_id: str,
+                               node_ids: List[NodeID], grace_s: float):
+        """Migration + deadline watcher for one draining slice: PG bundle
+        handoff and actor migration run once for the WHOLE gang (not N
+        independent per-node passes), then every member still alive at
+        the shared deadline is marked dead as a planned loss."""
+        member_ids = set(node_ids)
+        infos = [self.nodes[nid] for nid in node_ids if nid in self.nodes]
+        if not infos:
+            # Same retire-or-handoff as the bottom of this task: members
+            # drained while we held the _gang_tasks slot must not strand.
+            leftover = [n.node_id for n in self._slice_members(slice_id)
+                        if n.draining and n.node_id not in member_ids]
+            if leftover:
+                self._gang_tasks[slice_id] = asyncio.ensure_future(
+                    self._drain_gang_task(slice_id, leftover, grace_s))
+            else:
+                self._gang_tasks.pop(slice_id, None)
+            return
+        deadline = max(n.drain_deadline for n in infos)
+        # Snapshot the affected PGs at drain start, before the first
+        # await: an idle member can report drain_complete within the
+        # grace window and its _mark_node_dead reschedule can finish the
+        # whole move before this task wakes — recovery is judged against
+        # this set however the re-place ends up being driven.
+        moved_pgs: List = [
+            pg for pg in self.placement_groups.values()
+            if pg.state != PG_REMOVED
+            and member_ids & set(pg.bundle_nodes.values())]
+        if grace_s > 0:
+            await asyncio.sleep(min(grace_s,
+                                    max(0.0, deadline - time.time())))
+        t_replace = time.time()
+        n_actors = 0
+
+        async def _migrate_members(ids: set):
+            # Re-place every PG with a bundle on ANY member as one unit:
+            # reserve-before-release handoff (see _schedule_pg) acquires
+            # the whole replacement footprint — including the slice_head
+            # bundle — on the destination domain before any source
+            # reservation drops. Then migrate the members' actors,
+            # uncharged.
+            nonlocal n_actors
+            for pg in list(self.placement_groups.values()):
+                if pg.state != PG_REMOVED \
+                        and ids & set(pg.bundle_nodes.values()):
+                    # Track every AFFECTED PG, not just the ones this
+                    # scan reschedules: an idle member that reported
+                    # drain_complete before the grace elapsed already
+                    # kicked the reschedule via _mark_node_dead (state
+                    # is RESCHEDULING by now), but its re-commit still
+                    # gates gang recovery below.
+                    if pg not in moved_pgs:
+                        moved_pgs.append(pg)
+                    if pg.state == PG_CREATED:
+                        await self._reschedule_pg(pg)
+            for actor in list(self.actors.values()):
+                if actor.node_id in ids \
+                        and actor.state in (ACTOR_ALIVE, ACTOR_PENDING):
+                    n_actors += 1
+                    await self._migrate_actor(
+                        actor, f"slice {slice_id} draining")
+
+        await _migrate_members(member_ids)
+        self._record_gang_span(slice_id, "gang_re_place",
+                               t_replace, time.time())
+        # Until the shared deadline: (a) absorb LATE members — a host
+        # that registered (or was drained) after this task spawned would
+        # otherwise sit DRAINING forever, never migrated nor reaped —
+        # and (b) watch for recovery: the replacement domain is actually
+        # ready once every re-placed PG committed again. A destination
+        # that never fits is the all-or-nothing fail case, left to the
+        # background reschedule loop.
+        t_restart = time.time()
+        recovered = False
+        while True:
+            late = [n for n in self._slice_members(slice_id)
+                    if n.draining and n.node_id not in member_ids]
+            if late:
+                member_ids.update(n.node_id for n in late)
+                deadline = max([deadline] +
+                               [n.drain_deadline for n in late])
+                await _migrate_members({n.node_id for n in late})
+            # Recovered = every affected PG re-committed OFF the gang
+            # (or was removed). The moved_pgs guard keeps the counter
+            # honest: a gang with no placement groups must not count a
+            # vacuous "recovery" — drains==recoveries for idle slices
+            # would make the ratio operators alert on meaningless.
+            if not recovered and moved_pgs and all(
+                    pg.state == PG_REMOVED
+                    or (pg.state == PG_CREATED
+                        and not (member_ids
+                                 & set(pg.bundle_nodes.values())))
+                    for pg in moved_pgs):
+                recovered = True
+                self.gang_recoveries_total += 1
+                self._record_gang_span(slice_id, "gang_restart",
+                                       t_restart, time.time())
+                logger.info("slice %s recovered: %d PG(s) re-placed, "
+                            "%d actor(s) migrating uncharged",
+                            slice_id, len(moved_pgs), n_actors)
+            if time.time() >= deadline:
+                break
+            # Nothing left to watch: every member already dead and the
+            # recovery verdict is in. Don't 20 Hz-poll node/PG tables
+            # until the deadline for an outcome that cannot change —
+            # a member drained AFTER this exits gets a fresh gang task
+            # (_start_gang_drain re-spawns once the prior one is done).
+            if (recovered or not moved_pgs) and not any(
+                    (n := self.nodes.get(nid)) is not None and n.alive
+                    for nid in member_ids):
+                break
+            await asyncio.sleep(min(0.25 if recovered else 0.05,
+                                    max(0.0, deadline - time.time())))
+        for nid in member_ids:
+            info = self.nodes.get(nid)
+            if info is not None and info.alive:
+                await self._mark_node_dead(
+                    nid, reason=f"gang drain deadline (slice {slice_id})",
+                    preempted=True)
+        self._record_gang_span(slice_id, "gang_drain_window",
+                               t_replace, time.time())
+        # Retire-or-handoff, atomically (no await in this block): a member
+        # drained while the _mark_node_dead awaits above ran was past this
+        # task's absorption loop, and _start_gang_drain refuses to spawn
+        # while we still occupy _gang_tasks — without the handoff it would
+        # sit alive+DRAINING forever (unschedulable, never migrated, never
+        # reaped). Scanning and swapping in one sync block closes the race
+        # with a concurrent _start_gang_drain double-spawning.
+        leftover = [n.node_id for n in self._slice_members(slice_id)
+                    if n.draining and n.node_id not in member_ids]
+        if leftover:
+            self._gang_tasks[slice_id] = asyncio.ensure_future(
+                self._drain_gang_task(slice_id, leftover, grace_s))
+        else:
+            self._gang_tasks.pop(slice_id, None)
+
+    def _record_gang_span(self, slice_id: str, name: str,
+                          start: float, end: float):
+        """Flight-recorder stamp for the drain→re-place→restart window:
+        rides the task-event ring as a span row, so `tracing.get_spans`
+        and the state API surface gang recoveries next to task phases."""
+        if not self.config.task_events_enabled:
+            return
+        self.task_events.append({
+            "kind": "span", "trace_id": f"gang:{slice_id}",
+            "span_id": os.urandom(8).hex(), "parent_id": "",
+            "name": name, "task_id": f"gang:{slice_id}",
+            "start": start, "end": end})
+
+    @rpc.idempotent
     async def rpc_drain_complete(self, conn, payload):
         """Raylet-side report: running work finished / objects migrated —
         the node can die before its deadline."""
@@ -860,6 +1144,7 @@ class GcsServer:
 
     # ------------- resource view sync (RaySyncer equivalent) -------------
 
+    @rpc.idempotent
     async def rpc_report_resources(self, conn, payload):
         node_id = payload["node_id"]
         info = self.nodes.get(node_id)
@@ -871,6 +1156,19 @@ class GcsServer:
         self._publish_resources(info)
         return True
 
+    @rpc.idempotent
+    async def rpc_get_node_address(self, conn, payload):
+        """Single-node liveness + address lookup (PG-pinned lease
+        routing): resolving one bundle home must not pull the O(cluster)
+        get_cluster_resources payload on every cold cache / handoff
+        poll."""
+        n = self.nodes.get(payload["node_id"])
+        if n is None:
+            return None
+        return {"address": n.address, "alive": n.alive,
+                "draining": n.draining}
+
+    @rpc.idempotent
     async def rpc_get_cluster_resources(self, conn, payload):
         return {
             n.node_id: {"total": n.resources_total,
@@ -882,17 +1180,28 @@ class GcsServer:
 
     # ------------- pubsub -------------
 
+    @rpc.idempotent
     async def rpc_subscribe(self, conn, payload):
         self.pubsub.subscribe(conn, payload["channels"])
         return True
 
+    @rpc.non_idempotent
     async def rpc_publish(self, conn, payload):
         self.pubsub.publish(payload["channel"], payload["message"])
         return True
 
     # ------------- KV (function table, runtime envs, rendezvous) -------------
 
+    @rpc.idempotent
     async def rpc_kv_put(self, conn, payload):
+        """Keyed upsert: replaying never corrupts state. Caveat for the
+        overwrite=False path: a replay whose first attempt inserted the
+        key reports False — fine for the in-repo callers (content-
+        addressed function/package export, return value ignored), but a
+        claim-style user of overwrite=False can see a won claim reported
+        lost after a GCS restart. Function export liveness across GCS
+        restarts depends on this replay; do not flip to non_idempotent
+        without giving those callers their own retry."""
         ns = self.kv.setdefault(payload.get("namespace", ""), {})
         overwrite = payload.get("overwrite", True)
         if not overwrite and payload["key"] in ns:
@@ -901,9 +1210,11 @@ class GcsServer:
         self._mark_dirty()
         return True
 
+    @rpc.idempotent
     async def rpc_kv_get(self, conn, payload):
         return self.kv.get(payload.get("namespace", ""), {}).get(payload["key"])
 
+    @rpc.idempotent
     async def rpc_kv_del(self, conn, payload):
         ns = self.kv.get(payload.get("namespace", ""), {})
         removed = ns.pop(payload["key"], None) is not None
@@ -911,9 +1222,11 @@ class GcsServer:
             self._mark_dirty()
         return removed
 
+    @rpc.idempotent
     async def rpc_kv_exists(self, conn, payload):
         return payload["key"] in self.kv.get(payload.get("namespace", ""), {})
 
+    @rpc.idempotent
     async def rpc_kv_keys(self, conn, payload):
         ns = self.kv.get(payload.get("namespace", ""), {})
         prefix = payload.get("prefix", b"")
@@ -921,6 +1234,7 @@ class GcsServer:
 
     # ------------- jobs -------------
 
+    @rpc.non_idempotent
     async def rpc_register_job(self, conn, payload):
         self._job_counter += 1
         job_id = JobID.from_int(self._job_counter)
@@ -930,6 +1244,7 @@ class GcsServer:
         self._mark_dirty()
         return job_id
 
+    @rpc.idempotent
     async def rpc_finish_job(self, conn, payload):
         info = self.jobs.get(payload["job_id"])
         if info:
@@ -948,9 +1263,11 @@ class GcsServer:
         self._mark_dirty()
         return True
 
+    @rpc.idempotent
     async def rpc_get_all_jobs(self, conn, payload):
         return list(self.jobs.values())
 
+    @rpc.idempotent
     async def rpc_owner_disconnected(self, conn, payload):
         """A core worker (driver or nested-task submitter) left the
         cluster: its non-detached actors die with it (reference:
@@ -969,6 +1286,7 @@ class GcsServer:
 
     # ------------- actor management -------------
 
+    @rpc.idempotent
     async def rpc_register_actor(self, conn, payload):
         """Register + schedule an actor creation task. Idempotent: a client
         retrying after a connection loss must not double-schedule."""
@@ -1115,7 +1433,14 @@ class GcsServer:
                     "event": "dead", "actor_id": actor.actor_id,
                     "reason": reason, "actor_info": actor})
 
+    @rpc.idempotent
     async def rpc_report_actor_failure(self, conn, payload):
+        """Replay-safe by its own guards, and replay MATTERS: the raylet
+        sends exactly one report per dead worker and swallows RpcError,
+        so a report lost to a GCS restart would otherwise leave the
+        actor stuck ALIVE forever. A duplicate execution is absorbed
+        below — RESTARTING and stale-worker reports return early, and
+        _handle_actor_failure no-ops on ACTOR_DEAD."""
         actor = self.actors.get(payload["actor_id"])
         if actor is None:
             return False
@@ -1134,6 +1459,7 @@ class GcsServer:
         await self._handle_actor_failure(actor, payload.get("reason", "worker died"))
         return True
 
+    @rpc.idempotent
     async def rpc_kill_actor(self, conn, payload):
         actor = self.actors.get(payload["actor_id"])
         if actor is None:
@@ -1162,9 +1488,11 @@ class GcsServer:
                                            "actor_info": actor})
         return True
 
+    @rpc.idempotent
     async def rpc_get_actor_info(self, conn, payload):
         return self.actors.get(payload["actor_id"])
 
+    @rpc.idempotent
     async def rpc_get_named_actor(self, conn, payload):
         key = (payload.get("namespace", ""), payload["name"])
         actor_id = self.named_actors.get(key)
@@ -1172,6 +1500,7 @@ class GcsServer:
             return None
         return self.actors.get(actor_id)
 
+    @rpc.idempotent
     async def rpc_list_named_actors(self, conn, payload):
         ns = payload.get("namespace")
         out = []
@@ -1196,6 +1525,7 @@ class GcsServer:
                 return False
         return True
 
+    @rpc.idempotent
     async def rpc_get_all_actors(self, conn, payload):
         filters = (payload or {}).get("filters")
         limit = (payload or {}).get("limit")
@@ -1205,27 +1535,59 @@ class GcsServer:
 
     # ------------- placement groups -------------
 
+    @rpc.idempotent
     async def rpc_create_placement_group(self, conn, payload):
+        """Idempotent: a client retrying after a connection loss must not
+        re-register (and re-place) a PG the GCS already owns — the second
+        schedule pass would race the first for reservations."""
         pg: PlacementGroupInfo = payload["pg"]
+        existing = self.placement_groups.get(pg.pg_id)
+        if existing is not None and existing.state != PG_REMOVED:
+            return True
         self.placement_groups[pg.pg_id] = pg
         self._mark_dirty()
         asyncio.ensure_future(self._schedule_pg(pg))
         return True
 
     async def _schedule_pg(self, pg: PlacementGroupInfo, delay: float = 0.0):
+        """Place (or re-place) a PG with reserve-before-release handoff.
+
+        Bundles the PG already holds (`pg.bundle_nodes` surviving a node
+        loss) stay reserved while the new footprint — including any
+        moved bundle and the slice_head bundle of a gang — is acquired on
+        the destination nodes. Only after EVERY new reservation succeeds
+        does the placement commit; only after the commit are the stale
+        source reservations released. A failed acquisition rolls back
+        exactly what this attempt acquired (all-or-nothing), never a
+        reservation the PG still owns — closing the leak where old
+        reservations on surviving nodes outlived a bundle move.
+        """
         if delay:
             await asyncio.sleep(delay)
         if pg.state == PG_REMOVED:
             return
+        # Cancellation-proof: callers like _drain_node_task get cancelled
+        # the moment their node dies (often mid-reserve — an idle raylet
+        # reports drain_complete immediately). Abandoning the handoff
+        # between reserve and commit/rollback is exactly how reservations
+        # strand, so the critical section always runs to completion.
+        await asyncio.shield(self._do_schedule_pg(pg))
+
+    async def _do_schedule_pg(self, pg: PlacementGroupInfo):
         async with self._pg_lock:
-            placement = self._place_bundles(pg)
+            if pg.state == PG_REMOVED:
+                return
+            prev = {idx: nid for idx, nid in pg.bundle_nodes.items()
+                    if (n := self.nodes.get(nid)) is not None
+                    and self._schedulable(n)}
+            placement = self._place_bundles(pg, prev)
             if placement is None:
                 self.pubsub.publish("demand", {"pg": pg.pg_id,
                                                "bundles": pg.bundles})
                 asyncio.ensure_future(self._schedule_pg(pg, delay=0.5))
                 return
-            # Two-phase: reserve on each node IN PARALLEL (bundle count no
-            # longer multiplies commit latency), rollback on any failure.
+            # Reserve the NEW footprint in parallel (bundles staying on
+            # their current node keep the reservation they already hold).
             async def _reserve(idx: int, node_id) -> bool:
                 node = self.nodes.get(node_id)
                 try:
@@ -1236,39 +1598,137 @@ class GcsServer:
                 except Exception:  # noqa: BLE001 — node may be dying
                     return False
 
-            items = list(placement.items())
+            items = [(idx, node_id) for idx, node_id in placement.items()
+                     if prev.get(idx) != node_id]
             results = await asyncio.gather(
                 *[_reserve(idx, node_id) for idx, node_id in items])
+
+            async def _return(idx: int, node_id):
+                node = self.nodes.get(node_id)
+                if node is None or not node.alive:
+                    return
+                try:
+                    await self.clients.request(
+                        node.address, "return_bundle",
+                        {"pg_id": pg.pg_id, "bundle_index": idx},
+                        timeout=10.0)
+                except Exception:  # noqa: BLE001
+                    pass
+
             if not all(results):
-                async def _rollback(idx: int, node_id):
-                    node = self.nodes.get(node_id)
-                    try:
-                        await self.clients.request(
-                            node.address, "return_bundle",
-                            {"pg_id": pg.pg_id, "bundle_index": idx},
-                            timeout=10.0)
-                    except Exception:  # noqa: BLE001
-                        pass
+                # All-or-nothing: roll back only this attempt's grabs;
+                # prev reservations remain live for the retry.
                 await asyncio.gather(*[
-                    _rollback(idx, node_id)
+                    _return(idx, node_id)
+                    for (idx, node_id), got in zip(items, results) if got])
+                fails = self._pg_handoff_failures.get(pg.pg_id, 0) + 1
+                self._pg_handoff_failures[pg.pg_id] = fails
+                if fails >= 4 and prev:
+                    # Liveness backstop: the placement-stability
+                    # preference avoids self-deadlock in practice, but a
+                    # plan that genuinely must cross-move bundles between
+                    # surviving nodes can never be acquired while the old
+                    # footprint is held. After repeated all-or-nothing
+                    # failures, release the held reservations and re-place
+                    # from scratch (accepting the transient window the
+                    # leaky pre-handoff code always had).
+                    logger.warning(
+                        "pg %s handoff stuck after %d attempts; releasing "
+                        "%d held reservation(s) to re-place from scratch",
+                        pg.pg_id.hex()[:12], fails, len(prev))
+                    await asyncio.gather(*[_return(idx, nid)
+                                           for idx, nid in prev.items()])
+                    pg.bundle_nodes = {}
+                    self._pg_handoff_failures.pop(pg.pg_id, None)
+                asyncio.ensure_future(self._schedule_pg(pg, delay=0.5))
+                return
+            self._pg_handoff_failures.pop(pg.pg_id, None)
+            dead = [nid for nid in placement.values()
+                    if (n := self.nodes.get(nid)) is None or not n.alive]
+            if dead:
+                # A planned home (kept bundle OR fresh reserve) died
+                # during the reserve gather. Committing would pin the
+                # bundle to the dead node FOREVER: _mark_node_dead's
+                # reschedule scan only fires for PG_CREATED, and this PG
+                # was mid-schedule when the death event ran. (The
+                # pre-handoff code re-reserved every bundle per attempt,
+                # so a dead node failed its reserve — skipping reserves
+                # for kept bundles removed that implicit liveness check;
+                # this re-check restores it.) Roll back this attempt's
+                # grabs and re-place: the retry's prev-filter drops the
+                # dead node.
+                await asyncio.gather(*[
+                    _return(idx, node_id)
                     for (idx, node_id), got in zip(items, results) if got])
                 asyncio.ensure_future(self._schedule_pg(pg, delay=0.5))
+                return
+            if pg.state == PG_REMOVED:
+                # rpc_remove_placement_group ran while the reserve gather
+                # was in flight: it released the OLD bundle_nodes and
+                # published "removed". Committing now would resurrect the
+                # PG and strand this attempt's fresh reservations, so
+                # return them instead. (No await between this check and
+                # the commit below — the race cannot reopen.)
+                await asyncio.gather(*[
+                    _return(idx, node_id)
+                    for (idx, node_id), got in zip(items, results) if got])
                 return
             pg.bundle_nodes = dict(placement)
             pg.state = PG_CREATED
             self._mark_dirty()
             self.pubsub.publish("placement_groups", {"event": "created", "pg": pg})
+            # Release AFTER commit: source reservations whose bundle
+            # moved elsewhere (still inside the lock so a concurrent
+            # reschedule cannot re-claim the key mid-release).
+            stale = [(idx, nid) for idx, nid in prev.items()
+                     if placement.get(idx) != nid]
+            if stale:
+                await asyncio.gather(*[_return(idx, nid)
+                                       for idx, nid in stale])
 
-    def _place_bundles(self, pg: PlacementGroupInfo) -> Optional[Dict[int, NodeID]]:
+    def _place_bundles(self, pg: PlacementGroupInfo,
+                       prev: Optional[Dict[int, NodeID]] = None
+                       ) -> Optional[Dict[int, NodeID]]:
         """Bundle placement honoring PACK/SPREAD/STRICT_PACK/STRICT_SPREAD.
 
         Reference semantics: bundle_scheduling_policy.h — STRICT_PACK all on
         one node; STRICT_SPREAD all on distinct nodes; PACK/SPREAD best-effort.
+
+        `prev` carries the PG's live reservations (reserve-before-release
+        re-placement): their capacity is credited back into the planning
+        view, and each bundle PREFERS its previous node. The preference
+        is load-bearing, not cosmetic — a plan that moves bundle A onto
+        the node whose room is only free because bundle B's kept
+        reservation "moved away" can never be reserved without releasing
+        first (the handoff would deadlock against its own footprint).
         """
         alive = [n for n in self.nodes.values() if self._schedulable(n)]
         if not alive:
             return None
+        prev = prev or {}
         avail = {n.node_id: dict(n.resources_available) for n in alive}
+        for idx, nid in prev.items():
+            pool = avail.get(nid)
+            if pool is None:
+                continue
+            for k, v in pg.bundles[idx].items():
+                if v > 0:
+                    pool[k] = pool.get(k, 0.0) + v
+
+        def prefer(order: List[NodeInfo], idx: int) -> List[NodeInfo]:
+            pn = prev.get(idx)
+            if pn is None:
+                return order
+            return ([n for n in order if n.node_id == pn]
+                    + [n for n in order if n.node_id != pn])
+
+        def bundle_order():
+            # Bundles keeping a reservation place FIRST, onto their own
+            # node, before homeless bundles can consume the credited
+            # capacity that reservation backs (otherwise the plan
+            # cross-moves and can never be acquired without releasing).
+            return sorted(enumerate(pg.bundles),
+                          key=lambda t: (t[0] not in prev, t[0]))
 
         def take(node_id, bundle) -> bool:
             a = avail[node_id]
@@ -1280,7 +1740,13 @@ class GcsServer:
 
         placement: Dict[int, NodeID] = {}
         if pg.strategy == "STRICT_PACK":
-            for n in alive:
+            # Prefer the node already hosting the most of this PG's
+            # reservations (re-place keeps the footprint in place).
+            pref_count: Dict[NodeID, int] = {}
+            for nid in prev.values():
+                pref_count[nid] = pref_count.get(nid, 0) + 1
+            for n in sorted(alive,
+                            key=lambda n: -pref_count.get(n.node_id, 0)):
                 trial = dict(avail[n.node_id])
                 ok = True
                 for b in pg.bundles:
@@ -1296,9 +1762,9 @@ class GcsServer:
             if len(pg.bundles) > len(alive):
                 return None
             used_nodes: set = set()
-            for i, b in enumerate(pg.bundles):
+            for i, b in bundle_order():
                 placed = False
-                for n in alive:
+                for n in prefer(alive, i):
                     if n.node_id in used_nodes:
                         continue
                     if take(n.node_id, b):
@@ -1311,12 +1777,12 @@ class GcsServer:
             return placement
         # PACK / SPREAD best-effort
         order = alive if pg.strategy == "PACK" else list(alive)
-        for i, b in enumerate(pg.bundles):
+        for i, b in bundle_order():
             placed = False
             if pg.strategy == "SPREAD":
                 # round-robin start
                 order = alive[i % len(alive):] + alive[: i % len(alive)]
-            for n in order:
+            for n in prefer(order, i):
                 if take(n.node_id, b):
                     placement[i] = n.node_id
                     placed = True
@@ -1335,11 +1801,15 @@ class GcsServer:
         self.pubsub.publish("placement_groups", {"event": "rescheduling", "pg": pg})
         await self._schedule_pg(pg)
 
+    @rpc.idempotent
     async def rpc_remove_placement_group(self, conn, payload):
         pg = self.placement_groups.get(payload["pg_id"])
         if pg is None:
             return False
         pg.state = PG_REMOVED
+        # Removal ends any reserve-before-release streak; without this a
+        # PG removed mid-failure-streak leaks its counter entry forever.
+        self._pg_handoff_failures.pop(pg.pg_id, None)
         self._mark_dirty()
         for idx, node_id in pg.bundle_nodes.items():
             node = self.nodes.get(node_id)
@@ -1355,6 +1825,7 @@ class GcsServer:
                                                  "pg_id": pg.pg_id})
         return True
 
+    @rpc.idempotent
     async def rpc_get_placement_group(self, conn, payload):
         if "pg_id" in payload and payload["pg_id"] is not None:
             return self.placement_groups.get(payload["pg_id"])
@@ -1364,11 +1835,13 @@ class GcsServer:
                 return pg
         return None
 
+    @rpc.idempotent
     async def rpc_get_all_placement_groups(self, conn, payload):
         return list(self.placement_groups.values())
 
     # ------------- task events (observability) -------------
 
+    @rpc.non_idempotent
     async def rpc_report_task_events(self, conn, payload):
         if not self.config.task_events_enabled:
             return True
@@ -1379,6 +1852,7 @@ class GcsServer:
             del self.task_events[:overflow]
         return True
 
+    @rpc.idempotent
     async def rpc_get_task_events(self, conn, payload):
         """Raw or reduced task-event query.
 

@@ -111,27 +111,37 @@ class KVStoreServer:
         os.replace(tmp, path)
 
     # ------------- RPC handlers -------------
+    # Wire names are kv_store_* (not store_*): the raylet's object-store
+    # service exposes a non-idempotent `store_get` (pins), and the
+    # registry's safer-flag merge on a name collision would strip these
+    # pure reads of their replay — the GCS external-store restore read
+    # must survive a transient connection loss.
 
-    async def rpc_store_set(self, conn, payload) -> dict:
+    @rpc.idempotent
+    async def rpc_kv_store_set(self, conn, payload) -> dict:
         key, value = payload["key"], payload["value"]
         self.data[key] = value
         self._persist(key, value)
         return {"ok": True}
 
-    async def rpc_store_get(self, conn, payload) -> dict:
+    @rpc.idempotent
+    async def rpc_kv_store_get(self, conn, payload) -> dict:
         return {"value": self.data.get(payload["key"])}
 
-    async def rpc_store_del(self, conn, payload) -> dict:
+    @rpc.idempotent
+    async def rpc_kv_store_del(self, conn, payload) -> dict:
         existed = self.data.pop(payload["key"], None) is not None
         if existed:
             self._persist(payload["key"], None)
         return {"deleted": existed}
 
-    async def rpc_store_keys(self, conn, payload) -> dict:
+    @rpc.idempotent
+    async def rpc_kv_store_keys(self, conn, payload) -> dict:
         prefix = payload.get("prefix", "")
         return {"keys": [k for k in self.data if k.startswith(prefix)]}
 
-    async def rpc_store_ping(self, conn, payload) -> dict:
+    @rpc.idempotent
+    async def rpc_kv_store_ping(self, conn, payload) -> dict:
         return {"ok": True, "keys": len(self.data)}
 
     # ------------- lifecycle -------------
@@ -157,20 +167,20 @@ class ExternalStoreClient:
         self._own_pool = pool is None
 
     async def set(self, key: str, value: bytes):
-        await self._pool.request(self.address, "store_set",
+        await self._pool.request(self.address, "kv_store_set",
                                  {"key": key, "value": value}, timeout=30)
 
     async def get(self, key: str) -> Optional[bytes]:
-        out = await self._pool.request(self.address, "store_get",
+        out = await self._pool.request(self.address, "kv_store_get",
                                        {"key": key}, timeout=30)
         return out["value"]
 
     async def delete(self, key: str):
-        await self._pool.request(self.address, "store_del", {"key": key},
+        await self._pool.request(self.address, "kv_store_del", {"key": key},
                                  timeout=30)
 
     async def ping(self) -> dict:
-        return await self._pool.request(self.address, "store_ping", {},
+        return await self._pool.request(self.address, "kv_store_ping", {},
                                         timeout=10)
 
     async def close(self):

@@ -299,7 +299,18 @@ class ObjectStoreHost:
 
     def write_and_seal(self, object_id: bytes, data, metadata: bytes = b"",
                        owner_address: str = ""):
-        """Host-side put (used by object transfer and spill restore)."""
+        """Host-side put (used by object transfer and spill restore).
+
+        Keyed upsert: a put for an object that already exists SEALED is a
+        no-op success, not an error — object content is immutable per id,
+        so the bytes are identical by construction. This is what makes
+        `store_put_bytes` honestly @rpc.idempotent: a replayed transfer
+        whose first attempt landed (reply lost with the connection) must
+        report success, or drain push-off would count a completed
+        migration as failed and skip telling the owner the new location."""
+        ent = self.objects.get(object_id)
+        if ent is not None and ent.state == SEALED:
+            return
         name, offset = self.create(object_id, len(data), metadata, owner_address)
         self.arena.view(offset, len(data))[:] = data
         self.seal(object_id)

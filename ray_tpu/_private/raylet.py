@@ -261,7 +261,7 @@ class Raylet:
                  labels: Optional[Dict[str, str]] = None,
                  is_head: bool = False,
                  object_store_memory: Optional[int] = None,
-                 node_name: str = ""):
+                 node_name: str = "", slice_id: str = ""):
         self.config = config
         self.gcs_address = gcs_address
         self.session_dir = session_dir
@@ -269,7 +269,13 @@ class Raylet:
         self.node_name = node_name or self.node_id.hex()[:8]
         self.is_head = is_head
         self.resources = resources or self._default_resources()
-        self.labels = labels or {}
+        self.labels = dict(labels or {})
+        # TPU slice fault domain: every host of one ICI domain registers
+        # the same slice_id so the GCS drains/recovers them as one gang.
+        from ray_tpu.parallel.mesh import SLICE_LABEL, detect_slice_id
+        self.slice_id = slice_id or detect_slice_id(self.labels)
+        if self.slice_id:
+            self.labels.setdefault(SLICE_LABEL, self.slice_id)
         self.pool = ResourcePool(self.resources)
         self.server = rpc.RpcServer(f"raylet-{self.node_name}")
         self.store = ObjectStoreHost(
@@ -421,6 +427,7 @@ class Raylet:
             resources_total=dict(self.pool.total),
             resources_available=dict(self.pool.available),
             labels=self.labels, is_head=self.is_head,
+            slice_id=self.slice_id,
         )
         reply = await self.gcs_conn.request("register_node",
                                             {"node_info": info})
@@ -675,6 +682,7 @@ class Raylet:
         self._starting_workers += 1
         return handle
 
+    @rpc.idempotent
     async def rpc_register_worker(self, conn, payload):
         """Called by a worker process once its RPC server is up."""
         worker_id = payload["worker_id"]
@@ -880,10 +888,22 @@ class Raylet:
     # ------------------------------------------------------------------
     # Drain protocol (planned removal)
 
+    @rpc.idempotent
     async def rpc_drain(self, conn, payload):
         """GCS -> raylet drain notice: stop granting leases, finish running
         work up to the deadline, push primary object copies to live peers,
-        and report drain_complete once idle."""
+        and report drain_complete once idle.
+
+        `gang_addresses` lists fellow hosts of this node's slice draining
+        in the same gang: they are pruned from the cluster view up front
+        (gang-coherent rejection) so neither a lease spillback nor an
+        object push-off can route work INTO the dying slice before the
+        per-peer pubsub notices land."""
+        gang = set(payload.get("gang_addresses") or [])
+        if gang:
+            for nid, view in list(self.cluster_view.items()):
+                if view.get("address") in gang:
+                    self.cluster_view.pop(nid, None)
         if self._draining:
             return True
         self._draining = True
@@ -1021,6 +1041,7 @@ class Raylet:
     # ------------------------------------------------------------------
     # Lease protocol (normal tasks)
 
+    @rpc.non_idempotent
     async def rpc_request_worker_lease(self, conn, payload):
         """Grant local worker(s), queue, or spill to another node.
 
@@ -1184,6 +1205,7 @@ class Raylet:
             return None
         return best_addr
 
+    @rpc.idempotent
     async def rpc_announce_client(self, conn, payload):
         """Core workers identify themselves right after connecting so a
         later disconnect maps back to their owner address (driver OR
@@ -1329,6 +1351,7 @@ class Raylet:
         self._pending_leases = [e for e in remaining if not e[2].done()]
         self._ensure_worker_supply()
 
+    @rpc.idempotent
     async def rpc_return_worker(self, conn, payload):
         """Lease released by the submitter (idle timeout or task class change)."""
         worker_id = payload["worker_id"]
@@ -1400,6 +1423,7 @@ class Raylet:
     # ------------------------------------------------------------------
     # Actor creation (GCS -> this raylet)
 
+    @rpc.non_idempotent
     async def rpc_create_actor(self, conn, payload):
         spec: TaskSpec = payload["spec"]
         if self._draining:
@@ -1498,6 +1522,7 @@ class Raylet:
         for _ in range(max(0, floor - supply)):
             self._spawn_worker()
 
+    @rpc.idempotent
     async def rpc_kill_worker(self, conn, payload):
         handle = self.workers.get(payload["worker_id"])
         if handle is None:
@@ -1517,6 +1542,7 @@ class Raylet:
     # ------------------------------------------------------------------
     # Placement group bundles
 
+    @rpc.idempotent
     async def rpc_reserve_bundle(self, conn, payload):
         if self._draining:
             return False
@@ -1526,6 +1552,7 @@ class Raylet:
             self._mark_resources_dirty()
         return ok
 
+    @rpc.idempotent
     async def rpc_return_bundle(self, conn, payload):
         key = (payload["pg_id"].binary(), payload["bundle_index"])
         self.pool.return_bundle(key)
@@ -1535,15 +1562,18 @@ class Raylet:
     # ------------------------------------------------------------------
     # Object store service (workers on this node + remote raylets)
 
+    @rpc.non_idempotent
     async def rpc_store_create(self, conn, payload):
         return self.store.create(payload["object_id"], payload["size"],
                                  payload.get("metadata", b""),
                                  payload.get("owner_address", ""))
 
+    @rpc.idempotent
     async def rpc_store_seal(self, conn, payload):
         self.store.seal(payload["object_id"])
         return True
 
+    @rpc.non_idempotent
     async def rpc_store_get(self, conn, payload):
         oid = payload["object_id"]
         timeout = payload.get("timeout")
@@ -1553,21 +1583,26 @@ class Raylet:
                 return None
         return self.store.pin(oid)
 
+    @rpc.non_idempotent
     async def rpc_store_release(self, conn, payload):
         self.store.unpin(payload["object_id"])
         return True
 
+    @rpc.idempotent
     async def rpc_store_contains(self, conn, payload):
         return self.store.contains(payload["object_id"])
 
+    @rpc.idempotent
     async def rpc_store_delete(self, conn, payload):
         for oid in payload["object_ids"]:
             self.store.delete(oid)
         return True
 
+    @rpc.idempotent
     async def rpc_store_stats(self, conn, payload):
         return self.store.stats()
 
+    @rpc.idempotent
     async def rpc_store_list(self, conn, payload):
         """Object inventory for the state API (`ray_tpu list objects`)."""
         out = []
@@ -1577,6 +1612,7 @@ class Raylet:
                         "owner": ent.owner_address})
         return out
 
+    @rpc.idempotent
     async def rpc_store_put_bytes(self, conn, payload):
         """Put raw serialized bytes (used by small-RPC path and transfers)."""
         self.store.write_and_seal(payload["object_id"], payload["data"],
@@ -1586,6 +1622,7 @@ class Raylet:
 
     # ---- inter-node transfer (object manager) ----
 
+    @rpc.idempotent
     async def rpc_store_pull_chunk(self, conn, payload):
         """Serve one chunk of a local object to a remote raylet."""
         oid = payload["object_id"]
@@ -1602,11 +1639,23 @@ class Raylet:
         finally:
             self.store.unpin(oid)
 
+    @rpc.idempotent
     async def rpc_store_fetch_remote(self, conn, payload):
         """Pull an object from a remote node into the local store."""
         oid = payload["object_id"]
         if self.store.contains(oid):
             return True
+        if self.store.objects.get(oid) is not None:
+            # A concurrent writer holds the entry mid-transfer — e.g. a
+            # REPLAYED fetch racing its still-running original (handlers
+            # are not cancelled when the requesting connection dies).
+            # Racing create() would crash 'already exists'; wait for the
+            # first writer's seal, and only fall through to fetch if it
+            # aborted (entry rolled back) or stalled out.
+            if await self.store.wait_sealed(oid, timeout=60.0):
+                return True
+            if self.store.contains(oid):
+                return True
         locations: List[str] = payload["locations"]   # raylet addresses
         chunk_size = self.config.object_transfer_chunk_bytes
         for address in locations:

@@ -110,6 +110,123 @@ class RpcError(Exception):
     pass
 
 
+# ---- per-method idempotency annotations ------------------------------------
+# Every server handler carries an explicit idempotency marker (enforced by
+# scripts/check_rpc_idempotency.py). ClientPool.request consults the
+# registry to decide whether a request that may have REACHED the peer can
+# be replayed after a connection loss: idempotent methods always can;
+# non-idempotent methods must not (replaying e.g. register_job or a task
+# push would double-execute on a live peer that only dropped the
+# connection). Requests that provably never left this process
+# (ConnectionLost.sent is False) are safe to retry either way.
+#
+# The registry fills two ways: decorator side effects when a server module
+# is imported, and a lazy source scan (_scan_source_annotations) for the
+# processes that dial methods whose defining module they never import — a
+# driver or worker pulls in core_worker but not gcs.py/raylet.py, and an
+# empty registry there would silently fall back to replaying everything.
+
+_IDEMPOTENCY: Dict[str, bool] = {}
+_SOURCE_SCANNED = False
+
+
+def _annotate(fn, flag: bool):
+    name = fn.__name__
+    if name.startswith("rpc_"):
+        name = name[4:]
+    elif name.startswith("_rpc_"):
+        name = name[5:]
+    fn._rpc_idempotent = flag
+    # Import-time registration keys by the FUNCTION name (rpc_ prefix
+    # stripped) — correct for every server whose wire names match its
+    # method names. Servers that alias on the wire (client_*/serve_*)
+    # are re-registered under the true wire name in RpcServer.register.
+    # When two servers expose the same name the SAFER flag wins — a
+    # client pool addresses both kinds of peer. A colliding PURE READ
+    # therefore loses its replay; give it a distinct wire name instead
+    # (kv_store's kv_store_get vs the raylet's pinning store_get).
+    prev = _IDEMPOTENCY.get(name)
+    _IDEMPOTENCY[name] = flag if prev is None else (prev and flag)
+    return fn
+
+
+def idempotent(fn):
+    """Mark an rpc_* handler safe to execute more than once per logical
+    request (pure reads, set-to-value writes, keyed upserts)."""
+    return _annotate(fn, True)
+
+
+def non_idempotent(fn):
+    """Mark an rpc_* handler whose replay observably double-executes
+    (counters, appends, spawns). ClientPool never replays these once the
+    original request may have reached the peer."""
+    return _annotate(fn, False)
+
+
+def scan_handler_annotations(lines) -> list:
+    """Line-walk one file's source: (handler_name, lineno, flag) per
+    `async def rpc_*` / `_rpc_*`, flag None when unannotated.
+
+    THE single parser for idempotency annotations — used by the lazy
+    runtime registry fill below AND by scripts/check_rpc_idempotency.py,
+    so the CI gate and the process that acts on the annotations can
+    never read the source differently."""
+    import re
+    handler = re.compile(r"^\s*async def (_?rpc_[a-z0-9_]+)\(")
+    annot = re.compile(r"^\s*@(?:rpc\.)?(idempotent|non_idempotent)\b")
+    deco = re.compile(r"^\s*@")
+    out = []
+    for i, line in enumerate(lines):
+        m = handler.match(line)
+        if not m:
+            continue
+        flag = None
+        j = i - 1
+        while j >= 0 and deco.match(lines[j]):
+            am = annot.match(lines[j])
+            if am:
+                flag = am.group(1) == "idempotent"
+            j -= 1
+        out.append((m.group(1), i + 1, flag))
+    return out
+
+
+def _scan_source_annotations():
+    """Fill the registry from package source without importing the server
+    modules; runs once per process, lazily, on the first unknown-method
+    lookup."""
+    global _SOURCE_SCANNED
+    _SOURCE_SCANNED = True
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fname),
+                          encoding="utf-8") as f:
+                    lines = f.readlines()
+            except OSError:
+                continue
+            for name, _lineno, flag in scan_handler_annotations(lines):
+                if flag is None:
+                    continue
+                name = name[5:] if name.startswith("_rpc_") else name[4:]
+                prev = _IDEMPOTENCY.get(name)
+                _IDEMPOTENCY[name] = flag if prev is None \
+                    else (prev and flag)
+
+
+def idempotency_of(method: str) -> Optional[bool]:
+    """True/False when the method is annotated, None when unknown (a
+    handler outside the package, e.g. test doubles)."""
+    flag = _IDEMPOTENCY.get(method)
+    if flag is None and not _SOURCE_SCANNED:
+        _scan_source_annotations()
+        flag = _IDEMPOTENCY.get(method)
+    return flag
+
+
 class RemoteRpcError(RpcError):
     def __init__(self, method: str, err_type: str, message: str, tb: str):
         self.method = method
@@ -127,7 +244,15 @@ class RemoteRpcError(RpcError):
 
 
 class ConnectionLost(RpcError):
-    pass
+    """Transport-level loss. `sent` records whether the request bytes may
+    have reached the peer: False = provably never left this process (dial
+    failure, connection already closed before the write), True = in
+    flight when the connection died, so the peer MAY have executed it.
+    Retry policies key off this (see ClientPool.request)."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.sent = False
 
 
 async def _read_msg(reader: asyncio.StreamReader):
@@ -338,7 +463,11 @@ class Connection:
         self._out_est_bytes = 0
         for fut in self._pending.values():
             if not fut.done():
-                fut.set_exception(ConnectionLost(str(exc)))
+                lost = ConnectionLost(str(exc))
+                # These requests were already written (or queued for the
+                # transport): the peer may have executed them.
+                lost.sent = True
+                fut.set_exception(lost)
         self._pending.clear()
         try:
             self.writer.close()
@@ -406,6 +535,19 @@ class RpcServer:
 
     def register(self, method: str, handler: Handler):
         self._handlers[method] = handler
+        # Authoritative idempotency registration: the decorator keys the
+        # registry by the handler's FUNCTION name, which is wrong for
+        # servers that alias on the wire (ClientServer's `client_<name>`,
+        # GrpcProxyActor's `serve_unary`). Recording under the actual
+        # wire name here makes the annotation effective for every pool /
+        # reconnecting client living in a process that runs (or imports
+        # and registers) the server. A REMOTE process that never
+        # registers the aliased server still falls back to the
+        # function-name source scan — see ROADMAP follow-on.
+        flag = getattr(handler, "_rpc_idempotent", None)
+        if flag is not None:
+            prev = _IDEMPOTENCY.get(method)
+            _IDEMPOTENCY[method] = flag if prev is None else (prev and flag)
 
     def register_all(self, obj: Any, prefix: str = ""):
         """Register every ``rpc_*`` coroutine method of obj."""
@@ -580,17 +722,26 @@ class ReconnectingConnection:
 
     async def request(self, method: str, payload: Any = None,
                       timeout: Optional[float] = None) -> Any:
-        for _attempt in range(2):
+        """Request with redial-and-replay on loss (GCS restart liveness).
+
+        Replay policy mirrors ClientPool.request: a request that provably
+        never left this process (`ConnectionLost.sent` False) is always
+        safe to replay, but one that may have REACHED the peer is
+        replayed only if the method is not annotated non-idempotent — a
+        GCS that executed e.g. register_job and then dropped the
+        connection must not run it twice."""
+        attempts = 3
+        for attempt in range(attempts):
             if self._conn is None or self._conn.closed:
                 await self._redial()
             try:
                 return await self._conn.request(method, payload, timeout)
-            except ConnectionLost:
-                if self._closed:
+            except ConnectionLost as e:
+                if self._closed or attempt == attempts - 1:
                     raise
-                continue
-        await self._redial()
-        return await self._conn.request(method, payload, timeout)
+                if getattr(e, "sent", True) \
+                        and idempotency_of(method) is False:
+                    raise
 
     async def notify(self, method: str, payload: Any = None):
         if self._conn is None or self._conn.closed:
@@ -627,21 +778,41 @@ class ClientPool:
     async def request(self, address: str, method: str, payload: Any = None,
                       timeout: Optional[float] = None,
                       retry_once: bool = True) -> Any:
-        conn = await self.get(address)
-        try:
-            return await conn.request(method, payload, timeout)
-        except ConnectionLost:
-            if not retry_once:
-                raise
-            # The pooled connection may be stale (peer restarted on the
-            # same address): invalidate, re-dial once, retry. A dial
-            # failure re-raises ConnectionLost — the peer really is gone.
-            # Callers with at-most-once semantics (task/actor pushes: the
-            # peer may have EXECUTED before the connection died) pass
-            # retry_once=False and keep their own retry accounting.
-            self.invalidate(address)
+        """Request with idempotency-aware redial on connection loss.
+
+        Retry policy per attempt that died with ConnectionLost:
+        - the request never left this process (`sent` False): always safe
+          to retry — invalidate the stale pooled connection and re-dial;
+        - the request may have reached the peer (`sent` True): retry only
+          if the method is NOT annotated non-idempotent (see
+          idempotent()/non_idempotent(); replaying e.g. register_job on a
+          live peer that merely dropped the connection double-executes);
+        - methods annotated idempotent get one extra redial attempt — a
+          peer restarting mid-redial no longer fails them.
+        Callers with their own at-most-once accounting (task/actor
+        pushes) pass retry_once=False and see the raw error.
+        """
+        attempts = None  # resolved on the FAILURE path only: the first
+        attempt = 0      # unknown-method idempotency_of() may walk the
+        while True:      # package source — never tax a healthy request.
             conn = await self.get(address)
-            return await conn.request(method, payload, timeout)
+            try:
+                return await conn.request(method, payload, timeout)
+            except ConnectionLost as e:
+                if not retry_once:
+                    raise
+                if attempts is None:
+                    attempts = 3 if idempotency_of(method) else 2
+                attempt += 1
+                if attempt >= attempts:
+                    raise
+                if getattr(e, "sent", True) \
+                        and idempotency_of(method) is False:
+                    raise
+                # The pooled connection may be stale (peer restarted on
+                # the same address): invalidate and re-dial. A dial
+                # failure re-raises ConnectionLost — the peer is gone.
+                self.invalidate(address)
 
     def invalidate(self, address: str):
         conn = self._conns.pop(address, None)

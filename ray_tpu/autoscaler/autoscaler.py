@@ -338,18 +338,38 @@ class StandardAutoscaler:
         alive_by_hex, gcs_hex_of = self._correlate(state)
 
         for pid in notices:
-            if pid in self._preempt_draining:
+            # Slice gangs fail as one unit: a notice for any member means
+            # the whole slice is going away — drain and reap every host
+            # of the gang, not just the noticed one. (The GCS escalates
+            # the drain to the slice fault domain on its side too; this
+            # keeps the PROVIDER view consistent so sibling VMs are
+            # terminated instead of lingering as zombie capacity.)
+            # Skip only once EVERY member is marked: gating on the
+            # noticed pid alone would strand a sibling that had no GCS
+            # registration (or hit a GCS hiccup) on the first pass.
+            gang = self._gang_of.get(pid, (pid,))
+            if all(m in self._preempt_draining for m in gang):
                 continue
-            nid = gcs_hex_of(pid)
-            if not nid:
-                continue
-            logger.warning("autoscaler: preemption notice for %s "
-                           "(gcs node %s); draining", pid, nid[:12])
-            self.gcs_request("drain_node", {
-                "node_id_hex": nid,
-                "deadline_s": self.config.preempt_deadline_s,
-                "reason": "preemption notice"})
-            self._preempt_draining[pid] = time.time()
+            first = True
+            for member in gang:
+                if member in self._preempt_draining:
+                    continue
+                nid = gcs_hex_of(member)
+                if not nid:
+                    continue  # not registered yet: a later pass retries
+                logger.warning(
+                    "autoscaler: preemption notice for %s (gcs node %s%s); "
+                    "draining", member, nid[:12],
+                    "" if first else f", gang of {pid}")
+                first = False
+                self.gcs_request("drain_node", {
+                    "node_id_hex": nid,
+                    "deadline_s": self.config.preempt_deadline_s,
+                    "reason": "preemption notice"})
+                # Recorded only after the request went through: a GCS
+                # hiccup here leaves the member unmarked so the next
+                # pass retries the drain (rpc_drain_node is idempotent).
+                self._preempt_draining[member] = time.time()
         for pid in list(self._preempt_draining):
             gone_from_provider = pid not in self.provider.non_terminated_nodes()
             nid = gcs_hex_of(pid)

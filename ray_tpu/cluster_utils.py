@@ -47,7 +47,10 @@ class Cluster:
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
                  object_store_memory: int = 256 * 1024**2,
-                 is_head: bool = False, node_name: str = "") -> Raylet:
+                 is_head: bool = False, node_name: str = "",
+                 slice_id: str = "") -> Raylet:
+        """slice_id groups fake nodes into one TPU slice fault domain:
+        draining (or losing) any member gang-drains the whole group."""
         res = dict(resources or {})
         res.setdefault("CPU", float(num_cpus))
         if num_tpus:
@@ -59,7 +62,8 @@ class Cluster:
             raylet = Raylet(self.config, self.gcs_address, self.session_dir,
                             resources=res, labels=labels, is_head=is_head,
                             object_store_memory=object_store_memory,
-                            node_name=node_name or f"node{len(self.raylets)}")
+                            node_name=node_name or f"node{len(self.raylets)}",
+                            slice_id=slice_id)
             await raylet.start()
             return raylet
 

@@ -125,6 +125,11 @@ def fake_mesh(n_devices: int = 8, **axis_sizes):
 
 # chips per host for each generation (reference tpu.py:37 consts).
 CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5e": 8, "v5p": 4, "v6e": 8}
+
+# Node label under which a host advertises its slice fault domain. All
+# hosts of one ICI domain share the value; the GCS groups them into one
+# gang for drain/recovery (a preempted host kills the whole slice).
+SLICE_LABEL = "ray_tpu.io/slice"
 # Real accelerator-type strings use pod aliases (v5e-16 => "v5litepod-16").
 GEN_ALIASES = {"v5litepod": "v5e", "v6litepod": "v6e"}
 
@@ -172,6 +177,34 @@ def get_slice_info() -> SliceInfo:
                          worker_id=worker_id, topology=topology)
     return SliceInfo(name="", generation=gen, chips_per_host=cph,
                      worker_id=worker_id, topology=topology)
+
+
+def detect_slice_id(labels: Optional[Dict[str, str]] = None) -> str:
+    """Fault-domain key for this host — unique PER SLICE, shared by every
+    host of one ICI domain, "" when the host is not part of a gang.
+
+    Precedence: an explicit `ray_tpu.io/slice` label (tests,
+    heterogeneous deployments), then the TPU resource name from the
+    runtime (`TPU_NAME`, suffixed with `MEGASCALE_SLICE_ID` so each slice
+    of a multislice job is its own domain), then a fingerprint of
+    `TPU_WORKER_HOSTNAMES` (identical on every host of one slice,
+    distinct across slices). The accelerator type alone
+    (`SliceInfo.name`, e.g. "v4-16") is deliberately NOT a fallback: two
+    independent slices of the same type would merge into one fault
+    domain and a single-host preemption would gang-drain both."""
+    explicit = (labels or {}).get(SLICE_LABEL, "")
+    if explicit:
+        return explicit
+    tpu_name = os.environ.get("TPU_NAME", "")
+    ms_slice = os.environ.get("MEGASCALE_SLICE_ID", "")
+    if tpu_name:
+        return f"{tpu_name}/{ms_slice}" if ms_slice else tpu_name
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if hostnames and "," in hostnames:
+        import hashlib
+        digest = hashlib.sha1(hostnames.encode()).hexdigest()[:12]
+        return f"hosts:{digest}"
+    return ""
 
 
 def slice_bundles(slice_info: SliceInfo) -> List[Dict[str, float]]:

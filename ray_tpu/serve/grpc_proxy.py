@@ -73,12 +73,14 @@ class GrpcProxyActor:
             self._handles[key] = handle
         return handle
 
+    @rpc.non_idempotent
     async def _rpc_unary(self, conn, payload):
         self._num_requests += 1
         handle = await self._handle_for(payload)
         return await handle.remote(*payload.get("args", ()),
                                    **payload.get("kwargs", {}))
 
+    @rpc.non_idempotent
     async def _rpc_stream(self, conn, payload):
         self._num_requests += 1
         handle = await self._handle_for(payload)

@@ -11,6 +11,7 @@ no runtime object at all (SURVEY.md §2.5).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
@@ -119,6 +120,44 @@ class BackendExecutor:
             self.scaling.placement_strategy)
         self.node_info_per_worker = self.worker_group.node_infos()
         self.backend.on_start(self)
+        self._start_preempt_watcher()
+
+    # ---- driver-side preemption watcher ----
+
+    def _start_preempt_watcher(self):
+        """Background poll of the driver's drain-event log so
+        save-on-preempt fires even when only the DRIVER sees the notice
+        (e.g. the gang workers' pubsub frames were lost with their node,
+        or the notice landed between report rounds). Worker-side
+        should_checkpoint() and the get_next_results() check remain the
+        other two braces."""
+        self._stop_preempt_watcher()  # restart attempts re-arm cleanly
+        self._watch_stop = threading.Event()
+
+        def _loop():
+            while not self._watch_stop.wait(0.25):
+                if self._save_pushed:
+                    return
+                try:
+                    if self._preempted_since_start():
+                        self._save_pushed = True
+                        self.request_save()
+                        return
+                except Exception:  # noqa: BLE001 — watcher must not die
+                    pass
+
+        self._watcher = threading.Thread(
+            target=_loop, daemon=True, name="train-preempt-watcher")
+        self._watcher.start()
+
+    def _stop_preempt_watcher(self):
+        stop = getattr(self, "_watch_stop", None)
+        if stop is not None:
+            stop.set()
+        watcher = getattr(self, "_watcher", None)
+        if watcher is not None:
+            watcher.join(timeout=2.0)
+            self._watcher = None
 
     def _preempted_since_start(self) -> bool:
         """Did a node HOSTING THIS GANG receive a drain/preemption notice
@@ -135,15 +174,20 @@ class BackendExecutor:
         start = getattr(self, "_started_at", 0.0)
         gang_nodes = {i.get("node_id", "") for i in self.node_info_per_worker}
         gang_nodes.discard("")
+        def _hexes(ev) -> list:
+            ids = ev.get("node_ids") or [ev.get("node_id")]
+            return [nid.hex() if hasattr(nid, "hex") else str(nid or "")
+                    for nid in ids]
+
         for ev in events:
             if ev.get("time", 0.0) < start:
                 continue
-            nid = ev.get("node_id")
-            ev_hex = nid.hex() if hasattr(nid, "hex") else str(nid or "")
             # Unknown gang placement (old workers without node_id): keep
             # the permissive classification rather than charging a
-            # possibly-planned loss.
-            if not gang_nodes or ev_hex in gang_nodes:
+            # possibly-planned loss. Slice gang_draining events carry
+            # every member id — any overlap with the training gang's
+            # hosts classifies the restart as planned.
+            if not gang_nodes or gang_nodes & set(_hexes(ev)):
                 return True
         return False
 
@@ -247,6 +291,7 @@ class BackendExecutor:
                 pass
 
     def shutdown(self):
+        self._stop_preempt_watcher()
         if self.worker_group is not None:
             self.backend.on_shutdown(self)
             self.worker_group.shutdown()

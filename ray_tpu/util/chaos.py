@@ -100,11 +100,7 @@ class NodeKiller(_KillerBase):
         self.kills.append(f"node:{raylet.node_name}")
         if self.respawn:
             time.sleep(0.2)
-            self.cluster.add_node(
-                num_cpus=resources.get("CPU", 1),
-                resources={k: v for k, v in resources.items()
-                           if k not in ("CPU", "memory",
-                                        "object_store_memory")})
+            _respawn(self.cluster, resources)
 
 
 class NodeDrainer(_KillerBase):
@@ -150,26 +146,100 @@ class NodeDrainer(_KillerBase):
             self.kills.append(f"drain:{raylet.node_name}")
         if self.respawn:
             time.sleep(0.2)
-            self.cluster.add_node(
-                num_cpus=resources.get("CPU", 1),
-                resources={k: v for k, v in resources.items()
-                           if k not in ("CPU", "memory",
-                                        "object_store_memory")})
+            _respawn(self.cluster, resources)
 
     def _hard_reclaim(self, raylet):
         """SIGKILL the node's workers, then stop the raylet — the reclaim
         half of the notice-then-kill race."""
-        for handle in list(raylet.workers.values()):
-            if handle.pid > 0:
-                try:
-                    os.kill(handle.pid, signal.SIGKILL)
-                except OSError:
-                    pass
-        if raylet in self.cluster.raylets:
+        _hard_reclaim(self.cluster, raylet)
+
+
+class SlicePreemptionKiller(_KillerBase):
+    """Kills every host of ONE TPU slice within a jittered window — the
+    failure shape gang-scheduled slices actually exhibit: the ICI domain
+    co-fails, but the hosts' reclaims land milliseconds-to-seconds apart.
+
+    notice=True first issues a drain on one member (the GCS escalates it
+    to an atomic gang drain), then reclaims each host at a random offset
+    inside `window_s`; notice=False skips the warning entirely (hard
+    co-failure). The workload's gang recovery — atomic gang drain,
+    reserve-before-release PG handoff, uncharged gang retries — must
+    absorb the loss.
+    """
+
+    def __init__(self, cluster, interval_s: float = 1.0,
+                 max_kills: int = 1, seed: Optional[int] = None,
+                 deadline_s: float = 2.0, grace_s: float = 0.2,
+                 window_s: float = 0.5, notice: bool = True,
+                 respawn: bool = False):
+        super().__init__(interval_s, max_kills, seed)
+        self.cluster = cluster
+        self.deadline_s = deadline_s
+        self.grace_s = grace_s
+        self.window_s = window_s
+        self.notice = notice
+        self.respawn = respawn
+
+    def _pick_slice(self):
+        slices = {}
+        for r in self.cluster.raylets:
+            if not r.is_head and getattr(r, "slice_id", ""):
+                slices.setdefault(r.slice_id, []).append(r)
+        if not slices:
+            return None, []
+        name = self._rng.choice(sorted(slices))
+        return name, slices[name]
+
+    def _kill_one(self):
+        name, hosts = self._pick_slice()
+        if not hosts:
+            return
+        saved = [(dict(r.pool.total), r.slice_id) for r in hosts]
+        if self.notice:
+            self.cluster.drain_node(hosts[0], deadline_s=self.deadline_s,
+                                    grace_s=self.grace_s, wait=False)
+            time.sleep(self.deadline_s)
+        # Reclaim each host at its own jittered offset inside the window.
+        offsets = sorted(self._rng.uniform(0.0, self.window_s)
+                         for _ in hosts)
+        t0 = time.time()
+        for raylet, offset in zip(list(hosts), offsets):
+            delay = t0 + offset - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            _hard_reclaim(self.cluster, raylet)
+        self.kills.append(f"slice:{name}")
+        if self.respawn:
+            time.sleep(0.2)
+            for resources, slice_id in saved:
+                _respawn(self.cluster, resources, slice_id)
+
+
+def _respawn(cluster, resources, slice_id: str = ""):
+    """Replacement node with the victim's custom resources (one respawn
+    recipe for every killer — keep drift-free)."""
+    cluster.add_node(
+        num_cpus=resources.get("CPU", 1),
+        resources={k: v for k, v in resources.items()
+                   if k not in ("CPU", "memory", "object_store_memory")},
+        slice_id=slice_id)
+
+
+def _hard_reclaim(cluster, raylet):
+    """SIGKILL a node's workers, then tear down its raylet — the reclaim
+    half of the notice-then-kill race (shared by the drain-based and
+    slice killers)."""
+    for handle in list(raylet.workers.values()):
+        if handle.pid > 0:
             try:
-                self.cluster.remove_node(raylet)
-            except Exception:  # noqa: BLE001 — already dead is fine
+                os.kill(handle.pid, signal.SIGKILL)
+            except OSError:
                 pass
+    if raylet in cluster.raylets:
+        try:
+            cluster.remove_node(raylet)
+        except Exception:  # noqa: BLE001 — already dead is fine
+            pass
 
 
 class PreemptionKiller(NodeDrainer):

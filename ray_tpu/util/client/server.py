@@ -86,6 +86,7 @@ class ClientServer:
 
     # ------------------------------------------------------------------
 
+    @rpc.non_idempotent
     async def rpc_connect(self, conn, payload):
         session_id = payload["session"]
         config = get_config()
@@ -127,16 +128,19 @@ class ClientServer:
                 pass
             await session.core.shutdown_async()
 
+    @rpc.idempotent
     async def rpc_disconnect(self, conn, payload):
         await self._reap(payload["session"])
         return True
 
+    @rpc.non_idempotent
     async def rpc_put(self, conn, payload):
         s = self._session(payload)
         value = s.core.serialization.deserialize(payload["data"])
         ref = await s.core.put_async(value)
         return s.track(ref)
 
+    @rpc.idempotent
     async def rpc_get(self, conn, payload):
         s = self._session(payload)
         refs = [s.resolve(r) for r in payload["refs"]]
@@ -150,6 +154,7 @@ class ClientServer:
                     s.core.serialization.serialize(e).to_bytes()}
         return [s.core.serialization.serialize(v).to_bytes() for v in values]
 
+    @rpc.idempotent
     async def rpc_wait(self, conn, payload):
         s = self._session(payload)
         refs = [s.resolve(r) for r in payload["refs"]]
@@ -188,6 +193,7 @@ class ClientServer:
                 await s.core.gcs.request("kv_put", {
                     "namespace": "packages", "key": key, "value": data})
 
+    @rpc.non_idempotent
     async def rpc_submit_task(self, conn, payload):
         s = self._session(payload)
         if payload.get("function_blob"):
@@ -211,6 +217,7 @@ class ClientServer:
             return gen._task_id.binary()
         return [s.track(r) for r in refs]
 
+    @rpc.non_idempotent
     async def rpc_submit_named(self, conn, payload):
         """Cross-language task submission: invoke an importable Python
         function by "module:function" name (the reference's cross-language
@@ -240,6 +247,7 @@ class ClientServer:
                        name=qualname)
         return await self.rpc_submit_task(conn, payload)
 
+    @rpc.non_idempotent
     async def rpc_create_actor(self, conn, payload):
         s = self._session(payload)
         if payload.get("class_path"):
@@ -283,6 +291,7 @@ class ClientServer:
         s.actors[actor_id.binary()] = actor_id
         return actor_id.binary()
 
+    @rpc.non_idempotent
     async def rpc_submit_actor_task(self, conn, payload):
         s = self._session(payload)
         actor_id = ActorID(payload["actor_id"])
@@ -299,6 +308,7 @@ class ClientServer:
             return gen._task_id.binary()
         return [s.track(r) for r in refs]
 
+    @rpc.idempotent
     async def rpc_generator_next(self, conn, payload):
         """Next ref of a streaming generator; None when exhausted. The
         client passes an explicit cursor so a retried request cannot skip
@@ -319,6 +329,7 @@ class ClientServer:
             return None
         return s.track(ref)
 
+    @rpc.non_idempotent
     async def rpc_generator_subscribe(self, conn, payload):
         """Switch a streaming generator to server-push delivery: the
         server iterates the stream and pushes (ref, value) items over the
@@ -386,6 +397,7 @@ class ClientServer:
             s.gen_pumps.pop(tid, None)
             s.gen_credits.pop(tid, None)
 
+    @rpc.non_idempotent
     async def rpc_generator_credit(self, conn, payload):
         """Client consumed items: replenish the pump's window."""
         s = self._session(payload)
@@ -395,6 +407,7 @@ class ClientServer:
                 sem.release()
         return True
 
+    @rpc.idempotent
     async def rpc_generator_release(self, conn, payload):
         """Client abandoned a stream: free it + unconsumed return objects."""
         s = self._session(payload)
@@ -408,12 +421,14 @@ class ClientServer:
                                      payload.get("consumed", 0))
         return True
 
+    @rpc.idempotent
     async def rpc_kill_actor(self, conn, payload):
         s = self._session(payload)
         await s.core.kill_actor(ActorID(payload["actor_id"]),
                                 payload.get("no_restart", True))
         return True
 
+    @rpc.idempotent
     async def rpc_get_named_actor(self, conn, payload):
         s = self._session(payload)
         info = await s.core.get_named_actor(payload["name"],
@@ -421,16 +436,19 @@ class ClientServer:
         s.actors[info.actor_id.binary()] = info.actor_id
         return info.actor_id.binary()
 
+    @rpc.idempotent
     async def rpc_release(self, conn, payload):
         s = self._session(payload)
         for r in payload["refs"]:
             s.refs.pop(r, None)
         return True
 
+    @rpc.idempotent
     async def rpc_cluster_resources(self, conn, payload):
         s = self._session(payload)
         return await s.core.gcs.request("get_cluster_resources", {})
 
+    @rpc.idempotent
     async def rpc_nodes(self, conn, payload):
         s = self._session(payload)
         infos = await s.core.gcs.request("get_all_nodes", {})
@@ -440,6 +458,7 @@ class ClientServer:
             "Labels": n.labels, "IsHead": n.is_head,
         } for n in infos]
 
+    @rpc.idempotent
     async def rpc_cancel(self, conn, payload):
         s = self._session(payload)
         ref = s.resolve(payload["ref"])

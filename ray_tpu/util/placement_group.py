@@ -79,6 +79,17 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
     return PlacementGroup(pg_id, info.bundles)
 
 
+def slice_placement_group(slice_info, name: str = "") -> PlacementGroup:
+    """Gang-reserve a whole TPU slice: one STRICT_SPREAD bundle per host
+    (chips_per_host TPU each; bundle 0 carries the slice-head resource).
+    The returned PG is the unit the GCS's slice fault-domain recovery
+    re-places atomically — reserve-before-release on a replacement
+    domain — when any host of the slice is drained or preempted."""
+    from ray_tpu.parallel.mesh import slice_bundles
+    return placement_group(slice_bundles(slice_info),
+                           strategy="STRICT_SPREAD", name=name)
+
+
 def remove_placement_group(pg: PlacementGroup):
     core = worker_api.get_core()
     worker_api._call_on_core_loop(

@@ -1,0 +1,524 @@
+"""TPU-slice fault domains: atomic gang drain, gang-aware recovery, and
+reserve-before-release placement handoff.
+
+Reference pattern: on TPU pods the unit of failure is the slice, not the
+host — preempting one host of a v4-16 kills the whole gang ("Exploring
+the limits of Concurrency in ML Training on Google TPUs"), so draining
+any member must drain every member atomically, and the placement-group
+footprint (including the slice_head bundle) must move to a replacement
+domain with reserve-before-release semantics: the destination is fully
+acquired before any source reservation is released, all-or-nothing.
+"""
+
+import time
+
+import pytest
+
+
+def _current_node_id():
+    import os
+    return os.environ.get("RAY_TPU_NODE_ID", "")
+
+
+def _core():
+    from ray_tpu._private import worker_api
+    return worker_api.get_core()
+
+
+def _gcs_actor_info(handle):
+    from ray_tpu._private import worker_api
+    core = worker_api.get_core()
+    return worker_api._call_on_core_loop(
+        core, core.gcs.request("get_actor_info",
+                               {"actor_id": handle._actor_id}), 10)
+
+
+def _status(cluster) -> dict:
+    from ray_tpu._private import worker_api
+    core = _core()
+    return worker_api._call_on_core_loop(
+        core, core.gcs.request("get_status_summary", {}), 10)
+
+
+def _stop_raylet(cluster, raylet):
+    """Tear down a raylet the GCS already marked dead (gang members the
+    drain killed logically but whose in-process server still runs)."""
+    async def _stop():
+        await raylet.stop()
+    cluster._run(_stop())
+    if raylet in cluster.raylets:
+        cluster.raylets.remove(raylet)
+
+
+def _add_slice(cluster, slice_id: str, head_resource: str,
+               num_hosts: int = 2, tpus_per_host: float = 4.0):
+    """Fake TPU slice: num_hosts nodes sharing one fault domain; host 0
+    carries the slice-head resource (mesh.slice_bundles shape)."""
+    hosts = []
+    for i in range(num_hosts):
+        res = {"TPU": tpus_per_host}
+        if i == 0:
+            res[head_resource] = 1.0
+        hosts.append(cluster.add_node(num_cpus=1, resources=res,
+                                      slice_id=slice_id))
+    return hosts
+
+
+def _assert_no_leaked_reservations(cluster):
+    """Reserve-before-release invariant: every bundle reservation held by
+    a surviving raylet backs a CURRENT placement of a live PG — nothing
+    left behind by a bundle move."""
+    from ray_tpu._private import worker_api
+    from ray_tpu._private.common import PG_REMOVED
+    core = _core()
+    pgs = worker_api._call_on_core_loop(
+        core, core.gcs.request("get_all_placement_groups", {}), 10)
+    placed = set()
+    for pg in pgs:
+        if pg.state == PG_REMOVED:
+            continue
+        for idx, node_id in pg.bundle_nodes.items():
+            placed.add((pg.pg_id.binary(), idx, node_id))
+    for raylet in cluster.raylets:
+        for (pg_bin, idx) in raylet.pool.bundles:
+            assert (pg_bin, idx, raylet.node_id) in placed, (
+                f"leaked reservation (pg={pg_bin.hex()[:12]}, bundle "
+                f"{idx}) on surviving node {raylet.node_name}")
+
+
+def _mk_slice_info(name="v4-16", hosts=2):
+    from ray_tpu.parallel.mesh import SliceInfo
+    return SliceInfo(name=name, generation="v4", num_chips=4 * hosts,
+                     num_hosts=hosts, chips_per_host=4)
+
+
+def test_detect_slice_id_is_unique_per_slice(monkeypatch):
+    """The fault-domain key must distinguish two slices of the same
+    accelerator type: keying on TPU_ACCELERATOR_TYPE alone would merge
+    independent v4-16 slices into one gang and a single-host preemption
+    would drain both."""
+    from ray_tpu.parallel.mesh import SLICE_LABEL, detect_slice_id
+
+    for var in ("TPU_NAME", "MEGASCALE_SLICE_ID", "TPU_WORKER_HOSTNAMES",
+                "TPU_ACCELERATOR_TYPE"):
+        monkeypatch.delenv(var, raising=False)
+    assert detect_slice_id({SLICE_LABEL: "lab"}) == "lab"
+    # Accelerator type alone is NOT a fault-domain key.
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-16")
+    assert detect_slice_id() == ""
+    # Same type, distinct host sets -> distinct domains.
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    a = detect_slice_id()
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h2,h3")
+    b = detect_slice_id()
+    assert a and b and a != b
+    # TPU resource name wins; multislice splits per slice index.
+    monkeypatch.setenv("TPU_NAME", "pod-7")
+    assert detect_slice_id() == "pod-7"
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+    assert detect_slice_id() == "pod-7/1"
+
+
+# ---------------------------------------------------------------------------
+# acceptance: atomic gang drain + uncharged gang recovery + no PG leak
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(180)
+def test_gang_drain_atomic_recovery_no_leak(ray_cluster):
+    """Draining ONE host of a fake 2-host slice atomically drains the
+    whole gang; the slice placement group (slice_head bundle included)
+    re-places onto a replacement domain reserve-before-release; a gang
+    actor restarts there without charging max_restarts; no reservation
+    leaks on surviving nodes."""
+    import ray_tpu
+    from ray_tpu.util.placement_group import slice_placement_group
+    from ray_tpu.util.scheduling_strategies import \
+        PlacementGroupSchedulingStrategy
+
+    sinfo = _mk_slice_info()
+    head_res = sinfo.head_resource()
+    a1, a2 = _add_slice(ray_cluster, "slice-a", head_res)
+    ray_cluster.connect()
+    ray_cluster.wait_for_nodes()
+
+    # The slice gang PG can only fit slice A right now.
+    pg = slice_placement_group(sinfo, name="gang")
+    assert pg.wait(60)
+
+    @ray_tpu.remote
+    class Chip:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def where(self):
+            return _current_node_id()
+
+    actor = Chip.options(
+        num_cpus=0, resources={"TPU": 1}, max_restarts=0,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=1)).remote()
+    assert ray_tpu.get(actor.incr.remote(), timeout=60) == 1
+    a_ids = {a1.node_id.hex(), a2.node_id.hex()}
+    assert ray_tpu.get(actor.where.remote(), timeout=60) in a_ids
+
+    # Replacement domain comes up AFTER placement, so the re-place must
+    # actively move the gang (not merely have picked B initially).
+    b1, b2 = _add_slice(ray_cluster, "slice-b", head_res)
+    ray_cluster.wait_for_nodes()
+    b_ids = {b1.node_id.hex(), b2.node_id.hex()}
+
+    # Drain ONE host; the GCS escalates to the whole fault domain.
+    ray_cluster.drain_node(a2, deadline_s=6.0, grace_s=0.2, wait=True)
+    assert ray_cluster.gcs.gang_drains_total == 1
+    st = _status(ray_cluster)
+    gone = {n["node_id"] for n in st["nodes"]
+            if n["draining"] or not n["alive"]}
+    assert a_ids <= gone, "gang drain was not atomic across the slice"
+    _stop_raylet(ray_cluster, a1)
+
+    # PG re-placed entirely onto the replacement domain.
+    from ray_tpu.util.placement_group import placement_group_table
+    deadline = time.time() + 60
+    row = None
+    while time.time() < deadline:
+        row = next(r for r in placement_group_table()
+                   if r["placement_group_id"] == pg.id.hex())
+        if row["state"] == "CREATED" \
+                and set(row["bundle_nodes"].values()) <= b_ids:
+            break
+        time.sleep(0.2)
+    assert row["state"] == "CREATED"
+    assert set(row["bundle_nodes"].values()) <= b_ids
+    assert ray_cluster.gcs.gang_recoveries_total == 1
+
+    # Gang actor restarted on the replacement domain, uncharged.
+    deadline = time.time() + 90
+    val = None
+    while time.time() < deadline:
+        try:
+            val = ray_tpu.get(actor.incr.remote(), timeout=20)
+            break
+        except Exception:
+            time.sleep(0.2)
+    assert val == 1  # fresh instance despite max_restarts=0
+    info = _gcs_actor_info(actor)
+    assert info.state == "ALIVE"
+    assert info.node_id.hex() in b_ids
+    assert info.num_restarts >= 1
+    assert info.num_restarts - info.preempted_restarts == 0
+
+    _assert_no_leaked_reservations(ray_cluster)
+
+    # Flight recorder covered the drain→re-place→restart window.
+    spans = [e for e in ray_cluster.gcs.task_events
+             if e.get("kind") == "span"
+             and e.get("trace_id") == "gang:slice-a"]
+    names = {s["name"] for s in spans}
+    assert {"gang_drain_notice", "gang_re_place",
+            "gang_restart"} <= names, names
+
+
+@pytest.mark.timeout(120)
+def test_gang_drain_lease_rejection_is_gang_coherent(ray_cluster):
+    """While a gang drains, NO member grants leases — including members
+    that only learned of the drain through the gang notice — and
+    spillback never routes into the dying slice."""
+    from ray_tpu._private.common import SchedulingStrategy, TaskSpec
+    from ray_tpu._private.ids import JobID, TaskID, WorkerID
+    from ray_tpu._private import worker_api
+
+    s1, s2 = _add_slice(ray_cluster, "slice-s", "TPU-test-head")
+    ray_cluster.connect()
+    import ray_tpu  # noqa: F401
+    ray_cluster.wait_for_nodes()
+
+    ray_cluster.drain_node(s1, deadline_s=5.0, grace_s=0.0, wait=False)
+    # Both raylets' drain notices are delivered asynchronously; the GCS
+    # state flipped atomically, the probe just needs the raylet flags.
+    deadline = time.time() + 10
+    while time.time() < deadline and not (s1._draining and s2._draining):
+        time.sleep(0.05)
+    assert s1._draining and s2._draining
+    core = _core()
+    gang_addresses = {s1.address, s2.address}
+
+    def probe(address, resources):
+        spec = TaskSpec(
+            task_id=TaskID.of(JobID.from_int(0)), job_id=JobID.from_int(0),
+            name="probe", function_id="probe", resources=resources,
+            scheduling=SchedulingStrategy(),
+            owner_worker_id=WorkerID.from_random())
+        return worker_api._call_on_core_loop(
+            core, core.clients.request(address, "request_worker_lease",
+                                       {"spec": spec}, timeout=10), 20)
+
+    # BOTH members reject (s2 was only drained via the gang escalation);
+    # a CPU shape may spill, but never into the gang.
+    for address in (s1.address, s2.address):
+        reply = probe(address, {"CPU": 1.0})
+        assert "granted" not in reply and "grants" not in reply
+        if "spillback" in reply:
+            assert reply["spillback"] not in gang_addresses
+    # A TPU shape no survivor can serve: draining retry, not a grant.
+    reply = probe(s2.address, {"TPU": 1.0})
+    assert reply.get("retry") or reply.get("infeasible")
+
+
+# ---------------------------------------------------------------------------
+# reserve-before-release handoff
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(120)
+def test_bundle_move_releases_source_reservation(ray_cluster):
+    """Regression for the PR 1 leak: when a bundle moves off a drained
+    node, the reservation its sibling bundle holds on a SURVIVING node
+    must stay (reserve-before-release keeps it), and nothing else may
+    remain reserved there after the move."""
+    import ray_tpu  # noqa: F401
+    from ray_tpu.util.placement_group import placement_group, \
+        placement_group_table
+
+    n2 = ray_cluster.add_node(num_cpus=1, resources={"pin": 1})
+    n3 = ray_cluster.add_node(num_cpus=1, resources={"pin": 1})
+    n4 = ray_cluster.add_node(num_cpus=1, resources={"pin": 1})
+    ray_cluster.connect()
+    ray_cluster.wait_for_nodes()
+
+    pg = placement_group([{"pin": 1}, {"pin": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(30)
+    row = next(r for r in placement_group_table()
+               if r["placement_group_id"] == pg.id.hex())
+    placed_on = set(row["bundle_nodes"].values())
+    assert len(placed_on) == 2
+    victim = next(r for r in (n2, n3, n4)
+                  if r.node_id.hex() in placed_on)
+    survivor = next(r for r in (n2, n3, n4)
+                    if r.node_id.hex() in placed_on and r is not victim)
+    survivor_keys = set(survivor.pool.bundles)
+
+    ray_cluster.drain_node(victim, deadline_s=5.0, grace_s=0.0, wait=True)
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        row = next(r for r in placement_group_table()
+                   if r["placement_group_id"] == pg.id.hex())
+        if row["state"] == "CREATED" \
+                and victim.node_id.hex() not in row["bundle_nodes"].values():
+            break
+        time.sleep(0.2)
+    assert row["state"] == "CREATED"
+    # The surviving bundle kept ITS reservation across the handoff.
+    assert set(survivor.pool.bundles) == survivor_keys
+    _assert_no_leaked_reservations(ray_cluster)
+
+
+@pytest.mark.timeout(120)
+def test_gang_handoff_all_or_nothing_when_destination_cannot_fit(
+        ray_cluster):
+    """A gang whose replacement domain does not exist yet must not strand
+    partial reservations anywhere: the re-place attempt rolls back to
+    zero, then commits atomically once capacity appears."""
+    import ray_tpu  # noqa: F401
+    from ray_tpu.util.placement_group import placement_group, \
+        placement_group_table
+
+    s1, s2 = _add_slice(ray_cluster, "slice-x", "TPU-x-head")
+    ray_cluster.connect()
+    ray_cluster.wait_for_nodes()
+
+    pg = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="STRICT_SPREAD")
+    assert pg.wait(30)
+
+    ray_cluster.drain_node(s1, deadline_s=2.0, grace_s=0.0, wait=True)
+    _stop_raylet(ray_cluster, s2)
+    time.sleep(1.0)  # give the background reschedule a few failing laps
+
+    row = next(r for r in placement_group_table()
+               if r["placement_group_id"] == pg.id.hex())
+    assert row["state"] != "CREATED"
+    # No TPU capacity anywhere: the survivors hold ZERO reservations.
+    for raylet in ray_cluster.raylets:
+        assert not raylet.pool.bundles
+    assert ray_cluster.gcs.gang_recoveries_total == 0
+
+    # Capacity arrives -> the gang commits atomically on the new domain.
+    t1, t2 = _add_slice(ray_cluster, "slice-y", "TPU-y-head")
+    ray_cluster.wait_for_nodes()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        row = next(r for r in placement_group_table()
+                   if r["placement_group_id"] == pg.id.hex())
+        if row["state"] == "CREATED":
+            break
+        time.sleep(0.2)
+    assert row["state"] == "CREATED"
+    assert set(row["bundle_nodes"].values()) == {t1.node_id.hex(),
+                                                 t2.node_id.hex()}
+    _assert_no_leaked_reservations(ray_cluster)
+
+
+@pytest.mark.timeout(120)
+def test_redrain_after_gang_death_reaps_new_member(ray_cluster):
+    """A host that registers with a previously-drained slice_id (provider
+    respawn reusing the slice) must still be drainable: the retired gang
+    task hands off (or a fresh one spawns) and the new member is reaped
+    by its own deadline instead of sitting DRAINING forever."""
+    import ray_tpu  # noqa: F401
+
+    s1, s2 = _add_slice(ray_cluster, "slice-r", "TPU-r-head")
+    ray_cluster.connect()
+    ray_cluster.wait_for_nodes()
+
+    ray_cluster.drain_node(s1, deadline_s=1.0, grace_s=0.0, wait=True)
+    deadline = time.time() + 20
+    while time.time() < deadline and any(
+            n.alive and n.slice_id == "slice-r"
+            for n in ray_cluster.gcs.nodes.values()):
+        time.sleep(0.1)
+    _stop_raylet(ray_cluster, s2)
+
+    # Same fault domain comes back (one replacement host registered).
+    r1 = ray_cluster.add_node(num_cpus=1, resources={"TPU": 4},
+                              slice_id="slice-r")
+    ray_cluster.wait_for_nodes()
+    ray_cluster.drain_node(r1, deadline_s=1.0, grace_s=0.0, wait=True)
+    info = ray_cluster.gcs.nodes.get(r1.node_id)
+    assert info is not None and not info.alive, \
+        "respawned gang member was never reaped"
+    assert ray_cluster.gcs.gang_drains_total == 2
+
+
+# ---------------------------------------------------------------------------
+# gang-aware task retry (uncharged, routed to the replacement domain)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(180)
+def test_gang_task_retry_uncharged_on_replacement_domain(ray_cluster):
+    """A max_retries=0 task running inside a slice PG when the slice is
+    reclaimed completes anyway: the loss classifies as preemption
+    (uncharged retry) and the retry routes to wherever the GCS re-placed
+    the bundle — the replacement domain."""
+    import ray_tpu
+    from ray_tpu.util.placement_group import slice_placement_group
+    from ray_tpu.util.scheduling_strategies import \
+        PlacementGroupSchedulingStrategy
+
+    sinfo = _mk_slice_info()
+    head_res = sinfo.head_resource()
+    a1, a2 = _add_slice(ray_cluster, "slice-a", head_res)
+    ray_cluster.connect()
+    ray_cluster.wait_for_nodes()
+
+    pg = slice_placement_group(sinfo)
+    assert pg.wait(60)
+    a_ids = {a1.node_id.hex(), a2.node_id.hex()}
+
+    b1, b2 = _add_slice(ray_cluster, "slice-b", head_res)
+    ray_cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def slow_where():
+        time.sleep(3.0)
+        return _current_node_id()
+
+    ref = slow_where.options(
+        num_cpus=0, resources={"TPU": 1}, max_retries=0,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg,
+            placement_group_bundle_index=1)).remote()
+    time.sleep(0.5)  # running inside slice A now
+
+    # The task (3s) cannot finish before the slice dies (1.5s deadline).
+    ray_cluster.drain_node(a1, deadline_s=1.5, grace_s=0.2, wait=True)
+    _stop_raylet(ray_cluster, a2)
+
+    got = ray_tpu.get(ref, timeout=120)
+    assert got and got not in a_ids
+    assert got in {b1.node_id.hex(), b2.node_id.hex()}
+    assert _core().reconstructions_total == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos: slice preemption killer (fast deterministic + slow soak)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(180)
+def test_chaos_slice_preemption_killer(ray_cluster):
+    """SlicePreemptionKiller reclaims every host of one slice within its
+    jitter window; the cluster keeps serving work on the other slice."""
+    import ray_tpu
+    from ray_tpu.util.chaos import SlicePreemptionKiller, run_with_chaos
+
+    _add_slice(ray_cluster, "kill-a", "TPU-ka-head")
+    _add_slice(ray_cluster, "kill-b", "TPU-kb-head")
+    ray_cluster.connect()
+    ray_cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def work(i):
+        time.sleep(0.1)
+        return i * 3
+
+    killer = SlicePreemptionKiller(ray_cluster, interval_s=0.5,
+                                   max_kills=1, seed=13, deadline_s=1.0,
+                                   grace_s=0.1, window_s=0.4)
+
+    def workload():
+        out = []
+        deadline = time.time() + 90
+        while (not killer.kills or len(out) < 24) \
+                and time.time() < deadline:
+            try:
+                out.extend(ray_tpu.get(
+                    [work.remote(i) for i in range(6)], timeout=60))
+            except Exception:
+                time.sleep(0.2)
+        return out
+
+    result, kill_log = run_with_chaos(workload, [killer])
+    assert kill_log and kill_log[0].startswith("slice:")
+    assert len(result) >= 24
+    dead_slice = kill_log[0].split(":", 1)[1]
+    # Every host of the victim slice is gone from the live cluster.
+    assert all(r.slice_id != dead_slice for r in ray_cluster.raylets)
+    assert ray_cluster.gcs.gang_drains_total >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_chaos_slice_preemption_soak(ray_cluster):
+    """Soak: repeated whole-slice reclaims with respawn under steady
+    load; the cluster must keep completing work after every loss."""
+    import ray_tpu
+    from ray_tpu.util.chaos import SlicePreemptionKiller, run_with_chaos
+
+    _add_slice(ray_cluster, "soak-a", "TPU-sa-head")
+    _add_slice(ray_cluster, "soak-b", "TPU-sb-head")
+    ray_cluster.connect()
+    ray_cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def work(i):
+        time.sleep(0.1)
+        return i
+
+    killer = SlicePreemptionKiller(ray_cluster, interval_s=2.0,
+                                   max_kills=3, seed=7, deadline_s=2.0,
+                                   grace_s=0.2, window_s=0.6,
+                                   respawn=True)
+
+    def workload():
+        total = 0
+        for _round in range(10):
+            total += sum(ray_tpu.get(
+                [work.remote(i) for i in range(10)], timeout=120))
+        return total
+
+    result, kill_log = run_with_chaos(workload, [killer])
+    assert result == 10 * sum(range(10))
+    assert kill_log and all(k.startswith("slice:") for k in kill_log)
+    assert ray_cluster.gcs.gang_drains_total >= len(kill_log)

@@ -1,0 +1,173 @@
+"""RPC idempotency annotations: static coverage check (tier-1, like
+test_metrics_catalog) + the ClientPool retry semantics they drive.
+
+The double-execute hole: a retried non-idempotent method could run twice
+when a LIVE peer only dropped the connection after receiving the
+request. With per-method annotations, ClientPool replays sent-but-lost
+requests only for idempotent methods; non-idempotent ones surface the
+ConnectionLost to the caller's own accounting.
+"""
+
+import asyncio
+import importlib.util
+import os
+
+
+def _load_checker():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts",
+        "check_rpc_idempotency.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_rpc_idempotency", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# static check (tier-1 guard alongside check_metrics_catalog)
+# ---------------------------------------------------------------------------
+
+def test_every_rpc_handler_is_annotated():
+    checker = _load_checker()
+    problems = checker.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_checker_detects_unannotated_handler(tmp_path):
+    checker = _load_checker()
+    p = tmp_path / "fake_daemon.py"
+    p.write_text(
+        "class S:\n"
+        "    @rpc.idempotent\n"
+        "    async def rpc_ok(self, conn, payload):\n"
+        "        pass\n"
+        "    async def rpc_gap(self, conn, payload):\n"
+        "        pass\n")
+    gaps = checker.handler_gaps(str(p))
+    assert [g[0] for g in gaps] == ["rpc_gap"]
+
+
+def test_registry_conflicts_merge_to_safer_flag():
+    from ray_tpu._private import rpc
+
+    @rpc.idempotent
+    async def rpc__merge_probe(conn, payload):  # noqa: U100
+        pass
+
+    assert rpc.idempotency_of("_merge_probe") is True
+
+    @rpc.non_idempotent
+    async def rpc__merge_probe(conn, payload):  # noqa: F811,U100
+        pass
+
+    # Two servers exposing one name: the safer (non-idempotent) wins.
+    assert rpc.idempotency_of("_merge_probe") is False
+
+
+def test_registry_fills_without_importing_server_modules(monkeypatch):
+    """A driver/worker process never imports gcs.py or raylet.py, so the
+    decorator side effects alone would leave the registry empty exactly
+    where the replay policy matters: cross-process. The lazy source scan
+    must resolve those methods anyway."""
+    from ray_tpu._private import rpc
+    monkeypatch.setattr(rpc, "_IDEMPOTENCY", {})
+    monkeypatch.setattr(rpc, "_SOURCE_SCANNED", False)
+    # Defined only in gcs.py / raylet.py — unimported-module stand-ins.
+    assert rpc.idempotency_of("register_job") is False
+    assert rpc.idempotency_of("kv_get") is True
+    assert rpc.idempotency_of("request_worker_lease") is False
+    assert rpc.idempotency_of("reserve_bundle") is True
+    # Unknown methods (test doubles, external handlers) stay None.
+    assert rpc.idempotency_of("no_such_method_anywhere") is None
+
+
+# ---------------------------------------------------------------------------
+# ClientPool replay semantics
+# ---------------------------------------------------------------------------
+
+def test_clientpool_replays_idempotent_not_nonidempotent():
+    """A handler that executes then kills the connection before the
+    reply: the client sees ConnectionLost with sent=True. Idempotent
+    methods are replayed (second attempt answers); non-idempotent
+    methods raise without double-executing."""
+    from ray_tpu._private import rpc
+
+    calls = {"idem": 0, "nonidem": 0}
+
+    async def run():
+        server = rpc.RpcServer("idem-test")
+
+        @rpc.idempotent
+        async def rpc__idem_probe(conn, payload):
+            calls["idem"] += 1
+            if calls["idem"] == 1:
+                conn.abort(rpc.ConnectionLost("simulated drop"))
+                await asyncio.sleep(0)  # reply write dies with the conn
+            return "ok"
+
+        @rpc.non_idempotent
+        async def rpc__nonidem_probe(conn, payload):
+            calls["nonidem"] += 1
+            conn.abort(rpc.ConnectionLost("simulated drop"))
+            return "never delivered"
+
+        server.register("_idem_probe", rpc__idem_probe)
+        server.register("_nonidem_probe", rpc__nonidem_probe)
+        port = await server.start("127.0.0.1", 0)
+        address = f"127.0.0.1:{port}"
+        pool = rpc.ClientPool()
+        try:
+            # Idempotent: replayed transparently on a fresh dial.
+            assert await pool.request(address, "_idem_probe",
+                                      timeout=10) == "ok"
+            assert calls["idem"] == 2
+
+            # Non-idempotent: the loss surfaces, no double-execute.
+            try:
+                await pool.request(address, "_nonidem_probe", timeout=10)
+                raised = False
+            except rpc.ConnectionLost as e:
+                raised = True
+                assert e.sent is True
+            assert raised
+            assert calls["nonidem"] == 1
+        finally:
+            await pool.close_all()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_connectionlost_sent_false_for_dial_failures():
+    """A request that provably never reached a peer (dial failure) keeps
+    sent=False, so even non-idempotent callers may safely retry it."""
+    from ray_tpu._private import rpc
+
+    async def run():
+        try:
+            await rpc.connect("127.0.0.1:1", timeout=1.0)
+        except rpc.ConnectionLost as e:
+            return e.sent
+        return None
+
+    assert asyncio.run(run()) is False
+
+
+def test_server_register_records_wire_alias():
+    """Servers that alias handlers on the wire (ClientServer's
+    client_<name>, GrpcProxyActor's serve_unary) get their annotation
+    registered under the TRUE wire name at RpcServer.register time —
+    the function-name key alone would leave the annotation inert for
+    any replay-capable client dialing the alias."""
+    from ray_tpu._private import rpc
+
+    @rpc.non_idempotent
+    async def rpc_probe_for_alias(conn, payload):
+        return None
+
+    server = rpc.RpcServer("alias-test")
+    server.register("aliased_probe_wire", rpc_probe_for_alias)
+    assert rpc.idempotency_of("aliased_probe_wire") is False
+    # The function-derived key is registered too (decorator side effect).
+    assert rpc.idempotency_of("probe_for_alias") is False
