@@ -35,10 +35,12 @@ from ray_tpu._private.flightrec import (IDX_WORKER, N_STAMPS, PH_ARGS_READY,
                                         PH_LEASE_WAIT, PH_RECEIVED,
                                         PH_REPLY_HANDLED, PH_RESULT_PUT,
                                         PH_SUBMITTED, PHASE_ORDER,
-                                        RECORD_LEN)
+                                        RECORD_LEN, EventRing)
 from ray_tpu._private import rpc
 from ray_tpu._private.common import (ACTOR_ALIVE, ACTOR_DEAD, ARG_INLINE,
-                                     ARG_REF, ActorInfo, TaskArg, TaskSpec)
+                                     ARG_REF, ActorInfo, TaskArg, TaskSpec,
+                                     TaskSpecTemplate, lease_probe_spec,
+                                     wire_spec_batch)
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
                                   WorkerID)
@@ -55,9 +57,17 @@ META_EXCEPTION = b"EXC"
 # receiver advances its ordering cursor without executing anything.
 SEQ_SKIP_METHOD = "__ray_tpu_seq_skip__"
 
+# Shared (task_args, kw_names, pin_refs, credits) for zero-arg calls: the
+# steady-state `.remote()` hot path allocates nothing for its arguments.
+_EMPTY_PREBUILT: tuple = ((), (), (), ())
 
-@dataclass
+
+@dataclass(slots=True)
 class OwnedObject:
+    """One owned object's refcount/location record. The three collection
+    fields are LAZY (shared-empty/None until first use): a steady-state
+    task return allocates the record and nothing else — three always-empty
+    lists per object were a top allocation site on the submit hot path."""
     object_id: ObjectID
     local_refs: int = 0
     borrowers: int = 0
@@ -67,34 +77,55 @@ class OwnedObject:
     handoff_credits: int = 0
     # For locally-stored containers: contained oids credited when THIS
     # object's value was serialized — freeing the container without it
-    # ever being deserialized returns those credits.
-    credited_contained: List["ObjectID"] = field(default_factory=list)
-    # Where the primary copy lives (raylet addresses).
-    locations: List[str] = field(default_factory=list)
+    # ever being deserialized returns those credits. () until assigned.
+    credited_contained: Any = ()
+    # Where the primary copy lives (raylet addresses); None = nowhere yet.
+    locations: Optional[List[str]] = None
     inline_value: Optional[bytes] = None       # serialized, for small objects
     is_exception: bool = False
     # Lineage: spec of the task that created it (for reconstruction).
     creating_spec: Optional[TaskSpec] = None
     ready: bool = False
-    waiters: List[asyncio.Future] = field(default_factory=list)
+    # Futures parked on readiness; None until the first waiter.
+    waiters: Optional[List[asyncio.Future]] = None
     spilled: bool = False
     reconstructions: int = 0   # lineage re-executions consumed (bounded)
 
+    def add_waiter(self, fut: "asyncio.Future"):
+        if self.waiters is None:
+            self.waiters = [fut]
+        else:
+            self.waiters.append(fut)
 
-@dataclass
+    def add_location(self, addr: str):
+        if self.locations is None:
+            self.locations = [addr]
+        elif addr not in self.locations:
+            self.locations.append(addr)
+
+    def wake_waiters(self):
+        if self.waiters:
+            for fut in self.waiters:
+                if not fut.done():
+                    fut.set_result(True)
+            self.waiters.clear()
+
+
+@dataclass(slots=True)
 class PendingTask:
     spec: TaskSpec
     retries_left: int = 0
     returns: List[ObjectID] = field(default_factory=list)
     # Holding real ObjectRefs pins arg objects (refcount) until completion.
-    arg_refs: List[ObjectRef] = field(default_factory=list)
+    # () = none yet (shared empty; the no-arg hot path allocates nothing).
+    arg_refs: Any = ()
     # Handoff credits granted when the task's inline args were serialized
     # (self-owned refs contained in arg values). Cleared when the spec
     # actually ships to an executor (the receiver's deserialization
     # consumes them); returned via _return_handoff_credits if the spec is
     # discarded unshipped (cancel/queue-failure) — otherwise the contained
     # objects stay pinned forever (ADVICE r4).
-    arg_credits: List[ObjectID] = field(default_factory=list)
+    arg_credits: Any = ()
     # Flight-recorder stamps: a fixed-size list indexed by flightrec's
     # PH_* constants (wall-clock floats; None = not reached; last slot =
     # executing worker hex). Owner-side stamps land here directly;
@@ -105,7 +136,7 @@ class PendingTask:
     phases: Optional[list] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class GeneratorStream:
     """Owner-side state of a streaming-generator task
     (reference: task_manager.h ObjectRefStream, num_returns='streaming')."""
@@ -133,7 +164,7 @@ class GeneratorStream:
         self.waiters.clear()
 
 
-@dataclass
+@dataclass(slots=True)
 class LeaseEntry:
     worker_id: WorkerID
     worker_address: str
@@ -318,13 +349,24 @@ class CoreWorker:
         self._running_tasks: Dict[TaskID, Any] = {}
         self._cancelled_tasks: set = set()
         self.generator_streams: Dict[TaskID, GeneratorStream] = {}
-        self._task_events_buffer: List[dict] = []
+        # Task events: fixed-slot ring written on the submit/reply hot
+        # path, folded into wire dicts only at flush (PR 3's recorder at
+        # near-zero marginal cost). Spans (tracing.enable()) are rare and
+        # keep a plain list.
+        self._task_events = EventRing()
+        self._span_events: List[dict] = []
+        self._te_flush_scheduled = False
         # Drain/preemption awareness (nodes channel): raylet addresses that
         # announced a drain, the event log (Train reads it to classify gang
         # failures), and whether THIS process's node is draining (worker
         # mode: feeds train.should_checkpoint / save-on-preempt).
         self._draining_raylets: set = set()
         self.drain_events: List[dict] = []
+        # Push-wakeup hooks fired (on the core loop) when a drain notice
+        # lands: the Train preemption watcher parks on an event instead of
+        # polling drain_events at 0.25s (see worker_api
+        # add_drain_event_listener).
+        self.drain_listeners: List[Callable[[], None]] = []
         self.local_node_draining = False
         # Lineage re-executions performed by this owner (drain acceptance
         # tests assert the graceful path keeps this at zero).
@@ -667,6 +709,7 @@ class CoreWorker:
                 if self.node_id is not None and any(
                         nid == self.node_id for nid in node_ids):
                     self.local_node_draining = True
+                self._fire_drain_listeners()
             elif event == "draining":
                 address = msg.get("address", "")
                 self.drain_events.append({
@@ -681,6 +724,7 @@ class CoreWorker:
                     # Our own host is going away: surface to the session
                     # layer (Train save-on-preempt).
                     self.local_node_draining = True
+                self._fire_drain_listeners()
             elif event == "dead":
                 # Reconstruction checks for objects on that node happen
                 # lazily (a failed fetch walks the location list itself).
@@ -701,6 +745,13 @@ class CoreWorker:
                 for addr in stale:
                     self.loop.call_later(
                         15.0, self._draining_raylets.discard, addr)
+
+    def _fire_drain_listeners(self):
+        for cb in list(self.drain_listeners):
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — listeners must not break pubsub
+                logger.exception("drain-event listener failed")
 
     def _on_raylet_draining(self, address: str):
         """Stop routing new tasks through leases on a draining node: drop
@@ -848,7 +899,7 @@ class CoreWorker:
                 pass
         if ent is None:
             return
-        for addr in ent.locations:
+        for addr in ent.locations or ():
             try:
                 conn = await self.clients.get(addr)
                 await conn.notify("store_delete", {"object_ids": [oid.binary()]})
@@ -952,7 +1003,7 @@ class CoreWorker:
             return {"error": "freed"}
         if not ent.ready:
             fut = asyncio.get_running_loop().create_future()
-            ent.waiters.append(fut)
+            ent.add_waiter(fut)
             timeout = payload.get("timeout")
             try:
                 await asyncio.wait_for(fut, timeout)
@@ -962,7 +1013,7 @@ class CoreWorker:
             if ent is None:
                 return {"error": "freed"}
         return {"inline": ent.inline_value,
-                "locations": list(ent.locations),
+                "locations": list(ent.locations or ()),
                 "is_exception": ent.is_exception}
 
     @rpc.non_idempotent
@@ -1006,9 +1057,7 @@ class CoreWorker:
     async def _rpc_owner_add_location(self, conn, payload):
         ent = self.owned.get(payload["object_id"])
         if ent is not None:
-            addr = payload["location"]
-            if addr not in ent.locations:
-                ent.locations.append(addr)
+            ent.add_location(payload["location"])
         return True
 
     # ---- put / get ----
@@ -1043,7 +1092,7 @@ class CoreWorker:
         ent.credited_contained = list(ser.credited_ids)
         self.owned[oid] = ent
         await self.store.put(oid.binary(), ser, owner_address=self.address)
-        ent.locations.append(self.raylet_address)
+        ent.add_location(self.raylet_address)
         return ObjectRef(oid, self.address)
 
     def put_sync(self, value: Any) -> ObjectRef:
@@ -1093,7 +1142,7 @@ class CoreWorker:
                 continue
             if not ent.ready:
                 fut = asyncio.get_running_loop().create_future()
-                ent.waiters.append(fut)
+                ent.add_waiter(fut)
                 waits.append((i, oid, fut))
                 continue
             if not self._resolve_ready_inline(ent, out, i):
@@ -1162,7 +1211,7 @@ class CoreWorker:
         oid = ent.object_id
         if not ent.ready:
             fut = asyncio.get_running_loop().create_future()
-            ent.waiters.append(fut)
+            ent.add_waiter(fut)
             if deadline is None:
                 await fut
             else:
@@ -1177,7 +1226,7 @@ class CoreWorker:
                 self._inproc_exc.add(oid)
             return val, ent.is_exception
         # Large object: fetch via local store (pull from remote if needed).
-        result = await self._materialize_large(oid, ent.locations,
+        result = await self._materialize_large(oid, ent.locations or (),
                                                self.address, deadline)
         if result is None:
             # Primary copies lost -> lineage reconstruction.
@@ -1281,8 +1330,8 @@ class CoreWorker:
         # Record the new location with the owner.
         if owner == self.address:
             ent = self.owned.get(oid)
-            if ent is not None and self.raylet_address not in ent.locations:
-                ent.locations.append(self.raylet_address)
+            if ent is not None:
+                ent.add_location(self.raylet_address)
         else:
             try:
                 conn = await self.clients.get(owner)
@@ -1392,7 +1441,7 @@ class CoreWorker:
         if ent is not None:
             if not ent.ready:
                 fut = asyncio.get_running_loop().create_future()
-                ent.waiters.append(fut)
+                ent.add_waiter(fut)
                 await fut
             return True
         if ref.id in self.inproc:
@@ -1575,10 +1624,7 @@ class CoreWorker:
             ent.inline_value = ser
             ent.is_exception = not ok
             ent.ready = True
-            for fut in ent.waiters:
-                if not fut.done():
-                    fut.set_result(True)
-            ent.waiters.clear()
+            ent.wake_waiters()
 
     # ==================================================================
     # Task submission (normal tasks)
@@ -1675,33 +1721,37 @@ class CoreWorker:
         fetches them. `credits` are the handoff credits granted while
         serializing inline args — track them with the spec and return them
         if the bytes are discarded unshipped."""
+        if not args and not kwargs:
+            return _EMPTY_PREBUILT
         task_args: List[TaskArg] = []
-        kw_names: List[str] = []
         pin_refs: List[ObjectRef] = []
         credits: List[ObjectID] = []
+        serialize_inline = self.serialization.serialize_inline
+        limit = self.config.max_direct_call_object_size
         try:
-            for v in list(args) + list(kwargs.values()):
+            for v in (args if not kwargs else (*args, *kwargs.values())):
                 if isinstance(v, ObjectRef):
                     task_args.append(TaskArg(ARG_REF, object_id=v.id,
                                              owner_address=v.owner_address or self.address))
-                else:
+                    continue
+                data = serialize_inline(v, limit)
+                if data is None:
                     ser = self.serialization.serialize(v)
-                    if ser.total_size > self.config.max_direct_call_object_size:
+                    if ser.total_size > limit:
                         ref = await self.put_async(v)
                         pin_refs.append(ref)
                         task_args.append(TaskArg(ARG_REF, object_id=ref.id,
                                                  owner_address=self.address))
-                    else:
-                        credits.extend(ser.credited_ids)
-                        task_args.append(TaskArg(ARG_INLINE,
-                                                 data=ser.to_bytes()))
+                        continue
+                    credits.extend(ser.credited_ids)
+                    data = ser.to_bytes()
+                task_args.append(TaskArg(ARG_INLINE, data=data))
         except Exception:
             # A later arg failed to serialize: the earlier args' bytes are
             # dead — return their credits before propagating.
             self._return_handoff_credits(credits)
             raise
-        kw_names = list(kwargs.keys())
-        return task_args, kw_names, pin_refs, credits
+        return task_args, tuple(kwargs) if kwargs else (), pin_refs, credits
 
     async def submit_task(self, function_id: str, args: tuple, kwargs: dict,
                           **opts) -> List[ObjectRef]:
@@ -1760,8 +1810,7 @@ class CoreWorker:
             self.generator_streams[task_id] = GeneratorStream(task_id,
                                                               spec=spec)
         self.pending_tasks[task_id] = PendingTask(
-            spec=spec, retries_left=spec.max_retries, returns=returns,
-            arg_refs=[])
+            spec=spec, retries_left=spec.max_retries, returns=returns)
         self._stamp_phase(task_id, PH_SUBMITTED)
         self._record_task_event(spec, "PENDING")
         asyncio.ensure_future(
@@ -1781,26 +1830,33 @@ class CoreWorker:
         granted by the probe serializations are returned — the probe's
         bytes are discarded and _build_args re-serializes from scratch
         (ADVICE r4: the probe credit leaked, pinning contained refs)."""
+        if not args and not kwargs:
+            return _EMPTY_PREBUILT
         task_args: List[TaskArg] = []
         credits: List[ObjectID] = []
+        serialize_inline = self.serialization.serialize_inline
+        limit = self.config.max_direct_call_object_size
         try:
-            for v in list(args) + list(kwargs.values()):
+            for v in (args if not kwargs else (*args, *kwargs.values())):
                 if isinstance(v, ObjectRef):
                     task_args.append(TaskArg(
                         ARG_REF, object_id=v.id,
                         owner_address=v.owner_address or self.address))
-                else:
+                    continue
+                data = serialize_inline(v, limit)
+                if data is None:
                     ser = self.serialization.serialize(v)
-                    if ser.total_size > self.config.max_direct_call_object_size:
+                    if ser.total_size > limit:
                         credits.extend(ser.credited_ids)
                         self._return_handoff_credits(credits)
                         return None  # needs async plasma put; loop path
                     credits.extend(ser.credited_ids)
-                    task_args.append(TaskArg(ARG_INLINE, data=ser.to_bytes()))
+                    data = ser.to_bytes()
+                task_args.append(TaskArg(ARG_INLINE, data=data))
         except Exception:
             self._return_handoff_credits(credits)
             raise
-        return task_args, list(kwargs.keys()), [], credits
+        return task_args, tuple(kwargs) if kwargs else (), (), credits
 
     def submit_task_threadsafe(self, function_id: str, args: tuple,
                                kwargs: dict, *, name: str = "",
@@ -1847,8 +1903,7 @@ class CoreWorker:
                 self.generator_streams[task_id] = GeneratorStream(task_id,
                                                                   spec=spec)
             self.pending_tasks[task_id] = PendingTask(
-                spec=spec, retries_left=spec.max_retries, returns=returns,
-                arg_refs=[])
+                spec=spec, retries_left=spec.max_retries, returns=returns)
         self._stamp_phase(task_id, PH_SUBMITTED)
         self._record_task_event(spec, "PENDING")
         self._post_to_loop(
@@ -1877,12 +1932,136 @@ class CoreWorker:
             spec.args = task_args
             if kw_names:
                 spec.kwarg_names = tuple(kw_names)
-            pt.arg_refs = self._pin_arg_refs(spec) + pin_refs
+            pt.arg_refs = self._pin_args(spec, pin_refs)
             pt.arg_credits = credits
             self._enqueue_task_spec(spec)
             return
         asyncio.ensure_future(
             self._finish_task_submission(spec, args, kwargs, export, prebuilt))
+
+    # ---- templated submission (the steady-state `.remote()` fast path) ----
+
+    def submit_task_templated(self, tmpl: TaskSpecTemplate, args: tuple,
+                              kwargs: dict) -> List[ObjectRef]:
+        """Thread-safe submission for a templated call site.
+
+        The façade pre-resolved every invariant (options, resources,
+        scheduling, runtime_env=None, exported function) into `tmpl`;
+        a steady-state call stamps task id + args onto a template copy
+        and registers bookkeeping — no per-call option dicts, no
+        30-kwarg dataclass construction, no per-call coroutine."""
+        prebuilt = self._try_build_args_sync(args, kwargs)
+        task_id = self._next_task_id()
+        if prebuilt is not None:
+            task_args, kw_names, pin_refs, credits = prebuilt
+            spec = tmpl.make(task_id, task_args,
+                             tuple(kw_names) if kw_names else ())
+        else:
+            spec = tmpl.make(task_id, [])
+        ctx = _tracing.current_context()
+        if ctx is not None:
+            spec.trace_ctx = ctx
+        refs: List[ObjectRef] = []
+        returns: List[ObjectID] = []
+        with self.submission_lock:
+            for i in range(tmpl.num_returns):
+                oid = ObjectID.for_task_return(task_id, i)
+                self.owned[oid] = OwnedObject(object_id=oid,
+                                              creating_spec=spec)
+                returns.append(oid)
+                refs.append(ObjectRef(oid, self.address))
+            self.pending_tasks[task_id] = PendingTask(
+                spec=spec, retries_left=spec.max_retries, returns=returns)
+        if self.config.task_events_enabled:
+            now = time.time()  # one clock read feeds stamp AND event
+            self._stamp_phase(task_id, PH_SUBMITTED, now)
+            self._record_task_event(spec, "PENDING", t=now)
+        if prebuilt is not None:
+            self._post_to_loop(self._post_templated_task_submit, spec,
+                               pin_refs, credits)
+        else:
+            # An arg needs a plasma put: loop-side serialization path.
+            self._post_to_loop(self._post_threadsafe_task_submit, spec,
+                               args, kwargs, None, None)
+        return refs
+
+    def _post_templated_task_submit(self, spec, pin_refs, credits):
+        if spec.function_id in getattr(self, "_pending_exports", ()):
+            # A deferred export of this function is still in flight:
+            # chain behind it on the slow path.
+            asyncio.ensure_future(self._finish_task_submission(
+                spec, (), {}, None,
+                (spec.args, spec.kwarg_names, pin_refs, credits)))
+            return
+        pt = self.pending_tasks.get(spec.task_id)
+        if pt is None:
+            self._return_handoff_credits(credits)
+            return  # cancelled before dispatch
+        pt.arg_refs = self._pin_args(spec, pin_refs)
+        pt.arg_credits = credits
+        self._enqueue_task_spec(spec)
+
+    def submit_actor_task_templated(self, tmpl: TaskSpecTemplate,
+                                    args: tuple, kwargs: dict
+                                    ) -> List[ObjectRef]:
+        """Thread-safe actor-call submission for a templated call site
+        (same contract as submit_actor_task_threadsafe)."""
+        prebuilt = self._try_build_args_sync(args, kwargs)
+        actor_id = tmpl.base["actor_id"]
+        with self.submission_lock:
+            q = self.actor_queues.get(actor_id)
+            new_q = q is None
+            if new_q:
+                q = ActorSubmitQueue(actor_id, self.submission_lock)
+                self.actor_queues[actor_id] = q
+            seq_no = q.next_seq()
+            task_id = TaskID.for_actor_task(self.job_id, actor_id, seq_no,
+                                            q.epoch)
+            if prebuilt is not None:
+                task_args, kw_names, pin_refs, credits = prebuilt
+                spec = tmpl.make(task_id, task_args,
+                                 tuple(kw_names) if kw_names else (),
+                                 seq_no)
+            else:
+                spec = tmpl.make(task_id, [], seq_no=seq_no)
+            ctx = _tracing.current_context()
+            if ctx is not None:
+                spec.trace_ctx = ctx
+            q.inflight[seq_no] = spec
+            refs: List[ObjectRef] = []
+            returns: List[ObjectID] = []
+            for i in range(tmpl.num_returns):
+                oid = ObjectID.for_task_return(task_id, i)
+                self.owned[oid] = OwnedObject(object_id=oid)
+                returns.append(oid)
+                refs.append(ObjectRef(oid, self.address))
+            self.pending_tasks[task_id] = PendingTask(
+                spec=spec, retries_left=spec.max_retries, returns=returns)
+        self._stamp_phase(task_id, PH_SUBMITTED)
+        if prebuilt is not None:
+            self._post_to_loop(self._post_templated_actor_submit, q, spec,
+                               pin_refs, credits, new_q)
+        else:
+            self._post_to_loop(self._post_threadsafe_actor_submit, q, spec,
+                               args, kwargs, None, new_q)
+        return refs
+
+    def _post_templated_actor_submit(self, q, spec, pin_refs, credits,
+                                     new_q):
+        if new_q:
+            asyncio.ensure_future(self._populate_actor_queue(q))
+        pt = self.pending_tasks.get(spec.task_id)
+        if pt is None:
+            self._return_handoff_credits(credits)
+            return  # cancelled before dispatch
+        pt.arg_refs = self._pin_args(spec, pin_refs)
+        pt.arg_credits = credits
+        if q.state == "ALIVE":
+            # Fast path: enqueue the push directly, NO per-task coroutine;
+            # the batch flusher dispatches the reply.
+            self._enqueue_actor_push(q, spec, None)
+            return
+        asyncio.ensure_future(self._submit_actor_task(q, spec))
 
     def _post_to_loop(self, fn, *args):
         """call_soon_threadsafe with wakeup coalescing (any thread)."""
@@ -1966,7 +2145,7 @@ class CoreWorker:
         if spec.runtime_env:
             spec.runtime_env = await self.prepare_runtime_env(spec.runtime_env)
         pt = self.pending_tasks[spec.task_id]
-        pt.arg_refs = self._pin_arg_refs(spec) + pin_refs
+        pt.arg_refs = self._pin_args(spec, pin_refs)
         pt.arg_credits = credits
         await self._submit_to_cluster(spec)
 
@@ -1975,6 +2154,16 @@ class CoreWorker:
         (reference semantics: reference_count.h submitted-task references)."""
         return [ObjectRef(a.object_id, a.owner_address)
                 for a in spec.args if a.kind == ARG_REF]
+
+    def _pin_args(self, spec: TaskSpec, extra):
+        """_pin_arg_refs + the prebuilt pin_refs, allocation-free when the
+        spec has no args (the shared-empty-prebuilt hot path)."""
+        if not spec.args:
+            return list(extra) if extra else ()
+        refs = self._pin_arg_refs(spec)
+        if extra:
+            refs.extend(extra)
+        return refs
 
     def _enqueue_task_spec(self, spec: TaskSpec):
         sched_class = spec.scheduling_class()
@@ -2115,7 +2304,8 @@ class CoreWorker:
                 try:
                     reply = await self.clients.request(
                         raylet_addr, "request_worker_lease",
-                        {"spec": sample_spec, "count": count},
+                        {"spec": lease_probe_spec(sample_spec),
+                         "count": count},
                         timeout=self.config.worker_lease_timeout_s + 10)
                 except (rpc.RpcError, OSError) as e:
                     if self._shutdown:
@@ -2225,7 +2415,9 @@ class CoreWorker:
                 # batches only form for overflow beyond live lease demand.
                 # (A per-item streamed-reply variant measured ~2.4x slower
                 # on the microbenchmarks; reply latency lost.)
-                push_payload = {"specs": specs}
+                # Templated batches ship the invariant spec fields once
+                # per frame; the executor decodes them once.
+                push_payload = {"specs": wire_spec_batch(specs)}
             if not self.config.task_events_enabled:
                 # Owner recorder off: the executor skips its stamps too.
                 push_payload["ph"] = 0
@@ -2322,19 +2514,30 @@ class CoreWorker:
                 f"worker died while running task {spec.name}",
                 preempted=preempted), retry=False)
 
-    def _handle_task_reply(self, spec: TaskSpec, reply: dict,
+    def _merge_exec_phases(self, spec: TaskSpec, wphases):
+        if wphases is None or not self.config.task_events_enabled:
+            return
+        pt = self.pending_tasks.get(spec.task_id)
+        if pt is not None:
+            ph = pt.phases
+            if ph is None:
+                ph = pt.phases = [None] * RECORD_LEN
+            for i in range(PH_RECEIVED, RECORD_LEN):
+                v = wphases[i]
+                if v is not None:
+                    ph[i] = v
+
+    def _handle_task_reply(self, spec: TaskSpec, reply,
                            exec_raylet: str):
-        wphases = reply.get("phases")
-        if wphases is not None and self.config.task_events_enabled:
-            pt = self.pending_tasks.get(spec.task_id)
-            if pt is not None:
-                ph = pt.phases
-                if ph is None:
-                    ph = pt.phases = [None] * RECORD_LEN
-                for i in range(PH_RECEIVED, RECORD_LEN):
-                    v = wphases[i]
-                    if v is not None:
-                        ph[i] = v
+        if type(reply) is tuple:
+            # Flat success envelope (returns, phases): the steady-state
+            # path — no dict lookups, return slots resolved straight from
+            # the pending record.
+            returns, wphases = reply
+            self._merge_exec_phases(spec, wphases)
+            self._complete_task_ok(spec, returns, exec_raylet)
+            return
+        self._merge_exec_phases(spec, reply.get("phases"))
         if reply.get("cancelled"):
             self._complete_task_error(spec, exc.TaskCancelledError(spec.task_id),
                                       retry=False)
@@ -2361,29 +2564,37 @@ class CoreWorker:
                 stream.total = reply["generator_done"]
                 stream.wake()
             return
-        returns = reply["returns"]  # list of {"inline": bytes}|{"stored": addr, "size": n}
+        # Legacy dict-form success envelope: convert its rows to the flat
+        # record shape so the "decoders handle both" contract holds (an
+        # old-version executor replying dict-form must not hang the get).
+        returns = [r if type(r) is tuple else
+                   (r.get("inline"), r.get("stored"),
+                    bool(r.get("is_exception")))
+                   for r in reply["returns"]]
         self._complete_task_ok(spec, returns, exec_raylet)
 
-    def _register_return_object(self, spec: TaskSpec, index: int, ret: dict,
-                                exec_raylet: str) -> ObjectID:
-        """Make return slot `index` of `spec` a ready owned object."""
-        oid = ObjectID.for_task_return(spec.task_id, index)
+    def _register_return_object(self, spec: TaskSpec, index: int, ret,
+                                exec_raylet: str,
+                                oid: Optional[ObjectID] = None) -> ObjectID:
+        """Make return slot `index` of `spec` a ready owned object.
+
+        `ret` is a flat (inline_bytes|None, stored_addr|None, is_exception)
+        record; `oid` lets completion reuse the ObjectID already held in
+        PendingTask.returns instead of re-deriving it."""
+        if oid is None:
+            oid = ObjectID.for_task_return(spec.task_id, index)
         ent = self.owned.get(oid)
         if ent is None:
             ent = OwnedObject(object_id=oid, creating_spec=spec)
             self.owned[oid] = ent
-        if "inline" in ret:
-            ent.inline_value = ret["inline"]
+        inline, stored, is_exc = ret
+        if inline is not None:
+            ent.inline_value = inline
         else:
-            loc = ret.get("stored", exec_raylet)
-            if loc not in ent.locations:
-                ent.locations.append(loc)
-        ent.is_exception = bool(ret.get("is_exception"))
+            ent.add_location(stored or exec_raylet)
+        ent.is_exception = is_exc
         ent.ready = True
-        for fut in ent.waiters:
-            if not fut.done():
-                fut.set_result(True)
-        ent.waiters.clear()
+        ent.wake_waiters()
         return oid
 
     @rpc.idempotent
@@ -2464,13 +2675,17 @@ class CoreWorker:
                     pass
             asyncio.ensure_future(_cancel())
 
-    def _complete_task_ok(self, spec: TaskSpec, returns: List[dict],
+    def _complete_task_ok(self, spec: TaskSpec, returns: list,
                           exec_raylet: str):
         pt = self.pending_tasks.pop(spec.task_id, None)
         phases = self._finish_phase_record(pt)
         self._record_task_event(spec, "FINISHED", phases)
+        oids = (pt.returns if pt is not None
+                and len(pt.returns) == len(returns) else None)
         for i, ret in enumerate(returns):
-            self._register_return_object(spec, i, ret, exec_raylet)
+            self._register_return_object(
+                spec, i, ret, exec_raylet,
+                oids[i] if oids is not None else None)
 
     def _complete_task_error(self, spec: TaskSpec, error: Exception,
                              retry: bool):
@@ -2499,10 +2714,7 @@ class CoreWorker:
             ent.inline_value = ser
             ent.is_exception = True
             ent.ready = True
-            for fut in ent.waiters:
-                if not fut.done():
-                    fut.set_result(True)
-            ent.waiters.clear()
+            ent.wake_waiters()
 
     async def cancel_task(self, ref: ObjectRef, force: bool = False):
         task_id = ref.id.task_id()
@@ -2602,7 +2814,7 @@ class CoreWorker:
             # (re)instantiated — restarts re-fetch them — so the pins are
             # released only on the DEAD pubsub event.
             self._actor_creation_pins[spec.actor_id] = \
-                self._pin_arg_refs(spec) + pin_refs
+                self._pin_args(spec, pin_refs)
             await self.gcs.request("register_actor", {"spec": spec})
         except Exception as e:
             # Spec never reached an executor: its inline-arg credits would
@@ -2660,8 +2872,7 @@ class CoreWorker:
             self.generator_streams[task_id] = GeneratorStream(task_id,
                                                               spec=spec)
         self.pending_tasks[task_id] = PendingTask(
-            spec=spec, retries_left=max_task_retries, returns=returns,
-            arg_refs=[])
+            spec=spec, retries_left=max_task_retries, returns=returns)
         self._stamp_phase(task_id, PH_SUBMITTED)
         asyncio.ensure_future(
             self._finish_actor_task_submission(q, spec, args, kwargs,
@@ -2716,8 +2927,7 @@ class CoreWorker:
                 self.generator_streams[task_id] = GeneratorStream(task_id,
                                                                   spec=spec)
             self.pending_tasks[task_id] = PendingTask(
-                spec=spec, retries_left=max_task_retries, returns=returns,
-                arg_refs=[])
+                spec=spec, retries_left=max_task_retries, returns=returns)
         self._stamp_phase(task_id, PH_SUBMITTED)
         self._post_to_loop(
             self._post_threadsafe_actor_submit, q, spec, args, kwargs,
@@ -2743,7 +2953,7 @@ class CoreWorker:
             task_args, kw_names, pin_refs, credits = prebuilt
             spec.args = task_args
             spec.kwarg_names = tuple(kw_names)
-            pt.arg_refs = self._pin_arg_refs(spec) + pin_refs
+            pt.arg_refs = self._pin_args(spec, pin_refs)
             pt.arg_credits = credits
             self._enqueue_actor_push(q, spec, None)
             return
@@ -2775,7 +2985,7 @@ class CoreWorker:
         spec.args = task_args
         spec.kwarg_names = tuple(kw_names)
         pt = self.pending_tasks[spec.task_id]
-        pt.arg_refs = self._pin_arg_refs(spec) + pin_refs
+        pt.arg_refs = self._pin_args(spec, pin_refs)
         pt.arg_credits = credits
         await self._submit_actor_task(q, spec)
 
@@ -2976,7 +3186,8 @@ class CoreWorker:
                 push_payload: dict = {"spec": live[0][0]}
                 push_method = "push_actor_task"
             else:
-                push_payload = {"specs": [s for s, _ in live]}
+                push_payload = {"specs": wire_spec_batch(
+                    [s for s, _ in live])}
                 push_method = "push_actor_tasks"
             if not record:
                 push_payload["ph"] = 0  # executor skips its stamps too
@@ -3154,24 +3365,44 @@ class CoreWorker:
             return values[:n_pos], dict(zip(kw_names, values[n_pos:]))
         return values, {}
 
-    def _serialize_return(self, value: Any, is_exception: bool = False) -> dict:
+    def _resolve_inline_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        """Synchronous arg resolution for ALL-INLINE specs: no coroutine
+        per task on the executor's batch hot path (inline deserialization
+        never blocks)."""
+        deser = self.serialization.deserialize
+        values = [deser(a.data) for a in spec.args]
+        kw_names = spec.kwarg_names
+        if kw_names:
+            n_pos = len(values) - len(kw_names)
+            return values[:n_pos], dict(zip(kw_names, values[n_pos:]))
+        return values, {}
+
+    def _serialize_return(self, value: Any, is_exception: bool = False
+                          ) -> tuple:
+        """Flat return record (inline_bytes|None, large_ser|None, is_exc);
+        a SerializedObject in slot 1 means the value needs a plasma put
+        (the caller replaces it with the storing raylet's address)."""
+        limit = self.config.max_direct_call_object_size
+        data = self.serialization.serialize_inline(value, limit)
+        if data is not None:
+            return (data, None, is_exception)
         ser = self.serialization.serialize(value)
-        if ser.total_size <= self.config.max_direct_call_object_size:
-            return {"inline": ser.to_bytes(), "is_exception": is_exception}
-        return {"__large__": ser, "is_exception": is_exception}
+        if ser.total_size <= limit:
+            return (ser.to_bytes(), None, is_exception)
+        return (None, ser, is_exception)
 
     async def _store_returns(self, spec: TaskSpec, values: List[Any],
-                             is_exception: bool = False) -> List[dict]:
+                             is_exception: bool = False) -> list:
         out = []
         for i, v in enumerate(values):
             r = self._serialize_return(v, is_exception)
-            if "__large__" in r:
-                ser = r.pop("__large__")
+            ser = r[1]
+            if ser is not None:
                 oid = ObjectID.for_task_return(spec.task_id, i)
                 meta = META_EXCEPTION if is_exception else b""
                 await self.store.put(oid.binary(), ser, metadata=meta,
                                      owner_address=spec.owner_address)
-                r["stored"] = self.raylet_address
+                r = (None, self.raylet_address, is_exception)
             out.append(r)
         return out
 
@@ -3219,10 +3450,9 @@ class CoreWorker:
                 elif ok:
                     values = self._split_returns(res, spec.num_returns)
                     returns = await self._store_returns(spec, values)
-                    replies[i] = {"returns": returns}
                     if ph is not None:
                         ph[PH_RESULT_PUT] = time.time()
-                        replies[i]["phases"] = ph
+                    replies[i] = (returns, ph)
                 else:
                     e, tb_str = res
                     err = exc.TaskError(e, tb_str, spec.task_id, os.getpid())
@@ -3284,45 +3514,67 @@ class CoreWorker:
         # later spec's env (ADVICE r4 — caller-side scheduling-class
         # homogeneity makes mixed-env batches unlikely, but the handler
         # must enforce it itself).
-        current_env_key: Any = ()
+        current_env_key: Any = None
 
         want_ph = payload.get("ph", 1)
+        fn_cache = self._function_cache
         async with self._task_exec_lock:
             for i, spec in enumerate(specs):
                 ph = self._new_exec_phases(want_ph)
-                # Mirror _push_task_locked's prep + error envelope.
-                try:
-                    env_key = (repr(sorted(spec.runtime_env.items()))
-                               if spec.runtime_env else None)
-                    if env_key != current_env_key:
-                        await flush_jobs()
-                        current_env_key = env_key
-                    await self._ensure_runtime_env(spec.runtime_env)
-                    func = await self._load_function(spec.function_id)
-                    if any(a.kind != ARG_INLINE for a in spec.args):
-                        # Bounded: a ref arg that can only become ready
-                        # via THIS batch's reply (a submitter bug —
-                        # _take_batch forbids it) must degrade to a
-                        # retryable error, not wedge the worker's exec
-                        # lock forever. Inline args never block: skip the
-                        # wait_for Task per spec.
-                        args, kwargs = await asyncio.wait_for(
-                            self._resolve_task_args(spec),
-                            timeout=self.config.worker_lease_timeout_s)
-                    else:
-                        args, kwargs = await self._resolve_task_args(spec)
-                except _DependencyError as e:
-                    replies[i] = self._app_error_envelope(e.error, None)
-                    continue
-                except exc.RuntimeEnvSetupError as e:
-                    err = exc.TaskError(e, str(e), spec.task_id, os.getpid())
-                    returns = await self._store_returns(
-                        spec, [err] * spec.num_returns, is_exception=True)
-                    replies[i] = self._app_error_envelope(err, returns)
-                    continue
-                except Exception as e:  # noqa: BLE001
-                    replies[i] = {"system_error": f"{type(e).__name__}: {e}"}
-                    continue
+                # Steady-state fast path: function cached, no runtime env
+                # (and none pending from an earlier spec), all-inline args
+                # — zero coroutines per spec.
+                func = (fn_cache.get(spec.function_id)
+                        if not spec.runtime_env and current_env_key is None
+                        else None)
+                if func is not None \
+                        and not any(a.kind != ARG_INLINE
+                                    for a in spec.args):
+                    try:
+                        args, kwargs = self._resolve_inline_args(spec)
+                    except Exception as e:  # noqa: BLE001
+                        replies[i] = {
+                            "system_error": f"{type(e).__name__}: {e}"}
+                        continue
+                else:
+                    # Mirror _push_task_locked's prep + error envelope.
+                    try:
+                        env_key = (repr(sorted(spec.runtime_env.items()))
+                                   if spec.runtime_env else None)
+                        if env_key != current_env_key:
+                            await flush_jobs()
+                            current_env_key = env_key
+                        await self._ensure_runtime_env(spec.runtime_env)
+                        func = await self._load_function(spec.function_id)
+                        if any(a.kind != ARG_INLINE for a in spec.args):
+                            # Bounded: a ref arg that can only become
+                            # ready via THIS batch's reply (a submitter
+                            # bug — _take_batch forbids it) must degrade
+                            # to a retryable error, not wedge the
+                            # worker's exec lock forever. Inline args
+                            # never block: skip the wait_for Task per
+                            # spec.
+                            args, kwargs = await asyncio.wait_for(
+                                self._resolve_task_args(spec),
+                                timeout=self.config.worker_lease_timeout_s)
+                        else:
+                            args, kwargs = await self._resolve_task_args(
+                                spec)
+                    except _DependencyError as e:
+                        replies[i] = self._app_error_envelope(e.error, None)
+                        continue
+                    except exc.RuntimeEnvSetupError as e:
+                        err = exc.TaskError(e, str(e), spec.task_id,
+                                            os.getpid())
+                        returns = await self._store_returns(
+                            spec, [err] * spec.num_returns,
+                            is_exception=True)
+                        replies[i] = self._app_error_envelope(err, returns)
+                        continue
+                    except Exception as e:  # noqa: BLE001
+                        replies[i] = {
+                            "system_error": f"{type(e).__name__}: {e}"}
+                        continue
                 if ph is not None:
                     ph[PH_ARGS_READY] = time.time()
                 if spec.task_id in self._cancelled_tasks:
@@ -3402,11 +3654,9 @@ class CoreWorker:
                 ph[PH_EXEC_END] = time.time()
             values = self._split_returns(result, spec.num_returns)
             returns = await self._store_returns(spec, values)
-            reply = {"returns": returns}
             if ph is not None:
                 ph[PH_RESULT_PUT] = time.time()
-                reply["phases"] = ph
-            return reply
+            return (returns, ph)
         except asyncio.CancelledError:
             return {"cancelled": True}
         except Exception as e:  # noqa: BLE001
@@ -3451,7 +3701,9 @@ class CoreWorker:
     def _finish_span(self, span):
         if span is None:
             return
-        self._task_events_buffer.append(_tracing.end_span(span))
+        self._span_events.append(_tracing.end_span(span))
+        if len(self._span_events) > 20000:
+            del self._span_events[:10000]  # exporter unreachable: window
 
     async def _execute_generator_task(self, spec: TaskSpec, func, args,
                                       kwargs) -> dict:
@@ -3469,13 +3721,13 @@ class CoreWorker:
         async def emit(value, is_exception=False):
             nonlocal index
             r = self._serialize_return(value, is_exception)
-            if "__large__" in r:
-                ser = r.pop("__large__")
+            if r[1] is not None:
+                ser = r[1]
                 oid = ObjectID.for_task_return(spec.task_id, index)
                 meta = META_EXCEPTION if is_exception else b""
                 await self.store.put(oid.binary(), ser, metadata=meta,
                                      owner_address=spec.owner_address)
-                r["stored"] = self.raylet_address
+                r = (None, self.raylet_address, is_exception)
             await owner.notify("generator_item", {
                 "task_id": spec.task_id, "index": index, "ret": r,
                 "exec_raylet": self.raylet_address,
@@ -3622,15 +3874,15 @@ class CoreWorker:
         # poison the frame for its batch-mates (ADVICE r4).
         return replies
 
-    async def _gate_actor_seq(self, spec: TaskSpec):
-        """Per-caller in-order start gate (reference:
-        actor_scheduling_queue.cc). Ordering gates task *start*, not
-        completion: the cursor advances and the successor wakes before the
-        task body runs, so async/concurrent actors interleave."""
+    def _gate_seq_entry(self, spec: TaskSpec):
+        """Sync half of the per-caller in-order start gate: None when the
+        spec may start NOW (the overwhelmingly common in-order case — no
+        coroutine needed), else a future to await before calling
+        _gate_seq_advance."""
         if getattr(self, "_execute_out_of_order", False):
             # Out-of-order mode: tasks start as they arrive (reference:
             # out_of_order_actor_scheduling_queue).
-            return
+            return None
         caller = spec.owner_worker_id.binary()
         next_seq = self._caller_next_seq.setdefault(caller, 0)
         if spec.seq_no > next_seq:
@@ -3638,16 +3890,33 @@ class CoreWorker:
             buf = self._caller_buffer.setdefault(caller, {})
             fut = asyncio.get_running_loop().create_future()
             buf[spec.seq_no] = fut
-            await fut
+            return fut
+        return None
+
+    def _gate_seq_advance(self, spec: TaskSpec):
+        if getattr(self, "_execute_out_of_order", False):
+            return
+        caller = spec.owner_worker_id.binary()
         # max(): a REPLAYED seq (client re-push after a frame-level reply
         # failure — the task may have already run here) must not regress
         # the cursor, or every later seq buffers forever (liveness).
         self._caller_next_seq[caller] = max(
             self._caller_next_seq.get(caller, 0), spec.seq_no + 1)
-        buf = self._caller_buffer.get(caller, {})
-        nxt = buf.pop(spec.seq_no + 1, None)
-        if nxt is not None and not nxt.done():
-            nxt.set_result(None)
+        buf = self._caller_buffer.get(caller)
+        if buf:
+            nxt = buf.pop(spec.seq_no + 1, None)
+            if nxt is not None and not nxt.done():
+                nxt.set_result(None)
+
+    async def _gate_actor_seq(self, spec: TaskSpec):
+        """Per-caller in-order start gate (reference:
+        actor_scheduling_queue.cc). Ordering gates task *start*, not
+        completion: the cursor advances and the successor wakes before the
+        task body runs, so async/concurrent actors interleave."""
+        fut = self._gate_seq_entry(spec)
+        if fut is not None:
+            await fut
+        self._gate_seq_advance(spec)
 
     def _can_batch_execute(self, specs) -> bool:
         if (self.executing_actor is None
@@ -3679,19 +3948,21 @@ class CoreWorker:
         jobs = []  # (reply index, spec, bound method, args, kwargs, phases)
         for i, spec in enumerate(specs):
             ph = self._new_exec_phases(want_ph)
-            await self._gate_actor_seq(spec)
+            gate = self._gate_seq_entry(spec)
+            if gate is not None:  # in-order arrivals never allocate a Task
+                await gate
+            self._gate_seq_advance(spec)
             if spec.method_name == SEQ_SKIP_METHOD:
-                replies[i] = {"returns": []}
+                replies[i] = ((), None)
                 continue
             if spec.task_id in self._cancelled_tasks:
                 self._cancelled_tasks.discard(spec.task_id)
                 replies[i] = {"cancelled": True}
                 continue
             try:
-                args, kwargs = await self._resolve_task_args(spec)
-            except _DependencyError as e:
-                replies[i] = self._app_error_envelope(e.error, None)
-                continue
+                # _can_batch_execute guarantees all-inline args: resolve
+                # synchronously, no coroutine per spec.
+                args, kwargs = self._resolve_inline_args(spec)
             except Exception as e:  # noqa: BLE001
                 replies[i] = {"system_error": f"{type(e).__name__}: {e}"}
                 continue
@@ -3715,7 +3986,7 @@ class CoreWorker:
         if spec.method_name == SEQ_SKIP_METHOD:
             # Seq-slot placeholder for a submission that failed caller-side
             # (e.g. unserializable args): ordering advanced, nothing to run.
-            return {"returns": []}
+            return ((), None)
         return await self._execute_actor_task(spec, payload.get("ph", 1))
 
     async def _execute_actor_task(self, spec: TaskSpec,
@@ -3752,11 +4023,9 @@ class CoreWorker:
                     ph[PH_EXEC_END] = time.time()
                 values = self._split_returns(result, spec.num_returns)
                 returns = await self._store_returns(spec, values)
-                reply = {"returns": returns}
                 if ph is not None:
                     ph[PH_RESULT_PUT] = time.time()
-                    reply["phases"] = ph
-                return reply
+                return (returns, ph)
             except _DependencyError as e:
                 return self._app_error_envelope(e.error, None)
             except asyncio.CancelledError:
@@ -3897,7 +4166,8 @@ class CoreWorker:
         return ph
 
     def _record_task_event(self, spec: TaskSpec, state: str,
-                           phases: Optional[list] = None):
+                           phases: Optional[list] = None,
+                           t: Optional[float] = None):
         if not self.config.task_events_enabled:
             return
         from ray_tpu.util import metrics as _m
@@ -3920,25 +4190,28 @@ class CoreWorker:
         with lock:
             slot["value"] += 1
         # Hex/dict formatting deferred to flush time (off the hot path).
-        # Light tuple only — holding the spec would pin its inline arg
-        # payloads past task completion.
-        self._task_events_buffer.append((
+        # Fixed-slot ring write: no per-event tuple, no list growth, and
+        # overflow (GCS unreachable for a long stretch) is O(1)
+        # drop-oldest instead of a list slice. Fields only — holding the
+        # spec would pin its inline arg payloads past task completion.
+        pending = self._task_events.record(
             spec.task_id.binary(), spec.job_id.binary(),
             spec.name or spec.method_name or spec.function_id, state,
-            time.time(), spec.actor_id.binary() if spec.actor_id else None,
-            spec.resources, phases))
-        if len(self._task_events_buffer) > 20000:
-            # GCS unreachable for a long stretch: drop oldest, keep a window.
-            del self._task_events_buffer[:10000]
-        if len(self._task_events_buffer) > 1000:
+            time.time() if t is None else t,
+            spec.actor_id.binary() if spec.actor_id else None,
+            spec.resources, phases)
+        if pending > 1000 and not self._te_flush_scheduled:
+            self._te_flush_scheduled = True
             try:
                 asyncio.get_running_loop()
             except RuntimeError:
                 # Threadsafe submission path: flush from the loop.
-                self.loop.call_soon_threadsafe(
-                    lambda: asyncio.ensure_future(self._flush_task_events()))
+                self.loop.call_soon_threadsafe(self._spawn_event_flush)
             else:
-                asyncio.ensure_future(self._flush_task_events())
+                self._spawn_event_flush()
+
+    def _spawn_event_flush(self):
+        asyncio.ensure_future(self._flush_task_events())
 
     def _task_event_dict(self, task_id: bytes, job_id: bytes, name: str,
                          state: str, t: float, actor_id, resources,
@@ -3955,9 +4228,13 @@ class CoreWorker:
         return out
 
     async def _flush_task_events(self):
-        if not self._task_events_buffer or self.gcs is None or self.gcs.closed:
+        self._te_flush_scheduled = False
+        if self.gcs is None or self.gcs.closed:
+            return  # ring keeps the window; overflow drops oldest in O(1)
+        if not len(self._task_events) and not self._span_events:
             return
-        buf, self._task_events_buffer = self._task_events_buffer, []
+        buf = self._task_events.drain()
+        spans, self._span_events = self._span_events, []
         # Coalesce within the flush window: a task that reached a terminal
         # state here ships ONLY its terminal event when that event carries
         # the full phase record — its PENDING/RUNNING rows are superseded
@@ -3967,13 +4244,15 @@ class CoreWorker:
         # flight keep their intermediate rows.
         done_with_phases = {
             e[0] for e in buf
-            if not isinstance(e, dict) and e[7] is not None
-            and e[3] in ("FINISHED", "FAILED")}
-        events = [e if isinstance(e, dict) else self._task_event_dict(*e)
+            if e[7] is not None and e[3] in ("FINISHED", "FAILED")}
+        events = [self._task_event_dict(*e)
                   for e in buf
-                  if isinstance(e, dict)
-                  or e[3] in ("FINISHED", "FAILED")
+                  if e[3] in ("FINISHED", "FAILED")
                   or e[0] not in done_with_phases]
+        if spans:
+            events.extend(spans)
+        if not events:
+            return
         try:
             await self.gcs.request("report_task_events", {"events": events})
         except rpc.RpcError:
@@ -3982,7 +4261,12 @@ class CoreWorker:
     async def _flush_task_events_loop(self):
         while not self._shutdown:
             await asyncio.sleep(1.0)
-            await self._flush_task_events()
+            try:
+                await self._flush_task_events()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — one bad flush must not
+                logger.exception("task-event flush failed")  # kill the loop
 
 
 class _DependencyError(Exception):

@@ -214,6 +214,17 @@ class GcsServer:
         for slice_id, members in regang.items():
             self._gang_tasks[slice_id] = asyncio.ensure_future(
                 self._drain_gang_task(slice_id, members, 0.0))
+        # Re-drive actor creations restored mid-flight: a snapshot taken
+        # before a creation completed leaves the row PENDING_CREATION with
+        # no _schedule_actor task alive (it died with the old process),
+        # and the worker's eventual death report can't help — the restored
+        # record has no worker bound. Same re-arm treatment as the drain
+        # tasks above; RESTARTING rows lost their reschedule task the same
+        # way. _schedule_actor retries until a node is feasible, so firing
+        # before raylets re-register is safe.
+        for actor in self.actors.values():
+            if actor.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+                asyncio.ensure_future(self._schedule_actor(actor))
         self._health_task = asyncio.ensure_future(self._health_loop())
         if self.session_dir or self._ext_store is not None:
             self._persist_task = asyncio.ensure_future(self._persist_loop())

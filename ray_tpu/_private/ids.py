@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import struct
 import threading
 
 _NIL = b"\x00"
@@ -111,9 +112,10 @@ class TaskID(BaseID):
 
         Avoids an os.urandom syscall on the submission hot path (reference
         derives TaskIDs from parent task + counter the same way,
-        src/ray/common/id.h)."""
-        import struct
-        return cls(seed[:8] + struct.pack("<Q", index) + job_id.binary())
+        src/ray/common/id.h). One fused pack: the slice+pack+concat chain
+        was three allocations per submitted task ("8s" truncates a longer
+        seed)."""
+        return cls(struct.pack("<8sQ4s", seed, index, job_id.binary()))
 
     @classmethod
     def for_actor_task(cls, job_id: JobID, actor_id: ActorID, seq: int,
@@ -137,12 +139,13 @@ class ObjectID(BaseID):
 
     @classmethod
     def for_task_return(cls, task_id: TaskID, index: int):
-        return cls(task_id.binary() + index.to_bytes(4, "little"))
+        return cls(struct.pack("<20sI", task_id.binary(), index))
 
     @classmethod
     def for_put(cls, task_id: TaskID, put_index: int):
         # Put objects use the high bit of the index to avoid collision with returns.
-        return cls(task_id.binary() + (put_index | 0x80000000).to_bytes(4, "little"))
+        return cls(struct.pack("<20sI", task_id.binary(),
+                               put_index | 0x80000000))
 
     def task_id(self) -> TaskID:
         return TaskID(self._bytes[:20])

@@ -168,6 +168,28 @@ class _SharedForkServer:
         return True
 
 
+class PendingLease:
+    """One queued worker-lease request with its per-spec scheduling keys
+    resolved ONCE at enqueue. _try_dispatch / _ensure_worker_supply scan
+    the pending list on every tick (and per grant); re-deriving
+    env_hash / container-env / scheduling_class from the spec each scan
+    was measurable overhead under a multi-client lease storm."""
+
+    __slots__ = ("spec", "pg_key", "fut", "conn", "count", "env_hash",
+                 "container_env", "sched_class")
+
+    def __init__(self, spec, pg_key, fut, conn, count):
+        self.spec = spec
+        self.pg_key = pg_key
+        self.fut = fut
+        self.conn = conn
+        self.count = count
+        self.env_hash = spec.env_hash()
+        env = getattr(spec, "runtime_env", None) or {}
+        self.container_env = env if env.get("container") else None
+        self.sched_class = spec.scheduling_class()
+
+
 @dataclass
 class WorkerHandle:
     worker_id: WorkerID
@@ -288,7 +310,7 @@ class Raylet:
         # Actor creates waiting for a worker: (env_hash, exact, future),
         # FIFO-served by rpc_register_worker.
         self._actor_worker_waiters: List[tuple] = []
-        self._pending_leases: List[tuple] = []  # (spec, pg, fut, conn, count)
+        self._pending_leases: List[PendingLease] = []
         # Driver conns that have been granted leases: on close, their
         # leased workers are reclaimed (reference: leased workers of an
         # exited job are destroyed, worker_pool.cc DisconnectClient).
@@ -506,11 +528,11 @@ class Raylet:
         """Queued lease demand for the autoscaler, one shape per needed
         GRANT (a multi-grant request with count=n is n workers of demand)."""
         shapes: list = []
-        for spec, _pg, fut, _c, count in self._pending_leases:
-            if fut.done():
+        for req in self._pending_leases:
+            if req.fut.done():
                 continue
-            for _ in range(min(count, cap - len(shapes))):
-                shapes.append(dict(spec.resources))
+            for _ in range(min(req.count, cap - len(shapes))):
+                shapes.append(dict(req.spec.resources))
             if len(shapes) >= cap:
                 break
         return shapes
@@ -817,19 +839,20 @@ class Raylet:
         starting_hashes = [h.env_hash for h in self.workers.values()
                            if not h.registered and h.env_hash]
         n_starting_container = len(starting_hashes)
-        for spec, _pg_key, fut, _conn, count in self._pending_leases:
-            if fut.done():
+        for req in self._pending_leases:
+            if req.fut.done():
                 continue
+            spec = req.spec
             # A multi-grant request is `count` workers of demand, each
             # gated on the resources its grant would consume.
-            for _ in range(count):
+            for _ in range(req.count):
                 if not all(avail.get(k, 0) >= v
                            for k, v in spec.resources.items() if v > 0):
                     break
                 for k, v in spec.resources.items():
                     avail[k] = avail.get(k, 0) - v
-                eh = spec.env_hash()
-                cenv = self._container_env(spec)
+                eh = req.env_hash
+                cenv = req.container_env
                 if cenv is not None:
                     # Containerized lease: only an exact-hash worker (idle
                     # or already starting) can serve it.
@@ -913,9 +936,9 @@ class Raylet:
                     self.node_name, self._drain_deadline - time.time())
         # Bounce queued lease requests: the submitter re-requests and the
         # draining guard spills it to a live peer.
-        for _spec, _pg, fut, _c, _n in self._pending_leases:
-            if not fut.done():
-                fut.set_result({"retry": True})
+        for req in self._pending_leases:
+            if not req.fut.done():
+                req.fut.set_result({"retry": True})
         self._pending_leases.clear()
         self._tasks.append(asyncio.ensure_future(self._drain_to_idle()))
         return True
@@ -1159,7 +1182,8 @@ class Raylet:
                                     f"{spec.resources})")}
 
         fut = asyncio.get_running_loop().create_future()
-        self._pending_leases.append((spec, pg_key, fut, conn, count))
+        req = PendingLease(spec, pg_key, fut, conn, count)
+        self._pending_leases.append(req)
         self._watch_lease_client(conn)
         self._try_dispatch()
         self._ensure_worker_supply()
@@ -1167,7 +1191,7 @@ class Raylet:
             return await asyncio.wait_for(fut, self.config.worker_lease_timeout_s)
         except asyncio.TimeoutError:
             try:
-                self._pending_leases.remove((spec, pg_key, fut, conn, count))
+                self._pending_leases.remove(req)
             except ValueError:
                 pass
             return {"retry": True}
@@ -1241,11 +1265,11 @@ class Raylet:
     async def _reclaim_client_leases(self, conn):
         # Pending (ungranted) requests from the dead client must not be
         # granted to nobody: cancel their futures.
-        for spec, _pg, fut, req_conn, _n in self._pending_leases:
-            if req_conn is conn and not fut.done():
-                fut.cancel()
+        for req in self._pending_leases:
+            if req.conn is conn and not req.fut.done():
+                req.fut.cancel()
         self._pending_leases = [
-            e for e in self._pending_leases if not e[2].done()]
+            e for e in self._pending_leases if not e.fut.done()]
         for handle in list(self.workers.values()):
             if not (handle.leased and handle.lease_conn is conn):
                 continue
@@ -1276,19 +1300,22 @@ class Raylet:
     def _try_dispatch(self):
         if self._draining:
             # No grants during drain; bounce anything still queued.
-            for _spec, _pg, fut, _c, _n in self._pending_leases:
-                if not fut.done():
-                    fut.set_result({"retry": True})
+            for req in self._pending_leases:
+                if not req.fut.done():
+                    req.fut.set_result({"retry": True})
             self._pending_leases.clear()
             return
         if not self._pending_leases:
             return
         remaining = []
-        n_waiting = sum(1 for e in self._pending_leases if not e[2].done())
+        n_waiting = sum(1 for e in self._pending_leases
+                        if not e.fut.done())
         idle0 = len(self._idle_workers)
-        for spec, pg_key, fut, req_conn, count in self._pending_leases:
+        for req in self._pending_leases:
+            fut = req.fut
             if fut.done():
                 continue
+            spec, pg_key, count = req.spec, req.pg_key, req.count
             if not self.pool.fits(spec.resources, pg_key):
                 # Re-evaluate spillback for queued requests: the entry-time
                 # decision can race with concurrent grants that drained the
@@ -1313,7 +1340,7 @@ class Raylet:
                                 {"spillback": view["address"]})
                             break
                 if not fut.done():
-                    remaining.append((spec, pg_key, fut, req_conn, count))
+                    remaining.append(req)
                 continue
             # Fair multi-grant: one client's backlog hint must not soak
             # every idle worker while other clients' requests wait.
@@ -1324,19 +1351,19 @@ class Raylet:
             while len(grants) < cap and self.pool.fits(spec.resources,
                                                        pg_key):
                 worker = self._get_idle_worker(
-                    spec.env_hash(),
-                    exact=self._container_env(spec) is not None)
+                    req.env_hash,
+                    exact=req.container_env is not None)
                 if worker is None:
                     break
                 self.pool.acquire(spec.resources, pg_key)
                 worker.leased = True
                 worker.lease_owner = spec.owner_address
-                if spec.env_hash():
-                    worker.env_hash = spec.env_hash()
-                worker.lease_class = spec.scheduling_class()
+                if req.env_hash:
+                    worker.env_hash = req.env_hash
+                worker.lease_class = req.sched_class
                 worker.lease_resources = dict(spec.resources)
                 worker.lease_pg = pg_key
-                worker.lease_conn = req_conn
+                worker.lease_conn = req.conn
                 worker.idle_since = time.time()
                 grants.append({
                     "worker_id": worker.worker_id,
@@ -1344,11 +1371,11 @@ class Raylet:
                     "node_id": self.node_id,
                 })
             if not grants:
-                remaining.append((spec, pg_key, fut, req_conn, count))
+                remaining.append(req)
                 continue
             self._mark_resources_dirty()
             fut.set_result({"granted": grants[0], "grants": grants})
-        self._pending_leases = [e for e in remaining if not e[2].done()]
+        self._pending_leases = [e for e in remaining if not e.fut.done()]
         self._ensure_worker_supply()
 
     @rpc.idempotent

@@ -191,10 +191,26 @@ def scan_handler_annotations(lines) -> list:
     return out
 
 
+# Wire-alias map for the source scan: servers that register handlers
+# under a DIFFERENT wire name than the function-derived key. The scan
+# (which never imports the server module, so it cannot observe
+# RpcServer.register's authoritative aliasing) applies the module's
+# template to every handler it finds in that file, e.g. ClientServer's
+# rpc_connect -> wire "client_connect". Without this, a replay-capable
+# remote thin client dialing `client_*` / `serve_*` would find no
+# annotation and fall back to the legacy retry-once behavior — a
+# double-execute hole for the non-idempotent mutating calls.
+_WIRE_ALIAS_MODULES = {
+    os.path.join("util", "client", "server.py"): "client_{name}",
+    os.path.join("serve", "grpc_proxy.py"): "serve_{name}",
+}
+
+
 def _scan_source_annotations():
     """Fill the registry from package source without importing the server
     modules; runs once per process, lazily, on the first unknown-method
-    lookup."""
+    lookup. Files listed in _WIRE_ALIAS_MODULES additionally register
+    every handler under its aliased wire name."""
     global _SOURCE_SCANNED
     _SOURCE_SCANNED = True
     pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -202,19 +218,25 @@ def _scan_source_annotations():
         for fname in files:
             if not fname.endswith(".py"):
                 continue
+            path = os.path.join(dirpath, fname)
             try:
-                with open(os.path.join(dirpath, fname),
-                          encoding="utf-8") as f:
+                with open(path, encoding="utf-8") as f:
                     lines = f.readlines()
             except OSError:
                 continue
+            rel = os.path.relpath(path, pkg)
+            alias_tpl = _WIRE_ALIAS_MODULES.get(rel)
             for name, _lineno, flag in scan_handler_annotations(lines):
                 if flag is None:
                     continue
                 name = name[5:] if name.startswith("_rpc_") else name[4:]
-                prev = _IDEMPOTENCY.get(name)
-                _IDEMPOTENCY[name] = flag if prev is None \
-                    else (prev and flag)
+                keys = [name]
+                if alias_tpl is not None:
+                    keys.append(alias_tpl.format(name=name))
+                for key in keys:
+                    prev = _IDEMPOTENCY.get(key)
+                    _IDEMPOTENCY[key] = flag if prev is None \
+                        else (prev and flag)
 
 
 def idempotency_of(method: str) -> Optional[bool]:

@@ -317,6 +317,28 @@ def drain_events() -> List[dict]:
     return list(core.drain_events) if core is not None else []
 
 
+def add_drain_event_listener(cb) -> bool:
+    """Register a push wakeup fired (from the core loop) whenever a
+    drain/preemption notice lands in this process's drain-event log.
+    Returns False when no core worker is connected — the caller should
+    fall back to polling drain_events(). The callback must be cheap and
+    thread-agnostic (typically threading.Event.set)."""
+    core = _worker_core.core or _state.core
+    if core is None:
+        return False
+    core.drain_listeners.append(cb)
+    return True
+
+
+def remove_drain_event_listener(cb) -> None:
+    core = _worker_core.core or _state.core
+    if core is not None:
+        try:
+            core.drain_listeners.remove(cb)
+        except ValueError:
+            pass
+
+
 def local_node_draining() -> bool:
     """True inside a process whose hosting node received a drain notice
     (spot reclaim / downscale). The save-on-preempt hook: a training loop

@@ -24,6 +24,11 @@ class ActorMethod:
         self._name = name
         self._num_returns = num_returns
         self._concurrency_group = concurrency_group
+        # Spec template for the steady-state call fast path (see
+        # RemoteFunction): invariants of THIS (handle, method, options)
+        # triple. .options() products are new ActorMethod instances, so
+        # an option change never reuses a stale template.
+        self._spec_template = None
 
     def options(self, **opts) -> "ActorMethod":
         return ActorMethod(
@@ -31,10 +36,32 @@ class ActorMethod:
             opts.get("num_returns", self._num_returns),
             opts.get("concurrency_group", self._concurrency_group))
 
+    def _build_template(self, core):
+        from ray_tpu._private.common import TaskSpec, TaskSpecTemplate
+        proto = TaskSpec(
+            task_id=None, job_id=core.job_id, name=self._name,
+            args=[], num_returns=self._num_returns,
+            owner_address=core.address, owner_worker_id=core.worker_id,
+            actor_id=self._handle._actor_id, method_name=self._name,
+            max_retries=self._handle._max_task_retries,
+            concurrency_group=self._concurrency_group,
+        )
+        tmpl = TaskSpecTemplate(proto, token=(core, None))
+        self._spec_template = tmpl
+        return tmpl
+
     def remote(self, *args, **kwargs):
         core = worker_api.get_core()
         num_returns = self._num_returns
         streaming = num_returns == "streaming"
+        if not streaming and not worker_api._on_core_loop(core):
+            # Steady-state fast path: stamp task id + seq + args onto the
+            # cached template; no per-call option resolution.
+            tmpl = self._spec_template
+            if tmpl is None or tmpl.token[0] is not core:
+                tmpl = self._build_template(core)
+            refs = core.submit_actor_task_templated(tmpl, args, kwargs)
+            return refs[0] if num_returns == 1 else refs
         if streaming:
             num_returns = 0
         if worker_api._on_core_loop(core):
@@ -64,6 +91,13 @@ class ActorMethod:
             f"Actor method '{self._name}' cannot be called directly; use "
             f"'.{self._name}.remote()'.")
 
+    def __getstate__(self):
+        # Process-local template (token holds the live CoreWorker): never
+        # rides a pickle — rebuilt on first call wherever this lands.
+        d = dict(self.__dict__)
+        d["_spec_template"] = None
+        return d
+
     def bind(self, *args, **kwargs):
         """Build a lazy DAG node (reference: dag_node.py bind)."""
         from ray_tpu.dag.dag_node import ClassMethodNode
@@ -86,9 +120,17 @@ class ActorHandle:
         if name.startswith("_"):
             raise AttributeError(name)
         mo = self._method_options.get(name, {})
-        return ActorMethod(self, name,
-                           num_returns=mo.get("num_returns", 1),
-                           concurrency_group=mo.get("concurrency_group", ""))
+        method = ActorMethod(self, name,
+                             num_returns=mo.get("num_returns", 1),
+                             concurrency_group=mo.get("concurrency_group",
+                                                      ""))
+        # Memoize: `h.method.remote()` in a loop was allocating a fresh
+        # ActorMethod (and losing its spec template) per call. Instance
+        # attribute hits bypass __getattr__ entirely from now on;
+        # __reduce__ pickles the handle from its explicit fields, so the
+        # cache never rides the wire.
+        self.__dict__[name] = method
+        return method
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"

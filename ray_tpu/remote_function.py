@@ -42,6 +42,26 @@ def _resolve_scheduling(options: dict) -> SchedulingStrategy:
     raise ValueError(f"unknown scheduling strategy {strategy!r}")
 
 
+def _build_task_template(core, fid: str, submit_kwargs: dict):
+    """TaskSpecTemplate for a (function, options) call site: the resolved
+    invariants of submit_task_threadsafe, pre-stamped once."""
+    from ray_tpu._private.common import TaskSpec, TaskSpecTemplate
+    mr = submit_kwargs["max_retries"]
+    proto = TaskSpec(
+        task_id=None, job_id=core.job_id, name=submit_kwargs["name"],
+        function_id=fid, args=[],
+        num_returns=submit_kwargs["num_returns"],
+        resources=submit_kwargs["resources"],
+        scheduling=submit_kwargs["scheduling"],
+        max_retries=(core.config.task_max_retries_default if mr < 0
+                     else mr),
+        retry_exceptions=submit_kwargs["retry_exceptions"],
+        owner_address=core.address, owner_worker_id=core.worker_id,
+    )
+    return TaskSpecTemplate(proto,
+                            token=(core, worker_api._state.job_runtime_env))
+
+
 def _resources_from_options(options: dict) -> Dict[str, float]:
     res = dict(options.get("resources") or {})
     num_cpus = options.get("num_cpus")
@@ -61,6 +81,12 @@ class RemoteFunction:
         self._function = func
         self._options = options or {}
         self._function_id: Optional[str] = None
+        # Spec template for the steady-state `.remote()` fast path: the
+        # invariant spec fields of THIS (function, options) pair,
+        # pre-resolved once. Keyed off the core worker + job runtime env
+        # identities; `.options()` products get their own (fresh) slot, so
+        # an option change can never reuse a stale template.
+        self._spec_template = None
         self.__name__ = getattr(func, "__name__", "remote_fn")
         self.__doc__ = getattr(func, "__doc__", None)
 
@@ -68,6 +94,14 @@ class RemoteFunction:
         raise TypeError(
             f"Remote function '{self.__name__}' cannot be called directly; "
             f"use '{self.__name__}.remote()'.")
+
+    def __getstate__(self):
+        # The spec template is process-local (its token holds the live
+        # CoreWorker): a RemoteFunction riding a closure/module pickle
+        # must drop it — the receiver rebuilds its own on first call.
+        d = dict(self.__dict__)
+        d["_spec_template"] = None
+        return d
 
     def bind(self, *args, **kwargs):
         """Build a lazy DAG node (reference: dag_node.py bind)."""
@@ -101,6 +135,15 @@ class RemoteFunction:
         if client is not None:
             return client.submit_function(self, args, kwargs, self._options)
         core = worker_api.get_core()
+        tmpl = self._spec_template
+        if (tmpl is not None and tmpl.token[0] is core
+                and tmpl.token[1] is worker_api._state.job_runtime_env
+                and not worker_api._on_core_loop(core)):
+            # Steady-state fast path: every invariant (options, resources,
+            # scheduling, export) was resolved when the template was
+            # built; this call stamps only task id + args.
+            refs = core.submit_task_templated(tmpl, args, kwargs)
+            return refs[0] if tmpl.num_returns == 1 else refs
         opts = self._options
         num_returns = opts.get("num_returns", 1)
         streaming = num_returns == "streaming"
@@ -129,6 +172,14 @@ class RemoteFunction:
             runtime_env=worker_api.resolve_runtime_env(
                 opts.get("runtime_env")),
         )
+        if (not streaming and not on_loop
+                and submit_kwargs["runtime_env"] is None):
+            # Cache the invariants for the next call. Tasks with a
+            # runtime_env stay on the legacy path (env preparation
+            # mutates the spec per submission), as do on-loop
+            # submissions (deferred exports).
+            self._spec_template = _build_task_template(
+                core, fid, submit_kwargs)
         if on_loop:
             refs = core.submit_task_local(fid, args, kwargs, export=export,
                                           **submit_kwargs)

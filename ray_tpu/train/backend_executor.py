@@ -125,18 +125,39 @@ class BackendExecutor:
     # ---- driver-side preemption watcher ----
 
     def _start_preempt_watcher(self):
-        """Background poll of the driver's drain-event log so
+        """Event-driven watch of the driver's drain-event log so
         save-on-preempt fires even when only the DRIVER sees the notice
         (e.g. the gang workers' pubsub frames were lost with their node,
         or the notice landed between report rounds). Worker-side
         should_checkpoint() and the get_next_results() check remain the
-        other two braces."""
+        other two braces.
+
+        The core worker's nodes-channel pubsub pushes a wakeup the
+        instant a notice lands (worker_api.add_drain_event_listener), so
+        steady state costs zero polls; a slow poll remains as the
+        fallback for a dropped subscription (no core, or the GCS channel
+        lost mid-run). Without a subscription the legacy 0.25 s poll
+        cadence is kept."""
         self._stop_preempt_watcher()  # restart attempts re-arm cleanly
         self._watch_stop = threading.Event()
+        kick = self._watch_kick = threading.Event()
+        from ray_tpu._private import worker_api
+
+        def _listener():
+            kick.set()
+
+        self._watch_listener = _listener
+        try:
+            subscribed = worker_api.add_drain_event_listener(_listener)
+        except Exception:  # noqa: BLE001 — not connected (unit tests)
+            subscribed = False
+        poll_s = 5.0 if subscribed else 0.25
 
         def _loop():
-            while not self._watch_stop.wait(0.25):
-                if self._save_pushed:
+            while not self._watch_stop.is_set():
+                kick.wait(poll_s)  # push wakeup; timeout = poll fallback
+                kick.clear()
+                if self._watch_stop.is_set() or self._save_pushed:
                     return
                 try:
                     if self._preempted_since_start():
@@ -154,6 +175,17 @@ class BackendExecutor:
         stop = getattr(self, "_watch_stop", None)
         if stop is not None:
             stop.set()
+        kick = getattr(self, "_watch_kick", None)
+        if kick is not None:
+            kick.set()  # unblock the wait so the thread exits promptly
+        listener = getattr(self, "_watch_listener", None)
+        if listener is not None:
+            from ray_tpu._private import worker_api
+            try:
+                worker_api.remove_drain_event_listener(listener)
+            except Exception:  # noqa: BLE001
+                pass
+            self._watch_listener = None
         watcher = getattr(self, "_watcher", None)
         if watcher is not None:
             watcher.join(timeout=2.0)

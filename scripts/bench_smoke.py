@@ -109,6 +109,34 @@ def main() -> dict:
     except Exception as e:  # noqa: BLE001
         log(f"n:n phase skipped: {type(e).__name__}: {e}")
 
+    # --- per-call allocation probe (caller-side hot path) ---
+    # tracemalloc block count for 1k steady-state `.remote()` calls in
+    # the driver process: the allocation-regression tripwire for the
+    # templated submit path. Asserted under a ceiling in tier-1
+    # (tests/test_bench_smoke.py) — unlike throughput, an allocation
+    # count is deterministic enough to gate on a loaded CI box.
+    try:
+        import tracemalloc
+        ray_tpu.get([nop.remote() for _ in range(300)], timeout=60)
+        time.sleep(0.5)  # drain in-flight loop work
+        tracemalloc.start()
+        try:
+            snap0 = tracemalloc.take_snapshot()
+            refs = [nop.remote() for _ in range(1000)]
+            snap1 = tracemalloc.take_snapshot()
+            ray_tpu.get(refs, timeout=60)
+        finally:
+            # A failed probe must not leave tracing on: it would slow
+            # (and silently skew) every later phase's numbers.
+            tracemalloc.stop()
+        blocks = sum(st.count_diff
+                     for st in snap1.compare_to(snap0, "lineno")
+                     if st.count_diff > 0)
+        out["alloc_blocks_per_call"] = round(blocks / 1000, 2)
+        log(f"alloc probe: {blocks / 1000:.1f} blocks per .remote() call")
+    except Exception as e:  # noqa: BLE001
+        log(f"alloc probe skipped: {type(e).__name__}: {e}")
+
     # --- placement group create/remove latency ---
     try:
         from ray_tpu.util.placement_group import (placement_group,

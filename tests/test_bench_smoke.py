@@ -34,3 +34,11 @@ def test_bench_smoke_completes(jax_cpu):
     for key in ("multi_client_tasks_async", "n_n_actor_calls",
                 "pg_create_ms"):
         assert key in row, (key, row)
+    # Hot-path allocation tripwire: a steady-state `.remote()` call must
+    # stay a small, bounded number of allocations (measured ~19 blocks
+    # with the recorder on after the template/flat-reply/event-ring
+    # work, down from ~35; the ceiling leaves headroom for platform
+    # variance, not for regressions). Unlike wall-clock rows this is
+    # deterministic enough to assert in tier-1.
+    assert "alloc_blocks_per_call" in row, row
+    assert row["alloc_blocks_per_call"] <= 28.0, row

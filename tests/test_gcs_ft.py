@@ -98,6 +98,91 @@ def test_snapshot_written_and_atomic(ray_cluster):
     assert not os.path.exists(path + ".tmp")
 
 
+def test_pending_creation_rescheduled_after_restore(tmp_path):
+    """Regression (found while driving PR 4): a GCS restored from a
+    snapshot taken BEFORE an actor's creation completed left the row
+    PENDING_CREATION forever — nothing re-drove pending creations at
+    restore, and the worker's later death report couldn't help because
+    the restored record has no worker bound. Restore must re-run
+    _schedule_actor for PENDING_CREATION rows the way drain tasks are
+    re-armed."""
+    import asyncio
+
+    async def run():
+        from ray_tpu._private import rpc
+        from ray_tpu._private.common import (ACTOR_ALIVE, ACTOR_PENDING,
+                                             NodeInfo, TaskSpec)
+        from ray_tpu._private.config import Config
+        from ray_tpu._private.gcs import GcsServer
+        from ray_tpu._private.ids import (ActorID, NodeID, TaskID,
+                                          WorkerID)
+
+        config = Config.load({})
+        creates = {"n": 0}
+        hang = asyncio.Event()
+
+        # Fake raylet: the first create_actor (driven by the original
+        # GCS) hangs past the snapshot — the actor is restored
+        # mid-creation; creates against the restarted GCS succeed.
+        fake = rpc.RpcServer("fake-raylet")
+
+        async def create_actor(conn, payload):
+            creates["n"] += 1
+            if creates["n"] == 1:
+                await hang.wait()
+            return {"actor_address": "127.0.0.1:1",
+                    "worker_id": WorkerID.from_random()}
+
+        fake.register("create_actor", create_actor)
+        port = await fake.start("127.0.0.1", 0)
+        node = NodeInfo(node_id=NodeID.from_random(),
+                        address=f"127.0.0.1:{port}",
+                        resources_total={"CPU": 4.0},
+                        resources_available={"CPU": 4.0})
+
+        sdir = str(tmp_path)
+        gcs = GcsServer(config, session_dir=sdir)
+        await gcs.start("127.0.0.1", 0, restore=False)
+        conn = await rpc.connect(gcs.address)
+        await conn.request("register_node", {"node_info": node})
+        job_id = await conn.request("register_job",
+                                    {"driver_address": "",
+                                     "entrypoint": ""})
+        actor_id = ActorID.of(job_id)
+        spec = TaskSpec(task_id=TaskID.of(job_id), job_id=job_id,
+                        name="Held", function_id="actor:feedface",
+                        resources={"CPU": 1.0}, actor_id=actor_id,
+                        is_actor_creation=True)
+        await conn.request("register_actor", {"spec": spec})
+        # Let _schedule_actor dial the (hanging) create.
+        deadline = asyncio.get_running_loop().time() + 5
+        while creates["n"] == 0 \
+                and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.05)
+        assert creates["n"] == 1
+        assert gcs.actors[actor_id].state == ACTOR_PENDING
+        gcs.save_snapshot()  # snapshot lags actor creation
+        await conn.close()
+        await gcs.stop()
+
+        # Head restart from that snapshot: the row comes back
+        # PENDING_CREATION and must be re-driven to ALIVE.
+        gcs2 = GcsServer(config, session_dir=sdir)
+        await gcs2.start("127.0.0.1", 0, restore=True)
+        assert gcs2.actors[actor_id].state in (ACTOR_PENDING, ACTOR_ALIVE)
+        deadline = asyncio.get_running_loop().time() + 10
+        while gcs2.actors[actor_id].state != ACTOR_ALIVE \
+                and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.1)
+        assert gcs2.actors[actor_id].state == ACTOR_ALIVE
+        assert creates["n"] >= 2
+        hang.set()
+        await gcs2.stop()
+        await fake.stop()
+
+    asyncio.run(run())
+
+
 # ------------------------------------------------- external store (Redis-eq)
 
 def test_kv_store_server_persistence(tmp_path):

@@ -154,6 +154,30 @@ def test_connectionlost_sent_false_for_dial_failures():
     assert asyncio.run(run()) is False
 
 
+def test_source_scan_resolves_wire_aliases(monkeypatch):
+    """The lazy source scan must resolve handlers that are registered
+    OUT-OF-PROCESS under an aliased wire name (ClientServer's
+    client_<name>, GrpcProxyActor's serve_<name>): a replay-capable thin
+    client never imports those server modules, so without the alias map
+    the annotation would be invisible exactly where the replay policy
+    matters."""
+    from ray_tpu._private import rpc
+    monkeypatch.setattr(rpc, "_IDEMPOTENCY", {})
+    monkeypatch.setattr(rpc, "_SOURCE_SCANNED", False)
+    # ClientServer mutating calls must NOT be replayed...
+    assert rpc.idempotency_of("client_connect") is False
+    assert rpc.idempotency_of("client_submit_task") is False
+    assert rpc.idempotency_of("client_create_actor") is False
+    # ...while its pure reads replay freely.
+    assert rpc.idempotency_of("client_get") is True
+    assert rpc.idempotency_of("client_cluster_resources") is True
+    # GrpcProxyActor's serve_<name> aliases resolve the same way.
+    assert rpc.idempotency_of("serve_unary") is False
+    assert rpc.idempotency_of("serve_stream") is False
+    # The plain function-derived keys keep working for everyone else.
+    assert rpc.idempotency_of("kv_get") is True
+
+
 def test_server_register_records_wire_alias():
     """Servers that alias handlers on the wire (ClientServer's
     client_<name>, GrpcProxyActor's serve_unary) get their annotation

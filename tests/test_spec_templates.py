@@ -1,0 +1,316 @@
+"""Task-spec templates: the caller-side hot path for repeated call sites.
+
+Covers the tentpole's correctness surface: template invalidation on
+options/runtime_env/num_returns changes, concurrent callers on one
+template never cross-stamping task ids, legacy (RAY_TPU_RPC_BATCH=0)
+framing interop with the templated batch wire form, and recorder-on
+parity of flight-recorder phase stamps through the event ring.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# wire form (no cluster needed)
+# ---------------------------------------------------------------------------
+
+def test_templated_batch_wire_roundtrip():
+    """A batch of template-stamped specs pickles as (invariants, rows) and
+    unpickles into specs identical to the long-form encoding."""
+    import pickle
+
+    from ray_tpu._private.common import (TaskArg, TaskSpec, ARG_INLINE,
+                                         TaskSpecTemplate, wire_spec_batch,
+                                         _TemplatedSpecBatch)
+    from ray_tpu._private.ids import JobID, TaskID, WorkerID
+
+    job = JobID.from_int(3)
+    proto = TaskSpec(task_id=None, job_id=job, name="f", function_id="fn:1",
+                     args=[], num_returns=2, resources={"CPU": 1.0},
+                     max_retries=3, owner_address="127.0.0.1:9",
+                     owner_worker_id=WorkerID.from_random())
+    tmpl = TaskSpecTemplate(proto)
+    specs = [tmpl.make(TaskID.of(job),
+                       [TaskArg(ARG_INLINE, data=b"x%d" % i)],
+                       ("k",), seq_no=i)
+             for i in range(4)]
+    batch = wire_spec_batch(specs)
+    assert isinstance(batch, _TemplatedSpecBatch)
+    decoded = pickle.loads(pickle.dumps(batch, protocol=5))
+    assert isinstance(decoded, list) and len(decoded) == 4
+    for orig, dec in zip(specs, decoded):
+        # Wire round trip equals the long-form encoding field for field.
+        long_form = pickle.loads(pickle.dumps(orig, protocol=5))
+        assert dec == long_form
+        assert dec.task_id == orig.task_id
+        assert dec.seq_no == orig.seq_no
+        assert dec.args[0].data == orig.args[0].data
+        assert dec.scheduling_class() == orig.scheduling_class()
+
+
+def test_mixed_or_mutated_batch_falls_back_to_long_form():
+    """Specs from different templates — or whose invariant fields were
+    mutated after stamping (SEQ_SKIP rewrite, prepared runtime_env) —
+    must ship long-form."""
+    from ray_tpu._private.common import (TaskSpec, TaskSpecTemplate,
+                                         wire_spec_batch)
+    from ray_tpu._private.ids import JobID, TaskID
+
+    job = JobID.from_int(1)
+    t1 = TaskSpecTemplate(TaskSpec(task_id=None, job_id=job, name="a",
+                                   function_id="fn:a", args=[]))
+    t2 = TaskSpecTemplate(TaskSpec(task_id=None, job_id=job, name="b",
+                                   function_id="fn:b", args=[]))
+    mixed = [t1.make(TaskID.of(job)), t2.make(TaskID.of(job))]
+    assert wire_spec_batch(mixed) is mixed  # plain list: legacy encoding
+
+    mutated = [t1.make(TaskID.of(job)) for _ in range(2)]
+    mutated[1].method_name = "__ray_tpu_seq_skip__"
+    assert wire_spec_batch(mutated) is mutated
+
+    env_mutated = [t1.make(TaskID.of(job)) for _ in range(2)]
+    env_mutated[1].runtime_env = {"env_vars": {"X": "1"}}
+    assert wire_spec_batch(env_mutated) is env_mutated
+
+
+def test_template_caches_scheduling_class():
+    from ray_tpu._private.common import TaskSpec, TaskSpecTemplate
+    from ray_tpu._private.ids import JobID, TaskID
+
+    job = JobID.from_int(1)
+    proto = TaskSpec(task_id=None, job_id=job, name="f", function_id="fn:1",
+                     args=[], resources={"CPU": 2.0})
+    tmpl = TaskSpecTemplate(proto)
+    spec = tmpl.make(TaskID.of(job))
+    assert spec.scheduling_class() is tmpl.sched_class
+    assert spec.scheduling_class() == proto.scheduling_class()
+
+
+# ---------------------------------------------------------------------------
+# event ring (byte-identical fold)
+# ---------------------------------------------------------------------------
+
+def test_event_ring_preserves_record_content():
+    from ray_tpu._private.flightrec import EventRing
+
+    ring = EventRing(capacity=8)
+    rows = [(b"t%d" % i, b"j", "name", "FINISHED", float(i), None,
+             {"CPU": 1.0}, [float(i)] * 11) for i in range(5)]
+    for r in rows:
+        ring.record(*r)
+    assert ring.drain() == rows  # content byte-identical, oldest first
+    assert ring.drain() == []   # cursor advanced
+
+    # Overflow is drop-oldest with accounting.
+    for i in range(20):
+        ring.record(b"o%d" % i, b"j", "n", "PENDING", float(i), None, {},
+                    None)
+    out = ring.drain()
+    assert len(out) == 8
+    assert out[-1][0] == b"o19"
+    assert out[0][0] == b"o12"
+    assert ring.dropped == 12
+
+
+def test_event_ring_concurrent_writers():
+    from ray_tpu._private.flightrec import EventRing
+
+    ring = EventRing(capacity=4096)
+    n_threads, per = 8, 256
+
+    def write(t):
+        for i in range(per):
+            ring.record((t, i), None, None, None, None, None, None, None)
+
+    threads = [threading.Thread(target=write, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out = ring.drain()
+    assert len(out) == n_threads * per
+    assert len({r[0] for r in out}) == n_threads * per  # no lost writes
+
+
+# ---------------------------------------------------------------------------
+# cluster behavior
+# ---------------------------------------------------------------------------
+
+def test_options_changes_invalidate_template(ray_shared):
+    """num_returns / resources / runtime_env option changes must never
+    reuse a prior template (each .options() product resolves fresh)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def val(x):
+        import os
+        return (x, os.environ.get("TMPL_PROBE", ""))
+
+    # Prime the template via repeated plain calls.
+    assert ray_tpu.get([val.remote(i) for i in range(8)],
+                       timeout=60) == [(i, "") for i in range(8)]
+
+    # num_returns change: two real refs, correct values.
+    @ray_tpu.remote
+    def pair():
+        return 1, 2
+
+    assert ray_tpu.get(pair.remote(), timeout=60) == (1, 2)
+    r1, r2 = pair.options(num_returns=2).remote()
+    assert ray_tpu.get([r1, r2], timeout=60) == [1, 2]
+    # And the base callable's own template still yields one ref.
+    assert ray_tpu.get(pair.remote(), timeout=60) == (1, 2)
+
+    # runtime_env change: the env-var must reach the worker (legacy path).
+    got = ray_tpu.get(
+        val.options(runtime_env={"env_vars": {"TMPL_PROBE": "on"}})
+           .remote(7), timeout=120)
+    assert got == (7, "on")
+    # Back on the template path afterwards: no env leakage into the spec.
+    assert ray_tpu.get(val.remote(9), timeout=60)[0] == 9
+
+
+def test_concurrent_callers_do_not_cross_stamp(ray_shared):
+    """Many user threads submitting through ONE template concurrently:
+    every call keeps its own task id and its own argument payload."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def echo(x):
+        return x
+
+    n_threads, per = 8, 25
+    results = {}
+    refs_by_thread = {}
+    errors = []
+
+    def burst(t):
+        try:
+            refs = [echo.remote((t, i)) for i in range(per)]
+            refs_by_thread[t] = refs
+            results[t] = ray_tpu.get(refs, timeout=120)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=burst, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for t in range(n_threads):
+        assert results[t] == [(t, i) for i in range(per)]
+    # Task/object ids are globally unique across the template's callers.
+    all_ids = [r.id.binary() for refs in refs_by_thread.values()
+               for r in refs]
+    assert len(set(all_ids)) == n_threads * per
+
+
+def test_actor_template_concurrent_callers(ray_shared):
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Echo:
+        def hit(self, x):
+            return x
+
+    a = Echo.remote()
+    assert ray_tpu.get(a.hit.remote(0), timeout=60) == 0
+    results = {}
+    errors = []
+
+    def burst(t):
+        try:
+            results[t] = ray_tpu.get(
+                [a.hit.remote((t, i)) for i in range(20)], timeout=120)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=burst, args=(t,)) for t in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for t in range(6):
+        assert results[t] == [(t, i) for i in range(20)]
+
+
+def test_recorder_phase_stamps_through_ring(ray_shared):
+    """Recorder-on parity: templated submissions still produce full
+    merged phase records (owner + executor stamps, monotonic) through
+    the ring-buffered event path."""
+    import ray_tpu
+    from ray_tpu._private import worker_api
+    from ray_tpu._private.flightrec import PHASE_ORDER, as_dict
+
+    @ray_tpu.remote
+    def ringed():
+        return 1
+
+    assert ray_tpu.get([ringed.remote() for _ in range(6)],
+                       timeout=60) == [1] * 6
+    core = worker_api.get_core()
+    deadline = time.time() + 10
+    phased = []
+    while time.time() < deadline and not phased:
+        events = worker_api._call_on_core_loop(
+            core, core.gcs.request("get_task_events", {"limit": 100000}),
+            30)
+        phased = [e for e in events
+                  if e.get("name") == "ringed" and e.get("phases")
+                  and e.get("state") == "FINISHED"]
+        time.sleep(0.3)
+    assert phased, "no templated task event carried phases"
+    ph = as_dict(phased[0]["phases"])
+    for must in ("submitted", "dispatched", "received", "exec_start",
+                 "exec_end", "reply_handled"):
+        assert must in ph, ph
+    stamps = [ph[p] for p in PHASE_ORDER if p in ph]
+    assert stamps == sorted(stamps), ph
+
+
+# ---------------------------------------------------------------------------
+# legacy framing interop
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(170)
+def test_legacy_framing_interop(jax_cpu):
+    """RAY_TPU_RPC_BATCH=0 (legacy per-frame envelopes) must interoperate
+    with templated batches end to end: tasks, actor calls, args."""
+    script = (
+        "import os, sys\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(num_cpus=2)\n"
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x + 1\n"
+        "assert ray_tpu.get([f.remote(i) for i in range(40)], timeout=60)"
+        " == list(range(1, 41))\n"
+        "@ray_tpu.remote\n"
+        "class A:\n"
+        "    def m(self, x):\n"
+        "        return x * 2\n"
+        "a = A.remote()\n"
+        "assert ray_tpu.get([a.m.remote(i) for i in range(40)], timeout=60)"
+        " == [i * 2 for i in range(40)]\n"
+        "ray_tpu.shutdown()\n"
+        "print('LEGACY_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RAY_TPU_RPC_BATCH="0")
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=150,
+                          env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "LEGACY_OK" in proc.stdout
