@@ -432,6 +432,7 @@ class CoreWorker:
         self._bg_tasks.append(asyncio.ensure_future(self._flush_task_events_loop()))
         self._bg_tasks.append(asyncio.ensure_future(self._lease_janitor_loop()))
         self._bg_tasks.append(asyncio.ensure_future(self._report_metrics_loop()))
+        self._bg_tasks.append(asyncio.ensure_future(self._gcs_liveness_loop()))
         from ray_tpu.util import metrics as metrics_mod
         self._bg_tasks.append(metrics_mod.start_loop_lag_probe(self.mode))
 
@@ -519,6 +520,49 @@ class CoreWorker:
         # the connection): re-run the state race-closer for each.
         for pg_id in list(self._pg_ready_waiters):
             asyncio.ensure_future(self._check_pg_ready(pg_id))
+        # Same race-closer for actors: an actor that went ALIVE (or died)
+        # while we were reconnecting published its event to nobody — a
+        # queue stuck PENDING/RESTARTING would park its calls forever.
+        # The subscribe above is already live, so query-then-event can't
+        # lose a second transition.
+        for actor_id, q in list(self.actor_queues.items()):
+            if q.state in ("PENDING", "RESTARTING"):
+                asyncio.ensure_future(self._check_actor_state(actor_id))
+
+    async def _check_actor_state(self, actor_id):
+        try:
+            info = await self.gcs.request("get_actor_info",
+                                          {"actor_id": actor_id})
+        except rpc.RpcError:
+            return
+        q = self.actor_queues.get(actor_id)
+        if q is None or info is None:
+            return
+        state = getattr(info, "state", "")
+        if state == "ALIVE" and q.state != "ALIVE" and info.address:
+            q.set_state("ALIVE", info.address,
+                        num_restarts=info.num_restarts)
+        elif state == "DEAD" and q.state != "DEAD":
+            q.set_state("DEAD", reason="actor died while GCS reconnecting")
+
+    async def _gcs_liveness_loop(self):
+        """Active redial of a lost GCS channel. Every consumer of the
+        channel (event flush, metrics report) politely SKIPS while it is
+        closed, so a process with no explicit GCS calls in flight — e.g.
+        a driver whose only work is parked actor calls — would otherwise
+        never redial, never re-subscribe, and never learn about actor
+        transitions that happened across a GCS restart."""
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            g = self.gcs
+            if g is None or getattr(g, "_closed", False) or not g.closed:
+                continue
+            try:
+                # Any idempotent request drives _redial + _on_gcs_reconnect
+                # (resubscribe + actor/PG state race-closers).
+                await g.request("get_status_summary", {})
+            except rpc.RpcError:
+                pass  # still down; retry next tick (redial backs off)
 
     async def _raylet_request(self, method, payload):
         return await self.raylet.request(method, payload)

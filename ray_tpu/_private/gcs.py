@@ -167,6 +167,11 @@ class GcsServer:
         # Slice fault domains: one drain/migration task per draining gang
         # (keyed by slice_id), plus lifetime counters for the gang paths.
         self._gang_tasks: Dict[str, asyncio.Task] = {}
+        # Post-deadline "replacement READY" watchers: gang recovery is
+        # counted when the replacement domain actually serves (PGs
+        # re-committed AND migrated actors' constructors done), which can
+        # land well after the drain deadline.
+        self._recovery_tasks: set = set()
         self.gang_drains_total = 0
         self.gang_recoveries_total = 0
         # Consecutive failed reserve-before-release attempts per PG (the
@@ -245,6 +250,8 @@ class GcsServer:
         for task in self._drain_tasks.values():
             task.cancel()
         for task in self._gang_tasks.values():
+            task.cancel()
+        for task in list(self._recovery_tasks):
             task.cancel()
         if self._health_task:
             self._health_task.cancel()
@@ -922,6 +929,15 @@ class GcsServer:
             pg for pg in self.placement_groups.values()
             if pg.state != PG_REMOVED
             and member_ids & set(pg.bundle_nodes.values())]
+        # Affected actors, snapshotted the same way: recovery is counted
+        # at "replacement READY" — their restarted constructors DONE
+        # (ACTOR_ALIVE off the gang) — not merely at PG re-commit, so
+        # gang_recoveries_total and the gang_restart span reflect real
+        # time-to-serve.
+        moved_actors: List = [
+            a for a in self.actors.values()
+            if a.node_id in member_ids
+            and a.state in (ACTOR_ALIVE, ACTOR_PENDING)]
         if grace_s > 0:
             await asyncio.sleep(min(grace_s,
                                     max(0.0, deadline - time.time())))
@@ -953,6 +969,8 @@ class GcsServer:
                 if actor.node_id in ids \
                         and actor.state in (ACTOR_ALIVE, ACTOR_PENDING):
                     n_actors += 1
+                    if actor not in moved_actors:
+                        moved_actors.append(actor)
                     await self._migrate_actor(
                         actor, f"slice {slice_id} draining")
 
@@ -976,23 +994,22 @@ class GcsServer:
                 deadline = max([deadline] +
                                [n.drain_deadline for n in late])
                 await _migrate_members({n.node_id for n in late})
-            # Recovered = every affected PG re-committed OFF the gang
-            # (or was removed). The moved_pgs guard keeps the counter
-            # honest: a gang with no placement groups must not count a
-            # vacuous "recovery" — drains==recoveries for idle slices
+            # Recovered = "replacement READY": every affected PG
+            # re-committed OFF the gang (or removed) AND every migrated
+            # actor's replacement constructor finished (ALIVE off-gang,
+            # or dead for good). The non-vacuousness guard keeps the
+            # counter honest: a gang with no PGs and no actors must not
+            # count a "recovery" — drains==recoveries for idle slices
             # would make the ratio operators alert on meaningless.
-            if not recovered and moved_pgs and all(
-                    pg.state == PG_REMOVED
-                    or (pg.state == PG_CREATED
-                        and not (member_ids
-                                 & set(pg.bundle_nodes.values())))
-                    for pg in moved_pgs):
+            if not recovered and (moved_pgs or moved_actors) \
+                    and self._gang_pgs_ready(moved_pgs, member_ids) \
+                    and self._gang_actors_ready(moved_actors, member_ids):
                 recovered = True
                 self.gang_recoveries_total += 1
                 self._record_gang_span(slice_id, "gang_restart",
                                        t_restart, time.time())
                 logger.info("slice %s recovered: %d PG(s) re-placed, "
-                            "%d actor(s) migrating uncharged",
+                            "%d actor(s) restarted uncharged",
                             slice_id, len(moved_pgs), n_actors)
             if time.time() >= deadline:
                 break
@@ -1001,9 +1018,10 @@ class GcsServer:
             # until the deadline for an outcome that cannot change —
             # a member drained AFTER this exits gets a fresh gang task
             # (_start_gang_drain re-spawns once the prior one is done).
-            if (recovered or not moved_pgs) and not any(
-                    (n := self.nodes.get(nid)) is not None and n.alive
-                    for nid in member_ids):
+            if (recovered or (not moved_pgs and not moved_actors)) \
+                    and not any(
+                        (n := self.nodes.get(nid)) is not None and n.alive
+                        for nid in member_ids):
                 break
             await asyncio.sleep(min(0.25 if recovered else 0.05,
                                     max(0.0, deadline - time.time())))
@@ -1015,6 +1033,16 @@ class GcsServer:
                     preempted=True)
         self._record_gang_span(slice_id, "gang_drain_window",
                                t_replace, time.time())
+        if not recovered and (moved_pgs or moved_actors):
+            # Replacement not READY by the drain deadline (actor restarts
+            # are bounded by worker spawn + constructor time, not by the
+            # reclaim notice): keep watching past it so the counter and
+            # the gang_restart span still record real time-to-serve.
+            watcher = asyncio.ensure_future(self._watch_gang_recovery(
+                slice_id, moved_pgs, moved_actors, set(member_ids),
+                t_restart))
+            self._recovery_tasks.add(watcher)
+            watcher.add_done_callback(self._recovery_tasks.discard)
         # Retire-or-handoff, atomically (no await in this block): a member
         # drained while the _mark_node_dead awaits above ran was past this
         # task's absorption loop, and _start_gang_drain refuses to spawn
@@ -1029,6 +1057,47 @@ class GcsServer:
                 self._drain_gang_task(slice_id, leftover, grace_s))
         else:
             self._gang_tasks.pop(slice_id, None)
+
+    @staticmethod
+    def _gang_pgs_ready(moved_pgs, member_ids) -> bool:
+        """Every affected PG re-committed off the gang (or removed)."""
+        return all(
+            pg.state == PG_REMOVED
+            or (pg.state == PG_CREATED
+                and not (member_ids & set(pg.bundle_nodes.values())))
+            for pg in moved_pgs)
+
+    @staticmethod
+    def _gang_actors_ready(moved_actors, member_ids) -> bool:
+        """Every migrated actor's replacement constructor is DONE (ALIVE
+        off the gang) or the actor is gone for good — the "time-to-serve"
+        half of gang recovery."""
+        return all(
+            a.state == ACTOR_DEAD
+            or (a.state == ACTOR_ALIVE and a.node_id not in member_ids)
+            for a in moved_actors)
+
+    # Bound on the post-deadline replacement watch: a destination that
+    # never fits / a constructor that never finishes gives up counting
+    # (the drain itself already completed).
+    RECOVERY_WATCH_S = 600.0
+
+    async def _watch_gang_recovery(self, slice_id: str, moved_pgs,
+                                   moved_actors, member_ids,
+                                   t_restart: float):
+        deadline = time.time() + self.RECOVERY_WATCH_S
+        while time.time() < deadline:
+            if self._gang_pgs_ready(moved_pgs, member_ids) \
+                    and self._gang_actors_ready(moved_actors, member_ids):
+                self.gang_recoveries_total += 1
+                self._record_gang_span(slice_id, "gang_restart",
+                                       t_restart, time.time())
+                logger.info(
+                    "slice %s recovered after its drain deadline: %d "
+                    "PG(s) re-placed, %d actor(s) restarted uncharged",
+                    slice_id, len(moved_pgs), len(moved_actors))
+                return
+            await asyncio.sleep(0.1)
 
     def _record_gang_span(self, slice_id: str, name: str,
                           start: float, end: float):

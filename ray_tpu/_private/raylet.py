@@ -204,6 +204,10 @@ class WorkerHandle:
     lease_pg: Optional[tuple] = None     # (pg_id, bundle_index)
     is_actor_worker: bool = False
     actor_id: Optional[object] = None
+    # Restart epoch of the hosted actor: create-by-actor-id dedupe keys
+    # on (actor_id, epoch) so a re-driven create for the SAME epoch joins
+    # this instance while a genuine restart (epoch+1) re-instantiates.
+    actor_epoch: int = -1
     idle_since: float = field(default_factory=time.time)
     conn: Optional[rpc.Connection] = None
     # Runtime env this worker has applied ("" = fresh). A tagged worker is
@@ -310,6 +314,11 @@ class Raylet:
         # Actor creates waiting for a worker: (env_hash, exact, future),
         # FIFO-served by rpc_register_worker.
         self._actor_worker_waiters: List[tuple] = []
+        # In-flight create_actor dedupe keyed (actor_id, num_restarts):
+        # a GCS-restore re-drive (or RPC replay) for an actor whose
+        # original create is STILL RUNNING here must join that create,
+        # not double-instantiate the actor.
+        self._creating_actors: Dict[tuple, asyncio.Future] = {}
         self._pending_leases: List[PendingLease] = []
         # Driver conns that have been granted leases: on close, their
         # leased workers are reclaimed (reference: leased workers of an
@@ -1452,7 +1461,50 @@ class Raylet:
 
     @rpc.non_idempotent
     async def rpc_create_actor(self, conn, payload):
+        """Create-by-actor-id dedupe in front of the real create: a GCS
+        restored from a snapshot re-drives PENDING creations, and the
+        original create may STILL be running on this raylet (hung
+        constructor, slow worker spawn) — or may have completed with its
+        reply lost to the dead GCS connection. Either way a second
+        instantiation of the same (actor_id, restart-epoch) would leak a
+        worker + double the actor's side effects; instead the re-drive
+        joins the in-flight create or returns the already-hosted
+        instance."""
         spec: TaskSpec = payload["spec"]
+        epoch = payload.get("num_restarts", 0)
+        key = (spec.actor_id.binary(), epoch)
+        for w in self.workers.values():
+            if (getattr(w, "is_actor_worker", False) and w.leased
+                    and w.actor_id == spec.actor_id
+                    and getattr(w, "actor_epoch", -1) == epoch):
+                return {"actor_address": w.address, "worker_id": w.worker_id}
+        inflight = self._creating_actors.get(key)
+        if inflight is not None:
+            # Shielded: the joiner's own cancellation must not cancel the
+            # original create it merely observes.
+            return await asyncio.shield(inflight)
+        fut = asyncio.get_event_loop().create_future()
+        # A joiner may never materialize; don't warn on an unretrieved
+        # create failure (the original caller gets it raised directly).
+        fut.add_done_callback(
+            lambda f: f.exception() if not f.cancelled() else None)
+        self._creating_actors[key] = fut
+        try:
+            result = await self._create_actor(spec, payload, epoch)
+            if not fut.done():
+                fut.set_result(result)
+            return result
+        except BaseException as e:
+            if not fut.done():
+                if isinstance(e, asyncio.CancelledError):
+                    fut.cancel()
+                else:
+                    fut.set_exception(e)
+            raise
+        finally:
+            self._creating_actors.pop(key, None)
+
+    async def _create_actor(self, spec: TaskSpec, payload, epoch: int):
         if self._draining:
             # The GCS already excludes draining nodes from placement; this
             # covers the race where the pick happened pre-drain.
@@ -1536,6 +1588,11 @@ class Raylet:
             self.pool.release(spec.resources, pg_key)
             self._mark_resources_dirty()
             return {"app_error": reply["app_error"]}
+        # Stamp the epoch only on a COMPLETED create: the dedupe fast
+        # path must never hand out the address of a worker whose
+        # constructor is still running (a re-driven create joins the
+        # in-flight future instead and replies post-construction).
+        worker.actor_epoch = epoch
         return {"actor_address": worker.address, "worker_id": worker.worker_id}
 
     def _prestart_workers(self):

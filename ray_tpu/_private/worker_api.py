@@ -306,6 +306,7 @@ def nodes() -> List[dict]:
         "NodeID": n.node_id.hex(), "Alive": n.alive, "Address": n.address,
         "Resources": n.resources_total, "Labels": n.labels,
         "IsHead": n.is_head, "Draining": getattr(n, "draining", False),
+        "SliceId": getattr(n, "slice_id", ""),
     } for n in infos]
 
 

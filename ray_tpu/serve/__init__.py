@@ -22,6 +22,8 @@ from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.config_deploy import (deploy_config, import_application,
                                          load_serve_config,
                                          run_import_path)
+from ray_tpu.serve.exceptions import (BackPressureError, ReplicaDiedError,
+                                      RequestTimeoutError, ServeError)
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "shutdown",
@@ -30,5 +32,6 @@ __all__ = [
     "DeploymentResponseGenerator", "ServeRpcClient", "batch", "multiplexed",
     "get_multiplexed_model_id", "AutoscalingConfig", "HTTPOptions",
     "gRPCOptions", "deploy_config", "import_application",
-    "load_serve_config", "run_import_path",
+    "load_serve_config", "run_import_path", "ServeError",
+    "BackPressureError", "RequestTimeoutError", "ReplicaDiedError",
 ]

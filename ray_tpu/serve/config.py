@@ -57,3 +57,24 @@ class DeploymentConfig:
     autoscaling_config: Optional[AutoscalingConfig] = None
     health_check_period_s: float = 2.0
     graceful_shutdown_timeout_s: float = 5.0
+    # Admission control: requests beyond max_ongoing_requests queue on
+    # the replica up to this bound, then shed (drop-newest) with a typed
+    # BackPressureError / HTTP 503. -1 = unbounded (legacy behavior).
+    max_queued_requests: int = -1
+    # Queue-preserving failover: True asserts the deployment's handlers
+    # are replay-safe (idempotent), letting the router re-route a
+    # dispatched-but-unfinished request to a healthy replica when its
+    # replica dies or its slice gang-drains. False (default) fails such
+    # requests fast with a typed ReplicaDiedError — mirroring the RPC
+    # layer's @rpc.idempotent replay gating.
+    request_replay: bool = False
+    # Default end-to-end deadline applied to every request through a
+    # handle (None = no deadline). Propagated handle -> replica: a
+    # timed-out request is cancelled ON the replica instead of burning
+    # TPU time; per-call handle.options(timeout_s=...) overrides.
+    request_timeout_s: Optional[float] = None
+    # Spread replicas across TPU-slice fault domains (slice_id gangs)
+    # so one slice preemption never takes the whole deployment. Only
+    # applies when the cluster exposes >= 2 slice domains and the
+    # deployment doesn't pin placement itself.
+    slice_spread: bool = True

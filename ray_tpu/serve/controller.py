@@ -4,15 +4,38 @@ Reference parity: python/ray/serve/_private/controller.py:91 and
 deployment_state.py:1226 (DeploymentState/DeploymentStateManager). One named
 actor holds target state per app/deployment, reconciles replicas (create,
 remove, rolling-update by version), health-checks them, and applies
-queue-depth autoscaling. Routers poll get_replicas() with a version counter
+queue-depth autoscaling. Routers poll get_routing() with a version counter
 (the long-poll analogue).
+
+Replica lifecycle (serve-under-fire):
+
+    STARTING --ready--> RUNNING --drain--> DRAINING --> killed
+
+- STARTING replicas are routable only while NO replica is RUNNING (cold
+  start: queueing on a starting replica beats failing), so a rolling
+  update never routes onto a not-yet-ready replacement.
+- Rolling updates and scale-downs are replace-then-drain: the new
+  replica must reach RUNNING before the old one drains; draining stops
+  new dispatch, hands queued work back to the router, finishes in-flight
+  requests within graceful_shutdown_timeout_s, then the actor dies.
+- Node drain notices (PR 1's two-phase drain / slice gang drains) are
+  consumed from this process's drain-event log: replicas on a draining
+  node drain proactively instead of dying with the host.
+- Replicas spread across TPU-slice fault domains (config.slice_spread)
+  so one slice preemption never takes the whole deployment.
+- Readiness is watched by per-replica background tasks — a hung
+  constructor can never stall the reconcile loop — and the reconcile /
+  health-check periods are jittered so co-resident controllers and
+  probe bursts desynchronize.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
@@ -21,13 +44,27 @@ logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
+REPLICA_STARTING = "STARTING"
+REPLICA_RUNNING = "RUNNING"
+REPLICA_DRAINING = "DRAINING"
+
 
 class _ReplicaInfo:
     def __init__(self, handle, version: str):
         self.handle = handle
         self.version = version
+        self.replica_id = uuid.uuid4().hex[:12]
         self.started = time.monotonic()
         self.ever_healthy = False
+        self.state = REPLICA_STARTING
+        self.node_id = None            # resolved once READY
+        self.target_slice = ""         # slice domain picked at start
+        self.ready_task: Optional[asyncio.Task] = None
+        self.drain_task: Optional[asyncio.Task] = None
+        # Rolling update: the old replica this one replaces — retired
+        # (drained) only once this replica reaches RUNNING.
+        self.replaces: Optional["_ReplicaInfo"] = None
+        self.being_replaced = False
 
 
 class _DeploymentState:
@@ -40,23 +77,53 @@ class _DeploymentState:
         self.blob = blob
         self.config = config
         self.version = version
-        self.replicas: List[_ReplicaInfo] = []
+        self.replicas: List[_ReplicaInfo] = []   # STARTING / RUNNING
+        self.draining: List[_ReplicaInfo] = []   # retiring, not routable
         self.target_num = config.num_replicas
         self.list_version = 0              # bumped on any replica-set change
         self.last_scale_change = 0.0
+        self.next_health_check = 0.0
+
+    def active(self) -> List[_ReplicaInfo]:
+        """Replicas that fill a target slot (replacements don't — they
+        take their predecessor's slot at swap time)."""
+        return [r for r in self.replicas if r.replaces is None]
 
 
 class ServeController:
+    RECONCILE_PERIOD_S = 0.5
+
     def __init__(self):
         self._deployments: Dict[tuple, _DeploymentState] = {}
         self._routes: Dict[str, tuple] = {}  # route_prefix -> (app, ingress)
         self._proxy = None
         self._reconcile_task = None
         self._started = False
+        self._wake: Optional[asyncio.Event] = None
+        # deploy_app's inline reconcile and the background loop interleave
+        # (replica starts await the slice-domain lookup): without mutual
+        # exclusion both can top up the same deployment and overshoot.
+        self._reconcile_lock = asyncio.Lock()
+        self._drain_seen = 0               # index into drain_events()
+        self._domains: Dict[str, list] = {}
+        self._node_slice: Dict[Any, str] = {}
+        self._nodes_ts = 0.0
 
     async def _ensure_loops(self):
         if not self._started:
             self._started = True
+            self._wake = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            wake = self._wake
+
+            def _notice():
+                loop.call_soon_threadsafe(wake.set)
+
+            try:
+                from ray_tpu._private import worker_api
+                worker_api.add_drain_event_listener(_notice)
+            except Exception:  # noqa: BLE001 — no core (unit tests)
+                pass
             self._reconcile_task = asyncio.ensure_future(
                 self._reconcile_loop())
 
@@ -100,14 +167,26 @@ class ServeController:
         st = self._deployments.pop(key, None)
         if st is None:
             return
-        for r in st.replicas:
+        for r in list(st.replicas):
+            if r.ready_task is not None:
+                r.ready_task.cancel()
             await self._stop_replica(st, r.handle)
+        st.replicas.clear()
+        # Already-DRAINING replicas finish through their own drain tasks.
 
-    async def _stop_replica(self, st, rep):
+    # Idle linger before a drained replica dies: covers the router
+    # routable-set cache window (Router.REFRESH_S) plus wire latency, so
+    # late-routed requests bounce (re-route) instead of dying with the
+    # actor. Only applied to live drains (rolling update / scale-down /
+    # node drain) — app deletion kills without it.
+    DRAIN_LINGER_S = 1.3
+
+    async def _stop_replica(self, st, rep, linger_s: float = 0.0):
+        timeout = st.config.graceful_shutdown_timeout_s
         try:
             await asyncio.wait_for(
-                rep.drain.remote(st.config.graceful_shutdown_timeout_s).future(),
-                timeout=st.config.graceful_shutdown_timeout_s + 2)
+                rep.drain.remote(timeout, linger_s).future(),
+                timeout=timeout + linger_s + 2)
         except Exception:
             pass
         try:
@@ -116,61 +195,181 @@ class ServeController:
             pass
 
     # ------------------------------------------------------------------
-    # Reconciliation
+    # Replica lifecycle
     # ------------------------------------------------------------------
-    async def _start_replica(self, st: _DeploymentState):
+    async def _start_replica(self, st: _DeploymentState,
+                             replaces: Optional[_ReplicaInfo] = None):
         from ray_tpu.serve.replica import ReplicaActor
-        opts = dict(st.config.ray_actor_options)
+        cfg = st.config
+        opts = dict(cfg.ray_actor_options)
         opts.setdefault("num_cpus", 0.1)
-        opts.setdefault("max_concurrency", st.config.max_ongoing_requests)
+        # Admission control lives in the replica (bounded queue + shed):
+        # the actor's concurrency cap must sit ABOVE max_ongoing + queue
+        # so queued requests reach the replica's gate — and control
+        # methods (health, drain, metrics) never starve behind a full
+        # request queue.
+        queued = (cfg.max_queued_requests if cfg.max_queued_requests >= 0
+                  else 2048)
+        opts.setdefault("max_concurrency",
+                        cfg.max_ongoing_requests + queued + 32)
+        target_slice = ""
+        if cfg.slice_spread and "scheduling_strategy" not in opts:
+            strat, target_slice = await self._slice_spread_strategy(st)
+            if strat is not None:
+                opts["scheduling_strategy"] = strat
         cls = ray_tpu.remote(**opts)(ReplicaActor)
-        rep = cls.remote(st.blob, st.config.user_config)
+        limits = {"deployment": st.name,
+                  "max_ongoing": cfg.max_ongoing_requests,
+                  "max_queued": cfg.max_queued_requests,
+                  "request_replay": cfg.request_replay}
+        rep = cls.remote(st.blob, cfg.user_config, limits)
         info = _ReplicaInfo(rep, st.version)
+        info.replaces = replaces
+        info.target_slice = target_slice
         st.replicas.append(info)
         st.list_version += 1
+        info.ready_task = asyncio.ensure_future(self._wait_ready(st, info))
         return info
 
+    async def _wait_ready(self, st: _DeploymentState, info: _ReplicaInfo):
+        """Background readiness watcher: bounded, one per replica — a
+        hung constructor stalls only its own watcher, never the
+        reconcile loop (the health loop's startup grace reaps it)."""
+        try:
+            await asyncio.wait_for(
+                info.handle.check_health.remote().future(),
+                timeout=st.STARTUP_GRACE_S)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return
+        # READY + swap in ONE sync block (no await between them): the
+        # routable set must never publish a version where both the old
+        # replica and its replacement serve — a client that already saw
+        # the new version could be routed back to the old one.
+        info.ever_healthy = True
+        if info.state == REPLICA_STARTING:
+            info.state = REPLICA_RUNNING
+            st.list_version += 1
+        old, info.replaces = info.replaces, None
+        if old is not None and old in st.replicas:
+            # Replace-then-drain: the replacement serves before the old
+            # replica retires (rolling, never big-bang).
+            self._begin_drain(st, old, "rolling update")
+        try:
+            info.node_id = await self._actor_node(info.handle)
+        except Exception:  # noqa: BLE001 — placement info is best-effort
+            pass
+
+    def _begin_drain(self, st: _DeploymentState, r: _ReplicaInfo,
+                     reason: str):
+        """DRAINING: out of the routable set immediately; queued work is
+        handed back to routers by the replica; in-flight finishes within
+        graceful_shutdown_timeout_s; then the actor dies."""
+        if r.state == REPLICA_DRAINING:
+            return
+        if r.ready_task is not None:
+            r.ready_task.cancel()
+        if r in st.replicas:
+            st.replicas.remove(r)
+        st.list_version += 1
+        r.state = REPLICA_DRAINING
+        st.draining.append(r)
+        logger.info("draining replica %s of %s (%s)",
+                    r.replica_id, st.name, reason)
+        r.drain_task = asyncio.ensure_future(self._drain_and_stop(st, r))
+
+    async def _drain_and_stop(self, st: _DeploymentState, r: _ReplicaInfo):
+        await self._stop_replica(st, r.handle, linger_s=self.DRAIN_LINGER_S)
+        if r in st.draining:
+            st.draining.remove(r)
+
+    # ------------------------------------------------------------------
+    # Reconciliation
+    # ------------------------------------------------------------------
     async def _reconcile_once(self):
+        async with self._reconcile_lock:
+            await self._reconcile_locked()
+
+    async def _reconcile_locked(self):
         for st in list(self._deployments.values()):
-            # Rolling update: replace replicas built from an older version.
-            stale = [i for i, r in enumerate(st.replicas)
-                     if r.version != st.version]
-            for i in sorted(stale, reverse=True):
-                old = st.replicas[i]
-                del st.replicas[i]
-                st.list_version += 1
-                new = await self._start_replica(st)
-                # Wait for the new replica to come up before killing the old
-                # one (rolling, not big-bang).
-                try:
-                    await asyncio.wait_for(
-                        new.handle.check_health.remote().future(), timeout=30)
-                    new.ever_healthy = True
-                except Exception:
-                    pass
-                await self._stop_replica(st, old.handle)
-            # Scale to target.
-            while len(st.replicas) < st.target_num:
+            # Rolling update: replace stale-version replicas one at a
+            # time — new replica first, old drained once it's READY.
+            if not any(r.replaces is not None for r in st.replicas):
+                stale = next(
+                    (r for r in st.replicas
+                     if r.version != st.version and not r.being_replaced),
+                    None)
+                if stale is not None:
+                    stale.being_replaced = True
+                    await self._start_replica(st, replaces=stale)
+            # Scale to target (replacement replicas don't fill a slot).
+            while len(st.active()) < st.target_num:
                 await self._start_replica(st)
-            while len(st.replicas) > st.target_num:
-                r = st.replicas.pop()
-                st.list_version += 1
-                await self._stop_replica(st, r.handle)
+            while len(st.active()) > st.target_num:
+                # Prefer retiring replicas that never served, then the
+                # newest — oldest replicas are the proven ones.
+                victims = sorted(
+                    (r for r in st.active() if not r.being_replaced),
+                    key=lambda r: (r.state == REPLICA_RUNNING, -r.started))
+                if not victims:
+                    break
+                self._begin_drain(st, victims[0], "scale down")
 
     async def _reconcile_loop(self):
         while True:
             try:
+                self._process_drain_notices()
                 await self._reconcile_once()
                 await self._health_check()
                 await self._autoscale()
             except Exception:
                 logger.exception("serve controller reconcile error")
-            await asyncio.sleep(0.5)
+            # Jittered so co-resident controllers/probes desynchronize;
+            # the wake event short-circuits the sleep on drain notices.
+            period = self.RECONCILE_PERIOD_S * random.uniform(0.7, 1.3)
+            try:
+                await asyncio.wait_for(self._wake.wait(), period)
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+
+    def _process_drain_notices(self):
+        """Proactively drain replicas whose host got a drain/preemption
+        notice (PR 1 two-phase drain, PR 4 gang drains): their queued
+        work re-routes NOW instead of dying with the node at the
+        deadline. The reconcile pass tops the count back up — on a
+        healthy domain, thanks to slice spread."""
+        try:
+            from ray_tpu._private import worker_api
+            events = worker_api.drain_events()
+        except Exception:  # noqa: BLE001
+            return
+        new = events[self._drain_seen:]
+        self._drain_seen = len(events)
+        if not new:
+            return
+        draining_nodes = set()
+        for ev in new:
+            ids = ev.get("node_ids") or (
+                [ev["node_id"]] if ev.get("node_id") is not None else [])
+            draining_nodes.update(ids)
+        if not draining_nodes:
+            return
+        for st in list(self._deployments.values()):
+            for r in list(st.replicas):
+                if r.node_id is not None and r.node_id in draining_nodes:
+                    self._begin_drain(st, r, "node drain notice")
 
     async def _health_check(self):
         from ray_tpu import exceptions as exc
         now = time.monotonic()
         for st in list(self._deployments.values()):
+            if now < st.next_health_check:
+                continue
+            st.next_health_check = now + (
+                st.config.health_check_period_s * random.uniform(0.75, 1.25))
+
             async def check(r):
                 try:
                     await asyncio.wait_for(
@@ -188,6 +387,9 @@ class ServeController:
                 ok = oks[i]
                 if ok is True:
                     r.ever_healthy = True
+                    if r.state == REPLICA_STARTING:
+                        r.state = REPLICA_RUNNING
+                        st.list_version += 1
                     continue
                 # A replica that has never come up yet may simply still be
                 # starting (worker spawn under load): give it a grace
@@ -198,13 +400,26 @@ class ServeController:
                 if (ok is False and not r.ever_healthy
                         and now - r.started < st.STARTUP_GRACE_S):
                     continue
-                del st.replicas[i]
-                st.list_version += 1
-                try:
-                    ray_tpu.kill(r.handle)
-                except Exception:
-                    pass
+                self._drop_dead_replica(st, r)
         # reconcile_once (caller loop) will top the count back up
+
+    def _drop_dead_replica(self, st: _DeploymentState, r: _ReplicaInfo):
+        if r in st.replicas:
+            st.replicas.remove(r)
+        st.list_version += 1
+        if r.ready_task is not None:
+            r.ready_task.cancel()
+        # Untangle rolling-update links so the swap machinery retries.
+        if r.replaces is not None:
+            r.replaces.being_replaced = False
+            r.replaces = None
+        for other in st.replicas:
+            if other.replaces is r:
+                other.replaces = None
+        try:
+            ray_tpu.kill(r.handle)
+        except Exception:
+            pass
 
     async def _autoscale(self):
         now = time.monotonic()
@@ -212,6 +427,7 @@ class ServeController:
             asc = st.config.autoscaling_config
             if asc is None or not st.replicas:
                 continue
+
             async def metrics(r):
                 try:
                     return await asyncio.wait_for(
@@ -220,8 +436,11 @@ class ServeController:
                     return None
             results = await asyncio.gather(
                 *[metrics(r) for r in st.replicas])
-            total = sum(m["ongoing"] for m in results if m)
-            desired = asc.decide(len(st.replicas), total)
+            # Queued requests count toward pressure: with replica-side
+            # admission queues, "ongoing" alone under-reports load.
+            total = sum(m["ongoing"] + m.get("queued", 0)
+                        for m in results if m)
+            desired = asc.decide(len(st.active()), total)
             delay = (asc.upscale_delay_s if desired > st.target_num
                      else asc.downscale_delay_s)
             if desired != st.target_num:
@@ -234,6 +453,59 @@ class ServeController:
                 st.last_scale_change = now
 
     # ------------------------------------------------------------------
+    # Slice fault-domain spread
+    # ------------------------------------------------------------------
+    async def _slice_domains(self):
+        now = time.monotonic()
+        if now - self._nodes_ts < 2.0:
+            return self._domains
+        from ray_tpu._private import worker_api
+        core = worker_api.get_core()
+        infos = await core.gcs.request("get_all_nodes", {})
+        domains: Dict[str, list] = {}
+        node_slice: Dict[Any, str] = {}
+        for n in infos:
+            sid = getattr(n, "slice_id", "")
+            if not sid:
+                continue
+            node_slice[n.node_id] = sid
+            if n.alive and not getattr(n, "draining", False):
+                domains.setdefault(sid, []).append(n)
+        self._domains = domains
+        self._node_slice = node_slice
+        self._nodes_ts = now
+        return domains
+
+    async def _slice_spread_strategy(self, st: _DeploymentState):
+        """Anti-affinity across TPU-slice fault domains: pick the domain
+        hosting the fewest of this deployment's replicas, soft node
+        affinity into it — one slice preemption can then never take the
+        whole deployment."""
+        try:
+            domains = await self._slice_domains()
+        except Exception:  # noqa: BLE001 — placement hint is best-effort
+            return None, ""
+        if len(domains) < 2:
+            return None, ""
+        counts = {s: 0 for s in domains}
+        for r in st.replicas:
+            sid = r.target_slice or self._node_slice.get(r.node_id, "")
+            if sid in counts:
+                counts[sid] += 1
+        target = min(sorted(counts), key=lambda s: counts[s])
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        node = domains[target][0]
+        return NodeAffinitySchedulingStrategy(node.node_id, soft=True), target
+
+    async def _actor_node(self, handle):
+        from ray_tpu._private import worker_api
+        core = worker_api.get_core()
+        info = await core.gcs.request(
+            "get_actor_info", {"actor_id": handle._actor_id})
+        return getattr(info, "node_id", None)
+
+    # ------------------------------------------------------------------
     # Queries
     # ------------------------------------------------------------------
     def get_replicas(self, app_name: str, deployment_name: str):
@@ -241,6 +513,28 @@ class ServeController:
         if st is None:
             return (0, [])
         return (st.list_version, [r.handle for r in st.replicas])
+
+    def get_routing(self, app_name: str, deployment_name: str):
+        """Routable replica set + the routing-relevant config bits.
+
+        RUNNING replicas only — except cold start (none RUNNING yet),
+        where STARTING replicas are offered so requests queue on a
+        booting replica instead of failing."""
+        st = self._deployments.get((app_name, deployment_name))
+        if st is None:
+            return {"version": 0, "replicas": [], "config": {}}
+        routable = [r for r in st.replicas if r.state == REPLICA_RUNNING]
+        if not routable:
+            routable = list(st.replicas)
+        return {
+            "version": st.list_version,
+            "replicas": [(r.replica_id, r.handle) for r in routable],
+            "config": {
+                "deployment": st.name,
+                "request_replay": st.config.request_replay,
+                "request_timeout_s": st.config.request_timeout_s,
+            },
+        }
 
     def get_route_table(self):
         return dict(self._routes)
@@ -251,6 +545,9 @@ class ServeController:
             out.setdefault(app, {})[name] = {
                 "target": st.target_num,
                 "running": len(st.replicas),
+                "ready": sum(1 for r in st.replicas
+                             if r.state == REPLICA_RUNNING),
+                "draining": len(st.draining),
                 "version": st.version,
             }
         return out

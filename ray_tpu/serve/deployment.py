@@ -30,7 +30,11 @@ class Deployment:
                 user_config: Any = None,
                 ray_actor_options: Optional[dict] = None,
                 autoscaling_config: Optional[AutoscalingConfig] = None,
-                route_prefix: Optional[str] = None) -> "Deployment":
+                route_prefix: Optional[str] = None,
+                max_queued_requests: Optional[int] = None,
+                request_replay: Optional[bool] = None,
+                request_timeout_s: Optional[float] = None,
+                slice_spread: Optional[bool] = None) -> "Deployment":
         cfg = replace(self.config)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
@@ -42,6 +46,14 @@ class Deployment:
             cfg.ray_actor_options = dict(ray_actor_options)
         if autoscaling_config is not None:
             cfg.autoscaling_config = autoscaling_config
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
+        if request_replay is not None:
+            cfg.request_replay = request_replay
+        if request_timeout_s is not None:
+            cfg.request_timeout_s = request_timeout_s
+        if slice_spread is not None:
+            cfg.slice_spread = slice_spread
         return Deployment(
             func_or_class=self.func_or_class,
             name=name or self.name,
@@ -85,7 +97,11 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                user_config: Any = None,
                ray_actor_options: Optional[dict] = None,
                autoscaling_config: Optional[AutoscalingConfig] = None,
-               route_prefix: Optional[str] = None):
+               route_prefix: Optional[str] = None,
+               max_queued_requests: int = -1,
+               request_replay: bool = False,
+               request_timeout_s: Optional[float] = None,
+               slice_spread: bool = True):
     """@serve.deployment decorator (reference: serve/api.py deployment)."""
 
     def wrap(f_or_c):
@@ -95,6 +111,10 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             user_config=user_config,
             ray_actor_options=dict(ray_actor_options or {}),
             autoscaling_config=autoscaling_config,
+            max_queued_requests=max_queued_requests,
+            request_replay=request_replay,
+            request_timeout_s=request_timeout_s,
+            slice_spread=slice_spread,
         )
         return Deployment(func_or_class=f_or_c,
                           name=name or f_or_c.__name__,

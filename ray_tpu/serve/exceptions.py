@@ -1,0 +1,119 @@
+"""Typed serve request-path errors.
+
+Reference parity: python/ray/serve/exceptions.py (BackPressureError,
+RequestCancelledError) and the gRPC status-code mapping in
+_private/proxy.py. Every error a request can hit on the serve path is
+typed so callers (and the HTTP/binary proxies) can distinguish "shed it"
+from "replica died" from "deadline passed" — the proxies map `code` to
+HTTP 503/504 and the binary ingress ships the exception itself (the
+gRPC RESOURCE_EXHAUSTED analogue rides the `code` attribute).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.exceptions import RayTpuError
+
+
+class ServeError(RayTpuError):
+    """Base class for serve request-path errors."""
+
+    #: gRPC-style status code surfaced by the binary ingress.
+    code = "INTERNAL"
+    #: HTTP status the proxy maps this error to.
+    http_status = 500
+
+
+class BackPressureError(ServeError):
+    """The deployment's bounded queue is full: the request was shed
+    (drop-newest) instead of queueing unboundedly. Retry later or scale
+    up; the proxies surface this as HTTP 503 / RESOURCE_EXHAUSTED."""
+
+    code = "RESOURCE_EXHAUSTED"
+    http_status = 503
+
+    def __init__(self, deployment: str = "", queued: int = 0,
+                 limit: int = 0):
+        self.deployment = deployment
+        self.queued = queued
+        self.limit = limit
+        super().__init__(
+            f"deployment {deployment!r} shed request: {queued} queued >= "
+            f"max_queued_requests={limit}")
+
+    def __reduce__(self):
+        return (BackPressureError, (self.deployment, self.queued,
+                                    self.limit))
+
+
+class RequestTimeoutError(ServeError, TimeoutError):
+    """The request's end-to-end deadline passed. Raised on the replica
+    (the in-flight handler is cancelled so it stops burning TPU time) or
+    router-side when the deadline expires during routing/replay."""
+
+    code = "DEADLINE_EXCEEDED"
+    http_status = 504
+
+    def __init__(self, deployment: str = "", timeout_s: float = 0.0,
+                 where: str = "replica"):
+        self.deployment = deployment
+        self.timeout_s = timeout_s
+        self.where = where
+        super().__init__(
+            f"request to deployment {deployment!r} exceeded its "
+            f"{timeout_s:.3g}s deadline ({where})")
+
+    def __reduce__(self):
+        return (RequestTimeoutError, (self.deployment, self.timeout_s,
+                                      self.where))
+
+
+class ReplicaDiedError(ServeError):
+    """The replica executing this request died (crash, slice preemption)
+    and the request is NOT replayable (`request_replay=False`): fail
+    fast with the typed cause instead of hanging or silently re-running
+    a possibly non-idempotent handler."""
+
+    code = "UNAVAILABLE"
+    http_status = 503
+
+    def __init__(self, deployment: str = "", reason: str = "replica died"):
+        self.deployment = deployment
+        self.reason = reason
+        super().__init__(
+            f"replica of deployment {deployment!r} died mid-request "
+            f"({reason}); set request_replay=True on the deployment to "
+            f"re-route idempotent requests instead")
+
+    def __reduce__(self):
+        return (ReplicaDiedError, (self.deployment, self.reason))
+
+
+class ReplicaDrainingError(ServeError):
+    """Internal re-route signal: the replica is draining (scale-down,
+    rolling update, node drain) and handed this still-QUEUED request
+    back before it started executing. The router always replays these —
+    a request that never started is replay-safe regardless of the
+    deployment's request_replay setting. User code should never see
+    this error; reaching a caller means every re-route attempt failed."""
+
+    code = "UNAVAILABLE"
+    http_status = 503
+
+    def __init__(self, deployment: str = ""):
+        self.deployment = deployment
+        super().__init__(
+            f"replica of deployment {deployment!r} is draining; request "
+            f"handed back to the router")
+
+    def __reduce__(self):
+        return (ReplicaDrainingError, (self.deployment,))
+
+
+def unwrap(err: BaseException) -> BaseException:
+    """Peel the TaskError envelope off a replica-raised exception: actor
+    methods surface application errors as TaskError(cause); the serve
+    layer routes on the typed cause."""
+    from ray_tpu.exceptions import TaskError
+    if isinstance(err, TaskError) and err.cause is not None:
+        return err.cause
+    return err

@@ -25,6 +25,29 @@ from typing import Any, Dict, Optional
 from ray_tpu._private import rpc
 
 
+def _reraise_typed(e: "rpc.RemoteRpcError"):
+    """Map a remote serve error back to its typed class (the generated-
+    stub analogue of gRPC status codes: BackPressureError carries
+    RESOURCE_EXHAUSTED, RequestTimeoutError DEADLINE_EXCEEDED,
+    ReplicaDiedError UNAVAILABLE). Instances are built through their
+    real constructors so every documented field exists and the error
+    stays picklable; the remote message replaces the synthesized one."""
+    from ray_tpu.serve import exceptions as serr
+    factory = {
+        "BackPressureError": lambda: serr.BackPressureError("", 0, 0),
+        "RequestTimeoutError": lambda: serr.RequestTimeoutError(
+            "", 0.0, "remote"),
+        "ReplicaDiedError": lambda: serr.ReplicaDiedError(
+            "", e.err_message),
+        "ReplicaDrainingError": lambda: serr.ReplicaDrainingError(""),
+    }.get(e.err_type)
+    if factory is None:
+        raise e
+    err = factory()
+    err.args = (e.err_message,)
+    raise err from e
+
+
 class GrpcProxyActor:
     """Ingress actor: RpcServer in front of the deployment router."""
 
@@ -56,12 +79,25 @@ class GrpcProxyActor:
             self._routes = await ctrl.get_route_table.remote()
         app = payload.get("app", "default")
         deployment = payload.get("deployment")
-        if deployment is None:
-            # Route to the app's ingress deployment.
+
+        def _ingress():
             for _prefix, (app_name, ingress) in self._routes.items():
                 if app_name == app:
-                    deployment = ingress
-                    break
+                    return ingress
+            return None
+
+        if deployment is None:
+            # Route to the app's ingress deployment; a just-deployed app
+            # may not be in the cached table yet — force one refresh
+            # before failing.
+            deployment = _ingress()
+            if deployment is None:
+                self._last_refresh = 0.0
+                from ray_tpu.serve.api import _get_controller_async
+                ctrl = await _get_controller_async()
+                self._routes = await ctrl.get_route_table.remote()
+                self._last_refresh = time.monotonic()
+                deployment = _ingress()
         if deployment is None:
             raise ValueError(f"no application {app!r}")
         key = (app, deployment, payload.get("method") or "__call__")
@@ -144,8 +180,11 @@ class ServeRpcClient:
                 "serve_unary",
                 {"app": app, "deployment": deployment, "method": method,
                  "args": args, "kwargs": kwargs}, timeout)
-        return asyncio.run_coroutine_threadsafe(
-            go(), self._loop).result(timeout + 10)
+        try:
+            return asyncio.run_coroutine_threadsafe(
+                go(), self._loop).result(timeout + 10)
+        except rpc.RemoteRpcError as e:
+            _reraise_typed(e)
 
     def stream(self, *args, app: str = "default",
                deployment: Optional[str] = None, method: str = "__call__",
@@ -183,7 +222,10 @@ class ServeRpcClient:
                 if item is _END:
                     break
                 yield item
-            fut.result(5)  # surface stream errors
+            try:
+                fut.result(5)  # surface stream errors
+            except rpc.RemoteRpcError as e:
+                _reraise_typed(e)
         finally:
             self._streams.pop(call_id, None)
 

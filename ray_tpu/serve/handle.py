@@ -1,9 +1,22 @@
-"""DeploymentHandle + power-of-two-choices routing.
+"""DeploymentHandle + power-of-two-choices routing with queue-preserving
+failover.
 
 Reference parity: python/ray/serve/handle.py (DeploymentHandle) and
 _private/replica_scheduler/pow_2_scheduler.py:44. The router keeps local
 in-flight counts per replica and picks the lighter of two random choices —
 locality/queue-aware without a round trip per request.
+
+Serve-under-fire semantics: the handle retains every dispatched request's
+payload until its reply lands. When the replica dies (crash, slice
+preemption) or hands queued work back while draining, the request is
+re-routed to a healthy replica — gated on the deployment's
+`request_replay` flag exactly like the RPC layer's idempotency replay:
+replayable requests re-dispatch (deduped replica-side by request id),
+non-replayable ones fail fast with a typed ReplicaDiedError. Requests a
+draining replica handed back never started executing, so they re-route
+unconditionally. End-to-end deadlines propagate handle -> replica: a
+late request is cancelled ON the replica and surfaces as
+RequestTimeoutError.
 """
 
 from __future__ import annotations
@@ -11,9 +24,47 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Any, Dict, List, Optional
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.serve.exceptions import (ReplicaDiedError, ReplicaDrainingError,
+                                      RequestTimeoutError, ServeError, unwrap)
+
+_MAX_ATTEMPTS = 6          # routing/replay attempts per request
+
+
+def _replays_counter():
+    from ray_tpu.util import metrics
+    return metrics.Counter(
+        "ray_tpu_serve_replays_total",
+        "serve requests re-routed to a healthy replica after their "
+        "replica died or drained (queue-preserving failover)",
+        tag_keys=("Deployment",))
+
+
+def _count_replay(deployment: str):
+    try:
+        _replays_counter().inc(tags={"Deployment": deployment})
+    except Exception:  # noqa: BLE001 — metrics must not fail requests
+        pass
+
+
+class _PendingRequest:
+    """Retained request payload: everything needed to re-dispatch."""
+
+    __slots__ = ("method", "mux_id", "args", "kwargs", "request_id",
+                 "deadline_ts", "attempts")
+
+    def __init__(self, method: str, mux_id: str, args: tuple, kwargs: dict,
+                 deadline_ts: float = 0.0):
+        self.method = method
+        self.mux_id = mux_id
+        self.args = args
+        self.kwargs = kwargs
+        self.request_id = uuid.uuid4().hex
+        self.deadline_ts = deadline_ts
+        self.attempts = 0
 
 
 class DeploymentResponse:
@@ -24,10 +75,11 @@ class DeploymentResponse:
     performs routing + the call; use `await response`.
     """
 
-    def __init__(self, ref=None, on_done=None, coro=None):
+    def __init__(self, ref=None, on_done=None, coro=None, recover=None):
         self._ref = ref
         self._on_done = on_done or (lambda: None)
         self._coro = coro
+        self._recover = recover  # fn(err) -> new ref (re-dispatch) or raise
         self._done = False
 
     def result(self, timeout: Optional[float] = None):
@@ -35,11 +87,42 @@ class DeploymentResponse:
             raise RuntimeError(
                 "result() is not available in async context; use "
                 "`await response` instead")
-        try:
-            out = ray_tpu.get(self._ref, timeout=timeout)
-        finally:
-            self._settle()
-        return out
+        from ray_tpu import exceptions as exc
+        while True:
+            try:
+                out = ray_tpu.get(self._ref, timeout=timeout)
+                self._settle()
+                return out
+            except exc.TaskError as e:
+                cause = unwrap(e)
+                if isinstance(cause, ReplicaDrainingError) \
+                        and self._recover is not None:
+                    # Queued work handed back by a draining replica:
+                    # always replay-safe (it never started executing).
+                    try:
+                        self._ref = self._recover(cause)
+                        continue
+                    except Exception:
+                        self._settle()
+                        raise
+                if isinstance(cause, ServeError):
+                    self._settle()
+                    raise cause from None   # typed errors surface bare
+                self._settle()
+                raise
+            except (exc.ActorDiedError, exc.ActorUnavailableError,
+                    exc.WorkerCrashedError) as e:
+                if self._recover is None:
+                    self._settle()
+                    raise
+                try:
+                    self._ref = self._recover(e)
+                except Exception:
+                    self._settle()
+                    raise
+            except Exception:
+                self._settle()
+                raise
 
     def _settle(self):
         if not self._done:
@@ -73,18 +156,34 @@ class DeploymentResponse:
 class DeploymentResponseGenerator:
     """Iterator over a streaming deployment call's items (reference:
     handle.py DeploymentResponseGenerator). Yields VALUES; works as a sync
-    iterator from driver threads and an async iterator on the core loop."""
+    iterator from driver threads and an async iterator on the core loop.
 
-    def __init__(self, ref_gen=None, on_done=None, setup_coro=None):
+    Failover: before the FIRST item, a died/draining replica re-routes
+    the stream (replay-gated like unary calls). After items were
+    delivered, replaying would duplicate them — the stream fails with a
+    typed ReplicaDiedError instead."""
+
+    def __init__(self, ref_gen=None, on_done=None, setup_coro=None,
+                 recover=None, deployment: str = ""):
         self._gen = ref_gen
         self._on_done = on_done or (lambda: None)
         self._setup_coro = setup_coro  # async context: routing is deferred
+        self._recover = recover        # sync re-dispatch (pre-first-item)
+        self._deployment = deployment
+        self._items = 0
         self._done = False
 
     def _settle(self):
         if not self._done:
             self._done = True
             self._on_done()
+
+    def _release_once(self):
+        """Release the current replica slot exactly once before a replay
+        re-setup: a later _settle()/__del__ must not double-decrement the
+        router's in-flight count if the re-setup raises."""
+        cb, self._on_done = self._on_done, (lambda: None)
+        cb()
 
     def __iter__(self):
         return self
@@ -93,26 +192,88 @@ class DeploymentResponseGenerator:
         if self._gen is None:
             raise RuntimeError("streaming call was made in async context; "
                                "iterate with `async for`")
-        try:
-            ref = next(self._gen)
-        except StopIteration:
-            self._settle()
-            raise
-        return ray_tpu.get(ref)
+        from ray_tpu import exceptions as exc
+        while True:
+            try:
+                try:
+                    ref = next(self._gen)
+                except StopIteration:
+                    self._settle()
+                    raise
+                value = ray_tpu.get(ref)
+                self._items += 1
+                return value
+            except exc.TaskError as e:
+                cause = unwrap(e)
+                if isinstance(cause, ReplicaDrainingError) \
+                        and self._items == 0 and self._recover is not None:
+                    self._gen = self._recover(cause)
+                    continue
+                if isinstance(cause, ServeError):
+                    self._settle()
+                    raise cause from None
+                self._settle()
+                raise
+            except (exc.ActorDiedError, exc.ActorUnavailableError,
+                    exc.WorkerCrashedError) as e:
+                if self._items == 0 and self._recover is not None:
+                    try:
+                        self._gen = self._recover(e)
+                        continue
+                    except Exception:
+                        self._settle()
+                        raise
+                self._settle()
+                raise ReplicaDiedError(
+                    self._deployment,
+                    reason=f"died mid-stream after {self._items} item(s)",
+                ) from e
 
     def __aiter__(self):
         return self
 
     async def __anext__(self):
+        from ray_tpu import exceptions as exc
         if self._gen is None:
             # First iteration in async context: run the deferred routing.
-            self._gen, self._on_done = await self._setup_coro
+            self._gen, self._on_done = await self._setup_coro(None)
         try:
             ref = await self._gen.__anext__()
+            value = await ref
+            self._items += 1
+            return value
         except StopAsyncIteration:
             self._settle()
             raise
-        return await ref
+        except exc.TaskError as e:
+            cause = unwrap(e)
+            if isinstance(cause, ReplicaDrainingError) and self._items == 0 \
+                    and self._setup_coro is not None:
+                self._release_once()
+                self._gen, self._on_done = await self._setup_coro(cause)
+                return await self.__anext__()
+            if isinstance(cause, ServeError):
+                self._settle()
+                raise cause from None
+            self._settle()
+            raise
+        except (exc.ActorDiedError, exc.ActorUnavailableError,
+                exc.WorkerCrashedError) as e:
+            if self._items == 0 and self._setup_coro is not None:
+                self._release_once()
+                try:
+                    # Replay-gated inside the setup: non-replayable
+                    # deployments get the typed ReplicaDiedError here.
+                    self._gen, self._on_done = await self._setup_coro(e)
+                except Exception:
+                    self._settle()
+                    raise
+                return await self.__anext__()
+            self._settle()
+            raise ReplicaDiedError(
+                self._deployment,
+                reason=f"died mid-stream after {self._items} item(s)",
+            ) from e
 
     def __del__(self):
         try:
@@ -122,26 +283,42 @@ class DeploymentResponseGenerator:
 
 
 class Router:
-    """Client-side replica picker with periodic replica-list refresh."""
+    """Client-side replica picker with periodic replica-list refresh.
+
+    Replicas are keyed by the controller-issued replica id; in-flight
+    counts survive list refreshes for replicas that stay in the set."""
 
     REFRESH_S = 1.0
 
     def __init__(self, deployment_name: str, app_name: str):
         self._dep = deployment_name
         self._app = app_name
-        self._replicas: List[Any] = []
+        self._replicas: List[Tuple[str, Any]] = []   # [(replica_id, handle)]
         self._version = -1
-        self._inflight: Dict[int, int] = {}
+        self._inflight: Dict[str, int] = {}
+        self._meta: Dict[str, Any] = {}
         self._last_refresh = 0.0
         self._lock = threading.Lock()
 
-    def _apply(self, now, version, replicas):
+    @property
+    def meta(self) -> Dict[str, Any]:
+        return self._meta
+
+    @property
+    def replayable(self) -> bool:
+        return bool(self._meta.get("request_replay"))
+
+    def _apply(self, now, routing: dict):
         with self._lock:
             self._last_refresh = now
+            self._meta = routing.get("config") or self._meta
+            version = routing.get("version", 0)
             if version != self._version:
                 self._version = version
-                self._replicas = replicas
-                self._inflight = {i: 0 for i in range(len(replicas))}
+                self._replicas = list(routing.get("replicas") or [])
+                old = self._inflight
+                self._inflight = {rid: old.get(rid, 0)
+                                  for rid, _ in self._replicas}
 
     def _refresh(self, force: bool = False):
         now = time.monotonic()
@@ -149,9 +326,9 @@ class Router:
             return
         from ray_tpu.serve.api import _get_controller
         ctrl = _get_controller()
-        version, replicas = ray_tpu.get(
-            ctrl.get_replicas.remote(self._app, self._dep), timeout=30)
-        self._apply(now, version, replicas)
+        routing = ray_tpu.get(
+            ctrl.get_routing.remote(self._app, self._dep), timeout=30)
+        self._apply(now, routing)
 
     async def refresh_async(self, force: bool = False):
         now = time.monotonic()
@@ -159,9 +336,8 @@ class Router:
             return
         from ray_tpu.serve.api import _get_controller_async
         ctrl = await _get_controller_async()
-        version, replicas = await ctrl.get_replicas.remote(
-            self._app, self._dep)
-        self._apply(now, version, replicas)
+        routing = await ctrl.get_routing.remote(self._app, self._dep)
+        self._apply(now, routing)
 
     def pick_cached(self):
         """Power of two choices on local in-flight counts (no refresh)."""
@@ -174,19 +350,20 @@ class Router:
                 i = 0
             else:
                 a, b = random.sample(range(n), 2)
-                i = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) \
-                    else b
-            self._inflight[i] = self._inflight.get(i, 0) + 1
-            return i, self._replicas[i]
+                i = a if self._inflight.get(self._replicas[a][0], 0) <= \
+                    self._inflight.get(self._replicas[b][0], 0) else b
+            rid, handle = self._replicas[i]
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+            return rid, handle
 
     def pick(self):
         self._refresh()
         return self.pick_cached()
 
-    def release(self, i: int):
+    def release(self, rid: str):
         with self._lock:
-            if i in self._inflight and self._inflight[i] > 0:
-                self._inflight[i] -= 1
+            if rid in self._inflight and self._inflight[rid] > 0:
+                self._inflight[rid] -= 1
 
     def drop_replicas(self):
         with self._lock:
@@ -197,23 +374,27 @@ class Router:
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
                  method_name: str = "__call__",
-                 multiplexed_model_id: str = "", stream: bool = False):
+                 multiplexed_model_id: str = "", stream: bool = False,
+                 timeout_s: Optional[float] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method = method_name
         self._mux_id = multiplexed_model_id
         self._stream = stream
+        self._timeout_s = timeout_s
         self._router: Optional[Router] = None
 
     def options(self, *, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                timeout_s: Optional[float] = None) -> "DeploymentHandle":
         h = DeploymentHandle(
             self.deployment_name, self.app_name,
             method_name or self._method,
             multiplexed_model_id if multiplexed_model_id is not None
             else self._mux_id,
-            self._stream if stream is None else stream)
+            self._stream if stream is None else stream,
+            self._timeout_s if timeout_s is None else timeout_s)
         h._router = self._router
         return h
 
@@ -222,6 +403,63 @@ class DeploymentHandle:
             self._router = Router(self.deployment_name, self.app_name)
         return self._router
 
+    # ------------------------------------------------------------------
+    # Request construction + replay gating
+    # ------------------------------------------------------------------
+    def _make_request(self, args, kwargs) -> _PendingRequest:
+        deadline = time.time() + self._timeout_s if self._timeout_s else 0.0
+        return _PendingRequest(self._method, self._mux_id, args, kwargs,
+                               deadline_ts=deadline)
+
+    def _fill_deadline(self, req: _PendingRequest, router: Router):
+        """Apply the deployment's default request_timeout_s (known only
+        after the first routing refresh) when no per-call timeout set."""
+        if req.deadline_ts:
+            return
+        default = router.meta.get("request_timeout_s")
+        if default:
+            req.deadline_ts = time.time() + float(default)
+
+    def _gate_replay(self, router: Router, req: _PendingRequest, err):
+        """Decide whether a failed dispatch may re-route. Raises the
+        caller-facing typed error when it may not."""
+        if req.deadline_ts and time.time() >= req.deadline_ts:
+            raise RequestTimeoutError(self.deployment_name,
+                                      where="router") from err
+        if req.attempts >= _MAX_ATTEMPTS:
+            raise ReplicaDiedError(
+                self.deployment_name,
+                reason=f"gave up after {req.attempts} attempts: {err!r}",
+            ) from err
+        if isinstance(err, ReplicaDrainingError):
+            return  # handed back before execution: always replay-safe
+        if not router.replayable:
+            raise ReplicaDiedError(self.deployment_name,
+                                   reason=repr(err)) from err
+
+    @staticmethod
+    def _remaining(req: _PendingRequest) -> float:
+        """Time budget left, shipped to the replica INSTEAD of the
+        absolute deadline: the replica re-anchors it on its own clock,
+        so cross-host clock skew cannot corrupt deadline semantics."""
+        if not req.deadline_ts:
+            return 0.0
+        return max(0.001, req.deadline_ts - time.time())
+
+    def _submit(self, replica, req: _PendingRequest):
+        return replica.handle_request.remote(
+            req.method, req.mux_id, req.args, req.kwargs,
+            req.request_id, self._remaining(req))
+
+    def _submit_stream(self, replica, req: _PendingRequest):
+        return replica.handle_request_streaming.options(
+            num_returns="streaming").remote(
+                req.method, req.mux_id, req.args, req.kwargs,
+                req.request_id, self._remaining(req))
+
+    # ------------------------------------------------------------------
+    # Sync (driver-thread) path
+    # ------------------------------------------------------------------
     def remote(self, *args, **kwargs):
         import asyncio
         try:
@@ -229,100 +467,171 @@ class DeploymentHandle:
             in_async = True
         except RuntimeError:
             in_async = False
+        req = self._make_request(args, kwargs)
         if in_async:
             # Replica/proxy context: routing must not block the loop.
             if self._stream:
                 return DeploymentResponseGenerator(
-                    setup_coro=self._stream_setup_async(args, kwargs))
-            return DeploymentResponse(
-                coro=self._call_async(args, kwargs))
+                    setup_coro=lambda err: self._stream_setup_async(req, err),
+                    deployment=self.deployment_name)
+            return DeploymentResponse(coro=self._call_async(req))
         router = self._get_router()
-        last_err = None
-        for attempt in range(5):
-            try:
-                i, replica = router.pick()
-            except RuntimeError as e:
-                # Momentarily empty replica set (rolling update / health
-                # replacement): force-refresh and retry.
-                last_err = e
-                router.drop_replicas()
-                time.sleep(0.2 * (attempt + 1))
-                continue
-            try:
-                if self._stream:
-                    gen = replica.handle_request_streaming.options(
-                        num_returns="streaming").remote(
-                            self._method, self._mux_id, args, kwargs)
-                    return DeploymentResponseGenerator(
-                        gen, on_done=lambda i=i: router.release(i))
-                ref = replica.handle_request.remote(
-                    self._method, self._mux_id, args, kwargs)
-                return DeploymentResponse(ref,
-                                          on_done=lambda i=i: router.release(i))
-            except Exception as e:
-                router.release(i)
-                router.drop_replicas()  # replica may be dead: force refresh
-                last_err = e
-        raise last_err
+        state = {"rid": None}
 
-    async def _stream_setup_async(self, args, kwargs):
+        def release():
+            rid, state["rid"] = state["rid"], None
+            if rid is not None:
+                router.release(rid)
+
+        submit = self._submit_stream if self._stream else self._submit
+
+        def dispatch():
+            last_err = None
+            for attempt in range(5):
+                if req.deadline_ts and time.time() >= req.deadline_ts:
+                    raise RequestTimeoutError(self.deployment_name,
+                                              where="router")
+                try:
+                    rid, replica = router.pick()
+                except RuntimeError as e:
+                    # Momentarily empty replica set (rolling update /
+                    # health replacement): force-refresh and retry.
+                    last_err = e
+                    router.drop_replicas()
+                    time.sleep(0.2 * (attempt + 1))
+                    continue
+                # pick() refreshed routing: the deployment's default
+                # request_timeout_s is known — stamp the deadline BEFORE
+                # the payload ships.
+                self._fill_deadline(req, router)
+                try:
+                    out = submit(replica, req)
+                    state["rid"] = rid
+                    return out
+                except Exception as e:
+                    router.release(rid)
+                    router.drop_replicas()  # replica may be dead: refresh
+                    last_err = e
+            raise last_err
+
+        def recover(err):
+            release()
+            req.attempts += 1
+            self._gate_replay(router, req, err)
+            _count_replay(self.deployment_name)
+            router.drop_replicas()
+            # Backoff: the controller needs a health-check round to drop
+            # a dead replica from the routable set — instant re-dispatch
+            # could burn every attempt on the same corpse.
+            if not isinstance(err, ReplicaDrainingError):
+                time.sleep(min(0.25 * req.attempts, 1.0))
+            return dispatch()
+
+        first = dispatch()
+        if self._stream:
+            return DeploymentResponseGenerator(
+                first, on_done=release, recover=recover,
+                deployment=self.deployment_name)
+        return DeploymentResponse(first, on_done=release, recover=recover)
+
+    # ------------------------------------------------------------------
+    # Async (core-loop) paths
+    # ------------------------------------------------------------------
+    async def _stream_setup_async(self, req: _PendingRequest, err=None):
         """Deferred routing for a streaming call made on the core loop:
-        returns (ObjectRefGenerator, release_fn)."""
+        returns (ObjectRefGenerator, release_fn). Re-invoked by the
+        generator for pre-first-item failover with the triggering error —
+        each re-invocation is gated on the replay rules."""
         import asyncio
         router = self._get_router()
+        if err is not None:
+            req.attempts += 1
+            self._gate_replay(router, req, err)
+            _count_replay(self.deployment_name)
+            router.drop_replicas()
+            if not isinstance(err, ReplicaDrainingError):
+                # Let the controller's health check drop the dead replica.
+                await asyncio.sleep(min(0.25 * req.attempts, 1.0))
         last_err = None
         for attempt in range(5):
-            await router.refresh_async(force=attempt > 0)
+            await router.refresh_async(force=attempt > 0 or err is not None)
+            self._fill_deadline(req, router)
+            if req.deadline_ts and time.time() >= req.deadline_ts:
+                raise RequestTimeoutError(self.deployment_name,
+                                          where="router")
             try:
-                i, replica = router.pick_cached()
+                rid, replica = router.pick_cached()
             except RuntimeError as e:
                 last_err = e
                 router.drop_replicas()
                 await asyncio.sleep(0.2 * (attempt + 1))
                 continue
             try:
-                gen = replica.handle_request_streaming.options(
-                    num_returns="streaming").remote(
-                        self._method, self._mux_id, args, kwargs)
-                return gen, (lambda i=i: router.release(i))
+                gen = self._submit_stream(replica, req)
+                return gen, (lambda rid=rid: router.release(rid))
             except Exception as e:  # noqa: BLE001
-                router.release(i)
+                router.release(rid)
                 router.drop_replicas()
                 last_err = e
         raise last_err
 
-    async def _call_async(self, args, kwargs):
+    async def _call_async(self, req: _PendingRequest):
         import asyncio
         from ray_tpu import exceptions as exc
         router = self._get_router()
         last_err = None
-        for attempt in range(5):
-            await router.refresh_async(force=attempt > 0)
+        while True:
+            if req.attempts >= _MAX_ATTEMPTS:
+                raise ReplicaDiedError(
+                    self.deployment_name,
+                    reason=f"gave up after {req.attempts} attempts",
+                ) from last_err
+            req.attempts += 1
+            await router.refresh_async(force=last_err is not None)
+            self._fill_deadline(req, router)
+            if req.deadline_ts and time.time() >= req.deadline_ts:
+                raise RequestTimeoutError(self.deployment_name,
+                                          where="router") from last_err
             try:
-                i, replica = router.pick_cached()
+                rid, replica = router.pick_cached()
             except RuntimeError as e:
                 last_err = e
                 router.drop_replicas()
-                await asyncio.sleep(0.2 * (attempt + 1))
+                await asyncio.sleep(min(0.2 * req.attempts, 1.0))
                 continue
             try:
-                ref = replica.handle_request.remote(
-                    self._method, self._mux_id, args, kwargs)
+                ref = self._submit(replica, req)
             except Exception as e:
-                router.release(i)
+                router.release(rid)
                 router.drop_replicas()
                 last_err = e
                 continue
             try:
                 return await ref
-            except exc.ActorDiedError as e:
-                # Dead replica: refresh the set and retry. Application
-                # exceptions propagate to the caller unchanged.
+            except exc.TaskError as e:
+                cause = unwrap(e)
+                if isinstance(cause, ReplicaDrainingError):
+                    # Handed back before execution: re-route, always.
+                    router.drop_replicas()
+                    _count_replay(self.deployment_name)
+                    last_err = cause
+                    continue
+                if isinstance(cause, ServeError):
+                    raise cause from None
+                raise    # application exceptions propagate unchanged
+            except (exc.ActorDiedError, exc.ActorUnavailableError,
+                    exc.WorkerCrashedError) as e:
                 router.drop_replicas()
+                if not router.replayable:
+                    raise ReplicaDiedError(self.deployment_name,
+                                           reason=repr(e)) from e
+                _count_replay(self.deployment_name)
                 last_err = e
+                # Backoff past the controller's health-check round so
+                # retries don't all land on the not-yet-dropped corpse.
+                await asyncio.sleep(min(0.25 * req.attempts, 1.0))
             finally:
-                router.release(i)
-        raise last_err
+                router.release(rid)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -332,4 +641,4 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self._method,
-                 self._mux_id, self._stream))
+                 self._mux_id, self._stream, self._timeout_s))

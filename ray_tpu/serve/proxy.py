@@ -36,8 +36,29 @@ class Request:
         return {k: v[0] for k, v in self.query.items()}
 
 
+def _error_response(e: BaseException):
+    """Map a request-path error to (status, body, content_type): typed
+    serve errors carry their HTTP status (503 shed / replica died, 504
+    deadline) and a JSON body with the gRPC-style code; anything else is
+    a plain 500."""
+    from ray_tpu.serve.exceptions import ServeError, unwrap
+    err = unwrap(e)
+    if isinstance(err, ServeError):
+        body = json.dumps({
+            "error": type(err).__name__,
+            "code": err.code,
+            "message": str(err),
+        }).encode()
+        return err.http_status, body, "application/json"
+    return 500, repr(e).encode(), "text/plain"
+
+
 class ProxyActor:
     ROUTE_REFRESH_S = 1.0
+
+    # /-/healthz stays ready as long as the controller answered a route
+    # refresh this recently; past it, readiness requires a live probe.
+    HEALTHZ_GRACE_S = 10.0
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self._host = host
@@ -47,6 +68,7 @@ class ProxyActor:
         self._handles: Dict[tuple, Any] = {}
         self._streaming: Dict[tuple, bool] = {}  # ingress -> generator?
         self._last_refresh = 0.0
+        self._ctrl_ok_ts = 0.0      # last successful controller round trip
         self._num_requests = 0
         self._ws_queues: Dict[str, asyncio.Queue] = {}
 
@@ -61,9 +83,13 @@ class ProxyActor:
         if now - self._last_refresh < self.ROUTE_REFRESH_S:
             return
         self._last_refresh = now
-        from ray_tpu.serve.api import _get_controller_async
-        ctrl = await _get_controller_async()
-        routes = await ctrl.get_route_table.remote()
+        try:
+            from ray_tpu.serve.api import _get_controller_async
+            ctrl = await _get_controller_async()
+            routes = await ctrl.get_route_table.remote()
+        except Exception:  # noqa: BLE001 — serve with stale routes;
+            return         # /-/healthz flips after HEALTHZ_GRACE_S
+        self._ctrl_ok_ts = time.monotonic()
         if routes != self._routes:
             # Redeploys may switch a handler generator <-> plain: re-probe.
             self._streaming.clear()
@@ -108,9 +134,25 @@ class ProxyActor:
                     {k: v[0] for k, v in self._routes.items()}).encode())
                 return
             if path == "/-/healthz":
-                await self._respond(writer, 200, b"success")
+                # Readiness = the control plane is reachable. Rolling
+                # updates keep this green: replicas swap replace-then-
+                # drain, the controller never goes away.
+                if time.monotonic() - self._ctrl_ok_ts \
+                        < self.HEALTHZ_GRACE_S:
+                    await self._respond(writer, 200, b"success")
+                else:
+                    await self._respond(
+                        writer, 503, b"unhealthy: controller unreachable")
                 return
             match = self._match_route(path)
+            if match is None:
+                # A just-deployed route may not be in this proxy's table
+                # yet (refresh window, or another request's refresh still
+                # in flight holding the timestamp): force one refresh and
+                # re-check before 404ing.
+                self._last_refresh = 0.0
+                await self._refresh_routes()
+                match = self._match_route(path)
             if match is None:
                 await self._respond(writer, 404,
                                     f"no route for {path}".encode())
@@ -145,13 +187,15 @@ class ProxyActor:
                     gen = handle.options(stream=True).remote(req)
                     await self._send_stream(writer, gen)
                 except Exception as e:
-                    await self._respond(writer, 500, repr(e).encode())
+                    code, body, ctype = _error_response(e)
+                    await self._respond(writer, code, body, ctype=ctype)
                 return
             try:
                 resp = handle.remote(req)
                 result = await resp
             except Exception as e:
-                await self._respond(writer, 500, repr(e).encode())
+                code, body, ctype = _error_response(e)
+                await self._respond(writer, code, body, ctype=ctype)
                 return
             await self._send_result(writer, result)
         except Exception:
@@ -257,10 +301,27 @@ class ProxyActor:
             await writer.drain()
         except (ConnectionError, OSError):
             pass
-        except Exception:
-            # handler failed: close with 1011 (internal error)
+        except Exception as e:
+            # Typed close codes: 1012 Service Restart when the replica
+            # died / is draining (client should reconnect), 1013 Try
+            # Again Later on backpressure, 1011 otherwise.
+            from ray_tpu import exceptions as exc
+            from ray_tpu.serve.exceptions import (BackPressureError,
+                                                  ReplicaDiedError,
+                                                  ReplicaDrainingError,
+                                                  unwrap)
+            err = unwrap(e)
+            if isinstance(err, (ReplicaDiedError, ReplicaDrainingError,
+                                exc.ActorDiedError, exc.ActorUnavailableError,
+                                exc.WorkerCrashedError)):
+                code = 1012
+            elif isinstance(err, BackPressureError):
+                code = 1013
+            else:
+                code = 1011
             try:
-                writer.write(ws.encode_frame(ws.OP_CLOSE, b"\x03\xf3"))
+                writer.write(ws.encode_frame(
+                    ws.OP_CLOSE, code.to_bytes(2, "big")))
                 await writer.drain()
             except Exception:
                 pass
@@ -361,7 +422,9 @@ class ProxyActor:
     async def _respond(self, writer, code: int, body: bytes,
                        ctype: str = "text/plain"):
         status = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  500: "Internal Server Error"}.get(code, "OK")
+                  500: "Internal Server Error",
+                  503: "Service Unavailable",
+                  504: "Gateway Timeout"}.get(code, "OK")
         writer.write(
             f"HTTP/1.1 {code} {status}\r\n"
             f"Content-Type: {ctype}\r\n"

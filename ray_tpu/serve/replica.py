@@ -3,17 +3,47 @@
 Reference parity: python/ray/serve/_private/replica.py (ReplicaActor :233,
 UserCallableWrapper :715). Async ray_tpu actor with high max_concurrency;
 tracks ongoing requests for the power-of-two router and autoscaler.
+
+Request lifecycle hardening (serve-under-fire):
+
+- **Admission control**: at most `max_ongoing` requests execute; up to
+  `max_queued` more wait on the replica. Past that the request is shed
+  immediately (drop-newest) with a typed BackPressureError — an
+  overloaded deployment degrades to 503s instead of queueing unboundedly.
+- **Deadlines**: the handle propagates the request's REMAINING time
+  budget (converted to a local deadline on arrival — clock-skew-free
+  across hosts); a request that is already late fails without
+  executing, and an in-flight async handler is CANCELLED at the
+  deadline so it stops burning TPU time.
+- **Draining**: once `drain()` is called the replica stops admitting new
+  work and hands every still-queued request back to the router with
+  ReplicaDrainingError (queued work never started — replay-safe), then
+  waits out in-flight requests within the graceful timeout.
+- **Replay dedupe**: completed results are cached by request id so a
+  replayed request (router re-route after a lost reply) returns the
+  original result instead of executing twice — the replica-side half of
+  exactly-once for `request_replay=True` deployments.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import contextvars
 import inspect
+import time
 from typing import Any, Dict, Optional
+
+from ray_tpu.serve.exceptions import (BackPressureError, ReplicaDrainingError,
+                                      RequestTimeoutError)
 
 _request_context: contextvars.ContextVar = contextvars.ContextVar(
     "serve_request_context", default=None)
+
+# Completed-result cache bound: old entries fall off FIFO. Sized so a
+# burst of replays during one failover window always hits, without
+# pinning unbounded result memory on a long-lived replica.
+_DEDUPE_CAP = 2048
 
 
 class RequestContext:
@@ -25,8 +55,27 @@ def get_request_context() -> Optional[RequestContext]:
     return _request_context.get()
 
 
+def _shed_counter():
+    from ray_tpu.util import metrics
+    return metrics.Counter(
+        "ray_tpu_serve_shed_total",
+        "serve requests dropped (drop-newest) by replica admission "
+        "control: queue at max_queued_requests",
+        tag_keys=("Deployment",))
+
+
+def _timeout_counter():
+    from ray_tpu.util import metrics
+    return metrics.Counter(
+        "ray_tpu_serve_timeouts_total",
+        "serve requests that exceeded their end-to-end deadline "
+        "(failed fast or cancelled on the replica)",
+        tag_keys=("Deployment",))
+
+
 class ReplicaActor:
-    def __init__(self, blob: bytes, user_config: Any = None):
+    def __init__(self, blob: bytes, user_config: Any = None,
+                 limits: Optional[dict] = None):
         import cloudpickle
         spec = cloudpickle.loads(blob)
         func_or_class = spec["func_or_class"]
@@ -50,10 +99,30 @@ class ReplicaActor:
         else:
             self._callable = func_or_class
             self._is_function = True
-        self._ongoing = 0
-        self._total = 0
+        self._init_limits(limits)
         if user_config is not None:
             self._apply_user_config(user_config)
+
+    def _init_limits(self, limits: Optional[dict] = None):
+        """Runtime request-path state (split out so unit tests can build
+        a bare replica around an in-process callable)."""
+        limits = limits or {}
+        self._deployment = limits.get("deployment", "")
+        self._max_ongoing = int(limits.get("max_ongoing", 100))
+        self._max_queued = int(limits.get("max_queued", -1))
+        # Result caching is the replica-side half of request replay; a
+        # deployment that never replays (router fails fast instead) must
+        # not pin dead results in memory.
+        self._replay = bool(limits.get("request_replay", False))
+        self._ongoing = 0
+        self._queued = 0
+        self._total = 0
+        self._shed = 0
+        self._timeouts = 0
+        self._draining = False
+        # Pulsed when a slot frees or drain flips: queued admits re-check.
+        self._slot_event = asyncio.Event()
+        self._dedupe: "collections.OrderedDict" = collections.OrderedDict()
 
     def _apply_user_config(self, user_config):
         recon = getattr(self._callable, "reconfigure", None)
@@ -69,20 +138,119 @@ class ReplicaActor:
         self._apply_user_config(user_config)
         return True
 
+    # ------------------------------------------------------------------
+    # Admission control + deadlines
+    # ------------------------------------------------------------------
+    def _count_shed(self):
+        self._shed += 1
+        try:
+            _shed_counter().inc(tags={"Deployment": self._deployment})
+        except Exception:  # noqa: BLE001 — metrics must not fail requests
+            pass
+
+    def _count_timeout(self):
+        self._timeouts += 1
+        try:
+            _timeout_counter().inc(tags={"Deployment": self._deployment})
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _gate(self, deadline_ts: float):
+        """Fail-fast checks before a request may queue/execute."""
+        if self._draining:
+            raise ReplicaDrainingError(self._deployment)
+        if deadline_ts and time.time() >= deadline_ts:
+            self._count_timeout()
+            raise RequestTimeoutError(self._deployment, where="replica")
+
+    async def _admit(self, deadline_ts: float):
+        """Wait for an execution slot (reserved on return — the sync
+        slot-claim after wakeup means two queued waiters can't both take
+        the last slot); queued requests are bounded by max_queued (shed
+        past it) and are handed BACK to the router the instant the
+        replica starts draining — they never began executing, so
+        re-routing them elsewhere is always safe."""
+        self._gate(deadline_ts)
+        if self._ongoing < self._max_ongoing:
+            self._ongoing += 1
+            return
+        if 0 <= self._max_queued <= self._queued:
+            self._count_shed()
+            raise BackPressureError(self._deployment, self._queued,
+                                    self._max_queued)
+        self._queued += 1
+        try:
+            while self._ongoing >= self._max_ongoing:
+                self._gate(deadline_ts)
+                timeout = None
+                if deadline_ts:
+                    timeout = max(0.0, deadline_ts - time.time())
+                self._slot_event.clear()
+                try:
+                    if timeout is None:
+                        await self._slot_event.wait()
+                    else:
+                        await asyncio.wait_for(
+                            self._slot_event.wait(), timeout + 0.001)
+                except asyncio.TimeoutError:
+                    pass
+            self._gate(deadline_ts)
+            self._ongoing += 1
+        finally:
+            self._queued -= 1
+
+    def _release_slot(self):
+        self._ongoing -= 1
+        self._slot_event.set()
+
+    async def _run_with_deadline(self, coro, deadline_ts: float):
+        if not deadline_ts:
+            return await coro
+        remaining = deadline_ts - time.time()
+        if remaining <= 0:
+            coro.close()
+            self._count_timeout()
+            raise RequestTimeoutError(self._deployment, where="replica")
+        try:
+            return await asyncio.wait_for(coro, remaining)
+        except asyncio.TimeoutError:
+            self._count_timeout()
+            raise RequestTimeoutError(
+                self._deployment, timeout_s=remaining,
+                where="replica (handler cancelled)") from None
+
+    # ------------------------------------------------------------------
+    # Request handling
+    # ------------------------------------------------------------------
     async def handle_request(self, method_name: str, mux_model_id: str,
-                             args: tuple, kwargs: dict):
-        self._ongoing += 1
+                             args: tuple, kwargs: dict,
+                             request_id: str = "",
+                             timeout_s: float = 0.0):
+        # The handle ships the REMAINING time budget, not an absolute
+        # timestamp: converting to a local deadline here keeps the
+        # semantics clock-skew-free across hosts (transit time is noise
+        # next to ordinary NTP drift).
+        deadline_ts = time.time() + timeout_s if timeout_s else 0.0
+        if self._replay and request_id and request_id in self._dedupe:
+            # Replayed request whose original completed here: return the
+            # cached result instead of executing twice (exactly-once).
+            return self._dedupe[request_id]
+        await self._admit(deadline_ts)
         self._total += 1
         token = _request_context.set(RequestContext(mux_model_id))
         try:
             target = self._target_for(method_name)
             result = target(*args, **kwargs)
             if inspect.iscoroutine(result):
-                result = await result
+                result = await self._run_with_deadline(result, deadline_ts)
+            if self._replay and request_id:
+                self._dedupe[request_id] = result
+                while len(self._dedupe) > _DEDUPE_CAP:
+                    self._dedupe.popitem(last=False)
             return result
         finally:
             _request_context.reset(token)
-            self._ongoing -= 1
+            self._release_slot()
 
     def _target_for(self, method_name: str):
         if self._is_function or method_name in ("__call__", ""):
@@ -101,20 +269,30 @@ class ReplicaActor:
 
     async def handle_request_streaming(self, method_name: str,
                                        mux_model_id: str, args: tuple,
-                                       kwargs: dict):
+                                       kwargs: dict,
+                                       request_id: str = "",
+                                       timeout_s: float = 0.0):
         """Streamed variant of handle_request: iterates the handler's
         generator, yielding each item as one stream element (delivered to
-        the caller as a streaming-generator actor call)."""
-        self._ongoing += 1
+        the caller as a streaming-generator actor call). Shares the
+        admission gate with the unary path; deadlines bound the wait for
+        EACH item, cancelling a stalled async generator on the replica."""
+        deadline_ts = time.time() + timeout_s if timeout_s else 0.0
+        await self._admit(deadline_ts)
         self._total += 1
         token = _request_context.set(RequestContext(mux_model_id))
         try:
             target = self._target_for(method_name)
             result = target(*args, **kwargs)
             if inspect.iscoroutine(result):
-                result = await result
+                result = await self._run_with_deadline(result, deadline_ts)
             if inspect.isasyncgen(result):
-                async for item in result:
+                while True:
+                    try:
+                        item = await self._run_with_deadline(
+                            result.__anext__(), deadline_ts)
+                    except StopAsyncIteration:
+                        break
                     yield item
             elif inspect.isgenerator(result):
                 # Pull sync generators on the executor so a handler that
@@ -134,6 +312,10 @@ class ReplicaActor:
                         return False, None
 
                 while True:
+                    if deadline_ts and time.time() >= deadline_ts:
+                        self._count_timeout()
+                        raise RequestTimeoutError(
+                            self._deployment, where="replica (stream)")
                     ok, item = await loop.run_in_executor(
                         None, lambda: ctx.run(_next))
                     if not ok:
@@ -143,10 +325,13 @@ class ReplicaActor:
                 yield result
         finally:
             _request_context.reset(token)
-            self._ongoing -= 1
+            self._release_slot()
 
     def get_metrics(self) -> Dict[str, float]:
-        return {"ongoing": self._ongoing, "total": self._total}
+        return {"ongoing": self._ongoing, "queued": self._queued,
+                "total": self._total, "shed": self._shed,
+                "timeouts": self._timeouts,
+                "draining": float(self._draining)}
 
     async def check_health(self) -> bool:
         user_check = getattr(self._callable, "check_health", None)
@@ -157,11 +342,25 @@ class ReplicaActor:
             return bool(res) if res is not None else True
         return True
 
-    async def drain(self, timeout_s: float = 5.0) -> bool:
-        """Graceful shutdown: wait for in-flight requests to finish."""
-        deadline = asyncio.get_event_loop().time() + timeout_s
-        while self._ongoing > 0:
-            if asyncio.get_event_loop().time() > deadline:
-                return False
+    async def drain(self, timeout_s: float = 5.0,
+                    linger_s: float = 0.0) -> bool:
+        """Graceful shutdown: stop admitting, hand queued requests back
+        to the router (ReplicaDrainingError — they re-route), wait for
+        in-flight requests to finish within the timeout.
+
+        linger_s keeps the (idle) replica alive PAST the last in-flight
+        request: routers cache the routable set for up to REFRESH_S, so
+        a request routed just before the set changed can still land here
+        — during the linger it bounces with ReplicaDrainingError and
+        re-routes; killing immediately would turn it into an
+        ActorDiedError a non-replayable deployment cannot recover."""
+        self._draining = True
+        self._slot_event.set()  # wake queued admits so they bounce now
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout_s
+        settle = loop.time() + linger_s
+        while self._ongoing > 0 or loop.time() < settle:
+            if loop.time() > deadline:
+                return self._ongoing == 0
             await asyncio.sleep(0.02)
         return True

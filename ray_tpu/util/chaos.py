@@ -78,6 +78,35 @@ class WorkerKiller(_KillerBase):
             pass
 
 
+class ReplicaKiller(_KillerBase):
+    """SIGKILLs a random ACTOR-hosting worker process — the serve-shaped
+    variant of WorkerKiller: each kill takes out one deployment replica
+    (or another actor) mid-request. The serve layer's queue-preserving
+    failover must absorb it: replayable requests re-route, the
+    controller replaces the replica."""
+
+    def __init__(self, cluster, interval_s: float = 0.5,
+                 max_kills: int = 3, seed: Optional[int] = None):
+        super().__init__(interval_s, max_kills, seed)
+        self.cluster = cluster
+
+    def _kill_one(self):
+        candidates = []
+        for raylet in self.cluster.raylets:
+            for handle in raylet.workers.values():
+                if (handle.pid > 0 and handle.registered
+                        and getattr(handle, "is_actor_worker", False)):
+                    candidates.append(handle.pid)
+        if not candidates:
+            return
+        pid = self._rng.choice(candidates)
+        try:
+            os.kill(pid, signal.SIGKILL)
+            self.kills.append(f"replica:{pid}")
+        except OSError:
+            pass
+
+
 class NodeKiller(_KillerBase):
     """Removes a random non-head raylet (reference: NodeKillerActor
     test_utils.py:1498). Lineage reconstruction and actor failover must

@@ -137,6 +137,63 @@ def main() -> dict:
     except Exception as e:  # noqa: BLE001
         log(f"alloc probe skipped: {type(e).__name__}: {e}")
 
+    # --- serve sustained-QPS smoke (the serve trajectory row) ---
+    # 4 driver threads fire sync handle requests at a 2-replica echo
+    # deployment for ~3s: QPS + p99 latency + requests shed by admission
+    # control. Printed, never asserted (same policy as the other rows).
+    try:
+        import threading
+
+        from ray_tpu import serve
+        from ray_tpu.serve.exceptions import BackPressureError
+
+        @serve.deployment(num_replicas=2, max_ongoing_requests=8,
+                          max_queued_requests=64, request_replay=True)
+        def echo(x):
+            return x
+
+        h = serve.run(echo.bind(), name="bench_serve",
+                      route_prefix="/bench_serve")
+        h.remote(0).result(timeout=60)  # warm router + replicas
+        lat: list = []
+        dropped = [0]
+        lock = threading.Lock()
+        stop_at = time.perf_counter() + 2.0
+
+        def pump():
+            while time.perf_counter() < stop_at:
+                t0 = time.perf_counter()
+                try:
+                    h.remote(1).result(timeout=30)
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lat.append(dt)
+                except BackPressureError:
+                    with lock:
+                        dropped[0] += 1
+                except Exception:  # noqa: BLE001 — smoke keeps pumping
+                    pass
+
+        threads = [threading.Thread(target=pump) for _ in range(4)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        elapsed = time.perf_counter() - t0
+        if lat:
+            lat.sort()
+            out["serve_qps"] = round(len(lat) / elapsed, 1)
+            out["serve_p99_ms"] = round(
+                lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1e3, 2)
+        out["serve_requests_dropped"] = dropped[0]
+        log(f"serve: {out.get('serve_qps', 0):,.0f} req/s, "
+            f"p99 {out.get('serve_p99_ms', 0):.1f} ms, "
+            f"{dropped[0]} shed")
+        serve.shutdown()
+    except Exception as e:  # noqa: BLE001
+        log(f"serve phase skipped: {type(e).__name__}: {e}")
+
     # --- placement group create/remove latency ---
     try:
         from ray_tpu.util.placement_group import (placement_group,

@@ -31,8 +31,11 @@ def test_bench_smoke_completes(jax_cpu):
     row = json.loads(lines[-1])
     assert row.get("smoke") is True
     # Same row names as bench.py so numbers are comparable by eye.
+    # serve_requests_dropped is the serve-trajectory row: its presence
+    # proves the serve request path (deploy, route, admission control)
+    # ran end to end in the smoke.
     for key in ("multi_client_tasks_async", "n_n_actor_calls",
-                "pg_create_ms"):
+                "pg_create_ms", "serve_requests_dropped"):
         assert key in row, (key, row)
     # Hot-path allocation tripwire: a steady-state `.remote()` call must
     # stay a small, bounded number of allocations (measured ~19 blocks

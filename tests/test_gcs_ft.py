@@ -98,6 +98,61 @@ def test_snapshot_written_and_atomic(ray_cluster):
     assert not os.path.exists(path + ".tmp")
 
 
+def test_create_actor_dedupe_on_gcs_redrive(ray_cluster, tmp_path):
+    """Regression (ROADMAP carry-over): a GCS restored from a snapshot
+    taken while an actor's create was STILL RUNNING re-drives
+    _schedule_actor — the raylet must JOIN the in-flight create (keyed
+    by actor_id + restart epoch) instead of instantiating a second copy
+    of the actor (double construction, leaked worker)."""
+    ray_cluster.connect()
+    import ray_tpu
+
+    marker = tmp_path / "constructions"
+    gate = tmp_path / "go"
+
+    @ray_tpu.remote
+    class Slow:
+        def __init__(self, marker_path, gate_path):
+            import os
+            import time as _t
+            with open(marker_path, "a") as f:
+                f.write(f"{os.getpid()}\n")
+                f.flush()
+            while not os.path.exists(gate_path):
+                _t.sleep(0.05)
+
+        def ping(self):
+            return "ok"
+
+    a = Slow.remote(str(marker), str(gate))  # constructor hangs on gate
+    deadline = time.time() + 90
+    while not marker.exists() and time.time() < deadline:
+        time.sleep(0.1)
+    assert marker.exists(), "constructor never started"
+
+    # Force a snapshot NOW (on the cluster loop): under a loaded suite
+    # the periodic persistence tick can lag past the restart below.
+    async def _snap():
+        ray_cluster.gcs.save_snapshot()
+    ray_cluster._run(_snap())
+    ray_cluster.restart_gcs()  # restore re-drives the pending create
+    time.sleep(1.0)           # re-driven create lands on the raylet
+
+    gate.write_text("go")     # release the (single) constructor
+    deadline = time.time() + 60
+    got = None
+    while time.time() < deadline:
+        try:
+            got = ray_tpu.get(a.ping.remote(), timeout=10)
+            break
+        except Exception:
+            time.sleep(0.3)
+    assert got == "ok"
+    # Exactly ONE construction despite the re-driven create.
+    lines = [ln for ln in marker.read_text().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"actor constructed {len(lines)}x: {lines}"
+
+
 def test_pending_creation_rescheduled_after_restore(tmp_path):
     """Regression (found while driving PR 4): a GCS restored from a
     snapshot taken BEFORE an actor's creation completed left the row
