@@ -166,6 +166,53 @@ def phase_durations(rec: Sequence) -> List[Tuple[str, float]]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Serve request phases (the request-scoped twin of the task vocabulary).
+#
+# A serve request crosses three hops — proxy ingress, handle/router, and
+# replica — each of which stamps the subset of phases it owns into one
+# fixed-index record and ships it as a `kind:"serve_request"` event on
+# the same task-event channel (serve/request_trace.py owns the ring +
+# flush). A phase duration is the gap between two consecutive present
+# stamps, reported under the LATER stamp's name, exactly like tasks.
+# ---------------------------------------------------------------------------
+
+REQ_PHASE_ORDER = (
+    "proxy_recv",      # proxy: request fully parsed off the socket
+    "admission",       # replica: request arrived at the admission gate
+    "queue_wait",      # replica: execution slot acquired (gap = queueing)
+    "dispatch",        # proxy/handle: payload handed to handle.remote()
+    "exec_start",      # replica: handler entered
+    "exec_end",        # replica: handler returned
+    "first_item",      # replica: first streamed item yielded
+    "reply",           # hop-local: reply delivered / stream finished
+)
+(RQ_PROXY_RECV, RQ_ADMISSION, RQ_QUEUE_WAIT, RQ_DISPATCH, RQ_EXEC_START,
+ RQ_EXEC_END, RQ_FIRST_ITEM, RQ_REPLY) = range(8)
+REQ_RECORD_LEN = 8
+
+
+def new_request_record() -> list:
+    return [None] * REQ_RECORD_LEN
+
+
+def request_phase_durations(rec: Sequence) -> List[Tuple[str, float]]:
+    """(phase, seconds) pairs for one hop's request record, plus a
+    ("total", first->last) row. Stamp order follows REQ_PHASE_ORDER
+    except `dispatch`, which the proxy stamps BEFORE the replica's
+    phases happen — sort present stamps by time so cross-hop records
+    never produce inverted gaps."""
+    present = [(rec[i], REQ_PHASE_ORDER[i])
+               for i in range(REQ_RECORD_LEN) if rec[i] is not None]
+    present.sort()
+    out: List[Tuple[str, float]] = []
+    for (t0, _n0), (t1, n1) in zip(present, present[1:]):
+        out.append((n1, max(0.0, t1 - t0)))
+    if len(present) >= 2:
+        out.append(("total", max(0.0, present[-1][0] - present[0][0])))
+    return out
+
+
 # Worker-lane sub-slices drawn inside the task slice on the timeline.
 SUB_SLICES = (
     ("args_resolve", PH_RECEIVED, PH_ARGS_READY),
@@ -191,8 +238,13 @@ def build_trace(events: List[dict]) -> List[dict]:
     """
     trace: List[dict] = []
     starts: Dict[str, dict] = {}
+    serve_events = [e for e in events if isinstance(e, dict)
+                    and e.get("kind") == "serve_request"]
+    if serve_events:
+        trace.extend(_build_serve_trace(serve_events, events))
     for e in events:
-        if not isinstance(e, dict) or e.get("kind") == "span":
+        if not isinstance(e, dict) or e.get("kind") in (
+                "span", "serve_request"):
             continue
         state = e.get("state")
         task_id = e.get("task_id")
@@ -267,6 +319,114 @@ def build_trace(events: List[dict]) -> List[dict]:
     return trace
 
 
+def _build_serve_trace(serve_events: List[dict],
+                       all_events: List[dict]) -> List[dict]:
+    """Chrome-trace rows for serve requests: one trace per request id
+    crossing every pid the request touched.
+
+    Per `kind:"serve_request"` event (one per hop — proxy, replica,
+    replay marker) this emits an enclosing hop slice on that process's
+    lane, per-phase sub-slices, and flow arrows proxy -> replica keyed
+    by the request id. Spans whose trace_id belongs to a serve request
+    (the root request span, the replica exec span, and any task/nested
+    spans the handler spawned — they inherit the trace through
+    TaskSpec.trace_ctx) are drawn as `serve_span` slices on THEIR
+    recording pid, which is what stitches proxy, replica, and spawned-
+    task processes into one trace."""
+    out: List[dict] = []
+    by_req: Dict[str, list] = {}
+    for e in serve_events:
+        rid = e.get("request_id")
+        if rid:
+            by_req.setdefault(rid, []).append(e)
+    for rid, evs in by_req.items():
+        for e in evs:
+            hop = e.get("hop", "")
+            pid = str(e.get("pid", ""))
+            dep = e.get("deployment", "")
+            ph = e.get("phases") or [None] * REQ_RECORD_LEN
+            if hop == "replay":
+                out.append({
+                    "cat": "serve", "name": "replay", "ph": "i",
+                    "ts": e.get("time", 0.0) * 1e6, "pid": pid, "tid": 0,
+                    "s": "p", "request_id": rid, "deployment": dep,
+                })
+                continue
+            present = [(t, REQ_PHASE_ORDER[i])
+                       for i, t in enumerate(ph) if t is not None]
+            present.sort()
+            if not present:
+                continue
+            ts = present[0][0] * 1e6
+            end = max(present[-1][0] * 1e6, ts)
+            hop_slice = {
+                "cat": "serve", "name": f"{hop}:{dep}", "ph": "X",
+                "ts": ts, "dur": end - ts, "pid": pid, "tid": 0,
+                "request_id": rid, "deployment": dep, "hop": hop,
+            }
+            if e.get("replays"):
+                hop_slice["replays"] = e["replays"]
+            out.append(hop_slice)
+            for (t0, _n0), (t1, n1) in zip(present, present[1:]):
+                out.append({
+                    "cat": "serve_phase", "name": n1, "ph": "X",
+                    "ts": t0 * 1e6,
+                    "dur": max(0.0, (t1 - t0)) * 1e6,
+                    "pid": pid, "tid": 1, "request_id": rid,
+                })
+        # Flow arrows: proxy dispatch -> each replica exec_start.
+        proxies = [e for e in evs if e.get("hop") == "proxy"]
+        replicas = [e for e in evs if e.get("hop") == "replica"]
+        if proxies and replicas:
+            p = proxies[0]
+            pph = p.get("phases") or []
+            src = None
+            if len(pph) > RQ_DISPATCH and pph[RQ_DISPATCH] is not None:
+                src = pph[RQ_DISPATCH]
+            elif len(pph) > RQ_PROXY_RECV:
+                src = pph[RQ_PROXY_RECV]
+            if src is not None:
+                out.append({
+                    "cat": "serve_flow", "name": "request", "ph": "s",
+                    "id": "req:" + rid, "ts": src * 1e6,
+                    "pid": str(p.get("pid", "")), "tid": 0,
+                    "request_id": rid,
+                })
+                for r in replicas:
+                    rph = r.get("phases") or []
+                    dst = next((rph[i] for i in (RQ_EXEC_START,
+                                                 RQ_ADMISSION)
+                                if len(rph) > i and rph[i] is not None),
+                               None)
+                    if dst is None:
+                        continue
+                    out.append({
+                        "cat": "serve_flow", "name": "request", "ph": "f",
+                        "bp": "e", "id": "req:" + rid,
+                        "ts": max(dst, src) * 1e6,
+                        "pid": str(r.get("pid", "")), "tid": 0,
+                        "request_id": rid,
+                    })
+    # Spans belonging to serve traces: drawn here (build_trace skips
+    # spans otherwise) so the handler's spawned tasks / nested calls
+    # appear in the same chrome trace on their own pids.
+    for e in all_events:
+        if not isinstance(e, dict) or e.get("kind") != "span":
+            continue
+        tid = e.get("trace_id")
+        if tid not in by_req or e.get("end") is None:
+            continue
+        out.append({
+            "cat": "serve_span", "name": e.get("name", ""), "ph": "X",
+            "ts": e["start"] * 1e6,
+            "dur": max(0.0, e["end"] - e["start"]) * 1e6,
+            "pid": str(e.get("pid", "")), "tid": 2,
+            "request_id": tid, "span_id": e.get("span_id"),
+            "parent_id": e.get("parent_id"),
+        })
+    return out
+
+
 def latency_summary(events: List[dict]) -> List[dict]:
     """Per-(task name, phase) p50/p95 rows from task events with phases:
     the data behind `ray_tpu summary`'s latency table and the dashboard
@@ -277,6 +437,13 @@ def latency_summary(events: List[dict]) -> List[dict]:
             continue
         ph = e.get("phases")
         if not ph:
+            continue
+        if e.get("kind") == "serve_request":
+            # Serve request hops fold under "serve:<deployment>" so the
+            # same latency table covers tasks AND requests.
+            name = "serve:" + e.get("deployment", "")
+            for phase, d in request_phase_durations(ph):
+                acc.setdefault((name, phase), []).append(d)
             continue
         name = e.get("name", "")
         for phase, d in phase_durations(ph):

@@ -668,6 +668,8 @@ class GcsServer:
         """Counts by (task name, latest state) — `ray summary tasks`."""
         latest: Dict[tuple, str] = {}
         for e in self.task_events:
+            if e.get("kind"):  # spans / serve_request rows aren't tasks
+                continue
             key = (e.get("name", ""), e.get("task_id"))
             latest[key] = e.get("state", "")
         counts: Dict[tuple, int] = {}
@@ -1958,8 +1960,8 @@ class GcsServer:
         for e in self.task_events:
             if job_id is not None and e.get("job_id") != job_id:
                 continue
-            if e.get("kind") == "span":
-                continue
+            if e.get("kind"):  # span / serve_request rows aren't tasks —
+                continue       # they'd all collapse onto task_id=None
             if not self._match_filters(e, other_filters):
                 continue
             latest[e.get("task_id")] = e

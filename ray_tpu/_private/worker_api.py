@@ -78,6 +78,16 @@ def get_core() -> CoreWorker:
     return _state.core
 
 
+def peek_core() -> Optional[CoreWorker]:
+    """The live CoreWorker, or None — NEVER auto-initializes. For
+    observability paths (span export, serve request events) that must
+    degrade to buffering instead of spinning up a cluster as a side
+    effect."""
+    if _worker_core.core is not None:
+        return _worker_core.core
+    return _state.core if _state.initialized else None
+
+
 class _WorkerCore:
     """Set inside worker processes (see worker_main) for API reentrancy."""
     def __init__(self):

@@ -54,6 +54,13 @@ def _run_compiled_loop(fns: List, node_specs: List[tuple]):
                 out_channel.close()
                 closed = True
                 continue
+            except Exception as e:  # noqa: BLE001 — a read error must
+                # surface to the caller as a typed result, never kill the
+                # loop silently: a dead loop leaves every later execute()
+                # spinning on an output channel nobody will write.
+                out_channel.write(_DagError(e))
+                read_cache[id(out_channel)] = _DagError(e)
+                continue
             err = next((v for v in values if isinstance(v, _DagError)),
                        None)
             if err is not None:
@@ -174,11 +181,38 @@ class CompiledDAG:
         self._input_channel.write(value)
         # Drain EVERY output before raising: an unread channel would hand
         # this pass's value to the next execute() (stale-read hazard).
-        outs = [ch.read() for ch in self._output_channels]
+        outs = [self._read_output(ch) for ch in self._output_channels]
         err = next((o for o in outs if isinstance(o, _DagError)), None)
         if err is not None:
             raise err.error
         return outs if len(outs) > 1 else outs[0]
+
+    def _read_output(self, ch) -> Any:
+        """Channel read with a liveness backstop: an executor whose loop
+        died (worker crash, failed actor creation) will never write this
+        channel — without the check, execute() spins on the seqlock
+        until some outer timeout kills the caller."""
+        while True:
+            try:
+                return ch.read(timeout=1.0)
+            except TimeoutError:
+                self._raise_if_executor_dead()
+
+    def _raise_if_executor_dead(self):
+        import ray_tpu
+        # timeout must be > 0: wait(timeout=0) returns before the ready
+        # probes get a single loop tick, i.e. it never reports anything
+        # done.
+        done, _pending = ray_tpu.wait(
+            list(self._loop_refs), num_returns=len(self._loop_refs),
+            timeout=0.2)
+        for ref in done:
+            # run_loop only returns at teardown: any settled ref here is
+            # a dead executor. get() re-raises its error (ActorDiedError,
+            # creation failure); a clean exit still means no writer.
+            ray_tpu.get(ref, timeout=5)
+            raise RuntimeError(
+                "compiled DAG executor loop exited before teardown")
 
     def teardown(self):
         if self._torn_down:

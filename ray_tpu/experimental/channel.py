@@ -81,20 +81,51 @@ class Channel:
     # -- reader side --------------------------------------------------
 
     def read(self, timeout: Optional[float] = None) -> Any:
-        """Block until a version newer than the last read; return value."""
+        """Block until a version newer than the last read; return value.
+
+        Torn-read guards, in order of subtlety: the header re-read must
+        match on BOTH fields (the 16-byte header is two non-atomic
+        loads — a reader can observe the NEW version with the STALE
+        length, because memcpy may load the fields in either order), and
+        a payload that still fails to unpickle is treated as torn and
+        RETRIED rather than raised — the writer finishes its store
+        nanoseconds later, and surfacing a transient tear as EOFError
+        killed executor loops (observed as compiled-DAG wedges). The
+        read cursor only ever advances past a fully-validated message,
+        so a retry can never skip one."""
         deadline = None if timeout is None else time.monotonic() + timeout
         spin = 0
+        bad_version = bad_count = 0
         while True:
             version, length = _HEADER.unpack_from(self._buf, 0)
-            if version % 2 == 0 and version > self._last_read_version:
+            if (version % 2 == 0 and version > self._last_read_version
+                    and length <= self.max_size):
                 payload = bytes(
                     self._buf[HEADER_SIZE:HEADER_SIZE + length])
-                v2, _ = _HEADER.unpack_from(self._buf, 0)
-                if v2 == version:               # no torn read
-                    self._last_read_version = version
+                v2, l2 = _HEADER.unpack_from(self._buf, 0)
+                if v2 == version and l2 == length:   # no torn read
                     if payload == _CLOSED_TAG:
+                        self._last_read_version = version
                         raise ChannelClosedError(self._shm.name)
-                    return pickle.loads(payload)
+                    try:
+                        value = pickle.loads(payload)
+                    except Exception:
+                        # Torn payload despite a stable header: spin —
+                        # the next copy sees the completed write within
+                        # nanoseconds. But a payload that KEEPS failing
+                        # at the same version isn't torn (unpicklable
+                        # value — class missing in this process): raise
+                        # it rather than hang a timeout-less reader.
+                        if version != bad_version:
+                            bad_version, bad_count = version, 1
+                        else:
+                            bad_count += 1
+                        if bad_count >= 64:
+                            raise
+                        time.sleep(5e-5)
+                    else:
+                        self._last_read_version = version
+                        return value
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"channel read timed out ({timeout}s)")
             # Micro-backoff: tight spin first (latency), 50 µs naps next,

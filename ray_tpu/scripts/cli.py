@@ -143,6 +143,11 @@ def cmd_summary(args):
 def cmd_timeline(args):
     ray_tpu = _connect(args)
     trace = ray_tpu.timeline()
+    rid = getattr(args, "request", None)
+    if rid:
+        # One serve request's trace only: every row stamped with the
+        # request id (proxy/replica hops, replay markers, handler spans).
+        trace = [t for t in trace if t.get("request_id") == rid]
     out = args.output or "timeline.json"
     with open(out, "w") as f:
         json.dump(trace, f)
@@ -392,6 +397,8 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("timeline", help="dump chrome-trace timeline")
     s.add_argument("--address", default=None)
     s.add_argument("-o", "--output", default=None)
+    s.add_argument("--request", default=None,
+                   help="filter to one serve request id (X-Request-Id)")
     s.set_defaults(fn=cmd_timeline)
 
     s = sub.add_parser("profile", help="profile a live worker "

@@ -14,7 +14,8 @@ from ray_tpu.serve.api import (delete, get_app_handle, get_deployment_handle,
                                status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import Application, Deployment, deployment
-from ray_tpu.serve.config import AutoscalingConfig, HTTPOptions, gRPCOptions
+from ray_tpu.serve.config import (AutoscalingConfig, HTTPOptions,
+                                  SLOConfig, gRPCOptions)
 from ray_tpu.serve.grpc_proxy import ServeRpcClient
 from ray_tpu.serve.handle import (DeploymentHandle, DeploymentResponse,
                                   DeploymentResponseGenerator)
@@ -30,7 +31,8 @@ __all__ = [
     "delete", "status", "get_app_handle", "get_deployment_handle",
     "get_grpc_address", "DeploymentHandle", "DeploymentResponse",
     "DeploymentResponseGenerator", "ServeRpcClient", "batch", "multiplexed",
-    "get_multiplexed_model_id", "AutoscalingConfig", "HTTPOptions",
+    "get_multiplexed_model_id", "AutoscalingConfig", "SLOConfig",
+    "HTTPOptions",
     "gRPCOptions", "deploy_config", "import_application",
     "load_serve_config", "run_import_path", "ServeError",
     "BackPressureError", "RequestTimeoutError", "ReplicaDiedError",

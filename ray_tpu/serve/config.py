@@ -36,6 +36,34 @@ class AutoscalingConfig:
 
 
 @dataclass
+class SLOConfig:
+    """Per-deployment service-level objective, evaluated in the
+    controller as multi-window burn rates (SRE-style: a fast window
+    catches sharp regressions, a slow window filters blips — both must
+    burn before the deployment is declared violating).
+
+    A request is "bad" when it finished over `target_p99_s`, raised an
+    application error, was shed by admission control, or exceeded its
+    deadline. burn rate = bad_fraction / (1 - slo): burn 1.0 consumes
+    the error budget exactly at the sustainable rate; sustained burn
+    above `burn_threshold` trips `ray_tpu_serve_slo_violations_total`
+    and — when the deployment also has an AutoscalingConfig — scales it
+    up BEFORE the bounded queue starts shedding."""
+
+    target_p99_s: float = 1.0     # per-request latency target
+    slo: float = 0.99             # fraction that must be good (budget=1-slo)
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    burn_threshold: float = 2.0
+    # Minimum fast-window sample count before burn is trusted: one slow
+    # request out of one must not page/scale anything.
+    min_samples: int = 10
+    # Burn-driven upscale cadence (independent of AutoscalingConfig's
+    # upscale_delay_s — burn is already a sustained, windowed signal).
+    upscale_cooldown_s: float = 10.0
+
+
+@dataclass
 class HTTPOptions:
     host: str = "127.0.0.1"
     port: int = 8000
@@ -78,3 +106,6 @@ class DeploymentConfig:
     # applies when the cluster exposes >= 2 slice domains and the
     # deployment doesn't pin placement itself.
     slice_spread: bool = True
+    # Latency/error SLO evaluated in the controller (burn-rate engine,
+    # serve/slo.py). None = no SLO tracking for this deployment.
+    slo_config: Optional["SLOConfig"] = None

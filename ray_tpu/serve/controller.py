@@ -83,6 +83,16 @@ class _DeploymentState:
         self.list_version = 0              # bumped on any replica-set change
         self.last_scale_change = 0.0
         self.next_health_check = 0.0
+        self.slo = None                    # DeploymentSLO when configured
+        self.last_slo_scale = 0.0
+        self._rebuild_slo()
+
+    def _rebuild_slo(self):
+        if self.config.slo_config is None:
+            self.slo = None
+            return
+        from ray_tpu.serve.slo import DeploymentSLO
+        self.slo = DeploymentSLO(self.name, self.config.slo_config)
 
     def active(self) -> List[_ReplicaInfo]:
         """Replicas that fill a target slot (replacements don't — they
@@ -124,6 +134,11 @@ class ServeController:
                 worker_api.add_drain_event_listener(_notice)
             except Exception:  # noqa: BLE001 — no core (unit tests)
                 pass
+            try:
+                from ray_tpu.util import metrics
+                metrics.start_loop_lag_probe_once("serve_controller")
+            except Exception:  # noqa: BLE001 — lag probe is best-effort
+                pass
             self._reconcile_task = asyncio.ensure_future(
                 self._reconcile_loop())
 
@@ -147,6 +162,7 @@ class ServeController:
                 cur.config = d["config"]
                 cur.version = d["version"]
                 cur.target_num = d["config"].num_replicas
+                cur._rebuild_slo()  # fresh windows for the new objective
         # Remove deployments no longer in the app.
         for key in [k for k in self._deployments
                     if k[0] == app_name and k not in incoming]:
@@ -221,7 +237,12 @@ class ServeController:
         limits = {"deployment": st.name,
                   "max_ongoing": cfg.max_ongoing_requests,
                   "max_queued": cfg.max_queued_requests,
-                  "request_replay": cfg.request_replay}
+                  "request_replay": cfg.request_replay,
+                  # Replica-side SLO accounting (slow-request counter)
+                  # needs the latency target; 0 disables.
+                  "slo_latency_target_s":
+                      cfg.slo_config.target_p99_s
+                      if cfg.slo_config is not None else 0.0}
         rep = cls.remote(st.blob, cfg.user_config, limits)
         info = _ReplicaInfo(rep, st.version)
         info.replaces = replaces
@@ -425,7 +446,7 @@ class ServeController:
         now = time.monotonic()
         for st in list(self._deployments.values()):
             asc = st.config.autoscaling_config
-            if asc is None or not st.replicas:
+            if (asc is None and st.slo is None) or not st.replicas:
                 continue
 
             async def metrics(r):
@@ -436,10 +457,41 @@ class ServeController:
                     return None
             results = await asyncio.gather(
                 *[metrics(r) for r in st.replicas])
+            polled = {r.replica_id: m
+                      for r, m in zip(st.replicas, results) if m}
+            # SLO burn: evaluated every pass (gauges/violations export
+            # even without autoscaling); with autoscaling it scales UP on
+            # sustained burn — latency pressure fires before the bounded
+            # queue fills, so burn-driven capacity lands before a single
+            # request is shed.
+            if st.slo is not None and polled:
+                st.slo.ingest(polled)
+                verdict = st.slo.evaluate()
+                if (verdict["violating"] and asc is not None
+                        and st.target_num < asc.max_replicas
+                        and now - st.last_slo_scale
+                        >= st.config.slo_config.upscale_cooldown_s):
+                    logger.info(
+                        "SLO burn autoscale %s: %d -> %d (burn fast=%.1f "
+                        "slow=%.1f)", st.name, st.target_num,
+                        st.target_num + 1, verdict["fast"],
+                        verdict["slow"])
+                    st.target_num += 1
+                    st.last_slo_scale = now
+                    st.last_scale_change = now
+                    continue  # burn owns this tick: no queue downscale
+                if verdict["violating"]:
+                    # Still burning (at max, or cooling down): never let
+                    # the queue-depth policy scale DOWN a burning
+                    # deployment.
+                    st.last_scale_change = now
+                    continue
+            if asc is None:
+                continue
             # Queued requests count toward pressure: with replica-side
             # admission queues, "ongoing" alone under-reports load.
             total = sum(m["ongoing"] + m.get("queued", 0)
-                        for m in results if m)
+                        for m in polled.values())
             desired = asc.decide(len(st.active()), total)
             delay = (asc.upscale_delay_s if desired > st.target_num
                      else asc.downscale_delay_s)
@@ -542,7 +594,7 @@ class ServeController:
     def status(self):
         out = {}
         for (app, name), st in self._deployments.items():
-            out.setdefault(app, {})[name] = {
+            row = {
                 "target": st.target_num,
                 "running": len(st.replicas),
                 "ready": sum(1 for r in st.replicas
@@ -550,6 +602,14 @@ class ServeController:
                 "draining": len(st.draining),
                 "version": st.version,
             }
+            if st.slo is not None:
+                row["slo"] = {
+                    "burn_fast": round(st.slo.burn_fast, 3),
+                    "burn_slow": round(st.slo.burn_slow, 3),
+                    "violating": st.slo.violating,
+                    "violations": st.slo.violations,
+                }
+            out.setdefault(app, {})[name] = row
         return out
 
     async def ensure_proxy(self, host: str, port: int):

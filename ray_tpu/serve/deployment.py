@@ -12,7 +12,8 @@ import copy
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, Optional, Tuple, Union
 
-from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.config import (AutoscalingConfig, DeploymentConfig,
+                                  SLOConfig)
 
 
 @dataclass
@@ -34,7 +35,8 @@ class Deployment:
                 max_queued_requests: Optional[int] = None,
                 request_replay: Optional[bool] = None,
                 request_timeout_s: Optional[float] = None,
-                slice_spread: Optional[bool] = None) -> "Deployment":
+                slice_spread: Optional[bool] = None,
+                slo_config: Optional[SLOConfig] = None) -> "Deployment":
         cfg = replace(self.config)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
@@ -54,6 +56,8 @@ class Deployment:
             cfg.request_timeout_s = request_timeout_s
         if slice_spread is not None:
             cfg.slice_spread = slice_spread
+        if slo_config is not None:
+            cfg.slo_config = slo_config
         return Deployment(
             func_or_class=self.func_or_class,
             name=name or self.name,
@@ -101,7 +105,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                max_queued_requests: int = -1,
                request_replay: bool = False,
                request_timeout_s: Optional[float] = None,
-               slice_spread: bool = True):
+               slice_spread: bool = True,
+               slo_config: Optional[SLOConfig] = None):
     """@serve.deployment decorator (reference: serve/api.py deployment)."""
 
     def wrap(f_or_c):
@@ -115,6 +120,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             request_replay=request_replay,
             request_timeout_s=request_timeout_s,
             slice_spread=slice_spread,
+            slo_config=slo_config,
         )
         return Deployment(func_or_class=f_or_c,
                           name=name or f_or_c.__name__,

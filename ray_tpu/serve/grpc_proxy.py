@@ -68,6 +68,11 @@ class GrpcProxyActor:
             self._server.register("serve_unary", self._rpc_unary)
             self._server.register("serve_stream", self._rpc_stream)
             self._port = await self._server.start(self._host, self._port)
+            try:
+                from ray_tpu.util import metrics
+                metrics.start_loop_lag_probe_once("serve_grpc_proxy")
+            except Exception:  # noqa: BLE001 — lag probe is best-effort
+                pass
         return self._port
 
     async def _handle_for(self, payload) -> Any:
@@ -112,25 +117,50 @@ class GrpcProxyActor:
     @rpc.non_idempotent
     async def _rpc_unary(self, conn, payload):
         self._num_requests += 1
+        t_recv = time.time()
         handle = await self._handle_for(payload)
-        return await handle.remote(*payload.get("args", ()),
-                                   **payload.get("kwargs", {}))
+        # Same request-trace contract as the HTTP proxy: this ingress
+        # mints (or adopts the client's request_id) and the handle/
+        # replica/spawned tasks join the trace through the contextvar.
+        from ray_tpu.serve import request_trace
+        trace = request_trace.mint(handle.deployment_name,
+                                   request_id=payload.get("request_id", ""))
+        trace.stamp(request_trace.RQ_PROXY_RECV, t_recv)
+        token = request_trace.bind(trace)
+        try:
+            return await handle.remote(*payload.get("args", ()),
+                                       **payload.get("kwargs", {}))
+        finally:
+            request_trace.unbind(token)
+            request_trace.finish(trace, "proxy")
 
     @rpc.non_idempotent
     async def _rpc_stream(self, conn, payload):
         self._num_requests += 1
+        t_recv = time.time()
         handle = await self._handle_for(payload)
         call_id = payload["call_id"]
-        gen = handle.options(stream=True).remote(
-            *payload.get("args", ()), **payload.get("kwargs", {}))
-        n = 0
-        async for item in gen:
-            # Items stream as PUSH frames; the final RESPONSE closes the
-            # call (reference: gRPC server-streaming).
-            await conn.push("serve_stream_item",
-                            {"call_id": call_id, "item": item})
-            n += 1
-        return {"items": n}
+        from ray_tpu.serve import request_trace
+        trace = request_trace.mint(handle.deployment_name,
+                                   request_id=payload.get("request_id", ""))
+        trace.stamp(request_trace.RQ_PROXY_RECV, t_recv)
+        token = request_trace.bind(trace)
+        try:
+            gen = handle.options(stream=True).remote(
+                *payload.get("args", ()), **payload.get("kwargs", {}))
+            n = 0
+            async for item in gen:
+                if trace.phases[request_trace.RQ_FIRST_ITEM] is None:
+                    trace.stamp(request_trace.RQ_FIRST_ITEM)
+                # Items stream as PUSH frames; the final RESPONSE closes
+                # the call (reference: gRPC server-streaming).
+                await conn.push("serve_stream_item",
+                                {"call_id": call_id, "item": item})
+                n += 1
+            return {"items": n}
+        finally:
+            request_trace.unbind(token)
+            request_trace.finish(trace, "proxy")
 
     def get_num_requests(self) -> int:
         return self._num_requests
@@ -173,13 +203,14 @@ class ServeRpcClient:
 
     def call(self, *args, app: str = "default",
              deployment: Optional[str] = None, method: str = "__call__",
-             timeout: float = 60.0, **kwargs):
+             timeout: float = 60.0, request_id: str = "", **kwargs):
         async def go():
             conn = await self._ensure_conn()
             return await conn.request(
                 "serve_unary",
                 {"app": app, "deployment": deployment, "method": method,
-                 "args": args, "kwargs": kwargs}, timeout)
+                 "args": args, "kwargs": kwargs,
+                 "request_id": request_id}, timeout)
         try:
             return asyncio.run_coroutine_threadsafe(
                 go(), self._loop).result(timeout + 10)

@@ -28,6 +28,8 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu._private.flightrec import RQ_DISPATCH
+from ray_tpu.serve import request_trace
 from ray_tpu.serve.exceptions import (ReplicaDiedError, ReplicaDrainingError,
                                       RequestTimeoutError, ServeError, unwrap)
 
@@ -54,17 +56,45 @@ class _PendingRequest:
     """Retained request payload: everything needed to re-dispatch."""
 
     __slots__ = ("method", "mux_id", "args", "kwargs", "request_id",
-                 "deadline_ts", "attempts")
+                 "deadline_ts", "attempts", "trace", "finish_on_settle")
 
     def __init__(self, method: str, mux_id: str, args: tuple, kwargs: dict,
-                 deadline_ts: float = 0.0):
+                 deadline_ts: float = 0.0, trace=None):
+        self.finish_on_settle = False
         self.method = method
         self.mux_id = mux_id
         self.args = args
         self.kwargs = kwargs
+        # Trace context rides the request: proxy-minted (contextvar) or
+        # handle-minted here. The replay-dedupe key stays a PRIVATE
+        # uuid4 — the trace id may be client-supplied (X-Request-Id),
+        # and a reused client id must never alias two requests onto one
+        # replica result-cache entry.
+        self.trace = trace
         self.request_id = uuid.uuid4().hex
         self.deadline_ts = deadline_ts
         self.attempts = 0
+
+    def wire_trace(self):
+        return self.trace.wire() \
+            if self.trace is not None and self.trace.sampled else None
+
+    def record_replay(self, err) -> None:
+        if self.trace is not None:
+            try:
+                self.trace.record_replay(repr(err))
+            except Exception:  # noqa: BLE001 — tracing never fails calls
+                pass
+
+    def settle_trace(self) -> None:
+        """Finish a HANDLE-minted trace when the response settles (a
+        proxy-minted one is finished by the proxy, which also stamps the
+        reply phase after the payload went out on the socket)."""
+        if self.trace is not None and self.finish_on_settle:
+            try:
+                request_trace.finish(self.trace, "handle")
+            except Exception:  # noqa: BLE001
+                pass
 
 
 class DeploymentResponse:
@@ -408,8 +438,23 @@ class DeploymentHandle:
     # ------------------------------------------------------------------
     def _make_request(self, args, kwargs) -> _PendingRequest:
         deadline = time.time() + self._timeout_s if self._timeout_s else 0.0
-        return _PendingRequest(self._method, self._mux_id, args, kwargs,
-                               deadline_ts=deadline)
+        # Request trace: adopt the ingress context (proxy set it on this
+        # task's contextvars) or mint one here — EVERY entry into the
+        # serve data plane carries a request id + trace from this point.
+        ctx = request_trace.current()
+        handle_minted = False
+        if ctx is None:
+            try:
+                ctx = request_trace.mint(self.deployment_name, hop="handle")
+                handle_minted = True
+            except Exception:  # noqa: BLE001 — tracing never fails calls
+                ctx = None
+        req = _PendingRequest(self._method, self._mux_id, args, kwargs,
+                              deadline_ts=deadline, trace=ctx)
+        # A proxy-minted context is recorded/finished by the proxy; the
+        # handle finishes only traces it minted itself.
+        req.finish_on_settle = handle_minted
+        return req
 
     def _fill_deadline(self, req: _PendingRequest, router: Router):
         """Apply the deployment's default request_timeout_s (known only
@@ -446,16 +491,28 @@ class DeploymentHandle:
             return 0.0
         return max(0.001, req.deadline_ts - time.time())
 
+    @staticmethod
+    def _stamp_dispatch(req: _PendingRequest):
+        """Request-trace dispatch stamp + the wire context forwarded to
+        the replica (None when unsampled — zero overhead off)."""
+        if req.trace is None:
+            return None
+        if req.trace.sampled:
+            req.trace.stamp(RQ_DISPATCH)
+        return req.wire_trace()
+
     def _submit(self, replica, req: _PendingRequest):
+        trace_ctx = self._stamp_dispatch(req)
         return replica.handle_request.remote(
             req.method, req.mux_id, req.args, req.kwargs,
-            req.request_id, self._remaining(req))
+            req.request_id, self._remaining(req), trace_ctx)
 
     def _submit_stream(self, replica, req: _PendingRequest):
+        trace_ctx = self._stamp_dispatch(req)
         return replica.handle_request_streaming.options(
             num_returns="streaming").remote(
                 req.method, req.mux_id, req.args, req.kwargs,
-                req.request_id, self._remaining(req))
+                req.request_id, self._remaining(req), trace_ctx)
 
     # ------------------------------------------------------------------
     # Sync (driver-thread) path
@@ -519,6 +576,7 @@ class DeploymentHandle:
             req.attempts += 1
             self._gate_replay(router, req, err)
             _count_replay(self.deployment_name)
+            req.record_replay(err)  # failover stays ONE trace: replay hop
             router.drop_replicas()
             # Backoff: the controller needs a health-check round to drop
             # a dead replica from the routable set — instant re-dispatch
@@ -527,12 +585,16 @@ class DeploymentHandle:
                 time.sleep(min(0.25 * req.attempts, 1.0))
             return dispatch()
 
+        def done():
+            release()
+            req.settle_trace()
+
         first = dispatch()
         if self._stream:
             return DeploymentResponseGenerator(
-                first, on_done=release, recover=recover,
+                first, on_done=done, recover=recover,
                 deployment=self.deployment_name)
-        return DeploymentResponse(first, on_done=release, recover=recover)
+        return DeploymentResponse(first, on_done=done, recover=recover)
 
     # ------------------------------------------------------------------
     # Async (core-loop) paths
@@ -548,6 +610,7 @@ class DeploymentHandle:
             req.attempts += 1
             self._gate_replay(router, req, err)
             _count_replay(self.deployment_name)
+            req.record_replay(err)
             router.drop_replicas()
             if not isinstance(err, ReplicaDrainingError):
                 # Let the controller's health check drop the dead replica.
@@ -568,7 +631,11 @@ class DeploymentHandle:
                 continue
             try:
                 gen = self._submit_stream(replica, req)
-                return gen, (lambda rid=rid: router.release(rid))
+
+                def _release(rid=rid):
+                    router.release(rid)
+                    req.settle_trace()
+                return gen, _release
             except Exception as e:  # noqa: BLE001
                 router.release(rid)
                 router.drop_replicas()
@@ -576,6 +643,12 @@ class DeploymentHandle:
         raise last_err
 
     async def _call_async(self, req: _PendingRequest):
+        try:
+            return await self._call_async_inner(req)
+        finally:
+            req.settle_trace()
+
+    async def _call_async_inner(self, req: _PendingRequest):
         import asyncio
         from ray_tpu import exceptions as exc
         router = self._get_router()
@@ -614,6 +687,7 @@ class DeploymentHandle:
                     # Handed back before execution: re-route, always.
                     router.drop_replicas()
                     _count_replay(self.deployment_name)
+                    req.record_replay(cause)
                     last_err = cause
                     continue
                 if isinstance(cause, ServeError):
@@ -626,6 +700,7 @@ class DeploymentHandle:
                     raise ReplicaDiedError(self.deployment_name,
                                            reason=repr(e)) from e
                 _count_replay(self.deployment_name)
+                req.record_replay(e)
                 last_err = e
                 # Backoff past the controller's health-check round so
                 # retries don't all land on the not-yet-dropped corpse.

@@ -76,6 +76,11 @@ class ProxyActor:
         if self._server is None:
             self._server = await asyncio.start_server(
                 self._handle_conn, self._host, self._port)
+            try:
+                from ray_tpu.util import metrics
+                metrics.start_loop_lag_probe_once("serve_http_proxy")
+            except Exception:  # noqa: BLE001 — lag probe is best-effort
+                pass
         return self._port
 
     async def _refresh_routes(self):
@@ -126,6 +131,7 @@ class ProxyActor:
             body = b""
             if "content-length" in headers:
                 body = await reader.readexactly(int(headers["content-length"]))
+            t_recv = time.time()   # request fully parsed off the socket
             url = urlsplit(target)
             path = url.path
             await self._refresh_routes()
@@ -170,34 +176,50 @@ class ProxyActor:
                           query=parse_qs(url.query), headers=headers,
                           body=body)
             self._num_requests += 1
-            streaming = self._streaming.get(key)
-            if streaming is None:
-                # One probe per ingress: is the handler a generator
-                # function? (reference: proxy.py checks the response type;
-                # here the replica inspects its callable once.) A failed
-                # probe (e.g. empty replica set mid-rollout) is NOT cached:
-                # the next request retries it.
+            # Request trace: minted HERE (or adopted from the client's
+            # X-Request-Id), bound to the task context so the handle —
+            # and through it the replica and anything the handler spawns
+            # — joins the same trace.
+            from ray_tpu.serve import request_trace
+            trace = request_trace.mint(
+                ingress, request_id=headers.get("x-request-id", ""))
+            trace.stamp(request_trace.RQ_PROXY_RECV, t_recv)
+            trace_token = request_trace.bind(trace)
+            try:
+                streaming = self._streaming.get(key)
+                if streaming is None:
+                    # One probe per ingress: is the handler a generator
+                    # function? (reference: proxy.py checks the response
+                    # type; here the replica inspects its callable once.)
+                    # A failed probe (e.g. empty replica set mid-rollout)
+                    # is NOT cached: the next request retries it.
+                    try:
+                        streaming = await self._probe_streaming(handle)
+                        self._streaming[key] = streaming
+                    except Exception:
+                        streaming = False
+                if streaming:
+                    try:
+                        gen = handle.options(stream=True).remote(req)
+                        await self._send_stream(writer, gen, trace=trace)
+                    except Exception as e:
+                        code, body, ctype = _error_response(e)
+                        await self._respond(writer, code, body, ctype=ctype,
+                                            request_id=trace.request_id)
+                    return
                 try:
-                    streaming = await self._probe_streaming(handle)
-                    self._streaming[key] = streaming
-                except Exception:
-                    streaming = False
-            if streaming:
-                try:
-                    gen = handle.options(stream=True).remote(req)
-                    await self._send_stream(writer, gen)
+                    resp = handle.remote(req)
+                    result = await resp
                 except Exception as e:
                     code, body, ctype = _error_response(e)
-                    await self._respond(writer, code, body, ctype=ctype)
-                return
-            try:
-                resp = handle.remote(req)
-                result = await resp
-            except Exception as e:
-                code, body, ctype = _error_response(e)
-                await self._respond(writer, code, body, ctype=ctype)
-                return
-            await self._send_result(writer, result)
+                    await self._respond(writer, code, body, ctype=ctype,
+                                        request_id=trace.request_id)
+                    return
+                await self._send_result(writer, result,
+                                        request_id=trace.request_id)
+            finally:
+                request_trace.unbind(trace_token)
+                request_trace.finish(trace, "proxy")
         except Exception:
             try:
                 await self._respond(writer, 500, b"internal error")
@@ -285,9 +307,18 @@ class ProxyActor:
         req = Request(method="WEBSOCKET", path=self._sub_path(prefix, path),
                       query=parse_qs(url.query), headers=headers,
                       ws=ws.WebSocketChannel(self._self_handle(), conn_id))
+        # Websocket sessions trace like any request: upgrade = proxy_recv,
+        # first frame out = first_item, session close = reply.
+        from ray_tpu.serve import request_trace
+        trace = request_trace.mint(
+            ingress, request_id=headers.get("x-request-id", ""))
+        trace.stamp(request_trace.RQ_PROXY_RECV)
+        trace_token = request_trace.bind(trace)
         try:
             gen = handle.options(stream=True).remote(req)
             async for item in gen:
+                if trace.phases[request_trace.RQ_FIRST_ITEM] is None:
+                    trace.stamp(request_trace.RQ_FIRST_ITEM)
                 if isinstance(item, str):
                     frame = ws.encode_frame(ws.OP_TEXT, item.encode())
                 else:
@@ -326,6 +357,8 @@ class ProxyActor:
             except Exception:
                 pass
         finally:
+            request_trace.unbind(trace_token)
+            request_trace.finish(trace, "proxy")
             reader_task.cancel()
             self._ws_queues.pop(conn_id, None)
 
@@ -367,7 +400,7 @@ class ProxyActor:
             return item.encode()
         return (json.dumps(_jsonable(item)) + "\n").encode()
 
-    async def _send_stream(self, writer, gen):
+    async def _send_stream(self, writer, gen, trace=None):
         """Chunked transfer encoding: each generator item is flushed as its
         own chunk the moment the replica yields it (reference: proxy.py
         :745 ASGI streaming responses).
@@ -384,8 +417,14 @@ class ProxyActor:
             first = await it.__anext__()
         except StopAsyncIteration:
             have_first = False
+        if trace is not None and have_first:
+            from ray_tpu.serve import request_trace
+            trace.stamp(request_trace.RQ_FIRST_ITEM)
+        req_id_hdr = (f"X-Request-Id: {trace.request_id}\r\n".encode()
+                      if trace is not None else b"")
         writer.write(b"HTTP/1.1 200 OK\r\n"
                      b"Content-Type: application/octet-stream\r\n"
+                     + req_id_hdr +
                      b"Transfer-Encoding: chunked\r\n"
                      b"Connection: close\r\n\r\n")
         await writer.drain()
@@ -407,27 +446,30 @@ class ProxyActor:
         except Exception:
             return  # headers sent: truncate, never write a 500 mid-stream
 
-    async def _send_result(self, writer, result):
+    async def _send_result(self, writer, result, request_id: str = ""):
         if isinstance(result, bytes):
             await self._respond(writer, 200, result,
-                                ctype="application/octet-stream")
+                                ctype="application/octet-stream",
+                                request_id=request_id)
         elif isinstance(result, str):
             await self._respond(writer, 200, result.encode(),
-                                ctype="text/plain")
+                                ctype="text/plain", request_id=request_id)
         else:
             await self._respond(writer, 200,
                                 json.dumps(_jsonable(result)).encode(),
-                                ctype="application/json")
+                                ctype="application/json",
+                                request_id=request_id)
 
     async def _respond(self, writer, code: int, body: bytes,
-                       ctype: str = "text/plain"):
+                       ctype: str = "text/plain", request_id: str = ""):
         status = {200: "OK", 400: "Bad Request", 404: "Not Found",
                   500: "Internal Server Error",
                   503: "Service Unavailable",
                   504: "Gateway Timeout"}.get(code, "OK")
+        rid = f"X-Request-Id: {request_id}\r\n" if request_id else ""
         writer.write(
             f"HTTP/1.1 {code} {status}\r\n"
-            f"Content-Type: {ctype}\r\n"
+            f"Content-Type: {ctype}\r\n{rid}"
             f"Content-Length: {len(body)}\r\n"
             f"Connection: close\r\n\r\n".encode() + body)
         await writer.drain()

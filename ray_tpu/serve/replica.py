@@ -34,8 +34,12 @@ import inspect
 import time
 from typing import Any, Dict, Optional
 
+from ray_tpu._private.flightrec import (RQ_ADMISSION, RQ_EXEC_END,
+                                        RQ_EXEC_START, RQ_FIRST_ITEM,
+                                        RQ_QUEUE_WAIT, RQ_REPLY)
+from ray_tpu.serve import request_trace
 from ray_tpu.serve.exceptions import (BackPressureError, ReplicaDrainingError,
-                                      RequestTimeoutError)
+                                      RequestTimeoutError, ServeError)
 
 _request_context: contextvars.ContextVar = contextvars.ContextVar(
     "serve_request_context", default=None)
@@ -102,6 +106,13 @@ class ReplicaActor:
         self._init_limits(limits)
         if user_config is not None:
             self._apply_user_config(user_config)
+        # Event-loop lag visibility for a busy replica (once per hosting
+        # process — co-resident serve daemons must not double-count).
+        try:
+            from ray_tpu.util import metrics
+            metrics.start_loop_lag_probe_once("serve_replica")
+        except Exception:  # noqa: BLE001 — no loop (bare unit tests)
+            pass
 
     def _init_limits(self, limits: Optional[dict] = None):
         """Runtime request-path state (split out so unit tests can build
@@ -114,9 +125,15 @@ class ReplicaActor:
         # deployment that never replays (router fails fast instead) must
         # not pin dead results in memory.
         self._replay = bool(limits.get("request_replay", False))
+        # SLO accounting (serve/slo.py inputs, polled via get_metrics):
+        # counted for EVERY request — independent of trace sampling.
+        self._slo_target = float(limits.get("slo_latency_target_s") or 0.0)
         self._ongoing = 0
         self._queued = 0
         self._total = 0
+        self._completed = 0     # exec finished (success or app error)
+        self._slow = 0          # completed OK but over the SLO target
+        self._errors = 0        # handler raised a non-serve exception
         self._shed = 0
         self._timeouts = 0
         self._draining = False
@@ -222,33 +239,96 @@ class ReplicaActor:
     # ------------------------------------------------------------------
     # Request handling
     # ------------------------------------------------------------------
+    def _trace_ctx(self, trace_ctx):
+        if trace_ctx is None:
+            return None
+        try:
+            return request_trace.RequestTrace.from_wire(
+                trace_ctx, self._deployment)
+        except Exception:  # noqa: BLE001 — tracing must not fail requests
+            return None
+
+    def _finish_request_trace(self, ctx):
+        if ctx is None:
+            return
+        try:
+            if ctx.phases[RQ_REPLY] is None:
+                ctx.stamp(RQ_REPLY)
+            request_trace.record_event(ctx, "replica",
+                                       phases=list(ctx.phases))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _account_exec(self, t0: float, error: bool):
+        """SLO counters for one finished exec (disjoint categories: a
+        failed handler counts as an error, never also as slow)."""
+        self._completed += 1
+        if error:
+            self._errors += 1
+        elif self._slo_target and time.time() - t0 > self._slo_target:
+            self._slow += 1
+
     async def handle_request(self, method_name: str, mux_model_id: str,
                              args: tuple, kwargs: dict,
                              request_id: str = "",
-                             timeout_s: float = 0.0):
+                             timeout_s: float = 0.0,
+                             trace_ctx=None):
         # The handle ships the REMAINING time budget, not an absolute
         # timestamp: converting to a local deadline here keeps the
         # semantics clock-skew-free across hosts (transit time is noise
         # next to ordinary NTP drift).
         deadline_ts = time.time() + timeout_s if timeout_s else 0.0
+        # Constructor ran on the exec pool (no loop): the probe starts
+        # with the first on-loop request instead. Set-hit after that.
+        from ray_tpu.util.metrics import start_loop_lag_probe_once
+        start_loop_lag_probe_once("serve_replica")
+        ctx = self._trace_ctx(trace_ctx)
+        if ctx is not None:
+            ctx.stamp(RQ_ADMISSION)
         if self._replay and request_id and request_id in self._dedupe:
             # Replayed request whose original completed here: return the
-            # cached result instead of executing twice (exactly-once).
+            # cached result instead of executing twice (exactly-once) —
+            # NO exec stamps/span, so a replayed trace keeps exactly one
+            # exec span.
+            self._finish_request_trace(ctx)
             return self._dedupe[request_id]
-        await self._admit(deadline_ts)
+        try:
+            await self._admit(deadline_ts)
+        except BaseException:
+            self._finish_request_trace(ctx)  # shed/drain/late visible
+            raise
+        if ctx is not None:
+            ctx.stamp(RQ_QUEUE_WAIT)
         self._total += 1
         token = _request_context.set(RequestContext(mux_model_id))
+        span = None
+        if ctx is not None:
+            span = request_trace.start_exec_span(
+                ctx, f"exec:{self._deployment or method_name}")
+        t0 = time.time()
+        if ctx is not None:
+            ctx.phases[RQ_EXEC_START] = t0
         try:
             target = self._target_for(method_name)
             result = target(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = await self._run_with_deadline(result, deadline_ts)
+            if ctx is not None:
+                ctx.stamp(RQ_EXEC_END)
+            self._account_exec(t0, error=False)
             if self._replay and request_id:
                 self._dedupe[request_id] = result
                 while len(self._dedupe) > _DEDUPE_CAP:
                     self._dedupe.popitem(last=False)
             return result
+        except ServeError:
+            raise  # deadline cancel: already in _timeouts
+        except Exception:
+            self._account_exec(t0, error=True)
+            raise
         finally:
+            request_trace.finish_exec_span(span)
+            self._finish_request_trace(ctx)
             _request_context.reset(token)
             self._release_slot()
 
@@ -271,16 +351,38 @@ class ReplicaActor:
                                        mux_model_id: str, args: tuple,
                                        kwargs: dict,
                                        request_id: str = "",
-                                       timeout_s: float = 0.0):
+                                       timeout_s: float = 0.0,
+                                       trace_ctx=None):
         """Streamed variant of handle_request: iterates the handler's
         generator, yielding each item as one stream element (delivered to
         the caller as a streaming-generator actor call). Shares the
         admission gate with the unary path; deadlines bound the wait for
         EACH item, cancelling a stalled async generator on the replica."""
         deadline_ts = time.time() + timeout_s if timeout_s else 0.0
-        await self._admit(deadline_ts)
+        ctx = self._trace_ctx(trace_ctx)
+        if ctx is not None:
+            ctx.stamp(RQ_ADMISSION)
+        try:
+            await self._admit(deadline_ts)
+        except BaseException:
+            self._finish_request_trace(ctx)
+            raise
+        if ctx is not None:
+            ctx.stamp(RQ_QUEUE_WAIT)
         self._total += 1
         token = _request_context.set(RequestContext(mux_model_id))
+        span = None
+        if ctx is not None:
+            span = request_trace.start_exec_span(
+                ctx, f"exec:{self._deployment or method_name}")
+        t_exec = time.time()
+        if ctx is not None:
+            ctx.phases[RQ_EXEC_START] = t_exec
+        stream_error = False
+
+        def _first_item():
+            if ctx is not None and ctx.phases[RQ_FIRST_ITEM] is None:
+                ctx.stamp(RQ_FIRST_ITEM)
         try:
             target = self._target_for(method_name)
             result = target(*args, **kwargs)
@@ -293,6 +395,7 @@ class ReplicaActor:
                             result.__anext__(), deadline_ts)
                     except StopAsyncIteration:
                         break
+                    _first_item()
                     yield item
             elif inspect.isgenerator(result):
                 # Pull sync generators on the executor so a handler that
@@ -303,7 +406,7 @@ class ReplicaActor:
                 # which would break get_multiplexed_model_id() in the body.
                 import contextvars
                 loop = asyncio.get_running_loop()
-                ctx = contextvars.copy_context()
+                cvars = contextvars.copy_context()
 
                 def _next():
                     try:
@@ -317,13 +420,31 @@ class ReplicaActor:
                         raise RequestTimeoutError(
                             self._deployment, where="replica (stream)")
                     ok, item = await loop.run_in_executor(
-                        None, lambda: ctx.run(_next))
+                        None, lambda: cvars.run(_next))
                     if not ok:
                         break
+                    _first_item()
                     yield item
             else:
+                _first_item()
                 yield result
+        except ServeError:
+            stream_error = True
+            raise
+        except (GeneratorExit, asyncio.CancelledError):
+            stream_error = True  # caller went away: neither ok nor error
+            raise
+        except BaseException:
+            stream_error = True
+            self._account_exec(t_exec, error=True)
+            raise
         finally:
+            if not stream_error:
+                if ctx is not None:
+                    ctx.stamp(RQ_EXEC_END)
+                self._account_exec(t_exec, error=False)
+            request_trace.finish_exec_span(span)
+            self._finish_request_trace(ctx)
             _request_context.reset(token)
             self._release_slot()
 
@@ -331,6 +452,8 @@ class ReplicaActor:
         return {"ongoing": self._ongoing, "queued": self._queued,
                 "total": self._total, "shed": self._shed,
                 "timeouts": self._timeouts,
+                "completed": self._completed, "slow": self._slow,
+                "errors": self._errors,
                 "draining": float(self._draining)}
 
     async def check_health(self) -> bool:

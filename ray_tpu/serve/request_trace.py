@@ -1,0 +1,357 @@
+"""Request-scoped serve tracing: one trace per request, proxy to TPU task.
+
+A request entering any serve ingress (HTTP proxy, binary-RPC proxy,
+websocket upgrade) — or created directly on a DeploymentHandle — mints a
+`RequestTrace`: a request id, a trace id, and a root span. The context
+rides every hop:
+
+  proxy --(contextvar)--> DeploymentHandle._PendingRequest
+        --(wire tuple on handle_request)--> replica
+        --(util.tracing contextvar / TaskSpec.trace_ctx)--> any tasks or
+          nested handle calls the handler spawns.
+
+Each hop stamps the request phases it owns (flightrec.REQ_PHASE_ORDER)
+into a fixed-index record and ships ONE `kind:"serve_request"` event
+through this module's EventRing (the PR 5 ring — fixed slots, O(1)
+drop-oldest) to the GCS task-event buffer, where `flightrec.build_trace`
+renders the whole request as a single chrome trace crossing proxy,
+replica, and spawned-task pids, and `latency_summary` folds it into the
+/api/latency + `ray_tpu summary` tables. A replayed request (PR 6
+queue-preserving failover) stays ONE trace: the handle records an
+explicit `replay` hop + span, and the replica's result-cache dedupe
+keeps exec spans exactly-once.
+
+Sampling: `RAY_TPU_SERVE_TRACE_SAMPLE` = N records 1 in N requests
+(default 1 = every request; 0 disables recording entirely). The sampled
+bit is decided ONCE at mint time and travels with the context, so a
+request is either fully traced on every hop or not at all — never a
+torn trace. Replica-side SLO counters (serve/slo.py inputs) are NOT
+sampled; they count every request regardless.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu._private.flightrec import (  # noqa: F401 — re-exported
+    EventRing, REQ_PHASE_ORDER, REQ_RECORD_LEN, RQ_ADMISSION, RQ_DISPATCH,
+    RQ_EXEC_END, RQ_EXEC_START, RQ_FIRST_ITEM, RQ_PROXY_RECV,
+    RQ_QUEUE_WAIT, RQ_REPLY, request_phase_durations)
+
+_SAMPLE_ENV = "RAY_TPU_SERVE_TRACE_SAMPLE"
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_request_trace", default=None)
+
+_sample_n = None        # resolved lazily from the env (tests override)
+_sample_counter = 0
+_sample_lock = threading.Lock()
+
+
+def sample_n() -> int:
+    global _sample_n
+    if _sample_n is None:
+        try:
+            _sample_n = max(0, int(os.environ.get(_SAMPLE_ENV, "1")))
+        except ValueError:
+            _sample_n = 1
+    return _sample_n
+
+
+def set_sample_n(n: Optional[int]) -> None:
+    """Override the sampling rate for this process (None = re-read the
+    env). 0 disables request tracing; N records 1 in N requests."""
+    global _sample_n
+    _sample_n = None if n is None else max(0, int(n))
+
+
+def _sampled() -> bool:
+    """One coin flip per minted request: strict round-robin 1-in-N."""
+    n = sample_n()
+    if n <= 0:
+        return False
+    if n == 1:
+        return True
+    global _sample_counter
+    with _sample_lock:
+        _sample_counter += 1
+        return _sample_counter % n == 1
+
+
+class RequestTrace:
+    """Per-request trace context: ids + the hop-local phase record.
+
+    `request_id` is unique per request; `trace_id` is the ROOT request's
+    id (nested handle calls inside a handler inherit it), which is what
+    groups every hop, replay, and spawned-task span into one trace."""
+
+    __slots__ = ("request_id", "trace_id", "parent_span_id", "sampled",
+                 "deployment", "phases", "replays", "root_span", "owned",
+                 "_done")
+
+    def __init__(self, request_id: str, trace_id: str,
+                 parent_span_id: str = "", sampled: bool = True,
+                 deployment: str = ""):
+        self.request_id = request_id
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self.sampled = sampled
+        self.deployment = deployment
+        self.phases = [None] * REQ_RECORD_LEN
+        self.replays = 0
+        self.root_span: Optional[dict] = None
+        # True on the hop that minted this context — that hop records the
+        # trace's root event/span at finish(); non-minting hops must not.
+        self.owned = False
+        self._done = False
+
+    # -- phase stamps ---------------------------------------------------
+    def stamp(self, idx: int, t: Optional[float] = None) -> float:
+        t = time.time() if t is None else t
+        self.phases[idx] = t
+        return t
+
+    # -- wire form (handle -> replica) ----------------------------------
+    def wire(self) -> Tuple[str, str, str, bool]:
+        return (self.request_id, self.trace_id, self.parent_span_id,
+                self.sampled)
+
+    @classmethod
+    def from_wire(cls, w, deployment: str = "") -> "RequestTrace":
+        request_id, trace_id, parent, sampled = w
+        return cls(request_id, trace_id, parent, sampled, deployment)
+
+    # -- replay marker --------------------------------------------------
+    def record_replay(self, reason: str = "") -> None:
+        """One failover re-dispatch: keeps the request a single trace
+        with an explicit `replay` hop (event + span)."""
+        self.replays += 1
+        if not self.sampled:
+            return
+        now = time.time()
+        record_event(self, "replay", phases=None, t=now)
+        from ray_tpu.util import tracing
+        tracing.export_span({
+            "kind": "span", "trace_id": self.trace_id,
+            "span_id": os.urandom(8).hex(),
+            "parent_id": self.parent_span_id,
+            "name": "replay", "task_id": self.request_id,
+            "start": now, "end": now, "pid": os.getpid(),
+            "reason": reason[:200],
+        })
+
+
+def mint(deployment: str, request_id: str = "",
+         hop: str = "proxy") -> RequestTrace:
+    """New trace context at an entry point. Inside an already-traced
+    handler (nested handle call) the child inherits the ACTIVE trace —
+    one request stays one tree across deployment graphs.
+
+    `request_id` may be client-supplied (X-Request-Id) — it names the
+    trace only; replay dedupe uses a private id (handle.py)."""
+    from ray_tpu.util import tracing
+    rid = (request_id or "")[:64] or os.urandom(8).hex()
+    # Adopt ONLY a serve exec span (a replica handler making a nested
+    # handle call) — identified by the marker start_exec_span sets.
+    # Neither tracing.current_context() (fabricates a fresh random trace
+    # whenever tracing.enable() is on) nor a bare active span (task
+    # spans LEAK into the proxy's connection-handler context through
+    # asyncio.start_server when a traced control task started the
+    # server) is safe to adopt: both sever the request id from the span
+    # tree.
+    span = tracing.active_span()
+    if span is not None and span.get("serve_exec"):
+        ctx = RequestTrace(rid, span["trace_id"], span["span_id"],
+                           sampled=sample_n() > 0, deployment=deployment)
+        ctx.owned = True
+        return ctx
+    ctx = RequestTrace(rid, rid, "", sampled=_sampled(),
+                       deployment=deployment)
+    ctx.owned = True
+    if ctx.sampled:
+        ctx.root_span = {
+            "kind": "span", "trace_id": ctx.trace_id,
+            "span_id": os.urandom(8).hex(), "parent_id": "",
+            "name": f"request:{deployment}" if deployment else "request",
+            "task_id": rid, "start": time.time(), "end": None,
+            "pid": os.getpid(), "hop": hop,
+        }
+        ctx.parent_span_id = ctx.root_span["span_id"]
+    return ctx
+
+
+def finish(ctx: Optional[RequestTrace], hop: str) -> None:
+    """Close out the minting hop: stamp `reply` if the hop hasn't,
+    record the hop event, and export the root span. Idempotent — replay
+    loops and settle callbacks may race to call it."""
+    if ctx is None or not ctx.sampled or ctx._done:
+        return
+    ctx._done = True
+    if ctx.phases[RQ_REPLY] is None:
+        ctx.stamp(RQ_REPLY)
+    record_event(ctx, hop, phases=list(ctx.phases))
+    if ctx.root_span is not None:
+        from ray_tpu.util import tracing
+        span, ctx.root_span = ctx.root_span, None
+        tracing.export_span(span)
+
+
+# -- contextvar plumbing (proxy -> handle, same process) ---------------
+
+def bind(ctx: Optional[RequestTrace]):
+    return _current.set(ctx)
+
+
+def unbind(token) -> None:
+    _current.reset(token)
+
+
+def current() -> Optional[RequestTrace]:
+    return _current.get()
+
+
+# -- replica-side span helpers -----------------------------------------
+
+def start_exec_span(ctx: RequestTrace, name: str) -> Optional[dict]:
+    """Open the replica exec span AND make it the active tracing span,
+    so tasks / nested handle calls the handler spawns parent under it
+    (TaskSpec.trace_ctx rides the existing contextvar machinery)."""
+    if not ctx.sampled:
+        return None
+    from ray_tpu.util import tracing
+    span = tracing.start_span(name, (ctx.trace_id, ctx.parent_span_id),
+                              ctx.request_id)
+    span["serve_exec"] = True  # mint() adopts ONLY these (nested calls)
+    return span
+
+
+def finish_exec_span(span: Optional[dict]) -> None:
+    if span is None:
+        return
+    from ray_tpu.util import tracing
+    tracing.export_span(tracing.end_span(span))
+
+
+# -- event ring + flush -------------------------------------------------
+
+_ring = EventRing(8192)
+_flush_lock = threading.Lock()
+_flush_core = None          # core whose loop runs the current flusher
+
+
+def record_event(ctx: RequestTrace, hop: str,
+                 phases: Optional[list] = None,
+                 t: Optional[float] = None) -> None:
+    """One hop's request event into the ring (skipped unsampled), plus
+    the per-deployment phase histograms."""
+    if not ctx.sampled:
+        return
+    if phases is not None:
+        _observe_phases(ctx.deployment, phases)
+    _ring.record(ctx.request_id, ctx.trace_id, ctx.deployment, hop,
+                 tuple(phases) if phases is not None else None,
+                 ctx.replays, time.time() if t is None else t, None)
+    _ensure_flusher()
+
+
+def _fold(rec) -> dict:
+    rid, trace_id, deployment, hop, phases, replays, t, _spare = rec
+    out = {
+        "kind": "serve_request", "request_id": rid, "trace_id": trace_id,
+        "deployment": deployment, "hop": hop, "time": t,
+        "pid": os.getpid(),
+    }
+    if phases is not None:
+        out["phases"] = list(phases)
+    if replays:
+        out["replays"] = replays
+    return out
+
+
+def _ensure_flusher() -> None:
+    """Start (or restart after shutdown) the flush loop on the core
+    worker's event loop. Records made before any core exists just wait
+    in the ring — capacity-bounded, drop-oldest."""
+    global _flush_core
+    from ray_tpu._private import worker_api
+    core = worker_api.peek_core()
+    if core is None or getattr(core, "_shutdown", False):
+        return
+    if _flush_core is core:  # hot path: flusher already running
+        return
+    with _flush_lock:
+        if _flush_core is core:
+            return
+        _flush_core = core
+    try:
+        core.loop.call_soon_threadsafe(
+            lambda: asyncio.ensure_future(_flush_loop(core)))
+    except RuntimeError:
+        with _flush_lock:
+            _flush_core = None
+
+
+async def _flush_loop(core) -> None:
+    global _flush_core
+    try:
+        while not getattr(core, "_shutdown", False):
+            await asyncio.sleep(0.5)
+            await flush_now(core)
+    finally:
+        with _flush_lock:
+            if _flush_core is core:
+                _flush_core = None
+
+
+async def flush_now(core) -> int:
+    """Drain the ring to the GCS task-event buffer; returns rows sent."""
+    if core.gcs is None or core.gcs.closed:
+        return 0
+    buf = _ring.drain()
+    if not buf:
+        return 0
+    events = [_fold(r) for r in buf]
+    try:
+        await core.gcs.request("report_task_events", {"events": events})
+    except Exception:  # noqa: BLE001 — ring re-fills; next tick retries
+        return 0
+    return len(events)
+
+
+# -- per-deployment phase histograms ------------------------------------
+
+REQ_PHASE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+_hist_slots: Dict[Tuple[str, str], Any] = {}
+_hist_gen = -1
+
+
+def _observe_phases(deployment: str, phases) -> None:
+    """Fold one hop's stamps into ray_tpu_serve_request_phase_seconds
+    (Deployment x Phase), slot-cached like the task-phase fold."""
+    global _hist_gen
+    from ray_tpu.util import metrics as _m
+    if _hist_gen != _m._generation:
+        _hist_gen = _m._generation
+        _hist_slots.clear()
+    hist = None
+    for phase, d in request_phase_durations(phases):
+        slot = _hist_slots.get((deployment, phase))
+        if slot is None:
+            if hist is None:
+                hist = _m.Histogram(
+                    "ray_tpu_serve_request_phase_seconds",
+                    "serve request phase latency (request flight "
+                    "recorder): proxy_recv/admission/queue_wait/"
+                    "dispatch/exec/first_item/reply gaps per hop",
+                    boundaries=REQ_PHASE_BUCKETS,
+                    tag_keys=("Deployment", "Phase"))
+            slot = hist._slot({"Deployment": deployment, "Phase": phase})
+            _hist_slots[(deployment, phase)] = slot
+        _m.observe_into(slot, d)

@@ -1,0 +1,175 @@
+"""SLO burn-rate engine: the controller-side consumer of the request
+observability plane.
+
+Inputs are the per-replica SLO counters the controller already polls for
+autoscaling (`ReplicaActor.get_metrics`: cumulative completed / slow /
+errors / shed / timeouts — counted for EVERY request, independent of
+trace sampling; the counters are themselves fed by the same request
+phase stamps that build `ray_tpu_serve_request_phase_seconds`). The
+engine turns cumulative snapshots into per-poll deltas, accumulates them
+into one-second buckets, and evaluates the classic multi-window
+burn-rate condition:
+
+    burn(w) = bad_fraction(w) / (1 - slo)
+
+A deployment is VIOLATING when both the fast and the slow window burn
+above `SLOConfig.burn_threshold` (fast alone = maybe a blip; slow alone
+= an old episode still draining out of the window). Violations export as
+`ray_tpu_serve_slo_burn_rate{Deployment,Window}` gauges plus a
+`ray_tpu_serve_slo_violations_total` edge counter, and — when the
+deployment autoscales — drive a scale-up BEFORE the bounded replica
+queue ever sheds a request (serve/controller.py `_autoscale`).
+
+Replica restarts are absorbed: a cumulative counter that goes BACKWARDS
+(fresh replica, id reuse) clamps its delta to the new absolute value.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, Optional, Tuple
+
+# Cumulative replica counters the engine consumes. `completed` counts
+# finished execs (success or app error); shed/timeouts never reach exec,
+# so total = completed + shed + timeouts and the bad categories are
+# disjoint by construction (replica.py _account_exec).
+_TOTAL_KEYS = ("completed", "shed", "timeouts")
+_BAD_KEYS = ("slow", "errors", "shed", "timeouts")
+_KEYS = ("completed", "slow", "errors", "shed", "timeouts")
+
+
+class _WindowRing:
+    """One-second (total, bad) buckets over the longest window — O(1)
+    add, O(window) sum (windows are <= minutes; the controller polls
+    twice a second at most)."""
+
+    def __init__(self, span_s: float):
+        self._n = max(2, int(math.ceil(span_s)) + 1)
+        self._total = [0.0] * self._n
+        self._bad = [0.0] * self._n
+        self._stamps = [0.0] * self._n   # bucket epoch-second or 0
+
+    def add(self, now: float, total: float, bad: float) -> None:
+        sec = int(now)
+        i = sec % self._n
+        if self._stamps[i] != sec:
+            self._stamps[i] = sec
+            self._total[i] = 0.0
+            self._bad[i] = 0.0
+        self._total[i] += total
+        self._bad[i] += bad
+
+    def sums(self, now: float, window_s: float) -> Tuple[float, float]:
+        lo = int(now) - int(math.ceil(window_s)) + 1
+        total = bad = 0.0
+        for i in range(self._n):
+            if self._stamps[i] >= lo and self._stamps[i] <= int(now):
+                total += self._total[i]
+                bad += self._bad[i]
+        return total, bad
+
+
+def _burn_gauge():
+    from ray_tpu.util import metrics
+    return metrics.Gauge(
+        "ray_tpu_serve_slo_burn_rate",
+        "error-budget burn rate per SLO window (bad_fraction / "
+        "(1 - slo)); sustained burn above the deployment's threshold "
+        "in BOTH windows is an SLO violation",
+        tag_keys=("Deployment", "Window"))
+
+
+def _violations_counter():
+    from ray_tpu.util import metrics
+    return metrics.Counter(
+        "ray_tpu_serve_slo_violations_total",
+        "SLO violation episodes (multi-window burn crossed the "
+        "threshold): each count is one False->True edge",
+        tag_keys=("Deployment",))
+
+
+class DeploymentSLO:
+    """Burn-rate state for one deployment."""
+
+    def __init__(self, deployment: str, cfg):
+        self.deployment = deployment
+        self.cfg = cfg
+        self._last: Dict[str, Dict[str, float]] = {}
+        self._ring = _WindowRing(cfg.slow_window_s)
+        self.burn_fast = 0.0
+        self.burn_slow = 0.0
+        self.violating = False
+        self.violations = 0
+
+    # ------------------------------------------------------------------
+    def ingest(self, replica_metrics: Dict[str, dict],
+               now: Optional[float] = None) -> None:
+        """Fold one controller poll: cumulative snapshots -> deltas ->
+        window buckets. `replica_metrics` maps replica_id -> the dict
+        ReplicaActor.get_metrics returned (replicas that failed the poll
+        are simply absent — their counts arrive with the next poll)."""
+        now = time.time() if now is None else now
+        total_d = bad_d = 0.0
+        for rid, m in replica_metrics.items():
+            prev = self._last.get(rid)
+            cur = {k: float(m.get(k, 0.0)) for k in _KEYS}
+            if prev is None:
+                # First sight of this replica (fresh engine after a
+                # controller restart / redeploy, or a fresh replica):
+                # its cumulative counters cover an UNKNOWN span of time,
+                # so charging them into one second-bucket would let
+                # hours-old history trip an instant dual-window
+                # violation. Record the baseline; deltas start next poll.
+                self._last[rid] = cur
+                continue
+            delta = {}
+            for k in _KEYS:
+                d = cur[k] - prev[k]
+                # Restarted replica (counter reset): charge the new
+                # absolute value, never a negative delta.
+                delta[k] = cur[k] if d < 0 else d
+            self._last[rid] = cur
+            total_d += sum(delta[k] for k in _TOTAL_KEYS)
+            bad_d += sum(delta[k] for k in _BAD_KEYS)
+        # Forget replicas no longer reporting (retired/dead).
+        gone = set(self._last) - set(replica_metrics)
+        for rid in gone:
+            del self._last[rid]
+        self._ring.add(now, total_d, min(bad_d, total_d))
+
+    # ------------------------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        """Recompute burn rates; returns {"fast","slow","violating",
+        "new_violation"} and exports the gauges/counter."""
+        now = time.time() if now is None else now
+        budget = max(1e-9, 1.0 - self.cfg.slo)
+
+        def burn(window_s: float, min_samples: int) -> float:
+            total, bad = self._ring.sums(now, window_s)
+            if total < max(1, min_samples):
+                return 0.0
+            return (bad / total) / budget
+
+        self.burn_fast = burn(self.cfg.fast_window_s, self.cfg.min_samples)
+        self.burn_slow = burn(self.cfg.slow_window_s, self.cfg.min_samples)
+        was = self.violating
+        self.violating = (self.burn_fast > self.cfg.burn_threshold
+                          and self.burn_slow > self.cfg.burn_threshold)
+        new_violation = self.violating and not was
+        if new_violation:
+            self.violations += 1
+        try:
+            g = _burn_gauge()
+            g.set(self.burn_fast, tags={"Deployment": self.deployment,
+                                        "Window": "fast"})
+            g.set(self.burn_slow, tags={"Deployment": self.deployment,
+                                        "Window": "slow"})
+            if new_violation:
+                _violations_counter().inc(
+                    tags={"Deployment": self.deployment})
+        except Exception:  # noqa: BLE001 — metrics must not fail control
+            pass
+        return {"fast": self.burn_fast, "slow": self.burn_slow,
+                "violating": self.violating,
+                "new_violation": new_violation}

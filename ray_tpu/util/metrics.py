@@ -252,6 +252,35 @@ def start_loop_lag_probe(process: str, interval: float = 0.2):
     return asyncio.ensure_future(_loop_lag_loop(process, interval))
 
 
+# Probe kinds already running in THIS process. Serve daemons (replicas,
+# proxies, the controller) start their probe from inside actor code, and
+# several of them can share one process (local mode, co-hosted actors) —
+# two probes under the same tag would double every lag sample in the
+# merge.
+_probe_kinds: set = set()
+
+
+def start_loop_lag_probe_once(process: str, interval: float = 0.2):
+    """start_loop_lag_probe, at most once per (process kind, OS process).
+    Returns the task on first start, None when already running or when
+    the calling thread has no running loop (callers retry from loop
+    context — e.g. a replica constructor runs on the exec pool, so the
+    probe starts with the first request instead)."""
+    import asyncio
+    if process in _probe_kinds:
+        return None
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return None
+    _probe_kinds.add(process)
+    try:
+        return start_loop_lag_probe(process, interval)
+    except Exception:
+        _probe_kinds.discard(process)
+        raise
+
+
 def to_prometheus(metrics: List[dict]) -> str:
     """Render merged metrics in Prometheus text exposition format."""
     lines: List[str] = []

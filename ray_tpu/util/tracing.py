@@ -41,6 +41,12 @@ def is_enabled() -> bool:
     return _enabled
 
 
+def active_span() -> Optional[dict]:
+    """The span currently open in this context, or None. Unlike
+    current_context(), never fabricates a fresh root."""
+    return _current_span.get()
+
+
 def current_context() -> Optional[tuple]:
     """(trace_id, span_id) to stamp onto an outgoing task spec, or None.
 
@@ -60,7 +66,7 @@ def start_span(name: str, trace_ctx: Optional[tuple], task_id: str) -> dict:
     span = {"kind": "span", "trace_id": trace_id,
             "span_id": os.urandom(8).hex(), "parent_id": parent,
             "name": name, "task_id": task_id, "start": time.time(),
-            "end": None}
+            "end": None, "pid": os.getpid()}
     token = _current_span.set(span)
     span["_token"] = token
     return span
@@ -72,6 +78,38 @@ def end_span(span: dict) -> dict:
     if token is not None:
         _current_span.reset(token)
     return {k: v for k, v in span.items()}
+
+
+# Spans recorded outside task execution (serve request roots, replica
+# exec spans, replay markers) buffer here when no core worker exists yet
+# (unit tests, pre-init); export_span drains it the moment a core is
+# reachable so nothing is lost across init ordering.
+_pending_spans: List[dict] = []
+
+
+def export_span(span: dict) -> None:
+    """Queue a FINISHED span for the GCS task-event channel.
+
+    Task spans flush through the executing core worker's buffer
+    automatically; this is the same path for spans recorded outside a
+    task (serve hops). Safe from any thread; a missing/closed core
+    worker just re-buffers (bounded) until one exists."""
+    if span.get("end") is None:
+        span = end_span(span)
+    try:
+        from ray_tpu._private import worker_api
+        core = worker_api.peek_core()
+        buf = core._span_events if core is not None else None
+    except Exception:  # noqa: BLE001 — import cycle during teardown
+        buf = None
+    if buf is None:  # no core yet (unit tests, pre-init): hold the span
+        _pending_spans.append(span)
+        del _pending_spans[:-2000]
+        return
+    if _pending_spans:
+        buf.extend(_pending_spans)
+        del _pending_spans[:]
+    buf.append(span)
 
 
 def get_spans(trace_id: Optional[str] = None) -> List[Dict[str, Any]]:

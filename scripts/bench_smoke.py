@@ -155,41 +155,67 @@ def main() -> dict:
         h = serve.run(echo.bind(), name="bench_serve",
                       route_prefix="/bench_serve")
         h.remote(0).result(timeout=60)  # warm router + replicas
-        lat: list = []
         dropped = [0]
         lock = threading.Lock()
-        stop_at = time.perf_counter() + 2.0
 
-        def pump():
-            while time.perf_counter() < stop_at:
-                t0 = time.perf_counter()
-                try:
-                    h.remote(1).result(timeout=30)
-                    dt = time.perf_counter() - t0
-                    with lock:
-                        lat.append(dt)
-                except BackPressureError:
-                    with lock:
-                        dropped[0] += 1
-                except Exception:  # noqa: BLE001 — smoke keeps pumping
-                    pass
+        def sustained(duration: float):
+            """One 4-thread sustained-QPS burst -> (sorted lats, secs)."""
+            lat: list = []
+            stop_at = time.perf_counter() + duration
 
-        threads = [threading.Thread(target=pump) for _ in range(4)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(60)
-        elapsed = time.perf_counter() - t0
-        if lat:
+            def pump():
+                while time.perf_counter() < stop_at:
+                    t0 = time.perf_counter()
+                    try:
+                        h.remote(1).result(timeout=30)
+                        dt = time.perf_counter() - t0
+                        with lock:
+                            lat.append(dt)
+                    except BackPressureError:
+                        with lock:
+                            dropped[0] += 1
+                    except Exception:  # noqa: BLE001 — keep pumping
+                        pass
+
+            threads = [threading.Thread(target=pump) for _ in range(4)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
             lat.sort()
+            return lat, time.perf_counter() - t0
+
+        # A/B: request tracing sampled 1-in-1 vs fully off. The sampled
+        # bit is minted caller-side and rides the wire, so toggling it
+        # here switches replica-side recording too. A warm-up burst
+        # first: the traced leg runs first, and without it the delta
+        # would mostly measure cold leases/JIT, not tracing.
+        from ray_tpu.serve import request_trace
+        request_trace.set_sample_n(0)
+        sustained(0.8)
+        request_trace.set_sample_n(1)
+        lat, elapsed = sustained(2.0)
+        if lat:
             out["serve_qps"] = round(len(lat) / elapsed, 1)
             out["serve_p99_ms"] = round(
                 lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1e3, 2)
+        request_trace.set_sample_n(0)
+        lat_off, elapsed_off = sustained(2.0)
+        request_trace.set_sample_n(None)
+        if lat and lat_off:
+            qps_on = len(lat) / elapsed
+            qps_off = len(lat_off) / elapsed_off
+            # Positive = tracing costs throughput.
+            out["serve_trace_overhead_pct"] = round(
+                (qps_off - qps_on) / qps_off * 100.0, 1)
+        else:
+            out["serve_trace_overhead_pct"] = 0.0
         out["serve_requests_dropped"] = dropped[0]
         log(f"serve: {out.get('serve_qps', 0):,.0f} req/s, "
             f"p99 {out.get('serve_p99_ms', 0):.1f} ms, "
-            f"{dropped[0]} shed")
+            f"{dropped[0]} shed, trace overhead "
+            f"{out['serve_trace_overhead_pct']:+.1f}%")
         serve.shutdown()
     except Exception as e:  # noqa: BLE001
         log(f"serve phase skipped: {type(e).__name__}: {e}")

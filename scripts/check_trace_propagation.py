@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Static check: every serve entry point forwards the request trace.
+
+The request observability plane only works if EVERY ingress mints/binds
+a RequestTrace and every dispatch path ships it to the replica: one
+entry point that forgets produces silently truncated traces (a request
+that "disappears" at the proxy), which is exactly the failure mode this
+plane exists to kill. Same philosophy as check_rpc_idempotency: the
+invariant is structural, so enforce it structurally — AST-scoped
+source checks, no imports of the package, runs in milliseconds.
+
+Checked invariants:
+  * each proxy ingress (HTTP conn handler, websocket upgrade, binary-RPC
+    unary/stream) mints AND binds a request trace;
+  * the handle adopts the bound context (or mints) in _make_request, and
+    both submit paths stamp/forward it to the replica;
+  * the replica accepts the wire context on both request methods;
+  * nobody dispatches to a replica around the forwarding submitters
+    (raw `handle_request*.remote(` outside handle.py's _submit pair).
+
+Exit status 0 = fully wired; 1 = gaps (printed).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (file, class, function, [required regexes], why)
+RULES = [
+    ("ray_tpu/serve/proxy.py", "ProxyActor", "_handle_conn",
+     [r"request_trace\.mint\(", r"request_trace\.bind\(",
+      r"request_trace\.finish\("],
+     "HTTP ingress must mint+bind+finish the request trace"),
+    ("ray_tpu/serve/proxy.py", "ProxyActor", "_handle_websocket",
+     [r"request_trace\.mint\(", r"request_trace\.bind\(",
+      r"request_trace\.finish\("],
+     "websocket ingress must mint+bind+finish the request trace"),
+    ("ray_tpu/serve/grpc_proxy.py", "GrpcProxyActor", "_rpc_unary",
+     [r"request_trace\.mint\(", r"request_trace\.bind\(",
+      r"request_trace\.finish\("],
+     "binary-RPC unary ingress must mint+bind+finish the request trace"),
+    ("ray_tpu/serve/grpc_proxy.py", "GrpcProxyActor", "_rpc_stream",
+     [r"request_trace\.mint\(", r"request_trace\.bind\(",
+      r"request_trace\.finish\("],
+     "binary-RPC stream ingress must mint+bind+finish the request trace"),
+    ("ray_tpu/serve/handle.py", "DeploymentHandle", "_make_request",
+     [r"request_trace\.current\(", r"request_trace\.mint\("],
+     "the handle must adopt the bound ingress context or mint one"),
+    ("ray_tpu/serve/handle.py", "DeploymentHandle", "_submit",
+     [r"_stamp_dispatch\(", r"trace_ctx"],
+     "unary dispatch must stamp+forward the trace to the replica"),
+    ("ray_tpu/serve/handle.py", "DeploymentHandle", "_submit_stream",
+     [r"_stamp_dispatch\(", r"trace_ctx"],
+     "streaming dispatch must stamp+forward the trace to the replica"),
+    ("ray_tpu/serve/replica.py", "ReplicaActor", "handle_request",
+     [r"trace_ctx", r"_trace_ctx\("],
+     "the replica must accept and decode the wire trace context"),
+    ("ray_tpu/serve/replica.py", "ReplicaActor", "handle_request_streaming",
+     [r"trace_ctx", r"_trace_ctx\("],
+     "the streaming replica path must accept the wire trace context"),
+]
+
+# Raw replica dispatch is allowed ONLY in the forwarding submitters.
+_RAW_DISPATCH = re.compile(r"handle_request(_streaming)?\s*(\.options\("
+                           r"[^)]*\))?\s*\.remote\(")
+_DISPATCH_ALLOWED = {("ray_tpu/serve/handle.py", "_submit"),
+                     ("ray_tpu/serve/handle.py", "_submit_stream")}
+
+
+def _function_sources(path: str):
+    """{(class_name, fn_name): source_segment} for one file."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    tree = ast.parse(text)
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    out[(node.name, item.name)] = (
+                        ast.get_source_segment(text, item) or "",
+                        item.lineno)
+    return out, text
+
+
+def check() -> list:
+    problems = []
+    cache = {}
+    for rel, cls, fn, patterns, why in RULES:
+        path = os.path.join(REPO, rel)
+        if rel not in cache:
+            try:
+                cache[rel] = _function_sources(path)
+            except (OSError, SyntaxError) as e:
+                problems.append(f"{rel}: unreadable ({e})")
+                cache[rel] = ({}, "")
+                continue
+        funcs, _text = cache[rel]
+        ent = funcs.get((cls, fn))
+        if ent is None:
+            problems.append(
+                f"{rel}: {cls}.{fn} not found — entry point renamed? "
+                f"update check_trace_propagation.py ({why})")
+            continue
+        src, lineno = ent
+        for pat in patterns:
+            if not re.search(pat, src):
+                problems.append(
+                    f"{rel}:{lineno}: {cls}.{fn} does not match "
+                    f"/{pat}/ — {why}")
+    # No raw replica dispatch outside the forwarding submitters.
+    serve_dir = os.path.join(REPO, "ray_tpu", "serve")
+    for fname in sorted(os.listdir(serve_dir)):
+        if not fname.endswith(".py"):
+            continue
+        rel = f"ray_tpu/serve/{fname}"
+        path = os.path.join(serve_dir, fname)
+        try:
+            funcs, _text = cache.get(rel) or _function_sources(path)
+        except (OSError, SyntaxError):
+            continue
+        for (cls, fn), (src, lineno) in funcs.items():
+            if (rel, fn) in _DISPATCH_ALLOWED:
+                continue
+            if _RAW_DISPATCH.search(src):
+                problems.append(
+                    f"{rel}:{lineno}: {cls}.{fn} dispatches to a replica "
+                    f"directly — route through DeploymentHandle._submit/"
+                    f"_submit_stream so the request trace is forwarded")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(f"\n{len(problems)} trace-propagation gap(s); every serve "
+              f"entry point must mint/bind the request trace and every "
+              f"dispatch path must forward it.", file=sys.stderr)
+        return 1
+    print(f"request-trace propagation wired "
+          f"({len(RULES)} entry points checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,8 +34,11 @@ def test_bench_smoke_completes(jax_cpu):
     # serve_requests_dropped is the serve-trajectory row: its presence
     # proves the serve request path (deploy, route, admission control)
     # ran end to end in the smoke.
+    # serve_trace_overhead_pct proves the request-tracing A/B (sampled
+    # 1-in-1 vs off) ran over the sustained-QPS serve phase.
     for key in ("multi_client_tasks_async", "n_n_actor_calls",
-                "pg_create_ms", "serve_requests_dropped"):
+                "pg_create_ms", "serve_requests_dropped",
+                "serve_trace_overhead_pct"):
         assert key in row, (key, row)
     # Hot-path allocation tripwire: a steady-state `.remote()` call must
     # stay a small, bounded number of allocations (measured ~19 blocks

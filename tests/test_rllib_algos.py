@@ -197,9 +197,15 @@ def test_marwil_beats_bc_weighting(ray_rl, jax_cpu, tmp_path):
     assert ev["evaluation_reward_mean"] > 60, ev
 
 
+@pytest.mark.timeout(100)
 def test_a2c_learns_cartpole(ray_rl, jax_cpu):
     """A2C (vanilla advantage policy gradient, one on-policy step per
-    batch) improves CartPole returns (reference: rllib/algorithms/a2c)."""
+    batch) improves CartPole returns (reference: rllib/algorithms/a2c).
+
+    Cost-capped: in a long full-suite process this test bimodally either
+    finishes in well under a minute or wedges past it (env-runner actors
+    starved in the accumulated-state process) — the default 180s budget
+    let the wedge mode eat 3 minutes of tier-1 for the same failure."""
     from ray_tpu.rllib import A2CConfig
 
     algo = (A2CConfig()

@@ -1,0 +1,410 @@
+"""Request-scoped serve tracing + SLO burn-rate autoscaling.
+
+The two acceptance properties of the request observability plane:
+
+  1. ONE request id yields ONE trace crossing proxy -> handle ->
+     replica -> spawned-task pids — including across a PR 6 replay hop
+     (replica killed mid-request), with an explicit `replay` span and
+     exactly-once exec spans.
+  2. The controller scales a deployment UP on SLO burn rate before the
+     bounded queue sheds a single request.
+
+Plus deterministic unit coverage of the burn-rate math and the sampling
+knob (no cluster).
+"""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve import request_trace
+from ray_tpu.serve.config import SLOConfig
+from ray_tpu.serve.slo import DeploymentSLO, _WindowRing
+
+
+@pytest.fixture(scope="module")
+def ray_mod():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def serve_app(ray_mod):
+    yield serve
+    try:
+        for app in list(serve.status().keys()):
+            serve.delete(app)
+    except Exception:
+        pass
+
+
+def _controller():
+    from ray_tpu.serve.api import _get_controller
+    return _get_controller()
+
+
+def _replica_handles(app: str, dep: str):
+    ctrl = _controller()
+    _v, reps = ray_tpu.get(ctrl.get_replicas.remote(app, dep), timeout=30)
+    return reps
+
+
+def _wait_ready(app: str, dep: str, n: int, timeout: float = 90):
+    ctrl = _controller()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = ray_tpu.get(ctrl.status.remote(), timeout=30)
+        if st.get(app, {}).get(dep, {}).get("ready", 0) >= n:
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def _raw_events():
+    from ray_tpu._private import worker_api
+    core = worker_api.get_core()
+    return worker_api._call_on_core_loop(
+        core, core.gcs.request("get_task_events", {"limit": 100000}), 30)
+
+
+# ---------------------------------------------------------------------------
+# acceptance 1: single trace across proxy/replica/spawned-task + replay
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(180)
+def test_single_trace_spans_proxy_replica_task_with_replay(serve_app):
+    """Kill the serving replica mid-request (request_replay on): the
+    retained request replays to the survivor and the WHOLE story — both
+    hops, the replay marker, the handler's spawned task — is one trace
+    under the client's request id, with exactly one exec span (the
+    killed attempt never exported one; a completed-then-replayed attempt
+    is answered from the replica result cache without re-executing)."""
+    import asyncio as _a  # noqa: F401 — handler body runs remotely
+
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @serve.deployment(num_replicas=2, request_replay=True)
+    class Traced:
+        async def __call__(self, req):
+            import asyncio
+            v = await child.remote(1)
+            await asyncio.sleep(1.2)
+            return v
+
+    serve.start(http_options=serve.HTTPOptions(port=8151))
+    serve.run(Traced.bind(), name="trace1", route_prefix="/trace1")
+    assert _wait_ready("trace1", "Traced", 2)
+
+    rid = "feedc0de00112233"
+    result = {}
+
+    def fire():
+        req = urllib.request.Request("http://127.0.0.1:8151/trace1",
+                                     headers={"X-Request-Id": rid})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            result["status"] = r.status
+            result["body"] = r.read()
+            result["rid"] = r.headers.get("X-Request-Id")
+
+    t = threading.Thread(target=fire)
+    t.start()
+
+    # Find the replica executing the request and kill it mid-handler.
+    victim = None
+    deadline = time.time() + 30
+    while victim is None and time.time() < deadline:
+        for rep in _replica_handles("trace1", "Traced"):
+            try:
+                m = ray_tpu.get(rep.get_metrics.remote(), timeout=5)
+            except Exception:
+                continue
+            if m.get("ongoing", 0) > 0:
+                victim = rep
+                break
+        time.sleep(0.05)
+    assert victim is not None, "request never started executing"
+    ray_tpu.kill(victim)
+
+    t.join(120)
+    assert result.get("status") == 200, result
+    assert result.get("body") == b"2", result
+    assert result.get("rid") == rid  # the response names its trace
+
+    # One trace: both hops, a replay hop, exactly one exec span, the
+    # spawned task's span — all under the request id.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        evs = _raw_events()
+        serve_evs = [e for e in evs if isinstance(e, dict)
+                     and e.get("kind") == "serve_request"
+                     and e.get("trace_id") == rid]
+        spans = [e for e in evs if isinstance(e, dict)
+                 and e.get("kind") == "span" and e.get("trace_id") == rid]
+        hops = [e["hop"] for e in serve_evs]
+        names = [s["name"] for s in spans]
+        if ({"proxy", "replica", "replay"} <= set(hops)
+                and "child" in names and "replay" in names
+                and any(n.startswith("request") for n in names)):
+            break
+        time.sleep(0.5)
+    assert {"proxy", "replica", "replay"} <= set(hops), hops
+    exec_spans = [s for s in spans if s["name"].startswith("exec:")]
+    assert len(exec_spans) == 1, [s["name"] for s in spans]
+    roots = [s for s in spans if s["parent_id"] == ""]
+    assert len(roots) == 1 and roots[0]["name"].startswith("request")
+    root_id = roots[0]["span_id"]
+    # Single tree: exec + replay parent directly under the root; the
+    # spawned task parents under the exec span.
+    assert exec_spans[0]["parent_id"] == root_id
+    replays = [s for s in spans if s["name"] == "replay"]
+    assert replays and all(s["parent_id"] == root_id for s in replays)
+    child_spans = [s for s in spans if s["name"] == "child"]
+    assert any(s["parent_id"] == exec_spans[0]["span_id"]
+               for s in child_spans)
+
+    # Chrome trace: the request crosses >= 3 pids (proxy process,
+    # replica process, spawned-task worker) and carries the replay.
+    from ray_tpu._private import flightrec
+    trace = flightrec.build_trace(evs)
+    rows = [r for r in trace if r.get("request_id") == rid]
+    pids = {r["pid"] for r in rows}
+    assert len(pids) >= 3, (pids, rows)
+    assert any(r["name"] == "replay" for r in rows)
+    # The timeline rendering joins hops with flow arrows.
+    assert any(r.get("cat") == "serve_flow" and r["ph"] == "s"
+               for r in rows)
+    assert any(r.get("cat") == "serve_flow" and r["ph"] == "f"
+               for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# acceptance 2: burn-rate upscale fires before the queue sheds
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(180)
+def test_slo_burn_scales_up_before_shedding(serve_app):
+    """Every request breaches the latency target, so burn explodes in
+    both windows while the bounded queue stays far from full: the
+    controller must add a replica on burn — and zero requests shed."""
+
+    @serve.deployment(
+        num_replicas=1, max_ongoing_requests=4, max_queued_requests=64,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3,
+            # Queue-depth policy effectively disabled: only burn scales.
+            target_ongoing_requests=1000.0, upscale_delay_s=999.0,
+            downscale_delay_s=999.0),
+        slo_config=SLOConfig(target_p99_s=0.005, slo=0.9,
+                             fast_window_s=1.0, slow_window_s=3.0,
+                             burn_threshold=1.5, min_samples=5,
+                             upscale_cooldown_s=1.0))
+    class Slow:
+        async def __call__(self, x):
+            import asyncio
+            await asyncio.sleep(0.08)  # >> 5ms target: 100% bad
+            return x
+
+    h = serve.run(Slow.bind(), name="slo1", route_prefix="/slo1")
+    assert _wait_ready("slo1", "Slow", 1)
+    h.remote(0).result(timeout=60)
+
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            try:
+                h.remote(1).result(timeout=30)
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=pump) for _ in range(6)]
+    for th in threads:
+        th.start()
+    try:
+        ctrl = _controller()
+        scaled = False
+        burn_seen = 0.0
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = ray_tpu.get(ctrl.status.remote(), timeout=30)
+            row = st.get("slo1", {}).get("Slow", {})
+            burn_seen = max(burn_seen,
+                            row.get("slo", {}).get("burn_fast", 0.0))
+            if row.get("target", 1) >= 2:
+                scaled = True
+                break
+            time.sleep(0.25)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(30)
+    assert scaled, f"no burn-driven upscale (max fast burn {burn_seen})"
+    assert burn_seen > 1.5
+    # Not a single request was shed: burn fired while the queue (6
+    # in-flight vs 64 allowed) was nowhere near its bound.
+    shed = 0
+    for rep in _replica_handles("slo1", "Slow"):
+        try:
+            shed += ray_tpu.get(rep.get_metrics.remote(),
+                                timeout=10).get("shed", 0)
+        except Exception:
+            pass
+    assert shed == 0
+    st = ray_tpu.get(ctrl.status.remote(), timeout=30)
+    assert st["slo1"]["Slow"]["slo"]["violations"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# unit: burn-rate math (no cluster)
+# ---------------------------------------------------------------------------
+
+def test_window_ring_sums_and_expiry():
+    ring = _WindowRing(5.0)
+    now = 1000.0
+    ring.add(now, 10, 1)
+    ring.add(now + 1, 10, 2)
+    ring.add(now + 2, 10, 3)
+    assert ring.sums(now + 2, 3.0) == (30, 6)
+    assert ring.sums(now + 2, 1.0) == (10, 3)
+    # Buckets age out of the window.
+    assert ring.sums(now + 10, 3.0) == (0, 0)
+    # Bucket reuse after wrap must reset stale contents.
+    ring.add(now + 6, 5, 5)   # same slot as now+1 for a 6-bucket ring
+    total, bad = ring.sums(now + 6, 1.0)
+    assert (total, bad) == (5, 5)
+
+
+def _cfg(**kw):
+    base = dict(target_p99_s=0.01, slo=0.9, fast_window_s=2.0,
+                slow_window_s=4.0, burn_threshold=1.5, min_samples=1,
+                upscale_cooldown_s=0.0)
+    base.update(kw)
+    return SLOConfig(**base)
+
+
+def test_burn_rate_from_cumulative_deltas():
+    slo = DeploymentSLO("d", _cfg())
+    now = 2000.0
+    # Poll 1: first sight is a BASELINE only — lifetime counters cover
+    # an unknown span, so they must not land in any window bucket
+    # (a controller restart would otherwise replay hours-old badness
+    # as an instant violation).
+    slo.ingest({"r1": {"completed": 10, "slow": 0, "errors": 0,
+                       "shed": 0, "timeouts": 0}}, now=now)
+    v = slo.evaluate(now=now)
+    assert v["fast"] == 0.0 and not v["violating"]
+    assert slo._ring.sums(now, 10.0) == (0.0, 0.0)
+    # Poll 2: +10 completed of which +8 slow -> bad fraction 0.8 over
+    # the window, budget 0.1 -> burn 8.0 in both windows.
+    slo.ingest({"r1": {"completed": 20, "slow": 8, "errors": 0,
+                       "shed": 0, "timeouts": 0}}, now=now + 1)
+    v = slo.evaluate(now=now + 1)
+    assert v["fast"] == pytest.approx(8.0)
+    assert v["slow"] == pytest.approx(8.0)
+    assert v["violating"] and v["new_violation"]
+    # Same condition next tick: still violating, but NOT a new episode.
+    v = slo.evaluate(now=now + 1.5)
+    assert v["violating"] and not v["new_violation"]
+    assert slo.violations == 1
+
+
+def test_burn_counts_shed_timeouts_and_restart_clamp():
+    slo = DeploymentSLO("d", _cfg())
+    now = 3000.0
+    slo.ingest({"r1": {"completed": 10, "slow": 0, "errors": 0,
+                       "shed": 0, "timeouts": 0}}, now=now)
+    # Replica restarted (counters reset) AND shed 3: the delta clamps to
+    # the new absolute values instead of going negative.
+    slo.ingest({"r1": {"completed": 2, "slow": 0, "errors": 1,
+                       "shed": 3, "timeouts": 1}}, now=now + 1)
+    total, bad = slo._ring.sums(now + 1, 1.0)
+    assert total == 2 + 3 + 1   # completed + shed + timeouts
+    assert bad == 1 + 3 + 1     # errors + shed + timeouts
+    # A replica that stops reporting is forgotten.
+    slo.ingest({"r2": {"completed": 1, "slow": 0, "errors": 0,
+                       "shed": 0, "timeouts": 0}}, now=now + 2)
+    assert set(slo._last) == {"r2"}
+
+
+def test_min_samples_gates_burn():
+    slo = DeploymentSLO("d", _cfg(min_samples=10))
+    now = 4000.0
+    slo.ingest({"r1": {"completed": 0, "slow": 0, "errors": 0,
+                       "shed": 0, "timeouts": 0}}, now=now)
+    # One bad request out of one: not enough samples to trust burn.
+    slo.ingest({"r1": {"completed": 1, "slow": 1, "errors": 0,
+                       "shed": 0, "timeouts": 0}}, now=now + 1)
+    v = slo.evaluate(now=now + 1)
+    assert v["fast"] == 0.0 and not v["violating"]
+
+
+# ---------------------------------------------------------------------------
+# unit: sampling knob + phase folding (no cluster)
+# ---------------------------------------------------------------------------
+
+def test_sampling_knob():
+    try:
+        request_trace.set_sample_n(0)
+        assert not request_trace.mint("d").sampled
+        request_trace.set_sample_n(1)
+        assert request_trace.mint("d").sampled
+        request_trace.set_sample_n(3)
+        flips = [request_trace.mint("d").sampled for _ in range(30)]
+        assert sum(flips) == 10  # strict 1-in-3 round robin
+    finally:
+        request_trace.set_sample_n(None)
+
+
+def test_unsampled_requests_record_nothing():
+    try:
+        request_trace.set_sample_n(0)
+        before = len(request_trace._ring)
+        ctx = request_trace.mint("d")
+        ctx.stamp(request_trace.RQ_PROXY_RECV)
+        request_trace.finish(ctx, "proxy")
+        ctx.record_replay("x")
+        assert len(request_trace._ring) == before
+    finally:
+        request_trace.set_sample_n(None)
+
+
+def test_request_phase_durations_sorts_cross_hop_stamps():
+    from ray_tpu._private import flightrec
+    rec = flightrec.new_request_record()
+    # Replica record where the handle's dispatch stamp (index 3) is
+    # EARLIER than admission (index 1): sorted by time, never negative.
+    rec[flightrec.RQ_DISPATCH] = 10.0
+    rec[flightrec.RQ_ADMISSION] = 10.5
+    rec[flightrec.RQ_EXEC_START] = 10.6
+    rec[flightrec.RQ_EXEC_END] = 11.0
+    rec[flightrec.RQ_REPLY] = 11.1
+    out = dict(flightrec.request_phase_durations(rec))
+    assert all(v >= 0 for v in out.values())
+    assert out["admission"] == pytest.approx(0.5)
+    assert out["exec_end"] == pytest.approx(0.4)
+    assert out["total"] == pytest.approx(1.1)
+
+
+def test_latency_summary_folds_serve_rows():
+    from ray_tpu._private import flightrec
+    rec = flightrec.new_request_record()
+    rec[flightrec.RQ_ADMISSION] = 1.0
+    rec[flightrec.RQ_EXEC_START] = 1.1
+    rec[flightrec.RQ_EXEC_END] = 1.4
+    rec[flightrec.RQ_REPLY] = 1.5
+    rows = flightrec.latency_summary([
+        {"kind": "serve_request", "deployment": "D", "hop": "replica",
+         "phases": rec, "request_id": "r", "trace_id": "r", "time": 1.5},
+    ])
+    by = {(r["name"], r["phase"]): r for r in rows}
+    assert ("serve:D", "exec_end") in by
+    assert by[("serve:D", "exec_end")]["p50_ms"] == pytest.approx(300.0)
+    assert ("serve:D", "total") in by

@@ -83,3 +83,109 @@ def test_tracing_off_by_default():
         assert tracing.get_spans() == []
     finally:
         ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Regression: per-call trace_ctx must survive PR 5's templated batch wire
+# (trace_ctx is PER-CALL state — stamping it onto the template copy, or
+# treating it as a template invariant, silently drops/merges traces).
+# ---------------------------------------------------------------------------
+
+def _proto_spec():
+    from ray_tpu._private.common import TaskSpec
+    from ray_tpu._private.ids import JobID, TaskID
+    job = JobID(b"\x01" * JobID.SIZE)
+    return TaskSpec(task_id=TaskID(b"\x02" * TaskID.SIZE), job_id=job,
+                    name="f", function_id="fid")
+
+
+def test_trace_ctx_rides_templated_batch_wire():
+    import pickle
+
+    from ray_tpu._private.common import (TaskSpecTemplate,
+                                         _TemplatedSpecBatch,
+                                         wire_spec_batch)
+    from ray_tpu._private.ids import TaskID
+
+    tmpl = TaskSpecTemplate(_proto_spec())
+    # trace_ctx must not leak into the template base or its wire
+    # invariants (it is per-call state).
+    assert "trace_ctx" not in tmpl.base
+    assert not any(isinstance(v, tuple) and len(v) == 2
+                   and v == ("t0", "s0")
+                   for v in tmpl.wire_invariants())
+
+    specs = []
+    for i in range(3):
+        s = tmpl.make(TaskID(bytes([i + 3]) * TaskID.SIZE))
+        if i != 1:  # middle call untraced: mixed batches stay per-call
+            s.trace_ctx = (f"trace{i}", f"span{i}")
+        specs.append(s)
+    batch = wire_spec_batch(specs)
+    assert isinstance(batch, _TemplatedSpecBatch)  # compact form taken
+    out = pickle.loads(pickle.dumps(batch))
+    assert [s.trace_ctx for s in out] == [
+        ("trace0", "span0"), None, ("trace2", "span2")]
+    assert [s.task_id for s in out] == [s.task_id for s in specs]
+
+
+def test_trace_ctx_rides_long_form_wire():
+    import pickle
+
+    proto = _proto_spec()
+    proto.trace_ctx = ("tlong", "slong")
+    out = pickle.loads(pickle.dumps([proto]))
+    assert out[0].trace_ctx == ("tlong", "slong")
+
+
+def test_spans_propagate_through_templated_bursts_and_legacy_framing():
+    """Live halves of the regression: a templated call-site burst (batch
+    frames on the wire) records one span per call, under the default
+    BATCH transport AND the RAY_TPU_RPC_BATCH=0 legacy framing."""
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import time
+import ray_tpu
+from ray_tpu.util import tracing
+
+ray_tpu.init(num_cpus=2, num_tpus=0)
+tracing.enable()
+
+@ray_tpu.remote
+def burst_fn(i):
+    return i
+
+@ray_tpu.remote
+class BurstActor:
+    def m(self, i):
+        return i
+
+a = BurstActor.remote()
+refs = [burst_fn.remote(i) for i in range(24)]
+refs += [a.m.remote(i) for i in range(24)]
+assert ray_tpu.get(refs, timeout=60) == list(range(24)) * 2
+deadline = time.time() + 20
+n_f = n_m = 0
+while time.time() < deadline:
+    spans = tracing.get_spans()
+    n_f = len([s for s in spans if s["name"] == "burst_fn"])
+    n_m = len([s for s in spans if s["name"] == "m"])
+    if n_f >= 24 and n_m >= 24:
+        break
+    time.sleep(0.3)
+assert n_f == 24 and n_m == 24, (n_f, n_m)
+ray_tpu.shutdown()
+print("SPANS_OK")
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for batch_env in ("1", "0"):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   RAY_TPU_RPC_BATCH=batch_env)
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              cwd=repo, capture_output=True, text=True,
+                              timeout=150)
+        assert proc.returncode == 0, (batch_env, proc.stderr[-2000:])
+        assert "SPANS_OK" in proc.stdout, (batch_env, proc.stdout)
