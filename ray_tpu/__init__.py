@@ -12,7 +12,8 @@ from ray_tpu._private.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu._private.worker_api import (available_resources, cancel,
                                          cluster_resources, get, get_actor,
                                          init, is_initialized, kill, nodes,
-                                         put, shutdown, timeline, wait)
+                                         prestart_workers, put, shutdown,
+                                         timeline, wait)
 from ray_tpu.actor import ActorClass, ActorHandle
 from ray_tpu.remote_function import RemoteFunction
 
@@ -51,6 +52,7 @@ __all__ = [
     "__version__", "init", "shutdown", "is_initialized", "remote", "method",
     "get", "put", "wait", "kill", "cancel", "get_actor", "nodes",
     "cluster_resources", "available_resources", "timeline",
+    "prestart_workers",
     "ObjectRef", "ObjectRefGenerator", "ActorClass", "ActorHandle",
     "RemoteFunction", "exceptions",
 ]

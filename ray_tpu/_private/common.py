@@ -416,6 +416,11 @@ class NodeInfo:
     # TPU topology: slice name / topology this host belongs to, if any.
     slice_id: str = ""
     hostname: str = "localhost"
+    # Warm worker-pool depth per runtime-env hash ("" = fresh), synced by
+    # the raylet heartbeat: the GCS creation pipeline routes launch
+    # storms toward (and debits) warm capacity instead of packing them
+    # onto one node whose pool is already drained.
+    idle_workers: Dict[str, int] = field(default_factory=dict)
 
 
 # Actor lifecycle states (reference: gcs.proto ActorTableData.ActorState)

@@ -69,6 +69,12 @@ class Config:
     worker_start_timeout_s: float = 60.0
     # Soft cap of started workers per node; more start on demand.
     prestart_workers: bool = True
+    # Concurrent create_actor RPCs the GCS creation pipeline keeps in
+    # flight PER RAYLET (a launch storm fans out pipelined, but one node
+    # must not absorb an unbounded dial-in).
+    gcs_create_actor_concurrency: int = 32
+    # TTL of a prestart hint's warm-pool floor (reaper protection).
+    prestart_hint_ttl_s: float = 30.0
 
     # --- health / fault tolerance ---
     # OOM defense: kill a leased worker when system memory usage crosses

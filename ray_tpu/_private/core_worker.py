@@ -331,6 +331,9 @@ class CoreWorker:
         self.actor_handles: Dict[ActorID, Any] = {}
         # Refs pinning actor-creation args until instantiation completes.
         self._actor_creation_pins: Dict[ActorID, List[ObjectRef]] = {}
+        # In-flight GCS registrations (anonymous creates are
+        # fire-and-forget); kill_actor awaits these to avoid racing them.
+        self._actor_registrations: Dict[ActorID, asyncio.Future] = {}
 
         # executor state (worker mode)
         self.executing_actor = None
@@ -450,9 +453,16 @@ class CoreWorker:
             float(sum(self._lease_rpcs_inflight.values())))
         g("ray_tpu_leases_held", "worker leases currently cached").set(
             float(sum(len(v) for v in self.leases.values())))
+        # create_actor_threadsafe inserts queues from USER threads: hold
+        # the same lock it reserves under, or a storm of anonymous
+        # creates resizes the dict mid-iteration and the RuntimeError
+        # kills the whole report loop.
+        with self.submission_lock:
+            outbox_depth = sum(len(q.outbox)
+                               for q in self.actor_queues.values())
         g("ray_tpu_actor_outbox_depth",
           "actor-call pushes queued in per-actor outboxes").set(
-            float(sum(len(q.outbox) for q in self.actor_queues.values())))
+            float(outbox_depth))
         g("ray_tpu_pending_tasks",
           "tasks submitted by this process and not yet completed").set(
             float(len(self.pending_tasks)))
@@ -467,7 +477,12 @@ class CoreWorker:
         reporter = f"{self.mode}:{self.worker_id.hex()[:12]}"
         while not self._shutdown:
             await asyncio.sleep(self.config.metrics_report_interval_s)
-            self._update_pipeline_gauges()
+            try:
+                self._update_pipeline_gauges()
+            except RuntimeError:
+                # A user-thread submit resized a dict mid-scan; gauges
+                # are best-effort — never let one tick kill the loop.
+                pass
             if not metrics_mod.claim_reporter(self):
                 continue
             rpc.export_transport_metrics()
@@ -695,6 +710,15 @@ class CoreWorker:
                 print(f"{prefix} {line}", file=_sys.stderr)
             return
         if channel == "actors":
+            if msg.get("event") == "alive_batch":
+                # Coalesced ALIVE publishes: one frame carries every
+                # creation that completed in that GCS loop tick.
+                for info in msg.get("actors", []):
+                    q = self.actor_queues.get(info.actor_id)
+                    if q is not None:
+                        q.set_state("ALIVE", info.address,
+                                    num_restarts=info.num_restarts)
+                return
             info: Optional[ActorInfo] = msg.get("actor_info")
             actor_id = info.actor_id if info is not None else msg.get("actor_id")
             q = self.actor_queues.get(actor_id)
@@ -2793,7 +2817,19 @@ class CoreWorker:
         actor_id, done = self.create_actor_local(class_function_id, args,
                                                  kwargs, _prebuilt=prebuilt,
                                                  **opts)
-        await done  # propagate registration errors to threaded callers
+        if opts.get("name"):
+            # Named creation: surface "name already taken" at the call
+            # site (get_if_exists and user code branch on it).
+            await done
+        else:
+            # Anonymous creation is fire-and-forget — a launch storm of N
+            # `.remote()` calls must not pay N serial GCS round trips in
+            # the caller (measured: the submit loop, not the cluster, was
+            # capping the storm). Registration failures surface through
+            # the actor queue (DEAD => method calls raise), same as the
+            # on-loop path has always behaved.
+            done.add_done_callback(
+                lambda f: f.exception() if not f.cancelled() else None)
         return actor_id
 
     def create_actor_local(self, class_function_id: str, args: tuple,
@@ -2807,16 +2843,24 @@ class CoreWorker:
                            concurrency_groups: Optional[dict] = None,
                            execute_out_of_order: bool = False,
                            method_options: Optional[dict] = None,
-                           export: Optional[Any] = None, _prebuilt=None):
+                           export: Optional[Any] = None, _prebuilt=None,
+                           _actor_id: Optional[ActorID] = None,
+                           _queue: Optional["ActorSubmitQueue"] = None):
         """Synchronous actor creation: returns (actor_id, done_future).
 
         Must run on the core loop thread. Arg serialization, optional class
         export, and GCS registration run in the background; method calls
         submitted before registration park in the submit queue until the
         actor goes ALIVE (or DEAD on registration failure).
+
+        `_actor_id`/`_queue` carry reservations a threadsafe caller
+        (create_actor_threadsafe) already made on its own thread — method
+        calls submitted against that id before this runs must land in
+        the SAME queue, not be clobbered by a fresh one.
         """
         from ray_tpu._private.common import SchedulingStrategy
-        actor_id = ActorID.of(self.job_id)
+        actor_id = _actor_id if _actor_id is not None \
+            else ActorID.of(self.job_id)
         task_id = self._next_task_id()
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, name=class_name,
@@ -2832,12 +2876,51 @@ class CoreWorker:
             execute_out_of_order=execute_out_of_order,
             method_options=method_options,
         )
-        q = ActorSubmitQueue(actor_id, self.submission_lock)
+        q = _queue if _queue is not None \
+            else ActorSubmitQueue(actor_id, self.submission_lock)
         self.actor_queues[actor_id] = q
         done = asyncio.ensure_future(
             self._finish_actor_creation(q, spec, args, kwargs, lifetime,
                                         export, _prebuilt))
+        # Registration is fire-and-forget for anonymous creates: remember
+        # the in-flight future so GCS-side operations issued right after
+        # .remote() (kill, in particular) can await it instead of
+        # no-opping on an actor the GCS hasn't heard of yet.
+        self._actor_registrations[actor_id] = done
+        done.add_done_callback(
+            lambda _f, a=actor_id: self._actor_registrations.pop(a, None))
         return actor_id, done
+
+    def create_actor_threadsafe(self, class_function_id: str, args: tuple,
+                                kwargs: dict, **opts) -> Optional[ActorID]:
+        """Non-blocking actor creation from a user (non-loop) thread.
+
+        Same contract as create_actor, minus the wait: args serialize on
+        THIS thread, the actor id + submit queue reserve under the
+        submission lock, and registration is handed to the loop
+        fire-and-forget — a 1k-actor launch storm pays 1k lock-guarded
+        reservations instead of 1k cross-thread round trips through a
+        busy loop (measured: the submit loop, not the cluster, capped the
+        storm). Returns None when an arg needs the loop (plasma-sized) —
+        the caller falls back to the blocking path. Registration failures
+        surface through the actor queue (DEAD => method calls raise)."""
+        prebuilt = self._try_build_args_sync(args, kwargs)
+        if prebuilt is None:
+            return None
+        with self.submission_lock:
+            actor_id = ActorID.of(self.job_id)
+            q = ActorSubmitQueue(actor_id, self.submission_lock)
+            self.actor_queues[actor_id] = q
+
+        def _go():
+            _aid, done = self.create_actor_local(
+                class_function_id, args, kwargs, _prebuilt=prebuilt,
+                _actor_id=actor_id, _queue=q, **opts)
+            done.add_done_callback(
+                lambda f: f.exception() if not f.cancelled() else None)
+
+        self.loop.call_soon_threadsafe(_go)
+        return actor_id
 
     async def _finish_actor_creation(self, q: "ActorSubmitQueue",
                                      spec: TaskSpec, args, kwargs,
@@ -3370,6 +3453,15 @@ class CoreWorker:
             logger.exception("actor task reply dispatch failed")
 
     async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        reg = self._actor_registrations.get(actor_id)
+        if reg is not None and not reg.done():
+            # The create's registration is still in flight (anonymous
+            # creates don't await it): a kill racing ahead of it would
+            # no-op at the GCS and the actor would be created anyway.
+            try:
+                await asyncio.wait_for(asyncio.shield(reg), 30)
+            except Exception:  # noqa: BLE001 — kill proceeds regardless
+                pass
         await self.gcs.request("kill_actor", {"actor_id": actor_id,
                                               "no_restart": no_restart})
 
@@ -3852,6 +3944,15 @@ class CoreWorker:
     async def _rpc_instantiate_actor(self, conn, payload):
         spec: TaskSpec = payload["spec"]
         try:
+            blob = payload.get("function_blob")
+            if blob is not None and spec.function_id not in \
+                    self._function_cache:
+                # The raylet prefetched the (content-addressed) class and
+                # shipped it along: skip the per-worker KV fetch a launch
+                # storm would otherwise multiply by N.
+                import pickle as _pickle
+                self._function_cache[spec.function_id] = \
+                    _pickle.loads(blob)
             await self._ensure_runtime_env(spec.runtime_env)
             cls = await self._load_function(spec.function_id)
             args, kwargs = await self._resolve_task_args(spec)

@@ -177,6 +177,29 @@ class GcsServer:
         # Consecutive failed reserve-before-release attempts per PG (the
         # release-and-replace liveness backstop in _schedule_pg).
         self._pg_handoff_failures: Dict[PlacementGroupID, int] = {}
+        # Batched actor-creation pipeline (GcsActorScheduler): PENDING
+        # creations queue here; one loop drains ALL due entries per pass,
+        # places them against a debited planning view, hints the
+        # destination raylets' warm pools, and fans creates out
+        # concurrently bounded per raylet.
+        self._creation_queue: List[tuple] = []   # (ready_time, ActorInfo)
+        self._creation_wakeup = asyncio.Event()
+        self._creation_task: Optional[asyncio.Task] = None
+        self._create_sems: Dict[NodeID, asyncio.Semaphore] = {}
+        # Outstanding create_actor RPCs per node: a cold storm (no warm
+        # capacity anywhere) spreads by this instead of packing onto the
+        # one most-utilized node whose zygote then forks the whole storm
+        # serially.
+        self._creates_inflight: Dict[NodeID, int] = {}
+        # (actor_id, num_restarts) incarnations with a create in flight:
+        # duplicate enqueues that land in different passes are dropped
+        # here instead of driving two concurrent creates on two nodes.
+        self._creating: set = set()
+        # ALIVE pubsub coalescing: creations completing in the same loop
+        # tick publish ONE "alive_batch" frame.
+        self._alive_buf: List[ActorInfo] = []
+        self._alive_flush_scheduled = False
+        self.alive_frames_published = 0
         self._health_task: Optional[asyncio.Task] = None
         self._persist_task: Optional[asyncio.Task] = None
         self._lag_task: Optional[asyncio.Task] = None
@@ -227,9 +250,11 @@ class GcsServer:
         # tasks above; RESTARTING rows lost their reschedule task the same
         # way. _schedule_actor retries until a node is feasible, so firing
         # before raylets re-register is safe.
+        self._creation_task = asyncio.ensure_future(
+            self._actor_creation_loop())
         for actor in self.actors.values():
             if actor.state in (ACTOR_PENDING, ACTOR_RESTARTING):
-                asyncio.ensure_future(self._schedule_actor(actor))
+                self._enqueue_creation(actor)
         self._health_task = asyncio.ensure_future(self._health_loop())
         if self.session_dir or self._ext_store is not None:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
@@ -255,6 +280,8 @@ class GcsServer:
             task.cancel()
         if self._health_task:
             self._health_task.cancel()
+        if self._creation_task:
+            self._creation_task.cancel()
         if self._persist_task:
             self._persist_task.cancel()
         if self._lag_task:
@@ -394,6 +421,8 @@ class GcsServer:
             info.resources_available = payload["resources_available"]
         if "pending_demand" in payload:
             self.node_demand[node_id] = payload["pending_demand"]
+        if "idle_workers" in payload:
+            info.idle_workers = payload["idle_workers"]
         # Raylets queue (instead of fail) infeasible leases only while an
         # autoscaler is polling — it may be about to add the node.
         return {"reregister": False,
@@ -940,6 +969,11 @@ class GcsServer:
             a for a in self.actors.values()
             if a.node_id in member_ids
             and a.state in (ACTOR_ALIVE, ACTOR_PENDING)]
+        # Warm the surviving domains' worker pools BEFORE the migration
+        # wave: gang recovery is bounded by the slowest actor restart,
+        # and the restart is bounded by worker spawn — prestarting during
+        # the grace window takes the spawn off the recovery clock.
+        self._prestart_for_actors(moved_actors, member_ids)
         if grace_s > 0:
             await asyncio.sleep(min(grace_s,
                                     max(0.0, deadline - time.time())))
@@ -1172,22 +1206,39 @@ class GcsServer:
         cfg = self.config
         from ray_tpu.util import metrics as _metrics
         while True:
+            before = time.time()
             await asyncio.sleep(cfg.heartbeat_interval_s)
             # Keep the process's metrics-reporter claim fresh — and
             # authoritative: a live GCS always owns its process's slot
             # (see metrics.claim_reporter force semantics).
             _metrics.claim_reporter(self, force=True)
-            now = time.time()
-            for node_id, info in list(self.nodes.items()):
-                if info.alive and now - info.last_heartbeat > cfg.node_death_timeout_s:
-                    logger.warning("node %s missed heartbeats; marking dead",
-                                   node_id.hex()[:12])
-                    # A draining node that stops heartbeating was reclaimed
-                    # early (notice-then-kill race): still the planned-loss
-                    # path, so no budgets are charged.
-                    await self._mark_node_dead(node_id,
-                                               reason="heartbeat timeout",
-                                               preempted=info.draining)
+            stall = time.time() - before - cfg.heartbeat_interval_s
+            await self._health_tick(stall)
+
+    async def _health_tick(self, stall: float):
+        cfg = self.config
+        now = time.time()
+        if stall > cfg.heartbeat_interval_s:
+            # The detector itself was stalled (CPU-starved head during a
+            # launch storm, suspended VM, debugger): peers' heartbeats
+            # were queued behind the same stall, so a stale stamp right
+            # now measures OUR lag, not their death. Credit the measured
+            # stall back to every live node; a genuinely dead node still
+            # accrues staleness once ticks arrive on time again.
+            for info in self.nodes.values():
+                if info.alive:
+                    info.last_heartbeat = min(
+                        now, info.last_heartbeat + stall)
+        for node_id, info in list(self.nodes.items()):
+            if info.alive and now - info.last_heartbeat > cfg.node_death_timeout_s:
+                logger.warning("node %s missed heartbeats; marking dead",
+                               node_id.hex()[:12])
+                # A draining node that stops heartbeating was reclaimed
+                # early (notice-then-kill race): still the planned-loss
+                # path, so no budgets are charged.
+                await self._mark_node_dead(node_id,
+                                           reason="heartbeat timeout",
+                                           preempted=info.draining)
 
     async def _mark_node_dead(self, node_id: NodeID, reason: str,
                               preempted: bool = False):
@@ -1398,17 +1449,208 @@ class GcsServer:
         return True
 
     async def _schedule_actor(self, actor: ActorInfo, delay: float = 0.0):
-        if delay:
-            await asyncio.sleep(delay)
+        """Legacy entrypoint (every (re)creation path calls it): enqueue
+        into the batched creation pipeline."""
+        self._enqueue_creation(actor, delay)
+
+    def _enqueue_creation(self, actor: ActorInfo, delay: float = 0.0):
+        if actor.state == ACTOR_DEAD:
+            return
+        self._creation_queue.append((time.time() + delay, actor))
+        self._creation_wakeup.set()
+
+    async def _actor_creation_loop(self):
+        """Batched, pipelined actor creation (the launch-storm path).
+
+        Per pass: drain every due PENDING/RESTARTING creation, place them
+        ALL against one debited planning view (40 concurrent creates no
+        longer pile onto the node whose availability the next heartbeat
+        hasn't caught up with), send `prestart_workers` hints so the
+        destination raylets fork the whole worker batch through the
+        zygote before the first create lands, then fan the creates out —
+        concurrently, bounded per raylet so one storm cannot saturate a
+        node's RPC loop."""
+        while True:
+            now = time.time()
+            due: List[ActorInfo] = []
+            later: List[tuple] = []
+            queued_ids = set()
+            for ready, actor in self._creation_queue:
+                if actor.state == ACTOR_DEAD:
+                    continue
+                if id(actor) in queued_ids:
+                    # Duplicate enqueue of the same creation (e.g. a
+                    # gang restart racing a retry): DROP it — deferring
+                    # it would drive a second concurrent create next
+                    # pass and two workers would run the constructor.
+                    continue
+                if ready <= now:
+                    queued_ids.add(id(actor))
+                    due.append(actor)
+                else:
+                    later.append((ready, actor))
+            self._creation_queue = later
+            if not due:
+                self._creation_wakeup.clear()
+                if later:
+                    timeout = max(0.01, min(r for r, _ in later) - now)
+                    try:
+                        await asyncio.wait_for(
+                            self._creation_wakeup.wait(), timeout)
+                    except asyncio.TimeoutError:
+                        pass
+                else:
+                    await self._creation_wakeup.wait()
+                continue
+            try:
+                self._drive_creation_pass(due)
+            except Exception:  # noqa: BLE001
+                # Backstop (the pass guards per-actor internally): a bug
+                # here must not kill the single cluster-wide creation
+                # pipeline. Drop the pass's _creating keys before
+                # re-queueing — a key registered for a create task that
+                # was never spawned would make every retry a "duplicate"
+                # and wedge the actor PENDING forever.
+                logger.exception("creation pass failed; re-queueing "
+                                 "%d creations", len(due))
+                for actor in due:
+                    self._creating.discard(
+                        (actor.actor_id, actor.num_restarts))
+                    self._enqueue_creation(actor, delay=0.5)
+            # Yield so the spawned create tasks (and their RPC writes,
+            # which coalesce per tick) get the loop before the next drain.
+            await asyncio.sleep(0)
+
+    def _drive_creation_pass(self, due: List[ActorInfo]):
+        view = {n.node_id: dict(n.resources_available)
+                for n in self.nodes.values() if self._schedulable(n)}
+        assignments: List[tuple] = []
+        for actor in due:
+            try:
+                self._place_one(actor, view, assignments)
+            except Exception:  # noqa: BLE001
+                # One bad entry must not abort the whole pass (the
+                # already-placed actors' in-flight counts would leak and
+                # the good entries would churn through re-queue).
+                logger.exception("placing actor %s failed; re-queueing",
+                                 actor.actor_id.hex()[:12])
+                self._enqueue_creation(actor, delay=0.5)
+        if not assignments:
+            return
+        try:
+            self._send_prestart_hints([(a, n) for a, n, _k in assignments])
+        except Exception:  # noqa: BLE001 — hints are best-effort
+            logger.exception("prestart hints failed")
+        for actor, node, key in assignments:
+            asyncio.ensure_future(self._create_bounded(actor, node, key))
+
+    def _place_one(self, actor: ActorInfo, view: dict,
+                   assignments: List[tuple]):
+        spec = actor.creation_spec
+        if spec is None:
+            return  # restored row without a spec: nothing to drive
+        if actor.state not in (ACTOR_PENDING, ACTOR_RESTARTING):
+            # A stale duplicate enqueue outliving the create it
+            # duplicated (the in-flight guard below only spans the
+            # create itself): the incarnation is already ALIVE (or
+            # DEAD) — driving another create would run the constructor
+            # twice and leak the first worker.
+            return
+        key = (actor.actor_id, actor.num_restarts)
+        if key in self._creating:
+            # A create for this exact incarnation is already in flight
+            # (duplicate enqueues can land in different passes when their
+            # delays differ): driving a second one could place it on a
+            # DIFFERENT node, where the raylet's per-node (actor_id,
+            # epoch) dedupe cannot join it. Drop — the in-flight create
+            # re-enqueues itself on failure.
+            return
+        env_hash = spec.env_hash()
+        env = getattr(spec, "runtime_env", None) or {}
+        exact = bool(env.get("container"))
+        node = self._pick_node_for(spec.resources, spec.scheduling,
+                                   view=view, warm_env=env_hash,
+                                   warm_exact=exact)
+        if node is None:
+            # No feasible node right now; retry (autoscaler hook
+            # lives here).
+            self.pubsub.publish("demand",
+                                {"resources": spec.resources})
+            self._enqueue_creation(actor, delay=0.5)
+            return
+        if spec.scheduling.placement_group_id is None:
+            # Debit the planning view (PG-pinned creates consume
+            # bundle reservations, not node availability).
+            avail = view.get(node.node_id)
+            if avail is not None:
+                for k, v in spec.resources.items():
+                    if v > 0:
+                        avail[k] = avail.get(k, 0.0) - v
+        # Debit the node's synced warm-pool view too (the next
+        # heartbeat restores truth): without this, every create of
+        # one pass — and of the passes until that heartbeat — reads
+        # the same pre-storm pool depth and piles onto one node.
+        w = getattr(node, "idle_workers", None)
+        if w:
+            if env_hash and w.get(env_hash, 0) > 0:
+                w[env_hash] -= 1
+            elif not exact and w.get("", 0) > 0:
+                w[""] -= 1
+        self._creates_inflight[node.node_id] = \
+            self._creates_inflight.get(node.node_id, 0) + 1
+        self._creating.add(key)
+        assignments.append((actor, node, key))
+
+    def _send_prestart_hints(self, assignments: List[tuple]):
+        """Warm the destination pools ahead of the create fan-out: one
+        hint per (node, env) carrying the whole batch's demand."""
+        counts: Dict[tuple, int] = {}
+        addr: Dict[NodeID, str] = {}
+        for actor, node in assignments:
+            spec = actor.creation_spec
+            env = getattr(spec, "runtime_env", None) or {}
+            if env.get("container"):
+                continue  # container workers need dedicated spawns
+            key = (node.node_id, spec.env_hash())
+            counts[key] = counts.get(key, 0) + 1
+            addr[node.node_id] = node.address
+        for (node_id, env_hash), count in counts.items():
+            if count <= 1:
+                continue  # the create itself spawns; no batch to warm
+            asyncio.ensure_future(self._notify_prestart(
+                addr[node_id], env_hash, count))
+
+    async def _notify_prestart(self, address: str, env_hash: str,
+                               count: int):
+        try:
+            conn = await self.clients.get(address)
+            await conn.notify("prestart_workers",
+                              {"env_hash": env_hash, "count": count})
+        except Exception:  # noqa: BLE001 — a hint is best-effort
+            pass
+
+    async def _create_bounded(self, actor: ActorInfo, node: NodeInfo,
+                              key: Optional[tuple] = None):
+        sem = self._create_sems.get(node.node_id)
+        if sem is None:
+            sem = self._create_sems[node.node_id] = asyncio.Semaphore(
+                max(1, int(self.config.gcs_create_actor_concurrency)))
+        try:
+            async with sem:
+                await self._create_actor_on_node(actor, node)
+        finally:
+            self._creating.discard(key)
+            left = self._creates_inflight.get(node.node_id, 0) - 1
+            if left > 0:
+                self._creates_inflight[node.node_id] = left
+            else:
+                self._creates_inflight.pop(node.node_id, None)
+
+    async def _create_actor_on_node(self, actor: ActorInfo,
+                                    node: NodeInfo):
         if actor.state == ACTOR_DEAD:
             return
         spec = actor.creation_spec
-        node = self._pick_node_for(spec.resources, spec.scheduling)
-        if node is None:
-            # No feasible node right now; retry (autoscaler hook lives here).
-            self.pubsub.publish("demand", {"resources": spec.resources})
-            asyncio.ensure_future(self._schedule_actor(actor, delay=0.5))
-            return
         try:
             result = await self.clients.request(
                 node.address, "create_actor",
@@ -1425,7 +1667,7 @@ class GcsServer:
             logger.warning("actor %s creation on %s failed: %s",
                            actor.actor_id.hex()[:12], node.address, e)
             if actor.state != ACTOR_DEAD:
-                asyncio.ensure_future(self._schedule_actor(actor, delay=0.5))
+                self._enqueue_creation(actor, delay=0.5)
             return
         if isinstance(result, dict) and result.get("app_error"):
             # The constructor itself raised — an application error, counted
@@ -1453,14 +1695,61 @@ class GcsServer:
         actor.worker_id = result["worker_id"]
         actor.node_id = node.node_id
         self._mark_dirty()
-        self.pubsub.publish("actors", {"event": "alive", "actor_info": actor})
+        self._publish_actor_alive(actor)
 
-    def _pick_node_for(self, resources: Dict[str, float], scheduling=None):
-        """GCS-side node selection for actor creation (GcsActorScheduler)."""
+    def _publish_actor_alive(self, actor: ActorInfo):
+        """Coalesced ALIVE publish: every creation completing in the same
+        loop tick rides ONE 'alive_batch' pubsub frame — a launch storm
+        costs subscribers O(ticks), not O(actors)."""
+        self._alive_buf.append(actor)
+        if not self._alive_flush_scheduled:
+            self._alive_flush_scheduled = True
+            asyncio.get_running_loop().call_soon(
+                self._flush_alive_publishes)
+
+    def _flush_alive_publishes(self):
+        self._alive_flush_scheduled = False
+        buf, self._alive_buf = self._alive_buf, []
+        # A kill/failure task may have run between the buffered publish
+        # and this flush: emitting the stale ALIVE after its DEAD event
+        # would resurrect the actor on clients (DEAD -> ALIVE queues
+        # submitting into a killed worker).
+        buf = [a for a in buf if a.state == ACTOR_ALIVE]
+        if not buf:
+            return
+        self.alive_frames_published += 1
+        if len(buf) == 1:
+            self.pubsub.publish("actors", {"event": "alive",
+                                           "actor_info": buf[0]})
+        else:
+            self.pubsub.publish("actors", {"event": "alive_batch",
+                                           "actors": buf})
+
+    def _pick_node_for(self, resources: Dict[str, float], scheduling=None,
+                       view: Optional[dict] = None,
+                       warm_env: Optional[str] = None,
+                       warm_exact: bool = False):
+        """GCS-side node selection for actor creation (GcsActorScheduler).
+
+        `view` (node_id -> available dict) is the creation pass's debited
+        planning copy: batch placement decisions subtract their own
+        demand instead of all reading the same heartbeat-stale
+        availability. `warm_env` (an env hash, "" = no runtime env)
+        routes toward warm worker capacity: among feasible nodes, ones
+        holding an idle worker that can serve the env win — a storm
+        spreads across the pools a prestart hint just populated instead
+        of packing onto one node and cold-spawning there."""
+        def avail_of(n: NodeInfo) -> Dict[str, float]:
+            if view is not None:
+                got = view.get(n.node_id)
+                if got is not None:
+                    return got
+            return n.resources_available
+
         if scheduling is not None and scheduling.kind == "NODE_AFFINITY":
             node = self.nodes.get(scheduling.node_id)
             if node is not None and self._schedulable(node) \
-                    and _fits(resources, node.resources_available):
+                    and _fits(resources, avail_of(node)):
                 return node
             if scheduling is not None and not scheduling.soft:
                 return None
@@ -1475,13 +1764,34 @@ class GcsServer:
                 else None
         candidates = [n for n in self.nodes.values()
                       if self._schedulable(n)
-                      and _fits(resources, n.resources_available)]
+                      and _fits(resources, avail_of(n))]
         if not candidates:
             return None
+        if warm_env is not None:
+            def warm_cap(n: NodeInfo) -> int:
+                w = getattr(n, "idle_workers", None) or {}
+                # Exact (container) envs can only be served by their own
+                # dedicated pool — a generic idle process cannot enter
+                # the container, so fresh workers are NOT capacity here.
+                cap = 0 if warm_exact else w.get("", 0)
+                if warm_env:
+                    cap += w.get(warm_env, 0)
+                return cap
+            hot = [n for n in candidates if warm_cap(n) > 0]
+            if hot:
+                candidates = hot
+            elif self._creates_inflight:
+                # Cold storm (no warm capacity anywhere, creates already
+                # in flight): spread by outstanding creates per CPU so
+                # every node's zygote forks its share in parallel instead
+                # of one node absorbing the whole storm serially.
+                return min(candidates, key=lambda n: (
+                    self._creates_inflight.get(n.node_id, 0)
+                    / max(1.0, n.resources_total.get("CPU", 1.0))))
         # Hybrid: prefer most-utilized node under threshold (pack), else spread.
         def util(n: NodeInfo):
             used = [
-                1 - n.resources_available.get(k, 0) / t
+                1 - avail_of(n).get(k, 0) / t
                 for k, t in n.resources_total.items() if t > 0
             ]
             return max(used) if used else 0.0
@@ -1514,6 +1824,71 @@ class GcsServer:
                 self.pubsub.publish("actors", {
                     "event": "dead", "actor_id": actor.actor_id,
                     "reason": reason, "actor_info": actor})
+
+    def _prestart_for_actors(self, actors: List[ActorInfo],
+                             exclude_ids: set):
+        """Hint the warm pools of every schedulable off-gang node with
+        the per-env worker demand these actors are about to impose
+        (ceil-split across the candidates — over-hinting decays with the
+        hint TTL, under-hinting just means a cold spawn)."""
+        env_counts: Dict[str, int] = {}
+        for a in actors:
+            spec = a.creation_spec
+            if spec is None:
+                continue
+            env = getattr(spec, "runtime_env", None) or {}
+            if env.get("container"):
+                continue
+            env_counts[spec.env_hash()] = \
+                env_counts.get(spec.env_hash(), 0) + 1
+        if not env_counts:
+            return
+        targets = [n for n in self.nodes.values()
+                   if self._schedulable(n)
+                   and n.node_id not in exclude_ids]
+        if not targets:
+            return
+        for env_hash, count in env_counts.items():
+            per = -(-count // len(targets))  # ceil split
+            for n in targets:
+                asyncio.ensure_future(
+                    self._notify_prestart(n.address, env_hash, per))
+
+    @rpc.idempotent
+    async def rpc_prestart_workers(self, conn, payload):
+        """Driver/serve-facing warm-up: fan `count` workers of demand for
+        `env_hash` across the schedulable raylets (weighted by available
+        CPU — the same shape placement will take) ahead of a scale-up or
+        storm. Returns the number of nodes hinted."""
+        count = max(0, int(payload.get("count", 0)))
+        env_hash = payload.get("env_hash", "") or ""
+        if count <= 0:
+            return 0
+        targets = [n for n in self.nodes.values() if self._schedulable(n)]
+        if not targets:
+            return 0
+        weights = [max(0.0, n.resources_available.get("CPU", 0.0))
+                   for n in targets]
+        if sum(weights) <= 0:
+            weights = [1.0] * len(targets)
+        total_w = sum(weights)
+        # Largest-remainder split: shares sum to EXACTLY count (a 1-
+        # replica upscale on a 50-node cluster must hint ONE worker on
+        # one node, not fork a jax-preloaded worker on all 50).
+        raw = [count * w / total_w for w in weights]
+        shares = [int(r) for r in raw]
+        for i in sorted(range(len(targets)),
+                        key=lambda i: raw[i] - shares[i],
+                        reverse=True)[:count - sum(shares)]:
+            shares[i] += 1
+        hinted = 0
+        for n, share in zip(targets, shares):
+            if share <= 0:
+                continue
+            asyncio.ensure_future(
+                self._notify_prestart(n.address, env_hash, share))
+            hinted += 1
+        return hinted
 
     @rpc.idempotent
     async def rpc_report_actor_failure(self, conn, payload):

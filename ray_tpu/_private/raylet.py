@@ -51,7 +51,10 @@ class _SharedForkServer:
         self.handlers: Dict[str, "Raylet"] = {}   # worker_id hex -> raylet
         self._starting = False
         self._ready_callbacks: List = []
-        self._pending_spawns: List[bytes] = []    # buffered before proc is up
+        # Buffered before proc is up: (env, log_path, raylet) records —
+        # kept structured (not pre-encoded bytes) so spawns that outlive
+        # a dead zygote can fail over to Popen as a batch.
+        self._pending_spawns: List[tuple] = []
         self._base_env: Optional[Dict[str, str]] = None
 
     @classmethod
@@ -82,14 +85,28 @@ class _SharedForkServer:
             return
         finally:
             self._starting = False
-        for line in self._pending_spawns:
-            try:
-                self.proc.stdin.write(line)
-            except Exception:
-                self.dead = True
-                break
-        self._pending_spawns.clear()
+        if self._pending_spawns:
+            pending, self._pending_spawns = self._pending_spawns, []
+            if not self._write_batch([(e, lp) for e, lp, _r in pending]):
+                # The pipe died before the buffered spawns ever reached
+                # the zygote: fail them over (as a batch) via Popen.
+                self._pending_spawns = pending
+                self._fail_pending()
+                return
         asyncio.ensure_future(self._reader())
+
+    def _write_batch(self, jobs: List[tuple]) -> bool:
+        """One spawn_batch line for N workers; False if the pipe is gone."""
+        import json
+        line = (json.dumps({"spawn_batch": [
+            {"env": env, "log_path": lp} for env, lp in jobs]}) + "\n"
+        ).encode()
+        try:
+            self.proc.stdin.write(line)
+        except Exception:
+            self.dead = True
+            return False
+        return True
 
     async def _reader(self):
         import json
@@ -124,10 +141,25 @@ class _SharedForkServer:
             self._fail_pending()
 
     def _fail_pending(self):
-        """Zygote died (or could not start): every worker it still tracked
-        is gone or will never be forked. Tell the owning raylets so supply
-        accounting doesn't leak phantom handles."""
-        self._pending_spawns.clear()
+        """Zygote died (or could not start). Spawns still BUFFERED here
+        never reached it — their workers can still start, just without
+        the warm fork: hand them back to their raylets as one batched
+        Popen failover (one-by-one fallback was the old behavior; a
+        launch storm buffered behind a dead zygote paid N serial
+        round trips through the create timeout). Workers the zygote
+        actually tracked are gone (or unknowable): report exits so
+        supply accounting doesn't leak phantom handles."""
+        pending, self._pending_spawns = self._pending_spawns, []
+        by_raylet: Dict[int, tuple] = {}
+        for env, log_path, raylet in pending:
+            self.handlers.pop(env.get("RAY_TPU_WORKER_ID", ""), None)
+            by_raylet.setdefault(id(raylet), (raylet, []))[1].append(
+                (env, log_path))
+        for raylet, jobs in by_raylet.values():
+            try:
+                raylet._popen_failover_batch(jobs)
+            except Exception:
+                logger.exception("batched Popen failover failed")
         for wid, raylet in list(self.handlers.items()):
             try:
                 raylet._on_forkserver_event(
@@ -142,13 +174,12 @@ class _SharedForkServer:
         else:
             self._ready_callbacks.append(cb)
 
-    def spawn(self, env: Dict[str, str], log_path: str,
-              raylet: "Raylet") -> bool:
-        if self.dead:
-            return False
-        import json
-        line = (json.dumps({"spawn": {"env": env,
-                                      "log_path": log_path}}) + "\n").encode()
+    def spawn_many(self, jobs: List[tuple], raylet: "Raylet") -> bool:
+        """Fork N workers with ONE request line (and one pipe write):
+        `jobs` is [(env, log_path), ...]. All-or-nothing: False means no
+        job was submitted and the caller should Popen-spawn instead."""
+        if self.dead or not jobs:
+            return not self.dead and not jobs
         if self.proc is None or self.proc.stdin is None:
             # Buffer (flushed on start). If no start is in flight — e.g.
             # this is a fresh instance replacing a dead zygote — kick one
@@ -157,14 +188,13 @@ class _SharedForkServer:
                 if self._base_env is None:
                     return False  # nothing can start it: use Popen fallback
                 asyncio.ensure_future(self.ensure_started(self._base_env))
-            self._pending_spawns.append(line)
+            self._pending_spawns.extend(
+                (env, log_path, raylet) for env, log_path in jobs)
         else:
-            try:
-                self.proc.stdin.write(line)
-            except Exception:
-                self.dead = True
+            if not self._write_batch(jobs):
                 return False
-        self.handlers[env["RAY_TPU_WORKER_ID"]] = raylet
+        for env, _log_path in jobs:
+            self.handlers[env["RAY_TPU_WORKER_ID"]] = raylet
         return True
 
 
@@ -176,7 +206,7 @@ class PendingLease:
     was measurable overhead under a multi-client lease storm."""
 
     __slots__ = ("spec", "pg_key", "fut", "conn", "count", "env_hash",
-                 "container_env", "sched_class")
+                 "container_env", "sched_class", "demand_recorded")
 
     def __init__(self, spec, pg_key, fut, conn, count):
         self.spec = spec
@@ -184,10 +214,180 @@ class PendingLease:
         self.fut = fut
         self.conn = conn
         self.count = count
+        # Pool demand/miss accounting happens on the FIRST idle-worker
+        # scan for this lease only; dispatch re-scans don't re-count.
+        self.demand_recorded = False
         self.env_hash = spec.env_hash()
         env = getattr(spec, "runtime_env", None) or {}
         self.container_env = env if env.get("container") else None
         self.sched_class = spec.scheduling_class()
+
+
+class WarmPools:
+    """Env-hash-keyed idle worker pools with demand-sized floors.
+
+    Replaces the flat idle list: a launch storm for one runtime env can
+    no longer drain (or be starved by) another env's warm capacity, the
+    reaper keeps a per-env floor sized by recent demand (EWMA of worker
+    requests/s), and explicit `prestart_workers` hints — sent by the GCS
+    ahead of gang restarts, serve scale-ups, and creation-batch fan-outs
+    — pin a temporary floor so the pool is warm BEFORE the storm lands
+    (reference: worker_pool.h PrestartWorkers + dedicated-worker pools
+    per runtime env).
+    """
+
+    EWMA_HALFLIFE_S = 30.0
+    # The demand floor holds enough warm workers to absorb this many
+    # seconds of the recent request rate.
+    DEMAND_WINDOW_S = 5.0
+    # Demand-derived floors are a smoothing signal, not a license to hold
+    # the node: they never exceed this per env (hints may).
+    MAX_DEMAND_FLOOR = 16
+
+    def __init__(self):
+        self.pools: Dict[str, List["WorkerHandle"]] = {}
+        self._rates: Dict[str, tuple] = {}   # env -> (EWMA req/s, stamp)
+        # env -> (count, expires_at, fresh_alias). fresh_alias hints also
+        # count toward the FRESH pool's floor (the generic workers they
+        # prestart idle there until first lease).
+        self._hints: Dict[str, tuple] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return sum(len(p) for p in self.pools.values())
+
+    def sizes(self) -> Dict[str, int]:
+        return {h: len(p) for h, p in self.pools.items() if p}
+
+    def hash_list(self) -> List[str]:
+        out: List[str] = []
+        for h, p in self.pools.items():
+            out.extend([h] * len(p))
+        return out
+
+    def put(self, handle: "WorkerHandle"):
+        pool = self.pools.setdefault(handle.env_hash, [])
+        if handle not in pool:
+            pool.append(handle)
+
+    def remove(self, handle: "WorkerHandle") -> bool:
+        pool = self.pools.get(handle.env_hash)
+        if pool and handle in pool:
+            pool.remove(handle)
+            return True
+        # The handle may have been re-tagged after it went idle.
+        for p in self.pools.values():
+            if handle in p:
+                p.remove(handle)
+                return True
+        return False
+
+    def note_demand(self, env_hash: str, n: int = 1):
+        """One worker-acquisition attempt for this env (feeds the EWMA
+        floor the reaper respects)."""
+        now = time.time()
+        rate, ts = self._rates.get(env_hash, (0.0, now))
+        if now > ts:
+            rate *= 0.5 ** ((now - ts) / self.EWMA_HALFLIFE_S)
+        self._rates[env_hash] = (rate + float(n), now)
+
+    def hint(self, env_hash: str, count: int, ttl_s: float = 30.0,
+             merge: bool = False, fresh_alias: bool = False):
+        """Explicit prestart hint: hold at least `count` warm workers for
+        `env_hash` until the hint expires (storms are announced, not
+        inferred). merge=True keeps the max of this and any live hint —
+        per-env max keeps a replayed hint RPC idempotent. fresh_alias
+        hints ALSO count (summed across envs) toward the fresh pool's
+        floor: the generic workers they prestart idle there until first
+        lease, and two envs' batches must BOTH survive the reaper — a
+        max would let it eat the second batch."""
+        now = time.time()
+        count = max(0, int(count))
+        expires = now + ttl_s
+        if merge:
+            prev_count, prev_exp, prev_alias = self._hints.get(
+                env_hash, (0, 0.0, False))
+            if prev_exp > now:
+                count = max(count, prev_count)
+                expires = max(expires, prev_exp)
+                fresh_alias = fresh_alias or prev_alias
+        self._hints[env_hash] = (count, expires, fresh_alias)
+
+    def floor(self, env_hash: str, fresh_floor: int = 0) -> int:
+        """Reap-protection floor for one env pool: the fresh pool keeps
+        the node's base prestart floor plus the sum of live fresh_alias
+        hints; every pool keeps max(EWMA demand, live hint)."""
+        now = time.time()
+        hint_count, expires, _alias = self._hints.get(
+            env_hash, (0, 0.0, False))
+        if now >= expires:
+            hint_count = 0
+        if env_hash == "":
+            hint_count += sum(
+                c for h, (c, exp, alias) in self._hints.items()
+                if h != "" and alias and exp > now)
+        acc, ts = self._rates.get(env_hash, (0.0, now))
+        acc *= 0.5 ** (max(0.0, now - ts) / self.EWMA_HALFLIFE_S)
+        # `acc` is a decayed cumulative COUNT whose steady state is
+        # rate * halflife/ln2 — convert to req/s, then hold enough warm
+        # workers to absorb ~DEMAND_WINDOW_S of that rate. (Treating the
+        # raw accumulator as a rate saturated the cap at <1 req/s and
+        # pinned 16 jax-preloaded workers per env on light traffic.)
+        est_rate = acc * 0.6931 / self.EWMA_HALFLIFE_S
+        demand_floor = min(self.MAX_DEMAND_FLOOR,
+                           int(est_rate * self.DEMAND_WINDOW_S + 0.5))
+        base = fresh_floor if env_hash == "" else 0
+        return max(base, demand_floor, hint_count)
+
+    def prune(self):
+        """Drop empty pools, expired hints, and fully decayed demand
+        accumulators — a long-lived node serving many distinct runtime
+        envs must not grow these dicts (and downstream per-env metric
+        rows) forever."""
+        now = time.time()
+        for h in [h for h, p in self.pools.items() if not p and h != ""]:
+            del self.pools[h]
+        for h in [h for h, (_c, exp, _a) in self._hints.items()
+                  if exp <= now]:
+            del self._hints[h]
+        for h in [h for h, (acc, ts) in self._rates.items()
+                  if acc * 0.5 ** ((now - ts) / self.EWMA_HALFLIFE_S) < 0.05]:
+            del self._rates[h]
+
+    def pop(self, env_hash: str, exact: bool, alive,
+            count_miss: bool = True) -> Optional["WorkerHandle"]:
+        """Newest-first pop: exact env pool, then the fresh pool (a fresh
+        worker can still apply the env). exact=True (container envs)
+        never falls back — a generic process cannot retroactively enter
+        the container. `alive(handle)` prunes dead entries mid-scan.
+        count_miss=False for re-scans of an already-counted request."""
+        for key in ((env_hash,) if exact or env_hash == ""
+                    else (env_hash, "")):
+            pool = self.pools.get(key)
+            while pool:
+                handle = pool.pop()
+                if alive(handle):
+                    self.hits += 1
+                    return handle
+        if count_miss:
+            self.misses += 1
+        return None
+
+
+@dataclass
+class _ActorWorkerWaiter:
+    """One actor creation waiting for a worker. The spec rides along so
+    rpc_register_worker can hand the newly registered worker its actor
+    assignment IN THE REGISTRATION REPLY (no register→idle→re-offer→
+    instantiate round trip)."""
+    env_hash: str
+    exact: bool
+    fut: asyncio.Future
+    spec: Optional[TaskSpec] = None
+    epoch: int = 0
+    pg_key: Optional[tuple] = None
+    function_blob: Optional[bytes] = None
 
 
 @dataclass
@@ -219,6 +419,16 @@ class WorkerHandle:
     # The raylet connection the lease was granted over: when it closes
     # (driver exited), the lease is reclaimed.
     lease_conn: Optional[rpc.Connection] = None
+    # Launch-storm debugging: when/how the process was spawned
+    # (fork | popen | container).
+    spawned_at: float = 0.0
+    spawn_mode: str = ""
+    # The assignment dispatched in this worker's registration reply,
+    # kept until its instantiate_result arrives so an idempotent
+    # register_worker REPLAY re-sends the same assignment instead of
+    # stranding both sides (the first reply being lost is exactly the
+    # case replays exist for).
+    pending_assignment: Optional[dict] = None
 
 
 class ResourcePool:
@@ -310,10 +520,31 @@ class Raylet:
         )
         self.clients = rpc.ClientPool()
         self.workers: Dict[WorkerID, WorkerHandle] = {}
-        self._idle_workers: List[WorkerHandle] = []
-        # Actor creates waiting for a worker: (env_hash, exact, future),
-        # FIFO-served by rpc_register_worker.
-        self._actor_worker_waiters: List[tuple] = []
+        # Env-hash-keyed warm pools (was a flat idle list).
+        self._pools = WarmPools()
+        # Actor creates waiting for a worker (_ActorWorkerWaiter records),
+        # FIFO-served by rpc_register_worker — which dispatches the actor
+        # assignment in the registration reply when the waiter carries a
+        # spec.
+        self._actor_worker_waiters: List[_ActorWorkerWaiter] = []
+        # worker_id -> future resolved by rpc_instantiate_result (the
+        # constructor outcome of a register-reply-dispatched create).
+        self._instantiate_results: Dict[WorkerID, asyncio.Future] = {}
+        # Counters for tests / observability (exported as deltas by the
+        # metrics loop).
+        self.register_reply_dispatches = 0
+        self.prestart_hints_received = 0
+        self._exported_pool_hits = 0
+        self._exported_pool_misses = 0
+        self._pool_gauge_envs: set = set()
+        # actor:spawn/register/ctor flightrec spans, flushed to the GCS
+        # task-event buffer by the heartbeat loop.
+        self._pending_spans: List[dict] = []
+        # Content-addressed class blobs (function_id -> pickled class),
+        # prefetched ONCE per node and shipped inside the instantiate
+        # payload: a 1k-actor storm costs 1 GCS KV fetch here instead of
+        # 1k worker-side fetches through a saturated GCS loop.
+        self._function_blobs: Dict[str, bytes] = {}
         # In-flight create_actor dedupe keyed (actor_id, num_restarts):
         # a GCS-restore re-drive (or RPC replay) for an actor whose
         # original create is STILL RUNNING here must join that create,
@@ -420,8 +651,14 @@ class Raylet:
         _metrics.release_reporter(self)
         for gname in ("ray_tpu_raylet_pending_leases",
                       "ray_tpu_raylet_idle_workers",
-                      "ray_tpu_raylet_leased_workers"):
+                      "ray_tpu_raylet_leased_workers",
+                      "ray_tpu_worker_pool_hits_total",
+                      "ray_tpu_worker_pool_misses_total"):
             _metrics.remove(gname, {"Node": self.node_name})
+        for env_hash in self._pool_gauge_envs:
+            _metrics.remove("ray_tpu_worker_pool_size",
+                            {"Node": self.node_name,
+                             "Env": env_hash or "fresh"})
         if getattr(self, "log_monitor", None) is not None:
             self.log_monitor.stop()
         if getattr(self, "memory_monitor", None) is not None:
@@ -489,11 +726,49 @@ class Raylet:
                 float(len(self._pending_leases)), tags=tags)
             g("ray_tpu_raylet_idle_workers",
               "registered workers idle in the pool").set(
-                float(len(self._idle_workers)), tags=tags)
+                float(len(self._pools)), tags=tags)
             g("ray_tpu_raylet_leased_workers",
               "workers currently leased out").set(
                 float(sum(1 for w in self.workers.values() if w.leased)),
                 tags=tags)
+            # Warm-pool health: per-env pool depth + cumulative hit/miss.
+            # Rows for envs whose pool emptied AND whose floor expired
+            # are removed (not left at 0 forever): a long-lived node
+            # serving many per-job env hashes must not grow metric
+            # cardinality without bound.
+            sizes = self._pools.sizes()
+            for env_hash in set(self._pool_gauge_envs) | set(sizes):
+                depth = sizes.get(env_hash, 0)
+                if (depth == 0 and env_hash not in sizes
+                        and self._pools.floor(env_hash) == 0):
+                    _metrics.remove("ray_tpu_worker_pool_size",
+                                    {"Node": self.node_name,
+                                     "Env": env_hash or "fresh"})
+                    self._pool_gauge_envs.discard(env_hash)
+                    continue
+                self._pool_gauge_envs.add(env_hash)
+                _metrics.Gauge(
+                    "ray_tpu_worker_pool_size",
+                    "idle workers per runtime-env warm pool",
+                    tag_keys=("Node", "Env")).set(
+                    float(depth),
+                    tags={"Node": self.node_name,
+                          "Env": env_hash or "fresh"})
+            hits, misses = self._pools.hits, self._pools.misses
+            if hits > self._exported_pool_hits:
+                _metrics.Counter(
+                    "ray_tpu_worker_pool_hits_total",
+                    "worker requests served from a warm pool",
+                    tag_keys=("Node",)).inc(
+                    hits - self._exported_pool_hits, tags=tags)
+                self._exported_pool_hits = hits
+            if misses > self._exported_pool_misses:
+                _metrics.Counter(
+                    "ray_tpu_worker_pool_misses_total",
+                    "worker requests that found no warm worker (cold "
+                    "spawn or wait)", tag_keys=("Node",)).inc(
+                    misses - self._exported_pool_misses, tags=tags)
+                self._exported_pool_misses = misses
             if not _metrics.claim_reporter(self):
                 continue
             rpc.export_transport_metrics()
@@ -516,6 +791,9 @@ class Raylet:
                     # Queued lease shapes feed the autoscaler's demand
                     # bin-packing (reference: resource_demand_scheduler.py).
                     "pending_demand": self._pending_demand_shapes(64),
+                    # Warm-pool depth per env: the GCS creation pipeline
+                    # routes storms toward live warm capacity.
+                    "idle_workers": self._pools.sizes(),
                 })
                 if reply.get("reregister"):
                     # GCS restarted without our node in its (restored) table.
@@ -523,6 +801,7 @@ class Raylet:
                 self._autoscaler_active = bool(
                     reply.get("autoscaler_active"))
                 self._check_worker_deaths()
+                await self._flush_spans()
                 if self._resources_dirty:
                     self._resources_dirty = False
                     await self._report_resources()
@@ -545,6 +824,30 @@ class Raylet:
             if len(shapes) >= cap:
                 break
         return shapes
+
+    def _record_span(self, trace_id: str, name: str, start: float,
+                     end: float):
+        """Launch-path flight-recorder span (actor:spawn / actor:register
+        / actor:ctor): buffered here, flushed to the GCS task-event ring
+        by the heartbeat loop so `ray_tpu timeline` shows where a slow
+        actor launch spent its time."""
+        if not self.config.task_events_enabled:
+            return
+        self._pending_spans.append({
+            "kind": "span", "trace_id": trace_id,
+            "span_id": os.urandom(8).hex(), "parent_id": "",
+            "name": name, "task_id": trace_id,
+            "start": start, "end": end})
+
+    async def _flush_spans(self):
+        if not self._pending_spans:
+            return
+        spans, self._pending_spans = self._pending_spans, []
+        try:
+            await self.gcs_conn.request("report_task_events",
+                                        {"events": spans})
+        except rpc.RpcError:
+            pass
 
     async def _reconnect_gcs(self):
         while not self._stopped:
@@ -663,59 +966,134 @@ class Raylet:
 
     def _spawn_worker(self, container_env: Optional[dict] = None
                       ) -> WorkerHandle:
-        worker_id = WorkerID.from_random()
-        env = self._worker_env_for(worker_id)
-        log_path = self._worker_log_path(worker_id)
-        self._spawned_worker_prefixes.add(worker_id.hex()[:12])
+        return self._spawn_workers(1, container_env)[0]
+
+    def _spawn_workers(self, n: int,
+                       container_env: Optional[dict] = None
+                       ) -> List[WorkerHandle]:
+        """Start `n` workers. Generic workers ride ONE multi-spawn
+        request through the zygote (one pipe write forks N children);
+        container workers stay per-process (each is its own podman/docker
+        invocation)."""
+        if n <= 0:
+            return []
         if container_env is not None:
-            # Containerized worker (runtime_env={"container": ...}): start
-            # the worker inside the image via podman/docker (or the test
-            # hook), pre-dedicated to this env's hash so only matching
-            # leases ever use it (reference: runtime_env/container.py).
-            from ray_tpu._private import runtime_env_container as rec
-            from ray_tpu._private.runtime_env import env_hash as _ehash
-            argv = rec.build_worker_command(
-                container_env["container"], env=env,
-                session_dir=self.session_dir)
-            out = open(log_path, "ab")
-            proc = subprocess.Popen(argv, env=env, stdout=out,
-                                    stderr=subprocess.STDOUT,
-                                    start_new_session=True)
-            handle = WorkerHandle(worker_id=worker_id, pid=proc.pid,
-                                  proc=proc)
-            handle.env_hash = (container_env.get("_hash")
-                               or _ehash(container_env))
-            self.workers[worker_id] = handle
-            self._workers_by_hex[worker_id.hex()] = handle
-            self._starting_workers += 1
-            return handle
+            return [self._spawn_container_worker(container_env)
+                    for _ in range(n)]
+        jobs: List[tuple] = []
+        for _ in range(n):
+            worker_id = WorkerID.from_random()
+            env = self._worker_env_for(worker_id)
+            log_path = self._worker_log_path(worker_id)
+            self._spawned_worker_prefixes.add(worker_id.hex()[:12])
+            jobs.append((worker_id, env, log_path))
         fs = _SharedForkServer.get()
-        # Fast path: ask the zygote to fork a worker (~ms, vs seconds for a
-        # cold python+jax start). Requests written before the zygote finishes
-        # importing are buffered in the pipe. The FULL worker env ships with
-        # the request (the child resets os.environ to it) — the zygote is a
-        # long-lived singleton whose template env can be stale.
-        if fs.spawn(env, log_path, self):
-            handle = WorkerHandle(worker_id=worker_id, pid=-1, proc=None)
-            self.workers[worker_id] = handle
-            self._workers_by_hex[worker_id.hex()] = handle
-            self._starting_workers += 1
-            return handle
+        # Fast path: ask the zygote to fork the workers (~ms each, vs
+        # seconds for a cold python+jax start). Requests written before
+        # the zygote finishes importing are buffered. The FULL worker env
+        # ships with each request (the child resets os.environ to it) —
+        # the zygote is a long-lived singleton whose template env can be
+        # stale.
+        if fs.spawn_many([(env, lp) for _wid, env, lp in jobs], self):
+            handles = []
+            now = time.time()
+            for worker_id, _env, _lp in jobs:
+                handle = WorkerHandle(worker_id=worker_id, pid=-1,
+                                      proc=None)
+                handle.spawn_mode = "fork"
+                handle.spawned_at = now
+                self.workers[worker_id] = handle
+                self._workers_by_hex[worker_id.hex()] = handle
+                self._starting_workers += 1
+                handles.append(handle)
+            return handles
+        return [self._popen_spawn(worker_id, env, lp)
+                for worker_id, env, lp in jobs]
+
+    @staticmethod
+    def _start_worker_proc(env: Dict[str, str],
+                           log_path: str) -> subprocess.Popen:
+        """The one place a generic worker process is exec'd (normal
+        Popen path AND zygote-death failover)."""
         out = open(log_path, "ab")
-        proc = subprocess.Popen(
+        return subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             env=env, stdout=out, stderr=subprocess.STDOUT,
             start_new_session=True,
         )
+
+    def _popen_spawn(self, worker_id: WorkerID, env: Dict[str, str],
+                     log_path: str) -> WorkerHandle:
+        proc = self._start_worker_proc(env, log_path)
         handle = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc)
+        handle.spawn_mode = "popen"
+        handle.spawned_at = time.time()
         self.workers[worker_id] = handle
         self._workers_by_hex[worker_id.hex()] = handle
         self._starting_workers += 1
         return handle
 
+    def _spawn_container_worker(self, container_env: dict) -> WorkerHandle:
+        # Containerized worker (runtime_env={"container": ...}): start
+        # the worker inside the image via podman/docker (or the test
+        # hook), pre-dedicated to this env's hash so only matching
+        # leases ever use it (reference: runtime_env/container.py).
+        worker_id = WorkerID.from_random()
+        env = self._worker_env_for(worker_id)
+        log_path = self._worker_log_path(worker_id)
+        self._spawned_worker_prefixes.add(worker_id.hex()[:12])
+        from ray_tpu._private import runtime_env_container as rec
+        from ray_tpu._private.runtime_env import env_hash as _ehash
+        argv = rec.build_worker_command(
+            container_env["container"], env=env,
+            session_dir=self.session_dir)
+        out = open(log_path, "ab")
+        proc = subprocess.Popen(argv, env=env, stdout=out,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        handle = WorkerHandle(worker_id=worker_id, pid=proc.pid,
+                              proc=proc)
+        handle.env_hash = (container_env.get("_hash")
+                           or _ehash(container_env))
+        handle.spawn_mode = "container"
+        handle.spawned_at = time.time()
+        self.workers[worker_id] = handle
+        self._workers_by_hex[worker_id.hex()] = handle
+        self._starting_workers += 1
+        return handle
+
+    def _popen_failover_batch(self, jobs: List[tuple]):
+        """Spawns that were buffered at a zygote that died before forking
+        them: start each via Popen, reusing the handle already tracked
+        for the spawn (supply accounting and any actor-create waiter keep
+        working; only the warm fork is lost)."""
+        for env, log_path in jobs:
+            handle = self._workers_by_hex.get(
+                env.get("RAY_TPU_WORKER_ID", ""))
+            if (handle is None or handle.registered or handle.proc
+                    is not None or self._stopped):
+                continue
+            try:
+                proc = self._start_worker_proc(env, log_path)
+            except Exception:
+                asyncio.ensure_future(
+                    self._on_worker_disconnect(handle.worker_id))
+                continue
+            handle.proc = proc
+            handle.pid = proc.pid
+            handle.spawn_mode = "popen"
+
     @rpc.idempotent
     async def rpc_register_worker(self, conn, payload):
-        """Called by a worker process once its RPC server is up."""
+        """Called by a worker process once its RPC server is up.
+
+        The reply can carry the worker's FIRST assignment: when an actor
+        creation is already waiting for a worker of this env, the lease
+        happens here and the instantiate payload rides the registration
+        reply — the worker starts constructing immediately instead of
+        going idle, being re-offered, and waiting for a separate
+        instantiate dial (the register→idle→re-offer→dispatch round trip
+        a launch storm pays per actor)."""
         worker_id = payload["worker_id"]
         handle = self.workers.get(worker_id)
         if handle is None:
@@ -726,7 +1104,10 @@ class Raylet:
         handle.conn = conn
         handle.idle_since = time.time()
         self._starting_workers = max(0, self._starting_workers - 1)
-        self._offer_idle_worker(handle)
+        # (Boot latency itself is visible through the Mode=cold rows of
+        # ray_tpu_worker_spawn_seconds, observed ONCE per actor create
+        # in _create_actor — observing it here too double-counted every
+        # cold create and emitted rows for prestarts nobody waited on.)
         conn.peer_info["worker_id"] = worker_id
         prev = conn.on_close
         def _on_close(c, _prev=prev):
@@ -734,20 +1115,126 @@ class Raylet:
             if _prev:
                 _prev(c)
         conn.on_close = _on_close
+        reply = {"node_id": self.node_id, "config": self.config.to_dict()}
+        if not handle.leased:
+            assignment = self._try_register_assignment(handle)
+            if assignment is not None:
+                handle.pending_assignment = assignment
+                reply["assignment"] = assignment
+            else:
+                self._offer_idle_worker(handle)
+        elif handle.pending_assignment is not None:
+            # Replayed registration whose original reply (carrying the
+            # assignment) may have been lost: re-send the SAME
+            # assignment. The worker applies it once; the create's
+            # result future is still waiting on instantiate_result.
+            reply["assignment"] = handle.pending_assignment
         self._try_dispatch()
-        return {"node_id": self.node_id, "config": self.config.to_dict()}
+        return reply
+
+    def _try_register_assignment(self, handle: WorkerHandle
+                                 ) -> Optional[dict]:
+        """Serve the oldest compatible actor-create waiter by leasing the
+        registering worker NOW and returning the instantiate payload for
+        the registration reply. The waiter's future resolves to the
+        result future rpc_instantiate_result will complete."""
+        for waiter in list(self._actor_worker_waiters):
+            if waiter.fut.done():
+                self._actor_worker_waiters.remove(waiter)
+                continue
+            if waiter.spec is None:
+                continue
+            if not (handle.env_hash == waiter.env_hash
+                    or (handle.env_hash == "" and not waiter.exact)):
+                continue
+            self._actor_worker_waiters.remove(waiter)
+            self._lease_worker_for_actor(handle, waiter.spec,
+                                         waiter.pg_key)
+            result_fut = asyncio.get_event_loop().create_future()
+            self._instantiate_results[handle.worker_id] = result_fut
+            self.register_reply_dispatches += 1
+            waiter.fut.set_result(("dispatched", handle, result_fut))
+            assignment = {"spec": waiter.spec,
+                          "num_restarts": waiter.epoch}
+            if waiter.function_blob is not None:
+                assignment["function_blob"] = waiter.function_blob
+            return assignment
+        return None
+
+    async def _prefetch_function(self, function_id: str
+                                 ) -> Optional[bytes]:
+        """Fetch (once per node) the content-addressed class blob so the
+        instantiate payload can carry it — the id is a content hash, so
+        the cache never goes stale. Best-effort: None just means the
+        worker falls back to its own KV fetch."""
+        blob = self._function_blobs.get(function_id)
+        if blob is not None:
+            return blob
+        try:
+            blob = await self.gcs_conn.request("kv_get", {
+                "namespace": "funcs", "key": function_id.encode()})
+        except Exception:  # noqa: BLE001 — prefetch is an optimization
+            return None
+        if blob is None:
+            return None
+        if len(self._function_blobs) >= 128:
+            self._function_blobs.pop(next(iter(self._function_blobs)))
+        self._function_blobs[function_id] = blob
+        return blob
+
+    def _lease_worker_for_actor(self, worker: WorkerHandle, spec: TaskSpec,
+                                pg_key: Optional[tuple]):
+        """Stamp the lease fields for an actor create (resources were
+        acquired by _create_actor before the spawn)."""
+        worker.leased = True
+        worker.lease_owner = spec.owner_address
+        if spec.env_hash():
+            worker.env_hash = spec.env_hash()
+        worker.is_actor_worker = True
+        worker.actor_id = spec.actor_id
+        worker.lease_resources = dict(spec.resources)
+        worker.lease_pg = pg_key
+        self._mark_resources_dirty()
+
+    @rpc.idempotent
+    async def rpc_instantiate_result(self, conn, payload):
+        """Constructor outcome of a register-reply-dispatched create,
+        reported by the worker over its raylet connection."""
+        handle = self.workers.get(payload["worker_id"])
+        if handle is not None:
+            handle.pending_assignment = None
+        fut = self._instantiate_results.pop(payload["worker_id"], None)
+        if fut is not None and not fut.done():
+            result = payload.get("result")
+            if isinstance(result, dict) and "_infra_error" in result:
+                # The worker's dispatch plumbing (not the constructor)
+                # failed: re-raise into the create path so the GCS
+                # retries, exactly like the old request/reply dispatch.
+                fut.set_exception(RuntimeError(result["_infra_error"]))
+            else:
+                fut.set_result(result)
+        return True
 
     async def _on_worker_disconnect(self, worker_id: WorkerID):
         handle = self.workers.pop(worker_id, None)
         self._workers_by_hex.pop(worker_id.hex(), None)
+        fut = self._instantiate_results.pop(worker_id, None)
+        if fut is not None and not fut.done():
+            fut.set_exception(RuntimeError(
+                "worker died during actor construction"))
         if handle is None:
             return
         if not handle.registered:
             # Died during startup: it still counts against supply.
             self._starting_workers = max(0, self._starting_workers - 1)
-        if handle in self._idle_workers:
-            self._idle_workers.remove(handle)
+        self._pools.remove(handle)
         if handle.leased:
+            # Clear the flag with the release: the create path that our
+            # instantiate-future exception wakes runs
+            # _unlease_failed_create, which must not release AGAIN (an
+            # unclamped double release makes available exceed total and
+            # the node over-schedules forever).
+            handle.leased = False
             self.pool.release(handle.lease_resources, handle.lease_pg)
             self._mark_resources_dirty()
         if handle.is_actor_worker and handle.actor_id is not None:
@@ -774,59 +1261,65 @@ class Raylet:
                     asyncio.ensure_future(self._on_worker_disconnect(worker_id))
 
     async def _idle_worker_reaper(self):
-        """Kill surplus idle workers beyond the prestart floor."""
+        """Kill surplus idle workers beyond each pool's floor.
+
+        Per-env floors (not one global count): the fresh pool keeps the
+        node's prestart floor, and every env pool keeps its demand/hint
+        floor — the reaper can no longer eat a warm pool another env just
+        paid to populate (the old single global floor did exactly that:
+        any env's idles counted against the one shared number)."""
         while True:
             await asyncio.sleep(5.0)
-            floor = max(2, int(self.pool.total.get("CPU", 1)))
-            while len(self._idle_workers) > floor:
-                handle = self._idle_workers.pop(0)
-                try:
-                    if handle.conn:
-                        await handle.conn.push("shutdown", {})
-                except Exception:
-                    pass
+            self._pools.prune()
+            fresh_floor = max(2, int(self.pool.total.get("CPU", 1)))
+            for env_hash, pool in list(self._pools.pools.items()):
+                floor = self._pools.floor(env_hash, fresh_floor)
+                while len(pool) > floor:
+                    handle = pool.pop(0)
+                    try:
+                        if handle.conn:
+                            await handle.conn.push("shutdown", {})
+                    except Exception:
+                        pass
 
     def _offer_idle_worker(self, handle: "WorkerHandle"):
         """A worker became available: serve the oldest compatible waiting
-        actor-create (FIFO — see rpc_create_actor) or return it to the
-        idle pool. Every idle-return path goes through here so a freed
-        worker can rescue a waiting create whose own spawn died."""
+        actor-create (FIFO — see rpc_create_actor) or return it to its
+        env's warm pool. Every idle-return path goes through here so a
+        freed worker can rescue a waiting create whose own spawn died."""
         for waiter in list(self._actor_worker_waiters):
-            eh, exact, fut = waiter
-            if fut.done():
+            if waiter.fut.done():
                 self._actor_worker_waiters.remove(waiter)
                 continue
-            if handle.env_hash == eh or (handle.env_hash == ""
-                                         and not exact):
+            if handle.env_hash == waiter.env_hash or \
+                    (handle.env_hash == "" and not waiter.exact):
                 self._actor_worker_waiters.remove(waiter)
-                fut.set_result(handle)
+                waiter.fut.set_result(("worker", handle, None))
                 return
-        if handle not in self._idle_workers:
-            self._idle_workers.append(handle)
+        self._pools.put(handle)
 
-    def _get_idle_worker(self, env_hash: str = "",
-                         exact: bool = False) -> Optional[WorkerHandle]:
+    def _get_idle_worker(self, env_hash: str = "", exact: bool = False,
+                         record: bool = True,
+                         demand_n: int = 1) -> Optional[WorkerHandle]:
         """Pop a live idle worker compatible with `env_hash`: exact-match
         tagged workers preferred, fresh ("") workers serve any env.
         exact=True (container envs) never falls back to a fresh worker —
-        a generic process cannot retroactively enter the container."""
-        fallback = None
-        for i in range(len(self._idle_workers) - 1, -1, -1):
-            handle = self._idle_workers[i]
-            if not (handle.registered and handle.worker_id in self.workers
-                    and not (handle.conn and handle.conn.closed)):
-                self._idle_workers.pop(i)
-                continue
-            if handle.env_hash == env_hash:
-                self._idle_workers.pop(i)
-                return handle
-            if handle.env_hash == "" and fallback is None:
-                fallback = handle
-        if exact:
-            return None
-        if fallback is not None:
-            self._idle_workers.remove(fallback)
-        return fallback
+        a generic process cannot retroactively enter the container.
+
+        record=False for RE-scans of a request that was already counted
+        (dispatch-loop passes over a queued lease, a create's last-chance
+        retry): counting each pass would inflate the EWMA demand floor
+        and the miss counter with phantom requests. demand_n: workers of
+        demand this request represents (a count=N multi-grant lease is N,
+        not 1 — undersizing the EWMA floor ~Nx starves warm pools for
+        multi-worker workloads)."""
+        if record:
+            self._pools.note_demand(env_hash, demand_n)
+        return self._pools.pop(
+            env_hash, exact,
+            lambda h: (h.registered and h.worker_id in self.workers
+                       and not (h.conn and h.conn.closed)),
+            count_miss=record)
 
     @staticmethod
     def _container_env(spec) -> Optional[dict]:
@@ -840,7 +1333,7 @@ class Raylet:
         # spawning workers for requests that can't get resources just burns
         # CPU on process startup (round-1 regression on small boxes).
         avail = dict(self.pool.available)
-        free_hashes = [h.env_hash for h in self._idle_workers]
+        free_hashes = self._pools.hash_list()
         demand = 0
         container_demand: list = []
         # Container workers still starting (spawned, not yet registered):
@@ -899,17 +1392,18 @@ class Raylet:
             # room — otherwise distinct runtime envs permanently pin worker
             # slots and scheduling deadlocks (reference: worker_pool.cc
             # kills idle dedicated workers under pressure).
-            for handle in sorted(
-                    [h for h in self._idle_workers if h.env_hash != ""],
-                    key=lambda h: h.idle_since)[:demand - supply]:
-                self._idle_workers.remove(handle)
+            tagged = [h for pool_hash, pool in self._pools.pools.items()
+                      if pool_hash != "" for h in pool]
+            for handle in sorted(tagged,
+                                 key=lambda h: h.idle_since
+                                 )[:demand - supply]:
+                self._pools.remove(handle)
                 self.workers.pop(handle.worker_id, None)
                 self._workers_by_hex.pop(handle.worker_id.hex(), None)
                 if handle.conn:
                     asyncio.ensure_future(self._push_shutdown(handle))
                 can_start += 1
-        for _ in range(min(max(0, demand - supply), max(0, can_start))):
-            self._spawn_worker()
+        self._spawn_workers(min(max(0, demand - supply), max(0, can_start)))
 
     async def _push_shutdown(self, handle: WorkerHandle):
         try:
@@ -1319,7 +1813,7 @@ class Raylet:
         remaining = []
         n_waiting = sum(1 for e in self._pending_leases
                         if not e.fut.done())
-        idle0 = len(self._idle_workers)
+        idle0 = len(self._pools)
         for req in self._pending_leases:
             fut = req.fut
             if fut.done():
@@ -1361,7 +1855,10 @@ class Raylet:
                                                        pg_key):
                 worker = self._get_idle_worker(
                     req.env_hash,
-                    exact=req.container_env is not None)
+                    exact=req.container_env is not None,
+                    record=not req.demand_recorded,
+                    demand_n=req.count)
+                req.demand_recorded = True
                 if worker is None:
                     break
                 self.pool.acquire(spec.resources, pg_key)
@@ -1522,8 +2019,16 @@ class Raylet:
             pg_key = (spec.scheduling.placement_group_id.binary(), idx)
         if not self.pool.acquire(spec.resources, pg_key):
             raise RuntimeError("resources no longer available for actor")
+        from ray_tpu.util import metrics as _metrics
+        trace = f"actor:{spec.actor_id.hex()}"
+        function_blob = await self._prefetch_function(spec.function_id)
+        # t0 AFTER the blob prefetch: the spawn histogram/span measures
+        # the wait for a worker, not the (first-create-only) KV fetch.
+        t0 = time.time()
         worker = self._get_idle_worker(spec.env_hash(),
                                        exact=cenv is not None)
+        result_fut: Optional[asyncio.Future] = None
+        mode = "warm" if worker is not None else "cold"
         if worker is None:
             try:
                 self._spawn_worker(container_env=cenv)
@@ -1537,55 +2042,92 @@ class Raylet:
             # Polling here instead let N concurrent creates steal each
             # other's spawns — under a 40-actor storm on one node some
             # handlers starved to the timeout (measured: 4s -> 240s).
+            # The waiter carries the SPEC so registration can dispatch
+            # the assignment in its reply (no idle→re-offer round trip).
             fut = asyncio.get_event_loop().create_future()
-            waiter = (spec.env_hash(), cenv is not None, fut)
+            waiter = _ActorWorkerWaiter(spec.env_hash(), cenv is not None,
+                                        fut, spec, epoch, pg_key,
+                                        function_blob)
             self._actor_worker_waiters.append(waiter)
+            got = None
             try:
-                worker = await asyncio.wait_for(
+                got = await asyncio.wait_for(
                     fut, timeout=self.config.worker_start_timeout_s)
             except asyncio.TimeoutError:
-                worker = None
+                pass
             finally:
                 if waiter in self._actor_worker_waiters:
                     self._actor_worker_waiters.remove(waiter)
-            if worker is None:
-                # Last chance: a worker freed via the idle path.
+            if got is not None:
+                _kind, worker, result_fut = got
+            else:
+                # Last chance: a worker freed via the idle path (the
+                # request was already counted by the first attempt).
                 worker = self._get_idle_worker(spec.env_hash(),
-                                               exact=cenv is not None)
+                                               exact=cenv is not None,
+                                               record=False)
             if worker is None:
                 self.pool.release(spec.resources, pg_key)
                 raise RuntimeError("worker failed to start for actor")
-        worker.leased = True
-        worker.lease_owner = spec.owner_address
-        if spec.env_hash():
-            worker.env_hash = spec.env_hash()
-        worker.is_actor_worker = True
-        worker.actor_id = spec.actor_id
-        worker.lease_resources = dict(spec.resources)
-        worker.lease_pg = pg_key
-        self._mark_resources_dirty()
-        try:
-            reply = await self.clients.request(worker.address,
-                                               "instantiate_actor", {
-                "spec": spec, "num_restarts": payload.get("num_restarts", 0)},
-                timeout=self.config.worker_start_timeout_s)
-        except Exception:
-            worker.leased = False
-            worker.is_actor_worker = False
-            worker.actor_id = None
-            self.pool.release(spec.resources, pg_key)
-            raise
+        t_worker = time.time()
+        _metrics.Histogram(
+            "ray_tpu_worker_spawn_seconds",
+            "how long an actor create waited for its worker "
+            "(Mode=warm: pool hit; Mode=cold: process boot)",
+            tag_keys=("Mode",)).observe(t_worker - t0, tags={"Mode": mode})
+        self._record_span(trace, "actor:spawn", t0, t_worker)
+        if result_fut is None:
+            # Warm pool hit / idle rescue: lease here and dispatch the
+            # constructor over the worker's RPC server.
+            self._lease_worker_for_actor(worker, spec, pg_key)
+            t_ctor = time.time()
+            self._record_span(trace, "actor:register", t_worker, t_ctor)
+            inst_payload = {"spec": spec,
+                            "num_restarts": payload.get("num_restarts", 0)}
+            if function_blob is not None:
+                inst_payload["function_blob"] = function_blob
+            try:
+                if worker.conn is not None and not worker.conn.closed:
+                    # Dispatch over the worker's registration connection
+                    # (one push + one result request) — no per-create
+                    # dial; a warm storm costs zero new TCP connections.
+                    result_fut = asyncio.get_event_loop().create_future()
+                    self._instantiate_results[worker.worker_id] = \
+                        result_fut
+                    await worker.conn.push("instantiate_actor",
+                                           inst_payload)
+                    reply = await asyncio.wait_for(
+                        result_fut,
+                        timeout=self.config.worker_start_timeout_s)
+                else:
+                    reply = await self.clients.request(
+                        worker.address, "instantiate_actor", inst_payload,
+                        timeout=self.config.worker_start_timeout_s)
+            except BaseException:
+                self._instantiate_results.pop(worker.worker_id, None)
+                self._unlease_failed_create(worker, spec, pg_key)
+                raise
+        else:
+            # Register-reply dispatch: the lease and the instantiate
+            # payload rode the registration reply; await the outcome.
+            t_ctor = t_worker
+            self._record_span(trace, "actor:register", t_worker, t_ctor)
+            try:
+                reply = await asyncio.wait_for(
+                    result_fut, timeout=self.config.worker_start_timeout_s)
+            except BaseException:
+                self._instantiate_results.pop(worker.worker_id, None)
+                self._unlease_failed_create(worker, spec, pg_key)
+                raise
+        self._record_span(trace, "actor:ctor", t_ctor, time.time())
         if isinstance(reply, dict) and reply.get("app_error"):
             # Constructor raised: the worker is still healthy — return it
-            # to the idle pool (it was popped by _get_idle_worker; without
-            # this it would leak, unleasable, one process per attempt) and
-            # surface the error to the GCS as data.
-            worker.leased = False
-            worker.is_actor_worker = False
-            worker.actor_id = None
+            # to the idle pool (without this it would leak, unleasable,
+            # one process per attempt) and surface the error to the GCS
+            # as data.
+            self._unlease_failed_create(worker, spec, pg_key)
             worker.idle_since = time.time()
             self._offer_idle_worker(worker)
-            self.pool.release(spec.resources, pg_key)
             self._mark_resources_dirty()
             return {"app_error": reply["app_error"]}
         # Stamp the epoch only on a COMPLETED create: the dedupe fast
@@ -1595,6 +2137,17 @@ class Raylet:
         worker.actor_epoch = epoch
         return {"actor_address": worker.address, "worker_id": worker.worker_id}
 
+    def _unlease_failed_create(self, worker: WorkerHandle, spec: TaskSpec,
+                               pg_key: Optional[tuple]):
+        if worker.leased:
+            # `leased` gates the release on BOTH failure paths (here and
+            # _on_worker_disconnect): whichever runs first releases, the
+            # other no-ops.
+            self.pool.release(spec.resources, pg_key)
+        worker.leased = False
+        worker.is_actor_worker = False
+        worker.actor_id = None
+
     def _prestart_workers(self):
         """Warm the pool so first leases don't wait on worker boot
         (reference: WorkerPool prestart, worker_pool.h)."""
@@ -1602,9 +2155,46 @@ class Raylet:
             return
         floor = min(int(self.pool.total.get("CPU", 1)), 4,
                     self.config.max_workers_per_node - len(self.workers))
-        supply = len(self._idle_workers) + self._starting_workers
-        for _ in range(max(0, floor - supply)):
-            self._spawn_worker()
+        supply = len(self._pools) + self._starting_workers
+        self._spawn_workers(max(0, floor - supply))
+
+    @rpc.idempotent
+    async def rpc_prestart_workers(self, conn, payload):
+        """Explicit warm-up hint (GCS creation batches, gang recovery,
+        serve scale-ups): `count` worker acquisitions for `env_hash` are
+        about to land on this node. Pins the pool floor for the hint's
+        TTL and spawns the shortfall NOW as one multi-spawn batch, so the
+        storm forks before its first create arrives. Container envs are
+        not generically prestartable (the spawn needs the container
+        spec); their hint still pins the floor so the reaper spares any
+        dedicated workers already warm."""
+        if self._draining or self._stopped:
+            return 0
+        count = max(0, int(payload.get("count", 0)))
+        env_hash = payload.get("env_hash", "") or ""
+        if count <= 0:
+            return 0
+        self.prestart_hints_received += count
+        ttl_s = float(payload.get("ttl_s",
+                                  self.config.prestart_hint_ttl_s))
+        # merge=True: a replayed hint RPC must stay idempotent (per-env
+        # max). fresh_alias: for a non-container env the workers this
+        # hint spawns are GENERIC (they apply the env at first lease) and
+        # idle in the fresh pool — the alias adds this hint to that
+        # pool's floor (summed across envs, so two envs' batches both
+        # survive the reaper).
+        self._pools.hint(env_hash, count, ttl_s=ttl_s, merge=True,
+                         fresh_alias=bool(env_hash)
+                         and not payload.get("container"))
+        if payload.get("container"):
+            return 0
+        sizes = self._pools.sizes()
+        supply = (sizes.get(env_hash, 0) + self._starting_workers
+                  + (sizes.get("", 0) if env_hash else 0))
+        can_start = self.config.max_workers_per_node - len(self.workers)
+        n = min(max(0, count - supply), max(0, can_start))
+        self._spawn_workers(n)
+        return n
 
     @rpc.idempotent
     async def rpc_kill_worker(self, conn, payload):

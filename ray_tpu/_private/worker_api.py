@@ -320,6 +320,44 @@ def nodes() -> List[dict]:
     } for n in infos]
 
 
+async def prestart_workers_async(core, count: int,
+                                 runtime_env: Optional[dict] = None) -> int:
+    """Core-loop half of prestart_workers — the ONE place that prepares
+    the env and shapes the hint RPC (the serve controller calls this
+    directly; keep the payload in sync with raylet rpc_prestart_workers
+    by editing here, not at call sites)."""
+    env = resolve_runtime_env(runtime_env)
+    env_hash = ""
+    if env:
+        if env.get("container"):
+            # Container workers need dedicated spawns (WarmPools.pop is
+            # exact-only for them — a generic process can never enter
+            # the container retroactively): a hint would fork generic
+            # workers no container create can use, and pin the fresh
+            # pool floor doing it. Same skip the GCS's own hint path
+            # (_send_prestart_hints) applies.
+            return 0
+        # Same packaging + hash stamping the actor spec will get, so
+        # the hint keys the SAME pool the creates will ask for (and
+        # the package upload itself is pre-warmed).
+        prepared = await core.prepare_runtime_env(dict(env))
+        env_hash = prepared.get("_hash", "")
+    return await core.gcs.request(
+        "prestart_workers", {"count": int(count), "env_hash": env_hash})
+
+
+def prestart_workers(count: int, runtime_env: Optional[dict] = None) -> int:
+    """Warm the cluster's worker pools ahead of a launch storm: `count`
+    actor/task creations for `runtime_env` are about to be submitted.
+    The GCS fans the hint across schedulable raylets (env-keyed pool
+    floors + immediate multi-spawn through the forkserver), so the storm
+    finds forked workers instead of paying cold process boots. Best
+    effort; returns the number of nodes hinted."""
+    core = get_core()
+    return _call_on_core_loop(
+        core, prestart_workers_async(core, count, runtime_env), 30)
+
+
 def drain_events() -> List[dict]:
     """Drain/preemption notices observed by this process's core worker
     ({"time", "node_id", "address", "deadline"} per event). Train uses

@@ -12,10 +12,13 @@ template at fork time, so the fork is safe.
 
 Protocol (line-delimited JSON over stdin/stdout):
   raylet -> forkserver: {"spawn": {"env": {...}, "log_path": "..."}}
+                        {"spawn_batch": [{"env": ..., "log_path": ...}, ...]}
   forkserver -> raylet: {"event": "ready"}
                         {"event": "spawned", "pid": N, "worker_id": "..."}
                         {"event": "exit", "pid": N, "worker_id": "...",
                          "status": N}
+A `spawn_batch` line forks every requested child back to back (launch
+storms pay one pipe write + one template wakeup for N workers, not N).
 On stdin EOF (raylet death) the forkserver kills its children and exits.
 """
 
@@ -65,6 +68,17 @@ def _run_child(req: dict) -> None:
                 sys.path.insert(0, p)
     signal.signal(signal.SIGTERM, signal.SIG_DFL)
     signal.signal(signal.SIGINT, signal.SIG_IGN)
+    # Every child forked from the template inherits the SAME PRNG state
+    # (and object addresses — see the pool/serve tests): reseed so
+    # worker-side random choices (jitter, sampling) don't march in
+    # lockstep across the fleet.
+    import random
+    random.seed()
+    try:
+        import numpy as _np
+        _np.random.seed()
+    except Exception:  # noqa: BLE001 — numpy is optional here
+        pass
     try:
         from ray_tpu._private import worker_main
         worker_main.main()
@@ -77,10 +91,45 @@ def _run_child(req: dict) -> None:
         os._exit(0)
 
 
+def _warm_imports() -> None:
+    """Pre-import the worker's heavy module set while still
+    single-threaded, so fork->register is import-free in the child.
+    worker_main's own top-level imports are light (its heavy deps load
+    inside main()), so name the hot ones explicitly; each is
+    best-effort — a missing optional dep must not kill the zygote."""
+    for mod in ("ray_tpu._private.worker_main",
+                "ray_tpu._private.serialization",
+                "ray_tpu._private.core_worker",
+                "ray_tpu._private.rpc",
+                "ray_tpu._private.config",
+                "ray_tpu._private.object_store",
+                "ray_tpu._private.runtime_env",
+                "ray_tpu.dag.compiled",
+                "ray_tpu.exceptions",
+                "numpy",
+                # worker_main mirrors JAX_PLATFORMS into jax.config per
+                # child; without the template import every forked child
+                # pays the full (~0.6s) jax import serially on a loaded
+                # box. Import only — backend init stays lazy, so no
+                # threads exist at fork time.
+                "jax"):
+        try:
+            __import__(mod)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _fork_one(spawn: dict, children: dict) -> None:
+    pid = os.fork()
+    if pid == 0:
+        _run_child(spawn)  # never returns
+    wid = spawn.get("env", {}).get("RAY_TPU_WORKER_ID", "")
+    children[pid] = wid
+    _send({"event": "spawned", "pid": pid, "worker_id": wid})
+
+
 def main() -> None:
-    # Warm the worker's import tree while we are still single-threaded.
-    import ray_tpu._private.worker_main  # noqa: F401
-    import ray_tpu._private.serialization  # noqa: F401
+    _warm_imports()
 
     children: dict = {}  # pid -> worker_id hex
     _send({"event": "ready"})
@@ -126,15 +175,12 @@ def main() -> None:
                 req = json.loads(line)
             except ValueError:
                 continue
-            spawn = req.get("spawn")
-            if spawn is None:
-                continue
-            pid = os.fork()
-            if pid == 0:
-                _run_child(spawn)  # never returns
-            wid = spawn.get("env", {}).get("RAY_TPU_WORKER_ID", "")
-            children[pid] = wid
-            _send({"event": "spawned", "pid": pid, "worker_id": wid})
+            batch = req.get("spawn_batch")
+            if batch is None:
+                spawn = req.get("spawn")
+                batch = [spawn] if spawn is not None else []
+            for spawn in batch:
+                _fork_one(spawn, children)
 
 
 if __name__ == "__main__":

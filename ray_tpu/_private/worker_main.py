@@ -58,13 +58,56 @@ def main():
         # Make the public API (ray_tpu.get/put/remote inside tasks) reentrant.
         from ray_tpu._private import worker_api
         worker_api._worker_core.core = core
-        # Register with the raylet so it can hand out leases to us.
-        raylet_conn = await rpc.connect(raylet_address, core.server and None)
+        # Register with the raylet so it can hand out leases to us. The
+        # push handler is live from the first frame: the raylet delivers
+        # warm-path actor constructions as a PUSH over this connection
+        # (no per-create dial back to our server).
+        conn_cell = {}
+
+        async def _instantiate_and_report(payload):
+            try:
+                result = await core._rpc_instantiate_actor(None, payload)
+            except BaseException as e:  # noqa: BLE001
+                # Nothing awaits this task: an escaped error would leave
+                # the raylet's result future waiting out the full create
+                # timeout. Ship it as an infra error instead — the
+                # raylet re-raises it into the create path (same
+                # semantics the old request/reply dispatch had).
+                import traceback
+                result = {"_infra_error":
+                          f"{type(e).__name__}: {e}\n"
+                          f"{traceback.format_exc()}"}
+            try:
+                # notify, not request: the raylet's result future is the
+                # ack (its create path times out if this frame is lost
+                # with the connection — same failure semantics).
+                await conn_cell["conn"].notify("instantiate_result", {
+                    "worker_id": worker_id, "result": result})
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "instantiate_result report failed")
+
+        def _raylet_push(method, payload):
+            if method == "shutdown":
+                core.loop.call_soon(core.loop.stop)
+            elif method == "instantiate_actor":
+                return _instantiate_and_report(payload)
+
+        raylet_conn = await rpc.connect(raylet_address, _raylet_push)
+        conn_cell["conn"] = raylet_conn
         reply = await raylet_conn.request("register_worker", {
             "worker_id": worker_id, "pid": os.getpid(),
             "address": core.address,
         })
         set_config(Config.load(reply["config"]))
+
+        assignment = reply.get("assignment")
+        if assignment is not None:
+            # First assignment rode the registration reply (an actor
+            # create was waiting for this worker): construct immediately
+            # and report the outcome over this same connection — no
+            # idle→re-offer→instantiate dial round trip.
+            asyncio.ensure_future(_instantiate_and_report(assignment))
 
         # The raylet pushes "shutdown" notifications over this connection.
         async def watch_raylet():
@@ -82,12 +125,10 @@ def main():
     core_and_conn = loop.run_until_complete(run())
     core, raylet_conn = core_and_conn
 
-    # raylet "shutdown" arrives as a notify on the raylet connection; handle it.
-    def push_handler(method, payload):
-        if method == "shutdown":
-            loop.call_soon_threadsafe(loop.stop)
-    raylet_conn.push_handler = push_handler
-    # notify-style shutdown also arrives as a request on our server (handled).
+    # raylet "shutdown" / "instantiate_actor" pushes are handled by the
+    # push handler installed at connect time (see _raylet_push above);
+    # notify-style shutdown also arrives as a request on our server.
+    del raylet_conn  # kept alive by the run() closure
 
     profile_dir = os.environ.get("RAY_TPU_PROFILE_WORKER")
     prof = None

@@ -160,6 +160,11 @@ class ActorClass:
         self._options = options or {}
         self._class_id: Optional[str] = None
         self.__name__ = cls.__name__
+        # Per-class invariants resolved once per core (launch storms call
+        # .remote() in a tight loop; the inspect scans and option
+        # resolution were measurable per-create costs): (core, kwargs).
+        self._create_cache: Optional[tuple] = None
+        self._methods: Optional[list] = None
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -229,6 +234,30 @@ class ActorClass:
                     core, core.export_function(self._cls, self._class_id), 30)
             worker_api._state.exported_functions[self._class_id] = True
         opts = self._options
+        create_kwargs = self._resolve_create_kwargs(core, opts)
+        if on_loop:
+            actor_id, _done = core.create_actor_local(
+                self._class_id, args, kwargs, export=export, **create_kwargs)
+        else:
+            actor_id = None
+            if not create_kwargs["name"]:
+                # Fire-and-forget reservation on this thread (a storm of
+                # anonymous creates pays no per-call loop round trip);
+                # None => an arg needs the loop, take the blocking path.
+                actor_id = core.create_actor_threadsafe(
+                    self._class_id, args, kwargs, **create_kwargs)
+            if actor_id is None:
+                actor_id = worker_api._call_on_core_loop(
+                    core, core.create_actor(self._class_id, args, kwargs,
+                                            **create_kwargs), None)
+        return ActorHandle(actor_id, self._methods,
+                           opts.get("max_task_retries", 0), self.__name__,
+                           create_kwargs["method_options"])
+
+    def _resolve_create_kwargs(self, core, opts) -> dict:
+        cached = self._create_cache
+        if cached is not None and cached[0] is core:
+            return cached[1]
         is_async = self._is_async()
         max_concurrency = opts.get(
             "max_concurrency", 1000 if is_async else 1)
@@ -246,10 +275,12 @@ class ActorClass:
         cgs = opts.get("concurrency_groups")
         if isinstance(cgs, (list, tuple)):
             cgs = {g["name"]: int(g["max_concurrency"]) for g in cgs}
+        members = inspect.getmembers(self._cls, inspect.isfunction)
         method_options = {
             n: dict(m.__ray_tpu_method_options__)
-            for n, m in inspect.getmembers(self._cls, inspect.isfunction)
+            for n, m in members
             if getattr(m, "__ray_tpu_method_options__", None)}
+        self._methods = [n for n, _ in members if not n.startswith("__")]
         create_kwargs = dict(
             class_name=self.__name__,
             resources=resources,
@@ -268,15 +299,5 @@ class ActorClass:
                                                False)),
             method_options=method_options,
         )
-        if on_loop:
-            actor_id, _done = core.create_actor_local(
-                self._class_id, args, kwargs, export=export, **create_kwargs)
-        else:
-            actor_id = worker_api._call_on_core_loop(core, core.create_actor(
-                self._class_id, args, kwargs, **create_kwargs), None)
-        methods = [n for n, _ in inspect.getmembers(self._cls,
-                                                    inspect.isfunction)
-                   if not n.startswith("__")]
-        return ActorHandle(actor_id, methods,
-                           opts.get("max_task_retries", 0), self.__name__,
-                           method_options)
+        self._create_cache = (core, create_kwargs)
+        return create_kwargs

@@ -85,6 +85,9 @@ class _DeploymentState:
         self.next_health_check = 0.0
         self.slo = None                    # DeploymentSLO when configured
         self.last_slo_scale = 0.0
+        # Worker prestart-hint throttle (scale-up warm-up).
+        self.last_prestart = 0.0
+        self.last_prestart_n = 0
         self._rebuild_slo()
 
     def _rebuild_slo(self):
@@ -325,6 +328,13 @@ class ServeController:
                     stale.being_replaced = True
                     await self._start_replica(st, replaces=stale)
             # Scale to target (replacement replicas don't fill a slot).
+            # Warm the worker pools FIRST: every deficit path — initial
+            # deploy, queue-policy upscale, SLO-burn upscale, gang
+            # failover — funnels through here, and the replica actors'
+            # time-to-READY is bounded by worker spawn.
+            deficit = st.target_num - len(st.active())
+            if deficit > 0:
+                await self._prestart_for(st, deficit)
             while len(st.active()) < st.target_num:
                 await self._start_replica(st)
             while len(st.active()) > st.target_num:
@@ -336,6 +346,22 @@ class ServeController:
                 if not victims:
                     break
                 self._begin_drain(st, victims[0], "scale down")
+
+    async def _prestart_for(self, st: _DeploymentState, deficit: int):
+        """Send the GCS a prestart hint for `deficit` replica workers
+        (throttled: the reconcile loop re-enters every ~0.5s while the
+        replicas start — re-hinting the same deficit would just churn)."""
+        now = time.time()
+        if deficit <= st.last_prestart_n and now - st.last_prestart < 5.0:
+            return
+        st.last_prestart, st.last_prestart_n = now, deficit
+        try:
+            from ray_tpu._private import worker_api
+            await worker_api.prestart_workers_async(
+                worker_api.get_core(), deficit,
+                (st.config.ray_actor_options or {}).get("runtime_env"))
+        except Exception:  # noqa: BLE001 — a hint is best-effort
+            logger.debug("prestart hint failed", exc_info=True)
 
     async def _reconcile_loop(self):
         while True:

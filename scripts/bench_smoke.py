@@ -100,12 +100,20 @@ def main() -> dict:
     try:
         callers = [Caller.remote() for _ in range(2)]
         ray_tpu.get([c.burst.remote(5) for c in callers], timeout=90)
-        n = 150
-        t0 = time.perf_counter()
-        ray_tpu.get([c.burst.remote(n) for c in callers], timeout=90)
-        v = 2 * n / (time.perf_counter() - t0)
+        # Median of 3 bursts: the row is bimodal under post-phase load
+        # (a ~4k/s slow mode shows up straight after the multi-client
+        # phase on an idle-again box — reproducible on builds back to
+        # r08), and one burst kept sampling the slow mode.
+        rates = []
+        for _ in range(3):
+            n = 150
+            t0 = time.perf_counter()
+            ray_tpu.get([c.burst.remote(n) for c in callers], timeout=90)
+            rates.append(2 * n / (time.perf_counter() - t0))
+        v = statistics.median(rates)
         out["n_n_actor_calls"] = round(v, 1)
-        log(f"n_n_actor_calls_async: {v:,.0f}/s")
+        log(f"n_n_actor_calls_async: {v:,.0f}/s (median of "
+            f"{[round(r) for r in rates]})")
     except Exception as e:  # noqa: BLE001
         log(f"n:n phase skipped: {type(e).__name__}: {e}")
 
@@ -241,6 +249,110 @@ def main() -> dict:
         log(f"pg phase skipped: {type(e).__name__}: {e}")
 
     ray_tpu.shutdown()
+
+    # --- launch storm: cold vs warm actor creation on a 3-node fake ---
+    # The fleet-scale launch row: a cold storm (pools at their base
+    # floor) and a warm storm (prestart-hinted pools) of actor creates
+    # on the same bench.py topology, with the spawn-phase span breakdown
+    # (actor:spawn / actor:register / actor:ctor) proving where the time
+    # went. The warm rate is tier-1-asserted against a conservative
+    # floor (tests/test_bench_smoke.py) so the 0.05x row can't silently
+    # regress; the rest is printed, never asserted.
+    try:
+        out.update(_launch_storm_phase())
+    except Exception as e:  # noqa: BLE001 — smoke must finish
+        log(f"launch-storm phase skipped: {type(e).__name__}: {e}")
+    return out
+
+
+def _launch_storm_phase() -> dict:
+    import collections
+
+    import ray_tpu
+    from ray_tpu._private import worker_api
+    from ray_tpu.cluster_utils import Cluster
+
+    out: dict = {}
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 64},
+                      system_config={"worker_start_timeout_s": 120.0})
+    for _ in range(2):
+        cluster.add_node(num_cpus=64)
+    cluster.connect()
+    try:
+        cluster.wait_for_nodes()
+
+        @ray_tpu.remote(num_cpus=0.01)
+        class Tiny:
+            def ready(self):
+                return 1
+
+        def span_breakdown(since: float) -> dict:
+            agg = collections.defaultdict(list)
+            for e in cluster.gcs.task_events:
+                if (e.get("kind") == "span" and e.get("start", 0) >= since
+                        and str(e.get("name", "")).startswith("actor:")):
+                    agg[e["name"]].append(e["end"] - e["start"])
+            brk = {}
+            for name, vals in agg.items():
+                vals.sort()
+                brk[name.split(":", 1)[1]] = {
+                    "n": len(vals),
+                    "p50_ms": round(vals[len(vals) // 2] * 1e3, 1),
+                    "p90_ms": round(vals[int(len(vals) * 0.9)] * 1e3, 1),
+                }
+            return brk
+
+        def storm(n: int) -> tuple:
+            t_wall = time.time()
+            t0 = time.perf_counter()
+            actors = [Tiny.remote() for _ in range(n)]
+            # Below the 260s harness cap (tests/test_bench_smoke.py): a
+            # hung storm must surface as this phase's "skipped" log, not
+            # a SIGKILLed bench with no JSON row.
+            ray_tpu.get([a.ready.remote() for a in actors],
+                        timeout=200)
+            return n / (time.perf_counter() - t0), t_wall
+
+        # Cold-ish storm first (bench.py's exact shape: 8 warmed, then
+        # 40 creates against pools at their base prestart floor).
+        warm8 = [Tiny.remote() for _ in range(8)]
+        ray_tpu.get([a.ready.remote() for a in warm8], timeout=120)
+        rate, t_wall = storm(40)
+        out["actor_launch_per_s"] = round(rate, 1)
+        out["launch_storm_cold_spans"] = span_breakdown(t_wall)
+        hits = sum(r._pools.hits for r in cluster.raylets)
+        misses = sum(r._pools.misses for r in cluster.raylets)
+        log(f"actor_launch (cold storm): {rate:,.1f}/s "
+            f"(pool {hits} hits / {misses} misses)")
+
+        # Warm storm: announce it (the serve/gang paths send the same
+        # prestart hint), wait for the pools to fork the batch, fire.
+        n = 40
+        worker_api.prestart_workers(n)
+        deadline = time.time() + 90
+        while time.time() < deadline and \
+                sum(len(r._pools) for r in cluster.raylets) < n:
+            time.sleep(0.3)
+        frames0 = cluster.gcs.alive_frames_published
+        hits0 = sum(r._pools.hits for r in cluster.raylets)
+        rate, t_wall = storm(n)
+        out["actor_launch_warm_per_s"] = round(rate, 1)
+        out["launch_storm_warm_spans"] = span_breakdown(t_wall)
+        out["launch_storm_warm_pool_hits"] = \
+            sum(r._pools.hits for r in cluster.raylets) - hits0
+        out["launch_storm_alive_frames"] = \
+            cluster.gcs.alive_frames_published - frames0
+        out["launch_storm_reg_reply_dispatches"] = \
+            sum(r.register_reply_dispatches for r in cluster.raylets)
+        log(f"actor_launch (warm storm): {rate:,.1f}/s "
+            f"({out['launch_storm_warm_pool_hits']} pool hits, "
+            f"{out['launch_storm_alive_frames']} ALIVE frames)")
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()
     return out
 
 

@@ -19,11 +19,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "scripts", "bench_smoke.py")
 
 
-@pytest.mark.timeout(170)
+@pytest.mark.timeout(280)
 def test_bench_smoke_completes(jax_cpu):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run([sys.executable, SCRIPT], capture_output=True,
-                          text=True, timeout=150, env=env, cwd=REPO)
+                          text=True, timeout=260, env=env, cwd=REPO)
     assert proc.returncode == 0, proc.stderr[-3000:]
     lines = [ln for ln in proc.stdout.splitlines()
              if ln.strip().startswith("{")]
@@ -48,3 +48,11 @@ def test_bench_smoke_completes(jax_cpu):
     # deterministic enough to assert in tier-1.
     assert "alloc_blocks_per_call" in row, row
     assert row["alloc_blocks_per_call"] <= 28.0, row
+    # Launch-storm floor: the warm path measured ~115/s on an idle
+    # 2-vCPU box (the pre-pipeline row on the same box was 1.6/s). The
+    # floor leaves ~6x headroom for CI load — this asserts the
+    # warm-pool machinery ENGAGED (pool hits, not cold spawns), not a
+    # throughput target.
+    assert "actor_launch_warm_per_s" in row, row
+    assert row["actor_launch_warm_per_s"] >= 20.0, row
+    assert row.get("launch_storm_warm_pool_hits", 0) > 0, row

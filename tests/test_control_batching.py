@@ -178,7 +178,7 @@ class TestLeaseMultiGrant:
             h = WorkerHandle(worker_id=WorkerID.from_random(), pid=1000 + i,
                              address=f"127.0.0.1:{20000+i}", registered=True)
             raylet.workers[h.worker_id] = h
-            raylet._idle_workers.append(h)
+            raylet._pools.put(h)
         return raylet
 
     def test_multi_grant_one_round_trip(self, tmp_path):
@@ -216,7 +216,7 @@ class TestLeaseMultiGrant:
         async def main():
             raylet = self._mk_raylet(tmp_path, cpus=4.0)
             # Start with NO workers so both requests queue.
-            raylet._idle_workers.clear()
+            raylet._pools.pools.clear()
             raylet.workers.clear()
             try:
                 def mk_spec():
@@ -236,7 +236,7 @@ class TestLeaseMultiGrant:
                                      address=f"127.0.0.1:{21000+i}",
                                      registered=True)
                     raylet.workers[h.worker_id] = h
-                    raylet._idle_workers.append(h)
+                    raylet._pools.put(h)
                 raylet._try_dispatch()
                 a, b = await asyncio.gather(fut_a, fut_b)
                 assert len(a["grants"]) + len(b["grants"]) == 4

@@ -49,7 +49,11 @@ def test_class_deployment_replicas_and_routing(ray_mod):
             return self.count
 
         def whoami(self):
-            return id(self)
+            # (pid, id): replica workers fork from the same zygote
+            # template, so object addresses can COLLIDE across replica
+            # processes — id(self) alone no longer distinguishes them.
+            import os
+            return (os.getpid(), id(self))
 
     h = serve.run(Counter.bind(100), name="d2", route_prefix="/counter")
     results = [h.remote(1).result(timeout=30) for _ in range(6)]
