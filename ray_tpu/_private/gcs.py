@@ -310,6 +310,13 @@ class GcsServer:
             # Give every restored node a fresh heartbeat window to reconnect
             # before the health loop declares it dead.
             info.last_heartbeat = now
+            # Actor-liveness reconcile: a worker that died while this GCS
+            # was down reported to nobody (the raylet's one-shot death
+            # report swallows RpcError), so its actor is restored ALIVE
+            # forever. Each node's next heartbeat is asked to send the
+            # live worker set once; rpc_reconcile_actors restarts the
+            # orphaned actors (registry + restore interplay).
+            info.needs_actor_reconcile = True
         logger.info("GCS restored %d nodes / %d actors / %d PGs from %s",
                     len(self.nodes), len(self.actors),
                     len(self.placement_groups), path)
@@ -333,6 +340,7 @@ class GcsServer:
         now = time.time()
         for info in self.nodes.values():
             info.last_heartbeat = now
+            info.needs_actor_reconcile = True  # see _maybe_restore
         logger.info("GCS restored %d nodes / %d actors / %d PGs from "
                     "external store %s", len(self.nodes), len(self.actors),
                     len(self.placement_groups),
@@ -377,7 +385,20 @@ class GcsServer:
             info.draining = prev.draining
             info.drain_deadline = prev.drain_deadline
             info.resources_available = prev.resources_available
+            # A replayed registration must not lose a pending
+            # post-restore reconcile ask (cleared below when the payload
+            # carries the live set).
+            if getattr(prev, "needs_actor_reconcile", False):
+                info.needs_actor_reconcile = True
         self.nodes[info.node_id] = info
+        if "live_worker_ids" in payload:
+            # (Re)registration doubles as the actor-liveness reconcile:
+            # after a GCS restart the raylet's reconnect lands here, and
+            # ALIVE actors whose workers died during the outage get
+            # their (lost) failure reports re-driven now.
+            self._reconcile_node_actors(
+                info.node_id, set(payload.get("live_worker_ids") or []))
+            info.needs_actor_reconcile = False
         logger.info("node %s registered at %s (resources=%s)",
                     info.node_id.hex()[:12], info.address, info.resources_total)
         self.pubsub.publish("nodes", {"event": "alive", "node_info": info})
@@ -426,8 +447,44 @@ class GcsServer:
         # Raylets queue (instead of fail) infeasible leases only while an
         # autoscaler is polling — it may be about to add the node.
         return {"reregister": False,
+                # Post-restore handshake: ask this node for its live
+                # worker set once so ALIVE actors whose workers died
+                # during the GCS outage get restarted (their one-shot
+                # death reports were lost with the old process).
+                "report_actors":
+                    getattr(info, "needs_actor_reconcile", False),
                 "autoscaler_active":
                     time.time() - self._autoscaler_seen < 60.0}
+
+    def _reconcile_node_actors(self, node_id, live: set) -> int:
+        """Registry + restore interplay: any ALIVE actor bound to this
+        node whose worker is not in the reported live set lost its death
+        report to a GCS restart — put it through the normal failure
+        path (restart per max_restarts) now instead of never."""
+        fixed = 0
+        for actor in list(self.actors.values()):
+            if (actor.state == ACTOR_ALIVE and actor.node_id == node_id
+                    and actor.worker_id is not None
+                    and actor.worker_id not in live):
+                logger.warning(
+                    "actor %s lost its worker while the GCS was down; "
+                    "driving the failure path now",
+                    actor.actor_id.hex()[:12])
+                asyncio.ensure_future(self._handle_actor_failure(
+                    actor, "worker lost during GCS restart"))
+                fixed += 1
+        return fixed
+
+    @rpc.idempotent
+    async def rpc_reconcile_actors(self, conn, payload):
+        """The raylet's answer to a `report_actors` heartbeat flag (the
+        backstop path; registration carries the same live set inline)."""
+        node_id = payload["node_id"]
+        info = self.nodes.get(node_id)
+        if info is not None:
+            info.needs_actor_reconcile = False
+        return self._reconcile_node_actors(
+            node_id, set(payload.get("live_worker_ids") or []))
 
     # ------------- metrics / observability plane -------------
 

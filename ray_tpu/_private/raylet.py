@@ -697,8 +697,16 @@ class Raylet:
             labels=self.labels, is_head=self.is_head,
             slice_id=self.slice_id,
         )
-        reply = await self.gcs_conn.request("register_node",
-                                            {"node_info": info})
+        reply = await self.gcs_conn.request("register_node", {
+            "node_info": info,
+            # Actor-liveness reconcile on (re)registration: a restarted
+            # GCS restored from a snapshot may believe actors are ALIVE
+            # on workers that died during its outage (their one-shot
+            # death reports were lost) — the live set lets it drive
+            # those through the failure path immediately.
+            "live_worker_ids": [h.worker_id for h in self.workers.values()
+                                if h.pid > 0],
+        })
         for node_id, view in reply.get("cluster_view", {}).items():
             if node_id != self.node_id:
                 self.cluster_view[node_id] = view
@@ -798,6 +806,16 @@ class Raylet:
                 if reply.get("reregister"):
                     # GCS restarted without our node in its (restored) table.
                     await self._register_with_gcs()
+                if reply.get("report_actors"):
+                    # Post-restore handshake: tell the (restarted) GCS
+                    # which workers actually live here so it can restart
+                    # ALIVE actors whose death reports it never received.
+                    await self.gcs_conn.request("reconcile_actors", {
+                        "node_id": self.node_id,
+                        "live_worker_ids": [
+                            h.worker_id for h in self.workers.values()
+                            if h.pid > 0],
+                    })
                 self._autoscaler_active = bool(
                     reply.get("autoscaler_active"))
                 self._check_worker_deaths()

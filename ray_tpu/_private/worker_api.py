@@ -451,6 +451,36 @@ def internal_kv_keys(prefix: bytes = b"", namespace: str = "kv") -> List[bytes]:
         "namespace": namespace, "prefix": prefix}), 30)
 
 
+# Awaitable internal-KV variants for ON-LOOP callers (async actors — the
+# serve controller's write-ahead store is the main one): the sync
+# wrappers above block on the core loop and would deadlock there.
+
+async def internal_kv_put_async(core, key: bytes, value: bytes,
+                                namespace: str = "kv",
+                                overwrite: bool = True) -> bool:
+    return await core.gcs.request("kv_put", {
+        "namespace": namespace, "key": key, "value": value,
+        "overwrite": overwrite})
+
+
+async def internal_kv_get_async(core, key: bytes,
+                                namespace: str = "kv") -> Optional[bytes]:
+    return await core.gcs.request("kv_get", {
+        "namespace": namespace, "key": key})
+
+
+async def internal_kv_del_async(core, key: bytes,
+                                namespace: str = "kv") -> bool:
+    return await core.gcs.request("kv_del", {
+        "namespace": namespace, "key": key})
+
+
+async def internal_kv_keys_async(core, prefix: bytes = b"",
+                                 namespace: str = "kv") -> List[bytes]:
+    return await core.gcs.request("kv_keys", {
+        "namespace": namespace, "prefix": prefix})
+
+
 def timeline(job_id=None) -> List[dict]:
     """Chrome-trace-format task timeline (reference: ray.timeline).
 

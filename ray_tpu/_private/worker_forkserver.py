@@ -130,6 +130,18 @@ def _fork_one(spawn: dict, children: dict) -> None:
 
 def main() -> None:
     _warm_imports()
+    # Freeze the preloaded heap before serving forks: children inherit
+    # the template's object graph (jax + the worker module set, hundreds
+    # of thousands of objects), and without this every gen-2 GC pass in
+    # every forked worker re-traverses it — measured as a ~50-75 ms
+    # stop-the-world stall that made the n:n actor-call smoke row
+    # bimodal (slow mode = a burst that contained one such pass). The
+    # permanent generation survives fork, so one freeze here covers the
+    # whole fleet; it also keeps copy-on-write pages shared (gc touches
+    # refcount-adjacent GC headers when it scans).
+    import gc
+    gc.collect()
+    gc.freeze()
 
     children: dict = {}  # pid -> worker_id hex
     _send({"event": "ready"})

@@ -21,8 +21,13 @@ def _get_controller():
     try:
         _controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:
+        # Restartable detached named actor: a controller crash (or
+        # preemption) restarts it uncharged, and the fresh instance
+        # recovers its persisted target state and REATTACHES live
+        # replicas (see serve/persistence.py) instead of cold-starting.
         cls = ray_tpu.remote(num_cpus=0.1, name=CONTROLLER_NAME,
-                             get_if_exists=True)(ServeController)
+                             get_if_exists=True, max_restarts=-1,
+                             lifetime="detached")(ServeController)
         _controller = cls.remote()
     return _controller
 

@@ -61,6 +61,12 @@ class SLOConfig:
     # Burn-driven upscale cadence (independent of AutoscalingConfig's
     # upscale_delay_s — burn is already a sustained, windowed signal).
     upscale_cooldown_s: float = 10.0
+    # Burn-driven DOWNSCALE: with an SLO configured, the queue policy may
+    # only shrink the deployment when burn has stayed under idle_burn_max
+    # in BOTH windows for a full slow window — and then one replica per
+    # downscale_cooldown_s. Burning deployments never scale down.
+    idle_burn_max: float = 0.1
+    downscale_cooldown_s: float = 30.0
 
 
 @dataclass

@@ -7,6 +7,27 @@ remove, rolling-update by version), health-checks them, and applies
 queue-depth autoscaling. Routers poll get_routing() with a version counter
 (the long-poll analogue).
 
+Durable control plane (reference: the controller checkpoints to the GCS
+KV and RECOVERS running replicas, it never restarts them):
+
+- Every target-state mutation (deploy / delete / scale / autoscale
+  decision) persists a schema-versioned record to the GCS KV (``serve``
+  namespace, serve/persistence.py) BEFORE the mutation's routing or
+  replica effects publish, and every live replica keeps a registry row
+  (actor id, version, node/slice, rolling-update swap link).
+- The controller is a restartable detached named actor
+  (max_restarts=-1): a crash or preemption restart re-runs the
+  constructor, which loads target state; the first call then REATTACHES
+  the still-live ReplicaActors from the registry and reconciles — only
+  version-mismatched or unhealthy replicas are replaced, healthy ones
+  keep serving without a blip. An in-flight rolling update resumes
+  replace-then-drain from its persisted swap link instead of routing
+  two versions or restarting the rollout.
+- Replicas and proxies are detached too: a controller death must not
+  cascade into its children through owner cleanup, and routers/proxies
+  serve from their last-known routing tables (bounded staleness) right
+  through the outage — a controller death alone never drops a request.
+
 Replica lifecycle (serve-under-fire):
 
     STARTING --ready--> RUNNING --drain--> DRAINING --> killed
@@ -33,12 +54,14 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 import time
 import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.serve import persistence
 
 logger = logging.getLogger(__name__)
 
@@ -47,6 +70,32 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 REPLICA_STARTING = "STARTING"
 REPLICA_RUNNING = "RUNNING"
 REPLICA_DRAINING = "DRAINING"
+
+
+def _recoveries_counter():
+    from ray_tpu.util import metrics
+    return metrics.Counter(
+        "ray_tpu_serve_controller_recoveries_total",
+        "serve controller restarts that recovered persisted target state "
+        "from the GCS KV (reattach-first: healthy replicas kept serving)")
+
+
+def _reattached_counter():
+    from ray_tpu.util import metrics
+    return metrics.Counter(
+        "ray_tpu_serve_replicas_reattached_total",
+        "still-live replicas a recovered controller reattached from the "
+        "KV registry instead of restarting",
+        tag_keys=("Deployment",))
+
+
+def _replaced_counter():
+    from ray_tpu.util import metrics
+    return metrics.Counter(
+        "ray_tpu_serve_replicas_replaced_total",
+        "registry replicas a recovered controller found dead/unhealthy "
+        "and replaced (the non-reattachable remainder)",
+        tag_keys=("Deployment",))
 
 
 class _ReplicaInfo:
@@ -85,6 +134,7 @@ class _DeploymentState:
         self.next_health_check = 0.0
         self.slo = None                    # DeploymentSLO when configured
         self.last_slo_scale = 0.0
+        self.last_slo_downscale = 0.0
         # Worker prestart-hint throttle (scale-up warm-up).
         self.last_prestart = 0.0
         self.last_prestart_n = 0
@@ -105,45 +155,429 @@ class _DeploymentState:
 
 class ServeController:
     RECONCILE_PERIOD_S = 0.5
+    PROXY_WATCH_PERIOD_S = 5.0
 
     def __init__(self):
         self._deployments: Dict[tuple, _DeploymentState] = {}
         self._routes: Dict[str, tuple] = {}  # route_prefix -> (app, ingress)
         self._proxy = None
         self._reconcile_task = None
-        self._started = False
+        self._boot_task: Optional[asyncio.Task] = None
         self._wake: Optional[asyncio.Event] = None
         # deploy_app's inline reconcile and the background loop interleave
         # (replica starts await the slice-domain lookup): without mutual
         # exclusion both can top up the same deployment and overshoot.
         self._reconcile_lock = asyncio.Lock()
+        # Control-plane API mutations (deploy/delete/shutdown) serialize:
+        # the route table and proxy bindings are read-modify-write over
+        # awaits, and interleaved calls would lose updates.
+        self._api_lock = asyncio.Lock()
+        self._proxy_lock = asyncio.Lock()
         self._drain_seen = 0               # index into drain_events()
         self._domains: Dict[str, list] = {}
         self._node_slice: Dict[Any, str] = {}
         self._nodes_ts = 0.0
-
-    async def _ensure_loops(self):
-        if not self._started:
-            self._started = True
-            self._wake = asyncio.Event()
-            loop = asyncio.get_running_loop()
-            wake = self._wake
-
-            def _notice():
-                loop.call_soon_threadsafe(wake.set)
-
+        self._next_proxy_watch = 0.0
+        self._proxy_watch_task: Optional[asyncio.Task] = None
+        # Durable control plane: write-ahead store + recovery bookkeeping.
+        self._persist = persistence.ServeStateStore()
+        self._recoveries_cum = 0           # KV-backed, across restarts
+        self._recover_t0 = 0.0             # >0 => this instance recovered
+        self._reattached_total = 0
+        self._replaced_total = 0
+        self._pending_reattach: Dict[tuple, List[dict]] = {}
+        self._proxy_rec: Dict[str, dict] = {}
+        self._known_actor_ids: set = set()   # registry + proxy actor ids
+        # The constructor runs on the worker's exec pool (no loop):
+        # blocking KV loads are legal here, and method calls can't land
+        # until it returns — so by the time anyone queries routing, the
+        # target state below is complete.
+        self._load_state()
+        if self._recover_t0:
+            # Self-driven recovery: a restarted controller must not wait
+            # for external traffic to kick its boot — the proxy may be
+            # dead too, leaving NOBODY to call us, and recovery is what
+            # re-arms the proxy. Schedule boot on the worker's core loop
+            # directly from the constructor.
             try:
                 from ray_tpu._private import worker_api
-                worker_api.add_drain_event_listener(_notice)
-            except Exception:  # noqa: BLE001 — no core (unit tests)
-                pass
+                core = worker_api.peek_core()
+                if core is not None:
+                    asyncio.run_coroutine_threadsafe(
+                        self._ensure_loops(), core.loop)
+            except Exception:  # noqa: BLE001 — first call still boots
+                logger.debug("self-boot kick failed", exc_info=True)
+
+    # ------------------------------------------------------------------
+    # Recovery: load persisted state (sync, constructor) + reattach
+    # ------------------------------------------------------------------
+    def _load_state(self):
+        try:
+            records = self._persist.load_all()
+        except Exception:  # noqa: BLE001 — KV unreachable: start empty
+            logger.exception("serve state load failed; starting fresh")
+            return
+        meta = records.pop(b"meta", None) or {}
+        self._recoveries_cum = int(meta.get("recoveries", 0))
+        targets = {k: r for k, r in records.items()
+                   if k.startswith(b"target/")}
+        has_rows = any(k.startswith(b"replica/") for k in records)
+        if (not targets and not has_rows
+                and persistence.PROXIES_KEY not in records):
+            return  # fresh cluster: nothing to recover
+        # Orphan replica rows with NO target (crash mid-delete) still
+        # demand a recovery pass: target-less rows are killed + GC'd.
+        self._recover_t0 = time.time()
+        self._recoveries_cum += 1
+        # Per-record fault isolation: one torn/foreign record must skip,
+        # never crash — a constructor exception would crash-loop the
+        # max_restarts=-1 controller on the same record forever.
+        for rec in targets.values():
             try:
-                from ray_tpu.util import metrics
-                metrics.start_loop_lag_probe_once("serve_controller")
-            except Exception:  # noqa: BLE001 — lag probe is best-effort
+                key = (rec["app"], rec["name"])
+                st = _DeploymentState(rec["app"], rec["name"], rec["blob"],
+                                      rec["config"], rec["version"])
+                self._apply_target_record(st, rec)
+                self._deployments[key] = st
+            except Exception:  # noqa: BLE001
+                logger.exception("skipping unreadable target record")
+        routes = records.get(persistence.ROUTES_KEY)
+        if routes:
+            self._routes = dict(routes.get("routes") or {})
+        for k, rec in records.items():
+            if not k.startswith(b"replica/"):
+                continue
+            try:
+                dkey = (rec["app"], rec["deployment"])
+                self._known_actor_ids.add(rec["actor_id"])
+                self._pending_reattach.setdefault(dkey, []).append(rec)
+            except Exception:  # noqa: BLE001
+                logger.exception("skipping unreadable replica row")
+        self._proxy_rec = dict(records.get(persistence.PROXIES_KEY) or {})
+        for rec in self._proxy_rec.values():
+            if isinstance(rec, dict) and "actor_id" in rec:
+                self._known_actor_ids.add(rec["actor_id"])
+        try:
+            self._persist.put_sync(b"meta",
+                                   {"recoveries": self._recoveries_cum})
+        except Exception:  # noqa: BLE001
+            logger.debug("recovery-count persist failed", exc_info=True)
+        try:
+            _recoveries_counter().inc()
+        except Exception:  # noqa: BLE001 — metrics never block recovery
+            pass
+        logger.info(
+            "serve controller recovering: %d deployment(s), %d registry "
+            "replica row(s), %d route(s) (recovery #%d)",
+            len(targets), sum(len(v) for v in self._pending_reattach.values()),
+            len(self._routes), self._recoveries_cum)
+
+    @staticmethod
+    def _apply_target_record(st: _DeploymentState, rec: dict):
+        """The ONE place (besides _set_target) allowed to write target
+        fields — enforced by scripts/check_serve_persistence.py."""
+        st.blob = rec["blob"]
+        st.config = rec["config"]
+        st.version = rec["version"]
+        st.target_num = rec["target_num"]
+        st._rebuild_slo()
+
+    async def _recover(self):
+        """Reattach-first recovery: probe every registry row, keep the
+        healthy replicas serving (no restart), replace the dead, resume
+        any in-flight rolling update from its persisted swap link."""
+        if not self._recover_t0:
+            return
+        from ray_tpu.util import tracing
+        span = tracing.start_span("serve:controller_recovery", None, "")
+        pending, self._pending_reattach = self._pending_reattach, {}
+        for dkey, rows in pending.items():
+            st = self._deployments.get(dkey)
+            if st is None:
+                # Rows for a deployment whose target record was deleted
+                # mid-shutdown: finish the job.
+                for row in rows:
+                    self._kill_registry_actor(row)
+                    self._persist.delete_soon(persistence.replica_key(
+                        row["app"], row["deployment"], row["replica_id"]))
+                continue
+            try:
+                await self._reattach_deployment(st, rows)
+            except Exception:  # noqa: BLE001 — never wedge recovery
+                logger.exception("reattach failed for %s; replicas will "
+                                 "be replaced by reconcile", dkey)
+        # Sweep BEFORE proxy reattach: an orphan proxy from a crash in
+        # the create-before-persist window may still hold the bind port
+        # the recreation below needs.
+        await self._sweep_orphan_actors()
+        await self._reattach_proxies()
+        try:
+            tracing.export_span(span)
+        except Exception:  # noqa: BLE001
+            pass
+        logger.info("serve controller recovery done: %d reattached, "
+                    "%d replaced", self._reattached_total,
+                    self._replaced_total)
+
+    # Serve's detached actor classes — anything of these classes alive
+    # in the cluster belongs to THIS control plane (one named controller
+    # per cluster), so an instance no KV record references is an orphan.
+    _SERVE_ACTOR_CLASSES = ("ReplicaActor", "ProxyActor", "GrpcProxyActor")
+
+    async def _sweep_orphan_actors(self):
+        """Close the create-before-persist window: a crash between a
+        detached actor's creation (replica in _start_replica, proxy in
+        the ensure paths) and its KV record leaves a live actor no
+        registry row references — owner cleanup no longer reaps it
+        (detached), so recovery must. Runs before the reconcile loop
+        starts creating anything new, so every legitimate serve actor is
+        either in the loaded registry or a reattached proxy binding."""
+        from ray_tpu._private import worker_api
+        from ray_tpu._private.common import ACTOR_DEAD
+        from ray_tpu.actor import ActorHandle
+        core = worker_api.peek_core()
+        if core is None:
+            return
+        try:
+            infos = await core.gcs.request("get_all_actors", {})
+        except Exception:  # noqa: BLE001 — sweep is best-effort
+            return
+        for info in infos:
+            if (info.class_name not in self._SERVE_ACTOR_CLASSES
+                    or info.state == ACTOR_DEAD
+                    or info.actor_id in self._known_actor_ids):
+                continue
+            logger.warning(
+                "killing orphaned serve actor %s (%s): created but never "
+                "registered before a controller crash",
+                info.actor_id.hex()[:12], info.class_name)
+            try:
+                ray_tpu.kill(ActorHandle._from_actor_info(info))
+            except Exception:  # noqa: BLE001
                 pass
-            self._reconcile_task = asyncio.ensure_future(
-                self._reconcile_loop())
+
+    @staticmethod
+    def _kill_registry_actor(row: dict):
+        try:
+            from ray_tpu.actor import ActorHandle
+            ray_tpu.kill(ActorHandle(row["actor_id"],
+                                     class_name="ReplicaActor"))
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
+
+    async def _reattach_deployment(self, st: _DeploymentState,
+                                   rows: List[dict]):
+        from ray_tpu._private import worker_api
+        from ray_tpu._private.common import (ACTOR_DEAD, ACTOR_PENDING,
+                                             ACTOR_RESTARTING)
+        from ray_tpu.actor import ActorHandle
+        core = worker_api.peek_core()
+        if core is None:
+            return  # bare unit tests: reconcile starts replicas fresh
+
+        async def probe(row):
+            try:
+                info = await core.gcs.request(
+                    "get_actor_info", {"actor_id": row["actor_id"]})
+            except Exception:  # noqa: BLE001
+                info = None
+            if info is None or info.state == ACTOR_DEAD:
+                return row, None, "dead"
+            handle = ActorHandle._from_actor_info(info)
+            if info.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+                # Constructor still running (crash landed mid-start):
+                # reattach as STARTING with fresh startup grace.
+                return row, handle, "starting"
+            try:
+                await asyncio.wait_for(
+                    handle.check_health.remote().future(), timeout=5)
+                return row, handle, "healthy"
+            except Exception:  # noqa: BLE001
+                return row, handle, "unhealthy"
+
+        results = await asyncio.gather(*(probe(r) for r in rows))
+        by_rid: Dict[str, tuple] = {}
+        for row, handle, verdict in results:
+            key = persistence.replica_key(row["app"], row["deployment"],
+                                          row["replica_id"])
+            if row.get("state") == REPLICA_DRAINING:
+                # Drain was in flight when the old controller died:
+                # finish the job (graceful stop + kill + row GC) instead
+                # of leaking a zombie replica. Not a restart, not a
+                # replacement — just a resumed retirement.
+                if handle is not None:
+                    stale = _ReplicaInfo(handle, row["version"])
+                    stale.replica_id = row["replica_id"]
+                    stale.state = REPLICA_DRAINING
+                    st.draining.append(stale)
+                    stale.drain_task = asyncio.ensure_future(
+                        self._drain_and_stop(st, stale))
+                else:
+                    self._persist.delete_soon(key)
+                continue
+            if verdict in ("dead", "unhealthy"):
+                self._replaced_total += 1
+                try:
+                    _replaced_counter().inc(tags={"Deployment": st.name})
+                except Exception:  # noqa: BLE001
+                    pass
+                if handle is not None:
+                    try:
+                        ray_tpu.kill(handle)
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._persist.delete_soon(key)
+                continue
+            info = _ReplicaInfo(handle, row["version"])
+            info.replica_id = row["replica_id"]
+            info.target_slice = row.get("target_slice") or ""
+            info.node_id = row.get("node_id")
+            if verdict == "healthy":
+                info.state = REPLICA_RUNNING
+                info.ever_healthy = True
+            st.replicas.append(info)
+            by_rid[info.replica_id] = (info, row)
+            self._reattached_total += 1
+            try:
+                _reattached_counter().inc(tags={"Deployment": st.name})
+            except Exception:  # noqa: BLE001
+                pass
+        # Resume the rolling update from the persisted swap links:
+        # replacement READY -> swap now (drain the old); replacement
+        # still starting -> re-link so _wait_ready swaps when it lands.
+        for _rid, (info, row) in list(by_rid.items()):
+            old_rid = row.get("replaces")
+            if not old_rid:
+                continue
+            old = by_rid.get(old_rid, (None, None))[0]
+            if old is None:
+                continue  # old already drained: this replica owns the slot
+            if info.state == REPLICA_RUNNING:
+                info.replaces = None
+                self._begin_drain(st, old, "rolling update (resumed)")
+            else:
+                info.replaces = old
+                old.being_replaced = True
+        for info, _row in by_rid.values():
+            if info.state == REPLICA_STARTING and info in st.replicas:
+                info.ready_task = asyncio.ensure_future(
+                    self._wait_ready(st, info))
+        st.list_version += 1
+
+    async def _reattach_proxies(self):
+        """Re-bind the persisted proxy actors (they are detached and
+        restartable: still-live ones keep serving from stale routes; a
+        restarted instance needs one ready() to re-listen)."""
+        from ray_tpu._private import worker_api
+        from ray_tpu._private.common import ACTOR_DEAD
+        from ray_tpu.actor import ActorHandle
+        core = worker_api.peek_core()
+        if core is None or not self._proxy_rec:
+            return
+        for kind, rec in list(self._proxy_rec.items()):
+            if not isinstance(rec, dict) or "actor_id" not in rec:
+                continue
+            try:
+                info = await core.gcs.request(
+                    "get_actor_info", {"actor_id": rec["actor_id"]})
+            except Exception:  # noqa: BLE001
+                info = None
+            alive = info is not None and info.state != ACTOR_DEAD
+            try:
+                if kind == "http":
+                    if alive:
+                        self._proxy = ActorHandle._from_actor_info(info)
+                    else:
+                        self._proxy = None
+                        await self._ensure_proxy_inner(rec["host"],
+                                                       rec["port"])
+                elif kind == "grpc":
+                    if alive:
+                        self._grpc_proxy = ActorHandle._from_actor_info(info)
+                        self._grpc_host = rec["host"]
+                        self._grpc_port = rec["port"]
+                    else:
+                        self._grpc_proxy = None
+                        await self._ensure_grpc_proxy_inner(rec["host"],
+                                                            rec["port"])
+            except Exception:  # noqa: BLE001 — proxy watch retries
+                logger.exception("proxy reattach (%s) failed", kind)
+
+    # ------------------------------------------------------------------
+    # Boot: listeners + recovery + reconcile loop, exactly once
+    # ------------------------------------------------------------------
+    async def _ensure_loops(self):
+        if self._boot_task is None:
+            self._boot_task = asyncio.ensure_future(self._boot())
+        await asyncio.shield(self._boot_task)
+
+    async def _boot(self):
+        self._wake = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        wake = self._wake
+
+        def _notice():
+            loop.call_soon_threadsafe(wake.set)
+
+        try:
+            from ray_tpu._private import worker_api
+            worker_api.add_drain_event_listener(_notice)
+        except Exception:  # noqa: BLE001 — no core (unit tests)
+            pass
+        try:
+            from ray_tpu.util import metrics
+            metrics.start_loop_lag_probe_once("serve_controller")
+        except Exception:  # noqa: BLE001 — lag probe is best-effort
+            pass
+        try:
+            await self._recover()
+        except Exception:  # noqa: BLE001 — recovery must not wedge boot
+            logger.exception("serve controller recovery failed; "
+                             "continuing from target state only")
+        self._reconcile_task = asyncio.ensure_future(self._reconcile_loop())
+
+    # ------------------------------------------------------------------
+    # Write-ahead persistence helpers
+    # ------------------------------------------------------------------
+    def _target_record(self, st: _DeploymentState) -> dict:
+        return persistence.target_record(st.app_name, st.name, st.blob,
+                                         st.config, st.version,
+                                         st.target_num)
+
+    def _replica_row(self, st: _DeploymentState, info: _ReplicaInfo) -> dict:
+        return persistence.replica_record(
+            st.app_name, st.name, info.replica_id, info.handle._actor_id,
+            info.version, info.state, node_id=info.node_id,
+            target_slice=info.target_slice,
+            replaces=info.replaces.replica_id
+            if info.replaces is not None else None)
+
+    async def _persist_replica_row(self, st: _DeploymentState,
+                                   info: _ReplicaInfo,
+                                   row: Optional[dict] = None):
+        await self._persist.put(
+            persistence.replica_key(st.app_name, st.name, info.replica_id),
+            row if row is not None else self._replica_row(st, info))
+
+    def _persist_replica_row_soon(self, st, info):
+        try:
+            asyncio.ensure_future(self._persist_replica_row(st, info))
+        except RuntimeError:  # no loop (sync unit tests)
+            pass
+
+    async def _set_target(self, st: _DeploymentState, n: int, reason: str):
+        """The ONE scale path: write-ahead the new target, then apply.
+        (scripts/check_serve_persistence.py forbids raw target_num
+        assignments elsewhere.)"""
+        if n == st.target_num:
+            return
+        rec = self._target_record(st)
+        rec["target_num"] = int(n)
+        await self._persist.put(
+            persistence.target_key(st.app_name, st.name), rec)
+        logger.info("scale %s: %d -> %d (%s)", st.name, st.target_num, n,
+                    reason)
+        st.target_num = int(n)
 
     # ------------------------------------------------------------------
     # Deployment API
@@ -152,20 +586,42 @@ class ServeController:
                          route_prefix: Optional[str], ingress: str):
         """deployments: [{name, blob, config, version}]"""
         await self._ensure_loops()
-        incoming = set()
+        async with self._api_lock:
+            return await self._deploy_app_locked(
+                app_name, deployments, route_prefix, ingress)
+
+    async def _deploy_app_locked(self, app_name, deployments, route_prefix,
+                                 ingress):
+        # Write-ahead, per DEPLOYMENT: each target record (and the route
+        # table) lands in the KV before its in-memory state or replica
+        # effects publish, so every deployment recovers to exactly its
+        # old or its new record. A crash BETWEEN two records of one
+        # multi-deployment app can recover a cross-deployment version
+        # mix (each internally consistent) — re-running the deploy
+        # converges it; app-atomic snapshots are a ROADMAP follow-on.
+        incoming: Dict[tuple, dict] = {}
         for d in deployments:
-            key = (app_name, d["name"])
-            incoming.add(key)
+            # ONE record per deployment, persisted then applied: the KV
+            # copy and the in-memory state can never diverge field-wise.
+            rec = persistence.target_record(
+                app_name, d["name"], d["blob"], d["config"], d["version"],
+                d["config"].num_replicas)
+            incoming[(app_name, d["name"])] = rec
+            await self._persist.put(
+                persistence.target_key(app_name, d["name"]), rec)
+        if route_prefix is not None:
+            routes = dict(self._routes)
+            routes[route_prefix] = (app_name, ingress)
+            await self._persist.put(persistence.ROUTES_KEY,
+                                    {"routes": routes})
+        for key, rec in incoming.items():
             cur = self._deployments.get(key)
             if cur is None:
-                self._deployments[key] = _DeploymentState(
-                    app_name, d["name"], d["blob"], d["config"], d["version"])
-            else:
-                cur.blob = d["blob"]
-                cur.config = d["config"]
-                cur.version = d["version"]
-                cur.target_num = d["config"].num_replicas
-                cur._rebuild_slo()  # fresh windows for the new objective
+                cur = _DeploymentState(rec["app"], rec["name"],
+                                       rec["blob"], rec["config"],
+                                       rec["version"])
+                self._deployments[key] = cur
+            self._apply_target_record(cur, rec)
         # Remove deployments no longer in the app.
         for key in [k for k in self._deployments
                     if k[0] == app_name and k not in incoming]:
@@ -176,21 +632,35 @@ class ServeController:
         return True
 
     async def delete_app(self, app_name: str):
-        for key in [k for k in self._deployments if k[0] == app_name]:
-            await self._remove_deployment(key)
-        self._routes = {r: v for r, v in self._routes.items()
-                        if v[0] != app_name}
-        return True
+        await self._ensure_loops()
+        async with self._api_lock:
+            routes = {r: v for r, v in self._routes.items()
+                      if v[0] != app_name}
+            await self._persist.put(persistence.ROUTES_KEY,
+                                    {"routes": routes})
+            for key in [k for k in self._deployments if k[0] == app_name]:
+                await self._remove_deployment(key)
+            self._routes = routes
+            return True
 
     async def _remove_deployment(self, key):
-        st = self._deployments.pop(key, None)
+        st = self._deployments.get(key)
         if st is None:
             return
+        # Write-ahead delete of the TARGET record first: a crash
+        # mid-removal recovers to "deleted". The registry rows stay
+        # until each replica is actually stopped — recovery finds
+        # target-less rows and garbage-collects the survivors instead
+        # of leaking them.
+        await self._persist.delete(persistence.target_key(*key))
+        self._deployments.pop(key, None)
         for r in list(st.replicas):
             if r.ready_task is not None:
                 r.ready_task.cancel()
             await self._stop_replica(st, r.handle)
         st.replicas.clear()
+        await self._persist.delete_prefix(
+            f"replica/{key[0]}/{key[1]}/".encode())
         # Already-DRAINING replicas finish through their own drain tasks.
 
     # Idle linger before a drained replica dies: covers the router
@@ -218,10 +688,19 @@ class ServeController:
     # ------------------------------------------------------------------
     async def _start_replica(self, st: _DeploymentState,
                              replaces: Optional[_ReplicaInfo] = None):
+        if self._deployments.get((st.app_name, st.name)) is not st:
+            # A reconcile pass parked across an await while delete_app
+            # removed the deployment: starting a replica for the
+            # orphaned state would leak a detached actor nobody tracks.
+            return None
         from ray_tpu.serve.replica import ReplicaActor
         cfg = st.config
         opts = dict(cfg.ray_actor_options)
         opts.setdefault("num_cpus", 0.1)
+        # Detached: replicas must survive their owner (this controller
+        # worker) dying — the controller reattaches them on recovery;
+        # lifecycle is explicit (drain/kill), never owner cleanup.
+        opts.setdefault("lifetime", "detached")
         # Admission control lives in the replica (bounded queue + shed):
         # the actor's concurrency cap must sit ABOVE max_ongoing + queue
         # so queued requests reach the replica's gate — and control
@@ -250,6 +729,20 @@ class ServeController:
         info = _ReplicaInfo(rep, st.version)
         info.replaces = replaces
         info.target_slice = target_slice
+        self._known_actor_ids.add(rep._actor_id)  # never orphan-swept
+        # Registry row BEFORE the replica set publishes: recovery must
+        # know about every replica routers might have seen. If the
+        # persist fails, the just-created detached actor must not leak
+        # (no row, no routing entry, no owner to reap it) — kill it and
+        # let the next reconcile pass retry the whole start.
+        try:
+            await self._persist_replica_row(st, info)
+        except BaseException:
+            try:
+                ray_tpu.kill(rep)
+            except Exception:  # noqa: BLE001
+                pass
+            raise
         st.replicas.append(info)
         st.list_version += 1
         info.ready_task = asyncio.ensure_future(self._wait_ready(st, info))
@@ -267,6 +760,17 @@ class ServeController:
             raise
         except Exception:
             return
+        # Persist the swap outcome BEFORE publishing it: a crash right
+        # here recovers a RUNNING replacement that owns its slot, and
+        # the (still-registered) old replica drains as the stale-version
+        # overshoot — never a restarted rollout.
+        row = self._replica_row(st, info)
+        row["state"] = REPLICA_RUNNING
+        row["replaces"] = None
+        try:
+            await self._persist_replica_row(st, info, row)
+        except Exception:  # noqa: BLE001 — persistence lags, serving wins
+            logger.debug("replica row persist failed", exc_info=True)
         # READY + swap in ONE sync block (no await between them): the
         # routable set must never publish a version where both the old
         # replica and its replacement serve — a client that already saw
@@ -282,6 +786,7 @@ class ServeController:
             self._begin_drain(st, old, "rolling update")
         try:
             info.node_id = await self._actor_node(info.handle)
+            self._persist_replica_row_soon(st, info)
         except Exception:  # noqa: BLE001 — placement info is best-effort
             pass
 
@@ -299,6 +804,11 @@ class ServeController:
         st.list_version += 1
         r.state = REPLICA_DRAINING
         st.draining.append(r)
+        # The registry row stays (marked DRAINING) until the drain
+        # COMPLETES: if this controller dies mid-drain, recovery finds
+        # the row and finishes the kill instead of leaking a zombie
+        # replica actor whose drain task died with us.
+        self._persist_replica_row_soon(st, r)
         logger.info("draining replica %s of %s (%s)",
                     r.replica_id, st.name, reason)
         r.drain_task = asyncio.ensure_future(self._drain_and_stop(st, r))
@@ -307,6 +817,9 @@ class ServeController:
         await self._stop_replica(st, r.handle, linger_s=self.DRAIN_LINGER_S)
         if r in st.draining:
             st.draining.remove(r)
+        # Registry GC only now that the actor is gone (see _begin_drain).
+        self._persist.delete_soon(persistence.replica_key(
+            st.app_name, st.name, r.replica_id))
 
     # ------------------------------------------------------------------
     # Reconciliation
@@ -336,13 +849,17 @@ class ServeController:
             if deficit > 0:
                 await self._prestart_for(st, deficit)
             while len(st.active()) < st.target_num:
-                await self._start_replica(st)
+                if await self._start_replica(st) is None:
+                    break  # deployment deleted mid-pass (orphan guard)
             while len(st.active()) > st.target_num:
-                # Prefer retiring replicas that never served, then the
-                # newest — oldest replicas are the proven ones.
+                # Prefer retiring stale-version replicas (a recovered
+                # mid-swap rollout drains the OLD side), then replicas
+                # that never served, then the newest — oldest replicas
+                # are the proven ones.
                 victims = sorted(
                     (r for r in st.active() if not r.being_replaced),
-                    key=lambda r: (r.state == REPLICA_RUNNING, -r.started))
+                    key=lambda r: (r.version == st.version,
+                                   r.state == REPLICA_RUNNING, -r.started))
                 if not victims:
                     break
                 self._begin_drain(st, victims[0], "scale down")
@@ -370,6 +887,7 @@ class ServeController:
                 await self._reconcile_once()
                 await self._health_check()
                 await self._autoscale()
+                await self._watch_proxies()
             except Exception:
                 logger.exception("serve controller reconcile error")
             # Jittered so co-resident controllers/probes desynchronize;
@@ -437,6 +955,7 @@ class ServeController:
                     if r.state == REPLICA_STARTING:
                         r.state = REPLICA_RUNNING
                         st.list_version += 1
+                        self._persist_replica_row_soon(st, r)
                     continue
                 # A replica that has never come up yet may simply still be
                 # starting (worker spawn under load): give it a grace
@@ -453,6 +972,8 @@ class ServeController:
     def _drop_dead_replica(self, st: _DeploymentState, r: _ReplicaInfo):
         if r in st.replicas:
             st.replicas.remove(r)
+        self._persist.delete_soon(persistence.replica_key(
+            st.app_name, st.name, r.replica_id))
         st.list_version += 1
         if r.ready_task is not None:
             r.ready_task.cancel()
@@ -490,6 +1011,7 @@ class ServeController:
             # sustained burn — latency pressure fires before the bounded
             # queue fills, so burn-driven capacity lands before a single
             # request is shed.
+            verdict = None
             if st.slo is not None and polled:
                 st.slo.ingest(polled)
                 verdict = st.slo.evaluate()
@@ -497,12 +1019,10 @@ class ServeController:
                         and st.target_num < asc.max_replicas
                         and now - st.last_slo_scale
                         >= st.config.slo_config.upscale_cooldown_s):
-                    logger.info(
-                        "SLO burn autoscale %s: %d -> %d (burn fast=%.1f "
-                        "slow=%.1f)", st.name, st.target_num,
-                        st.target_num + 1, verdict["fast"],
-                        verdict["slow"])
-                    st.target_num += 1
+                    await self._set_target(
+                        st, st.target_num + 1,
+                        f"SLO burn fast={verdict['fast']:.1f} "
+                        f"slow={verdict['slow']:.1f}")
                     st.last_slo_scale = now
                     st.last_scale_change = now
                     continue  # burn owns this tick: no queue downscale
@@ -519,13 +1039,29 @@ class ServeController:
             total = sum(m["ongoing"] + m.get("queued", 0)
                         for m in polled.values())
             desired = asc.decide(len(st.active()), total)
+            if st.slo is not None and desired < st.target_num:
+                # Burn-driven DOWNSCALE: with an SLO configured, capacity
+                # only shrinks when the error budget has not burned for a
+                # full slow window AND the queue policy agrees — and then
+                # by ONE replica per its own cooldown, so a recovery
+                # blip never cliffs the fleet.
+                cfg = st.config.slo_config
+                idle_s = verdict["idle_s"] if verdict else 0.0
+                if (idle_s >= cfg.slow_window_s
+                        and now - st.last_slo_downscale
+                        >= cfg.downscale_cooldown_s):
+                    await self._set_target(
+                        st, max(asc.min_replicas, st.target_num - 1),
+                        f"SLO idle {idle_s:.0f}s, queue wants {desired}")
+                    st.last_slo_downscale = now
+                    st.last_scale_change = now
+                continue
             delay = (asc.upscale_delay_s if desired > st.target_num
                      else asc.downscale_delay_s)
             if desired != st.target_num:
                 if now - st.last_scale_change >= delay:
-                    logger.info("autoscale %s: %d -> %d (ongoing=%.1f)",
-                                st.name, st.target_num, desired, total)
-                    st.target_num = desired
+                    await self._set_target(
+                        st, desired, f"queue autoscale ongoing={total:.1f}")
                     st.last_scale_change = now
             else:
                 st.last_scale_change = now
@@ -586,18 +1122,20 @@ class ServeController:
     # ------------------------------------------------------------------
     # Queries
     # ------------------------------------------------------------------
-    def get_replicas(self, app_name: str, deployment_name: str):
+    async def get_replicas(self, app_name: str, deployment_name: str):
+        await self._ensure_loops()
         st = self._deployments.get((app_name, deployment_name))
         if st is None:
             return (0, [])
         return (st.list_version, [r.handle for r in st.replicas])
 
-    def get_routing(self, app_name: str, deployment_name: str):
+    async def get_routing(self, app_name: str, deployment_name: str):
         """Routable replica set + the routing-relevant config bits.
 
         RUNNING replicas only — except cold start (none RUNNING yet),
         where STARTING replicas are offered so requests queue on a
         booting replica instead of failing."""
+        await self._ensure_loops()
         st = self._deployments.get((app_name, deployment_name))
         if st is None:
             return {"version": 0, "replicas": [], "config": {}}
@@ -614,10 +1152,12 @@ class ServeController:
             },
         }
 
-    def get_route_table(self):
+    async def get_route_table(self):
+        await self._ensure_loops()
         return dict(self._routes)
 
-    def status(self):
+    async def status(self):
+        await self._ensure_loops()
         out = {}
         for (app, name), st in self._deployments.items():
             row = {
@@ -638,33 +1178,140 @@ class ServeController:
             out.setdefault(app, {})[name] = row
         return out
 
+    async def ping(self):
+        """Cheap liveness/identity probe: answers DURING recovery (it
+        kicks boot instead of awaiting it) so proxies can re-anchor
+        their healthz grace on recovery progress."""
+        if self._boot_task is None:
+            self._boot_task = asyncio.ensure_future(self._boot())
+        return {"pid": os.getpid(),
+                "recovering": not self._boot_task.done(),
+                "recovered": self._recover_t0 > 0}
+
+    async def recovery_info(self):
+        await self._ensure_loops()
+        return {"recoveries": self._recoveries_cum,
+                "recovered": self._recover_t0 > 0,
+                "reattached": self._reattached_total,
+                "replaced": self._replaced_total,
+                "pid": os.getpid()}
+
+    # ------------------------------------------------------------------
+    # Proxies
+    # ------------------------------------------------------------------
     async def ensure_proxy(self, host: str, port: int):
-        if self._proxy is None:
-            from ray_tpu.serve.proxy import ProxyActor
-            cls = ray_tpu.remote(num_cpus=0.1)(ProxyActor)
-            self._proxy = cls.remote(host, port)
-            await self._proxy.ready.remote()
+        await self._ensure_loops()
+        return await self._ensure_proxy_inner(host, port)
+
+    async def _ensure_proxy_inner(self, host: str, port: int):
+        # Split from ensure_proxy: recovery (inside _boot) re-creates a
+        # dead proxy through HERE — the public method's _ensure_loops
+        # would await the very boot task recovery runs in (deadlock).
+        # _proxy_lock serializes the PROXIES_KEY read-modify-write with
+        # the grpc path: an interleaved copy would drop the other
+        # binding from the KV, and the next recovery would then
+        # orphan-sweep a healthy listening proxy.
+        async with self._proxy_lock:
+            if self._proxy is None:
+                from ray_tpu.serve.proxy import ProxyActor
+                # Detached + restartable: the ingress must outlive both
+                # this controller worker and its own crashes (the proxy
+                # watch re-arms the listener after a restart).
+                cls = ray_tpu.remote(num_cpus=0.1, max_restarts=-1,
+                                     lifetime="detached")(ProxyActor)
+                proxy = cls.remote(host, port)
+                self._known_actor_ids.add(proxy._actor_id)
+                await proxy.ready.remote()
+                rec = dict(self._proxy_rec)
+                rec["http"] = {"actor_id": proxy._actor_id, "host": host,
+                               "port": port}
+                await self._persist.put(persistence.PROXIES_KEY, rec)
+                self._proxy_rec = rec
+                self._proxy = proxy
         return True
 
     async def ensure_grpc_proxy(self, host: str, port: int) -> int:
         """Start the binary-RPC ingress (reference: gRPCProxy); returns the
         bound port."""
-        if getattr(self, "_grpc_proxy", None) is None:
-            from ray_tpu.serve.grpc_proxy import GrpcProxyActor
-            cls = ray_tpu.remote(num_cpus=0.1)(GrpcProxyActor)
-            actor = cls.remote(host, port)
-            try:
-                self._grpc_port = await actor.ready.remote()
-            except Exception:
-                # Failed startup (e.g. port in use) must stay retryable.
+        await self._ensure_loops()
+        return await self._ensure_grpc_proxy_inner(host, port)
+
+    async def _ensure_grpc_proxy_inner(self, host: str, port: int) -> int:
+        # Split for the same boot-reentrancy reason (and under the same
+        # PROXIES_KEY serialization) as _ensure_proxy_inner.
+        async with self._proxy_lock:
+            if getattr(self, "_grpc_proxy", None) is None:
+                from ray_tpu.serve.grpc_proxy import GrpcProxyActor
+                cls = ray_tpu.remote(num_cpus=0.1, max_restarts=-1,
+                                     lifetime="detached")(GrpcProxyActor)
+                actor = cls.remote(host, port)
+                self._known_actor_ids.add(actor._actor_id)
                 try:
-                    ray_tpu.kill(actor)
+                    self._grpc_port = await actor.ready.remote()
                 except Exception:
-                    pass
-                raise
-            self._grpc_host = host
-            self._grpc_proxy = actor
+                    # Failed startup (e.g. port in use) stays retryable.
+                    try:
+                        ray_tpu.kill(actor)
+                    except Exception:
+                        pass
+                    raise
+                self._grpc_host = host
+                self._grpc_proxy = actor
+                rec = dict(self._proxy_rec)
+                # Persist the BOUND port: a recovered controller
+                # recreating a dead ingress must rebind where clients
+                # already point.
+                rec["grpc"] = {"actor_id": actor._actor_id, "host": host,
+                               "port": self._grpc_port}
+                await self._persist.put(persistence.PROXIES_KEY, rec)
+                self._proxy_rec = rec
         return self._grpc_port
+
+    async def _watch_proxies(self):
+        """Proxy autonomy, controller side: proxies are restartable
+        detached actors, but a restarted instance listens again only
+        when someone calls ready() — this throttled watch is that
+        someone. It also retries a recreation that failed during
+        recovery (a persisted binding with no live handle). The probe
+        runs as a background task: a parked ready() on a mid-restart
+        proxy must not stall the reconcile/health cadence."""
+        now = time.monotonic()
+        if now < self._next_proxy_watch:
+            return
+        if self._proxy_watch_task is not None \
+                and not self._proxy_watch_task.done():
+            return  # previous probe still in flight (parked call)
+        self._next_proxy_watch = now + self.PROXY_WATCH_PERIOD_S
+        self._proxy_watch_task = asyncio.ensure_future(
+            self._watch_proxies_inner())
+
+    async def _watch_proxies_inner(self):
+        for kind in ("http", "grpc"):
+            actor = self._proxy if kind == "http" \
+                else getattr(self, "_grpc_proxy", None)
+            if actor is None:
+                # Persisted binding with no live handle: the recovery
+                # recreation failed (port briefly held, GCS hiccup) —
+                # keep retrying here until ingress is back.
+                rec = self._proxy_rec.get(kind)
+                if not isinstance(rec, dict) or "host" not in rec:
+                    continue
+                try:
+                    if kind == "http":
+                        await self._ensure_proxy_inner(rec["host"],
+                                                       rec["port"])
+                    else:
+                        await self._ensure_grpc_proxy_inner(rec["host"],
+                                                            rec["port"])
+                except Exception:  # noqa: BLE001 — next pass retries
+                    logger.debug("proxy recreate retry failed",
+                                 exc_info=True)
+                continue
+            try:
+                await asyncio.wait_for(actor.ready.remote().future(),
+                                       timeout=5)
+            except Exception:  # noqa: BLE001 — restarting: next pass
+                logger.debug("proxy watch ready() failed", exc_info=True)
 
     def get_grpc_address(self) -> str:
         if getattr(self, "_grpc_proxy", None) is None:
@@ -673,13 +1320,28 @@ class ServeController:
         return f"{self._grpc_host}:{self._grpc_port}"
 
     async def shutdown(self):
+        await self._ensure_loops()
+        async with self._api_lock:
+            return await self._shutdown_locked()
+
+    async def _shutdown_locked(self):
         for key in list(self._deployments):
             await self._remove_deployment(key)
+        # Clear ALL serve state (routes, proxies, recovery meta): a
+        # shut-down serve instance must not be "recovered" by the next
+        # controller this cluster starts — and the proxy watch must not
+        # resurrect the proxies we kill below.
+        self._proxy_rec = {}
+        try:
+            await self._persist.delete_prefix(b"")
+        except Exception:  # noqa: BLE001
+            logger.debug("serve state clear failed", exc_info=True)
         if getattr(self, "_grpc_proxy", None) is not None:
             try:
                 ray_tpu.kill(self._grpc_proxy)
             except Exception:
                 pass
+            self._grpc_proxy = None
         if self._proxy is not None:
             try:
                 ray_tpu.kill(self._proxy)

@@ -75,13 +75,27 @@ class GrpcProxyActor:
                 pass
         return self._port
 
+    # Stale-while-revalidate (same contract as the HTTP proxy): a
+    # controller outage must not fail or stall ingress — refresh attempts
+    # are bounded and failures keep serving the cached table.
+    CTRL_TIMEOUT_S = 2.0
+
+    async def _refresh_routes(self):
+        import asyncio
+        from ray_tpu.serve.api import _get_controller_async
+        ctrl = await _get_controller_async()
+        self._routes = await asyncio.wait_for(
+            ctrl.get_route_table.remote().future(),
+            timeout=self.CTRL_TIMEOUT_S)
+
     async def _handle_for(self, payload) -> Any:
         now = time.monotonic()
         if now - self._last_refresh > self.ROUTE_REFRESH_S:
             self._last_refresh = now
-            from ray_tpu.serve.api import _get_controller_async
-            ctrl = await _get_controller_async()
-            self._routes = await ctrl.get_route_table.remote()
+            try:
+                await self._refresh_routes()
+            except Exception:  # noqa: BLE001 — serve from stale routes
+                pass
         app = payload.get("app", "default")
         deployment = payload.get("deployment")
 
@@ -97,11 +111,11 @@ class GrpcProxyActor:
             # before failing.
             deployment = _ingress()
             if deployment is None:
-                self._last_refresh = 0.0
-                from ray_tpu.serve.api import _get_controller_async
-                ctrl = await _get_controller_async()
-                self._routes = await ctrl.get_route_table.remote()
-                self._last_refresh = time.monotonic()
+                try:
+                    await self._refresh_routes()
+                    self._last_refresh = time.monotonic()
+                except Exception:  # noqa: BLE001
+                    pass
                 deployment = _ingress()
         if deployment is None:
             raise ValueError(f"no application {app!r}")

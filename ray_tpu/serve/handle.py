@@ -56,7 +56,8 @@ class _PendingRequest:
     """Retained request payload: everything needed to re-dispatch."""
 
     __slots__ = ("method", "mux_id", "args", "kwargs", "request_id",
-                 "deadline_ts", "attempts", "trace", "finish_on_settle")
+                 "deadline_ts", "attempts", "trace", "finish_on_settle",
+                 "last_rid")
 
     def __init__(self, method: str, mux_id: str, args: tuple, kwargs: dict,
                  deadline_ts: float = 0.0, trace=None):
@@ -74,6 +75,7 @@ class _PendingRequest:
         self.request_id = uuid.uuid4().hex
         self.deadline_ts = deadline_ts
         self.attempts = 0
+        self.last_rid = None   # replica this request last dispatched to
 
     def wire_trace(self):
         return self.trace.wire() \
@@ -190,17 +192,26 @@ class DeploymentResponseGenerator:
 
     Failover: before the FIRST item, a died/draining replica re-routes
     the stream (replay-gated like unary calls). After items were
-    delivered, replaying would duplicate them — the stream fails with a
-    typed ReplicaDiedError instead."""
+    delivered, a REPLAYABLE deployment re-routes with a mid-stream
+    cursor: the handle tracks the item offset already delivered, replays
+    the stream on a healthy replica, and fast-forwards past the cursor —
+    the caller sees the stream resume from the last delivered item, no
+    duplicates, no restart. (The handler re-executes, so this is gated
+    on `request_replay=True` exactly like unary replays; a replay that
+    produces FEWER items than the cursor — a non-deterministic handler —
+    fails with a typed ReplicaDiedError instead of silently yielding a
+    divergent tail.) Non-replayable deployments keep the old behavior:
+    a typed ReplicaDiedError after the first delivered item."""
 
     def __init__(self, ref_gen=None, on_done=None, setup_coro=None,
                  recover=None, deployment: str = ""):
         self._gen = ref_gen
         self._on_done = on_done or (lambda: None)
         self._setup_coro = setup_coro  # async context: routing is deferred
-        self._recover = recover        # sync re-dispatch (pre-first-item)
+        self._recover = recover        # sync re-dispatch (replay-gated)
         self._deployment = deployment
         self._items = 0
+        self._to_skip = 0              # replay cursor fast-forward budget
         self._done = False
 
     def _settle(self):
@@ -218,6 +229,28 @@ class DeploymentResponseGenerator:
     def __iter__(self):
         return self
 
+    def _short_replay(self):
+        self._settle()
+        return ReplicaDiedError(
+            self._deployment,
+            reason=f"mid-stream replay ended after "
+                   f"{self._items - self._to_skip} item(s), before the "
+                   f"{self._items}-item cursor — handler output is not "
+                   f"deterministic, cannot resume the stream")
+
+    def _recover_sync(self, err):
+        """Replay-gated re-route (sync path): on success the cursor arms
+        the fast-forward so already-delivered items are skipped."""
+        if self._recover is None:
+            self._settle()
+            raise err
+        try:
+            self._gen = self._recover(err)
+        except BaseException:
+            self._settle()
+            raise
+        self._to_skip = self._items
+
     def __next__(self):
         if self._gen is None:
             raise RuntimeError("streaming call was made in async context; "
@@ -228,16 +261,24 @@ class DeploymentResponseGenerator:
                 try:
                     ref = next(self._gen)
                 except StopIteration:
+                    if self._to_skip > 0:
+                        raise self._short_replay() from None
                     self._settle()
                     raise
                 value = ray_tpu.get(ref)
+                if self._to_skip > 0:
+                    self._to_skip -= 1   # cursor fast-forward: re-
+                    continue             # delivered item, don't re-yield
                 self._items += 1
                 return value
             except exc.TaskError as e:
                 cause = unwrap(e)
                 if isinstance(cause, ReplicaDrainingError) \
-                        and self._items == 0 and self._recover is not None:
-                    self._gen = self._recover(cause)
+                        and self._recover is not None:
+                    # Pre-first-item: always replay-safe. Mid-replay
+                    # bounce (re-routed onto a now-draining replica):
+                    # gated inside _recover like any replay.
+                    self._recover_sync(cause)
                     continue
                 if isinstance(cause, ServeError):
                     self._settle()
@@ -246,13 +287,20 @@ class DeploymentResponseGenerator:
                 raise
             except (exc.ActorDiedError, exc.ActorUnavailableError,
                     exc.WorkerCrashedError) as e:
-                if self._items == 0 and self._recover is not None:
+                if self._recover is not None:
                     try:
-                        self._gen = self._recover(e)
+                        # Replay-gated (request_replay): items == 0 always
+                        # re-routes; past that the cursor resumes.
+                        self._recover_sync(e)
                         continue
-                    except Exception:
-                        self._settle()
+                    except ReplicaDiedError:
                         raise
+                    except (exc.ActorDiedError, exc.ActorUnavailableError,
+                            exc.WorkerCrashedError):
+                        raise ReplicaDiedError(
+                            self._deployment,
+                            reason=f"died mid-stream after {self._items} "
+                                   f"item(s)") from e
                 self._settle()
                 raise ReplicaDiedError(
                     self._deployment,
@@ -262,48 +310,72 @@ class DeploymentResponseGenerator:
     def __aiter__(self):
         return self
 
+    async def _recover_async(self, err):
+        """Replay-gated re-route (async path) + cursor arm."""
+        if self._setup_coro is None:
+            self._settle()
+            raise err
+        self._release_once()
+        try:
+            self._gen, self._on_done = await self._setup_coro(err)
+        except BaseException:
+            self._settle()
+            raise
+        self._to_skip = self._items
+
     async def __anext__(self):
         from ray_tpu import exceptions as exc
         if self._gen is None:
             # First iteration in async context: run the deferred routing.
             self._gen, self._on_done = await self._setup_coro(None)
-        try:
-            ref = await self._gen.__anext__()
-            value = await ref
-            self._items += 1
-            return value
-        except StopAsyncIteration:
-            self._settle()
-            raise
-        except exc.TaskError as e:
-            cause = unwrap(e)
-            if isinstance(cause, ReplicaDrainingError) and self._items == 0 \
-                    and self._setup_coro is not None:
-                self._release_once()
-                self._gen, self._on_done = await self._setup_coro(cause)
-                return await self.__anext__()
-            if isinstance(cause, ServeError):
+        while True:
+            try:
+                ref = await self._gen.__anext__()
+                value = await ref
+                if self._to_skip > 0:
+                    self._to_skip -= 1   # cursor fast-forward
+                    continue
+                self._items += 1
+                return value
+            except StopAsyncIteration:
+                if self._to_skip > 0:
+                    raise self._short_replay() from None
                 self._settle()
-                raise cause from None
-            self._settle()
-            raise
-        except (exc.ActorDiedError, exc.ActorUnavailableError,
-                exc.WorkerCrashedError) as e:
-            if self._items == 0 and self._setup_coro is not None:
-                self._release_once()
-                try:
-                    # Replay-gated inside the setup: non-replayable
-                    # deployments get the typed ReplicaDiedError here.
-                    self._gen, self._on_done = await self._setup_coro(e)
-                except Exception:
+                raise
+            except exc.TaskError as e:
+                cause = unwrap(e)
+                if isinstance(cause, ReplicaDrainingError) \
+                        and self._setup_coro is not None:
+                    await self._recover_async(cause)
+                    continue
+                if isinstance(cause, ServeError):
                     self._settle()
-                    raise
-                return await self.__anext__()
-            self._settle()
-            raise ReplicaDiedError(
-                self._deployment,
-                reason=f"died mid-stream after {self._items} item(s)",
-            ) from e
+                    raise cause from None
+                self._settle()
+                raise
+            except (exc.ActorDiedError, exc.ActorUnavailableError,
+                    exc.WorkerCrashedError) as e:
+                if self._setup_coro is not None:
+                    try:
+                        # Replay-gated inside the setup: non-replayable
+                        # deployments get the typed ReplicaDiedError here
+                        # (items == 0 always re-routes; past that the
+                        # cursor resumes on a replayable deployment).
+                        await self._recover_async(e)
+                        continue
+                    except ReplicaDiedError:
+                        raise
+                    except (exc.ActorDiedError, exc.ActorUnavailableError,
+                            exc.WorkerCrashedError):
+                        raise ReplicaDiedError(
+                            self._deployment,
+                            reason=f"died mid-stream after {self._items} "
+                                   f"item(s)") from e
+                self._settle()
+                raise ReplicaDiedError(
+                    self._deployment,
+                    reason=f"died mid-stream after {self._items} item(s)",
+                ) from e
 
     def __del__(self):
         try:
@@ -316,9 +388,20 @@ class Router:
     """Client-side replica picker with periodic replica-list refresh.
 
     Replicas are keyed by the controller-issued replica id; in-flight
-    counts survive list refreshes for replicas that stay in the set."""
+    counts survive list refreshes for replicas that stay in the set.
+
+    Stale-while-revalidate: when the controller is unreachable (crash,
+    restart, recovery in progress) the router keeps serving from its
+    last-known routing table for up to STALE_MAX_S — a controller death
+    alone never fails a request. Locally-observed replica deaths/drains
+    evict the replica from the cached set (`evict`) so stale routing
+    converges onto the live replicas without the controller's help."""
 
     REFRESH_S = 1.0
+    # Bounded staleness: past this with no successful controller round
+    # trip the cached routing is too old to trust (replicas may have
+    # moved wholesale) and routing errors surface to the caller.
+    STALE_MAX_S = 30.0
 
     def __init__(self, deployment_name: str, app_name: str):
         self._dep = deployment_name
@@ -327,7 +410,8 @@ class Router:
         self._version = -1
         self._inflight: Dict[str, int] = {}
         self._meta: Dict[str, Any] = {}
-        self._last_refresh = 0.0
+        self._last_refresh = 0.0       # last refresh ATTEMPT (throttle)
+        self._last_success = 0.0       # last controller round trip
         self._lock = threading.Lock()
 
     @property
@@ -341,6 +425,7 @@ class Router:
     def _apply(self, now, routing: dict):
         with self._lock:
             self._last_refresh = now
+            self._last_success = now
             self._meta = routing.get("config") or self._meta
             version = routing.get("version", 0)
             if version != self._version:
@@ -350,23 +435,43 @@ class Router:
                 self._inflight = {rid: old.get(rid, 0)
                                   for rid, _ in self._replicas}
 
+    def _serve_stale(self, now, err) -> None:
+        """Refresh failed (controller down/restarting): keep the cached
+        set within the staleness bound, surface the error past it."""
+        if self._replicas and now - self._last_success < self.STALE_MAX_S:
+            return
+        raise err
+
     def _refresh(self, force: bool = False):
         now = time.monotonic()
         if not force and now - self._last_refresh < self.REFRESH_S:
             return
+        self._last_refresh = now
         from ray_tpu.serve.api import _get_controller
         ctrl = _get_controller()
-        routing = ray_tpu.get(
-            ctrl.get_routing.remote(self._app, self._dep), timeout=30)
+        try:
+            routing = ray_tpu.get(
+                ctrl.get_routing.remote(self._app, self._dep), timeout=10)
+        except Exception as e:  # noqa: BLE001 — stale-while-revalidate
+            self._serve_stale(now, e)
+            return
         self._apply(now, routing)
 
     async def refresh_async(self, force: bool = False):
+        import asyncio
         now = time.monotonic()
         if not force and now - self._last_refresh < self.REFRESH_S:
             return
-        from ray_tpu.serve.api import _get_controller_async
-        ctrl = await _get_controller_async()
-        routing = await ctrl.get_routing.remote(self._app, self._dep)
+        self._last_refresh = now
+        try:
+            from ray_tpu.serve.api import _get_controller_async
+            ctrl = await _get_controller_async()
+            routing = await asyncio.wait_for(
+                ctrl.get_routing.remote(self._app, self._dep).future(),
+                timeout=10)
+        except Exception as e:  # noqa: BLE001 — stale-while-revalidate
+            self._serve_stale(now, e)
+            return
         self._apply(now, routing)
 
     def pick_cached(self):
@@ -394,6 +499,18 @@ class Router:
         with self._lock:
             if rid in self._inflight and self._inflight[rid] > 0:
                 self._inflight[rid] -= 1
+
+    def evict(self, rid: str):
+        """Locally remove a replica the caller OBSERVED dead/draining:
+        during a controller outage the stale routing table can't drop it
+        for us, and p2c would keep burning attempts on the corpse. The
+        next successful controller refresh replaces the whole set."""
+        with self._lock:
+            before = len(self._replicas)
+            self._replicas = [(r, h) for r, h in self._replicas if r != rid]
+            if len(self._replicas) != before:
+                self._inflight.pop(rid, None)
+                self._version = -1   # any refresh re-applies authoritative
 
     def drop_replicas(self):
         with self._lock:
@@ -564,6 +681,7 @@ class DeploymentHandle:
                 try:
                     out = submit(replica, req)
                     state["rid"] = rid
+                    req.last_rid = rid
                     return out
                 except Exception as e:
                     router.release(rid)
@@ -572,11 +690,16 @@ class DeploymentHandle:
             raise last_err
 
         def recover(err):
+            failed_rid = state["rid"]
             release()
             req.attempts += 1
             self._gate_replay(router, req, err)
             _count_replay(self.deployment_name)
             req.record_replay(err)  # failover stays ONE trace: replay hop
+            # Locally evict the observed-dead/draining replica: during a
+            # controller outage the stale routing table can't drop it.
+            if failed_rid is not None:
+                router.evict(failed_rid)
             router.drop_replicas()
             # Backoff: the controller needs a health-check round to drop
             # a dead replica from the routable set — instant re-dispatch
@@ -611,6 +734,8 @@ class DeploymentHandle:
             self._gate_replay(router, req, err)
             _count_replay(self.deployment_name)
             req.record_replay(err)
+            if req.last_rid is not None:
+                router.evict(req.last_rid)
             router.drop_replicas()
             if not isinstance(err, ReplicaDrainingError):
                 # Let the controller's health check drop the dead replica.
@@ -630,6 +755,7 @@ class DeploymentHandle:
                 await asyncio.sleep(0.2 * (attempt + 1))
                 continue
             try:
+                req.last_rid = rid
                 gen = self._submit_stream(replica, req)
 
                 def _release(rid=rid):
@@ -685,6 +811,7 @@ class DeploymentHandle:
                 cause = unwrap(e)
                 if isinstance(cause, ReplicaDrainingError):
                     # Handed back before execution: re-route, always.
+                    router.evict(rid)
                     router.drop_replicas()
                     _count_replay(self.deployment_name)
                     req.record_replay(cause)
@@ -695,6 +822,7 @@ class DeploymentHandle:
                 raise    # application exceptions propagate unchanged
             except (exc.ActorDiedError, exc.ActorUnavailableError,
                     exc.WorkerCrashedError) as e:
+                router.evict(rid)
                 router.drop_replicas()
                 if not router.replayable:
                     raise ReplicaDiedError(self.deployment_name,

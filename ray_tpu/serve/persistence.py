@@ -1,0 +1,207 @@
+"""Durable serve control-plane state: the controller's write-ahead store.
+
+Reference parity: python/ray/serve/_private/storage/kv_store.py +
+controller checkpointing (serve/_private/controller.py persists target
+state to the GCS internal KV and *recovers* running replicas instead of
+restarting them). Every controller mutation — deploy / delete / scale /
+autoscale decision / SLO config — persists a schema-versioned record
+here BEFORE the controller publishes any routing or replica effect, and
+every live replica keeps a registry row (deployment, replica id, actor
+id, version, node / slice domain, swap link). A restarted controller
+loads this state, reattaches the still-live ReplicaActors, and
+reconciles — only version-mismatched or unhealthy replicas are
+replaced.
+
+Keys (GCS KV, ``serve`` namespace):
+
+    target/{app}/{deployment}      -> deployment target record
+    replica/{app}/{deployment}/{replica_id} -> live-replica registry row
+    routes                         -> route_prefix -> (app, ingress)
+    proxies                        -> persisted proxy actor bindings
+
+Records are pickled dicts stamped with ``schema``; a loader skips
+records from a NEWER schema (a rolled-back controller must not
+misread state a newer one wrote) and upgrades older ones in place.
+
+The store has two faces: synchronous loads/puts for the controller
+constructor (which runs on the worker's exec pool, where blocking on
+the core loop is legal) and awaitable puts/deletes for the controller's
+method bodies (which run ON the core loop). With no core worker at all
+(bare unit tests) it degrades to a process-local dict so controller
+logic stays unit-testable.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+SCHEMA_VERSION = 1
+NAMESPACE = "serve"
+
+# Process-local fallback when no core worker exists (bare unit tests):
+# namespace semantics preserved so tests exercise real key handling.
+_local_store: Dict[bytes, bytes] = {}
+
+
+def target_key(app: str, deployment: str) -> bytes:
+    return f"target/{app}/{deployment}".encode()
+
+def replica_key(app: str, deployment: str, replica_id: str) -> bytes:
+    return f"replica/{app}/{deployment}/{replica_id}".encode()
+
+
+ROUTES_KEY = b"routes"
+PROXIES_KEY = b"proxies"
+
+
+def encode(record: dict) -> bytes:
+    rec = dict(record)
+    rec.setdefault("schema", SCHEMA_VERSION)
+    return pickle.dumps(rec)
+
+
+def decode(blob: Optional[bytes]) -> Optional[dict]:
+    """None for missing/unreadable records and records written by a
+    NEWER schema (rolled-back controller: treat as absent rather than
+    misinterpret fields)."""
+    if blob is None:
+        return None
+    try:
+        rec = pickle.loads(blob)
+    except Exception:  # noqa: BLE001 — torn/foreign record: skip it
+        logger.warning("unreadable serve state record dropped")
+        return None
+    if not isinstance(rec, dict) or rec.get("schema", 0) > SCHEMA_VERSION:
+        return None
+    return rec
+
+
+class ServeStateStore:
+    """KV facade bound to this process's core worker (or the local
+    fallback dict)."""
+
+    def __init__(self):
+        self._core = None
+        try:
+            from ray_tpu._private import worker_api
+            self._core = worker_api.peek_core()
+        except Exception:  # noqa: BLE001 — no core: unit-test fallback
+            self._core = None
+
+    # ------------------------------------------------------ sync face
+    def _sync(self, coro, timeout: float = 30):
+        from ray_tpu._private import worker_api
+        return worker_api._call_on_core_loop(self._core, coro, timeout)
+
+    def load_all(self) -> Dict[bytes, dict]:
+        """Every serve-namespace record, decoded. Used once, by the
+        controller constructor (exec pool — blocking is legal there).
+        One cross-loop hop: the key list + all gets run concurrently on
+        the core loop, so recovery load is O(1) round trips from the
+        constructor's thread, not O(keys)."""
+        out: Dict[bytes, dict] = {}
+        if self._core is None:
+            items = list(_local_store.items())
+        else:
+            core = self._core
+
+            async def _fetch():
+                import asyncio
+                keys = await core.gcs.request(
+                    "kv_keys", {"namespace": NAMESPACE, "prefix": b""})
+                blobs = await asyncio.gather(*[
+                    core.gcs.request("kv_get",
+                                     {"namespace": NAMESPACE, "key": k})
+                    for k in keys])
+                return list(zip(keys, blobs))
+
+            items = self._sync(_fetch(), timeout=60)
+        for k, blob in items:
+            rec = decode(blob)
+            if rec is not None:
+                out[k] = rec
+        return out
+
+    def put_sync(self, key: bytes, record: dict) -> None:
+        if self._core is None:
+            _local_store[key] = encode(record)
+            return
+        self._sync(self._core.gcs.request("kv_put", {
+            "namespace": NAMESPACE, "key": key, "value": encode(record),
+            "overwrite": True}))
+
+    # ----------------------------------------------------- async face
+    async def put(self, key: bytes, record: dict) -> None:
+        """Write-ahead put: callers await this BEFORE publishing the
+        mutation's effects (routing/replica changes)."""
+        if self._core is None:
+            _local_store[key] = encode(record)
+            return
+        from ray_tpu._private import worker_api
+        await worker_api.internal_kv_put_async(
+            self._core, key, encode(record), namespace=NAMESPACE)
+
+    async def delete(self, key: bytes) -> None:
+        if self._core is None:
+            _local_store.pop(key, None)
+            return
+        from ray_tpu._private import worker_api
+        await worker_api.internal_kv_del_async(
+            self._core, key, namespace=NAMESPACE)
+
+    def delete_soon(self, key: bytes) -> None:
+        """Fire-and-forget delete for registry GC from sync contexts
+        (a stale registry row is harmless: recovery health-probes every
+        row and discards the dead)."""
+        if self._core is None:
+            _local_store.pop(key, None)
+            return
+        import asyncio
+        try:
+            asyncio.ensure_future(self.delete(key))
+        except RuntimeError:  # no running loop (sync unit tests)
+            pass
+
+    async def delete_prefix(self, prefix: bytes) -> int:
+        keys = await self.keys(prefix)
+        for k in keys:
+            await self.delete(k)
+        return len(keys)
+
+    async def keys(self, prefix: bytes = b"") -> List[bytes]:
+        if self._core is None:
+            return [k for k in _local_store if k.startswith(prefix)]
+        from ray_tpu._private import worker_api
+        return list(await worker_api.internal_kv_keys_async(
+            self._core, prefix, namespace=NAMESPACE))
+
+    async def get(self, key: bytes) -> Optional[dict]:
+        if self._core is None:
+            return decode(_local_store.get(key))
+        from ray_tpu._private import worker_api
+        return decode(await worker_api.internal_kv_get_async(
+            self._core, key, namespace=NAMESPACE))
+
+
+def target_record(app: str, name: str, blob: bytes, config: Any,
+                  version: str, target_num: int) -> dict:
+    return {"schema": SCHEMA_VERSION, "app": app, "name": name,
+            "blob": blob, "config": config, "version": version,
+            "target_num": int(target_num)}
+
+
+def replica_record(app: str, deployment: str, replica_id: str,
+                   actor_id: Any, version: str, state: str,
+                   node_id: Any = None, target_slice: str = "",
+                   replaces: Optional[str] = None) -> dict:
+    """One live-replica registry row. ``replaces`` carries the rolling
+    update's swap step: a crash mid-update resumes replace-then-drain
+    from this link instead of restarting the rollout."""
+    return {"schema": SCHEMA_VERSION, "app": app, "deployment": deployment,
+            "replica_id": replica_id, "actor_id": actor_id,
+            "version": version, "state": state, "node_id": node_id,
+            "target_slice": target_slice, "replaces": replaces}

@@ -60,6 +60,17 @@ class ProxyActor:
     # refresh this recently; past it, readiness requires a live probe.
     HEALTHZ_GRACE_S = 10.0
 
+    # Proxy autonomy: with the controller down (crash, restart, recovery
+    # in progress) the proxy keeps serving its last-known route table —
+    # requests route from stale state and the handles' own stale routing
+    # keeps them flowing to live replicas. Readiness only flips once the
+    # outage outlives this bound (the table is then too old to trust).
+    ROUTE_STALE_MAX_S = 60.0
+
+    # One controller round trip must never block a request: past this the
+    # refresh attempt is abandoned and the stale table serves.
+    CTRL_TIMEOUT_S = 2.0
+
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self._host = host
         self._port = port
@@ -91,14 +102,40 @@ class ProxyActor:
         try:
             from ray_tpu.serve.api import _get_controller_async
             ctrl = await _get_controller_async()
-            routes = await ctrl.get_route_table.remote()
+            # Bounded: a restarting controller parks calls until it is
+            # back — that wait must never ride a request's latency. The
+            # abandoned call completes harmlessly later.
+            routes = await asyncio.wait_for(
+                ctrl.get_route_table.remote().future(),
+                timeout=self.CTRL_TIMEOUT_S)
         except Exception:  # noqa: BLE001 — serve with stale routes;
-            return         # /-/healthz flips after HEALTHZ_GRACE_S
+            return         # /-/healthz flips per _healthz_ready
         self._ctrl_ok_ts = time.monotonic()
         if routes != self._routes:
             # Redeploys may switch a handler generator <-> plain: re-probe.
             self._streaming.clear()
         self._routes = routes
+
+    async def _healthz_ready(self) -> bool:
+        """Readiness, re-anchored on recovery progress: controller
+        answered recently -> ready; controller unreachable -> probe it
+        (a restarted controller answers ping() DURING recovery, which
+        re-anchors the grace window); still unreachable -> stay ready on
+        the stale route table within ROUTE_STALE_MAX_S."""
+        now = time.monotonic()
+        if now - self._ctrl_ok_ts < self.HEALTHZ_GRACE_S:
+            return True
+        try:
+            from ray_tpu.serve.api import _get_controller_async
+            ctrl = await _get_controller_async()
+            await asyncio.wait_for(ctrl.ping.remote().future(),
+                                   timeout=self.CTRL_TIMEOUT_S)
+            self._ctrl_ok_ts = time.monotonic()
+            return True
+        except Exception:  # noqa: BLE001 — controller really down
+            pass
+        return bool(self._routes) and \
+            now - self._ctrl_ok_ts < self.ROUTE_STALE_MAX_S
 
     def _match_route(self, path: str):
         best = None
@@ -140,15 +177,16 @@ class ProxyActor:
                     {k: v[0] for k, v in self._routes.items()}).encode())
                 return
             if path == "/-/healthz":
-                # Readiness = the control plane is reachable. Rolling
-                # updates keep this green: replicas swap replace-then-
-                # drain, the controller never goes away.
-                if time.monotonic() - self._ctrl_ok_ts \
-                        < self.HEALTHZ_GRACE_S:
+                # Readiness = the control plane is reachable OR the proxy
+                # can still serve autonomously from bounded-stale routes
+                # (controller crash/recovery window). Rolling updates keep
+                # this green: replicas swap replace-then-drain.
+                if await self._healthz_ready():
                     await self._respond(writer, 200, b"success")
                 else:
                     await self._respond(
-                        writer, 503, b"unhealthy: controller unreachable")
+                        writer, 503, b"unhealthy: controller unreachable "
+                        b"and route table stale")
                 return
             match = self._match_route(path)
             if match is None:

@@ -448,6 +448,14 @@ class ReplicaActor:
             _request_context.reset(token)
             self._release_slot()
 
+    def describe(self) -> Dict[str, Any]:
+        """Process identity of this replica instance — lets operators
+        (and the controller-recovery tests) prove a replica was
+        REATTACHED, not restarted: the pid survives, a restart wouldn't."""
+        import os
+        return {"pid": os.getpid(), "deployment": self._deployment,
+                "draining": self._draining}
+
     def get_metrics(self) -> Dict[str, float]:
         return {"ongoing": self._ongoing, "queued": self._queued,
                 "total": self._total, "shed": self._shed,

@@ -101,6 +101,10 @@ class DeploymentSLO:
         self.burn_slow = 0.0
         self.violating = False
         self.violations = 0
+        # Burn-idle tracking (downscale gate): seeded NOW so a fresh
+        # engine (deploy, controller restart) must observe a full quiet
+        # slow window before it can vouch for a scale-down.
+        self._last_burn_ts = time.time()
 
     # ------------------------------------------------------------------
     def ingest(self, replica_metrics: Dict[str, dict],
@@ -141,7 +145,7 @@ class DeploymentSLO:
     # ------------------------------------------------------------------
     def evaluate(self, now: Optional[float] = None) -> dict:
         """Recompute burn rates; returns {"fast","slow","violating",
-        "new_violation"} and exports the gauges/counter."""
+        "new_violation","idle_s"} and exports the gauges/counter."""
         now = time.time() if now is None else now
         budget = max(1e-9, 1.0 - self.cfg.slo)
 
@@ -153,6 +157,12 @@ class DeploymentSLO:
 
         self.burn_fast = burn(self.cfg.fast_window_s, self.cfg.min_samples)
         self.burn_slow = burn(self.cfg.slow_window_s, self.cfg.min_samples)
+        # Burn-idle clock: any burn above the idle threshold in EITHER
+        # window re-arms it; idle_s is how long burn has stayed ~0 —
+        # the controller's downscale gate (never shrink while burning).
+        idle_max = getattr(self.cfg, "idle_burn_max", 0.1)
+        if self.burn_fast > idle_max or self.burn_slow > idle_max:
+            self._last_burn_ts = now
         was = self.violating
         self.violating = (self.burn_fast > self.cfg.burn_threshold
                           and self.burn_slow > self.cfg.burn_threshold)
@@ -172,4 +182,5 @@ class DeploymentSLO:
             pass
         return {"fast": self.burn_fast, "slow": self.burn_slow,
                 "violating": self.violating,
-                "new_violation": new_violation}
+                "new_violation": new_violation,
+                "idle_s": now - self._last_burn_ts}

@@ -107,6 +107,50 @@ class ReplicaKiller(_KillerBase):
             pass
 
 
+class ControllerKiller(_KillerBase):
+    """SIGKILLs the worker hosting a named control-plane actor (default:
+    the serve controller) — the durable-control-plane chaos shape. The
+    controller is a restartable detached actor: each kill must produce
+    one recovery that REATTACHES the live replicas (no healthy-replica
+    restarts) while proxies and handles keep serving from bounded-stale
+    routing. Kills are spaced by `interval_s`, so recovery gets a window
+    to complete between them."""
+
+    def __init__(self, cluster, interval_s: float = 2.0,
+                 max_kills: int = 1, seed: Optional[int] = None,
+                 name: str = "SERVE_CONTROLLER", namespace: str = ""):
+        super().__init__(interval_s, max_kills, seed)
+        self.cluster = cluster
+        self.name = name
+        self.namespace = namespace
+
+    def _controller_actor_id(self):
+        gcs = self.cluster.gcs
+        for (ns, nm), actor_id in list(gcs.named_actors.items()):
+            if nm == self.name and (not self.namespace
+                                    or ns == self.namespace):
+                return actor_id
+        return None
+
+    def _kill_one(self):
+        actor_id = self._controller_actor_id()
+        if actor_id is None:
+            return
+        from ray_tpu._private.common import ACTOR_ALIVE
+        info = self.cluster.gcs.actors.get(actor_id)
+        if info is None or info.state != ACTOR_ALIVE:
+            return  # mid-restart: let recovery finish, kill next tick
+        for raylet in self.cluster.raylets:
+            for handle in raylet.workers.values():
+                if handle.actor_id == actor_id and handle.pid > 0:
+                    try:
+                        os.kill(handle.pid, signal.SIGKILL)
+                        self.kills.append(f"controller:{handle.pid}")
+                    except OSError:
+                        pass
+                    return
+
+
 class NodeKiller(_KillerBase):
     """Removes a random non-head raylet (reference: NodeKillerActor
     test_utils.py:1498). Lineage reconstruction and actor failover must

@@ -238,6 +238,60 @@ def test_pending_creation_rescheduled_after_restore(tmp_path):
     asyncio.run(run())
 
 
+def test_actor_worker_death_during_gcs_downtime_reconciled(ray_cluster):
+    """Registry + restore interplay (PR 10): an actor's worker dies
+    WHILE the GCS is down — the raylet's one-shot death report reaches
+    nobody, and the restored GCS believes the actor is ALIVE forever.
+    The (re)registration live-worker reconcile must drive the failure
+    path so the actor restarts per max_restarts."""
+    import os
+    import signal
+
+    ray_cluster.connect()
+    import ray_tpu
+
+    @ray_tpu.remote(max_restarts=1)
+    class Pid:
+        def pid(self):
+            return os.getpid()
+
+    a = Pid.options(name="reconcile_me", lifetime="detached").remote()
+    pid0 = ray_tpu.get(a.pid.remote(), timeout=60)
+
+    # Freeze state (actor ALIVE, worker bound), stop the GCS, THEN kill
+    # the worker — its death report is lost to the void.
+    async def _snap():
+        ray_cluster.gcs.save_snapshot()
+    ray_cluster._run(_snap())
+
+    async def _stop():
+        await ray_cluster.gcs.stop()
+    ray_cluster._run(_stop())
+    os.kill(pid0, signal.SIGKILL)
+    time.sleep(0.5)   # raylet notices + swallows the report
+
+    from ray_tpu._private.gcs import GcsServer
+    host, port = ray_cluster.gcs_address.rsplit(":", 1)
+
+    async def _start():
+        ray_cluster.gcs = GcsServer(ray_cluster.config,
+                                    ray_cluster.session_dir)
+        await ray_cluster.gcs.start(host, int(port), restore=True)
+    ray_cluster._run(_start())
+
+    # The reconcile restarts the actor on a fresh worker.
+    deadline = time.time() + 60
+    pid1 = None
+    while time.time() < deadline:
+        try:
+            pid1 = ray_tpu.get(a.pid.remote(), timeout=10)
+            break
+        except Exception:  # noqa: BLE001 — restart in flight
+            time.sleep(0.3)
+    assert pid1 is not None, "actor never restarted after the reconcile"
+    assert pid1 != pid0
+
+
 # ------------------------------------------------- external store (Redis-eq)
 
 def test_kv_store_server_persistence(tmp_path):

@@ -373,6 +373,99 @@ def test_replica_admission_shed_unit():
 
 
 # ---------------------------------------------------------------------------
+# Mid-stream replay cursor
+# ---------------------------------------------------------------------------
+
+def test_stream_replays_mid_stream_with_cursor(serve_app):
+    """Replica dies AFTER items were delivered: a replayable deployment
+    re-routes the stream and the handle's item-offset cursor fast-
+    forwards past the already-delivered items — the caller sees the full
+    sequence exactly once, resumed from where it broke."""
+    @serve.deployment(num_replicas=1, request_replay=True)
+    class Gen:
+        async def __call__(self, n):
+            import os
+            for i in range(n):
+                await asyncio.sleep(0.25)
+                yield {"i": i, "pid": os.getpid()}
+
+    h = serve.run(Gen.bind(), name="ftc1", route_prefix="/ftc1")
+    assert _wait_ready("ftc1", "Gen", 1)
+
+    gen = h.options(stream=True).remote(6)
+    items = [next(gen), next(gen)]   # two items delivered, then murder
+    ray_tpu.kill(_replica_handles("ftc1", "Gen")[0])
+    items.extend(gen)
+    assert [it["i"] for it in items] == list(range(6)), items
+    # The tail really came from the REPLACEMENT replica (a replay, not
+    # a survivor): pid changed after the kill.
+    assert items[-1]["pid"] != items[0]["pid"]
+
+
+def test_stream_mid_stream_death_not_replayable_fails(serve_app):
+    """Without request_replay a mid-stream death keeps failing fast with
+    the typed error (never silently re-executes the generator)."""
+    @serve.deployment(num_replicas=1)
+    class Gen:
+        async def __call__(self, n):
+            for i in range(n):
+                await asyncio.sleep(0.25)
+                yield i
+
+    h = serve.run(Gen.bind(), name="ftc2", route_prefix="/ftc2")
+    assert _wait_ready("ftc2", "Gen", 1)
+
+    gen = h.options(stream=True).remote(6)
+    assert next(gen) == 0
+    ray_tpu.kill(_replica_handles("ftc2", "Gen")[0])
+    with pytest.raises(ReplicaDiedError):
+        list(gen)
+
+
+def test_stream_cursor_short_replay_raises():
+    """Unit: a replayed stream that ends BEFORE the cursor (handler is
+    not deterministic) surfaces a typed error instead of a divergent
+    tail."""
+    from ray_tpu.serve.handle import DeploymentResponseGenerator
+
+    class _FakeRef:
+        def __init__(self, v):
+            self.v = v
+
+    real_get = ray_tpu.get
+
+    def fake_get(ref, *a, **k):
+        if isinstance(ref, _FakeRef):
+            return ref.v
+        return real_get(ref, *a, **k)
+
+    from ray_tpu import exceptions as exc
+
+    first = iter([_FakeRef(0), _FakeRef(1)])
+
+    class DieAfter:
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            try:
+                return next(first)
+            except StopIteration:
+                raise exc.ActorDiedError("replica") from None
+
+    short = iter([_FakeRef(0)])  # replay yields 1 item < cursor 2
+
+    gen = DeploymentResponseGenerator(
+        DieAfter(), recover=lambda err: short, deployment="d")
+    import unittest.mock as mock
+    with mock.patch.object(ray_tpu, "get", fake_get):
+        assert next(gen) == 0
+        assert next(gen) == 1
+        with pytest.raises(ReplicaDiedError, match="not deterministic"):
+            next(gen)
+
+
+# ---------------------------------------------------------------------------
 # Proxy failure surfaces
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,75 @@
+"""Write-ahead persistence static check (tier-1 guard, like
+test_trace_propagation_check): every serve-controller target-state
+mutation persists to the KV before publishing routing/replica effects."""
+
+import importlib.util
+import os
+
+
+def _load_checker():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts",
+        "check_serve_persistence.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_serve_persistence", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_controller_is_fully_write_ahead():
+    checker = _load_checker()
+    problems = checker.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_checker_detects_missing_persist(monkeypatch):
+    """A mutation path with no persist call is reported — the check can
+    actually fail, it isn't vacuous."""
+    checker = _load_checker()
+    monkeypatch.setattr(checker, "ORDERED_RULES", checker.ORDERED_RULES + [
+        ("ServeController", "deploy_app",
+         r"THIS_PERSIST_CALL_DOES_NOT_EXIST", r"self\._deployments\[",
+         "synthetic gap")])
+    problems = checker.check()
+    assert any("THIS_PERSIST_CALL_DOES_NOT_EXIST" in p for p in problems)
+
+
+def test_checker_detects_effect_before_persist(monkeypatch):
+    """An effect that textually precedes its persist call is an
+    ordering violation (the write-ahead contract)."""
+    checker = _load_checker()
+    # In _deploy_app_locked the `incoming` dict init precedes the first
+    # persist — use a pattern that matches earlier text as the "effect".
+    monkeypatch.setattr(checker, "ORDERED_RULES", [
+        ("ServeController", "_deploy_app_locked",
+         r"self\._persist\.put\(", r"incoming: Dict",
+         "synthetic ordering violation")])
+    problems = checker.check()
+    assert any("BEFORE persisting" in p for p in problems)
+
+
+def test_checker_detects_renamed_mutation_path(monkeypatch):
+    checker = _load_checker()
+    monkeypatch.setattr(checker, "ORDERED_RULES", checker.ORDERED_RULES + [
+        ("ServeController", "_set_target_v2",
+         r"self\._persist\.put\(", r"\.target_num\s*=(?!=)",
+         "synthetic rename")])
+    problems = checker.check()
+    assert any("_set_target_v2 not found" in p for p in problems)
+
+
+def test_checker_flags_rogue_target_assignment(monkeypatch):
+    """The containment rules catch a scale path that bypasses
+    _set_target (raw target_num assignment elsewhere)."""
+    import re
+
+    checker = _load_checker()
+    monkeypatch.setattr(checker, "FORBID_RULES", [
+        (re.compile(r"\.target_num\s*=(?!=)"),
+         {("_DeploymentState", "__init__")},   # whitelist shrunk
+         "synthetic containment")])
+    problems = checker.check()
+    # _set_target's legitimate assignment is now "rogue" -> flagged.
+    assert any("_set_target" in p and "synthetic containment" in p
+               for p in problems)
