@@ -186,10 +186,17 @@ REQ_PHASE_ORDER = (
     "exec_end",        # replica: handler returned
     "first_item",      # replica: first streamed item yielded
     "reply",           # hop-local: reply delivered / stream finished
+    # Continuous-batching phase split (serve/continuous_batching.py):
+    # the gap exec_start -> prefill_end is the sequence's prefill time,
+    # prefill_end -> exec_end its decode time. Appended AFTER the
+    # original eight so existing fixed-index records stay valid;
+    # request_phase_durations sorts stamps by time, so position in this
+    # tuple never inverts a gap.
+    "prefill_end",     # replica: sequence left the prefill phase
 )
 (RQ_PROXY_RECV, RQ_ADMISSION, RQ_QUEUE_WAIT, RQ_DISPATCH, RQ_EXEC_START,
- RQ_EXEC_END, RQ_FIRST_ITEM, RQ_REPLY) = range(8)
-REQ_RECORD_LEN = 8
+ RQ_EXEC_END, RQ_FIRST_ITEM, RQ_REPLY, RQ_PREFILL_END) = range(9)
+REQ_RECORD_LEN = 9
 
 
 def new_request_record() -> list:
@@ -202,8 +209,11 @@ def request_phase_durations(rec: Sequence) -> List[Tuple[str, float]]:
     except `dispatch`, which the proxy stamps BEFORE the replica's
     phases happen — sort present stamps by time so cross-hop records
     never produce inverted gaps."""
+    # min(): records written by a pre-prefill_end process are 8 slots —
+    # a version-skewed reader must fold them, not IndexError.
     present = [(rec[i], REQ_PHASE_ORDER[i])
-               for i in range(REQ_RECORD_LEN) if rec[i] is not None]
+               for i in range(min(len(rec), REQ_RECORD_LEN))
+               if rec[i] is not None]
     present.sort()
     out: List[Tuple[str, float]] = []
     for (t0, _n0), (t1, n1) in zip(present, present[1:]):

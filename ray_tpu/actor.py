@@ -178,6 +178,15 @@ class ActorClass:
         ac._class_id = self._class_id
         return ac
 
+    def __getstate__(self):
+        """The create cache holds (core_worker, kwargs) — process-local
+        and unpicklable (live asyncio state). An ActorClass captured in
+        a remote closure (e.g. a worker that spawns its own actors)
+        must ship WITHOUT it; the remote process rebuilds its own."""
+        state = self.__dict__.copy()
+        state["_create_cache"] = None
+        return state
+
     def _is_async(self) -> bool:
         return any(inspect.iscoroutinefunction(m)
                    for _, m in inspect.getmembers(self._cls,

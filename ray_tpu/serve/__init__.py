@@ -13,9 +13,12 @@ from ray_tpu.serve.api import (delete, get_app_handle, get_deployment_handle,
                                get_grpc_address, run, shutdown, start,
                                status)
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.continuous_batching import (BatchScheduler,
+                                               continuous_batching)
 from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.config import (AutoscalingConfig, HTTPOptions,
-                                  SLOConfig, gRPCOptions)
+                                  ServeConfig, SLOConfig, gRPCOptions)
+from ray_tpu.serve import request_trace
 from ray_tpu.serve.grpc_proxy import ServeRpcClient
 from ray_tpu.serve.handle import (DeploymentHandle, DeploymentResponse,
                                   DeploymentResponseGenerator)
@@ -30,8 +33,10 @@ __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "shutdown",
     "delete", "status", "get_app_handle", "get_deployment_handle",
     "get_grpc_address", "DeploymentHandle", "DeploymentResponse",
-    "DeploymentResponseGenerator", "ServeRpcClient", "batch", "multiplexed",
-    "get_multiplexed_model_id", "AutoscalingConfig", "SLOConfig",
+    "DeploymentResponseGenerator", "ServeRpcClient", "batch",
+    "continuous_batching", "BatchScheduler", "multiplexed",
+    "get_multiplexed_model_id", "request_trace",
+    "AutoscalingConfig", "ServeConfig", "SLOConfig",
     "HTTPOptions",
     "gRPCOptions", "deploy_config", "import_application",
     "load_serve_config", "run_import_path", "ServeError",

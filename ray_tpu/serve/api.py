@@ -45,10 +45,16 @@ async def _get_controller_async():
 
 
 def start(*, http_options=None, proxy: bool = False,
-          grpc_options=None, grpc_proxy: bool = False):
+          grpc_options=None, grpc_proxy: bool = False, config=None):
     """Start the Serve control plane (controller, optionally the HTTP
-    proxy and/or the binary-RPC ingress — reference: gRPCProxy)."""
+    proxy and/or the binary-RPC ingress — reference: gRPCProxy).
+    ``config`` (a ServeConfig) sets cluster-level control-plane knobs;
+    they persist to the serve KV so controller recovery keeps them."""
     ctrl = _get_controller()
+    if config is not None:
+        from dataclasses import asdict
+        ray_tpu.get(ctrl.set_serve_config.remote(asdict(config)),
+                    timeout=30)
     if proxy or http_options is not None:
         from ray_tpu.serve.config import HTTPOptions
         opts = http_options or HTTPOptions()

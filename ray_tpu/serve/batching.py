@@ -1,10 +1,18 @@
-"""@serve.batch: dynamic request batching.
+"""@serve.batch: dynamic request batching (queue-then-flush).
 
 Reference parity: python/ray/serve/batching.py. Calls to the decorated
 async function are queued; a flusher invokes the underlying function with a
 list of requests once max_batch_size accumulate or batch_wait_timeout_s
 elapses. On TPU this is the lever that keeps the jitted callable fed with a
-fixed batch dimension (pad to max_batch_size to avoid recompilation).
+fixed batch dimension: with ``pad_batches=True`` every flush ships EXACTLY
+max_batch_size entries (short batches padded with ``pad_value``), so the
+jitted function traces one shape and never recompiles.
+
+For iteration-level batching — requests joining/leaving a RUNNING batch
+at step boundaries (token generation) — use
+``@serve.continuous_batching`` (serve/continuous_batching.py) instead;
+this decorator is the right shape for one-shot batch inference
+(embed/classify/score) where the whole batch finishes together.
 """
 
 from __future__ import annotations
@@ -16,10 +24,13 @@ from typing import Any, Callable, List, Optional
 
 class _BatchQueue:
     def __init__(self, fn: Callable, max_batch_size: int,
-                 batch_wait_timeout_s: float):
+                 batch_wait_timeout_s: float, pad_batches: bool = False,
+                 pad_value: Any = None):
         self._fn = fn
         self._max = max_batch_size
         self._timeout = batch_wait_timeout_s
+        self._pad = pad_batches
+        self._pad_value = pad_value
         self._queue: List = []   # (args_tuple, future)
         self._flusher: Optional[asyncio.Task] = None
 
@@ -51,13 +62,21 @@ class _BatchQueue:
             n_args = len(batch[0][0])
             args_lists = tuple([a[i] for a, _f in batch]
                                for i in range(n_args))
+            if self._pad and len(batch) < self._max:
+                # Fixed bucket: every flush is exactly max_batch_size
+                # long, so a jitted fn traces ONE shape. Pad results are
+                # dropped below (zip stops at the real futures).
+                fill = self._max - len(batch)
+                args_lists = tuple(lst + [self._pad_value] * fill
+                                   for lst in args_lists)
             results = self._fn(*args_lists)
             if asyncio.iscoroutine(results):
                 results = await results
-            if len(results) != len(batch):
+            expect = self._max if self._pad else len(batch)
+            if len(results) != expect:
                 raise ValueError(
                     f"@serve.batch function returned {len(results)} results "
-                    f"for a batch of {len(batch)}")
+                    f"for a batch of {expect}")
             for f, r in zip(futures, results):
                 if not f.done():
                     f.set_result(r)
@@ -68,9 +87,12 @@ class _BatchQueue:
 
 
 def batch(_fn=None, *, max_batch_size: int = 8,
-          batch_wait_timeout_s: float = 0.01):
+          batch_wait_timeout_s: float = 0.01, pad_batches: bool = False,
+          pad_value: Any = None):
     """Decorator: async fn(self, item) -> result, executed as fn(self,
-    [items]) -> [results]."""
+    [items]) -> [results]. ``pad_batches`` pads every flush to
+    max_batch_size with ``pad_value`` (constant shapes for jit); the fn
+    must then return max_batch_size results, pad outputs are dropped."""
 
     def wrap(fn):
         attr = f"__serve_batch_queue_{fn.__name__}"
@@ -90,13 +112,15 @@ def batch(_fn=None, *, max_batch_size: int = 8,
                 if q is None:
                     q = _BatchQueue(
                         lambda *ls: fn(owner, *ls),
-                        max_batch_size, batch_wait_timeout_s)
+                        max_batch_size, batch_wait_timeout_s,
+                        pad_batches, pad_value)
                     setattr(owner, attr, q)
             else:
                 bound_args = args
                 q = getattr(wrapper, "_queue", None)
                 if q is None:
-                    q = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+                    q = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s,
+                                    pad_batches, pad_value)
                     wrapper._queue = q
             return await q.submit(bound_args)
 

@@ -70,6 +70,20 @@ class SLOConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Cluster-level serve control-plane knobs, applied via
+    ``serve.start(config=ServeConfig(...))`` and PERSISTED to the serve
+    KV namespace — a restarted controller recovers with the operator's
+    settings, not the defaults (recovery is exactly when they matter)."""
+
+    # Per-replica health-probe timeout during controller recovery
+    # (reattach-first: rows whose probe exceeds this are replaced).
+    # Raise it on clusters where replica processes respond slowly under
+    # recovery load; was a hardcoded 5 s before this knob existed.
+    recovery_probe_timeout_s: float = 5.0
+
+
+@dataclass
 class HTTPOptions:
     host: str = "127.0.0.1"
     port: int = 8000

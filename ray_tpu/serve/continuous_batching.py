@@ -1,0 +1,609 @@
+"""Continuous (iteration-level) batching: the serve inference hot path.
+
+`@serve.batch` (serve/batching.py) is queue-then-flush: calls coalesce
+into ONE fixed batch, the whole batch runs, the whole batch returns.
+That shape starves a TPU the moment sequence lengths diverge — the
+jitted decode step idles while the longest sequence finishes. This
+module is the iteration-level engine the Gemma-on-TPU serving paper
+builds around: requests JOIN a running batch at step boundaries, every
+finished sequence RETIRES mid-flight and its slot backfills from the
+admission queue on the next boundary, so the step function stays fed at
+high occupancy for as long as there is work.
+
+Scheduler contract (the user's decorated method is the STEP function):
+
+    @serve.deployment
+    class LM:
+        @serve.continuous_batching(max_batch_size=8)
+        def step(self, phase, batch):
+            # phase: "prefill" | "decode"
+            # batch: list of EXACTLY max_batch_size slots — Sequence
+            #   objects for live slots, None for padding. The length
+            #   never changes, so a jitted callable traced on the first
+            #   step never recompiles (pad-to-bucket).
+            # returns: a list of the same length; None for pad slots,
+            #   (emission, done) for live ones. emission=None emits
+            #   nothing this step; after its prefill step a sequence
+            #   moves to the decode phase unless done.
+            ...
+
+        async def __call__(self, prompt):
+            async for token in self.step(prompt):   # submit ONE request
+                yield token
+
+    Calling the wrapped step with one request's args submits it to the
+    per-instance BatchScheduler and returns an async generator of that
+    request's emissions — which composes with the replica streaming
+    path, so tokens flow to the client as the batch produces them and a
+    replica death mid-generation fails over through the handle's
+    mid-stream replay cursor (PR 10) with zero client-visible loss.
+
+Scheduling policy:
+
+- Prefill and decode are DISTINCT scheduled phases: a step runs either
+  up to ``prefill_chunk`` prefill-phase sequences or every decode-ready
+  sequence, never a mix — matching the two jitted callables a TPU
+  serving stack actually has.
+- Prefill has priority (time-to-first-token), bounded by
+  ``decode_starvation_steps``: after that many consecutive prefill
+  steps with decode work waiting, one decode step is forced so a
+  prefill flood can never stall token streams already in flight.
+- Multiplexed tenancy: each step groups sequences of ONE model id
+  (oldest-waiting model first), so a replica hosting several
+  ``@serve.multiplexed`` models never thrashes its LRU by interleaving
+  models within a step.
+
+Observability: per-sequence REQ_* stamps (``prefill_end`` marks the
+prefill->decode transition on the request's trace) plus ``prefill`` /
+``decode`` spans under the replica exec span, and two histograms —
+``ray_tpu_serve_batch_occupancy`` (live slots per step) and
+``ray_tpu_serve_batch_step_seconds{Phase=prefill|decode}``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import os
+import time
+from collections import deque
+from typing import Any, Callable, List, Optional
+
+PREFILL = "prefill"
+DECODE = "decode"
+
+_DONE = object()          # out-queue sentinel: sequence finished cleanly
+
+
+OCCUPANCY_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0,
+                     32.0, 48.0, 64.0, 96.0, 128.0)
+STEP_SECONDS_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                        0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+def _occupancy_hist():
+    from ray_tpu.util import metrics
+    return metrics.Histogram(
+        "ray_tpu_serve_batch_occupancy",
+        "live (non-pad) sequences per continuous-batching step — p50 > 1 "
+        "means iteration-level batching is actually coalescing work",
+        boundaries=OCCUPANCY_BUCKETS,
+        tag_keys=("Deployment", "Phase"))
+
+
+def _step_hist():
+    from ray_tpu.util import metrics
+    return metrics.Histogram(
+        "ray_tpu_serve_batch_step_seconds",
+        "wall time of one continuous-batching step call, split by "
+        "scheduled phase (prefill | decode)",
+        boundaries=STEP_SECONDS_BUCKETS,
+        tag_keys=("Deployment", "Phase"))
+
+
+class Sequence:
+    """One request's slot in the running batch (user-visible in the step
+    function). ``state`` is scratch space the step function owns across
+    steps (KV cache handle, cursor, ...); the engine never touches it."""
+
+    __slots__ = ("args", "kwargs", "model_id", "state", "phase", "steps",
+                 "request_id", "_out", "_done", "_cancelled", "_defers",
+                 "_trace", "_parent_span", "_t_submit", "_t_first_step",
+                 "_t_phase_start", "_t_last_step")
+
+    def __init__(self, args: tuple, kwargs: dict, model_id: str = ""):
+        self.args = args
+        self.kwargs = kwargs
+        self.model_id = model_id
+        self.state: Any = None
+        self.phase = PREFILL
+        self.steps = 0                       # steps this sequence ran in
+        self.request_id = ""
+        self._out: asyncio.Queue = asyncio.Queue()
+        self._done = False
+        self._cancelled = False
+        self._defers = 0   # times passed over by model-locality admission
+        self._trace = None                   # RequestTrace (sampled) | None
+        self._parent_span = None             # replica exec span dict | None
+        self._t_submit = time.monotonic()
+        self._t_first_step = 0.0
+        self._t_phase_start = 0.0
+        # When this sequence last participated in a step — the model-
+        # fairness clock (_plan runs the most-starved model first).
+        self._t_last_step = self._t_submit
+
+    def __repr__(self):
+        return (f"Sequence(model={self.model_id!r}, phase={self.phase}, "
+                f"steps={self.steps})")
+
+
+class _SeqError:
+    __slots__ = ("err",)
+
+    def __init__(self, err: BaseException):
+        self.err = err
+
+
+class BatchScheduler:
+    """Per-replica iteration-level batch scheduler: one step loop, a
+    fixed slot array (the pad bucket), an admission queue, and
+    per-sequence output queues. All state lives on ONE event loop (the
+    replica's); no locks needed."""
+
+    def __init__(self, step_fn: Callable, *, max_batch_size: int = 8,
+                 prefill_chunk: Optional[int] = None,
+                 decode_starvation_steps: int = 4,
+                 deployment: str = ""):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self._step_fn = step_fn
+        self._max = int(max_batch_size)
+        self._prefill_chunk = int(prefill_chunk or max_batch_size)
+        self._starve_bound = max(1, int(decode_starvation_steps))
+        self._deployment = deployment
+        self._slots: List[Optional[Sequence]] = [None] * self._max
+        self._waiting: deque = deque()
+        self._wake = asyncio.Event()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._prefill_streak = 0      # consecutive prefill steps w/ decode
+        self._cancel_pending = 0      # cancels since the last reap pass
+        # Stats (tests + bench introspection; metrics export the same).
+        self.steps_total = 0
+        self.steps_prefill = 0
+        self.steps_decode = 0
+        self.occupancy_sum = 0
+        self.admitted_total = 0
+        self.retired_total = 0
+        # Exact per-step samples for stats(): occupancy is small-integer
+        # valued (counter is exact + O(max_batch_size) memory); step
+        # times keep a bounded window per phase.
+        self._occ_counts: dict = {}
+        self._step_times = {PREFILL: deque(maxlen=4096),
+                            DECODE: deque(maxlen=4096)}
+        self._occ_slot = None
+        self._step_slots: dict = {}
+        self._metrics_gen = -1
+
+    # ------------------------------------------------------------------
+    # Submission (called from request handlers on the replica loop)
+    # ------------------------------------------------------------------
+    async def stream(self, args: tuple, kwargs: dict, model_id: str = ""):
+        """Submit one request; yield its emissions as the batch produces
+        them. Closing the generator (client gone, deadline cancel)
+        retires the sequence at the next step boundary — leave is as
+        boundary-aligned as join."""
+        seq = Sequence(args, kwargs, model_id)
+        self._attach_trace(seq)
+        self._ensure_loop()
+        self._waiting.append(seq)
+        self._wake.set()
+        try:
+            while True:
+                item = await seq._out.get()
+                if item is _DONE:
+                    return
+                if isinstance(item, _SeqError):
+                    raise item.err
+                yield item
+        finally:
+            # Consumer went away (completed, cancelled, or errored):
+            # the step loop frees the slot at the next boundary.
+            seq._cancelled = True
+            if not seq._done:
+                self._cancel_pending += 1
+            self._wake.set()
+
+    def _attach_trace(self, seq: Sequence) -> None:
+        """Capture the request's trace context + the replica exec span
+        so the step loop (a DIFFERENT task, no request contextvars) can
+        stamp phases and parent prefill/decode spans correctly."""
+        try:
+            from ray_tpu.serve import request_trace
+            ctx = request_trace.current()
+            if ctx is not None and ctx.sampled:
+                seq._trace = ctx
+                seq.request_id = ctx.request_id
+            from ray_tpu.util import tracing
+            seq._parent_span = tracing.active_span()
+        except Exception:  # noqa: BLE001 — tracing must not fail requests
+            pass
+
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.ensure_future(self._run())
+
+    # ------------------------------------------------------------------
+    # Step loop
+    # ------------------------------------------------------------------
+    def _live(self) -> List[Sequence]:
+        return [s for s in self._slots if s is not None]
+
+    def _retire_cancelled(self) -> None:
+        if not self._cancel_pending:
+            return   # hot path: no scan when nothing cancelled
+        self._cancel_pending = 0
+        for i, seq in enumerate(self._slots):
+            if seq is not None and seq._cancelled and not seq._done:
+                self._finish(seq, i)
+        # Never-joined cancels (client gave up while the batch was
+        # saturated) must be reaped from the WAITING queue too — under
+        # sustained retry load with no slot turnover they would pile up
+        # unboundedly, each pinning its prompt payload.
+        if self._waiting:
+            self._waiting = deque(s for s in self._waiting
+                                  if not s._cancelled)
+
+    # After this many model-locality pass-overs a waiting request is
+    # admitted strictly FIFO: locality is a preference, starvation is
+    # not (the admission analogue of decode_starvation_steps).
+    ADMIT_STARVATION_DEFERS = 8
+
+    def _admit(self) -> None:
+        """Join-at-step-boundary: fill free slots from the waiting queue.
+        Same-model grouping applies here too — prefer requests matching
+        the model already dominant in the live batch, so a freed slot
+        backfills without forcing a model swap mid-batch. A request
+        passed over ADMIT_STARVATION_DEFERS times is admitted FIFO
+        regardless, so sustained same-model load can never starve a
+        different model's waiter while slots keep turning over."""
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free or not self._waiting:
+            return
+        live = self._live()
+        resident = {s.model_id for s in live}
+        # Two passes: matching-model first (stable FIFO within each).
+        for pass_match in (True, False):
+            if not free:
+                break
+            kept: deque = deque()
+            while self._waiting and free:
+                seq = self._waiting.popleft()
+                if seq._cancelled:
+                    continue   # gave up before ever joining
+                match = ((not resident) or (seq.model_id in resident)
+                         or seq._defers >= self.ADMIT_STARVATION_DEFERS)
+                if pass_match and not match:
+                    seq._defers += 1
+                    kept.append(seq)
+                    continue
+                i = free.pop(0)
+                self._slots[i] = seq
+                resident.add(seq.model_id)
+                self.admitted_total += 1
+            kept.extend(self._waiting)
+            self._waiting = kept
+
+    @staticmethod
+    def _starved_model(cands) -> str:
+        """Model of the sequence that has gone longest without a step —
+        model-level fairness: after model A runs, its sequences' clocks
+        advance past model B's, so co-resident models alternate instead
+        of the lowest-slot model monopolizing every step."""
+        return min(cands, key=lambda it: it[1]._t_last_step)[1].model_id
+
+    def _plan(self):
+        """(phase, model_id, [slot indices]) for the next step, or None
+        when no live sequence is runnable. Prefill priority bounded by
+        the decode-starvation rule; one model id per step, most-starved
+        model first."""
+        prefill = [(i, s) for i, s in enumerate(self._slots)
+                   if s is not None and s.phase == PREFILL]
+        decode = [(i, s) for i, s in enumerate(self._slots)
+                  if s is not None and s.phase == DECODE]
+        run_prefill = bool(prefill) and (
+            not decode or self._prefill_streak < self._starve_bound)
+        if run_prefill:
+            model = self._starved_model(prefill)
+            idx = [i for i, s in prefill
+                   if s.model_id == model][: self._prefill_chunk]
+            self._prefill_streak += 1 if decode else 0
+            return PREFILL, model, idx
+        if decode:
+            model = self._starved_model(decode)
+            idx = [i for i, s in decode if s.model_id == model]
+            self._prefill_streak = 0
+            return DECODE, model, idx
+        return None
+
+    def _padded(self, idx: List[int]) -> List[Optional[Sequence]]:
+        """The step function's view: ALWAYS max_batch_size slots, live
+        sequences in their slot positions, None pads elsewhere — the
+        constant shape a jitted step traces once."""
+        batch: List[Optional[Sequence]] = [None] * self._max
+        for i in idx:
+            batch[i] = self._slots[i]
+        return batch
+
+    async def _run(self) -> None:
+        while True:
+            self._retire_cancelled()
+            self._admit()
+            plan = self._plan()
+            if plan is None:
+                if not self._waiting:
+                    self._wake.clear()
+                    await self._wake.wait()
+                continue
+            phase, _model, idx = plan
+            batch = self._padded(idx)
+            for i in idx:
+                seq = self._slots[i]
+                if seq._t_first_step == 0.0:
+                    seq._t_first_step = time.monotonic()
+                    seq._t_phase_start = time.time()
+            t0 = time.perf_counter()
+            try:
+                results = self._step_fn(phase, batch)
+                if asyncio.iscoroutine(results):
+                    results = await results
+            except Exception as e:  # noqa: BLE001 — fail THIS step's seqs
+                for i in idx:
+                    seq = self._slots[i]
+                    if seq is not None:
+                        seq._out.put_nowait(_SeqError(e))
+                        self._finish(seq, i, error=True)
+                await asyncio.sleep(0)
+                continue
+            dt = time.perf_counter() - t0
+            occ = len(idx)
+            # ALL step accounting lives here — a step that ran is a step
+            # that counts, even if _apply rejects its results, so
+            # stats() means/percentiles and the exported histograms
+            # always describe the same step set.
+            self.steps_total += 1
+            if phase == PREFILL:
+                self.steps_prefill += 1
+            else:
+                self.steps_decode += 1
+            self.occupancy_sum += occ
+            self._occ_counts[occ] = self._occ_counts.get(occ, 0) + 1
+            self._step_times[phase].append(dt)
+            self._observe_step(phase, occ, dt)
+            try:
+                self._apply(phase, idx, results)
+            except Exception as e:  # noqa: BLE001 — loop must survive
+                # Belt-and-braces: _apply guards malformed results per
+                # slot, but ANY escape here would kill the loop task and
+                # hang every consumer — fail this step's sequences.
+                for i in idx:
+                    seq = self._slots[i]
+                    if seq is not None:
+                        seq._out.put_nowait(_SeqError(e))
+                        self._finish(seq, i, error=True)
+            # One cooperative yield per step: emissions flush to their
+            # consumers and cancellations/admissions land at the
+            # boundary, without an idle sleep throttling throughput.
+            await asyncio.sleep(0)
+
+    def _apply(self, phase: str, idx: List[int], results) -> None:
+        if results is None or len(results) != self._max:
+            err = ValueError(
+                f"continuous-batching step must return exactly "
+                f"{self._max} slots (got "
+                f"{'None' if results is None else len(results)}) — the "
+                f"pad bucket is part of the contract")
+            for i in idx:
+                seq = self._slots[i]
+                if seq is not None:
+                    seq._out.put_nowait(_SeqError(err))
+                    self._finish(seq, i, error=True)
+            return
+        now = time.monotonic()
+        for i in idx:
+            seq = self._slots[i]
+            if seq is None:
+                continue
+            seq.steps += 1
+            seq._t_last_step = now    # model-fairness clock
+            res = results[i]
+            if res is None:
+                emission, done = None, False
+            elif isinstance(res, (tuple, list)) and len(res) == 2:
+                emission, done = res
+            else:
+                # Malformed per-slot result: fail THIS sequence typed —
+                # an unpack error here would kill the step loop and
+                # silently hang every other in-flight request.
+                seq._out.put_nowait(_SeqError(ValueError(
+                    f"continuous-batching step returned {res!r} for a "
+                    f"live slot; expected None or (emission, done)")))
+                self._finish(seq, i, error=True)
+                continue
+            if emission is not None and not seq._cancelled:
+                seq._out.put_nowait(emission)
+            if phase == PREFILL and not done:
+                self._to_decode(seq)
+            if done:
+                self._finish(seq, i)
+
+    def _to_decode(self, seq: Sequence) -> None:
+        seq.phase = DECODE
+        now = time.time()
+        if seq._trace is not None:
+            try:
+                from ray_tpu._private.flightrec import RQ_PREFILL_END
+                if seq._trace.phases[RQ_PREFILL_END] is None:
+                    seq._trace.stamp(RQ_PREFILL_END, now)
+            except Exception:  # noqa: BLE001
+                pass
+        self._export_phase_span(seq, PREFILL, now)
+        seq._t_phase_start = now
+
+    def _finish(self, seq: Sequence, slot: int, error: bool = False) -> None:
+        self._slots[slot] = None
+        if seq._done:
+            return
+        seq._done = True
+        self.retired_total += 1
+        if not error:
+            self._export_phase_span(seq, seq.phase, time.time())
+        seq._out.put_nowait(_DONE)
+
+    def _export_phase_span(self, seq: Sequence, phase: str,
+                           end: float) -> None:
+        """One prefill/decode span per sequence, parented under the
+        replica's exec span so `ray_tpu timeline --request` shows the
+        phase split inside the handler slice."""
+        if seq._trace is None or not seq._t_phase_start:
+            return
+        try:
+            from ray_tpu.util import tracing
+            parent = seq._parent_span
+            tracing.export_span({
+                "kind": "span", "trace_id": seq._trace.trace_id,
+                "span_id": os.urandom(8).hex(),
+                "parent_id": parent["span_id"] if parent
+                else seq._trace.parent_span_id,
+                "name": f"cb:{phase}", "task_id": seq.request_id,
+                "start": seq._t_phase_start, "end": end,
+                "pid": os.getpid(), "steps": seq.steps,
+            })
+        except Exception:  # noqa: BLE001
+            pass
+
+    # ------------------------------------------------------------------
+    # Metrics + introspection
+    # ------------------------------------------------------------------
+    def _observe_step(self, phase: str, occupancy: int, dt: float) -> None:
+        try:
+            from ray_tpu.util import metrics as _m
+            if self._metrics_gen != _m._generation:
+                self._metrics_gen = _m._generation
+                self._occ_slot = None
+                self._step_slots.clear()
+            if self._occ_slot is None:
+                self._occ_slot = {}
+                hist = _occupancy_hist()
+                step = _step_hist()
+                for ph in (PREFILL, DECODE):
+                    self._occ_slot[ph] = hist._slot(
+                        {"Deployment": self._deployment, "Phase": ph})
+                    self._step_slots[ph] = step._slot(
+                        {"Deployment": self._deployment, "Phase": ph})
+            _m.observe_into(self._occ_slot[phase], float(occupancy))
+            _m.observe_into(self._step_slots[phase], dt)
+        except Exception:  # noqa: BLE001 — metrics must not fail steps
+            pass
+
+    def _occ_percentile(self, q: float) -> float:
+        total = sum(self._occ_counts.values())
+        if not total:
+            return 0.0
+        rank = q * (total - 1)
+        seen = 0
+        for occ in sorted(self._occ_counts):
+            seen += self._occ_counts[occ]
+            if seen > rank:
+                return float(occ)
+        return float(max(self._occ_counts))
+
+    @staticmethod
+    def _time_percentile(samples, q: float) -> float:
+        if not samples:
+            return 0.0
+        s = sorted(samples)
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+    def stats(self) -> dict:
+        live = len(self._live())
+        return {
+            "steps_total": self.steps_total,
+            "steps_prefill": self.steps_prefill,
+            "steps_decode": self.steps_decode,
+            "occupancy_mean": (self.occupancy_sum / self.steps_total
+                               if self.steps_total else 0.0),
+            "occupancy_p50": self._occ_percentile(0.50),
+            "occupancy_p95": self._occ_percentile(0.95),
+            "step_ms": {
+                ph: {
+                    "n": len(ts),
+                    "p50": round(
+                        self._time_percentile(ts, 0.50) * 1e3, 3),
+                    "p95": round(
+                        self._time_percentile(ts, 0.95) * 1e3, 3),
+                } for ph, ts in self._step_times.items()},
+            "admitted_total": self.admitted_total,
+            "retired_total": self.retired_total,
+            "live": live,
+            "waiting": len(self._waiting),
+        }
+
+
+def continuous_batching(_fn=None, *, max_batch_size: int = 8,
+                        prefill_chunk: Optional[int] = None,
+                        decode_starvation_steps: int = 4):
+    """Decorator: the decorated method IS the step function
+    ``step(self, phase, batch)``; CALLING it with one request's args
+    submits that request to the per-instance BatchScheduler and returns
+    an async generator of the request's emissions (mirrors the
+    @serve.batch dual-signature convention)."""
+
+    def wrap(fn):
+        attr = f"__serve_cb_scheduler_{fn.__name__}"
+
+        @functools.wraps(fn)
+        async def wrapper(*args, **kwargs):
+            # Method vs plain function: descriptor check, exactly like
+            # @serve.batch — args[0] owns the scheduler when the wrapper
+            # is a class attribute of its type.
+            is_method = bool(args) and getattr(
+                type(args[0]), fn.__name__, None) is wrapper
+            if is_method:
+                owner = args[0]
+                call_args = args[1:]
+                sched = getattr(owner, attr, None)
+                if sched is None:
+                    dep = ""
+                    try:
+                        from ray_tpu.serve.replica import get_request_context
+                        rc = get_request_context()
+                        dep = getattr(rc, "deployment", "") or ""
+                    except Exception:  # noqa: BLE001
+                        pass
+                    sched = BatchScheduler(
+                        lambda phase, batch: fn(owner, phase, batch),
+                        max_batch_size=max_batch_size,
+                        prefill_chunk=prefill_chunk,
+                        decode_starvation_steps=decode_starvation_steps,
+                        deployment=dep or type(owner).__name__)
+                    setattr(owner, attr, sched)
+            else:
+                call_args = args
+                sched = getattr(wrapper, "_scheduler", None)
+                if sched is None:
+                    sched = BatchScheduler(
+                        fn, max_batch_size=max_batch_size,
+                        prefill_chunk=prefill_chunk,
+                        decode_starvation_steps=decode_starvation_steps,
+                        deployment=fn.__name__)
+                    wrapper._scheduler = sched
+            from ray_tpu.serve.multiplex import get_multiplexed_model_id
+            async for item in sched.stream(call_args, kwargs,
+                                           get_multiplexed_model_id()):
+                yield item
+
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -67,6 +67,13 @@ logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
+# Reserved actor NAMESPACE for every actor the serve control plane
+# creates (replicas, HTTP/binary proxies). The recovery orphan sweep
+# keys on membership in this namespace plus absence from the KV
+# registry — never on class names — so a user actor class literally
+# named "ReplicaActor" can never be mistaken for serve's and killed.
+SERVE_ACTOR_NAMESPACE = "_ray_tpu_serve"
+
 REPLICA_STARTING = "STARTING"
 REPLICA_RUNNING = "RUNNING"
 REPLICA_DRAINING = "DRAINING"
@@ -108,6 +115,9 @@ class _ReplicaInfo:
         self.state = REPLICA_STARTING
         self.node_id = None            # resolved once READY
         self.target_slice = ""         # slice domain picked at start
+        # Multiplexing: model ids resident in the replica's LRU cache,
+        # polled with health checks and published via get_routing.
+        self.resident_models: frozenset = frozenset()
         self.ready_task: Optional[asyncio.Task] = None
         self.drain_task: Optional[asyncio.Task] = None
         # Rolling update: the old replica this one replaces — retired
@@ -179,6 +189,10 @@ class ServeController:
         self._nodes_ts = 0.0
         self._next_proxy_watch = 0.0
         self._proxy_watch_task: Optional[asyncio.Task] = None
+        # Operator knobs (serve.start(config=...)), recovered from the KV
+        # before any recovery machinery that consumes them runs.
+        from ray_tpu.serve.config import ServeConfig
+        self._serve_config = ServeConfig()
         # Durable control plane: write-ahead store + recovery bookkeeping.
         self._persist = persistence.ServeStateStore()
         self._recoveries_cum = 0           # KV-backed, across restarts
@@ -219,6 +233,9 @@ class ServeController:
             return
         meta = records.pop(b"meta", None) or {}
         self._recoveries_cum = int(meta.get("recoveries", 0))
+        cfg_rec = records.pop(persistence.CONFIG_KEY, None)
+        if cfg_rec:
+            self._apply_serve_config(cfg_rec)
         targets = {k: r for k, r in records.items()
                    if k.startswith(b"target/")}
         has_rows = any(k.startswith(b"replica/") for k in records)
@@ -272,6 +289,25 @@ class ServeController:
             len(targets), sum(len(v) for v in self._pending_reattach.values()),
             len(self._routes), self._recoveries_cum)
 
+    def _apply_serve_config(self, fields: dict) -> None:
+        """Overlay persisted/operator ServeConfig fields onto defaults —
+        unknown keys are ignored (forward compat with newer writers)."""
+        for k in ("recovery_probe_timeout_s",):
+            if k in fields:
+                try:
+                    setattr(self._serve_config, k, float(fields[k]))
+                except (TypeError, ValueError):
+                    pass
+
+    async def set_serve_config(self, fields: dict) -> bool:
+        """serve.start(config=ServeConfig(...)): persist, then apply."""
+        await self._ensure_loops()
+        rec = {k: v for k, v in (fields or {}).items()
+               if not k.startswith("_")}
+        await self._persist.put(persistence.CONFIG_KEY, rec)
+        self._apply_serve_config(rec)
+        return True
+
     @staticmethod
     def _apply_target_record(st: _DeploymentState, rec: dict):
         """The ONE place (besides _set_target) allowed to write target
@@ -319,11 +355,6 @@ class ServeController:
                     "%d replaced", self._reattached_total,
                     self._replaced_total)
 
-    # Serve's detached actor classes — anything of these classes alive
-    # in the cluster belongs to THIS control plane (one named controller
-    # per cluster), so an instance no KV record references is an orphan.
-    _SERVE_ACTOR_CLASSES = ("ReplicaActor", "ProxyActor", "GrpcProxyActor")
-
     async def _sweep_orphan_actors(self):
         """Close the create-before-persist window: a crash between a
         detached actor's creation (replica in _start_replica, proxy in
@@ -331,9 +362,14 @@ class ServeController:
         registry row references — owner cleanup no longer reaps it
         (detached), so recovery must. Runs before the reconcile loop
         starts creating anything new, so every legitimate serve actor is
-        either in the loaded registry or a reattached proxy binding."""
+        either in the loaded registry or a reattached proxy binding.
+
+        Candidate identity is the controller-owned actor NAMESPACE
+        (every serve-created actor is born into SERVE_ACTOR_NAMESPACE),
+        never the class name: a user actor class literally named
+        "ReplicaActor" lives in the user's namespace and is invisible
+        to this sweep."""
         from ray_tpu._private import worker_api
-        from ray_tpu._private.common import ACTOR_DEAD
         from ray_tpu.actor import ActorHandle
         core = worker_api.peek_core()
         if core is None:
@@ -342,11 +378,7 @@ class ServeController:
             infos = await core.gcs.request("get_all_actors", {})
         except Exception:  # noqa: BLE001 — sweep is best-effort
             return
-        for info in infos:
-            if (info.class_name not in self._SERVE_ACTOR_CLASSES
-                    or info.state == ACTOR_DEAD
-                    or info.actor_id in self._known_actor_ids):
-                continue
+        for info in self._orphan_candidates(infos):
             logger.warning(
                 "killing orphaned serve actor %s (%s): created but never "
                 "registered before a controller crash",
@@ -355,6 +387,16 @@ class ServeController:
                 ray_tpu.kill(ActorHandle._from_actor_info(info))
             except Exception:  # noqa: BLE001
                 pass
+
+    def _orphan_candidates(self, infos) -> list:
+        """Sweep policy, isolated for unit tests: alive + born in the
+        serve namespace + absent from the registry/known set. Class
+        names are deliberately NOT consulted."""
+        from ray_tpu._private.common import ACTOR_DEAD
+        return [info for info in infos
+                if getattr(info, "namespace", "") == SERVE_ACTOR_NAMESPACE
+                and info.state != ACTOR_DEAD
+                and info.actor_id not in self._known_actor_ids]
 
     @staticmethod
     def _kill_registry_actor(row: dict):
@@ -375,6 +417,8 @@ class ServeController:
         if core is None:
             return  # bare unit tests: reconcile starts replicas fresh
 
+        probe_timeout = self._serve_config.recovery_probe_timeout_s
+
         async def probe(row):
             try:
                 info = await core.gcs.request(
@@ -390,7 +434,8 @@ class ServeController:
                 return row, handle, "starting"
             try:
                 await asyncio.wait_for(
-                    handle.check_health.remote().future(), timeout=5)
+                    handle.check_health.remote().future(),
+                    timeout=probe_timeout)
                 return row, handle, "healthy"
             except Exception:  # noqa: BLE001
                 return row, handle, "unhealthy"
@@ -701,6 +746,11 @@ class ServeController:
         # worker) dying — the controller reattaches them on recovery;
         # lifecycle is explicit (drain/kill), never owner cleanup.
         opts.setdefault("lifetime", "detached")
+        # Reserved namespace = sweep identity: recovery's orphan sweep
+        # may only ever consider actors born here (forced, not
+        # defaulted — an opt-out would silently leak create-before-
+        # persist orphans).
+        opts["namespace"] = SERVE_ACTOR_NAMESPACE
         # Admission control lives in the replica (bounded queue + shed):
         # the actor's concurrency cap must sit ABOVE max_ongoing + queue
         # so queued requests reach the replica's gate — and control
@@ -935,15 +985,28 @@ class ServeController:
             st.next_health_check = now + (
                 st.config.health_check_period_s * random.uniform(0.75, 1.25))
 
-            async def check(r):
+            # Multiplex resident-model poll: deployments with an
+            # autoscaler/SLO already get_metrics every _autoscale pass
+            # (which updates resident sets) — only poll here for the
+            # rest, CONCURRENTLY with the health probe so a wedged
+            # replica costs one 5 s window, not two.
+            poll_resident = (st.config.autoscaling_config is None
+                             and st.slo is None)
+
+            async def check(r, st=st, poll_resident=poll_resident):
+                res_task = asyncio.ensure_future(
+                    self._poll_resident(st, r)) if poll_resident else None
                 try:
                     await asyncio.wait_for(
                         r.handle.check_health.remote().future(), timeout=5)
-                    return True
+                    verdict = True
                 except exc.ActorDiedError:
-                    return "dead"      # definitive: GCS marked it dead
+                    verdict = "dead"   # definitive: GCS marked it dead
                 except Exception:
-                    return False       # slow/unreachable: maybe starting
+                    verdict = False    # slow/unreachable: maybe starting
+                if res_task is not None:
+                    await res_task
+                return verdict
             # Probe all replicas concurrently: serial checks would make one
             # slow/dead replica delay the whole reconcile pass by its
             # timeout multiplied by the replica count.
@@ -968,6 +1031,24 @@ class ServeController:
                     continue
                 self._drop_dead_replica(st, r)
         # reconcile_once (caller loop) will top the count back up
+
+    def _update_resident(self, st: _DeploymentState, r: _ReplicaInfo,
+                         m: dict) -> None:
+        """Fold one get_metrics result's resident-model set into routing
+        state; a change bumps list_version so routers re-pull the table
+        (which carries the sets)."""
+        resident = frozenset(m.get("resident_models") or ())
+        if resident != r.resident_models:
+            r.resident_models = resident
+            st.list_version += 1
+
+    async def _poll_resident(self, st: _DeploymentState, r: _ReplicaInfo):
+        try:
+            m = await asyncio.wait_for(
+                r.handle.get_metrics.remote().future(), timeout=5)
+            self._update_resident(st, r, m)
+        except Exception:  # noqa: BLE001 — routing hint only
+            pass
 
     def _drop_dead_replica(self, st: _DeploymentState, r: _ReplicaInfo):
         if r in st.replicas:
@@ -1006,6 +1087,9 @@ class ServeController:
                 *[metrics(r) for r in st.replicas])
             polled = {r.replica_id: m
                       for r, m in zip(st.replicas, results) if m}
+            for r, m in zip(st.replicas, results):
+                if m:   # this poll doubles as the resident-model poll
+                    self._update_resident(st, r, m)
             # SLO burn: evaluated every pass (gauges/violations export
             # even without autoscaling); with autoscaling it scales UP on
             # sustained burn — latency pressure fires before the bounded
@@ -1145,6 +1229,11 @@ class ServeController:
         return {
             "version": st.list_version,
             "replicas": [(r.replica_id, r.handle) for r in routable],
+            # Multiplexing: per-replica resident-model sets (polled with
+            # health) — handles route model-tagged requests to replicas
+            # that already hold the model.
+            "resident": {r.replica_id: sorted(r.resident_models)
+                         for r in routable if r.resident_models},
             "config": {
                 "deployment": st.name,
                 "request_replay": st.config.request_replay,
@@ -1217,8 +1306,9 @@ class ServeController:
                 # Detached + restartable: the ingress must outlive both
                 # this controller worker and its own crashes (the proxy
                 # watch re-arms the listener after a restart).
-                cls = ray_tpu.remote(num_cpus=0.1, max_restarts=-1,
-                                     lifetime="detached")(ProxyActor)
+                cls = ray_tpu.remote(
+                    num_cpus=0.1, max_restarts=-1, lifetime="detached",
+                    namespace=SERVE_ACTOR_NAMESPACE)(ProxyActor)
                 proxy = cls.remote(host, port)
                 self._known_actor_ids.add(proxy._actor_id)
                 await proxy.ready.remote()
@@ -1242,8 +1332,9 @@ class ServeController:
         async with self._proxy_lock:
             if getattr(self, "_grpc_proxy", None) is None:
                 from ray_tpu.serve.grpc_proxy import GrpcProxyActor
-                cls = ray_tpu.remote(num_cpus=0.1, max_restarts=-1,
-                                     lifetime="detached")(GrpcProxyActor)
+                cls = ray_tpu.remote(
+                    num_cpus=0.1, max_restarts=-1, lifetime="detached",
+                    namespace=SERVE_ACTOR_NAMESPACE)(GrpcProxyActor)
                 actor = cls.remote(host, port)
                 self._known_actor_ids.add(actor._actor_id)
                 try:

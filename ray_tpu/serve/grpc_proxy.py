@@ -126,6 +126,11 @@ class GrpcProxyActor:
             handle = DeploymentHandle(deployment, app_name=app,
                                       method_name=key[2])
             self._handles[key] = handle
+        # Multiplexing: a model-id-tagged call rides mux-aware routing
+        # (model-resident replica preferred), same as the HTTP header.
+        mux_id = payload.get("multiplexed_model_id") or ""
+        if mux_id:
+            handle = handle.options(multiplexed_model_id=mux_id)
         return handle
 
     @rpc.non_idempotent
@@ -217,14 +222,16 @@ class ServeRpcClient:
 
     def call(self, *args, app: str = "default",
              deployment: Optional[str] = None, method: str = "__call__",
-             timeout: float = 60.0, request_id: str = "", **kwargs):
+             timeout: float = 60.0, request_id: str = "",
+             multiplexed_model_id: str = "", **kwargs):
         async def go():
             conn = await self._ensure_conn()
             return await conn.request(
                 "serve_unary",
                 {"app": app, "deployment": deployment, "method": method,
                  "args": args, "kwargs": kwargs,
-                 "request_id": request_id}, timeout)
+                 "request_id": request_id,
+                 "multiplexed_model_id": multiplexed_model_id}, timeout)
         try:
             return asyncio.run_coroutine_threadsafe(
                 go(), self._loop).result(timeout + 10)
@@ -233,7 +240,8 @@ class ServeRpcClient:
 
     def stream(self, *args, app: str = "default",
                deployment: Optional[str] = None, method: str = "__call__",
-               idle_timeout: float = 60.0, **kwargs):
+               idle_timeout: float = 60.0, multiplexed_model_id: str = "",
+               **kwargs):
         """Generator over streamed items (blocks between items).
 
         idle_timeout bounds the wait for EACH item, not the whole stream —
@@ -250,7 +258,8 @@ class ServeRpcClient:
                 return await conn.request(
                     "serve_stream",
                     {"app": app, "deployment": deployment, "method": method,
-                     "args": args, "kwargs": kwargs, "call_id": call_id},
+                     "args": args, "kwargs": kwargs, "call_id": call_id,
+                     "multiplexed_model_id": multiplexed_model_id},
                     timeout=None)
             finally:
                 q.put_nowait(_END)

@@ -410,6 +410,9 @@ class Router:
         self._version = -1
         self._inflight: Dict[str, int] = {}
         self._meta: Dict[str, Any] = {}
+        # Multiplexing: replica_id -> frozenset of resident model ids,
+        # published by the controller (polled from replicas with health).
+        self._resident: Dict[str, frozenset] = {}
         self._last_refresh = 0.0       # last refresh ATTEMPT (throttle)
         self._last_success = 0.0       # last controller round trip
         self._lock = threading.Lock()
@@ -431,6 +434,9 @@ class Router:
             if version != self._version:
                 self._version = version
                 self._replicas = list(routing.get("replicas") or [])
+                self._resident = {
+                    rid: frozenset(models) for rid, models in
+                    (routing.get("resident") or {}).items()}
                 old = self._inflight
                 self._inflight = {rid: old.get(rid, 0)
                                   for rid, _ in self._replicas}
@@ -474,26 +480,41 @@ class Router:
             return
         self._apply(now, routing)
 
-    def pick_cached(self):
-        """Power of two choices on local in-flight counts (no refresh)."""
+    def pick_cached(self, mux_id: str = ""):
+        """Power of two choices on local in-flight counts (no refresh).
+
+        Multiplex-aware: a request tagged with a model id picks among
+        the replicas where that model is already RESIDENT (p2c within
+        the subset — locality never defeats load balancing between
+        warm replicas); only when no replica holds the model does it
+        fall back to plain p2c over the full set, and the chosen
+        replica's LRU loads the model (becoming resident for the next
+        routing refresh)."""
         with self._lock:
-            n = len(self._replicas)
-            if n == 0:
+            pool = list(range(len(self._replicas)))
+            if not pool:
                 raise RuntimeError(
                     f"deployment {self._dep!r} has no running replicas")
+            if mux_id:
+                warm = [i for i in pool
+                        if mux_id in self._resident.get(
+                            self._replicas[i][0], ())]
+                if warm:
+                    pool = warm
+            n = len(pool)
             if n == 1:
-                i = 0
+                i = pool[0]
             else:
-                a, b = random.sample(range(n), 2)
+                a, b = random.sample(pool, 2)
                 i = a if self._inflight.get(self._replicas[a][0], 0) <= \
                     self._inflight.get(self._replicas[b][0], 0) else b
             rid, handle = self._replicas[i]
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             return rid, handle
 
-    def pick(self):
+    def pick(self, mux_id: str = ""):
         self._refresh()
-        return self.pick_cached()
+        return self.pick_cached(mux_id)
 
     def release(self, rid: str):
         with self._lock:
@@ -542,7 +563,12 @@ class DeploymentHandle:
             else self._mux_id,
             self._stream if stream is None else stream,
             self._timeout_s if timeout_s is None else timeout_s)
-        h._router = self._router
+        # Share a MATERIALIZED router: proxies derive a per-request
+        # handle via options(multiplexed_model_id=...) — copying a
+        # still-None router would hand every derived handle its own
+        # fresh Router (a controller round trip per request, p2c over
+        # empty in-flight counts).
+        h._router = self._get_router()
         return h
 
     def _get_router(self) -> Router:
@@ -558,7 +584,13 @@ class DeploymentHandle:
         # Request trace: adopt the ingress context (proxy set it on this
         # task's contextvars) or mint one here — EVERY entry into the
         # serve data plane carries a request id + trace from this point.
+        # The REPLICA hop's bound context is not ours to adopt: a nested
+        # handle call mints a CHILD trace (inheriting the trace id
+        # through the active exec span) instead of stamping dispatch
+        # into the replica's phase record.
         ctx = request_trace.current()
+        if ctx is not None and ctx.replica_hop:
+            ctx = None
         handle_minted = False
         if ctx is None:
             try:
@@ -666,7 +698,7 @@ class DeploymentHandle:
                     raise RequestTimeoutError(self.deployment_name,
                                               where="router")
                 try:
-                    rid, replica = router.pick()
+                    rid, replica = router.pick(req.mux_id)
                 except RuntimeError as e:
                     # Momentarily empty replica set (rolling update /
                     # health replacement): force-refresh and retry.
@@ -748,7 +780,7 @@ class DeploymentHandle:
                 raise RequestTimeoutError(self.deployment_name,
                                           where="router")
             try:
-                rid, replica = router.pick_cached()
+                rid, replica = router.pick_cached(req.mux_id)
             except RuntimeError as e:
                 last_err = e
                 router.drop_replicas()
@@ -792,7 +824,7 @@ class DeploymentHandle:
                 raise RequestTimeoutError(self.deployment_name,
                                           where="router") from last_err
             try:
-                rid, replica = router.pick_cached()
+                rid, replica = router.pick_cached(req.mux_id)
             except RuntimeError as e:
                 last_err = e
                 router.drop_replicas()

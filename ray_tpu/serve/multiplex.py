@@ -3,6 +3,13 @@
 Reference parity: python/ray/serve/multiplex.py (_ModelMultiplexWrapper) and
 serve.get_multiplexed_model_id. The loader is LRU-bounded per replica; the
 requested model id rides the request context set by the replica actor.
+
+Routing awareness: every load/evict updates the owner's
+``__serve_mux_resident__`` set, which ReplicaActor.get_metrics() exposes,
+the controller polls alongside health checks, and the routing table
+publishes — so handles route a model-id-tagged request to a replica that
+already holds the model (no cold load, no LRU thrash) whenever one
+exists, falling back to least-loaded otherwise.
 """
 
 from __future__ import annotations
@@ -14,10 +21,34 @@ from typing import Any, Callable
 
 from ray_tpu.serve.replica import get_request_context
 
+# Well-known attr on the deployment callable instance: the union of
+# model ids currently cached by every @serve.multiplexed method on it.
+RESIDENT_ATTR = "__serve_mux_resident__"
+
 
 def get_multiplexed_model_id() -> str:
     ctx = get_request_context()
     return ctx.multiplexed_model_id if ctx else ""
+
+
+def _publish_resident(owner, cache: "OrderedDict") -> None:
+    """Refresh the owner's resident-model set after a load or evict.
+    One flat set per owner (multiple decorated methods union into it via
+    per-method caches — evicting from one method's cache recomputes from
+    all of them)."""
+    try:
+        caches = getattr(owner, "__serve_mux_caches__", None)
+        if caches is None:
+            caches = []
+            setattr(owner, "__serve_mux_caches__", caches)
+        if not any(c is cache for c in caches):   # identity, not dict ==
+            caches.append(cache)
+        resident = set()
+        for c in caches:
+            resident.update(c.keys())
+        setattr(owner, RESIDENT_ATTR, resident)
+    except Exception:  # noqa: BLE001 — routing hint only, never fails a load
+        pass
 
 
 def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
@@ -61,8 +92,10 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
                 loading.pop(model_id, None)
             cache[model_id] = model
             cache.move_to_end(model_id)
+            _publish_resident(owner, cache)
             while len(cache) > max_num_models_per_replica:
                 _old_id, old_model = cache.popitem(last=False)
+                _publish_resident(owner, cache)
                 # Give the model an explicit release hook (device memory is
                 # not guaranteed to free on refcount drop alone).
                 unload = getattr(old_model, "unload", None)

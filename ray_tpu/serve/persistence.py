@@ -56,6 +56,9 @@ def replica_key(app: str, deployment: str, replica_id: str) -> bytes:
 
 ROUTES_KEY = b"routes"
 PROXIES_KEY = b"proxies"
+# Operator-set ServeConfig fields (serve.start(config=...)): persisted so
+# a recovered controller keeps the operator's control-plane knobs.
+CONFIG_KEY = b"serve_config"
 
 
 def encode(record: dict) -> bytes:

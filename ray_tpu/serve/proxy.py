@@ -209,6 +209,13 @@ class ProxyActor:
             prefix, (app_name, ingress) = match
             key = (app_name, ingress)
             handle = self._handle_for(key)
+            # Multiplexing through the front door: the reference's
+            # serve_multiplexed_model_id header tags the request with a
+            # model id, which rides handle.options into mux-aware
+            # routing (model-resident replica preferred).
+            mux_id = headers.get("serve_multiplexed_model_id", "")
+            if mux_id:
+                handle = handle.options(multiplexed_model_id=mux_id)
             sub_path = self._sub_path(prefix, path)
             req = Request(method=method, path=sub_path or "/",
                           query=parse_qs(url.query), headers=headers,
@@ -299,6 +306,11 @@ class ProxyActor:
         from ray_tpu.serve import websocket as ws
         prefix, (app_name, ingress) = match
         handle = self._handle_for((app_name, ingress))
+        # Same mux-aware routing as the HTTP branch: a model-id-tagged
+        # websocket session prefers a model-resident replica.
+        mux_id = headers.get("serve_multiplexed_model_id", "")
+        if mux_id:
+            handle = handle.options(multiplexed_model_id=mux_id)
 
         writer.write(
             b"HTTP/1.1 101 Switching Protocols\r\n"
@@ -423,7 +435,17 @@ class ProxyActor:
     async def _probe_streaming(self, handle) -> bool:
         router = handle._get_router()
         await router.refresh_async()
-        _i, replica = router.pick_cached()
+        try:
+            _i, replica = router.pick_cached()
+        except RuntimeError:
+            # Shared-router race: a concurrent request's refresh holds
+            # the throttle window while its controller round trip is
+            # still in flight, so this coroutine saw an empty cached
+            # set. Force one authoritative refresh — a failed probe
+            # would fall back to the UNARY path, which breaks streaming
+            # handlers for this request.
+            await router.refresh_async(force=True)
+            _i, replica = router.pick_cached()
         try:
             return bool(await replica.is_streaming_method.remote(
                 handle._method))

@@ -51,8 +51,10 @@ _DEDUPE_CAP = 2048
 
 
 class RequestContext:
-    def __init__(self, multiplexed_model_id: str = ""):
+    def __init__(self, multiplexed_model_id: str = "",
+                 deployment: str = ""):
         self.multiplexed_model_id = multiplexed_model_id
+        self.deployment = deployment
 
 
 def get_request_context() -> Optional[RequestContext]:
@@ -243,8 +245,12 @@ class ReplicaActor:
         if trace_ctx is None:
             return None
         try:
-            return request_trace.RequestTrace.from_wire(
+            ctx = request_trace.RequestTrace.from_wire(
                 trace_ctx, self._deployment)
+            # Bound on this hop for span()/the batch scheduler; nested
+            # handle calls must mint their own child trace, not adopt it.
+            ctx.replica_hop = True
+            return ctx
         except Exception:  # noqa: BLE001 — tracing must not fail requests
             return None
 
@@ -300,7 +306,12 @@ class ReplicaActor:
         if ctx is not None:
             ctx.stamp(RQ_QUEUE_WAIT)
         self._total += 1
-        token = _request_context.set(RequestContext(mux_model_id))
+        token = _request_context.set(
+            RequestContext(mux_model_id, self._deployment))
+        # Bind the trace to THIS task's contextvars: the user-facing
+        # request_trace.span(...) API and the continuous-batching
+        # scheduler both discover the active trace through current().
+        rt_token = request_trace.bind(ctx)
         span = None
         if ctx is not None:
             span = request_trace.start_exec_span(
@@ -329,6 +340,7 @@ class ReplicaActor:
         finally:
             request_trace.finish_exec_span(span)
             self._finish_request_trace(ctx)
+            request_trace.unbind(rt_token)
             _request_context.reset(token)
             self._release_slot()
 
@@ -370,7 +382,9 @@ class ReplicaActor:
         if ctx is not None:
             ctx.stamp(RQ_QUEUE_WAIT)
         self._total += 1
-        token = _request_context.set(RequestContext(mux_model_id))
+        token = _request_context.set(
+            RequestContext(mux_model_id, self._deployment))
+        rt_token = request_trace.bind(ctx)
         span = None
         if ctx is not None:
             span = request_trace.start_exec_span(
@@ -445,6 +459,7 @@ class ReplicaActor:
                 self._account_exec(t_exec, error=False)
             request_trace.finish_exec_span(span)
             self._finish_request_trace(ctx)
+            request_trace.unbind(rt_token)
             _request_context.reset(token)
             self._release_slot()
 
@@ -456,13 +471,21 @@ class ReplicaActor:
         return {"pid": os.getpid(), "deployment": self._deployment,
                 "draining": self._draining}
 
-    def get_metrics(self) -> Dict[str, float]:
-        return {"ongoing": self._ongoing, "queued": self._queued,
-                "total": self._total, "shed": self._shed,
-                "timeouts": self._timeouts,
-                "completed": self._completed, "slow": self._slow,
-                "errors": self._errors,
-                "draining": float(self._draining)}
+    def get_metrics(self) -> Dict[str, Any]:
+        out = {"ongoing": self._ongoing, "queued": self._queued,
+               "total": self._total, "shed": self._shed,
+               "timeouts": self._timeouts,
+               "completed": self._completed, "slow": self._slow,
+               "errors": self._errors,
+               "draining": float(self._draining)}
+        # Multiplexing: models currently resident in this replica's
+        # @serve.multiplexed LRU cache(s). The controller polls this
+        # with health and publishes it through the routing table so
+        # handles can prefer model-resident replicas.
+        resident = getattr(self._callable, "__serve_mux_resident__", None)
+        if resident:
+            out["resident_models"] = sorted(resident)
+        return out
 
     async def check_health(self) -> bool:
         user_check = getattr(self._callable, "check_health", None)

@@ -40,8 +40,8 @@ from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu._private.flightrec import (  # noqa: F401 — re-exported
     EventRing, REQ_PHASE_ORDER, REQ_RECORD_LEN, RQ_ADMISSION, RQ_DISPATCH,
-    RQ_EXEC_END, RQ_EXEC_START, RQ_FIRST_ITEM, RQ_PROXY_RECV,
-    RQ_QUEUE_WAIT, RQ_REPLY, request_phase_durations)
+    RQ_EXEC_END, RQ_EXEC_START, RQ_FIRST_ITEM, RQ_PREFILL_END,
+    RQ_PROXY_RECV, RQ_QUEUE_WAIT, RQ_REPLY, request_phase_durations)
 
 _SAMPLE_ENV = "RAY_TPU_SERVE_TRACE_SAMPLE"
 
@@ -92,7 +92,7 @@ class RequestTrace:
 
     __slots__ = ("request_id", "trace_id", "parent_span_id", "sampled",
                  "deployment", "phases", "replays", "root_span", "owned",
-                 "_done")
+                 "replica_hop", "_done")
 
     def __init__(self, request_id: str, trace_id: str,
                  parent_span_id: str = "", sampled: bool = True,
@@ -108,6 +108,13 @@ class RequestTrace:
         # True on the hop that minted this context — that hop records the
         # trace's root event/span at finish(); non-minting hops must not.
         self.owned = False
+        # True while bound as the REPLICA hop's context (the replica
+        # binds it so span()/the batch scheduler can find the trace).
+        # A nested handle call inside the handler must NOT adopt this
+        # record as its own (it would stamp dispatch into the replica's
+        # phase record); it minted a child via exec-span adoption before
+        # the replica bound anything, and still does.
+        self.replica_hop = False
         self._done = False
 
     # -- phase stamps ---------------------------------------------------
@@ -213,6 +220,67 @@ def unbind(token) -> None:
 
 def current() -> Optional[RequestTrace]:
     return _current.get()
+
+
+# -- user-facing span API ----------------------------------------------
+
+class _NullSpan:
+    """No-op context manager: span() outside a traced request (or on an
+    unsampled one) costs nothing and never fails the handler."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _UserSpan:
+    __slots__ = ("_ctx", "_name", "_span")
+
+    def __init__(self, ctx: "RequestTrace", name: str):
+        self._ctx = ctx
+        self._name = str(name)[:120]
+        self._span = None
+
+    def __enter__(self):
+        from ray_tpu.util import tracing
+        parent = tracing.active_span()
+        trace_ctx = ((parent["trace_id"], parent["span_id"])
+                     if parent is not None
+                     else (self._ctx.trace_id, self._ctx.parent_span_id))
+        self._span = tracing.start_span(
+            self._name, trace_ctx, self._ctx.request_id)
+        return self
+
+    def __exit__(self, *exc):
+        from ray_tpu.util import tracing
+        try:
+            tracing.export_span(tracing.end_span(self._span))
+        except Exception:  # noqa: BLE001 — tracing never fails handlers
+            pass
+        return False
+
+
+def span(name: str):
+    """User context manager: mark a sub-phase inside a serve handler.
+
+        from ray_tpu.serve import request_trace
+        with request_trace.span("tokenize"):
+            ids = tok(prompt)
+
+    The span nests under the replica's exec span (or whatever span is
+    active in the handler's context — spans nest arbitrarily deep), is
+    stamped with the request id, and renders inside the handler slice in
+    ``ray_tpu timeline --request <id>``. On an unsampled or untraced
+    request this is a no-op."""
+    ctx = current()
+    if ctx is None or not ctx.sampled:
+        return _NULL_SPAN
+    return _UserSpan(ctx, name)
 
 
 # -- replica-side span helpers -----------------------------------------

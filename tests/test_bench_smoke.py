@@ -40,6 +40,21 @@ def test_bench_smoke_completes(jax_cpu):
                 "pg_create_ms", "serve_requests_dropped",
                 "serve_trace_overhead_pct"):
         assert key in row, (key, row)
+    # Continuous-batching serve phase: a sustained token-streaming load
+    # against the iteration-level scheduler vs the single-request-per-
+    # call baseline on the SAME simulated device. Occupancy p50 > 1
+    # proves requests actually shared steps (the whole point of
+    # iteration-level batching), and the >= 2x speedup is a ratio on
+    # one box — stable under CI load where absolute rates are not.
+    for key in ("serve_cb_qps", "serve_cb_baseline_qps",
+                "serve_cb_speedup", "serve_cb_p99_ms",
+                "serve_cb_baseline_p99_ms", "serve_cb_occupancy_p50",
+                "serve_cb_occupancy_p95", "serve_cb_step_ms"):
+        assert key in row, (key, row)
+    assert row["serve_cb_occupancy_p50"] > 1.0, row
+    assert row["serve_cb_speedup"] >= 2.0, row
+    # Per-phase step times recorded for both scheduled phases.
+    assert set(row["serve_cb_step_ms"]) >= {"prefill", "decode"}, row
     # Hot-path allocation tripwire: a steady-state `.remote()` call must
     # stay a small, bounded number of allocations (measured ~19 blocks
     # with the recorder on after the template/flat-reply/event-ring

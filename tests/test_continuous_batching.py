@@ -1,0 +1,791 @@
+"""Continuous-batching replicas: iteration-level scheduling, the
+prefill/decode phase split, and multiplex-aware routing.
+
+Unit tests drive a bare BatchScheduler on a private event loop
+(deterministic: join/leave at step boundaries, pad-bucket shape
+stability, the decode-starvation bound, one-model-per-step grouping).
+Cluster tests prove the serve integration: token streams through the
+replica streaming path, exactly-once delivery across a mid-generation
+replica SIGKILL via the mid-stream replay cursor, and model-resident
+routing for multiplexed bursts.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.continuous_batching import (BatchScheduler, DECODE,
+                                               PREFILL)
+
+
+# ---------------------------------------------------------------------------
+# unit: scheduler core (no cluster)
+# ---------------------------------------------------------------------------
+
+def _token_step(trace=None):
+    """Deterministic step fn: prefill initializes a counter from
+    args[0]; each decode step emits one token until the counter runs
+    out. `trace` (a list) records (phase, live_slots, batch_len)."""
+
+    def step(phase, batch):
+        if trace is not None:
+            trace.append((phase,
+                          [i for i, s in enumerate(batch) if s is not None],
+                          len(batch)))
+        out = [None] * len(batch)
+        for i, s in enumerate(batch):
+            if s is None:
+                continue
+            if phase == PREFILL:
+                s.state = {"n": s.args[0], "i": 0}
+                out[i] = (None, False)
+            else:
+                st = s.state
+                tok = f"t{st['i']}"
+                st["i"] += 1
+                out[i] = (tok, st["i"] >= st["n"])
+        return out
+
+    return step
+
+
+def test_join_and_leave_at_step_boundaries():
+    """A request submitted while a batch is RUNNING joins at the next
+    step boundary (never mid-step), and a finished sequence's slot is
+    backfilled — both visible as occupancy changing between steps while
+    every step itself sees a frozen membership. Steps are gated on a
+    semaphore so the join point is deterministic."""
+    trace = []
+    inner = _token_step(trace)
+
+    async def run():
+        gate = asyncio.Semaphore(0)
+
+        async def step(phase, batch):
+            await gate.acquire()
+            return inner(phase, batch)
+
+        sched = BatchScheduler(step, max_batch_size=4)
+
+        async def consume(n):
+            return [x async for x in sched.stream((n,), {})]
+
+        t_long = asyncio.ensure_future(consume(12))
+        # Run exactly 3 gated steps (prefill + 2 decodes) solo...
+        for _ in range(3):
+            gate.release()
+        while sched.stats()["steps_total"] < 3:
+            await asyncio.sleep(0.001)
+        # ...then submit the late request MID-GENERATION and drain.
+        t_late = asyncio.ensure_future(consume(3))
+        await asyncio.sleep(0.005)
+        done = asyncio.gather(t_long, t_late)
+        while not done.done():
+            gate.release()
+            await asyncio.sleep(0.001)
+        out_long, out_late = await done
+        assert out_long == [f"t{i}" for i in range(12)]
+        assert out_late == [f"t{i}" for i in range(3)]
+        st = sched.stats()
+        assert st["admitted_total"] == 2 and st["retired_total"] == 2
+        assert st["live"] == 0 and st["waiting"] == 0
+
+    asyncio.run(run())
+    # The late request JOINED the running batch: some decode step ran
+    # both slots at once (occupancy 2) after steps that ran only one.
+    decode_occ = [len(live) for ph, live, _l in trace if ph == DECODE]
+    assert 1 in decode_occ and 2 in decode_occ, decode_occ
+    # ... and LEFT mid-flight: the long sequence kept stepping alone
+    # after the short one retired (trailing steps back at occupancy 1).
+    assert decode_occ[-1] == 1
+    # Membership only ever changes BETWEEN steps: within a step the
+    # engine passed a frozen slot list (implicitly true by construction,
+    # asserted via the per-step snapshot being internally consistent).
+    assert all(len(set(live)) == len(live) for _p, live, _l in trace)
+
+
+def test_pad_bucket_constant_shapes():
+    """Every step-function call sees EXACTLY max_batch_size slots no
+    matter how many sequences are live — the no-recompile contract for
+    a jitted step."""
+    trace = []
+
+    async def run():
+        sched = BatchScheduler(_token_step(trace), max_batch_size=5)
+        outs = await asyncio.gather(*[
+            _collect(sched, (n,)) for n in (1, 4, 2, 7, 3, 2, 5)])
+        assert [len(o) for o in outs] == [1, 4, 2, 7, 3, 2, 5]
+
+    asyncio.run(run())
+    assert trace, "step function never ran"
+    assert {batch_len for _p, _l, batch_len in trace} == {5}, (
+        "pad bucket violated: step saw a varying batch length")
+
+
+async def _collect(sched, args):
+    return [x async for x in sched.stream(args, {})]
+
+
+def test_decode_starvation_bound():
+    """Prefill has priority, but with decode work waiting the scheduler
+    may run at most decode_starvation_steps consecutive prefill steps
+    before a decode step is forced."""
+    trace = []
+
+    async def run():
+        # One-slot prefill chunks + a steady prefill backlog.
+        sched = BatchScheduler(_token_step(trace), max_batch_size=8,
+                               prefill_chunk=1, decode_starvation_steps=2)
+        await asyncio.gather(*[_collect(sched, (6,)) for _ in range(8)])
+
+    asyncio.run(run())
+    phases = [p for p, _l, _n in trace]
+    assert PREFILL in phases and DECODE in phases
+    # No run of prefill steps longer than the bound once decode work
+    # exists (the first prefills may run unbounded — nothing to starve).
+    seen_decode = False
+    streak = 0
+    for p in phases:
+        if p == DECODE:
+            seen_decode = True
+            streak = 0
+        elif seen_decode:
+            streak += 1
+            assert streak <= 2, f"decode starved for {streak} steps"
+
+
+def test_one_model_per_step_grouping():
+    """Multiplexed tenancy: the scheduler never mixes model ids within
+    one step, so co-resident models can't thrash the LRU mid-batch."""
+    seen = []
+
+    def step(phase, batch):
+        models = {s.model_id for s in batch if s is not None}
+        seen.append(models)
+        out = [None] * len(batch)
+        for i, s in enumerate(batch):
+            if s is None:
+                continue
+            if phase == PREFILL:
+                s.state = 2
+                out[i] = (None, False)
+            else:
+                s.state -= 1
+                out[i] = (s.model_id, s.state == 0)
+        return out
+
+    async def run():
+        sched = BatchScheduler(step, max_batch_size=4)
+
+        async def one(model):
+            return [x async for x in sched.stream((), {}, model_id=model)]
+
+        outs = await asyncio.gather(*[one(m) for m in
+                                      ("a", "b", "a", "b", "a", "b")])
+        for m, out in zip(("a", "b", "a", "b", "a", "b"), outs):
+            assert out == [m, m]
+
+    asyncio.run(run())
+    assert seen and all(len(models) == 1 for models in seen), seen
+
+
+def test_step_error_fails_only_that_steps_sequences():
+    """A step-function exception surfaces on the sequences in THAT step;
+    the scheduler loop survives and keeps serving later submissions."""
+    boom = {"armed": False}
+
+    def step(phase, batch):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("model OOM")
+        out = [None] * len(batch)
+        for i, s in enumerate(batch):
+            if s is None:
+                continue
+            out[i] = ("ok", True) if phase == DECODE else (None, False)
+        return out
+
+    async def run():
+        sched = BatchScheduler(step, max_batch_size=2)
+        assert await _collect(sched, ()) == ["ok"]
+        boom["armed"] = True
+        with pytest.raises(RuntimeError, match="model OOM"):
+            await _collect(sched, ())
+        # The loop is still alive.
+        assert await _collect(sched, ()) == ["ok"]
+
+    asyncio.run(run())
+
+
+def test_malformed_slot_result_fails_only_that_sequence():
+    """A step fn returning garbage for ONE slot (not None / not a
+    2-tuple) errors that sequence typed; other sequences in the same
+    step and the loop itself keep going — never a silent hang."""
+    first_live = {"armed": True}
+
+    def step(phase, batch):
+        out = [None] * len(batch)
+        live = [i for i, s in enumerate(batch) if s is not None]
+        for i in live:
+            if phase == PREFILL:
+                batch[i].state = 1
+                out[i] = (None, False)
+            else:
+                out[i] = ("ok", True)
+        if phase == DECODE and first_live["armed"] and len(live) >= 2:
+            first_live["armed"] = False
+            out[live[0]] = "garbage"   # not None, not a 2-tuple
+        return out
+
+    async def run():
+        sched = BatchScheduler(step, max_batch_size=2)
+        r1 = asyncio.ensure_future(_collect(sched, ()))
+        r2 = asyncio.ensure_future(_collect(sched, ()))
+        results = await asyncio.wait_for(
+            asyncio.gather(r1, r2, return_exceptions=True), 10)
+        errs = [r for r in results if isinstance(r, BaseException)]
+        oks = [r for r in results if not isinstance(r, BaseException)]
+        assert len(errs) == 1 and "expected None or" in str(errs[0])
+        assert oks == [["ok"]]
+        # Loop survived: later submissions still complete.
+        assert await asyncio.wait_for(_collect(sched, ()), 10) == ["ok"]
+
+    asyncio.run(run())
+
+
+def test_decode_fairness_across_models():
+    """Co-resident models share decode steps (most-starved model first):
+    a short model-b generation finishes long before a marathon model-a
+    one, instead of waiting for a's entire token budget."""
+    done_order = []
+
+    def step(phase, batch):
+        out = [None] * len(batch)
+        for i, s in enumerate(batch):
+            if s is None:
+                continue
+            if phase == PREFILL:
+                s.state = {"n": s.args[0], "i": 0}
+                out[i] = (None, False)
+            else:
+                st = s.state
+                st["i"] += 1
+                fin = st["i"] >= st["n"]
+                if fin:
+                    done_order.append(s.model_id)
+                out[i] = (st["i"], fin)
+        return out
+
+    async def run():
+        sched = BatchScheduler(step, max_batch_size=4)
+
+        async def one(n, model):
+            return [x async for x in sched.stream((n,), {},
+                                                  model_id=model)]
+
+        a, b = await asyncio.wait_for(asyncio.gather(
+            one(60, "a"), one(2, "b")), 30)
+        assert len(a) == 60 and len(b) == 2
+
+    asyncio.run(run())
+    # b retired first — decode steps alternated between models instead
+    # of the lowest slot's model monopolizing the scheduler.
+    assert done_order[0] == "b", done_order
+
+
+def test_step_must_return_full_bucket():
+    """Returning fewer slots than max_batch_size is a contract error —
+    surfaced typed to the affected sequences, not swallowed."""
+
+    def step(phase, batch):
+        return [(None, True)]  # wrong length
+
+    async def run():
+        sched = BatchScheduler(step, max_batch_size=3)
+        with pytest.raises(ValueError, match="exactly 3 slots"):
+            await _collect(sched, ())
+
+    asyncio.run(run())
+
+
+def test_cancelled_consumer_retires_at_boundary():
+    """Closing the output generator (client gone / deadline) retires the
+    sequence at the next step boundary and frees its slot."""
+
+    async def run():
+        sched = BatchScheduler(_token_step(), max_batch_size=2)
+        agen = sched.stream((100,), {})
+        assert await agen.__anext__() == "t0"
+        await agen.aclose()
+        # The slot frees at a boundary; a new sequence then completes
+        # even though the cancelled one "had" 100 tokens left.
+        out = await asyncio.wait_for(_collect(sched, (2,)), 10)
+        assert out == ["t0", "t1"]
+        deadline = time.monotonic() + 5
+        while sched.stats()["live"] and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        assert sched.stats()["live"] == 0
+
+    asyncio.run(run())
+
+
+def test_admission_aging_bounds_cross_model_starvation():
+    """Model-locality admission is a preference, not a starvation
+    hazard: with one slot pinned by a long model-'a' stream and a
+    steady supply of fresh 'a' requests, a waiting 'b' request is
+    admitted FIFO after ADMIT_STARVATION_DEFERS pass-overs instead of
+    being deferred forever."""
+    order = []
+
+    async def run():
+        sched = BatchScheduler(_token_step(), max_batch_size=2)
+
+        async def one(tag, model, n):
+            out = [x async for x in sched.stream((n,), {},
+                                                 model_id=model)]
+            order.append(tag)
+            return out
+
+        marathon = asyncio.ensure_future(one("a0", "a", 500))
+        while sched.stats()["steps_total"] < 2:
+            await asyncio.sleep(0.001)
+        churn = [asyncio.ensure_future(one("b", "b", 1))]
+        churn += [asyncio.ensure_future(one(f"a{k}", "a", 1))
+                  for k in range(1, 13)]
+        await asyncio.wait_for(asyncio.gather(*churn), 30)
+        marathon.cancel()
+
+    asyncio.run(run())
+    # b finished before the churn drained — it was aged in, not starved
+    # to the back of the line.
+    assert "b" in order[:-2], order
+
+
+def test_cancelled_waiters_reaped_while_batch_saturated():
+    """Clients that give up while every slot is busy must be reaped
+    from the WAITING queue at the next boundary — not pile up
+    unboundedly holding their prompt payloads."""
+
+    async def run():
+        gate = asyncio.Semaphore(0)
+        inner = _token_step()
+
+        async def step(phase, batch):
+            await gate.acquire()
+            return inner(phase, batch)
+
+        sched = BatchScheduler(step, max_batch_size=1)
+        long_task = asyncio.ensure_future(_collect(sched, (50,)))
+        gate.release(); gate.release()   # prefill + 1 decode: slot busy
+        while sched.stats()["steps_total"] < 2:
+            await asyncio.sleep(0.001)
+        # 5 impatient clients submit and give up without ever joining.
+        quitters = [sched.stream((3,), {}) for _ in range(5)]
+        for q in quitters:
+            t = asyncio.ensure_future(q.__anext__())
+            await asyncio.sleep(0.005)
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, StopAsyncIteration):
+                pass
+            await q.aclose()
+        assert sched.stats()["waiting"] == 5   # not yet reaped (no step)
+        gate.release()                         # one boundary passes
+        deadline = time.monotonic() + 5
+        while sched.stats()["waiting"] and time.monotonic() < deadline:
+            await asyncio.sleep(0.005)
+        assert sched.stats()["waiting"] == 0, sched.stats()
+        long_task.cancel()
+
+    asyncio.run(run())
+
+
+def test_decorator_submits_and_streams():
+    """@serve.continuous_batching: the decorated method is the step fn;
+    calling it submits one request and yields its emissions — and the
+    wrapper is an async-generator function, which is what the replica's
+    streaming-path probe keys on."""
+    import inspect
+
+    class Model:
+        @serve.continuous_batching(max_batch_size=3)
+        def step(self, phase, batch):
+            out = [None] * len(batch)
+            for i, s in enumerate(batch):
+                if s is None:
+                    continue
+                if phase == PREFILL:
+                    s.state = list(range(s.args[0]))
+                    out[i] = (None, False)
+                else:
+                    out[i] = (s.state.pop(0), not s.state)
+            return out
+
+    assert inspect.isasyncgenfunction(Model.step)
+
+    async def run():
+        m = Model()
+        a, b = await asyncio.gather(
+            _drain(m.step(3)), _drain(m.step(2)))
+        assert a == [0, 1, 2] and b == [0, 1]
+        sched = getattr(m, "__serve_cb_scheduler_step")
+        assert sched.stats()["retired_total"] == 2
+        # Shared state proves BOTH requests rode one scheduler/batch.
+        assert sched.stats()["occupancy_mean"] > 1.0
+
+    asyncio.run(run())
+
+
+async def _drain(agen):
+    return [x async for x in agen]
+
+
+# ---------------------------------------------------------------------------
+# unit: controller satellites (no cluster)
+# ---------------------------------------------------------------------------
+
+def test_orphan_sweep_keys_on_namespace_not_class_name():
+    """A user actor class literally named ReplicaActor (user namespace)
+    is NEVER an orphan candidate; a serve-namespace actor missing from
+    the registry is; a registered serve actor is not."""
+    from ray_tpu.serve.controller import (SERVE_ACTOR_NAMESPACE,
+                                          ServeController)
+
+    class _Info:
+        def __init__(self, actor_id, namespace, class_name, state="ALIVE"):
+            self.actor_id = actor_id
+            self.namespace = namespace
+            self.class_name = class_name
+            self.state = state
+
+    ctrl = ServeController.__new__(ServeController)
+    ctrl._known_actor_ids = {"registered"}
+    infos = [
+        _Info("user1", "", "ReplicaActor"),              # user impostor
+        _Info("user2", "myapp", "ProxyActor"),           # user impostor
+        _Info("orphan", SERVE_ACTOR_NAMESPACE, "ReplicaActor"),
+        _Info("registered", SERVE_ACTOR_NAMESPACE, "ReplicaActor"),
+        _Info("dead", SERVE_ACTOR_NAMESPACE, "ReplicaActor",
+              state="DEAD"),
+    ]
+    victims = [i.actor_id for i in ctrl._orphan_candidates(infos)]
+    assert victims == ["orphan"], victims
+
+
+def test_recovery_probe_timeout_configurable():
+    """ServeConfig.recovery_probe_timeout_s: default 5.0; an operator
+    value persists through the KV and survives a controller restart
+    (the unit-mode local store stands in for the GCS KV)."""
+    from ray_tpu.serve import persistence
+    from ray_tpu.serve.config import ServeConfig
+    from ray_tpu.serve.controller import ServeController
+
+    assert ServeConfig().recovery_probe_timeout_s == 5.0
+    saved = dict(persistence._local_store)
+    # Force the unit-mode local store even when an earlier test module
+    # left a (possibly shut-down) core worker in this process.
+    from ray_tpu._private import worker_api
+    real_peek = worker_api.peek_core
+    worker_api.peek_core = lambda: None
+    try:
+        persistence._local_store.clear()
+        persistence._local_store[persistence.CONFIG_KEY] = \
+            persistence.encode({"recovery_probe_timeout_s": 11.5})
+        ctrl = ServeController()
+        assert ctrl._serve_config.recovery_probe_timeout_s == 11.5
+        # Unknown/garbage fields never break recovery.
+        ctrl._apply_serve_config({"recovery_probe_timeout_s": "nan-ish",
+                                  "future_knob": 1})
+        assert ctrl._serve_config.recovery_probe_timeout_s == 11.5
+    finally:
+        worker_api.peek_core = real_peek
+        persistence._local_store.clear()
+        persistence._local_store.update(saved)
+
+
+def test_multiplex_tracks_resident_models():
+    """@serve.multiplexed publishes the owner's resident-model set on
+    every load/evict — the signal the controller polls for routing."""
+    from ray_tpu.serve.multiplex import RESIDENT_ATTR, multiplexed
+
+    class Host:
+        @multiplexed(max_num_models_per_replica=2)
+        async def load(self, model_id):
+            return f"model:{model_id}"
+
+    async def run():
+        h = Host()
+        await h.load("a")
+        await h.load("b")
+        assert getattr(h, RESIDENT_ATTR) == {"a", "b"}
+        await h.load("c")              # evicts LRU "a"
+        assert getattr(h, RESIDENT_ATTR) == {"b", "c"}
+
+    asyncio.run(run())
+
+
+def test_router_prefers_model_resident_replicas():
+    """Router.pick_cached(mux_id): p2c runs within the model-resident
+    subset when one exists; untagged requests and unknown models fall
+    back to the full set."""
+    from ray_tpu.serve.handle import Router
+
+    r = Router("d", "a")
+    r._apply(time.monotonic(), {
+        "version": 1,
+        "replicas": [("r1", "h1"), ("r2", "h2"), ("r3", "h3")],
+        "resident": {"r2": ["m1"], "r3": ["m2"]},
+        "config": {},
+    })
+    picks = set()
+    for _ in range(40):
+        rid, handle = r.pick_cached("m1")
+        picks.add(rid)
+        r.release(rid)
+    assert picks == {"r2"}, picks   # every m1 request hit the warm replica
+    assert handle == "h2"
+    # Unknown model / untagged: full-set p2c still spreads.
+    picks = set()
+    for _ in range(60):
+        rid, _h = r.pick_cached("m-unknown")
+        picks.add(rid)
+        r.release(rid)
+    assert len(picks) > 1
+    picks = set()
+    for _ in range(60):
+        rid, _h = r.pick_cached()
+        picks.add(rid)
+        r.release(rid)
+    assert len(picks) > 1
+
+
+# ---------------------------------------------------------------------------
+# cluster: serve integration
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ray_mod():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def serve_app(ray_mod):
+    yield serve
+    try:
+        for app in list(serve.status().keys()):
+            serve.delete(app)
+    except Exception:
+        pass
+
+
+def _replica_handles(app: str, dep: str):
+    from ray_tpu.serve.api import _get_controller
+    ctrl = _get_controller()
+    _v, reps = ray_tpu.get(ctrl.get_replicas.remote(app, dep), timeout=30)
+    return reps
+
+
+def _wait_ready(app: str, dep: str, n: int, timeout: float = 120):
+    from ray_tpu.serve.api import _get_controller
+    ctrl = _get_controller()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = ray_tpu.get(ctrl.status.remote(), timeout=30)
+        if st.get(app, {}).get(dep, {}).get("ready", 0) >= n:
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def _make_lm(num_replicas=1, request_replay=False, decode_sleep=0.0):
+    @serve.deployment(num_replicas=num_replicas,
+                      request_replay=request_replay, name="LM")
+    class LM:
+        @serve.continuous_batching(max_batch_size=4)
+        async def step(self, phase, batch):
+            if decode_sleep and phase == DECODE:
+                await asyncio.sleep(decode_sleep)
+            out = [None] * len(batch)
+            for i, s in enumerate(batch):
+                if s is None:
+                    continue
+                if phase == PREFILL:
+                    s.state = {"n": s.args[0], "i": 0}
+                    out[i] = (None, False)
+                else:
+                    st = s.state
+                    tok = {"t": st["i"]}
+                    st["i"] += 1
+                    out[i] = (tok, st["i"] >= st["n"])
+            return out
+
+        async def __call__(self, n):
+            import os
+            async for tok in self.step(n):
+                yield dict(tok, pid=os.getpid())
+
+        def cb_stats(self):
+            sched = getattr(self, "__serve_cb_scheduler_step", None)
+            return sched.stats() if sched is not None else {}
+
+    return LM
+
+
+@pytest.mark.timeout(180)
+def test_cb_streams_tokens_and_batches_concurrent_requests(serve_app):
+    """End to end: concurrent token streams ride ONE replica's running
+    batch (occupancy > 1), every client gets its full sequence, and the
+    occupancy/step metrics populate."""
+    import threading
+
+    serve.run(_make_lm(decode_sleep=0.05).bind(), name="cb1",
+              route_prefix="/cb1")
+    assert _wait_ready("cb1", "LM", 1)
+    h = serve.get_app_handle("cb1")
+
+    results = {}
+
+    def client(k, n):
+        gen = h.options(stream=True).remote(n)
+        results[k] = [tok["t"] for tok in gen]
+
+    threads = [threading.Thread(target=client, args=(k, 8 + k))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    for k in range(4):
+        assert results[k] == list(range(8 + k)), results
+    stats = h.cb_stats.remote().result(timeout=30)
+    assert stats["retired_total"] >= 4
+    assert stats["steps_prefill"] >= 1 and stats["steps_decode"] >= 1
+    # The whole point: concurrent streams shared steps.
+    assert stats["occupancy_mean"] > 1.0, stats
+
+
+@pytest.mark.timeout(240)
+def test_cb_mid_generation_kill_delivers_exactly_once(serve_app):
+    """Replica SIGKILLed mid-generation on a replayable deployment: the
+    stream re-routes through the mid-stream replay cursor and the client
+    sees the FULL token sequence exactly once — and the tail really came
+    from the replacement (pid flips)."""
+    serve.run(_make_lm(num_replicas=2, request_replay=True,
+                       decode_sleep=0.15).bind(),
+              name="cb2", route_prefix="/cb2")
+    assert _wait_ready("cb2", "LM", 2)
+    h = serve.get_app_handle("cb2")
+
+    gen = h.options(stream=True).remote(8)
+    items = [next(gen), next(gen)]          # two tokens delivered...
+    victim = None
+    for rep in _replica_handles("cb2", "LM"):
+        m = ray_tpu.get(rep.get_metrics.remote(), timeout=10)
+        if m.get("ongoing", 0) > 0:
+            victim = rep
+            break
+    assert victim is not None, "no replica reports the stream in flight"
+    ray_tpu.kill(victim)                    # ...then murder mid-decode
+    items.extend(gen)
+    assert [it["t"] for it in items] == list(range(8)), items
+    assert items[-1]["pid"] != items[0]["pid"], \
+        "tail did not come from the replacement replica"
+
+
+@pytest.mark.timeout(240)
+def test_mux_routing_prefers_model_resident_replicas(serve_app):
+    """Same-model burst routing: after one warm-up request loads the
+    model somewhere and the resident set propagates (health poll ->
+    routing table -> router refresh), >= 90% of a same-model burst must
+    land on the model-resident replica. (With p2c confined to the
+    resident subset this is deterministically 100%.)"""
+    @serve.deployment(num_replicas=2, name="Mux")
+    class Mux:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def load(self, model_id):
+            return f"model:{model_id}"
+
+        async def __call__(self, _x):
+            import os
+            model = await self.load(serve.get_multiplexed_model_id())
+            return {"pid": os.getpid(), "model": model}
+
+    serve.run(Mux.bind(), name="mux1", route_prefix="/mux1")
+    assert _wait_ready("mux1", "Mux", 2)
+    h = serve.get_app_handle("mux1").options(multiplexed_model_id="m1")
+
+    first = h.remote(0).result(timeout=60)
+    warm_pid = first["pid"]
+
+    # Wait for the resident set to reach the routing table.
+    from ray_tpu.serve.api import _get_controller
+    ctrl = _get_controller()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        routing = ray_tpu.get(
+            ctrl.get_routing.remote("mux1", "Mux"), timeout=30)
+        if any("m1" in models
+               for models in (routing.get("resident") or {}).values()):
+            break
+        time.sleep(0.25)
+    else:
+        pytest.fail(f"resident set never propagated: {routing}")
+    time.sleep(1.2)   # router refresh window (Router.REFRESH_S)
+
+    pids = [h.remote(i).result(timeout=60)["pid"] for i in range(30)]
+    hits = sum(1 for p in pids if p == warm_pid)
+    assert hits >= 27, (hits, warm_pid, pids)   # >= 90% model-resident
+
+
+@pytest.mark.timeout(180)
+def test_serve_namespace_isolates_user_replica_actor(serve_app):
+    """Integration half of the orphan-sweep fix: serve's replicas live
+    in the reserved namespace; a user actor class literally named
+    ReplicaActor does not — so the sweep predicate can never select
+    it."""
+    @serve.deployment(num_replicas=1, name="NS")
+    def ns_handler(x):
+        return x
+
+    serve.run(ns_handler.bind(), name="ns1", route_prefix="/ns1")
+    assert _wait_ready("ns1", "NS", 1)
+
+    @ray_tpu.remote
+    class ReplicaActor:      # user impostor, default namespace
+        def ping(self):
+            return "user"
+
+    user = ReplicaActor.remote()
+    assert ray_tpu.get(user.ping.remote(), timeout=60) == "user"
+
+    from ray_tpu._private import worker_api
+    from ray_tpu.serve.api import _get_controller
+    from ray_tpu.serve.controller import SERVE_ACTOR_NAMESPACE
+    core = worker_api.get_core()
+    infos = worker_api._call_on_core_loop(
+        core, core.gcs.request("get_all_actors", {}), 30)
+    by_ns = {}
+    for info in infos:
+        if info.class_name == "ReplicaActor" and info.state != "DEAD":
+            by_ns.setdefault(info.namespace, []).append(info)
+    assert SERVE_ACTOR_NAMESPACE in by_ns, by_ns.keys()
+    assert "" in by_ns or any(ns != SERVE_ACTOR_NAMESPACE
+                              for ns in by_ns), by_ns.keys()
+    # The sweep predicate (fed the REAL cluster view, with an empty
+    # known set — the worst case) only ever selects serve-namespace
+    # actors; the user's ReplicaActor survives by construction.
+    ctrl_cls = _get_controller()  # noqa: F841 — controller is up
+    from ray_tpu.serve.controller import ServeController
+    probe = ServeController.__new__(ServeController)
+    probe._known_actor_ids = set()
+    victims = probe._orphan_candidates(infos)
+    assert all(getattr(i, "namespace", "") == SERVE_ACTOR_NAMESPACE
+               for i in victims)
+    assert user._actor_id not in [i.actor_id for i in victims]

@@ -209,6 +209,28 @@ def test_batching(ray_mod):
     assert max(sizes) > 1  # some requests were actually batched
 
 
+def test_batch_pads_to_fixed_bucket():
+    """pad_batches=True: a short flush ships EXACTLY max_batch_size
+    entries (pad_value fill), pad outputs are dropped — the constant
+    shape a jitted batch fn needs. Unit — no cluster."""
+    import asyncio
+
+    shapes = []
+
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.01,
+                 pad_batches=True, pad_value=0)
+    async def tenx(xs):
+        shapes.append(len(xs))
+        return [x * 10 for x in xs]
+
+    async def run():
+        out = await asyncio.gather(*[tenx(i) for i in range(3)])
+        assert list(out) == [0, 10, 20]
+
+    asyncio.run(run())
+    assert shapes == [4], shapes
+
+
 def test_multiplex(ray_mod):
     @serve.deployment
     class MuxModel:

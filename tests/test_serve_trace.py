@@ -191,6 +191,93 @@ def test_single_trace_spans_proxy_replica_task_with_replay(serve_app):
 
 
 # ---------------------------------------------------------------------------
+# user span API: request_trace.span(...) inside handlers
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(120)
+def test_user_span_api_nests_under_exec_span(serve_app):
+    """`with request_trace.span("tokenize")` inside a handler: the span
+    parents under the replica's exec span, nested spans parent under it,
+    both carry the request id, and the per-request timeline renders
+    them — the handler-interior visibility PR 7 left open."""
+    @serve.deployment
+    class Spanny:
+        async def __call__(self, x):
+            from ray_tpu.serve import request_trace
+            with request_trace.span("tokenize"):
+                with request_trace.span("bpe"):
+                    pass
+            return x
+
+    h = serve.run(Spanny.bind(), name="sp1", route_prefix="/sp1")
+    assert _wait_ready("sp1", "Spanny", 1)
+    assert h.remote(7).result(timeout=60) == 7
+
+    spans = {}
+    evs = []
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        evs = _raw_events()
+        spans = {s.get("name"): s for s in evs if isinstance(s, dict)
+                 and s.get("kind") == "span"}
+        if "tokenize" in spans and "bpe" in spans:
+            break
+        time.sleep(0.5)
+    assert "tokenize" in spans and "bpe" in spans, sorted(spans)
+    tok, bpe = spans["tokenize"], spans["bpe"]
+    execs = [s for s in evs if isinstance(s, dict)
+             and s.get("kind") == "span"
+             and str(s.get("name", "")).startswith("exec:Spanny")
+             and s.get("trace_id") == tok["trace_id"]]
+    assert execs, "exec span missing for the traced request"
+    assert tok["parent_id"] == execs[0]["span_id"]
+    assert bpe["parent_id"] == tok["span_id"]       # spans nest
+    assert tok["task_id"] == execs[0]["task_id"]    # request id rides
+    # ... and the span renders in `ray_tpu timeline --request <id>`.
+    from ray_tpu._private import flightrec
+    rows = [r for r in flightrec.build_trace(evs)
+            if r.get("request_id") == tok["task_id"]]
+    assert any(r.get("name") == "tokenize"
+               and r.get("cat") == "serve_span" for r in rows), rows
+
+
+def test_span_api_is_noop_outside_traced_request():
+    """span() with no active trace (or unsampled) must be a do-nothing
+    context manager — user code never pays or breaks."""
+    from ray_tpu.serve import request_trace
+    with request_trace.span("free-floating"):
+        pass
+    try:
+        request_trace.set_sample_n(0)
+        ctx = request_trace.mint("d")
+        token = request_trace.bind(ctx)
+        try:
+            before = len(request_trace._ring)
+            with request_trace.span("unsampled"):
+                pass
+            assert len(request_trace._ring) == before
+        finally:
+            request_trace.unbind(token)
+    finally:
+        request_trace.set_sample_n(None)
+
+
+def test_prefill_end_phase_folds():
+    """The continuous-batching prefill/decode split rides the request
+    record: exec_start -> prefill_end -> exec_end folds into positive
+    prefill and decode gaps."""
+    from ray_tpu._private import flightrec
+    rec = flightrec.new_request_record()
+    rec[flightrec.RQ_EXEC_START] = 1.0
+    rec[flightrec.RQ_PREFILL_END] = 1.2
+    rec[flightrec.RQ_EXEC_END] = 1.5
+    out = dict(flightrec.request_phase_durations(rec))
+    assert out["prefill_end"] == pytest.approx(0.2)   # prefill time
+    assert out["exec_end"] == pytest.approx(0.3)      # decode time
+    assert out["total"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
 # acceptance 2: burn-rate upscale fires before the queue sheds
 # ---------------------------------------------------------------------------
 
