@@ -3465,6 +3465,111 @@ class CoreWorker:
         await self.gcs.request("kill_actor", {"actor_id": actor_id,
                                               "no_restart": no_restart})
 
+    # ---- compiled-DAG lease pinning (dag/compiled.py) ----
+
+    async def local_node_id(self):
+        """This process's hosting node id. Workers know it from their
+        environment; a driver resolves it ONCE by matching the raylet it
+        dialed (compiled DAGs use it to decide which channel edges can
+        be same-node shm rings)."""
+        if self.node_id is not None:
+            return self.node_id
+        try:
+            nodes = await self.gcs.request("get_all_nodes", {})
+        except rpc.RpcError:
+            return None
+        for n in nodes:
+            if n.address == self.raylet_address:
+                self.node_id = n.node_id
+                break
+        return self.node_id
+
+    async def _wait_actor_alive(self, actor_id: ActorID,
+                                timeout_s: float) -> "ActorInfo":
+        """Poll until the actor is ALIVE with a known placement — a
+        compiled DAG pins leases against live workers only."""
+        deadline = time.time() + timeout_s
+        while True:
+            info = await self.gcs.request("get_actor_info",
+                                          {"actor_id": actor_id})
+            if info is not None:
+                if info.state == ACTOR_ALIVE and info.node_id is not None:
+                    return info
+                if info.state == ACTOR_DEAD:
+                    raise exc.ActorDiedError(
+                        actor_id, info.death_cause
+                        or "died before DAG compile finished")
+            if time.time() > deadline:
+                raise exc.GetTimeoutError(
+                    f"actor {actor_id.hex()[:12]} not ALIVE within "
+                    f"{timeout_s}s (state="
+                    f"{getattr(info, 'state', 'unknown')})")
+            await asyncio.sleep(0.05)
+
+    async def dag_pin_actors(self, dag_id: str, actor_ids: list,
+                             timeout_s: float = 60.0) -> dict:
+        """Resolve every participant's placement and pin its worker's
+        lease at the hosting raylet for the DAG's lifetime. Returns
+        {actor_id: {node_id, worker_id, raylet}}; dag_release() undoes
+        the pins. Placement waits and per-raylet pins run CONCURRENTLY
+        (compile latency stays O(slowest actor), not O(actors)); a
+        partial failure rolls back every raylet already pinned — a
+        half-pinned DAG would leak OOM/reaper-exempt leases forever."""
+        async def _place(aid):
+            info = await self._wait_actor_alive(aid, timeout_s)
+            node = await self.gcs.request("get_node_address",
+                                          {"node_id": info.node_id})
+            if not node or not node.get("alive"):
+                raise exc.ActorUnavailableError(
+                    f"actor {aid.hex()[:12]}'s node is not alive")
+            return aid, {"node_id": info.node_id,
+                         "worker_id": info.worker_id,
+                         "raylet": node["address"]}
+
+        placements = dict(await asyncio.gather(
+            *[_place(aid) for aid in actor_ids]))
+        by_addr: Dict[str, list] = {}
+        for aid, p in placements.items():
+            by_addr.setdefault(p["raylet"], []).append(aid)
+        results = await asyncio.gather(
+            *[self.clients.request(addr, "dag_pin_workers",
+                                   {"dag_id": dag_id, "actor_ids": aids})
+              for addr, aids in by_addr.items()],
+            return_exceptions=True)
+        failed = next((r for r in results if isinstance(r, BaseException)),
+                      None)
+        if failed is not None:
+            await self.dag_release(dag_id, list(by_addr))
+            raise failed
+        return placements
+
+    async def dag_release(self, dag_id: str, raylet_addrs: list) -> list:
+        """Release every lease `dag_id` pinned; returns the released
+        worker ids (hex). A vanished raylet released implicitly — its
+        leases died with it."""
+        released: list = []
+        for addr in raylet_addrs:
+            try:
+                released.extend(await self.clients.request(
+                    addr, "dag_release_workers", {"dag_id": dag_id}))
+            except rpc.RpcError:
+                pass
+        return released
+
+    async def dag_lease_accounting(self, raylet_addrs: list) -> dict:
+        """{dag_id: [worker hexes]} merged across `raylet_addrs` — the
+        accounting surface teardown tests assert empties out."""
+        merged: Dict[str, list] = {}
+        for addr in raylet_addrs:
+            try:
+                acct = await self.clients.request(
+                    addr, "dag_lease_accounting", {})
+            except rpc.RpcError:
+                continue
+            for dag_id, workers in acct.items():
+                merged.setdefault(dag_id, []).extend(workers)
+        return merged
+
     async def get_named_actor(self, name: str, namespace: str = ""):
         info: Optional[ActorInfo] = await self.gcs.request(
             "get_named_actor", {"name": name, "namespace": namespace})

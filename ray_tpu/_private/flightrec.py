@@ -223,6 +223,20 @@ def request_phase_durations(rec: Sequence) -> List[Tuple[str, float]]:
     return out
 
 
+def span_event(name: str, trace_id: str, start: float, end: float,
+               **extra) -> dict:
+    """One kind:"span" task-event record — the wire shape get_spans()
+    and the timeline consume — for spans recorded OUTSIDE util/tracing's
+    contextvar machinery: the GCS gang-drain spans and the compiled-DAG
+    dag:compile / dag:tick spans build these directly (a contextvar span
+    would mis-parent them under whatever task happens to be running)."""
+    import os as _os
+    return {"kind": "span", "trace_id": trace_id,
+            "span_id": _os.urandom(8).hex(), "parent_id": "",
+            "name": name, "task_id": trace_id, "start": start, "end": end,
+            "pid": _os.getpid(), **extra}
+
+
 # Worker-lane sub-slices drawn inside the task slice on the timeline.
 SUB_SLICES = (
     ("args_resolve", PH_RECEIVED, PH_ARGS_READY),

@@ -50,9 +50,13 @@ def pick_victim(workers: List) -> Optional[object]:
     Expects objects with .leased, .is_actor_worker, .lease_owner,
     .idle_since (last grant time), .pid. Returns the newest worker of the
     owner with the most leased workers; task workers are preferred over
-    actor workers (actors lose state on kill).
+    actor workers (actors lose state on kill). Workers pinned by a
+    compiled DAG (.dag_pins non-empty) are never victims: killing one
+    wedges every tick of its pipeline, a far worse outcome than letting
+    a retryable task die.
     """
-    leased = [w for w in workers if w.leased]
+    leased = [w for w in workers
+              if w.leased and not getattr(w, "dag_pins", None)]
     if not leased:
         return None
     for pool in ([w for w in leased if not w.is_actor_worker],

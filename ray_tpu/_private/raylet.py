@@ -429,6 +429,10 @@ class WorkerHandle:
     # stranding both sides (the first reply being lost is exactly the
     # case replays exist for).
     pending_assignment: Optional[dict] = None
+    # Compiled-DAG pins (dag ids): while non-empty this worker's lease
+    # is load-bearing pipeline state — excluded from OOM victim
+    # selection and the idle reaper until every DAG releases it.
+    dag_pins: set = field(default_factory=set)
 
 
 class ResourcePool:
@@ -551,6 +555,9 @@ class Raylet:
         # not double-instantiate the actor.
         self._creating_actors: Dict[tuple, asyncio.Future] = {}
         self._pending_leases: List[PendingLease] = []
+        # Compiled-DAG lease accounting: dag_id -> worker hexes pinned on
+        # this node (rpc_dag_pin_workers / rpc_dag_release_workers).
+        self._dag_pins: Dict[str, set] = {}
         # Driver conns that have been granted leases: on close, their
         # leased workers are reclaimed (reference: leased workers of an
         # exited job are destroyed, worker_pool.cc DisconnectClient).
@@ -652,6 +659,7 @@ class Raylet:
         for gname in ("ray_tpu_raylet_pending_leases",
                       "ray_tpu_raylet_idle_workers",
                       "ray_tpu_raylet_leased_workers",
+                      "ray_tpu_raylet_dag_pinned_workers",
                       "ray_tpu_worker_pool_hits_total",
                       "ray_tpu_worker_pool_misses_total"):
             _metrics.remove(gname, {"Node": self.node_name})
@@ -739,6 +747,10 @@ class Raylet:
               "workers currently leased out").set(
                 float(sum(1 for w in self.workers.values() if w.leased)),
                 tags=tags)
+            g("ray_tpu_raylet_dag_pinned_workers",
+              "workers whose lease a compiled DAG holds pinned").set(
+                float(sum(1 for w in self.workers.values()
+                          if w.dag_pins)), tags=tags)
             # Warm-pool health: per-env pool depth + cumulative hit/miss.
             # Rows for envs whose pool emptied AND whose floor expired
             # are removed (not left at 0 forever): a long-lived node
@@ -1233,6 +1245,51 @@ class Raylet:
                 fut.set_result(result)
         return True
 
+    # ---- compiled-DAG lease pinning -----------------------------------
+
+    @rpc.idempotent
+    async def rpc_dag_pin_workers(self, conn, payload):
+        """Pin the leases of the workers hosting `actor_ids` for a
+        compiled DAG's lifetime: pinned workers are excluded from OOM
+        victim selection and the idle reaper, and stay visible in
+        rpc_dag_lease_accounting until rpc_dag_release_workers (or
+        worker death) drops them. Set-based, so replays are no-ops."""
+        dag_id = payload["dag_id"]
+        by_actor = {h.actor_id: h for h in self.workers.values()
+                    if h.is_actor_worker and h.actor_id is not None}
+        # Validate-then-pin (atomic per raylet): a missing actor midway
+        # through the loop must not leave the earlier ones half-pinned.
+        handles = []
+        for actor_id in payload["actor_ids"]:
+            handle = by_actor.get(actor_id)
+            if handle is None:
+                raise rpc.RpcError(
+                    f"no live worker hosts actor {actor_id.hex()[:12]} "
+                    f"on node {self.node_name}")
+            handles.append((actor_id, handle))
+        pinned = {}
+        for actor_id, handle in handles:
+            handle.dag_pins.add(dag_id)
+            self._dag_pins.setdefault(dag_id, set()).add(
+                handle.worker_id.hex())
+            pinned[actor_id.hex()] = handle.worker_id.hex()
+        return pinned
+
+    @rpc.idempotent
+    async def rpc_dag_release_workers(self, conn, payload):
+        """Release every lease `dag_id` pinned on this node."""
+        dag_id = payload["dag_id"]
+        released = sorted(self._dag_pins.pop(dag_id, set()))
+        for handle in self.workers.values():
+            handle.dag_pins.discard(dag_id)
+        return released
+
+    @rpc.idempotent
+    async def rpc_dag_lease_accounting(self, conn, payload):
+        """{dag_id: [worker hexes]} of live pinned leases on this node."""
+        return {dag_id: sorted(ws)
+                for dag_id, ws in self._dag_pins.items() if ws}
+
     async def _on_worker_disconnect(self, worker_id: WorkerID):
         handle = self.workers.pop(worker_id, None)
         self._workers_by_hex.pop(worker_id.hex(), None)
@@ -1242,6 +1299,17 @@ class Raylet:
                 "worker died during actor construction"))
         if handle is None:
             return
+        if handle.dag_pins:
+            # The DAG's failure watcher surfaces the death to the driver;
+            # here the lease accounting must not leak a dead worker.
+            whex = handle.worker_id.hex()
+            for dag_id in list(handle.dag_pins):
+                pins = self._dag_pins.get(dag_id)
+                if pins is not None:
+                    pins.discard(whex)
+                    if not pins:
+                        self._dag_pins.pop(dag_id, None)
+            handle.dag_pins.clear()
         if not handle.registered:
             # Died during startup: it still counts against supply.
             self._starting_workers = max(0, self._starting_workers - 1)
@@ -1292,8 +1360,14 @@ class Raylet:
             fresh_floor = max(2, int(self.pool.total.get("CPU", 1)))
             for env_hash, pool in list(self._pools.pools.items()):
                 floor = self._pools.floor(env_hash, fresh_floor)
-                while len(pool) > floor:
-                    handle = pool.pop(0)
+                surplus = len(pool) - floor
+                if surplus <= 0:
+                    continue
+                # DAG-pinned workers are load-bearing pipeline state even
+                # if they ever land back in a pool: never reap them.
+                for handle in [h for h in list(pool)
+                               if not h.dag_pins][:surplus]:
+                    pool.remove(handle)
                     try:
                         if handle.conn:
                             await handle.conn.push("shutdown", {})

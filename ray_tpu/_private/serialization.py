@@ -357,3 +357,19 @@ def get_serialization_context() -> SerializationContext:
     if _default_context is None:
         _default_context = SerializationContext()
     return _default_context
+
+
+def context_for_process() -> SerializationContext:
+    """The live core worker's context when one exists, else the module
+    default. Out-of-task serializers (shm channels) must prefer the
+    core's context so contained ObjectRefs get the same handoff-credit /
+    borrower registration as the task path — the bare default context
+    would round-trip refs without refcounting."""
+    try:
+        from ray_tpu._private import worker_api
+        core = worker_api.peek_core()
+        if core is not None:
+            return core.serialization
+    except Exception:  # noqa: BLE001 — import cycle during teardown
+        pass
+    return get_serialization_context()

@@ -118,6 +118,11 @@ class StandardAutoscaler:
         # Provider nodes whose preemption notice already triggered a drain;
         # terminated (reaped) once the GCS no longer reports them alive.
         self._preempt_draining: Dict[str, float] = {}   # pid -> drain ts
+        # Noticed gang members with NO GCS registration: first sighting is
+        # recorded, provider-side terminate happens only on a LATER pass
+        # still unregistered — a member whose registration raced this
+        # pass's state snapshot keeps its graceful drain.
+        self._unregistered_notice: Dict[str, float] = {}  # pid -> first ts
 
     # ---------------- slice (gang) accounting ----------------
 
@@ -356,7 +361,32 @@ class StandardAutoscaler:
                     continue
                 nid = gcs_hex_of(member)
                 if not nid:
-                    continue  # not registered yet: a later pass retries
+                    # No GCS registration for a noticed gang member. One
+                    # retry pass first — a registration racing this
+                    # pass's state snapshot deserves its graceful drain;
+                    # a member STILL unregistered next pass never came
+                    # up (died during boot / preemption beat it): there
+                    # is nothing to drain, so reclaim the instance
+                    # provider-side — the old skip-forever path leaked
+                    # it (gcs_hex_of stays empty, so the drain path
+                    # never marks it and the reaper below never fires).
+                    if member not in self._unregistered_notice:
+                        self._unregistered_notice[member] = time.time()
+                        continue  # retry once: may register next pass
+                    try:
+                        self.provider.terminate_node(member)
+                    except Exception:  # noqa: BLE001 — cloud reclaimed it
+                        pass
+                    logger.warning(
+                        "autoscaler: preemption notice for %s, which "
+                        "never registered; terminated provider-side",
+                        member)
+                    self._unregistered_notice.pop(member, None)
+                    # Marked so the gang gate + reap loop see it handled;
+                    # the reap pass pops it once the provider confirms.
+                    self._preempt_draining[member] = time.time()
+                    continue
+                self._unregistered_notice.pop(member, None)
                 logger.warning(
                     "autoscaler: preemption notice for %s (gcs node %s%s); "
                     "draining", member, nid[:12],

@@ -2,7 +2,8 @@
 
 from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, FunctionNode,
                                   InputNode, MultiOutputNode)
-from ray_tpu.dag.compiled import CompiledDAG
+from ray_tpu.dag.compiled import CompiledDAG, DagRef
+from ray_tpu.exceptions import DagExecutionError
 
 __all__ = ["DAGNode", "InputNode", "FunctionNode", "ClassMethodNode",
-           "MultiOutputNode", "CompiledDAG"]
+           "MultiOutputNode", "CompiledDAG", "DagRef", "DagExecutionError"]

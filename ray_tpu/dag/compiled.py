@@ -1,23 +1,48 @@
-"""Compiled DAGs: the graph lowered onto persistent executors + mutable
-shared-memory channels.
+"""Compiled DAGs: pre-leased pipelines over reusable shm ring channels.
 
 Reference: python/ray/dag/compiled_dag_node.py:141 (CompiledDAG /
-CompiledTask). Instead of one task/actor RPC round trip per node per
-call (~1 ms each), compilation starts ONE long-running loop per executor
-that blocks on its input channels, runs its bound functions/methods, and
-writes output channels — execute() then costs one channel write + one
-read. All nodes bound to the same actor run inside a single loop (the
-reference runs an actor's compiled tasks on one executable loop too), so
-an actor is pinned by exactly one long-running task until teardown().
+CompiledTask) over the mutable-object channel layer. `compile()` pays
+every control-plane cost ONCE:
+
+  * executor actors are created (FunctionNodes) or adopted (actor
+    method nodes), their placements resolved, and their worker leases
+    PINNED at the hosting raylets for the DAG's lifetime (pinned
+    workers are excluded from OOM victim selection and the idle reaper
+    and show up in dag lease accounting until teardown);
+  * every edge gets a reusable channel — a multi-slot shm ring
+    (`experimental/channels.py`) when both endpoints share a node, the
+    KV/object-store fallback when the DAG spans raylets; ring depth =
+    pipelined ticks in flight (writer blocks when full = natural
+    backpressure);
+  * each participating actor is shipped ONE persistent `run_loop` task
+    that reads its input channels, calls the bound methods, and writes
+    downstream.
+
+`execute()` is then one input-channel write + one output-channel read —
+zero per-tick task RPCs — and `execute_async()` overlaps executions up
+to the channel depth. Executor death mid-tick surfaces as a typed
+`DagExecutionError` on the in-flight and all subsequent executes via a
+settled-ref watcher parked on the loop refs (push, not the old 1s-slice
+polling backstop); `teardown()` releases every pinned lease and unlinks
+every channel segment.
 """
 
 from __future__ import annotations
 
+import os
+import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, FunctionNode,
                                   InputNode, MultiOutputNode)
-from ray_tpu.experimental.channel import Channel, ChannelClosedError
+from ray_tpu.exceptions import DagExecutionError
+from ray_tpu.experimental.channel import ChannelClosedError
+from ray_tpu.experimental.channels import RingChannel, StoreChannel
+
+# How long a run loop waits on one read before re-checking channel
+# liveness; the read itself raises ChannelClosedError on close/orphan.
+_LOOP_READ_TIMEOUT_S = None
 
 
 class _DagError:
@@ -30,55 +55,62 @@ class _DagError:
 def _run_compiled_loop(fns: List, node_specs: List[tuple]):
     """One executor loop driving one or more compiled nodes.
 
-    node_specs[i] = (in_channels, arg_template, kw_template, out_channel)
+    node_specs[i] = (in_readers, arg_template, kw_template, out_writer)
     for fns[i], in topological order — intra-executor edges resolve
-    because the producer's channel was written earlier in the same pass.
-    pickle memoization can alias two in_channels entries to one attached
-    object; each distinct channel is read once per pass.
+    because the producer wrote its ring slot earlier in the same pass
+    and this node holds its own reader cursor on that channel.
     """
+    writers = [spec[3] for spec in node_specs]
+
+    def _close_all():
+        for w in writers:
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001 — teardown race
+                pass
+
     while True:
-        read_cache: Dict[int, Any] = {}
         closed = False
-        for fn, (in_channels, arg_t, kw_t, out_channel) in zip(fns,
-                                                               node_specs):
+        for fn, (in_readers, arg_t, kw_t, out_writer) in zip(fns,
+                                                             node_specs):
             if closed:
-                out_channel.close()
                 continue
             values = []
             try:
-                for ch in in_channels:
-                    if id(ch) not in read_cache:
-                        read_cache[id(ch)] = ch.read()
-                    values.append(read_cache[id(ch)])
+                for r in in_readers:
+                    values.append(r.read(timeout=_LOOP_READ_TIMEOUT_S))
             except ChannelClosedError:
-                out_channel.close()
+                _close_all()
                 closed = True
                 continue
             except Exception as e:  # noqa: BLE001 — a read error must
                 # surface to the caller as a typed result, never kill the
                 # loop silently: a dead loop leaves every later execute()
                 # spinning on an output channel nobody will write.
-                out_channel.write(_DagError(e))
-                read_cache[id(out_channel)] = _DagError(e)
+                try:
+                    out_writer.write(_DagError(e))
+                except ChannelClosedError:
+                    _close_all()
+                    closed = True
                 continue
             err = next((v for v in values if isinstance(v, _DagError)),
                        None)
             if err is not None:
-                out_channel.write(err)
-                read_cache[id(out_channel)] = err
-                continue
-            args = [values[i] if kind == "chan" else const
-                    for kind, i, const in arg_t]
-            kwargs = {key: (values[i] if kind == "chan" else const)
-                      for key, kind, i, const in kw_t}
+                result = err
+            else:
+                args = [values[i] if kind == "chan" else const
+                        for kind, i, const in arg_t]
+                kwargs = {key: (values[i] if kind == "chan" else const)
+                          for key, kind, i, const in kw_t}
+                try:
+                    result = fn(*args, **kwargs)
+                except Exception as e:  # noqa: BLE001
+                    result = _DagError(e)
             try:
-                result = fn(*args, **kwargs)
-            except Exception as e:  # noqa: BLE001
-                result = _DagError(e)
-            out_channel.write(result)
-            # Intra-executor consumers read the fresh value from cache
-            # (their reader cursor may lag the channel version).
-            read_cache[id(out_channel)] = result
+                out_writer.write(result)
+            except ChannelClosedError:
+                _close_all()
+                closed = True
         if closed:
             return "closed"
 
@@ -94,43 +126,116 @@ def _dag_loop_method(self, method_names: List[str], node_specs: List[tuple]):
 _EXECUTOR_OPTION_KEYS = ("num_cpus", "num_tpus", "num_gpus", "resources",
                          "scheduling_strategy", "runtime_env")
 
+_DRIVER = "__driver__"
+
+_tick_hist = None
+_inflight_gauge = None
+
+
+def _metric_handles():
+    global _tick_hist, _inflight_gauge
+    if _tick_hist is None:
+        from ray_tpu.util import metrics
+        _tick_hist = metrics.Histogram(
+            "ray_tpu_dag_tick_seconds",
+            "compiled-DAG per-tick latency (input write -> output read)",
+            boundaries=[0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                        0.01, 0.025, 0.05, 0.1, 0.5, 1.0, 5.0])
+        _inflight_gauge = metrics.Gauge(
+            "ray_tpu_dag_inflight_executions",
+            "compiled-DAG executions submitted but not yet collected")
+    return _tick_hist, _inflight_gauge
+
 
 class CompiledDAG:
-    def __init__(self, root: DAGNode, max_message_size: int = 1 << 20):
+    """Compile once, tick forever. See module docstring.
+
+    Lifecycle: `compile()` (or `dag.experimental_compile()`) acquires
+    channels + pinned leases + run loops; `execute()` /
+    `execute_async()` tick; `teardown()` releases everything —
+    scripts/check_dag_teardown.py statically enforces that every
+    acquisition has a release on the teardown AND the compile-error
+    path.
+    """
+
+    @classmethod
+    def compile(cls, dag: DAGNode, *, channel_depth: int = 2,
+                max_message_size: int = 1 << 20,
+                compile_timeout_s: float = 60.0) -> "CompiledDAG":
+        return cls(dag, max_message_size, channel_depth=channel_depth,
+                   compile_timeout_s=compile_timeout_s)
+
+    def __init__(self, root: DAGNode, max_message_size: int = 1 << 20,
+                 channel_depth: int = 2, compile_timeout_s: float = 60.0):
         self._root = root
         self._max_size = max_message_size
-        self._nodes = root._topo()
-        self._input_channel = Channel(max_message_size)
-        self._channels: Dict[int, Channel] = {}
+        self._depth = max(1, int(channel_depth))
+        self._dag_id = os.urandom(6).hex()
+        # Resource registries — initialized FIRST so teardown() is safe
+        # from any partial-compile state.
+        self._channels: List[Any] = []          # every created channel
         self._loop_refs: List[Any] = []
         self._executor_actors: List[Any] = []
+        self._pinned_raylets: List[str] = []
+        self._input_writers: List[Any] = []
+        self._output_readers: List[Any] = []
+        self._watcher = None
         self._torn_down = False
+        self._error: Optional[BaseException] = None
+        self._submit_lock = threading.Lock()
+        self._collect_lock = threading.Lock()
+        self._next_seq = 0
+        self._collected = 0
+        self._results: Dict[int, list] = {}
+        # Per-tick output-read resume state: values already drained from
+        # SOME output readers when a timeout interrupted the rest. The
+        # cursors of the drained readers advanced persistently, so a
+        # retrying collect must resume from here — re-reading would pair
+        # tick N+1's value from one reader with tick N's from another.
+        self._tick_buf: Dict[int, Any] = {}
+        self._submit_ts: Dict[int, float] = {}
+        self._inflight = 0
+        self.max_inflight = 0
+        self.ticks = 0
+        try:
+            t0 = time.time()
+            self._compile(compile_timeout_s)
+            self._export_span("dag:compile", t0, time.time())
+        except BaseException:
+            # Error-path release: whatever the partial compile acquired
+            # (channels, leases, executor actors) must not leak.
+            self.teardown()
+            raise
 
+    # ------------------------------------------------------------------
+    # Compilation
+    # ------------------------------------------------------------------
+    def _compile(self, compile_timeout_s: float):
+        from ray_tpu._private import worker_api
+
+        root = self._root
+        nodes = root._topo()
         multi = isinstance(root, MultiOutputNode)
         compute_nodes: List[DAGNode] = []
-        for node in self._nodes:
+        for node in nodes:
             if isinstance(node, InputNode):
-                self._channels[id(node)] = self._input_channel
-            elif isinstance(node, (FunctionNode, ClassMethodNode)):
-                self._channels[id(node)] = Channel(max_message_size)
+                continue
+            if isinstance(node, (FunctionNode, ClassMethodNode)):
                 compute_nodes.append(node)
             elif isinstance(node, MultiOutputNode):
                 if node is not root:
                     raise ValueError("MultiOutputNode must be the DAG root")
             else:
                 raise TypeError(f"cannot compile node {node!r}")
-        if multi:
-            self._output_channels = [self._channels[id(o)]
-                                     for o in root._bound_args]
-        else:
-            self._output_channels = [self._channels[id(root)]]
+        outputs = (list(root._bound_args) if multi else [root])
+        for o in outputs:
+            if not isinstance(o, (FunctionNode, ClassMethodNode)):
+                raise TypeError("DAG outputs must be compute nodes")
 
-        # Group nodes into executors: one per FunctionNode, one per ACTOR
-        # (all of an actor's nodes share a single loop; separate loops
-        # would deadlock on the actor's concurrency slot).
-        actor_groups: Dict[Any, List[ClassMethodNode]] = {}
+        # 1. One executor actor per FunctionNode; ClassMethodNodes adopt
+        # their user actor. All nodes of one actor share a single loop.
+        owner_of: Dict[int, Any] = {}          # id(node) -> actor handle
         for node in compute_nodes:
-            spec = self._node_spec(node)
             if isinstance(node, FunctionNode):
                 opts = {k: v for k, v in node._remote_fn._options.items()
                         if k in _EXECUTOR_OPTION_KEYS}
@@ -138,106 +243,399 @@ class CompiledDAG:
                     max_concurrency=1, **opts).remote(
                         node._remote_fn._function)
                 self._executor_actors.append(executor)
-                self._loop_refs.append(
-                    executor.run_loop.remote([spec]))
+                owner_of[id(node)] = executor
             else:
-                handle = node._actor_method._handle
-                actor_groups.setdefault(handle._actor_id, (handle, []))
-                actor_groups[handle._actor_id][1].append(node)
-        for handle, nodes in actor_groups.values():
-            from ray_tpu.actor import ActorMethod
-            loop_method = ActorMethod(handle, "__ray_tpu_dag_loop__")
-            self._loop_refs.append(loop_method.remote(
-                [n._actor_method._name for n in nodes],
-                [self._node_spec(n) for n in nodes]))
+                owner_of[id(node)] = node._actor_method._handle
 
-    def _node_spec(self, node: DAGNode) -> tuple:
-        in_channels: List[Channel] = []
-        arg_t: List[tuple] = []
-        kw_t: List[tuple] = []
+        # 2. Pin every participant's lease ONCE; placements come back
+        # with node ids, which drive the per-edge channel choice.
+        core = worker_api.get_core()
+        handles = {h._actor_id: h for h in owner_of.values()}
+        placements = worker_api._call_on_core_loop(
+            core, core.dag_pin_actors(self._dag_id, list(handles),
+                                      timeout_s=compile_timeout_s),
+            compile_timeout_s)
+        self._pinned_raylets = sorted(
+            {p["raylet"] for p in placements.values()})
+        driver_node = worker_api._call_on_core_loop(
+            core, core.local_node_id(), 30)
 
-        def wire(value):
-            if isinstance(value, DAGNode):
-                in_channels.append(self._channels[id(value)])
-                return ("chan", len(in_channels) - 1, None)
-            return ("const", -1, value)
+        def node_of(entity) -> Any:
+            if entity == _DRIVER:
+                return driver_node
+            return placements[entity]["node_id"]
 
-        for a in node._bound_args:
-            arg_t.append(wire(a))
-        for k, v in node._bound_kwargs.items():
-            kind, i, const = wire(v)
-            kw_t.append((k, kind, i, const))
-        if not in_channels:
-            # Const-only node: the input channel is its trigger, else the
-            # loop would spin hot and never observe teardown.
-            in_channels.append(self._input_channel)
-        return (in_channels, arg_t, kw_t, self._channels[id(node)])
+        def entity_of(node: DAGNode) -> Any:
+            return owner_of[id(node)]._actor_id
 
-    def execute(self, *args) -> Any:
-        """One synchronous pass through the pipeline: channel write + read."""
-        if self._torn_down:
-            raise RuntimeError("compiled DAG was torn down")
+        # 3. Edges: which NODES consume each produced value. Reader
+        # cursors are per consuming node (two nodes on one actor each
+        # hold their own cursor — a shared one would double-advance per
+        # tick); a node binding the same upstream twice (diamond) still
+        # collapses onto one cursor below. The input channel's consumers
+        # are every node reading InputNode plus const-only nodes (the
+        # input is their tick trigger — a triggerless loop would spin
+        # hot and never observe teardown).
+        consumers: Dict[int, List[DAGNode]] = {id(n): [] for n in nodes}
+        input_consumers: List[DAGNode] = []
+        for node in compute_nodes:
+            deps = node._deps()
+            if not deps or any(isinstance(d, InputNode) for d in deps):
+                input_consumers.append(node)
+            for dep in deps:
+                if not isinstance(dep, InputNode):
+                    consumers[id(dep)].append(node)
+
+        # 4. Create the channels. One producer each: the driver for the
+        # input channel, a node's hosting actor otherwise. A ring needs
+        # every endpoint on ONE node; any remote endpoint moves the whole
+        # edge to the KV/store fallback.
+        ch_index = 0
+
+        def place_of(consumer) -> Any:
+            if consumer is _DRIVER:
+                return driver_node
+            return node_of(entity_of(consumer))
+
+        def make_channel(writer_place, reader_list):
+            nonlocal ch_index
+            places = {writer_place}
+            places.update(place_of(r) for r in reader_list)
+            if len(places) == 1 and None not in places:
+                ch = RingChannel(self._max_size, self._depth,
+                                 len(reader_list))
+            else:
+                ch = StoreChannel(f"{self._dag_id}/{ch_index}",
+                                  self._depth, len(reader_list))
+            ch_index += 1
+            self._channels.append(ch)
+            return ch
+
+        def dedup(seq):
+            out, seen = [], set()
+            for x in seq:
+                if id(x) not in seen:
+                    seen.add(id(x))
+                    out.append(x)
+            return out
+
+        input_nodes_list = dedup(input_consumers)
+        input_channel = make_channel(driver_node, input_nodes_list)
+        input_reader_of = {id(n): input_channel.reader(i)
+                           for i, n in enumerate(input_nodes_list)}
+        out_channel_of: Dict[int, Any] = {}
+        reader_of: Dict[Tuple[int, int], Any] = {}
+        driver_readers: Dict[int, Any] = {}
+        for node in compute_nodes:
+            readers = dedup(consumers[id(node)])
+            if node in outputs:
+                readers = readers + [_DRIVER]
+            ch = make_channel(place_of(node), readers)
+            out_channel_of[id(node)] = ch
+            for i, consumer in enumerate(readers):
+                if consumer is _DRIVER:
+                    driver_readers[id(node)] = ch.reader(i)
+                else:
+                    reader_of[(id(node), id(consumer))] = ch.reader(i)
+
+        # 5. Node specs: per consumed value either a channel-read index
+        # or an inline constant; repeat reads collapse onto one reader.
+        def node_spec(node: DAGNode) -> tuple:
+            in_readers: List[Any] = []
+            reader_idx: Dict[Any, int] = {}
+
+            def wire(value):
+                if isinstance(value, InputNode):
+                    key, rd = "input", input_reader_of[id(node)]
+                elif isinstance(value, DAGNode):
+                    key, rd = id(value), reader_of[(id(value), id(node))]
+                else:
+                    return ("const", -1, value)
+                if key not in reader_idx:
+                    reader_idx[key] = len(in_readers)
+                    in_readers.append(rd)
+                return ("chan", reader_idx[key], None)
+
+            arg_t = [wire(a) for a in node._bound_args]
+            kw_t = []
+            for k, v in node._bound_kwargs.items():
+                kind, i, const = wire(v)
+                kw_t.append((k, kind, i, const))
+            if not in_readers:
+                in_readers.append(input_reader_of[id(node)])
+            writer = out_channel_of[id(node)]
+            if isinstance(writer, RingChannel):
+                writer = writer.writer()
+            return (in_readers, arg_t, kw_t, writer)
+
+        # 6. Ship ONE run loop per actor (an actor's nodes share it —
+        # separate loops would deadlock on the actor's concurrency slot).
+        groups: Dict[Any, Tuple[Any, List[DAGNode]]] = {}
+        for node in compute_nodes:
+            handle = owner_of[id(node)]
+            groups.setdefault(handle._actor_id, (handle, []))[1].append(node)
+        for handle, group_nodes in groups.values():
+            specs = [node_spec(n) for n in group_nodes]
+            if isinstance(group_nodes[0], FunctionNode):
+                self._loop_refs.append(handle.run_loop.remote(specs))
+            else:
+                from ray_tpu.actor import ActorMethod
+                loop_method = ActorMethod(handle, "__ray_tpu_dag_loop__")
+                self._loop_refs.append(loop_method.remote(
+                    [n._actor_method._name for n in group_nodes], specs))
+
+        # 7. Driver endpoints + the settled-ref failure watcher.
+        self._input_writers = [input_channel]
+        self._output_readers = [driver_readers[id(o)] for o in outputs]
+        self._multi = multi
+        self._arm_watcher(core)
+
+    # ------------------------------------------------------------------
+    # Failure watcher: push-based, parked on the loop refs
+    # ------------------------------------------------------------------
+    def _arm_watcher(self, core):
+        import asyncio
+
+        refs = list(self._loop_refs)
+
+        async def _watch():
+            # Any settled loop ref before teardown = dead executor: the
+            # loops only return once their channels close. get() digs
+            # out the cause (ActorDiedError / WorkerCrashedError / app
+            # failure in the loop plumbing).
+            done, _ = await core.wait_async(refs, num_returns=1,
+                                            timeout=None, fetch_local=False)
+            try:
+                await core.get_async([done[0]], 5)
+                return RuntimeError("executor loop exited before teardown")
+            except Exception as e:  # noqa: BLE001
+                return e
+
+        fut = asyncio.run_coroutine_threadsafe(_watch(), core.loop)
+
+        def _on_done(f):
+            if f.cancelled() or self._torn_down:
+                return
+            try:
+                cause = f.result()
+            except Exception as e:  # noqa: BLE001
+                cause = e
+            self._fail(DagExecutionError(
+                "compiled DAG executor died mid-tick", cause))
+
+        fut.add_done_callback(_on_done)
+        self._watcher = fut
+
+    def _fail(self, err: DagExecutionError):
+        """Mark the DAG failed and wake EVERY blocked channel end: the
+        in-flight execute raises typed instead of wedging, and so does
+        every subsequent one."""
+        if self._error is None:
+            self._error = err
+        for ch in self._channels:
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001 — teardown race
+                pass
+
+    # ------------------------------------------------------------------
+    # Execution
+    # ------------------------------------------------------------------
+    def execute(self, *args, timeout: Optional[float] = None) -> Any:
+        """One pipeline tick, synchronously: channel write + read."""
+        return self.execute_async(*args).result(timeout)
+
+    def execute_async(self, *args) -> "DagRef":
+        """Submit a tick without waiting for its output: overlapping
+        executions are bounded by the channel depth (the input write
+        blocks once `depth` ticks are in flight — backpressure, not an
+        error). A single-threaded caller must therefore collect results
+        at least every `channel_depth` submissions (see
+        StagePipeline.run for the windowed pattern); submitting
+        unboundedly ahead would block this write with nobody draining
+        the output rings."""
+        self._check_live()
         value = args[0] if len(args) == 1 else args
-        self._input_channel.write(value)
-        # Drain EVERY output before raising: an unread channel would hand
-        # this pass's value to the next execute() (stale-read hazard).
-        outs = [self._read_output(ch) for ch in self._output_channels]
+        with self._submit_lock:
+            self._check_live()
+            try:
+                for w in self._input_writers:
+                    w.write(value)
+            except ChannelClosedError:
+                self._raise_dead()
+            seq = self._next_seq
+            self._next_seq += 1
+            self._submit_ts[seq] = time.time()
+            self._inflight += 1
+            self.max_inflight = max(self.max_inflight, self._inflight)
+            try:
+                _, gauge = _metric_handles()
+                gauge.set(float(self._inflight))
+            except Exception:  # noqa: BLE001 — metrics never block ticks
+                pass
+        return DagRef(self, seq)
+
+    def _collect(self, seq: int, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._collect_lock:
+            if seq < self._collected and seq not in self._results:
+                raise ValueError(
+                    f"DagRef for tick {seq} was already consumed — "
+                    f"result() is one-shot")
+            while seq not in self._results:
+                if self._error is not None:
+                    raise self._error
+                remaining = None if deadline is None else \
+                    max(0.0, deadline - time.monotonic())
+                outs = []
+                try:
+                    # Drain EVERY output before the tick completes (an
+                    # unread channel would hand this tick's value to the
+                    # next collect); the same node bound twice in a
+                    # MultiOutputNode shares one reader — read it once.
+                    # Reads resume from _tick_buf after a timeout (their
+                    # cursors advanced persistently), and copy=True
+                    # detaches results from the ring slots the writer
+                    # will recycle `depth` ticks from now — callers may
+                    # hold results indefinitely.
+                    for r in self._output_readers:
+                        if id(r) not in self._tick_buf:
+                            self._tick_buf[id(r)] = r.read(
+                                timeout=remaining, copy=True)
+                        outs.append(self._tick_buf[id(r)])
+                    self._tick_buf.clear()
+                except ChannelClosedError:
+                    self._raise_dead()
+                done_seq = self._collected
+                self._collected += 1
+                self._results[done_seq] = outs
+                self._inflight -= 1
+                self.ticks += 1
+                t0 = self._submit_ts.pop(done_seq, None)
+                now = time.time()
+                try:
+                    hist, gauge = _metric_handles()
+                    if t0 is not None:
+                        hist.observe(now - t0)
+                    gauge.set(float(self._inflight))
+                except Exception:  # noqa: BLE001
+                    pass
+                if t0 is not None:
+                    self._export_span("dag:tick", t0, now,
+                                      only_if_traced=True)
+            outs = self._results.pop(seq)
         err = next((o for o in outs if isinstance(o, _DagError)), None)
         if err is not None:
             raise err.error
         return outs if len(outs) > 1 else outs[0]
 
-    def _read_output(self, ch) -> Any:
-        """Channel read with a liveness backstop: an executor whose loop
-        died (worker crash, failed actor creation) will never write this
-        channel — without the check, execute() spins on the seqlock
-        until some outer timeout kills the caller."""
-        while True:
-            try:
-                return ch.read(timeout=1.0)
-            except TimeoutError:
-                self._raise_if_executor_dead()
+    def _check_live(self):
+        if self._torn_down:
+            raise RuntimeError("compiled DAG was torn down")
+        if self._error is not None:
+            raise self._error
 
-    def _raise_if_executor_dead(self):
-        import ray_tpu
-        # timeout must be > 0: wait(timeout=0) returns before the ready
-        # probes get a single loop tick, i.e. it never reports anything
-        # done.
-        done, _pending = ray_tpu.wait(
-            list(self._loop_refs), num_returns=len(self._loop_refs),
-            timeout=0.2)
-        for ref in done:
-            # run_loop only returns at teardown: any settled ref here is
-            # a dead executor. get() re-raises its error (ActorDiedError,
-            # creation failure); a clean exit still means no writer.
-            ray_tpu.get(ref, timeout=5)
-            raise RuntimeError(
-                "compiled DAG executor loop exited before teardown")
+    def _raise_dead(self):
+        if self._error is not None:
+            raise self._error
+        if self._torn_down:
+            raise RuntimeError("compiled DAG was torn down")
+        raise DagExecutionError("compiled DAG channel closed unexpectedly")
 
+    def stats(self) -> dict:
+        return {"dag_id": self._dag_id, "ticks": self.ticks,
+                "inflight": self._inflight,
+                "max_inflight": self.max_inflight,
+                "channels": len(self._channels),
+                "pinned_raylets": list(self._pinned_raylets)}
+
+    # ------------------------------------------------------------------
+    # Teardown
+    # ------------------------------------------------------------------
     def teardown(self):
+        """Release every compile-time acquisition: close channels (run
+        loops exit), await the loops, release pinned leases, kill
+        executor actors, unlink every shm segment / KV record."""
         if self._torn_down:
             return
         self._torn_down = True
+        if self._watcher is not None:
+            self._watcher.cancel()
         import ray_tpu
-        self._input_channel.close()
+        # Close BEFORE waiting: a loop blocked mid-read anywhere in the
+        # pipeline only exits once its channels wake it.
+        for ch in self._channels:
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001
+                pass
         for ref in self._loop_refs:
             try:
                 ray_tpu.get(ref, timeout=10)
-            except Exception:
+            except Exception:  # noqa: BLE001 — dead executor: lease died
                 pass
+        try:
+            from ray_tpu._private import worker_api
+            core = worker_api.peek_core()
+            if core is not None and self._pinned_raylets:
+                worker_api._call_on_core_loop(
+                    core, core.dag_release(self._dag_id,
+                                           self._pinned_raylets), 30)
+        except Exception:  # noqa: BLE001 — cluster already down
+            pass
         for a in self._executor_actors:
             try:
                 ray_tpu.kill(a)
-            except Exception:
+            except Exception:  # noqa: BLE001
                 pass
-        for ch in self._channels.values():
-            ch.destroy()
+        for ch in self._channels:
+            try:
+                ch.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            _, gauge = _metric_handles()
+            gauge.set(0.0)
+        except Exception:  # noqa: BLE001
+            pass
 
     def __del__(self):
         try:
             self.teardown()
         except Exception:
             pass
+
+    # ------------------------------------------------------------------
+    def _export_span(self, name: str, start: float, end: float,
+                     only_if_traced: bool = False):
+        try:
+            from ray_tpu.util import tracing
+            if only_if_traced and not tracing.is_enabled():
+                return
+            from ray_tpu._private import flightrec
+            tracing.export_span(flightrec.span_event(
+                name, f"dag:{self._dag_id}", start, end))
+        except Exception:  # noqa: BLE001 — observability never blocks
+            pass
+
+
+class DagRef:
+    """Handle to one submitted tick; `result()` blocks for its outputs.
+    Outputs complete strictly in submission order (the pipeline is
+    FIFO), so collecting a later ref first also drains earlier ones."""
+
+    __slots__ = ("_dag", "_seq")
+
+    def __init__(self, dag: CompiledDAG, seq: int):
+        self._dag = dag
+        self._seq = seq
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        return self._dag._collect(self._seq, timeout)
+
+    def done(self) -> bool:
+        return self._seq in self._dag._results \
+            or self._seq < self._dag._collected
 
 
 _executor_cls = None

@@ -80,9 +80,14 @@ class DAGNode:
     def _execute_one(self, results, input_args, input_kwargs):
         raise NotImplementedError
 
-    def experimental_compile(self, max_message_size: int = 1 << 20):
+    def experimental_compile(self, max_message_size: int = 1 << 20,
+                             channel_depth: int = 2):
+        """Lower this graph onto pre-leased actors + reusable shm
+        channels (dag/compiled.py). `channel_depth` bounds how many
+        pipelined executions can be in flight at once."""
         from ray_tpu.dag.compiled import CompiledDAG
-        return CompiledDAG(self, max_message_size)
+        return CompiledDAG(self, max_message_size,
+                           channel_depth=channel_depth)
 
 
 class InputNode(DAGNode):

@@ -131,6 +131,33 @@ class NodeDrainedError(RayTpuError):
         return (NodeDrainedError, (self.node_id, self.reason))
 
 
+class DagExecutionError(RayTpuError):
+    """A compiled DAG can no longer execute: an executor loop / pinned
+    worker died mid-tick, or the pipeline was torn down underneath an
+    in-flight execute. Raised on the in-flight execute AND every
+    subsequent one — the DAG must be torn down and recompiled.
+
+    Application errors raised by a bound method are NOT wrapped in this;
+    they re-raise as themselves and the pipeline keeps ticking.
+    """
+
+    def __init__(self, reason: str = "compiled DAG executor died",
+                 cause: BaseException | None = None):
+        self.reason = reason
+        self.cause = cause
+        detail = f": {type(cause).__name__}: {cause}" if cause else ""
+        super().__init__(f"{reason}{detail}")
+
+    def __reduce__(self):
+        import pickle
+        cause = self.cause
+        try:
+            pickle.dumps(cause)
+        except Exception:
+            cause = RayTpuError(f"{type(self.cause).__name__}: {self.cause}")
+        return (DagExecutionError, (self.reason, cause))
+
+
 class RuntimeEnvSetupError(RayTpuError):
     pass
 

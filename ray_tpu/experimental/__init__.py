@@ -1,5 +1,9 @@
 """Experimental APIs (reference: python/ray/experimental/)."""
 
 from ray_tpu.experimental.channel import Channel, ChannelClosedError
+from ray_tpu.experimental.channels import (RingChannel, RingReader,
+                                           RingWriter, StoreChannel,
+                                           StoreReader)
 
-__all__ = ["Channel", "ChannelClosedError"]
+__all__ = ["Channel", "ChannelClosedError", "RingChannel", "RingReader",
+           "RingWriter", "StoreChannel", "StoreReader"]

@@ -1,0 +1,611 @@
+"""Multi-slot shared-memory ring channels: the compiled-DAG data plane.
+
+Grown out of `experimental/channel.py` (single-slot seqlock mutable
+object; reference: python/ray/experimental/channel.py:49 over
+src/ray/core_worker/experimental_mutable_object_manager.h). A
+`RingChannel` is a bounded ring of seqlock slots in ONE shared-memory
+segment:
+
+  * **bounded depth** — `depth` slots means `depth` pipelined ticks can
+    be in flight; the writer blocks when the slowest reader is `depth`
+    messages behind (natural backpressure, no unbounded buffering);
+  * **single writer, multi reader** — each reader owns a cursor slot in
+    the segment header, so N consumers of one producer progress
+    independently and the writer's window is bounded by the SLOWEST;
+  * **per-slot seqlock discipline** — every slot carries its own
+    [version, length] header; version `2*seq+1` marks a write in
+    flight, `2*seq+2` a completed write of message `seq`. Readers
+    re-check BOTH fields after the copy and treat an unpicklable
+    payload under a stable header as torn (bounded retries), exactly
+    the PR 7 torn-read discipline;
+  * **pickle-5 out-of-band payloads** — values are serialized with the
+    framework `SerializationContext` (same wire layout as the object
+    store), so numpy / host jax arrays land as out-of-band buffers
+    written straight into the slot and deserialize as ZERO-COPY views
+    onto the shared memory;
+  * **oversize + cross-node fallback** — a message that exceeds the
+    slot capacity ships as an object-store reference (`worker_api.put`)
+    with only the tiny ref crossing the ring, so the payload rides the
+    existing store transfer path (`store_fetch_remote` pulls it on a
+    remote node). A fully cross-node EDGE uses `StoreChannel`, which
+    runs the same protocol over the GCS KV + object store so a
+    compiled DAG can span raylets.
+
+Zero-copy caveat: a value read from a ring slot references the shared
+memory of that slot, which the writer reuses once every reader is
+`depth` messages past it — consume (or copy) the value before reading
+`depth` further messages. The compiled-DAG run loop consumes each value
+within its tick, so this never bites there.
+
+Segment names are `rtch_<creator-pid>_<rand>`; readers parse the
+creator pid for a liveness backstop (creator process gone + segment
+still mapped = orphaned pipeline: reads raise ChannelClosedError
+instead of spinning forever).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import time
+from typing import Any, List, Optional
+
+from ray_tpu._private.object_store import Arena, _attach_untracked
+from ray_tpu.experimental.channel import ChannelClosedError
+
+__all__ = ["RingChannel", "RingReader", "RingWriter", "StoreChannel",
+           "StoreReader", "ChannelClosedError", "local_segments"]
+
+MAGIC = 0x52544348  # "RTCH"
+_HEADER = struct.Struct("<IIQQQQ")   # magic, closed, depth, slot, n_readers, seq
+HEADER_SIZE = 64                     # _HEADER.size padded to a cache line
+_SLOT_HEADER = struct.Struct("<QQ")  # version, length
+
+_SEQ_OFF = 4 + 4 + 8 + 8 + 8         # byte offset of writer_seq in the header
+_CLOSED_OFF = 4                      # byte offset of the closed flag
+
+
+def _align(n: int, a: int = 64) -> int:
+    return (n + a - 1) // a * a
+
+
+def local_segments(prefix: str = "rtch_") -> List[str]:
+    """Names of live /dev/shm segments with `prefix` (teardown asserts)."""
+    try:
+        return sorted(n for n in os.listdir("/dev/shm")
+                      if n.startswith(prefix))
+    except OSError:
+        return []
+
+
+def _serialization_ctx():
+    from ray_tpu._private.serialization import context_for_process
+    return context_for_process()
+
+
+_full_counter = None
+
+
+def _note_channel_full() -> None:
+    """Count a write that had to block on a full channel (backpressure
+    engaging is normal; a high rate means the pipeline is depth-bound)."""
+    global _full_counter
+    if _full_counter is None:
+        from ray_tpu.util import metrics
+        _full_counter = metrics.Counter(
+            "ray_tpu_dag_channel_full_total",
+            "compiled-DAG channel writes that blocked on a full ring")
+    _full_counter.inc()
+
+
+class _OversizeRef:
+    """Marker for a payload that exceeded the slot: only the object-store
+    ref crosses the ring; the value rides the store (transfer) path."""
+
+    __slots__ = ("ref",)
+
+    def __init__(self, ref):
+        self.ref = ref
+
+
+def _resolve_payload(value):
+    if isinstance(value, _OversizeRef):
+        from ray_tpu._private import worker_api
+        return worker_api.get(value.ref, timeout=60)
+    return value
+
+
+class _RingBase:
+    """Layout math + attach shared by creator/writer/reader handles."""
+
+    def __init__(self, depth: int, slot_size: int, n_readers: int):
+        self.depth = int(depth)
+        self.slot_size = int(slot_size)
+        self.n_readers = int(n_readers)
+        self._cursor_off = HEADER_SIZE
+        self._slots_off = _align(HEADER_SIZE + 8 * self.n_readers)
+        self._slot_stride = _align(_SLOT_HEADER.size + self.slot_size)
+        self.total_size = self._slots_off + self.depth * self._slot_stride
+        self._buf = None
+        self.name = ""
+
+    # -- header accessors ---------------------------------------------
+    def _writer_seq(self) -> int:
+        return struct.unpack_from("<Q", self._buf, _SEQ_OFF)[0]
+
+    def _set_writer_seq(self, seq: int) -> None:
+        struct.pack_into("<Q", self._buf, _SEQ_OFF, seq)
+
+    def closed(self) -> bool:
+        return struct.unpack_from("<I", self._buf, _CLOSED_OFF)[0] != 0
+
+    def close(self) -> None:
+        """Mark the channel closed: blocked readers AND writers wake with
+        ChannelClosedError on their next spin. Idempotent, any-process."""
+        try:
+            struct.pack_into("<I", self._buf, _CLOSED_OFF, 1)
+        except (ValueError, TypeError):
+            pass  # segment already torn down
+
+    def _cursor(self, idx: int) -> int:
+        return struct.unpack_from("<Q", self._buf,
+                                  self._cursor_off + 8 * idx)[0]
+
+    def _set_cursor(self, idx: int, v: int) -> None:
+        struct.pack_into("<Q", self._buf, self._cursor_off + 8 * idx, v)
+
+    def _min_cursor(self) -> int:
+        off = self._cursor_off
+        buf = self._buf
+        return min(struct.unpack_from("<Q", buf, off + 8 * i)[0]
+                   for i in range(self.n_readers))
+
+    def _slot_view(self, seq: int):
+        base = self._slots_off + (seq % self.depth) * self._slot_stride
+        return base
+
+    # -- liveness backstop --------------------------------------------
+    def _creator_alive(self) -> bool:
+        """False once the creating process is gone AND the segment file
+        was unlinked (or the creator pid no longer exists): a reader
+        blocked on an orphaned pipeline must error out, not spin."""
+        if not os.path.isdir("/dev/shm"):
+            return True  # non-Linux: no cheap check; rely on close()
+        if not os.path.exists(f"/dev/shm/{self.name}"):
+            return False
+        try:
+            pid = int(self.name.split("_")[1])
+        except (IndexError, ValueError):
+            return True
+        return os.path.exists(f"/proc/{pid}")
+
+
+class RingChannel(_RingBase):
+    """Creator-side channel object (driver). Owns the segment lifetime;
+    hand `writer()` to the producer and `reader(i)` to each consumer."""
+
+    def __init__(self, slot_size: int = 1 << 20, depth: int = 2,
+                 n_readers: int = 1):
+        if depth < 1 or n_readers < 1:
+            raise ValueError("RingChannel needs depth >= 1, n_readers >= 1")
+        super().__init__(depth, slot_size, n_readers)
+        # The Arena (object_store.py) provides the untracked /dev/shm
+        # segment + warm-page machinery; one alloc spans the whole ring.
+        self._arena = Arena(self.total_size, name_prefix="rtch")
+        self.name = self._arena.name
+        self._buf = self._arena.shm.buf
+        _HEADER.pack_into(self._buf, 0, MAGIC, 0, self.depth,
+                          self.slot_size, self.n_readers, 0)
+        for i in range(self.n_readers):
+            self._set_cursor(i, 0)
+        for s in range(self.depth):
+            _SLOT_HEADER.pack_into(self._buf, self._slot_view(s), 0, 0)
+        self._writer = None
+        self._next_reader = 0
+
+    # The creator can act as the writer directly (input channels).
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        if self._writer is None:
+            self._writer = RingWriter(self.name, self.depth, self.slot_size,
+                                      self.n_readers, _attached=self)
+        self._writer.write(value, timeout)
+
+    def writer(self) -> "RingWriter":
+        return RingWriter(self.name, self.depth, self.slot_size,
+                          self.n_readers)
+
+    def reader(self, idx: Optional[int] = None) -> "RingReader":
+        if idx is None:
+            idx = self._next_reader
+            self._next_reader += 1
+        if not 0 <= idx < self.n_readers:
+            raise ValueError(f"reader index {idx} out of range "
+                             f"(n_readers={self.n_readers})")
+        return RingReader(self.name, self.depth, self.slot_size,
+                          self.n_readers, idx)
+
+    def destroy(self) -> None:
+        self.close()
+        self._buf = None
+        if self._writer is not None:
+            self._writer._buf = None
+        self._arena.destroy()
+
+    def __reduce__(self):
+        # A pickled channel crosses as a WRITER handle (the single-writer
+        # end); consumers must be handed explicit reader(i) objects.
+        return (RingWriter, (self.name, self.depth, self.slot_size,
+                             self.n_readers))
+
+
+class RingWriter(_RingBase):
+    """The single-writer end; picklable by segment name."""
+
+    def __init__(self, name: str, depth: int, slot_size: int,
+                 n_readers: int, _attached=None):
+        super().__init__(depth, slot_size, n_readers)
+        self.name = name
+        if _attached is not None:
+            self._seg = None
+            self._buf = _attached._buf
+        else:
+            self._seg = _attach_untracked(name)
+            self._buf = self._seg.buf
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        seq = self._writer_seq()
+        ser = _serialization_ctx().serialize(value)
+        if ser.total_size > self.slot_size:
+            # Oversize: park the payload in the object store (zero-copy
+            # shm put; remote readers pull via the store transfer path)
+            # and ring only the ref. The ref is kept alive writer-side
+            # until every reader's cursor passes this seq (see below).
+            from ray_tpu._private import worker_api
+            ref = worker_api.put(value)
+            ser = _serialization_ctx().serialize(_OversizeRef(ref))
+            if not hasattr(self, "_held_refs"):
+                self._held_refs = {}
+            self._held_refs[seq] = ref
+        deadline = None if timeout is None else time.monotonic() + timeout
+        blocked = False
+        spin = 0
+        while seq - self._min_cursor() >= self.depth:
+            if self.closed():
+                raise ChannelClosedError(self.name)
+            if not blocked:
+                blocked = True
+                _note_channel_full()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"channel write blocked on full ring for {timeout}s")
+            spin += 1
+            time.sleep(2e-3 if spin > 2000 else 5e-5)
+        if self.closed():
+            raise ChannelClosedError(self.name)
+        base = self._slot_view(seq)
+        _SLOT_HEADER.pack_into(self._buf, base, 2 * seq + 1, 0)
+        payload = self._buf[base + _SLOT_HEADER.size:
+                            base + _SLOT_HEADER.size + ser.total_size]
+        ser.write_to(payload)
+        _SLOT_HEADER.pack_into(self._buf, base, 2 * seq + 2, ser.total_size)
+        self._set_writer_seq(seq + 1)
+        # Drop refs every reader has consumed (oversize lifetime bound).
+        held = getattr(self, "_held_refs", None)
+        if held:
+            floor = self._min_cursor()
+            for s in [s for s in held if s < floor]:
+                del held[s]
+
+    def destroy(self) -> None:
+        if getattr(self, "_held_refs", None):
+            self._held_refs.clear()
+        if self._seg is not None:
+            try:
+                self._seg.close()
+            except Exception:  # noqa: BLE001 — zero-copy views may pin it
+                pass
+
+    def __reduce__(self):
+        return (RingWriter, (self.name, self.depth, self.slot_size,
+                             self.n_readers))
+
+
+class RingReader(_RingBase):
+    """One consumer's end: owns reader slot `idx`'s cursor."""
+
+    def __init__(self, name: str, depth: int, slot_size: int,
+                 n_readers: int, idx: int):
+        super().__init__(depth, slot_size, n_readers)
+        self.name = name
+        self.idx = idx
+        self._seg = _attach_untracked(name)
+        self._buf = self._seg.buf
+        self._local_cursor = self._cursor(idx)
+
+    def read(self, timeout: Optional[float] = None,
+             copy: bool = False) -> Any:
+        """Next message for THIS reader; blocks until the writer produces
+        it. Raises ChannelClosedError once the channel is closed and
+        drained (in-flight messages are still delivered first).
+
+        copy=False (default) deserializes zero-copy views onto the ring
+        slot — valid until the writer laps it, `depth` messages later.
+        copy=True detaches the payload first (one memcpy) so the value
+        may be held indefinitely — the right mode for consumers that
+        outlive the tick (the compiled DAG's driver-side output reads)."""
+        cursor = self._local_cursor
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spin = 0
+        next_liveness = time.monotonic() + 2.0
+        bad_count = 0
+        while True:
+            if self._writer_seq() > cursor:
+                base = self._slot_view(cursor)
+                version, length = _SLOT_HEADER.unpack_from(self._buf, base)
+                if version == 2 * cursor + 2 and length <= self.slot_size:
+                    payload = self._buf[base + _SLOT_HEADER.size:
+                                        base + _SLOT_HEADER.size + length]
+                    if copy:
+                        payload = memoryview(bytes(payload))
+                    try:
+                        value = _serialization_ctx().deserialize(payload)
+                    except Exception:
+                        # Stable header but an unpicklable payload: a torn
+                        # store resolves within nanoseconds — retry without
+                        # advancing; a payload that KEEPS failing is a
+                        # genuinely bad message (hostile/raw writer) and
+                        # must raise, not hang a timeout-less read (the
+                        # PR 7 discipline).
+                        bad_count += 1
+                        if bad_count >= 64:
+                            raise
+                        time.sleep(5e-5)
+                        continue
+                    v2, l2 = _SLOT_HEADER.unpack_from(self._buf, base)
+                    if v2 == version and l2 == length:   # no torn read
+                        value = _resolve_payload(value)
+                        self._local_cursor = cursor + 1
+                        self._set_cursor(self.idx, cursor + 1)
+                        return value
+                # Torn / lapped header: fall through and spin.
+            elif self.closed():
+                raise ChannelClosedError(self.name)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel read timed out ({timeout}s)")
+            # Backoff ladder: ~2k tight spins (~100 µs — long enough to
+            # cover a whole pipeline tick, so an ACTIVELY streaming
+            # reader wakes within nanoseconds of the write instead of a
+            # 50 µs+ sleep quantum per hop), then 50 µs naps, then 2 ms
+            # naps once clearly idle (don't burn a core forever).
+            spin += 1
+            if spin > 20000:
+                time.sleep(2e-3)
+                if time.monotonic() > next_liveness:
+                    next_liveness = time.monotonic() + 2.0
+                    if not self._creator_alive():
+                        raise ChannelClosedError(
+                            f"{self.name}: channel creator is gone")
+            elif spin > 2000:
+                time.sleep(5e-5)
+
+    def destroy(self) -> None:
+        try:
+            self._seg.close()
+        except Exception:  # noqa: BLE001 — zero-copy views may pin it
+            pass
+
+    def __reduce__(self):
+        return (RingReader, (self.name, self.depth, self.slot_size,
+                             self.n_readers, self.idx))
+
+
+# ---------------------------------------------------------------------------
+# Cross-node fallback: the same protocol over the GCS KV + object store.
+# ---------------------------------------------------------------------------
+
+_KV_NAMESPACE = "dagch"
+_INLINE_LIMIT = 64 << 10
+
+
+def _kv_put(key: str, value: bytes) -> None:
+    from ray_tpu._private import worker_api
+    worker_api.internal_kv_put(key.encode(), value, namespace=_KV_NAMESPACE)
+
+
+def _kv_get(key: str) -> Optional[bytes]:
+    from ray_tpu._private import worker_api
+    return worker_api.internal_kv_get(key.encode(), namespace=_KV_NAMESPACE)
+
+
+def _kv_del(key: str) -> None:
+    from ray_tpu._private import worker_api
+    worker_api.internal_kv_del(key.encode(), namespace=_KV_NAMESPACE)
+
+
+class StoreChannel:
+    """Cross-raylet channel: seq/cursor control rides the GCS KV; payloads
+    above the inline limit ride the object store, whose existing
+    chunked `store_fetch_remote` transfer moves them node to node.
+
+    Interface-compatible with RingChannel (write / reader(i).read /
+    close / destroy) so compiled DAGs pick per EDGE: shm ring when both
+    endpoints share a node, this when they don't. Per-message cost is a
+    couple of small KV round trips — the fallback trades latency for
+    spanning raylets; the zero-RPC tick claim applies to ring edges.
+    """
+
+    def __init__(self, channel_id: str, depth: int = 2, n_readers: int = 1,
+                 inline_limit: int = _INLINE_LIMIT):
+        self.channel_id = channel_id
+        self.depth = int(depth)
+        self.n_readers = int(n_readers)
+        self.inline_limit = int(inline_limit)
+        self._seq = 0
+        self._held_refs = {}
+        self._next_reader = 0
+        self._closed_local = False
+        self._gc_upto = 0
+
+    # -- keys ----------------------------------------------------------
+    def _mkey(self, seq: int) -> str:
+        return f"{self.channel_id}/m/{seq}"
+
+    def _ckey(self, idx: int) -> str:
+        return f"{self.channel_id}/c/{idx}"
+
+    def _closed_key(self) -> str:
+        return f"{self.channel_id}/closed"
+
+    def _min_cursor(self) -> int:
+        lo = None
+        for i in range(self.n_readers):
+            raw = _kv_get(self._ckey(i))
+            cur = int(raw) if raw else 0
+            lo = cur if lo is None else min(lo, cur)
+        return lo or 0
+
+    def closed(self) -> bool:
+        if self._closed_local:
+            return True
+        return _kv_get(self._closed_key()) is not None
+
+    # -- writer side ---------------------------------------------------
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        blocked = False
+        while self._seq - self._min_cursor() >= self.depth:
+            if self.closed():
+                raise ChannelClosedError(self.channel_id)
+            if not blocked:
+                blocked = True
+                _note_channel_full()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"channel write blocked on full window for {timeout}s")
+            time.sleep(0.02)
+        if self.closed():
+            raise ChannelClosedError(self.channel_id)
+        ser = _serialization_ctx().serialize(value)
+        if ser.total_size > self.inline_limit:
+            from ray_tpu._private import worker_api
+            ref = worker_api.put(value)
+            self._held_refs[self._seq] = ref
+            body = pickle.dumps(("r", ref), protocol=5)
+        else:
+            body = b"v" + ser.to_bytes()
+        _kv_put(self._mkey(self._seq), body)
+        self._seq += 1
+        floor = self._min_cursor()
+        for s in [s for s in self._held_refs if s < floor]:
+            del self._held_refs[s]
+        # Control records every reader consumed are GC'd exactly once.
+        for s in range(self._gc_upto, floor):
+            _kv_del(self._mkey(s))
+        self._gc_upto = max(self._gc_upto, floor)
+
+    def reader(self, idx: Optional[int] = None) -> "StoreReader":
+        if idx is None:
+            idx = self._next_reader
+            self._next_reader += 1
+        if not 0 <= idx < self.n_readers:
+            raise ValueError(f"reader index {idx} out of range")
+        return StoreReader(self.channel_id, self.depth, self.n_readers,
+                           idx)
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self) -> None:
+        self._closed_local = True
+        try:
+            import asyncio
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                loop = None
+            if loop is not None:
+                # On-loop caller (the DAG failure watcher runs on the
+                # core loop): the sync KV wrapper would deadlock here.
+                from ray_tpu._private import worker_api
+                core = worker_api.peek_core()
+                if core is not None:
+                    asyncio.ensure_future(worker_api.internal_kv_put_async(
+                        core, self._closed_key().encode(), b"1",
+                        namespace=_KV_NAMESPACE))
+                return
+            _kv_put(self._closed_key(), b"1")
+        except Exception:  # noqa: BLE001 — closing a dead cluster
+            pass
+
+    def destroy(self) -> None:
+        self._held_refs.clear()
+        try:
+            from ray_tpu._private import worker_api
+            for k in worker_api.internal_kv_keys(
+                    f"{self.channel_id}/".encode(), namespace=_KV_NAMESPACE):
+                worker_api.internal_kv_del(k, namespace=_KV_NAMESPACE)
+        except Exception:  # noqa: BLE001 — cluster already down
+            pass
+
+    def __reduce__(self):
+        # Crossing processes hands over the WRITER role (single-writer:
+        # the creator stops writing once it ships the channel, and it
+        # ships BEFORE the first write — seq restarts at 0). No KV probe
+        # here: unpickling happens on the receiving core loop, where a
+        # blocking KV round trip would deadlock.
+        return (StoreChannel,
+                (self.channel_id, self.depth, self.n_readers,
+                 self.inline_limit))
+
+
+class StoreReader:
+    """One consumer's end of a StoreChannel. The persisted cursor is
+    resolved lazily on the first read (never at unpickle time — that
+    runs on the receiver's event loop)."""
+
+    def __init__(self, channel_id: str, depth: int, n_readers: int,
+                 idx: int):
+        self.channel_id = channel_id
+        self.depth = depth
+        self.n_readers = n_readers
+        self.idx = idx
+        self._cursor: Optional[int] = None
+
+    def read(self, timeout: Optional[float] = None,
+             copy: bool = False) -> Any:
+        # `copy` accepted for interface parity with RingReader; KV/store
+        # payloads are already private bytes, never shared-slot views.
+        if self._cursor is None:
+            raw = _kv_get(f"{self.channel_id}/c/{self.idx}")
+            self._cursor = int(raw) if raw else 0
+        deadline = None if timeout is None else time.monotonic() + timeout
+        key = f"{self.channel_id}/m/{self._cursor}"
+        napped = 0
+        while True:
+            body = _kv_get(key)
+            if body is not None:
+                break
+            if _kv_get(f"{self.channel_id}/closed") is not None:
+                raise ChannelClosedError(self.channel_id)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel read timed out ({timeout}s)")
+            napped += 1
+            time.sleep(min(0.05, 0.005 * napped))
+        if body[:1] == b"v":
+            value = _serialization_ctx().deserialize(body[1:])
+        else:
+            kind, ref = pickle.loads(body)
+            from ray_tpu._private import worker_api
+            value = worker_api.get(ref, timeout=60)
+        self._cursor += 1
+        _kv_put(f"{self.channel_id}/c/{self.idx}", str(self._cursor).encode())
+        return value
+
+    def close(self) -> None:
+        pass
+
+    def destroy(self) -> None:
+        pass
+
+    def __reduce__(self):
+        return (StoreReader, (self.channel_id, self.depth, self.n_readers,
+                              self.idx))

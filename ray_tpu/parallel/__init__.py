@@ -7,5 +7,14 @@ from ray_tpu.parallel.sharding import (ShardingRules, ShardingStrategy,
 __all__ = [
     "MeshConfig", "build_mesh", "get_slice_info", "fake_mesh",
     "ShardingRules", "ShardingStrategy", "shard_params", "batch_sharding",
-    "strategy_from_name",
+    "strategy_from_name", "StagePipeline",
 ]
+
+
+def __getattr__(name):
+    # Lazy: StagePipeline pulls in the model stack via pipeline.py; the
+    # common mesh/sharding import path must not pay for it.
+    if name == "StagePipeline":
+        from ray_tpu.parallel.pipeline import StagePipeline
+        return StagePipeline
+    raise AttributeError(name)

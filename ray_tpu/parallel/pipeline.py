@@ -26,8 +26,29 @@ from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _shard_map_compat(f, mesh, in_specs, out_specs):
+    """shard_map across jax versions: the replication-check kwarg was
+    renamed check_rep -> check_vma; disable whichever this jax has (the
+    body mixes collectives manually — 0.4.x's rep inference rejects the
+    per-rank lax.cond branches)."""
+    import inspect
+    params = inspect.signature(_shard_map).parameters
+    kw = {}
+    if "check_vma" in params:
+        kw["check_vma"] = False
+    elif "check_rep" in params:
+        kw["check_rep"] = False
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)
 
 from ray_tpu.models.gpt import GPTConfig, _rmsnorm, _rope
 from ray_tpu.ops.attention import flash_attention, mha_reference
@@ -210,13 +231,97 @@ def make_gpt_pp_loss(cfg: GPTConfig, mesh: Mesh, num_microbatches: int):
              jax.tree_util.tree_flatten_with_path(stacked)[0]])
         lm_head = pp_params.get("lm_head", pp_params["embed"]["table"])
         tokens = batch["tokens"]
-        fn = shard_map(
+        fn = _shard_map_compat(
             body, mesh=mesh,
             in_specs=(stacked_specs, P(), P(), P(), P("data"), P("data")),
-            out_specs=P(),
-            check_vma=False)
+            out_specs=P())
         return fn(stacked, pp_params["embed"]["table"],
                   pp_params["final_norm"]["scale"], lm_head,
                   tokens[:, :-1], tokens[:, 1:])
 
     return loss_fn
+
+
+# ---------------------------------------------------------------------------
+# MPMD stage pipelines over the compiled-DAG substrate.
+#
+# The GPipe loss above is SPMD: one XLA program, ppermute over ICI. The
+# MPMD shape (PAPERS.md, arXiv:2412.14374) runs each stage as its OWN
+# program on its own slice/process, with activations crossing stages
+# through channels — which is exactly the compiled-DAG substrate: a
+# stage tick costs one shm channel write, not a task RPC round trip.
+# ---------------------------------------------------------------------------
+
+
+class StagePipeline:
+    """A linear chain of actor stages compiled onto reusable channels.
+
+    ``stages`` are live actor handles; each tick flows the input through
+    ``stage[0].method -> stage[1].method -> ...`` over pre-leased
+    workers and shm ring channels (one channel write per hop).
+    ``channel_depth`` microbatches can be in flight at once — the GPipe
+    bubble shrinks to (n_stages - 1) ticks, and backpressure from the
+    slowest stage bounds memory instead of an unbounded queue.
+
+    Usage::
+
+        pipe = StagePipeline([s0, s1, s2], method="apply", channel_depth=4)
+        outs = pipe.run(microbatches)      # pipelined map, order-preserving
+        pipe.teardown()                    # or `with StagePipeline(...)`
+    """
+
+    def __init__(self, stages, method: str = "__call__", *,
+                 channel_depth: int = 4, max_message_size: int = 1 << 20):
+        if not stages:
+            raise ValueError("StagePipeline needs at least one stage")
+        from ray_tpu.dag.compiled import CompiledDAG
+        from ray_tpu.dag.dag_node import InputNode
+        with InputNode() as inp:
+            node = inp
+            for handle in stages:
+                node = getattr(handle, method).bind(node)
+        self.n_stages = len(stages)
+        self.channel_depth = channel_depth
+        self._dag = CompiledDAG.compile(
+            node, channel_depth=channel_depth,
+            max_message_size=max_message_size)
+
+    def submit(self, value):
+        """Inject one microbatch; returns a DagRef. The input write
+        blocks once `channel_depth` ticks are in flight (backpressure) —
+        a single-threaded caller must collect at least every
+        `channel_depth` submissions or it deadlocks itself (run() does
+        the windowing for you)."""
+        return self._dag.execute_async(value)
+
+    def run(self, inputs, timeout: float = None):
+        """Pipelined map over `inputs`, outputs in input order.
+
+        Windowed submit/collect: at most `channel_depth` ticks stay
+        uncollected — that already keeps every stage busy (the rings
+        hold `depth` messages per edge), and submitting further ahead
+        from THIS thread would block the input write with nobody
+        draining outputs."""
+        from collections import deque
+        pending = deque()
+        out = []
+        for x in inputs:
+            if len(pending) >= self.channel_depth:
+                out.append(pending.popleft().result(timeout))
+            pending.append(self.submit(x))
+        while pending:
+            out.append(pending.popleft().result(timeout))
+        return out
+
+    def stats(self) -> dict:
+        return self._dag.stats()
+
+    def teardown(self):
+        self._dag.teardown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.teardown()
+        return False

@@ -238,10 +238,12 @@ class ServeController:
             self._apply_serve_config(cfg_rec)
         targets = {k: r for k, r in records.items()
                    if k.startswith(b"target/")}
+        apps = {k: r for k, r in records.items() if k.startswith(b"app/")}
         has_rows = any(k.startswith(b"replica/") for k in records)
-        if (not targets and not has_rows
+        if (not targets and not apps and not has_rows
                 and persistence.PROXIES_KEY not in records):
             return  # fresh cluster: nothing to recover
+        self._reconcile_app_snapshots(apps, targets, records)
         # Orphan replica rows with NO target (crash mid-delete) still
         # demand a recovery pass: target-less rows are killed + GC'd.
         self._recover_t0 = time.time()
@@ -288,6 +290,67 @@ class ServeController:
             "replica row(s), %d route(s) (recovery #%d)",
             len(targets), sum(len(v) for v in self._pending_reattach.values()),
             len(self._routes), self._recoveries_cum)
+
+    def _reconcile_app_snapshots(self, apps: dict, targets: dict,
+                                 records: dict) -> None:
+        """App-atomic recovery: the per-app snapshot blob (ONE KV value,
+        written before any per-deployment record) is authoritative for
+        app MEMBERSHIP and per-deployment VERSIONS. A crash between a
+        deploy's snapshot and its per-deployment writes leaves
+        stragglers: records missing or carrying the PREVIOUS version
+        adopt the snapshot's copy, and records for deployments the
+        snapshot no longer lists (a removal that crashed mid-way) are
+        dropped — never a cross-deployment version mix. Records whose
+        version matches keep their own target_num (scales after the
+        deploy are per-deployment state, not snapshot state)."""
+        for snap in apps.values():
+            try:
+                app = snap["app"]
+                snap_recs = {r["name"]: r
+                             for r in (snap.get("deployments") or [])}
+            except Exception:  # noqa: BLE001 — torn snapshot: skip
+                logger.exception("skipping unreadable app snapshot")
+                continue
+            for name, rec in snap_recs.items():
+                tkey = persistence.target_key(app, name)
+                cur = targets.get(tkey)
+                if cur is None or cur.get("version") != rec.get("version"):
+                    logger.warning(
+                        "app %s/%s: adopting snapshot record (crash "
+                        "mid-deploy left %s)", app, name,
+                        "no record" if cur is None else
+                        f"version {cur.get('version')!r}")
+                    targets[tkey] = dict(rec)
+                    try:
+                        self._persist.put_sync(tkey, dict(rec))
+                    except Exception:  # noqa: BLE001
+                        logger.debug("snapshot record re-persist failed",
+                                     exc_info=True)
+            prefix = f"target/{app}/".encode()
+            for tkey in [t for t in list(targets)
+                         if t.startswith(prefix)]:
+                if targets[tkey].get("name") not in snap_recs:
+                    targets.pop(tkey)
+                    try:
+                        self._persist.delete_sync(tkey)
+                    except Exception:  # noqa: BLE001
+                        logger.debug("stale target delete failed",
+                                     exc_info=True)
+            # Route binding rides the snapshot too: a crash before the
+            # ROUTES_KEY write must not leave the app unroutable.
+            rp, ingress = snap.get("route_prefix"), snap.get("ingress", "")
+            if rp:
+                routes_rec = records.get(persistence.ROUTES_KEY) or {}
+                routes = dict(routes_rec.get("routes") or {})
+                if routes.get(rp) != (app, ingress):
+                    routes[rp] = (app, ingress)
+                    records[persistence.ROUTES_KEY] = {"routes": routes}
+                    try:
+                        self._persist.put_sync(persistence.ROUTES_KEY,
+                                               {"routes": routes})
+                    except Exception:  # noqa: BLE001
+                        logger.debug("route re-persist failed",
+                                     exc_info=True)
 
     def _apply_serve_config(self, fields: dict) -> None:
         """Overlay persisted/operator ServeConfig fields onto defaults —
@@ -637,13 +700,12 @@ class ServeController:
 
     async def _deploy_app_locked(self, app_name, deployments, route_prefix,
                                  ingress):
-        # Write-ahead, per DEPLOYMENT: each target record (and the route
-        # table) lands in the KV before its in-memory state or replica
-        # effects publish, so every deployment recovers to exactly its
-        # old or its new record. A crash BETWEEN two records of one
-        # multi-deployment app can recover a cross-deployment version
-        # mix (each internally consistent) — re-running the deploy
-        # converges it; app-atomic snapshots are a ROADMAP follow-on.
+        # Write-ahead, app-atomic FIRST: one snapshot blob carrying every
+        # deployment's target record + the route binding lands in a
+        # single KV put before anything else. A crash between the per-
+        # deployment records below can no longer recover a cross-
+        # deployment version mix — _load_state reconciles stragglers
+        # against the snapshot.
         incoming: Dict[tuple, dict] = {}
         for d in deployments:
             # ONE record per deployment, persisted then applied: the KV
@@ -652,8 +714,13 @@ class ServeController:
                 app_name, d["name"], d["blob"], d["config"], d["version"],
                 d["config"].num_replicas)
             incoming[(app_name, d["name"])] = rec
+        await self._persist.put(
+            persistence.app_key(app_name),
+            persistence.app_snapshot_record(
+                app_name, list(incoming.values()), route_prefix, ingress))
+        for (_, name), rec in incoming.items():
             await self._persist.put(
-                persistence.target_key(app_name, d["name"]), rec)
+                persistence.target_key(app_name, name), rec)
         if route_prefix is not None:
             routes = dict(self._routes)
             routes[route_prefix] = (app_name, ingress)
@@ -679,6 +746,10 @@ class ServeController:
     async def delete_app(self, app_name: str):
         await self._ensure_loops()
         async with self._api_lock:
+            # Snapshot first: a crash mid-delete must recover to "app
+            # being removed", never resurrect deployments from a stale
+            # snapshot after their target records are gone.
+            await self._persist.delete(persistence.app_key(app_name))
             routes = {r: v for r, v in self._routes.items()
                       if v[0] != app_name}
             await self._persist.put(persistence.ROUTES_KEY,

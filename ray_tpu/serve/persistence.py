@@ -53,6 +53,9 @@ def target_key(app: str, deployment: str) -> bytes:
 def replica_key(app: str, deployment: str, replica_id: str) -> bytes:
     return f"replica/{app}/{deployment}/{replica_id}".encode()
 
+def app_key(app: str) -> bytes:
+    return f"app/{app}".encode()
+
 
 ROUTES_KEY = b"routes"
 PROXIES_KEY = b"proxies"
@@ -137,6 +140,15 @@ class ServeStateStore:
             "namespace": NAMESPACE, "key": key, "value": encode(record),
             "overwrite": True}))
 
+    def delete_sync(self, key: bytes) -> None:
+        """Constructor-context delete (recovery's app-snapshot reconcile
+        drops target records the snapshot says were being removed)."""
+        if self._core is None:
+            _local_store.pop(key, None)
+            return
+        self._sync(self._core.gcs.request("kv_del", {
+            "namespace": NAMESPACE, "key": key}))
+
     # ----------------------------------------------------- async face
     async def put(self, key: bytes, record: dict) -> None:
         """Write-ahead put: callers await this BEFORE publishing the
@@ -195,6 +207,19 @@ def target_record(app: str, name: str, blob: bytes, config: Any,
     return {"schema": SCHEMA_VERSION, "app": app, "name": name,
             "blob": blob, "config": config, "version": version,
             "target_num": int(target_num)}
+
+
+def app_snapshot_record(app: str, target_records: List[dict],
+                        route_prefix: Any, ingress: str) -> dict:
+    """ONE KV value describing a whole app deploy — every deployment's
+    target record plus the route binding, written atomically BEFORE the
+    per-deployment records. A controller crash between two per-
+    deployment writes of a multi-deployment app can no longer recover a
+    cross-deployment version mix: recovery reconciles stragglers against
+    this snapshot (the reference-style app checkpoint)."""
+    return {"schema": SCHEMA_VERSION, "app": app,
+            "deployments": [dict(r) for r in target_records],
+            "route_prefix": route_prefix, "ingress": ingress}
 
 
 def replica_record(app: str, deployment: str, replica_id: str,

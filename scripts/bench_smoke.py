@@ -271,6 +271,20 @@ def main() -> dict:
     except Exception as e:  # noqa: BLE001
         log(f"pg phase skipped: {type(e).__name__}: {e}")
 
+    # --- compiled-DAG phase: per-tick latency vs the .remote() chain ---
+    # A 3-stage actor pipeline compiled onto pre-leased workers + shm
+    # ring channels vs the same three actors chained through ordinary
+    # task RPCs. Records sequential per-tick latency, pipelined
+    # throughput at depth 4, the transport-frame delta across the ticks
+    # (the zero-per-tick-RPC proof), and the speedup RATIO — which is
+    # tier-1-asserted >= 3x (tests/test_bench_smoke.py): like the CB
+    # speedup, a same-box ratio is stable under CI load where absolute
+    # rates are not.
+    try:
+        out.update(_dag_phase())
+    except Exception as e:  # noqa: BLE001 — smoke must finish
+        log(f"compiled-DAG phase skipped: {type(e).__name__}: {e}")
+
     ray_tpu.shutdown()
 
     # --- launch storm: cold vs warm actor creation on a 3-node fake ---
@@ -415,6 +429,88 @@ def _serve_cb_phase() -> dict:
             serve.shutdown()
         except Exception:  # noqa: BLE001
             pass
+    return out
+
+
+def _dag_phase() -> dict:
+    import statistics
+
+    import ray_tpu
+    from ray_tpu._private import rpc
+    from ray_tpu.dag import InputNode
+    from ray_tpu.dag.compiled import CompiledDAG
+
+    # Fractional CPUs: the earlier phases' actors (callers/sinks) still
+    # hold whole-CPU leases; the pipeline stages must schedule anyway.
+    @ray_tpu.remote(num_cpus=0.01)
+    class Stage:
+        def __init__(self, off):
+            self.off = off
+
+        def apply(self, x):
+            return x + self.off
+
+    stages = [Stage.remote(1), Stage.remote(10), Stage.remote(100)]
+    with InputNode() as inp:
+        node = inp
+        for s in stages:
+            node = s.apply.bind(node)
+
+    out: dict = {}
+    compiled = CompiledDAG.compile(node, channel_depth=4)
+    try:
+        for i in range(10):                      # warm every hop
+            assert compiled.execute(i, timeout=60) == i + 111
+        n = 200
+        frames0 = rpc.transport_stats()["frames"]
+        per = []
+        for i in range(n):
+            t0 = time.perf_counter()
+            compiled.execute(i, timeout=60)
+            per.append(time.perf_counter() - t0)
+        out["dag_tick_rpc_frames"] = \
+            rpc.transport_stats()["frames"] - frames0
+        out["dag_tick_ms"] = round(statistics.median(per) * 1e3, 3)
+        out["dag_ticks_per_s"] = round(n / sum(per), 1)
+        # Pipelined: windowed submit/collect (submitting unboundedly
+        # ahead of collection from one thread would block the input
+        # write with nobody draining outputs — see StagePipeline.run).
+        from collections import deque
+        pending = deque()
+        t0 = time.perf_counter()
+        for i in range(n):
+            if len(pending) >= 4:
+                pending.popleft().result(timeout=60)
+            pending.append(compiled.execute_async(i))
+        while pending:
+            pending.popleft().result(timeout=60)
+        out["dag_pipelined_ticks_per_s"] = round(
+            n / (time.perf_counter() - t0), 1)
+        out["dag_max_inflight"] = compiled.stats()["max_inflight"]
+    finally:
+        compiled.teardown()
+
+    # Baseline: the same 3 actors chained through ordinary task RPCs.
+    s1, s2, s3 = stages
+    ray_tpu.get(s3.apply.remote(s2.apply.remote(s1.apply.remote(0))),
+                timeout=60)
+    per_b = []
+    for i in range(60):
+        t0 = time.perf_counter()
+        ray_tpu.get(
+            s3.apply.remote(s2.apply.remote(s1.apply.remote(i))),
+            timeout=60)
+        per_b.append(time.perf_counter() - t0)
+    out["dag_chain_baseline_ms"] = round(
+        statistics.median(per_b) * 1e3, 3)
+    out["dag_speedup"] = round(
+        out["dag_chain_baseline_ms"] / out["dag_tick_ms"], 2) \
+        if out.get("dag_tick_ms") else 0.0
+    log(f"compiled DAG: {out['dag_tick_ms']} ms/tick "
+        f"({out['dag_ticks_per_s']}/s seq, "
+        f"{out['dag_pipelined_ticks_per_s']}/s pipelined, "
+        f"{out['dag_tick_rpc_frames']} rpc frames/{200} ticks) vs chain "
+        f"{out['dag_chain_baseline_ms']} ms -> {out['dag_speedup']}x")
     return out
 
 

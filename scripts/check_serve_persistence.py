@@ -41,9 +41,20 @@ CONTROLLER = "ray_tpu/serve/controller.py"
 # persist_pattern must precede the FIRST match of effect_pattern.
 ORDERED_RULES = [
     ("ServeController", "_deploy_app_locked",
+     r"persistence\.app_key",
+     r"persistence\.target_key",
+     "deploy must persist the app-atomic snapshot blob before any "
+     "per-deployment record (a crash between records must reconcile "
+     "against ONE consistent app state)"),
+    ("ServeController", "_deploy_app_locked",
      r"self\._persist\.put\(\s*\n?\s*persistence\.target_key",
      r"self\._deployments\[",
      "deploy must persist every target record before mutating state"),
+    ("ServeController", "delete_app",
+     r"persistence\.app_key",
+     r"persistence\.ROUTES_KEY",
+     "delete must drop the app snapshot before anything else — a stale "
+     "snapshot would resurrect deployments on recovery"),
     ("ServeController", "_deploy_app_locked",
      r"persistence\.ROUTES_KEY",
      r"self\._routes\[",

@@ -45,14 +45,15 @@ def pytest_configure(config):
         "markers", "timeout(seconds): per-test wall-clock limit "
         f"(default {_DEFAULT_TEST_TIMEOUT:.0f}s)")
     # Killed runs leak plasma arenas (/dev/shm/rtpu_<pid>_*) — 4.3 GB
-    # piled up in one session and degraded a later full-suite run.
-    # Reap arenas whose creator pid is gone before this run starts.
+    # piled up in one session and degraded a later full-suite run —
+    # and compiled-DAG ring channels (rtch_<pid>_*, same name scheme).
+    # Reap segments whose creator pid is gone before this run starts.
     try:
         names = os.listdir("/dev/shm")
     except OSError:
         names = []
     for name in names:
-        if not name.startswith("rtpu_"):
+        if not name.startswith(("rtpu_", "rtch_")):
             continue
         try:
             pid = int(name.split("_")[1])

@@ -134,6 +134,81 @@ def test_slice_gang_scaling(ray_cluster):
     assert provider.non_terminated_nodes() == []
 
 
+class _StubProvider:
+    """Minimal in-memory provider for pure control-loop unit tests."""
+
+    def __init__(self, nodes):
+        self.nodes = {pid: dict(tags) for pid, tags in nodes.items()}
+        self.terminated = []
+        self.notices = []
+
+    def non_terminated_nodes(self):
+        return list(self.nodes)
+
+    def node_tags(self, pid):
+        return dict(self.nodes.get(pid, {}))
+
+    def terminate_node(self, pid):
+        self.nodes.pop(pid, None)
+        self.terminated.append(pid)
+
+    def preemption_notices(self):
+        return [p for p in self.notices if p in self.nodes]
+
+
+def test_preemption_terminates_never_registered_gang_member():
+    """PR 4 carry-over (ISSUE 12 satellite): a gang member that died
+    before ever registering with the GCS has nothing to drain — the
+    preemption pass must terminate it PROVIDER-side instead of skipping
+    it forever (the old 'a later pass retries' path leaked the
+    instance: gcs_hex_of stays empty for a node that never comes up)."""
+    from ray_tpu.autoscaler.autoscaler import (AutoscalerConfig,
+                                               StandardAutoscaler)
+
+    provider = _StubProvider({
+        "a": {"node_id": "aa", "node_type": "w"},
+        "b": {"node_type": "w"},          # never registered with the GCS
+    })
+    node_info = {"alive": True, "available": {"CPU": 1.0},
+                 "total": {"CPU": 1.0}, "labels": {}, "draining": False}
+    state = {"nodes": {"aa": dict(node_info)},
+             "pending_demand": [], "pending_placement_groups": []}
+    calls = []
+
+    def gcs_request(method, payload):
+        calls.append((method, payload))
+        return state if method == "get_autoscaler_state" else True
+
+    scaler = StandardAutoscaler(AutoscalerConfig.from_dict({}),
+                                provider, gcs_request)
+    gang = ("a", "b")
+    scaler._gang_of = {"a": gang, "b": gang}
+    provider.notices.append("a")
+
+    scaler.update()
+    # First pass: the registered member gets the graceful GCS drain; the
+    # unregistered one gets ONE retry pass (its registration may be
+    # racing the state snapshot — terminating immediately would forfeit
+    # the graceful drain for a live host).
+    assert any(m == "drain_node" and p["node_id_hex"] == "aa"
+               for m, p in calls)
+    assert "b" not in provider.terminated
+    scaler.update()
+    # Still unregistered on the second pass: it never came up — reclaim
+    # provider-side (the old skip-forever path leaked the instance).
+    assert "b" in provider.terminated
+    assert "a" not in provider.terminated
+
+    # Once the GCS reports the drained member dead, the reap pass
+    # terminates it too and all gang bookkeeping empties out.
+    state["nodes"]["aa"]["alive"] = False
+    scaler.update()
+    scaler.update()
+    assert "a" in provider.terminated
+    assert scaler._preempt_draining == {}
+    assert scaler._gang_of == {}
+
+
 def test_min_workers_maintained(ray_cluster):
     ray_cluster.connect()
     scaler, provider = _mk(ray_cluster, {

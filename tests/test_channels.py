@@ -1,0 +1,262 @@
+"""Ring/store channel concurrency tests (experimental/channels.py).
+
+Coverage per ISSUE 12's satellite list: multi-reader cursor isolation,
+writer-blocked backpressure, torn-read regression under a hostile
+writer loop, out-of-band numpy round trip asserting zero-copy, and the
+cross-node (KV + object store) fallback.
+"""
+
+import ctypes
+import os
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.experimental.channels import (ChannelClosedError, RingChannel,
+                                           RingReader, RingWriter,
+                                           StoreChannel, local_segments,
+                                           _SLOT_HEADER)
+
+
+class TestRingChannel:
+    def test_multi_reader_cursor_isolation(self):
+        """Two readers progress independently; neither sees skipped or
+        repeated messages and the slow one bounds the writer."""
+        ch = RingChannel(1 << 14, depth=4, n_readers=2)
+        try:
+            r0, r1 = ch.reader(0), ch.reader(1)
+            for i in range(3):
+                ch.write(i)
+            assert [r0.read(timeout=5) for _ in range(3)] == [0, 1, 2]
+            assert r1.read(timeout=5) == 0       # r1 lags at cursor 1
+            ch.write(3)
+            ch.write(4)                           # window: 5 - 1 = 4 full
+            with pytest.raises(TimeoutError):
+                ch.write(5, timeout=0.2)          # blocked on r1
+            assert [r1.read(timeout=5) for _ in range(4)] == [1, 2, 3, 4]
+            ch.write(5, timeout=5)                # window freed by r1
+            assert r0.read(timeout=5) == 3
+            assert r0.read(timeout=5) == 4
+            assert r0.read(timeout=5) == 5
+            assert r1.read(timeout=5) == 5
+        finally:
+            ch.destroy()
+
+    def test_writer_blocked_backpressure_unblocks(self):
+        """A writer blocked on a full ring resumes the moment the slow
+        reader advances (no lost or reordered messages)."""
+        ch = RingChannel(1 << 12, depth=2, n_readers=1)
+        try:
+            r = ch.reader(0)
+            ch.write("a")
+            ch.write("b")
+            done = []
+
+            def blocked_write():
+                ch.write("c", timeout=10)
+                done.append(time.monotonic())
+
+            t = threading.Thread(target=blocked_write)
+            t.start()
+            time.sleep(0.2)
+            assert not done, "write must block while the ring is full"
+            assert r.read(timeout=5) == "a"
+            t.join(5)
+            assert done, "write must unblock once the reader advances"
+            assert r.read(timeout=5) == "b"
+            assert r.read(timeout=5) == "c"
+        finally:
+            ch.destroy()
+
+    def test_close_wakes_blocked_writer_and_reader(self):
+        ch = RingChannel(1 << 12, depth=1, n_readers=1)
+        try:
+            r = ch.reader(0)
+            ch.write("x")
+            errs = []
+
+            def blocked_write():
+                try:
+                    ch.write("y", timeout=10)
+                except ChannelClosedError:
+                    errs.append("writer")
+
+            t = threading.Thread(target=blocked_write)
+            t.start()
+            time.sleep(0.1)
+            ch.close()
+            t.join(5)
+            assert errs == ["writer"]
+            # In-flight message still drains, THEN the reader raises.
+            assert r.read(timeout=5) == "x"
+            with pytest.raises(ChannelClosedError):
+                r.read(timeout=5)
+        finally:
+            ch.destroy()
+
+    def test_numpy_oob_zero_copy(self):
+        """An out-of-band numpy payload deserializes as a view ONTO the
+        channel's shared memory — same buffer, no copy."""
+        arr = np.arange(4096, dtype=np.float64)
+        ch = RingChannel(1 << 16, depth=2, n_readers=1)
+        try:
+            r = ch.reader(0)
+            ch.write(arr)
+            out = r.read(timeout=5)
+            assert np.array_equal(out, arr)
+            base = ctypes.addressof(ctypes.c_char.from_buffer(r._buf))
+            addr = out.__array_interface__["data"][0]
+            assert base <= addr < base + r.total_size, \
+                "deserialized array must map onto the channel segment"
+            # And it is NOT the writer's buffer.
+            assert addr != arr.__array_interface__["data"][0]
+        finally:
+            ch.destroy()
+
+    def test_torn_read_regression_hostile_writer(self):
+        """A hostile writer loop that mutates slots under torn windows
+        (odd seqlock version while the payload is half-written) must
+        never surface a corrupted value: every read either returns an
+        intact message or keeps spinning until the slot stabilizes."""
+        from ray_tpu._private.serialization import get_serialization_context
+        ctx = get_serialization_context()
+        ch = RingChannel(1 << 14, depth=2, n_readers=1)
+        try:
+            r = ch.reader(0)
+            n_msgs = 60
+            payloads = [ctx.serialize((i, bytes([i % 251]) * 2048))
+                        for i in range(n_msgs)]
+
+            def hostile():
+                buf = ch._buf
+                for seq in range(n_msgs):
+                    # Honor backpressure so the reader is never lapped...
+                    while seq - ch._min_cursor() >= ch.depth:
+                        time.sleep(1e-4)
+                    base = ch._slot_view(seq)
+                    ser = payloads[seq]
+                    # ...but write TORN: version goes odd, the payload
+                    # lands in two halves around a yield, garbage length
+                    # flickers in between, and only then does the final
+                    # even version commit.
+                    _SLOT_HEADER.pack_into(buf, base, 2 * seq + 1, 0)
+                    data = ser.to_bytes()
+                    half = len(data) // 2
+                    off = base + _SLOT_HEADER.size
+                    buf[off:off + half] = data[:half]
+                    _SLOT_HEADER.pack_into(buf, base, 2 * seq + 1,
+                                           len(data) * 3)
+                    time.sleep(0)
+                    buf[off + half:off + len(data)] = data[half:]
+                    _SLOT_HEADER.pack_into(buf, base, 2 * seq + 2,
+                                           len(data))
+                    ch._set_writer_seq(seq + 1)
+
+            t = threading.Thread(target=hostile)
+            t.start()
+            got = [r.read(timeout=30) for _ in range(n_msgs)]
+            t.join(10)
+            for i, (seq, blob) in enumerate(got):
+                assert seq == i
+                assert blob == bytes([i % 251]) * 2048, \
+                    f"message {i} surfaced torn"
+        finally:
+            ch.destroy()
+
+    def test_unpicklable_payload_raises_bounded(self):
+        """A stable-header payload that consistently fails to unpickle
+        is NOT a torn read: bounded retries, then raise — and the cursor
+        must not advance past it before the writer overwrites it."""
+        ch = RingChannel(1 << 12, depth=2, n_readers=1)
+        try:
+            r = ch.reader(0)
+            base = ch._slot_view(0)
+            garbage = b"\x80\x05 this is not a wire payload"
+            ch._buf[base + _SLOT_HEADER.size:
+                    base + _SLOT_HEADER.size + len(garbage)] = garbage
+            _SLOT_HEADER.pack_into(ch._buf, base, 2, len(garbage))
+            ch._set_writer_seq(1)
+            t0 = time.monotonic()
+            with pytest.raises(Exception) as ei:
+                r.read(timeout=30)
+            assert not isinstance(ei.value, TimeoutError)
+            assert time.monotonic() - t0 < 5
+        finally:
+            ch.destroy()
+
+    def test_handles_pickle_roundtrip_and_destroy_unlinks(self):
+        import pickle
+        ch = RingChannel(1 << 12, depth=2, n_readers=1)
+        name = ch.name
+        assert name in local_segments()
+        w = pickle.loads(pickle.dumps(ch.writer()))
+        r = pickle.loads(pickle.dumps(ch.reader(0)))
+        assert isinstance(w, RingWriter) and isinstance(r, RingReader)
+        w.write({"via": "pickled-writer"})
+        assert r.read(timeout=5) == {"via": "pickled-writer"}
+        r.destroy()
+        w.destroy()
+        ch.destroy()
+        assert name not in local_segments()
+
+    def test_oversize_payload_falls_back_to_object_store(self, ray_shared):
+        """A message over the slot capacity ships as an object-store ref
+        (the store transfer path), transparently to the reader."""
+        ch = RingChannel(1 << 12, depth=2, n_readers=1)  # 4 KiB slots
+        try:
+            r = ch.reader(0)
+            big = np.arange(1 << 16, dtype=np.float64)   # 512 KiB
+            ch.write(big)
+            out = r.read(timeout=30)
+            assert np.array_equal(out, big)
+        finally:
+            ch.destroy()
+
+
+class TestStoreChannel:
+    """The cross-node fallback: control via the GCS KV, big payloads via
+    the object store. Needs a live cluster."""
+
+    def test_roundtrip_backpressure_close(self, ray_shared):
+        ch = StoreChannel("testch/rt", depth=2, n_readers=1)
+        try:
+            r = ch.reader(0)
+            ch.write({"x": 1})
+            ch.write([2, 3])
+            with pytest.raises(TimeoutError):
+                ch.write("blocked", timeout=0.3)
+            assert r.read(timeout=10) == {"x": 1}
+            ch.write("third", timeout=10)
+            assert r.read(timeout=10) == [2, 3]
+            assert r.read(timeout=10) == "third"
+            ch.close()
+            with pytest.raises(ChannelClosedError):
+                r.read(timeout=10)
+        finally:
+            ch.destroy()
+
+    def test_large_payload_rides_object_store(self, ray_shared):
+        ch = StoreChannel("testch/big", depth=2, n_readers=1,
+                          inline_limit=1024)
+        try:
+            r = ch.reader(0)
+            big = np.arange(1 << 15, dtype=np.float64)
+            ch.write(big)
+            assert np.array_equal(r.read(timeout=30), big)
+        finally:
+            ch.destroy()
+
+    def test_destroy_gcs_records(self, ray_shared):
+        from ray_tpu._private import worker_api
+        ch = StoreChannel("testch/gc", depth=2, n_readers=1)
+        r = ch.reader(0)
+        ch.write("v")
+        assert r.read(timeout=10) == "v"
+        assert worker_api.internal_kv_keys(b"testch/gc/",
+                                           namespace="dagch")
+        ch.destroy()
+        assert not worker_api.internal_kv_keys(b"testch/gc/",
+                                               namespace="dagch")

@@ -251,6 +251,261 @@ class TestCompiledDAG:
         with pytest.raises(ValueError, match="positional"):
             dag.execute(x=5)
 
+class TestCompiledDagSubsystem:
+    """ISSUE 12 acceptance: pre-leased pipelines over ring channels."""
+
+    def _three_stage(self, ray_tpu):
+        @ray_tpu.remote
+        class Stage:
+            def __init__(self, off):
+                self.off = off
+
+            def apply(self, x):
+                return x + self.off
+
+        stages = [Stage.remote(1), Stage.remote(10), Stage.remote(100)]
+        with InputNode() as inp:
+            node = inp
+            for s in stages:
+                node = s.apply.bind(node)
+        return stages, node
+
+    @pytest.mark.timeout(120)
+    def test_zero_per_tick_rpcs(self, ray_shared):
+        """A 3-stage actor pipeline ticks with ZERO per-tick task RPCs:
+        the transport frame counter stays flat across hundreds of ticks
+        (background loops contribute O(1), not O(ticks))."""
+        from ray_tpu._private import rpc
+        from ray_tpu.dag.compiled import CompiledDAG
+        _stages, node = self._three_stage(ray_shared)
+        c = CompiledDAG.compile(node, channel_depth=2)
+        try:
+            for i in range(5):
+                assert c.execute(i) == i + 111
+            n = 300
+            frames0 = rpc.transport_stats()["frames"]
+            for i in range(n):
+                assert c.execute(i) == i + 111
+            delta = rpc.transport_stats()["frames"] - frames0
+            assert delta <= n * 0.05, \
+                f"{delta} transport frames across {n} ticks — the tick " \
+                f"path is paying RPCs"
+        finally:
+            c.teardown()
+
+    @pytest.mark.timeout(120)
+    def test_overlapping_executions_bounded_by_depth(self, ray_shared):
+        """execute_async overlaps ticks: with per-stage sleeps, k ticks
+        finish in pipelined (not serial) time, and >= 2 executions are
+        in flight at channel depth >= 2."""
+        @ray_shared.remote
+        class Slow:
+            def apply(self, x):
+                time.sleep(0.05)
+                return x + 1
+
+        stages = [Slow.remote(), Slow.remote(), Slow.remote()]
+        with InputNode() as inp:
+            node = inp
+            for s in stages:
+                node = s.apply.bind(node)
+        from ray_tpu.dag.compiled import CompiledDAG
+        c = CompiledDAG.compile(node, channel_depth=4)
+        try:
+            assert c.execute(0) == 3   # warm
+            k = 8
+            t0 = time.perf_counter()
+            refs = [c.execute_async(i) for i in range(k)]
+            outs = [r.result(timeout=30) for r in refs]
+            dt = time.perf_counter() - t0
+            assert outs == [i + 3 for i in range(k)]
+            serial = k * 3 * 0.05
+            assert dt < serial * 0.75, \
+                f"{dt:.2f}s for {k} ticks — no overlap (serial {serial:.2f}s)"
+            assert c.stats()["max_inflight"] >= 2
+        finally:
+            c.teardown()
+
+    @pytest.mark.timeout(120)
+    def test_worker_death_mid_tick_typed_and_teardown_clean(self,
+                                                            ray_start):
+        """Killing a pipeline worker mid-tick raises DagExecutionError on
+        the in-flight execute (fast — the settled-ref watcher, not a
+        polling backstop) and on every subsequent one; teardown then
+        releases every pinned lease and unlinks every segment."""
+        from ray_tpu._private import worker_api
+        from ray_tpu.dag.compiled import CompiledDAG
+        from ray_tpu.exceptions import DagExecutionError
+        from ray_tpu.experimental.channels import local_segments
+
+        @ray_start.remote
+        class Stage:
+            def __init__(self, off):
+                self.off = off
+
+            def apply(self, x):
+                if x == 999:
+                    time.sleep(60)
+                return x + self.off
+
+        stages = [Stage.remote(1), Stage.remote(10), Stage.remote(100)]
+        with InputNode() as inp:
+            node = inp
+            for s in stages:
+                node = s.apply.bind(node)
+        c = CompiledDAG.compile(node, channel_depth=2)
+        raylet = worker_api._state.head.raylet
+        assert c._dag_id in raylet._dag_pins
+        assert len(raylet._dag_pins[c._dag_id]) == 3
+        seg_names = [ch.name for ch in c._channels if hasattr(ch, "name")]
+        assert set(seg_names) <= set(local_segments())
+        try:
+            assert c.execute(0) == 111
+            ref = c.execute_async(999)   # stage 1 wedges mid-tick
+            time.sleep(0.2)
+            ray_start.kill(stages[0])
+            t0 = time.monotonic()
+            with pytest.raises(DagExecutionError):
+                ref.result(timeout=60)
+            assert time.monotonic() - t0 < 30, "liveness window blown"
+            with pytest.raises(DagExecutionError):
+                c.execute(1)
+        finally:
+            c.teardown()
+        # Lease accounting drained + every shm segment unlinked.
+        assert c._dag_id not in raylet._dag_pins
+        assert not any(h.dag_pins for h in raylet.workers.values())
+        assert not set(seg_names) & set(local_segments())
+
+    @pytest.mark.timeout(120)
+    def test_compile_error_path_releases(self, ray_shared):
+        """A compile that fails after acquiring resources must release
+        them (channels + pinned leases) — the error-path teardown."""
+        from ray_tpu._private import worker_api
+        from ray_tpu.dag.compiled import CompiledDAG
+        from ray_tpu.experimental.channels import local_segments
+
+        @ray_shared.remote
+        class Stage:
+            def apply(self, x):
+                return x
+
+        s = Stage.remote()
+        with InputNode() as inp:
+            dag = s.apply.bind(inp)
+        segs0 = set(local_segments())
+        raylet = worker_api._state.head.raylet
+        pins0 = {d for d, w in raylet._dag_pins.items() if w}
+
+        class _Boom(CompiledDAG):
+            def _arm_watcher(self, core):
+                raise RuntimeError("injected compile failure")
+
+        with pytest.raises(RuntimeError, match="injected"):
+            _Boom(dag)
+        assert {d for d, w in raylet._dag_pins.items() if w} == pins0
+        assert set(local_segments()) == segs0
+
+    @pytest.mark.timeout(120)
+    def test_stage_pipeline_proof_workload(self, ray_shared):
+        """parallel.pipeline.StagePipeline: the MPMD stage graph compiled
+        onto the substrate — pipelined map, order preserved."""
+        from ray_tpu.parallel.pipeline import StagePipeline
+
+        @ray_shared.remote
+        class Stage:
+            def __init__(self, tag):
+                self.tag = tag
+
+            def apply(self, x):
+                return x + [self.tag]
+
+        stages = [Stage.remote(t) for t in ("a", "b", "c")]
+        with StagePipeline(stages, method="apply",
+                           channel_depth=4) as pipe:
+            outs = pipe.run([[i] for i in range(10)], timeout=30)
+            assert outs == [[i, "a", "b", "c"] for i in range(10)]
+            assert pipe.stats()["ticks"] == 10
+
+    @pytest.mark.timeout(60)
+    def test_multi_output_timeout_resumes_aligned(self, ray_shared):
+        """A result() timeout that interrupted a PARTIAL output drain
+        (fast branch read, slow branch pending) must resume — not
+        re-read the fast branch, which would pair tick N+1's fast value
+        with tick N's slow one forever after."""
+        @ray_shared.remote
+        def fast(x):
+            return ("fast", x)
+
+        @ray_shared.remote
+        def slow(x):
+            time.sleep(0.8)
+            return ("slow", x)
+
+        with InputNode() as inp:
+            dag = MultiOutputNode([fast.bind(inp), slow.bind(inp)])
+        from ray_tpu.dag.compiled import CompiledDAG
+        c = CompiledDAG.compile(dag, channel_depth=2)
+        try:
+            ref = c.execute_async(1)
+            with pytest.raises(TimeoutError):
+                ref.result(timeout=0.15)   # fast read, slow timed out
+            assert ref.result(timeout=30) == [("fast", 1), ("slow", 1)]
+            assert c.execute(2, timeout=30) == [("fast", 2), ("slow", 2)]
+        finally:
+            c.teardown()
+
+    @pytest.mark.timeout(60)
+    def test_result_is_one_shot_and_detached(self, ray_shared):
+        """result() twice raises instead of wedging, and a HELD result
+        array survives the writer recycling its ring slot (driver-side
+        reads copy out of the ring)."""
+        import numpy as np
+
+        @ray_shared.remote
+        def ident(x):
+            return x
+
+        with InputNode() as inp:
+            dag = ident.bind(inp)
+        from ray_tpu.dag.compiled import CompiledDAG
+        c = CompiledDAG.compile(dag, channel_depth=2)
+        try:
+            ref = c.execute_async(np.full(2048, 7.0))
+            held = ref.result(timeout=30)
+            with pytest.raises(ValueError, match="already consumed"):
+                ref.result(timeout=5)
+            for i in range(6):   # lap every ring slot
+                c.execute(np.full(2048, float(i)), timeout=30)
+            assert (held == 7.0).all(), "held result was recycled"
+        finally:
+            c.teardown()
+
+    @pytest.mark.timeout(60)
+    def test_compiled_dag_metrics_and_span(self, ray_shared):
+        """dag:compile span exported; tick histogram/in-flight gauge
+        update (the observability satellite of the subsystem)."""
+        from ray_tpu.dag.compiled import CompiledDAG
+        from ray_tpu.util import metrics as _metrics
+
+        @ray_shared.remote
+        def ident(x):
+            return x
+
+        with InputNode() as inp:
+            dag = ident.bind(inp)
+        c = CompiledDAG.compile(dag)
+        try:
+            for i in range(3):
+                assert c.execute(i) == i
+            snap = {m["name"]: m for m in _metrics.snapshot()}
+            assert snap["ray_tpu_dag_tick_seconds"]["count"] >= 3
+            assert "ray_tpu_dag_inflight_executions" in snap
+        finally:
+            c.teardown()
+
+
+class TestCompiledDagLatency:
     @pytest.mark.timeout(60)
     def test_compiled_latency_beats_task_path(self, ray_shared):
         """The channel hand-off must be much cheaper than a task RPC.

@@ -235,6 +235,7 @@ def test_target_state_and_registry_persisted(serve_cluster):
 
     ks = keys()
     assert b"target/persist1/P" in ks
+    assert b"app/persist1" in ks        # the app-atomic snapshot blob
     assert b"routes" in ks
     replica_rows = [k for k in ks if k.startswith(b"replica/persist1/P/")]
     assert len(replica_rows) == 2, ks
@@ -269,7 +270,8 @@ def test_target_state_and_registry_persisted(serve_cluster):
     left = None
     while time.time() < deadline:
         left = [k for k in keys() if k.startswith(b"target/persist1/")
-                or k.startswith(b"replica/persist1/")]
+                or k.startswith(b"replica/persist1/")
+                or k == b"app/persist1"]
         if not left:
             break
         time.sleep(0.2)
@@ -483,6 +485,58 @@ def test_persistence_local_fallback_roundtrip():
         assert await store.get(persistence.target_key("a", "d")) is None
 
     asyncio.run(run())
+    persistence._local_store.clear()
+
+
+def test_app_snapshot_reconcile_units():
+    """App-atomic recovery (ISSUE 12 satellite): a crash between the
+    per-deployment records of one multi-deployment deploy recovers to
+    the SNAPSHOT's state — stragglers adopt it, removed deployments
+    drop, the route binding heals — never a cross-deployment mix."""
+    from ray_tpu.serve import persistence
+    from ray_tpu.serve.controller import ServeController
+
+    persistence._local_store.clear()
+    store = persistence.ServeStateStore()
+    ctrl = ServeController.__new__(ServeController)
+    ctrl._persist = store
+
+    def rec(name, version, target_num=1):
+        return persistence.target_record("app1", name, b"blob", None,
+                                         version, target_num)
+
+    # Deploy of v2 crashed after the snapshot + deployment "a"'s record:
+    # "b" still carries v1 (scaled to 3 meanwhile), "old" was removed by
+    # the v2 deploy but its record survived, and the route write never
+    # happened.
+    snap = persistence.app_snapshot_record(
+        "app1", [rec("a", "v2"), rec("b", "v2")], "/app1", "a")
+    targets = {
+        persistence.target_key("app1", "a"): rec("a", "v2"),
+        persistence.target_key("app1", "b"): rec("b", "v1", target_num=3),
+        persistence.target_key("app1", "old"): rec("old", "v1"),
+    }
+    records = {}
+    ctrl._reconcile_app_snapshots({persistence.app_key("app1"): snap},
+                                  targets, records)
+    assert targets[persistence.target_key("app1", "a")]["version"] == "v2"
+    assert targets[persistence.target_key("app1", "b")]["version"] == "v2"
+    assert persistence.target_key("app1", "old") not in targets
+    assert records[persistence.ROUTES_KEY]["routes"]["/app1"] == \
+        ("app1", "a")
+    # The adopted records were re-persisted; the stale one deleted.
+    assert persistence.decode(persistence._local_store[
+        persistence.target_key("app1", "b")])["version"] == "v2"
+    assert persistence.target_key("app1", "old") not in \
+        persistence._local_store
+
+    # Matching versions keep their own target_num (a scale AFTER the
+    # deploy is per-deployment state the snapshot must not roll back).
+    targets2 = {persistence.target_key("app1", "a"): rec("a", "v2", 5),
+                persistence.target_key("app1", "b"): rec("b", "v2", 2)}
+    ctrl._reconcile_app_snapshots({persistence.app_key("app1"): snap},
+                                  targets2, {})
+    assert targets2[persistence.target_key("app1", "a")]["target_num"] == 5
     persistence._local_store.clear()
 
 
