@@ -89,7 +89,10 @@ def _function_sources(path: str):
     return out, text
 
 
-def check() -> list:
+def check(extra_dispatch_dirs=()) -> list:
+    """Run all checks; extra_dispatch_dirs are additionally scanned for
+    raw replica dispatch (lets tests plant rogue fixtures in a tmp dir
+    instead of the real package)."""
     problems = []
     cache = {}
     for rel, cls, fn, patterns, why in RULES:
@@ -115,24 +118,27 @@ def check() -> list:
                     f"{rel}:{lineno}: {cls}.{fn} does not match "
                     f"/{pat}/ — {why}")
     # No raw replica dispatch outside the forwarding submitters.
-    serve_dir = os.path.join(REPO, "ray_tpu", "serve")
-    for fname in sorted(os.listdir(serve_dir)):
-        if not fname.endswith(".py"):
-            continue
-        rel = f"ray_tpu/serve/{fname}"
-        path = os.path.join(serve_dir, fname)
-        try:
-            funcs, _text = cache.get(rel) or _function_sources(path)
-        except (OSError, SyntaxError):
-            continue
-        for (cls, fn), (src, lineno) in funcs.items():
-            if (rel, fn) in _DISPATCH_ALLOWED:
+    scan_dirs = [os.path.join(REPO, "ray_tpu", "serve")]
+    scan_dirs.extend(extra_dispatch_dirs)
+    for serve_dir in scan_dirs:
+        for fname in sorted(os.listdir(serve_dir)):
+            if not fname.endswith(".py"):
                 continue
-            if _RAW_DISPATCH.search(src):
-                problems.append(
-                    f"{rel}:{lineno}: {cls}.{fn} dispatches to a replica "
-                    f"directly — route through DeploymentHandle._submit/"
-                    f"_submit_stream so the request trace is forwarded")
+            path = os.path.join(serve_dir, fname)
+            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+            try:
+                funcs, _text = cache.get(rel) or _function_sources(path)
+            except (OSError, SyntaxError):
+                continue
+            for (cls, fn), (src, lineno) in funcs.items():
+                if (rel, fn) in _DISPATCH_ALLOWED:
+                    continue
+                if _RAW_DISPATCH.search(src):
+                    problems.append(
+                        f"{rel}:{lineno}: {cls}.{fn} dispatches to a "
+                        f"replica directly — route through "
+                        f"DeploymentHandle._submit/_submit_stream so the "
+                        f"request trace is forwarded")
     return problems
 
 

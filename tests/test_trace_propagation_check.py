@@ -45,19 +45,17 @@ def test_checker_detects_renamed_entry_point(monkeypatch):
     assert any("_handle_conn_v2 not found" in p for p in problems)
 
 
-def test_checker_flags_raw_replica_dispatch(tmp_path, monkeypatch):
+def test_checker_flags_raw_replica_dispatch(tmp_path):
     """Dispatching handle_request.remote() outside the forwarding
-    submitters is flagged (the trace would be silently dropped)."""
+    submitters is flagged (the trace would be silently dropped).  The
+    rogue fixture is planted in tmp_path — never the real package dir,
+    where an interrupted run would leak it into the checkout."""
     checker = _load_checker()
-    serve_dir = os.path.join(checker.REPO, "ray_tpu", "serve")
-    rogue = os.path.join(serve_dir, "_rogue_dispatch_test.py")
-    with open(rogue, "w", encoding="utf-8") as f:
-        f.write("class Rogue:\n"
-                "    def go(self, replica):\n"
-                "        return replica.handle_request.remote('m')\n")
-    try:
-        problems = checker.check()
-        assert any("_rogue_dispatch_test.py" in p
-                   and "directly" in p for p in problems)
-    finally:
-        os.remove(rogue)
+    rogue = tmp_path / "_rogue_dispatch_test.py"
+    rogue.write_text("class Rogue:\n"
+                     "    def go(self, replica):\n"
+                     "        return replica.handle_request.remote('m')\n",
+                     encoding="utf-8")
+    problems = checker.check(extra_dispatch_dirs=[str(tmp_path)])
+    assert any("_rogue_dispatch_test.py" in p
+               and "directly" in p for p in problems)
