@@ -415,6 +415,10 @@ class NodeInfo:
     last_heartbeat: float = field(default_factory=time.time)
     # TPU topology: slice name / topology this host belongs to, if any.
     slice_id: str = ""
+    # DCN locality domain (pod / cloud zone): migration off a draining
+    # slice prefers replacement nodes with a MATCHING zone so the moved
+    # gang / compiled DAG keeps its cross-slice traffic on-fabric.
+    zone: str = ""
     hostname: str = "localhost"
     # Warm worker-pool depth per runtime-env hash ("" = fresh), synced by
     # the raylet heartbeat: the GCS creation pipeline routes launch
@@ -448,6 +452,10 @@ class ActorInfo:
     preempted_restarts: int = 0
     max_restarts: int = 0
     death_cause: str = ""
+    # Transient migration hint: the zone of the node this actor is being
+    # drained off — replacement placement prefers a matching-zone node
+    # (multi-slice DCN locality). Cleared once the actor lands.
+    prefer_zone: str = ""
     owner_address: str = ""
     creation_spec: Optional[TaskSpec] = None
     resources: Dict[str, float] = field(default_factory=dict)

@@ -770,6 +770,7 @@ class CoreWorker:
                     "node_id": node_ids[0] if node_ids else None,
                     "node_ids": node_ids,
                     "slice_id": msg.get("slice_id", ""),
+                    "dag_ids": msg.get("dag_ids") or [],
                     "deadline": msg.get("deadline", 0.0)})
                 for a in addrs:
                     self._draining_raylets.add(a)
@@ -783,6 +784,7 @@ class CoreWorker:
                 self.drain_events.append({
                     "time": time.time(), "address": address,
                     "node_id": msg.get("node_id"),
+                    "dag_ids": msg.get("dag_ids") or [],
                     "deadline": msg.get("deadline", 0.0)})
                 if address:
                     self._draining_raylets.add(address)
@@ -3543,15 +3545,38 @@ class CoreWorker:
             raise failed
         return placements
 
-    async def dag_release(self, dag_id: str, raylet_addrs: list) -> list:
-        """Release every lease `dag_id` pinned; returns the released
-        worker ids (hex). A vanished raylet released implicitly — its
-        leases died with it."""
+    async def dag_register(self, dag_id: str, node_ids: list):
+        """(Re)register a compiled DAG's CURRENT participant-node
+        footprint in the GCS drain index (keyed upsert) — a (gang-)drain
+        notice resolves the affected DAGs there and stamps their ids
+        into the event. The caller (CompiledDAG._pin) passes the pruned
+        footprint so replaced participants' old nodes drop out."""
+        try:
+            await self.gcs.request("dag_register", {
+                "dag_id": dag_id,
+                "node_ids": sorted(set(node_ids), key=lambda n: n.hex())})
+        except rpc.RpcError:
+            pass  # best-effort index: drivers also match by node id
+
+    async def dag_release(self, dag_id: str, raylet_addrs: list,
+                          unregister: bool = False) -> list:
+        """Release every lease `dag_id` pinned at `raylet_addrs`;
+        returns the released worker ids (hex). A PARTIAL release
+        (recovery handing off a draining/stale raylet) keeps the GCS
+        drain-index entry; `unregister=True` (teardown / failed
+        recovery — the DAG is gone for good) drops it. A vanished
+        raylet released implicitly — its leases died with it."""
         released: list = []
         for addr in raylet_addrs:
             try:
                 released.extend(await self.clients.request(
                     addr, "dag_release_workers", {"dag_id": dag_id}))
+            except rpc.RpcError:
+                pass
+        if unregister:
+            try:
+                await self.gcs.request("dag_unregister",
+                                       {"dag_id": dag_id})
             except rpc.RpcError:
                 pass
         return released

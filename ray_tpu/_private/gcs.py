@@ -174,6 +174,13 @@ class GcsServer:
         self._recovery_tasks: set = set()
         self.gang_drains_total = 0
         self.gang_recoveries_total = 0
+        # Compiled-DAG index: dag_id -> set of participant NodeIDs,
+        # maintained by the owning core worker at pin/release time. A
+        # (gang-)drain notice resolves the affected DAGs here and stamps
+        # their ids into the published event, so every driver's drain
+        # listener matches on one set-membership check instead of
+        # cross-referencing node ids.
+        self._dag_index: Dict[str, set] = {}
         # Consecutive failed reserve-before-release attempts per PG (the
         # release-and-replace liveness backstop in _schedule_pg).
         self._pg_handoff_failures: Dict[PlacementGroupID, int] = {}
@@ -866,6 +873,7 @@ class GcsServer:
             self.pubsub.publish("nodes", {
                 "event": "draining", "node_id": node_id,
                 "address": info.address, "deadline": info.drain_deadline,
+                "dag_ids": self._dags_on_nodes([node_id]),
                 "reason": payload.get("reason", "drain requested")})
             # Tell the raylet: reject new lease grants, let running tasks
             # finish, push primary object copies to live nodes, report
@@ -923,6 +931,29 @@ class GcsServer:
 
     # ------------- slice fault domains (gang drain) -------------
 
+    # ------------- compiled-DAG index (drain -> affected-DAG lookup) ----
+
+    @rpc.idempotent
+    async def rpc_dag_register(self, conn, payload):
+        """Owning core worker reports a compiled DAG's participant nodes
+        (at pin / re-pin time). Keyed upsert — replays and recovery
+        re-registrations overwrite with the current footprint."""
+        self._dag_index[payload["dag_id"]] = set(payload.get("node_ids")
+                                                 or [])
+        return True
+
+    @rpc.idempotent
+    async def rpc_dag_unregister(self, conn, payload):
+        self._dag_index.pop(payload["dag_id"], None)
+        return True
+
+    def _dags_on_nodes(self, node_ids) -> List[str]:
+        """dag_ids with at least one participant on `node_ids` — stamped
+        into drain notices so drivers match on one membership check."""
+        ids = set(node_ids)
+        return sorted(d for d, nodes in self._dag_index.items()
+                      if nodes & ids)
+
     def _slice_members(self, slice_id: str) -> List[NodeInfo]:
         return [n for n in self.nodes.values()
                 if n.alive and n.slice_id == slice_id]
@@ -960,15 +991,18 @@ class GcsServer:
         # One gang event (gang-aware consumers: core worker retry
         # classification, Train) plus the per-member events every
         # single-node consumer already understands.
+        affected_dags = self._dags_on_nodes(member_ids)
         self.pubsub.publish("nodes", {
             "event": "gang_draining", "slice_id": slice_id,
             "node_ids": member_ids, "addresses": addresses,
-            "deadline": deadline, "reason": reason})
+            "deadline": deadline, "reason": reason,
+            "dag_ids": affected_dags})
         for n in fresh:
             self.pubsub.publish("nodes", {
                 "event": "draining", "node_id": n.node_id,
                 "address": n.address, "deadline": deadline,
-                "reason": reason, "slice_id": slice_id})
+                "reason": reason, "slice_id": slice_id,
+                "dag_ids": affected_dags})
 
         async def _notify_raylet(node: NodeInfo):
             try:
@@ -1238,6 +1272,13 @@ class GcsServer:
             if actor.state == ACTOR_DEAD:
                 return
             old_address = actor.address
+            old_node = self.nodes.get(actor.node_id) \
+                if actor.node_id is not None else None
+            if old_node is not None and old_node.zone:
+                # Multi-slice DCN topology awareness: the replacement
+                # placement prefers a node in the SAME pod/zone as the
+                # domain this actor is being drained off.
+                actor.prefer_zone = old_node.zone
             actor.num_restarts += 1
             actor.preempted_restarts += 1
             actor.state = ACTOR_RESTARTING
@@ -1627,7 +1668,8 @@ class GcsServer:
         exact = bool(env.get("container"))
         node = self._pick_node_for(spec.resources, spec.scheduling,
                                    view=view, warm_env=env_hash,
-                                   warm_exact=exact)
+                                   warm_exact=exact,
+                                   prefer_zone=actor.prefer_zone)
         if node is None:
             # No feasible node right now; retry (autoscaler hook
             # lives here).
@@ -1751,6 +1793,7 @@ class GcsServer:
         actor.address = result["actor_address"]
         actor.worker_id = result["worker_id"]
         actor.node_id = node.node_id
+        actor.prefer_zone = ""   # migration landed: the hint is spent
         self._mark_dirty()
         self._publish_actor_alive(actor)
 
@@ -1785,7 +1828,8 @@ class GcsServer:
     def _pick_node_for(self, resources: Dict[str, float], scheduling=None,
                        view: Optional[dict] = None,
                        warm_env: Optional[str] = None,
-                       warm_exact: bool = False):
+                       warm_exact: bool = False,
+                       prefer_zone: str = ""):
         """GCS-side node selection for actor creation (GcsActorScheduler).
 
         `view` (node_id -> available dict) is the creation pass's debited
@@ -1824,6 +1868,13 @@ class GcsServer:
                       and _fits(resources, avail_of(n))]
         if not candidates:
             return None
+        if prefer_zone:
+            # Same-pod/zone replacement-domain preference (soft): a
+            # migrating gang member / compiled-DAG executor lands on the
+            # local DCN fabric when any matching node fits.
+            same = [n for n in candidates if n.zone == prefer_zone]
+            if same:
+                candidates = same
         if warm_env is not None:
             def warm_cap(n: NodeInfo) -> int:
                 w = getattr(n, "idle_workers", None) or {}

@@ -501,7 +501,7 @@ class Raylet:
                  labels: Optional[Dict[str, str]] = None,
                  is_head: bool = False,
                  object_store_memory: Optional[int] = None,
-                 node_name: str = "", slice_id: str = ""):
+                 node_name: str = "", slice_id: str = "", zone: str = ""):
         self.config = config
         self.gcs_address = gcs_address
         self.session_dir = session_dir
@@ -512,10 +512,16 @@ class Raylet:
         self.labels = dict(labels or {})
         # TPU slice fault domain: every host of one ICI domain registers
         # the same slice_id so the GCS drains/recovers them as one gang.
-        from ray_tpu.parallel.mesh import SLICE_LABEL, detect_slice_id
+        from ray_tpu.parallel.mesh import (SLICE_LABEL, ZONE_LABEL,
+                                           detect_slice_id, detect_zone)
         self.slice_id = slice_id or detect_slice_id(self.labels)
         if self.slice_id:
             self.labels.setdefault(SLICE_LABEL, self.slice_id)
+        # DCN locality (pod/zone): drives same-zone replacement-domain
+        # preference when gangs / compiled DAGs migrate off this host.
+        self.zone = zone or detect_zone(self.labels)
+        if self.zone:
+            self.labels.setdefault(ZONE_LABEL, self.zone)
         self.pool = ResourcePool(self.resources)
         self.server = rpc.RpcServer(f"raylet-{self.node_name}")
         self.store = ObjectStoreHost(
@@ -703,7 +709,7 @@ class Raylet:
             resources_total=dict(self.pool.total),
             resources_available=dict(self.pool.available),
             labels=self.labels, is_head=self.is_head,
-            slice_id=self.slice_id,
+            slice_id=self.slice_id, zone=self.zone,
         )
         reply = await self.gcs_conn.request("register_node", {
             "node_info": info,
@@ -1277,7 +1283,11 @@ class Raylet:
 
     @rpc.idempotent
     async def rpc_dag_release_workers(self, conn, payload):
-        """Release every lease `dag_id` pinned on this node."""
+        """Release every lease `dag_id` pinned on this node. (Recovery's
+        partial release is per-RAYLET — a dead participant's pin is
+        already dropped by _on_worker_disconnect, and a migrating DAG
+        releases whole draining raylets — so no worker-level subset is
+        needed here.)"""
         dag_id = payload["dag_id"]
         released = sorted(self._dag_pins.pop(dag_id, set()))
         for handle in self.workers.values():
@@ -1556,14 +1566,60 @@ class Raylet:
 
     async def _drain_to_idle(self):
         """Background drain worker: migrate objects, wait for running work,
-        then tell the GCS this node is safe to kill."""
+        then tell the GCS this node is safe to kill.
+
+        Compiled-DAG pins are counted EXPLICITLY: pinned workers are
+        excluded from the idle reaper, so without intervention a DAG
+        whose driver never migrates would hold its leases to the bitter
+        end and wedge drain_complete at the deadline. A migrating DAG
+        releases its pins itself (dag_release hand-off on the drain
+        notice); whatever pins remain once every ordinary lease has
+        drained are SHED near the deadline — the pinned workers are shut
+        down (they would die at the deadline anyway), the owning DAG's
+        settled-ref watcher sees the death, and replayable DAGs recover
+        while non-replayable ones fail typed exactly as a kill would."""
         try:
             await self._drain_push_objects()
         except Exception:  # noqa: BLE001 — migration is best-effort
             logger.exception("raylet %s object migration failed",
                              self.node_name)
-        while (not self._stopped and time.time() < self._drain_deadline
-               and any(h.leased for h in self.workers.values())):
+        window = max(0.0, self._drain_deadline - time.time())
+        shed_at = self._drain_deadline - min(2.0, 0.25 * window)
+        shed_done = False
+        last_log = 0.0
+        while not self._stopped and time.time() < self._drain_deadline:
+            leased = [h for h in self.workers.values() if h.leased]
+            if not leased:
+                break
+            pinned = [h for h in leased if h.dag_pins]
+            if time.time() - last_log > 1.0:
+                last_log = time.time()
+                logger.info(
+                    "raylet %s draining: %d leased worker(s), %d of them "
+                    "DAG-pinned (%s)", self.node_name, len(leased),
+                    len(pinned),
+                    sorted({d for h in pinned for d in h.dag_pins}))
+            if pinned and len(pinned) == len(leased) and not shed_done \
+                    and time.time() >= shed_at:
+                # Only DAG pins stand between this node and
+                # drain_complete: shed them instead of wedging until the
+                # deadline. Dropping the accounting first keeps
+                # rpc_dag_lease_accounting truthful while the shutdowns
+                # land.
+                shed_done = True
+                logger.warning(
+                    "raylet %s draining: shedding %d DAG-pinned "
+                    "worker(s) whose owning DAG did not migrate",
+                    self.node_name, len(pinned))
+                for h in pinned:
+                    for dag_id in list(h.dag_pins):
+                        pins = self._dag_pins.get(dag_id)
+                        if pins is not None:
+                            pins.discard(h.worker_id.hex())
+                            if not pins:
+                                self._dag_pins.pop(dag_id, None)
+                    h.dag_pins.clear()
+                    asyncio.ensure_future(self._push_shutdown(h))
             await asyncio.sleep(0.1)
         if self._stopped:
             return

@@ -48,9 +48,11 @@ class Cluster:
                  labels: Optional[Dict[str, str]] = None,
                  object_store_memory: int = 256 * 1024**2,
                  is_head: bool = False, node_name: str = "",
-                 slice_id: str = "") -> Raylet:
+                 slice_id: str = "", zone: str = "") -> Raylet:
         """slice_id groups fake nodes into one TPU slice fault domain:
-        draining (or losing) any member gang-drains the whole group."""
+        draining (or losing) any member gang-drains the whole group.
+        zone marks the DCN locality domain (pod / cloud zone): migration
+        off a draining slice prefers same-zone replacement nodes."""
         res = dict(resources or {})
         res.setdefault("CPU", float(num_cpus))
         if num_tpus:
@@ -63,7 +65,7 @@ class Cluster:
                             resources=res, labels=labels, is_head=is_head,
                             object_store_memory=object_store_memory,
                             node_name=node_name or f"node{len(self.raylets)}",
-                            slice_id=slice_id)
+                            slice_id=slice_id, zone=zone)
             await raylet.start()
             return raylet
 

@@ -20,11 +20,28 @@ every control-plane cost ONCE:
 
 `execute()` is then one input-channel write + one output-channel read —
 zero per-tick task RPCs — and `execute_async()` overlaps executions up
-to the channel depth. Executor death mid-tick surfaces as a typed
-`DagExecutionError` on the in-flight and all subsequent executes via a
-settled-ref watcher parked on the loop refs (push, not the old 1s-slice
-polling backstop); `teardown()` releases every pinned lease and unlinks
-every channel segment.
+to the channel depth.
+
+Self-healing (PR 13): every channel message carries the DAG's monotonic
+tick sequence. On a `tick_replay=True` DAG, executor death no longer
+poisons the pipeline: the settled-ref watcher transitions the DAG to
+RECOVERING instead of failing it — only the dead participant(s) are
+restarted (FunctionNode executors are recreated by the DAG; user actors
+ride their own `max_restarts` / preemption-migration machinery), their
+worker leases are re-pinned at the hosting raylets, only the channels
+whose locality changed are re-created (surviving ring segments — and
+the reader cursors persisted inside them — are kept and reopened), the
+persistent run loops are re-shipped, and the driver replays every
+unacknowledged tick from a bounded replay buffer. Surviving executors
+dedupe by sequence (skip recompute, re-emit their cached result only
+onto edges that lost data), so a tick that partially crossed the
+pipeline completes exactly once and survivors keep their pids. A
+node/gang drain notice triggers the same machinery *proactively*: the
+affected executors are migrated (uncharged, `preempted_restarts`),
+channels re-homed (ring<->store as locality changes) and the dying
+members' pins released BEFORE the kill. Non-replayable DAGs keep the
+typed fail-fast `DagExecutionError`; `teardown()` releases every pinned
+lease and unlinks every channel segment on every path.
 """
 
 from __future__ import annotations
@@ -32,11 +49,12 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
 
 from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, FunctionNode,
                                   InputNode, MultiOutputNode)
-from ray_tpu.exceptions import DagExecutionError
+from ray_tpu.exceptions import DagExecutionError, DagRecoveryError
 from ray_tpu.experimental.channel import ChannelClosedError
 from ray_tpu.experimental.channels import RingChannel, StoreChannel
 
@@ -52,14 +70,56 @@ class _DagError:
         self.error = error
 
 
-def _run_compiled_loop(fns: List, node_specs: List[tuple]):
+class _Unrecoverable(Exception):
+    """Internal: recovery cannot possibly succeed (participant dead for
+    good); carries the typed error to surface."""
+
+    def __init__(self, error: BaseException):
+        super().__init__(str(error))
+        self.error = error
+
+
+def _wire_bytes(message) -> bytes:
+    """Serialize a (seq, value) message into the channel wire format as
+    PRIVATE bytes — safe to cache for a recovery resend, where a live
+    result object could alias a zero-copy view onto a ring slot the
+    writer has since recycled."""
+    from ray_tpu._private.serialization import context_for_process
+    return context_for_process().serialize(message).to_bytes()
+
+
+def _run_compiled_loop(fns: List, node_specs: List[tuple],
+                       node_keys: Optional[List[int]] = None,
+                       state: Optional[dict] = None,
+                       resume: Optional[dict] = None,
+                       cache_bound: int = 64,
+                       detach: bool = False):
     """One executor loop driving one or more compiled nodes.
 
     node_specs[i] = (in_readers, arg_template, kw_template, out_writer)
     for fns[i], in topological order — intra-executor edges resolve
     because the producer wrote its ring slot earlier in the same pass
     and this node holds its own reader cursor on that channel.
+
+    Messages are (tick_seq, value) pairs. `state` is the actor-resident
+    per-node recovery state ({node_key: {last, cache, stash, careful}})
+    that survives loop re-ships on a surviving executor: `last` is the
+    newest tick this node computed (the exactly-once dedupe floor),
+    `cache` its recent results as PRIVATE wire bytes (the resend
+    source; kept only when `detach` — recovery — is armed), `stash`
+    per-reader ahead-of-target values. `resume[node_key]` directives ship with a recovery re-ship:
+    `start` floors a fresh node at the replay floor, `resend_from`
+    makes a survivor re-emit its cached tail onto an edge that lost
+    data, `careful` forces copied (never zero-copy) reads for the
+    post-recovery window where out-of-order deliveries can be stashed
+    past their ring slot's lifetime.
     """
+    n = len(fns)
+    if node_keys is None:
+        node_keys = list(range(n))
+    if state is None:
+        state = {}
+    resume = resume or {}
     writers = [spec[3] for spec in node_specs]
 
     def _close_all():
@@ -69,82 +129,178 @@ def _run_compiled_loop(fns: List, node_specs: List[tuple]):
             except Exception:  # noqa: BLE001 — teardown race
                 pass
 
+    sts = []
+    for i, key in enumerate(node_keys):
+        readers = node_specs[i][0]
+        st = state.get(key)
+        if st is None or len(st.get("stash", ())) != len(readers):
+            st = {"last": -1, "cache": OrderedDict(),
+                  "stash": [dict() for _ in readers], "careful": 0}
+            state[key] = st
+        sts.append(st)
+
+    # Resume directives: floor fresh nodes at the replay start, then
+    # re-emit cached tails onto edges whose contents were lost (the
+    # channel was re-created/re-homed, or a downstream reader was
+    # restarted and its consumed-but-unprocessed ticks died with it).
+    for i, key in enumerate(node_keys):
+        d = resume.get(key) or {}
+        st = sts[i]
+        if st["last"] < 0:
+            st["last"] = int(d.get("start", 0)) - 1
+        st["careful"] = max(st.get("careful", 0), int(d.get("careful", 0)))
+        rf = d.get("resend_from")
+        if rf is not None:
+            for seq in range(int(rf), st["last"] + 1):
+                if seq in st["cache"]:
+                    try:
+                        writers[i].write_bytes(st["cache"][seq])
+                    except ChannelClosedError:
+                        _close_all()
+                        return "closed"
+
+    def _fill(st: dict, readers: List) -> tuple:
+        """Block until every reader holds this node's next tick; returns
+        (seq, values). Duplicate deliveries (replays) are dropped by
+        seq; ahead-of-target deliveries are stashed — copied out of the
+        ring while in the careful window, since a stashed zero-copy
+        view could be lapped by the writer before it is consumed."""
+        want = st["last"] + 1
+        for j, r in enumerate(readers):
+            stash = st["stash"][j]
+            for stale in [s for s in stash if s < want]:
+                del stash[s]
+            while want not in stash:
+                seq, val = r.read(timeout=_LOOP_READ_TIMEOUT_S,
+                                  copy=st["careful"] > 0)
+                if seq >= want:
+                    stash[seq] = val
+        return want, [st["stash"][j].pop(want) for j in range(len(readers))]
+
     while True:
-        closed = False
-        for fn, (in_readers, arg_t, kw_t, out_writer) in zip(fns,
-                                                             node_specs):
-            if closed:
-                continue
-            values = []
+        for i, (fn, (in_readers, arg_t, kw_t, out_writer)) in \
+                enumerate(zip(fns, node_specs)):
+            st = sts[i]
             try:
-                for r in in_readers:
-                    values.append(r.read(timeout=_LOOP_READ_TIMEOUT_S))
+                seq, values = _fill(st, in_readers)
             except ChannelClosedError:
                 _close_all()
-                closed = True
-                continue
+                return "closed"
             except Exception as e:  # noqa: BLE001 — a read error must
                 # surface to the caller as a typed result, never kill the
                 # loop silently: a dead loop leaves every later execute()
                 # spinning on an output channel nobody will write.
-                try:
-                    out_writer.write(_DagError(e))
-                except ChannelClosedError:
-                    _close_all()
-                    closed = True
-                continue
-            err = next((v for v in values if isinstance(v, _DagError)),
-                       None)
-            if err is not None:
-                result = err
-            else:
-                args = [values[i] if kind == "chan" else const
-                        for kind, i, const in arg_t]
-                kwargs = {key: (values[i] if kind == "chan" else const)
-                          for key, kind, i, const in kw_t}
-                try:
-                    result = fn(*args, **kwargs)
-                except Exception as e:  # noqa: BLE001
-                    result = _DagError(e)
+                seq = st["last"] + 1
+                values = None
+                result = _DagError(e)
+            if values is not None:
+                err = next((v for v in values if isinstance(v, _DagError)),
+                           None)
+                if err is not None:
+                    result = err
+                else:
+                    args = [values[j] if kind == "chan" else const
+                            for kind, j, const in arg_t]
+                    kwargs = {key: (values[j] if kind == "chan" else const)
+                              for key, kind, j, const in kw_t}
+                    try:
+                        result = fn(*args, **kwargs)
+                    except Exception as e:  # noqa: BLE001
+                        result = _DagError(e)
+            st["last"] = seq
+            if st["careful"] > 0:
+                st["careful"] -= 1
             try:
-                out_writer.write(result)
+                if detach:
+                    # Recovery armed: serialize ONCE, cache the private
+                    # wire bytes (a live result could alias a zero-copy
+                    # view onto a ring slot the upstream writer recycles
+                    # `depth` ticks from now — resending it later would
+                    # replay silently corrupted memory), write the same
+                    # bytes downstream.
+                    wire = _wire_bytes((seq, result))
+                    st["cache"][seq] = wire
+                    while len(st["cache"]) > cache_bound:
+                        st["cache"].popitem(last=False)
+                    out_writer.write_bytes(wire)
+                else:
+                    # Fail-fast DAGs never resend: skip the cache.
+                    out_writer.write((seq, result))
             except ChannelClosedError:
                 _close_all()
-                closed = True
-        if closed:
-            return "closed"
+                return "closed"
 
 
-def _dag_loop_method(self, method_names: List[str], node_specs: List[tuple]):
+def _dag_loop_method(self, method_names: List[str], node_specs: List[tuple],
+                     node_keys: Optional[List[int]] = None,
+                     resume: Optional[dict] = None, cache_bound: int = 64,
+                     dag_id: str = "", detach: bool = False):
     """Injected onto every actor instance (core_worker instantiation) so a
     compiled DAG can pin a loop to a user actor without the class opting
-    in (reference: aDAG's internal actor executables)."""
+    in (reference: aDAG's internal actor executables). The per-dag
+    recovery state rides the instance so a surviving actor keeps its
+    dedupe cache across loop re-ships (a restarted instance starts
+    fresh — exactly the semantics recovery wants)."""
+    root = self.__dict__.setdefault("__ray_tpu_dag_state__", {})
     return _run_compiled_loop([getattr(self, m) for m in method_names],
-                              node_specs)
+                              node_specs, node_keys,
+                              root.setdefault(dag_id, {}), resume,
+                              cache_bound, detach)
 
 
 _EXECUTOR_OPTION_KEYS = ("num_cpus", "num_tpus", "num_gpus", "resources",
                          "scheduling_strategy", "runtime_env")
 
-_DRIVER = "__driver__"
+_DRIVER = -1          # reader-entity key for the driver endpoint
 
-_tick_hist = None
-_inflight_gauge = None
+_metrics = None
 
 
-def _metric_handles():
-    global _tick_hist, _inflight_gauge
-    if _tick_hist is None:
+def _metric_handles() -> dict:
+    global _metrics
+    if _metrics is None:
         from ray_tpu.util import metrics
-        _tick_hist = metrics.Histogram(
-            "ray_tpu_dag_tick_seconds",
-            "compiled-DAG per-tick latency (input write -> output read)",
-            boundaries=[0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
-                        0.01, 0.025, 0.05, 0.1, 0.5, 1.0, 5.0])
-        _inflight_gauge = metrics.Gauge(
-            "ray_tpu_dag_inflight_executions",
-            "compiled-DAG executions submitted but not yet collected")
-    return _tick_hist, _inflight_gauge
+        _metrics = {
+            "tick": metrics.Histogram(
+                "ray_tpu_dag_tick_seconds",
+                "compiled-DAG per-tick latency (input write -> output read)",
+                boundaries=[0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                            0.01, 0.025, 0.05, 0.1, 0.5, 1.0, 5.0]),
+            "inflight": metrics.Gauge(
+                "ray_tpu_dag_inflight_executions",
+                "compiled-DAG executions submitted but not yet collected"),
+            "recoveries": metrics.Counter(
+                "ray_tpu_dag_recoveries_total",
+                "compiled-DAG in-place recoveries (executor death or "
+                "proactive drain migration) that returned the DAG to "
+                "RUNNING"),
+            "recovery_s": metrics.Histogram(
+                "ray_tpu_dag_recovery_seconds",
+                "compiled-DAG recovery latency (failure/notice -> RUNNING)",
+                boundaries=[0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                            30.0, 60.0]),
+            "replayed": metrics.Counter(
+                "ray_tpu_dag_replayed_ticks_total",
+                "unacknowledged ticks replayed from the driver-side "
+                "buffer after a compiled-DAG recovery"),
+        }
+    return _metrics
+
+
+class _Participant:
+    """One loop-hosting actor: a DAG-owned FunctionNode executor or an
+    adopted user actor, plus the node group its single loop drives."""
+
+    __slots__ = ("handle", "node_keys", "is_fn", "fn", "fn_opts",
+                 "loop_ref")
+
+    def __init__(self, handle, node_keys, is_fn, fn=None, fn_opts=None):
+        self.handle = handle
+        self.node_keys = list(node_keys)
+        self.is_fn = is_fn
+        self.fn = fn
+        self.fn_opts = fn_opts or {}
+        self.loop_ref = None
 
 
 class CompiledDAG:
@@ -152,51 +308,76 @@ class CompiledDAG:
 
     Lifecycle: `compile()` (or `dag.experimental_compile()`) acquires
     channels + pinned leases + run loops; `execute()` /
-    `execute_async()` tick; `teardown()` releases everything —
-    scripts/check_dag_teardown.py statically enforces that every
-    acquisition has a release on the teardown AND the compile-error
-    path.
+    `execute_async()` tick; executor death on a `tick_replay` DAG runs
+    recompile-in-place recovery (`_recover`); `teardown()` releases
+    everything — scripts/check_dag_teardown.py statically enforces that
+    every acquisition has a release on the teardown, compile-error AND
+    recovery-failure paths.
     """
 
     @classmethod
     def compile(cls, dag: DAGNode, *, channel_depth: int = 2,
                 max_message_size: int = 1 << 20,
-                compile_timeout_s: float = 60.0) -> "CompiledDAG":
+                compile_timeout_s: float = 60.0,
+                tick_replay: bool = False,
+                recovery_timeout_s: float = 60.0,
+                max_recoveries: int = 64) -> "CompiledDAG":
         return cls(dag, max_message_size, channel_depth=channel_depth,
-                   compile_timeout_s=compile_timeout_s)
+                   compile_timeout_s=compile_timeout_s,
+                   tick_replay=tick_replay,
+                   recovery_timeout_s=recovery_timeout_s,
+                   max_recoveries=max_recoveries)
 
     def __init__(self, root: DAGNode, max_message_size: int = 1 << 20,
-                 channel_depth: int = 2, compile_timeout_s: float = 60.0):
+                 channel_depth: int = 2, compile_timeout_s: float = 60.0,
+                 tick_replay: bool = False,
+                 recovery_timeout_s: float = 60.0,
+                 max_recoveries: int = 64):
         self._root = root
         self._max_size = max_message_size
         self._depth = max(1, int(channel_depth))
         self._dag_id = os.urandom(6).hex()
+        self._tick_replay = bool(tick_replay)
+        self._recovery_timeout_s = float(recovery_timeout_s)
+        self._max_recoveries = int(max_recoveries)
         # Resource registries — initialized FIRST so teardown() is safe
         # from any partial-compile state.
-        self._channels: List[Any] = []          # every created channel
+        self._channels: List[Any] = []          # every live channel
+        self._edge_channels: Dict[Any, Any] = {}
         self._loop_refs: List[Any] = []
         self._executor_actors: List[Any] = []
+        self._participants: List[_Participant] = []
+        self._placements: Dict[Any, dict] = {}
         self._pinned_raylets: List[str] = []
         self._input_writers: List[Any] = []
         self._output_readers: List[Any] = []
+        self._output_map: List[int] = []
+        self._out_stash: Dict[int, dict] = {}
         self._watcher = None
+        self._watch_epoch = 0
+        self._epoch = 0
+        self._driver_node = None
         self._torn_down = False
         self._error: Optional[BaseException] = None
+        self._state = "running"
+        self._recovered_evt = threading.Event()
+        self._recovered_evt.set()
+        self._recover_lock = threading.Lock()
+        self._migration_inflight = False
+        self._drain_cb = None
+        self._drain_seen = 0
         self._submit_lock = threading.Lock()
         self._collect_lock = threading.Lock()
         self._next_seq = 0
         self._collected = 0
         self._results: Dict[int, list] = {}
-        # Per-tick output-read resume state: values already drained from
-        # SOME output readers when a timeout interrupted the rest. The
-        # cursors of the drained readers advanced persistently, so a
-        # retrying collect must resume from here — re-reading would pair
-        # tick N+1's value from one reader with tick N's from another.
-        self._tick_buf: Dict[int, Any] = {}
+        self._replay: Dict[int, Any] = {}
         self._submit_ts: Dict[int, float] = {}
         self._inflight = 0
         self.max_inflight = 0
         self.ticks = 0
+        self.recoveries = 0
+        self.replayed_ticks = 0
         try:
             t0 = time.time()
             self._compile(compile_timeout_s)
@@ -213,6 +394,21 @@ class CompiledDAG:
     def _compile(self, compile_timeout_s: float):
         from ray_tpu._private import worker_api
 
+        self._build_graph()
+        self._create_participants()
+        core = worker_api.get_core()
+        self._pin([p.handle._actor_id for p in self._participants],
+                  compile_timeout_s)
+        for edge in self._edge_defs:
+            self._make_edge_channel(edge)
+        self._ship_loops({})
+        self._refresh_driver_endpoints()
+        self._arm_watcher(core)
+        self._register_drain_listener()
+
+    def _build_graph(self):
+        """Topology metadata, built once — recovery re-derives channels
+        and specs from it without re-walking the user graph."""
         root = self._root
         nodes = root._topo()
         multi = isinstance(root, MultiOutputNode)
@@ -231,164 +427,208 @@ class CompiledDAG:
         for o in outputs:
             if not isinstance(o, (FunctionNode, ClassMethodNode)):
                 raise TypeError("DAG outputs must be compute nodes")
+        self._compute_nodes = compute_nodes
+        self._outputs = outputs
+        self._multi = multi
+        self._key_of = {id(n): i for i, n in enumerate(compute_nodes)}
 
-        # 1. One executor actor per FunctionNode; ClassMethodNodes adopt
-        # their user actor. All nodes of one actor share a single loop.
-        owner_of: Dict[int, Any] = {}          # id(node) -> actor handle
-        for node in compute_nodes:
-            if isinstance(node, FunctionNode):
-                opts = {k: v for k, v in node._remote_fn._options.items()
-                        if k in _EXECUTOR_OPTION_KEYS}
-                executor = _executor_actor_class().options(
-                    max_concurrency=1, **opts).remote(
-                        node._remote_fn._function)
-                self._executor_actors.append(executor)
-                owner_of[id(node)] = executor
-            else:
-                owner_of[id(node)] = node._actor_method._handle
-
-        # 2. Pin every participant's lease ONCE; placements come back
-        # with node ids, which drive the per-edge channel choice.
-        core = worker_api.get_core()
-        handles = {h._actor_id: h for h in owner_of.values()}
-        placements = worker_api._call_on_core_loop(
-            core, core.dag_pin_actors(self._dag_id, list(handles),
-                                      timeout_s=compile_timeout_s),
-            compile_timeout_s)
-        self._pinned_raylets = sorted(
-            {p["raylet"] for p in placements.values()})
-        driver_node = worker_api._call_on_core_loop(
-            core, core.local_node_id(), 30)
-
-        def node_of(entity) -> Any:
-            if entity == _DRIVER:
-                return driver_node
-            return placements[entity]["node_id"]
-
-        def entity_of(node: DAGNode) -> Any:
-            return owner_of[id(node)]._actor_id
-
-        # 3. Edges: which NODES consume each produced value. Reader
-        # cursors are per consuming node (two nodes on one actor each
-        # hold their own cursor — a shared one would double-advance per
-        # tick); a node binding the same upstream twice (diamond) still
-        # collapses onto one cursor below. The input channel's consumers
+        # Edges: which NODES consume each produced value. Reader cursors
+        # are per consuming node (two nodes on one actor each hold their
+        # own cursor — a shared one would double-advance per tick); a
+        # node binding the same upstream twice (diamond) still collapses
+        # onto one cursor in _node_spec. The input channel's consumers
         # are every node reading InputNode plus const-only nodes (the
         # input is their tick trigger — a triggerless loop would spin
         # hot and never observe teardown).
-        consumers: Dict[int, List[DAGNode]] = {id(n): [] for n in nodes}
-        input_consumers: List[DAGNode] = []
+        consumers: Dict[int, List[int]] = {i: []
+                                           for i in range(len(compute_nodes))}
+        input_consumers: List[int] = []
         for node in compute_nodes:
+            k = self._key_of[id(node)]
             deps = node._deps()
             if not deps or any(isinstance(d, InputNode) for d in deps):
-                input_consumers.append(node)
+                input_consumers.append(k)
             for dep in deps:
                 if not isinstance(dep, InputNode):
-                    consumers[id(dep)].append(node)
-
-        # 4. Create the channels. One producer each: the driver for the
-        # input channel, a node's hosting actor otherwise. A ring needs
-        # every endpoint on ONE node; any remote endpoint moves the whole
-        # edge to the KV/store fallback.
-        ch_index = 0
-
-        def place_of(consumer) -> Any:
-            if consumer is _DRIVER:
-                return driver_node
-            return node_of(entity_of(consumer))
-
-        def make_channel(writer_place, reader_list):
-            nonlocal ch_index
-            places = {writer_place}
-            places.update(place_of(r) for r in reader_list)
-            if len(places) == 1 and None not in places:
-                ch = RingChannel(self._max_size, self._depth,
-                                 len(reader_list))
-            else:
-                ch = StoreChannel(f"{self._dag_id}/{ch_index}",
-                                  self._depth, len(reader_list))
-            ch_index += 1
-            self._channels.append(ch)
-            return ch
+                    consumers[self._key_of[id(dep)]].append(k)
 
         def dedup(seq):
             out, seen = [], set()
             for x in seq:
-                if id(x) not in seen:
-                    seen.add(id(x))
+                if x not in seen:
+                    seen.add(x)
                     out.append(x)
             return out
 
-        input_nodes_list = dedup(input_consumers)
-        input_channel = make_channel(driver_node, input_nodes_list)
-        input_reader_of = {id(n): input_channel.reader(i)
-                           for i, n in enumerate(input_nodes_list)}
-        out_channel_of: Dict[int, Any] = {}
-        reader_of: Dict[Tuple[int, int], Any] = {}
-        driver_readers: Dict[int, Any] = {}
-        for node in compute_nodes:
-            readers = dedup(consumers[id(node)])
-            if node in outputs:
+        output_keys = {self._key_of[id(o)] for o in outputs}
+        self._edge_defs: List[dict] = [
+            {"key": "input", "writer": None, "readers": dedup(input_consumers)}
+        ]
+        for k in range(len(compute_nodes)):
+            readers = dedup(consumers[k])
+            if k in output_keys:
                 readers = readers + [_DRIVER]
-            ch = make_channel(place_of(node), readers)
-            out_channel_of[id(node)] = ch
-            for i, consumer in enumerate(readers):
-                if consumer is _DRIVER:
-                    driver_readers[id(node)] = ch.reader(i)
-                else:
-                    reader_of[(id(node), id(consumer))] = ch.reader(i)
+            self._edge_defs.append({"key": k, "writer": k,
+                                    "readers": readers})
+        # One loop re-ship can resend at most this much cached tail; the
+        # unacked window is bounded by the pipeline's total buffering.
+        self._cache_bound = len(self._edge_defs) * self._depth \
+            + self._depth + 8
 
-        # 5. Node specs: per consumed value either a channel-read index
-        # or an inline constant; repeat reads collapse onto one reader.
-        def node_spec(node: DAGNode) -> tuple:
-            in_readers: List[Any] = []
-            reader_idx: Dict[Any, int] = {}
-
-            def wire(value):
-                if isinstance(value, InputNode):
-                    key, rd = "input", input_reader_of[id(node)]
-                elif isinstance(value, DAGNode):
-                    key, rd = id(value), reader_of[(id(value), id(node))]
-                else:
-                    return ("const", -1, value)
-                if key not in reader_idx:
-                    reader_idx[key] = len(in_readers)
-                    in_readers.append(rd)
-                return ("chan", reader_idx[key], None)
-
-            arg_t = [wire(a) for a in node._bound_args]
-            kw_t = []
-            for k, v in node._bound_kwargs.items():
-                kind, i, const = wire(v)
-                kw_t.append((k, kind, i, const))
-            if not in_readers:
-                in_readers.append(input_reader_of[id(node)])
-            writer = out_channel_of[id(node)]
-            if isinstance(writer, RingChannel):
-                writer = writer.writer()
-            return (in_readers, arg_t, kw_t, writer)
-
-        # 6. Ship ONE run loop per actor (an actor's nodes share it —
-        # separate loops would deadlock on the actor's concurrency slot).
-        groups: Dict[Any, Tuple[Any, List[DAGNode]]] = {}
-        for node in compute_nodes:
-            handle = owner_of[id(node)]
-            groups.setdefault(handle._actor_id, (handle, []))[1].append(node)
-        for handle, group_nodes in groups.values():
-            specs = [node_spec(n) for n in group_nodes]
-            if isinstance(group_nodes[0], FunctionNode):
-                self._loop_refs.append(handle.run_loop.remote(specs))
+    def _create_participants(self):
+        """One executor actor per FunctionNode; ClassMethodNodes adopt
+        their user actor. All nodes of one actor share a single loop
+        (separate loops would deadlock on the actor's concurrency
+        slot)."""
+        by_actor: Dict[Any, _Participant] = {}
+        for k, node in enumerate(self._compute_nodes):
+            if isinstance(node, FunctionNode):
+                opts = {o: v for o, v in node._remote_fn._options.items()
+                        if o in _EXECUTOR_OPTION_KEYS}
+                fn = node._remote_fn._function
+                handle = _executor_actor_class().options(
+                    max_concurrency=1, **opts).remote(fn)
+                self._executor_actors.append(handle)
+                self._participants.append(
+                    _Participant(handle, [k], True, fn, opts))
             else:
-                from ray_tpu.actor import ActorMethod
-                loop_method = ActorMethod(handle, "__ray_tpu_dag_loop__")
-                self._loop_refs.append(loop_method.remote(
-                    [n._actor_method._name for n in group_nodes], specs))
+                handle = node._actor_method._handle
+                p = by_actor.get(handle._actor_id)
+                if p is None:
+                    p = _Participant(handle, [], False)
+                    by_actor[handle._actor_id] = p
+                    self._participants.append(p)
+                p.node_keys.append(k)
+        self._part_of_key = {k: p for p in self._participants
+                             for k in p.node_keys}
 
-        # 7. Driver endpoints + the settled-ref failure watcher.
-        self._input_writers = [input_channel]
-        self._output_readers = [driver_readers[id(o)] for o in outputs]
-        self._multi = multi
-        self._arm_watcher(core)
+    def _pin(self, actor_ids: list, timeout_s: float) -> dict:
+        """Pin (or re-pin, during recovery) `actor_ids`' worker leases at
+        their hosting raylets; merges the fresh placements and prunes
+        replaced participants'. dag_release() undoes the pins."""
+        from ray_tpu._private import worker_api
+        core = worker_api.get_core()
+        placements = worker_api._call_on_core_loop(
+            core, core.dag_pin_actors(self._dag_id, list(actor_ids),
+                                      timeout_s=timeout_s),
+            timeout_s + 15)
+        self._placements.update(placements)
+        current = {p.handle._actor_id for p in self._participants}
+        self._placements = {a: pl for a, pl in self._placements.items()
+                            if a in current}
+        self._pinned_raylets = sorted(
+            {pl["raylet"] for pl in self._placements.values()})
+        if self._driver_node is None:
+            self._driver_node = worker_api._call_on_core_loop(
+                core, core.local_node_id(), 30)
+        # Refresh the GCS drain index from the PRUNED footprint (a keyed
+        # upsert): registering from inside dag_pin_actors would merge
+        # replaced participants' old nodes in forever, and a later drain
+        # of such a node would misreport this DAG as affected.
+        try:
+            worker_api._call_on_core_loop(
+                core, core.dag_register(
+                    self._dag_id,
+                    [pl["node_id"] for pl in self._placements.values()]),
+                15)
+        except Exception:  # noqa: BLE001 — best-effort index
+            pass
+        return placements
+
+    # -- channels ------------------------------------------------------
+    def _place_of(self, entity) -> Any:
+        if entity is None or entity == _DRIVER:
+            return self._driver_node
+        p = self._part_of_key[entity]
+        pl = self._placements.get(p.handle._actor_id)
+        return pl["node_id"] if pl else None
+
+    def _edge_is_ring(self, edge: dict) -> bool:
+        """A ring needs every endpoint on ONE node; any remote endpoint
+        moves the whole edge to the KV/store fallback."""
+        places = {self._place_of(edge["writer"])}
+        places.update(self._place_of(r) for r in edge["readers"])
+        return len(places) == 1 and None not in places
+
+    def _make_edge_channel(self, edge: dict):
+        if self._edge_is_ring(edge):
+            ch = RingChannel(self._max_size, self._depth,
+                             len(edge["readers"]))
+        else:
+            ch = StoreChannel(f"{self._dag_id}/{edge['key']}e{self._epoch}",
+                              self._depth, len(edge["readers"]))
+        self._channels.append(ch)
+        self._edge_channels[edge["key"]] = ch
+        return ch
+
+    def _node_spec(self, k: int) -> tuple:
+        """Per consumed value either a channel-read index or an inline
+        constant; repeat reads collapse onto one reader."""
+        node = self._compute_nodes[k]
+        input_edge = self._edge_defs[0]
+        in_readers: List[Any] = []
+        reader_idx: Dict[Any, int] = {}
+
+        def wire(value):
+            if isinstance(value, InputNode):
+                ekey, ridx = "input", input_edge["readers"].index(k)
+            elif isinstance(value, DAGNode):
+                up = self._key_of[id(value)]
+                ekey, ridx = up, self._edge_defs[up + 1]["readers"].index(k)
+            else:
+                return ("const", -1, value)
+            if ekey not in reader_idx:
+                reader_idx[ekey] = len(in_readers)
+                in_readers.append(self._edge_channels[ekey].reader(ridx))
+            return ("chan", reader_idx[ekey], None)
+
+        arg_t = [wire(a) for a in node._bound_args]
+        kw_t = []
+        for key, v in node._bound_kwargs.items():
+            kind, j, const = wire(v)
+            kw_t.append((key, kind, j, const))
+        if not in_readers:
+            in_readers.append(self._edge_channels["input"].reader(
+                input_edge["readers"].index(k)))
+        writer = self._edge_channels[k]
+        if isinstance(writer, RingChannel):
+            writer = writer.writer()
+        return (in_readers, arg_t, kw_t, writer)
+
+    def _ship_loops(self, resume_map: dict):
+        """Ship ONE run loop per participant actor; resume directives
+        ride along on recovery re-ships."""
+        from ray_tpu.actor import ActorMethod
+        for p in self._participants:
+            specs = [self._node_spec(k) for k in p.node_keys]
+            keys = list(p.node_keys)
+            resume = {k: resume_map[k] for k in keys if k in resume_map}
+            if p.is_fn:
+                p.loop_ref = p.handle.run_loop.remote(
+                    specs, keys, resume, self._cache_bound, self._dag_id,
+                    self._tick_replay)
+            else:
+                loop_method = ActorMethod(p.handle, "__ray_tpu_dag_loop__")
+                p.loop_ref = loop_method.remote(
+                    [self._compute_nodes[k]._actor_method._name
+                     for k in keys],
+                    specs, keys, resume, self._cache_bound, self._dag_id,
+                    self._tick_replay)
+        self._loop_refs = [p.loop_ref for p in self._participants]
+
+    def _refresh_driver_endpoints(self):
+        self._input_writers = [self._edge_channels["input"]]
+        out_unique: List[int] = []
+        self._output_map = []
+        for o in self._outputs:
+            k = self._key_of[id(o)]
+            if k not in out_unique:
+                out_unique.append(k)
+            self._output_map.append(out_unique.index(k))
+        self._output_readers = [
+            self._edge_channels[k].reader(
+                self._edge_defs[k + 1]["readers"].index(_DRIVER))
+            for k in out_unique]
 
     # ------------------------------------------------------------------
     # Failure watcher: push-based, parked on the loop refs
@@ -396,6 +636,8 @@ class CompiledDAG:
     def _arm_watcher(self, core):
         import asyncio
 
+        self._watch_epoch += 1
+        epoch = self._watch_epoch
         refs = list(self._loop_refs)
 
         async def _watch():
@@ -414,14 +656,23 @@ class CompiledDAG:
         fut = asyncio.run_coroutine_threadsafe(_watch(), core.loop)
 
         def _on_done(f):
-            if f.cancelled() or self._torn_down:
+            # The epoch guard makes the watcher one-shot ACROSS recovery
+            # passes: recovery bumps the epoch BEFORE its quiesce close,
+            # so the loops it wakes ("executor loop exited") can never
+            # re-trigger it — only a genuine post-recovery death fires
+            # the freshly armed watcher.
+            if f.cancelled() or self._torn_down \
+                    or epoch != self._watch_epoch:
                 return
             try:
                 cause = f.result()
             except Exception as e:  # noqa: BLE001
                 cause = e
-            self._fail(DagExecutionError(
-                "compiled DAG executor died mid-tick", cause))
+            # Never block the core loop: recovery (or the typed fail)
+            # runs on its own thread.
+            threading.Thread(target=self._recover_or_fail,
+                             args=(cause, epoch),
+                             daemon=True, name="dag-recover").start()
 
         fut.add_done_callback(_on_done)
         self._watcher = fut
@@ -432,15 +683,444 @@ class CompiledDAG:
         every subsequent one."""
         if self._error is None:
             self._error = err
-        for ch in self._channels:
+        self._state = "failed"
+        self._recovered_evt.set()
+        for ch in list(self._channels):
             try:
                 ch.close()
             except Exception:  # noqa: BLE001 — teardown race
                 pass
 
     # ------------------------------------------------------------------
+    # Recovery: recompile-in-place onto restarted participants
+    # ------------------------------------------------------------------
+    def _recover_or_fail(self, cause, epoch: int):
+        """Watcher landing: a loop ref settled. Replayable DAGs recover
+        in place; everything else keeps the typed fail-fast."""
+        err = DagExecutionError("compiled DAG executor died mid-tick",
+                                cause)
+        if not self._tick_replay:
+            self._fail(err)
+            return
+        with self._recover_lock:
+            if self._torn_down or self._error is not None:
+                return
+            if epoch != self._watch_epoch:
+                # A recovery/migration pass completed while this thread
+                # waited for the lock: the failure that fired us was
+                # re-probed (and handled) by that pass.
+                return
+            if self.recoveries >= self._max_recoveries:
+                self._fail(err)
+                return
+            self._invalidate_watcher()
+            self._state = "recovering"
+            self._recovered_evt.clear()
+            ok = self._run_recovery(cause, drain=None)
+        if ok:
+            self._replay_unacked()
+
+    def _invalidate_watcher(self):
+        """Retire the armed watcher before the quiesce close: the loops
+        recovery wakes must not read as a fresh failure."""
+        self._watch_epoch += 1
+        if self._watcher is not None:
+            self._watcher.cancel()
+
+    def _run_recovery(self, cause, drain: Optional[dict]) -> bool:
+        """Drive _recover under the held lock with bounded retries (a
+        second death DURING recovery lands here as a failed attempt and
+        is absorbed); finishes the state machine + metrics. Returns True
+        once the DAG is RUNNING again."""
+        t0 = time.time()
+        attempts = 0
+        while True:
+            attempts += 1
+            if self._torn_down:
+                return False
+            try:
+                self._recover(cause, drain)
+                break
+            except _Unrecoverable as e:
+                self._recovery_failed(e.error)
+                return False
+            except BaseException as e:  # noqa: BLE001
+                if self._torn_down:
+                    return False
+                if attempts >= 3:
+                    self._recovery_failed(e)
+                    return False
+                time.sleep(0.25)
+        self.recoveries += 1
+        self._state = "running"
+        self._recovered_evt.set()
+        now = time.time()
+        try:
+            m = _metric_handles()
+            m["recoveries"].inc()
+            m["recovery_s"].observe(now - t0)
+        except Exception:  # noqa: BLE001 — metrics never block recovery
+            pass
+        self._export_span("dag:recover", t0, now)
+        return True
+
+    def _recovery_failed(self, cause: BaseException):
+        """Recovery-failure path: surface typed, wake every blocked end,
+        and release what the DAG still holds (re-pinned leases must not
+        leak on a pipeline that will never tick again)."""
+        err = cause if isinstance(cause, DagExecutionError) else \
+            DagRecoveryError("compiled DAG recovery failed", cause)
+        self._fail(err)
+        self._release_pins()
+
+    def _recover(self, cause, drain: Optional[dict] = None):
+        """One recovery attempt (caller holds _recover_lock):
+
+        1. quiesce — close every channel so all loops park and exit;
+        2. classify — survivors returned "closed"; dead loops raised;
+        3. restart — recreate dead FunctionNode executors, wait out the
+           actor-restart/migration of user actors (a drain migrates ALL
+           affected participants via the GCS, uncharged);
+        4. re-pin only the restarted participants' leases (partial);
+           release raylets the DAG no longer touches;
+        5. channels — reopen surviving segments (contents + cursors
+           kept); re-create only edges whose locality changed (re-home
+           ring<->store);
+        6. re-ship the run loops with resume directives; refresh driver
+           endpoints; re-arm the watcher.
+
+        The driver-side tick replay happens AFTER the lock drops (the
+        caller drains outputs concurrently — replaying under the lock
+        against a full ring would deadlock a single-threaded caller).
+        """
+        import ray_tpu
+        from ray_tpu import exceptions as exc
+        from ray_tpu._private import worker_api
+
+        core = worker_api.get_core()
+        deadline = time.time() + self._recovery_timeout_s
+        drain_nodes = set(drain["node_ids"]) if drain else set()
+        if drain:
+            # Hand off the dying members' pins FIRST: the draining
+            # raylet's drain_to_idle must never wait on this DAG.
+            stale = [a for a in self._pinned_raylets
+                     if a in set(drain.get("addrs") or ())]
+            if stale:
+                try:
+                    worker_api._call_on_core_loop(
+                        core, core.dag_release(self._dag_id, stale), 30)
+                except Exception:  # noqa: BLE001 — raylet may be gone
+                    pass
+
+        # 1 + 2: quiesce and classify.
+        for ch in list(self._channels):
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001
+                pass
+        dead: List[_Participant] = []
+        for p in self._participants:
+            try:
+                ray_tpu.get(p.loop_ref,
+                            timeout=max(1.0, deadline - time.time()))
+            except exc.GetTimeoutError:
+                raise DagExecutionError(
+                    "surviving executor loop did not quiesce within the "
+                    "recovery timeout")
+            except Exception:  # noqa: BLE001 — death cause re-derived below
+                dead.append(p)
+
+        # 3: restart the dead / drained participants.
+        to_restart: List[_Participant] = list(dead)
+        for p in self._participants:
+            if p in dead:
+                continue
+            pl = self._placements.get(p.handle._actor_id)
+            if drain_nodes and pl and pl.get("node_id") in drain_nodes:
+                to_restart.append(p)
+        for p in to_restart:
+            info = self._actor_state(core, p)
+            state = getattr(info, "state", "DEAD") if info else "DEAD"
+            if p.is_fn and (info is None or state == "DEAD"):
+                # DAG-owned executor with no restart budget: recreate it
+                # ourselves (same fn, same options).
+                try:
+                    ray_tpu.kill(p.handle)
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+                p.handle = _executor_actor_class().options(
+                    max_concurrency=1, **dict(p.fn_opts)).remote(p.fn)
+                self._executor_actors.append(p.handle)
+            else:
+                # User actor (or a drain-migrated executor): ride its own
+                # restart — max_restarts, or the uncharged
+                # preempted_restarts migration a drain already kicked.
+                self._wait_participant_alive(core, p, drain_nodes, deadline)
+        self._part_of_key = {k: p for p in self._participants
+                             for k in p.node_keys}
+
+        # 4: partial re-pin + stale-raylet release.
+        old_raylets = set(self._pinned_raylets)
+        restarted_ids = [p.handle._actor_id for p in to_restart]
+        for p in to_restart:
+            # Drop the replaced incarnation's placement so _pin prunes
+            # cleanly (recreated executors have a NEW actor id).
+            self._placements.pop(p.handle._actor_id, None)
+        if restarted_ids:
+            self._pin(restarted_ids, max(5.0, deadline - time.time()))
+        stale = sorted(old_raylets - set(self._pinned_raylets))
+        if stale:
+            try:
+                worker_api._call_on_core_loop(
+                    core, core.dag_release(self._dag_id, stale), 30)
+            except Exception:  # noqa: BLE001
+                pass
+
+        # 5: per-edge channel keep/reopen vs re-create/re-home.
+        self._epoch += 1
+        restarted_keys = {k for p in to_restart for k in p.node_keys}
+        for edge in self._edge_defs:
+            ch = self._edge_channels[edge["key"]]
+            want_ring = self._edge_is_ring(edge)
+            if want_ring == isinstance(ch, RingChannel):
+                ch.reopen()
+            else:
+                try:
+                    ch.destroy()
+                except Exception:  # noqa: BLE001
+                    pass
+                if ch in self._channels:
+                    self._channels.remove(ch)
+                self._make_edge_channel(edge)
+
+        # 6: resume directives + re-ship. EVERY survivor re-emits its
+        # cached tail from the replay floor: a quiesce can interrupt any
+        # node between caching a result and delivering it (the write
+        # raised ChannelClosedError), so every edge is potentially one
+        # tick short — and duplicates are filtered by sequence at every
+        # reader, so the blanket resend is safe where a lossy-edges-only
+        # resend provably is not.
+        replay_floor = self._collected
+        resume: Dict[int, dict] = {}
+        for k in range(len(self._compute_nodes)):
+            d = {"start": replay_floor, "careful": self._cache_bound}
+            if k not in restarted_keys:
+                d["resend_from"] = replay_floor
+            resume[k] = d
+        if self._torn_down:
+            raise _Unrecoverable(RuntimeError("compiled DAG was torn down"))
+        self._ship_loops(resume)
+        self._refresh_driver_endpoints()
+        self._arm_watcher(core)
+
+    def _actor_state(self, core, p: _Participant):
+        from ray_tpu._private import worker_api
+        try:
+            return worker_api._call_on_core_loop(
+                core, core.gcs.request("get_actor_info",
+                                       {"actor_id": p.handle._actor_id}), 10)
+        except Exception:  # noqa: BLE001 — GCS hiccup: treat as unknown
+            return None
+
+    def _wait_participant_alive(self, core, p: _Participant, avoid_nodes,
+                                deadline: float):
+        """Wait until the participant is ALIVE off `avoid_nodes` (its
+        restart is the GCS's job — max_restarts for kills, uncharged
+        migration for drains). DEAD-for-good is unrecoverable."""
+        while True:
+            info = self._actor_state(core, p)
+            state = getattr(info, "state", None)
+            if info is not None and state == "ALIVE" \
+                    and info.node_id is not None \
+                    and info.node_id not in avoid_nodes:
+                return info
+            if info is not None and state == "DEAD":
+                raise _Unrecoverable(DagRecoveryError(
+                    "participant actor died for good (max_restarts "
+                    "exhausted?) — cannot recompile in place",
+                    DagExecutionError("compiled DAG executor died",
+                                      None)))
+            if time.time() > deadline:
+                raise DagExecutionError(
+                    "timed out waiting for a participant restart during "
+                    "DAG recovery")
+            time.sleep(0.05)
+
+    def _replay_unacked(self) -> int:
+        """Re-drive every unacknowledged tick from the driver-side replay
+        buffer. Runs OUTSIDE the recovery lock: writes can block on a
+        full input ring and only the caller's collect drains the far
+        end. Duplicate deliveries are dropped by sequence everywhere, so
+        replaying a tick that survived inside a kept ring is harmless."""
+        epoch = self._watch_epoch
+        n = 0
+        for seq in sorted(self._replay):
+            if seq < self._collected:
+                continue
+            while True:
+                if self._torn_down or self._error is not None \
+                        or epoch != self._watch_epoch:
+                    return n
+                if seq not in self._replay:
+                    break  # collected while we were replaying
+                value = self._replay[seq]
+                try:
+                    with self._submit_lock:
+                        for w in self._input_writers:
+                            w.write((seq, value), timeout=0.25)
+                    n += 1
+                    break
+                except TimeoutError:
+                    continue  # ring full: release the lock, retry
+                except ChannelClosedError:
+                    return n  # a newer recovery pass took over
+        if n:
+            self.replayed_ticks += n
+            try:
+                _metric_handles()["replayed"].inc(n)
+            except Exception:  # noqa: BLE001
+                pass
+        return n
+
+    def _release_pins(self):
+        """Release every lease this DAG still pins (idempotent)."""
+        try:
+            from ray_tpu._private import worker_api
+            core = worker_api.peek_core()
+            if core is not None and self._pinned_raylets:
+                worker_api._call_on_core_loop(
+                    core, core.dag_release(self._dag_id,
+                                           list(self._pinned_raylets),
+                                           unregister=True), 30)
+        except Exception:  # noqa: BLE001 — cluster already down
+            pass
+
+    # ------------------------------------------------------------------
+    # Drain-aware proactive migration
+    # ------------------------------------------------------------------
+    def _register_drain_listener(self):
+        try:
+            from ray_tpu._private import worker_api
+            self._drain_seen = len(worker_api.drain_events())
+            if worker_api.add_drain_event_listener(self._on_drain_notice):
+                self._drain_cb = self._on_drain_notice
+        except Exception:  # noqa: BLE001 — driver without a core
+            pass
+
+    def _unregister_drain_listener(self):
+        if self._drain_cb is not None:
+            try:
+                from ray_tpu._private import worker_api
+                worker_api.remove_drain_event_listener(self._drain_cb)
+            except Exception:  # noqa: BLE001
+                pass
+            self._drain_cb = None
+
+    def _on_drain_notice(self):
+        """Core-loop callback on every drain/preemption notice: cheap
+        overlap check, then migration on its own thread."""
+        try:
+            if self._torn_down or self._error is not None \
+                    or self._migration_inflight:
+                return
+            from ray_tpu._private import worker_api
+            events = worker_api.drain_events()
+            fresh, self._drain_seen = events[self._drain_seen:], len(events)
+            if not fresh:
+                return
+            my_nodes = {pl["node_id"]
+                        for pl in self._placements.values()}
+            hit_nodes, hit_addrs, ddl = set(), set(), 0.0
+            for ev in fresh:
+                ids = list(ev.get("node_ids") or [])
+                if not ids and ev.get("node_id") is not None:
+                    ids = [ev["node_id"]]
+                ads = list(ev.get("addresses") or [])
+                if not ads and ev.get("address"):
+                    ads = [ev["address"]]
+                dag_ids = ev.get("dag_ids")
+                if (dag_ids and self._dag_id in dag_ids) \
+                        or any(i in my_nodes for i in ids):
+                    hit_nodes.update(ids)
+                    hit_addrs.update(ads)
+                    ddl = max(ddl, float(ev.get("deadline", 0.0)))
+            if hit_nodes & my_nodes:
+                self._migration_inflight = True
+                threading.Thread(
+                    target=self._drain_migrate,
+                    args=(hit_nodes, hit_addrs, ddl),
+                    daemon=True, name="dag-migrate").start()
+        except Exception:  # noqa: BLE001 — listeners must not break pubsub
+            pass
+
+    def _drain_migrate(self, node_ids: set, addrs: set,
+                       drain_deadline: float):
+        """Proactive migration off draining nodes: same recompile-in-place
+        machinery, entered BEFORE the kill — a drain with notice costs
+        zero failed ticks. Replayable DAGs cut over immediately (the
+        replay buffer completes in-flight ticks); non-replayable ones
+        migrate only from a quiesced pipeline (otherwise they keep
+        today's typed fail-fast when the deadline kill lands)."""
+        ok = False
+        try:
+            with self._recover_lock:
+                if self._torn_down or self._error is not None \
+                        or self._state != "running":
+                    return
+                affected = [
+                    p for p in self._participants
+                    if (self._placements.get(p.handle._actor_id) or {})
+                    .get("node_id") in node_ids]
+                if not affected:
+                    return
+                self._state = "recovering"
+                self._recovered_evt.clear()
+                if not self._tick_replay:
+                    budget = (drain_deadline - time.time() - 1.0) \
+                        if drain_deadline else 5.0
+                    qd = time.monotonic() + max(0.5, budget)
+                    while self._inflight > 0 and time.monotonic() < qd:
+                        time.sleep(0.01)
+                    if self._inflight > 0:
+                        # Can't drain the pipeline in time: leave it
+                        # running; the deadline kill surfaces as the
+                        # typed failure it always was.
+                        self._state = "running"
+                        self._recovered_evt.set()
+                        return
+                self._invalidate_watcher()
+                ok = self._run_recovery(
+                    NodeDrainedCause(list(node_ids)),
+                    drain={"node_ids": set(node_ids), "addrs": set(addrs)})
+            if ok and self._tick_replay:
+                self._replay_unacked()
+        finally:
+            self._migration_inflight = False
+            # Notices that landed WHILE this migration ran were left
+            # unconsumed by the listener (it early-returns on the
+            # inflight flag without advancing _drain_seen): reprocess
+            # them now, or a second node's drain would never migrate
+            # proactively.
+            try:
+                self._on_drain_notice()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------------
     # Execution
     # ------------------------------------------------------------------
+    def _await_running(self, deadline: Optional[float] = None):
+        """Block while a recovery pass owns the pipeline; re-raise the
+        typed error if it failed instead."""
+        while not self._recovered_evt.wait(timeout=0.25):
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    "compiled DAG still recovering past the deadline")
+        if self._error is not None:
+            raise self._error
+        if self._torn_down:
+            raise RuntimeError("compiled DAG was torn down")
+
     def execute(self, *args, timeout: Optional[float] = None) -> Any:
         """One pipeline tick, synchronously: channel write + read."""
         return self.execute_async(*args).result(timeout)
@@ -453,27 +1133,67 @@ class CompiledDAG:
         at least every `channel_depth` submissions (see
         StagePipeline.run for the windowed pattern); submitting
         unboundedly ahead would block this write with nobody draining
-        the output rings."""
-        self._check_live()
+        the output rings. During a recovery pass submission blocks until
+        the pipeline is RUNNING again (or raises its typed error)."""
         value = args[0] if len(args) == 1 else args
-        with self._submit_lock:
-            self._check_live()
-            try:
-                for w in self._input_writers:
-                    w.write(value)
-            except ChannelClosedError:
-                self._raise_dead()
-            seq = self._next_seq
-            self._next_seq += 1
-            self._submit_ts[seq] = time.time()
-            self._inflight += 1
-            self.max_inflight = max(self.max_inflight, self._inflight)
-            try:
-                _, gauge = _metric_handles()
-                gauge.set(float(self._inflight))
-            except Exception:  # noqa: BLE001 — metrics never block ticks
-                pass
-        return DagRef(self, seq)
+        deadline = time.monotonic() + self._recovery_timeout_s + 30.0
+        closed_retries = 0
+        while True:
+            self._await_running(deadline)
+            with self._submit_lock:
+                if not self._recovered_evt.is_set():
+                    continue  # a recovery started while we waited
+                seq = self._next_seq
+                try:
+                    for w in self._input_writers:
+                        w.write((seq, value))
+                except ChannelClosedError:
+                    if self._error is not None:
+                        raise self._error
+                    if self._torn_down:
+                        raise RuntimeError("compiled DAG was torn down")
+                    closed_retries += 1
+                    if closed_retries > 400:
+                        raise DagExecutionError(
+                            "compiled DAG channel closed unexpectedly")
+                    time.sleep(0.02)
+                    continue  # closed for recovery: wait it out
+                self._next_seq = seq + 1
+                if self._tick_replay:
+                    self._replay[seq] = value
+                self._submit_ts[seq] = time.time()
+                self._inflight += 1
+                self.max_inflight = max(self.max_inflight, self._inflight)
+                try:
+                    _metric_handles()["inflight"].set(float(self._inflight))
+                except Exception:  # noqa: BLE001 — metrics never block ticks
+                    pass
+            return DagRef(self, seq)
+
+    def _read_outputs(self, want: int, deadline: Optional[float]) -> list:
+        """Drain EVERY output for tick `want` (an unread channel would
+        hand this tick's value to the next collect); the same node bound
+        twice in a MultiOutputNode shares one reader — read it once.
+        Messages are (seq, value): duplicates below `want` (post-recovery
+        resends) are dropped, ahead-of-target values are stashed — which
+        also makes a result() timeout resumable (the drained readers'
+        cursors advanced persistently). copy=True detaches results from
+        the ring slots the writer will recycle `depth` ticks from now —
+        callers may hold results indefinitely."""
+        for idx, r in enumerate(self._output_readers):
+            stash = self._out_stash.setdefault(idx, {})
+            for stale in [s for s in stash if s < want]:
+                del stash[s]
+            while want not in stash:
+                remaining = None if deadline is None else \
+                    max(0.0, deadline - time.monotonic())
+                seq, val = r.read(timeout=remaining, copy=True)
+                if seq >= want:
+                    stash[seq] = val
+        outs = [self._out_stash[ridx][want] for ridx in self._output_map]
+        for idx in range(len(self._output_readers)):
+            self._out_stash.get(idx, {}).pop(want, None)
+        return outs
 
     def _collect(self, seq: int, timeout: Optional[float] = None) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -482,42 +1202,41 @@ class CompiledDAG:
                 raise ValueError(
                     f"DagRef for tick {seq} was already consumed — "
                     f"result() is one-shot")
+            closed_retries = 0
             while seq not in self._results:
                 if self._error is not None:
                     raise self._error
-                remaining = None if deadline is None else \
-                    max(0.0, deadline - time.monotonic())
-                outs = []
+                want = self._collected
                 try:
-                    # Drain EVERY output before the tick completes (an
-                    # unread channel would hand this tick's value to the
-                    # next collect); the same node bound twice in a
-                    # MultiOutputNode shares one reader — read it once.
-                    # Reads resume from _tick_buf after a timeout (their
-                    # cursors advanced persistently), and copy=True
-                    # detaches results from the ring slots the writer
-                    # will recycle `depth` ticks from now — callers may
-                    # hold results indefinitely.
-                    for r in self._output_readers:
-                        if id(r) not in self._tick_buf:
-                            self._tick_buf[id(r)] = r.read(
-                                timeout=remaining, copy=True)
-                        outs.append(self._tick_buf[id(r)])
-                    self._tick_buf.clear()
+                    outs = self._read_outputs(want, deadline)
                 except ChannelClosedError:
-                    self._raise_dead()
+                    if self._error is not None:
+                        raise self._error
+                    if self._torn_down:
+                        raise RuntimeError("compiled DAG was torn down")
+                    closed_retries += 1
+                    if closed_retries > 400:
+                        raise DagExecutionError(
+                            "compiled DAG channel closed unexpectedly")
+                    # Recovery in flight: wait for it, then resume the
+                    # drain against the refreshed readers.
+                    time.sleep(0.02)
+                    self._await_running(deadline)
+                    continue
+                closed_retries = 0
                 done_seq = self._collected
                 self._collected += 1
                 self._results[done_seq] = outs
+                self._replay.pop(done_seq, None)
                 self._inflight -= 1
                 self.ticks += 1
                 t0 = self._submit_ts.pop(done_seq, None)
                 now = time.time()
                 try:
-                    hist, gauge = _metric_handles()
+                    m = _metric_handles()
                     if t0 is not None:
-                        hist.observe(now - t0)
-                    gauge.set(float(self._inflight))
+                        m["tick"].observe(now - t0)
+                    m["inflight"].set(float(self._inflight))
                 except Exception:  # noqa: BLE001
                     pass
                 if t0 is not None:
@@ -529,25 +1248,16 @@ class CompiledDAG:
             raise err.error
         return outs if len(outs) > 1 else outs[0]
 
-    def _check_live(self):
-        if self._torn_down:
-            raise RuntimeError("compiled DAG was torn down")
-        if self._error is not None:
-            raise self._error
-
-    def _raise_dead(self):
-        if self._error is not None:
-            raise self._error
-        if self._torn_down:
-            raise RuntimeError("compiled DAG was torn down")
-        raise DagExecutionError("compiled DAG channel closed unexpectedly")
-
     def stats(self) -> dict:
         return {"dag_id": self._dag_id, "ticks": self.ticks,
                 "inflight": self._inflight,
                 "max_inflight": self.max_inflight,
                 "channels": len(self._channels),
-                "pinned_raylets": list(self._pinned_raylets)}
+                "pinned_raylets": list(self._pinned_raylets),
+                "state": self._state,
+                "tick_replay": self._tick_replay,
+                "recoveries": self.recoveries,
+                "replayed_ticks": self.replayed_ticks}
 
     # ------------------------------------------------------------------
     # Teardown
@@ -559,12 +1269,16 @@ class CompiledDAG:
         if self._torn_down:
             return
         self._torn_down = True
+        self._state = "torn_down"
+        self._recovered_evt.set()
         if self._watcher is not None:
             self._watcher.cancel()
+        self._watch_epoch += 1
+        self._unregister_drain_listener()
         import ray_tpu
         # Close BEFORE waiting: a loop blocked mid-read anywhere in the
         # pipeline only exits once its channels wake it.
-        for ch in self._channels:
+        for ch in list(self._channels):
             try:
                 ch.close()
             except Exception:  # noqa: BLE001
@@ -574,28 +1288,19 @@ class CompiledDAG:
                 ray_tpu.get(ref, timeout=10)
             except Exception:  # noqa: BLE001 — dead executor: lease died
                 pass
-        try:
-            from ray_tpu._private import worker_api
-            core = worker_api.peek_core()
-            if core is not None and self._pinned_raylets:
-                worker_api._call_on_core_loop(
-                    core, core.dag_release(self._dag_id,
-                                           self._pinned_raylets), 30)
-        except Exception:  # noqa: BLE001 — cluster already down
-            pass
+        self._release_pins()
         for a in self._executor_actors:
             try:
                 ray_tpu.kill(a)
             except Exception:  # noqa: BLE001
                 pass
-        for ch in self._channels:
+        for ch in list(self._channels):
             try:
                 ch.destroy()
             except Exception:  # noqa: BLE001
                 pass
         try:
-            _, gauge = _metric_handles()
-            gauge.set(0.0)
+            _metric_handles()["inflight"].set(0.0)
         except Exception:  # noqa: BLE001
             pass
 
@@ -617,6 +1322,19 @@ class CompiledDAG:
                 name, f"dag:{self._dag_id}", start, end))
         except Exception:  # noqa: BLE001 — observability never blocks
             pass
+
+
+class NodeDrainedCause(Exception):
+    """Cause marker for drain-triggered (proactive) recoveries."""
+
+    def __init__(self, node_ids):
+        names = []
+        for n in node_ids:
+            try:
+                names.append(n.hex()[:12])
+            except AttributeError:
+                names.append(str(n))
+        super().__init__(f"nodes draining: {names}")
 
 
 class DagRef:
@@ -653,10 +1371,14 @@ def _executor_actor_class():
 
             def __init__(self, fn):
                 self._fn = fn
+                self._dag_state = {}
 
-            def run_loop(self, node_specs):
-                return _run_compiled_loop([self._fn] * len(node_specs),
-                                          node_specs)
+            def run_loop(self, node_specs, node_keys=None, resume=None,
+                         cache_bound=64, dag_id="", detach=False):
+                return _run_compiled_loop(
+                    [self._fn] * len(node_specs), node_specs, node_keys,
+                    self._dag_state.setdefault(dag_id, {}), resume,
+                    cache_bound, detach)
 
         _executor_cls = _DAGExecutor
     return _executor_cls

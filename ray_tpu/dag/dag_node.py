@@ -81,13 +81,17 @@ class DAGNode:
         raise NotImplementedError
 
     def experimental_compile(self, max_message_size: int = 1 << 20,
-                             channel_depth: int = 2):
+                             channel_depth: int = 2,
+                             tick_replay: bool = False):
         """Lower this graph onto pre-leased actors + reusable shm
         channels (dag/compiled.py). `channel_depth` bounds how many
-        pipelined executions can be in flight at once."""
+        pipelined executions can be in flight at once; `tick_replay`
+        arms in-place recovery (executor death -> restart + exactly-once
+        replay of unacknowledged ticks instead of a typed failure)."""
         from ray_tpu.dag.compiled import CompiledDAG
         return CompiledDAG(self, max_message_size,
-                           channel_depth=channel_depth)
+                           channel_depth=channel_depth,
+                           tick_replay=tick_replay)
 
 
 class InputNode(DAGNode):

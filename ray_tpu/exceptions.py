@@ -155,7 +155,22 @@ class DagExecutionError(RayTpuError):
             pickle.dumps(cause)
         except Exception:
             cause = RayTpuError(f"{type(self.cause).__name__}: {self.cause}")
-        return (DagExecutionError, (self.reason, cause))
+        # type(self), not the base class: DagRecoveryError must survive
+        # a pickle round trip as itself.
+        return (type(self), (self.reason, cause))
+
+
+class DagRecoveryError(DagExecutionError):
+    """In-place recovery of a `tick_replay` compiled DAG failed: a
+    participant died for good (max_restarts exhausted), re-pinning its
+    replacement's lease failed repeatedly, or the recovery timed out.
+    Subclasses DagExecutionError so existing fail-fast handlers keep
+    working; the DAG must be torn down and recompiled.
+    """
+
+    def __init__(self, reason: str = "compiled DAG recovery failed",
+                 cause: BaseException | None = None):
+        super().__init__(reason, cause)
 
 
 class RuntimeEnvSetupError(RayTpuError):

@@ -148,6 +148,19 @@ class _RingBase:
         except (ValueError, TypeError):
             pass  # segment already torn down
 
+    def reopen(self) -> None:
+        """Clear the closed flag so a SURVIVING segment can carry traffic
+        again after a recovery pass quiesced it. Contents, the writer
+        seq, and every reader cursor are preserved — in-flight messages
+        that were in the ring when the channel closed are still
+        delivered. Only call once every attached loop has observed the
+        close and exited (compiled-DAG recovery awaits the loop refs
+        first); reopening under a live reader would race its drain."""
+        try:
+            struct.pack_into("<I", self._buf, _CLOSED_OFF, 0)
+        except (ValueError, TypeError):
+            pass  # segment already torn down
+
     def _cursor(self, idx: int) -> int:
         return struct.unpack_from("<Q", self._buf,
                                   self._cursor_off + 8 * idx)[0]
@@ -211,6 +224,12 @@ class RingChannel(_RingBase):
                                       self.n_readers, _attached=self)
         self._writer.write(value, timeout)
 
+    def write_bytes(self, data, timeout: Optional[float] = None) -> None:
+        if self._writer is None:
+            self._writer = RingWriter(self.name, self.depth, self.slot_size,
+                                      self.n_readers, _attached=self)
+        self._writer.write_bytes(data, timeout)
+
     def writer(self) -> "RingWriter":
         return RingWriter(self.name, self.depth, self.slot_size,
                           self.n_readers)
@@ -267,6 +286,26 @@ class RingWriter(_RingBase):
             if not hasattr(self, "_held_refs"):
                 self._held_refs = {}
             self._held_refs[seq] = ref
+        self._write_slot(seq, ser.total_size, ser.write_to, timeout)
+
+    def write_bytes(self, data, timeout: Optional[float] = None) -> None:
+        """Write an ALREADY-serialized message (the same wire format
+        write() produces). Compiled-DAG loops with recovery armed
+        serialize once, cache the private bytes for resend, and ship
+        them here — a cached live object could alias a zero-copy view
+        onto a ring slot the writer has since recycled."""
+        if len(data) > self.slot_size:
+            # Oversize falls back through the value path (the payload
+            # must ride the object store as a ref).
+            self.write(_serialization_ctx().deserialize(data), timeout)
+            return
+
+        def _fill(buf):
+            buf[:len(data)] = data
+
+        self._write_slot(self._writer_seq(), len(data), _fill, timeout)
+
+    def _write_slot(self, seq: int, size: int, fill, timeout) -> None:
         deadline = None if timeout is None else time.monotonic() + timeout
         blocked = False
         spin = 0
@@ -286,9 +325,9 @@ class RingWriter(_RingBase):
         base = self._slot_view(seq)
         _SLOT_HEADER.pack_into(self._buf, base, 2 * seq + 1, 0)
         payload = self._buf[base + _SLOT_HEADER.size:
-                            base + _SLOT_HEADER.size + ser.total_size]
-        ser.write_to(payload)
-        _SLOT_HEADER.pack_into(self._buf, base, 2 * seq + 2, ser.total_size)
+                            base + _SLOT_HEADER.size + size]
+        fill(payload)
+        _SLOT_HEADER.pack_into(self._buf, base, 2 * seq + 2, size)
         self._set_writer_seq(seq + 1)
         # Drop refs every reader has consumed (oversize lifetime bound).
         held = getattr(self, "_held_refs", None)
@@ -436,12 +475,17 @@ class StoreChannel:
     """
 
     def __init__(self, channel_id: str, depth: int = 2, n_readers: int = 1,
-                 inline_limit: int = _INLINE_LIMIT):
+                 inline_limit: int = _INLINE_LIMIT, _attach: bool = False):
         self.channel_id = channel_id
         self.depth = int(depth)
         self.n_readers = int(n_readers)
         self.inline_limit = int(inline_limit)
-        self._seq = 0
+        # An ATTACHED copy (unpickled on a shipped loop) resumes the
+        # persisted writer seq lazily on its first write: a compiled-DAG
+        # recovery re-ships the writer role to a surviving/restarted
+        # executor, and restarting at 0 would overwrite live message
+        # keys that readers' persisted cursors still point past.
+        self._seq: Optional[int] = None if _attach else 0
         self._held_refs = {}
         self._next_reader = 0
         self._closed_local = False
@@ -471,7 +515,42 @@ class StoreChannel:
         return _kv_get(self._closed_key()) is not None
 
     # -- writer side ---------------------------------------------------
+    def _resume_writer_seq(self) -> int:
+        """An attached copy derives the persisted writer seq on its
+        first write: probe message keys upward from the most-advanced
+        reader cursor (readers never pass the writer; undelivered
+        backlog <= depth keys exist above the GC floor). Restarting at 0
+        would overwrite live message keys past the readers' cursors."""
+        seq = 0
+        for i in range(self.n_readers):
+            raw = _kv_get(self._ckey(i))
+            seq = max(seq, int(raw) if raw else 0)
+        while _kv_get(self._mkey(seq)) is not None:
+            seq += 1
+        return seq
+
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        ser = _serialization_ctx().serialize(value)
+        if ser.total_size > self.inline_limit:
+            from ray_tpu._private import worker_api
+            ref = worker_api.put(value)
+            body = pickle.dumps(("r", ref), protocol=5)
+            self._write_body(body, timeout, held_ref=ref)
+        else:
+            self._write_body(b"v" + ser.to_bytes(), timeout)
+
+    def write_bytes(self, data, timeout: Optional[float] = None) -> None:
+        """Write an ALREADY-serialized message (write()'s inline wire
+        format); oversize payloads fall back through the value path."""
+        if len(data) > self.inline_limit:
+            self.write(_serialization_ctx().deserialize(data), timeout)
+            return
+        self._write_body(b"v" + bytes(data), timeout)
+
+    def _write_body(self, body: bytes, timeout: Optional[float],
+                    held_ref=None) -> None:
+        if self._seq is None:
+            self._seq = self._resume_writer_seq()
         deadline = None if timeout is None else time.monotonic() + timeout
         blocked = False
         while self._seq - self._min_cursor() >= self.depth:
@@ -486,14 +565,8 @@ class StoreChannel:
             time.sleep(0.02)
         if self.closed():
             raise ChannelClosedError(self.channel_id)
-        ser = _serialization_ctx().serialize(value)
-        if ser.total_size > self.inline_limit:
-            from ray_tpu._private import worker_api
-            ref = worker_api.put(value)
-            self._held_refs[self._seq] = ref
-            body = pickle.dumps(("r", ref), protocol=5)
-        else:
-            body = b"v" + ser.to_bytes()
+        if held_ref is not None:
+            self._held_refs[self._seq] = held_ref
         _kv_put(self._mkey(self._seq), body)
         self._seq += 1
         floor = self._min_cursor()
@@ -514,6 +587,18 @@ class StoreChannel:
                            idx)
 
     # -- lifecycle -----------------------------------------------------
+    def reopen(self) -> None:
+        """Recovery counterpart of close(): drop the closed record so the
+        channel carries traffic again. Message bodies and per-reader
+        cursors live in the KV and are untouched — a reader (even one
+        whose hosting process was restarted) resumes from its persisted
+        cursor. Call only after every attached loop exited."""
+        self._closed_local = False
+        try:
+            _kv_del(self._closed_key())
+        except Exception:  # noqa: BLE001 — cluster already down
+            pass
+
     def close(self) -> None:
         self._closed_local = True
         try:
@@ -548,13 +633,15 @@ class StoreChannel:
 
     def __reduce__(self):
         # Crossing processes hands over the WRITER role (single-writer:
-        # the creator stops writing once it ships the channel, and it
-        # ships BEFORE the first write — seq restarts at 0). No KV probe
-        # here: unpickling happens on the receiving core loop, where a
-        # blocking KV round trip would deadlock.
+        # the previous writer stops before the copy starts — compile
+        # ships before the first write, recovery awaits the old loop's
+        # exit). The attached copy resolves the persisted writer seq
+        # lazily on its FIRST WRITE, never here: unpickling happens on
+        # the receiving core loop, where a blocking KV round trip would
+        # deadlock.
         return (StoreChannel,
                 (self.channel_id, self.depth, self.n_readers,
-                 self.inline_limit))
+                 self.inline_limit, True))
 
 
 class StoreReader:

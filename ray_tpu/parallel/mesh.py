@@ -130,6 +130,11 @@ CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5e": 8, "v5p": 4, "v6e": 8}
 # hosts of one ICI domain share the value; the GCS groups them into one
 # gang for drain/recovery (a preempted host kills the whole slice).
 SLICE_LABEL = "ray_tpu.io/slice"
+# Node label for the DCN locality domain (pod / cloud zone): slices in
+# one zone talk over the same data-center network fabric, so replacement
+# domains in the SAME zone are preferred when a gang (or a compiled DAG
+# pinned to it) migrates off a preempted slice.
+ZONE_LABEL = "ray_tpu.io/zone"
 # Real accelerator-type strings use pod aliases (v5e-16 => "v5litepod-16").
 GEN_ALIASES = {"v5litepod": "v5e", "v6litepod": "v6e"}
 
@@ -204,6 +209,24 @@ def detect_slice_id(labels: Optional[Dict[str, str]] = None) -> str:
         import hashlib
         digest = hashlib.sha1(hostnames.encode()).hexdigest()[:12]
         return f"hosts:{digest}"
+    return ""
+
+
+def detect_zone(labels: Optional[Dict[str, str]] = None) -> str:
+    """DCN locality key for this host — shared by every slice in one
+    pod/zone, "" when unknown. Precedence: an explicit
+    `ray_tpu.io/zone` label (tests, heterogeneous deployments), then
+    the cloud runtime's zone env (`RAY_TPU_ZONE`, `CLOUD_ZONE`,
+    `TPU_ZONE`). Multi-slice DCN topology awareness: gang recovery and
+    compiled-DAG migration prefer replacement domains in the SAME zone,
+    so cross-slice traffic stays on the local fabric."""
+    explicit = (labels or {}).get(ZONE_LABEL, "")
+    if explicit:
+        return explicit
+    for env in ("RAY_TPU_ZONE", "CLOUD_ZONE", "TPU_ZONE"):
+        v = os.environ.get(env, "")
+        if v:
+            return v
     return ""
 
 

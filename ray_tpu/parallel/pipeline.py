@@ -271,7 +271,14 @@ class StagePipeline:
     """
 
     def __init__(self, stages, method: str = "__call__", *,
-                 channel_depth: int = 4, max_message_size: int = 1 << 20):
+                 channel_depth: int = 4, max_message_size: int = 1 << 20,
+                 tick_replay: bool = True):
+        """tick_replay=True (default) arms the compiled DAG's in-place
+        recovery: a stage actor dying mid-stream is restarted (give the
+        stages `max_restarts`!), its lease re-pinned, channels re-homed
+        and every unacknowledged microbatch replayed exactly once —
+        run() simply keeps returning results. tick_replay=False keeps
+        the typed fail-fast `DagExecutionError`."""
         if not stages:
             raise ValueError("StagePipeline needs at least one stage")
         from ray_tpu.dag.compiled import CompiledDAG
@@ -284,7 +291,8 @@ class StagePipeline:
         self.channel_depth = channel_depth
         self._dag = CompiledDAG.compile(
             node, channel_depth=channel_depth,
-            max_message_size=max_message_size)
+            max_message_size=max_message_size,
+            tick_replay=tick_replay)
 
     def submit(self, value):
         """Inject one microbatch; returns a DagRef. The input write

@@ -151,6 +151,70 @@ class ControllerKiller(_KillerBase):
                     return
 
 
+class DagExecutorKiller(_KillerBase):
+    """SIGKILLs a worker hosting a compiled-DAG executor (a worker with a
+    pinned lease, `handle.dag_pins` non-empty) — the self-healing-DAG
+    chaos shape. A `tick_replay` DAG must absorb each kill with an
+    in-place recovery (exactly-once ticks, surviving executors keep
+    their pids); a non-replayable one must fail typed.
+
+    notice=True exercises the drain path instead: the node hosting a
+    pinned worker gets a two-phase drain notice, the deadline passes,
+    and the host is hard-reclaimed (notice-then-kill) — the DAG's
+    proactive migration must move the executors off before the kill
+    lands. Reuses the shared `_respawn`/`_hard_reclaim` recipe so a
+    respawned replacement node carries the victim's resources."""
+
+    def __init__(self, cluster, interval_s: float = 1.0,
+                 max_kills: int = 3, seed: Optional[int] = None,
+                 notice: bool = False, deadline_s: float = 3.0,
+                 grace_s: float = 0.3, respawn: bool = False,
+                 dag_id: str = ""):
+        super().__init__(interval_s, max_kills, seed)
+        self.cluster = cluster
+        self.notice = notice
+        self.deadline_s = deadline_s
+        self.grace_s = grace_s
+        self.respawn = respawn
+        self.dag_id = dag_id      # restrict kills to one DAG's pins
+
+    def _pinned(self):
+        """(raylet, handle) pairs whose worker holds a DAG pin."""
+        out = []
+        for raylet in self.cluster.raylets:
+            for handle in raylet.workers.values():
+                pins = getattr(handle, "dag_pins", None) or ()
+                if handle.pid > 0 and pins and \
+                        (not self.dag_id or self.dag_id in pins):
+                    out.append((raylet, handle))
+        return out
+
+    def _kill_one(self):
+        pinned = self._pinned()
+        if not pinned:
+            return
+        raylet, handle = self._rng.choice(pinned)
+        if self.notice:
+            if raylet.is_head:
+                return  # never reclaim the head in the notice variant
+            resources = dict(raylet.pool.total)
+            slice_id = getattr(raylet, "slice_id", "")
+            self.cluster.drain_node(raylet, deadline_s=self.deadline_s,
+                                    grace_s=self.grace_s, wait=False)
+            time.sleep(self.deadline_s)
+            _hard_reclaim(self.cluster, raylet)
+            self.kills.append(f"dag-drain:{raylet.node_name}")
+            if self.respawn:
+                time.sleep(0.2)
+                _respawn(self.cluster, resources, slice_id)
+        else:
+            try:
+                os.kill(handle.pid, signal.SIGKILL)
+                self.kills.append(f"dag-executor:{handle.pid}")
+            except OSError:
+                pass
+
+
 class NodeKiller(_KillerBase):
     """Removes a random non-head raylet (reference: NodeKillerActor
     test_utils.py:1498). Lineage reconstruction and actor failover must

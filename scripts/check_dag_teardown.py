@@ -24,9 +24,20 @@ Checked invariants:
     and re-raises (a failed compile must not leak what it acquired);
   * the failure watcher path (_fail) closes channels so blocked
     executes surface the typed error instead of wedging;
+  * recovery-path acquisitions pair with releases on the
+    recovery-FAILURE path: a re-pin (_recover -> dag_pin_actors /
+    self._pin) requires dag_release reachable from _recovery_failed (a
+    DAG that will never tick again must not hold OOM/reaper-exempt
+    leases until the user happens to call teardown), and a channel
+    re-create inside _recover must register into self._channels so the
+    ordinary teardown destroy sweep covers it;
+  * the recovery driver (_run_recovery) routes every failed attempt
+    through _recovery_failed, which must reach _fail (blocked executes
+    wake typed instead of wedging on a half-recovered pipeline);
   * experimental/channels.py: every channel class exposes BOTH close()
     and destroy() (wake-everyone vs release-the-segment are distinct
-    duties; teardown needs both).
+    duties; teardown needs both), and reopen() (recovery keeps
+    surviving segments; a close it cannot undo would strand them).
 
 Exit status 0 = every acquisition releases; 1 = gaps (printed).
 """
@@ -172,6 +183,41 @@ def check() -> list:
             f"channel so blocked executes raise typed instead of "
             f"wedging")
 
+    # Recovery-path acquire/release pairing (self-healing DAGs).
+    if "_recover" in dag_fns:
+        recover_src = _transitive_source(dag_fns, "_recover")
+        recfail_src = _transitive_source(dag_fns, "_recovery_failed")
+        if re.search(r"dag_pin_actors\(|self\._pin\(", recover_src) and \
+                not re.search(r"dag_release\(", recfail_src):
+            problems.append(
+                f"{COMPILED}: _recover re-pins worker leases but the "
+                f"recovery-failure path (_recovery_failed) never matches "
+                f"/dag_release\\(/ — a failed recovery must not leave "
+                f"OOM/reaper-exempt leases pinned until teardown")
+        if re.search(r"RingChannel\(|StoreChannel\(", recover_src) and \
+                not re.search(r"_channels\.append\(", recover_src) and \
+                not re.search(r"\.destroy\(\)", recfail_src):
+            problems.append(
+                f"{COMPILED}: _recover re-creates channels without "
+                f"registering them into self._channels (teardown's "
+                f"destroy sweep) or destroying them in _recovery_failed "
+                f"— a re-homed edge's segment/KV records would leak")
+        driver_src = _transitive_source(dag_fns, "_run_recovery")
+        if "_run_recovery" in dag_fns and \
+                not re.search(r"self\._recovery_failed\(", driver_src):
+            problems.append(
+                f"{COMPILED}: _run_recovery must route failed attempts "
+                f"through self._recovery_failed(...)")
+        if not re.search(r"self\._fail\(", recfail_src):
+            problems.append(
+                f"{COMPILED}: _recovery_failed must reach _fail so "
+                f"blocked executes wake typed instead of wedging")
+    elif re.search(r"tick_replay", "".join(dag_fns.values())):
+        problems.append(
+            f"{COMPILED}: tick_replay is accepted but CompiledDAG has "
+            f"no _recover — recovery renamed? update "
+            f"check_dag_teardown.py")
+
     cpath = os.path.join(REPO, CHANNELS)
     try:
         ch_fns, ch_bases = _class_functions(cpath)
@@ -184,12 +230,13 @@ def check() -> list:
                 f"renamed? update check_dag_teardown.py")
             continue
         fns = _resolved_methods(ch_fns, ch_bases, cls)
-        for required in ("close", "destroy"):
+        for required in ("close", "destroy", "reopen"):
             if required not in fns:
                 problems.append(
                     f"{CHANNELS}: {cls} has no {required}() — teardown "
                     f"needs close (wake blocked ends) AND destroy "
-                    f"(release the segment/records) as distinct duties")
+                    f"(release the segment/records); recovery needs "
+                    f"reopen (kept segments must carry traffic again)")
     return problems
 
 

@@ -505,6 +505,253 @@ class TestCompiledDagSubsystem:
             c.teardown()
 
 
+class TestCompiledDagRecovery:
+    """ISSUE 13 acceptance: self-healing compiled DAGs — in-place
+    recovery, exactly-once tick replay, no teardown/recompile."""
+
+    def _pids_by_actor(self, raylet):
+        return {h.actor_id: h.pid for h in raylet.workers.values()
+                if h.actor_id is not None}
+
+    @pytest.mark.timeout(120)
+    def test_sigkill_executor_exactly_once(self, ray_start, tmp_path):
+        """SIGKILL one executor mid-pipelined-stream on a tick_replay
+        DAG: every submitted tick's result is delivered exactly once (no
+        duplicates, no gaps), the SAME CompiledDAG object keeps
+        executing (no teardown/recompile by the caller), surviving
+        executors keep their pids and never recompute a tick they
+        already processed, pins are rebalanced onto the replacement
+        worker, and ray_tpu_dag_recoveries_total increments once."""
+        import os
+        import signal
+
+        from ray_tpu._private import worker_api
+        from ray_tpu.dag.compiled import CompiledDAG
+        from ray_tpu.util import metrics as _metrics
+
+        log_dir = str(tmp_path)
+
+        @ray_start.remote(max_restarts=-1)
+        class Stage:
+            def __init__(self, off):
+                self.off = off
+                self._log = open(f"{log_dir}/stage_{off}.log", "a")
+
+            def apply(self, x):
+                # Side-effect log: a survivor recomputing a tick after
+                # recovery would duplicate its line here.
+                self._log.write(f"{x}\n")
+                self._log.flush()
+                return x + self.off
+
+        stages = [Stage.remote(1), Stage.remote(10), Stage.remote(100)]
+        with InputNode() as inp:
+            node = inp
+            for s in stages:
+                node = s.apply.bind(node)
+        c = CompiledDAG.compile(node, channel_depth=4, tick_replay=True)
+        raylet = worker_api._state.head.raylet
+        pids0 = self._pids_by_actor(raylet)
+        victim = pids0[stages[1]._actor_id]
+        rec0 = {m["name"]: m.get("value", 0.0)
+                for m in _metrics.snapshot()}.get(
+                    "ray_tpu_dag_recoveries_total", 0.0)
+        from collections import deque
+        pending = deque()
+        out = []
+        try:
+            for i in range(60):
+                if len(pending) >= 4:
+                    out.append(pending.popleft().result(timeout=90))
+                pending.append(c.execute_async(i))
+                if i == 25:
+                    os.kill(victim, signal.SIGKILL)
+            while pending:
+                out.append(pending.popleft().result(timeout=90))
+            # Exactly once, in order — no duplicates, no gaps, no typed
+            # error ever surfaced to the caller.
+            assert out == [i + 111 for i in range(60)]
+            assert c.recoveries == 1 and c.replayed_ticks >= 1
+            assert c.stats()["state"] == "running"
+            snap = {m["name"]: m.get("value", 0.0)
+                    for m in _metrics.snapshot()}
+            assert snap["ray_tpu_dag_recoveries_total"] == rec0 + 1
+            assert snap.get("ray_tpu_dag_replayed_ticks_total", 0) >= 1
+            # Survivors kept their pids; the victim was replaced.
+            pids1 = self._pids_by_actor(raylet)
+            assert pids1[stages[0]._actor_id] == pids0[stages[0]._actor_id]
+            assert pids1[stages[2]._actor_id] == pids0[stages[2]._actor_id]
+            assert pids1[stages[1]._actor_id] != victim
+            # Pins rebalanced: 3 again, dead worker's pin dropped.
+            assert len(raylet._dag_pins[c._dag_id]) == 3
+            # Survivors deduped by sequence: no tick recomputed (their
+            # side-effect logs hold exactly one line per tick).
+            lines = [ln for ln in
+                     open(f"{log_dir}/stage_100.log").read().splitlines()]
+            assert sorted(int(v) for v in lines) == \
+                [i + 11 for i in range(60)]
+            # Post-recovery steady state on the SAME object.
+            for i in range(60, 70):
+                assert c.execute(i, timeout=30) == i + 111
+        finally:
+            c.teardown()
+        assert c._dag_id not in raylet._dag_pins
+
+    @pytest.mark.timeout(120)
+    def test_non_replayable_keeps_typed_fail_fast(self, ray_start):
+        """Default (tick_replay=False) DAGs keep PR 12's contract: the
+        kill surfaces as DagExecutionError, no silent recovery."""
+        import os
+        import signal
+        import time as _time
+
+        from ray_tpu._private import worker_api
+        from ray_tpu.dag.compiled import CompiledDAG
+        from ray_tpu.exceptions import DagExecutionError
+
+        @ray_start.remote
+        class Stage:
+            def apply(self, x):
+                return x + 1
+
+        stages = [Stage.remote(), Stage.remote()]
+        with InputNode() as inp:
+            node = inp
+            for s in stages:
+                node = s.apply.bind(node)
+        c = CompiledDAG.compile(node, channel_depth=2)
+        try:
+            assert c.execute(0) == 2
+            raylet = worker_api._state.head.raylet
+            pid = next(h.pid for h in raylet.workers.values()
+                       if h.actor_id == stages[0]._actor_id)
+            ref = c.execute_async(1)
+            os.kill(pid, signal.SIGKILL)
+            with pytest.raises(DagExecutionError):
+                ref.result(timeout=60)
+            with pytest.raises(DagExecutionError):
+                c.execute(2)
+            assert c.recoveries == 0
+        finally:
+            c.teardown()
+
+    @pytest.mark.timeout(180)
+    def test_double_death_and_death_during_recovery(self, ray_start):
+        """Two executors dying at once are absorbed by one recovery
+        pass; a replacement dying DURING recovery (injected right after
+        the loop re-ship) is absorbed by the retrying watcher — the
+        stream still completes exactly once."""
+        import os
+        import signal
+
+        from ray_tpu._private import worker_api
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        @ray_start.remote
+        def double(x):
+            return x * 2
+
+        @ray_start.remote
+        def add_one(x):
+            return x + 1
+
+        with InputNode() as inp:
+            dag = add_one.bind(double.bind(inp))
+        c = CompiledDAG.compile(dag, channel_depth=4, tick_replay=True)
+        raylet = worker_api._state.head.raylet
+        from collections import deque
+        try:
+            assert c.execute(5) == 11
+            # Phase 1: kill BOTH executors' workers simultaneously.
+            victims = [
+                next(h.pid for h in raylet.workers.values()
+                     if h.actor_id == p.handle._actor_id)
+                for p in c._participants]
+            pending = deque()
+            out = []
+            for i in range(40):
+                if len(pending) >= 4:
+                    out.append(pending.popleft().result(timeout=90))
+                pending.append(c.execute_async(i))
+                if i == 10:
+                    for v in victims:
+                        os.kill(v, signal.SIGKILL)
+            while pending:
+                out.append(pending.popleft().result(timeout=90))
+            assert out == [i * 2 + 1 for i in range(40)]
+            assert c.recoveries >= 1
+            # Phase 2: kill one executor, then kill ANOTHER the moment
+            # the recovery pass re-ships the loops.
+            rec1 = c.recoveries
+            victim = next(h.pid for h in raylet.workers.values()
+                          if h.actor_id ==
+                          c._participants[1].handle._actor_id)
+            injected = []
+            orig_ship = c._ship_loops
+
+            def ship_then_kill(resume_map):
+                orig_ship(resume_map)
+                if resume_map and not injected:
+                    injected.append(True)
+                    aid = c._participants[0].handle._actor_id
+                    pid = next((h.pid for h in raylet.workers.values()
+                                if h.actor_id == aid), None)
+                    if pid:
+                        os.kill(pid, signal.SIGKILL)
+
+            c._ship_loops = ship_then_kill
+            pending = deque()
+            out = []
+            for i in range(40):
+                if len(pending) >= 4:
+                    out.append(pending.popleft().result(timeout=120))
+                pending.append(c.execute_async(i))
+                if i == 10:
+                    os.kill(victim, signal.SIGKILL)
+            while pending:
+                out.append(pending.popleft().result(timeout=120))
+            assert out == [i * 2 + 1 for i in range(40)]
+            assert injected and c.recoveries > rec1
+        finally:
+            c.teardown()
+
+    @pytest.mark.timeout(120)
+    def test_stage_pipeline_survives_stage_death(self, ray_start):
+        """StagePipeline (tick_replay default) absorbs a stage death
+        transparently: run() returns every microbatch exactly once."""
+        import os
+        import signal
+        import threading
+        import time as _time
+
+        from ray_tpu._private import worker_api
+        from ray_tpu.parallel.pipeline import StagePipeline
+
+        @ray_start.remote(max_restarts=-1)
+        class Stage:
+            def __init__(self, tag):
+                self.tag = tag
+
+            def apply(self, x):
+                _time.sleep(0.01)   # keep the stream alive past the kill
+                return x + [self.tag]
+
+        stages = [Stage.remote(t) for t in ("a", "b", "c")]
+        raylet = worker_api._state.head.raylet
+        with StagePipeline(stages, method="apply",
+                           channel_depth=4) as pipe:
+            victim = next(h.pid for h in raylet.workers.values()
+                          if h.actor_id == stages[1]._actor_id)
+            timer = threading.Timer(
+                0.4, lambda: os.kill(victim, signal.SIGKILL))
+            timer.start()
+            try:
+                outs = pipe.run(([[i] for i in range(150)]), timeout=90)
+            finally:
+                timer.cancel()
+            assert outs == [[i, "a", "b", "c"] for i in range(150)]
+            assert pipe.stats()["recoveries"] >= 1
+
 class TestCompiledDagLatency:
     @pytest.mark.timeout(60)
     def test_compiled_latency_beats_task_path(self, ray_shared):

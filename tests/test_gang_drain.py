@@ -500,6 +500,181 @@ def test_chaos_slice_preemption_killer(ray_cluster):
     assert ray_cluster.gcs.gang_drains_total >= 1
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 13: compiled DAGs ride the gang-drain machinery
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(180)
+def test_gang_drain_migrates_compiled_dag_zero_failed_ticks(ray_cluster):
+    """A slice drain WITH notice proactively migrates a compiled DAG
+    pinned to the slice: zero DagExecutionError reaches the caller,
+    every tick lands exactly once, the drained raylets reach
+    drain_complete before the deadline (no pin wedge), and the drain
+    notice carries the affected dag_id (GCS dag index)."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+    from ray_tpu.dag.compiled import CompiledDAG
+    from ray_tpu.util.scheduling_strategies import \
+        NodeAffinitySchedulingStrategy
+
+    a1, a2 = _add_slice(ray_cluster, "slice-dag", "TPU-dag-head")
+    ray_cluster.connect()
+    ray_cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, off):
+            self.off = off
+
+        def apply(self, x):
+            return x + self.off
+
+    s1 = Stage.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            a1.node_id, soft=True), max_restarts=-1).remote(1)
+    s2 = Stage.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            a2.node_id, soft=True), max_restarts=-1).remote(10)
+    with InputNode() as inp:
+        dag = s2.apply.bind(s1.apply.bind(inp))
+    c = CompiledDAG.compile(dag, channel_depth=4, tick_replay=True)
+    try:
+        assert c.execute(0) == 11
+        # The GCS dag index knows this DAG's footprint: a drain of any
+        # slice member must name it in the published notice.
+        gcs = ray_cluster.gcs
+        assert c._dag_id in gcs._dag_index
+        assert gcs._dags_on_nodes([a1.node_id]) == [c._dag_id]
+
+        errors, out, stop = [], [], threading.Event()
+
+        def pump():
+            i = 1
+            while not stop.is_set() and i <= 400:
+                try:
+                    out.append((i, c.execute(i, timeout=60)))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+                i += 1
+                time.sleep(0.005)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        t0 = time.time()
+        # Drain ONE member: the GCS escalates to the whole gang.
+        ray_cluster.drain_node(a1, deadline_s=8.0, grace_s=0.3,
+                               wait=True)
+        drain_dt = time.time() - t0
+        time.sleep(1.0)
+        stop.set()
+        t.join(timeout=30)
+
+        assert not errors, errors
+        assert all(v == i + 11 for i, v in out), \
+            [x for x in out if x[1] != x[0] + 11][:5]
+        assert drain_dt < 7.0, \
+            f"drain took {drain_dt:.1f}s — DAG pins wedged the raylet"
+        assert ray_cluster.gcs.gang_drains_total >= 1
+        # Post-migration steady state off the dead slice.
+        for i in range(1000, 1010):
+            assert c.execute(i, timeout=30) == i + 11
+    finally:
+        c.teardown()
+    for raylet in ray_cluster.raylets:
+        assert c._dag_id not in raylet._dag_pins
+
+
+@pytest.mark.timeout(120)
+def test_gang_migration_prefers_same_zone_replacement(ray_cluster):
+    """Multi-slice DCN topology awareness: actors migrating off a
+    draining slice land on a replacement node in the SAME pod/zone when
+    one fits, not on an arbitrary feasible node."""
+    import ray_tpu
+    from ray_tpu.util.scheduling_strategies import \
+        NodeAffinitySchedulingStrategy
+
+    src = ray_cluster.add_node(num_cpus=1, slice_id="slice-z",
+                               zone="pod-a")
+    same = ray_cluster.add_node(num_cpus=1, zone="pod-a")
+    other = ray_cluster.add_node(num_cpus=1, zone="pod-b")
+    ray_cluster.connect()
+    ray_cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    class A:
+        def where(self):
+            import os
+            return os.environ.get("RAY_TPU_NODE_ID", "")
+
+    a = A.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            src.node_id, soft=True), max_restarts=-1).remote()
+    ray_tpu.get(a.where.remote(), timeout=30)   # constructor done
+    assert _gcs_actor_info(a).node_id == src.node_id
+    ray_cluster.drain_node(src, deadline_s=6.0, grace_s=0.1, wait=True)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        info = _gcs_actor_info(a)
+        if info.state == "ALIVE" and info.node_id != src.node_id:
+            break
+        time.sleep(0.1)
+    info = _gcs_actor_info(a)
+    assert info.state == "ALIVE"
+    assert info.node_id == same.node_id, \
+        "migration ignored the same-zone replacement preference"
+    assert info.preempted_restarts >= 1   # uncharged
+
+
+@pytest.mark.timeout(120)
+def test_draining_raylet_sheds_unmigrated_dag_pins(ray_cluster):
+    """Raylet-level drain-vs-pins backstop: a raylet draining while a
+    DAG's pins are still held (owner never migrates — here the drain
+    notice never reaches the driver because only the raylet is told)
+    sheds the pinned workers near the deadline instead of wedging
+    drain_complete until the deadline."""
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+    from ray_tpu.dag.compiled import CompiledDAG
+
+    ray_cluster.connect()
+    ray_cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    class Stage:
+        def apply(self, x):
+            return x + 1
+
+    s = Stage.remote()
+    with InputNode() as inp:
+        dag = s.apply.bind(inp)
+    c = CompiledDAG.compile(dag, channel_depth=2)
+    head = ray_cluster.raylets[0]
+    try:
+        assert c.execute(1) == 2
+        assert len(head._dag_pins.get(c._dag_id, ())) == 1
+        # Drive the raylet's drain worker directly (no GCS DrainNode, so
+        # no actor migration and no driver notice): only the shed path
+        # can clear the pin.
+        deadline_s = 3.0
+        t0 = time.time()
+        ray_cluster._run(head.rpc_drain(None, {"deadline_s": deadline_s}))
+        while time.time() - t0 < deadline_s + 2.0:
+            if not head._dag_pins.get(c._dag_id):
+                break
+            time.sleep(0.05)
+        shed_dt = time.time() - t0
+        assert not head._dag_pins.get(c._dag_id), \
+            "draining raylet never shed the DAG pins"
+        assert shed_dt < deadline_s, \
+            f"pins cleared only at the deadline ({shed_dt:.1f}s) — wedge"
+    finally:
+        c.teardown()
+
+
 @pytest.mark.slow
 @pytest.mark.timeout(420)
 def test_chaos_slice_preemption_soak(ray_cluster):
