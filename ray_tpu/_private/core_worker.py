@@ -2945,9 +2945,12 @@ class CoreWorker:
             self._actor_creation_pins[spec.actor_id] = \
                 self._pin_args(spec, pin_refs)
             await self.gcs.request("register_actor", {"spec": spec})
-        except Exception as e:
+        except BaseException as e:
             # Spec never reached an executor: its inline-arg credits would
-            # pin the contained objects forever.
+            # pin the contained objects forever. BaseException, not
+            # Exception: this coroutine runs fire-and-forget on the core
+            # loop, and a CancelledError landing mid-register (driver
+            # shutdown racing a create) must return the credits too.
             self._return_handoff_credits(credits)
             q.set_state("DEAD", reason=f"actor registration failed: {e!r}")
             raise

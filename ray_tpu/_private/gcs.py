@@ -363,7 +363,14 @@ class GcsServer:
                 try:
                     blob = self.snapshot()
                     if self.session_dir:
-                        self.save_snapshot(data=blob)
+                        # Write on the executor: the blob is already
+                        # built, and the atomic tmp+replace write must
+                        # not stall the GCS loop on a slow disk (every
+                        # control RPC in the cluster queues behind it).
+                        # The loop task is single, so writes stay
+                        # ordered.
+                        await asyncio.get_running_loop().run_in_executor(
+                            None, self.save_snapshot, "", blob)
                 except Exception:
                     logger.exception("GCS snapshot failed")
                 if self._ext_store is not None and blob is not None:

@@ -106,6 +106,7 @@ class KVStoreServer:
                 pass
             return
         tmp = path + ".tmp"
+        # ray-tpu: noqa(ASYNC-BLOCK): write-through durability; the ack must follow this atomic one-key tmp+replace write
         with open(tmp, "wb") as f:
             f.write(key.encode() + b"\n" + value)
         os.replace(tmp, path)

@@ -93,6 +93,7 @@ class LogMonitor:
                     self._offsets[path] = 0
                 continue
             try:
+                # ray-tpu: noqa(ASYNC-BLOCK): dedicated monitor loop; tailing log files IS its only duty
                 with open(path, "rb") as f:
                     f.seek(offset)
                     data = f.read(1 << 20)

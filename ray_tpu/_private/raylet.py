@@ -1051,6 +1051,7 @@ class Raylet:
                            log_path: str) -> subprocess.Popen:
         """The one place a generic worker process is exec'd (normal
         Popen path AND zygote-death failover)."""
+        # ray-tpu: noqa(ASYNC-BLOCK): cold-path spawn fallback; one append-mode open of the worker log (forkserver covers the hot path)
         out = open(log_path, "ab")
         return subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
@@ -1083,6 +1084,7 @@ class Raylet:
         argv = rec.build_worker_command(
             container_env["container"], env=env,
             session_dir=self.session_dir)
+        # ray-tpu: noqa(ASYNC-BLOCK): container spawn is explicitly a slow path (podman/docker exec); one log-file open alongside
         out = open(log_path, "ab")
         proc = subprocess.Popen(argv, env=env, stdout=out,
                                 stderr=subprocess.STDOUT,
@@ -2169,54 +2171,88 @@ class Raylet:
             raise RuntimeError("resources no longer available for actor")
         from ray_tpu.util import metrics as _metrics
         trace = f"actor:{spec.actor_id.hex()}"
-        function_blob = await self._prefetch_function(spec.function_id)
-        # t0 AFTER the blob prefetch: the spawn histogram/span measures
-        # the wait for a worker, not the (first-create-only) KV fetch.
-        t0 = time.time()
-        worker = self._get_idle_worker(spec.env_hash(),
-                                       exact=cenv is not None)
-        result_fut: Optional[asyncio.Future] = None
-        mode = "warm" if worker is not None else "cold"
-        if worker is None:
-            try:
-                self._spawn_worker(container_env=cenv)
-            except Exception:
-                # Spawn failure (e.g. container runner vanished) must not
-                # leak the acquired resources.
-                self.pool.release(spec.resources, pg_key)
-                raise
-            # FIFO hand-off: freshly registered workers go to the OLDEST
-            # waiting create (rpc_register_worker serves this queue).
-            # Polling here instead let N concurrent creates steal each
-            # other's spawns — under a 40-actor storm on one node some
-            # handlers starved to the timeout (measured: 4s -> 240s).
-            # The waiter carries the SPEC so registration can dispatch
-            # the assignment in its reply (no idle→re-offer round trip).
-            fut = asyncio.get_event_loop().create_future()
-            waiter = _ActorWorkerWaiter(spec.env_hash(), cenv is not None,
-                                        fut, spec, epoch, pg_key,
-                                        function_blob)
-            self._actor_worker_waiters.append(waiter)
-            got = None
-            try:
-                got = await asyncio.wait_for(
-                    fut, timeout=self.config.worker_start_timeout_s)
-            except asyncio.TimeoutError:
-                pass
-            finally:
-                if waiter in self._actor_worker_waiters:
-                    self._actor_worker_waiters.remove(waiter)
-            if got is not None:
-                _kind, worker, result_fut = got
-            else:
-                # Last chance: a worker freed via the idle path (the
-                # request was already counted by the first attempt).
-                worker = self._get_idle_worker(spec.env_hash(),
-                                               exact=cenv is not None,
-                                               record=False)
+        # The pool charge belongs to this coroutine throughout the try
+        # below (a worker lease only takes it over AFTER the try, or —
+        # in the register-reply race — via the fut inspected in the
+        # handler). CancelledError can land at ANY await inside — it is
+        # a BaseException, so ordinary failure-branch releases never
+        # see it — and without this handler a create cancelled
+        # mid-prefetch or mid-spawn-wait (GCS connection death) charged
+        # the node forever.
+        fut: Optional[asyncio.Future] = None
+        try:
+            function_blob = await self._prefetch_function(spec.function_id)
+            # t0 AFTER the blob prefetch: the spawn histogram/span
+            # measures the wait for a worker, not the (first-create-only)
+            # KV fetch.
+            t0 = time.time()
+            worker = self._get_idle_worker(spec.env_hash(),
+                                           exact=cenv is not None)
+            result_fut: Optional[asyncio.Future] = None
+            mode = "warm" if worker is not None else "cold"
             if worker is None:
+                self._spawn_worker(container_env=cenv)
+                # FIFO hand-off: freshly registered workers go to the
+                # OLDEST waiting create (rpc_register_worker serves this
+                # queue). Polling here instead let N concurrent creates
+                # steal each other's spawns — under a 40-actor storm on
+                # one node some handlers starved to the timeout
+                # (measured: 4s -> 240s). The waiter carries the SPEC so
+                # registration can dispatch the assignment in its reply
+                # (no idle→re-offer round trip).
+                fut = asyncio.get_event_loop().create_future()
+                waiter = _ActorWorkerWaiter(spec.env_hash(),
+                                            cenv is not None,
+                                            fut, spec, epoch, pg_key,
+                                            function_blob)
+                self._actor_worker_waiters.append(waiter)
+                got = None
+                try:
+                    got = await asyncio.wait_for(
+                        fut, timeout=self.config.worker_start_timeout_s)
+                except asyncio.TimeoutError:
+                    pass
+                finally:
+                    if waiter in self._actor_worker_waiters:
+                        self._actor_worker_waiters.remove(waiter)
+                if got is not None:
+                    _kind, worker, result_fut = got
+                else:
+                    # Last chance: a worker freed via the idle path (the
+                    # request was already counted by the first attempt).
+                    worker = self._get_idle_worker(spec.env_hash(),
+                                                   exact=cenv is not None,
+                                                   record=False)
+                if worker is None:
+                    raise RuntimeError("worker failed to start for actor")
+        except BaseException:
+            served = None
+            if fut is not None and fut.done() and \
+                    not fut.cancelled() and fut.exception() is None:
+                # ray-tpu: noqa(ASYNC-BLOCK): asyncio future, done() checked above — result() is a non-blocking read here
+                served = fut.result()
+            if served is not None and served[0] == "dispatched":
+                # A registration raced the cancellation and already
+                # leased the worker against this charge: undo it
+                # exactly like a failed instantiate (leased flag
+                # keeps the release single-shot).
+                w = served[1]
+                self._instantiate_results.pop(w.worker_id, None)
+                self._unlease_failed_create(w, spec, pg_key)
+            elif served is not None:
+                # Idle rescue raced the cancellation: the worker was
+                # handed over UNLEASED — give back the charge and
+                # return the worker to its pool.
                 self.pool.release(spec.resources, pg_key)
-                raise RuntimeError("worker failed to start for actor")
+                self._offer_idle_worker(served[1])
+            else:
+                self.pool.release(spec.resources, pg_key)
+            raise
+        # From here the charge is (or is about to be) owned by a worker
+        # lease: register-reply dispatch leased at registration, and the
+        # warm path leases synchronously below before the next await —
+        # every later failure releases via _unlease_failed_create's
+        # leased-flag gate, never via pool_owned.
         t_worker = time.time()
         _metrics.Histogram(
             "ray_tpu_worker_spawn_seconds",

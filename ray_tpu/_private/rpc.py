@@ -220,6 +220,7 @@ def _scan_source_annotations():
                 continue
             path = os.path.join(dirpath, fname)
             try:
+                # ray-tpu: noqa(ASYNC-BLOCK): one-shot lazy registry fill, cached for the process lifetime
                 with open(path, encoding="utf-8") as f:
                     lines = f.readlines()
             except OSError:

@@ -245,6 +245,15 @@ class RuntimeEnvManager:
         if data is None:
             raise exc.RuntimeEnvSetupError(
                 f"package {uri} not found in cluster KV")
+        # The extract runs on the executor: this coroutine runs on the
+        # raylet/worker daemon loop, and archive extraction + tree
+        # removal are unbounded file I/O — a large package would stall
+        # heartbeats and lease grants for its whole unpack.
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self._unpack_sync, data,
+                                          digest, final)
+
+    def _unpack_sync(self, data: bytes, digest: str, final: str) -> str:
         os.makedirs(self.cache_dir, exist_ok=True)
         tmp = tempfile.mkdtemp(dir=self.cache_dir, prefix=digest + ".tmp")
         try:

@@ -1,0 +1,24 @@
+"""ray_tpu.analysis — one AST engine for the daemon-loop invariants.
+
+The control plane lives or dies on single-threaded daemon event loops
+staying responsive, and the bug classes that wedge them (torn reads,
+shield-cancellation races, under-lock snapshots) are STATIC properties
+of the source. This package is the shared engine behind every such
+check: the five historical one-off checkers run here as registered
+passes, plus three concurrency passes aimed directly at the daemon
+loops. See README "Static analysis" for the pass catalog and how to
+write a new pass.
+
+Run it:
+    python -m ray_tpu.analysis [--json] [--rule RULE]
+    python scripts/check_all.py  (identical, but never imports ray_tpu)
+
+Everything in here is stdlib-only and must stay that way — the checks
+gate tier-1 and run in milliseconds with no cluster state.
+"""
+
+from .engine import (  # noqa: F401
+    Finding, ModuleCache, PassContext, SourceModule, all_passes,
+    apply_baseline, apply_noqa, load_baseline, register,
+)
+from .runner import Report, main, render, run  # noqa: F401

@@ -1,0 +1,8 @@
+"""`python -m ray_tpu.analysis` — run every registered pass."""
+
+import sys
+
+from .runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,515 @@
+"""Shared AST engine for the ray_tpu static-analysis passes.
+
+One module loader/cache, one name resolver, one call-graph walker, one
+Finding type, one suppression/baseline mechanism — the primitives the
+five historical one-off checkers (scripts/check_*.py) each re-invented
+(~800 LoC of duplicated walker code) plus what the concurrency passes
+need. Stdlib-only ON PURPOSE: scripts/check_all.py loads this package
+standalone (never importing ray_tpu/__init__, which pulls the whole
+runtime), so every pass runs in milliseconds with zero cluster state.
+
+Vocabulary:
+  * SourceModule — one parsed file: text, lines, AST, lazily-built
+    function/class maps, import-alias map, attr-constructor map.
+  * ModuleCache — parse each file once, share across all passes.
+  * Finding — rule id + file:line + message + a line-stable `key`
+    (baseline identity must survive unrelated edits shifting lines).
+  * PassContext — repo root + cache handed to every registered pass.
+  * register/all_passes — the pass registry the runner drains.
+
+Suppression forms:
+  * inline: `# ray-tpu: noqa(RULE)` or `# ray-tpu: noqa(RULE): reason`
+    on the finding's line (or the line directly above it);
+  * baseline: scripts/analysis_baseline.json entries keyed
+    (rule, file, key) with a mandatory one-line `why`. Stale entries
+    (no longer matched by any finding) FAIL the run — a fixed bug must
+    take its waiver with it.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+BASELINE_PATH = os.path.join(REPO, "scripts", "analysis_baseline.json")
+
+# The daemon-loop modules the concurrency passes police (one list, not
+# one copy per pass): everything under these runs on asyncio daemon
+# event loops whose responsiveness is the control plane's scaling
+# ceiling.
+DAEMON_TARGETS = (
+    "ray_tpu/_private",
+    "ray_tpu/serve",
+    "ray_tpu/dag",
+    "ray_tpu/experimental",
+    "ray_tpu/autoscaler",
+)
+
+_NOQA = re.compile(
+    r"#\s*ray-tpu:\s*noqa\(([A-Za-z0-9_-]+)\)(?::\s*(.*?))?\s*$")
+
+
+# ---------------------------------------------------------------------------
+# Finding
+# ---------------------------------------------------------------------------
+
+class Finding:
+    """One rule violation at file:line.
+
+    `key` is the line-independent identity used for baseline matching
+    and dedup: by default the message with every `:NNN` line reference
+    stripped, so a finding keeps its waiver when unrelated edits shift
+    it down the file. Passes that can name a better anchor (function,
+    method, metric name) should pass an explicit key.
+    """
+
+    __slots__ = ("rule", "file", "line", "message", "key",
+                 "suppressed", "reason")
+
+    def __init__(self, rule: str, file: str, line: int, message: str,
+                 key: str = ""):
+        self.rule = rule
+        self.file = file.replace(os.sep, "/")
+        self.line = int(line)
+        self.message = message
+        self.key = key or re.sub(r":\d+", "", message)
+        self.suppressed = False
+        self.reason = ""
+
+    @property
+    def ident(self) -> str:
+        return f"{self.rule}::{self.file}::{self.key}"
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else self.file
+        return f"{loc}: {self.rule}: {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "message": self.message, "key": self.key,
+                "suppressed": self.suppressed, "reason": self.reason}
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"Finding({self.render()!r})"
+
+
+# ---------------------------------------------------------------------------
+# Parsed-module cache
+# ---------------------------------------------------------------------------
+
+class SourceModule:
+    """One parsed source file with lazy derived views."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text)
+        self._functions: Optional[Dict[Tuple[str, str], Tuple]] = None
+        self._class_bases: Optional[Dict[str, List[str]]] = None
+        self._imports: Optional[Dict[str, str]] = None
+        self._attr_types: Optional[Dict[Tuple[str, str], str]] = None
+
+    # -- function / class maps -------------------------------------------
+
+    def segment(self, node) -> str:
+        """Exact source segment of a node — same result as
+        `ast.get_source_segment(text, node)` but sliced from the cached
+        line list: get_source_segment re-splits the WHOLE file per call,
+        which made extracting every function of a 4.5k-line module
+        quadratic (measured 10.8s for one pass over the tree; this is
+        ~50x cheaper)."""
+        try:
+            lines = self.lines[node.lineno - 1:node.end_lineno]
+        except AttributeError:  # pragma: no cover - pre-3.8 nodes
+            return ast.get_source_segment(self.text, node) or ""
+        if not lines:
+            return ""
+        # col_offset/end_col_offset are UTF-8 BYTE offsets — slicing the
+        # str directly drifts on any non-ASCII line (em dashes are all
+        # over this repo's strings) and could leak trailing comment text
+        # into a segment a regex pass then matches against.
+        raw = [ln.encode("utf-8") for ln in lines]
+        raw[-1] = raw[-1][:node.end_col_offset]
+        raw[0] = raw[0][node.col_offset:]
+        return "\n".join(b.decode("utf-8") for b in raw)
+
+    def functions(self) -> Dict[Tuple[str, str], Tuple]:
+        """{(class_name_or_"", fn_name): (node, source, lineno)}.
+
+        Module-level functions key under class "".  Replaces the
+        `_function_sources` / `_class_functions` walkers each legacy
+        checker carried.
+        """
+        if self._functions is None:
+            out: Dict[Tuple[str, str], Tuple] = {}
+            bases: Dict[str, List[str]] = {}
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.ClassDef):
+                    bases[node.name] = [b.id for b in node.bases
+                                        if isinstance(b, ast.Name)]
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            out[(node.name, item.name)] = (
+                                item, self.segment(item), item.lineno)
+            for item in self.tree.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    out[("", item.name)] = (item, self.segment(item),
+                                            item.lineno)
+            self._functions = out
+            self._class_bases = bases
+        return self._functions
+
+    def class_bases(self) -> Dict[str, List[str]]:
+        self.functions()
+        return self._class_bases or {}
+
+    def class_methods(self, cls: str) -> Dict[str, str]:
+        """{fn_name: source} for one class, same-file base classes
+        resolved MRO-ish (subclass wins) — lifted from
+        check_dag_teardown.py's `_resolved_methods`."""
+        out: Dict[str, str] = {}
+        for base in self.class_bases().get(cls, []):
+            out.update(self.class_methods(base))
+        for (c, fn), (_node, src, _ln) in self.functions().items():
+            if c == cls:
+                out[fn] = src
+        return out
+
+    def transitive_source(self, fns: Dict[str, str], root: str,
+                          bare: bool = False) -> str:
+        """Source of `root` plus every self._method it (transitively)
+        calls within `fns` — the call-graph walk the teardown checker
+        pioneered, now shared.  `bare=True` additionally follows
+        bare-name helper calls (module-level functions); the teardown
+        pass keeps the original self-only behavior for verdict parity.
+        """
+        seen: Set[str] = set()
+        queue, parts = [root], []
+        while queue:
+            name = queue.pop()
+            if name in seen or name not in fns:
+                continue
+            seen.add(name)
+            src = fns[name]
+            parts.append(src)
+            queue.extend(re.findall(r"self\.(\w+)\(", src))
+            if bare:
+                queue.extend(re.findall(r"(?<![\w.])(\w+)\(", src))
+        return "\n".join(parts)
+
+    # -- name resolution --------------------------------------------------
+
+    def imports(self) -> Dict[str, str]:
+        """{local_name: dotted_module_or_attr} from top-level imports
+        (`import time` -> time:time, `import threading as th` ->
+        th:threading, `from time import sleep` -> sleep:time.sleep)."""
+        if self._imports is None:
+            out: Dict[str, str] = {}
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        out[alias.asname or alias.name.split(".")[0]] = \
+                            alias.name
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    for alias in node.names:
+                        out[alias.asname or alias.name] = \
+                            f"{node.module}.{alias.name}"
+            self._imports = out
+        return self._imports
+
+    def call_name(self, call: ast.Call) -> str:
+        """Dotted name of a call with import aliases resolved:
+        `t.sleep(...)` after `import time as t` -> "time.sleep";
+        `sleep(...)` after `from time import sleep` -> "time.sleep";
+        `self.foo(...)` -> "self.foo"; unresolvable -> best-effort
+        attribute chain (leading `.attr` for complex receivers)."""
+        return self.expr_name(call.func)
+
+    def expr_name(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            root = self.imports().get(node.id, node.id)
+            parts.append(root)
+        else:
+            parts.append("")
+        return ".".join(reversed(parts))
+
+    def attr_constructor_types(self) -> Dict[Tuple[str, str], str]:
+        """{(class_name, attr): dotted constructor} for every
+        `self.attr = <Call>` assignment in the file, import-resolved —
+        e.g. ("Gcs", "_pg_lock"): "asyncio.Lock".  The scope-aware
+        resolver the lock passes key off."""
+        if self._attr_types is None:
+            out: Dict[Tuple[str, str], str] = {}
+            for node in ast.walk(self.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Assign) or \
+                            not isinstance(sub.value, ast.Call):
+                        continue
+                    ctor = self.call_name(sub.value)
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            out.setdefault((node.name, tgt.attr), ctor)
+            self._attr_types = out
+        return self._attr_types
+
+    def local_constructor_types(self, fn_node: ast.AST) -> Dict[str, str]:
+        """{name: dotted constructor} for `name = <Call>` assignments in
+        one function body (module-level assigns included via tree scan
+        when fn_node is the module)."""
+        out: Dict[str, str] = {}
+        for sub in ast.walk(fn_node):
+            if isinstance(sub, ast.Assign) and \
+                    isinstance(sub.value, ast.Call):
+                ctor = self.call_name(sub.value)
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.setdefault(tgt.id, ctor)
+        return out
+
+    # -- suppression -------------------------------------------------------
+
+    def noqa_at(self, line: int, rule: str) -> Optional[str]:
+        """Reason string ("" when none given) if `line` (or the line
+        directly above, for statements whose marker doesn't fit) carries
+        `# ray-tpu: noqa(RULE)`; None when unsuppressed."""
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _NOQA.search(self.lines[ln - 1])
+                if m and m.group(1) == rule:
+                    return m.group(2) or ""
+        return None
+
+
+class ModuleCache:
+    """Parse each file once per run, share across every pass."""
+
+    def __init__(self, repo: str = REPO):
+        self.repo = repo
+        self._modules: Dict[str, Optional[SourceModule]] = {}
+
+    def get(self, rel_or_path: str) -> Optional[SourceModule]:
+        """SourceModule for a repo-relative (or absolute) path; None if
+        unreadable/unparsable (passes decide whether that is an error)."""
+        if os.path.isabs(rel_or_path):
+            path = rel_or_path
+            rel = os.path.relpath(path, self.repo)
+        else:
+            rel = rel_or_path
+            path = os.path.join(self.repo, rel)
+        rel = rel.replace(os.sep, "/")
+        if rel not in self._modules:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+                self._modules[rel] = SourceModule(path, rel, text)
+            except (OSError, SyntaxError):
+                self._modules[rel] = None
+        return self._modules[rel]
+
+    def walk_py(self, *subdirs: str) -> Iterable[str]:
+        """Repo-relative paths of every .py file under the subdirs."""
+        for sub in subdirs:
+            base = os.path.join(self.repo, sub)
+            for root, dirs, files in os.walk(base):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                for fname in sorted(files):
+                    if fname.endswith(".py"):
+                        yield os.path.relpath(
+                            os.path.join(root, fname),
+                            self.repo).replace(os.sep, "/")
+
+
+# ---------------------------------------------------------------------------
+# Scope-respecting AST walkers (shared — don't re-invent in passes)
+# ---------------------------------------------------------------------------
+
+def walk_no_nested(node):
+    """Yield descendants of `node` WITHOUT descending into nested
+    function/lambda definitions: their bodies run wherever the closure
+    is later called, not at this point in the enclosing function — an
+    `await` or blocking call inside `async def cb(): ...` defined under
+    a lock does not execute under the lock."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        yield from walk_no_nested(child)
+
+
+def calls_no_nested(node) -> List[ast.Call]:
+    return [n for n in walk_no_nested(node) if isinstance(n, ast.Call)]
+
+
+def awaits_no_nested(node) -> List[ast.Await]:
+    return [n for n in walk_no_nested(node) if isinstance(n, ast.Await)]
+
+
+# ---------------------------------------------------------------------------
+# Pass registry
+# ---------------------------------------------------------------------------
+
+class PassContext:
+    def __init__(self, repo: str = REPO,
+                 cache: Optional[ModuleCache] = None):
+        self.repo = repo
+        self.cache = cache or ModuleCache(repo)
+
+
+class AnalysisPass:
+    def __init__(self, rule: str, title: str,
+                 fn: Callable[[PassContext], List[Finding]]):
+        self.rule = rule
+        self.title = title
+        self.fn = fn
+
+    def run(self, ctx: PassContext) -> List[Finding]:
+        return self.fn(ctx)
+
+
+_REGISTRY: Dict[str, AnalysisPass] = {}
+
+
+def register(rule: str, title: str):
+    """Decorator registering `fn(ctx) -> List[Finding]` as a pass."""
+    def deco(fn):
+        _REGISTRY[rule] = AnalysisPass(rule, title, fn)
+        return fn
+    return deco
+
+
+def all_passes() -> Dict[str, AnalysisPass]:
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Legacy-string bridging (the five ported checkers keep their exact
+# problem-string verdicts; the engine lifts them into Findings)
+# ---------------------------------------------------------------------------
+
+_LOC = re.compile(r"^([\w./-]+\.(?:py|md)):(\d+):\s*")
+_FILE = re.compile(r"^([\w./-]+\.(?:py|md)):\s*")
+
+
+def findings_from_problems(rule: str, problems: List[str],
+                           default_file: str) -> List[Finding]:
+    """Wrap legacy `file:line: message` problem strings as Findings,
+    preserving the string byte-for-byte in `message` (parity with the
+    pre-port checkers is asserted in tier-1)."""
+    out = []
+    for p in problems:
+        m = _LOC.match(p)
+        if m:
+            out.append(Finding(rule, m.group(1), int(m.group(2)), p))
+            continue
+        m = _FILE.match(p)
+        if m:
+            out.append(Finding(rule, m.group(1), 0, p))
+        else:
+            out.append(Finding(rule, default_file, 0, p))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Suppression + baseline
+# ---------------------------------------------------------------------------
+
+def apply_noqa(findings: List[Finding], cache: ModuleCache) -> None:
+    """Mark findings whose source line carries a matching inline noqa.
+    Suppressed findings stay in the list (the runner prints them with
+    their reason) but don't fail the run."""
+    for f in findings:
+        if not f.line or not f.file.endswith(".py"):
+            continue
+        mod = cache.get(f.file)
+        if mod is None:
+            continue
+        reason = mod.noqa_at(f.line, f.rule)
+        if reason is not None:
+            f.suppressed = True
+            f.reason = reason
+
+
+def load_baseline(path: str = "") -> List[dict]:
+    path = path or BASELINE_PATH
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return []
+    entries = data.get("entries", [])
+    for e in entries:
+        for field in ("rule", "file", "key", "why"):
+            if not isinstance(e.get(field), str) or not e[field]:
+                raise ValueError(
+                    f"baseline entry {e!r} missing required field "
+                    f"{field!r} (every waiver needs rule/file/key and a "
+                    f"one-line why)")
+    return entries
+
+
+def apply_baseline(findings: List[Finding],
+                   entries: List[dict]) -> List[str]:
+    """Mark baselined findings suppressed (reason = entry's `why`);
+    return messages for STALE entries (matched nothing) — stale
+    waivers fail the run so fixed bugs shed their exemptions.
+
+    ONE entry suppresses ONE finding (the first unsuppressed match):
+    keys are line-independent, so a second violation with the same key
+    (e.g. another blocking call added to an already-waived function)
+    must still fail the run instead of riding the old waiver."""
+    stale = []
+    for e in entries:
+        ident = f"{e['rule']}::{e['file']}::{e['key']}"
+        for f in findings:
+            if not f.suppressed and f.ident == ident:
+                f.suppressed = True
+                f.reason = f"baseline: {e['why']}"
+                break
+        else:
+            stale.append(
+                f"stale baseline entry {e['rule']}::{e['file']}::"
+                f"{e['key']!r} — no live finding matches; remove it "
+                f"from scripts/analysis_baseline.json")
+    return stale
+
+
+# ---------------------------------------------------------------------------
+# Standalone module loading (for passes that reuse runtime walkers,
+# e.g. rpc.scan_handler_annotations, without importing ray_tpu)
+# ---------------------------------------------------------------------------
+
+def load_standalone(rel: str, name: str):
+    """Load one repo module by path under a private name — never
+    triggering ray_tpu/__init__ (which drags in the whole runtime)."""
+    import importlib.util
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, rel))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(name, None)
+        raise
+    return mod

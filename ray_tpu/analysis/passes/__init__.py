@@ -1,0 +1,18 @@
+"""Registered analysis passes.
+
+Importing this package registers every pass with the engine registry
+(side effect of each module's @register decorator). The five ported
+legacy checkers keep their exact pre-port verdict strings; the three
+concurrency passes produce native Findings.
+"""
+
+from . import (  # noqa: F401
+    await_under_lock,
+    blocking_async,
+    cancellation_safety,
+    dag_teardown,
+    metrics_catalog,
+    rpc_idempotency,
+    serve_persistence,
+    trace_propagation,
+)

@@ -1,0 +1,227 @@
+"""AWAIT-LOCK: no await under a thread lock; no guarded-state straddle.
+
+Two sub-rules, both aimed at the daemon loops:
+
+  1. thread-lock hold across await — an `await` lexically inside a
+     `with <threading.Lock/RLock/Condition>` block. The lock is held
+     across the suspension: every OTHER thread (worker exec pool, user
+     threadsafe submitters) that touches the lock now blocks for as
+     long as the awaited I/O takes — and if the awaited work needs the
+     same lock on another thread, the loop deadlocks. (The PR 7 seqlock
+     torn-read was the cousin of this class: cross-thread state shared
+     with the loop without a loop-safe discipline.)
+
+  2. asyncio-lock guarded-state straddle — inside an
+     `async with <asyncio.Lock/Condition/Semaphore>` body, the same
+     `self.<attr>` is mutated BEFORE and AFTER an intervening `await`
+     (statement granularity). The lock stays held, but the awaited call
+     can re-enter this object, observe the half-applied state, or fail
+     — leaving the two mutations torn (the PR 8 gauges-snapshot bug
+     class: a snapshot taken in phase one no longer matches the state
+     phase two publishes).
+
+Lock identity comes from the engine's scope-aware resolution:
+`self._lock = threading.Lock()` in any method of the class, module
+globals, or function-local `lock = threading.Lock()` assignments;
+import aliases (`import threading as th`, `from threading import
+Lock`) resolve through SourceModule.imports(). Unresolvable context
+managers are skipped (conservative: no guessing).
+
+Suppress an intentional hold with
+`# ray-tpu: noqa(AWAIT-LOCK): <why the hold is loop-safe>`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..engine import (DAEMON_TARGETS, Finding, ModuleCache,
+                      awaits_no_nested, register, walk_no_nested)
+
+RULE = "AWAIT-LOCK"
+
+THREAD_LOCKS = {"threading.Lock", "threading.RLock",
+                "threading.Condition", "threading.BoundedSemaphore",
+                "threading.Semaphore"}
+ASYNC_LOCKS = {"asyncio.Lock", "asyncio.Condition", "asyncio.Semaphore",
+               "asyncio.BoundedSemaphore"}
+
+_MUTATORS = {"append", "add", "update", "pop", "clear", "remove",
+             "extend", "insert", "discard", "setdefault", "popitem"}
+
+
+def _module_globals(mod) -> dict:
+    """{name: dotted constructor} for TOP-LEVEL `name = <Call>` assigns
+    only — a function-local `lock = threading.Lock()` in one function
+    must not classify a same-named variable in another (cross-scope
+    guessing violates the pass's conservative contract). Memoized on
+    the SourceModule (shared cache outlives one pass run)."""
+    cached = getattr(mod, "_awl_module_globals", None)
+    if cached is None:
+        cached = {}
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call):
+                ctor = mod.call_name(stmt.value)
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        cached.setdefault(tgt.id, ctor)
+        mod._awl_module_globals = cached
+    return cached
+
+
+def _lock_kind(mod, cls: str, local_ctors: dict, expr) -> Optional[str]:
+    """"thread" / "async" / None for a with-item context expression."""
+    ctor = None
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        ctor = mod.attr_constructor_types().get((cls, expr.attr))
+    elif isinstance(expr, ast.Name):
+        ctor = local_ctors.get(expr.id)
+        if ctor is None:
+            ctor = _module_globals(mod).get(expr.id)
+    if ctor in THREAD_LOCKS:
+        return "thread"
+    if ctor in ASYNC_LOCKS:
+        return "async"
+    return None
+
+
+def _attr_root(node) -> Optional[str]:
+    """self.a.b[...] -> "a" (the guarded attribute's root name)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            return node.attr
+        node = node.value
+    return None
+
+
+def _mutated_attrs(stmt) -> Set[str]:
+    out: Set[str] = set()
+    # walk_no_nested yields DESCENDANTS; the statement itself (e.g. a
+    # top-level Assign) is part of the scan too.
+    for sub in (stmt, *walk_no_nested(stmt)):
+        if isinstance(sub, (ast.Assign, ast.AugAssign)):
+            targets = sub.targets if isinstance(sub, ast.Assign) \
+                else [sub.target]
+            for t in targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                if isinstance(base, ast.Attribute):
+                    root = _attr_root(base)
+                    if root:
+                        out.add(root)
+        elif isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr in _MUTATORS:
+            root = _attr_root(sub.func.value)
+            if root:
+                out.add(root)
+        elif isinstance(sub, ast.Delete):
+            for t in sub.targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                if isinstance(base, ast.Attribute):
+                    root = _attr_root(base)
+                    if root:
+                        out.add(root)
+    return out
+
+
+def _has_await(node) -> bool:
+    """Awaits that execute HERE — a nested `async def cb(): await ...`
+    defined under the lock runs elsewhere and must not trigger either
+    sub-rule (walk_no_nested skips defs encountered as children; a def
+    AS the probed statement is the statement-is-a-definition case)."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        return False
+    return bool(awaits_no_nested(node))
+
+
+def _first_await_line(node) -> int:
+    for sub in awaits_no_nested(node):
+        return sub.lineno
+    return node.lineno
+
+
+def scan_module(mod) -> List[Finding]:
+    findings: List[Finding] = []
+    for (cls, fn), (fn_node, _src, _ln) in mod.functions().items():
+        if not isinstance(fn_node, ast.AsyncFunctionDef):
+            continue
+        where = f"{cls}.{fn}" if cls else fn
+        local_ctors = mod.local_constructor_types(fn_node)
+        for node in walk_no_nested(fn_node):
+            # Sub-rule 1: await inside a sync `with <thread lock>`.
+            if isinstance(node, ast.With):
+                kinds = [_lock_kind(mod, cls, local_ctors,
+                                    it.context_expr)
+                         for it in node.items]
+                if "thread" in kinds and _has_await(node):
+                    line = _first_await_line(node)
+                    lock_src = ast.unparse(
+                        node.items[kinds.index("thread")].context_expr)
+                    findings.append(Finding(
+                        RULE, mod.rel, line,
+                        f"async {where} awaits while holding thread "
+                        f"lock `{lock_src}` (with at line "
+                        f"{node.lineno}) — every other thread touching "
+                        f"the lock stalls for the whole await; use an "
+                        f"asyncio.Lock or drop the lock before "
+                        f"awaiting",
+                        key=f"{where}::{lock_src}"))
+            # Sub-rule 2: guarded-state mutation straddles an await
+            # inside `async with <asyncio lock>`.
+            elif isinstance(node, ast.AsyncWith):
+                kinds = [_lock_kind(mod, cls, local_ctors,
+                                    it.context_expr)
+                         for it in node.items]
+                if "async" not in kinds:
+                    continue
+                lock_src = ast.unparse(
+                    node.items[kinds.index("async")].context_expr)
+                body = node.body
+                for i, stmt in enumerate(body):
+                    if not _has_await(stmt):
+                        continue
+                    before: Set[str] = set()
+                    for s in body[:i]:
+                        before |= _mutated_attrs(s)
+                    after: Set[str] = set()
+                    for s in body[i + 1:]:
+                        after |= _mutated_attrs(s)
+                    torn = sorted(before & after)
+                    if torn:
+                        findings.append(Finding(
+                            RULE, mod.rel, _first_await_line(stmt),
+                            f"async {where} mutates guarded state "
+                            f"self.{'/self.'.join(torn)} both before "
+                            f"and after the await at line "
+                            f"{_first_await_line(stmt)} inside `async "
+                            f"with {lock_src}` — a failure or "
+                            f"re-entry mid-await leaves the two "
+                            f"phases torn; finish the mutation before "
+                            f"awaiting (or make the await the last "
+                            f"statement)",
+                            key=f"{where}::{lock_src}::{','.join(torn)}"))
+                        break  # one report per async-with block
+    return findings
+
+
+def scan_paths(paths, cache: Optional[ModuleCache] = None
+               ) -> List[Finding]:
+    cache = cache or ModuleCache()
+    findings: List[Finding] = []
+    for p in paths:
+        mod = cache.get(p)
+        if mod is not None:
+            findings.extend(scan_module(mod))
+    return findings
+
+
+@register(RULE, "no await holding a threading lock; no guarded-state "
+                "mutation straddling an await under an asyncio lock")
+def run(ctx) -> List[Finding]:
+    return scan_paths(ctx.cache.walk_py(*DAEMON_TARGETS), ctx.cache)

@@ -1,0 +1,216 @@
+"""ASYNC-BLOCK: no blocking call inside an async daemon-loop body.
+
+The control plane is single-threaded asyncio: one `time.sleep`, one
+sync file read, one `.result()` on a concurrent future inside an
+`async def` freezes heartbeats, lease grants, pubsub — everything the
+loop-lag probe measures at runtime (metrics.start_loop_lag_probe), now
+a lint. This pass flags, inside any `async def` in the daemon modules:
+
+  * direct blocking calls: `time.sleep`, `os.system`, `subprocess.run/
+    call/check_*`/`Popen(...).wait/communicate`, sync `open(...)`,
+    `shutil.rmtree/copytree/move/copy*`, `socket.create_connection`,
+    `ZipFile(...).extractall`;
+  * `.result()` / `.join()`-on-thread-ish waits: `<x>.result(...)`
+    (concurrent.futures semantics — an asyncio future's result() is
+    only safe post-await and reads just as well via `await`);
+  * calls to same-module sync helpers that TRANSITIVELY reach one of
+    the above (the call-graph walk): an innocent-looking
+    `self._cleanup()` that rmtree's is just as much a stall.
+
+NOT flagged: references passed as arguments (run_in_executor(None,
+time.sleep, ...) — the call happens on the executor), calls inside
+nested `def`/`lambda` bodies (they run wherever they're shipped), and
+`await asyncio.sleep` (different name entirely).
+
+Suppress an intentional blocking call with
+`# ray-tpu: noqa(ASYNC-BLOCK): <why it cannot stall the loop>`. A
+marker on a HELPER's blocking line cuts the transitive chain for every
+async caller — the justification lives once, next to the call it
+excuses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import (DAEMON_TARGETS, Finding, ModuleCache,
+                      calls_no_nested, register)
+
+RULE = "ASYNC-BLOCK"
+
+# Dotted (import-resolved) call names that block the calling thread.
+BLOCKING_CALLS = {
+    "time.sleep": "time.sleep blocks the loop; use `await asyncio.sleep`",
+    "os.system": "os.system blocks on a subprocess",
+    "os.wait": "os.wait blocks on child processes",
+    "os.waitpid": "os.waitpid blocks on child processes",
+    "subprocess.run": "subprocess.run waits for the child synchronously",
+    "subprocess.call": "subprocess.call waits for the child synchronously",
+    "subprocess.check_call": "subprocess.check_call waits synchronously",
+    "subprocess.check_output": "subprocess.check_output waits "
+                               "synchronously",
+    "shutil.rmtree": "sync tree removal is unbounded file I/O",
+    "shutil.copytree": "sync tree copy is unbounded file I/O",
+    "shutil.copy": "sync file copy is file I/O",
+    "shutil.copy2": "sync file copy is file I/O",
+    "shutil.move": "sync move is file I/O",
+    "socket.create_connection": "sync connect blocks on the network",
+    "open": "sync file I/O on the loop; offload via run_in_executor",
+}
+
+# Method-attribute calls that block regardless of receiver module.
+BLOCKING_ATTRS = {
+    "result": "concurrent-future .result() parks the loop thread; "
+              "await the future (or wrap_future) instead",
+    "extractall": "sync archive extraction is unbounded file I/O",
+    "communicate": "Popen.communicate waits for the child synchronously",
+}
+
+
+def _call_target(mod, call: ast.Call) -> Tuple[str, str]:
+    """(dotted_name, bare_attr) of a call — dotted resolves imports."""
+    name = mod.call_name(call)
+    attr = ""
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+    return name, attr
+
+
+def _resolver(mod):
+    """Class-aware callee resolution: ("self", name) from class `cls`
+    resolves within cls then its same-file bases; ("", name) resolves to
+    a module-level function. Returns the (class, fn) key or None —
+    collapsing to bare names conflated same-named methods across
+    classes (one blocking FileStorage.put would taint every class's
+    put)."""
+    fns = mod.functions()
+    bases = mod.class_bases()
+
+    def resolve(cls: str, kind: str, name: str):
+        if kind == "self":
+            seen: Set[str] = set()
+            stack = [cls]
+            while stack:
+                c = stack.pop()
+                if c in seen:
+                    continue
+                seen.add(c)
+                if (c, name) in fns:
+                    return (c, name)
+                stack.extend(bases.get(c, []))
+            return None
+        return ("", name) if ("", name) in fns else None
+
+    return resolve
+
+
+def _callee_refs(call: ast.Call):
+    """("self"|"", name) for a call that might target a same-module
+    helper; None otherwise."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return ("", f.id)
+    if isinstance(f, ast.Attribute) and \
+            isinstance(f.value, ast.Name) and f.value.id == "self":
+        return ("self", f.attr)
+    return None
+
+
+def _sync_blockers(mod) -> Dict[Tuple[str, str], Tuple[str, int]]:
+    """{(class, fn): (blocking_dotted_name, lineno)} for every SYNC
+    function in the module that directly or transitively (class-aware
+    same-module call graph) performs a blocking call."""
+    resolve = _resolver(mod)
+    direct: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    callees: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+    for (cls, fn), (node, _src, _ln) in mod.functions().items():
+        if not isinstance(node, ast.FunctionDef):
+            continue  # async helpers are covered by the main scan
+        edges: Set[Tuple[str, str]] = set()
+        for call in calls_no_nested(node):
+            name, attr = _call_target(mod, call)
+            if name in BLOCKING_CALLS:
+                # A noqa on the helper's own blocking line cuts the
+                # chain for EVERY async caller: the justification lives
+                # once, next to the blocking call it excuses.
+                if mod.noqa_at(call.lineno, RULE) is None:
+                    direct.setdefault((cls, fn), (name, call.lineno))
+            elif attr in BLOCKING_ATTRS and attr == "extractall":
+                # extractall is unambiguous; .result/.communicate on
+                # unknown receivers inside sync helpers are too noisy.
+                if mod.noqa_at(call.lineno, RULE) is None:
+                    direct.setdefault((cls, fn), (f".{attr}", call.lineno))
+            ref = _callee_refs(call)
+            if ref is not None:
+                edges.add(ref)
+        callees[(cls, fn)] = edges
+    # Propagate: a sync fn calling a blocker blocks.
+    changed = True
+    while changed:
+        changed = False
+        for key, edges in callees.items():
+            if key in direct:
+                continue
+            for kind, name in edges:
+                target = resolve(key[0], kind, name)
+                if target is not None and target in direct:
+                    via, line = direct[target]
+                    direct[key] = (f"{name}() -> {via}", line)
+                    changed = True
+                    break
+    return direct
+
+
+def scan_module(mod) -> List[Finding]:
+    findings: List[Finding] = []
+    helpers = _sync_blockers(mod)
+    resolve = _resolver(mod)
+    for (cls, fn), (node, _src, _ln) in mod.functions().items():
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        where = f"{cls}.{fn}" if cls else fn
+        for call in calls_no_nested(node):
+            name, attr = _call_target(mod, call)
+            if name in BLOCKING_CALLS:
+                findings.append(Finding(
+                    RULE, mod.rel, call.lineno,
+                    f"async {where} calls {name}(...) — "
+                    f"{BLOCKING_CALLS[name]}",
+                    key=f"{where}::{name}"))
+                continue
+            if attr in BLOCKING_ATTRS:
+                findings.append(Finding(
+                    RULE, mod.rel, call.lineno,
+                    f"async {where} calls .{attr}(...) — "
+                    f"{BLOCKING_ATTRS[attr]}",
+                    key=f"{where}::.{attr}"))
+                continue
+            ref = _callee_refs(call)
+            target = resolve(cls, *ref) if ref is not None else None
+            if target is not None and target in helpers:
+                via, _line = helpers[target]
+                findings.append(Finding(
+                    RULE, mod.rel, call.lineno,
+                    f"async {where} calls sync helper {ref[1]}() which "
+                    f"transitively blocks via {via} — offload it with "
+                    f"run_in_executor or make the helper async",
+                    key=f"{where}::{ref[1]}"))
+    return findings
+
+
+def scan_paths(paths, cache: Optional[ModuleCache] = None
+               ) -> List[Finding]:
+    cache = cache or ModuleCache()
+    findings: List[Finding] = []
+    for p in paths:
+        mod = cache.get(p)
+        if mod is not None:
+            findings.extend(scan_module(mod))
+    return findings
+
+
+@register(RULE, "no blocking call (direct or via sync helpers) inside "
+                "async daemon-loop bodies")
+def run(ctx) -> List[Finding]:
+    return scan_paths(ctx.cache.walk_py(*DAEMON_TARGETS), ctx.cache)

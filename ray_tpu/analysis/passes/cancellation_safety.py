@@ -1,0 +1,202 @@
+"""CANCEL-SAFE: reserve/release critical sections survive cancellation.
+
+The PR 4 leak class, generalized: an async critical section that
+acquires a resource (lease, bundle reservation, pool debit, worker
+pin, semaphore) and releases it AFTER an intervening `await` is a
+cancellation hazard — `asyncio.CancelledError` can land at ANY await,
+and it is a BaseException: an `except Exception` cleanup never sees
+it, a straight-line release is never reached, and the resource stays
+acquired forever (`_mark_node_dead` cancelling `_schedule_pg`
+mid-reserve leaked PG bundles for exactly this reason until the
+critical section was shielded).
+
+A paired section is accepted when ANY of:
+  * every await between the acquire and the release sits in a `try`
+    whose `finally` (transitively) releases;
+  * a handler catching BaseException / bare / CancelledError around
+    those awaits (transitively) releases — release-and-reraise is the
+    PR 8 leased-flag idiom;
+  * the whole coroutine is wrapped in `asyncio.shield(...)` at its
+    call site(s) — the PR 4 fix shape (the shield keeps the section
+    running; the caller's cancellation lands after it completes).
+
+Acquire/release calls are recognized by identifier tokens
+(acquire/reserve/pin/debit vs release/unpin/return/rollback/refund/
+credit), and a release hidden inside a same-module helper counts (the
+engine's transitive call walk) — `self._unlease_failed_create()`
+releasing the pool is still a release.
+
+Suppress a deliberate fire-and-forget acquisition with
+`# ray-tpu: noqa(CANCEL-SAFE): <why cancellation cannot strand it>`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import (DAEMON_TARGETS, Finding, ModuleCache,
+                      awaits_no_nested, calls_no_nested, register)
+
+RULE = "CANCEL-SAFE"
+
+_TOKEN = re.compile(r"[a-zA-Z]+")
+
+ACQ_TOKENS = {"acquire", "acquires", "acquired", "reserve", "reserves",
+              "reserved", "pin", "pins", "pinned", "debit", "debits",
+              "debited"}
+REL_TOKENS = {"release", "releases", "released", "unpin", "unpins",
+              "unpinned", "return", "returns", "returned", "rollback",
+              "refund", "refunds", "refunded", "credit", "credits",
+              "credited", "unlease", "unleased"}
+
+_CATCH_ALL = {"BaseException", "CancelledError"}
+
+
+def _tokens(name: str) -> Set[str]:
+    return set(t.lower() for t in _TOKEN.findall(name))
+
+
+def _call_simple_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _is_catch_all(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    names = []
+    for node in elts:
+        if isinstance(node, ast.Attribute):
+            names.append(node.attr)
+        elif isinstance(node, ast.Name):
+            names.append(node.id)
+    return any(n in _CATCH_ALL for n in names)
+
+
+def _releases(mod, block_stmts, helper_srcs: Dict[str, str]) -> bool:
+    """True if the statements (transitively, via same-module helpers)
+    contain a release-token call."""
+    for stmt in block_stmts:
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _call_simple_name(sub)
+            if _tokens(name) & REL_TOKENS:
+                return True
+            if name in helper_srcs:
+                body = mod.transitive_source(helper_srcs, name,
+                                             bare=True)
+                for m in re.finditer(r"(?:self\.)?(\w+)\(", body):
+                    if _tokens(m.group(1)) & REL_TOKENS:
+                        return True
+    return False
+
+
+def _protected_await_lines(mod, fn_node,
+                           helper_srcs: Dict[str, str]) -> Set[int]:
+    """Lines of awaits protected by a releasing finally or a releasing
+    catch-all handler."""
+    protected: Set[int] = set()
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Try):
+            continue
+        guarded = node.finalbody and _releases(mod, node.finalbody,
+                                               helper_srcs)
+        if not guarded:
+            for h in node.handlers:
+                if _is_catch_all(h) and _releases(mod, h.body,
+                                                  helper_srcs):
+                    guarded = True
+                    break
+        if guarded:
+            for stmt in node.body + node.orelse:
+                for aw in awaits_no_nested(stmt):
+                    protected.add(aw.lineno)
+    return protected
+
+
+def _shielded_at_call_site(mod, fn_name: str) -> bool:
+    return re.search(
+        r"shield\(\s*(?:self\.)?" + re.escape(fn_name) + r"\(",
+        mod.text) is not None
+
+
+def scan_module(mod) -> List[Finding]:
+    findings: List[Finding] = []
+    module_fns: Dict[str, str] = {
+        fn: src for (c, fn), (_n, src, _ln) in mod.functions().items()
+        if not c}
+    by_class: Dict[str, Dict[str, str]] = {}
+
+    def _helpers_for(cls: str) -> Dict[str, str]:
+        # Class-scoped: self._cleanup() must resolve against THIS
+        # class's (and its same-file bases') methods, not a same-named
+        # method of an unrelated class in the module.
+        if cls not in by_class:
+            merged = dict(module_fns)
+            if cls:
+                merged.update(mod.class_methods(cls))
+            by_class[cls] = merged
+        return by_class[cls]
+
+    for (cls, fn), (fn_node, _src, _ln) in mod.functions().items():
+        if not isinstance(fn_node, ast.AsyncFunctionDef):
+            continue
+        helper_srcs = _helpers_for(cls)
+        if _shielded_at_call_site(mod, fn):
+            continue  # the PR 4 fix shape: cancellation waits it out
+        where = f"{cls}.{fn}" if cls else fn
+        calls = calls_no_nested(fn_node)
+        acquires = [(c.lineno, _call_simple_name(c)) for c in calls
+                    if _tokens(_call_simple_name(c)) & ACQ_TOKENS]
+        releases = [(c.lineno, _call_simple_name(c)) for c in calls
+                    if _tokens(_call_simple_name(c)) & REL_TOKENS]
+        if not acquires or not releases:
+            continue
+        awaits = [a.lineno for a in awaits_no_nested(fn_node)]
+        protected = _protected_await_lines(mod, fn_node, helper_srcs)
+        for a_line, a_name in acquires:
+            later = [r for r in releases if r[0] > a_line]
+            if not later:
+                continue
+            last_rel = max(r[0] for r in later)
+            between = [w for w in awaits if a_line < w <= last_rel]
+            exposed = [w for w in between if w not in protected]
+            if not exposed:
+                continue
+            findings.append(Finding(
+                RULE, mod.rel, a_line,
+                f"async {where} acquires via {a_name}(...) and releases "
+                f"via {'/'.join(sorted({r[1] for r in later}))} after "
+                f"awaiting (first unprotected await at line "
+                f"{exposed[0]}) — a cancellation landing there strands "
+                f"the resource; shield the critical section, release in "
+                f"a finally, or catch BaseException",
+                key=f"{where}::{a_name}"))
+            break  # one report per function is enough to act on
+    return findings
+
+
+def scan_paths(paths, cache: Optional[ModuleCache] = None
+               ) -> List[Finding]:
+    cache = cache or ModuleCache()
+    findings: List[Finding] = []
+    for p in paths:
+        mod = cache.get(p)
+        if mod is not None:
+            findings.extend(scan_module(mod))
+    return findings
+
+
+@register(RULE, "acquire/release critical sections spanning awaits are "
+                "shielded, finally'd, or BaseException-guarded")
+def run(ctx) -> List[Finding]:
+    return scan_paths(ctx.cache.walk_py(*DAEMON_TARGETS), ctx.cache)

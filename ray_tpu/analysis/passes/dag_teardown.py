@@ -1,0 +1,173 @@
+"""DAG-TEARDOWN: every compiled-DAG acquisition has a release.
+
+Ported from scripts/check_dag_teardown.py (verdict-parity asserted in
+tier-1). A CompiledDAG acquires durable resources at compile time — shm
+ring segments, KV-backed store channels, pinned worker leases at the
+raylets, executor actors, persistent run loops — and the ONLY thing
+standing between a bug and a leaked segment / permanently pinned lease
+is teardown() running the matching release on EVERY path (normal
+teardown, failure watcher, compile-error path, recovery-failure path).
+The same-file base-class method resolution and transitive self-method
+call walk this checker pioneered now live in the engine
+(SourceModule.class_methods / transitive_source).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ..engine import (Finding, ModuleCache, findings_from_problems,
+                      register)
+
+RULE = "DAG-TEARDOWN"
+
+COMPILED = "ray_tpu/dag/compiled.py"
+CHANNELS = "ray_tpu/experimental/channels.py"
+
+# (acquire_pattern, release_pattern, why). The acquire must appear in
+# CompiledDAG's compile path; the release must appear in teardown's
+# transitive source.
+ACQUIRE_RELEASE = [
+    (r"RingChannel\(", r"\.destroy\(\)",
+     "ring channels allocate /dev/shm segments that only destroy() "
+     "unlinks"),
+    (r"StoreChannel\(", r"\.destroy\(\)",
+     "store channels leave GCS KV records that only destroy() deletes"),
+    (r"dag_pin_actors\(", r"dag_release\(",
+     "pinned worker leases must be released at every raylet"),
+    (r"_executor_actor_class\(\)", r"\bkill\(",
+     "executor actors created for FunctionNodes must be killed"),
+    (r"\.remote\(", r"ray_tpu\.get\(ref",
+     "shipped run loops must be awaited (channels closed first) so "
+     "executors exit before their leases release"),
+]
+
+# (pattern_a, pattern_b, why): in teardown's own source, the FIRST match
+# of a must precede the FIRST match of b.
+TEARDOWN_ORDER = [
+    (r"\.close\(\)", r"ray_tpu\.get\(ref",
+     "close channels BEFORE waiting the loop refs (loops blocked "
+     "mid-read only exit once their channels wake them)"),
+    (r"ray_tpu\.get\(ref", r"\.destroy\(\)",
+     "wait the loop refs BEFORE destroying segments (an executor "
+     "mid-tick must not have its mapped memory unlinked underneath "
+     "it)"),
+]
+
+
+def check(cache: ModuleCache = None) -> list:
+    """Byte-level parity with the pre-port checker's output."""
+    cache = cache or ModuleCache()
+    problems: List[str] = []
+
+    mod = cache.get(COMPILED)
+    if mod is None:
+        return [f"{COMPILED}: unreadable (file missing or unparsable)"]
+    dag_fns = mod.class_methods("CompiledDAG")
+    if not dag_fns:
+        return [f"{COMPILED}: class CompiledDAG not found — subsystem "
+                f"renamed? update check_dag_teardown.py"]
+    compile_src = mod.transitive_source(dag_fns, "__init__") + \
+        mod.transitive_source(dag_fns, "_compile")
+    teardown_src = mod.transitive_source(dag_fns, "teardown")
+    if "teardown" not in dag_fns:
+        return [f"{COMPILED}: CompiledDAG.teardown missing"]
+
+    for acquire, release, why in ACQUIRE_RELEASE:
+        if not re.search(acquire, compile_src):
+            continue  # acquisition gone: nothing to release
+        if not re.search(release, teardown_src):
+            problems.append(
+                f"{COMPILED}: compile acquires /{acquire}/ but teardown "
+                f"never matches /{release}/ — {why}")
+
+    own_teardown = dag_fns["teardown"]
+    for pat_a, pat_b, why in TEARDOWN_ORDER:
+        a = re.search(pat_a, own_teardown)
+        b = re.search(pat_b, own_teardown)
+        if a is None or b is None:
+            problems.append(
+                f"{COMPILED}: teardown missing /{pat_a}/ or /{pat_b}/ "
+                f"— {why}")
+        elif a.start() > b.start():
+            problems.append(
+                f"{COMPILED}: teardown orders /{pat_b}/ before "
+                f"/{pat_a}/ — {why}")
+
+    init_src = dag_fns.get("__init__", "")
+    if not re.search(r"except\s+BaseException", init_src) or \
+            "self.teardown()" not in init_src or \
+            not re.search(r"\braise\b", init_src):
+        problems.append(
+            f"{COMPILED}: __init__ must wrap compilation in an error "
+            f"path that calls self.teardown() and re-raises — a failed "
+            f"compile must release whatever it already acquired")
+
+    fail_src = mod.transitive_source(dag_fns, "_fail")
+    if not re.search(r"\.close\(\)", fail_src):
+        problems.append(
+            f"{COMPILED}: the failure path (_fail) must close every "
+            f"channel so blocked executes raise typed instead of "
+            f"wedging")
+
+    # Recovery-path acquire/release pairing (self-healing DAGs).
+    if "_recover" in dag_fns:
+        recover_src = mod.transitive_source(dag_fns, "_recover")
+        recfail_src = mod.transitive_source(dag_fns, "_recovery_failed")
+        if re.search(r"dag_pin_actors\(|self\._pin\(", recover_src) and \
+                not re.search(r"dag_release\(", recfail_src):
+            problems.append(
+                f"{COMPILED}: _recover re-pins worker leases but the "
+                f"recovery-failure path (_recovery_failed) never matches "
+                f"/dag_release\\(/ — a failed recovery must not leave "
+                f"OOM/reaper-exempt leases pinned until teardown")
+        if re.search(r"RingChannel\(|StoreChannel\(", recover_src) and \
+                not re.search(r"_channels\.append\(", recover_src) and \
+                not re.search(r"\.destroy\(\)", recfail_src):
+            problems.append(
+                f"{COMPILED}: _recover re-creates channels without "
+                f"registering them into self._channels (teardown's "
+                f"destroy sweep) or destroying them in _recovery_failed "
+                f"— a re-homed edge's segment/KV records would leak")
+        driver_src = mod.transitive_source(dag_fns, "_run_recovery")
+        if "_run_recovery" in dag_fns and \
+                not re.search(r"self\._recovery_failed\(", driver_src):
+            problems.append(
+                f"{COMPILED}: _run_recovery must route failed attempts "
+                f"through self._recovery_failed(...)")
+        if not re.search(r"self\._fail\(", recfail_src):
+            problems.append(
+                f"{COMPILED}: _recovery_failed must reach _fail so "
+                f"blocked executes wake typed instead of wedging")
+    elif re.search(r"tick_replay", "".join(dag_fns.values())):
+        problems.append(
+            f"{COMPILED}: tick_replay is accepted but CompiledDAG has "
+            f"no _recover — recovery renamed? update "
+            f"check_dag_teardown.py")
+
+    chmod = cache.get(CHANNELS)
+    if chmod is None:
+        return problems + [f"{CHANNELS}: unreadable (file missing or "
+                           f"unparsable)"]
+    for cls in ("RingChannel", "StoreChannel"):
+        if not any(c == cls for c, _fn in chmod.functions()):
+            problems.append(
+                f"{CHANNELS}: class {cls} not found — channel layer "
+                f"renamed? update check_dag_teardown.py")
+            continue
+        fns = chmod.class_methods(cls)
+        for required in ("close", "destroy", "reopen"):
+            if required not in fns:
+                problems.append(
+                    f"{CHANNELS}: {cls} has no {required}() — teardown "
+                    f"needs close (wake blocked ends) AND destroy "
+                    f"(release the segment/records); recovery needs "
+                    f"reopen (kept segments must carry traffic again)")
+    return problems
+
+
+@register(RULE, "every channel/lease/actor a CompiledDAG acquires is "
+                "released on every teardown/error/recovery path")
+def run(ctx) -> List[Finding]:
+    return findings_from_problems(RULE, check(ctx.cache), COMPILED)

@@ -1,0 +1,154 @@
+"""SERVE-WAL: the serve controller is write-ahead, everywhere.
+
+Ported from scripts/check_serve_persistence.py (verdict-parity asserted
+in tier-1). The durable control plane only works if EVERY target-state
+mutation persists its record to the GCS KV BEFORE the mutation's
+routing or replica effects publish: one path that flips the order (or
+skips the write) produces a controller that recovers to a state routers
+never saw — exactly the split-brain the plane exists to kill.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ..engine import (Finding, ModuleCache, findings_from_problems,
+                      register)
+
+RULE = "SERVE-WAL"
+
+CONTROLLER = "ray_tpu/serve/controller.py"
+
+# (class, fn, persist_pattern, effect_pattern, why) — the FIRST match of
+# persist_pattern must precede the FIRST match of effect_pattern.
+ORDERED_RULES = [
+    ("ServeController", "_deploy_app_locked",
+     r"persistence\.app_key",
+     r"persistence\.target_key",
+     "deploy must persist the app-atomic snapshot blob before any "
+     "per-deployment record (a crash between records must reconcile "
+     "against ONE consistent app state)"),
+    ("ServeController", "_deploy_app_locked",
+     r"self\._persist\.put\(\s*\n?\s*persistence\.target_key",
+     r"self\._deployments\[",
+     "deploy must persist every target record before mutating state"),
+    ("ServeController", "delete_app",
+     r"persistence\.app_key",
+     r"persistence\.ROUTES_KEY",
+     "delete must drop the app snapshot before anything else — a stale "
+     "snapshot would resurrect deployments on recovery"),
+    ("ServeController", "_deploy_app_locked",
+     r"persistence\.ROUTES_KEY",
+     r"self\._routes\[",
+     "deploy must persist the route table before publishing the route"),
+    ("ServeController", "delete_app",
+     r"persistence\.ROUTES_KEY",
+     r"self\._routes\s*=",
+     "delete must persist the shrunken route table before applying it"),
+    ("ServeController", "_remove_deployment",
+     r"self\._persist\.delete",
+     r"self\._deployments\.pop",
+     "removal must delete the KV records before dropping the state"),
+    ("ServeController", "_set_target",
+     r"self\._persist\.put\(",
+     r"\.target_num\s*=(?!=)",
+     "scaling must write-ahead the new target before applying it"),
+    ("ServeController", "_start_replica",
+     r"_persist_replica_row\(",
+     r"st\.replicas\.append",
+     "a replica's registry row must exist before the set publishes"),
+    ("ServeController", "_wait_ready",
+     r"_persist_replica_row\(",
+     r"info\.state = REPLICA_RUNNING",
+     "the rolling-update swap must persist before it publishes"),
+]
+
+# (class, fn, pattern, why) — pattern must be present.
+PRESENCE_RULES = [
+    ("ServeController", "_begin_drain", r"_persist_replica_row_soon\(",
+     "draining must persist the DRAINING row so a controller crash "
+     "mid-drain can finish the kill instead of leaking the replica"),
+    ("ServeController", "_drain_and_stop", r"delete_soon\(",
+     "a completed drain must GC the replica's registry row"),
+    ("ServeController", "_drop_dead_replica", r"delete_soon\(",
+     "dropping a dead replica must GC its registry row"),
+]
+
+# (pattern, {allowed (class, fn)}, why) — pattern may ONLY appear in the
+# allowed functions anywhere in controller.py.
+FORBID_RULES = [
+    (re.compile(r"\.target_num\s*=(?!=)"),
+     {("ServeController", "_set_target"),
+      ("ServeController", "_apply_target_record"),
+      ("_DeploymentState", "__init__")},
+     "target_num is assigned outside the write-ahead scale path"),
+    (re.compile(r"\.replicas\.append"),
+     {("ServeController", "_start_replica"),
+      ("ServeController", "_reattach_deployment")},
+     "replica sets may only grow via _start_replica or recovery "
+     "reattach (both persist the registry row)"),
+    (re.compile(r"\.version\s*=(?!=)"),
+     {("ServeController", "_apply_target_record"),
+      ("_DeploymentState", "__init__"),
+      ("_ReplicaInfo", "__init__")},
+     "deployment/replica versions may only change through the "
+     "persisted target record (or the constructors)"),
+]
+
+
+def check(cache: ModuleCache = None) -> list:
+    """Byte-level parity with the pre-port checker's output."""
+    cache = cache or ModuleCache()
+    mod = cache.get(CONTROLLER)
+    if mod is None:
+        return [f"{CONTROLLER}: unreadable (file missing or unparsable)"]
+    funcs = {k: (src, ln) for k, (_n, src, ln) in mod.functions().items()
+             if k[0]}
+    problems: List[str] = []
+    for cls, fn, persist_pat, effect_pat, why in ORDERED_RULES:
+        ent = funcs.get((cls, fn))
+        if ent is None:
+            problems.append(
+                f"{CONTROLLER}: {cls}.{fn} not found — mutation path "
+                f"renamed? update check_serve_persistence.py ({why})")
+            continue
+        src, lineno = ent
+        persist = re.search(persist_pat, src)
+        effect = re.search(effect_pat, src)
+        if persist is None:
+            problems.append(
+                f"{CONTROLLER}:{lineno}: {cls}.{fn} never persists "
+                f"(/{persist_pat}/ absent) — {why}")
+            continue
+        if effect is not None and effect.start() < persist.start():
+            problems.append(
+                f"{CONTROLLER}:{lineno}: {cls}.{fn} publishes its effect "
+                f"(/{effect_pat}/) BEFORE persisting — {why}")
+    for cls, fn, pat, why in PRESENCE_RULES:
+        ent = funcs.get((cls, fn))
+        if ent is None:
+            problems.append(
+                f"{CONTROLLER}: {cls}.{fn} not found — mutation path "
+                f"renamed? update check_serve_persistence.py ({why})")
+            continue
+        src, lineno = ent
+        if not re.search(pat, src):
+            problems.append(
+                f"{CONTROLLER}:{lineno}: {cls}.{fn} does not match "
+                f"/{pat}/ — {why}")
+    for pat, allowed, why in FORBID_RULES:
+        for (cls, fn), (src, lineno) in funcs.items():
+            if (cls, fn) in allowed:
+                continue
+            if pat.search(src):
+                problems.append(
+                    f"{CONTROLLER}:{lineno}: {cls}.{fn} matches "
+                    f"/{pat.pattern}/ — {why}")
+    return problems
+
+
+@register(RULE, "every serve-controller target-state mutation persists "
+                "to the KV before publishing its effects")
+def run(ctx) -> List[Finding]:
+    return findings_from_problems(RULE, check(ctx.cache), CONTROLLER)

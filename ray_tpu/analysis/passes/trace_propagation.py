@@ -1,0 +1,126 @@
+"""TRACE-PROP: every serve entry point forwards the request trace.
+
+Ported from scripts/check_trace_propagation.py (verdict-parity asserted
+in tier-1). The request observability plane only works if EVERY ingress
+mints/binds a RequestTrace and every dispatch path ships it to the
+replica: one entry point that forgets produces silently truncated
+traces (a request that "disappears" at the proxy) — exactly the failure
+mode the plane exists to kill.
+
+Checked invariants:
+  * each proxy ingress (HTTP conn handler, websocket upgrade, binary-RPC
+    unary/stream) mints AND binds a request trace;
+  * the handle adopts the bound context (or mints) in _make_request, and
+    both submit paths stamp/forward it to the replica;
+  * the replica accepts the wire context on both request methods;
+  * nobody dispatches to a replica around the forwarding submitters
+    (raw `handle_request*.remote(` outside handle.py's _submit pair).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List
+
+from ..engine import (Finding, ModuleCache, findings_from_problems,
+                      register)
+
+RULE = "TRACE-PROP"
+
+# (file, class, function, [required regexes], why)
+RULES = [
+    ("ray_tpu/serve/proxy.py", "ProxyActor", "_handle_conn",
+     [r"request_trace\.mint\(", r"request_trace\.bind\(",
+      r"request_trace\.finish\("],
+     "HTTP ingress must mint+bind+finish the request trace"),
+    ("ray_tpu/serve/proxy.py", "ProxyActor", "_handle_websocket",
+     [r"request_trace\.mint\(", r"request_trace\.bind\(",
+      r"request_trace\.finish\("],
+     "websocket ingress must mint+bind+finish the request trace"),
+    ("ray_tpu/serve/grpc_proxy.py", "GrpcProxyActor", "_rpc_unary",
+     [r"request_trace\.mint\(", r"request_trace\.bind\(",
+      r"request_trace\.finish\("],
+     "binary-RPC unary ingress must mint+bind+finish the request trace"),
+    ("ray_tpu/serve/grpc_proxy.py", "GrpcProxyActor", "_rpc_stream",
+     [r"request_trace\.mint\(", r"request_trace\.bind\(",
+      r"request_trace\.finish\("],
+     "binary-RPC stream ingress must mint+bind+finish the request trace"),
+    ("ray_tpu/serve/handle.py", "DeploymentHandle", "_make_request",
+     [r"request_trace\.current\(", r"request_trace\.mint\("],
+     "the handle must adopt the bound ingress context or mint one"),
+    ("ray_tpu/serve/handle.py", "DeploymentHandle", "_submit",
+     [r"_stamp_dispatch\(", r"trace_ctx"],
+     "unary dispatch must stamp+forward the trace to the replica"),
+    ("ray_tpu/serve/handle.py", "DeploymentHandle", "_submit_stream",
+     [r"_stamp_dispatch\(", r"trace_ctx"],
+     "streaming dispatch must stamp+forward the trace to the replica"),
+    ("ray_tpu/serve/replica.py", "ReplicaActor", "handle_request",
+     [r"trace_ctx", r"_trace_ctx\("],
+     "the replica must accept and decode the wire trace context"),
+    ("ray_tpu/serve/replica.py", "ReplicaActor", "handle_request_streaming",
+     [r"trace_ctx", r"_trace_ctx\("],
+     "the streaming replica path must accept the wire trace context"),
+]
+
+# Raw replica dispatch is allowed ONLY in the forwarding submitters.
+_RAW_DISPATCH = re.compile(r"handle_request(_streaming)?\s*(\.options\("
+                           r"[^)]*\))?\s*\.remote\(")
+_DISPATCH_ALLOWED = {("ray_tpu/serve/handle.py", "_submit"),
+                     ("ray_tpu/serve/handle.py", "_submit_stream")}
+
+
+def check(cache: ModuleCache = None, extra_dispatch_dirs=()) -> list:
+    """Run all checks; extra_dispatch_dirs are additionally scanned for
+    raw replica dispatch (lets tests plant rogue fixtures in a tmp dir
+    instead of the real package). Byte-level parity with the pre-port
+    checker's output."""
+    cache = cache or ModuleCache()
+    problems: List[str] = []
+    for rel, cls, fn, patterns, why in RULES:
+        mod = cache.get(rel)
+        if mod is None:
+            problems.append(f"{rel}: unreadable (file missing or "
+                            f"unparsable)")
+            continue
+        ent = mod.functions().get((cls, fn))
+        if ent is None:
+            problems.append(
+                f"{rel}: {cls}.{fn} not found — entry point renamed? "
+                f"update check_trace_propagation.py ({why})")
+            continue
+        _node, src, lineno = ent
+        for pat in patterns:
+            if not re.search(pat, src):
+                problems.append(
+                    f"{rel}:{lineno}: {cls}.{fn} does not match "
+                    f"/{pat}/ — {why}")
+    # No raw replica dispatch outside the forwarding submitters.
+    scan_dirs = [os.path.join(cache.repo, "ray_tpu", "serve")]
+    scan_dirs.extend(extra_dispatch_dirs)
+    for serve_dir in scan_dirs:
+        for fname in sorted(os.listdir(serve_dir)):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(serve_dir, fname)
+            mod = cache.get(path)
+            if mod is None:
+                continue
+            rel = mod.rel
+            for (cls, fn), (_node, src, lineno) in mod.functions().items():
+                if not cls or (rel, fn) in _DISPATCH_ALLOWED:
+                    continue
+                if _RAW_DISPATCH.search(src):
+                    problems.append(
+                        f"{rel}:{lineno}: {cls}.{fn} dispatches to a "
+                        f"replica directly — route through "
+                        f"DeploymentHandle._submit/_submit_stream so the "
+                        f"request trace is forwarded")
+    return problems
+
+
+@register(RULE, "every serve ingress mints/binds the request trace and "
+                "every dispatch path forwards it")
+def run(ctx) -> List[Finding]:
+    return findings_from_problems(RULE, check(ctx.cache),
+                                  "ray_tpu/serve/handle.py")

@@ -1,0 +1,151 @@
+"""Run every registered analysis pass and fold the verdict.
+
+One entry point for humans (`python -m ray_tpu.analysis`, or the
+package-import-free `scripts/check_all.py`), for tier-1 (via
+tests/test_static_analysis.py), and for future CI (`--json` emits a
+stable machine-readable report; exit code 0 = clean, 1 = findings or
+stale baseline entries, 2 = a pass crashed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import engine
+from .engine import Finding, ModuleCache, PassContext
+
+
+class Report:
+    """Everything one run produced, pre-folded for rendering."""
+
+    def __init__(self):
+        self.findings: List[Finding] = []     # every finding, incl. suppressed
+        self.stale_baseline: List[str] = []
+        self.errors: List[str] = []           # pass crashes (exit 2)
+        self.pass_counts: dict = {}
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def ok(self) -> bool:
+        return not self.active and not self.stale_baseline \
+            and not self.errors
+
+    @property
+    def exit_code(self) -> int:
+        if self.errors:
+            return 2
+        return 0 if self.ok else 1
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "exit_code": self.exit_code,
+            "findings": [f.to_dict() for f in self.active],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "stale_baseline": list(self.stale_baseline),
+            "errors": list(self.errors),
+            "pass_counts": dict(self.pass_counts),
+        }
+
+
+def run(repo: str = engine.REPO, rules: Optional[List[str]] = None,
+        baseline_path: str = "", cache: Optional[ModuleCache] = None
+        ) -> Report:
+    """Run the registered passes (optionally a `rules` subset), apply
+    inline noqa + the baseline, and return the folded Report."""
+    from . import passes as _passes  # noqa: F401  (registration side effect)
+    report = Report()
+    ctx = PassContext(repo, cache or ModuleCache(repo))
+    selected = engine.all_passes()
+    if rules:
+        unknown = [r for r in rules if r not in selected]
+        if unknown:
+            report.errors.append(
+                f"unknown rule(s) {unknown}; known: "
+                f"{sorted(selected)}")
+            return report
+        selected = {r: selected[r] for r in rules}
+    for rule in sorted(selected):
+        p = selected[rule]
+        try:
+            found = p.run(ctx)
+        except Exception as e:  # a crashed pass must fail loudly
+            report.errors.append(f"pass {rule} crashed: {e!r}")
+            continue
+        report.pass_counts[rule] = len(found)
+        report.findings.extend(found)
+    engine.apply_noqa(report.findings, ctx.cache)
+    try:
+        entries = engine.load_baseline(baseline_path)
+    except ValueError as e:
+        report.errors.append(str(e))
+        entries = []
+    if rules:
+        # Partial runs can't see the other rules' findings; only their
+        # own baseline entries are in scope for staleness.
+        entries = [e for e in entries if e["rule"] in selected]
+    report.stale_baseline = engine.apply_baseline(report.findings,
+                                                  entries)
+    return report
+
+
+def render(report: Report, stream=None) -> None:
+    stream = stream or sys.stderr
+    for f in report.active:
+        print(f.render(), file=stream)
+    for msg in report.stale_baseline:
+        print(msg, file=stream)
+    for msg in report.errors:
+        print(f"ERROR: {msg}", file=stream)
+    for f in report.suppressed:
+        why = f.reason or "no reason given"
+        print(f"suppressed {f.rule} at {f.file}:{f.line} — {why}",
+              file=stream)
+    n = len(report.pass_counts)
+    if report.ok:
+        print(f"static analysis clean: {n} passes, "
+              f"{len(report.suppressed)} suppressed finding(s)",
+              file=stream)
+    else:
+        print(f"\n{len(report.active)} unbaselined finding(s), "
+              f"{len(report.stale_baseline)} stale baseline entr(y/ies) "
+              f"across {n} passes — fix, `# ray-tpu: noqa(RULE): why`, "
+              f"or baseline with a justification.", file=stream)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="check_all",
+        description="ray_tpu unified static analysis (all registered "
+                    "passes; see README 'Static analysis')")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a machine-readable report on stdout")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule id (repeatable)")
+    ap.add_argument("--baseline", default="",
+                    help="alternate baseline file (default "
+                         "scripts/analysis_baseline.json)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered passes and exit")
+    args = ap.parse_args(argv)
+    if args.list:
+        from . import passes as _passes  # noqa: F401
+        for rule, p in sorted(engine.all_passes().items()):
+            print(f"{rule}: {p.title}")
+        return 0
+    report = run(rules=args.rule, baseline_path=args.baseline)
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
+    else:
+        render(report)
+    return report.exit_code

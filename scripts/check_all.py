@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Unified static-analysis runner (package-import-free).
+
+Runs every registered ray_tpu.analysis pass — the five ported legacy
+checkers plus the concurrency passes — WITHOUT importing ray_tpu's
+package __init__ (which drags in the whole runtime); the analysis
+package is stdlib-only and loads standalone in milliseconds.
+
+    python scripts/check_all.py            # human-readable, exit 0/1/2
+    python scripts/check_all.py --json     # machine-readable report
+    python scripts/check_all.py --rule CANCEL-SAFE
+    python scripts/check_all.py --list
+
+Identical verdicts to `python -m ray_tpu.analysis`; see README
+"Static analysis" for the pass catalog, the `# ray-tpu: noqa(RULE)`
+inline form, and the scripts/analysis_baseline.json waiver format.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PKG_NAME = "_rt_analysis"
+
+
+def load_analysis():
+    """The ray_tpu.analysis package under a private name, loaded from
+    its path so `ray_tpu/__init__.py` never runs."""
+    if _PKG_NAME in sys.modules:
+        return sys.modules[_PKG_NAME]
+    pkg_dir = os.path.join(REPO, "ray_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        _PKG_NAME, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[_PKG_NAME] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(_PKG_NAME, None)
+        raise
+    return mod
+
+
+def main(argv=None) -> int:
+    return load_analysis().main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,243 +1,31 @@
 #!/usr/bin/env python
-"""Static check: every compiled-DAG acquisition has a release.
-
-A CompiledDAG acquires durable resources at compile time — shm ring
-segments, KV-backed store channels, pinned worker leases at the
-raylets, executor actors, persistent run loops — and the ONLY thing
-standing between a bug and a leaked segment / permanently pinned lease
-is teardown() running the matching release on EVERY path (normal
-teardown, failure watcher, and the compile-error path). Same philosophy
-as check_serve_persistence / check_rpc_idempotency: the invariant is
-structural, so enforce it structurally — AST-scoped source checks, no
-imports of the package, runs in milliseconds.
-
-Checked invariants:
-  * dag/compiled.py: every acquire call reachable from compile
-    (RingChannel / StoreChannel construction, dag_pin_actors, executor
-    `.remote(`, run-loop ship) has its release (channel destroy,
-    dag_release, kill, loop-ref wait) in teardown() — transitively
-    through the self-methods teardown calls;
-  * teardown() orders: close channels BEFORE waiting the loop refs
-    BEFORE destroying segments (a loop blocked mid-read only exits
-    once its channels wake it — destroy-first would wedge the wait);
-  * __init__ wraps compilation in an error path that calls teardown()
-    and re-raises (a failed compile must not leak what it acquired);
-  * the failure watcher path (_fail) closes channels so blocked
-    executes surface the typed error instead of wedging;
-  * recovery-path acquisitions pair with releases on the
-    recovery-FAILURE path: a re-pin (_recover -> dag_pin_actors /
-    self._pin) requires dag_release reachable from _recovery_failed (a
-    DAG that will never tick again must not hold OOM/reaper-exempt
-    leases until the user happens to call teardown), and a channel
-    re-create inside _recover must register into self._channels so the
-    ordinary teardown destroy sweep covers it;
-  * the recovery driver (_run_recovery) routes every failed attempt
-    through _recovery_failed, which must reach _fail (blocked executes
-    wake typed instead of wedging on a half-recovered pipeline);
-  * experimental/channels.py: every channel class exposes BOTH close()
-    and destroy() (wake-everyone vs release-the-segment are distinct
-    duties; teardown needs both), and reopen() (recovery keeps
-    surviving segments; a close it cannot undo would strand them).
-
-Exit status 0 = every acquisition releases; 1 = gaps (printed).
+"""Thin alias — the compiled-DAG teardown checker now runs as the
+DAG-TEARDOWN pass on the shared analysis engine (see
+ray_tpu/analysis/passes/dag_teardown.py, and scripts/check_all.py to
+run every pass at once). The same-file base-class method resolution and
+transitive self-method call walk this checker pioneered moved into the
+engine (SourceModule.class_methods / transitive_source). This shim
+keeps the historical entry point and module surface with identical
+verdicts.
 """
 
 from __future__ import annotations
 
-import ast
+import importlib
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from check_all import load_analysis  # noqa: E402
 
-COMPILED = "ray_tpu/dag/compiled.py"
-CHANNELS = "ray_tpu/experimental/channels.py"
+load_analysis()
+_pass = importlib.import_module("_rt_analysis.passes.dag_teardown")
 
-# (acquire_pattern, release_pattern, why). The acquire must appear in
-# CompiledDAG's compile path; the release must appear in teardown's
-# transitive source.
-ACQUIRE_RELEASE = [
-    (r"RingChannel\(", r"\.destroy\(\)",
-     "ring channels allocate /dev/shm segments that only destroy() "
-     "unlinks"),
-    (r"StoreChannel\(", r"\.destroy\(\)",
-     "store channels leave GCS KV records that only destroy() deletes"),
-    (r"dag_pin_actors\(", r"dag_release\(",
-     "pinned worker leases must be released at every raylet"),
-    (r"_executor_actor_class\(\)", r"\bkill\(",
-     "executor actors created for FunctionNodes must be killed"),
-    (r"\.remote\(", r"ray_tpu\.get\(ref",
-     "shipped run loops must be awaited (channels closed first) so "
-     "executors exit before their leases release"),
-]
-
-# (pattern_a, pattern_b, why): in teardown's own source, the FIRST match
-# of a must precede the FIRST match of b.
-TEARDOWN_ORDER = [
-    (r"\.close\(\)", r"ray_tpu\.get\(ref",
-     "close channels BEFORE waiting the loop refs (loops blocked "
-     "mid-read only exit once their channels wake them)"),
-    (r"ray_tpu\.get\(ref", r"\.destroy\(\)",
-     "wait the loop refs BEFORE destroying segments (an executor "
-     "mid-tick must not have its mapped memory unlinked underneath "
-     "it)"),
-]
-
-
-def _class_functions(path: str):
-    """({class_name: {fn_name: source}}, {class_name: [base names]})."""
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
-    tree = ast.parse(text)
-    fns, bases = {}, {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef):
-            bases[node.name] = [b.id for b in node.bases
-                                if isinstance(b, ast.Name)]
-            for item in node.body:
-                if isinstance(item, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                    fns.setdefault(node.name, {})[item.name] = \
-                        ast.get_source_segment(text, item) or ""
-    return fns, bases
-
-
-def _resolved_methods(fns: dict, bases: dict, cls: str) -> dict:
-    """Class methods including same-file base classes (MRO-ish)."""
-    out = {}
-    for base in bases.get(cls, []):
-        out.update(_resolved_methods(fns, bases, base))
-    out.update(fns.get(cls, {}))
-    return out
-
-
-def _transitive_source(fns: dict, root: str) -> str:
-    """Source of `root` plus every self._method it (transitively)
-    calls — the release may live in a helper teardown delegates to."""
-    seen, queue, parts = set(), [root], []
-    while queue:
-        name = queue.pop()
-        if name in seen or name not in fns:
-            continue
-        seen.add(name)
-        src = fns[name]
-        parts.append(src)
-        for callee in re.findall(r"self\.(\w+)\(", src):
-            queue.append(callee)
-    return "\n".join(parts)
-
-
-def check() -> list:
-    problems = []
-
-    path = os.path.join(REPO, COMPILED)
-    try:
-        fns_by_class, _ = _class_functions(path)
-    except (OSError, SyntaxError) as e:
-        return [f"{COMPILED}: unreadable ({e})"]
-    dag_fns = fns_by_class.get("CompiledDAG")
-    if not dag_fns:
-        return [f"{COMPILED}: class CompiledDAG not found — subsystem "
-                f"renamed? update check_dag_teardown.py"]
-    compile_src = _transitive_source(
-        dag_fns, "__init__") + _transitive_source(dag_fns, "_compile")
-    teardown_src = _transitive_source(dag_fns, "teardown")
-    if "teardown" not in dag_fns:
-        return [f"{COMPILED}: CompiledDAG.teardown missing"]
-
-    for acquire, release, why in ACQUIRE_RELEASE:
-        if not re.search(acquire, compile_src):
-            continue  # acquisition gone: nothing to release
-        if not re.search(release, teardown_src):
-            problems.append(
-                f"{COMPILED}: compile acquires /{acquire}/ but teardown "
-                f"never matches /{release}/ — {why}")
-
-    own_teardown = dag_fns["teardown"]
-    for pat_a, pat_b, why in TEARDOWN_ORDER:
-        a = re.search(pat_a, own_teardown)
-        b = re.search(pat_b, own_teardown)
-        if a is None or b is None:
-            problems.append(
-                f"{COMPILED}: teardown missing /{pat_a}/ or /{pat_b}/ "
-                f"— {why}")
-        elif a.start() > b.start():
-            problems.append(
-                f"{COMPILED}: teardown orders /{pat_b}/ before "
-                f"/{pat_a}/ — {why}")
-
-    init_src = dag_fns.get("__init__", "")
-    if not re.search(r"except\s+BaseException", init_src) or \
-            "self.teardown()" not in init_src or \
-            not re.search(r"\braise\b", init_src):
-        problems.append(
-            f"{COMPILED}: __init__ must wrap compilation in an error "
-            f"path that calls self.teardown() and re-raises — a failed "
-            f"compile must release whatever it already acquired")
-
-    fail_src = _transitive_source(dag_fns, "_fail")
-    if not re.search(r"\.close\(\)", fail_src):
-        problems.append(
-            f"{COMPILED}: the failure path (_fail) must close every "
-            f"channel so blocked executes raise typed instead of "
-            f"wedging")
-
-    # Recovery-path acquire/release pairing (self-healing DAGs).
-    if "_recover" in dag_fns:
-        recover_src = _transitive_source(dag_fns, "_recover")
-        recfail_src = _transitive_source(dag_fns, "_recovery_failed")
-        if re.search(r"dag_pin_actors\(|self\._pin\(", recover_src) and \
-                not re.search(r"dag_release\(", recfail_src):
-            problems.append(
-                f"{COMPILED}: _recover re-pins worker leases but the "
-                f"recovery-failure path (_recovery_failed) never matches "
-                f"/dag_release\\(/ — a failed recovery must not leave "
-                f"OOM/reaper-exempt leases pinned until teardown")
-        if re.search(r"RingChannel\(|StoreChannel\(", recover_src) and \
-                not re.search(r"_channels\.append\(", recover_src) and \
-                not re.search(r"\.destroy\(\)", recfail_src):
-            problems.append(
-                f"{COMPILED}: _recover re-creates channels without "
-                f"registering them into self._channels (teardown's "
-                f"destroy sweep) or destroying them in _recovery_failed "
-                f"— a re-homed edge's segment/KV records would leak")
-        driver_src = _transitive_source(dag_fns, "_run_recovery")
-        if "_run_recovery" in dag_fns and \
-                not re.search(r"self\._recovery_failed\(", driver_src):
-            problems.append(
-                f"{COMPILED}: _run_recovery must route failed attempts "
-                f"through self._recovery_failed(...)")
-        if not re.search(r"self\._fail\(", recfail_src):
-            problems.append(
-                f"{COMPILED}: _recovery_failed must reach _fail so "
-                f"blocked executes wake typed instead of wedging")
-    elif re.search(r"tick_replay", "".join(dag_fns.values())):
-        problems.append(
-            f"{COMPILED}: tick_replay is accepted but CompiledDAG has "
-            f"no _recover — recovery renamed? update "
-            f"check_dag_teardown.py")
-
-    cpath = os.path.join(REPO, CHANNELS)
-    try:
-        ch_fns, ch_bases = _class_functions(cpath)
-    except (OSError, SyntaxError) as e:
-        return problems + [f"{CHANNELS}: unreadable ({e})"]
-    for cls in ("RingChannel", "StoreChannel"):
-        if cls not in ch_fns:
-            problems.append(
-                f"{CHANNELS}: class {cls} not found — channel layer "
-                f"renamed? update check_dag_teardown.py")
-            continue
-        fns = _resolved_methods(ch_fns, ch_bases, cls)
-        for required in ("close", "destroy", "reopen"):
-            if required not in fns:
-                problems.append(
-                    f"{CHANNELS}: {cls} has no {required}() — teardown "
-                    f"needs close (wake blocked ends) AND destroy "
-                    f"(release the segment/records); recovery needs "
-                    f"reopen (kept segments must carry traffic again)")
-    return problems
+check = _pass.check
+COMPILED = _pass.COMPILED
+CHANNELS = _pass.CHANNELS
+ACQUIRE_RELEASE = _pass.ACQUIRE_RELEASE
+TEARDOWN_ORDER = _pass.TEARDOWN_ORDER
 
 
 def main() -> int:

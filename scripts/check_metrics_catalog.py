@@ -1,79 +1,28 @@
 #!/usr/bin/env python
-"""Static check: the README metrics catalog and the code agree.
-
-Every `ray_tpu_*` metric name constructed anywhere under `ray_tpu/` must
-have a row in README.md's "Metrics catalog" table, and every cataloged
-name must still exist in the code — so metric names can't silently drift
-(renames, additions, and removals all fail tier-1 until the catalog is
-updated). Grep-based on purpose: no imports, no cluster, runs in
-milliseconds.
-
-Exit status 0 = in sync; 1 = drift (differences printed).
+"""Thin alias — the metrics-catalog checker now runs as the METRICS-CAT
+pass on the shared analysis engine (see
+ray_tpu/analysis/passes/metrics_catalog.py, and scripts/check_all.py to
+run every pass at once). This shim keeps the historical entry point and
+module surface (check / code_metric_names / catalog_metric_names) with
+identical verdicts.
 """
 
 from __future__ import annotations
 
+import importlib
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from check_all import load_analysis  # noqa: E402
 
-# Full-string double-quoted literals that look like metric names but are
-# not (temp-dir prefixes, contextvar names). Anything added here must
-# genuinely not be a metric.
-NON_METRIC_LITERALS = {
-    "ray_tpu_ckpt_",       # checkpoint temp-dir prefix
-    "ray_tpu_results",     # train results dir
-    "ray_tpu_workflows",   # workflow storage dir
-    "ray_tpu_span",        # tracing contextvar name
-}
+load_analysis()
+_pass = importlib.import_module("_rt_analysis.passes.metrics_catalog")
 
-_LITERAL = re.compile(r'"(ray_tpu_[a-z0-9_]+)"')
-_CATALOG_ROW = re.compile(r"^\|\s*`(ray_tpu_[a-z0-9_]+)`")
-
-
-def code_metric_names() -> set:
-    names = set()
-    for root, _dirs, files in os.walk(os.path.join(REPO, "ray_tpu")):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            try:
-                with open(path, encoding="utf-8") as f:
-                    text = f.read()
-            except OSError:
-                continue
-            names.update(_LITERAL.findall(text))
-    return names - NON_METRIC_LITERALS
-
-
-def catalog_metric_names(readme_path: str = "") -> set:
-    path = readme_path or os.path.join(REPO, "README.md")
-    names = set()
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            m = _CATALOG_ROW.match(line.strip())
-            if m:
-                names.add(m.group(1))
-    return names
-
-
-def check() -> list:
-    """List of human-readable drift messages; empty = in sync."""
-    in_code = code_metric_names()
-    in_catalog = catalog_metric_names()
-    problems = []
-    for name in sorted(in_code - in_catalog):
-        problems.append(
-            f"metric {name!r} is constructed in ray_tpu/ but missing from "
-            f"the README metrics catalog")
-    for name in sorted(in_catalog - in_code):
-        problems.append(
-            f"README catalogs {name!r} but no code under ray_tpu/ "
-            f"constructs it")
-    return problems
+check = _pass.check
+code_metric_names = _pass.code_metric_names
+catalog_metric_names = _pass.catalog_metric_names
+NON_METRIC_LITERALS = _pass.NON_METRIC_LITERALS
 
 
 def main() -> int:

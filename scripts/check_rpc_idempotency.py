@@ -1,70 +1,25 @@
 #!/usr/bin/env python
-"""Static check: every ClientPool-reachable RPC handler is annotated.
-
-Every `async def rpc_*` / `async def _rpc_*` handler under `ray_tpu/`
-must carry an explicit `@rpc.idempotent` or `@rpc.non_idempotent`
-decorator (ray_tpu/_private/rpc.py). ClientPool.request keys its
-replay-after-ConnectionLost policy off the annotation registry, so an
-unannotated method silently falls back to the legacy retry-once
-behavior — which can double-execute a non-idempotent method when a live
-peer only dropped the connection. Runs in milliseconds: the ONE shared
-line-walker (`rpc.scan_handler_annotations` — the same code the runtime
-registry fills from, so check and runtime can never parse differently)
-is loaded straight from rpc.py without importing the ray_tpu package.
-
-Exit status 0 = fully annotated; 1 = gaps (printed).
+"""Thin alias — the RPC-idempotency checker now runs as the RPC-IDEM
+pass on the shared analysis engine (see
+ray_tpu/analysis/passes/rpc_idempotency.py, and scripts/check_all.py to
+run every pass at once). This shim keeps the historical entry point and
+module surface (check / handler_gaps) with identical verdicts.
 """
 
 from __future__ import annotations
 
-import importlib.util
+import importlib
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from check_all import load_analysis  # noqa: E402
 
-# rpc.py is stdlib-only; load it standalone (no ray_tpu/__init__).
-_spec = importlib.util.spec_from_file_location(
-    "_rpc_for_check", os.path.join(REPO, "ray_tpu", "_private", "rpc.py"))
-_rpc = importlib.util.module_from_spec(_spec)
-_spec.loader.exec_module(_rpc)
-scan_handler_annotations = _rpc.scan_handler_annotations
+load_analysis()
+_pass = importlib.import_module("_rt_analysis.passes.rpc_idempotency")
 
-
-def handler_gaps(path: str) -> list:
-    """(method, lineno) pairs for unannotated handlers in one file."""
-    with open(path, encoding="utf-8") as f:
-        lines = f.readlines()
-    return [(name, lineno)
-            for name, lineno, flag in scan_handler_annotations(lines)
-            if flag is None]
-
-
-def check() -> list:
-    """Human-readable problem list; empty = fully annotated."""
-    problems = []
-    n_handlers = 0
-    for root, _dirs, files in os.walk(os.path.join(REPO, "ray_tpu")):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(path, REPO)
-            try:
-                with open(path, encoding="utf-8") as f:
-                    if "async def rpc_" not in (text := f.read()) \
-                            and "async def _rpc_" not in text:
-                        continue
-            except OSError:
-                continue
-            n_handlers += 1
-            for method, lineno in handler_gaps(path):
-                problems.append(
-                    f"{rel}:{lineno}: handler {method!r} has no "
-                    f"@rpc.idempotent / @rpc.non_idempotent annotation")
-    if n_handlers == 0:
-        problems.append("no RPC handler files found — check is vacuous")
-    return problems
+check = _pass.check
+handler_gaps = _pass.handler_gaps
 
 
 def main() -> int:

@@ -1,183 +1,28 @@
 #!/usr/bin/env python
-"""Static check: the serve controller is write-ahead, everywhere.
-
-The durable control plane only works if EVERY target-state mutation
-persists its record to the GCS KV BEFORE the mutation's routing or
-replica effects publish: one path that flips the order (or skips the
-write) produces a controller that recovers to a state routers never
-saw — exactly the split-brain this plane exists to kill. Same
-philosophy as check_trace_propagation / check_rpc_idempotency: the
-invariant is structural, so enforce it structurally — AST-scoped source
-checks, no imports of the package, runs in milliseconds.
-
-Checked invariants (ray_tpu/serve/controller.py):
-  * deploy_app persists target records + the route table before it
-    mutates in-memory deployment/route state;
-  * delete_app / _remove_deployment persist the deletion first;
-  * _set_target write-aheads the new target before applying it, and it
-    is the ONLY place that assigns target_num outside the recovery
-    loader and the dataclass constructors;
-  * _start_replica registers the replica row before the replica set
-    publishes; _wait_ready persists the swap outcome before the
-    RUNNING/drain publish; drain/drop paths GC their registry rows;
-  * nobody appends to a replica set outside _start_replica and the
-    recovery reattach.
-
-Exit status 0 = fully write-ahead; 1 = gaps (printed).
+"""Thin alias — the serve write-ahead checker now runs as the SERVE-WAL
+pass on the shared analysis engine (see
+ray_tpu/analysis/passes/serve_persistence.py, and scripts/check_all.py
+to run every pass at once). This shim keeps the historical entry point
+and module surface with identical verdicts.
 """
 
 from __future__ import annotations
 
-import ast
+import importlib
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from check_all import load_analysis  # noqa: E402
 
-CONTROLLER = "ray_tpu/serve/controller.py"
+load_analysis()
+_pass = importlib.import_module("_rt_analysis.passes.serve_persistence")
 
-# (class, fn, persist_pattern, effect_pattern, why) — the FIRST match of
-# persist_pattern must precede the FIRST match of effect_pattern.
-ORDERED_RULES = [
-    ("ServeController", "_deploy_app_locked",
-     r"persistence\.app_key",
-     r"persistence\.target_key",
-     "deploy must persist the app-atomic snapshot blob before any "
-     "per-deployment record (a crash between records must reconcile "
-     "against ONE consistent app state)"),
-    ("ServeController", "_deploy_app_locked",
-     r"self\._persist\.put\(\s*\n?\s*persistence\.target_key",
-     r"self\._deployments\[",
-     "deploy must persist every target record before mutating state"),
-    ("ServeController", "delete_app",
-     r"persistence\.app_key",
-     r"persistence\.ROUTES_KEY",
-     "delete must drop the app snapshot before anything else — a stale "
-     "snapshot would resurrect deployments on recovery"),
-    ("ServeController", "_deploy_app_locked",
-     r"persistence\.ROUTES_KEY",
-     r"self\._routes\[",
-     "deploy must persist the route table before publishing the route"),
-    ("ServeController", "delete_app",
-     r"persistence\.ROUTES_KEY",
-     r"self\._routes\s*=",
-     "delete must persist the shrunken route table before applying it"),
-    ("ServeController", "_remove_deployment",
-     r"self\._persist\.delete",
-     r"self\._deployments\.pop",
-     "removal must delete the KV records before dropping the state"),
-    ("ServeController", "_set_target",
-     r"self\._persist\.put\(",
-     r"\.target_num\s*=(?!=)",
-     "scaling must write-ahead the new target before applying it"),
-    ("ServeController", "_start_replica",
-     r"_persist_replica_row\(",
-     r"st\.replicas\.append",
-     "a replica's registry row must exist before the set publishes"),
-    ("ServeController", "_wait_ready",
-     r"_persist_replica_row\(",
-     r"info\.state = REPLICA_RUNNING",
-     "the rolling-update swap must persist before it publishes"),
-]
-
-# (class, fn, pattern, why) — pattern must be present.
-PRESENCE_RULES = [
-    ("ServeController", "_begin_drain", r"_persist_replica_row_soon\(",
-     "draining must persist the DRAINING row so a controller crash "
-     "mid-drain can finish the kill instead of leaking the replica"),
-    ("ServeController", "_drain_and_stop", r"delete_soon\(",
-     "a completed drain must GC the replica's registry row"),
-    ("ServeController", "_drop_dead_replica", r"delete_soon\(",
-     "dropping a dead replica must GC its registry row"),
-]
-
-# (pattern, {allowed (class, fn)}, why) — pattern may ONLY appear in the
-# allowed functions anywhere in controller.py.
-FORBID_RULES = [
-    (re.compile(r"\.target_num\s*=(?!=)"),
-     {("ServeController", "_set_target"),
-      ("ServeController", "_apply_target_record"),
-      ("_DeploymentState", "__init__")},
-     "target_num is assigned outside the write-ahead scale path"),
-    (re.compile(r"\.replicas\.append"),
-     {("ServeController", "_start_replica"),
-      ("ServeController", "_reattach_deployment")},
-     "replica sets may only grow via _start_replica or recovery "
-     "reattach (both persist the registry row)"),
-    (re.compile(r"\.version\s*=(?!=)"),
-     {("ServeController", "_apply_target_record"),
-      ("_DeploymentState", "__init__"),
-      ("_ReplicaInfo", "__init__")},
-     "deployment/replica versions may only change through the "
-     "persisted target record (or the constructors)"),
-]
-
-
-def _function_sources(path: str):
-    """{(class_name, fn_name): (source_segment, lineno)} for one file."""
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
-    tree = ast.parse(text)
-    out = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef):
-            for item in node.body:
-                if isinstance(item, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                    out[(node.name, item.name)] = (
-                        ast.get_source_segment(text, item) or "",
-                        item.lineno)
-    return out
-
-
-def check() -> list:
-    problems = []
-    path = os.path.join(REPO, CONTROLLER)
-    try:
-        funcs = _function_sources(path)
-    except (OSError, SyntaxError) as e:
-        return [f"{CONTROLLER}: unreadable ({e})"]
-    for cls, fn, persist_pat, effect_pat, why in ORDERED_RULES:
-        ent = funcs.get((cls, fn))
-        if ent is None:
-            problems.append(
-                f"{CONTROLLER}: {cls}.{fn} not found — mutation path "
-                f"renamed? update check_serve_persistence.py ({why})")
-            continue
-        src, lineno = ent
-        persist = re.search(persist_pat, src)
-        effect = re.search(effect_pat, src)
-        if persist is None:
-            problems.append(
-                f"{CONTROLLER}:{lineno}: {cls}.{fn} never persists "
-                f"(/{persist_pat}/ absent) — {why}")
-            continue
-        if effect is not None and effect.start() < persist.start():
-            problems.append(
-                f"{CONTROLLER}:{lineno}: {cls}.{fn} publishes its effect "
-                f"(/{effect_pat}/) BEFORE persisting — {why}")
-    for cls, fn, pat, why in PRESENCE_RULES:
-        ent = funcs.get((cls, fn))
-        if ent is None:
-            problems.append(
-                f"{CONTROLLER}: {cls}.{fn} not found — mutation path "
-                f"renamed? update check_serve_persistence.py ({why})")
-            continue
-        src, lineno = ent
-        if not re.search(pat, src):
-            problems.append(
-                f"{CONTROLLER}:{lineno}: {cls}.{fn} does not match "
-                f"/{pat}/ — {why}")
-    for pat, allowed, why in FORBID_RULES:
-        for (cls, fn), (src, lineno) in funcs.items():
-            if (cls, fn) in allowed:
-                continue
-            if pat.search(src):
-                problems.append(
-                    f"{CONTROLLER}:{lineno}: {cls}.{fn} matches "
-                    f"/{pat.pattern}/ — {why}")
-    return problems
+check = _pass.check
+CONTROLLER = _pass.CONTROLLER
+ORDERED_RULES = _pass.ORDERED_RULES
+PRESENCE_RULES = _pass.PRESENCE_RULES
+FORBID_RULES = _pass.FORBID_RULES
 
 
 def main() -> int:

@@ -1,145 +1,29 @@
 #!/usr/bin/env python
-"""Static check: every serve entry point forwards the request trace.
-
-The request observability plane only works if EVERY ingress mints/binds
-a RequestTrace and every dispatch path ships it to the replica: one
-entry point that forgets produces silently truncated traces (a request
-that "disappears" at the proxy), which is exactly the failure mode this
-plane exists to kill. Same philosophy as check_rpc_idempotency: the
-invariant is structural, so enforce it structurally — AST-scoped
-source checks, no imports of the package, runs in milliseconds.
-
-Checked invariants:
-  * each proxy ingress (HTTP conn handler, websocket upgrade, binary-RPC
-    unary/stream) mints AND binds a request trace;
-  * the handle adopts the bound context (or mints) in _make_request, and
-    both submit paths stamp/forward it to the replica;
-  * the replica accepts the wire context on both request methods;
-  * nobody dispatches to a replica around the forwarding submitters
-    (raw `handle_request*.remote(` outside handle.py's _submit pair).
-
-Exit status 0 = fully wired; 1 = gaps (printed).
+"""Thin alias — the trace-propagation checker now runs as the
+TRACE-PROP pass on the shared analysis engine (see
+ray_tpu/analysis/passes/trace_propagation.py, and scripts/check_all.py
+to run every pass at once). This shim keeps the historical entry point
+and module surface (check / RULES) with identical verdicts.
 """
 
 from __future__ import annotations
 
-import ast
+import importlib
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from check_all import load_analysis  # noqa: E402
 
-# (file, class, function, [required regexes], why)
-RULES = [
-    ("ray_tpu/serve/proxy.py", "ProxyActor", "_handle_conn",
-     [r"request_trace\.mint\(", r"request_trace\.bind\(",
-      r"request_trace\.finish\("],
-     "HTTP ingress must mint+bind+finish the request trace"),
-    ("ray_tpu/serve/proxy.py", "ProxyActor", "_handle_websocket",
-     [r"request_trace\.mint\(", r"request_trace\.bind\(",
-      r"request_trace\.finish\("],
-     "websocket ingress must mint+bind+finish the request trace"),
-    ("ray_tpu/serve/grpc_proxy.py", "GrpcProxyActor", "_rpc_unary",
-     [r"request_trace\.mint\(", r"request_trace\.bind\(",
-      r"request_trace\.finish\("],
-     "binary-RPC unary ingress must mint+bind+finish the request trace"),
-    ("ray_tpu/serve/grpc_proxy.py", "GrpcProxyActor", "_rpc_stream",
-     [r"request_trace\.mint\(", r"request_trace\.bind\(",
-      r"request_trace\.finish\("],
-     "binary-RPC stream ingress must mint+bind+finish the request trace"),
-    ("ray_tpu/serve/handle.py", "DeploymentHandle", "_make_request",
-     [r"request_trace\.current\(", r"request_trace\.mint\("],
-     "the handle must adopt the bound ingress context or mint one"),
-    ("ray_tpu/serve/handle.py", "DeploymentHandle", "_submit",
-     [r"_stamp_dispatch\(", r"trace_ctx"],
-     "unary dispatch must stamp+forward the trace to the replica"),
-    ("ray_tpu/serve/handle.py", "DeploymentHandle", "_submit_stream",
-     [r"_stamp_dispatch\(", r"trace_ctx"],
-     "streaming dispatch must stamp+forward the trace to the replica"),
-    ("ray_tpu/serve/replica.py", "ReplicaActor", "handle_request",
-     [r"trace_ctx", r"_trace_ctx\("],
-     "the replica must accept and decode the wire trace context"),
-    ("ray_tpu/serve/replica.py", "ReplicaActor", "handle_request_streaming",
-     [r"trace_ctx", r"_trace_ctx\("],
-     "the streaming replica path must accept the wire trace context"),
-]
+load_analysis()
+_pass = importlib.import_module("_rt_analysis.passes.trace_propagation")
 
-# Raw replica dispatch is allowed ONLY in the forwarding submitters.
-_RAW_DISPATCH = re.compile(r"handle_request(_streaming)?\s*(\.options\("
-                           r"[^)]*\))?\s*\.remote\(")
-_DISPATCH_ALLOWED = {("ray_tpu/serve/handle.py", "_submit"),
-                     ("ray_tpu/serve/handle.py", "_submit_stream")}
+RULES = _pass.RULES
 
 
-def _function_sources(path: str):
-    """{(class_name, fn_name): source_segment} for one file."""
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
-    tree = ast.parse(text)
-    out = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef):
-            for item in node.body:
-                if isinstance(item, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                    out[(node.name, item.name)] = (
-                        ast.get_source_segment(text, item) or "",
-                        item.lineno)
-    return out, text
-
-
-def check(extra_dispatch_dirs=()) -> list:
-    """Run all checks; extra_dispatch_dirs are additionally scanned for
-    raw replica dispatch (lets tests plant rogue fixtures in a tmp dir
-    instead of the real package)."""
-    problems = []
-    cache = {}
-    for rel, cls, fn, patterns, why in RULES:
-        path = os.path.join(REPO, rel)
-        if rel not in cache:
-            try:
-                cache[rel] = _function_sources(path)
-            except (OSError, SyntaxError) as e:
-                problems.append(f"{rel}: unreadable ({e})")
-                cache[rel] = ({}, "")
-                continue
-        funcs, _text = cache[rel]
-        ent = funcs.get((cls, fn))
-        if ent is None:
-            problems.append(
-                f"{rel}: {cls}.{fn} not found — entry point renamed? "
-                f"update check_trace_propagation.py ({why})")
-            continue
-        src, lineno = ent
-        for pat in patterns:
-            if not re.search(pat, src):
-                problems.append(
-                    f"{rel}:{lineno}: {cls}.{fn} does not match "
-                    f"/{pat}/ — {why}")
-    # No raw replica dispatch outside the forwarding submitters.
-    scan_dirs = [os.path.join(REPO, "ray_tpu", "serve")]
-    scan_dirs.extend(extra_dispatch_dirs)
-    for serve_dir in scan_dirs:
-        for fname in sorted(os.listdir(serve_dir)):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(serve_dir, fname)
-            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
-            try:
-                funcs, _text = cache.get(rel) or _function_sources(path)
-            except (OSError, SyntaxError):
-                continue
-            for (cls, fn), (src, lineno) in funcs.items():
-                if (rel, fn) in _DISPATCH_ALLOWED:
-                    continue
-                if _RAW_DISPATCH.search(src):
-                    problems.append(
-                        f"{rel}:{lineno}: {cls}.{fn} dispatches to a "
-                        f"replica directly — route through "
-                        f"DeploymentHandle._submit/_submit_stream so the "
-                        f"request trace is forwarded")
-    return problems
+def check(extra_dispatch_dirs=(), cache=None) -> list:
+    return _pass.check(cache=cache,
+                       extra_dispatch_dirs=extra_dispatch_dirs)
 
 
 def main() -> int:
@@ -152,7 +36,7 @@ def main() -> int:
               f"dispatch path must forward it.", file=sys.stderr)
         return 1
     print(f"request-trace propagation wired "
-          f"({len(RULES)} entry points checked)")
+          f"({len(_pass.RULES)} entry points checked)")
     return 0
 
 

@@ -66,6 +66,36 @@ def pytest_configure(config):
                 pass  # raced with a concurrent reaper / foreign owner
 
 
+# ---------------------------------------------------------------------------
+# gen-2 GC relief for the pytest DRIVER process (the analogue of PR 10's
+# forkserver gc.freeze() fix, applied to the suite itself). Collection
+# imports every test module — pulling ray_tpu + jax + models into a heap
+# that only grows as the session ages; every gen-2 collection then
+# re-traverses all of it. Freezing moves the accumulated survivors into
+# the permanent generation (never traversed again; a gen-2 collect
+# measured 15ms -> 0 post-freeze); re-freezing at each module boundary
+# folds in whatever the previous module loaded lazily. gc.collect()
+# first so garbage cycles aren't immortalized. Measured at the 870s
+# tier-1 cap: 425 dots (80%) at HEAD -> 497 dots (88%) with this change
+# — while collecting ~45 MORE tests (the static-analysis suite) and
+# with the same 7 pre-existing failures.
+# ---------------------------------------------------------------------------
+
+
+def pytest_collection_finish(session):
+    import gc
+    gc.collect()
+    gc.freeze()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _gc_freeze_accumulated_heap():
+    import gc
+    gc.collect()
+    gc.freeze()
+    yield
+
+
 class _TestTimeout(Exception):
     pass
 

@@ -1,51 +1,26 @@
-"""Compiled-DAG teardown static check (tier-1 guard, like
-test_serve_persistence_check): every channel/lease/actor acquired in
-compile() must be released on every teardown/error path."""
+"""Thin alias — the compiled-DAG teardown check now runs on the shared
+analysis engine (DAG-TEARDOWN pass); the real tests live in
+test_static_analysis.py and are aliased here so the historical entry
+point never silently drops."""
 
-import importlib.util
-import os
-
-
-def _load_checker():
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "scripts", "check_dag_teardown.py")
-    spec = importlib.util.spec_from_file_location("check_dag_teardown",
-                                                  path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+from test_static_analysis import (  # noqa: F401
+    test_teardown_checker_detects_bad_order as
+    test_checker_detects_bad_teardown_order,
+    test_teardown_checker_detects_missing_release as
+    test_checker_detects_missing_release,
+)
+from test_static_analysis import _CACHE, _pass_mod, rule_clean
 
 
 def test_compiled_dag_teardown_complete():
-    checker = _load_checker()
-    problems = checker.check()
+    problems = _pass_mod("dag_teardown").check(cache=_CACHE)
     assert problems == [], "\n".join(problems)
-
-
-def test_checker_detects_missing_release(monkeypatch):
-    """An acquire with no matching release is reported — the check can
-    actually fail, it isn't vacuous."""
-    checker = _load_checker()
-    monkeypatch.setattr(
-        checker, "ACQUIRE_RELEASE", checker.ACQUIRE_RELEASE + [
-            (r"RingChannel\(", r"THIS_RELEASE_DOES_NOT_EXIST",
-             "synthetic gap")])
-    problems = checker.check()
-    assert any("THIS_RELEASE_DOES_NOT_EXIST" in p for p in problems)
-
-
-def test_checker_detects_bad_teardown_order(monkeypatch):
-    """destroy-before-close (the wedge-the-loops ordering) is flagged."""
-    checker = _load_checker()
-    monkeypatch.setattr(checker, "TEARDOWN_ORDER", [
-        (r"\.destroy\(\)", r"\.close\(\)", "synthetic inversion")])
-    problems = checker.check()
-    assert any("synthetic inversion" in p for p in problems)
+    assert rule_clean("DAG-TEARDOWN") == []
 
 
 def test_checker_detects_renamed_subsystem(monkeypatch):
-    checker = _load_checker()
-    monkeypatch.setattr(checker, "CHANNELS",
+    mod = _pass_mod("dag_teardown")
+    monkeypatch.setattr(mod, "CHANNELS",
                         "ray_tpu/experimental/does_not_exist.py")
-    problems = checker.check()
+    problems = mod.check()
     assert any("unreadable" in p for p in problems)

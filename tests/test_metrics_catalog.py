@@ -1,31 +1,16 @@
-"""Tier-1 guard: metric names in code and the README catalog can't drift
-(satellite of the flight-recorder PR; scripts/check_metrics_catalog.py)."""
+"""Thin alias — the metrics-catalog check now runs on the shared
+analysis engine (METRICS-CAT pass); the real tests live in
+test_static_analysis.py and are aliased here so the historical entry
+point never silently drops."""
 
-import importlib.util
-import os
-
-
-def _load_checker():
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "scripts", "check_metrics_catalog.py")
-    spec = importlib.util.spec_from_file_location("check_metrics_catalog",
-                                                  path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+from test_static_analysis import (  # noqa: F401
+    test_metrics_parser_sees_known_metrics as
+    test_catalog_parser_sees_known_metrics,
+)
+from test_static_analysis import _CACHE, _pass_mod, rule_clean
 
 
 def test_metrics_catalog_in_sync():
-    checker = _load_checker()
-    problems = checker.check()
+    problems = _pass_mod("metrics_catalog").check(cache=_CACHE)
     assert problems == [], "\n".join(problems)
-
-
-def test_catalog_parser_sees_known_metrics():
-    # The check is only meaningful if both scans actually find things.
-    checker = _load_checker()
-    code = checker.code_metric_names()
-    catalog = checker.catalog_metric_names()
-    assert "ray_tpu_task_phase_seconds" in code
-    assert "ray_tpu_pubsub_dropped_total" in code
-    assert len(catalog) >= 20
+    assert rule_clean("METRICS-CAT") == []

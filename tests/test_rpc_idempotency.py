@@ -1,5 +1,7 @@
-"""RPC idempotency annotations: static coverage check (tier-1, like
-test_metrics_catalog) + the ClientPool retry semantics they drive.
+"""RPC idempotency annotations: the static coverage check now runs on
+the shared analysis engine (RPC-IDEM pass; real static tests live in
+test_static_analysis.py and are aliased below so nothing silently
+drops) + the ClientPool retry semantics the annotations drive.
 
 The double-execute hole: a retried non-idempotent method could run twice
 when a LIVE peer only dropped the connection after receiving the
@@ -9,43 +11,17 @@ ConnectionLost to the caller's own accounting.
 """
 
 import asyncio
-import importlib.util
-import os
 
+from test_static_analysis import (  # noqa: F401
+    test_rpc_checker_detects_unannotated_handler as
+    test_checker_detects_unannotated_handler,
+)
+from test_static_analysis import rule_clean
 
-def _load_checker():
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "scripts",
-        "check_rpc_idempotency.py")
-    spec = importlib.util.spec_from_file_location(
-        "check_rpc_idempotency", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-# ---------------------------------------------------------------------------
-# static check (tier-1 guard alongside check_metrics_catalog)
-# ---------------------------------------------------------------------------
 
 def test_every_rpc_handler_is_annotated():
-    checker = _load_checker()
-    problems = checker.check()
-    assert problems == [], "\n".join(problems)
-
-
-def test_checker_detects_unannotated_handler(tmp_path):
-    checker = _load_checker()
-    p = tmp_path / "fake_daemon.py"
-    p.write_text(
-        "class S:\n"
-        "    @rpc.idempotent\n"
-        "    async def rpc_ok(self, conn, payload):\n"
-        "        pass\n"
-        "    async def rpc_gap(self, conn, payload):\n"
-        "        pass\n")
-    gaps = checker.handler_gaps(str(p))
-    assert [g[0] for g in gaps] == ["rpc_gap"]
+    """Alias of the live-tree gate, scoped to this checker."""
+    assert rule_clean("RPC-IDEM") == []
 
 
 def test_registry_conflicts_merge_to_safer_flag():

@@ -1,75 +1,45 @@
-"""Write-ahead persistence static check (tier-1 guard, like
-test_trace_propagation_check): every serve-controller target-state
-mutation persists to the KV before publishing routing/replica effects."""
+"""Thin alias — the serve write-ahead check now runs on the shared
+analysis engine (SERVE-WAL pass); the real tests live in
+test_static_analysis.py and are aliased here so the historical entry
+point never silently drops."""
 
-import importlib.util
-import os
-
-
-def _load_checker():
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "scripts",
-        "check_serve_persistence.py")
-    spec = importlib.util.spec_from_file_location(
-        "check_serve_persistence", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+from test_static_analysis import (  # noqa: F401
+    test_persistence_checker_detects_effect_before_persist as
+    test_checker_detects_effect_before_persist,
+    test_persistence_checker_detects_missing_persist as
+    test_checker_detects_missing_persist,
+)
+from test_static_analysis import _CACHE, _pass_mod, rule_clean
 
 
 def test_controller_is_fully_write_ahead():
-    checker = _load_checker()
-    problems = checker.check()
+    problems = _pass_mod("serve_persistence").check(cache=_CACHE)
     assert problems == [], "\n".join(problems)
-
-
-def test_checker_detects_missing_persist(monkeypatch):
-    """A mutation path with no persist call is reported — the check can
-    actually fail, it isn't vacuous."""
-    checker = _load_checker()
-    monkeypatch.setattr(checker, "ORDERED_RULES", checker.ORDERED_RULES + [
-        ("ServeController", "deploy_app",
-         r"THIS_PERSIST_CALL_DOES_NOT_EXIST", r"self\._deployments\[",
-         "synthetic gap")])
-    problems = checker.check()
-    assert any("THIS_PERSIST_CALL_DOES_NOT_EXIST" in p for p in problems)
-
-
-def test_checker_detects_effect_before_persist(monkeypatch):
-    """An effect that textually precedes its persist call is an
-    ordering violation (the write-ahead contract)."""
-    checker = _load_checker()
-    # In _deploy_app_locked the `incoming` dict init precedes the first
-    # persist — use a pattern that matches earlier text as the "effect".
-    monkeypatch.setattr(checker, "ORDERED_RULES", [
-        ("ServeController", "_deploy_app_locked",
-         r"self\._persist\.put\(", r"incoming: Dict",
-         "synthetic ordering violation")])
-    problems = checker.check()
-    assert any("BEFORE persisting" in p for p in problems)
+    assert rule_clean("SERVE-WAL") == []
 
 
 def test_checker_detects_renamed_mutation_path(monkeypatch):
-    checker = _load_checker()
-    monkeypatch.setattr(checker, "ORDERED_RULES", checker.ORDERED_RULES + [
+    mod = _pass_mod("serve_persistence")
+    monkeypatch.setattr(mod, "ORDERED_RULES", mod.ORDERED_RULES + [
         ("ServeController", "_set_target_v2",
          r"self\._persist\.put\(", r"\.target_num\s*=(?!=)",
          "synthetic rename")])
-    problems = checker.check()
+    problems = mod.check()
     assert any("_set_target_v2 not found" in p for p in problems)
 
 
 def test_checker_flags_rogue_target_assignment(monkeypatch):
     """The containment rules catch a scale path that bypasses
-    _set_target (raw target_num assignment elsewhere)."""
+    _set_target (raw target_num assignment elsewhere) — FORBID_RULES
+    can actually fire, it isn't vacuous."""
     import re
 
-    checker = _load_checker()
-    monkeypatch.setattr(checker, "FORBID_RULES", [
+    mod = _pass_mod("serve_persistence")
+    monkeypatch.setattr(mod, "FORBID_RULES", [
         (re.compile(r"\.target_num\s*=(?!=)"),
          {("_DeploymentState", "__init__")},   # whitelist shrunk
          "synthetic containment")])
-    problems = checker.check()
+    problems = mod.check()
     # _set_target's legitimate assignment is now "rogue" -> flagged.
     assert any("_set_target" in p and "synthetic containment" in p
                for p in problems)

@@ -1,61 +1,20 @@
-"""Trace-propagation static check (tier-1 guard, like
-test_rpc_idempotency / test_metrics_catalog): every serve entry point
-mints/binds the request trace and every dispatch path forwards it."""
+"""Thin alias — the trace-propagation check now runs on the shared
+analysis engine (TRACE-PROP pass); the real tests live in
+test_static_analysis.py and are aliased here so the historical entry
+point never silently drops."""
 
-import importlib.util
-import os
-
-
-def _load_checker():
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "scripts",
-        "check_trace_propagation.py")
-    spec = importlib.util.spec_from_file_location(
-        "check_trace_propagation", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+from test_static_analysis import (  # noqa: F401
+    test_trace_checker_detects_missing_forwarding as
+    test_checker_detects_missing_forwarding,
+    test_trace_checker_detects_renamed_entry_point as
+    test_checker_detects_renamed_entry_point,
+    test_trace_checker_flags_raw_replica_dispatch as
+    test_checker_flags_raw_replica_dispatch,
+)
+from test_static_analysis import _CACHE, _pass_mod, rule_clean
 
 
 def test_serve_trace_propagation_fully_wired():
-    checker = _load_checker()
-    problems = checker.check()
+    problems = _pass_mod("trace_propagation").check(cache=_CACHE)
     assert problems == [], "\n".join(problems)
-
-
-def test_checker_detects_missing_forwarding(monkeypatch):
-    """A rule whose pattern is absent must be reported — the check can
-    actually fail, it isn't vacuous."""
-    checker = _load_checker()
-    monkeypatch.setattr(checker, "RULES", checker.RULES + [
-        ("ray_tpu/serve/proxy.py", "ProxyActor", "_handle_conn",
-         [r"THIS_TOKEN_DOES_NOT_EXIST"], "synthetic gap")])
-    problems = checker.check()
-    assert any("THIS_TOKEN_DOES_NOT_EXIST" in p for p in problems)
-
-
-def test_checker_detects_renamed_entry_point(monkeypatch):
-    """An entry point the rules expect but the source no longer defines
-    fails loudly instead of silently passing."""
-    checker = _load_checker()
-    monkeypatch.setattr(checker, "RULES", checker.RULES + [
-        ("ray_tpu/serve/proxy.py", "ProxyActor", "_handle_conn_v2",
-         [r"request_trace\.mint\("], "synthetic rename")])
-    problems = checker.check()
-    assert any("_handle_conn_v2 not found" in p for p in problems)
-
-
-def test_checker_flags_raw_replica_dispatch(tmp_path):
-    """Dispatching handle_request.remote() outside the forwarding
-    submitters is flagged (the trace would be silently dropped).  The
-    rogue fixture is planted in tmp_path — never the real package dir,
-    where an interrupted run would leak it into the checkout."""
-    checker = _load_checker()
-    rogue = tmp_path / "_rogue_dispatch_test.py"
-    rogue.write_text("class Rogue:\n"
-                     "    def go(self, replica):\n"
-                     "        return replica.handle_request.remote('m')\n",
-                     encoding="utf-8")
-    problems = checker.check(extra_dispatch_dirs=[str(tmp_path)])
-    assert any("_rogue_dispatch_test.py" in p
-               and "directly" in p for p in problems)
+    assert rule_clean("TRACE-PROP") == []
