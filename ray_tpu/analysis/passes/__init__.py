@@ -13,6 +13,7 @@ from . import (  # noqa: F401
     dag_teardown,
     metrics_catalog,
     rpc_idempotency,
+    seqlock_discipline,
     serve_persistence,
     trace_propagation,
 )

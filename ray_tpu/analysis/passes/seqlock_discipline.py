@@ -1,0 +1,198 @@
+"""SEQLOCK-DISCIPLINE: channel readers survive torn seqlock reads.
+
+The PR 7 torn-read class, statically enforced: the 16-byte slot header
+of the shm channels (`experimental/channel.py` single-slot,
+`experimental/channels.py` multi-slot ring) is two non-atomic loads, so
+a reader racing the writer can pair the NEW version with the STALE
+length — or copy a payload the writer is mid-store on. The run-time
+discipline (today guarded only by hostile-writer tests) is:
+
+  1. **re-check** — after copying the payload, the reader re-reads the
+     slot header (a second `unpack_from` of the same struct);
+  2. **both fields** — the post-copy check compares BOTH header fields
+     against the pre-copy read (`v2 == version and l2 == length`;
+     checking the version alone still admits the torn-length pairing);
+  3. **guarded advance** — the reader's cursor (`self._set_cursor`,
+     `self._local_cursor = ...`, `self._last_read_version = ...`) only
+     advances inside the verified branch — advancing on any other path
+     consumes a message whose bytes were never validated.
+
+Scope: every function under `ray_tpu/experimental/` that unpacks a
+header from the shared buffer AND advances a read cursor (writers and
+control-plane accessors don't advance cursors and are skipped; the
+KV-backed StoreReader has no shared-memory header at all). Cursor
+identity: a `self._set_cursor(...)` call, or an assignment to a
+`self.<attr>` whose name contains `cursor` or `read_version`.
+
+Suppress an intentional deviation with
+`# ray-tpu: noqa(SEQLOCK-DISCIPLINE): <why the path is torn-safe>`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from ..engine import Finding, ModuleCache, register
+
+RULE = "SEQLOCK-DISCIPLINE"
+
+TARGETS = ("ray_tpu/experimental",)
+
+_CURSOR_MARKERS = ("cursor", "read_version")
+
+
+def _is_header_unpack(node) -> bool:
+    """`<X>.unpack_from(self._buf, ...)` / `(self._buf)` — a header read
+    off the shared segment (plain `struct.unpack_from` over non-self
+    buffers is not a seqlock header)."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "unpack_from" and node.args):
+        return False
+    buf = node.args[0]
+    return (isinstance(buf, ast.Attribute)
+            and isinstance(buf.value, ast.Name)
+            and buf.value.id == "self")
+
+
+def _tuple_unpacks(fn_node) -> List[Tuple[ast.Assign, List[str]]]:
+    """Source-ordered `a, b = X.unpack_from(self._buf, ...)` assigns."""
+    out = []
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Tuple) \
+                and _is_header_unpack(node.value):
+            names = [t.id if isinstance(t, ast.Name) else ""
+                     for t in node.targets[0].elts]
+            out.append((node, names))
+    out.sort(key=lambda p: p[0].lineno)
+    return out
+
+
+def _cursor_advances(fn_node) -> List[ast.AST]:
+    """Statements that advance a read cursor (see module docstring)."""
+    out = []
+    for node in ast.walk(fn_node):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" \
+                        and any(m in t.attr for m in _CURSOR_MARKERS):
+                    out.append(node)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self" \
+                and "set_cursor" in node.func.attr:
+            out.append(node)
+    return out
+
+
+def _eq_pairs(test) -> List[Tuple[str, str]]:
+    """Name pairs compared for equality anywhere in an if-test."""
+    pairs = []
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], ast.Eq) \
+                and isinstance(node.left, ast.Name) \
+                and len(node.comparators) == 1 \
+                and isinstance(node.comparators[0], ast.Name):
+            pairs.append((node.left.id, node.comparators[0].id))
+    return pairs
+
+
+def _verifying_ifs(fn_node, unpacks) -> List[ast.If]:
+    """If nodes whose test equates BOTH fields of a later header read
+    with a corresponding earlier one (`v2 == version and l2 == length`,
+    either operand order)."""
+    out = []
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.If):
+            continue
+        pairs = {frozenset(p) for p in _eq_pairs(node.test)}
+        for i, (_a1, first) in enumerate(unpacks):
+            for (_a2, second) in unpacks[i + 1:]:
+                if len(first) < 2 or len(second) < 2:
+                    continue
+                want0 = frozenset((first[0], second[0]))
+                want1 = frozenset((first[1], second[1]))
+                if len(want0) == 2 and len(want1) == 2 \
+                        and want0 in pairs and want1 in pairs:
+                    out.append(node)
+    return out
+
+
+def _inside_body(node, if_nodes: List[ast.If]) -> bool:
+    """Is `node` a descendant of the BODY (not orelse) of any verified
+    if? (The orelse is by definition the torn path.)"""
+    for cond in if_nodes:
+        for stmt in cond.body:
+            if node is stmt or any(node is d for d in ast.walk(stmt)):
+                return True
+    return False
+
+
+def scan_module(mod) -> List[Finding]:
+    findings: List[Finding] = []
+    for (cls, fn), (fn_node, _src, lineno) in mod.functions().items():
+        advances = _cursor_advances(fn_node)
+        unpacks = _tuple_unpacks(fn_node)
+        if not advances or not unpacks:
+            continue  # writer / control accessor / KV reader
+        where = f"{cls}.{fn}" if cls else fn
+        if len(unpacks) < 2:
+            findings.append(Finding(
+                RULE, mod.rel, lineno,
+                f"{where} copies a payload off a seqlock slot but never "
+                f"re-reads the header post-copy — a write racing the "
+                f"copy delivers torn bytes undetected; re-read and "
+                f"compare BOTH header fields before consuming",
+                key=f"{where}::no-recheck"))
+            continue
+        verified = _verifying_ifs(fn_node, unpacks)
+        if not verified:
+            findings.append(Finding(
+                RULE, mod.rel, unpacks[-1][0].lineno,
+                f"{where} re-reads the slot header but the post-copy "
+                f"check does not compare BOTH fields (version AND "
+                f"length) — the header is two non-atomic loads, so a "
+                f"new version can pair with a stale length",
+                key=f"{where}::partial-recheck"))
+            continue
+        # Ordinal (not line/col) keys: keys must be line-stable for
+        # baseline identity, but two same-column advances must NOT
+        # collapse onto one key — a single waiver would silently cover
+        # every unguarded advance in the function.
+        for ordinal, adv in enumerate(
+                a for a in advances if not _inside_body(a, verified)):
+            findings.append(Finding(
+                RULE, mod.rel, adv.lineno,
+                f"{where} advances its read cursor at line "
+                f"{adv.lineno} outside the verified post-copy "
+                f"branch — a torn read would be consumed and the "
+                f"message lost; only advance after both header "
+                f"fields re-check clean",
+                key=f"{where}::unguarded-advance:{ordinal}"))
+    return findings
+
+
+def scan_paths(paths, cache: Optional[ModuleCache] = None
+               ) -> List[Finding]:
+    cache = cache or ModuleCache()
+    findings: List[Finding] = []
+    for p in paths:
+        mod = cache.get(p)
+        if mod is not None:
+            findings.extend(scan_module(mod))
+    return findings
+
+
+@register(RULE, "shm channel readers re-check both seqlock header "
+                "fields post-copy and never advance a cursor on a "
+                "torn read")
+def run(ctx) -> List[Finding]:
+    return scan_paths(ctx.cache.walk_py(*TARGETS), ctx.cache)

@@ -321,23 +321,31 @@ class CompiledDAG:
                 compile_timeout_s: float = 60.0,
                 tick_replay: bool = False,
                 recovery_timeout_s: float = 60.0,
-                max_recoveries: int = 64) -> "CompiledDAG":
+                max_recoveries: int = 64,
+                patient_readers: bool = False) -> "CompiledDAG":
         return cls(dag, max_message_size, channel_depth=channel_depth,
                    compile_timeout_s=compile_timeout_s,
                    tick_replay=tick_replay,
                    recovery_timeout_s=recovery_timeout_s,
-                   max_recoveries=max_recoveries)
+                   max_recoveries=max_recoveries,
+                   patient_readers=patient_readers)
 
     def __init__(self, root: DAGNode, max_message_size: int = 1 << 20,
                  channel_depth: int = 2, compile_timeout_s: float = 60.0,
                  tick_replay: bool = False,
                  recovery_timeout_s: float = 60.0,
-                 max_recoveries: int = 64):
+                 max_recoveries: int = 64,
+                 patient_readers: bool = False):
         self._root = root
         self._max_size = max_message_size
         self._depth = max(1, int(channel_depth))
         self._dag_id = os.urandom(6).hex()
         self._tick_replay = bool(tick_replay)
+        # Patient channel readers nap instead of hot-polling: set this
+        # when node compute is ms-scale per tick (RL rollouts, learn
+        # steps) so blocked readers don't starve computing peers on
+        # small boxes; leave False for µs-tick pipelines (hot wakes).
+        self._patient = bool(patient_readers)
         self._recovery_timeout_s = float(recovery_timeout_s)
         self._max_recoveries = int(max_recoveries)
         # Resource registries — initialized FIRST so teardown() is safe
@@ -579,7 +587,8 @@ class CompiledDAG:
                 return ("const", -1, value)
             if ekey not in reader_idx:
                 reader_idx[ekey] = len(in_readers)
-                in_readers.append(self._edge_channels[ekey].reader(ridx))
+                in_readers.append(self._edge_channels[ekey].reader(
+                    ridx, patient=self._patient))
             return ("chan", reader_idx[ekey], None)
 
         arg_t = [wire(a) for a in node._bound_args]
@@ -589,7 +598,7 @@ class CompiledDAG:
             kw_t.append((key, kind, j, const))
         if not in_readers:
             in_readers.append(self._edge_channels["input"].reader(
-                input_edge["readers"].index(k)))
+                input_edge["readers"].index(k), patient=self._patient))
         writer = self._edge_channels[k]
         if isinstance(writer, RingChannel):
             writer = writer.writer()
@@ -627,7 +636,8 @@ class CompiledDAG:
             self._output_map.append(out_unique.index(k))
         self._output_readers = [
             self._edge_channels[k].reader(
-                self._edge_defs[k + 1]["readers"].index(_DRIVER))
+                self._edge_defs[k + 1]["readers"].index(_DRIVER),
+                patient=self._patient)
             for k in out_unique]
 
     # ------------------------------------------------------------------

@@ -1,0 +1,188 @@
+"""Streaming ingest: bounded-depth host-side queues with backpressure.
+
+The channels discipline (experimental/channels.py), host-side: a
+producer thread drives block execution through a `BoundedQueue` whose
+`put` BLOCKS while the queue is at depth — a slow consumer (a learner
+paying per-step device time) throttles the producers instead of letting
+fetched blocks pile up on the host until it OOMs. `Dataset.iter_stream`
+/ `DataIterator.iter_stream` wrap this around any plan so a training
+loop (`train.session` workers, the podracer learner's admission path)
+consumes a bounded-prefetch batch stream.
+
+Cancellation is clean in both directions: the consumer closing the
+stream (explicitly, via `with`, or by dropping the iterator) wakes a
+blocked producer with `QueueClosedError` so its thread exits and
+releases block refs; a producer error is re-raised at the consumer's
+next `get` instead of vanishing in a daemon thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+__all__ = ["BoundedQueue", "QueueClosedError", "StreamingIngest"]
+
+
+class QueueClosedError(Exception):
+    """The queue was closed/cancelled from the other side."""
+
+
+class _Done:
+    """Producer-finished sentinel (distinct from any user item)."""
+
+
+_DONE = _Done()
+
+
+class BoundedQueue:
+    """Bounded single-stage queue, writer-blocks discipline.
+
+    * `put` blocks while `depth` items are queued (backpressure), raises
+      QueueClosedError once cancelled;
+    * `get` blocks for the next item, raises QueueClosedError when the
+      producer finished (`finish()`) and the queue drained, or
+      immediately when cancelled;
+    * `finish()` = graceful producer EOF (consumers drain the backlog);
+      `cancel()` = drop everything and wake both sides;
+    * `peak_depth` records the high-water mark — the proof the bound
+      held (asserted by the bench's ingest phase).
+    """
+
+    def __init__(self, depth: int):
+        if depth < 1:
+            raise ValueError("BoundedQueue needs depth >= 1")
+        self.depth = int(depth)
+        self._items: list = []
+        self._cv = threading.Condition()
+        self._finished = False
+        self._cancelled = False
+        self.peak_depth = 0
+        self.puts = 0
+        self.gets = 0
+        self.blocked_puts = 0
+
+    def put(self, item: Any, timeout: Optional[float] = None) -> None:
+        with self._cv:
+            if len(self._items) >= self.depth:
+                self.blocked_puts += 1
+            while len(self._items) >= self.depth:
+                if self._cancelled:
+                    raise QueueClosedError("queue cancelled")
+                if not self._cv.wait(timeout=timeout):
+                    raise TimeoutError(
+                        f"put blocked on a full queue for {timeout}s")
+            if self._cancelled or self._finished:
+                raise QueueClosedError("queue closed")
+            self._items.append(item)
+            self.puts += 1
+            self.peak_depth = max(self.peak_depth, len(self._items))
+            self._cv.notify_all()
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        with self._cv:
+            while not self._items:
+                if self._cancelled:
+                    raise QueueClosedError("queue cancelled")
+                if self._finished:
+                    raise QueueClosedError("queue drained")
+                if not self._cv.wait(timeout=timeout):
+                    raise TimeoutError(
+                        f"get blocked on an empty queue for {timeout}s")
+            item = self._items.pop(0)
+            self.gets += 1
+            self._cv.notify_all()
+            return item
+
+    def qsize(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    def finish(self) -> None:
+        """Producer EOF: no more puts; gets drain the backlog then raise
+        QueueClosedError."""
+        with self._cv:
+            self._finished = True
+            self._cv.notify_all()
+
+    def cancel(self) -> None:
+        """Consumer cancel: drop the backlog, wake a blocked producer
+        (its put raises) AND any blocked consumer."""
+        with self._cv:
+            self._cancelled = True
+            self._items.clear()
+            self._cv.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._cv:
+            return self._cancelled or self._finished
+
+
+class StreamingIngest:
+    """One producer thread driving `source_fn()`'s iterator through a
+    BoundedQueue; iterate (or `get()`) to consume. Use as a context
+    manager or call `close()` — dropping it mid-stream also cancels via
+    __del__, so an abandoned consumer can't strand a blocked producer.
+    """
+
+    def __init__(self, source_fn: Callable[[], Iterator[Any]],
+                 depth: int = 4, name: str = "ingest"):
+        self._queue = BoundedQueue(depth)
+        self._error: Optional[BaseException] = None
+        self._name = name
+        self._thread = threading.Thread(
+            target=self._produce, args=(source_fn,),
+            name=f"ray-tpu-{name}", daemon=True)
+        self._thread.start()
+
+    def _produce(self, source_fn):
+        try:
+            for item in source_fn():
+                self._queue.put(item)
+        except QueueClosedError:
+            return  # consumer cancelled: exit quietly, drop refs
+        except BaseException as e:  # noqa: BLE001 — re-raised at get()
+            self._error = e
+        finally:
+            self._queue.finish()
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        try:
+            return self._queue.get(timeout=timeout)
+        except QueueClosedError:
+            if self._error is not None:
+                raise self._error
+            raise
+
+    def __iter__(self):
+        while True:
+            try:
+                yield self.get()
+            except QueueClosedError:
+                return
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Cancel the stream and join the producer (clean drain: the
+        producer's blocked put wakes and the thread exits)."""
+        self._queue.cancel()
+        self._thread.join(timeout=timeout)
+
+    def __del__(self):
+        try:
+            self._queue.cancel()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    def stats(self) -> dict:
+        q = self._queue
+        return {"depth": q.depth, "peak_depth": q.peak_depth,
+                "produced": q.puts, "consumed": q.gets,
+                "blocked_puts": q.blocked_puts,
+                "producer_alive": self._thread.is_alive()}

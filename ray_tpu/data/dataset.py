@@ -243,6 +243,31 @@ class Dataset:
                                 drop_last, local_shuffle_buffer_size,
                                 local_shuffle_seed)
 
+    def iter_stream(self, *, batch_size: Optional[int] = 256,
+                    batch_format: str = "numpy",
+                    max_queue_depth: int = 4,
+                    drop_last: bool = False):
+        """Streaming batch iterator with bounded host-side prefetch.
+
+        A producer thread executes the plan and re-batches blocks into a
+        `BoundedQueue` of depth `max_queue_depth`; `put` blocks when the
+        queue is full, so a slow consumer (a learner paying device time
+        per step) throttles block fetching instead of letting batches
+        pile up on the host (writer-blocks backpressure — the channels
+        discipline, host-side). Returns a `StreamingIngest`: iterate it,
+        use it as a context manager, or `close()` to cancel mid-stream
+        (the producer drains cleanly and drops its block refs).
+        """
+        from ray_tpu.data._internal.streaming import StreamingIngest
+        from ray_tpu.data.iterator import batch_blocks
+
+        def source():
+            return batch_blocks(self.iter_blocks(), batch_size,
+                                batch_format, drop_last)
+
+        return StreamingIngest(source, depth=max_queue_depth,
+                               name="dataset-stream")
+
     def iter_jax_batches(self, *, batch_size: int,
                          sharding=None, drop_last: bool = True,
                          dtype=None, **kw) -> Iterator[Any]:

@@ -121,6 +121,24 @@ class DataIterator:
             self.iter_batches(batch_size=batch_size, drop_last=drop_last,
                               **kw), sharding, dtype)
 
+    def iter_stream(self, *, batch_size: Optional[int] = 256,
+                    batch_format: str = "numpy",
+                    max_queue_depth: int = 4, drop_last: bool = False):
+        """Bounded-prefetch streaming batches over this shard (same
+        backpressure semantics as Dataset.iter_stream): a producer
+        thread fills a depth-bounded queue and BLOCKS when the consumer
+        falls behind — the per-worker ingest path for train.session
+        loops that must not buffer an epoch on the host."""
+        from ray_tpu.data._internal.streaming import StreamingIngest
+
+        def source():
+            return self.iter_batches(batch_size=batch_size,
+                                     batch_format=batch_format,
+                                     drop_last=drop_last)
+
+        return StreamingIngest(source, depth=max_queue_depth,
+                               name="shard-stream")
+
 
 class _SplitCoordinator:
     """Actor: runs the dataset once per epoch, deals blocks to n shards.

@@ -234,7 +234,8 @@ class RingChannel(_RingBase):
         return RingWriter(self.name, self.depth, self.slot_size,
                           self.n_readers)
 
-    def reader(self, idx: Optional[int] = None) -> "RingReader":
+    def reader(self, idx: Optional[int] = None,
+               patient: bool = False) -> "RingReader":
         if idx is None:
             idx = self._next_reader
             self._next_reader += 1
@@ -242,7 +243,7 @@ class RingChannel(_RingBase):
             raise ValueError(f"reader index {idx} out of range "
                              f"(n_readers={self.n_readers})")
         return RingReader(self.name, self.depth, self.slot_size,
-                          self.n_readers, idx)
+                          self.n_readers, idx, patient)
 
     def destroy(self) -> None:
         self.close()
@@ -351,16 +352,34 @@ class RingWriter(_RingBase):
 
 
 class RingReader(_RingBase):
-    """One consumer's end: owns reader slot `idx`'s cursor."""
+    """One consumer's end: owns reader slot `idx`'s cursor.
+
+    `patient=True` skips the tight-poll rung and waits on the nap
+    ladder from the first iteration: the right mode when the producer
+    COMPUTES for milliseconds per message (an RL rollout, a learn
+    step) — hot-polling through such a wait starves the very process
+    the reader is waiting on wherever pipeline participants outnumber
+    cores, and no reader-side heuristic can tell the two regimes apart
+    (on coarse-timer kernels the nap quantum itself inflates a hot
+    tick into the compute-wait range, so adaptive detection latches).
+    The CALLER knows its cadence; compiled DAGs plumb it through
+    `CompiledDAG.compile(patient_readers=...)`. Default False keeps
+    the hot path byte-identical: ~2k tight spins (~100 µs) so an
+    actively streaming reader wakes within nanoseconds of the write.
+    """
 
     def __init__(self, name: str, depth: int, slot_size: int,
-                 n_readers: int, idx: int):
+                 n_readers: int, idx: int, patient: bool = False):
         super().__init__(depth, slot_size, n_readers)
         self.name = name
         self.idx = idx
+        self.patient = bool(patient)
         self._seg = _attach_untracked(name)
         self._buf = self._seg.buf
         self._local_cursor = self._cursor(idx)
+
+    _TIGHT_SPINS = 2000      # ~100 µs of polling: covers a hot hop
+    _IDLE_SPINS = 20000      # then 2 ms naps: clearly idle
 
     def read(self, timeout: Optional[float] = None,
              copy: bool = False) -> Any:
@@ -374,9 +393,10 @@ class RingReader(_RingBase):
         may be held indefinitely — the right mode for consumers that
         outlive the tick (the compiled DAG's driver-side output reads)."""
         cursor = self._local_cursor
-        deadline = None if timeout is None else time.monotonic() + timeout
-        spin = 0
-        next_liveness = time.monotonic() + 2.0
+        t_entry = time.monotonic()
+        deadline = None if timeout is None else t_entry + timeout
+        spin = self._TIGHT_SPINS if self.patient else 0
+        next_liveness = t_entry + 2.0
         bad_count = 0
         while True:
             if self._writer_seq() > cursor:
@@ -412,20 +432,21 @@ class RingReader(_RingBase):
                 raise ChannelClosedError(self.name)
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"channel read timed out ({timeout}s)")
-            # Backoff ladder: ~2k tight spins (~100 µs — long enough to
-            # cover a whole pipeline tick, so an ACTIVELY streaming
-            # reader wakes within nanoseconds of the write instead of a
-            # 50 µs+ sleep quantum per hop), then 50 µs naps, then 2 ms
+            # Backoff ladder: a tight-poll rung (~100 µs — covers a
+            # hot pipeline hop, so an ACTIVELY streaming reader wakes
+            # within nanoseconds of the write; skipped entirely by
+            # PATIENT readers — a known ms-scale producer must get the
+            # core, not a polling peer), then 50 µs naps, then 2 ms
             # naps once clearly idle (don't burn a core forever).
             spin += 1
-            if spin > 20000:
+            if spin > self._IDLE_SPINS:
                 time.sleep(2e-3)
                 if time.monotonic() > next_liveness:
                     next_liveness = time.monotonic() + 2.0
                     if not self._creator_alive():
                         raise ChannelClosedError(
                             f"{self.name}: channel creator is gone")
-            elif spin > 2000:
+            elif spin > self._TIGHT_SPINS:
                 time.sleep(5e-5)
 
     def destroy(self) -> None:
@@ -436,7 +457,7 @@ class RingReader(_RingBase):
 
     def __reduce__(self):
         return (RingReader, (self.name, self.depth, self.slot_size,
-                             self.n_readers, self.idx))
+                             self.n_readers, self.idx, self.patient))
 
 
 # ---------------------------------------------------------------------------
@@ -577,7 +598,10 @@ class StoreChannel:
             _kv_del(self._mkey(s))
         self._gc_upto = max(self._gc_upto, floor)
 
-    def reader(self, idx: Optional[int] = None) -> "StoreReader":
+    def reader(self, idx: Optional[int] = None,
+               patient: bool = False) -> "StoreReader":
+        # `patient` accepted for interface parity with RingChannel
+        # (KV-backed reads already wait on a nap ladder).
         if idx is None:
             idx = self._next_reader
             self._next_reader += 1

@@ -1,0 +1,29 @@
+"""Podracer RL substrate: Anakin/Sebulba gangs on slice meshes.
+
+Reference: "Podracer architectures for scalable Reinforcement Learning"
+(arXiv 2104.06272). Two topologies over this framework's primitives:
+
+  * **Anakin** — everything co-located on ONE mesh/fault domain: the
+    actor gangs and the learner share a slice, weights never cross the
+    DCN, and the learner's parameter/batch placement rides a
+    `parallel/sharding.py` strategy.
+  * **Sebulba** — actor gangs DECOUPLED from the learner gang: each
+    actor gang pinned to its own slice fault domain (PR 4 gangs,
+    reserved via `util.placement_group.slice_placement_group`), the
+    learner on a separate slice; trajectory batches cross via the
+    compiled-DAG channel plane, weights broadcast via the shm object
+    plane (one put, zero-copy gets).
+
+The act->learn data path is a `tick_replay=True` compiled DAG
+(`dag/compiled.py`): a slice preemption mid-rollout migrates the
+affected gang uncharged (`preempted_restarts`) with exactly-once batch
+delivery, and weight versions observed by actors stay monotonic across
+the migration.
+"""
+
+from ray_tpu.podracer.topology import (GangPlacement, PodracerConfig,
+                                       TopologyPlan, TopologyPlanner)
+from ray_tpu.podracer.runtime import PodracerRun
+
+__all__ = ["PodracerConfig", "TopologyPlanner", "TopologyPlan",
+           "GangPlacement", "PodracerRun"]

@@ -1,0 +1,435 @@
+"""Podracer runtime: the act->learn data path as a compiled DAG.
+
+One tick of the substrate:
+
+  driver --(tick, weight_version, weights_ref)--> every rollout actor
+      --(fixed-shape trajectory batch over a ring/store channel)-->
+  learner --(version, new weights_ref, metrics)--> driver
+
+The whole path is a `tick_replay=True` compiled DAG (PR 12/13): zero
+per-tick task RPCs, bounded pipelining (channel depth = how stale actor
+weights may run), and self-healing — a slice preemption mid-rollout
+migrates the affected gang uncharged (`preempted_restarts`) while the
+driver's replay buffer + per-message tick sequence give exactly-once
+batch delivery (the learner applies every tick exactly once, asserted
+via its `applied` counter riding each output).
+
+Weight broadcast rides the shm plane: the learner emits new params
+(numpy leaves) once per `broadcast_interval` updates; the driver folds
+them into the control tuple, so ONE input-ring write serves every
+actor gang — params land as pickle-5 out-of-band buffers and each
+actor reads a ZERO-COPY view of the same slot (copied once into its
+runner, since the ring recycles slots `depth` ticks later). Params
+that exceed the slot automatically spill to the object store with only
+the ref ringing (the channels' oversize path), so big models degrade
+to one store put + per-actor gets instead of failing. Versions
+observed by any actor are monotonic — a restarted actor re-adopts the
+current weights from its first control tuple, and a restarted learner
+resumes the version sequence from the control echo (its weights re-
+initialize unless a checkpoint layer restores them — see ROADMAP).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ray_tpu.podracer.topology import (PodracerConfig, TopologyPlan,
+                                       TopologyPlanner)
+
+_metrics = None
+
+
+def _metric_handles() -> dict:
+    global _metrics
+    if _metrics is None:
+        from ray_tpu.util import metrics
+        _metrics = {
+            "steps": metrics.Counter(
+                "ray_tpu_podracer_steps_total",
+                "environment steps collected by podracer actor gangs"),
+            "batches": metrics.Counter(
+                "ray_tpu_podracer_batches_total",
+                "trajectory batches delivered act->learn (exactly once "
+                "per actor per tick)"),
+            "staleness": metrics.Gauge(
+                "ray_tpu_podracer_weight_staleness",
+                "learner weight version minus the oldest version any "
+                "actor sampled with, at the last collected tick"),
+        }
+    return _metrics
+
+
+def _to_numpy_tree(params):
+    import jax
+    return jax.tree_util.tree_map(np.asarray, params)
+
+
+class _RolloutWorker:
+    """One actor-gang member: wraps an rllib EnvRunner; `collect` is the
+    compiled-DAG node method (fixed-shape fragments per tick)."""
+
+    # The columns a PPO learner consumes — everything else the sampler
+    # produces stays host-local so the channel message shape is fixed
+    # and minimal.
+    _COLS = ("obs", "actions", "action_logp", "advantages",
+             "value_targets")
+
+    def __init__(self, env_spec, env_config: dict, num_envs: int,
+                 fragment_len: int, seed: int, hidden=(32, 32),
+                 gamma: float = 0.99, lam: float = 0.95):
+        from ray_tpu.rllib.env_runner import EnvRunner
+        self._runner = EnvRunner(env_spec, env_config, num_envs, seed,
+                                 hidden=tuple(hidden))
+        self._fragment_len = int(fragment_len)
+        self._gamma = float(gamma)
+        self._lam = float(lam)
+        self._version = 0
+        # Bounded: one entry per collect on a loop that ticks forever.
+        self._versions_seen: deque = deque(maxlen=4096)
+
+    def collect(self, ctl) -> dict:
+        """One rollout fragment under the weights `ctl` announces.
+        ctl = (tick, weight_version, weights) — `weights` deserialized
+        as zero-copy views onto the input ring slot every actor gang
+        shares (one write, N readers)."""
+        import jax
+        tick, version, weights = ctl
+        if weights is not None and version > self._version:
+            # Copy out of the ring slot ONCE per broadcast: the stored
+            # params outlive this tick, and the writer recycles the
+            # slot `depth` messages later.
+            self._runner.set_weights(
+                jax.tree_util.tree_map(np.array, weights))
+            self._version = version
+        self._versions_seen.append(self._version)
+        batch = self._runner.sample(self._fragment_len, self._gamma,
+                                    self._lam)
+        return {
+            "tick": tick,
+            "version": self._version,
+            "ctl_version": version,
+            "steps": self._fragment_len * len(self._runner._envs),
+            "rewards": self._runner.episode_rewards(),
+            "columns": {k: np.asarray(batch[k]) for k in self._COLS},
+        }
+
+    def versions_seen(self) -> List[int]:
+        """Recent weight versions at each collect, in order (test
+        probe: must be monotonic — non-decreasing — across
+        migrations)."""
+        return list(self._versions_seen)
+
+    def ping(self):
+        return True
+
+
+class _Learner:
+    """The learner gang's single rep: consumes every gang's batch each
+    tick, runs the jitted PPO update, broadcasts weights on a versioned
+    cadence via the object plane."""
+
+    def __init__(self, obs_dim: int, num_actions: int, *, lr: float,
+                 hidden=(32, 32), minibatch_size: int = 64,
+                 num_epochs: int = 1, broadcast_interval: int = 1,
+                 seed: int = 0):
+        from ray_tpu.rllib.learner import PPOLearner
+        self._learner = PPOLearner(obs_dim, num_actions, lr=lr,
+                                   hidden=tuple(hidden), seed=seed)
+        self._minibatch_size = int(minibatch_size)
+        self._num_epochs = int(num_epochs)
+        self._broadcast_interval = max(1, int(broadcast_interval))
+        self._seed = seed
+        self._version = 0
+        self._weights = None
+        self._applied = 0
+        self._broadcast()
+
+    def _broadcast(self):
+        """Stamp a new version; the numpy param tree rides the output
+        channel to the driver, which folds it into the NEXT control
+        tuple — one input-ring write then serves every actor."""
+        self._version += 1
+        self._weights = _to_numpy_tree(self._learner.get_weights())
+
+    def control(self) -> tuple:
+        """(version, weights) for the driver's first control tuple."""
+        return (self._version, self._weights)
+
+    def learn(self, *batches) -> dict:
+        from ray_tpu.rllib import sample_batch as sb
+        # Restart resumption: a migrated/restarted learner holds fresh
+        # params, but the control echo names the live version sequence —
+        # resume it so versions observed downstream stay monotonic (the
+        # params themselves re-initialize; restoring them is the
+        # checkpoint layer's job, see ROADMAP).
+        ctl_version = max(b["ctl_version"] for b in batches)
+        if ctl_version > self._version:
+            self._version = ctl_version
+            self._weights = _to_numpy_tree(self._learner.get_weights())
+        cols = {k: np.concatenate([b["columns"][k] for b in batches])
+                for k in batches[0]["columns"]}
+        train = sb.SampleBatch(cols)
+        metrics = self._learner.update(
+            train, minibatch_size=min(self._minibatch_size,
+                                      len(train)) or 1,
+            num_epochs=self._num_epochs,
+            seed=self._seed + self._applied)
+        self._applied += 1
+        broadcast = self._applied % self._broadcast_interval == 0
+        if broadcast:
+            self._broadcast()
+        tick = batches[0]["tick"]
+        return {
+            "tick": tick,
+            # Exactly-once probe: applied must equal tick+1 at every
+            # collected output — a replayed tick that re-ran the update
+            # (lost dedupe) or a dropped batch both break the equality.
+            "applied": self._applied,
+            "tick_skew": sum(1 for b in batches if b["tick"] != tick),
+            "version": self._version,
+            # Params ride the output only when the version bumped (the
+            # recovery-armed loop caches recent outputs as wire bytes —
+            # shipping the tree every tick would multiply that memory).
+            "weights": self._weights if broadcast else None,
+            # Per-actor weight versions at sample time, in actor order —
+            # the driver-side monotonicity probe (and staleness source).
+            "versions": [b["version"] for b in batches],
+            "staleness": self._version - min(b["version"] for b in batches),
+            "num_batches": len(batches),
+            "steps": int(sum(b["steps"] for b in batches)),
+            "rewards": [r for b in batches for r in b["rewards"]],
+            "metrics": {k: float(v) for k, v in metrics.items()},
+        }
+
+    def ping(self):
+        return True
+
+
+def _probe_env_dims(env_spec, env_config: dict) -> tuple:
+    from ray_tpu.rllib.env import make_env
+    env = make_env(env_spec, env_config)
+    return env.observation_dim, env.num_actions
+
+
+class PodracerRun:
+    """Driver handle: compile once, tick forever (teardown() releases
+    the DAG, the gang actors, and the plan's slice reservations)."""
+
+    def __init__(self, config: PodracerConfig,
+                 plan: Optional[TopologyPlan] = None):
+        import ray_tpu
+        from ray_tpu.dag import InputNode
+        from ray_tpu.dag.compiled import CompiledDAG
+        from ray_tpu.rllib.env import get_env_creator
+
+        self.config = config
+        # Teardown-relevant state FIRST: any failure mid-__init__ (a
+        # constructor timeout, a compile error) must release whatever
+        # was already acquired — actors, the learner, the plan's slice
+        # reservations — instead of leaking max_restarts=-1 actors.
+        self._torn_down = False
+        self.plan = None
+        self.actors: List[Any] = []
+        self.learner = None
+        self.dag = None
+        self._pending: deque = deque()
+        self.ticks = 0
+        self.steps = 0
+        # Bounded histories: the driver ticks forever; stats() and the
+        # test probes only ever need a recent window.
+        self.episode_rewards: deque = deque(maxlen=1000)
+        self.outputs: deque = deque(maxlen=4096)
+        self._submit_lock = threading.Lock()
+        try:
+            self._build(config, plan)
+        except BaseException:
+            self.teardown()
+            raise
+
+    def _build(self, config: PodracerConfig,
+               plan: Optional[TopologyPlan]):
+        import ray_tpu
+        from ray_tpu.dag import InputNode
+        from ray_tpu.dag.compiled import CompiledDAG
+        from ray_tpu.rllib.env import get_env_creator
+
+        t0 = time.time()
+        self.plan = plan or TopologyPlanner(config).plan()
+        creator = get_env_creator(config.env)
+        obs_dim, num_actions = _probe_env_dims(creator, config.env_config)
+
+        actor_cls = ray_tpu.remote(num_cpus=config.actor_num_cpus)(
+            _RolloutWorker)
+        for g, gang in enumerate(self.plan.actor_gangs):
+            for m in range(config.actors_per_gang):
+                opts = dict(gang.member_options[m]
+                            if m < len(gang.member_options) else {})
+                opts["max_restarts"] = -1
+                self.actors.append(actor_cls.options(**opts).remote(
+                    creator, config.env_config, config.num_envs,
+                    config.fragment_len,
+                    seed=config.seed + 1000 * (len(self.actors) + 1),
+                    hidden=config.hidden, gamma=config.gamma,
+                    lam=config.lam))
+        learner_cls = ray_tpu.remote(num_cpus=config.learner_num_cpus)(
+            _Learner)
+        lopts = dict(self.plan.learner.member_options[0]
+                     if self.plan.learner.member_options else {})
+        lopts["max_restarts"] = -1
+        self.learner = learner_cls.options(**lopts).remote(
+            obs_dim, num_actions, lr=config.lr, hidden=config.hidden,
+            minibatch_size=config.minibatch_size,
+            num_epochs=config.num_epochs,
+            broadcast_interval=config.broadcast_interval,
+            seed=config.seed)
+
+        # Bootstrap: actors start from the learner's version-1 weights
+        # (constructor broadcast), so every gang samples the same policy
+        # from tick 0.
+        self._version, self._weights = ray_tpu.get(
+            self.learner.control.remote(), timeout=120)
+        ray_tpu.get([a.ping.remote() for a in self.actors], timeout=120)
+
+        with InputNode() as inp:
+            root = self.learner.learn.bind(
+                *[a.collect.bind(inp) for a in self.actors])
+        # patient_readers: every node here computes for milliseconds per
+        # tick (rollout / learn), so blocked channel readers must nap,
+        # not hot-poll — polling peers starve the computing process
+        # wherever pipeline participants outnumber cores.
+        self.dag = CompiledDAG.compile(
+            root, channel_depth=config.channel_depth,
+            max_message_size=config.max_message_size, tick_replay=True,
+            patient_readers=True)
+        self._export_span("podracer:compile", t0, time.time())
+
+    # -- ticking -------------------------------------------------------
+    def submit(self):
+        """Submit one tick (pipelined up to channel_depth by the DAG's
+        input-write backpressure); pair with collect(). The control
+        tuple carries the CURRENT weights every tick — one multi-reader
+        ring write serves every actor gang zero-copy, and a freshly
+        restarted actor re-adopts the live version from its first
+        message instead of sampling with init params."""
+        # One lock serializes driver-side submitters so the tick
+        # embedded in the control tuple cannot desync from the sequence
+        # the DAG assigns the write (two racing readers of _next_seq
+        # would both stamp N while the DAG hands out N and N+1 — the
+        # learner's applied==tick+1 probe would report phantom losses).
+        with self._submit_lock:
+            ref = self.dag.execute_async(
+                (self.dag._next_seq, self._version, self._weights))
+            self._pending.append((ref, time.time()))
+        return ref
+
+    def collect(self, timeout: Optional[float] = None) -> dict:
+        """Collect the oldest in-flight tick's learner output; folds the
+        new weight version into the next control tuple and the podracer
+        metrics."""
+        ref, t0 = self._pending.popleft()
+        out = ref.result(timeout)
+        if out["version"] > self._version and out["weights"] is not None:
+            self._version, self._weights = out["version"], out["weights"]
+            self._export_span("podracer:broadcast", t0, time.time(),
+                              only_if_traced=True)
+        self.ticks += 1
+        self.steps += out["steps"]
+        self.episode_rewards.extend(out["rewards"])
+        # Keep the tick record without the param tree (a long run must
+        # not accumulate one weights copy per broadcast).
+        self.outputs.append({k: v for k, v in out.items()
+                             if k != "weights"})
+        try:
+            m = _metric_handles()
+            m["steps"].inc(out["steps"])
+            m["batches"].inc(out["num_batches"])
+            m["staleness"].set(float(out["staleness"]))
+        except Exception:  # noqa: BLE001 — metrics never block ticks
+            pass
+        self._export_span("podracer:tick", t0, time.time(),
+                          only_if_traced=True)
+        return out
+
+    def step(self, timeout: Optional[float] = None) -> dict:
+        """One synchronous tick: submit + collect."""
+        self.submit()
+        return self.collect(timeout)
+
+    def run(self, num_ticks: int, window: Optional[int] = None,
+            timeout: Optional[float] = None) -> List[dict]:
+        """Windowed pipelined ticking (the StagePipeline pattern): keep
+        up to `window` ticks in flight, collect in submission order."""
+        window = max(1, window or self.config.channel_depth)
+        out: List[dict] = []
+        for _ in range(num_ticks):
+            if len(self._pending) >= window:
+                out.append(self.collect(timeout))
+            self.submit()
+        while self._pending:
+            out.append(self.collect(timeout))
+        return out
+
+    # -- introspection -------------------------------------------------
+    def stats(self) -> dict:
+        d = self.dag.stats()
+        return {
+            "mode": self.plan.mode, "ticks": self.ticks,
+            "steps": self.steps, "weight_version": self._version,
+            "inflight": len(self._pending),
+            "max_inflight": d["max_inflight"],
+            "recoveries": d["recoveries"],
+            "replayed_ticks": d["replayed_ticks"],
+            "dag_state": d["state"],
+            "staleness": (self.outputs[-1]["staleness"]
+                          if self.outputs else 0),
+            "episode_reward_mean": (
+                float(np.mean(list(self.episode_rewards)[-100:]))
+                if self.episode_rewards else float("nan")),
+        }
+
+    # -- teardown ------------------------------------------------------
+    def teardown(self):
+        """Release everything — safe from ANY partial-__init__ state
+        (the failure path calls this before the caller ever holds a
+        handle)."""
+        if getattr(self, "_torn_down", True):
+            return
+        self._torn_down = True
+        import ray_tpu
+        try:
+            if self.dag is not None:
+                self.dag.teardown()
+        finally:
+            for a in self.actors + [self.learner]:
+                if a is None:
+                    continue
+                try:
+                    ray_tpu.kill(a)
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+            if self.plan is not None:
+                self.plan.teardown()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
+    @staticmethod
+    def _export_span(name: str, start: float, end: float,
+                     only_if_traced: bool = False):
+        try:
+            from ray_tpu.util import tracing
+            if only_if_traced and not tracing.is_enabled():
+                return
+            from ray_tpu._private import flightrec
+            tracing.export_span(flightrec.span_event(
+                name, "podracer", start, end))
+        except Exception:  # noqa: BLE001 — observability never blocks
+            pass

@@ -1,0 +1,288 @@
+"""Topology planner: place a learner gang + N actor gangs on fault domains.
+
+The planner turns the cluster's slice inventory (NodeInfo.slice_id — the
+PR 4 fault-domain key) into a `TopologyPlan`:
+
+  * **Sebulba** (decoupled): the learner gang takes one slice, each
+    actor gang is pinned to a DIFFERENT slice (round-robin over the
+    rest) — one preemption can never take both an actor gang and the
+    learner. Each gang's slice is gang-reserved with
+    `slice_placement_group` (STRICT_SPREAD, one bundle per host) so the
+    GCS's atomic gang-drain machinery re-places the whole footprint on
+    a replacement domain; the gang's host-side actor processes ride
+    soft NodeAffinity onto the same hosts (soft: a drain migrates them
+    off instead of wedging them on a dead node).
+  * **Anakin** (co-located): every role shares ONE domain (the largest
+    slice, or the driver's node off-slice); the learner's param/batch
+    placement is a `parallel/sharding.py` strategy over the local mesh.
+
+Sliceless clusters (CI boxes, laptops) degrade gracefully: no
+placement groups, actor gangs spread round-robin across alive nodes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.parallel.mesh import SliceInfo
+
+
+@dataclass
+class PodracerConfig:
+    """One knob set for planner + runtime (kept flat on purpose: the
+    whole config crosses to actor constructors as plain values)."""
+
+    mode: str = "sebulba"              # "sebulba" | "anakin"
+    env: Any = "CartPole-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_actor_gangs: int = 2
+    actors_per_gang: int = 1
+    num_envs: int = 1                  # env copies per actor
+    fragment_len: int = 16             # steps per env per tick
+    hidden: tuple = (32, 32)
+    lr: float = 5e-4
+    gamma: float = 0.99
+    lam: float = 0.95
+    minibatch_size: int = 64
+    num_epochs: int = 1
+    seed: int = 0
+    # Weight broadcast cadence: a new version is put to the object plane
+    # every `broadcast_interval` learner updates (1 = every tick).
+    broadcast_interval: int = 1
+    # Compiled-DAG channel tuning: depth bounds pipelined ticks in
+    # flight (= how stale actor weights may run under execute_async).
+    channel_depth: int = 2
+    max_message_size: int = 1 << 20
+    # Anakin learner placement strategy (parallel/sharding preset name).
+    learner_sharding: str = "dp"
+    # Gang-reserve each gang's slice with a slice_placement_group.
+    # None = auto (reserve when the slice exposes TPU resources).
+    reserve_slices: Optional[bool] = None
+    actor_num_cpus: float = 1.0
+    learner_num_cpus: float = 1.0
+
+    def steps_per_tick(self) -> int:
+        return (self.num_actor_gangs * self.actors_per_gang
+                * self.num_envs * self.fragment_len)
+
+
+@dataclass
+class GangPlacement:
+    """Where one gang (learner or actor gang) lives."""
+
+    role: str                          # "learner" | "actors[i]"
+    slice_id: str = ""                 # "" = off-slice
+    node_ids: List[str] = field(default_factory=list)
+    # Per-member .options() kwargs (scheduling_strategy etc.), one per
+    # gang member, round-robin over the domain's hosts.
+    member_options: List[Dict[str, Any]] = field(default_factory=list)
+    placement_group: Any = None        # slice reservation (or None)
+
+
+@dataclass
+class TopologyPlan:
+    mode: str
+    learner: GangPlacement = None
+    actor_gangs: List[GangPlacement] = field(default_factory=list)
+    sharding: Any = None               # ShardingStrategy (Anakin learner)
+    slices: Dict[str, List[str]] = field(default_factory=dict)
+
+    def describe(self) -> dict:
+        return {
+            "mode": self.mode,
+            "learner_slice": self.learner.slice_id if self.learner else "",
+            "actor_slices": [g.slice_id for g in self.actor_gangs],
+            "reserved": sum(1 for g in ([self.learner] + self.actor_gangs)
+                            if g and g.placement_group is not None),
+            "sharding": getattr(self.sharding, "name", None),
+        }
+
+    def teardown(self):
+        """Release every slice reservation the plan holds."""
+        from ray_tpu.util.placement_group import remove_placement_group
+        for g in [self.learner] + list(self.actor_gangs):
+            if g is not None and g.placement_group is not None:
+                try:
+                    remove_placement_group(g.placement_group)
+                except Exception:  # noqa: BLE001 — cluster already down
+                    pass
+                g.placement_group = None
+
+
+def _slice_info_from_nodes(slice_id: str, nodes: List[dict]) -> SliceInfo:
+    """Reconstruct a SliceInfo from the GCS's view of one fault domain
+    (fake clusters and real TPU VMs both register per-host TPU totals +
+    a head resource on host 0)."""
+    per_host = max((float(n["Resources"].get("TPU", 0.0)) for n in nodes),
+                   default=0.0)
+    name = ""
+    for n in nodes:
+        for res in n["Resources"]:
+            if res.startswith("TPU-") and res.endswith("-head"):
+                name = res[len("TPU-"):-len("-head")]
+                break
+        if name:
+            break
+    return SliceInfo(name=name, num_chips=int(per_host * len(nodes)),
+                     num_hosts=len(nodes),
+                     chips_per_host=int(per_host) or 4)
+
+
+class TopologyPlanner:
+    """Maps PodracerConfig roles onto the live cluster's fault domains."""
+
+    def __init__(self, config: PodracerConfig):
+        if config.mode not in ("sebulba", "anakin"):
+            raise ValueError(f"unknown podracer mode {config.mode!r} "
+                             f"(one of 'sebulba', 'anakin')")
+        self.config = config
+
+    # -- cluster inventory --------------------------------------------
+    def _inventory(self):
+        from ray_tpu._private import worker_api
+        alive = [n for n in worker_api.nodes()
+                 if n["Alive"] and not n.get("Draining")]
+        slices: Dict[str, List[dict]] = {}
+        for n in alive:
+            sid = n.get("SliceId") or ""
+            if sid:
+                slices.setdefault(sid, []).append(n)
+        return alive, dict(sorted(slices.items()))
+
+    def _reserve(self, role: str, slice_id: str,
+                 members: List[dict]):
+        """Gang-reserve one slice for `role` (STRICT_SPREAD, one bundle
+        per host) so the PR 4 machinery migrates the footprint as a
+        unit. Skipped when the slice exposes no TPU resources (nothing
+        to reserve) unless explicitly forced."""
+        reserve = self.config.reserve_slices
+        has_tpu = any(float(n["Resources"].get("TPU", 0.0)) > 0
+                      for n in members)
+        if reserve is None:
+            reserve = has_tpu
+        if not reserve or not has_tpu:
+            return None
+        from ray_tpu.util.placement_group import slice_placement_group
+        info = _slice_info_from_nodes(slice_id, members)
+        pg = slice_placement_group(info, name=f"podracer-{role}")
+        pg.wait(timeout_seconds=30.0)
+        return pg
+
+    @staticmethod
+    def _member_options(nodes: List[dict], count: int) -> List[dict]:
+        """Soft NodeAffinity round-robin over the domain's hosts: the
+        scheduler lands members on the gang's slice, and a drain can
+        still migrate them off (hard affinity would pin a migrating
+        actor to its dead node forever)."""
+        from ray_tpu.util.scheduling_strategies import \
+            NodeAffinitySchedulingStrategy
+        if not nodes:
+            return [{} for _ in range(count)]
+        out = []
+        for i in range(count):
+            node = nodes[i % len(nodes)]
+            out.append({"scheduling_strategy": NodeAffinitySchedulingStrategy(
+                node["NodeID"], soft=True)})
+        return out
+
+    def _gang(self, role: str, slice_id: str, members: List[dict],
+              count: int, reserve: bool = True) -> GangPlacement:
+        pg = self._reserve(role, slice_id, members) \
+            if (slice_id and reserve) else None
+        return GangPlacement(
+            role=role, slice_id=slice_id,
+            node_ids=[n["NodeID"] for n in members],
+            member_options=self._member_options(members, count),
+            placement_group=pg)
+
+    # -- planning ------------------------------------------------------
+    def plan(self) -> TopologyPlan:
+        cfg = self.config
+        alive, slices = self._inventory()
+        plan = TopologyPlan(mode=cfg.mode,
+                            slices={s: [n["NodeID"] for n in ns]
+                                    for s, ns in slices.items()})
+        slice_ids = list(slices)
+        if cfg.mode == "anakin":
+            self._plan_anakin(plan, alive, slices, slice_ids)
+        else:
+            self._plan_sebulba(plan, alive, slices, slice_ids)
+        self._export_span(plan)
+        return plan
+
+    def _plan_anakin(self, plan: TopologyPlan, alive, slices, slice_ids):
+        """Co-located: one domain hosts learner AND every actor gang;
+        the learner's device placement is a sharding strategy over that
+        mesh (act/learn share the chips, the Anakin premise)."""
+        cfg = self.config
+        from ray_tpu.parallel.sharding import strategy_from_name
+        plan.sharding = strategy_from_name(cfg.learner_sharding)
+        if slice_ids:
+            # Largest slice wins (most chips to co-locate onto).
+            home = max(slice_ids, key=lambda s: len(slices[s]))
+            members = slices[home]
+        else:
+            home, members = "", self._driver_home(alive)
+        plan.learner = self._gang("learner", home, members, 1)
+        for g in range(cfg.num_actor_gangs):
+            # The learner's reservation covers the shared domain —
+            # actor gangs must not double-reserve the same slice.
+            plan.actor_gangs.append(self._gang(
+                f"actors{g}", home, members, cfg.actors_per_gang,
+                reserve=False))
+
+    def _plan_sebulba(self, plan: TopologyPlan, alive, slices, slice_ids):
+        """Decoupled: learner slice first, actor gangs round-robin over
+        the REMAINING slices; with a single slice the actors take it
+        and the learner runs off-slice; with none, round-robin nodes."""
+        cfg = self.config
+        if len(slice_ids) >= 2:
+            learner_members = slices[slice_ids[0]]
+            plan.learner = self._gang("learner", slice_ids[0],
+                                      learner_members, 1)
+            actor_sids = slice_ids[1:]
+            reserved = set()
+            for g in range(cfg.num_actor_gangs):
+                sid = actor_sids[g % len(actor_sids)]
+                plan.actor_gangs.append(self._gang(
+                    f"actors{g}", sid, slices[sid], cfg.actors_per_gang,
+                    reserve=sid not in reserved))
+                reserved.add(sid)
+        elif len(slice_ids) == 1:
+            sid = slice_ids[0]
+            off_slice = [n for n in alive if not n.get("SliceId")]
+            plan.learner = self._gang(
+                "learner", "", off_slice or self._driver_home(alive), 1)
+            reserved = False
+            for g in range(cfg.num_actor_gangs):
+                plan.actor_gangs.append(self._gang(
+                    f"actors{g}", sid, slices[sid], cfg.actors_per_gang,
+                    reserve=not reserved))
+                reserved = True
+        else:
+            home = self._driver_home(alive)
+            others = [n for n in alive if n not in home] or home
+            plan.learner = self._gang("learner", "", home, 1)
+            for g in range(cfg.num_actor_gangs):
+                members = [others[g % len(others)]]
+                plan.actor_gangs.append(self._gang(
+                    f"actors{g}", "", members, cfg.actors_per_gang))
+
+    @staticmethod
+    def _driver_home(alive: List[dict]) -> List[dict]:
+        head = [n for n in alive if n.get("IsHead")]
+        return head or alive[:1]
+
+    @staticmethod
+    def _export_span(plan: TopologyPlan):
+        try:
+            import time
+
+            from ray_tpu._private import flightrec
+            from ray_tpu.util import tracing
+            now = time.time()
+            tracing.export_span(flightrec.span_event(
+                "podracer:plan", f"podracer:{plan.mode}", now, now))
+        except Exception:  # noqa: BLE001 — observability never blocks
+            pass

@@ -1,0 +1,30 @@
+"""jax version gates for tests.
+
+`grad`-of-`shard_map` raises `_SpecError` on jax 0.4.x (the transpose
+loses its out-spec), and `jax.lax.pvary` (ring attention's collective)
+only exists from 0.5 — both upstream limitations, not regressions: the
+affected tests pass on jax >= 0.5 unchanged. The version probe reads
+package metadata instead of importing jax (conftest must set platform
+env vars before jax initializes anywhere in the test process).
+"""
+
+from importlib import metadata as _metadata
+
+import pytest
+
+
+def _jax_version() -> tuple:
+    try:
+        parts = _metadata.version("jax").split(".")[:2]
+        return tuple(int(p) for p in parts)
+    except Exception:  # noqa: BLE001 — unknown build: don't skip
+        return (99, 0)
+
+
+JAX_04X = _jax_version() < (0, 5)
+
+jax04x_shard_map_grad_skip = pytest.mark.skipif(
+    JAX_04X,
+    reason="upstream jax 0.4.x limitation (grad-of-shard_map _SpecError "
+           "/ missing lax.pvary); passes on jax >= 0.5 — not a "
+           "regression")

@@ -469,3 +469,157 @@ def test_dataset_unique(ray_mod):
         "a", "b"]
     with pytest.raises(Exception):
         ds.unique("missing")
+
+
+# ---------------------------------------------------------------------------
+# Streaming ingest: bounded host-side queues with writer-blocks
+# backpressure (data/_internal/streaming.py + Dataset.iter_stream)
+# ---------------------------------------------------------------------------
+
+def test_bounded_queue_never_exceeds_depth():
+    """Concurrent producer vs slow consumer: the queue's high-water mark
+    never passes the configured depth (writer blocks instead), ordering
+    is preserved, and the blocked-put counter proves backpressure
+    actually engaged."""
+    import threading
+    import time
+
+    from ray_tpu.data._internal.streaming import BoundedQueue
+
+    q = BoundedQueue(depth=3)
+
+    def produce():
+        for i in range(50):
+            q.put(i)
+        q.finish()
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    got = []
+    from ray_tpu.data._internal.streaming import QueueClosedError
+    while True:
+        time.sleep(0.002)  # slow consumer: the producer must block
+        try:
+            got.append(q.get(timeout=10))
+        except QueueClosedError:
+            break
+    t.join(timeout=10)
+    assert got == list(range(50))
+    assert q.peak_depth <= 3
+    assert q.blocked_puts > 0
+
+
+def test_bounded_queue_producer_blocks_until_space():
+    from ray_tpu.data._internal.streaming import BoundedQueue
+
+    q = BoundedQueue(depth=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(TimeoutError):
+        q.put(3, timeout=0.1)
+    assert q.get() == 1
+    q.put(3, timeout=1.0)  # space freed: the put lands
+    assert q.get() == 2 and q.get() == 3
+
+
+def test_bounded_queue_cancel_wakes_blocked_producer():
+    """Consumer cancel drains cleanly: a producer blocked on a full
+    queue wakes with QueueClosedError and its thread exits."""
+    import threading
+
+    from ray_tpu.data._internal.streaming import (BoundedQueue,
+                                                  QueueClosedError)
+
+    q = BoundedQueue(depth=1)
+    q.put("fill")
+    outcome = []
+
+    def produce():
+        try:
+            q.put("blocked")
+        except QueueClosedError:
+            outcome.append("woken")
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    t.join(timeout=0.2)
+    assert t.is_alive()          # genuinely blocked on the full queue
+    q.cancel()
+    t.join(timeout=10)
+    assert not t.is_alive() and outcome == ["woken"]
+    with pytest.raises(QueueClosedError):
+        q.get(timeout=1)
+
+
+def test_iter_stream_bounded_and_complete(ray_mod):
+    """Dataset.iter_stream delivers every batch in order while the
+    host-side queue's peak depth respects the configured bound under a
+    slow consumer."""
+    import time
+
+    ds = rd.range(64, parallelism=4)
+    with ds.iter_stream(batch_size=8, max_queue_depth=2) as stream:
+        ids = []
+        for batch in stream:
+            time.sleep(0.01)     # slow learner: producers must throttle
+            ids.extend(int(v) for v in batch["id"])
+        st = stream.stats()
+    assert sorted(ids) == list(range(64))
+    assert st["consumed"] == 8
+    assert st["peak_depth"] <= 2
+    assert not st["producer_alive"]
+
+
+def test_iter_stream_consumer_cancel_drains_cleanly(ray_mod):
+    """Breaking out mid-stream cancels the producer thread (it would
+    otherwise sit blocked on the full queue holding block refs)."""
+    ds = rd.range(1000, parallelism=4)
+    stream = ds.iter_stream(batch_size=10, max_queue_depth=2)
+    first = stream.get(timeout=30)
+    assert len(first["id"]) == 10
+    stream.close()
+    assert not stream.stats()["producer_alive"]
+
+
+def test_iter_stream_producer_error_surfaces(ray_mod):
+    """An execution error inside the producer thread re-raises at the
+    consumer instead of vanishing (or hanging the iterator)."""
+    def boom(row):
+        raise RuntimeError("ingest boom")
+
+    ds = rd.range(16, parallelism=2).map(boom)
+    with ds.iter_stream(batch_size=4, max_queue_depth=2) as stream:
+        with pytest.raises(Exception, match="ingest boom"):
+            for _ in stream:
+                pass
+
+
+def test_iter_stream_feeds_train_session(ray_mod):
+    """The admission path: a train.session worker consumes its shard
+    via iter_stream — a slow train loop throttles the ingest (peak
+    depth bounded) and still sees every row exactly once."""
+    import time
+
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+    from ray_tpu.train import get_dataset_shard, report
+
+    def train_fn(config):
+        shard = get_dataset_shard("train")
+        seen = []
+        with shard.iter_stream(batch_size=8, max_queue_depth=2) as st:
+            for batch in st:
+                time.sleep(0.01)          # the "slow learner"
+                seen.extend(int(v) for v in batch["id"])
+            stats = st.stats()
+        report({"rows": len(seen), "distinct": len(set(seen)),
+                "peak_depth": stats["peak_depth"]})
+
+    trainer = JaxTrainer(
+        train_fn, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        datasets={"train": rd.range(64, parallelism=4)})
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["rows"] == 64
+    assert result.metrics["distinct"] == 64
+    assert result.metrics["peak_depth"] <= 2

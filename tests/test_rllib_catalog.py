@@ -82,6 +82,9 @@ def test_lstm_seq_apply_matches_stepwise(jax_cpu):
 
 
 @pytest.mark.timeout(600)
+# Budget audit (PR 15, --durations): 16s — CNN-torso learning soak;
+# dqn_cnn_learns_gridgoal keeps the catalog CNN fast gate.
+@pytest.mark.slow
 def test_ppo_cnn_learns_gridgoal(ray_rl, jax_cpu):
     """PPO with the auto-CNN torso solves the 4x4 image gridworld."""
     from ray_tpu.rllib import PPOConfig

@@ -125,6 +125,9 @@ def test_c51_projection_matches_numpy(jax_cpu):
 
 
 @pytest.mark.timeout(360)
+# Budget audit (PR 15, --durations): 17s — distributed-DQN learning
+# soak; dqn_learns_cartpole keeps the family's fast gate.
+@pytest.mark.slow
 def test_apex_learns_cartpole(ray_rl, jax_cpu):
     from ray_tpu.rllib import ApexDQNConfig
 
@@ -308,6 +311,9 @@ def test_noisy_net_noise_structure(jax_cpu):
 
 
 @pytest.mark.timeout(360)
+# Budget audit (PR 15, --durations): 15s — exploration-variant
+# learning soak; dqn_learns_cartpole keeps the fast gate.
+@pytest.mark.slow
 def test_noisy_dqn_learns_cartpole(ray_rl, jax_cpu):
     """Noise-driven exploration (epsilon pinned to 0) still solves
     CartPole."""

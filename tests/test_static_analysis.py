@@ -96,10 +96,11 @@ def test_check_all_script_json_contract():
 
 
 def test_all_passes_registered():
+    importlib.import_module(f"{check_all._PKG_NAME}.passes")
     passes = _A.all_passes()
     for rule in ("RPC-IDEM", "TRACE-PROP", "SERVE-WAL", "DAG-TEARDOWN",
                  "METRICS-CAT", "ASYNC-BLOCK", "AWAIT-LOCK",
-                 "CANCEL-SAFE"):
+                 "CANCEL-SAFE", "SEQLOCK-DISCIPLINE"):
         assert rule in passes, f"pass {rule} not registered"
 
 
@@ -624,3 +625,102 @@ def test_live_baseline_entries_all_match():
     baselined = [f for f in report.suppressed
                  if f.reason.startswith("baseline: ")]
     assert len(baselined) == len(entries)
+
+
+# ---------------------------------------------------------------------------
+# SEQLOCK-DISCIPLINE (shm channel readers vs torn reads)
+# ---------------------------------------------------------------------------
+
+SEQLOCK_FIXTURE = """\
+import struct
+
+H = struct.Struct("<QQ")
+
+
+class NoRecheck:
+    def read(self):
+        version, length = H.unpack_from(self._buf, 0)
+        payload = bytes(self._buf[16:16 + length])
+        self._local_cursor = version
+        return payload
+
+
+class PartialRecheck:
+    def read(self):
+        version, length = H.unpack_from(self._buf, 0)
+        payload = bytes(self._buf[16:16 + length])
+        v2, l2 = H.unpack_from(self._buf, 0)
+        if v2 == version:
+            self._local_cursor = version
+        return payload
+
+
+class UnguardedAdvance:
+    def read(self):
+        version, length = H.unpack_from(self._buf, 0)
+        payload = bytes(self._buf[16:16 + length])
+        v2, l2 = H.unpack_from(self._buf, 0)
+        if v2 == version and l2 == length:
+            ok = payload
+        # ray-tpu: noqa(SEQLOCK-DISCIPLINE): fixture reason text
+        self._set_cursor(0, version)
+        return payload
+
+
+class CleanReader:
+    def read(self):
+        version, length = H.unpack_from(self._buf, 0)
+        payload = bytes(self._buf[16:16 + length])
+        v2, l2 = H.unpack_from(self._buf, 0)
+        if v2 == version and l2 == length:
+            self._set_cursor(0, version)
+            return payload
+
+
+class WriterOnly:
+    def write(self, data):
+        version, _ = H.unpack_from(self._buf, 0)
+        H.pack_into(self._buf, 0, version + 1, len(data))
+"""
+
+
+def test_seqlock_positives_and_negatives(tmp_path):
+    findings, _cache = _scan("seqlock_discipline", tmp_path,
+                             SEQLOCK_FIXTURE)
+    by_key = {f.key: f for f in findings}
+    assert "NoRecheck.read::no-recheck" in by_key
+    assert "PartialRecheck.read::partial-recheck" in by_key
+    assert any(k.startswith("UnguardedAdvance.read::unguarded-advance")
+               for k in by_key)
+    # Clean reader and the cursor-less writer never flag.
+    assert not any(k.startswith(("CleanReader", "WriterOnly"))
+                   for k in by_key), by_key
+
+
+def test_seqlock_noqa_suppresses_with_reason(tmp_path):
+    findings, cache = _scan("seqlock_discipline", tmp_path,
+                            SEQLOCK_FIXTURE)
+    _A.apply_noqa(findings, cache)
+    unguarded = [f for f in findings
+                 if f.key.startswith("UnguardedAdvance")]
+    assert unguarded and all(f.suppressed for f in unguarded)
+    assert unguarded[0].reason == "fixture reason text"
+    others = [f for f in findings if not f.key.startswith("Unguarded")]
+    assert others and not any(f.suppressed for f in others)
+
+
+def test_seqlock_recognizes_live_readers():
+    """The pass must actually classify the shipping channel readers as
+    seqlock readers (a predicate drift that skips them would make the
+    live-tree gate vacuous) — and find them clean."""
+    sq = _pass_mod("seqlock_discipline")
+    readers = set()
+    for rel in ("ray_tpu/experimental/channel.py",
+                "ray_tpu/experimental/channels.py"):
+        mod = _CACHE.get(rel)
+        for (cls, fn), (node, _s, _l) in mod.functions().items():
+            if sq._cursor_advances(node) and sq._tuple_unpacks(node):
+                readers.add((cls, fn))
+    assert ("Channel", "read") in readers
+    assert ("RingReader", "read") in readers
+    assert rule_clean("SEQLOCK-DISCIPLINE") == []

@@ -182,6 +182,10 @@ def test_train_step_sharded_mlp(jax_cpu):
     assert losses[-1] < losses[0]
 
 
+# Budget audit (PR 15, --durations): 62s — the multiprocess SPMD
+# equivalence soak; single-process sharded training + torch DDP
+# allreduce keep the fast-gate coverage.
+@pytest.mark.slow
 def test_multiprocess_gang_matches_single_process(ray_start, jax_cpu):
     """The REAL multi-host path (VERDICT r4 #2): two worker PROCESSES,
     each owning 4 virtual CPU devices, join one jax.distributed gang via
@@ -271,6 +275,9 @@ def test_torch_trainer_ddp_allreduce(ray_start):
     assert result.metrics["loss"] < 100.0
 
 
+# Budget audit (PR 15, --durations): 43s — third-party (HF) breadth
+# integration, not core-path logic.
+@pytest.mark.slow
 def test_transformers_trainer_tiny_bert(ray_start, tmp_path):
     """HF Trainer runs on the gang with the gloo process group formed;
     metrics flow back through prepare_trainer's report bridge
