@@ -257,6 +257,11 @@ class CoreWorker:
                  session_dir: str = ""):
         self.mode = mode
         self.config = config
+        # Inline/shm cutover for puts, task args, and returns: the object
+        # plane owns the policy (env-overridable), seeded from config.
+        from ray_tpu._private import object_plane as _plane
+        self.plane_threshold = _plane.threshold(
+            "task", config.max_direct_call_object_size)
         self.gcs_address = gcs_address
         self.raylet_address = raylet_address
         self.job_id = job_id or JobID.from_int(0)
@@ -1152,7 +1157,7 @@ class CoreWorker:
     async def put_async(self, value: Any, _pin_object: bool = True) -> ObjectRef:
         oid = self._reserve_put_oid()
         ser = self.serialization.serialize(value)
-        if ser.total_size <= self.config.max_direct_call_object_size:
+        if ser.total_size <= self.plane_threshold:
             return self._register_inline_put(oid, value, ser)
         return await self._put_large(oid, ser)
 
@@ -1170,7 +1175,7 @@ class CoreWorker:
         values serialize on the caller and only the store RPCs cross over."""
         oid = self._reserve_put_oid()
         ser = self.serialization.serialize(value)
-        if ser.total_size <= self.config.max_direct_call_object_size:
+        if ser.total_size <= self.plane_threshold:
             return self._register_inline_put(oid, value, ser)
         try:
             on_loop = asyncio.get_running_loop() is self.loop
@@ -1264,6 +1269,32 @@ class CoreWorker:
         if is_exception:
             raise value
         return value
+
+    async def get_local_async(self, ref: ObjectRef,
+                              timeout: Optional[float] = None):
+        """Resolve `ref` from the NODE-LOCAL object plane only: returns a
+        1-tuple `(value,)` when this node's store holds the object (pinned
+        zero-copy view, same discipline as a full get), or None when it
+        does not. Never crosses the network — no owner round trip, no
+        remote fetch. The StoreChannel fast path for same-node oversize
+        payloads: only the control word rings; the bytes stay in the
+        segment they were written to."""
+        oid = ref.id
+        if oid in self.inproc:
+            if oid in self._inproc_exc:
+                raise self.inproc[oid]
+            return (self.inproc[oid],)
+        if not await self.store.contains(oid.binary()):
+            return None
+        deadline = None if timeout is None else time.time() + timeout
+        result = await self._materialize_large(oid, (), self.address,
+                                               deadline)
+        if result is None:
+            return None
+        value, is_exception = result
+        if is_exception:
+            raise value
+        return (value,)
 
     async def _resolve_object(self, ref: ObjectRef,
                               deadline: Optional[float]) -> Tuple[Any, bool]:
@@ -1797,7 +1828,7 @@ class CoreWorker:
         pin_refs: List[ObjectRef] = []
         credits: List[ObjectID] = []
         serialize_inline = self.serialization.serialize_inline
-        limit = self.config.max_direct_call_object_size
+        limit = self.plane_threshold
         try:
             for v in (args if not kwargs else (*args, *kwargs.values())):
                 if isinstance(v, ObjectRef):
@@ -1905,7 +1936,7 @@ class CoreWorker:
         task_args: List[TaskArg] = []
         credits: List[ObjectID] = []
         serialize_inline = self.serialization.serialize_inline
-        limit = self.config.max_direct_call_object_size
+        limit = self.plane_threshold
         try:
             for v in (args if not kwargs else (*args, *kwargs.values())):
                 if isinstance(v, ObjectRef):
@@ -3651,7 +3682,7 @@ class CoreWorker:
         """Flat return record (inline_bytes|None, large_ser|None, is_exc);
         a SerializedObject in slot 1 means the value needs a plasma put
         (the caller replaces it with the storing raylet's address)."""
-        limit = self.config.max_direct_call_object_size
+        limit = self.plane_threshold
         data = self.serialization.serialize_inline(value, limit)
         if data is not None:
             return (data, None, is_exception)

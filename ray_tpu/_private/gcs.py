@@ -2045,6 +2045,16 @@ class GcsServer:
             key = (actor.namespace, actor.name)
             if self.named_actors.get(key) == actor.actor_id and no_restart:
                 del self.named_actors[key]
+        if no_restart:
+            # Publish in the same synchronous run as the state write:
+            # the kill RPC below can await seconds, and another handler
+            # interleaving there would publish ITS transition first —
+            # subscribers would see events out of order vs the state
+            # they describe.
+            self.pubsub.publish("actors", {"event": "dead",
+                                           "actor_id": actor.actor_id,
+                                           "reason": "killed",
+                                           "actor_info": actor})
         if actor.address:
             try:
                 await self.clients.request(
@@ -2053,11 +2063,6 @@ class GcsServer:
                     timeout=5.0)
             except Exception:
                 pass
-        if no_restart:
-            self.pubsub.publish("actors", {"event": "dead",
-                                           "actor_id": actor.actor_id,
-                                           "reason": "killed",
-                                           "actor_info": actor})
         return True
 
     @rpc.idempotent
@@ -2383,6 +2388,12 @@ class GcsServer:
         # PG removed mid-failure-streak leaks its counter entry forever.
         self._pg_handoff_failures.pop(pg.pg_id, None)
         self._mark_dirty()
+        # Publish with the state write, BEFORE the bundle-return RPCs:
+        # the loop below can await tens of seconds, and the removal is
+        # committed the moment the state flipped (the PG_REMOVED check
+        # in _do_schedule_pg already handles a racing scheduler).
+        self.pubsub.publish("placement_groups", {"event": "removed",
+                                                 "pg_id": pg.pg_id})
         for idx, node_id in pg.bundle_nodes.items():
             node = self.nodes.get(node_id)
             if node is None or not node.alive:
@@ -2393,8 +2404,6 @@ class GcsServer:
                                            timeout=10.0)
             except Exception:
                 pass
-        self.pubsub.publish("placement_groups", {"event": "removed",
-                                                 "pg_id": pg.pg_id})
         return True
 
     @rpc.idempotent

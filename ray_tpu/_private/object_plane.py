@@ -1,0 +1,213 @@
+"""Object-plane facade: one routing layer over the node-local shm store.
+
+The store itself (object_store.py) is mechanism — segments, entries, pins.
+This module is POLICY: every subsystem that moves large payloads (core
+put/get, serve request/response bodies, streaming-ingest blocks, podracer
+weight broadcasts, compiled-DAG store channels) decides "inline or plane?"
+here, against one set of size thresholds, and wraps its bytes so they ride
+pickle-5 out-of-band buffers — written straight into a shm segment on put
+and handed back as pinned zero-copy views on a same-node get.
+
+Static enforcement: scripts/check_store_routing.py walks the producer
+paths and fails if any of them serializes a large payload over a raw RPC
+frame instead of calling through this module.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Size thresholds (bytes). Everything at or above the threshold for its
+# path goes through the shm store; below it rides inline in the RPC frame
+# (a store round-trip costs two RPCs — for tiny payloads the frame wins).
+# ---------------------------------------------------------------------------
+
+_DEFAULTS = {
+    # Task args / returns / ray_tpu.put — matches
+    # config.max_direct_call_object_size (the reference's inline cutover).
+    "task": 100 * 1024,
+    # HTTP bodies: the proxy<->replica hop copies the body once per RPC
+    # frame; above 1MB the store's single shm write wins.
+    "serve_body": 1 << 20,
+    # Streaming-ingest blocks queued between producer and consumer.
+    "ingest_block": 1 << 20,
+    # Podracer weight broadcasts (per-version, fanned out to every gang
+    # member on the node).
+    "weights": 4 << 20,
+    # Compiled-DAG StoreChannel messages: above this the KV carries only
+    # the control word and the payload rides the store.
+    "dag_channel": 64 << 10,
+}
+
+
+def threshold(kind: str = "task", default: Optional[int] = None) -> int:
+    """Size threshold for a routing path, env-overridable per kind
+    (RAY_TPU_PLANE_THRESHOLD_SERVE_BODY=...) or globally
+    (RAY_TPU_OBJECT_PLANE_THRESHOLD). `default` lets a caller carry a
+    configured value (e.g. config.max_direct_call_object_size) that the
+    env overrides but the table default does not."""
+    env = os.environ.get(f"RAY_TPU_PLANE_THRESHOLD_{kind.upper()}")
+    if env is None:
+        env = os.environ.get("RAY_TPU_OBJECT_PLANE_THRESHOLD")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    if default is not None:
+        return default
+    return _DEFAULTS.get(kind, _DEFAULTS["task"])
+
+
+# ---------------------------------------------------------------------------
+# Zero-copy payload wrapper.
+# ---------------------------------------------------------------------------
+
+class SharedPayload:
+    """Bytes-like wrapper that serializes OUT-OF-BAND (pickle protocol 5).
+
+    A plain ``bytes`` value pickles in-band: it is copied into the pickle
+    stream on serialize and copied out again on loads — two full-body
+    copies per hop. Wrapping the body makes it a PickleBuffer, which the
+    serializer keeps as a raw buffer: the store client writes it directly
+    into the shm segment, and a same-node reader deserializes it as a
+    memoryview INTO the segment (no copy at all until someone asks for
+    ``bytes(payload)``).
+
+    The view stays valid for as long as the deserialized object's store
+    pin is held (core_worker keeps the pin while any materialized value
+    from that object is alive); callers that need the data past the
+    value's lifetime must copy via ``to_bytes()``.
+    """
+
+    __slots__ = ("_buf",)
+
+    def __init__(self, data):
+        if isinstance(data, SharedPayload):
+            data = data._buf
+        self._buf = data if isinstance(data, memoryview) else memoryview(data)
+
+    # -- pickle-5 out-of-band plumbing --
+    def __reduce_ex__(self, protocol):
+        if protocol >= 5:
+            return (SharedPayload, (pickle.PickleBuffer(self._buf),))
+        return (SharedPayload, (bytes(self._buf),))
+
+    # -- bytes-like surface --
+    @property
+    def view(self) -> memoryview:
+        return self._buf
+
+    def to_bytes(self) -> bytes:
+        return bytes(self._buf)
+
+    def __bytes__(self) -> bytes:
+        return bytes(self._buf)
+
+    def __len__(self) -> int:
+        return self._buf.nbytes
+
+    def __buffer__(self, flags):  # Python 3.12 buffer protocol
+        return self._buf
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, SharedPayload):
+            return self._buf == other._buf
+        if isinstance(other, (bytes, bytearray, memoryview)):
+            return self._buf == other
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(bytes(self._buf))
+
+    def __repr__(self) -> str:
+        return f"SharedPayload({self._buf.nbytes} bytes)"
+
+
+def wrap_body(data, kind: str = "serve_body"):
+    """Route a bytes payload: SharedPayload (out-of-band, plane) when at or
+    above the threshold for `kind`, unchanged otherwise."""
+    if isinstance(data, SharedPayload):
+        return data
+    if isinstance(data, (bytes, bytearray, memoryview)) and \
+            len(data) >= threshold(kind):
+        return SharedPayload(data)
+    return data
+
+
+def body_view(data) -> memoryview:
+    """Zero-copy view of a body regardless of wrapping."""
+    if isinstance(data, SharedPayload):
+        return data.view
+    return memoryview(data)
+
+
+def body_bytes(data) -> bytes:
+    """Materialize a body to plain bytes (copies if wrapped)."""
+    if isinstance(data, (bytes, type(None))):
+        return data or b""
+    if isinstance(data, SharedPayload):
+        return data.to_bytes()
+    return bytes(data)
+
+
+# ---------------------------------------------------------------------------
+# Ref-based offload for queue/broadcast paths (ingest blocks, weights).
+# ---------------------------------------------------------------------------
+
+class PlaneRef:
+    """Marker carrying an ObjectRef through a queue/control message so the
+    consumer knows to resolve it from the plane (vs a literal value)."""
+
+    __slots__ = ("ref",)
+
+    def __init__(self, ref):
+        self.ref = ref
+
+
+def _approx_size(value) -> int:
+    """Cheap size probe for offload decisions — exact for buffers, nbytes
+    for arrays, 0 (never offload) for anything unsized."""
+    if isinstance(value, SharedPayload):
+        return len(value)
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return len(value)
+    nbytes = getattr(value, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes
+    return 0
+
+
+def maybe_offload(value, kind: str) -> Any:
+    """Put `value` into the object plane when it is large, returning a
+    PlaneRef; small/unsized values pass through untouched."""
+    if isinstance(value, PlaneRef):
+        return value
+    if _approx_size(value) >= threshold(kind):
+        from ray_tpu._private import worker_api
+        return PlaneRef(worker_api.put(value))
+    return value
+
+
+def resolve(item, timeout: Optional[float] = None) -> Any:
+    """Inverse of maybe_offload: fetch a PlaneRef's value (zero-copy view
+    for arrays/wrapped bytes on the same node), pass literals through."""
+    if isinstance(item, PlaneRef):
+        from ray_tpu._private import worker_api
+        return worker_api.get(item.ref, timeout)
+    return item
+
+
+def put_object(value: Any):
+    """Plane put from any thread; returns an ObjectRef."""
+    from ray_tpu._private import worker_api
+    return worker_api.put(value)
+
+
+def get_object(ref, timeout: Optional[float] = None) -> Any:
+    """Plane get from any thread (zero-copy for same-node large buffers)."""
+    from ray_tpu._private import worker_api
+    return worker_api.get(ref, timeout)

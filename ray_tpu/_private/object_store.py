@@ -6,10 +6,17 @@ shared memory managed by the node daemon; same-node workers attach to the
 segment and read objects zero-copy; LRU eviction of unpinned objects with
 fallback spilling to disk; create/seal lifecycle; pinning while mapped.
 
-Differences from the reference (deliberate, TPU-first): a single mmap'd arena
-with a Python free-list allocator instead of dlmalloc (the C++ arena allocator
-is a planned drop-in via ctypes); client<->store protocol rides the framework
+Differences from the reference (deliberate, TPU-first): a pool of mmap'd
+segments with Python free-list allocators instead of dlmalloc (the pool grows
+geometrically up to the configured capacity; the C++ arena allocator is a
+planned drop-in via ctypes); client<->store protocol rides the framework
 RPC layer instead of a bespoke flatbuffers unix-socket protocol.
+
+This module is the node-local OBJECT PLANE: everything above the inline
+threshold — core put/get, serve bodies, streaming-ingest blocks, podracer
+weight broadcasts, compiled-DAG store channels — moves through these
+segments, with spill-to-external-storage and chunked cross-node transfer as
+the overflow paths (see ray_tpu/_private/object_plane.py for the facade).
 """
 
 from __future__ import annotations
@@ -163,17 +170,101 @@ class Arena:
             pass
 
 
+class SegmentPool:
+    """Multi-segment arena: the node-local object plane's memory.
+
+    One logical capacity backed by several mmap'd segments. The pool
+    starts with one segment and GROWS — geometric doubling, clamped to
+    the logical capacity — when an allocation does not fit the existing
+    segments. Growth instead of one giant up-front segment keeps small
+    clusters (fake multi-node tests run several stores per process)
+    from reserving gigabytes each, while a real node still reaches full
+    capacity under load. Segments are append-only: once created they
+    live until destroy() (clients cache attachments by segment name, so
+    recycling a name would alias stale mappings).
+    """
+
+    _INITIAL_SEGMENT = 256 << 20
+
+    def __init__(self, capacity: int, name_prefix: str = "rtpu",
+                 initial_segment: Optional[int] = None,
+                 on_segment_created=None):
+        self.capacity = capacity
+        self._name_prefix = name_prefix
+        self._on_segment_created = on_segment_created
+        self.segments: List[Arena] = []
+        self._by_name: Dict[str, Arena] = {}
+        first = min(capacity, initial_segment or self._INITIAL_SEGMENT)
+        self._add_segment(first)
+
+    @property
+    def allocated(self) -> int:
+        """Bytes of shm actually reserved (sum of segment sizes)."""
+        return sum(s.capacity for s in self.segments)
+
+    @property
+    def used(self) -> int:
+        return sum(s.used for s in self.segments)
+
+    def _add_segment(self, size: int) -> Arena:
+        seg = Arena(size, name_prefix=self._name_prefix)
+        self.segments.append(seg)
+        self._by_name[seg.name] = seg
+        if self._on_segment_created is not None:
+            self._on_segment_created(seg)
+        return seg
+
+    def alloc(self, size: int) -> Optional[Tuple[str, int]]:
+        """Returns (segment_name, offset) or None when full even after
+        growing to capacity."""
+        for seg in self.segments:
+            off = seg.alloc(size)
+            if off is not None:
+                return seg.name, off
+        # Grow: double the last segment size (at least `size`), clamped
+        # to what logical capacity remains.
+        headroom = self.capacity - self.allocated
+        if headroom <= 0:
+            return None
+        aligned = (size + _ALIGN - 1) // _ALIGN * _ALIGN
+        want = max(self.segments[-1].capacity * 2 if self.segments else 0,
+                   aligned, self._INITIAL_SEGMENT)
+        grow = min(headroom, want)
+        if grow < aligned:
+            return None
+        seg = self._add_segment(grow)
+        off = seg.alloc(size)
+        if off is None:
+            return None
+        return seg.name, off
+
+    def free(self, name: str, offset: int, size: int):
+        seg = self._by_name.get(name)
+        if seg is not None:
+            seg.free(offset, size)
+
+    def view(self, name: str, offset: int, size: int) -> memoryview:
+        return self._by_name[name].view(offset, size)
+
+    def destroy(self):
+        for seg in self.segments:
+            seg.destroy()
+        self.segments.clear()
+        self._by_name.clear()
+
+
 CREATING, SEALED, SPILLED = 0, 1, 2
 
 
 class ObjectEntry:
-    __slots__ = ("object_id", "offset", "size", "state", "pins", "metadata",
-                 "owner_address", "spill_path", "create_time",
+    __slots__ = ("object_id", "segment", "offset", "size", "state", "pins",
+                 "metadata", "owner_address", "spill_path", "create_time",
                  "delete_on_unpin")
 
-    def __init__(self, object_id: bytes, offset: int, size: int,
+    def __init__(self, object_id: bytes, segment: str, offset: int, size: int,
                  metadata: bytes = b"", owner_address: str = ""):
         self.object_id = object_id
+        self.segment = segment
         self.offset = offset
         self.size = size
         self.state = CREATING
@@ -188,8 +279,13 @@ class ObjectEntry:
 class ObjectStoreHost:
     """Runs inside the node daemon; owns the arena and the object index."""
 
-    def __init__(self, capacity: int, spill_dir: str, prefault: bool = True):
-        self.arena = Arena(capacity)
+    def __init__(self, capacity: int, spill_dir: str, prefault: bool = True,
+                 initial_segment: Optional[int] = None):
+        self._prefault = prefault
+        self._prefault_budget = self._PREFAULT_CAP
+        self._prefault_stops: List[threading.Event] = []
+        self.pool = SegmentPool(capacity, initial_segment=initial_segment,
+                                on_segment_created=self._segment_created)
         self.spill_dir = spill_dir
         os.makedirs(spill_dir, exist_ok=True)
         # Spill backend: local disk by default, or an external store
@@ -198,8 +294,6 @@ class ObjectStoreHost:
         from ray_tpu._private.external_storage import storage_from_uri
         self.spill_storage = storage_from_uri(
             os.environ.get("RAY_TPU_SPILL_STORAGE_URI", ""), spill_dir)
-        if prefault:
-            self._start_prefault()
         self.objects: Dict[bytes, ObjectEntry] = {}
         # LRU over sealed, unpinned objects (insertion-ordered).
         self._lru: OrderedDict[bytes, None] = OrderedDict()
@@ -207,11 +301,21 @@ class ObjectStoreHost:
         self.num_spilled = 0
         self.num_evicted = 0
         self.bytes_spilled = 0
+        # Object-plane observability (exported as gauges/counters by the
+        # raylet metrics loop; see README metrics catalog).
+        self.pinned_bytes = 0
+        self.num_hits = 0
+        self.num_misses = 0
+        self.num_zero_copy_gets = 0
 
     _PREFAULT_CAP = 1 << 30
     _PREFAULT_CHUNK = 32 << 20
 
-    def _start_prefault(self):
+    def _segment_created(self, seg: Arena):
+        if self._prefault:
+            self._start_prefault(seg)
+
+    def _start_prefault(self, seg: Arena):
         """Warm arena pages in a background thread so first writes into
         fresh regions run at warm-memcpy speed (~8 GB/s on this VM class)
         instead of hypervisor-fault speed (~0.1 GB/s) — the round-1
@@ -225,8 +329,13 @@ class ObjectStoreHost:
         and needs no allocator coordination. MADV_WILLNEED over the whole
         arena first is free and lifts unwarmed-region writes ~6x on its
         own. Short sleeps keep the warmer off the critical path on small
-        boxes; free-list reuse keeps regions warm afterwards."""
-        mm = getattr(self.arena.shm, "_mmap", None)
+        boxes; free-list reuse keeps regions warm afterwards.
+
+        Runs once per segment: a pool that grows under load warms each
+        new segment as it appears, drawing from one shared budget so a
+        multi-segment store never populates more than _PREFAULT_CAP
+        (or an eighth of MemAvailable) in total."""
+        mm = getattr(seg.shm, "_mmap", None)
         if mm is None:
             return
         # POPULATE makes pages physically resident, so cap by the box's
@@ -241,9 +350,13 @@ class ObjectStoreHost:
                         break
         except OSError:
             pass
-        n = min(self.arena.capacity, self._PREFAULT_CAP,
+        n = min(seg.capacity, self._prefault_budget,
                 *( [avail // 8] if avail else [] ))
-        stop = self._prefault_stop = threading.Event()
+        if n <= 0:
+            return
+        self._prefault_budget -= n
+        stop = threading.Event()
+        self._prefault_stops.append(stop)
         chunk = self._PREFAULT_CHUNK
         MADV_POPULATE_WRITE = 23  # Linux 5.14+
 
@@ -277,16 +390,18 @@ class ObjectStoreHost:
                 del self.objects[object_id]
             else:
                 raise ValueError(f"object {object_id.hex()} already exists")
-        offset = self.arena.alloc(size)
-        if offset is None:
+        loc = self.pool.alloc(size)
+        if loc is None:
             self._make_room(size)
-            offset = self.arena.alloc(size)
-        if offset is None:
+            loc = self.pool.alloc(size)
+        if loc is None:
             raise MemoryError(
-                f"object store full: need {size}, capacity {self.arena.capacity}")
-        ent = ObjectEntry(object_id, offset, size, metadata, owner_address)
+                f"object store full: need {size}, capacity {self.pool.capacity}")
+        name, offset = loc
+        ent = ObjectEntry(object_id, name, offset, size, metadata,
+                          owner_address)
         self.objects[object_id] = ent
-        return self.arena.name, offset
+        return name, offset
 
     def seal(self, object_id: bytes):
         ent = self.objects[object_id]
@@ -312,7 +427,7 @@ class ObjectStoreHost:
         if ent is not None and ent.state == SEALED:
             return
         name, offset = self.create(object_id, len(data), metadata, owner_address)
-        self.arena.view(offset, len(data))[:] = data
+        self.pool.view(name, offset, len(data))[:] = data
         self.seal(object_id)
 
     def contains(self, object_id: bytes) -> bool:
@@ -326,18 +441,25 @@ class ObjectStoreHost:
         """
         ent = self.objects.get(object_id)
         if ent is None or ent.state == CREATING:
+            self.num_misses += 1
             return None
         if ent.state == SPILLED:
             self._restore(ent)
+        if ent.pins == 0:
+            self.pinned_bytes += ent.size
         ent.pins += 1
+        self.num_hits += 1
         self._lru.pop(object_id, None)
-        return self.arena.name, ent.offset, ent.size, ent.metadata
+        return ent.segment, ent.offset, ent.size, ent.metadata
 
     def unpin(self, object_id: bytes):
         ent = self.objects.get(object_id)
         if ent is None:
             return
-        ent.pins = max(0, ent.pins - 1)
+        if ent.pins > 0:
+            ent.pins -= 1
+            if ent.pins == 0:
+                self.pinned_bytes -= ent.size
         if ent.pins == 0:
             if ent.delete_on_unpin:
                 self.delete(object_id)
@@ -358,15 +480,17 @@ class ObjectStoreHost:
         if ent.state == SPILLED:
             self._delete_spill(ent)
         else:
-            self.arena.free(ent.offset, ent.size)
+            self.pool.free(ent.segment, ent.offset, ent.size)
 
     def abort_create(self, object_id: bytes):
-        """Roll back a CREATING entry after a failed write/transfer."""
+        """Roll back a CREATING entry after a failed write/transfer (or a
+        writer that died between create and seal — the raylet calls this
+        for every CREATING object a disconnecting client left behind)."""
         ent = self.objects.get(object_id)
         if ent is None or ent.state != CREATING:
             return
         self.objects.pop(object_id, None)
-        self.arena.free(ent.offset, ent.size)
+        self.pool.free(ent.segment, ent.offset, ent.size)
 
     async def wait_sealed(self, object_id: bytes, timeout: Optional[float] = None) -> bool:
         ent = self.objects.get(object_id)
@@ -386,10 +510,14 @@ class ObjectStoreHost:
         if desc is None:
             return None
         try:
-            _, offset, size, _ = desc
-            return bytes(self.arena.view(offset, size))
+            name, offset, size, _ = desc
+            return bytes(self.pool.view(name, offset, size))
         finally:
             self.unpin(object_id)
+
+    def view(self, segment: str, offset: int, size: int) -> memoryview:
+        """Zero-copy view into a segment; caller must hold a pin."""
+        return self.pool.view(segment, offset, size)
 
     # ---- eviction & spilling ----
 
@@ -398,7 +526,7 @@ class ObjectStoreHost:
         target = size
         victims = list(self._lru.keys())
         for oid in victims:
-            if self.arena.capacity - self.arena.used >= target:
+            if self.pool.capacity - self.pool.used >= target:
                 break
             ent = self.objects.get(oid)
             if ent is None or ent.pins > 0 or ent.state != SEALED:
@@ -408,8 +536,9 @@ class ObjectStoreHost:
 
     def _spill(self, ent: ObjectEntry):
         ent.spill_path = self.spill_storage.put(
-            ent.object_id.hex(), self.arena.view(ent.offset, ent.size))
-        self.arena.free(ent.offset, ent.size)
+            ent.object_id.hex(),
+            self.pool.view(ent.segment, ent.offset, ent.size))
+        self.pool.free(ent.segment, ent.offset, ent.size)
         ent.state = SPILLED
         self._lru.pop(ent.object_id, None)
         self.num_spilled += 1
@@ -418,15 +547,17 @@ class ObjectStoreHost:
 
     def _restore(self, ent: ObjectEntry):
         data = self.spill_storage.get(ent.spill_path)
-        offset = self.arena.alloc(len(data))
-        if offset is None:
+        loc = self.pool.alloc(len(data))
+        if loc is None:
             self._make_room(len(data))
-            offset = self.arena.alloc(len(data))
-        if offset is None:
+            loc = self.pool.alloc(len(data))
+        if loc is None:
             raise MemoryError("cannot restore spilled object: store full")
-        self.arena.view(offset, len(data))[:] = data
+        name, offset = loc
+        self.pool.view(name, offset, len(data))[:] = data
         self._delete_spill(ent)
-        ent.offset, ent.size, ent.state = offset, len(data), SEALED
+        ent.segment, ent.offset, ent.size, ent.state = \
+            name, offset, len(data), SEALED
 
     def _delete_spill(self, ent: ObjectEntry):
         self.spill_storage.delete(ent.spill_path)
@@ -434,18 +565,23 @@ class ObjectStoreHost:
 
     def stats(self) -> dict:
         return {
-            "capacity": self.arena.capacity,
-            "used": self.arena.used,
+            "capacity": self.pool.capacity,
+            "allocated": self.pool.allocated,
+            "used": self.pool.used,
+            "num_segments": len(self.pool.segments),
             "num_objects": len(self.objects),
             "num_spilled": self.num_spilled,
             "bytes_spilled": self.bytes_spilled,
+            "pinned_bytes": self.pinned_bytes,
+            "num_hits": self.num_hits,
+            "num_misses": self.num_misses,
+            "num_zero_copy_gets": self.num_zero_copy_gets,
         }
 
     def destroy(self):
-        stop = getattr(self, "_prefault_stop", None)
-        if stop is not None:
+        for stop in self._prefault_stops:
             stop.set()
-        self.arena.destroy()
+        self.pool.destroy()
 
 
 class ObjectStoreClient:
@@ -481,21 +617,30 @@ class ObjectStoreClient:
              "owner_address": owner_address},
         )
         shm = self._segment(name)
-        if size > (4 << 20):
-            # Big write: off-loop so the event loop stays responsive, via a
-            # plain memcpy through the shared mapping. On this VM class,
-            # WARM tmpfs pages memcpy at ~8.4 GB/s through the mapping vs
-            # ~3.3 GB/s through pwrite (syscall + page-cache path); COLD
-            # (never-touched) pages are hypervisor-fault-bound at ~0.1 GB/s
-            # either way, and the store warms its arena in the background
-            # (ObjectStoreHost._start_prefault) so steady-state puts land
-            # on warm pages.
-            dest = memoryview(shm.buf)[offset : offset + size]
-            loop = asyncio.get_running_loop()
-            await loop.run_in_executor(None, serialized.write_to, dest)
-        else:
-            dest = memoryview(shm.buf)[offset : offset + size]
-            serialized.write_to(dest)
+        try:
+            if size > (4 << 20):
+                # Big write: off-loop so the event loop stays responsive,
+                # via a plain memcpy through the shared mapping. On this VM
+                # class, WARM tmpfs pages memcpy at ~8.4 GB/s through the
+                # mapping vs ~3.3 GB/s through pwrite (syscall + page-cache
+                # path); COLD (never-touched) pages are hypervisor-fault-
+                # bound at ~0.1 GB/s either way, and the store warms each
+                # segment in the background (ObjectStoreHost._start_prefault)
+                # so steady-state puts land on warm pages.
+                dest = memoryview(shm.buf)[offset : offset + size]
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, serialized.write_to, dest)
+            else:
+                dest = memoryview(shm.buf)[offset : offset + size]
+                serialized.write_to(dest)
+        except BaseException:
+            # The entry is CREATING and would otherwise wedge readers in
+            # wait_sealed while leaking its region; roll it back.
+            try:
+                await self._request("store_abort", {"object_id": object_id})
+            except Exception:
+                pass
+            raise
         if self._notify is not None:
             await self._notify("store_seal", {"object_id": object_id})
         else:

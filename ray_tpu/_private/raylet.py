@@ -545,6 +545,7 @@ class Raylet:
         self.register_reply_dispatches = 0
         self.prestart_hints_received = 0
         self._exported_pool_hits = 0
+        self._exported_zero_copy_gets = 0
         self._exported_pool_misses = 0
         self._pool_gauge_envs: set = set()
         # actor:spawn/register/ctor flightrec spans, flushed to the GCS
@@ -757,6 +758,30 @@ class Raylet:
               "workers whose lease a compiled DAG holds pinned").set(
                 float(sum(1 for w in self.workers.values()
                           if w.dag_pins)), tags=tags)
+            # Object-plane health: occupancy/pinned/spill gauges plus the
+            # zero-copy get counter (delta-exported like pool hits).
+            st = self.store.stats()
+            g("ray_tpu_store_occupancy_bytes",
+              "bytes allocated to objects in the shm segment pool").set(
+                float(st["used"]), tags=tags)
+            g("ray_tpu_store_pinned_bytes",
+              "bytes pinned by outstanding zero-copy views").set(
+                float(st["pinned_bytes"]), tags=tags)
+            g("ray_tpu_store_spilled_bytes",
+              "cumulative bytes spilled to external storage").set(
+                float(st["bytes_spilled"]), tags=tags)
+            lookups = st["num_hits"] + st["num_misses"]
+            g("ray_tpu_store_hit_ratio",
+              "fraction of store lookups served from shm").set(
+                (st["num_hits"] / lookups) if lookups else 1.0, tags=tags)
+            zc = st["num_zero_copy_gets"]
+            if zc > self._exported_zero_copy_gets:
+                _metrics.Counter(
+                    "ray_tpu_store_zero_copy_gets_total",
+                    "same-node gets served as pinned zero-copy shm views",
+                    tag_keys=("Node",)).inc(
+                    zc - self._exported_zero_copy_gets, tags=tags)
+                self._exported_zero_copy_gets = zc
             # Warm-pool health: per-env pool depth + cumulative hit/miss.
             # Rows for envs whose pool emptied AND whose floor expired
             # are removed (not left at 0 forever): a long-lived node
@@ -1691,8 +1716,8 @@ class Raylet:
                     if desc is None:
                         continue
                     try:
-                        _name, offset, sz, metadata = desc
-                        data = bytes(self.store.arena.view(offset, sz))
+                        seg, offset, sz, metadata = desc
+                        data = bytes(self.store.view(seg, offset, sz))
                     finally:
                         self.store.unpin(oid)
                     await self.clients.request(target, "store_put_bytes", {
@@ -2422,13 +2447,55 @@ class Raylet:
 
     @rpc.non_idempotent
     async def rpc_store_create(self, conn, payload):
-        return self.store.create(payload["object_id"], payload["size"],
-                                 payload.get("metadata", b""),
-                                 payload.get("owner_address", ""))
+        oid = payload["object_id"]
+        res = self.store.create(oid, payload["size"],
+                                payload.get("metadata", b""),
+                                payload.get("owner_address", ""))
+        self._track_creating(conn, oid)
+        return res
+
+    def _track_creating(self, conn, oid):
+        """Abort CREATING entries whose writer dies before sealing.
+
+        A worker that crashes between store_create and store_seal would
+        otherwise leave the entry CREATING forever: readers block in
+        wait_sealed until timeout and the region never returns to the
+        free list. Tie the entry to the writer's connection — on close,
+        abort whatever it never sealed (abort_create is a no-op for
+        entries that did seal)."""
+        pending = getattr(conn, "_store_creating", None)
+        if pending is None:
+            pending = set()
+            conn._store_creating = pending
+            prev = conn.on_close
+
+            def _abort_unsealed(c, _prev=prev):
+                for o in list(pending):
+                    self.store.abort_create(o)
+                pending.clear()
+                if _prev:
+                    _prev(c)
+
+            conn.on_close = _abort_unsealed
+        pending.add(oid)
 
     @rpc.idempotent
     async def rpc_store_seal(self, conn, payload):
-        self.store.seal(payload["object_id"])
+        oid = payload["object_id"]
+        self.store.seal(oid)
+        pending = getattr(conn, "_store_creating", None)
+        if pending is not None:
+            pending.discard(oid)
+        return True
+
+    @rpc.idempotent
+    async def rpc_store_abort(self, conn, payload):
+        """Writer-side rollback of a CREATING entry (failed local write)."""
+        oid = payload["object_id"]
+        self.store.abort_create(oid)
+        pending = getattr(conn, "_store_creating", None)
+        if pending is not None:
+            pending.discard(oid)
         return True
 
     @rpc.non_idempotent
@@ -2439,7 +2506,11 @@ class Raylet:
             ok = await self.store.wait_sealed(oid, timeout)
             if not ok:
                 return None
-        return self.store.pin(oid)
+        desc = self.store.pin(oid)
+        if desc is not None:
+            # Same-node pin descriptor = a zero-copy view handed out.
+            self.store.num_zero_copy_gets += 1
+        return desc
 
     @rpc.non_idempotent
     async def rpc_store_release(self, conn, payload):
@@ -2490,9 +2561,9 @@ class Raylet:
         if desc is None:
             return None
         try:
-            _, obj_off, size, metadata = desc
-            chunk = bytes(self.store.arena.view(obj_off + offset,
-                                                min(length, size - offset)))
+            seg, obj_off, size, metadata = desc
+            chunk = bytes(self.store.view(seg, obj_off + offset,
+                                          min(length, size - offset)))
             return {"data": chunk, "total_size": size, "metadata": metadata}
         finally:
             self.store.unpin(oid)
@@ -2532,7 +2603,7 @@ class Raylet:
                                                  first.get("metadata", b""),
                                                  payload.get("owner_address", ""))
                 created = True
-                view = self.store.arena.view(offset, total)
+                view = self.store.view(name, offset, total)
                 data = first["data"]
                 view[: len(data)] = data
                 pos = len(data)

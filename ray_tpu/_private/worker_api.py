@@ -243,6 +243,21 @@ def get(refs, timeout: Optional[float] = None):
     return _call_on_core_loop(core, coro, timeout)
 
 
+def get_local(ref: ObjectRef, timeout: Optional[float] = None):
+    """Node-local object-plane get: `(value,)` when this node's store
+    holds the object (pinned zero-copy view), None when it does not.
+    Never crosses the network — callers fall back to `get()` for the
+    cross-node transfer path."""
+    if _state.client is not None:
+        return None  # client mode has no node-local store
+    core = get_core()
+    if not isinstance(ref, ObjectRef):
+        raise TypeError(f"get_local() expects an ObjectRef; got "
+                        f"{type(ref).__name__}")
+    return _call_on_core_loop(core, core.get_local_async(ref, timeout),
+                              timeout)
+
+
 def wait(refs: List[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None, fetch_local: bool = True):
     if _state.client is not None:

@@ -12,6 +12,7 @@ from . import (  # noqa: F401
     cancellation_safety,
     dag_teardown,
     metrics_catalog,
+    pubsub_ordering,
     rpc_idempotency,
     seqlock_discipline,
     serve_persistence,

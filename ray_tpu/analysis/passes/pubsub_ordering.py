@@ -1,0 +1,242 @@
+"""PUBSUB-ORDER: publish-after-state-write discipline for GCS pubsub.
+
+The GCS contract (gcs.py): a pubsub publish announces a state
+transition that has ALREADY been applied, and the write plus its
+publishes form one synchronous run — no `await` between them. Two
+violation shapes, both at statement granularity inside async daemon
+handlers:
+
+  1. write -> await -> publish — another handler interleaves at the
+     await and publishes ITS transition first, so subscribers observe
+     the two events out of order relative to the state they describe
+     (the drain/lease races the gang-drain machinery exists to
+     prevent). The publish must ride the same synchronous run as the
+     write it announces.
+
+  2. publish -> await -> publish (same channel, same block) — one
+     transition's event fan-out is split across a suspension point, so
+     a subscriber can act on the first event (e.g. send an RPC back
+     into the GCS) and observe the half-announced transition before
+     the second publish lands.
+
+Publish sites are recognized conservatively: calls of the form
+`<anything>.pubsub.publish(...)` / `pubsub.publish(...)` or an
+attribute resolving to a `Pubsub()` constructor — `self.publish(...)`
+on unrelated classes (the log monitor's own fan-out) never matches.
+Statements that both mutate state and await (e.g. `self.x = await f()`)
+RESET the write anchor: the await happened producing the value, not
+between write and publish.
+
+Suppress an intentional gap with
+`# ray-tpu: noqa(PUBSUB-ORDER): <why the interleave is safe>`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..engine import (DAEMON_TARGETS, Finding, ModuleCache,
+                      awaits_no_nested, register, walk_no_nested)
+
+RULE = "PUBSUB-ORDER"
+
+_MUTATORS = {"append", "add", "update", "pop", "clear", "remove",
+             "extend", "insert", "discard", "setdefault", "popitem"}
+
+
+def _is_publish(mod, cls: str, call: ast.Call) -> bool:
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "publish"):
+        return False
+    v = f.value
+    if isinstance(v, ast.Attribute):
+        if "pubsub" in v.attr.lower():
+            return True
+        if isinstance(v.value, ast.Name) and v.value.id == "self":
+            ctor = mod.attr_constructor_types().get((cls, v.attr)) or ""
+            return ctor.endswith("Pubsub")
+        return False
+    if isinstance(v, ast.Name):
+        return "pubsub" in v.id.lower()
+    return False
+
+
+def _publish_channel(call: ast.Call) -> Optional[str]:
+    """The channel literal of a publish call, when statically known."""
+    if call.args and isinstance(call.args[0], ast.Constant) and \
+            isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _attr_root(node) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            return node.attr
+        node = node.value
+    return None
+
+
+def _mutated_attrs(stmt) -> Set[str]:
+    """self-attribute roots this statement writes (assign / augassign /
+    del / container-mutator method calls)."""
+    out: Set[str] = set()
+    for sub in (stmt, *walk_no_nested(stmt)):
+        if isinstance(sub, (ast.Assign, ast.AugAssign)):
+            targets = sub.targets if isinstance(sub, ast.Assign) \
+                else [sub.target]
+            for t in targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                if isinstance(base, ast.Attribute):
+                    root = _attr_root(base)
+                    if root:
+                        out.add(root)
+        elif isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr in _MUTATORS:
+            root = _attr_root(sub.func.value)
+            if root:
+                out.add(root)
+        elif isinstance(sub, ast.Delete):
+            for t in sub.targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                if isinstance(base, ast.Attribute):
+                    root = _attr_root(base)
+                    if root:
+                        out.add(root)
+    return out
+
+
+def _stmt_publishes(mod, cls: str, stmt) -> List[ast.Call]:
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        return []
+    return [n for n in (stmt, *walk_no_nested(stmt))
+            if isinstance(n, ast.Call) and _is_publish(mod, cls, n)]
+
+
+def _has_await(stmt) -> bool:
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        return False
+    return bool(awaits_no_nested(stmt))
+
+
+_EXITS = (ast.Return, ast.Raise, ast.Continue, ast.Break)
+
+
+def _flow_awaits(stmt) -> List[int]:
+    """Await lines in `stmt` that can be FOLLOWED by the next statement
+    of the enclosing block. An await inside an if/elif suite that
+    unconditionally exits (return/raise/continue/break as its last
+    statement) never reaches it — the early-exit rollback idiom
+    (`if dead: await gather(...); return`) must not poison the
+    fall-through path. Other compound statements stay conservative."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        return []
+    if isinstance(stmt, ast.If):
+        out = [a.lineno for a in awaits_no_nested(stmt.test)]
+        for suite in (stmt.body, stmt.orelse):
+            if not suite or isinstance(suite[-1], _EXITS):
+                continue
+            for s in suite:
+                out.extend(_flow_awaits(s))
+        return out
+    return [a.lineno for a in awaits_no_nested(stmt)]
+
+
+def _blocks(fn_node):
+    """Every straight-line statement list in the function (no descent
+    into nested defs — their bodies run elsewhere)."""
+    for node in (fn_node, *walk_no_nested(fn_node)):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(node, field, None)
+            if isinstance(stmts, list) and stmts and \
+                    isinstance(stmts[0], ast.stmt):
+                yield stmts
+
+
+def _scan_block(mod, cls: str, where: str, stmts,
+                findings: List[Finding]) -> None:
+    last_write = None          # (line, attrs) of the nearest state write
+    awaits_since_write: List[int] = []
+    last_pub = None            # (line, channel) of the previous publish
+    awaits_since_pub: List[int] = []
+    for stmt in stmts:
+        pubs = _stmt_publishes(mod, cls, stmt)
+        for call in pubs:
+            if last_write is not None and awaits_since_write:
+                line, attrs = last_write
+                findings.append(Finding(
+                    RULE, mod.rel, call.lineno,
+                    f"{where} publishes at line {call.lineno} after "
+                    f"the state write of self."
+                    f"{'/self.'.join(sorted(attrs))} (line {line}) "
+                    f"with an await at line {awaits_since_write[0]} "
+                    f"between them — another handler can interleave "
+                    f"and subscribers observe events out of order; "
+                    f"publish in the same synchronous run as the "
+                    f"write it announces",
+                    key=f"{where}::write-await-publish::"
+                        f"{','.join(sorted(attrs))}"))
+                # One report per stale write anchor.
+                last_write = None
+                awaits_since_write = []
+            chan = _publish_channel(call)
+            if last_pub is not None and awaits_since_pub and \
+                    chan is not None and chan == last_pub[1]:
+                findings.append(Finding(
+                    RULE, mod.rel, call.lineno,
+                    f"{where} splits publishes to channel "
+                    f"'{chan}' (lines {last_pub[0]} and "
+                    f"{call.lineno}) across an await at line "
+                    f"{awaits_since_pub[0]} — one transition's "
+                    f"fan-out must not straddle a suspension point",
+                    key=f"{where}::publish-await-publish::{chan}"))
+            last_pub = (call.lineno, chan)
+            awaits_since_pub = []
+        mutated = _mutated_attrs(stmt)
+        if mutated:
+            # A combined `self.x = await f()` statement resets the
+            # anchor with NO pending await: the suspension produced the
+            # written value rather than separating write from publish.
+            last_write = (stmt.lineno, mutated)
+            awaits_since_write = []
+        else:
+            flow = _flow_awaits(stmt)
+            if flow:
+                awaits_since_write.append(flow[0])
+                awaits_since_pub.append(flow[0])
+
+
+def scan_module(mod) -> List[Finding]:
+    findings: List[Finding] = []
+    for (cls, fn), (fn_node, _src, _ln) in mod.functions().items():
+        if not isinstance(fn_node, ast.AsyncFunctionDef):
+            continue
+        where = f"{cls}.{fn}" if cls else fn
+        for stmts in _blocks(fn_node):
+            _scan_block(mod, cls, where, stmts, findings)
+    return findings
+
+
+def scan_paths(paths, cache: Optional[ModuleCache] = None
+               ) -> List[Finding]:
+    cache = cache or ModuleCache()
+    findings: List[Finding] = []
+    for p in paths:
+        mod = cache.get(p)
+        if mod is not None:
+            findings.extend(scan_module(mod))
+    return findings
+
+
+@register(RULE, "pubsub publishes ride the same synchronous run as the "
+                "state write they announce; no await splits a "
+                "transition's fan-out")
+def run(ctx) -> List[Finding]:
+    return scan_paths(ctx.cache.walk_py(*DAEMON_TARGETS), ctx.cache)

@@ -151,10 +151,15 @@ def _run_compiled_loop(fns: List, node_specs: List[tuple],
         st["careful"] = max(st.get("careful", 0), int(d.get("careful", 0)))
         rf = d.get("resend_from")
         if rf is not None:
+            # Store channels re-seal dangling oversize records in place
+            # (a dead writer's object refs) before appending the replay;
+            # ring channels have no persisted records to repair.
+            resend = getattr(writers[i], "resend_bytes",
+                             writers[i].write_bytes)
             for seq in range(int(rf), st["last"] + 1):
                 if seq in st["cache"]:
                     try:
-                        writers[i].write_bytes(st["cache"][seq])
+                        resend(st["cache"][seq])
                     except ChannelClosedError:
                         _close_all()
                         return "closed"

@@ -127,19 +127,41 @@ class StreamingIngest:
     """
 
     def __init__(self, source_fn: Callable[[], Iterator[Any]],
-                 depth: int = 4, name: str = "ingest"):
+                 depth: int = 4, name: str = "ingest",
+                 plane_offload: bool = True):
         self._queue = BoundedQueue(depth)
         self._error: Optional[BaseException] = None
         self._name = name
+        # Large blocks ride the node's object plane instead of sitting in
+        # the host queue: the producer puts the block into the shm store
+        # and queues only a PlaneRef; the consumer's get resolves it as a
+        # zero-copy view. Queue depth then bounds the number of in-flight
+        # blocks while the store (which can spill) holds the bytes.
+        self._offload = plane_offload
+        self.offloaded_blocks = 0
         self._thread = threading.Thread(
             target=self._produce, args=(source_fn,),
             name=f"ray-tpu-{name}", daemon=True)
         self._thread.start()
 
+    def _maybe_offload(self, item: Any) -> Any:
+        if not self._offload:
+            return item
+        try:
+            from ray_tpu._private import object_plane, worker_api
+            if worker_api.peek_core() is None:
+                return item  # bare-iterator use outside a cluster
+            routed = object_plane.maybe_offload(item, "ingest_block")
+            if routed is not item:
+                self.offloaded_blocks += 1
+            return routed
+        except Exception:  # noqa: BLE001 — offload is an optimization
+            return item
+
     def _produce(self, source_fn):
         try:
             for item in source_fn():
-                self._queue.put(item)
+                self._queue.put(self._maybe_offload(item))
         except QueueClosedError:
             return  # consumer cancelled: exit quietly, drop refs
         except BaseException as e:  # noqa: BLE001 — re-raised at get()
@@ -149,11 +171,13 @@ class StreamingIngest:
 
     def get(self, timeout: Optional[float] = None) -> Any:
         try:
-            return self._queue.get(timeout=timeout)
+            item = self._queue.get(timeout=timeout)
         except QueueClosedError:
             if self._error is not None:
                 raise self._error
             raise
+        from ray_tpu._private import object_plane
+        return object_plane.resolve(item)
 
     def __iter__(self):
         while True:
@@ -185,4 +209,5 @@ class StreamingIngest:
         return {"depth": q.depth, "peak_depth": q.peak_depth,
                 "produced": q.puts, "consumed": q.gets,
                 "blocked_puts": q.blocked_puts,
+                "offloaded_blocks": self.offloaded_blocks,
                 "producer_alive": self._thread.is_alive()}

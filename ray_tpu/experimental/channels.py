@@ -55,7 +55,8 @@ from ray_tpu._private.object_store import Arena, _attach_untracked
 from ray_tpu.experimental.channel import ChannelClosedError
 
 __all__ = ["RingChannel", "RingReader", "RingWriter", "StoreChannel",
-           "StoreReader", "ChannelClosedError", "local_segments"]
+           "StoreReader", "ChannelClosedError", "ChannelDataLostError",
+           "local_segments"]
 
 MAGIC = 0x52544348  # "RTCH"
 _HEADER = struct.Struct("<IIQQQQ")   # magic, closed, depth, slot, n_readers, seq
@@ -99,6 +100,13 @@ def _note_channel_full() -> None:
     _full_counter.inc()
 
 
+class ChannelDataLostError(Exception):
+    """An oversize payload's object is gone and no recovery re-sealed the
+    record: the writer that owned it died before (or without) a recovery
+    pass replaying its cached wire bytes. Typed so a compiled-DAG tick
+    fails fast instead of hanging a full object-get timeout."""
+
+
 class _OversizeRef:
     """Marker for a payload that exceeded the slot: only the object-store
     ref crosses the ring; the value rides the store (transfer) path."""
@@ -112,6 +120,16 @@ class _OversizeRef:
 def _resolve_payload(value):
     if isinstance(value, _OversizeRef):
         from ray_tpu._private import worker_api
+        # Ring endpoints share a node, so the payload sits in the local
+        # object plane: pin it straight from the store (zero-copy view,
+        # no owner round trip). The full get is only the fallback for a
+        # not-yet-sealed put racing the read.
+        try:
+            hit = worker_api.get_local(value.ref)
+        except Exception:  # noqa: BLE001 — fall through to the full get
+            hit = None
+        if hit is not None:
+            return hit[0]
         return worker_api.get(value.ref, timeout=60)
     return value
 
@@ -496,11 +514,20 @@ class StoreChannel:
     """
 
     def __init__(self, channel_id: str, depth: int = 2, n_readers: int = 1,
-                 inline_limit: int = _INLINE_LIMIT, _attach: bool = False):
+                 inline_limit: Optional[int] = None, _attach: bool = False):
         self.channel_id = channel_id
         self.depth = int(depth)
         self.n_readers = int(n_readers)
+        if inline_limit is None:
+            from ray_tpu._private import object_plane
+            inline_limit = object_plane.threshold("dag_channel",
+                                                  _INLINE_LIMIT)
         self.inline_limit = int(inline_limit)
+        # Channel seqs (at/above the resume floor) whose records were
+        # written by a PREVIOUS writer incarnation as object refs: the
+        # pins died with that writer, so the payloads are presumed gone.
+        # resend_bytes() re-seals them in place from cached wire bytes.
+        self._stale_ref_seqs: List[int] = []
         # An ATTACHED copy (unpickled on a shipped loop) resumes the
         # persisted writer seq lazily on its first write: a compiled-DAG
         # recovery re-ships the writer role to a surviving/restarted
@@ -538,16 +565,26 @@ class StoreChannel:
     # -- writer side ---------------------------------------------------
     def _resume_writer_seq(self) -> int:
         """An attached copy derives the persisted writer seq on its
-        first write: probe message keys upward from the most-advanced
-        reader cursor (readers never pass the writer; undelivered
+        first write: probe message keys upward from the SLOWEST reader's
+        cursor (records are contiguous from there — GC only deletes
+        below the min cursor; readers never pass the writer; undelivered
         backlog <= depth keys exist above the GC floor). Restarting at 0
-        would overwrite live message keys past the readers' cursors."""
-        seq = 0
-        for i in range(self.n_readers):
-            raw = _kv_get(self._ckey(i))
-            seq = max(seq, int(raw) if raw else 0)
-        while _kv_get(self._mkey(seq)) is not None:
+        would overwrite live message keys past the readers' cursors.
+
+        The probe doubles as the dangling-ref census: any undelivered
+        record holding an object ref was written by the previous writer
+        incarnation, whose pins died with it — those seqs are queued for
+        in-place re-sealing by resend_bytes()."""
+        seq = self._min_cursor()
+        stale = []
+        while True:
+            body = _kv_get(self._mkey(seq))
+            if body is None:
+                break
+            if body[:1] != b"v":
+                stale.append(seq)
             seq += 1
+        self._stale_ref_seqs = stale
         return seq
 
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
@@ -567,6 +604,39 @@ class StoreChannel:
             self.write(_serialization_ctx().deserialize(data), timeout)
             return
         self._write_body(b"v" + bytes(data), timeout)
+
+    def _seal_body(self, data, seq: int) -> bytes:
+        """Wire bytes -> a sealed KV record owned by THIS writer: inline
+        when they fit, else a fresh object-plane put (ref held against
+        `seq` so the payload outlives every reader's cursor)."""
+        if len(data) <= self.inline_limit:
+            return b"v" + bytes(data)
+        from ray_tpu._private import worker_api
+        ref = worker_api.put(_serialization_ctx().deserialize(data))
+        self._held_refs[seq] = ref
+        return pickle.dumps(("r", ref), protocol=5)
+
+    def resend_bytes(self, data, timeout: Optional[float] = None) -> None:
+        """Recovery resend of a cached already-serialized message.
+
+        Unlike write_bytes, this first RE-SEALS the lowest stale
+        oversize record left by the previous writer incarnation: a ref
+        written by a dead (or torn-down) writer dangles — its pin died
+        with the process — and a reader paused at that record would
+        otherwise fail on an object that can never materialize. The
+        record is overwritten IN PLACE with a body sealed from the
+        cached wire bytes (a fresh put owned by this writer when
+        oversize). Readers dedupe replays by the embedded tick seq, so
+        re-sealing a slot with a neighboring tick's payload is harmless;
+        what matters is that every undelivered record is readable. The
+        message is then also appended normally — the blanket-resend
+        contract compiled-DAG recovery relies on."""
+        if self._seq is None:
+            self._seq = self._resume_writer_seq()
+        if self._stale_ref_seqs:
+            seq = self._stale_ref_seqs.pop(0)
+            _kv_put(self._mkey(seq), self._seal_body(data, seq))
+        self.write_bytes(data, timeout)
 
     def _write_body(self, body: bytes, timeout: Optional[float],
                     held_ref=None) -> None:
@@ -704,12 +774,59 @@ class StoreReader:
         if body[:1] == b"v":
             value = _serialization_ctx().deserialize(body[1:])
         else:
-            kind, ref = pickle.loads(body)
-            from ray_tpu._private import worker_api
-            value = worker_api.get(ref, timeout=60)
+            value = self._resolve_ref(body, key, deadline)
         self._cursor += 1
         _kv_put(f"{self.channel_id}/c/{self.idx}", str(self._cursor).encode())
         return value
+
+    _REF_GET_SLICE_S = 5.0     # per-attempt bound on the cross-node get
+    _REF_LOST_RETRIES = 3      # lost-object re-reads before failing typed
+
+    def _resolve_ref(self, body: bytes, key: str, deadline):
+        """Materialize an oversize record. Same-node payloads pin
+        straight out of the local object plane (zero-copy view, no owner
+        round trip — the control word was the only KV hop); cross-node
+        ones ride the store transfer path. A ref whose owner died is
+        retried against the CONTROL WORD, not the object: recovery
+        re-seals the record in place from the writer's cached wire
+        bytes, so the reader re-reads the key between bounded get
+        attempts and fails typed (ChannelDataLostError) only if no
+        re-seal ever lands — never a silent multi-minute hang."""
+        from ray_tpu import exceptions as rexc
+        from ray_tpu._private import worker_api
+        lost = 0
+        last_err = None
+        while True:
+            kind, ref = pickle.loads(body)
+            try:
+                hit = worker_api.get_local(ref)
+            except Exception:  # noqa: BLE001 — fall through to full get
+                hit = None
+            if hit is not None:
+                return hit[0]
+            try:
+                return worker_api.get(ref, timeout=self._REF_GET_SLICE_S)
+            except rexc.ObjectLostError as e:   # owner died / copies gone
+                lost += 1
+                last_err = e
+            except (rexc.GetTimeoutError, TimeoutError):
+                # Slow fetch, not a dead owner: honor the read deadline.
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"channel read timed out resolving {key}")
+                continue
+            time.sleep(0.2)
+            resealed = _kv_get(key)
+            if resealed is not None and resealed != body:
+                if resealed[:1] == b"v":
+                    return _serialization_ctx().deserialize(resealed[1:])
+                body = resealed
+                continue
+            if lost >= self._REF_LOST_RETRIES or (
+                    deadline is not None and time.monotonic() > deadline):
+                raise ChannelDataLostError(
+                    f"{key}: oversize payload lost — its writer died "
+                    f"before recovery re-sealed the record") from last_err
 
     def close(self) -> None:
         pass

@@ -20,9 +20,12 @@ them into the control tuple, so ONE input-ring write serves every
 actor gang — params land as pickle-5 out-of-band buffers and each
 actor reads a ZERO-COPY view of the same slot (copied once into its
 runner, since the ring recycles slots `depth` ticks later). Params
-that exceed the slot automatically spill to the object store with only
-the ref ringing (the channels' oversize path), so big models degrade
-to one store put + per-actor gets instead of failing. Versions
+above the plane's weights threshold are put into the object store ONCE
+PER VERSION by the driver (PlaneRef in the control tuple) — per-tick
+submits ring only the tiny ref, and actors fetch the tree (zero-copy
+view) only when the version actually advanced; the channels' own
+oversize path remains the backstop for anything else that outgrows a
+slot. Versions
 observed by any actor are monotonic — a restarted actor re-adopts the
 current weights from its first control tuple, and a restarted learner
 resumes the version sequence from the control echo (its weights re-
@@ -96,13 +99,18 @@ class _RolloutWorker:
         """One rollout fragment under the weights `ctl` announces.
         ctl = (tick, weight_version, weights) — `weights` deserialized
         as zero-copy views onto the input ring slot every actor gang
-        shares (one write, N readers)."""
+        shares (one write, N readers). Oversize trees arrive as a
+        PlaneRef into the node's object store instead: resolved (one
+        zero-copy get) ONLY when the version actually advanced — stale
+        ticks skip the fetch entirely."""
         import jax
         tick, version, weights = ctl
         if weights is not None and version > self._version:
-            # Copy out of the ring slot ONCE per broadcast: the stored
-            # params outlive this tick, and the writer recycles the
-            # slot `depth` messages later.
+            from ray_tpu._private import object_plane
+            weights = object_plane.resolve(weights)
+            # Copy out of the ring slot / store view ONCE per broadcast:
+            # the stored params outlive this tick, and the writer
+            # recycles the slot `depth` messages later.
             self._runner.set_weights(
                 jax.tree_util.tree_map(np.array, weights))
             self._version = version
@@ -245,6 +253,13 @@ class PodracerRun:
         self.episode_rewards: deque = deque(maxlen=1000)
         self.outputs: deque = deque(maxlen=4096)
         self._submit_lock = threading.Lock()
+        # Control-tuple form of the current weights: literal tree when
+        # small, PlaneRef when oversize (one store put per VERSION, not
+        # per tick — the old path re-spilled the whole tree into the
+        # channel's oversize store put every submit). Recent refs stay
+        # held so pipelined in-flight ticks can't race the free.
+        self._ctl_weights = None
+        self._weight_refs: deque = deque(maxlen=8)
         try:
             self._build(config, plan)
         except BaseException:
@@ -293,6 +308,7 @@ class PodracerRun:
         # from tick 0.
         self._version, self._weights = ray_tpu.get(
             self.learner.control.remote(), timeout=120)
+        self._ctl_weights = self._fold_weights(self._weights)
         ray_tpu.get([a.ping.remote() for a in self.actors], timeout=120)
 
         with InputNode() as inp:
@@ -307,6 +323,25 @@ class PodracerRun:
             max_message_size=config.max_message_size, tick_replay=True,
             patient_readers=True)
         self._export_span("podracer:compile", t0, time.time())
+
+    def _fold_weights(self, weights):
+        """Route a weight tree into the control tuple: literal below the
+        plane's weights threshold, else ONE object-plane put for this
+        version with only the ref ringing to every actor gang."""
+        if weights is None:
+            return None
+        from ray_tpu._private import object_plane
+        try:
+            import jax
+            size = sum(int(np.asarray(leaf).nbytes)
+                       for leaf in jax.tree_util.tree_leaves(weights))
+        except Exception:  # noqa: BLE001 — unsized tree: send literal
+            return weights
+        if size < object_plane.threshold("weights"):
+            return weights
+        ref = object_plane.put_object(weights)
+        self._weight_refs.append(ref)
+        return object_plane.PlaneRef(ref)
 
     # -- ticking -------------------------------------------------------
     def submit(self):
@@ -323,7 +358,7 @@ class PodracerRun:
         # learner's applied==tick+1 probe would report phantom losses).
         with self._submit_lock:
             ref = self.dag.execute_async(
-                (self.dag._next_seq, self._version, self._weights))
+                (self.dag._next_seq, self._version, self._ctl_weights))
             self._pending.append((ref, time.time()))
         return ref
 
@@ -335,6 +370,7 @@ class PodracerRun:
         out = ref.result(timeout)
         if out["version"] > self._version and out["weights"] is not None:
             self._version, self._weights = out["version"], out["weights"]
+            self._ctl_weights = self._fold_weights(self._weights)
             self._export_span("podracer:broadcast", t0, time.time(),
                               only_if_traced=True)
         self.ticks += 1

@@ -11,22 +11,48 @@ from __future__ import annotations
 import asyncio
 import json
 import time
-from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, urlsplit
 
 
-@dataclass
 class Request:
-    method: str
-    path: str
-    query: Dict[str, list]
-    headers: Dict[str, str]
-    body: bytes = b""
-    # WebSocketChannel on upgraded connections (method == "WEBSOCKET"):
-    # the handler awaits request.ws.receive() for client messages and
-    # yields to send (serve/websocket.py).
-    ws: Any = None
+    """HTTP request as seen by a deployment handler.
+
+    Large bodies (>= the serve_body object-plane threshold) travel
+    proxy->replica as out-of-band SharedPayload buffers: written once
+    into the node's shm store and deserialized on the replica as a
+    zero-copy view. `body` materializes bytes lazily (one copy, only if
+    the handler asks); `body_view` is the no-copy path.
+    """
+
+    def __init__(self, method: str, path: str, query: Dict[str, list],
+                 headers: Dict[str, str], body=b"", ws: Any = None,
+                 wrap_response: bool = False):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self._body = body
+        # WebSocketChannel on upgraded connections (method == "WEBSOCKET"):
+        # the handler awaits request.ws.receive() for client messages and
+        # yields to send (serve/websocket.py).
+        self.ws = ws
+        # Set by the proxy: large bytes results come back plane-routed
+        # (the replica wraps them; only the proxy unwraps, so direct
+        # handle.remote() callers keep plain-bytes results).
+        self.wrap_response = wrap_response
+
+    @property
+    def body(self) -> bytes:
+        from ray_tpu._private import object_plane
+        if not isinstance(self._body, bytes):
+            self._body = object_plane.body_bytes(self._body)
+        return self._body
+
+    @property
+    def body_view(self) -> memoryview:
+        from ray_tpu._private import object_plane
+        return object_plane.body_view(self._body)
 
     def json(self) -> Any:
         return json.loads(self.body or b"null")
@@ -217,9 +243,11 @@ class ProxyActor:
             if mux_id:
                 handle = handle.options(multiplexed_model_id=mux_id)
             sub_path = self._sub_path(prefix, path)
+            from ray_tpu._private import object_plane
             req = Request(method=method, path=sub_path or "/",
                           query=parse_qs(url.query), headers=headers,
-                          body=body)
+                          body=object_plane.wrap_body(body),
+                          wrap_response=True)
             self._num_requests += 1
             # Request trace: minted HERE (or adopted from the client's
             # X-Request-Id), bound to the task context so the handle —
@@ -454,6 +482,9 @@ class ProxyActor:
 
     @staticmethod
     def _as_chunk(item) -> bytes:
+        from ray_tpu._private.object_plane import SharedPayload
+        if isinstance(item, SharedPayload):
+            return item.to_bytes()
         if isinstance(item, bytes):
             return item
         if isinstance(item, str):
@@ -507,7 +538,15 @@ class ProxyActor:
             return  # headers sent: truncate, never write a 500 mid-stream
 
     async def _send_result(self, writer, result, request_id: str = ""):
-        if isinstance(result, bytes):
+        from ray_tpu._private.object_plane import SharedPayload
+        if isinstance(result, SharedPayload):
+            # Plane-routed large body: the view aliases the shm segment
+            # (pinned through the handle's materialized value) and goes
+            # straight to the socket — no copy on the proxy at all.
+            await self._respond(writer, 200, result.view,
+                                ctype="application/octet-stream",
+                                request_id=request_id)
+        elif isinstance(result, bytes):
             await self._respond(writer, 200, result,
                                 ctype="application/octet-stream",
                                 request_id=request_id)
@@ -520,18 +559,22 @@ class ProxyActor:
                                 ctype="application/json",
                                 request_id=request_id)
 
-    async def _respond(self, writer, code: int, body: bytes,
+    async def _respond(self, writer, code: int, body,
                        ctype: str = "text/plain", request_id: str = ""):
         status = {200: "OK", 400: "Bad Request", 404: "Not Found",
                   500: "Internal Server Error",
                   503: "Service Unavailable",
                   504: "Gateway Timeout"}.get(code, "OK")
         rid = f"X-Request-Id: {request_id}\r\n" if request_id else ""
+        nbytes = body.nbytes if isinstance(body, memoryview) else len(body)
         writer.write(
             f"HTTP/1.1 {code} {status}\r\n"
             f"Content-Type: {ctype}\r\n{rid}"
-            f"Content-Length: {len(body)}\r\n"
-            f"Connection: close\r\n\r\n".encode() + body)
+            f"Content-Length: {nbytes}\r\n"
+            f"Connection: close\r\n\r\n".encode())
+        # Body written as its own frame: a memoryview body (zero-copy
+        # plane view) must not be concatenated into the header bytes.
+        writer.write(body)
         await writer.drain()
 
     def get_num_requests(self):

@@ -327,6 +327,7 @@ class ReplicaActor:
             if ctx is not None:
                 ctx.stamp(RQ_EXEC_END)
             self._account_exec(t0, error=False)
+            result = self._maybe_wrap_body(args, result)
             if self._replay and request_id:
                 self._dedupe[request_id] = result
                 while len(self._dedupe) > _DEDUPE_CAP:
@@ -348,6 +349,24 @@ class ReplicaActor:
         if self._is_function or method_name in ("__call__", ""):
             return self._callable
         return getattr(self._callable, method_name)
+
+    @staticmethod
+    def _maybe_wrap_body(args, result):
+        """Route large HTTP response bodies through the object plane.
+
+        Only for proxy-originated requests (Request.wrap_response): the
+        bytes body serializes as an out-of-band buffer — one shm write
+        here, a zero-copy view at the proxy — instead of being copied
+        into and out of the reply frame. Direct handle.remote() callers
+        see plain bytes, unchanged."""
+        if not args:
+            return result
+        if not getattr(args[0], "wrap_response", False):
+            return result
+        if isinstance(result, (bytes, bytearray)):
+            from ray_tpu._private import object_plane
+            return object_plane.wrap_body(result)
+        return result
 
     def is_streaming_method(self, method_name: str) -> bool:
         """True when the handler is a (sync or async) generator function —
@@ -410,7 +429,7 @@ class ReplicaActor:
                     except StopAsyncIteration:
                         break
                     _first_item()
-                    yield item
+                    yield self._maybe_wrap_body(args, item)
             elif inspect.isgenerator(result):
                 # Pull sync generators on the executor so a handler that
                 # blocks between yields (sleep, model step) doesn't freeze
@@ -438,7 +457,7 @@ class ReplicaActor:
                     if not ok:
                         break
                     _first_item()
-                    yield item
+                    yield self._maybe_wrap_body(args, item)
             else:
                 _first_item()
                 yield result

@@ -153,6 +153,30 @@ def main() -> dict:
     except Exception as e:  # noqa: BLE001
         log(f"alloc probe skipped: {type(e).__name__}: {e}")
 
+    # --- object-plane put/get: small vs large + zero-copy proof ---
+    # Small puts measure the control path (inline, below threshold);
+    # 64MB puts/gets measure the shm plane. The get row also PROVES
+    # zero-copy: the returned array's data pointer must lie inside a
+    # store segment the driver attached — asserted in tier-1
+    # (tests/test_bench_smoke.py), since unlike throughput a pointer
+    # range is deterministic under CI load.
+    try:
+        out.update(_put_get_phase())
+    except Exception as e:  # noqa: BLE001 — smoke must finish
+        log(f"put/get phase skipped: {type(e).__name__}: {e}")
+
+    # --- serve large-body p99: plane routing vs forced-inline ---
+    # The acceptance A/B for ISSUE 17's serve story: 2MB echo bodies
+    # through the handle with the object plane ON (bodies ride shm,
+    # zero-copy views out) vs the SAME code with the plane thresholds
+    # pushed above any payload (bodies pickled into RPC frames — the
+    # r13 wire shape). Each leg runs in its own subprocess cluster so
+    # the env-var threshold override reaches the forked workers.
+    try:
+        out.update(_serve_large_body_phase())
+    except Exception as e:  # noqa: BLE001 — smoke must finish
+        log(f"serve large-body phase skipped: {type(e).__name__}: {e}")
+
     # --- serve sustained-QPS smoke (the serve trajectory row) ---
     # 4 driver threads fire sync handle requests at a 2-replica echo
     # deployment for ~3s: QPS + p99 latency + requests shed by admission
@@ -322,6 +346,146 @@ def main() -> dict:
         out.update(_launch_storm_phase())
     except Exception as e:  # noqa: BLE001 — smoke must finish
         log(f"launch-storm phase skipped: {type(e).__name__}: {e}")
+    return out
+
+
+def _put_get_phase() -> dict:
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import worker_api
+
+    out: dict = {}
+    # Small objects: per-call control cost, not bandwidth.
+    small = np.zeros(8)
+    for r in [ray_tpu.put(small) for _ in range(50)]:      # warm
+        ray_tpu.get(r)
+    n = 300
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(small) for _ in range(n)]
+    out["put_small_calls_per_s"] = round(n / (time.perf_counter() - t0), 1)
+    t0 = time.perf_counter()
+    for r in refs:
+        ray_tpu.get(r)
+    out["get_small_calls_per_s"] = round(n / (time.perf_counter() - t0), 1)
+
+    # 64MB through the plane. One warm round first (attaches the
+    # segment); each measured put lands on a DISTINCT region of the
+    # prefaulted initial segment — freeing between rounds would race the
+    # async release and hand a later round cold pages. Best-of-3: the
+    # same sandbox stall quarantine as the n:n row. A put is one memcpy
+    # into shm by construction, so the box's warm copy rate is its
+    # ceiling — recorded alongside as put_copy_ceiling_gbs so the ratio
+    # survives VM-to-VM memory-bandwidth drift.
+    big = np.ones(64 << 20, dtype=np.uint8)
+    gbs = big.nbytes / 1e9
+    ray_tpu.get(ray_tpu.put(big))
+    scratch = np.empty_like(big)
+    ceiling = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        scratch[:] = big
+        ceiling = max(ceiling, gbs / (time.perf_counter() - t0))
+    del scratch
+    put_best = get_best = 0.0
+    refs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        refs.append(ray_tpu.put(big))
+        put_best = max(put_best, gbs / (time.perf_counter() - t0))
+    val = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        val = ray_tpu.get(refs[-1], timeout=60)
+        get_best = max(get_best, gbs / (time.perf_counter() - t0))
+    out["put_large_gbs"] = round(put_best, 2)
+    out["get_large_gbs"] = round(get_best, 2)
+    out["put_copy_ceiling_gbs"] = round(ceiling, 2)
+
+    # Zero-copy proof: the array handed back by a same-node get is a
+    # view INTO an attached shm segment, not a copy.
+    assert isinstance(val, np.ndarray) and val.nbytes == big.nbytes
+    addr = val.__array_interface__["data"][0]
+    core = worker_api.peek_core()
+    inside = False
+    for shm in core.store._segments.values():
+        seg = np.frombuffer(shm.buf, dtype=np.uint8)
+        base = seg.__array_interface__["data"][0]
+        if base <= addr < base + seg.nbytes:
+            inside = True
+            break
+    out["put_get_zero_copy"] = inside
+    log(f"put/get: small {out['put_small_calls_per_s']:,.0f}/"
+        f"{out['get_small_calls_per_s']:,.0f} calls/s, 64MB "
+        f"{out['put_large_gbs']}/{out['get_large_gbs']} GB/s put/get, "
+        f"zero_copy={inside}")
+    return out
+
+
+_LB_SCRIPT = """
+import json, os, sys, time
+os.environ['JAX_PLATFORMS'] = 'cpu'
+if {inline!r}:
+    # Push every plane threshold above any payload: bodies ride the RPC
+    # frame exactly as they did before the object plane landed.
+    os.environ['RAY_TPU_OBJECT_PLANE_THRESHOLD'] = str(1 << 40)
+sys.path.insert(0, {here!r})
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu._private import object_plane
+ray_tpu.init(num_cpus=2)
+body = b'x' * (2 << 20)
+
+@serve.deployment(num_replicas=1, max_ongoing_requests=8)
+def echo(b):
+    return b
+
+h = serve.run(echo.bind(), name='lb', route_prefix='/lb')
+for _ in range(8):                       # warm lease + JIT + segment
+    r = h.remote(body).result(timeout=60)
+lats = []
+for _ in range(60):
+    t0 = time.perf_counter()
+    r = h.remote(body).result(timeout=60)
+    # Time-to-usable, not time-to-copy: a zero-copy consumer reads the
+    # view in place (len + first byte), it does not materialize bytes.
+    assert len(r) == len(body) and object_plane.body_view(r)[0] == 120
+    lats.append(time.perf_counter() - t0)
+lats.sort()
+print('LBROW', json.dumps({{
+    'p50_ms': round(lats[len(lats) // 2] * 1e3, 2),
+    'p99_ms': round(lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+                    * 1e3, 2)}}))
+serve.shutdown()
+ray_tpu.shutdown()
+"""
+
+
+def _serve_large_body_phase() -> dict:
+    out: dict = {}
+    rows = {}
+    for tag, inline in (("plane", False), ("inline", True)):
+        script = _LB_SCRIPT.format(inline=inline, here=HERE)
+        proc = subprocess.run([sys.executable, "-c", script],
+                              capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            raise RuntimeError(f"{tag} leg rc={proc.returncode}: "
+                               f"{proc.stderr[-500:]}")
+        for ln in proc.stdout.splitlines():
+            if ln.startswith("LBROW "):
+                rows[tag] = json.loads(ln[6:])
+    out["serve_lb_p99_ms"] = rows["plane"]["p99_ms"]
+    out["serve_lb_p50_ms"] = rows["plane"]["p50_ms"]
+    out["serve_lb_inline_p99_ms"] = rows["inline"]["p99_ms"]
+    out["serve_lb_inline_p50_ms"] = rows["inline"]["p50_ms"]
+    out["serve_lb_p99_speedup"] = round(
+        rows["inline"]["p99_ms"] / rows["plane"]["p99_ms"], 2) \
+        if rows["plane"]["p99_ms"] else 0.0
+    log(f"serve large-body (2MB): plane p50/p99 "
+        f"{out['serve_lb_p50_ms']}/{out['serve_lb_p99_ms']} ms vs "
+        f"inline {out['serve_lb_inline_p50_ms']}/"
+        f"{out['serve_lb_inline_p99_ms']} ms -> "
+        f"{out['serve_lb_p99_speedup']}x at p99")
     return out
 
 

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Static check: large-payload producer paths route through the object
+plane (ray_tpu/_private/object_plane.py) rather than serializing bodies
+into raw RPC/KV frames.
+
+The plane only pays off if EVERY producer of big bytes routes through
+it — one path that pickles a 64MB body into an RPC frame re-introduces
+the two full-body copies the shm store exists to kill, silently. Three
+producer families are pinned:
+
+  * serve body send    — proxy ingress wraps request bodies, the replica
+                         wraps response bodies (object_plane.wrap_body);
+  * StoreChannel write — oversize DAG messages ride a plane put and the
+                         KV carries only the (seq, ref) control word;
+  * ingest hand-off    — streaming blocks queue as PlaneRefs
+                         (object_plane.maybe_offload), not literals.
+
+Two layers, both pure AST (no imports of the checked modules):
+
+  1. ROUTES anchors: each producer function still CALLS its plane API
+     (a rename/refactor that drops the call fails loudly, as does a
+     renamed entry point).
+  2. Structural rules: the hand-off sites themselves stay wrapped —
+     `Request(body=...)` takes `object_plane.wrap_body(...)` at the call
+     site, the ingest producer queues through `self._maybe_offload(...)`,
+     and only StoreChannel's two sealers (`_write_body`, `resend_bytes`)
+     may write a message record to the KV.
+
+Run: python scripts/check_store_routing.py   (exit 1 on any gap).
+Wired into tier-1 via tests/test_store_routing_check.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (file, class, function, [required dotted-call suffixes], why)
+ROUTES = [
+    ("ray_tpu/serve/proxy.py", "ProxyActor", "_handle_conn",
+     ["object_plane.wrap_body"],
+     "HTTP ingress must wrap request bodies for the plane"),
+    ("ray_tpu/serve/replica.py", "ReplicaActor", "_maybe_wrap_body",
+     ["object_plane.wrap_body"],
+     "replica responses must wrap large bodies for the plane"),
+    ("ray_tpu/experimental/channels.py", "StoreChannel", "write",
+     ["worker_api.put"],
+     "oversize channel messages must ride a plane put, not the KV"),
+    ("ray_tpu/experimental/channels.py", "StoreChannel", "_seal_body",
+     ["worker_api.put"],
+     "recovery re-seals must re-put the payload into the plane"),
+    ("ray_tpu/data/_internal/streaming.py", "StreamingIngest",
+     "_maybe_offload", ["object_plane.maybe_offload"],
+     "ingest blocks must offload through the plane facade"),
+    ("ray_tpu/podracer/runtime.py", "PodracerRun", "_fold_weights",
+     ["object_plane.put_object"],
+     "weight broadcasts must put once into the plane and ring the ref"),
+]
+
+# Only these StoreChannel methods may write a message record; everything
+# else must go through them so the inline-limit/plane split is enforced
+# in exactly one place.
+_SEALERS = ("_write_body", "resend_bytes")
+
+
+def _dotted(node) -> Optional[str]:
+    """`a.b.c(...)`'s func as 'a.b.c'; None for non-name call targets."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _calls(fn_node) -> List[str]:
+    return [d for d in (_dotted(n.func) for n in ast.walk(fn_node)
+                        if isinstance(n, ast.Call)) if d]
+
+
+def _functions(tree) -> Dict[Tuple[str, str], ast.AST]:
+    """(class, function) -> def node, module-level and one class deep."""
+    out: Dict[Tuple[str, str], ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[("", node.name)] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    out[(node.name, sub.name)] = sub
+    return out
+
+
+def _parse(root: str, rel: str):
+    path = os.path.join(root, rel)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return ast.parse(f.read(), filename=rel)
+    except (OSError, SyntaxError):
+        return None
+
+
+def _check_request_bodies(rel: str, tree, problems: List[str]) -> None:
+    """Every `Request(...)` built with a body= keyword must wrap it in
+    object_plane.wrap_body(...) AT THE CALL SITE — a raw `body=body`
+    ships the bytes in-band through the handle RPC."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Name) and
+                node.func.id == "Request"):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "body":
+                continue
+            v = kw.value
+            wrapped = (isinstance(v, ast.Call) and
+                       (_dotted(v.func) or "").endswith("wrap_body"))
+            if not wrapped:
+                problems.append(
+                    f"{rel}:{node.lineno}: Request(body=...) does not "
+                    f"wrap the body in object_plane.wrap_body(...) — "
+                    f"large bodies must ride the plane, not the RPC "
+                    f"frame")
+
+
+def _check_ingest_handoff(rel: str, fns, problems: List[str]) -> None:
+    """The ingest producer hands every block to the queue through
+    self._maybe_offload(...)."""
+    fn = fns.get(("StreamingIngest", "_produce"))
+    if fn is None:
+        problems.append(
+            f"{rel}: StreamingIngest._produce not found — producer "
+            f"renamed? update check_store_routing.py")
+        return
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call) and
+                (_dotted(node.func) or "").endswith("._queue.put")):
+            continue
+        arg = node.args[0] if node.args else None
+        routed = (isinstance(arg, ast.Call) and
+                  (_dotted(arg.func) or "").endswith("_maybe_offload"))
+        if not routed:
+            problems.append(
+                f"{rel}:{node.lineno}: StreamingIngest._produce queues "
+                f"a block without self._maybe_offload(...) — large "
+                f"blocks must enter the plane, not sit in the host "
+                f"queue")
+
+
+def _check_channel_sealers(rel: str, tree, problems: List[str]) -> None:
+    """Inside StoreChannel, a message-record write
+    (`_kv_put(self._mkey(...), ...)`) is legal only in the sealers."""
+    for node in tree.body:
+        if not (isinstance(node, ast.ClassDef) and
+                node.name == "StoreChannel"):
+            continue
+        for sub in node.body:
+            if not isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                continue
+            for call in ast.walk(sub):
+                if not (isinstance(call, ast.Call) and
+                        _dotted(call.func) == "_kv_put" and call.args):
+                    continue
+                key = call.args[0]
+                is_mkey = (isinstance(key, ast.Call) and
+                           (_dotted(key.func) or "")
+                           .endswith("._mkey"))
+                if is_mkey and sub.name not in _SEALERS:
+                    problems.append(
+                        f"{rel}:{call.lineno}: StoreChannel.{sub.name} "
+                        f"writes a message record directly — only "
+                        f"{'/'.join(_SEALERS)} may seal records, so the "
+                        f"inline-limit/plane split stays in one place")
+
+
+def check(root: str = REPO) -> List[str]:
+    problems: List[str] = []
+    trees = {}
+    for rel in sorted({r[0] for r in ROUTES}):
+        trees[rel] = _parse(root, rel)
+        if trees[rel] is None:
+            problems.append(f"{rel}: unreadable (file missing or "
+                            f"unparsable)")
+    for rel, cls, fn, suffixes, why in ROUTES:
+        tree = trees.get(rel)
+        if tree is None:
+            continue
+        fns = _functions(tree)
+        node = fns.get((cls, fn))
+        if node is None:
+            problems.append(
+                f"{rel}: {cls}.{fn} not found — producer path renamed? "
+                f"update check_store_routing.py ({why})")
+            continue
+        calls = _calls(node)
+        for suffix in suffixes:
+            if not any(c == suffix or c.endswith("." + suffix)
+                       for c in calls):
+                problems.append(
+                    f"{rel}:{node.lineno}: {cls}.{fn} never calls "
+                    f"{suffix}(...) — {why}")
+    rel = "ray_tpu/serve/proxy.py"
+    if trees.get(rel) is not None:
+        _check_request_bodies(rel, trees[rel], problems)
+    rel = "ray_tpu/data/_internal/streaming.py"
+    if trees.get(rel) is not None:
+        _check_ingest_handoff(rel, _functions(trees[rel]), problems)
+    rel = "ray_tpu/experimental/channels.py"
+    if trees.get(rel) is not None:
+        _check_channel_sealers(rel, trees[rel], problems)
+    return problems
+
+
+def main() -> int:
+    problems = check(REPO)
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(f"\n{len(problems)} store-routing gap(s); every "
+              f"large-payload producer must route through the object "
+              f"plane (ray_tpu/_private/object_plane.py).",
+              file=sys.stderr)
+        return 1
+    print(f"object-plane routing wired ({len(ROUTES)} producer paths, "
+          f"3 structural rules checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -282,14 +282,27 @@ class TestCompiledDagSubsystem:
         try:
             for i in range(5):
                 assert c.execute(i) == i + 111
+            # Background loops (heartbeats, lease renewal) frame at a
+            # WALL-CLOCK rate independent of ticks; on a slow box the
+            # tick loop takes whole seconds and collects them. Sample
+            # that idle rate and subtract it — the claim under test is
+            # that frames don't scale with ticks, not that the
+            # transport goes silent while the DAG runs.
+            idle0 = rpc.transport_stats()["frames"]
+            time.sleep(1.0)
+            idle_rate = rpc.transport_stats()["frames"] - idle0
             n = 300
             frames0 = rpc.transport_stats()["frames"]
+            t0 = time.monotonic()
             for i in range(n):
                 assert c.execute(i) == i + 111
+            elapsed = time.monotonic() - t0
             delta = rpc.transport_stats()["frames"] - frames0
-            assert delta <= n * 0.05, \
-                f"{delta} transport frames across {n} ticks — the tick " \
-                f"path is paying RPCs"
+            budget = n * 0.05 + idle_rate * elapsed * 2 + 2
+            assert delta <= budget, \
+                f"{delta} transport frames across {n} ticks " \
+                f"({elapsed:.2f}s, idle rate {idle_rate}/s, budget " \
+                f"{budget:.0f}) — the tick path is paying RPCs"
         finally:
             c.teardown()
 
@@ -751,6 +764,100 @@ class TestCompiledDagRecovery:
                 timer.cancel()
             assert outs == [[i, "a", "b", "c"] for i in range(150)]
             assert pipe.stats()["recoveries"] >= 1
+
+    @pytest.mark.timeout(120)
+    def test_oversize_store_ref_replay_reseals_dangling_record(
+            self, ray_start):
+        """ISSUE 17 satellite: an oversize StoreChannel record points at
+        an object owned by the writer; when that writer dies, the pin
+        dies with it and the record dangles. The recovery resend path
+        (what _run_compiled_loop runs on a resend_from directive) must
+        RE-SEAL the record in place from the cached wire bytes so a
+        reader paused at it still gets a payload — not a ref to memory
+        the store has since unlinked."""
+        import gc
+        import pickle
+        import time as _time
+
+        import numpy as np
+
+        from ray_tpu._private import worker_api
+        from ray_tpu._private.serialization import context_for_process
+        from ray_tpu.experimental.channels import StoreChannel
+
+        ch = StoreChannel("testch/replay", depth=4, n_readers=1,
+                          inline_limit=1024)
+        try:
+            big = np.arange(1 << 15, dtype=np.float64)   # 256 KiB
+            wire = context_for_process().serialize((0, big)).to_bytes()
+            ch.write_bytes(wire)           # oversize: rides the store
+            oid = next(iter(ch._held_refs.values())).id.binary()
+            # The writer "dies": its held pins are dropped and the owner
+            # frees the payload — the KV record now dangles.
+            ch._held_refs.clear()
+            gc.collect()
+            raylet = worker_api._state.head.raylet
+            deadline = _time.time() + 15
+            while raylet.store.contains(oid) and _time.time() < deadline:
+                _time.sleep(0.05)
+            assert not raylet.store.contains(oid), "free never landed"
+
+            # Recovery re-ships the writer role (attach copy) and
+            # replays the cached wire bytes through the resend hook,
+            # exactly as the compiled loop's resume directive does.
+            w2 = pickle.loads(pickle.dumps(ch))
+            resend = getattr(w2, "resend_bytes", w2.write_bytes)
+            resend(wire)
+
+            r = ch.reader(0)
+            t0 = _time.monotonic()
+            seq, out = r.read(timeout=30)
+            assert seq == 0 and np.array_equal(out, big)
+            assert _time.monotonic() - t0 < 20, "re-sealed read hung"
+            # The appended replay copy is also delivered (the compiled
+            # loop dedupes replays by the embedded tick seq).
+            seq2, out2 = r.read(timeout=30)
+            assert seq2 == 0 and np.array_equal(out2, big)
+            w2.destroy()
+        finally:
+            ch.destroy()
+
+    @pytest.mark.timeout(120)
+    def test_dangling_store_ref_fails_typed_without_resend(self, ray_start):
+        """Without a recovery resend, a reader that hits a dangling
+        oversize record must fail TYPED (ChannelDataLostError) within
+        bounded time — never hang out a full object-get timeout on an
+        object that can never materialize."""
+        import gc
+        import time as _time
+
+        import numpy as np
+
+        from ray_tpu._private import worker_api
+        from ray_tpu.experimental.channels import (ChannelDataLostError,
+                                                   StoreChannel)
+
+        ch = StoreChannel("testch/dangle", depth=2, n_readers=1,
+                          inline_limit=1024)
+        try:
+            big = np.arange(1 << 14, dtype=np.float64)
+            ch.write(big)
+            oid = next(iter(ch._held_refs.values())).id.binary()
+            ch._held_refs.clear()
+            gc.collect()
+            raylet = worker_api._state.head.raylet
+            deadline = _time.time() + 15
+            while raylet.store.contains(oid) and _time.time() < deadline:
+                _time.sleep(0.05)
+
+            r = ch.reader(0)
+            t0 = _time.monotonic()
+            with pytest.raises(ChannelDataLostError):
+                r.read(timeout=60)
+            assert _time.monotonic() - t0 < 30, "typed failure too slow"
+        finally:
+            ch.destroy()
+
 
 class TestCompiledDagLatency:
     @pytest.mark.timeout(60)

@@ -1,0 +1,152 @@
+"""View-lifetime safety for the node-local object plane.
+
+The store hands out ZERO-COPY views (pin descriptors) into shm
+segments; these tests pin down the lifetime contract that makes that
+safe: a pinned object's bytes never move or get recycled under a live
+view, unpinning returns it to the eviction pool, deletes defer to the
+last unpin, and half-written (CREATING) entries roll back cleanly —
+including when the writer dies mid-create and the raylet's
+connection-close hook has to clean up after it."""
+
+import os
+import types
+
+import pytest
+
+from ray_tpu._private.object_store import (CREATING, SEALED, SPILLED,
+                                           ObjectStoreHost)
+
+CAP = 1 << 20          # one 1MB segment: two 600KB objects cannot coexist
+BIG = 600 * 1024
+
+
+@pytest.fixture
+def host(tmp_path):
+    h = ObjectStoreHost(capacity=CAP, spill_dir=str(tmp_path / "spill"),
+                        prefault=False, initial_segment=CAP)
+    yield h
+    h.destroy()
+
+
+def _put(host, oid: bytes, size: int, fill: int) -> None:
+    name, off = host.create(oid, size)
+    host.pool.view(name, off, size)[:] = bytes([fill]) * size
+    host.seal(oid)
+
+
+def test_pin_blocks_eviction_bytes_stable_under_live_view(host):
+    """A reader holding a pinned view must never see recycled bytes:
+    while the pin is live the object is not evictable, so an allocation
+    that needs its space fails instead of scribbling over the view."""
+    _put(host, b"a" * 8, BIG, 0xAB)
+    seg, off, size, _ = host.pin(b"a" * 8)
+    view = host.view(seg, off, size)
+    assert view[0] == 0xAB and view[-1] == 0xAB
+    with pytest.raises(MemoryError):
+        host.create(b"b" * 8, BIG)
+    # The failed alloc spilled nothing and moved nothing.
+    assert host.objects[b"a" * 8].state == SEALED
+    assert bytes(view[:4]) == b"\xab\xab\xab\xab"
+    assert bytes(view[-4:]) == b"\xab\xab\xab\xab"
+    view.release()
+    host.unpin(b"a" * 8)
+
+
+def test_unpin_returns_object_to_eviction_pool(host):
+    """unpin -> evictable: the same allocation that failed under the pin
+    succeeds afterwards by spilling the victim, whose content survives
+    (restored from spill on next read)."""
+    _put(host, b"a" * 8, BIG, 0xAB)
+    host.pin(b"a" * 8)
+    host.unpin(b"a" * 8)
+    _put(host, b"b" * 8, BIG, 0xBB)     # spills a to make room
+    assert host.objects[b"a" * 8].state == SPILLED
+    assert host.num_spilled == 1
+    data = host.read_bytes(b"a" * 8)    # restore round-trip
+    assert len(data) == BIG and data[0] == 0xAB and data[-1] == 0xAB
+
+
+def test_double_unpin_and_double_delete_are_safe(host):
+    """Over-release must not corrupt the accounting: pins never go
+    negative, pinned_bytes stays exact, and a second delete is a no-op
+    (the region is freed exactly once)."""
+    _put(host, b"a" * 8, BIG, 0x01)
+    host.pin(b"a" * 8)
+    assert host.pinned_bytes == BIG
+    host.unpin(b"a" * 8)
+    host.unpin(b"a" * 8)                # double free
+    ent = host.objects[b"a" * 8]
+    assert ent.pins == 0 and host.pinned_bytes == 0
+    used_before = host.pool.used
+    host.delete(b"a" * 8)
+    host.delete(b"a" * 8)               # second delete: no-op
+    assert b"a" * 8 not in host.objects
+    assert host.pool.used == 0 and used_before > 0
+
+
+def test_delete_while_pinned_defers_to_last_unpin(host):
+    """Plasma delete semantics: delete under a live pin marks
+    delete_on_unpin; the view stays valid until the reader releases."""
+    _put(host, b"a" * 8, BIG, 0xCD)
+    seg, off, size, _ = host.pin(b"a" * 8)
+    view = host.view(seg, off, size)
+    host.delete(b"a" * 8)
+    assert b"a" * 8 in host.objects      # still indexed, deferred
+    assert view[0] == 0xCD               # bytes untouched under the pin
+    view.release()
+    host.unpin(b"a" * 8)
+    assert b"a" * 8 not in host.objects
+    assert host.pool.used == 0
+
+
+def test_abort_create_frees_region_and_spares_sealed(host):
+    """abort_create rolls back a CREATING entry (region back on the free
+    list, id gone); it must be a no-op for anything already sealed."""
+    host.create(b"x" * 8, BIG)
+    assert host.objects[b"x" * 8].state == CREATING
+    assert host.pin(b"x" * 8) is None    # unsealed: not readable
+    host.abort_create(b"x" * 8)
+    assert b"x" * 8 not in host.objects
+    assert host.pool.used == 0
+    _put(host, b"y" * 8, 1024, 0x11)
+    host.abort_create(b"y" * 8)          # sealed: no-op
+    assert host.objects[b"y" * 8].state == SEALED
+    assert host.read_bytes(b"y" * 8) == b"\x11" * 1024
+
+
+def test_writer_death_mid_create_aborts_via_conn_close(host):
+    """The raylet ties every CREATING entry to its writer's connection;
+    the on_close hook aborts whatever the writer never sealed, so a
+    crash between create and seal can't leak the region or wedge
+    readers in wait_sealed. Sealed objects survive the same close."""
+    from ray_tpu._private.raylet import Raylet
+
+    raylet = types.SimpleNamespace(store=host)
+    conn = types.SimpleNamespace(on_close=None)
+
+    host.create(b"d" * 8, BIG)
+    Raylet._track_creating(raylet, conn, b"d" * 8)
+    _put(host, b"s" * 8, 1024, 0x22)
+    Raylet._track_creating(raylet, conn, b"s" * 8)  # sealed before close
+    assert conn.on_close is not None
+
+    conn.on_close(conn)                  # writer dies
+    assert b"d" * 8 not in host.objects  # unsealed: rolled back
+    assert host.objects[b"s" * 8].state == SEALED
+    assert host.read_bytes(b"s" * 8) == b"\x22" * 1024
+    # Region is reusable immediately — no leak, no wedged readers.
+    host.create(b"e" * 8, BIG)
+
+
+def test_recreate_after_spill_drops_stale_spill_copy(host, tmp_path):
+    """Re-creating a spilled id (restore-by-transfer path) must drop the
+    spill file so the store never resurrects stale bytes."""
+    _put(host, b"a" * 8, BIG, 0xAB)
+    host._spill(host.objects[b"a" * 8])
+    spill_dir = str(tmp_path / "spill")
+    assert os.listdir(spill_dir)
+    name, off = host.create(b"a" * 8, BIG)
+    host.pool.view(name, off, BIG)[:] = b"\xEE" * BIG
+    host.seal(b"a" * 8)
+    assert not os.listdir(spill_dir)
+    assert host.read_bytes(b"a" * 8) == b"\xEE" * BIG

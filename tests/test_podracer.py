@@ -216,12 +216,23 @@ class TestPodracerGangDrain:
 
             t = threading.Thread(target=pump, daemon=True)
             t.start()
-            time.sleep(0.5)    # mid-rollout, ticks in flight
+            # Mid-rollout means ticks actually in flight — wait for the
+            # pump to tick rather than assuming a fixed nap suffices on
+            # a loaded box.
+            deadline = time.monotonic() + 30
+            while run.ticks < 2 and not errors \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)
             ticks_at_drain = run.ticks
             # Drain ONE member: the GCS escalates to the whole gang.
             ray_cluster.drain_node(act_hosts[0], deadline_s=8.0,
                                    grace_s=0.3, wait=True)
-            time.sleep(1.0)
+            # Post-drain progress is the condition under test; poll for
+            # it instead of napping a wall-clock guess.
+            deadline = time.monotonic() + 60
+            while run.ticks <= ticks_at_drain and not errors \
+                    and time.monotonic() < deadline:
+                time.sleep(0.1)
             stop.set()
             t.join(timeout=60)
             assert not errors, errors

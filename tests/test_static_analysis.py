@@ -100,7 +100,7 @@ def test_all_passes_registered():
     passes = _A.all_passes()
     for rule in ("RPC-IDEM", "TRACE-PROP", "SERVE-WAL", "DAG-TEARDOWN",
                  "METRICS-CAT", "ASYNC-BLOCK", "AWAIT-LOCK",
-                 "CANCEL-SAFE", "SEQLOCK-DISCIPLINE"):
+                 "CANCEL-SAFE", "SEQLOCK-DISCIPLINE", "PUBSUB-ORDER"):
         assert rule in passes, f"pass {rule} not registered"
 
 
@@ -724,3 +724,81 @@ def test_seqlock_recognizes_live_readers():
     assert ("Channel", "read") in readers
     assert ("RingReader", "read") in readers
     assert rule_clean("SEQLOCK-DISCIPLINE") == []
+
+
+# ---------------------------------------------------------------------------
+# PUBSUB-ORDER (publish-after-state-write discipline, gcs.py)
+# ---------------------------------------------------------------------------
+
+PUBSUB_FIXTURE = """\
+class Gcs:
+    def __init__(self):
+        self.pubsub = Pubsub()
+
+    async def ok_sync_run(self, payload):
+        self.nodes[payload["id"]] = payload
+        self.pubsub.publish("nodes", {"event": "alive"})
+        await self.clients.request("x", "y", {})
+
+    async def bad_write_await_publish(self, payload):
+        self.nodes.pop(payload["id"], None)
+        await self.clients.request("addr", "kill", {})
+        self.pubsub.publish("nodes", {"event": "dead"})
+
+    async def ok_early_exit_branch(self, payload):
+        self.counters.pop("k", None)
+        if payload.get("dead"):
+            await self.rollback()
+            return
+        self.pubsub.publish("nodes", {"event": "alive"})
+
+    async def bad_split_fanout(self, payload):
+        self.pubsub.publish("nodes", {"event": "gang", "n": 2})
+        await self.flush()
+        self.pubsub.publish("nodes", {"event": "draining"})
+
+    async def ok_mixed_channels(self, payload):
+        self.pubsub.publish("nodes", {"event": "dead"})
+        await self.flush()
+        self.pubsub.publish("actors", {"event": "dead"})
+
+    async def bad_suppressed(self, payload):
+        self.jobs["j"] = payload
+        await self.flush()
+        # ray-tpu: noqa(PUBSUB-ORDER): fixture reason text
+        self.pubsub.publish("jobs", {"event": "finished"})
+
+    async def ok_write_is_await_result(self, payload):
+        self.stats = await self.collect()
+        self.pubsub.publish("nodes", {"event": "stats"})
+"""
+
+
+def test_pubsub_order_positives_and_negatives(tmp_path):
+    findings, _cache = _scan("pubsub_ordering", tmp_path, PUBSUB_FIXTURE)
+    keys = {f.key for f in findings}
+    assert ("Gcs.bad_write_await_publish::write-await-publish::nodes"
+            in keys), keys
+    assert "Gcs.bad_split_fanout::publish-await-publish::nodes" in keys
+    assert ("Gcs.bad_suppressed::write-await-publish::jobs" in keys)
+    # Clean shapes: publish in the write's synchronous run, early-exit
+    # rollback branches, different channels, write-from-await-result.
+    assert not any(k.startswith("Gcs.ok_") for k in keys), keys
+
+
+def test_pubsub_order_noqa_suppresses_with_reason(tmp_path):
+    findings, cache = _scan("pubsub_ordering", tmp_path, PUBSUB_FIXTURE)
+    _A.apply_noqa(findings, cache)
+    supp = [f for f in findings if f.key.startswith("Gcs.bad_suppressed")]
+    assert supp and all(f.suppressed for f in supp)
+    assert supp[0].reason == "fixture reason text"
+    others = [f for f in findings
+              if not f.key.startswith("Gcs.bad_suppressed")]
+    assert others and not any(f.suppressed for f in others)
+
+
+def test_pubsub_order_live_tree_clean():
+    """gcs.py's publish sites all ride the synchronous run of the state
+    write they announce (the kill-actor and remove-pg publishes were
+    hoisted above their slow RPC awaits when this pass landed)."""
+    assert rule_clean("PUBSUB-ORDER") == []

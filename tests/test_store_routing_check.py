@@ -1,0 +1,121 @@
+"""scripts/check_store_routing.py — large-payload producers route
+through the object plane. The live tree must be clean, and the checker
+must actually catch each class of regression (anchor dropped, entry
+point renamed, hand-off site unwrapped, rogue record writer)."""
+
+import importlib.util
+import os
+import re
+import shutil
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "check_store_routing",
+    os.path.join(REPO, "scripts", "check_store_routing.py"))
+_checker = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_checker)
+
+
+def _fixture_root(tmp_path, mutate=None):
+    """Mirror the checked files into a tmp root; `mutate` maps a
+    relative path to a source-transform function."""
+    mutate = mutate or {}
+    for rel in sorted({r[0] for r in _checker.ROUTES}):
+        src = os.path.join(REPO, rel)
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        if rel in mutate:
+            with open(src, "r", encoding="utf-8") as f:
+                text = f.read()
+            dst.write_text(mutate[rel](text))
+        else:
+            shutil.copyfile(src, dst)
+    return str(tmp_path)
+
+
+def test_live_tree_routes_through_plane():
+    problems = _checker.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_fixture_mirror_is_clean(tmp_path):
+    assert _checker.check(_fixture_root(tmp_path)) == []
+
+
+def test_detects_unwrapped_request_body(tmp_path):
+    # Drop the wrap at the proxy's Request(...) call site: both the
+    # _handle_conn anchor and the structural body rule must fire.
+    root = _fixture_root(tmp_path, {
+        "ray_tpu/serve/proxy.py": lambda s: s.replace(
+            "body=object_plane.wrap_body(body)", "body=body")})
+    problems = _checker.check(root)
+    assert any("_handle_conn never calls object_plane.wrap_body" in p
+               for p in problems), problems
+    assert any("Request(body=...) does not wrap" in p
+               for p in problems), problems
+
+
+def test_detects_renamed_producer(tmp_path):
+    root = _fixture_root(tmp_path, {
+        "ray_tpu/serve/replica.py": lambda s: s.replace(
+            "def _maybe_wrap_body", "def _maybe_wrap_body_v2")})
+    problems = _checker.check(root)
+    assert any("_maybe_wrap_body not found" in p and "renamed" in p
+               for p in problems), problems
+
+
+def test_detects_raw_ingest_handoff(tmp_path):
+    root = _fixture_root(tmp_path, {
+        "ray_tpu/data/_internal/streaming.py": lambda s: s.replace(
+            "self._queue.put(self._maybe_offload(item))",
+            "self._queue.put(item)")})
+    problems = _checker.check(root)
+    assert any("queues a block without self._maybe_offload" in p
+               for p in problems), problems
+
+
+def test_detects_rogue_record_writer(tmp_path):
+    # Plant a StoreChannel method that writes a message record without
+    # going through the sealers.
+    def add_rogue(src):
+        rogue = ("    def rogue(self, seq, body):\n"
+                 "        _kv_put(self._mkey(seq), body)\n\n"
+                 "    def _mkey(self, seq: int) -> str:")
+        out = src.replace("    def _mkey(self, seq: int) -> str:",
+                          rogue, 1)
+        assert out != src
+        return out
+
+    root = _fixture_root(tmp_path, {
+        "ray_tpu/experimental/channels.py": add_rogue})
+    problems = _checker.check(root)
+    assert any("StoreChannel.rogue writes a message record directly"
+               in p for p in problems), problems
+
+
+def test_detects_dropped_plane_put(tmp_path):
+    # Weights folded without the plane put: the podracer anchor fires.
+    root = _fixture_root(tmp_path, {
+        "ray_tpu/podracer/runtime.py": lambda s: re.sub(
+            r"ref = object_plane\.put_object\(weights\)",
+            "ref = None  # broken", s)})
+    problems = _checker.check(root)
+    assert any("_fold_weights never calls object_plane.put_object" in p
+               for p in problems), problems
+
+
+def test_unreadable_file_reported(tmp_path):
+    root = _fixture_root(tmp_path)
+    os.remove(os.path.join(root, "ray_tpu/serve/proxy.py"))
+    problems = _checker.check(root)
+    assert any("ray_tpu/serve/proxy.py: unreadable" in p
+               for p in problems), problems
+
+
+def test_main_exit_codes(tmp_path, capsys, monkeypatch):
+    assert _checker.main() == 0
+    out = capsys.readouterr().out
+    assert "object-plane routing wired" in out
+    monkeypatch.setattr(_checker, "REPO", str(tmp_path / "nowhere"))
+    assert _checker.main() == 1
