@@ -105,6 +105,16 @@ class Config:
     task_events_enabled: bool = True
     task_events_max_buffer: int = 100_000
     metrics_report_interval_s: float = 2.0
+    # Cluster time-series store (GCS-side ring buffers fed by the
+    # per-process MetricsAgent delta frames). Retention/resolution set
+    # the per-series slot count: default ~15 min at 5 s = 180 slots.
+    tsdb_retention_s: float = 900.0
+    tsdb_resolution_s: float = 5.0
+    # Hard cardinality bound on stored series; past it new series are
+    # dropped and counted in ray_tpu_tsdb_dropped_series_total.
+    tsdb_max_series: int = 8192
+    # Kill switch for per-process metrics shipping (bench A/B).
+    metrics_agent_enabled: bool = True
 
     # --- logging ---
     log_to_driver: bool = True

@@ -479,7 +479,8 @@ class CoreWorker:
         registry — when the GCS or a raylet lives in this process it may
         hold the claim instead, and this loop only maintains gauges."""
         from ray_tpu.util import metrics as metrics_mod
-        reporter = f"{self.mode}:{self.worker_id.hex()[:12]}"
+        agent = metrics_mod.MetricsAgent(
+            f"{self.mode}:{self.worker_id.hex()[:12]}", self.gcs.request)
         while not self._shutdown:
             await asyncio.sleep(self.config.metrics_report_interval_s)
             try:
@@ -488,6 +489,8 @@ class CoreWorker:
                 # A user-thread submit resized a dict mid-scan; gauges
                 # are best-effort — never let one tick kill the loop.
                 pass
+            if not self.config.metrics_agent_enabled:
+                continue
             if not metrics_mod.claim_reporter(self):
                 continue
             rpc.export_transport_metrics()
@@ -495,8 +498,7 @@ class CoreWorker:
             if not snap:
                 continue
             try:
-                await self.gcs.request("report_metrics", {
-                    "reporter": reporter, "metrics": snap})
+                await agent.ship(snap)
             except rpc.RpcError:
                 pass
 

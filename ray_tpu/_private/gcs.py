@@ -153,6 +153,17 @@ class GcsServer:
         self.kv: Dict[str, Dict[bytes, bytes]] = {}     # namespace -> {key: val}
         self.node_demand: Dict[NodeID, list] = {}       # queued lease shapes
         self.metrics_reports: Dict[str, list] = {}      # reporter -> snapshot
+        # Telemetry plane: per-reporter delta-frame decoders feeding the
+        # cluster time-series store. The epoch tags this GCS incarnation;
+        # agents that shipped frames to a previous incarnation see the
+        # mismatch in the reply and re-send interned definitions.
+        from ray_tpu._private import tsdb as _tsdb
+        self.tsdb = _tsdb.TSDB(retention_s=config.tsdb_retention_s,
+                               resolution_s=config.tsdb_resolution_s,
+                               max_series=config.tsdb_max_series)
+        self.metrics_frames: Dict[str, list] = {}   # reporter -> (ts, decoder)
+        self._tsdb_epoch = os.urandom(6).hex()
+        self._tsdb_task: Optional[asyncio.Task] = None
         self.metrics_http_address = ""
         self._http_server = None
         self.task_events: List[dict] = []
@@ -272,6 +283,10 @@ class GcsServer:
         from ray_tpu.util import metrics as _metrics
         _metrics.claim_reporter(self, force=True)
         self._lag_task = _metrics.start_loop_lag_probe("gcs")
+        # The head process's own registry never rides a frame (the claim
+        # above suppresses every co-resident agent), so a local sampler
+        # feeds it into the tsdb at the store's native resolution.
+        self._tsdb_task = asyncio.ensure_future(self._tsdb_local_loop())
         await self._start_http(host)
         logger.info("GCS started at %s", self.address)
         return self.address
@@ -293,6 +308,8 @@ class GcsServer:
             self._persist_task.cancel()
         if self._lag_task:
             self._lag_task.cancel()
+        if self._tsdb_task:
+            self._tsdb_task.cancel()
         if self._http_server is not None:
             self._http_server.close()
         await self.server.stop()
@@ -528,6 +545,21 @@ class GcsServer:
                     "/api/logtail": lambda: self._log_tail(
                         q.get("file", [""])[0],
                         int(q.get("n", ["200"])[0] or 200)),
+                    "/api/metrics/query": lambda: self.tsdb.query(
+                        q.get("name", [""])[0],
+                        tags={k[4:]: v[0] for k, v in q.items()
+                              if k.startswith("tag.")},
+                        window_s=float(q.get("window", ["300"])[0] or 300),
+                        fold=q.get("fold", ["value"])[0]),
+                    "/api/metrics/series": lambda: {
+                        "names": self.tsdb.series_names(),
+                        "resolution_s": self.tsdb.res},
+                    "/api/traces": lambda: self._traces_search(
+                        deployment=q.get("deployment", [""])[0],
+                        min_ms=float(q.get("min_ms", ["0"])[0] or 0),
+                        errors_only=q.get("errors_only", ["0"])[0]
+                        in ("1", "true"),
+                        limit=int(q.get("limit", ["100"])[0] or 100)),
                 }
                 route = next((fn for p, fn in api_routes.items()
                               if urlsplit(path).path == p), None)
@@ -616,6 +648,24 @@ class GcsServer:
                   Subscriber=sub)
         gauge("ray_tpu_task_events_buffered", len(self.task_events),
               "task events held in the GCS ring buffer")
+        gauge("ray_tpu_tsdb_series", self.tsdb.n_series,
+              "series held in the cluster time-series store")
+        g.append({"name": "ray_tpu_tsdb_dropped_series_total",
+                  "type": "counter",
+                  "description": "series refused by the tsdb cardinality "
+                                 "bound (tsdb_max_series)",
+                  "tags": {}, "value": float(self.tsdb.dropped_total)})
+        # Per-node CPU pressure for `ray_tpu top` (the cluster-wide
+        # Resource gauges above have no Node axis).
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            tot = n.resources_total.get("CPU", 0.0)
+            if tot > 0:
+                used = tot - n.resources_available.get("CPU", 0.0)
+                gauge("ray_tpu_node_cpu_used_frac", used / tot,
+                      "fraction of a node's CPU slots leased out",
+                      Node=n.node_id.hex()[:12])
         # Slice fault domains: gang drains started / gangs whose
         # replacement domain became ready within the drain window.
         g.append({"name": "ray_tpu_gang_drains_total", "type": "counter",
@@ -639,7 +689,12 @@ class GcsServer:
         for reporter in [r for r, (ts, _) in self.metrics_reports.items()
                          if now - ts > ttl]:
             del self.metrics_reports[reporter]
+        for reporter in [r for r, (ts, _) in self.metrics_frames.items()
+                         if now - ts > ttl]:
+            del self.metrics_frames[reporter]
+            self.tsdb.drop_reporter(reporter)
         snaps = [snap for _, snap in self.metrics_reports.values()]
+        snaps.extend(dec.snapshot() for _, dec in self.metrics_frames.values())
         if m.claim_reporter(self):
             # This process's registry (GCS + any co-resident raylet/driver
             # core) is served locally; nobody else pushes it (see
@@ -780,9 +835,124 @@ class GcsServer:
 
     @rpc.idempotent
     async def rpc_report_metrics(self, conn, payload):
+        # Legacy full-snapshot push (pre-delta-frame agents). Still feeds
+        # the tsdb: ingest takes absolutes, so replays are harmless.
         self.metrics_reports[payload["reporter"]] = (time.time(),
                                                      payload["metrics"])
+        self.tsdb.ingest(payload["reporter"], payload["metrics"])
         return True
+
+    @rpc.idempotent
+    async def rpc_report_metrics_frame(self, conn, payload):
+        """MetricsAgent delta-frame ingest.
+
+        Rows carry absolute cumulative values (idempotent on replay);
+        delta/clamp accounting happens in the tsdb. The reply always
+        carries this GCS incarnation's epoch — an agent that shipped to a
+        previous incarnation resets its encoder and re-sends definitions;
+        ``resync`` covers the same race within one incarnation (decoder
+        evicted by the reporter TTL while the agent kept interning)."""
+        from ray_tpu._private import tsdb as _tsdb
+        reporter = payload["reporter"]
+        entry = self.metrics_frames.get(reporter)
+        dec = entry[1] if entry else _tsdb.FrameDecoder()
+        try:
+            changed = dec.decode(payload["frame"])
+        except _tsdb.ResyncNeeded:
+            return {"epoch": self._tsdb_epoch, "resync": True}
+        self.metrics_frames[reporter] = (time.time(), dec)
+        self.tsdb.ingest(reporter, changed)
+        return {"epoch": self._tsdb_epoch, "resync": False}
+
+    @rpc.idempotent
+    async def rpc_metrics_query(self, conn, payload):
+        """Aligned-window tsdb query; accepts one query or a batch.
+
+        One query: ``{"name", "tags"?, "window_s"?, "fold"?}`` →
+        ``[{"name","tags","type","points"}]``. Batch: ``{"queries":
+        [...]}`` → list of those, one per query (how `ray_tpu top`
+        fetches a whole refresh in one round trip)."""
+        queries = payload.get("queries")
+        single = queries is None
+        if single:
+            queries = [payload]
+        out = [self.tsdb.query(q["name"], tags=q.get("tags"),
+                               window_s=float(q.get("window_s", 300.0)),
+                               fold=q.get("fold", "value"))
+               for q in queries]
+        return out[0] if single else out
+
+    @rpc.idempotent
+    async def rpc_metrics_series(self, conn, payload):
+        return {"names": self.tsdb.series_names(),
+                "n_series": self.tsdb.n_series,
+                "dropped": self.tsdb.dropped_total,
+                "resolution_s": self.tsdb.res}
+
+    async def _tsdb_local_loop(self):
+        from ray_tpu._private import rpc as _rpc
+        from ray_tpu.util import metrics as m
+        while True:
+            await asyncio.sleep(self.tsdb.res)
+            try:
+                if m.claim_reporter(self):
+                    _rpc.export_transport_metrics()
+                    self.tsdb.ingest("gcs:local",
+                                     m.snapshot() + self._internal_metrics())
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — sampler must outlive hiccups
+                logger.exception("tsdb local sampler tick failed")
+
+    # ------------- per-request trace search (over the task-event ring) ----
+
+    def _traces_search(self, deployment: str = "", min_ms: float = 0.0,
+                       errors_only: bool = False, limit: int = 100) -> list:
+        """Group `serve_request` events by request id into searchable
+        summaries (start, total ms, hops, replays, error) — the rows feed
+        `ray_tpu timeline --request <id>` for the full phase view."""
+        reqs: Dict[str, dict] = {}
+        for e in self.task_events:
+            if e.get("kind") != "serve_request":
+                continue
+            rid = e.get("request_id", "")
+            r = reqs.get(rid)
+            if r is None:
+                r = reqs[rid] = {"request_id": rid,
+                                 "trace_id": e.get("trace_id", ""),
+                                 "deployment": "", "hops": [],
+                                 "start": e["time"], "end": e["time"],
+                                 "replays": 0, "error": ""}
+            dep = e.get("deployment", "")
+            if dep and not r["deployment"]:
+                r["deployment"] = dep
+            r["hops"].append(e.get("hop", ""))
+            ts = [e["time"]] + [p for p in (e.get("phases") or []) if p]
+            r["start"] = min(r["start"], min(ts))
+            r["end"] = max(r["end"], max(ts))
+            r["replays"] = max(r["replays"], e.get("replays", 0))
+            if e.get("error"):
+                r["error"] = e["error"]
+        rows = []
+        for r in reqs.values():
+            r["total_ms"] = (r["end"] - r["start"]) * 1000.0
+            if deployment and r["deployment"] != deployment:
+                continue
+            if r["total_ms"] < min_ms:
+                continue
+            if errors_only and not r["error"]:
+                continue
+            rows.append(r)
+        rows.sort(key=lambda r: r["start"], reverse=True)
+        return rows[:max(1, min(int(limit), 5000))]
+
+    @rpc.idempotent
+    async def rpc_search_traces(self, conn, payload):
+        return self._traces_search(
+            deployment=payload.get("deployment", ""),
+            min_ms=float(payload.get("min_ms", 0.0)),
+            errors_only=bool(payload.get("errors_only", False)),
+            limit=int(payload.get("limit", 100)))
 
     @rpc.idempotent
     async def rpc_get_metrics_address(self, conn, payload):
@@ -2564,6 +2734,20 @@ _DASHBOARD_HTML = """<!doctype html>
  <th>name</th><th>phase</th><th>count</th><th>p50 ms</th><th>p95 ms</th>
  </tr></thead><tbody></tbody></table>
 </div>
+<div class="panel" id="p-history">
+ <p style="font-size:.8rem;color:#666">Server-side time series from the GCS
+ tsdb (<code>/api/metrics/query</code>); one line per label set.</p>
+ <select id="histName"></select>
+ <select id="histFold"><option>value</option><option>rate</option>
+  <option>mean</option><option>p50</option><option>p95</option>
+  <option>p99</option></select>
+ <select id="histWindow"><option value="60">1m</option>
+  <option value="300" selected>5m</option><option value="900">15m</option>
+ </select>
+ <canvas id="historyC" style="border:1px solid #ddd;width:100%;
+  height:300px;margin-top:.5rem"></canvas>
+ <div id="histLegend" style="font-size:.78rem"></div>
+</div>
 <div class="panel" id="p-timeline">
  <p style="font-size:.8rem;color:#666">Completed task spans per worker
  (latest buffer; darker = FAILED).</p>
@@ -2578,8 +2762,8 @@ _DASHBOARD_HTML = """<!doctype html>
 </div>
 <script>
 const TABS=[["overview","Overview"],["actors","Actors"],["jobs","Jobs/PGs"],
-  ["tasks","Tasks"],["latency","Latency"],["timeline","Timeline"],
-  ["logs","Logs"],["metrics","Metrics"]];
+  ["tasks","Tasks"],["latency","Latency"],["history","History"],
+  ["timeline","Timeline"],["logs","Logs"],["metrics","Metrics"]];
 let active="overview", logFile=null;
 const nav=document.getElementById('tabs');
 for(const [id,label] of TABS){
@@ -2644,6 +2828,53 @@ function drawCards(prom,st){
  b.textContent=String(st.pending_demand);
  const s=document.createElement('span'); s.textContent='pending demand';
  d.append(b,s); cards.appendChild(d);
+}
+const HIST_COLORS=['#1a73e8','#d93025','#188038','#f9ab00','#9334e6',
+ '#e8710a','#12b5cb','#5f6368'];
+async function drawHistory(){
+ const nameSel=document.getElementById('histName');
+ if(!nameSel.options.length){
+  const s=await (await fetch('/api/metrics/series')).json();
+  for(const n of (s.names||[])){
+   const o=document.createElement('option'); o.textContent=n;
+   nameSel.appendChild(o);
+  }
+ }
+ if(!nameSel.value) return;
+ const fold=document.getElementById('histFold').value;
+ const win=document.getElementById('histWindow').value;
+ const series=await (await fetch('/api/metrics/query?name='+
+   encodeURIComponent(nameSel.value)+'&fold='+fold+
+   '&window='+win)).json();
+ const c=document.getElementById('historyC');
+ c.width=c.clientWidth; c.height=300;
+ const g=c.getContext('2d'); g.clearRect(0,0,c.width,c.height);
+ const pts=series.flatMap(s=>s.points||[]);
+ const legend=document.getElementById('histLegend'); legend.innerHTML='';
+ if(!pts.length){ g.fillStyle='#888';
+   g.fillText('no samples yet',20,20); return; }
+ const t0=Math.min(...pts.map(p=>p[0])), t1=Math.max(...pts.map(p=>p[0]));
+ const v1=Math.max(...pts.map(p=>p[1]),0);
+ const v0=Math.min(...pts.map(p=>p[1]),0);
+ const ts=(t1-t0)||1, vs=(v1-v0)||1;
+ g.font='11px system-ui';
+ series.forEach((s,si)=>{
+  const col=HIST_COLORS[si%HIST_COLORS.length];
+  g.strokeStyle=col; g.lineWidth=1.4; g.beginPath();
+  (s.points||[]).forEach((p,i)=>{
+   const x=6+(p[0]-t0)/ts*(c.width-12);
+   const y=c.height-8-(p[1]-v0)/vs*(c.height-20);
+   i?g.lineTo(x,y):g.moveTo(x,y);
+  });
+  g.stroke();
+  const d=document.createElement('span');
+  d.style.color=col; d.style.marginRight='.8rem';
+  d.textContent='■ '+JSON.stringify(s.tags||{});
+  legend.appendChild(d);
+ });
+ g.fillStyle='#555';
+ g.fillText(v1.toPrecision(4),6,12);
+ g.fillText(v0.toPrecision(4),6,c.height-12);
 }
 function drawTimeline(trace){
  // Lanes draw the task slices; the full export (flow events + phase
@@ -2747,6 +2978,7 @@ async function tick(){
     t=>[t.name, t.state, t.count]);
   if(active==='latency') await fillTable('/api/latency', '#latency',
     r=>[r.name, r.phase, r.count, r.p50_ms, r.p95_ms]);
+  if(active==='history') await drawHistory();
   if(active==='timeline')
     drawTimeline(await (await fetch('/api/timeline')).json());
   if(active==='logs') await drawLogs();

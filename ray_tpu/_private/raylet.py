@@ -736,7 +736,8 @@ class Raylet:
         leaves the push to whoever claimed first — the gauges still
         update in the shared registry either way."""
         from ray_tpu.util import metrics as _metrics
-        reporter = f"raylet:{self.node_name}"
+        agent = _metrics.MetricsAgent(f"raylet:{self.node_name}",
+                                      self.gcs_conn.request)
         while not self._stopped:
             await asyncio.sleep(self.config.metrics_report_interval_s)
             tags = {"Node": self.node_name}
@@ -820,6 +821,8 @@ class Raylet:
                     "spawn or wait)", tag_keys=("Node",)).inc(
                     misses - self._exported_pool_misses, tags=tags)
                 self._exported_pool_misses = misses
+            if not self.config.metrics_agent_enabled:
+                continue
             if not _metrics.claim_reporter(self):
                 continue
             rpc.export_transport_metrics()
@@ -827,8 +830,7 @@ class Raylet:
             if not snap:
                 continue
             try:
-                await self.gcs_conn.request("report_metrics", {
-                    "reporter": reporter, "metrics": snap})
+                await agent.ship(snap)
             except rpc.RpcError:
                 pass
 

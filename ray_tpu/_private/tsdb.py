@@ -1,0 +1,397 @@
+"""Cluster time-series store and the delta-frame codec that feeds it.
+
+The telemetry plane has three pieces:
+
+- ``FrameEncoder`` runs inside every daemon-hosting process (the
+  MetricsAgent side).  Each report tick it diffs the local registry
+  snapshot against what it last shipped and emits a *delta frame*:
+  only changed series, with the (name, tags) tuple interned to a small
+  integer on first ship so steady-state frames are a handful of
+  ``[id, value]`` rows.  Rows carry **absolute** cumulative values, not
+  deltas — replaying a frame is idempotent, and all reset/restart
+  accounting happens once, server-side.
+- ``FrameDecoder`` runs on the GCS, one per reporter.  It reconstructs
+  the reporter's full current snapshot (so the merged Prometheus view
+  keeps working) and returns the changed rows for TSDB ingest.  An
+  unknown intern id (GCS restarted, or the agent outlived a decoder
+  eviction) raises ``ResyncNeeded`` and the agent re-ships definitions.
+- ``TSDB`` is the GCS-side store: one fixed-slot ring per series
+  (``retention_s / resolution_s`` slots), bounded cardinality with a
+  drop counter, and per-(series, reporter) counter-reset clamping — the
+  DeploymentSLO restart-clamp logic generalized: first sight of a
+  reporter records a baseline without charging, a negative delta means
+  the process restarted and the new absolute is charged in full.
+
+Queries return window-aligned points (slot timestamps are multiples of
+the resolution) with ``value``/``rate``/``mean``/``p50``/``p95``/``p99``
+folds; percentiles are derived from the shipped histogram buckets by
+linear interpolation within the covering bucket.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class ResyncNeeded(Exception):
+    """Decoder saw an intern id it has no definition for."""
+
+
+# ---------------------------------------------------------------------------
+# Delta-frame codec
+# ---------------------------------------------------------------------------
+
+
+class FrameEncoder:
+    """Delta-encodes registry snapshots for shipping (agent side)."""
+
+    def __init__(self):
+        self._ids: Dict[Tuple[str, tuple], int] = {}
+        self._last: Dict[int, Any] = {}
+        self._next = 0
+
+    def reset(self) -> None:
+        """Forget everything shipped; the next frame re-sends definitions."""
+        self._ids.clear()
+        self._last.clear()
+        self._next = 0
+
+    def encode(self, metrics: Sequence[dict]) -> Optional[dict]:
+        """Diff ``metrics`` (a registry snapshot) against the last ship.
+
+        Returns a frame dict or ``None`` when nothing changed.
+        """
+        defs: Dict[int, list] = {}
+        rows: List[list] = []
+        for m in metrics:
+            tags = m.get("tags") or {}
+            key = (m["name"], tuple(sorted(tags.items())))
+            sid = self._ids.get(key)
+            fresh = sid is None
+            if fresh:
+                sid = self._next
+                self._next += 1
+                self._ids[key] = sid
+                defs[sid] = [m["name"], m.get("type", "gauge"),
+                             sorted(tags.items()),
+                             m.get("description", ""),
+                             list(m.get("bounds") or [])]
+            if m.get("type") == "histogram":
+                state = (tuple(m["bucket_counts"]), m["sum"], m["count"])
+                if not fresh and self._last.get(sid) == state:
+                    continue
+                self._last[sid] = state
+                rows.append([sid, list(state[0]), state[1], state[2]])
+            else:
+                v = m.get("value", 0)
+                if not fresh and self._last.get(sid) == v:
+                    continue
+                self._last[sid] = v
+                rows.append([sid, v])
+        if not rows and not defs:
+            return None
+        return {"defs": defs, "rows": rows}
+
+
+class FrameDecoder:
+    """Reconstructs one reporter's snapshot from delta frames (GCS side)."""
+
+    def __init__(self):
+        self.series: Dict[int, dict] = {}
+
+    def decode(self, frame: dict) -> List[dict]:
+        """Apply a frame; returns the changed metric dicts (live refs)."""
+        for sid, d in (frame.get("defs") or {}).items():
+            sid = int(sid)
+            name, typ, tags, desc, bounds = d
+            m = {"name": name, "type": typ, "description": desc,
+                 "tags": dict(tags)}
+            if typ == "histogram":
+                m["bounds"] = list(bounds)
+                m["bucket_counts"] = [0] * (len(bounds) + 1)
+                m["sum"] = 0.0
+                m["count"] = 0
+            else:
+                m["value"] = 0
+            self.series[sid] = m
+        changed: List[dict] = []
+        for row in frame.get("rows") or []:
+            m = self.series.get(row[0])
+            if m is None:
+                raise ResyncNeeded(row[0])
+            if m["type"] == "histogram":
+                m["bucket_counts"] = list(row[1])
+                m["sum"] = row[2]
+                m["count"] = row[3]
+            else:
+                m["value"] = row[1]
+            changed.append(m)
+        return changed
+
+    def snapshot(self) -> List[dict]:
+        out = []
+        for m in self.series.values():
+            c = dict(m)
+            if c["type"] == "histogram":
+                c["bucket_counts"] = list(c["bucket_counts"])
+            out.append(c)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Time-series store
+# ---------------------------------------------------------------------------
+
+_PCT = {"p50": 0.50, "p95": 0.95, "p99": 0.99}
+
+
+class _Series:
+    __slots__ = ("name", "type", "tags", "bounds",
+                 "vals", "stamps", "last_idx", "first_idx",
+                 "cum", "hcounts", "hsum", "hcount",
+                 "per_rep", "last_write_t")
+
+    def __init__(self, name: str, typ: str, tags: tuple, nslots: int,
+                 bounds: Optional[list]):
+        self.name = name
+        self.type = typ
+        self.tags = tags
+        self.bounds = list(bounds) if bounds else None
+        self.vals: List[Any] = [None] * nslots
+        self.stamps: List[int] = [-1] * nslots
+        self.last_idx = -1
+        self.first_idx = -1
+        self.cum = 0.0
+        self.hcounts: Optional[List[int]] = (
+            [0] * (len(bounds) + 1) if bounds is not None else None)
+        self.hsum = 0.0
+        self.hcount = 0
+        # reporter -> last absolute (counter), last value (gauge), or
+        # (bucket_counts, sum, count) tuple (histogram) — the clamp state.
+        self.per_rep: Dict[str, Any] = {}
+        self.last_write_t = 0.0
+
+
+class TSDB:
+    """Ring-buffer time-series store with bounded cardinality."""
+
+    def __init__(self, retention_s: float = 900.0, resolution_s: float = 5.0,
+                 max_series: int = 8192):
+        self.res = max(0.05, float(resolution_s))
+        self.nslots = max(2, int(math.ceil(retention_s / self.res)))
+        self.max_series = max_series
+        self._series: Dict[Tuple[str, tuple], _Series] = {}
+        self._lock = threading.Lock()
+        self.dropped_total = 0
+
+    @property
+    def n_series(self) -> int:
+        return len(self._series)
+
+    # -- ingest ------------------------------------------------------------
+
+    def ingest(self, reporter: str, metrics: Sequence[dict],
+               now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        with self._lock:
+            for m in metrics:
+                self._ingest_one(reporter, m, now)
+
+    def _ingest_one(self, reporter: str, m: dict, now: float) -> None:
+        tags = m.get("tags") or {}
+        key = (m["name"], tuple(sorted(tags.items())))
+        s = self._series.get(key)
+        if s is None:
+            if len(self._series) >= self.max_series:
+                self.dropped_total += 1
+                return
+            s = _Series(m["name"], m.get("type", "gauge"), key[1],
+                        self.nslots, m.get("bounds"))
+            self._series[key] = s
+        typ = s.type
+        if typ == "histogram":
+            counts = m.get("bucket_counts")
+            if (counts is None or s.hcounts is None
+                    or len(counts) != len(s.hcounts)):
+                return
+            state = (list(counts), float(m.get("sum", 0.0)),
+                     int(m.get("count", 0)))
+            prev = s.per_rep.get(reporter)
+            s.per_rep[reporter] = state
+            if prev is None:
+                # First sight: baseline only (DeploymentSLO semantics).
+                self._write(s, now)
+                return
+            dcount = state[2] - prev[2]
+            if dcount < 0 or any(a < b for a, b in zip(state[0], prev[0])):
+                # Process restarted: its counters began again from zero,
+                # so the new absolutes are all post-restart activity.
+                dcounts = state[0]
+                dsum, dcount = state[1], state[2]
+            else:
+                dcounts = [a - b for a, b in zip(state[0], prev[0])]
+                dsum = state[1] - prev[1]
+            for i, d in enumerate(dcounts):
+                s.hcounts[i] += d
+            s.hsum += dsum
+            s.hcount += dcount
+        elif typ == "counter":
+            v = float(m.get("value", 0))
+            prev = s.per_rep.get(reporter)
+            s.per_rep[reporter] = v
+            if prev is None:
+                self._write(s, now)
+                return
+            d = v - prev
+            if d < 0:
+                d = v
+            s.cum += d
+        else:  # gauge: level is the sum of each reporter's latest value
+            s.per_rep[reporter] = float(m.get("value", 0))
+            s.cum = sum(s.per_rep.values())
+        self._write(s, now)
+
+    def _write(self, s: _Series, now: float) -> None:
+        idx = int(now // self.res)
+        if s.first_idx < 0:
+            s.first_idx = idx
+        if s.last_idx >= 0 and idx > s.last_idx:
+            # Carry the running cumulative forward over silent slots so
+            # rate()/percentile folds see a flat step, not a hole.
+            for j in range(s.last_idx + 1, idx):
+                if idx - j >= self.nslots:
+                    continue
+                pos = j % self.nslots
+                s.vals[pos] = s.vals[s.last_idx % self.nslots]
+                s.stamps[pos] = j
+        pos = idx % self.nslots
+        if s.type == "histogram":
+            s.vals[pos] = (tuple(s.hcounts), s.hsum, s.hcount)
+        else:
+            s.vals[pos] = s.cum
+        s.stamps[pos] = idx
+        s.last_idx = max(s.last_idx, idx)
+        s.last_write_t = now
+
+    def drop_reporter(self, reporter: str) -> None:
+        """Forget a vanished reporter's clamp state (and gauge share)."""
+        with self._lock:
+            for s in self._series.values():
+                if reporter in s.per_rep:
+                    del s.per_rep[reporter]
+                    if s.type == "gauge":
+                        s.cum = sum(s.per_rep.values())
+
+    # -- query -------------------------------------------------------------
+
+    def series_names(self) -> List[str]:
+        with self._lock:
+            return sorted({k[0] for k in self._series})
+
+    def query(self, name: str, tags: Optional[dict] = None,
+              window_s: float = 300.0, fold: str = "value",
+              now: Optional[float] = None) -> List[dict]:
+        """Aligned-window query.
+
+        Returns ``[{"name", "tags", "type", "points": [[t, v], ...]}]``,
+        one entry per series whose tags are a superset of ``tags``.
+        Point timestamps are multiples of the resolution.  ``latest``
+        ignores alignment and returns the single most recent value.
+        """
+        now = time.time() if now is None else now
+        want = tuple(sorted((tags or {}).items()))
+        out: List[dict] = []
+        with self._lock:
+            for (sname, stags), s in self._series.items():
+                if sname != name:
+                    continue
+                if want and not set(want).issubset(set(stags)):
+                    continue
+                out.append({"name": sname, "tags": dict(stags),
+                            "type": s.type,
+                            "points": self._fold_series(s, window_s, fold,
+                                                        now)})
+        return out
+
+    def _fold_series(self, s: _Series, window_s: float, fold: str,
+                     now: float) -> List[list]:
+        if fold == "latest":
+            if s.last_idx < 0:
+                return []
+            v = s.vals[s.last_idx % self.nslots]
+            if s.type == "histogram":
+                v = v[2]
+            return [[s.last_write_t, v]]
+        end_idx = int(now // self.res)
+        n = min(self.nslots - 1, max(1, int(math.ceil(window_s / self.res))))
+        pts: List[list] = []
+        for idx in range(end_idx - n + 1, end_idx + 1):
+            if idx < 0:
+                continue
+            pos = idx % self.nslots
+            if s.stamps[pos] != idx:
+                continue
+            t = idx * self.res
+            v = self._fold_point(s, idx, fold)
+            if v is not None:
+                pts.append([t, v])
+        return pts
+
+    def _prev_val(self, s: _Series, idx: int):
+        """Value at idx-1, or the zero baseline for the first-ever slot."""
+        ppos = (idx - 1) % self.nslots
+        if s.stamps[ppos] == idx - 1:
+            return s.vals[ppos]
+        if idx == s.first_idx:
+            if s.type == "histogram":
+                return (tuple([0] * len(s.hcounts)), 0.0, 0)
+            return 0.0
+        return None
+
+    def _fold_point(self, s: _Series, idx: int, fold: str):
+        v = s.vals[idx % self.nslots]
+        if fold in ("value", "raw"):
+            return v[2] if s.type == "histogram" else v
+        if fold == "rate":
+            prev = self._prev_val(s, idx)
+            if prev is None:
+                return None
+            if s.type == "histogram":
+                return (v[2] - prev[2]) / self.res
+            return (v - prev) / self.res
+        if s.type != "histogram":
+            return None
+        prev = self._prev_val(s, idx)
+        if prev is None:
+            return None
+        dcounts = [a - b for a, b in zip(v[0], prev[0])]
+        dcount = v[2] - prev[2]
+        if dcount <= 0:
+            return None
+        if fold == "mean":
+            return (v[1] - prev[1]) / dcount
+        q = _PCT.get(fold)
+        if q is None:
+            return None
+        return _bucket_quantile(s.bounds, dcounts, dcount, q)
+
+
+def _bucket_quantile(bounds: Sequence[float], dcounts: Sequence[int],
+                     total: int, q: float) -> float:
+    """Linear-interpolated quantile over histogram bucket deltas."""
+    target = q * total
+    cum = 0
+    for j, c in enumerate(dcounts):
+        if c <= 0:
+            cum += max(0, c)
+            continue
+        if cum + c >= target:
+            lower = bounds[j - 1] if j > 0 else 0.0
+            upper = bounds[j] if j < len(bounds) else bounds[-1]
+            frac = (target - cum) / c
+            return lower + (upper - lower) * max(0.0, min(1.0, frac))
+        cum += c
+    return float(bounds[-1]) if bounds else 0.0

@@ -155,6 +155,39 @@ def cmd_timeline(args):
     ray_tpu.shutdown()
 
 
+def cmd_top(args):
+    """Live cluster dashboard over the GCS time-series store."""
+    from ray_tpu.scripts import top
+    top.run(args)
+
+
+def cmd_traces(args):
+    """Search the GCS serve-request trace buffer (slow / failed requests)."""
+    ray_tpu = _connect(args)
+    from ray_tpu._private import worker_api
+    core = worker_api.get_core()
+    rows = worker_api._call_on_core_loop(
+        core,
+        core.gcs.request("search_traces", {
+            "deployment": args.deployment,
+            "min_ms": args.min_ms,
+            "errors_only": args.errors_only,
+            "limit": args.limit,
+        }), 30)
+    if not rows:
+        print("no matching requests")
+    else:
+        print(f"{'request_id':<34}{'deployment':<18}{'ms':>9}"
+              f"{'hops':>6}{'replays':>8}  error")
+        for r in rows:
+            print(f"{r['request_id']:<34.33}{r['deployment']:<18.17}"
+                  f"{r['total_ms']:>9.1f}{r['hops']:>6}{r['replays']:>8}"
+                  f"  {r.get('error') or ''}")
+        print(f"\n{len(rows)} request(s); inspect one with: "
+              f"python -m ray_tpu timeline --request <request_id>")
+    ray_tpu.shutdown()
+
+
 def cmd_stack(args):
     """`ray stack` equivalent: thread dumps / CPU samples / heap snapshots
     from a live worker over its profiling RPCs (reference:
@@ -400,6 +433,25 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--request", default=None,
                    help="filter to one serve request id (X-Request-Id)")
     s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("top", help="live cluster dashboard "
+                                   "(tsdb-backed, ANSI redraw)")
+    s.add_argument("--address", default=None)
+    s.add_argument("--once", action="store_true",
+                   help="print a single frame and exit (no ANSI)")
+    s.add_argument("--interval", type=float, default=2.0)
+    s.add_argument("--window", type=float, default=300.0,
+                   help="query window in seconds")
+    s.set_defaults(fn=cmd_top)
+
+    s = sub.add_parser("traces", help="search serve request traces")
+    s.add_argument("--address", default=None)
+    s.add_argument("--deployment", default=None)
+    s.add_argument("--min-ms", type=float, default=0.0,
+                   help="only requests slower than this end-to-end")
+    s.add_argument("--errors-only", action="store_true")
+    s.add_argument("--limit", type=int, default=50)
+    s.set_defaults(fn=cmd_traces)
 
     s = sub.add_parser("profile", help="profile a live worker "
                                        "(stack/cpu/memory)")

@@ -1141,8 +1141,43 @@ class ServeController:
         except Exception:
             pass
 
+    async def _proxy_slo_rows(self) -> Dict[str, dict]:
+        """Per-deployment proxy-side queue counters from the GCS tsdb.
+
+        Proxies count dispatched requests and queue-wait SLO misses into
+        their process registries; the metrics frames carry them to the
+        tsdb, which merges across proxies. Folding the latest cumulative
+        values into DeploymentSLO as ONE pseudo-replica per deployment
+        closes the PR 7 blind spot: burn now fires on proxy-only
+        queueing delay (stalled proxy loop, controller round trips)
+        that replica-side counters can never see."""
+        if not any(st.slo is not None for st in self._deployments.values()):
+            return {}
+        try:
+            from ray_tpu._private import worker_api
+            core = worker_api.get_core()
+            res = await asyncio.wait_for(core.gcs.request(
+                "metrics_query", {"queries": [
+                    {"name": "ray_tpu_serve_proxy_requests_total",
+                     "fold": "latest"},
+                    {"name": "ray_tpu_serve_proxy_queue_slow_total",
+                     "fold": "latest"},
+                ]}), timeout=5)
+        except Exception:  # noqa: BLE001 — telemetry gaps never stall
+            return {}      # autoscaling; the next pass re-baselines
+        folds: list = [{}, {}]
+        for series_list, dest in zip(res, folds):
+            for s in series_list:
+                dep = s["tags"].get("Deployment", "")
+                if dep and s["points"]:
+                    dest[dep] = dest.get(dep, 0.0) + s["points"][-1][1]
+        totals, slows = folds
+        return {dep: {"completed": total, "slow": slows.get(dep, 0.0)}
+                for dep, total in totals.items()}
+
     async def _autoscale(self):
         now = time.monotonic()
+        proxy_rows = await self._proxy_slo_rows()
         for st in list(self._deployments.values()):
             asc = st.config.autoscaling_config
             if (asc is None and st.slo is None) or not st.replicas:
@@ -1168,7 +1203,14 @@ class ServeController:
             # request is shed.
             verdict = None
             if st.slo is not None and polled:
-                st.slo.ingest(polled)
+                rows = dict(polled)
+                prow = proxy_rows.get(st.name)
+                if prow:
+                    # The proxy plane as one pseudo-replica: restart
+                    # clamping and vanish cleanup come from the same
+                    # per-reporter machinery replicas use.
+                    rows[f"proxy::{st.name}"] = prow
+                st.slo.ingest(rows)
                 verdict = st.slo.evaluate()
                 if (verdict["violating"] and asc is not None
                         and st.target_num < asc.max_replicas
@@ -1315,6 +1357,20 @@ class ServeController:
     async def get_route_table(self):
         await self._ensure_loops()
         return dict(self._routes)
+
+    async def get_slo_queue_targets(self):
+        """Deployment -> SLO latency target (s), for the proxies' queue-
+        wait accounting. Only SLO-configured deployments appear; a proxy
+        never classifies queue wait for deployments with no target."""
+        return {st.name: st.config.slo_config.target_p99_s
+                for st in self._deployments.values()
+                if st.config.slo_config is not None}
+
+    async def get_proxy_actor_id(self):
+        """The detached HTTP proxy's actor id (tests / tooling build a
+        direct handle from it via get_actor_info)."""
+        rec = self._proxy_rec.get("http") or {}
+        return rec.get("actor_id")
 
     async def status(self):
         await self._ensure_loops()

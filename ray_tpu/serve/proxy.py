@@ -108,6 +108,16 @@ class ProxyActor:
         self._ctrl_ok_ts = 0.0      # last successful controller round trip
         self._num_requests = 0
         self._ws_queues: Dict[str, asyncio.Queue] = {}
+        # Proxy-side SLO accounting: per-deployment queue-wait budget
+        # (the SLO latency target, fetched with the route table) and a
+        # decayed-max sample of this proxy's event-loop lag. A request's
+        # ingress->dispatch queue wait is measured as (dispatch - recv)
+        # PLUS the current lag: a blocked proxy loop delays accept/parse
+        # BEFORE any stamp we control runs, so wall-clock deltas alone
+        # are blind to exactly the stall this accounting exists to see.
+        self._slo_targets: Dict[str, float] = {}
+        self._loop_lag = 0.0
+        self._lag_task = None
 
     async def ready(self):
         if self._server is None:
@@ -118,7 +128,49 @@ class ProxyActor:
                 metrics.start_loop_lag_probe_once("serve_http_proxy")
             except Exception:  # noqa: BLE001 — lag probe is best-effort
                 pass
+            if self._lag_task is None:
+                self._lag_task = asyncio.ensure_future(self._lag_loop())
         return self._port
+
+    async def _lag_loop(self):
+        """Feed the decayed-max loop-lag sample for queue-wait charging.
+        Decay keeps a stall visible across the next few requests (the
+        ones that queued behind it) without marking the proxy slow
+        forever."""
+        loop = asyncio.get_running_loop()
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(0.1)
+            lag = max(0.0, loop.time() - t0 - 0.1)
+            self._loop_lag = max(lag, self._loop_lag * 0.5)
+
+    def _account_queue_wait(self, deployment: str, t_recv: float) -> None:
+        """One dispatched request's ingress->dispatch queue wait into the
+        proxy SLO counters. These ship with the metrics frames; the
+        controller folds them into DeploymentSLO as a pseudo-replica, so
+        burn fires on proxy-only queueing delay too."""
+        from ray_tpu.util import metrics
+        metrics.Counter(
+            "ray_tpu_serve_proxy_requests_total",
+            "requests dispatched to a deployment by this proxy",
+            tag_keys=("Deployment",)).inc(1, tags={"Deployment": deployment})
+        target = self._slo_targets.get(deployment)
+        if not target:
+            return
+        qw = max(0.0, time.time() - t_recv) + self._loop_lag
+        if qw > target:
+            metrics.Counter(
+                "ray_tpu_serve_proxy_queue_slow_total",
+                "dispatched requests whose proxy-side queue wait alone "
+                "exceeded the deployment's SLO latency target",
+                tag_keys=("Deployment",)).inc(
+                1, tags={"Deployment": deployment})
+
+    async def debug_stall(self, seconds: float):
+        """Test hook: block THIS proxy's event loop (chaos/SLO tests
+        drive proxy-side queueing without touching replicas)."""
+        time.sleep(min(float(seconds), 2.0))  # ray-tpu: noqa(ASYNC-BLOCK): deliberate loop stall for SLO tests
+        return True
 
     async def _refresh_routes(self):
         now = time.monotonic()
@@ -131,12 +183,15 @@ class ProxyActor:
             # Bounded: a restarting controller parks calls until it is
             # back — that wait must never ride a request's latency. The
             # abandoned call completes harmlessly later.
-            routes = await asyncio.wait_for(
-                ctrl.get_route_table.remote().future(),
+            routes, targets = await asyncio.wait_for(
+                asyncio.gather(
+                    ctrl.get_route_table.remote().future(),
+                    ctrl.get_slo_queue_targets.remote().future()),
                 timeout=self.CTRL_TIMEOUT_S)
         except Exception:  # noqa: BLE001 — serve with stale routes;
             return         # /-/healthz flips per _healthz_ready
         self._ctrl_ok_ts = time.monotonic()
+        self._slo_targets = targets or {}
         if routes != self._routes:
             # Redeploys may switch a handler generator <-> plain: re-probe.
             self._streaming.clear()
@@ -271,11 +326,14 @@ class ProxyActor:
                         self._streaming[key] = streaming
                     except Exception:
                         streaming = False
+                self._account_queue_wait(ingress, t_recv)
                 if streaming:
                     try:
                         gen = handle.options(stream=True).remote(req)
                         await self._send_stream(writer, gen, trace=trace)
                     except Exception as e:
+                        from ray_tpu.serve.exceptions import unwrap
+                        trace.error = type(unwrap(e)).__name__
                         code, body, ctype = _error_response(e)
                         await self._respond(writer, code, body, ctype=ctype,
                                             request_id=trace.request_id)
@@ -284,6 +342,8 @@ class ProxyActor:
                     resp = handle.remote(req)
                     result = await resp
                 except Exception as e:
+                    from ray_tpu.serve.exceptions import unwrap
+                    trace.error = type(unwrap(e)).__name__
                     code, body, ctype = _error_response(e)
                     await self._respond(writer, code, body, ctype=ctype,
                                         request_id=trace.request_id)

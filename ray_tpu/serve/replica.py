@@ -333,9 +333,13 @@ class ReplicaActor:
                 while len(self._dedupe) > _DEDUPE_CAP:
                     self._dedupe.popitem(last=False)
             return result
-        except ServeError:
+        except ServeError as e:
+            if ctx is not None:
+                ctx.error = type(e).__name__
             raise  # deadline cancel: already in _timeouts
-        except Exception:
+        except Exception as e:
+            if ctx is not None:
+                ctx.error = type(e).__name__
             self._account_exec(t0, error=True)
             raise
         finally:

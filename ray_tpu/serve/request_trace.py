@@ -92,7 +92,7 @@ class RequestTrace:
 
     __slots__ = ("request_id", "trace_id", "parent_span_id", "sampled",
                  "deployment", "phases", "replays", "root_span", "owned",
-                 "replica_hop", "_done")
+                 "replica_hop", "error", "_done")
 
     def __init__(self, request_id: str, trace_id: str,
                  parent_span_id: str = "", sampled: bool = True,
@@ -115,6 +115,10 @@ class RequestTrace:
         # phase record); it minted a child via exec-span adoption before
         # the replica bound anything, and still does.
         self.replica_hop = False
+        # Exception class name when the request failed on this hop; rides
+        # the hop event's spare slot into the GCS buffer, where the trace
+        # search's --errors-only filter keys on it.
+        self.error = ""
         self._done = False
 
     # -- phase stamps ---------------------------------------------------
@@ -323,12 +327,13 @@ def record_event(ctx: RequestTrace, hop: str,
         _observe_phases(ctx.deployment, phases)
     _ring.record(ctx.request_id, ctx.trace_id, ctx.deployment, hop,
                  tuple(phases) if phases is not None else None,
-                 ctx.replays, time.time() if t is None else t, None)
+                 ctx.replays, time.time() if t is None else t,
+                 ctx.error or None)
     _ensure_flusher()
 
 
 def _fold(rec) -> dict:
-    rid, trace_id, deployment, hop, phases, replays, t, _spare = rec
+    rid, trace_id, deployment, hop, phases, replays, t, error = rec
     out = {
         "kind": "serve_request", "request_id": rid, "trace_id": trace_id,
         "deployment": deployment, "hop": hop, "time": t,
@@ -338,6 +343,8 @@ def _fold(rec) -> dict:
         out["phases"] = list(phases)
     if replays:
         out["replays"] = replays
+    if error:
+        out["error"] = error
     return out
 
 

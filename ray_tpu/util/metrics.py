@@ -281,6 +281,50 @@ def start_loop_lag_probe_once(process: str, interval: float = 0.2):
         raise
 
 
+class MetricsAgent:
+    """Delta-frame shipper for one daemon's report loop.
+
+    Wraps a FrameEncoder plus the resync protocol: every reply carries
+    the GCS incarnation epoch, and an epoch change (head restart) or an
+    explicit ``resync`` (the GCS evicted this reporter's decoder) resets
+    the encoder so the next frame re-ships interned definitions. Frames
+    carry absolute values, so a retried ship never double-counts.
+
+    The agent also self-measures: frames and encoded bytes go into the
+    process registry (and therefore ride the *next* frame), which is how
+    the bench derives per-frame wire cost.
+    """
+
+    def __init__(self, reporter: str, request):
+        from ray_tpu._private.tsdb import FrameEncoder
+        self.reporter = reporter
+        self._request = request   # async callable(method, payload)
+        self._enc = FrameEncoder()
+        self._epoch: Optional[str] = None
+        self._frames = Counter(
+            "ray_tpu_metrics_frames_total",
+            "delta-encoded metric frames shipped to the GCS")
+        self._bytes = Counter(
+            "ray_tpu_metrics_frame_bytes_total",
+            "pickled payload bytes of shipped metric frames")
+
+    async def ship(self, snap: List[dict]) -> None:
+        frame = self._enc.encode(snap)
+        if frame is None:
+            return
+        import pickle
+        self._frames.inc(1)
+        self._bytes.inc(len(pickle.dumps(frame)))
+        reply = await self._request("report_metrics_frame",
+                                    {"reporter": self.reporter,
+                                     "frame": frame})
+        epoch = (reply or {}).get("epoch")
+        if (reply or {}).get("resync") or (self._epoch is not None
+                                           and epoch != self._epoch):
+            self._enc.reset()
+        self._epoch = epoch
+
+
 def to_prometheus(metrics: List[dict]) -> str:
     """Render merged metrics in Prometheus text exposition format."""
     lines: List[str] = []

@@ -149,3 +149,18 @@ def test_bench_smoke_completes(jax_cpu):
     assert row["ingest_peak_queue_depth"] <= \
         row["ingest_queue_depth_bound"], row
     assert row["ingest_blocked_puts"] > 0, row
+    # Telemetry A/B (ISSUE 18): delta-frame shipping on vs off on fresh
+    # clusters. Frames must actually have shipped (and stay small —
+    # steady-state deltas are a few hundred bytes, not re-sent
+    # catalogs). The acceptance <= 2% overhead bound is judged on the
+    # recorded BENCH_r*.json from an idle box; here the bound is set at
+    # the box's measured run-to-run burst noise so only a gross
+    # regression (per-request shipping work) can trip it.
+    for key in ("telemetry_off_rate", "telemetry_on_rate",
+                "telemetry_overhead_pct", "telemetry_frames_shipped",
+                "telemetry_frame_bytes_avg"):
+        assert key in row, (key, row)
+    assert row["telemetry_frames_shipped"] >= 1, row
+    assert 1.0 <= row["telemetry_frame_bytes_avg"] <= 65536.0, row
+    if MULTI_CPU:
+        assert row["telemetry_overhead_pct"] <= 15.0, row

@@ -1,0 +1,343 @@
+"""Unit tests for the telemetry plane: the TSDB ring store, the
+delta-frame codec, and the agent-side claim/resync behaviour.
+
+All in-process (no cluster) — the live end-to-end path is covered by
+tests/test_telemetry.py.
+"""
+
+import asyncio
+
+import pytest
+
+from ray_tpu._private.tsdb import (FrameDecoder, FrameEncoder, ResyncNeeded,
+                                   TSDB, _bucket_quantile)
+
+
+def _counter(name, value, tags=None):
+    return {"name": name, "type": "counter", "description": "",
+            "tags": tags or {}, "value": value}
+
+
+def _gauge(name, value, tags=None):
+    return {"name": name, "type": "gauge", "description": "",
+            "tags": tags or {}, "value": value}
+
+
+def _hist(name, counts, hsum, count, bounds=(0.1, 1.0, 10.0), tags=None):
+    return {"name": name, "type": "histogram", "description": "",
+            "tags": tags or {}, "bounds": list(bounds),
+            "bucket_counts": list(counts), "sum": hsum, "count": count}
+
+
+# ---------------------------------------------------------------- codec
+
+
+def test_frame_encoder_ships_changed_series_only():
+    enc = FrameEncoder()
+    snap = [_counter("c", 1), _gauge("g", 5)]
+    f1 = enc.encode(snap)
+    assert len(f1["defs"]) == 2 and len(f1["rows"]) == 2
+
+    # Nothing changed -> no frame at all.
+    assert enc.encode(snap) is None
+
+    # Only the counter moved -> one row, no new defs.
+    f2 = enc.encode([_counter("c", 3), _gauge("g", 5)])
+    assert not f2["defs"]
+    assert f2["rows"] == [[0, 3]]
+
+
+def test_frame_roundtrip_and_resync():
+    enc, dec = FrameEncoder(), FrameDecoder()
+    changed = dec.decode(enc.encode([_counter("c", 2),
+                                     _hist("h", [1, 0, 0, 0], 0.05, 1)]))
+    assert {m["name"] for m in changed} == {"c", "h"}
+
+    # Decoder snapshot reconstructs the full reporter view.
+    snap = {m["name"]: m for m in dec.snapshot()}
+    assert snap["c"]["value"] == 2
+    assert snap["h"]["bucket_counts"] == [1, 0, 0, 0]
+
+    # A fresh decoder (GCS restart) can't resolve interned ids.
+    with pytest.raises(ResyncNeeded):
+        FrameDecoder().decode(enc.encode([_counter("c", 4)]))
+
+    # Agent resets -> defs re-shipped -> new decoder catches up.
+    enc.reset()
+    dec2 = FrameDecoder()
+    dec2.decode(enc.encode([_counter("c", 5)]))
+    assert dec2.snapshot()[0]["value"] == 5
+
+
+def test_metrics_agent_resync_protocol():
+    """An explicit resync reply (or epoch change) resets the encoder so
+    the next frame carries definitions again."""
+    from ray_tpu.util import metrics as M
+
+    replies = [{"epoch": "e1", "resync": False},
+               {"epoch": "e1", "resync": True},
+               {"epoch": "e1", "resync": False}]
+    frames = []
+
+    async def fake_request(method, payload):
+        assert method == "report_metrics_frame"
+        frames.append(payload["frame"])
+        return replies[len(frames) - 1]
+
+    agent = M.MetricsAgent("test:agent", fake_request)
+
+    async def drive():
+        await agent.ship([_counter("c", 1)])
+        await agent.ship([_counter("c", 2)])   # reply says resync
+        await agent.ship([_counter("c", 3)])   # must re-ship defs
+
+    asyncio.run(drive())
+    assert len(frames) == 3
+    assert frames[0]["defs"] and not frames[1]["defs"]
+    assert frames[2]["defs"], "resync reply did not reset the encoder"
+
+
+# ---------------------------------------------------------------- ingest
+
+
+def test_counter_first_sight_baseline_and_restart_clamp():
+    db = TSDB(retention_s=60, resolution_s=1, max_series=64)
+    db.ingest("rep", [_counter("c", 100)], now=10.0)   # baseline: no charge
+    db.ingest("rep", [_counter("c", 103)], now=11.0)   # +3
+    db.ingest("rep", [_counter("c", 2)], now=12.0)     # restart: +2
+    pts = db.query("c", fold="value", now=12.0)[0]["points"]
+    assert pts[-1][1] == 5.0
+
+
+def test_gauge_sums_reporters_and_drop_reporter():
+    db = TSDB(retention_s=60, resolution_s=1)
+    db.ingest("a", [_gauge("g", 3)], now=5.0)
+    db.ingest("b", [_gauge("g", 4)], now=5.2)
+    assert db.query("g", fold="latest", now=6.0)[0]["points"][0][1] == 7.0
+    db.drop_reporter("b")
+    db.ingest("a", [_gauge("g", 3)], now=6.0)
+    assert db.query("g", fold="latest", now=7.0)[0]["points"][0][1] == 3.0
+
+
+def test_reingest_same_absolutes_charges_nothing():
+    """Frames carry absolutes, so a replayed/retried ship is idempotent."""
+    db = TSDB(retention_s=60, resolution_s=1)
+    db.ingest("rep", [_counter("c", 5)], now=1.0)
+    db.ingest("rep", [_counter("c", 9)], now=2.0)
+    db.ingest("rep", [_counter("c", 9)], now=3.0)  # replay
+    pts = db.query("c", fold="value", now=3.0)[0]["points"]
+    assert pts[-1][1] == 4.0
+
+
+def test_cardinality_bound_bumps_drop_counter():
+    db = TSDB(retention_s=60, resolution_s=1, max_series=3)
+    for i in range(5):
+        db.ingest("rep", [_gauge("g", 1, tags={"Id": str(i)})], now=1.0)
+    assert db.n_series == 3
+    assert db.dropped_total == 2
+    # Existing series still accept writes.
+    db.ingest("rep", [_gauge("g", 9, tags={"Id": "0"})], now=2.0)
+    assert db.dropped_total == 2
+
+
+def test_ring_wraps_at_retention():
+    db = TSDB(retention_s=10, resolution_s=1)  # 10 slots
+    for t in range(40):
+        db.ingest("rep", [_counter("c", t)], now=float(t))
+    pts = db.query("c", fold="value", window_s=100, now=39.0)[0]["points"]
+    assert len(pts) <= db.nslots
+    assert pts[0][0] >= 30.0  # old slots overwritten
+    assert pts[-1] == [39.0, 39.0]  # baseline 0 at t=0, +1 each tick
+
+
+# ----------------------------------------------------------------- query
+
+
+def test_rate_fold_matches_hand_computed():
+    db = TSDB(retention_s=60, resolution_s=2)
+    db.ingest("rep", [_counter("c", 0)], now=0.0)
+    db.ingest("rep", [_counter("c", 10)], now=2.0)
+    db.ingest("rep", [_counter("c", 16)], now=4.0)
+    pts = dict(map(tuple, db.query("c", fold="rate", window_s=10,
+                                   now=4.0)[0]["points"]))
+    assert pts[2.0] == pytest.approx(5.0)  # 10 over a 2 s slot
+    assert pts[4.0] == pytest.approx(3.0)
+
+
+def test_histogram_folds_vs_hand_computed():
+    bounds = (0.1, 1.0, 10.0)
+    db = TSDB(retention_s=60, resolution_s=1)
+    db.ingest("rep", [_hist("h", [0, 0, 0, 0], 0.0, 0, bounds)], now=0.0)
+    # 8 samples in (0.1, 1.0], 2 in (1.0, 10.0]; sum 10.0.
+    db.ingest("rep", [_hist("h", [0, 8, 2, 0], 10.0, 10, bounds)], now=1.0)
+    res = {f: db.query("h", fold=f, window_s=5, now=1.0)[0]["points"]
+           for f in ("mean", "p50", "p99", "rate", "value")}
+    assert res["mean"][-1][1] == pytest.approx(1.0)
+    # p50: 5th of 8 samples in (0.1, 1.0] -> 0.1 + (5/8)*0.9
+    assert res["p50"][-1][1] == pytest.approx(0.1 + 0.9 * 5 / 8)
+    # p99: target 9.9 lands in (1.0, 10.0] at frac (9.9-8)/2
+    assert res["p99"][-1][1] == pytest.approx(1.0 + 9.0 * 1.9 / 2)
+    assert res["rate"][-1][1] == pytest.approx(10.0)
+    assert res["value"][-1][1] == 10  # cumulative count
+
+
+def test_carry_forward_fills_silent_slots():
+    db = TSDB(retention_s=60, resolution_s=1)
+    db.ingest("rep", [_counter("c", 0)], now=0.0)
+    db.ingest("rep", [_counter("c", 4)], now=1.0)
+    db.ingest("rep", [_counter("c", 6)], now=5.0)  # silent 2..4
+    pts = dict(map(tuple, db.query("c", fold="rate", window_s=10,
+                                   now=5.0)[0]["points"]))
+    assert pts[3.0] == pytest.approx(0.0)  # flat step, not a hole
+    assert pts[5.0] == pytest.approx(2.0)
+
+
+def test_query_tag_subset_filter():
+    db = TSDB(retention_s=60, resolution_s=1)
+    db.ingest("rep", [_gauge("g", 1, {"Node": "a", "Kind": "x"}),
+                      _gauge("g", 2, {"Node": "b", "Kind": "x"})], now=1.0)
+    res = db.query("g", tags={"Node": "a"}, fold="latest", now=2.0)
+    assert len(res) == 1 and res[0]["tags"]["Node"] == "a"
+    assert len(db.query("g", tags={"Kind": "x"}, fold="latest",
+                        now=2.0)) == 2
+
+
+def test_bucket_quantile_edge_cases():
+    assert _bucket_quantile([1.0], [5], 5, 0.5) == pytest.approx(0.5)
+    assert _bucket_quantile([1.0, 2.0], [0, 4], 4, 1.0) == pytest.approx(2.0)
+    assert _bucket_quantile([], [], 0, 0.5) == 0.0
+
+
+# ----------------------------------------------- reporter claim regression
+
+
+def test_single_claimant_per_process():
+    """Co-resident daemons share one registry; exactly one may ship it.
+    Regression for double-shipped frames inflating every counter 2x."""
+    from ray_tpu.util import metrics as M
+
+    a, b = object(), object()
+    had = M._reporter_owner
+    try:
+        M._reporter_owner = None
+        assert M.claim_reporter(a)
+        assert not M.claim_reporter(b)
+        assert M.claim_reporter(a)       # refresh keeps ownership
+        M.release_reporter(a)
+        assert M.claim_reporter(b)       # freed slot transfers
+    finally:
+        M._reporter_owner = had
+
+
+def test_top_render_smoke_non_tty():
+    """`ray_tpu top --once` rendering from canned query results — pure
+    function, no terminal, no cluster."""
+    from ray_tpu.scripts.top import render, sparkline
+
+    data = {
+        "serve_qps": [{"tags": {"Deployment": "Echo"},
+                       "points": [[0, 1.0], [5, 3.5]]}],
+        "serve_p99": [{"tags": {"Deployment": "Echo", "Phase": "total"},
+                       "points": [[5, 0.03]]}],
+        "serve_burn": [{"tags": {"Deployment": "Echo", "Window": "fast"},
+                        "points": [[5, 2.5]]}],
+        "node_cpu": [{"tags": {"Node": "abc"},
+                      "points": [[0, 0.25], [5, 0.75]]}],
+        "loop_lag": [{"tags": {"Process": "gcs"}, "points": [[5, 0.004]]}],
+    }
+    out = render(data)
+    for needle in ("Echo", "30.0", "2.5", "serve", "podracer", "nodes"):
+        assert needle in out
+    assert render({}).count("\n") > 5  # empty cluster still renders
+    assert sparkline([[0, 0], [1, 1], [2, 2]]) == "▁▄█"
+
+
+# ----------------------------------------------------------------- soaks
+
+
+@pytest.mark.slow
+def test_tsdb_concurrent_ingest_query_soak():
+    """Minutes of interleaved multi-reporter ingest + query with ring
+    wrap and cardinality churn: no exception, bounded series count,
+    folds stay finite."""
+    import threading
+
+    db = TSDB(retention_s=5, resolution_s=0.1, max_series=128)
+    stop = threading.Event()
+    errors = []
+
+    def writer(rep, offset):
+        t = 0.0
+        v = 0
+        while not stop.is_set():
+            v += offset
+            try:
+                db.ingest(rep, [
+                    _counter("soak_c", v, tags={"R": rep}),
+                    _gauge("soak_g", v % 7),
+                    _hist("soak_h", [v % 3, v % 5, v, 0], float(v), v),
+                    # Churn: rotating tag values probe the bound.
+                    _gauge("soak_churn", 1, tags={"Id": str(v % 500)}),
+                ], now=t)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            t += 0.03
+
+    def reader():
+        while not stop.is_set():
+            try:
+                for fold in ("value", "rate", "p95", "latest"):
+                    for name in ("soak_c", "soak_g", "soak_h"):
+                        for s in db.query(name, fold=fold, window_s=4,
+                                          now=1e9):
+                            for _, v in s["points"]:
+                                assert v == v  # not NaN
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = ([threading.Thread(target=writer, args=(f"rep{i}", i + 1))
+                for i in range(4)] + [threading.Thread(target=reader)])
+    for th in threads:
+        th.start()
+    import time as _time
+    _time.sleep(20)
+    stop.set()
+    for th in threads:
+        th.join(30)
+    assert not errors, errors[:3]
+    assert db.n_series <= 128
+    assert db.dropped_total > 0  # the churn metric hit the bound
+
+
+@pytest.mark.slow
+def test_frame_codec_soak_random_walk():
+    """Hours' worth of report ticks through encoder->decoder: the
+    decoder's reconstructed snapshot must equal the registry state after
+    every frame, across periodic resyncs."""
+    enc, dec = FrameEncoder(), FrameDecoder()
+    state = {}
+    for step in range(5000):
+        # Deterministic pseudo-random walk (no Date/random in tests
+        # that must reproduce): mutate a rotating subset.
+        for k in range(step % 7):
+            name = f"m{(step * 31 + k * 17) % 40}"
+            state[name] = state.get(name, 0) + ((step + k) % 5)
+        snap = [_counter(n, v) for n, v in sorted(state.items())]
+        frame = enc.encode(snap)
+        if frame is None:
+            continue
+        if step % 811 == 0 and step:
+            # GCS restart: fresh decoder, agent resyncs.
+            dec = FrameDecoder()
+            try:
+                dec.decode(frame)
+            except ResyncNeeded:
+                enc.reset()
+                frame = enc.encode(snap)
+            dec.decode(frame) if frame else None
+        else:
+            dec.decode(frame)
+        got = {m["name"]: m["value"] for m in dec.snapshot()}
+        assert got == state, step
